@@ -1,0 +1,69 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's API surface.
+
+Built from scratch on JAX/XLA/Pallas: eager mode records jax.vjp pullbacks on a tape
+(dygraph parity), jit mode traces the same code into XLA (static-graph parity), and
+distributed training maps Fleet semantics onto jax.sharding meshes and ICI
+collectives. See SURVEY.md for the reference layer map this mirrors.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# fp32 tensors must get true-fp32 matmul/conv accumulation (reference CUDA fp32
+# kernel semantics). jax's DEFAULT precision lowers fp32 matmuls to bf16 passes
+# on TPU; the perf path here is explicit bf16/AMP dtypes, which are unaffected.
+_jax.config.update("jax_default_matmul_precision", "highest")
+
+from .core import dtypes  # noqa: F401
+from .core.device import (CPUPlace, CUDAPlace, Place, TPUPlace,  # noqa: F401
+                          device_count, get_device, is_compiled_with_cuda,
+                          is_compiled_with_tpu, set_device)
+from .core.dtype import (bfloat16, bool_, complex64, complex128,  # noqa: F401
+                         float16, float32, float64, get_default_dtype, int8,
+                         int16, int32, int64, set_default_dtype, uint8)
+from .core.random import get_rng_state, seed, set_rng_state  # noqa: F401
+from .core.tensor import (Parameter, Tensor, enable_grad, grad,  # noqa: F401
+                          is_grad_enabled, no_grad)
+from .framework_io import load, save  # noqa: F401
+from .tensor import *  # noqa: F401,F403
+from .tensor import einsum  # noqa: F401
+
+from . import amp  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from .nn.layer.layers import ParamAttr  # noqa: F401,E402
+
+# paddle.disable_static / enable_static parity: eager is the default and the
+# "static" mode is jax.jit tracing — both are always available, so these are
+# no-ops kept for API compatibility.
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    return None
+
+
+def in_dynamic_mode():
+    return True
+
+
+def summary(net, input_size=None, dtypes=None):
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if p.trainable)
+    lines = [f"Total params: {total:,}", f"Trainable params: {trainable:,}"]
+    report = "\n".join(lines)
+    print(report)
+    return {"total_params": total, "trainable_params": trainable}

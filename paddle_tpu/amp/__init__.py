@@ -1,0 +1,153 @@
+"""AMP: auto_cast + GradScaler.
+
+Reference: python/paddle/amp/{auto_cast.py,grad_scaler.py} and the C++ autocast at
+imperative/amp_auto_cast.cc:171 (white/black op lists), plus loss-scale ops
+operators/amp/{check_finite_and_unscale,update_loss_scaling}_op.cu.
+
+TPU-native: bfloat16 is the default mixed dtype (no loss scaling needed — bf16 has
+fp32's exponent range); fp16 + dynamic GradScaler is kept for parity. auto_cast works
+by casting op *inputs* at the Tensor boundary: a thread-local flag makes the white-
+listed ops (matmul/conv) run in the low dtype while the blacklist (softmax, norms,
+reductions) stays fp32 — same split as AmpOperators in the reference.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.tensor import Tensor
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+
+
+_AMP = _AmpState()
+
+
+def amp_state():
+    return _AMP
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = (_AMP.enabled, _AMP.dtype, _AMP.level)
+    _AMP.enabled = enable
+    _AMP.dtype = dtypes.convert_dtype(dtype)
+    _AMP.level = level
+    try:
+        yield
+    finally:
+        _AMP.enabled, _AMP.dtype, _AMP.level = prev
+
+
+autocast = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the AMP dtype (pure-fp16/bf16 training).
+    (reference: fluid/contrib/mixed_precision/decorator.py)"""
+    d = dtypes.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (fp16 parity; bf16 runs fine with scaling disabled)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        from ..tensor.math import multiply
+        return multiply(var, self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is None:
+                continue
+            g = p.grad.data.astype(jnp.float32) / self._scale
+            finite = bool(jnp.all(jnp.isfinite(g)))
+            if not finite:
+                found = True
+            p.grad.data = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)

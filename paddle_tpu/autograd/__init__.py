@@ -1,0 +1,109 @@
+"""paddle.autograd analog: backward, grad, PyLayer.
+
+Reference: imperative/basic_engine.cc (backward), partial_grad_engine.cc
+(paddle.grad), python/paddle/autograd/py_layer.py (PyLayer custom-vjp).
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+
+from ..core.tensor import Tensor, apply, backward as _backward, grad  # noqa: F401
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for i, t in enumerate(tensors):
+        _backward(t, grad_tensors[i],
+                  retain_graph=True if i < len(tensors) - 1 else retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom op with user-defined forward/backward.
+
+    The backward is registered through jax.custom_vjp so the same definition
+    works in eager mode (tape) and under jit tracing.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+
+        tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+
+        def fwd_raw(*arrays):
+            wrapped = list(args)
+            for i, arr in zip(tensor_idx, arrays):
+                w = Tensor(arr)
+                w.stop_gradient = True
+                wrapped[i] = w
+            out = cls.forward(ctx, *wrapped, **kwargs)
+            single = not isinstance(out, (tuple, list))
+            outs = (out,) if single else tuple(out)
+            return tuple(o.data if isinstance(o, Tensor) else o for o in outs), \
+                single
+
+        @jax.custom_vjp
+        def f(*arrays):
+            outs, single = fwd_raw(*arrays)
+            return outs[0] if single else outs
+
+        def f_fwd(*arrays):
+            outs, single = fwd_raw(*arrays)
+            return (outs[0] if single else outs), None
+
+        def f_bwd(res, cot):
+            cots = (cot,) if not isinstance(cot, tuple) else cot
+            grads = cls.backward(ctx, *[Tensor(c) for c in cots])
+            gs = (grads,) if isinstance(grads, Tensor) else tuple(grads)
+            return tuple(g.data if isinstance(g, Tensor) else g for g in gs)
+
+        f.defvjp(f_fwd, f_bwd)
+        return apply(f, *[args[i] for i in tensor_idx])
+
+
+def set_grad_enabled(mode: bool):
+    from ..core import tensor as ct
+
+    class _Ctx:
+        def __enter__(self):
+            self.prev = ct._STATE.grad_enabled
+            ct._STATE.grad_enabled = mode
+
+        def __exit__(self, *exc):
+            ct._STATE.grad_enabled = self.prev
+
+    return _Ctx()
+
+
+def is_grad_enabled():
+    from ..core.tensor import is_grad_enabled as _ige
+    return _ige()

@@ -1,0 +1,12 @@
+from . import dtype as dtypes
+from .device import (CPUPlace, CUDAPlace, Place, TPUPlace, device_count,
+                     get_device, is_compiled_with_cuda, is_compiled_with_tpu,
+                     set_device)
+from .dtype import (bfloat16, bool_, complex64, complex128, convert_dtype,
+                    float16, float32, float64, get_default_dtype, int8, int16,
+                    int32, int64, is_floating_point, is_integer,
+                    set_default_dtype, uint8)
+from .random import (RNGStatesTracker, get_rng_state, get_rng_state_tracker,
+                     next_key, seed, set_rng_state)
+from .tensor import (Parameter, Tensor, apply, backward, enable_grad, grad,
+                     is_grad_enabled, no_grad, reset_tape, to_array)

@@ -1,0 +1,81 @@
+"""Dtype registry.
+
+Mirrors the reference's dtype surface (paddle.float32 etc.; see
+/root/reference/python/paddle/fluid/core.py VarDesc.VarType mapping) but is a thin
+veneer over numpy/jax dtypes — XLA owns layout and packing on TPU, so no LoD/layout
+metadata is carried here.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects are jnp dtypes so they flow into jax without conversion.
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_TO_DTYPE = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGRAL = {int8, int16, int32, int64, uint8, uint16, uint32, uint64}
+
+
+def convert_dtype(dtype):
+    """Normalize str/np.dtype/jnp dtype into a canonical numpy dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR_TO_DTYPE:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+        return np.dtype(_STR_TO_DTYPE[dtype])
+    return np.dtype(dtype)
+
+
+def is_floating_point(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in (np.dtype(t) for t in _FLOATING)
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in (np.dtype(t) for t in _INTEGRAL)
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(convert_dtype(dtype)).name
+
+
+_DEFAULT_DTYPE = [np.dtype(float32)]
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def set_default_dtype(dtype):
+    d = convert_dtype(dtype)
+    if not is_floating_point(d):
+        raise TypeError("default dtype must be floating point")
+    _DEFAULT_DTYPE[0] = d

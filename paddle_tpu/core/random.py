@@ -1,0 +1,115 @@
+"""Global RNG state + per-axis RNG trackers.
+
+Reference: paddle.seed → per-device generator; TP seed-splitting lives in
+/root/reference/python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py
+(RNGStatesTracker) and fleet_base.py:320-326 (model-parallel seed offsets).
+
+TPU-native: a single threading-local (seed, counter) pair from which jax PRNG keys are
+derived by folding the counter; named tracker states give the
+"same-seed-across-dp / distinct-seed-across-mp" semantics needed for dropout under
+tensor parallelism.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.seed = 0
+        self.counter = 0
+        self.tracker_states = {}  # name -> (seed, counter)
+        self.active = None  # name of active tracker state or None
+        self.base_key = None  # traced key threaded in by jit runners
+
+
+_RNG = _RngState()
+
+
+@contextlib.contextmanager
+def key_context(key):
+    """Thread a (possibly traced) PRNG key through a region.
+
+    jit train steps pass a fresh per-step key as an argument and enter this
+    context before calling model code, so dropout masks are data-dependent on
+    the traced key rather than baked into the compiled executable."""
+    prev = _RNG.base_key
+    _RNG.base_key = key
+    try:
+        yield
+    finally:
+        _RNG.base_key = prev
+
+
+def seed(s: int):
+    _RNG.seed = int(s)
+    _RNG.counter = 0
+    return s
+
+
+def next_key() -> jax.Array:
+    """Fresh PRNG key; advances the active state's counter."""
+    if _RNG.active is not None:
+        s, c = _RNG.tracker_states[_RNG.active]
+        _RNG.tracker_states[_RNG.active] = (s, c + 1)
+    else:
+        s, c = _RNG.seed, _RNG.counter
+        _RNG.counter += 1
+    if _RNG.base_key is not None:
+        # traced path: derive from the threaded key so the draw stays
+        # data-dependent inside jit (fresh randomness every executed step)
+        return jax.random.fold_in(jax.random.fold_in(_RNG.base_key, s), c)
+    return jax.random.fold_in(jax.random.PRNGKey(s), c)
+
+
+def get_rng_state():
+    return (_RNG.seed, _RNG.counter, dict(_RNG.tracker_states))
+
+
+def set_rng_state(state):
+    _RNG.seed, _RNG.counter, _RNG.tracker_states = state[0], state[1], dict(state[2])
+
+
+class RNGStatesTracker:
+    """Named RNG streams (parallel_layers/random.py:RNGStatesTracker analog)."""
+
+    def add(self, name: str, seed_: int):
+        if name in _RNG.tracker_states:
+            raise ValueError(f"RNG state {name!r} already exists")
+        _RNG.tracker_states[name] = (int(seed_), 0)
+
+    def states(self):
+        return dict(_RNG.tracker_states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        if name not in _RNG.tracker_states:
+            raise ValueError(f"RNG state {name!r} not added")
+        prev = _RNG.active
+        _RNG.active = name
+        try:
+            yield
+        finally:
+            _RNG.active = prev
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER
+
+
+def model_parallel_random_seed(base_seed: int, mp_rank: int, dp_rank: int = 0):
+    """fleet_base.py:320-326 analog: local (per-mp-rank) and global streams."""
+    global_seed = base_seed + dp_rank * 1000
+    local_seed = base_seed + 1024 + mp_rank * 100 + dp_rank * 1000
+    st = _RNG.tracker_states
+    st.pop("global_seed", None)
+    st.pop("local_seed", None)
+    _TRACKER.add("global_seed", global_seed)
+    _TRACKER.add("local_seed", local_seed)
+    seed(global_seed)

@@ -1,0 +1,412 @@
+"""Tensor + eager autograd tape.
+
+The reference implements eager mode with a C++ tracer that records a GradOpNode per op
+(/root/reference/paddle/fluid/imperative/tracer.cc:144,231) and a queue-driven backward
+engine (imperative/basic_engine.cc:305) with per-leaf gradient accumulators
+(imperative/gradient_accumulator.cc).
+
+TPU-native redesign: every eager op is a pure jax function. When gradients are enabled
+and an input requires grad, the op is executed through `jax.vjp`, which both runs the
+forward on-device and returns a host-side pullback closure holding on-device residuals.
+The pullbacks form a linear tape (execution order), so backward is a single reverse
+sweep — no op registry, no grad-op makers, no kernel dispatch: XLA differentiates every
+primitive. The jit path (`paddle_tpu.jit`, functional training steps) bypasses the tape
+entirely and uses jax.grad over a functionalized module call, which is the performance
+path on TPU.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .device import Place, get_device
+
+
+class _TapeState(threading.local):
+    def __init__(self):
+        self.grad_enabled: bool = True
+        self.seq: int = 0  # monotone op counter orders the reverse sweep
+
+
+_STATE = _TapeState()
+
+
+class _Node:
+    """One recorded eager op: pullback + links to diff inputs and outputs.
+
+    Nodes are owned by their output Tensors (no global tape), so autograd
+    graphs are freed by ordinary GC as soon as the activations die — an eval
+    loop without no_grad() cannot grow memory unboundedly. backward() walks
+    the graph from the loss and sweeps in reverse `seq` order."""
+
+    __slots__ = ("vjp_fn", "inputs", "outputs", "out_grads", "single", "seq")
+
+    def __init__(self, vjp_fn, inputs, outputs, single, seq):
+        self.vjp_fn = vjp_fn
+        self.inputs: List["Tensor"] = inputs
+        self.outputs: List["Tensor"] = outputs
+        self.out_grads: List[Optional[jax.Array]] = [None] * len(outputs)
+        self.single = single  # forward returned a bare array (not a tuple)
+        self.seq = seq
+
+    def seed(self, index: int, grad: jax.Array):
+        if self.out_grads[index] is None:
+            self.out_grads[index] = grad
+        else:
+            self.out_grads[index] = self.out_grads[index] + grad
+
+
+def is_grad_enabled() -> bool:
+    return _STATE.grad_enabled
+
+
+class no_grad:
+    """Context manager + decorator disabling tape recording (paddle.no_grad parity)."""
+
+    def __enter__(self):
+        self._prev = _STATE.grad_enabled
+        _STATE.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _STATE.grad_enabled
+        _STATE.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.grad_enabled = self._prev
+        return False
+
+
+def reset_tape():
+    """Kept for API compatibility; graphs are GC-owned so there is no global
+    tape to clear."""
+    _STATE.seq = 0
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def to_array(value, dtype=None) -> jax.Array:
+    """Convert arbitrary input to a jax.Array (host numpy path for lists/scalars)."""
+    if isinstance(value, Tensor):
+        arr = value.data
+    elif isinstance(value, (jax.Array,)) or _is_tracer(value):
+        arr = value
+    else:
+        arr = jnp.asarray(np.asarray(value))
+    if dtype is not None:
+        arr = arr.astype(dtypes.convert_dtype(dtype))
+    return arr
+
+
+class Tensor:
+    """Eager tensor: a jax.Array plus autograd metadata.
+
+    `stop_gradient` defaults True (paddle semantics); Parameters flip it to False.
+    """
+
+    __slots__ = ("data", "stop_gradient", "grad", "name", "_node", "_out_index",
+                 "persistable", "__weakref__")
+
+    def __init__(self, data, dtype=None, place: Optional[Place] = None,
+                 stop_gradient: bool = True, name: Optional[str] = None):
+        self.data = to_array(data, dtype)
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self.name = name
+        self.persistable = False
+        self._node: Optional[_Node] = None
+        self._out_index: int = 0
+
+    # ---- metadata ----
+    @property
+    def shape(self):
+        return list(self.data.shape)
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self.data.shape)) if self.data.shape else 1
+
+    @property
+    def dtype(self):
+        return np.dtype(self.data.dtype)
+
+    @property
+    def place(self):
+        return get_device()
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.data.ndim
+
+    # ---- conversion ----
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.data)
+
+    def item(self):
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __len__(self):
+        if not self.data.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self.data.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    # ---- autograd ----
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self.data, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self) -> "Tensor":
+        return apply(lambda x: x + 0, self)
+
+    def backward(self, grad_tensor: Optional["Tensor"] = None,
+                 retain_graph: bool = False):
+        backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def _accumulate_grad(self, g: jax.Array):
+        if self.grad is None:
+            self.grad = Tensor(g)
+        else:
+            self.grad = Tensor(self.grad.data + g)
+
+    # ---- mutation (optimizer updates, state loading) ----
+    def set_value(self, value):
+        arr = to_array(value)
+        if tuple(arr.shape) != tuple(self.data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {arr.shape} vs {self.data.shape}")
+        self.data = arr.astype(self.data.dtype)
+
+    def copy_(self, other, *_):
+        self.set_value(other)
+        return self
+
+    # ---- basic ops (full surface lives in paddle_tpu.tensor.*) ----
+    def astype(self, dtype) -> "Tensor":
+        d = dtypes.convert_dtype(dtype)
+        return apply(lambda x: x.astype(d), self)
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def __repr__(self):
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"stop_gradient={self.stop_gradient},\n{self.numpy()})")
+
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return apply(lambda x: x[idx], self)
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        val = to_array(value)
+        self.data = self.data.at[idx].set(val.astype(self.data.dtype))
+
+    # arithmetic operators are patched in by paddle_tpu.tensor.math to avoid a
+    # circular import; see paddle_tpu/tensor/__init__.py::monkey_patch_tensor.
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx.data
+    if isinstance(idx, tuple):
+        return tuple(i.data if isinstance(i, Tensor) else i for i in idx)
+    return idx
+
+
+class Parameter(Tensor):
+    """Trainable tensor (stop_gradient=False, persistable). Unlike activations
+    (slotted for footprint), Parameters carry an open __dict__ for attrs like
+    optimize_attr / partition_spec / no_weight_decay."""
+
+    __slots__ = ("trainable", "__dict__")
+
+    def __init__(self, data, dtype=None, name: Optional[str] = None,
+                 trainable: bool = True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+
+
+def _wrap_outputs(outs, node_needed: bool):
+    single = not isinstance(outs, (tuple, list))
+    outs_t = (outs,) if single else tuple(outs)
+    tensors = []
+    for o in outs_t:
+        t = Tensor(o, stop_gradient=not node_needed)
+        tensors.append(t)
+    return tensors, single
+
+
+def apply(fn: Callable, *args, **kwargs):
+    """Run a pure jax function over Tensor/array args, recording a tape node when
+    any floating-point Tensor input requires grad. Returns Tensor(s)."""
+    raw = [a.data if isinstance(a, Tensor) else a for a in args]
+    diff_idx = []
+    if _STATE.grad_enabled:
+        for i, a in enumerate(args):
+            if (isinstance(a, Tensor) and not a.stop_gradient
+                    and dtypes.is_floating_point(a.dtype)):
+                diff_idx.append(i)
+
+    if not diff_idx:
+        outs = fn(*raw, **kwargs)
+        tensors, single = _wrap_outputs(outs, node_needed=False)
+        return tensors[0] if single else tuple(tensors)
+
+    def closed(*diff_vals):
+        vals = list(raw)
+        for i, v in zip(diff_idx, diff_vals):
+            vals[i] = v
+        return fn(*vals, **kwargs)
+
+    outs, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])
+    tensors, single = _wrap_outputs(outs, node_needed=True)
+    _STATE.seq += 1
+    node = _Node(vjp_fn, [args[i] for i in diff_idx], tensors, single,
+                 _STATE.seq)
+    for k, t in enumerate(tensors):
+        t._node = node
+        t._out_index = k
+    return tensors[0] if single else tuple(tensors)
+
+
+def _reachable_nodes(roots: List[_Node]) -> List[_Node]:
+    """All nodes reachable from the roots, sorted by seq descending."""
+    seen = {}
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen[id(node)] = node
+        for inp in node.inputs:
+            if inp._node is not None:
+                stack.append(inp._node)
+    return sorted(seen.values(), key=lambda n: -n.seq)
+
+
+def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
+             retain_graph: bool = False, only_ids: Optional[set] = None,
+             capture_ids: Optional[set] = None):
+    """Reverse graph sweep (basic_engine.cc:305 analog).
+
+    only_ids: if set, restrict leaf .grad accumulation to these tensor ids
+    (paddle.grad uses this so model params aren't polluted).
+    capture_ids: non-leaf tensors whose flowing cotangent should be recorded
+    into .grad (paddle.grad w.r.t. intermediates).
+    """
+    seed = (grad_tensor.data if grad_tensor is not None
+            else jnp.ones_like(loss.data))
+    if loss._node is None:
+        if not loss.stop_gradient and (only_ids is None
+                                       or id(loss) in only_ids):
+            loss._accumulate_grad(seed)
+        return
+    if loss._node.vjp_fn is None:
+        return  # graph already consumed by a prior backward (paddle no-ops)
+    loss._node.seed(loss._out_index, seed)
+
+    nodes = _reachable_nodes([loss._node])
+    for node in nodes:
+        if node.vjp_fn is None or all(g is None for g in node.out_grads):
+            continue
+        cotangents = tuple(
+            g if g is not None else jnp.zeros_like(t.data)
+            for g, t in zip(node.out_grads, node.outputs)
+        )
+        if capture_ids:
+            for t, g in zip(node.outputs, cotangents):
+                if id(t) in capture_ids:
+                    t._accumulate_grad(g)
+        in_grads = node.vjp_fn(cotangents[0] if node.single else cotangents)
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            if inp._node is not None and inp._node.vjp_fn is not None:
+                inp._node.seed(inp._out_index, g)
+            elif only_ids is None or id(inp) in only_ids:
+                inp._accumulate_grad(g)
+        node.out_grads = [None] * len(node.outputs)
+    if not retain_graph:
+        for node in nodes:
+            node.vjp_fn = None  # free residuals; second backward is a no-op
+
+
+def grad(outputs: Sequence[Tensor], inputs: Sequence[Tensor],
+         grad_outputs: Optional[Sequence[Tensor]] = None,
+         retain_graph: bool = False, create_graph: bool = False):
+    """paddle.grad analog (partial_grad_engine.cc): grads of outputs w.r.t.
+    inputs (leaves OR intermediates) without touching .grad on other leaves."""
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    saved = [(t, t.grad) for t in inputs]
+    for t in inputs:
+        t.grad = None
+    leaf_ids = {id(t) for t in inputs if t._node is None}
+    cap_ids = {id(t) for t in inputs if t._node is not None}
+    for i, out in enumerate(outputs):
+        g = None if grad_outputs is None else grad_outputs[i]
+        backward(out, g, retain_graph=(retain_graph or i < len(outputs) - 1),
+                 only_ids=leaf_ids, capture_ids=cap_ids)
+    result = [t.grad if t.grad is not None else None for t in inputs]
+    for t, old in saved:
+        t.grad = old
+    return result

@@ -1,0 +1,40 @@
+"""paddle.distributed analog: collectives + topology + fleet.
+
+Reference: python/paddle/distributed/ (L8 in SURVEY §1).
+"""
+from . import fleet  # noqa: F401
+from .collective import (Group, ReduceOp, all_gather, all_reduce,  # noqa: F401
+                         all_to_all_single, alltoall, axis_context, barrier,
+                         broadcast, destroy_process_group, get_default_group,
+                         get_group, new_group, ppermute_to, recv, reduce,
+                         reduce_scatter, scatter, send, wait)
+from .parallel_env import (ParallelEnv, get_rank, get_world_size,  # noqa: F401
+                           init_parallel_env, is_initialized)
+from .strategy import DistributedStrategy  # noqa: F401
+from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F401
+                       ParallelMode, build_mesh_from_dims,
+                       get_hybrid_communicate_group, get_mesh, set_mesh,
+                       set_hybrid_communicate_group)
+from .data_parallel import DataParallel  # noqa: F401
+from .spawn import spawn  # noqa: F401
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Reference collective.py:1283 auto row/col-parallel helper — returns the
+    corresponding meta_parallel layer."""
+    from .fleet import meta_parallel as mp
+    if operation == "linear":
+        if axis == 0:
+            return mp.RowParallelLinear(size[0], size[1],
+                                        weight_attr=weight_attr,
+                                        has_bias=bias_attr is not False,
+                                        input_is_parallel=False)
+        return mp.ColumnParallelLinear(size[0], size[1],
+                                       weight_attr=weight_attr,
+                                       has_bias=bias_attr is not False,
+                                       gather_output=gather_out)
+    if operation == "embedding":
+        return mp.VocabParallelEmbedding(size[0], size[1],
+                                         weight_attr=weight_attr)
+    raise ValueError(f"unsupported split operation {operation}")

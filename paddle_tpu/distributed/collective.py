@@ -1,0 +1,446 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/collective.py (all_reduce:415, all_gather:589,
+reduce_scatter, alltoall:1456, send:1528/recv:1578, broadcast:348, new_group:208) —
+each emitting a `c_*` op bound to a ring_id → NCCLCommContext.
+
+TPU-native contract (SURVEY §2.4): c_allreduce_sum ↔ lax.psum, c_allgather ↔
+lax.all_gather, c_reducescatter ↔ lax.psum_scatter, alltoall ↔ lax.all_to_all,
+send_v2/recv_v2 ↔ lax.ppermute — *axis names on a jax Mesh replace ring ids*, and
+XLA schedules the ICI transfers (no streams/events).
+
+Execution contexts:
+1. Inside shard_map (the real multi-chip path): ops lower to lax collectives over
+   the ambient mesh axis. `axis_ctx` tracks which axes the enclosing runner mapped.
+2. Eager, single process: groups of size 1 → identity (matching the reference's
+   behavior when world_size == 1). This keeps user scripts runnable on one chip.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply
+from ..tensor.creation import _t
+from .parallel_env import ParallelEnv, get_rank, get_world_size
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communication group. Under SPMD a group is a mesh-axis name; `ranks`
+    kept for API parity/introspection."""
+
+    def __init__(self, rank: int, nranks: int, id: int = 0,
+                 ranks: Optional[List[int]] = None,
+                 axis_name: Optional[str] = None):
+        self.rank = rank
+        self.nranks = nranks
+        self.id = id
+        self.ranks = ranks or list(range(nranks))
+        self.axis_name = axis_name
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self):
+        return True
+
+    def __repr__(self):
+        return (f"Group(rank={self.rank}, nranks={self.nranks}, "
+                f"axis={self.axis_name})")
+
+
+_GROUP_COUNTER = [0]
+_DEFAULT_GROUP: List[Optional[Group]] = [None]
+
+
+class _AxisCtx(threading.local):
+    def __init__(self):
+        self.axes: tuple = ()       # axis names mapped by the enclosing shard_map
+        self.primary: Optional[str] = None
+
+
+_CTX = _AxisCtx()
+
+
+@contextlib.contextmanager
+def axis_context(axes: Sequence[str], primary: Optional[str] = None):
+    """Entered by parallel runners (shard_map wrappers) so collective calls in
+    model code know which mesh axes are live."""
+    prev = (_CTX.axes, _CTX.primary)
+    _CTX.axes = tuple(axes)
+    _CTX.primary = primary or (axes[0] if axes else None)
+    try:
+        yield
+    finally:
+        _CTX.axes, _CTX.primary = prev
+
+
+def in_axis_context() -> bool:
+    return bool(_CTX.axes)
+
+
+def current_axes():
+    return _CTX.axes
+
+
+def _resolve_axis(group) -> Optional[str]:
+    if isinstance(group, str):
+        return group
+    if group is not None and getattr(group, "axis_name", None):
+        if _CTX.axes and group.axis_name in _CTX.axes:
+            return group.axis_name
+        if _CTX.axes:
+            return None  # axis not mapped here → treat as trivial group
+        return group.axis_name if _CTX.axes else None
+    return _CTX.primary
+
+
+def get_group(gid=0):
+    return _DEFAULT_GROUP[0]
+
+
+def new_group(ranks=None, backend=None, axis_name=None):
+    """Reference collective.py:208. Under SPMD the meaningful handle is the mesh
+    axis; arbitrary rank lists are retained for bookkeeping only."""
+    _GROUP_COUNTER[0] += 1
+    gid = _GROUP_COUNTER[0]
+    rank = get_rank()
+    if ranks is None:
+        ranks = list(range(get_world_size()))
+    grp_rank = ranks.index(rank) if rank in ranks else -1
+    return Group(grp_rank, len(ranks), gid, list(ranks), axis_name)
+
+
+def _group_size(group) -> int:
+    axis = _resolve_axis(group)
+    if axis is not None and _CTX.axes:
+        return -1  # dynamic (resolved by lax at trace time)
+    if group is not None and not isinstance(group, str):
+        return group.nranks
+    return get_world_size()
+
+
+# ---- core collectives ----
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, use_calc_stream=True,
+               sync_op=True):
+    axis = _resolve_axis(group)
+    if axis is not None and _CTX.axes:
+        fns = {ReduceOp.SUM: lambda a: lax.psum(a, axis),
+               ReduceOp.MAX: lambda a: lax.pmax(a, axis),
+               ReduceOp.MIN: lambda a: lax.pmin(a, axis),
+               ReduceOp.AVG: lambda a: lax.pmean(a, axis),
+               ReduceOp.PROD: lambda a: jnp.exp(
+                   lax.psum(jnp.log(jnp.maximum(jnp.abs(a), 1e-30)), axis))}
+        out = apply(fns[op], _t(tensor))
+        tensor.data = out.data
+        return tensor
+    # trivial group (size 1): identity
+    return tensor
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, use_calc_stream=True):
+    # On TPU a reduce-to-one is a psum; non-dst ranks simply ignore the value.
+    return all_reduce(tensor, op, group, use_calc_stream)
+
+
+def all_gather(tensor_list, tensor, group=None, use_calc_stream=True,
+               axis=0):
+    ax = _resolve_axis(group)
+    t = _t(tensor)
+    if ax is not None and _CTX.axes:
+        out = apply(lambda a: lax.all_gather(a, ax), t)
+        n = out.shape[0]
+        if isinstance(tensor_list, list):
+            tensor_list.clear()
+            tensor_list.extend(out[i] for i in range(n))
+        return out
+    if isinstance(tensor_list, list):
+        tensor_list.clear()
+        tensor_list.append(t)
+    return t
+
+
+def all_gather_concat(tensor, group=None, concat_axis=0):
+    """Helper returning the concatenated gather (common TP use)."""
+    ax = _resolve_axis(group)
+    t = _t(tensor)
+    if ax is not None and _CTX.axes:
+        return apply(lambda a: lax.all_gather(a, ax, axis=concat_axis,
+                                              tiled=True), t)
+    return t
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    ax = _resolve_axis(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        from ..tensor.manipulation import concat
+        src = concat([_t(s) for s in src], axis=0)
+    src = _t(src)
+    if ax is not None and _CTX.axes:
+        out = apply(lambda a: lax.psum_scatter(a, ax, scatter_dimension=0,
+                                               tiled=True), src)
+        tensor.data = out.data
+        return tensor
+    tensor.data = src.data
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None,
+             use_calc_stream=True):
+    """Reference collective.py:1456. Under shard_map: lax.all_to_all over the
+    axis; list-of-tensors form maps to stacking on a new leading dim."""
+    ax = _resolve_axis(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        from ..tensor.manipulation import stack
+        stacked = stack([_t(t) for t in in_tensor_list], axis=0)
+    else:
+        stacked = _t(in_tensor_list)
+    if ax is not None and _CTX.axes:
+        out = apply(lambda a: lax.all_to_all(a, ax, split_axis=0,
+                                             concat_axis=0, tiled=True),
+                    stacked)
+    else:
+        out = stacked
+    if isinstance(out_tensor_list, list):
+        n = (len(in_tensor_list) if isinstance(in_tensor_list, (list, tuple))
+             else out.shape[0])
+        out_tensor_list.clear()
+        from ..tensor.manipulation import split as _split
+        pieces = _split(out, n, axis=0)
+        out_tensor_list.extend(pieces)
+    return out
+
+
+def all_to_all_single(tensor, group=None, split_axis=0, concat_axis=0):
+    ax = _resolve_axis(group)
+    t = _t(tensor)
+    if ax is not None and _CTX.axes:
+        return apply(lambda a: lax.all_to_all(a, ax, split_axis=split_axis,
+                                              concat_axis=concat_axis,
+                                              tiled=True), t)
+    return t
+
+
+def broadcast(tensor, src, group=None, use_calc_stream=True):
+    ax = _resolve_axis(group)
+    if ax is not None and _CTX.axes:
+        src_local = (group.get_group_rank(src)
+                     if group is not None and not isinstance(group, str)
+                     and src in getattr(group, "ranks", []) else src)
+
+        def f(a):
+            idx = lax.axis_index(ax)
+            masked = jnp.where(idx == src_local, a, jnp.zeros_like(a))
+            return lax.psum(masked, ax)
+
+        out = apply(f, _t(tensor))
+        tensor.data = out.data
+        return tensor
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, use_calc_stream=True):
+    ax = _resolve_axis(group)
+    if ax is not None and _CTX.axes and tensor_list is not None:
+        from ..tensor.manipulation import stack
+        stacked = stack([_t(t) for t in tensor_list], axis=0)
+
+        def f(a):
+            idx = lax.axis_index(ax)
+            # broadcast full stack from src then select own slice
+            src_stack = lax.psum(
+                jnp.where(idx == src, a, jnp.zeros_like(a)), ax)
+            return src_stack[idx]
+
+        out = apply(f, stacked)
+        tensor.data = out.data
+        return tensor
+    if tensor_list:
+        tensor.data = _t(tensor_list[src]).data
+    return tensor
+
+
+def send(tensor, dst=0, group=None, use_calc_stream=True):
+    """Point-to-point send (send_v2 analog). SPMD has no one-sided p2p: a
+    send/recv pair is one lax.ppermute. The pipeline layer calls ppermute_to
+    directly; a bare `send` under shard_map permutes to the absolute dst index
+    on the group axis and the matching `recv` is the identity on that value."""
+    ax = _resolve_axis(group)
+    if ax is not None and _CTX.axes:
+        return ppermute_to(tensor, dst, ax, mode="to")
+    return tensor
+
+
+def recv(tensor, src=0, group=None, use_calc_stream=True):
+    return tensor
+
+
+def ppermute_to(tensor, shift_or_dst, axis, mode="shift"):
+    """lax.ppermute helper: mode='shift' rotates by `shift`; the pipeline layer
+    uses this for stage-to-stage activation transfer."""
+    t = _t(tensor)
+
+    def f(a):
+        n = lax.psum(1, axis)
+        if mode == "shift":
+            perm = [(i, (i + shift_or_dst) % n) for i in range(n)]
+        else:
+            perm = [(i, shift_or_dst) for i in range(n)]
+        return lax.ppermute(a, axis, perm)
+
+    return apply(f, t)
+
+
+def barrier(group=None):
+    if _CTX.axes:
+        return
+    # host-level barrier across processes
+    try:
+        from jax.experimental import multihost_utils
+        if get_world_size() > 1:
+            multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    except Exception:
+        pass
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        jax.block_until_ready(tensor.data)
+    return tensor
+
+
+# ---- TP internals (reference collective.py:748-990) ----
+
+def _c_identity(tensor, group=None):
+    """Forward no-op, backward all-reduce (column-parallel input)."""
+    ax = _resolve_axis(group)
+    t = _t(tensor)
+    if ax is None or not _CTX.axes:
+        return t
+
+    @jax.custom_vjp
+    def f(a):
+        return a
+
+    def fwd(a):
+        return a, None
+
+    def bwd(_, g):
+        return (lax.psum(g, ax),)
+
+    f.defvjp(fwd, bwd)
+    return apply(f, t)
+
+
+def _mp_allreduce(tensor, op=ReduceOp.SUM, group=None):
+    """Forward all-reduce, backward no-op (row-parallel output)."""
+    ax = _resolve_axis(group)
+    t = _t(tensor)
+    if ax is None or not _CTX.axes:
+        return t
+
+    @jax.custom_vjp
+    def f(a):
+        return lax.psum(a, ax)
+
+    def fwd(a):
+        return lax.psum(a, ax), None
+
+    def bwd(_, g):
+        return (g,)
+
+    f.defvjp(fwd, bwd)
+    return apply(f, t)
+
+
+def _c_concat(tensor, group=None):
+    """all-gather along last dim (gather_output of column-parallel linear)."""
+    ax = _resolve_axis(group)
+    t = _t(tensor)
+    if ax is None or not _CTX.axes:
+        return t
+    return apply(lambda a: lax.all_gather(a, ax, axis=a.ndim - 1, tiled=True),
+                 t)
+
+
+def _c_split(tensor, group=None):
+    """keep own shard of last dim (input of row-parallel linear)."""
+    ax = _resolve_axis(group)
+    t = _t(tensor)
+    if ax is None or not _CTX.axes:
+        return t
+
+    def f(a):
+        n = lax.psum(1, ax)
+        idx = lax.axis_index(ax)
+        piece = a.shape[-1] // n
+        return lax.dynamic_slice_in_dim(a, idx * piece, piece, axis=a.ndim - 1)
+
+    return apply(f, t)
+
+
+def _c_softmax_with_cross_entropy(logits, label, group=None,
+                                  ignore_index=-100):
+    """Vocab-sharded softmax-CE (reference
+    c_softmax_with_cross_entropy_op.cu): logits sharded on the class dim over
+    the mp axis; computes global logsumexp via psum without materializing the
+    full vocab."""
+    ax = _resolve_axis(group)
+    lg, lb = _t(logits), _t(label)
+    if ax is None or not _CTX.axes:
+        from ..nn.functional.loss import softmax_with_cross_entropy
+        return softmax_with_cross_entropy(lg, lb)
+
+    def f(a, y):
+        n_shard = a.shape[-1]
+        idx = lax.axis_index(ax)
+        vocab_start = idx * n_shard
+        a32 = a.astype(jnp.float32)
+        local_max = jnp.max(a32, -1, keepdims=True)
+        gmax = lax.pmax(local_max, ax)
+        sumexp = jnp.sum(jnp.exp(a32 - gmax), -1, keepdims=True)
+        gsum = lax.psum(sumexp, ax)
+        logz = jnp.log(gsum) + gmax
+        y = y.astype(jnp.int32)
+        squeeze = (y.ndim == a.ndim and y.shape[-1] == 1)
+        yy = y[..., 0] if squeeze else y
+        local_label = yy - vocab_start
+        in_range = (local_label >= 0) & (local_label < n_shard)
+        safe = jnp.clip(local_label, 0, n_shard - 1)
+        picked = jnp.take_along_axis(a32, safe[..., None], axis=-1)[..., 0]
+        local_logit = jnp.where(in_range, picked, 0.0)
+        target_logit = lax.psum(local_logit, ax)
+        loss = logz[..., 0] - target_logit
+        return loss[..., None] if squeeze else loss
+
+    return apply(f, lg, lb)
+
+
+def get_default_group():
+    if _DEFAULT_GROUP[0] is None:
+        _DEFAULT_GROUP[0] = Group(get_rank(), get_world_size(), 0)
+    return _DEFAULT_GROUP[0]
+
+
+def destroy_process_group(group=None):
+    pass

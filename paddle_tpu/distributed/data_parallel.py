@@ -1,0 +1,67 @@
+"""DataParallel (reference: python/paddle/fluid/dygraph/parallel.py:382 +
+imperative/reducer.cc:289).
+
+The reference buckets grads into comm_buffer_size-MB groups and overlaps NCCL
+allreduce with backward via hooks. TPU-native: under pjit with the batch axis
+sharded on `data`, the gradient psum is inserted by XLA and fused/overlapped by the
+scheduler — bucketing is subsumed. This wrapper therefore:
+  - eager single-process: transparent passthrough (grad sync is a no-op at size 1);
+  - functional path: `sync_gradients_fn` gives the explicit psum/pmean used by the
+    shard_map-based runners for reducer-parity semantics (scale 1/N like
+    parallel.py:588 scale_loss).
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .collective import in_axis_context, current_axes
+from .parallel_env import get_world_size
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.comm_buffer_size = comm_buffer_size
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # parallel.py:588 — with SPMD pmean the 1/N scale is inside the psum
+        return loss
+
+    def apply_collective_grads(self):
+        # reducer.cc FusedAllReduceSchedule analog: a no-op at world_size 1;
+        # under the functional runners gradient sync happens inside the step.
+        if get_world_size() <= 1 and not in_axis_context():
+            return
+
+    # passthrough conveniences
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+
+def sync_gradients_fn(axis: str = "data", average: bool = True):
+    """Pure fn(grads_pytree) -> synced grads; used inside shard_map steps."""
+
+    def sync(grads):
+        op = lax.pmean if average else lax.psum
+        return jax.tree_util.tree_map(lambda g: op(g, axis), grads)
+
+    return sync

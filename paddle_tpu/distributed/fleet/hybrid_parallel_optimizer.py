@@ -1,0 +1,102 @@
+"""HybridParallelOptimizer (reference:
+fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:89 +
+HybridParallelClipGrad:32).
+
+Wraps the inner optimizer to make one step correct under dp×mp×pp×sharding:
+grad sync over the data axis, global-norm clipping whose norm psums across the
+model/sharding axes. In eager single-process mode these reduce to the inner
+optimizer; the cross-axis psums activate inside shard_map runners."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, no_grad
+from ...nn.clip import ClipGradByGlobalNorm
+from ..collective import current_axes, in_axis_context
+
+
+class HybridParallelClipGrad:
+    def __init__(self, clip, hcg):
+        self._clip = clip
+        self._hcg = hcg
+
+    def __call__(self, params_grads):
+        sq = [jnp.sum(jnp.square(g.data.astype(jnp.float32)))
+              for p, g in params_grads if g is not None]
+        if not sq:
+            return params_grads
+        global_sq = sum(sq)
+        # psum the squared norm across every live mesh axis except `data`
+        # (dp grads are already identical after dp sync)
+        if in_axis_context():
+            for ax in current_axes():
+                if ax != "data":
+                    global_sq = lax.psum(global_sq, ax)
+        global_norm = jnp.sqrt(global_sq)
+        clip_norm = self._clip.clip_norm
+        factor = jnp.minimum(clip_norm / jnp.maximum(global_norm, clip_norm),
+                             1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g.data.astype(jnp.float32) * factor)
+                                  .astype(g.data.dtype))))
+        return out
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        if isinstance(optimizer._grad_clip, ClipGradByGlobalNorm):
+            optimizer._grad_clip = HybridParallelClipGrad(
+                optimizer._grad_clip, hcg)
+
+    @property
+    def _parameter_list(self):
+        return self._inner_opt._parameter_list
+
+    def _dp_sync(self):
+        """fused_allreduce_gradients analog (hybrid_parallel_util.py:117)."""
+        if not in_axis_context() or "data" not in current_axes():
+            return
+        if self._hcg.get_data_parallel_world_size() <= 1:
+            return
+        for p in self._inner_opt._parameter_list or []:
+            if p.grad is not None:
+                p.grad.data = lax.pmean(p.grad.data, "data")
+
+    @no_grad()
+    def step(self):
+        self._dp_sync()
+        self._inner_opt.step()
+
+    def clear_grad(self):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, []
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def set_lr(self, value):
+        return self._inner_opt.set_lr(value)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)

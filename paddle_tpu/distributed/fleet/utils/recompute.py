@@ -1,0 +1,50 @@
+"""Activation recomputation (reference: fleet/utils/recompute.py:63 —
+RecomputeFunction stashes RNG, re-runs forward in backward).
+
+TPU-native: jax.checkpoint (remat) does exactly this inside a traced program, and
+XLA decides placement. Eager mode gets the same semantics with a custom-vjp whose
+forward saves only the inputs and whose backward re-runs the function under vjp —
+RNG state is snapshotted and restored like swith_rng_state:54."""
+from __future__ import annotations
+
+import jax
+
+from ....core import random as rnd
+from ....core.tensor import Tensor, apply, no_grad
+
+
+def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
+              **kwargs):
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    rng_state = rnd.get_rng_state() if preserve_rng_state else None
+
+    def raw(*arrays):
+        if preserve_rng_state:
+            saved = rnd.get_rng_state()
+            rnd.set_rng_state(rng_state)
+        try:
+            call_args = list(args)
+            for i, arr in zip(tensor_idx, arrays):
+                t = Tensor(arr)
+                call_args[i] = t
+            with no_grad():  # tape off: jax.checkpoint/vjp own differentiation
+                out = function(*call_args, **kwargs)
+        finally:
+            if preserve_rng_state:
+                rnd.set_rng_state(saved)
+        single = not isinstance(out, (tuple, list))
+        outs = (out,) if single else tuple(out)
+        return tuple(o.data if isinstance(o, Tensor) else o for o in outs), \
+            single
+
+    single_holder = []
+
+    @jax.checkpoint
+    def ck(*arrays):
+        outs, single = raw(*arrays)
+        if not single_holder:
+            single_holder.append(single)
+        return outs[0] if single else outs
+
+    out = apply(ck, *[args[i] for i in tensor_idx])
+    return out

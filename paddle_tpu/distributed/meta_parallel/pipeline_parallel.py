@@ -1,0 +1,100 @@
+"""Pipeline-parallel execution.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py:32 (PipelineParallel,
+train_batch:109 — F-then-B over micro-batches with p2p send/recv) and the static
+1F1B schedule in framework/section_worker.cc:149-183.
+
+TPU-native redesign: explicit per-rank p2p scheduling is replaced by a
+micro-batch loop the XLA scheduler can software-pipeline. `train_batch` runs
+micro-batches through the full layer stack (gradient accumulation), which under
+pjit + stage-sharded weights yields pipeline overlap via XLA's async collectives;
+the dedicated GPipe/1F1B shard_map schedule (ppermute-based, section_worker
+parity) lives in paddle_tpu.parallel.pipeline_schedule and is used by
+parallelize() when pp_degree > 1.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ...tensor.manipulation import split as tensor_split
+from ..topology import get_hybrid_communicate_group
+from .pp_layers import PipelineLayer
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg or get_hybrid_communicate_group()
+        self._strategy = strategy
+        cfg = getattr(strategy, "pipeline_configs", None)
+        self.micro_batch_size = getattr(cfg, "micro_batch_size", 1) if cfg else 1
+        self.accumulate_steps = getattr(cfg, "accumulate_steps", 1) if cfg else 1
+        self.total_loss = None
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def _load_micro_batch(self, data, idx):
+        inputs, labels = data
+        begin = idx * self.micro_batch_size
+        end = begin + self.micro_batch_size
+        return inputs[begin:end], labels[begin:end]
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """F-then-B over micro-batches with grad accumulation
+        (pipeline_parallel.py:109 semantics; loss averaged over micro-batches).
+        """
+        inputs, labels = data
+        total = inputs.shape[0]
+        n_micro = max(total // self.micro_batch_size, 1)
+        self.total_loss = None
+        loss_fn = self._layers._loss_fn
+        for i in range(n_micro):
+            x, y = self._load_micro_batch(data, i)
+            out = self._layers(x)
+            loss = loss_fn(out, y) if loss_fn is not None else out
+            from ...tensor.math import divide
+            scaled = divide(loss, float(n_micro))
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            if self.total_loss is None:
+                self.total_loss = loss.detach()
+            else:
+                from ...tensor.math import add
+                self.total_loss = add(self.total_loss, loss.detach())
+        self._layers.allreduce_shared_weight_gradients()
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        from ...tensor.math import divide
+        return divide(self.total_loss, float(n_micro))
+
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data
+        out = self._layers(inputs)
+        if compute_loss and self._layers._loss_fn is not None:
+            return self._layers._loss_fn(out, labels)
+        return out
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

@@ -1,0 +1,169 @@
+"""Pipeline layer description & segmentation.
+
+Reference: fleet/meta_parallel/parallel_layers/pp_layers.py — LayerDesc:44,
+SharedLayerDesc:62 (tied embeddings), SegmentLayers:23, PipelineLayer:76 with
+allreduce_shared_weight_gradients:188.
+
+TPU-native: PipelineLayer keeps the full layer list plus the stage segmentation;
+the SPMD pipeline runner (pipeline_parallel.py) turns the stages into a
+lax.scan-over-microbatches with ppermute stage transfer, or — on a single host —
+runs stages sequentially (degenerate pp=1 case).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Union
+
+from ...nn.layer.layers import Layer, LayerList
+from ..topology import get_hybrid_communicate_group
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Tied-weight layer shared across stages (e.g. embedding/logits)."""
+
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Split N layers into `num_parts` contiguous stages (pp_layers.py:23)."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+        assert len(layers_desc) >= num_parts
+
+    def do_segment(self) -> List[int]:
+        if self.method == "uniform":
+            return self.uniform(len(self.layers_desc), self.num_parts)
+        if self.method.startswith("layer:"):
+            # segment so each stage holds an equal count of the named layer
+            name = self.method.split(":", 1)[1]
+            weights = [1 if getattr(d, "layer_func", None) is not None
+                       and getattr(d.layer_func, "__name__", "") == name else 0
+                       for d in self.layers_desc]
+            total = sum(weights)
+            per = total // self.num_parts
+            result = [0]
+            acc = 0
+            for i, w in enumerate(weights):
+                acc += w
+                if len(result) < self.num_parts and acc >= per * len(result):
+                    result.append(i + 1)
+            while len(result) <= self.num_parts:
+                result.append(len(self.layers_desc))
+            result[-1] = len(self.layers_desc)
+            return result
+        raise ValueError(f"unknown segment method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        result = [0] * (num_parts + 1)
+        part_size = num_items // num_parts
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        hcg = get_hybrid_communicate_group()
+        if num_stages is None:
+            num_stages = (hcg.get_pipe_parallel_world_size() if hcg else 1)
+        self._num_stages = num_stages
+        self._stage_id = hcg.get_stage_id() if hcg else 0
+        self._recompute_interval = recompute_interval
+
+        self._layers_desc = list(layers)
+        seg = SegmentLayers(self._layers_desc, num_stages, seg_method)
+        self.segment_parts = seg.do_segment()
+
+        # Build ALL layers (SPMD: every host traces the whole program; XLA
+        # places stages by sharding. The per-stage view is kept for the
+        # explicit pipeline runner and for parity introspection.)
+        self._shared_layers = {}
+        built = []
+        for i, d in enumerate(self._layers_desc):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared_layers:
+                    self._shared_layers[d.layer_name] = d.build_layer()
+                built.append((self._shared_layers[d.layer_name],
+                              d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif isinstance(d, Layer):
+                built.append((d, None))
+            elif callable(d):
+                built.append((d, None))
+            else:
+                raise TypeError(f"bad pipeline entry {d!r}")
+        self.run_function = built
+        for i, (l, _) in enumerate(built):
+            if isinstance(l, Layer):
+                self.add_sublayer(str(i), l)
+
+    def get_stage_from_index(self, layer_idx):
+        for stage in range(self._num_stages):
+            if (self.segment_parts[stage] <= layer_idx
+                    < self.segment_parts[stage + 1]):
+                return stage
+        return self._num_stages - 1
+
+    def stage_layers(self, stage_id=None):
+        s = self._stage_id if stage_id is None else stage_id
+        lo, hi = self.segment_parts[s], self.segment_parts[s + 1]
+        return self.run_function[lo:hi]
+
+    def forward(self, x, stage_id=None):
+        """Run all stages (full model) or one stage's segment."""
+        entries = (self.run_function if stage_id is None
+                   else self.stage_layers(stage_id))
+        for layer, fwd in entries:
+            if fwd is not None:
+                x = fwd(layer, x)
+            elif isinstance(layer, Layer) or callable(layer):
+                x = layer(x)
+        return x
+
+    def allreduce_shared_weight_gradients(self):
+        """pp_layers.py:188 — tied-weight grads are reduced across the stages
+        that share them. Under full-program SPMD the shared layer object is one
+        parameter, so grads already accumulate; explicit mode handles it in the
+        runner."""
+        return
+
+    @property
+    def parameters_by_stage(self):
+        out = []
+        for s in range(self._num_stages):
+            ps = []
+            for layer, _ in self.stage_layers(s):
+                if isinstance(layer, Layer):
+                    ps.extend(layer.parameters())
+            out.append(ps)
+        return out

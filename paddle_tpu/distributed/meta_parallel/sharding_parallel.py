@@ -1,0 +1,30 @@
+"""ShardingParallel wrapper (reference: fleet/meta_parallel/sharding_parallel.py:33).
+
+ZeRO sharding on TPU is a sharding declaration on optimizer state / grads / params
+over the `sharding` mesh axis (see paddle_tpu.parallel.sharding); the model wrapper
+itself is a passthrough."""
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+
+
+class ShardingParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

@@ -1,0 +1,31 @@
+"""TensorParallel model wrapper (reference: fleet/meta_parallel/tensor_parallel.py:40
+— broadcasts params+inputs across the mp group at wrap time).
+
+Under GSPMD the "broadcast" is the sharding declaration itself: replicated params
+stay replicated, mp-sharded params (partition_spec on the model axis) are laid out
+by parallelize(). Eager wrap is a passthrough."""
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

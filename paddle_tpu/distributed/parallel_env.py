@@ -1,0 +1,98 @@
+"""Process/cluster environment.
+
+Reference: python/paddle/distributed/parallel.py:58 (init_parallel_env) +
+imperative/nccl_context.cc:53 (TCP bootstrap of nccl ids) +
+fleet/base/role_maker.py:794 (PADDLE_TRAINER_* env discovery).
+
+TPU-native: jax.distributed.initialize replaces the whole unique-id TCP dance; one
+process per *host* (not per device), with jax.process_index() as the node rank and
+all local TPU chips visible. The reference env vars are still honored so launch
+scripts port unchanged.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+_INITIALIZED = [False]
+
+
+class ParallelEnv:
+    """paddle.distributed.ParallelEnv parity."""
+
+    def __init__(self):
+        self._rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._device_id = int(os.getenv("FLAGS_selected_tpus",
+                                        os.getenv("FLAGS_selected_gpus", "0")))
+        endpoints = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = endpoints.split(",") if endpoints else []
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def rank(self):
+        if _INITIALIZED[0]:
+            return jax.process_index()
+        return self._rank
+
+    @property
+    def world_size(self):
+        if _INITIALIZED[0]:
+            return jax.process_count()
+        return self._world_size
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    # legacy aliases
+    local_rank = rank
+    nranks = world_size
+
+
+def init_parallel_env():
+    """Bootstrap multi-host jax. Single-host (or already-initialized) is a no-op.
+
+    Honors PADDLE_TRAINER_ENDPOINTS (rank-0 endpoint = coordinator) so
+    `paddle.distributed.launch`-style scripts work unchanged.
+    """
+    if _INITIALIZED[0]:
+        return ParallelEnv()
+    env = ParallelEnv()
+    n_procs = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+    if n_procs > 1 and env.trainer_endpoints:
+        coordinator = env.trainer_endpoints[0]
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=n_procs,
+            process_id=int(os.getenv("PADDLE_TRAINER_ID", "0")))
+        _INITIALIZED[0] = True
+    return env
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.get_group_rank(ParallelEnv().rank)
+    return ParallelEnv().rank
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    if _INITIALIZED[0]:
+        return jax.process_count()
+    return int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+
+
+def is_initialized():
+    return _INITIALIZED[0]

@@ -1,0 +1,38 @@
+"""paddle.distributed.spawn analog (reference: distributed/spawn.py).
+
+On TPU the normal model is one process per host (jax handles all local chips), so
+spawn is mainly used by CPU-mesh tests; it forks `nprocs` processes with the
+reference's PADDLE_* env contract.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+
+
+def _wrapper(func, rank, nprocs, base_port, args):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    endpoints = ",".join(f"127.0.0.1:{base_port + i}" for i in range(nprocs))
+    os.environ["PADDLE_TRAINER_ENDPOINTS"] = endpoints
+    os.environ["PADDLE_CURRENT_ENDPOINT"] = f"127.0.0.1:{base_port + rank}"
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    base_port = int(options.get("started_port", 35000))
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_wrapper,
+                        args=(func, rank, nprocs, base_port, args),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(f"spawned process exited with {p.exitcode}")
+    return procs

@@ -1,0 +1,174 @@
+"""paddle.jit analog: to_static == jax.jit over the functionalized layer.
+
+Reference: the 12k-LoC AST-rewriting dy2static stack
+(python/paddle/fluid/dygraph/dygraph_to_static/) collapses to jax tracing: the same
+eager code path runs on tracers, so there is no source transform at all. `to_static`
+returns a compiled callable with state_dict-backed weights; `TrainStep` fuses
+forward+backward+optimizer into one XLA executable — the TPU performance path.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor, no_grad
+from ..nn.layer.layers import Layer
+
+
+class StaticFunction:
+    """Compiled wrapper around a Layer (or plain function)."""
+
+    def __init__(self, fn_or_layer, input_spec=None):
+        self._target = fn_or_layer
+        self._input_spec = input_spec
+
+        if isinstance(fn_or_layer, Layer):
+            layer = fn_or_layer
+
+            def pure(params, buffers, rng, args, kwargs):
+                return layer.functional_call(params, buffers, *args, rng=rng,
+                                             **kwargs)
+
+            self._pure = jax.jit(pure)
+        else:
+            fn = fn_or_layer
+
+            def pure(rng, args, kwargs):
+                from ..core.random import key_context
+                wrapped = [Tensor(a) for a in args]
+                with no_grad(), key_context(rng):
+                    out = fn(*wrapped, **kwargs)
+                return jax.tree_util.tree_map(
+                    lambda o: o.data if isinstance(o, Tensor) else o, out,
+                    is_leaf=lambda o: isinstance(o, Tensor))
+
+            self._pure = jax.jit(pure)
+        self._call_count = 0
+
+    def _to_arrays(self, tree):
+        return jax.tree_util.tree_map(
+            lambda a: a.data if isinstance(a, Tensor) else a, tree,
+            is_leaf=lambda a: isinstance(a, Tensor))
+
+    def __call__(self, *args, **kwargs):
+        arrays = tuple(self._to_arrays(a) for a in args)
+        kw = {k: self._to_arrays(v) for k, v in kwargs.items()}
+        self._call_count += 1
+        rng = jax.random.PRNGKey(self._call_count)
+        if isinstance(self._target, Layer):
+            params, buffers = self._target.functional_state()
+            out = self._pure(params, buffers, rng, arrays, kw)
+        else:
+            out = self._pure(rng, arrays, kw)
+        return jax.tree_util.tree_map(Tensor, out)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    if function is None:
+        return functools.partial(to_static, input_spec=input_spec)
+    return StaticFunction(function, input_spec)
+
+
+class TrainStep:
+    """One fused train step: loss_fn(model outputs) + backward + optimizer update,
+    compiled once with jax.jit. This replaces the reference's
+    Executor.run(main_program) hot loop for single-device training.
+
+    usage:
+        step = TrainStep(model, loss_fn, optimizer)
+        loss = step(x, y)          # updates model parameters in place
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer,
+                 donate_state: bool = True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        params, buffers = model.functional_state()
+        self._buffers = buffers
+        self._opt_state = optimizer.init_state(params)
+        self._apply = optimizer.apply_gradients_fn()
+        self._clip = optimizer.clip_gradients_fn()
+        self._step_count = 0
+
+        def compute_loss(params, buffers, rng, *arrays):
+            out, new_buffers = model.functional_call_with_state(
+                params, buffers, arrays[0], rng=rng)
+            loss_t = loss_fn(Tensor(out) if not isinstance(out, Tensor) else out,
+                             *[Tensor(a) for a in arrays[1:]])
+            loss = loss_t.data if isinstance(loss_t, Tensor) else loss_t
+            return loss, new_buffers
+
+        def train_step(params, opt_state, buffers, lr, step, rng, *arrays):
+            (loss, new_buffers), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(params, buffers, rng, *arrays)
+            grads = self._clip(grads)
+            new_params, new_opt = self._apply(params, grads, opt_state, lr,
+                                              step)
+            return loss, new_params, new_opt, new_buffers
+
+        donate = (0, 1, 2) if donate_state else ()
+        self._jitted = jax.jit(train_step, donate_argnums=donate)
+
+    def __call__(self, *args):
+        arrays = [a.data if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in args]
+        params, _ = self.model.functional_state()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        self._step_count += 1
+        step = jnp.asarray(self._step_count, jnp.int32)
+        rng = jax.random.PRNGKey(self._step_count)
+        loss, new_params, self._opt_state, self._buffers = self._jitted(
+            params, self._opt_state, self._buffers, lr, step, rng, *arrays)
+        named = dict(self.model.named_parameters())
+        named_b = dict(self.model.named_buffers())
+        for k, arr in new_params.items():
+            named[k].data = arr
+        for k, arr in self._buffers.items():
+            if k in named_b:
+                named_b[k].data = arr
+            elif k in named:  # frozen params live in the buffer dict
+                named[k].data = arr
+        return Tensor(loss)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export weights + a loadable descriptor (serving export analog of
+    fluid/io.py save_inference_model). StableHLO export comes with the C++
+    predictor milestone."""
+    from ..framework_io import save as _save
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    _save(layer.state_dict(), path + ".pdparams")
+    meta = {"class": type(layer).__name__}
+    _save(meta, path + ".pdmodel")
+
+
+def load(path, **configs):
+    from ..framework_io import load as _load
+    return _load(path + ".pdparams")
+
+
+def not_to_static(fn=None):
+    return fn
+
+
+class ProgramTranslator:
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, flag):
+        pass
+
+
+def enable_to_static(flag=True):
+    pass

@@ -1,0 +1,30 @@
+"""paddle.nn analog — layer zoo + functional + initializers.
+
+Reference surface: python/paddle/nn/__init__.py (100+ layers).
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
+                   ClipGradByValue, GradientClipByGlobalNorm,
+                   GradientClipByNorm, GradientClipByValue, clip_grad_norm_)
+from .layer.activation import *  # noqa: F401,F403
+from .layer.common import *  # noqa: F401,F403
+from .layer.conv import (Conv1D, Conv1DTranspose, Conv2D,  # noqa: F401
+                         Conv2DTranspose, Conv3D, Conv3DTranspose)
+from .layer.layers import (Layer, LayerList, ParamAttr,  # noqa: F401
+                           ParameterList, Sequential)
+from .layer.loss import *  # noqa: F401,F403
+from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D,  # noqa: F401
+                         BatchNorm3D, GroupNorm, InstanceNorm1D,
+                         InstanceNorm2D, InstanceNorm3D, LayerNorm,
+                         LocalResponseNorm, SpectralNorm, SyncBatchNorm)
+from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,  # noqa: F401
+                            AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+                            AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
+                            AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D,
+                            MaxPool3D)
+from .layer.rnn import (GRU, LSTM, BiRNN, GRUCell, LSTMCell, RNN,  # noqa: F401
+                        SimpleRNN, SimpleRNNCell)
+from .layer.transformer import (MultiHeadAttention, Transformer,  # noqa: F401
+                                TransformerDecoder, TransformerDecoderLayer,
+                                TransformerEncoder, TransformerEncoderLayer)

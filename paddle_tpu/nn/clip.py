@@ -1,0 +1,95 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py — GradientClipByValue,
+GradientClipByNorm, GradientClipByGlobalNorm)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g.data, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.data.astype(jnp.float32))))
+            factor = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor((g.data.astype(jnp.float32) * factor).astype(
+                g.data.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip. Under hybrid parallel the squared-norm is psum'ed across
+    the model/sharding axes by HybridParallelClipGrad (distributed layer)."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        sq = [jnp.sum(jnp.square(g.data.astype(jnp.float32)))
+              for p, g in params_grads if g is not None
+              and getattr(p, "need_clip", True)]
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        factor = jnp.minimum(
+            self.clip_norm / jnp.maximum(global_norm, self.clip_norm), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g.data.astype(jnp.float32) * factor).astype(
+                g.data.dtype))))
+        return out
+
+
+# legacy fluid aliases
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(p.grad.data)) for p in params]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(p.grad.data.astype(jnp.float32)),
+                                  norm_type)) for p in params),
+            1.0 / norm_type)
+    factor = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        p.grad.data = (p.grad.data.astype(jnp.float32) * factor).astype(
+            p.grad.data.dtype)
+    return Tensor(total)

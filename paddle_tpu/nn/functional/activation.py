@@ -1,0 +1,149 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from ...tensor.creation import _t
+
+
+def _unary(fn):
+    def op(x, name=None):
+        return apply(fn, _t(x))
+    return op
+
+
+relu = _unary(jax.nn.relu)
+relu6 = _unary(jax.nn.relu6)
+sigmoid = _unary(jax.nn.sigmoid)
+tanh = _unary(jnp.tanh)
+silu = _unary(jax.nn.silu)
+swish = silu
+mish = _unary(lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+hardswish = _unary(jax.nn.hard_swish)
+hardsigmoid = _unary(lambda a: jnp.clip(a / 6.0 + 0.5, 0.0, 1.0))
+tanhshrink = _unary(lambda a: a - jnp.tanh(a))
+softsign = _unary(jax.nn.soft_sign)
+log_sigmoid = _unary(jax.nn.log_sigmoid)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda a: jax.nn.gelu(a, approximate=approximate), _t(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), _t(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.elu(a, alpha), _t(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.celu(a, alpha), _t(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), _t(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, w = _t(x), _t(weight)
+
+    def f(a, ww):
+        if ww.size == 1:
+            return jnp.where(a >= 0, a, ww.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format[1] == "C" or data_format == "NCHW" else a.ndim - 1
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape[ch_axis] = ww.size
+        return jnp.where(a >= 0, a, ww.reshape(shape) * a)
+
+    return apply(f, x, w)
+
+
+def rrelu(x, lower=0.125, upper=0.333, training=True, name=None):
+    x = _t(x)
+    if training:
+        from ...core.random import next_key
+        slope = jax.random.uniform(next_key(), x.data.shape, x.data.dtype,
+                                   lower, upper)
+    else:
+        slope = (lower + upper) / 2.0
+    return apply(lambda a: jnp.where(a >= 0, a, slope * a), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        lambda a: jnp.where(a * beta > threshold, a,
+                            jax.nn.softplus(a * beta) / beta), _t(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a - threshold,
+                                     jnp.where(a < -threshold, a + threshold,
+                                               0.0)), _t(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), _t(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda a: jnp.clip(a, min, max), _t(x))
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a, 0.0), _t(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+
+    return apply(f, _t(x))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = _t(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply(lambda a: jax.nn.softmax(a, axis=axis), x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = _t(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply(lambda a: jax.nn.log_softmax(a, axis=axis), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core.random import next_key
+    x = _t(x)
+    g = jax.random.gumbel(next_key(), x.data.shape, x.data.dtype)
+
+    def f(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y).at[...].set(0.0)
+            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis, inplace=False)
+            y = onehot - jax.lax.stop_gradient(y) + y
+        return y
+
+    return apply(f, x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda a: jax.nn.glu(a, axis=axis), _t(x))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return apply(
+        lambda a: a / jnp.maximum(
+            jnp.linalg.norm(a, ord=p, axis=axis, keepdims=True), epsilon), _t(x))

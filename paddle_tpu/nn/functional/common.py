@@ -1,0 +1,245 @@
+"""Common functionals: linear, dropout, embedding, one_hot, interpolate...
+
+(reference: python/paddle/nn/functional/common.py, input.py)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dtypes
+from ...core.random import next_key
+from ...core.tensor import Tensor, apply
+from ...tensor.creation import _t
+
+
+def linear(x, weight, bias=None, name=None):
+    # paddle weight layout: [in_features, out_features] → x @ W + b, one MXU matmul
+    if bias is not None:
+        return apply(lambda a, w, b: jnp.matmul(a, w) + b,
+                     _t(x), _t(weight), _t(bias))
+    return apply(lambda a, w: jnp.matmul(a, w), _t(x), _t(weight))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    x = _t(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda a: a * (1 - p), x)
+        return x
+    if p == 1.0:
+        return apply(lambda a: jnp.zeros_like(a), x)
+    shape = list(x.data.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(shape))
+
+    def f(a):
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return apply(f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = _t(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(x.data.shape))
+    a_coef = ((1 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+    b_coef = -a_coef * p * alpha_p
+
+    def f(a):
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+
+    return apply(f, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    x, weight = _t(x), _t(weight)
+
+    def f(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply(f, x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda a: jax.nn.one_hot(a.astype(jnp.int32), num_classes,
+                                          dtype=dtypes.get_default_dtype()),
+                 _t(x))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = _t(label)
+
+    def f(y, *pd):
+        k = y.shape[-1]
+        if pd:
+            return (1 - epsilon) * y + epsilon * pd[0]
+        return (1 - epsilon) * y + epsilon / k
+
+    if prior_dist is not None:
+        return apply(f, label, _t(prior_dist))
+    return apply(f, label)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    x = _t(x)
+    channel_last = data_format[-1] == "C"
+    nd = x.data.ndim - 2
+    spatial = (x.data.shape[1:-1] if channel_last else x.data.shape[2:])
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * nd
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    else:
+        if isinstance(size, Tensor):
+            size = [int(v) for v in size.numpy()]
+        size = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in size]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def f(a):
+        if channel_last:
+            out_shape = (a.shape[0],) + tuple(size) + (a.shape[-1],)
+        else:
+            out_shape = a.shape[:2] + tuple(size)
+        if jmode == "nearest":
+            # jax.image nearest matches paddle align_corners=False
+            return jax.image.resize(a, out_shape, method="nearest")
+        if align_corners:
+            # build index grid with corner alignment, gather per spatial dim
+            out = a
+            spatial_axes = (list(range(1, 1 + nd)) if channel_last
+                            else list(range(2, 2 + nd)))
+            for ax, s_out in zip(spatial_axes, size):
+                s_in = out.shape[ax]
+                if s_out == 1:
+                    idx = jnp.zeros((1,), jnp.float32)
+                else:
+                    idx = jnp.linspace(0.0, s_in - 1, s_out)
+                lo = jnp.floor(idx).astype(jnp.int32)
+                hi = jnp.minimum(lo + 1, s_in - 1)
+                wgt = (idx - lo).astype(a.dtype)
+                shape = [1] * out.ndim
+                shape[ax] = s_out
+                wgt = wgt.reshape(shape)
+                out = (jnp.take(out, lo, axis=ax) * (1 - wgt)
+                       + jnp.take(out, hi, axis=ax) * wgt)
+            return out
+        return jax.image.resize(a, out_shape, method=jmode)
+
+    return apply(f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            N, C, H, W = a.shape
+            out = a.reshape(N, C // (r * r), r, r, H, W)
+            out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+            return out.reshape(N, C // (r * r), H * r, W * r)
+        N, H, W, C = a.shape
+        out = a.reshape(N, H, W, r, r, C // (r * r))
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        return out.reshape(N, H * r, W * r, C // (r * r))
+
+    return apply(f, _t(x))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            N, C, H, W = a.shape
+            out = a.reshape(N, C, H // r, r, W // r, r)
+            out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+            return out.reshape(N, C * r * r, H // r, W // r)
+        N, H, W, C = a.shape
+        out = a.reshape(N, H // r, r, W // r, r, C)
+        out = jnp.transpose(out, (0, 2, 4, 1, 3, 5)).reshape(
+            N, H // r, W // r, C * r * r)
+        return out
+
+    return apply(f, _t(x))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            N, C, H, W = a.shape
+            out = a.reshape(N, groups, C // groups, H, W)
+            return jnp.swapaxes(out, 1, 2).reshape(N, C, H, W)
+        N, H, W, C = a.shape
+        out = a.reshape(N, H, W, groups, C // groups)
+        return jnp.swapaxes(out, 3, 4).reshape(N, H, W, C)
+
+    return apply(f, _t(x))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return apply(
+        lambda a, b: jnp.sum(a * b, axis=axis) / jnp.maximum(
+            jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis), eps),
+        _t(x1), _t(x2))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    args = [_t(x1), _t(x2), _t(weight)]
+
+    def f(a, b, w, *maybe_bias):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+
+    if bias is not None:
+        args.append(_t(bias))
+    return apply(f, *args)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def f(a):
+        N_T, C, H, W = a.shape
+        a5 = a.reshape(-1, seg_num, C, H, W)
+        fold = int(C * shift_ratio)
+        left = jnp.pad(a5[:, 1:, :fold], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+        right = jnp.pad(a5[:, :-1, fold:2 * fold],
+                        ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+        rest = a5[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(N_T, C, H, W)
+
+    return apply(f, _t(x))

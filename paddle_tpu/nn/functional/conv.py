@@ -1,0 +1,177 @@
+"""Convolutions via lax.conv_general_dilated — XLA maps these onto the MXU.
+
+Reference op: paddle/fluid/operators/conv_op.* (cuDNN); here the layout is carried as
+dimension_numbers so NCHW (paddle default) and NHWC (TPU-preferred) both work with no
+transposes in user code.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from ...tensor.creation import _t
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NCH", "OIH", "NCH") if not channel_last else ("NHC", "HIO", "NHC")
+    if n == 2:
+        return (("NCHW", "OIHW", "NCHW") if not channel_last
+                else ("NHWC", "HWIO", "NHWC"))
+    return (("NCDHW", "OIDHW", "NCDHW") if not channel_last
+            else ("NDHWC", "DHWIO", "NDHWC"))
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC", "NHC")
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    pad = _padding(padding, n)
+    dn_str = _dim_numbers(n, channel_last)
+
+    def f(a, w, *maybe_bias):
+        # weight layout is paddle's OIHW... convert for channel_last spec
+        lhs_spec, rhs_spec, out_spec = dn_str
+        if channel_last:
+            # paddle weights stay OIHW-like: [out, in/groups, *k]; transpose to HWIO
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            w = jnp.transpose(w, perm)
+        dn = jax.lax.conv_dimension_numbers(a.shape, w.shape,
+                                            (lhs_spec, rhs_spec, out_spec))
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32
+            if a.dtype == jnp.bfloat16 else None)
+        out = out.astype(a.dtype)
+        if maybe_bias:
+            b = maybe_bias[0]
+            shape = [1] * out.ndim
+            ch_axis = out.ndim - 1 if channel_last else 1
+            shape[ch_axis] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply(f, _t(x), _t(weight), _t(bias))
+    return apply(f, _t(x), _t(weight))
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NHC" if data_format == "NLC" else "NCH"
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, fmt)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    data_format)
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, n, data_format, output_size):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    pad = _padding(padding, n)
+    opad = _norm_tuple(output_padding or 0, n)
+
+    def f(a, w, *maybe_bias):
+        # paddle transpose-conv weight: [in, out/groups, *k]
+        lhs_spec = ("NCH", "NCHW", "NCDHW")[n - 1] if not channel_last else \
+            ("NHC", "NHWC", "NDHWC")[n - 1]
+        rhs_spec = ("IOH", "IOHW", "IODHW")[n - 1]
+        out_spec = lhs_spec
+        dn = jax.lax.conv_dimension_numbers(
+            a.shape, w.shape, (lhs_spec, rhs_spec, out_spec))
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            # grad-of-conv padding: k' = dilated kernel; p' = k'-1-p
+            ks = [w.shape[i] for i in range(2, 2 + n)]
+            padding_cfg = [
+                (dil[i] * (ks[i] - 1) - pad[i][0],
+                 dil[i] * (ks[i] - 1) - pad[i][1] + opad[i])
+                for i in range(n)]
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=(1,) * n, padding=padding_cfg,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=1 if groups == 1 else groups,
+            transpose_kernel=True)
+        if maybe_bias:
+            b = maybe_bias[0]
+            shape = [1] * out.ndim
+            ch_axis = out.ndim - 1 if channel_last else 1
+            shape[ch_axis] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply(f, _t(x), _t(weight), _t(bias))
+    return apply(f, _t(x), _t(weight))
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1, data_format, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3, data_format, output_size)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = _norm_tuple(kernel_sizes, 2)
+    st = _norm_tuple(strides, 2)
+    di = _norm_tuple(dilations, 2)
+    pd = _padding(paddings, 2)
+
+    def f(a):
+        N, C, H, W = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=ks, window_strides=st, padding=pd,
+            rhs_dilation=di, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [N, C*kh*kw, oh, ow] -> [N, C*kh*kw, L]
+        return patches.reshape(N, patches.shape[1], -1)
+
+    return apply(f, _t(x))

@@ -1,0 +1,253 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py).
+
+cross_entropy computes logsumexp in fp32 — bf16-safe on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from ...tensor.creation import _t
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True,
+                  label_smoothing=0.0, name=None):
+    input, label = _t(input), _t(label)
+
+    def f(logits, lab, *maybe_w):
+        h = logits.astype(jnp.float32)
+        if use_softmax:
+            logp = jax.nn.log_softmax(h, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(h, 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label:
+            soft = lab.astype(jnp.float32)
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            li = lab.astype(jnp.int32)
+            squeeze_last = (li.ndim == logp.ndim and li.shape[-1] == 1)
+            if squeeze_last:
+                li = li[..., 0]
+            if label_smoothing > 0.0:
+                onehot = jax.nn.one_hot(li, n_classes, axis=axis,
+                                        dtype=jnp.float32)
+                soft = onehot * (1 - label_smoothing) + label_smoothing / n_classes
+                loss = -jnp.sum(soft * logp, axis=axis)
+            else:
+                picked = jnp.take_along_axis(
+                    logp, jnp.expand_dims(li, axis), axis=axis)
+                loss = -jnp.squeeze(picked, axis)
+            mask = (li != ignore_index)
+            loss = jnp.where(mask, loss, 0.0)
+            if maybe_w:
+                w = maybe_w[0].astype(jnp.float32)
+                wv = jnp.take(w, jnp.maximum(li, 0))
+                loss = loss * jnp.where(mask, wv, 0.0)
+                if reduction == "mean":
+                    denom = jnp.maximum(
+                        jnp.sum(jnp.where(mask, wv, 0.0)), 1e-12)
+                    return jnp.sum(loss) / denom
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply(f, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    out = cross_entropy(logits, label, soft_label=soft_label,
+                        ignore_index=ignore_index, reduction="none", axis=axis)
+    # keep label's trailing-1 dim convention
+    lbl = _t(label)
+    if not soft_label and lbl.data.ndim == _t(logits).data.ndim:
+        out = apply(lambda a: jnp.expand_dims(a, axis), out)
+    if return_softmax:
+        from .activation import softmax as _softmax
+        return out, _softmax(logits, axis=axis)
+    return out
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    return cross_entropy(input, label, weight=weight, ignore_index=ignore_index,
+                         reduction=reduction, use_softmax=False,
+                         soft_label=False)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 _t(input), _t(label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                 _t(input), _t(label))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return apply(f, _t(input), _t(label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, *maybe_w):
+        p32 = jnp.clip(p.astype(jnp.float32), 1e-12, 1 - 1e-7)
+        loss = -(y * jnp.log(p32) + (1 - y) * jnp.log1p(-p32))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce(loss, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply(f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, y, *extra):
+        z32 = z.astype(jnp.float32)
+        y32 = y.astype(jnp.float32)
+        i = 0
+        pw = None
+        if weight is not None:
+            i += 1
+        if pos_weight is not None:
+            pw = extra[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight folding
+        if pw is None:
+            loss = jnp.maximum(z32, 0) - z32 * y32 + jnp.log1p(
+                jnp.exp(-jnp.abs(z32)))
+        else:
+            log_w = (pw - 1) * y32 + 1
+            loss = (1 - y32) * z32 + log_w * (
+                jnp.log1p(jnp.exp(-jnp.abs(z32))) + jnp.maximum(-z32, 0))
+        if weight is not None:
+            loss = loss * extra[0]
+        return _reduce(loss, reduction)
+
+    args = [_t(logit), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    if pos_weight is not None:
+        args.append(_t(pos_weight))
+    return apply(f, *args)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply(f, _t(input), _t(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return apply(
+        lambda a, b, y: _reduce(jnp.maximum(-y * (a - b) + margin, 0), reduction),
+        _t(input), _t(other), _t(label))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply(
+        lambda a, y: _reduce(
+            jnp.where(y == 1, a, jnp.maximum(margin - a, 0)), reduction),
+        _t(input), _t(label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / (
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0))
+        return _reduce(loss, reduction)
+
+    return apply(f, _t(input1), _t(input2), _t(label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0), reduction)
+
+    return apply(f, _t(input), _t(positive), _t(negative))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    # warpctc analog (operators/warpctc_op.*) — dynamic-program in pure jax.
+    log_probs, labels = _t(log_probs), _t(labels)
+    input_lengths, label_lengths = _t(input_lengths), _t(label_lengths)
+
+    def f(lp, lab, ilen, llen):
+        # lp: [T, B, C] log-probs (paddle feeds logits; normalize here)
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        L = 2 * llen.astype(jnp.int32) + 1
+        neg_inf = jnp.float32(-1e30)
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])
+
+        def step(alpha, lp_t):
+            prev1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)),
+                            constant_values=neg_inf)
+            prev2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)),
+                            constant_values=neg_inf)
+            ext_shift = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)),
+                                constant_values=-1)
+            allow_skip = (ext != blank) & (ext != ext_shift)
+            cand = jnp.logaddexp(alpha, prev1)
+            cand = jnp.where(allow_skip, jnp.logaddexp(cand, prev2), cand)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return cand + emit, None
+
+        def scan_body(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            alpha = jnp.where((t < ilen)[:, None], new_alpha, alpha)
+            return alpha, None
+
+        alpha, _ = jax.lax.scan(scan_body, alpha0, jnp.arange(1, T))
+        idx_last = jnp.stack([L - 1, L - 2], axis=1)
+        vals = jnp.take_along_axis(alpha, idx_last, axis=1)
+        loss = -jax.nn.logsumexp(vals, axis=1)
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(llen.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply(f, log_probs, labels, input_lengths, label_lengths)

@@ -1,0 +1,139 @@
+"""Pooling functionals via lax.reduce_window (reference: operators/pool_op.*)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import apply
+from ...tensor.creation import _t
+from .conv import _norm_tuple, _padding
+
+
+def _pool(x, fn, init, kernel, stride, padding, n, data_format, ceil_mode=False,
+          average=False, exclusive=True):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    ks = _norm_tuple(kernel, n)
+    st = _norm_tuple(stride if stride is not None else kernel, n)
+    pad = _padding(padding, n)
+
+    def f(a):
+        nd = a.ndim
+        if channel_last:
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            pads = pad if isinstance(pad, str) else [(0, 0)] + list(pad) + [(0, 0)]
+        else:
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            pads = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
+        if isinstance(pads, str):
+            pads = jax.lax.padtype_to_pads(a.shape, window, strides, pads)
+        out = jax.lax.reduce_window(a, init, fn, window, strides, pads)
+        if average:
+            if exclusive and any(p != (0, 0) for p in pads):
+                ones = jnp.ones_like(a)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                               strides, pads)
+                out = out / counts
+            else:
+                out = out / float(np.prod(ks))
+        return out
+
+    return apply(f, _t(x))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool(x, jax.lax.max, -jnp.inf, kernel_size, stride, padding, 1, "NCL",
+                 ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, jax.lax.max, -jnp.inf, kernel_size, stride, padding, 2,
+                 data_format, ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, jax.lax.max, -jnp.inf, kernel_size, stride, padding, 3,
+                 data_format, ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, jax.lax.add, 0.0, kernel_size, stride, padding, 1, "NCL",
+                 ceil_mode, average=True, exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, jax.lax.add, 0.0, kernel_size, stride, padding, 2,
+                 data_format, ceil_mode, average=True, exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, jax.lax.add, 0.0, kernel_size, stride, padding, 3,
+                 data_format, ceil_mode, average=True, exclusive=exclusive)
+
+
+def _adaptive_axes(in_size, out_size):
+    # split each spatial dim into out_size nearly-equal windows
+    return [(int(np.floor(i * in_size / out_size)),
+             int(np.ceil((i + 1) * in_size / out_size))) for i in range(out_size)]
+
+
+def _adaptive_pool(x, output_size, n, reduce_fn, data_format):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    out_sizes = _norm_tuple(output_size, n)
+
+    def f(a):
+        spatial_start = 1 if channel_last else 2
+        out = a
+        for d in range(n):
+            ax = spatial_start + d
+            in_size = a.shape[ax]
+            o = out_sizes[d]
+            if o is None:
+                continue
+            if in_size % o == 0:
+                # even split: reshape + reduce (fast path, static)
+                k = in_size // o
+                new_shape = out.shape[:ax] + (o, k) + out.shape[ax + 1:]
+                out = reduce_fn(out.reshape(new_shape), axis=ax + 1)
+            else:
+                segs = _adaptive_axes(in_size, o)
+                pieces = [reduce_fn(jax.lax.slice_in_dim(out, s, e, axis=ax),
+                                    axis=ax, keepdims=True) for s, e in segs]
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+
+    return apply(f, _t(x))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, jnp.mean, "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, jnp.mean, data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, jnp.mean, data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, jnp.max, "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, jnp.max, "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, jnp.max, "NCDHW")

@@ -1,0 +1,368 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py, operators/rnn_op.*).
+
+TPU-native: the time loop is jax.lax.scan over stacked gate matmuls — one fused
+[x|h] @ W per step keeps the MXU busy; no cuDNN-style fused kernel needed.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from ...tensor.creation import _t, zeros
+from .. import initializer as I
+from .layers import Layer, LayerList
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        B = batch_ref.shape[batch_dim_idx]
+        if isinstance(self.state_shape[0], (list, tuple)):
+            return tuple(zeros([B, *s]) for s in self.state_shape)
+        return zeros([B, *self.state_shape])
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out, out
+
+        out, h = apply(f, _t(inputs), _t(states), self.weight_ih,
+                       self.weight_hh, self.bias_ih, self.bias_hh)
+        return out, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def f(x, hh, cc, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hh @ wh.T + bh
+            i, fgt, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fgt = jax.nn.sigmoid(fgt)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            new_c = fgt * cc + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_h, new_c
+
+        out, new_h, new_c = apply(f, _t(inputs), _t(h), _t(c), self.weight_ih,
+                                  self.weight_hh, self.bias_ih, self.bias_hh)
+        return out, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ig = jnp.split(gi, 3, axis=-1)
+            hr, hz, hg = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(ig + r * hg)
+            out = (1 - z) * n + z * h
+            return out, out
+
+        out, h = apply(f, _t(inputs), _t(states), self.weight_ih,
+                       self.weight_hh, self.bias_ih, self.bias_hh)
+        return out, h
+
+
+def _scan_rnn(mode, x_arr, init_states, weights, hidden_size, reverse=False):
+    """Run one direction of one layer with lax.scan; x_arr [B, T, I]."""
+    wi, wh, bi, bh = weights
+    xs = jnp.swapaxes(x_arr, 0, 1)  # [T, B, I]
+    if reverse:
+        xs = jnp.flip(xs, 0)
+    # hoist the input matmul out of the scan: [T, B, G]
+    x_proj = jnp.einsum("tbi,gi->tbg", xs, wi) + bi
+
+    if mode == "LSTM":
+        def step(carry, xp):
+            h, c = carry
+            gates = xp + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o))
+            g = jnp.tanh(g)
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            return (h, c), h
+
+        carry, outs = jax.lax.scan(step, init_states, x_proj)
+    elif mode == "GRU":
+        def step(h, xp):
+            gh = h @ wh.T + bh
+            ir, iz, ig = jnp.split(xp, 3, axis=-1)
+            hr, hz, hg = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(ig + r * hg)
+            h = (1 - z) * n + z * h
+            return h, h
+
+        carry, outs = jax.lax.scan(step, init_states, x_proj)
+    else:
+        act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+
+        def step(h, xp):
+            h = act(xp + h @ wh.T + bh)
+            return h, h
+
+        carry, outs = jax.lax.scan(step, init_states, x_proj)
+    if reverse:
+        outs = jnp.flip(outs, 0)
+    return jnp.swapaxes(outs, 0, 1), carry
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirect else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for direction in range(self.num_directions):
+                in_size = (input_size if layer == 0
+                           else hidden_size * self.num_directions)
+                suffix = "_reverse" if direction else ""
+                wi = self.create_parameter([gate_mult * hidden_size, in_size],
+                                           weight_ih_attr,
+                                           default_initializer=u)
+                wh = self.create_parameter(
+                    [gate_mult * hidden_size, hidden_size], weight_hh_attr,
+                    default_initializer=u)
+                bi = self.create_parameter([gate_mult * hidden_size],
+                                           bias_ih_attr, is_bias=True,
+                                           default_initializer=u)
+                bh = self.create_parameter([gate_mult * hidden_size],
+                                           bias_hh_attr, is_bias=True,
+                                           default_initializer=u)
+                for n, p in zip(["weight_ih", "weight_hh", "bias_ih",
+                                 "bias_hh"], [wi, wh, bi, bh]):
+                    self.add_parameter(f"{n}_l{layer}{suffix}", p)
+                self._all_weights.append((wi, wh, bi, bh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = _t(inputs)
+        if self.time_major:
+            x = x.transpose([1, 0, 2])
+        B = x.shape[0]
+        is_lstm = self.mode == "LSTM"
+        L = self.num_layers * self.num_directions
+        if initial_states is None:
+            h0 = zeros([L, B, self.hidden_size])
+            c0 = zeros([L, B, self.hidden_size]) if is_lstm else None
+        else:
+            if is_lstm:
+                h0, c0 = initial_states
+            else:
+                h0, c0 = initial_states, None
+
+        flat_weights = [w for group in self._all_weights for w in group]
+
+        def run(xa, h0a, *rest):
+            if is_lstm:
+                c0a, flat = rest[0], rest[1:]
+            else:
+                c0a, flat = None, rest
+            out = xa
+            final_h, final_c = [], []
+            idx = 0
+            for layer in range(self.num_layers):
+                outs_dir = []
+                for d in range(self.num_directions):
+                    w = tuple(flat[4 * idx:4 * idx + 4])
+                    init = ((h0a[idx], c0a[idx]) if is_lstm else h0a[idx])
+                    o, carry = _scan_rnn(self.mode, out, init, w,
+                                         self.hidden_size, reverse=bool(d))
+                    outs_dir.append(o)
+                    if is_lstm:
+                        final_h.append(carry[0])
+                        final_c.append(carry[1])
+                    else:
+                        final_h.append(carry)
+                    idx += 1
+                out = (jnp.concatenate(outs_dir, -1)
+                       if self.num_directions == 2 else outs_dir[0])
+            fh = jnp.stack(final_h)
+            if is_lstm:
+                return out, fh, jnp.stack(final_c)
+            return out, fh
+
+        args = [x, _t(h0)]
+        if is_lstm:
+            args.append(_t(c0))
+        args.extend(flat_weights)
+        res = apply(run, *args)
+        if is_lstm:
+            out, fh, fc = res
+            states = (fh, fc)
+        else:
+            out, fh = res
+            states = fh
+        if self.time_major:
+            out = out.transpose([1, 0, 2])
+        return out, states
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = _t(inputs)
+        if not self.time_major:
+            x = x.transpose([1, 0, 2])
+        T = x.shape[0]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = [None] * T
+        for t in steps:
+            out, states = self.cell(x[t], states)
+            outs[t] = out
+        from ...tensor.manipulation import stack
+        out = stack(outs, axis=0)
+        if not self.time_major:
+            out = out.transpose([1, 0, 2])
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        sf, sb = (initial_states if initial_states is not None else (None, None))
+        out_f, st_f = self.rnn_fw(inputs, sf)
+        out_b, st_b = self.rnn_bw(inputs, sb)
+        from ...tensor.manipulation import concat
+        return concat([out_f, out_b], axis=-1), (st_f, st_b)

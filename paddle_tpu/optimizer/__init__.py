@@ -1,0 +1,3 @@
+from . import lr  # noqa: F401
+from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,  # noqa: F401
+                        Lamb, LarsMomentum, Momentum, Optimizer, RMSProp)

@@ -1,0 +1,469 @@
+"""Optimizers (reference: python/paddle/optimizer/*, operators/optimizers/*_op.cu).
+
+Each optimizer's math lives in a pure `_rule(g, p, state, lr, ctx) -> (new_p,
+new_state)` function over jax arrays — the eager `step()` applies it per parameter
+(one fused XLA computation per param, analogous to the reference's fused adam_op.cu),
+and the functional/jit path (`paddle_tpu.jit.TrainStep`, distributed optimizers)
+applies the same rule inside a traced train step, so eager and compiled training
+share numerics exactly.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor, no_grad
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip: Optional[ClipGradBase] = None, name=None,
+                 multi_precision=False):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._weight_decay = weight_decay or 0.0
+        self._multi_precision = multi_precision
+        # state: param id -> dict of slot arrays (moment, velocity, ...)
+        self._state: Dict[int, Dict[str, jnp.ndarray]] = {}
+        self._step_count = 0
+
+    # ---- lr plumbing ----
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = value
+
+    @property
+    def _lr_scheduler(self):
+        return (self._learning_rate
+                if isinstance(self._learning_rate, LRScheduler) else None)
+
+    # ---- the pure update rule: override in subclasses ----
+    def _init_slots(self, p: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def _rule(self, g, p, slots, lr, wd):
+        raise NotImplementedError
+
+    def _wd_for(self, param) -> float:
+        wd = self._weight_decay
+        if hasattr(wd, "__call__") and not isinstance(wd, (int, float)):
+            return 0.0
+        # honor per-param no-decay lists used by models (bias/norm exclusion)
+        if getattr(param, "no_weight_decay", False):
+            return 0.0
+        return float(wd)
+
+    # ---- eager step ----
+    @no_grad()
+    def step(self):
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        for p, g in params_grads:
+            if g is None:
+                continue
+            pid = id(p)
+            if pid not in self._state:
+                self._state[pid] = self._init_slots(p.data)
+            slots = self._state[pid]
+            lr = self.get_lr() * getattr(p, "optimize_attr",
+                                         {"learning_rate": 1.0})["learning_rate"]
+            new_p, new_slots = self._rule(g.data, p.data, slots, lr,
+                                          self._wd_for(p))
+            p.data = new_p
+            self._state[pid] = new_slots
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list or []:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        params_grads = [(p, p.grad) for p in self._parameter_list or []]
+        return None, params_grads
+
+    # ---- functional API (used by jit train steps & distributed wrappers) ----
+    def init_state(self, params: Dict[str, jnp.ndarray]):
+        """Pure: build slot pytree for a named-param dict."""
+        return {k: self._init_slots(v) for k, v in params.items()}
+
+    def clip_gradients_fn(self):
+        """Pure fn(grads_dict) -> clipped grads, mirroring self._grad_clip so
+        the jit path honors the same clipping as the eager step()."""
+        from ..nn.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
+                               ClipGradByValue)
+        clip = self._grad_clip
+
+        def clip_fn(grads):
+            if clip is None:
+                return grads
+            import jax
+            if isinstance(clip, ClipGradByValue):
+                return jax.tree_util.tree_map(
+                    lambda g: jnp.clip(g, clip.min, clip.max), grads)
+            if isinstance(clip, ClipGradByNorm):
+                def per_leaf(g):
+                    n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+                    f = jnp.minimum(clip.clip_norm / jnp.maximum(n, 1e-12),
+                                    1.0)
+                    return (g.astype(jnp.float32) * f).astype(g.dtype)
+                return jax.tree_util.tree_map(per_leaf, grads)
+            if isinstance(clip, ClipGradByGlobalNorm):
+                leaves = jax.tree_util.tree_leaves(grads)
+                gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                          for g in leaves)
+                gnorm = jnp.sqrt(gsq)
+                f = jnp.minimum(
+                    clip.clip_norm / jnp.maximum(gnorm, clip.clip_norm), 1.0)
+                return jax.tree_util.tree_map(
+                    lambda g: (g.astype(jnp.float32) * f).astype(g.dtype),
+                    grads)
+            return grads  # custom clips (hybrid) run in their own wrappers
+
+        return clip_fn
+
+    def apply_gradients_fn(self):
+        """Returns pure fn(params, grads, state, lr, step) -> (params, state).
+
+        All leaves are jax arrays; safe to jit/pjit. Weight decay uses the
+        optimizer's scalar setting for every param (per-param exclusions are an
+        eager-path feature).
+        """
+        wd = float(self._weight_decay) if not callable(self._weight_decay) else 0.0
+
+        def apply_fn(params, grads, state, lr, step):
+            new_params, new_state = {}, {}
+            for k, p in params.items():
+                g = grads.get(k)
+                if g is None:
+                    new_params[k] = p
+                    new_state[k] = state[k]
+                    continue
+                ctx_slots = dict(state[k])
+                ctx_slots["_step"] = step
+                np_, ns_ = self._rule(g, p, ctx_slots, lr, wd)
+                ns_.pop("_step", None)
+                new_params[k] = np_
+                new_state[k] = ns_
+            return new_params, new_state
+
+        return apply_fn
+
+    # ---- checkpointing ----
+    def state_dict(self):
+        out = {"_step_count": self._step_count}
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                slots = self._state.get(id(p))
+                if slots:
+                    for sname, arr in slots.items():
+                        out[f"{p.name or i}__{sname}"] = Tensor(arr)
+        if self._lr_scheduler is not None:
+            out["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("_step_count", 0))
+        if "LR_Scheduler" in state and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(state["LR_Scheduler"])
+        if not self._parameter_list:
+            return
+        for i, p in enumerate(self._parameter_list):
+            key = p.name or i
+            slots = {}
+            prefix = f"{key}__"
+            for k, v in state.items():
+                if isinstance(k, str) and k.startswith(str(prefix)):
+                    arr = v.data if isinstance(v, Tensor) else jnp.asarray(v)
+                    slots[k[len(str(prefix)):]] = arr
+            if slots:
+                self._state[id(p)] = slots
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    def _rule(self, g, p, slots, lr, wd):
+        g = g.astype(jnp.float32)
+        if wd:
+            g = g + wd * p.astype(jnp.float32)
+        return (p.astype(jnp.float32) - lr * g).astype(p.dtype), slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_slots(self, p):
+        return {"velocity": jnp.zeros(p.shape, jnp.float32)}
+
+    def _rule(self, g, p, slots, lr, wd):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if wd:
+            g = g + wd * p32
+        v = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            update = g + self._momentum * v
+        else:
+            update = v
+        out = {"velocity": v}
+        out.update({k: v2 for k, v2 in slots.items() if k == "_step"})
+        return (p32 - lr * update).astype(p.dtype), out
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_slots(self, p):
+        return {"moment1": jnp.zeros(p.shape, jnp.float32),
+                "moment2": jnp.zeros(p.shape, jnp.float32),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _decoupled(self):
+        return False
+
+    def _rule(self, g, p, slots, lr, wd):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if wd and not self._decoupled():
+            g = g + wd * p32
+        b1, b2 = self._beta1, self._beta2
+        b1p = slots["beta1_pow"] * b1
+        b2p = slots["beta2_pow"] * b2
+        m1 = b1 * slots["moment1"] + (1 - b1) * g
+        m2 = b2 * slots["moment2"] + (1 - b2) * g * g
+        m1_hat = m1 / (1 - b1p)
+        m2_hat = m2 / (1 - b2p)
+        update = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
+        if wd and self._decoupled():
+            update = update + wd * p32
+        new_p = (p32 - lr * update).astype(p.dtype)
+        return new_p, {"moment1": m1, "moment2": m2, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled(self):
+        return True
+
+    def _wd_for(self, param):
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(param.name)):
+            return 0.0
+        return super()._wd_for(param)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, p):
+        return {"moment": jnp.zeros(p.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p.shape, jnp.float32),
+                "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def _rule(self, g, p, slots, lr, wd):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if wd:
+            g = g + wd * p32
+        b1p = slots["beta1_pow"] * self._beta1
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g))
+        new_p = (p32 - lr / (1 - b1p) * m / (u + self._epsilon)).astype(p.dtype)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_slots(self, p):
+        return {"moment": jnp.full(p.shape, self._init_acc, jnp.float32)}
+
+    def _rule(self, g, p, slots, lr, wd):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if wd:
+            g = g + wd * p32
+        acc = slots["moment"] + g * g
+        new_p = (p32 - lr * g / (jnp.sqrt(acc) + self._epsilon)).astype(p.dtype)
+        return new_p, {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_slots(self, p):
+        slots = {"mean_square": jnp.zeros(p.shape, jnp.float32),
+                 "momentum": jnp.zeros(p.shape, jnp.float32)}
+        if self._centered:
+            slots["mean_grad"] = jnp.zeros(p.shape, jnp.float32)
+        return slots
+
+    def _rule(self, g, p, slots, lr, wd):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if wd:
+            g = g + wd * p32
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * g * g
+        out = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+            out["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * slots["momentum"] + lr * g / denom
+        out["momentum"] = mom
+        return (p32 - mom).astype(p.dtype), out
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_slots(self, p):
+        return {"avg_squared_grad": jnp.zeros(p.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(p.shape, jnp.float32)}
+
+    def _rule(self, g, p, slots, lr, wd):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if wd:
+            g = g + wd * p32
+        asg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * g * g
+        update = (jnp.sqrt(slots["avg_squared_update"] + self._epsilon)
+                  / jnp.sqrt(asg + self._epsilon)) * g
+        asu = (self._rho * slots["avg_squared_update"]
+               + (1 - self._rho) * update * update)
+        return (p32 - lr * update).astype(p.dtype), \
+            {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Lamb(Optimizer):
+    """LAMB (reference: operators/optimizers/lamb_op.cu, lamb meta-optimizer)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_slots(self, p):
+        return {"moment1": jnp.zeros(p.shape, jnp.float32),
+                "moment2": jnp.zeros(p.shape, jnp.float32),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _wd_for(self, param):
+        if self._exclude_fn is not None and self._exclude_fn(param):
+            return 0.0
+        return float(self._weight_decay)
+
+    def _rule(self, g, p, slots, lr, wd):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        b1p = slots["beta1_pow"] * b1
+        b2p = slots["beta2_pow"] * b2
+        m1 = b1 * slots["moment1"] + (1 - b1) * g
+        m2 = b2 * slots["moment2"] + (1 - b2) * g * g
+        m1h = m1 / (1 - b1p)
+        m2h = m2 / (1 - b2p)
+        r = m1h / (jnp.sqrt(m2h) + self._epsilon) + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where(w_norm > 0, jnp.where(r_norm > 0, w_norm / r_norm,
+                                                1.0), 1.0)
+        new_p = (p32 - lr * trust * r).astype(p.dtype)
+        return new_p, {"moment1": m1, "moment2": m2, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+
+class LarsMomentum(Optimizer):
+    """LARS (reference: operators/optimizers/lars_momentum_op.cu)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0, name=None):
+        super().__init__(learning_rate, parameters, lars_weight_decay,
+                         grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._epsilon = epsilon
+
+    def _init_slots(self, p):
+        return {"velocity": jnp.zeros(p.shape, jnp.float32)}
+
+    def _rule(self, g, p, slots, lr, wd):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        p_norm = jnp.linalg.norm(p32)
+        g_norm = jnp.linalg.norm(g)
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            self._lars_coeff * p_norm / (g_norm + wd * p_norm + self._epsilon),
+            1.0)
+        v = self._momentum * slots["velocity"] + lr * local_lr * (g + wd * p32)
+        return (p32 - v).astype(p.dtype), {"velocity": v}

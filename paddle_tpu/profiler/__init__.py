@@ -1,0 +1,136 @@
+"""Profiler (reference: paddle/fluid/platform/profiler.h RecordEvent/EnableProfiler,
+python/paddle/fluid/profiler.py).
+
+TPU-native: host spans are recorded in-process (RecordEvent parity) and device
+profiling delegates to jax.profiler (xprof) which captures XLA/TPU timelines —
+replacing the CUPTI device tracer (platform/device_tracer.cc:131).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+
+class _ProfState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.events: List[dict] = []
+        self.stack: List[tuple] = []
+
+
+_P = _ProfState()
+
+
+class RecordEvent:
+    """RAII host span (platform/profiler.h:127 analog)."""
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.begin = None
+
+    def __enter__(self):
+        self.begin = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def end(self):
+        if self.begin is None or not _P.enabled:
+            return
+        _P.events.append({
+            "name": self.name, "ts": self.begin / 1e3,
+            "dur": (time.perf_counter_ns() - self.begin) / 1e3,
+            "ph": "X", "pid": 0, "tid": threading.get_ident() % 10000,
+        })
+        self.begin = None
+
+
+def start_profiler(state="All", tracer_option="Default", trace_dir=None):
+    _P.enabled = True
+    _P.events.clear()
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
+        _P.trace_dir = trace_dir
+    else:
+        _P.trace_dir = None
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    _P.enabled = False
+    if getattr(_P, "trace_dir", None):
+        jax.profiler.stop_trace()
+    export_chrome_tracing(profile_path)
+
+
+def export_chrome_tracing(path: str):
+    with open(path, "w") as f:
+        json.dump({"traceEvents": _P.events}, f)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option="Default"):
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+class Profiler:
+    """paddle.profiler.Profiler-style API over jax.profiler."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, trace_dir="/tmp/paddle_tpu_trace"):
+        self.trace_dir = trace_dir
+        self.timer_only = timer_only
+        self._active = False
+
+    def start(self):
+        _P.enabled = True
+        _P.events.clear()
+        if not self.timer_only:
+            try:
+                jax.profiler.start_trace(self.trace_dir)
+                self._active = True
+            except Exception:
+                self._active = False
+
+    def stop(self):
+        _P.enabled = False
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def step(self, num_samples=None):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        by_name: Dict[str, List[float]] = {}
+        for e in _P.events:
+            by_name.setdefault(e["name"], []).append(e["dur"])
+        lines = [f"{'Event':40s} {'Calls':>8s} {'Total(us)':>12s} {'Avg(us)':>12s}"]
+        for name, durs in sorted(by_name.items(), key=lambda kv: -sum(kv[1])):
+            lines.append(f"{name:40s} {len(durs):8d} {sum(durs):12.1f} "
+                         f"{sum(durs)/len(durs):12.1f}")
+        return "\n".join(lines)
+
+
+def get_events():
+    return list(_P.events)

@@ -1,0 +1,116 @@
+"""paddle.static compatibility shim.
+
+The reference's static mode (ProgramDesc + Executor, framework/executor.cc:166) is
+subsumed by jax.jit: "building a program" is tracing, "running" is calling the
+compiled function. This module keeps the most-used static entry points alive so
+reference training scripts port mechanically; each maps onto the jit path.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit import StaticFunction, to_static
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name)
+
+
+class Program:
+    """Placeholder program object (a traced callable owns the real graph)."""
+
+    def __init__(self):
+        self._fn = None
+
+    def global_block(self):
+        return self
+
+
+def default_main_program():
+    return Program()
+
+
+def default_startup_program():
+    return Program()
+
+
+class Executor:
+    """Executor parity: run(fn, feed, fetch) where fn is a StaticFunction or a
+    plain callable; startup programs are no-ops (initialization is eager)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if callable(program) and not isinstance(program, Program):
+            args = [Tensor(v) for v in (feed or {}).values()]
+            out = program(*args)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [np.asarray(o.numpy()) for o in outs]
+        return []
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+    def with_data_parallel(self, *args, **kwargs):
+        return self
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+def save(program, model_path, **kwargs):
+    pass
+
+
+def load(program, model_path, executor=None, var_names=None):
+    pass
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         **kwargs):
+    pass
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.inference.Predictor for serving")
+
+
+class BuildStrategy:
+    """Accepted-and-ignored: XLA owns fusion/memory decisions on TPU."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_addto = False
+        self.fuse_all_reduce_ops = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+def name_scope(prefix=None):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+def program_guard(main_program, startup_program=None):
+    import contextlib
+    return contextlib.nullcontext()

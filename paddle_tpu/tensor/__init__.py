@@ -1,0 +1,116 @@
+"""paddle.tensor analog: functional tensor surface + Tensor method patching.
+
+The reference patches ~300 methods onto its VarBase via
+python/paddle/fluid/dygraph/varbase_patch_methods.py and generated core.ops functions;
+here the same functions are plain jax-backed callables attached to Tensor once.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from . import creation, linalg, logic, manipulation, math, random, search, stat
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import (median, nanmedian, nanquantile, quantile, std,  # noqa: F401
+                   var)
+from .creation import _t
+
+
+def einsum(equation, *operands):
+    tensors = [_t(o) for o in operands]
+    return apply(lambda *xs: jnp.einsum(equation, *xs), *tensors)
+
+
+_BINARY_OPS = {
+    "__add__": math.add, "__radd__": lambda x, y: math.add(y, x),
+    "__sub__": math.subtract, "__rsub__": lambda x, y: math.subtract(y, x),
+    "__mul__": math.multiply, "__rmul__": lambda x, y: math.multiply(y, x),
+    "__truediv__": math.divide, "__rtruediv__": lambda x, y: math.divide(y, x),
+    "__floordiv__": math.floor_divide,
+    "__mod__": math.mod,
+    "__pow__": math.pow, "__rpow__": lambda x, y: math.pow(y, x),
+    "__matmul__": linalg.matmul,
+    "__rmatmul__": lambda x, y: linalg.matmul(y, x),
+    "__eq__": logic.equal, "__ne__": logic.not_equal,
+    "__lt__": logic.less_than, "__le__": logic.less_equal,
+    "__gt__": logic.greater_than, "__ge__": logic.greater_equal,
+    "__and__": logic.bitwise_and, "__or__": logic.bitwise_or,
+    "__xor__": logic.bitwise_xor,
+}
+
+_METHODS = dict(
+    # math
+    add=math.add, subtract=math.subtract, multiply=math.multiply,
+    divide=math.divide, pow=math.pow, mod=math.mod, floor_divide=math.floor_divide,
+    maximum=math.maximum, minimum=math.minimum, remainder=math.remainder,
+    exp=math.exp, log=math.log, log2=math.log2, log10=math.log10,
+    log1p=math.log1p, sqrt=math.sqrt, rsqrt=math.rsqrt, square=math.square,
+    abs=math.abs, sign=math.sign, floor=math.floor, ceil=math.ceil,
+    round=math.round, trunc=math.trunc, sin=math.sin, cos=math.cos,
+    tan=math.tan, tanh=math.tanh, sigmoid=math.sigmoid, erf=math.erf,
+    reciprocal=math.reciprocal, neg=math.neg, clip=math.clip, scale=math.scale,
+    isnan=math.isnan, isinf=math.isinf, isfinite=math.isfinite,
+    sum=math.sum, mean=math.mean, max=math.max, min=math.min, prod=math.prod,
+    logsumexp=math.logsumexp, all=math.all, any=math.any,
+    cumsum=math.cumsum, cumprod=math.cumprod, trace=math.trace,
+    kron=math.kron, inner=math.inner, outer=math.outer, lerp=math.lerp,
+    # stat
+    var=stat.var, std=stat.std, median=stat.median, quantile=stat.quantile,
+    # linalg
+    matmul=linalg.matmul, mm=linalg.mm, bmm=linalg.bmm, dot=linalg.dot,
+    norm=linalg.norm, dist=linalg.dist, cholesky=linalg.cholesky,
+    inverse=linalg.inv, cross=linalg.cross, t=linalg.t,
+    # manipulation
+    reshape=manipulation.reshape, reshape_=manipulation.reshape_,
+    flatten=manipulation.flatten, transpose=manipulation.transpose,
+    squeeze=manipulation.squeeze, unsqueeze=manipulation.unsqueeze,
+    expand=manipulation.expand, expand_as=manipulation.expand_as,
+    broadcast_to=manipulation.broadcast_to, tile=manipulation.tile,
+    roll=manipulation.roll, flip=manipulation.flip, gather=manipulation.gather,
+    gather_nd=manipulation.gather_nd, scatter=manipulation.scatter,
+    split=manipulation.split, chunk=manipulation.chunk, unbind=manipulation.unbind,
+    index_select=manipulation.index_select, slice=manipulation.slice,
+    take_along_axis=manipulation.take_along_axis, pad=manipulation.pad,
+    repeat_interleave=manipulation.repeat_interleave, unique=manipulation.unique,
+    # logic
+    equal=logic.equal, not_equal=logic.not_equal,
+    greater_than=logic.greater_than, greater_equal=logic.greater_equal,
+    less_than=logic.less_than, less_equal=logic.less_equal,
+    logical_and=logic.logical_and, logical_or=logic.logical_or,
+    logical_not=logic.logical_not, logical_xor=logic.logical_xor,
+    equal_all=logic.equal_all, allclose=logic.allclose, isclose=logic.isclose,
+    where=lambda x, cond, y: logic.where(cond, x, y),
+    masked_select=search.masked_select,
+    # search
+    argmax=search.argmax, argmin=search.argmin, argsort=search.argsort,
+    sort=search.sort, topk=search.topk, kthvalue=search.kthvalue,
+    mode=search.mode,
+    # random (in-place)
+    uniform_=random.uniform_, normal_=random.normal_,
+    exponential_=random.exponential_,
+)
+
+
+def monkey_patch_tensor():
+    for name, fn in _BINARY_OPS.items():
+        setattr(Tensor, name, (lambda f: lambda self, other: f(self, other))(fn))
+    Tensor.__neg__ = lambda self: math.neg(self)
+    Tensor.__abs__ = lambda self: math.abs(self)
+    Tensor.__invert__ = lambda self: logic.logical_not(self)
+    for name, fn in _METHODS.items():
+        setattr(Tensor, name, (lambda f: lambda self, *a, **k: f(self, *a, **k))(fn))
+
+    @property
+    def T(self):
+        return apply(lambda a: jnp.transpose(a), self)
+
+    Tensor.T = T
+
+
+monkey_patch_tensor()

@@ -1,0 +1,142 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes
+from ..core.tensor import Tensor, apply, to_array
+
+
+def _d(dtype):
+    return dtypes.convert_dtype(dtype) if dtype is not None else dtypes.get_default_dtype()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    if isinstance(data, Tensor):
+        t = Tensor(data.data, dtype=dtype, stop_gradient=stop_gradient)
+        return t
+    arr = to_array(data)
+    if dtype is not None:
+        arr = arr.astype(dtypes.convert_dtype(dtype))
+    elif arr.dtype == jnp.float64:
+        arr = arr.astype(dtypes.get_default_dtype())
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), _d(dtype)))
+
+
+def ones(shape, dtype=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), _d(dtype)))
+
+
+def full(shape, fill_value, dtype=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, _d(dtype)))
+
+
+def empty(shape, dtype=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None) -> Tensor:
+    return apply(lambda a: jnp.zeros_like(a, dtype=dtypes.convert_dtype(dtype)), _t(x))
+
+
+def ones_like(x, dtype=None) -> Tensor:
+    return apply(lambda a: jnp.ones_like(a, dtype=dtypes.convert_dtype(dtype)), _t(x))
+
+
+def full_like(x, fill_value, dtype=None) -> Tensor:
+    return apply(lambda a: jnp.full_like(a, fill_value,
+                                         dtype=dtypes.convert_dtype(dtype)), _t(x))
+
+
+def empty_like(x, dtype=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None) -> Tensor:
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange bounds must be python numbers")
+    if dtype is None:
+        dtype = (dtypes.int64 if all(
+            float(v) == int(v) for v in (start, end, step)) else
+            dtypes.get_default_dtype())
+    return Tensor(jnp.arange(start, end, step, dtype=dtypes.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None) -> Tensor:
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_d(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None) -> Tensor:
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_d(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None) -> Tensor:
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_d(dtype)))
+
+
+def diag(x, offset=0, padding_value=0) -> Tensor:
+    x = _t(x)
+
+    def f(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(*out.shape, k=offset, dtype=bool)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(a, offset=offset)
+
+    return apply(f, x)
+
+
+def diagflat(x, offset=0) -> Tensor:
+    return apply(lambda a: jnp.diagflat(a, k=offset), _t(x))
+
+
+def tril(x, diagonal=0) -> Tensor:
+    return apply(lambda a: jnp.tril(a, k=diagonal), _t(x))
+
+
+def triu(x, diagonal=0) -> Tensor:
+    return apply(lambda a: jnp.triu(a, k=diagonal), _t(x))
+
+
+def meshgrid(*args):
+    args = [_t(a) for a in args]
+    outs = apply(lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), *args)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def assign(x, output=None) -> Tensor:
+    src = _t(x)
+    if output is None:
+        return apply(lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.inexact) else a,
+                     src)
+    output.set_value(src.data)
+    return output
+
+
+def clone(x) -> Tensor:
+    return _t(x).clone()
+
+
+def _t(x) -> Tensor:
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item() if isinstance(s, Tensor) else s) for s in shape)

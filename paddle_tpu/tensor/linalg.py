@@ -1,0 +1,224 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py).
+
+matmul is THE op on TPU: it maps to the MXU. Keep operands batched and let XLA tile;
+no hand-written GEMM here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from .creation import _t
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = _t(x), _t(y)
+
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply(f, x, y)
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), _t(x), _t(y))
+
+
+def t(input, name=None):
+    return apply(lambda a: jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a, _t(input))
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else None
+
+    def f(a, b):
+        if ax is None:
+            use = next(i for i, s in enumerate(a.shape) if s == 3)
+        else:
+            use = ax
+        return jnp.cross(a, b, axis=use)
+
+    return apply(f, _t(x), _t(y))
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    x = _t(x)
+
+    def f(a):
+        if p == "fro" and axis is None:
+            return jnp.sqrt(jnp.sum(a * a))
+        if axis is None:
+            flat = a.reshape(-1)
+            return jnp.linalg.norm(flat, ord=p)
+        if isinstance(axis, (list, tuple)):
+            return jnp.linalg.norm(a, ord=p if p != "fro" else "fro",
+                                   axis=tuple(axis), keepdims=keepdim)
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+    return apply(f, x)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(apply(jnp.subtract, _t(x), _t(y)), p=float(p))
+
+
+def cond(x, p=None, name=None):
+    return apply(lambda a: jnp.linalg.cond(a, p=p), _t(x))
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, _t(x))
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logabs])
+
+    return apply(f, _t(x))
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, _t(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), _t(x))
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, _t(x), _t(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return apply(
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular),
+        _t(x), _t(y))
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return apply(f, _t(x))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return apply(lambda b, L: jax.scipy.linalg.cho_solve((L, not upper), b),
+                 _t(x), _t(y))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+
+    out = apply(f, _t(x))
+    if get_infos:
+        from .creation import zeros
+        return out[0], out[1], zeros([1], dtype="int32")
+    return out
+
+
+def qr(x, mode="reduced", name=None):
+    def f(a):
+        return tuple(jnp.linalg.qr(a, mode=mode))
+
+    return apply(f, _t(x))
+
+
+def svd(x, full_matrices=False, name=None):
+    def f(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2)
+
+    return apply(f, _t(x))
+
+
+def eig(x, name=None):
+    def f(a):
+        return tuple(jnp.linalg.eig(a))
+
+    return apply(f, _t(x))
+
+
+def eigh(x, UPLO="L", name=None):
+    def f(a):
+        w, v = jnp.linalg.eigh(a, UPLO=UPLO)
+        return w, v
+
+    return apply(f, _t(x))
+
+
+def eigvals(x, name=None):
+    return apply(jnp.linalg.eigvals, _t(x))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), _t(x))
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), _t(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.matrix_rank(a), _t(x))
+
+
+def multi_dot(x, name=None):
+    tensors = [_t(t) for t in x]
+    return apply(lambda *xs: jnp.linalg.multi_dot(xs), *tensors)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def f(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+        return h.astype(jnp.int64)
+
+    return apply(f, _t(input))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = _t(x)
+    if weights is None:
+        return apply(lambda a: jnp.bincount(a, minlength=minlength,
+                                            length=int(x.numpy().max()) + 1
+                                            if x.size else minlength), x)
+    return apply(lambda a, w: jnp.bincount(a, w, minlength=minlength,
+                                           length=int(x.numpy().max()) + 1),
+                 x, _t(weights))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), _t(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), _t(x))

@@ -1,0 +1,73 @@
+"""Comparison & logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from .creation import _t
+
+
+def _cmp(fn):
+    def op(x, y, name=None):
+        return apply(fn, _t(x), _t(y))
+    return op
+
+
+equal = _cmp(jnp.equal)
+not_equal = _cmp(jnp.not_equal)
+greater_than = _cmp(jnp.greater)
+greater_equal = _cmp(jnp.greater_equal)
+less_than = _cmp(jnp.less)
+less_equal = _cmp(jnp.less_equal)
+logical_and = _cmp(jnp.logical_and)
+logical_or = _cmp(jnp.logical_or)
+logical_xor = _cmp(jnp.logical_xor)
+bitwise_and = _cmp(jnp.bitwise_and)
+bitwise_or = _cmp(jnp.bitwise_or)
+bitwise_xor = _cmp(jnp.bitwise_xor)
+
+
+def logical_not(x, name=None):
+    return apply(jnp.logical_not, _t(x))
+
+
+def bitwise_not(x, name=None):
+    return apply(jnp.bitwise_not, _t(x))
+
+
+def equal_all(x, y, name=None):
+    return apply(lambda a, b: jnp.array_equal(a, b), _t(x), _t(y))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan), _t(x), _t(y))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan), _t(x), _t(y))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.array(_t(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c, a, b), _t(condition), _t(x), _t(y))
+
+
+def nonzero(x, as_tuple=False):
+    # Data-dependent shape → host round-trip (mirrors reference CPU behavior).
+    import numpy as np
+    arr = np.asarray(_t(x).numpy())
+    idx = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(i.astype(np.int64)) for i in idx)
+    return Tensor(np.stack(idx, axis=1).astype(np.int64))

@@ -1,0 +1,361 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes
+from ..core.tensor import Tensor, apply
+from .creation import _t
+
+_py_slice = slice  # `slice` is shadowed by the paddle-named op below
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    s = _static_shape(shape)
+    return apply(lambda a: jnp.reshape(a, s), _t(x))
+
+
+def reshape_(x, shape, name=None):
+    x.data = jnp.reshape(x.data, _static_shape(shape))
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _t(x)
+
+    def f(a):
+        nd = a.ndim
+        s0 = start_axis % nd
+        s1 = stop_axis % nd
+        new_shape = a.shape[:s0] + (-1,) + a.shape[s1 + 1:]
+        return jnp.reshape(a, new_shape)
+
+    return apply(f, x)
+
+
+def transpose(x, perm, name=None):
+    return apply(lambda a: jnp.transpose(a, tuple(perm)), _t(x))
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), _t(x))
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply(lambda a: jnp.swapaxes(a, axis1, axis2), _t(x))
+
+
+def concat(x, axis=0, name=None):
+    tensors = [_t(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply(lambda *xs: jnp.concatenate(xs, axis=axis), *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = [_t(t) for t in x]
+    return apply(lambda *xs: jnp.stack(xs, axis=axis), *tensors)
+
+
+def unstack(x, axis=0, num=None):
+    x = _t(x)
+    n = num or x.shape[axis]
+    outs = apply(lambda a: tuple(jnp.moveaxis(a, axis, 0)[i] for i in range(n)), x)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        n_unknown = builtins_sum(1 for s in sizes if s < 0)
+        if n_unknown:
+            known = builtins_sum(s for s in sizes if s >= 0)
+            sizes = [s if s >= 0 else dim - known for s in sizes]
+    offsets = np.cumsum([0] + sizes)
+
+    def f(a):
+        return tuple(
+            jax.lax.slice_in_dim(a, int(offsets[i]), int(offsets[i + 1]), axis=axis)
+            for i in range(len(sizes)))
+
+    outs = apply(f, x)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def builtins_sum(it):
+    total = 0
+    for v in it:
+        total += v
+    return total
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    x = _t(x)
+
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        axes = tuple(ax % a.ndim for ax in axes)
+        axes = tuple(ax for ax in axes if a.shape[ax] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return apply(f, x)
+
+
+def unsqueeze(x, axis, name=None):
+    x = _t(x)
+    axes = (axis,) if isinstance(axis, int) else tuple(
+        int(a.item()) if isinstance(a, Tensor) else int(a) for a in axis)
+    return apply(lambda a: jnp.expand_dims(a, axes), x)
+
+
+def expand(x, shape, name=None):
+    s = _static_shape(shape)
+    x = _t(x)
+
+    def f(a):
+        tgt = list(s)
+        # -1 means keep original dim (paddle semantics)
+        off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, tuple(tgt))
+
+    return apply(f, x)
+
+
+def broadcast_to(x, shape, name=None):
+    return apply(lambda a: jnp.broadcast_to(a, _static_shape(shape)), _t(x))
+
+
+def expand_as(x, y, name=None):
+    return broadcast_to(x, _t(y).shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = [_t(t) for t in inputs]
+    outs = apply(lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *tensors)
+    return list(outs)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _static_shape(repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), _t(x))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda a: jnp.roll(a, shifts, axis=axis), _t(x))
+
+
+def flip(x, axis, name=None):
+    return apply(lambda a: jnp.flip(a, axis=axis), _t(x))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), _t(x))
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = _t(x), _t(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply(lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=axis), x, index)
+
+
+def gather_nd(x, index, name=None):
+    x, index = _t(x), _t(index)
+
+    def f(a, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+
+    return apply(f, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = _t(x), _t(index), _t(updates)
+
+    def f(a, i, u):
+        i = i.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            return a.at[i].set(u)
+        # paddle !overwrite: zero the rows then accumulate
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+
+    return apply(f, x, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = _t(x), _t(index), _t(updates)
+
+    def f(a, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u)
+
+    return apply(f, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    base = zeros(shape, dtype=_t(updates).dtype)
+    return scatter_nd_add(base, index, updates)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    x, indices = _t(x), _t(indices)
+    values = _t(values)
+
+    def f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        elif reduce == "add":
+            dims = [jnp.arange(s) for s in i.shape]
+            grid = jnp.meshgrid(*dims, indexing="ij")
+            grid[axis] = i
+            return a.at[tuple(grid)].add(v)
+        else:
+            raise ValueError(f"unsupported reduce {reduce}")
+
+    return apply(f, x, indices, values)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return apply(lambda a, i: jnp.take_along_axis(a, i, axis=axis),
+                 _t(arr), _t(indices))
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index):
+    x, index = _t(x), _t(index)
+    return apply(
+        lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=1), x, index)
+
+
+def slice(x, axes, starts, ends):
+    x = _t(x)
+    axes = [int(a) for a in axes]
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+
+    def f(a):
+        idx = [_py_slice(None)] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            idx[ax] = _py_slice(st, en)
+        return a[tuple(idx)]
+
+    return apply(f, x)
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    x = _t(x)
+
+    def f(a):
+        idx = [_py_slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[int(ax)] = _py_slice(int(st), int(en), int(sd))
+        return a[tuple(idx)]
+
+    return apply(f, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _t(x)
+    s = _static_shape(shape)
+    off = [0] * len(s) if offsets is None else [
+        int(o.item()) if isinstance(o, Tensor) else int(o) for o in offsets]
+    s = [x.shape[i] if s[i] == -1 else s[i] for i in range(len(s))]
+    return apply(lambda a: jax.lax.dynamic_slice(a, off, s), x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # Data-dependent output shape: host round-trip (not jittable), like the
+    # reference's CPU fallback for unique.
+    arr = np.asarray(_t(x).numpy())
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    out = [Tensor(res[0])]
+    d = dtypes.convert_dtype(dtype)
+    for extra in res[1:]:
+        out.append(Tensor(extra.astype(d)))
+    return tuple(out)
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats.data if isinstance(repeats, Tensor) else repeats
+    return apply(lambda a: jnp.repeat(a, r, axis=axis), _t(x))
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), _t(x))
+
+
+def as_real(x, name=None):
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), _t(x))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return apply(lambda a: a.view(dtypes.convert_dtype(shape_or_dtype)), _t(x))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = _t(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+
+    def f(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle nn.functional.pad convention: pads innermost dims, reversed
+            # pairs; e.g. NCHW with pad=[l,r,t,b] pads W then H.
+            n_spatial = len(pad) // 2
+            cfg = [(0, 0)] * nd
+            if data_format.endswith("C"):  # NHWC / NLC / NDHWC: spatial before C
+                spatial_axes = list(range(1, 1 + n_spatial))
+            else:
+                spatial_axes = list(range(nd - n_spatial, nd))
+            for i, ax in enumerate(reversed(spatial_axes)):
+                cfg[ax] = (pad[2 * i], pad[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=jmode)
+
+    return apply(f, x)

@@ -1,0 +1,294 @@
+"""Elementwise & reduction math ops (reference: python/paddle/tensor/math.py).
+
+Every function routes through core.tensor.apply so eager autograd records it; under
+jit tracing the same code path runs on tracers with the tape disabled.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.tensor import Tensor, apply
+from .creation import _t
+
+
+def _binary(fn):
+    def op(x, y, name=None):
+        return apply(fn, _t(x), _t(y))
+    return op
+
+
+def _unary(fn):
+    def op(x, name=None):
+        return apply(fn, _t(x))
+    return op
+
+
+add = _binary(jnp.add)
+subtract = _binary(jnp.subtract)
+multiply = _binary(jnp.multiply)
+divide = _binary(jnp.divide)
+floor_divide = _binary(lambda a, b: jnp.floor_divide(a, b))
+mod = _binary(jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = _binary(jnp.power)
+maximum = _binary(jnp.maximum)
+minimum = _binary(jnp.minimum)
+fmax = _binary(jnp.fmax)
+fmin = _binary(jnp.fmin)
+atan2 = _binary(jnp.arctan2)
+hypot = _binary(jnp.hypot)
+
+exp = _unary(jnp.exp)
+expm1 = _unary(jnp.expm1)
+log = _unary(jnp.log)
+log2 = _unary(jnp.log2)
+log10 = _unary(jnp.log10)
+log1p = _unary(jnp.log1p)
+sqrt = _unary(jnp.sqrt)
+rsqrt = _unary(lambda a: jax.lax.rsqrt(a))
+square = _unary(jnp.square)
+abs = _unary(jnp.abs)
+sign = _unary(jnp.sign)
+floor = _unary(jnp.floor)
+ceil = _unary(jnp.ceil)
+round = _unary(jnp.round)
+trunc = _unary(jnp.trunc)
+sin = _unary(jnp.sin)
+cos = _unary(jnp.cos)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+acos = _unary(jnp.arccos)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+cosh = _unary(jnp.cosh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+acosh = _unary(jnp.arccosh)
+atanh = _unary(jnp.arctanh)
+reciprocal = _unary(lambda a: 1.0 / a)
+neg = _unary(jnp.negative)
+erf = _unary(jax.scipy.special.erf)
+erfinv = _unary(jax.scipy.special.erfinv)
+lgamma = _unary(jax.scipy.special.gammaln)
+digamma = _unary(jax.scipy.special.digamma)
+sigmoid = _unary(jax.nn.sigmoid)
+logit = _unary(lambda a: jnp.log(a / (1 - a)))
+frac = _unary(lambda a: a - jnp.trunc(a))
+angle = _unary(jnp.angle)
+conj = _unary(jnp.conj)
+real = _unary(jnp.real)
+imag = _unary(jnp.imag)
+isnan = _unary(jnp.isnan)
+isinf = _unary(jnp.isinf)
+isfinite = _unary(jnp.isfinite)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = _t(x)
+    if bias_after_scale:
+        out = apply(lambda a: a * scale + bias, x)
+    else:
+        out = apply(lambda a: (a + bias) * scale, x)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def increment(x, value=1.0):
+    x.set_value(x.data + value)
+    return x
+
+
+def clip(x, min=None, max=None, name=None):
+    x = _t(x)
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply(lambda a: jnp.clip(a, lo, hi), x)
+
+
+def lerp(x, y, weight, name=None):
+    w = weight.data if isinstance(weight, Tensor) else weight
+    return apply(lambda a, b: a + w * (b - a), _t(x), _t(y))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), _t(x))
+
+
+def multiplex(inputs, index, name=None):
+    idx = _t(index)
+    ins = [_t(i) for i in inputs]
+    return apply(
+        lambda i, *xs: jnp.stack(xs, 0)[i.reshape(-1), jnp.arange(xs[0].shape[0])],
+        idx, *ins)
+
+
+# ---- reductions ----
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    return apply(lambda a: jnp.sum(a, axis=_axis(axis), dtype=d, keepdims=keepdim),
+                 _t(x))
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    return apply(lambda a: jnp.prod(a, axis=_axis(axis), dtype=d, keepdims=keepdim),
+                 _t(x))
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jax.scipy.special.logsumexp(
+        a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.all(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.any(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+
+    def f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=d)
+        return jnp.cumsum(a, axis=int(axis), dtype=d)
+
+    return apply(f, _t(x))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    return apply(lambda a: jnp.cumprod(a, axis=dim, dtype=d), _t(x))
+
+
+def _cum_extreme(x, axis, dtype, cum, eq_first):
+    def f(a):
+        src = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else int(axis)
+        vals = cum(src, axis=ax)
+        shape = [1] * src.ndim
+        shape[ax] = src.shape[ax]
+        pos = jnp.arange(src.shape[ax]).reshape(shape)
+        mark = jnp.where(src == vals, pos, -1)
+        ind = jax.lax.cummax(mark, axis=ax)
+        return vals, ind.astype(dtypes.convert_dtype(dtype))
+
+    return apply(f, _t(x))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, dtype, jax.lax.cummax, True)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, dtype, jax.lax.cummin, True)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanmean(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    return apply(lambda a: jnp.nansum(a, axis=_axis(axis), dtype=d,
+                                      keepdims=keepdim), _t(x))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.count_nonzero(a, axis=_axis(axis),
+                                             keepdims=keepdim), _t(x))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+                 _t(x))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [_t(x)]
+    has_pre = prepend is not None
+    has_app = append is not None
+    if has_pre:
+        args.append(_t(prepend))
+    if has_app:
+        args.append(_t(append))
+
+    def f(a, *extra):
+        kw = {}
+        i = 0
+        if has_pre:
+            kw["prepend"] = extra[i]
+            i += 1
+        if has_app:
+            kw["append"] = extra[i]
+        return jnp.diff(a, n=n, axis=axis, **kw)
+
+    return apply(f, *args)
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, _t(x), _t(y))
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, _t(x), _t(y))
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), _t(x), _t(y))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b),
+                 _t(input), _t(x), _t(y))
+
+
+def gcd(x, y, name=None):
+    return apply(jnp.gcd, _t(x), _t(y))
+
+
+def lcm(x, y, name=None):
+    return apply(jnp.lcm, _t(x), _t(y))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

@@ -1,0 +1,110 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py).
+
+All draws derive from core.random.next_key() so paddle_tpu.seed() makes runs
+reproducible and the TP RNGStatesTracker controls per-axis streams.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.random import next_key
+from ..core.tensor import Tensor
+from .creation import _shape, _t
+
+
+def _d(dtype):
+    return (dtypes.convert_dtype(dtype) if dtype is not None
+            else dtypes.get_default_dtype())
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _d(dtype),
+                                     minval=min, maxval=max))
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = _t(mean).data if isinstance(mean, Tensor) else mean
+        s = _t(std).data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            getattr(m, "shape", ()), getattr(s, "shape", ()))
+        return Tensor(m + s * jax.random.normal(next_key(), shp))
+    return Tensor(mean + std * jax.random.normal(
+        next_key(), _shape(shape), dtypes.get_default_dtype()))
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.normal(next_key(), _shape(shape), _d(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None) -> Tensor:
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high,
+                                     dtype=dtypes.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    x = _t(x)
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    return Tensor(jax.random.permutation(next_key(), n).astype(
+        dtypes.convert_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    x = _t(x)
+
+    logits = jnp.log(jnp.maximum(x.data, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(num_samples,) + x.data.shape[:-1])
+        if x.data.ndim == 2:
+            out = jnp.moveaxis(out, 0, -1)
+        return Tensor(out.astype(jnp.int64))
+    # without replacement: Gumbel top-k
+    g = jax.random.gumbel(next_key(), x.data.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    x = _t(x)
+    return Tensor(jax.random.bernoulli(next_key(), x.data).astype(x.data.dtype))
+
+
+def poisson(x, name=None) -> Tensor:
+    x = _t(x)
+    return Tensor(jax.random.poisson(next_key(), x.data).astype(x.data.dtype))
+
+
+def exponential_(x, lam=1.0, name=None) -> Tensor:
+    x = _t(x)
+    x.data = jax.random.exponential(next_key(), x.data.shape,
+                                    x.data.dtype) / lam
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None) -> Tensor:
+    x.data = jax.random.uniform(next_key(), x.data.shape, x.data.dtype,
+                                minval=min, maxval=max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None) -> Tensor:
+    x.data = mean + std * jax.random.normal(next_key(), x.data.shape,
+                                            x.data.dtype)
+    return x

@@ -1,0 +1,123 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.tensor import Tensor, apply
+from .creation import _t
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = dtypes.convert_dtype(dtype)
+    return apply(lambda a: jnp.argmax(a, axis=axis, keepdims=keepdim and
+                                      axis is not None).astype(d), _t(x))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = dtypes.convert_dtype(dtype)
+    return apply(lambda a: jnp.argmin(a, axis=axis, keepdims=keepdim and
+                                      axis is not None).astype(d), _t(x))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis)
+        if descending:
+            idx = jnp.flip(idx, axis=axis)
+        return idx.astype(jnp.int64)
+
+    return apply(f, _t(x))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out
+
+    return apply(f, _t(x))
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = _t(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def f(a):
+        ax = -1 if axis is None else axis
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, k)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+
+    return apply(f, x)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        srt = jnp.sort(a, axis=axis)
+        idx = jnp.argsort(a, axis=axis)
+        vals = jnp.take(srt, k - 1, axis=axis)
+        inds = jnp.take(idx, k - 1, axis=axis).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            inds = jnp.expand_dims(inds, axis)
+        return vals, inds
+
+    return apply(f, _t(x))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(a):
+        # O(n^2) pairwise count along the axis — fine for the small n this op
+        # sees; keeps everything static-shaped for XLA.
+        moved = jnp.moveaxis(a, axis, -1)
+        eq = moved[..., :, None] == moved[..., None, :]
+        counts = eq.sum(-1)
+        best = jnp.argmax(counts, axis=-1)
+        vals = jnp.take_along_axis(moved, best[..., None], axis=-1)[..., 0]
+        hit = moved == vals[..., None]
+        idx = jnp.max(jnp.where(hit, jnp.arange(moved.shape[-1]), -1), axis=-1)
+        if keepdim:
+            return (jnp.expand_dims(vals, axis),
+                    jnp.expand_dims(idx, axis).astype(jnp.int64))
+        return vals, idx.astype(jnp.int64)
+
+    return apply(f, _t(x))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    d = jnp.int32 if out_int32 else jnp.int64
+    return apply(lambda s, v: jnp.searchsorted(s, v, side=side).astype(d),
+                 _t(sorted_sequence), _t(values))
+
+
+def masked_select(x, mask, name=None):
+    import numpy as np
+    arr = np.asarray(_t(x).numpy())
+    m = np.asarray(_t(mask).numpy()).astype(bool)
+    return Tensor(arr[m])
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = _t(x)
+    idx = tuple(i.data if isinstance(i, Tensor) else i for i in indices)
+    v = _t(value)
+
+    def f(a, vv):
+        if accumulate:
+            return a.at[idx].add(vv)
+        return a.at[idx].set(vv)
+
+    return apply(f, x, v)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)

@@ -1,0 +1,41 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import apply
+from .creation import _t
+from .math import _axis
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda a: jnp.var(a, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), _t(x))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda a: jnp.std(a, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), _t(x))
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.median(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanmedian(a, axis=_axis(axis), keepdims=keepdim),
+                 _t(x))
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.quantile(a, jnp.asarray(q), axis=_axis(axis),
+                                        keepdims=keepdim), _t(x))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=_axis(axis),
+                                           keepdims=keepdim), _t(x))

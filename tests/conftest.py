@@ -1,0 +1,20 @@
+"""Test config: force an 8-device virtual CPU mesh so multi-chip sharding logic
+runs everywhere (SURVEY §4 implication: multi-node logic tested without a cluster).
+Must set XLA flags before jax initializes."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_state():
+    yield
+    # keep the eager tape from leaking across tests
+    from paddle_tpu.core.tensor import reset_tape
+    reset_tape()

@@ -1,0 +1,119 @@
+"""Eager autograd tape tests (reference behavior: imperative/basic_engine.cc +
+varbase_patch_methods Tensor.backward), including numeric-gradient checks in the
+OpTest style."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn, x_np, eps=1e-3):
+    g = np.zeros_like(x_np)
+    flat = x_np.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        hi = fn(x_np.copy().reshape(x_np.shape))
+        flat[i] = orig - eps
+        lo = fn(x_np.copy().reshape(x_np.shape))
+        flat[i] = orig
+        gf[i] = (hi - lo) / (2 * eps)
+    return g
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_backward():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3.0
+    z = (y * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 18.0 * x.numpy())
+
+
+def test_grad_accumulation_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_no_grad_blocks_tape():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    a = x * 3
+    b = x * 4
+    ((a + b) * 1.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_matmul_grad_numeric():
+    rng = np.random.RandomState(0)
+    a_np = rng.randn(3, 4).astype(np.float32)
+    b_np = rng.randn(4, 2).astype(np.float32)
+    a = paddle.to_tensor(a_np, stop_gradient=False)
+    b = paddle.to_tensor(b_np)
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+
+    def f(x):
+        return float((x @ b_np).sum())
+
+    ng = numeric_grad(f, a_np.copy())
+    np.testing.assert_allclose(a.grad.numpy(), ng, rtol=1e-2, atol=1e-2)
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (gx,) = paddle.grad([y], [x])
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_detach_stops_gradient():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * 2).detach()
+    z = y * 3
+    z.sum().backward()
+    assert x.grad is None
+
+
+def test_retain_graph_double_backward_pass():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_pylayer_custom_backward():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])

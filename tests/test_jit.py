@@ -1,0 +1,114 @@
+"""jit path tests: TrainStep/to_static parity with eager (the reference's
+dygraph-vs-static parity suite analog), incl. regression tests for traced RNG,
+buffer carry, and grad clip on the compiled path."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def test_to_static_matches_eager():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model.eval()
+    x = paddle.randn([3, 4])
+    eager = model(x).numpy()
+    static = paddle.jit.to_static(model)(x).numpy()
+    np.testing.assert_allclose(static, eager, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_forwards_kwargs():
+    class M(nn.Layer):
+        def forward(self, x, scale=None):
+            if scale is not None:
+                return x * scale
+            return x
+
+    m = M()
+    x = paddle.ones([2, 2])
+    out = paddle.jit.to_static(m)(x, scale=paddle.to_tensor(3.0))
+    np.testing.assert_allclose(out.numpy(), np.full((2, 2), 3.0))
+
+
+def test_train_step_converges_and_matches_eager_rule():
+    paddle.seed(7)
+    model = nn.Linear(4, 1)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    step = paddle.jit.TrainStep(
+        model, lambda out, y: nn.functional.mse_loss(out, y), opt)
+    x = paddle.randn([16, 4])
+    w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = paddle.to_tensor(x.numpy() @ w_true)
+    losses = [float(step(x, y).item()) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_train_step_dropout_mask_varies_per_step():
+    # regression: the mask must NOT be baked into the compiled executable
+    class Drop(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(64, 64)
+            self.drop = nn.Dropout(0.5)
+
+        def forward(self, x):
+            return self.drop(self.lin(x))
+
+    model = Drop()
+    opt = optimizer.SGD(learning_rate=0.0, parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, lambda o, y: (o * y).sum(), opt)
+    x = paddle.ones([1, 64])
+    y = paddle.ones([1, 64])
+    # lr=0 → params frozen; dropout pattern shows in grads? Instead check loss:
+    l1 = float(step(x, y).item())
+    l2 = float(step(x, y).item())
+    l3 = float(step(x, y).item())
+    # identical inputs & params, only the dropout mask differs
+    assert not (l1 == l2 == l3), "dropout mask is constant across jit steps"
+
+
+def test_train_step_updates_batchnorm_running_stats():
+    # regression: buffer updates must survive the traced step
+    model = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+    opt = optimizer.SGD(learning_rate=0.01, parameters=model.parameters())
+    step = paddle.jit.TrainStep(
+        model, lambda o, y: nn.functional.mse_loss(o, y), opt)
+    before = model[1]._mean.numpy().copy()
+    x = paddle.randn([32, 4]) + 5.0
+    y = paddle.randn([32, 8])
+    step(x, y)
+    after = model[1]._mean.numpy()
+    assert not np.allclose(before, after), "running mean did not update"
+
+
+def test_train_step_applies_grad_clip():
+    w0 = 1.0
+    model = nn.Linear(1, 1, bias_attr=False)
+    model.weight.set_value(np.array([[w0]], np.float32))
+    clip = nn.ClipGradByGlobalNorm(0.5)
+    opt = optimizer.SGD(learning_rate=1.0, parameters=model.parameters(),
+                        grad_clip=clip)
+    step = paddle.jit.TrainStep(model, lambda o, y: (o * 10.0).sum(), opt)
+    x = paddle.ones([1, 1])
+    step(x, paddle.ones([1, 1]))
+    # raw grad is 10; clipped global-norm to 0.5 → w = 1 - 0.5
+    np.testing.assert_allclose(model.weight.numpy(), [[0.5]], rtol=1e-5)
+
+
+def test_grad_wrt_intermediate():
+    # regression: paddle.grad must work for non-leaf inputs
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    x2 = x * 2
+    y = (x2 * x2).sum()
+    (g,) = paddle.grad([y], [x2])
+    np.testing.assert_allclose(g.numpy(), [8.0])
+
+
+def test_grad_does_not_pollute_other_leaves():
+    # regression: paddle.grad must not touch .grad of unrelated params
+    w = paddle.core.tensor.Parameter(np.array([3.0], np.float32))
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (w * x).sum()
+    (gx,) = paddle.grad([y], [x])
+    np.testing.assert_allclose(gx.numpy(), [3.0])
+    assert w.grad is None, "paddle.grad polluted parameter .grad"

@@ -1,0 +1,155 @@
+"""nn layer tests (dygraph/static parity analog of the reference's
+unittests/test_layers.py style)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_linear_shapes_and_grad():
+    layer = nn.Linear(8, 4)
+    x = paddle.randn([2, 8])
+    out = layer(x)
+    assert out.shape == [2, 4]
+    out.sum().backward()
+    assert layer.weight.grad is not None
+    assert layer.weight.grad.shape == [8, 4]
+    assert layer.bias.grad.shape == [4]
+
+
+def test_conv2d_matches_expected_shape():
+    conv = nn.Conv2D(3, 16, 3, stride=2, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    assert conv(x).shape == [2, 16, 8, 8]
+
+
+def test_conv2d_numerics_vs_numpy():
+    # 1x1 conv == per-pixel matmul
+    conv = nn.Conv2D(2, 3, 1, bias_attr=False)
+    x = paddle.randn([1, 2, 4, 4])
+    out = conv(x).numpy()
+    w = conv.weight.numpy().reshape(3, 2)
+    ref = np.einsum("oc,nchw->nohw", w, x.numpy())
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.randn([8, 4, 5, 5])
+    bn.train()
+    out = bn(x)
+    m = out.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(4), atol=1e-5)
+    # running stats moved from init
+    assert not np.allclose(bn._mean.numpy(), np.zeros(4))
+    bn.eval()
+    out2 = bn(x)
+    assert out2.shape == [8, 4, 5, 5]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(16)
+    x = paddle.randn([4, 16])
+    out = ln(x).numpy()
+    np.testing.assert_allclose(out.mean(-1), np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), np.ones(4), atol=1e-2)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    ids = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+    out = emb(ids)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+
+def test_dropout_modes():
+    drop = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    drop.eval()
+    np.testing.assert_allclose(drop(x).numpy(), x.numpy())
+    drop.train()
+    out = drop(x).numpy()
+    assert (out == 0).mean() > 0.3
+    kept = out[out != 0]
+    np.testing.assert_allclose(kept, np.full_like(kept, 2.0))
+
+
+def test_sequential_and_state_dict():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = model.state_dict()
+    assert "0.weight" in sd and "2.bias" in sd
+    model2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model2.set_state_dict(sd)
+    np.testing.assert_allclose(model2[0].weight.numpy(),
+                               model[0].weight.numpy())
+
+
+def test_loss_cross_entropy_vs_numpy():
+    logits_np = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    labels_np = np.array([0, 2, 1, 4])
+    loss = nn.CrossEntropyLoss()(paddle.to_tensor(logits_np),
+                                 paddle.to_tensor(labels_np))
+    # numpy reference
+    e = np.exp(logits_np - logits_np.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    ref = -np.log(p[np.arange(4), labels_np]).mean()
+    np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+
+def test_mse_and_l1():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 1.0])
+    np.testing.assert_allclose(nn.MSELoss()(a, b).numpy(), (4 + 1) / 2)
+    np.testing.assert_allclose(nn.L1Loss()(a, b).numpy(), (2 + 1) / 2)
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 5, 16])
+    out = mha(x, x, x)
+    assert out.shape == [2, 5, 16]
+    out.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 6, 16])
+    out = enc(x)
+    assert out.shape == [2, 6, 16]
+
+
+def test_lstm_forward_backward():
+    lstm = nn.LSTM(input_size=8, hidden_size=16, num_layers=2)
+    x = paddle.randn([4, 10, 8])
+    out, (h, c) = lstm(x)
+    assert out.shape == [4, 10, 16]
+    assert h.shape == [2, 4, 16]
+    out.sum().backward()
+    assert lstm.weight_ih_l0.grad is not None
+
+
+def test_gru_bidirectional():
+    gru = nn.GRU(input_size=8, hidden_size=16, direction="bidirect")
+    x = paddle.randn([2, 5, 8])
+    out, h = gru(x)
+    assert out.shape == [2, 5, 32]
+
+
+def test_activations():
+    x = paddle.to_tensor([-1.0, 0.0, 2.0])
+    np.testing.assert_allclose(nn.ReLU()(x).numpy(), [0, 0, 2])
+    np.testing.assert_allclose(
+        nn.functional.softmax(x).numpy().sum(), 1.0, rtol=1e-6)
+    g = nn.GELU()(x).numpy()
+    assert g[0] < 0 and abs(g[1]) < 1e-6 and g[2] > 1.9
+
+
+def test_parameters_traversal():
+    model = nn.Sequential(nn.Linear(4, 4), nn.Sequential(nn.Linear(4, 4)))
+    names = [n for n, _ in model.named_parameters()]
+    assert "0.weight" in names and "1.0.weight" in names
+    assert len(model.parameters()) == 4

@@ -1,0 +1,112 @@
+"""Op tests vs numpy reference — the OpTest analog (reference:
+python/paddle/fluid/tests/unittests/op_test.py:270: one-op programs checked
+against numpy forward + numeric grads)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_and_numpy():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_arithmetic_ops():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9])
+
+
+def test_matmul():
+    a = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    out = paddle.matmul(a, b)
+    np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy())
+
+
+def test_reductions():
+    x_np = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    x = paddle.to_tensor(x_np)
+    np.testing.assert_allclose(paddle.sum(x).numpy(), x_np.sum(), rtol=1e-6)
+    np.testing.assert_allclose(paddle.mean(x, axis=1).numpy(),
+                               x_np.mean(1), rtol=1e-6)
+    np.testing.assert_allclose(paddle.max(x, axis=0).numpy(), x_np.max(0))
+    np.testing.assert_allclose(paddle.min(x).numpy(), x_np.min())
+
+
+def test_manipulation():
+    x = paddle.arange(24, dtype="float32").reshape([2, 3, 4])
+    assert paddle.transpose(x, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(x, 1).shape == [2, 12]
+    pieces = paddle.split(x, 2, axis=2)
+    assert len(pieces) == 2 and pieces[0].shape == [2, 3, 2]
+    c = paddle.concat(pieces, axis=2)
+    np.testing.assert_allclose(c.numpy(), x.numpy())
+    s = paddle.stack([x, x], axis=0)
+    assert s.shape == [2, 2, 3, 4]
+    assert paddle.squeeze(paddle.unsqueeze(x, 0), 0).shape == [2, 3, 4]
+
+
+def test_indexing_and_gather():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 2].numpy(), [2, 6, 10])
+    idx = paddle.to_tensor(np.array([2, 0]))
+    g = paddle.gather(x, idx, axis=0)
+    np.testing.assert_allclose(g.numpy(), x.numpy()[[2, 0]])
+
+
+def test_comparison_and_where():
+    a = paddle.to_tensor([1.0, 5.0, 3.0])
+    b = paddle.to_tensor([4.0, 2.0, 3.0])
+    np.testing.assert_array_equal((a > b).numpy(), [False, True, False])
+    w = paddle.where(a > b, a, b)
+    np.testing.assert_allclose(w.numpy(), [4, 5, 3])
+
+
+def test_search_sort_topk():
+    x = paddle.to_tensor([[3.0, 1.0, 2.0], [9.0, 7.0, 8.0]])
+    vals, idx = paddle.topk(x, k=2)
+    np.testing.assert_allclose(vals.numpy(), [[3, 2], [9, 8]])
+    np.testing.assert_array_equal(idx.numpy(), [[0, 2], [0, 2]])
+    assert paddle.argmax(x, axis=1).numpy().tolist() == [0, 0]
+    np.testing.assert_allclose(paddle.sort(x, axis=1).numpy(),
+                               np.sort(x.numpy(), 1))
+
+
+def test_einsum():
+    a = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    b = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_cast_dtypes():
+    x = paddle.to_tensor([1.5, 2.5])
+    assert x.astype("int32").numpy().dtype == np.int32
+    assert x.astype(paddle.bfloat16).dtype == np.dtype(paddle.bfloat16)
+
+
+def test_random_reproducible():
+    paddle.seed(42)
+    a = paddle.rand([4])
+    paddle.seed(42)
+    b = paddle.rand([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+
+
+def test_cumsum_clip_scale():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(paddle.cumsum(x, axis=0).numpy(),
+                               np.cumsum(x.numpy(), 0))
+    np.testing.assert_allclose(paddle.clip(x, 1.5, 3.5).numpy(),
+                               np.clip(x.numpy(), 1.5, 3.5))
+    np.testing.assert_allclose(paddle.scale(x, 2.0, 1.0).numpy(),
+                               x.numpy() * 2 + 1)
