@@ -37,13 +37,9 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
         return tuple(o.data if isinstance(o, Tensor) else o for o in outs), \
             single
 
-    single_holder = []
-
     @jax.checkpoint
     def ck(*arrays):
         outs, single = raw(*arrays)
-        if not single_holder:
-            single_holder.append(single)
         return outs[0] if single else outs
 
     out = apply(ck, *[args[i] for i in tensor_idx])

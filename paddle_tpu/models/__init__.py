@@ -1,0 +1,4 @@
+"""Model zoo: the LLM families the north star benchmarks exercise."""
+from .gpt import GPT_PRESETS, GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
+from .llama import (LLAMA_PRESETS, LlamaConfig,  # noqa: F401
+                    LlamaForCausalLM, LlamaModel, RMSNorm)

@@ -1,0 +1,168 @@
+"""GPT model family (BASELINE config 2: GPT-3 1.3B pure DP).
+
+Reference anchor: the GPT-era ops the reference DOES ship —
+softmax_mask_fuse_upper_triangle (fused causal softmax, incubate API) and the TP
+parallel layers. Architecture: pre-LN GPT with learned positions, GELU MLP.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..distributed.meta_parallel.mp_layers import (ColumnParallelLinear,
+                                                   ParallelCrossEntropy,
+                                                   RowParallelLinear,
+                                                   VocabParallelEmbedding)
+from ..nn import Dropout, Embedding, LayerNorm
+from ..nn import functional as F
+from ..nn.layer.layers import Layer, LayerList
+from ..ops.attention import flash_attention
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 2048
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: int = 8192
+    max_position_embeddings: int = 2048
+    hidden_dropout_prob: float = 0.0
+    attention_dropout_prob: float = 0.0
+    layer_norm_eps: float = 1e-5
+    use_recompute: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+GPT_PRESETS = {
+    "gpt2-tiny": GPTConfig(vocab_size=512, hidden_size=128,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           intermediate_size=512,
+                           max_position_embeddings=512),
+    "gpt3-125m": GPTConfig(hidden_size=768, num_hidden_layers=12,
+                           num_attention_heads=12, intermediate_size=3072),
+    "gpt3-1.3b": GPTConfig(hidden_size=2048, num_hidden_layers=24,
+                           num_attention_heads=16, intermediate_size=8192),
+    "gpt3-6.7b": GPTConfig(hidden_size=4096, num_hidden_layers=32,
+                           num_attention_heads=32, intermediate_size=16384),
+}
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.head_dim
+        self.qkv_proj = ColumnParallelLinear(h, 3 * h, has_bias=True,
+                                             gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, has_bias=True,
+                                          input_is_parallel=True)
+        self.dropout_p = config.attention_dropout_prob
+
+    def forward(self, hidden):
+        qkv = self.qkv_proj(hidden)
+        hd = self.head_dim
+
+        def attn(a):
+            B, S, _ = a.shape
+            # local heads = local width / (3*head_dim)
+            n_local = a.shape[-1] // (3 * hd)
+            a = a.reshape(B, S, n_local, 3 * hd)
+            q, k, v = jnp.split(a, 3, axis=-1)
+            q = jnp.swapaxes(q, 1, 2)
+            k = jnp.swapaxes(k, 1, 2)
+            v = jnp.swapaxes(v, 1, 2)
+            out = flash_attention(q, k, v, causal=True)
+            out = jnp.swapaxes(out, 1, 2)
+            return out.reshape(B, S, -1)
+
+        ctx = apply(attn, qkv)
+        return self.out_proj(ctx)
+
+
+class GPTDecoderLayer(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.norm1 = LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.self_attn = GPTAttention(config)
+        self.norm2 = LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.linear1 = ColumnParallelLinear(h, config.intermediate_size,
+                                            has_bias=True,
+                                            gather_output=False)
+        self.linear2 = RowParallelLinear(config.intermediate_size, h,
+                                         has_bias=True,
+                                         input_is_parallel=True)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self._use_recompute = config.use_recompute
+
+    def _block(self, x):
+        x = x + self.self_attn(self.norm1(x))
+        h = self.linear1(self.norm2(x))
+        h = apply(lambda a: jax.nn.gelu(a), h)
+        h = self.linear2(h)
+        return x + self.dropout(h)
+
+    def forward(self, x):
+        if self._use_recompute and self.training:
+            from ..distributed.fleet.utils.recompute import recompute
+            return recompute(self._block, x)
+        return self._block(x)
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.word_embeddings = VocabParallelEmbedding(config.vocab_size,
+                                                      config.hidden_size)
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             config.hidden_size)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.layers = LayerList([GPTDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.final_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_eps)
+
+    def forward(self, input_ids):
+        S = input_ids.shape[1]
+        from ..tensor.creation import arange
+        pos = arange(S, dtype="int64")
+        hidden = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        hidden = self.dropout(hidden)
+        for layer in self.layers:
+            hidden = layer(hidden)
+        return self.final_norm(hidden)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        self.lm_head = ColumnParallelLinear(config.hidden_size,
+                                            config.vocab_size,
+                                            has_bias=False,
+                                            gather_output=True)
+        self.loss_fn = ParallelCrossEntropy()
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.gpt(input_ids)
+        logits = self.lm_head(hidden)
+        if labels is not None:
+            from ..tensor.math import mean
+            return mean(self.loss_fn(logits, labels))
+        return logits
+
+    @classmethod
+    def from_preset(cls, name: str, **overrides):
+        import dataclasses
+        cfg = dataclasses.replace(GPT_PRESETS[name], **overrides)
+        return cls(cfg)

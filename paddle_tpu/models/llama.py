@@ -1,0 +1,244 @@
+"""Llama-2 model family — the flagship (BASELINE configs 3 & 4).
+
+Reference capability anchor: the reference has no Llama model in-tree; its GPT-era
+parallel layers (fleet/meta_parallel/parallel_layers/mp_layers.py) define the TP
+contract this model uses. Architecture follows Llama-2 (RMSNorm, RoPE, SwiGLU,
+GQA), built TPU-first:
+- attention/MLP projections are the Megatron TP layers carrying PartitionSpecs
+  over the `model` mesh axis; under parallelize() GSPMD shards them and inserts
+  the TP collectives;
+- attention runs through ops.flash_attention (Pallas on long sequences);
+- weights default bf16-friendly; norm/softmax math is fp32 inside the ops.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor, apply
+from ..distributed.meta_parallel.mp_layers import (ColumnParallelLinear,
+                                                   ParallelCrossEntropy,
+                                                   RowParallelLinear,
+                                                   VocabParallelEmbedding)
+from ..nn import functional as F
+from ..nn.layer.layers import Layer, LayerList
+from ..ops.attention import flash_attention
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_recompute: bool = False
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+LLAMA_PRESETS = {
+    "llama2-tiny": LlamaConfig(vocab_size=512, hidden_size=128,
+                               intermediate_size=352, num_hidden_layers=2,
+                               num_attention_heads=4, num_key_value_heads=4,
+                               max_position_embeddings=512),
+    "llama2-7b": LlamaConfig(),
+    "llama2-13b": LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                              num_hidden_layers=40, num_attention_heads=40,
+                              num_key_value_heads=40),
+    "llama2-70b": LlamaConfig(hidden_size=8192, intermediate_size=28672,
+                              num_hidden_layers=80, num_attention_heads=64,
+                              num_key_value_heads=8),
+}
+
+
+class RMSNorm(Layer):
+    def __init__(self, hidden_size, eps=1e-5):
+        super().__init__()
+        from ..nn import initializer as I
+        self.weight = self.create_parameter(
+            [hidden_size], default_initializer=I.Constant(1.0))
+        self.weight.partition_spec = P(None)
+        self.eps = eps
+
+    def forward(self, x):
+        eps = self.eps
+
+        def f(a, w):
+            h = a.astype(jnp.float32)
+            var = jnp.mean(h * h, axis=-1, keepdims=True)
+            h = h * jax.lax.rsqrt(var + eps)
+            return (h * w.astype(jnp.float32)).astype(a.dtype)
+
+        return apply(f, x, self.weight)
+
+
+def _rope_cos_sin(seq_len, head_dim, theta, dtype=jnp.float32):
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                           dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)           # [S, D/2]
+    emb = jnp.concatenate([freqs, freqs], -1)  # [S, D]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _apply_rope(x, cos, sin):
+    # x: [B, H, S, D]
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], -1)
+    return x * cos[None, None] + rotated * sin[None, None]
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.head_dim
+        h = config.hidden_size
+        self.q_proj = ColumnParallelLinear(h, self.num_heads * self.head_dim,
+                                           has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(h, self.num_kv_heads * self.head_dim,
+                                           has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(h, self.num_kv_heads * self.head_dim,
+                                           has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(self.num_heads * self.head_dim, h,
+                                        has_bias=False, input_is_parallel=True)
+
+    def forward(self, hidden, attn_mask=None):
+        if attn_mask is not None:
+            raise NotImplementedError(
+                "padding masks are not wired into the fused attention yet; "
+                "pack sequences or pad-to-multiple instead")
+        q = self.q_proj(hidden)
+        k = self.k_proj(hidden)
+        v = self.v_proj(hidden)
+        n_rep = self.num_heads // self.num_kv_heads
+        hd = self.head_dim
+        theta = self.config.rope_theta
+
+        def attn(qa, ka, va):
+            qh = qa.reshape(qa.shape[0], qa.shape[1], -1, hd)
+            kh = ka.reshape(ka.shape[0], ka.shape[1], -1, hd)
+            vh = va.reshape(va.shape[0], va.shape[1], -1, hd)
+            qh = jnp.swapaxes(qh, 1, 2)   # [B, H, S, D]
+            kh = jnp.swapaxes(kh, 1, 2)
+            vh = jnp.swapaxes(vh, 1, 2)
+            cos, sin = _rope_cos_sin(qa.shape[1], hd, theta)
+            cos = cos.astype(qh.dtype)[None].squeeze(0)
+            sin = sin.astype(qh.dtype)[None].squeeze(0)
+            qh = _apply_rope(qh, cos, sin)
+            kh = _apply_rope(kh, cos, sin)
+            if n_rep > 1:  # GQA: repeat kv heads
+                kh = jnp.repeat(kh, n_rep, axis=1)
+                vh = jnp.repeat(vh, n_rep, axis=1)
+            out = flash_attention(qh, kh, vh, causal=True)
+            out = jnp.swapaxes(out, 1, 2)
+            return out.reshape(out.shape[0], out.shape[1], -1)
+
+        ctx = apply(attn, q, k, v)
+        return self.o_proj(ctx)
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, i = config.hidden_size, config.intermediate_size
+        self.gate_proj = ColumnParallelLinear(h, i, has_bias=False,
+                                              gather_output=False)
+        self.up_proj = ColumnParallelLinear(h, i, has_bias=False,
+                                            gather_output=False)
+        self.down_proj = RowParallelLinear(i, h, has_bias=False,
+                                           input_is_parallel=True)
+
+    def forward(self, x):
+        gate = self.gate_proj(x)
+        up = self.up_proj(x)
+        act = apply(lambda g, u: jax.nn.silu(g) * u, gate, up)
+        return self.down_proj(act)
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+        self._use_recompute = config.use_recompute
+
+    def _block(self, hidden):
+        residual = hidden
+        h = self.input_layernorm(hidden)
+        h = self.self_attn(h)
+        hidden = residual + h
+        residual = hidden
+        h = self.post_attention_layernorm(hidden)
+        h = self.mlp(h)
+        return residual + h
+
+    def forward(self, hidden):
+        if self._use_recompute and self.training:
+            from ..distributed.fleet.utils.recompute import recompute
+            return recompute(self._block, hidden)
+        return self._block(hidden)
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        self.layers = LayerList([LlamaDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids):
+        hidden = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            hidden = layer(hidden)
+        return self.norm(hidden)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        self.lm_head = ColumnParallelLinear(config.hidden_size,
+                                            config.vocab_size,
+                                            has_bias=False,
+                                            gather_output=True)
+        self.loss_fn = ParallelCrossEntropy()
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.llama(input_ids)
+        logits = self.lm_head(hidden)
+        if labels is not None:
+            loss = self.loss_fn(logits, labels)
+            from ..tensor.math import mean
+            return mean(loss)
+        return logits
+
+    @classmethod
+    def from_preset(cls, name: str, **overrides):
+        import dataclasses
+        cfg = dataclasses.replace(LLAMA_PRESETS[name], **overrides)
+        return cls(cfg)

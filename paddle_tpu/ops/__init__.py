@@ -1,0 +1,7 @@
+"""Fused TPU ops: Pallas kernels + fused XLA paths.
+
+Reference analog: paddle/fluid/operators/fused/ (hand-fused CUDA kernels). On TPU
+most fusion is XLA's job; Pallas covers what XLA can't fuse well (blockwise
+attention over long sequences, sharded softmax-CE).
+"""
+from .attention import flash_attention, scaled_dot_product_attention  # noqa: F401

@@ -1,0 +1,247 @@
+"""Fused attention.
+
+Reference capability anchors: softmax_mask_fuse_upper_triangle_op.cu (fused causal
+mask+softmax for GPT) and multihead_matmul_op.cu — the reference has NO flash
+attention (SURVEY header); this is a parity-plus op named in the north star.
+
+Design (pallas_guide.md):
+- forward: Pallas kernel, grid (batch*heads, q_blocks), online-softmax scan over
+  k-blocks; QK^T and PV hit the MXU with fp32 accumulation; causal blocks are
+  skipped entirely (not just masked) so the causal path does ~half the FLOPs.
+- backward: custom-vjp recomputation in k-blocks via lax.scan using the saved
+  row logsumexp — memory stays O(S·block) instead of O(S²), XLA fuses the
+  elementwise chain. (A full Pallas backward kernel is a later optimization.)
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+_NEG_INF = -1e30
+
+
+def _attention_reference(q, k, v, causal, scale, mask=None):
+    """Plain-XLA reference (fp32 softmax). Used for short sequences and tests."""
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    Sq, Sk = logits.shape[-2], logits.shape[-1]
+    if causal:
+        qi = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+        causal_mask = qi + (Sk - Sq) >= ki
+        logits = jnp.where(causal_mask, logits, _NEG_INF)
+    if mask is not None:
+        logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k):
+    """3D grid (batch*heads, q_blocks, k_blocks). TPU grids iterate
+    sequentially with the last dimension innermost, so the online-softmax
+    state lives in VMEM scratch across the k steps of one (bh, qi) cell.
+    Only [block, d]-sized K/V tiles are resident in VMEM at a time."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    num_kb = pl.num_programs(2)
+    q_start = qi * block_q
+    k_start = kb * block_k
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: skip blocks entirely in the future
+    run = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = (q_start + rows) >= (k_start + cols)
+            s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[...] + jnp.log(l)).astype(jnp.float32)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq = min(block_q, Sq)
+    bk = min(block_k, Sk)
+    assert Sq % bq == 0 and Sk % bk == 0, (
+        "flash_attention requires sequence divisible by block size; "
+        "callers fall back to the XLA reference otherwise")
+    qr = q.reshape(B * H, Sq, D)
+    kr = k.reshape(B * H, Sk, D)
+    vr = v.reshape(B * H, Sk, D)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B * H, Sq // bq, Sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),   # acc
+            pltpu.VMEM((bq, 1), jnp.float32),   # running max
+            pltpu.VMEM((bq, 1), jnp.float32),   # running sum
+        ],
+        interpret=(jax.default_backend() == "cpu"),
+    )(qr, kr, vr)
+    return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq, 1)
+
+
+def _chunked_bwd(q, k, v, out, lse, g, causal, scale, block_k):
+    """Recompute-based backward, scanned over k-blocks (O(S·block) memory)."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bk = min(block_k, Sk)
+    n_kb = (Sk + bk - 1) // bk
+    q32 = q.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    # delta = rowsum(dO * O)
+    delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1, keepdims=True)
+
+    def body(carry, kb):
+        dq_acc = carry
+        k_start = kb * bk
+        kblk = jax.lax.dynamic_slice_in_dim(k, k_start, bk, axis=2)
+        vblk = jax.lax.dynamic_slice_in_dim(v, k_start, bk, axis=2)
+        kb32 = kblk.astype(jnp.float32)
+        vb32 = vblk.astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, kb32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (Sq, bk), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (Sq, bk), 1)
+            m = rows[None, None] >= (k_start + cols)[None, None]
+            s = jnp.where(m, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # [B,H,Sq,bk] softmax probs via saved lse
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", g32, vb32)
+        ds = p * (dp - delta) * scale
+        dq_blk = jnp.einsum("bhqk,bhkd->bhqd", ds, kb32)
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32)
+        return dq_acc + dq_blk, (dk, dv)
+
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        body, jnp.zeros_like(q32), jnp.arange(n_kb))
+    # scan stacks [n_kb, B, H, bk, D] → [B, H, n_kb*bk, D]
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(B, H, n_kb * bk, D)[:, :, :Sk]
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(B, H, n_kb * bk, D)[:, :, :Sk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    dq, dk, dv = _chunked_bwd(q, k, v, out, lse, g, causal, scale, block_k)
+    return dq, dk, dv
+
+
+_flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    force_pallas: bool = False, mask=None):
+    """q,k,v: [B, H, S, D] jax arrays. Returns [B, H, Sq, D].
+
+    Uses the Pallas kernel on TPU for long sequences; falls back to the fused
+    XLA reference for short sequences, CPU, or when an additive mask is given.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    on_tpu = jax.default_backend() not in ("cpu",)
+    long_seq = q.shape[2] >= 1024
+    Sq, Sk = q.shape[2], k.shape[2]
+    divisible = (Sq % min(block_q, Sq) == 0 and Sk % min(block_k, Sk) == 0)
+    square = Sq == Sk  # kernel's causal mask assumes self-attention offsets
+    eligible = divisible and (square or not causal)
+    if mask is not None or not eligible or (
+            not force_pallas and not (on_tpu and long_seq)):
+        return _attention_reference(q, k, v, causal, scale, mask)
+    return _flash_attention(q, k, v, causal, scale, block_q, block_k)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """paddle.nn.functional.scaled_dot_product_attention parity wrapper.
+    Tensors are [B, S, H, D] in paddle convention."""
+    from ..core.tensor import apply
+    from ..tensor.creation import _t
+
+    if dropout_p > 0.0 and training:
+        raise NotImplementedError(
+            "attention dropout is not implemented in the fused path; "
+            "apply nn.Dropout outside or use dropout_p=0.0")
+    q, k, v = _t(query), _t(key), _t(value)
+
+    def f(qa, ka, va, *m):
+        qt = jnp.swapaxes(qa, 1, 2)
+        kt = jnp.swapaxes(ka, 1, 2)
+        vt = jnp.swapaxes(va, 1, 2)
+        out = flash_attention(qt, kt, vt, causal=is_causal,
+                              mask=m[0] if m else None)
+        return jnp.swapaxes(out, 1, 2)
+
+    if attn_mask is not None:
+        return apply(f, q, k, v, _t(attn_mask))
+    return apply(f, q, k, v)

@@ -1,0 +1,1 @@
+from .api import ShardedTrainStep, parallelize  # noqa: F401

@@ -1,6 +1,9 @@
 """Test config: force an 8-device virtual CPU mesh so multi-chip sharding logic
 runs everywhere (SURVEY §4 implication: multi-node logic tested without a cluster).
-Must set XLA flags before jax initializes."""
+
+Gotcha: the axon TPU sitecustomize (/root/.axon_site) registers the TPU backend at
+interpreter start and overrides JAX_PLATFORMS — re-force cpu via jax.config before
+any backend initializes."""
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -9,12 +12,32 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
 def _reset_state():
     yield
-    # keep the eager tape from leaking across tests
     from paddle_tpu.core.tensor import reset_tape
     reset_tape()
+
+
+@pytest.fixture()
+def mesh8():
+    """A 2x1x2x2 (data/pipe/sharding/model) mesh over the 8 CPU devices.
+    Tears the global hybrid group down so mp_degree doesn't leak into
+    unrelated tests."""
+    from paddle_tpu.distributed import DistributedStrategy, fleet
+    from paddle_tpu.distributed.topology import _GLOBAL_HCG, _GLOBAL_MESH
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                               "pp_degree": 1, "sharding_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    yield hcg.build_mesh()
+    _GLOBAL_HCG[0] = None
+    _GLOBAL_MESH[0] = None
