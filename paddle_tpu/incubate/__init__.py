@@ -1,0 +1,22 @@
+"""paddle.incubate analog (reference: python/paddle/incubate/)."""
+from ..nn.layer.moe import MoELayer  # noqa: F401
+from ..ops.attention import flash_attention  # noqa: F401
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """reference: incubate/operators/softmax_mask_fuse_upper_triangle.py —
+    fused causal-masked softmax for GPT attention scores [B, H, S, S]."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.tensor import apply
+    from ..tensor.creation import _t
+
+    def f(a):
+        S = a.shape[-1]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        masked = jnp.where(rows >= cols, a.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(masked, axis=-1).astype(a.dtype)
+
+    return apply(f, _t(x))

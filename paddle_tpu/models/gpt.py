@@ -34,6 +34,12 @@ class GPTConfig:
     attention_dropout_prob: float = 0.0
     layer_norm_eps: float = 1e-5
     use_recompute: bool = False
+    # MoE (ERNIE-MoE-style, BASELINE config 5): 0 = dense
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_every_n_layers: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 0.01
 
     @property
     def head_dim(self):
@@ -51,6 +57,14 @@ GPT_PRESETS = {
                            num_attention_heads=16, intermediate_size=8192),
     "gpt3-6.7b": GPTConfig(hidden_size=4096, num_hidden_layers=32,
                            num_attention_heads=32, intermediate_size=16384),
+    "ernie-moe-tiny": GPTConfig(vocab_size=512, hidden_size=128,
+                                num_hidden_layers=4, num_attention_heads=4,
+                                intermediate_size=256,
+                                max_position_embeddings=512,
+                                moe_num_experts=4),
+    "ernie-moe-base": GPTConfig(hidden_size=768, num_hidden_layers=12,
+                                num_attention_heads=12,
+                                intermediate_size=3072, moe_num_experts=8),
 }
 
 
@@ -88,32 +102,50 @@ class GPTAttention(Layer):
 
 
 class GPTDecoderLayer(Layer):
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, use_moe: bool = False):
         super().__init__()
         h = config.hidden_size
         self.norm1 = LayerNorm(h, epsilon=config.layer_norm_eps)
         self.self_attn = GPTAttention(config)
         self.norm2 = LayerNorm(h, epsilon=config.layer_norm_eps)
-        self.linear1 = ColumnParallelLinear(h, config.intermediate_size,
-                                            has_bias=True,
-                                            gather_output=False)
-        self.linear2 = RowParallelLinear(config.intermediate_size, h,
-                                         has_bias=True,
-                                         input_is_parallel=True)
+        self.use_moe = use_moe
+        if use_moe:
+            from ..nn.layer.moe import MoELayer
+            self.moe = MoELayer(h, config.intermediate_size,
+                                config.moe_num_experts, config.moe_top_k,
+                                config.moe_capacity_factor)
+        else:
+            self.linear1 = ColumnParallelLinear(h, config.intermediate_size,
+                                                has_bias=True,
+                                                gather_output=False)
+            self.linear2 = RowParallelLinear(config.intermediate_size, h,
+                                             has_bias=True,
+                                             input_is_parallel=True)
         self.dropout = Dropout(config.hidden_dropout_prob)
         self._use_recompute = config.use_recompute
 
     def _block(self, x):
+        """Returns (x, aux_loss): the MoE aux loss must flow through the
+        function OUTPUT (not a layer attribute) so it survives recompute /
+        jax.checkpoint retracing."""
         x = x + self.self_attn(self.norm1(x))
-        h = self.linear1(self.norm2(x))
-        h = apply(lambda a: jax.nn.gelu(a), h)
-        h = self.linear2(h)
-        return x + self.dropout(h)
+        if self.use_moe:
+            h = self.moe(self.norm2(x))
+            aux = self.moe.aux_loss
+        else:
+            h = self.linear1(self.norm2(x))
+            h = apply(lambda a: jax.nn.gelu(a), h)
+            h = self.linear2(h)
+            aux = None
+        return x + self.dropout(h), aux
 
     def forward(self, x):
         if self._use_recompute and self.training:
             from ..distributed.fleet.utils.recompute import recompute
-            return recompute(self._block, x)
+            if self.use_moe:
+                return recompute(self._block, x)
+            out = recompute(lambda a: self._block(a)[0], x)
+            return out, None
         return self._block(x)
 
 
@@ -126,20 +158,29 @@ class GPTModel(Layer):
         self.position_embeddings = Embedding(config.max_position_embeddings,
                                              config.hidden_size)
         self.dropout = Dropout(config.hidden_dropout_prob)
-        self.layers = LayerList([GPTDecoderLayer(config)
-                                 for _ in range(config.num_hidden_layers)])
+
+        def _is_moe(i):
+            return (config.moe_num_experts > 0
+                    and (i + 1) % config.moe_every_n_layers == 0)
+
+        self.layers = LayerList([GPTDecoderLayer(config, use_moe=_is_moe(i))
+                                 for i in range(config.num_hidden_layers)])
         self.final_norm = LayerNorm(config.hidden_size,
                                     epsilon=config.layer_norm_eps)
 
     def forward(self, input_ids):
+        """Returns (hidden, total_aux_loss) — aux is None for dense models."""
         S = input_ids.shape[1]
         from ..tensor.creation import arange
         pos = arange(S, dtype="int64")
         hidden = self.word_embeddings(input_ids) + self.position_embeddings(pos)
         hidden = self.dropout(hidden)
+        total_aux = None
         for layer in self.layers:
-            hidden = layer(hidden)
-        return self.final_norm(hidden)
+            hidden, aux = layer(hidden)
+            if aux is not None:
+                total_aux = aux if total_aux is None else total_aux + aux
+        return self.final_norm(hidden), total_aux
 
 
 class GPTForCausalLM(Layer):
@@ -154,11 +195,14 @@ class GPTForCausalLM(Layer):
         self.loss_fn = ParallelCrossEntropy()
 
     def forward(self, input_ids, labels=None):
-        hidden = self.gpt(input_ids)
+        hidden, total_aux = self.gpt(input_ids)
         logits = self.lm_head(hidden)
         if labels is not None:
             from ..tensor.math import mean
-            return mean(self.loss_fn(logits, labels))
+            loss = mean(self.loss_fn(logits, labels))
+            if total_aux is not None:
+                loss = loss + total_aux * self.config.moe_aux_loss_weight
+            return loss
         return logits
 
     @classmethod

@@ -14,6 +14,7 @@ from .layer.conv import (Conv1D, Conv1DTranspose, Conv2D,  # noqa: F401
 from .layer.layers import (Layer, LayerList, ParamAttr,  # noqa: F401
                            ParameterList, Sequential)
 from .layer.loss import *  # noqa: F401,F403
+from .layer.moe import MoELayer  # noqa: F401
 from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D,  # noqa: F401
                          BatchNorm3D, GroupNorm, InstanceNorm1D,
                          InstanceNorm2D, InstanceNorm3D, LayerNorm,

@@ -1,0 +1,316 @@
+"""SPMD pipeline parallelism: microbatch schedule over the `pipe` mesh axis.
+
+Reference: the 1F1B SectionWorker loop (framework/section_worker.cc:149-183) and
+dygraph F-then-B (fleet/meta_parallel/pipeline_parallel.py:109), which schedule
+micro-batches across per-stage processes with send_v2/recv_v2.
+
+TPU-native redesign (MPMD-pipeline paper pattern, PAPERS.md): the L decoder
+layers are stacked into per-stage parameter pytrees with a leading stage dim
+sharded over `pipe`. One shard_map program runs T = n_micro + n_stages - 1 ticks
+of a lax.scan; each tick every stage applies its segment to its activation
+register, then registers rotate one hop via lax.ppermute (ICI neighbor
+transfer). Reverse-mode AD through the scan+ppermute yields the backward
+pipeline automatically — no hand-written grad schedule, and XLA overlaps the
+permute DMA with the next tick's compute. jax.checkpoint on the stage body
+keeps live activations at O(n_micro) instead of O(n_micro · layers).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PIPE_AXIS = "pipe"
+
+
+def stack_stage_params(per_layer_params: List[Dict], n_stages: int):
+    """[{name: arr} per layer] -> {name: [n_stages, layers_per_stage, ...]}.
+
+    Layers are grouped contiguously (SegmentLayers.uniform semantics; requires
+    n_layers % n_stages == 0 — pad the model or choose stages accordingly).
+    """
+    n_layers = len(per_layer_params)
+    assert n_layers % n_stages == 0, (
+        f"{n_layers} layers not divisible into {n_stages} stages")
+    per_stage = n_layers // n_stages
+    keys = per_layer_params[0].keys()
+    out = {}
+    for k in keys:
+        rows = []
+        for s in range(n_stages):
+            rows.append(jnp.stack(
+                [per_layer_params[s * per_stage + i][k]
+                 for i in range(per_stage)]))
+        out[k] = jnp.stack(rows)  # [n_stages, per_stage, ...]
+    return out
+
+
+def pipeline_apply(layer_fn: Callable, stage_params, microbatches,
+                   n_stages: int, axis: str = PIPE_AXIS,
+                   remat: bool = True):
+    """Run the pipelined stack. MUST be called inside shard_map with `axis`
+    mapped and stage_params' leading dim sharded over it.
+
+    layer_fn(layer_params, x) -> x applies ONE layer.
+    stage_params: {name: [1(local stage), per_stage, ...]} local shard.
+    microbatches: [n_micro, mb, ...] (replicated).
+    Returns [n_micro, mb, ...] outputs (valid on the last stage, broadcast).
+    """
+    n_micro = microbatches.shape[0]
+    stage_idx = lax.axis_index(axis)
+
+    local = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+
+    def stage_fn(params, x):
+        per_stage = jax.tree_util.tree_leaves(params)[0].shape[0]
+
+        def body(h, layer_params):
+            return layer_fn(layer_params, h), None
+
+        out, _ = lax.scan(body, x, params)
+        return out
+
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    T = n_micro + n_stages - 1
+    state0 = jnp.zeros_like(microbatches[0])
+    outputs0 = jnp.zeros_like(microbatches)
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (clamped); other stages use incoming
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        inp = jnp.where(stage_idx == 0,
+                        lax.dynamic_index_in_dim(microbatches, mb_idx, 0,
+                                                 keepdims=False),
+                        state)
+        out = stage_fn(local, inp)
+        # last stage finished microbatch (t - n_stages + 1) at tick t
+        done_idx = t - (n_stages - 1)
+        write = jnp.logical_and(stage_idx == n_stages - 1, done_idx >= 0)
+        slot = jnp.clip(done_idx, 0, n_micro - 1)
+        cur = lax.dynamic_index_in_dim(outputs, slot, 0, keepdims=False)
+        new = jnp.where(write, out, cur)
+        outputs = lax.dynamic_update_index_in_dim(outputs, new, slot, 0)
+        # rotate activations one hop forward on the ring
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        state = lax.ppermute(out, axis, perm)
+        return (state, outputs), None
+
+    (state, outputs), _ = lax.scan(tick, (state0, outputs0), jnp.arange(T))
+    # broadcast the last stage's outputs to all pipe ranks
+    last = n_stages - 1
+    outputs = lax.psum(
+        jnp.where(stage_idx == last, outputs, jnp.zeros_like(outputs)), axis)
+    return outputs
+
+
+class PipelinedTrainStep:
+    """Pipeline training for decoder-LM models (Llama/GPT family).
+
+    The embedding and head run replicated on every pipe rank (cheap relative to
+    the decoder stack at scale; the decoder layers are pipelined). Composes
+    with dp/sharding/model axes on the same mesh: non-pipe axes work exactly as
+    in ShardedTrainStep.
+    """
+
+    def __init__(self, model, optimizer, mesh: Mesh, n_micro: int = 4,
+                 remat: bool = True):
+        self.model = model
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.n_micro = n_micro
+        self.n_stages = mesh.shape[PIPE_AXIS]
+        self._step_count = 0
+
+        # --- split params: per-layer decoder params vs the rest ---
+        params, buffers = model.functional_state()
+        layers = self._decoder_layers()
+        n_layers = len(layers)
+        assert n_layers % self.n_stages == 0
+
+        layer_prefixes = self._layer_prefixes()
+        per_layer = []
+        for pfx in layer_prefixes:
+            per_layer.append({k[len(pfx):]: v for k, v in params.items()
+                              if k.startswith(pfx)})
+        key_sets = {frozenset(d.keys()) for d in per_layer}
+        if len(key_sets) != 1:
+            raise ValueError(
+                "PipelinedTrainStep requires homogeneous decoder layers "
+                "(identical parameter sets per layer); models interleaving "
+                "MoE and dense FFNs are not pipeline-stackable yet")
+        self._layer_keys = list(per_layer[0].keys())
+        stacked = stack_stage_params(per_layer, self.n_stages)
+        rest = {k: v for k, v in params.items()
+                if not any(k.startswith(p) for p in layer_prefixes)}
+
+        opt_all = optimizer.init_state(
+            {**rest, **{f"__stack__{k}": v for k, v in stacked.items()}})
+        apply_fn = optimizer.apply_gradients_fn()
+        clip_fn = optimizer.clip_gradients_fn()
+        self._buffers = buffers
+
+        stage_spec = {k: P(PIPE_AXIS) for k in stacked}
+        rest_spec = {k: P() for k in rest}
+
+        layer_fn = self._make_layer_fn()
+        embed_fn = self._make_embed_fn()
+        head_fn = self._make_head_fn()
+        n_micro_ = n_micro
+        n_stages_ = self.n_stages
+
+        def loss_from(stacked_, rest_, ids, labels):
+            hidden = embed_fn(rest_, ids)          # [B, S, H]
+            B = hidden.shape[0]
+            mb = B // n_micro_
+            mbs = hidden.reshape((n_micro_, mb) + hidden.shape[1:])
+            outs = pipeline_apply(
+                lambda lp, x: layer_fn(lp, x), stacked_, mbs, n_stages_,
+                remat=remat)
+            hidden = outs.reshape(hidden.shape)
+            return head_fn(rest_, hidden, labels)
+
+        def train_step(stacked_, rest_, opt_state, lr, arrays):
+            ids, labels = arrays
+
+            def lf(ps):
+                return loss_from(ps[0], ps[1], ids, labels)
+
+            loss, grads = jax.value_and_grad(lf)((stacked_, rest_))
+            g_stacked, g_rest = grads
+            flat_params = {**rest_,
+                           **{f"__stack__{k}": v for k, v in stacked_.items()}}
+            flat_grads = {**g_rest,
+                          **{f"__stack__{k}": v for k, v in g_stacked.items()}}
+            flat_grads = clip_fn(flat_grads)
+            new_flat, new_opt = apply_fn(flat_params, flat_grads, opt_state,
+                                         lr, 1)
+            new_rest = {k: v for k, v in new_flat.items()
+                        if not k.startswith("__stack__")}
+            new_stacked = {k[len("__stack__"):]: v
+                           for k, v in new_flat.items()
+                           if k.startswith("__stack__")}
+            return loss, new_stacked, new_rest, new_opt
+
+        # optimizer slots whose shape matches a stacked param are stage-sharded
+        opt_specs = {}
+        for k, slots in opt_all.items():
+            if k.startswith("__stack__"):
+                base = k[len("__stack__"):]
+                opt_specs[k] = {
+                    s: (P(PIPE_AXIS) if a.ndim == stacked[base].ndim else P())
+                    for s, a in slots.items()}
+            else:
+                opt_specs[k] = {s: P() for s in slots}
+
+        def put(arr, spec):
+            return jax.device_put(arr, NamedSharding(mesh, spec))
+
+        self._stacked = {k: put(v, stage_spec[k]) for k, v in stacked.items()}
+        self._rest = {k: put(v, P()) for k, v in rest.items()}
+        self._opt_state = {
+            k: {s: put(a, opt_specs[k][s]) for s, a in slots.items()}
+            for k, slots in opt_all.items()}
+
+        in_specs = (
+            {k: P(PIPE_AXIS) for k in stacked},
+            {k: P() for k in rest},
+            opt_specs,
+            P(),
+            (P(), P()),
+        )
+        out_specs = (P(), {k: P(PIPE_AXIS) for k in stacked},
+                     {k: P() for k in rest}, opt_specs)
+
+        self._jitted = jax.jit(jax.shard_map(
+            train_step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False))
+        self._opt_specs = opt_specs
+
+    # ---- model adapters (Llama & GPT families) ----
+    def _decoder_layers(self):
+        core = getattr(self.model, "llama", None) or getattr(
+            self.model, "gpt", None)
+        return list(core.layers)
+
+    def _layer_prefixes(self):
+        core_name = "llama" if hasattr(self.model, "llama") else "gpt"
+        n = len(self._decoder_layers())
+        return [f"{core_name}.layers.{i}." for i in range(n)]
+
+    def _make_layer_fn(self):
+        layer0 = self._decoder_layers()[0]
+
+        def layer_fn(layer_params, x):
+            from ..core.tensor import Tensor, no_grad
+            with layer0._bound_state(layer_params, {}):
+                with no_grad():
+                    out = layer0(Tensor(x))
+            if isinstance(out, tuple):  # GPT layers return (x, aux)
+                out = out[0]
+            return out.data if hasattr(out, "data") else out
+
+        return layer_fn
+
+    def _make_embed_fn(self):
+        model = self.model
+        core_name = "llama" if hasattr(model, "llama") else "gpt"
+        core = getattr(model, core_name)
+
+        def embed_fn(rest, ids):
+            from ..core.tensor import Tensor, no_grad
+            emb_keys = {k: v for k, v in rest.items()
+                        if "embed" in k or "position" in k}
+            with model._bound_state(emb_keys, {}):
+                with no_grad():
+                    if core_name == "llama":
+                        h = core.embed_tokens(Tensor(ids))
+                    else:
+                        from ..tensor.creation import arange
+                        pos = arange(ids.shape[1], dtype="int64")
+                        h = core.word_embeddings(Tensor(ids)) + \
+                            core.position_embeddings(pos)
+            return h.data
+
+        return embed_fn
+
+    def _make_head_fn(self):
+        model = self.model
+        core_name = "llama" if hasattr(model, "llama") else "gpt"
+        core = getattr(model, core_name)
+
+        def head_fn(rest, hidden, labels):
+            from ..core.tensor import Tensor, no_grad
+            keys = {k: v for k, v in rest.items()
+                    if k.startswith(f"{core_name}.norm")
+                    or k.startswith(f"{core_name}.final_norm")
+                    or k.startswith("lm_head")}
+            with model._bound_state(keys, {}):
+                with no_grad():
+                    if core_name == "llama":
+                        h = core.norm(Tensor(hidden))
+                    else:
+                        h = core.final_norm(Tensor(hidden))
+                    logits = model.lm_head(h)
+                    loss = model.loss_fn(logits, Tensor(labels))
+                    from ..tensor.math import mean
+                    loss = mean(loss)
+            return loss.data
+
+        return head_fn
+
+    def __call__(self, ids, labels):
+        from ..core.tensor import Tensor
+        ids = ids.data if isinstance(ids, Tensor) else jnp.asarray(ids)
+        labels = (labels.data if isinstance(labels, Tensor)
+                  else jnp.asarray(labels))
+        self._step_count += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        loss, self._stacked, self._rest, self._opt_state = self._jitted(
+            self._stacked, self._rest, self._opt_state, lr, (ids, labels))
+        return Tensor(loss)

@@ -1,0 +1,129 @@
+"""Sequence/context parallelism: ring attention + Ulysses (all-to-all) attention.
+
+The reference has NO sequence parallelism of any kind (SURVEY header: repo-wide
+grep zero hits) — these are parity-plus capabilities named in the north star,
+designed TPU-first per PAPERS.md (blockwise ring attention; DeepSpeed-Ulysses):
+
+- ring_attention: q stays resident; k/v shards rotate around the `sep` mesh axis
+  via lax.ppermute (neighbor ICI hops), with online-softmax accumulation across
+  ring steps — memory O(S_local²) per chip, sequence length scales with the
+  ring size. Causal blocks ahead of the diagonal contribute nothing (masked).
+- ulysses_attention: all_to_all swaps the sequence shard dim for the head dim,
+  runs dense/flash attention on full sequences for H/n local heads, and swaps
+  back — two all_to_alls instead of n-1 permutes; best when H % n == 0.
+
+Both are pure functions over local shards intended for use inside shard_map
+(the sep axis mapped); both differentiate through scan/ppermute so the backward
+pass is the reverse ring/all_to_all automatically.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+SEP_AXIS = "sep"
+
+
+def ring_attention(q, k, v, axis: str = SEP_AXIS, causal: bool = True,
+                   scale: Optional[float] = None):
+    """q,k,v: LOCAL sequence shards [B, H, S_local, D] inside shard_map.
+
+    Sequence blocks are laid out contiguously by rank: rank r owns tokens
+    [r*S_local, (r+1)*S_local).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    n = lax.psum(1, axis)
+    my_idx = lax.axis_index(axis)
+    B, H, S, D = q.shape
+    q32 = q.astype(jnp.float32) * scale
+
+    def step(carry, r):
+        acc, m_prev, l_prev, k_cur, v_cur = carry
+        # k_cur originated at rank (my_idx - r) mod n
+        src = (my_idx - r) % n
+
+        def compute(args):
+            acc, m_prev, l_prev = args
+            s = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                           k_cur.astype(jnp.float32))
+            if causal:
+                rows = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+                cols = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+                q_pos = my_idx * S + rows
+                k_pos = src * S + cols
+                mask = q_pos >= k_pos
+                s = jnp.where(mask[None, None], s, _NEG_INF)
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * alpha + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+            return acc_new, m_new, l_new
+
+        # causal: when the source block is entirely in the future, skip
+        if causal:
+            skip = src > my_idx
+            acc, m_prev, l_prev = lax.cond(
+                skip, lambda a: a, compute, (acc, m_prev, l_prev))
+        else:
+            acc, m_prev, l_prev = compute((acc, m_prev, l_prev))
+
+        # rotate k/v one hop forward: rank i sends to i+1, so at step r+1
+        # this rank holds the block that originated at (my_idx - (r+1))
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        return (acc, m_prev, l_prev, k_nxt, v_nxt), None
+
+    acc0 = jnp.zeros((B, H, S, D), jnp.float32)
+    m0 = jnp.full((B, H, S, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, S, 1), jnp.float32)
+    (acc, m, l, _, _), _ = lax.scan(
+        step, (acc0, m0, l0, k, v), jnp.arange(n))
+    l = jnp.maximum(l, 1e-30)
+    return (acc / l).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis: str = SEP_AXIS, causal: bool = True,
+                      scale: Optional[float] = None):
+    """DeepSpeed-Ulysses: all_to_all seq-shard ↔ head-shard swap.
+
+    q,k,v: local [B, H, S_local, D] with full head count H; requires
+    H % axis_size == 0. After the swap each rank holds [B, H/n, S_full, D],
+    runs full attention (flash path), and swaps back.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def seq2head(x):
+        # [B, H, S_loc, D] -> all_to_all over H -> [B, H/n, S_full, D]
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def head2seq(x):
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+    from ..ops.attention import flash_attention
+    out = flash_attention(qg, kg, vg, causal=causal, scale=scale)
+    return head2seq(out)
+
+
+def sequence_parallel_attention(q, k, v, mode: str = "ring",
+                                axis: str = SEP_AXIS, causal: bool = True,
+                                scale: Optional[float] = None):
+    if mode == "ring":
+        return ring_attention(q, k, v, axis, causal, scale)
+    if mode in ("ulysses", "all_to_all"):
+        return ulysses_attention(q, k, v, axis, causal, scale)
+    raise ValueError(f"unknown sequence-parallel mode {mode!r}")

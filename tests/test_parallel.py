@@ -1,0 +1,152 @@
+"""Distributed correctness: N-device SPMD runs must match single-device numerics
+(the reference's TestDistBase loss-parity strategy, SURVEY §4 item 4, run on the
+virtual 8-device CPU mesh)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as optim
+from paddle_tpu.models.llama import LlamaForCausalLM
+from paddle_tpu.parallel import ShardedTrainStep
+
+
+def _data(cfg, B=8, S=32, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+    return ids, labels
+
+
+def _single_device_losses(model, opt, ids, labels, steps):
+    params, buffers = model.functional_state()
+    opt_state = opt.init_state(params)
+    apply_fn = opt.apply_gradients_fn()
+    clip_fn = opt.clip_gradients_fn()
+
+    def loss_fn(p, b, rng, i, l):
+        out, nb = model.functional_call_with_state(p, b, i, l, rng=rng)
+        return out, nb
+
+    @jax.jit
+    def step_fn(p, o, b, i, l, rng):
+        (loss, nb), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            p, b, rng, i, l)
+        grads = clip_fn(grads)
+        np_, no_ = apply_fn(p, grads, o, 1e-3, 1)
+        return loss, np_, no_, nb
+
+    losses = []
+    for s in range(steps):
+        loss, params, opt_state, buffers = step_fn(
+            params, opt_state, buffers, ids, labels,
+            jax.random.PRNGKey(s + 1))
+        losses.append(float(loss))
+    return losses
+
+
+def test_hybrid_sharded_step_matches_single_device(mesh8):
+    """dp2 x sharding2 x tp2 training == single-device training (loss parity,
+    the TestDistBase assertion)."""
+    paddle.seed(0)
+    model = LlamaForCausalLM.from_preset("llama2-tiny")
+    cfg = model.config
+    ids, labels = _data(cfg)
+
+    opt1 = optim.AdamW(learning_rate=1e-3,
+                       parameters=model.parameters())
+    ref_losses = _single_device_losses(model, opt1, ids, labels, steps=3)
+
+    opt2 = optim.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = ShardedTrainStep(model, opt2, mesh8, zero_stage=1)
+    sharded_losses = [float(step(ids, labels).item()) for _ in range(3)]
+
+    np.testing.assert_allclose(sharded_losses, ref_losses, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_zero_stage1_shards_optimizer_state(mesh8):
+    paddle.seed(0)
+    model = LlamaForCausalLM.from_preset("llama2-tiny")
+    opt = optim.Adam(learning_rate=1e-3, parameters=model.parameters())
+    step = ShardedTrainStep(model, opt, mesh8, zero_stage=1)
+    # at least one big param's moment must carry the sharding axis
+    sharded = [
+        k for k, per in step.opt_state_specs.items()
+        if any("sharding" in str(spec) for spec in per.values())
+    ]
+    assert sharded, "no optimizer slot got the ZeRO sharding axis"
+    # and the actual arrays must be laid out shard-wise (fewer bytes per dev)
+    k = sharded[0]
+    arr = step._opt_state[k]["moment1"]
+    shard_shape = arr.sharding.shard_shape(arr.shape)
+    assert np.prod(shard_shape) < np.prod(arr.shape)
+
+
+def test_zero_stage3_shards_parameters(mesh8):
+    paddle.seed(0)
+    model = LlamaForCausalLM.from_preset("llama2-tiny")
+    opt = optim.Adam(learning_rate=1e-3, parameters=model.parameters())
+    step = ShardedTrainStep(model, opt, mesh8, zero_stage=3)
+    sharded = [k for k, s in step.param_specs.items()
+               if "sharding" in str(s)]
+    assert sharded, "stage-3 did not shard any parameter"
+    ids, labels = _data(model.config)
+    loss = float(step(ids, labels).item())
+    assert np.isfinite(loss)
+
+
+def test_tp_weights_sharded_on_model_axis(mesh8):
+    paddle.seed(0)
+    model = LlamaForCausalLM.from_preset("llama2-tiny")
+    opt = optim.SGD(learning_rate=1e-3, parameters=model.parameters())
+    step = ShardedTrainStep(model, opt, mesh8)
+    qspec = step.param_specs["llama.layers.0.self_attn.q_proj.weight"]
+    assert "model" in str(qspec)
+    arr = step._params["llama.layers.0.self_attn.q_proj.weight"]
+    shard = arr.sharding.shard_shape(arr.shape)
+    assert shard[1] == arr.shape[1] // 2  # tp=2 splits the output dim
+
+
+def test_explicit_tp_column_row_parity():
+    """shard_map explicit-TP path (reference mp_layers semantics) matches the
+    dense computation — hybrid_parallel_mp_layers.py analog."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from paddle_tpu.distributed.collective import axis_context
+
+    devs = np.array(jax.devices()[:2]).reshape(2)
+    mesh = Mesh(devs, ("model",))
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8).astype(np.float32)
+    w1 = rng.randn(8, 16).astype(np.float32)
+    w2 = rng.randn(16, 8).astype(np.float32)
+    dense = np.maximum(x @ w1, 0) @ w2
+
+    def f(xs, w1s, w2s):
+        with axis_context(("model",)):
+            h = jnp.maximum(xs @ w1s, 0)
+            out = jax.lax.psum(h @ w2s, "model")
+        return out
+
+    sharded = shard_map(
+        f, mesh=mesh,
+        in_specs=(P(), P(None, "model"), P("model", None)),
+        out_specs=P())(jnp.asarray(x), jnp.asarray(w1), jnp.asarray(w2))
+    np.testing.assert_allclose(np.asarray(sharded), dense, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_sync_to_model_roundtrip(mesh8):
+    paddle.seed(0)
+    model = LlamaForCausalLM.from_preset("llama2-tiny")
+    opt = optim.SGD(learning_rate=1e-2, parameters=model.parameters())
+    step = ShardedTrainStep(model, opt, mesh8)
+    before = model.llama.embed_tokens.weight.numpy().copy()
+    ids, labels = _data(model.config)
+    step(ids, labels)
+    step.sync_to_model()
+    after = model.llama.embed_tokens.weight.numpy()
+    assert not np.allclose(before, after), "params did not update"
