@@ -32,15 +32,24 @@ from .tensor import einsum  # noqa: F401
 
 from . import amp  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
+from . import checkpoint  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
 from . import io  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
+from . import models  # noqa: F401,E402
 from . import nn  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
+from . import parallel  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import static  # noqa: F401,E402
+from . import text  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
+from .flags import get_flags, set_flags  # noqa: F401,E402
+from .hapi import Model  # noqa: F401,E402
 from .nn.layer.layers import ParamAttr  # noqa: F401,E402
 
 # paddle.disable_static / enable_static parity: eager is the default and the

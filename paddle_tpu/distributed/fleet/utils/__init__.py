@@ -1,1 +1,5 @@
+from . import fs  # noqa: F401
+from . import http_server  # noqa: F401
+from .fs import FS, HDFSClient, LocalFS  # noqa: F401
+from .http_server import KVClient, KVServer  # noqa: F401
 from .recompute import recompute  # noqa: F401

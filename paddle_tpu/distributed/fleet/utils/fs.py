@@ -1,0 +1,149 @@
+"""Filesystem abstraction (reference: fleet/utils/fs.py:57 — FS/LocalFS/HDFSClient
+used for checkpoint and rendezvous plumbing).
+
+TPU-native: LocalFS covers POSIX and fuse-mounted GCS; HDFSClient is kept as an
+interface raising unless a hadoop binary is configured (out of scope in a
+zero-egress environment)."""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    def ls_dir(self, fs_path) -> List[str]:
+        if not self.is_exist(fs_path):
+            return []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            if os.path.isdir(os.path.join(fs_path, name)):
+                dirs.append(name)
+            else:
+                files.append(name)
+        return dirs + files
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path, ignore_errors=True)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        open(fs_path, "a").close()
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def list_dirs(self, fs_path):
+        return [d for d in self.ls_dir(fs_path)
+                if os.path.isdir(os.path.join(fs_path, d))]
+
+
+class HDFSClient(FS):
+    """Interface parity; requires a local `hadoop` binary to function."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=300000,
+                 sleep_inter=1000):
+        self._hadoop = (os.path.join(hadoop_home, "bin/hadoop")
+                        if hadoop_home else "hadoop")
+        self._configs = configs or {}
+
+    def _run(self, *args):
+        cfg = []
+        for k, v in self._configs.items():
+            cfg.extend(["-D", f"{k}={v}"])
+        cmd = [self._hadoop, "fs"] + cfg + list(args)
+        try:
+            out = subprocess.run(cmd, capture_output=True, timeout=300)
+        except FileNotFoundError as e:
+            raise ExecuteError(
+                f"hadoop binary not available: {e}") from e
+        if out.returncode != 0:
+            raise ExecuteError(out.stderr.decode())
+        return out.stdout.decode()
+
+    def is_exist(self, fs_path):
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path):
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        return [line.split()[-1] for line in out.splitlines()
+                if line and not line.startswith("Found")]
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        self._run("-touchz", fs_path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)

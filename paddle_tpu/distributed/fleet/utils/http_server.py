@@ -1,0 +1,91 @@
+"""KV-store HTTP server for rendezvous (reference: fleet/utils/http_server.py —
+the gloo rendezvous KV used by role makers).
+
+jax.distributed replaces this for collective bootstrap; kept for API parity and
+for user scripts that coordinate via the KV store."""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib import request as urlrequest
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    kv = {}
+    lock = threading.Lock()
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        with self.lock:
+            val = self.kv.get(self.path)
+        if val is None:
+            self.send_response(404)
+            self.end_headers()
+        else:
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(val)))
+            self.end_headers()
+            self.wfile.write(val)
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        data = self.rfile.read(length)
+        with self.lock:
+            self.kv[self.path] = data
+        self.send_response(200)
+        self.end_headers()
+
+    do_POST = do_PUT
+
+    def do_DELETE(self):
+        with self.lock:
+            self.kv.pop(self.path, None)
+        self.send_response(200)
+        self.end_headers()
+
+
+class KVServer:
+    def __init__(self, port, size=None):
+        self.port = port
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    def should_stop(self):
+        return False
+
+
+class KVClient:
+    def __init__(self, endpoint):
+        self.endpoint = endpoint if endpoint.startswith("http") else \
+            f"http://{endpoint}"
+
+    def put(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        req = urlrequest.Request(f"{self.endpoint}{key}", data=value,
+                                 method="PUT")
+        with urlrequest.urlopen(req, timeout=10) as r:
+            return r.status == 200
+
+    def get(self, key):
+        try:
+            with urlrequest.urlopen(f"{self.endpoint}{key}", timeout=10) as r:
+                return r.read().decode()
+        except Exception:
+            return None
+
+    def delete(self, key):
+        req = urlrequest.Request(f"{self.endpoint}{key}", method="DELETE")
+        with urlrequest.urlopen(req, timeout=10) as r:
+            return r.status == 200

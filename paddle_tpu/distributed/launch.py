@@ -1,0 +1,159 @@
+"""Cluster launcher CLI: `python -m paddle_tpu.distributed.launch train.py`.
+
+Reference: fleet/launch.py:396 (CollectiveLauncher spawning one process per GPU
+with PADDLE_TRAINER_* env) + launch_utils.py (Cluster/Pod model, log redirection,
+watch_local_trainers restart/abort) + elastic.py:90 (etcd membership watch).
+
+TPU-native: the unit is one process per HOST (jax owns all local chips), so on a
+single host the launcher mostly execs the script directly; multi-host mode wires
+PADDLE_TRAINER_ENDPOINTS → jax.distributed coordinator. `--nproc_per_node` is
+still honored for CPU-mesh testing (reference TestDistBase pattern). A watch
+loop restarts failed ranks up to --max_restarts (elastic.py behavior without the
+etcd dependency; state comes back via checkpoint auto-resume).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+class Pod:
+    def __init__(self, rank, endpoints, script, script_args, log_dir, env):
+        self.rank = rank
+        self.endpoints = endpoints
+        self.script = script
+        self.script_args = script_args
+        self.log_dir = log_dir
+        self.env = env
+        self.proc = None
+        self.log_fh = None
+
+    def start(self):
+        env = dict(os.environ)
+        env.update(self.env)
+        env["PADDLE_TRAINER_ID"] = str(self.rank)
+        env["PADDLE_TRAINERS_NUM"] = str(len(self.endpoints))
+        env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(self.endpoints)
+        env["PADDLE_CURRENT_ENDPOINT"] = self.endpoints[self.rank]
+        cmd = [sys.executable, self.script] + list(self.script_args)
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self.log_fh = open(
+                os.path.join(self.log_dir, f"worker.{self.rank}.log"), "a")
+            self.proc = subprocess.Popen(cmd, env=env, stdout=self.log_fh,
+                                         stderr=subprocess.STDOUT)
+        else:
+            self.proc = subprocess.Popen(cmd, env=env)
+        return self.proc
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    def returncode(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self):
+        if self.alive():
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        if self.log_fh:
+            self.log_fh.close()
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch distributed training (one process per host)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes on this host (CPU-mesh testing; on TPU "
+                        "keep 1 — jax drives all local chips)")
+    p.add_argument("--hosts", type=str, default=None,
+                   help="comma list host:port of all nodes; this host first "
+                        "env-detected via PADDLE_TRAINER_ID")
+    p.add_argument("--started_port", type=int, default=36001)
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic: restart failed workers this many times")
+    p.add_argument("--devices", type=str, default=None,
+                   help="accepted for reference-CLI parity; ignored (XLA "
+                        "owns device selection)")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def get_cluster(args):
+    if args.hosts:
+        endpoints = args.hosts.split(",")
+    else:
+        endpoints = [f"127.0.0.1:{args.started_port + i}"
+                     for i in range(args.nproc_per_node)]
+    return endpoints
+
+
+def watch_local_trainers(pods, max_restarts):
+    """launch_utils.watch_local_trainers + elastic restart semantics."""
+    restarts = 0
+    while True:
+        time.sleep(0.5)
+        statuses = [(p, p.returncode()) for p in pods]
+        failed = [p for p, rc in statuses if rc not in (None, 0)]
+        done = all(rc == 0 for _, rc in statuses)
+        if done:
+            return 0
+        if failed:
+            if restarts < max_restarts:
+                restarts += 1
+                print(f"[launch] {len(failed)} worker(s) failed; "
+                      f"restart {restarts}/{max_restarts}", file=sys.stderr)
+                for p in pods:
+                    p.terminate()
+                for p in pods:
+                    p.start()
+            else:
+                for p in pods:
+                    p.terminate()
+                return failed[0].returncode() or 1
+
+
+def launch(argv=None):
+    args = parse_args(argv)
+    endpoints = get_cluster(args)
+    script_args = list(args.training_script_args)
+    if script_args and script_args[0] == "--":
+        script_args = script_args[1:]
+
+    if args.hosts:
+        # multi-host: this process IS the single per-host worker
+        rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        pod = Pod(rank, endpoints, args.training_script, script_args,
+                  args.log_dir, {})
+        pod.start()
+        rc = pod.proc.wait()
+        sys.exit(rc)
+
+    pods = [Pod(i, endpoints, args.training_script, script_args,
+                args.log_dir, {}) for i in range(len(endpoints))]
+    for pod in pods:
+        pod.start()
+
+    def _sig(_s, _f):
+        for p in pods:
+            p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    rc = watch_local_trainers(pods, args.max_restarts)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    launch()

@@ -1,0 +1,71 @@
+"""Global flags (reference: platform/flags.cc 35 gflags +
+pybind/global_value_getter_setter.cc:338, surfaced as paddle.get_flags/set_flags).
+
+Three tiers map onto TPU equivalents:
+- framework knobs handled here (FLAGS_check_nan_inf → jax debug_nans, etc.);
+- XLA knobs forwarded to jax.config / XLA_FLAGS;
+- CUDA-only knobs accepted and ignored (listed so reference scripts run).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Union
+
+import jax
+
+_FLAGS: Dict[str, object] = {
+    # functional sanitizer (platform/flags.cc:44)
+    "FLAGS_check_nan_inf": False,
+    # memory knobs — XLA owns allocation; retained for introspection
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_allocator_strategy": "xla",
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    # numeric
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_embedding_deterministic": False,
+    # comm — no rings on TPU; accepted for parity
+    "FLAGS_nccl_nrings": 1,
+    "FLAGS_sync_nccl_allreduce": True,
+    # profiler
+    "FLAGS_enable_rpc_profiler": False,
+    "FLAGS_selected_gpus": "",
+    "FLAGS_selected_tpus": "",
+}
+
+# env-var overrides at import (gflags behavior)
+for _k in list(_FLAGS):
+    if _k in os.environ:
+        v = os.environ[_k]
+        cur = _FLAGS[_k]
+        if isinstance(cur, bool):
+            _FLAGS[_k] = v.lower() in ("1", "true", "yes")
+        elif isinstance(cur, (int, float)):
+            _FLAGS[_k] = type(cur)(v)
+        else:
+            _FLAGS[_k] = v
+
+
+def get_flags(flags: Union[str, List[str]]):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        if f not in _FLAGS:
+            raise ValueError(f"unknown flag {f!r}")
+        out[f] = _FLAGS[f]
+    return out
+
+
+def set_flags(flags: Dict[str, object]):
+    for k, v in flags.items():
+        if k not in _FLAGS:
+            raise ValueError(f"unknown flag {k!r}")
+        _FLAGS[k] = v
+        if k == "FLAGS_check_nan_inf":
+            # nan_inf_utils_detail analog: XLA checks every op result
+            jax.config.update("jax_debug_nans", bool(v))
+        elif k in ("FLAGS_cudnn_deterministic",
+                   "FLAGS_embedding_deterministic"):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_gpu_deterministic_ops=true").strip()

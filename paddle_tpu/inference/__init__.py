@@ -1,0 +1,166 @@
+"""Inference engine (reference: paddle/fluid/inference/ — AnalysisPredictor:82,
+AnalysisConfig, zero-copy tensors).
+
+TPU-native serving: "analysis passes" are XLA's job, so export = trace the model
+once and serialize the StableHLO module (jax.export); serve = deserialize + call
+the compiled executable with zero host copies (device arrays in/out). The C++
+predictor (csrc/) consumes the same artifact via the PJRT C API.
+
+API parity:
+    config = Config(model_dir)            # AnalysisConfig analog
+    predictor = create_predictor(config)
+    inp = predictor.get_input_handle(name); inp.copy_from_cpu(arr)
+    predictor.run()
+    out = predictor.get_output_handle(names[0]).copy_to_cpu()
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+def export_model(layer: Layer, example_inputs, path: str):
+    """Export a Layer for serving: StableHLO module + weights + metadata.
+
+    example_inputs: list of Tensors/arrays fixing the traced shapes (dynamic
+    batch via jax.export symbolic dims is a follow-up).
+    """
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    params, buffers = layer.functional_state()
+    arrays = [a.data if isinstance(a, Tensor) else jnp.asarray(a)
+              for a in example_inputs]
+
+    def fwd(params, buffers, *xs):
+        layer.eval()
+        return layer.functional_call(params, buffers, *xs)
+
+    exported = jax.export.export(jax.jit(fwd))(params, buffers, *arrays)
+    with open(path + ".stablehlo", "wb") as f:
+        f.write(exported.serialize())
+    from ..framework_io import save as _save
+    _save({"params": params, "buffers": buffers}, path + ".pdiparams")
+    meta = {
+        "inputs": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                   for a in arrays],
+        "input_names": [f"x{i}" for i in range(len(arrays))],
+        "output_names": ["output"],
+    }
+    with open(path + ".pdmodel.json", "w") as f:
+        json.dump(meta, f)
+    return path
+
+
+class Config:
+    """AnalysisConfig analog. GPU/MKLDNN/TensorRT toggles are accepted and
+    ignored — XLA owns optimization on TPU."""
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        self.model_path = model_path
+        self._use_tpu = True
+        self.switch_ir_optim_ = True
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        self.switch_ir_optim_ = flag
+
+    def enable_memory_optim(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def enable_tensorrt_engine(self, **kwargs):
+        pass
+
+
+class _IOHandle:
+    """Zero-copy tensor handle (ZeroCopyTensor analog)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._array = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._array = jnp.asarray(arr)
+
+    def share_external_data(self, tensor):
+        self._array = tensor.data if isinstance(tensor, Tensor) else tensor
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._array)
+
+    def reshape(self, shape):
+        pass
+
+    def shape(self):
+        return list(self._array.shape) if self._array is not None else None
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        self.config = config
+        path = config.model_path
+        with open(path + ".stablehlo", "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        from ..framework_io import load as _load
+        state = _load(path + ".pdiparams")
+        self._params = {k: (v.data if isinstance(v, Tensor) else v)
+                        for k, v in state["params"].items()}
+        self._buffers = {k: (v.data if isinstance(v, Tensor) else v)
+                         for k, v in state["buffers"].items()}
+        with open(path + ".pdmodel.json") as f:
+            self._meta = json.load(f)
+        self._inputs = {n: _IOHandle(n) for n in self._meta["input_names"]}
+        self._outputs = {n: _IOHandle(n) for n in self._meta["output_names"]}
+        self._call = jax.jit(self._exported.call)
+
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs)
+
+    def get_input_handle(self, name) -> _IOHandle:
+        return self._inputs[name]
+
+    def get_output_handle(self, name) -> _IOHandle:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is not None:
+            for h, arr in zip(self._inputs.values(), inputs):
+                h.copy_from_cpu(arr)
+        args = [self._inputs[n]._array for n in self._meta["input_names"]]
+        out = self._call(self._params, self._buffers, *args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for h, o in zip(self._outputs.values(), outs):
+            h._array = o
+        if inputs is not None:
+            return [np.asarray(o) for o in outs]
+        return None
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+# eager convenience mirroring paddle.inference usage with jit.save artifacts
+def load_predictor(path: str) -> Predictor:
+    return Predictor(Config(path))

@@ -63,7 +63,7 @@ def _top_k_dispatch(gates, capacity, top_k):
 
 
 def moe_forward(x, gate_w, w1, b1, w2, b2, top_k, capacity_factor,
-                activation=jax.nn.gelu, expert_axis: str = EXPERT_AXIS):
+                activation=jax.nn.gelu):
     """Pure MoE math over arrays. x: [B, S, H]; w1: [E, H, F]; w2: [E, F, H]."""
     B, S, H = x.shape
     E = w1.shape[0]

@@ -28,16 +28,23 @@ DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30
 
 
+def causal_mask(n_rows: int, n_cols: int, q_offset=0, k_offset=0):
+    """Boolean [n_rows, n_cols] mask: True where query position >= key
+    position (with absolute offsets). Shared by the XLA reference, the Pallas
+    kernel blocks, the chunked backward, and incubate's fused softmax."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n_rows, n_cols), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (n_rows, n_cols), 1)
+    return (q_offset + rows) >= (k_offset + cols)
+
+
 def _attention_reference(q, k, v, causal, scale, mask=None):
     """Plain-XLA reference (fp32 softmax). Used for short sequences and tests."""
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     Sq, Sk = logits.shape[-2], logits.shape[-1]
     if causal:
-        qi = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
-        ki = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
-        causal_mask = qi + (Sk - Sq) >= ki
-        logits = jnp.where(causal_mask, logits, _NEG_INF)
+        logits = jnp.where(causal_mask(Sq, Sk, q_offset=Sk - Sq), logits,
+                           _NEG_INF)
     if mask is not None:
         logits = logits + mask.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
@@ -74,10 +81,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (q_start + rows) >= (k_start + cols)
-            s = jnp.where(mask, s, _NEG_INF)
+            s = jnp.where(causal_mask(block_q, block_k, q_start, k_start), s,
+                          _NEG_INF)
         m_prev = m_ref[...]
         l_prev = l_ref[...]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -157,10 +162,8 @@ def _chunked_bwd(q, k, v, out, lse, g, causal, scale, block_k):
         vb32 = vblk.astype(jnp.float32)
         s = jnp.einsum("bhqd,bhkd->bhqk", q32, kb32) * scale
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (Sq, bk), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (Sq, bk), 1)
-            m = rows[None, None] >= (k_start + cols)[None, None]
-            s = jnp.where(m, s, _NEG_INF)
+            m = causal_mask(Sq, bk, k_offset=k_start)
+            s = jnp.where(m[None, None], s, _NEG_INF)
         p = jnp.exp(s - lse)  # [B,H,Sq,bk] softmax probs via saved lse
         dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
         dp = jnp.einsum("bhqd,bhkd->bhqk", g32, vb32)

@@ -65,8 +65,6 @@ def pipeline_apply(layer_fn: Callable, stage_params, microbatches,
     local = jax.tree_util.tree_map(lambda a: a[0], stage_params)
 
     def stage_fn(params, x):
-        per_stage = jax.tree_util.tree_leaves(params)[0].shape[0]
-
         def body(h, layer_params):
             return layer_fn(layer_params, h), None
 
@@ -144,7 +142,12 @@ class PipelinedTrainStep:
                 "PipelinedTrainStep requires homogeneous decoder layers "
                 "(identical parameter sets per layer); models interleaving "
                 "MoE and dense FFNs are not pipeline-stackable yet")
-        self._layer_keys = list(per_layer[0].keys())
+        if any("moe." in k for k in per_layer[0]):
+            raise NotImplementedError(
+                "MoE layers are not supported under PipelinedTrainStep yet: "
+                "the stage scan would drop the auxiliary load-balance loss. "
+                "Use ShardedTrainStep with an ep mesh axis for MoE models.")
+        self._layer_prefix_list = layer_prefixes
         stacked = stack_stage_params(per_layer, self.n_stages)
         rest = {k: v for k, v in params.items()
                 if not any(k.startswith(p) for p in layer_prefixes)}
@@ -175,7 +178,7 @@ class PipelinedTrainStep:
             hidden = outs.reshape(hidden.shape)
             return head_fn(rest_, hidden, labels)
 
-        def train_step(stacked_, rest_, opt_state, lr, arrays):
+        def train_step(stacked_, rest_, opt_state, lr, step, arrays):
             ids, labels = arrays
 
             def lf(ps):
@@ -189,7 +192,7 @@ class PipelinedTrainStep:
                           **{f"__stack__{k}": v for k, v in g_stacked.items()}}
             flat_grads = clip_fn(flat_grads)
             new_flat, new_opt = apply_fn(flat_params, flat_grads, opt_state,
-                                         lr, 1)
+                                         lr, step)
             new_rest = {k: v for k, v in new_flat.items()
                         if not k.startswith("__stack__")}
             new_stacked = {k[len("__stack__"):]: v
@@ -222,14 +225,16 @@ class PipelinedTrainStep:
             {k: P() for k in rest},
             opt_specs,
             P(),
+            P(),
             (P(), P()),
         )
         out_specs = (P(), {k: P(PIPE_AXIS) for k in stacked},
                      {k: P() for k in rest}, opt_specs)
 
-        self._jitted = jax.jit(jax.shard_map(
-            train_step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=False))
+        self._jitted = jax.jit(
+            jax.shard_map(train_step, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False),
+            donate_argnums=(0, 1, 2))
         self._opt_specs = opt_specs
 
     # ---- model adapters (Llama & GPT families) ----
@@ -311,6 +316,27 @@ class PipelinedTrainStep:
                   else jnp.asarray(labels))
         self._step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        step = jnp.asarray(self._step_count, jnp.int32)
         loss, self._stacked, self._rest, self._opt_state = self._jitted(
-            self._stacked, self._rest, self._opt_state, lr, (ids, labels))
+            self._stacked, self._rest, self._opt_state, lr, step,
+            (ids, labels))
         return Tensor(loss)
+
+    def sync_to_model(self):
+        """Write trained weights back into the eager model (checkpointing).
+        Unstacks the [n_stages, per_stage, ...] decoder tensors to per-layer
+        parameters by structured name."""
+        named = dict(self.model.named_parameters())
+        for k, arr in self._rest.items():
+            named[k].data = arr
+        per_stage = len(self._layer_prefix_list) // self.n_stages
+        for key, stacked_arr in self._stacked.items():
+            for s in range(self.n_stages):
+                for i in range(per_stage):
+                    layer_idx = s * per_stage + i
+                    full = self._layer_prefix_list[layer_idx] + key
+                    named[full].data = stacked_arr[s, i]
+
+    def state_dict(self):
+        self.sync_to_model()
+        return self.model.state_dict()

