@@ -35,11 +35,12 @@ class IterableDataset(Dataset):
     def __iter__(self):
         raise NotImplementedError
 
+    # TypeError (not RuntimeError) so list()/length_hint degrade gracefully
     def __getitem__(self, idx):
-        raise RuntimeError("IterableDataset is not indexable")
+        raise TypeError("IterableDataset is not indexable")
 
     def __len__(self):
-        raise RuntimeError("IterableDataset has no len()")
+        raise TypeError("IterableDataset has no len()")
 
 
 class TensorDataset(Dataset):

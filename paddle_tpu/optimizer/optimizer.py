@@ -54,6 +54,26 @@ class Optimizer:
     def _rule(self, g, p, slots, lr, wd):
         raise NotImplementedError
 
+    def _is_low_precision(self, p) -> bool:
+        return p.dtype in (jnp.bfloat16, jnp.float16)
+
+    def _init_slots_mp(self, p: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        slots = self._init_slots(p)
+        if self._multi_precision and self._is_low_precision(p):
+            # fp32 master copy (reference: multi_precision adam_op / O2 AMP
+            # master weights) — updates accumulate in fp32, the live param
+            # stays bf16/fp16 for compute
+            slots["master_weight"] = p.astype(jnp.float32)
+        return slots
+
+    def _rule_mp(self, g, p, slots, lr, wd):
+        master = slots.pop("master_weight", None)
+        if master is None:
+            return self._rule(g, p, slots, lr, wd)
+        new_master, new_slots = self._rule(g, master, slots, lr, wd)
+        new_slots["master_weight"] = new_master
+        return new_master.astype(p.dtype), new_slots
+
     def _wd_for(self, param) -> float:
         wd = self._weight_decay
         if hasattr(wd, "__call__") and not isinstance(wd, (int, float)):
@@ -76,12 +96,12 @@ class Optimizer:
                 continue
             pid = id(p)
             if pid not in self._state:
-                self._state[pid] = self._init_slots(p.data)
+                self._state[pid] = self._init_slots_mp(p.data)
             slots = self._state[pid]
             lr = self.get_lr() * getattr(p, "optimize_attr",
                                          {"learning_rate": 1.0})["learning_rate"]
-            new_p, new_slots = self._rule(g.data, p.data, slots, lr,
-                                          self._wd_for(p))
+            new_p, new_slots = self._rule_mp(g.data, p.data, slots, lr,
+                                            self._wd_for(p))
             p.data = new_p
             self._state[pid] = new_slots
 
@@ -101,7 +121,7 @@ class Optimizer:
     # ---- functional API (used by jit train steps & distributed wrappers) ----
     def init_state(self, params: Dict[str, jnp.ndarray]):
         """Pure: build slot pytree for a named-param dict."""
-        return {k: self._init_slots(v) for k, v in params.items()}
+        return {k: self._init_slots_mp(v) for k, v in params.items()}
 
     def clip_gradients_fn(self):
         """Pure fn(grads_dict) -> clipped grads, mirroring self._grad_clip so
@@ -157,7 +177,7 @@ class Optimizer:
                     continue
                 ctx_slots = dict(state[k])
                 ctx_slots["_step"] = step
-                np_, ns_ = self._rule(g, p, ctx_slots, lr, wd)
+                np_, ns_ = self._rule_mp(g, p, ctx_slots, lr, wd)
                 ns_.pop("_step", None)
                 new_params[k] = np_
                 new_state[k] = ns_

@@ -1,0 +1,92 @@
+"""AMP: bf16 training with fp32 master weights + GradScaler behavior."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn, optimizer
+
+
+def test_master_weights_accumulate_small_updates():
+    # bf16 has ~8 bits of mantissa: 1.0 + 0.001 == 1.0 in bf16. With
+    # multi_precision the fp32 master accumulates 100 such updates.
+    w = paddle.core.tensor.Parameter(
+        np.ones(4, np.float32), name="w")
+    w.data = w.data.astype(jnp.bfloat16)
+    opt = optimizer.SGD(learning_rate=0.001, parameters=[w],
+                        multi_precision=True)
+    for _ in range(100):
+        w.grad = paddle.Tensor(jnp.full((4,), -1.0, jnp.bfloat16))
+        opt.step()
+        opt.clear_grad()
+    # master accumulated 0.1; bf16-only training would stay at 1.0
+    np.testing.assert_allclose(w.numpy().astype(np.float32),
+                               np.full(4, 1.1), rtol=5e-3)
+    master = opt._state[id(w)]["master_weight"]
+    np.testing.assert_allclose(np.asarray(master), np.full(4, 1.1),
+                               rtol=1e-5)
+
+
+def test_without_master_weights_bf16_stalls():
+    w = paddle.core.tensor.Parameter(np.ones(4, np.float32))
+    w.data = w.data.astype(jnp.bfloat16)
+    opt = optimizer.SGD(learning_rate=0.001, parameters=[w])
+    for _ in range(10):
+        w.grad = paddle.Tensor(jnp.full((4,), -1.0, jnp.bfloat16))
+        opt.step()
+        opt.clear_grad()
+    # updates vanish in bf16 rounding — documents WHY multi_precision exists
+    np.testing.assert_allclose(w.numpy().astype(np.float32), np.ones(4))
+
+
+def test_auto_cast_context():
+    with amp.auto_cast(True, dtype="bfloat16"):
+        assert amp.amp_state().enabled
+        assert amp.amp_state().dtype == jnp.bfloat16
+    assert not amp.amp_state().enabled
+
+
+def test_grad_scaler_skips_on_inf():
+    w = paddle.core.tensor.Parameter(np.array([1.0], np.float32))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=4.0)
+    w.grad = paddle.to_tensor(np.array([np.inf], np.float32))
+    scaler.step(opt)
+    np.testing.assert_allclose(w.numpy(), [1.0])  # step skipped
+    assert scaler._scale < 4.0 or scaler._bad_steps > 0
+
+
+def test_grad_scaler_scales_and_unscales():
+    w = paddle.core.tensor.Parameter(np.array([1.0], np.float32))
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=8.0)
+    loss = (w * 2.0).sum()
+    scaler.scale(loss).backward()
+    np.testing.assert_allclose(w.grad.numpy(), [16.0])  # scaled grad
+    scaler.step(opt)
+    np.testing.assert_allclose(w.numpy(), [-1.0])  # unscaled update (grad 2)
+
+
+def test_o2_decorate_casts_model():
+    model = nn.Linear(4, 4)
+    amp.decorate(model, level="O2", dtype="bfloat16")
+    assert model.weight.dtype == np.dtype(paddle.bfloat16)
+
+
+def test_jit_train_step_with_master_weights():
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    model.to(dtype="bfloat16")
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=model.parameters(),
+                          multi_precision=True)
+    step = paddle.jit.TrainStep(
+        model, lambda o, y: nn.functional.mse_loss(
+            o.astype("float32"), y), opt)
+    x = paddle.randn([16, 8]).astype("bfloat16")
+    y = paddle.randn([16, 4])
+    losses = [float(step(x, y).item()) for _ in range(15)]
+    assert losses[-1] < losses[0]
+    # master slots exist in the functional state
+    assert any("master_weight" in slots
+               for slots in step._opt_state.values())
