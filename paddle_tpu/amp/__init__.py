@@ -82,6 +82,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = set()  # ids of optimizers already unscaled this step
 
     def scale(self, var: Tensor) -> Tensor:
         if not self._enable:
@@ -90,33 +91,44 @@ class GradScaler:
         return multiply(var, self._scale)
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        if not self._enable or id(optimizer) in self._unscaled:
             return
-        found = False
+        self._unscaled.add(id(optimizer))
+        # one fused finiteness check across all grads (single host sync)
+        gs = [p.grad.data.astype(jnp.float32) / self._scale
+              for p in (optimizer._parameter_list or []) if p.grad is not None]
+        if not gs:
+            self._found_inf = False
+            return
+        finite = jnp.all(
+            jnp.stack([jnp.all(jnp.isfinite(g)) for g in gs]))
+        i = 0
         for p in optimizer._parameter_list or []:
             if p.grad is None:
                 continue
-            g = p.grad.data.astype(jnp.float32) / self._scale
-            finite = bool(jnp.all(jnp.isfinite(g)))
-            if not finite:
-                found = True
-            p.grad.data = g
-        self._found_inf = found
+            p.grad.data = gs[i]
+            i += 1
+        self._found_inf = not bool(finite)
 
     def step(self, optimizer):
+        """Unscale (if not already) and apply the optimizer step, skipping it
+        when an inf/nan was found. Like the reference, step() does NOT update
+        the loss scale — call update() once per iteration (minimize() does
+        both)."""
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
+        self.unscale_(optimizer)  # no-op if the user already called unscale_
         if not self._found_inf:
             optimizer.step()
-        self.update()
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
 
     def update(self):
+        self._unscaled.clear()
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

@@ -44,11 +44,17 @@ class _Node:
     loop without no_grad() cannot grow memory unboundedly. backward() walks
     the graph from the loss and sweeps in reverse `seq` order."""
 
-    __slots__ = ("vjp_fn", "inputs", "outputs", "out_grads", "single", "seq")
+    __slots__ = ("vjp_fn", "inputs", "in_links", "outputs", "out_grads",
+                 "single", "seq")
 
     def __init__(self, vjp_fn, inputs, outputs, single, seq):
         self.vjp_fn = vjp_fn
         self.inputs: List["Tensor"] = inputs
+        # (producer node, out index) per input, snapshotted at record time:
+        # in-place ops (__setitem__) rebind a Tensor's _node afterwards, and
+        # consumers recorded before the write must keep routing cotangents to
+        # the pre-write producer.
+        self.in_links = [(t._node, t._out_index) for t in inputs]
         self.outputs: List["Tensor"] = outputs
         self.out_grads: List[Optional[jax.Array]] = [None] * len(outputs)
         self.single = single  # forward returned a bare array (not a tuple)
@@ -255,7 +261,37 @@ class Tensor:
     def __setitem__(self, idx, value):
         idx = _unwrap_index(idx)
         val = to_array(value)
-        self.data = self.data.at[idx].set(val.astype(self.data.dtype))
+        if (_STATE.grad_enabled and not self.stop_gradient
+                and dtypes.is_floating_point(self.dtype)):
+            # Route through the tape (the reference's set_value op participates
+            # in autograd). A leaf that requires grad cannot be mutated in
+            # place without orphaning its grad accumulator — fail loudly.
+            if self._node is None:
+                raise RuntimeError(
+                    "in-place __setitem__ on a leaf tensor that requires "
+                    "grad; use x = x.at_set(...) style functional update or "
+                    "wrap in no_grad() if gradients through the assignment "
+                    "are not needed")
+            # apply() snapshots self's pre-write (node, index) into the new
+            # node's in_links, so the cotangent w.r.t. the old value flows
+            # into the existing graph even after we rebind self._node below
+            args = [self]
+            if isinstance(value, Tensor) and not value.stop_gradient:
+                def f(x, v):
+                    return x.at[idx].set(v.astype(x.dtype))
+                args.append(value)
+            else:
+                def f(x):
+                    return x.at[idx].set(val.astype(x.dtype))
+            out = apply(f, *args)
+            self.data = out.data
+            self._node = out._node
+            self._out_index = out._out_index
+            # downstream consumers hold `self`; the node must report grads
+            # through this object, not the discarded wrapper
+            self._node.outputs[self._out_index] = self
+        else:
+            self.data = self.data.at[idx].set(val.astype(self.data.dtype))
 
     # arithmetic operators are patched in by paddle_tpu.tensor.math to avoid a
     # circular import; see paddle_tpu/tensor/__init__.py::monkey_patch_tensor.
@@ -335,9 +371,9 @@ def _reachable_nodes(roots: List[_Node]) -> List[_Node]:
         if id(node) in seen:
             continue
         seen[id(node)] = node
-        for inp in node.inputs:
-            if inp._node is not None:
-                stack.append(inp._node)
+        for pnode, _ in node.in_links:
+            if pnode is not None:
+                stack.append(pnode)
     return sorted(seen.values(), key=lambda n: -n.seq)
 
 
@@ -375,11 +411,12 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
                 if id(t) in capture_ids:
                     t._accumulate_grad(g)
         in_grads = node.vjp_fn(cotangents[0] if node.single else cotangents)
-        for inp, g in zip(node.inputs, in_grads):
+        for inp, (pnode, pidx), g in zip(node.inputs, node.in_links,
+                                         in_grads):
             if g is None:
                 continue
-            if inp._node is not None and inp._node.vjp_fn is not None:
-                inp._node.seed(inp._out_index, g)
+            if pnode is not None and pnode.vjp_fn is not None:
+                pnode.seed(pidx, g)
             elif only_ids is None or id(inp) in only_ids:
                 inp._accumulate_grad(g)
         node.out_grads = [None] * len(node.outputs)

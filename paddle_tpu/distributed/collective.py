@@ -139,6 +139,21 @@ def _group_size(group) -> int:
 
 # ---- core collectives ----
 
+def _psum_prod(a, axis):
+    """Sign-correct product reduction. Integers take an exact gather-and-
+    multiply path; floats use psum-of-logs for magnitude (zeros handled by a
+    zero-count psum, sign via parity of the negative count)."""
+    if not jnp.issubdtype(a.dtype, jnp.floating):
+        return jnp.prod(lax.all_gather(a, axis), axis=0)
+    zero = a == 0
+    any_zero = lax.psum(zero.astype(jnp.int32), axis) > 0
+    neg = lax.psum((a < 0).astype(jnp.int32), axis)
+    sign = jnp.where(neg % 2 == 0, 1.0, -1.0).astype(a.dtype)
+    safe = jnp.where(zero, 1.0, jnp.abs(a))
+    mag = jnp.exp(lax.psum(jnp.log(safe.astype(jnp.float32)), axis))
+    return jnp.where(any_zero, jnp.zeros_like(a), sign * mag.astype(a.dtype))
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, use_calc_stream=True,
                sync_op=True):
     axis = _resolve_axis(group)
@@ -147,8 +162,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, use_calc_stream=True,
                ReduceOp.MAX: lambda a: lax.pmax(a, axis),
                ReduceOp.MIN: lambda a: lax.pmin(a, axis),
                ReduceOp.AVG: lambda a: lax.pmean(a, axis),
-               ReduceOp.PROD: lambda a: jnp.exp(
-                   lax.psum(jnp.log(jnp.maximum(jnp.abs(a), 1e-30)), axis))}
+               ReduceOp.PROD: lambda a: _psum_prod(a, axis)}
         out = apply(fns[op], _t(tensor))
         tensor.data = out.data
         return tensor
@@ -409,7 +423,7 @@ def _c_softmax_with_cross_entropy(logits, label, group=None,
     lg, lb = _t(logits), _t(label)
     if ax is None or not _CTX.axes:
         from ..nn.functional.loss import softmax_with_cross_entropy
-        return softmax_with_cross_entropy(lg, lb)
+        return softmax_with_cross_entropy(lg, lb, ignore_index=ignore_index)
 
     def f(a, y):
         n_shard = a.shape[-1]
@@ -431,6 +445,7 @@ def _c_softmax_with_cross_entropy(logits, label, group=None,
         local_logit = jnp.where(in_range, picked, 0.0)
         target_logit = lax.psum(local_logit, ax)
         loss = logz[..., 0] - target_logit
+        loss = jnp.where(yy == ignore_index, 0.0, loss)
         return loss[..., None] if squeeze else loss
 
     return apply(f, lg, lb)

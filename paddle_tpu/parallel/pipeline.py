@@ -176,7 +176,15 @@ class PipelinedTrainStep:
                 lambda lp, x: layer_fn(lp, x), stacked_, mbs, n_stages_,
                 remat=remat)
             hidden = outs.reshape(hidden.shape)
-            return head_fn(rest_, hidden, labels)
+            # Head loss is evaluated only on the last stage and psum-broadcast:
+            # its cotangent therefore seeds head grads on exactly one rank, and
+            # the pipe-axis psum over g_rest below restores replication (the
+            # embedding grads are likewise nonzero only on stage 0).
+            stage_idx = lax.axis_index(PIPE_AXIS)
+            loss_local = head_fn(rest_, hidden, labels)
+            return lax.psum(
+                jnp.where(stage_idx == n_stages_ - 1, loss_local, 0.0),
+                PIPE_AXIS)
 
         def train_step(stacked_, rest_, opt_state, lr, step, arrays):
             ids, labels = arrays
@@ -186,6 +194,11 @@ class PipelinedTrainStep:
 
             loss, grads = jax.value_and_grad(lf)((stacked_, rest_))
             g_stacked, g_rest = grads
+            # Replicate embedding/head grads across pipe ranks (each is
+            # produced on a single stage — see loss_from); without this the
+            # replicated `rest` params and their optimizer slots diverge.
+            g_rest = jax.tree_util.tree_map(
+                lambda g: lax.psum(g, PIPE_AXIS), g_rest)
             flat_params = {**rest_,
                            **{f"__stack__{k}": v for k, v in stacked_.items()}}
             flat_grads = {**g_rest,

@@ -1,0 +1,191 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def test_parallel_ce_ignore_index():
+    """_c_softmax_with_cross_entropy must zero the loss for ignore_index
+    tokens (ADVICE medium: padding tokens silently trained on)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+    from paddle_tpu.distributed.collective import (
+        _c_softmax_with_cross_entropy, axis_context)
+
+    rng = np.random.RandomState(0)
+    V = 16
+    logits = rng.randn(4, V).astype(np.float32)
+    labels = np.array([1, -100, 7, -100], dtype=np.int32)
+
+    devs = np.array(jax.devices()[:2]).reshape(2)
+    mesh = Mesh(devs, ("model",))
+
+    def f(lg, lb):
+        with axis_context(("model",)):
+            out = _c_softmax_with_cross_entropy(
+                Tensor(lg), Tensor(lb), group="model", ignore_index=-100)
+        return out.data
+
+    loss = shard_map(f, mesh=mesh, in_specs=(P(None, "model"), P()),
+                     out_specs=P())(jnp.asarray(logits), jnp.asarray(labels))
+    loss = np.asarray(loss)
+    # ignored rows contribute exactly zero
+    np.testing.assert_allclose(loss[[1, 3]], 0.0, atol=0)
+    # non-ignored rows match the dense reference
+    ref = -np.log(np.exp(logits) / np.exp(logits).sum(-1, keepdims=True))
+    np.testing.assert_allclose(loss[0], ref[0, 1], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(loss[2], ref[2, 7], rtol=1e-5, atol=1e-5)
+
+
+def test_allreduce_prod_sign_and_zero():
+    """ReduceOp.PROD must be sign-correct and handle zeros (ADVICE via
+    VERDICT weak #5)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+    from paddle_tpu.distributed.collective import (
+        ReduceOp, all_reduce, axis_context)
+
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("x",))
+    # per-device rows; product across devices has negatives and a zero column
+    vals = np.array([[2.0, -1.0, 3.0],
+                     [-3.0, -2.0, 0.0],
+                     [1.0, -1.0, 2.0],
+                     [-1.0, 4.0, 5.0]], dtype=np.float32)
+    expect = vals.prod(axis=0)
+
+    def f(a):
+        with axis_context(("x",)):
+            t = Tensor(a)
+            all_reduce(t, op=ReduceOp.PROD, group="x")
+        return t.data
+
+    out = shard_map(f, mesh=mesh, in_specs=(P("x"),), out_specs=P("x"))(
+        jnp.asarray(vals))
+    # every rank holds the full product
+    np.testing.assert_allclose(np.asarray(out)[0], expect, rtol=1e-5)
+
+
+def test_grad_scaler_no_double_unscale():
+    """scaler.unscale_(opt) -> clip -> scaler.step(opt) must divide the grads
+    by the scale exactly once (ADVICE medium)."""
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.amp import GradScaler
+
+    from paddle_tpu.core.tensor import Parameter
+    p = Parameter(np.array([1.0, 2.0], dtype=np.float32))
+    opt = optim.SGD(learning_rate=1.0, parameters=[p])
+    scaler = GradScaler(init_loss_scaling=8.0)
+
+    loss = (p * paddle.to_tensor(np.array([1.0, 1.0], np.float32))).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    # grad is now 8.0 per element
+    scaler.unscale_(opt)
+    g1 = np.asarray(p.grad.data).copy()
+    np.testing.assert_allclose(g1, [1.0, 1.0])
+    scaler.step(opt)  # must NOT unscale again
+    # sgd with lr=1: p_new = p - 1.0 * grad(unscaled once)
+    np.testing.assert_allclose(np.asarray(p.data), [0.0, 1.0], rtol=1e-6)
+
+
+def test_grad_scaler_unscale_without_step_recovers():
+    """unscale_ without a following step() must not permanently disable
+    unscaling for that optimizer: update() clears the per-step bookkeeping."""
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.amp import GradScaler
+    from paddle_tpu.core.tensor import Parameter
+
+    p = Parameter(np.array([1.0], dtype=np.float32))
+    opt = optim.SGD(learning_rate=1.0, parameters=[p])
+    scaler = GradScaler(init_loss_scaling=4.0)
+    # iteration 1: unscale, then skip step (e.g. user bails on clip failure)
+    p.grad = paddle.to_tensor(np.array([4.0], np.float32))
+    scaler.unscale_(opt)
+    scaler.update()
+    # iteration 2: unscale_ must run again
+    p.grad = paddle.to_tensor(np.array([4.0], np.float32))
+    scaler.unscale_(opt)
+    np.testing.assert_allclose(np.asarray(p.grad.data), [1.0])
+
+
+def test_allreduce_prod_int_exact():
+    """Integer PROD must be exact (no exp/log round-trip truncation)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+    from paddle_tpu.distributed.collective import (
+        ReduceOp, all_reduce, axis_context)
+
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("x",))
+    vals = np.array([[2, 7], [3, 1], [1, 5], [7, 3]], dtype=np.int32)
+
+    def f(a):
+        with axis_context(("x",)):
+            t = Tensor(a)
+            all_reduce(t, op=ReduceOp.PROD, group="x")
+        return t.data
+
+    out = shard_map(f, mesh=mesh, in_specs=(P("x"),), out_specs=P("x"))(
+        jnp.asarray(vals))
+    assert np.asarray(out).dtype == np.int32
+    np.testing.assert_array_equal(np.asarray(out)[0], [42, 105])
+
+
+def test_setitem_prior_consumers_keep_grads():
+    """Ops that consumed a tensor BEFORE an in-place write keep their
+    gradient path to the original producer (in_links snapshot)."""
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = x * 2.0
+    z = y * 3.0          # consumes pre-write y
+    y[0:1] = 0.0         # in-place write rebinds y's node
+    z.sum().backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(np.asarray(x.grad.data), [6.0, 6.0, 6.0])
+
+
+def test_setitem_pre_and_post_consumers():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = x * 2.0
+    z = y * 3.0          # pre-write consumer: d/dx = 6 everywhere
+    y[0:1] = 0.0         # write kills x's path through y[0]
+    w = y * 5.0          # post-write consumer: d/dx = 10 except idx 0
+    (z.sum() + w.sum()).backward()
+    np.testing.assert_allclose(np.asarray(x.grad.data), [6.0, 16.0, 16.0])
+
+
+def test_split_indivisible_raises():
+    x = paddle.to_tensor(np.arange(10, dtype=np.float32))
+    with pytest.raises(ValueError):
+        paddle.split(x, 3)
+
+
+def test_setitem_grad_flows():
+    """__setitem__ on a non-leaf participates in autograd (ADVICE low)."""
+    x = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    y = x * 2.0              # non-leaf
+    v = paddle.to_tensor(np.array([5.0], np.float32), stop_gradient=False)
+    y[1:2] = v
+    loss = (y * y).sum()
+    loss.backward()
+    # dy/dx: positions 0,2,3 give d((2x)^2)/dx = 8x = 8; position 1 overwritten
+    np.testing.assert_allclose(np.asarray(x.grad.data), [8.0, 0.0, 8.0, 8.0])
+    # grad w.r.t. the assigned value: d(v^2)/dv = 2v = 10
+    np.testing.assert_allclose(np.asarray(v.grad.data), [10.0])
+
+
+def test_setitem_leaf_requires_grad_raises():
+    p = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    with pytest.raises(RuntimeError):
+        p[0] = 2.0
+
+
+def test_setitem_no_grad_ok():
+    p = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    with paddle.no_grad():
+        p[0] = 2.0
+    np.testing.assert_allclose(np.asarray(p.data), [2.0, 1.0, 1.0])

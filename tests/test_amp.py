@@ -53,6 +53,7 @@ def test_grad_scaler_skips_on_inf():
     scaler = amp.GradScaler(init_loss_scaling=4.0)
     w.grad = paddle.to_tensor(np.array([np.inf], np.float32))
     scaler.step(opt)
+    scaler.update()  # reference usage: step() then update()
     np.testing.assert_allclose(w.numpy(), [1.0])  # step skipped
     assert scaler._scale < 4.0 or scaler._bad_steps > 0
 
