@@ -3,27 +3,86 @@
 Runs a GPT-scale causal-LM training step (bf16, jit/SPMD path) on the available
 device and reports tokens/sec/chip + MFU vs the BASELINE north star.
 
-The model size auto-scales to the device: the single v5e chip in CI runs a
-~125M-param GPT at seq 1024; on a real pod slice the same harness scales up.
+Hardened per round-1 verdict: TPU backend init is retried with backoff (the
+tunneled axon backend is flaky), falls back to CPU if the chip never comes up,
+and a JSON line is ALWAYS emitted (an error record in the worst case) so the
+driver's BENCH_r{N}.json is never empty.
 """
 from __future__ import annotations
 
 import json
+import sys
 import time
+import traceback
 
 import numpy as np
 
+# per-chip peak bf16 FLOP/s by device_kind substring (longest match wins)
+_PEAK_BF16 = {
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
 
-def main():
+
+def _peak_flops(device_kind: str, backend: str) -> float:
+    if backend == "cpu":
+        return 1e12  # nominal: CPU numbers are sanity-only, not MFU claims
+    kind = device_kind.lower()
+    for key in sorted(_PEAK_BF16, key=len, reverse=True):
+        if key in kind:
+            return _PEAK_BF16[key]
+    return 197e12  # unknown TPU: assume the smallest current chip
+
+
+def _init_backend(force_cpu: bool, max_tries: int = 2):
+    """Initialize the default backend, retrying flaky TPU init (the tunneled
+    axon backend can also HANG inside native code — the parent process
+    watchdog in main() covers that case by killing this child)."""
     import jax
-    import jax.numpy as jnp
+
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+        return jax, "cpu", None
+    last_err = None
+    for attempt in range(max_tries):
+        try:
+            return jax, jax.default_backend(), None
+        except RuntimeError as e:
+            last_err = str(e).splitlines()[0][:200]
+            sys.stderr.write(
+                f"bench: backend init failed (attempt {attempt + 1}/"
+                f"{max_tries}): {last_err}\n")
+            try:
+                from jax._src import xla_bridge
+                xla_bridge._clear_backends()
+            except Exception:
+                pass
+            if attempt < max_tries - 1:
+                time.sleep(10 * (attempt + 1))
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge
+        xla_bridge._clear_backends()
+    except Exception:
+        pass
+    return jax, "cpu", last_err
+
+
+def run_bench(force_cpu: bool = False, init_err_note: str = None):
+    jax, backend, init_err = _init_backend(force_cpu)
+    init_err = init_err or init_err_note
+    on_tpu = backend not in ("cpu",)
 
     import paddle_tpu as paddle
     from paddle_tpu import optimizer as optim
     from paddle_tpu.models.gpt import GPTForCausalLM
-
-    backend = jax.default_backend()
-    on_tpu = backend not in ("cpu",)
 
     # size to the hardware: single-chip CI uses gpt3-125m bf16
     preset = "gpt3-125m" if on_tpu else "gpt2-tiny"
@@ -81,12 +140,12 @@ def main():
     tokens_per_step = B * S
     tokens_per_sec_chip = tokens_per_step / dt / n_chips
 
-    # MFU: 6 * params * tokens FLOPs (fwd+bwd) vs peak
+    # MFU: 6 * params * tokens FLOPs (fwd+bwd) vs the chip's actual peak
     n_params = sum(int(np.prod(p.shape)) for p in params.values())
     flops_per_step = 6.0 * n_params * tokens_per_step
     achieved = flops_per_step / dt / n_chips
-    # v5e (TPU v5 lite): 197 TFLOP/s bf16 peak; CPU: report vs 1 TF nominal
-    peak = 197e12 if on_tpu else 1e12
+    device_kind = jax.devices()[0].device_kind
+    peak = _peak_flops(device_kind, backend)
     mfu = achieved / peak
 
     result = {
@@ -101,11 +160,66 @@ def main():
             "params_m": round(n_params / 1e6, 1),
             "mfu": round(mfu, 4),
             "backend": backend,
+            "device_kind": device_kind,
+            "peak_tflops": peak / 1e12,
             "n_chips": n_chips,
+            "tpu_init_error": (init_err.splitlines()[0][:200]
+                               if init_err else None),
         },
     }
     print(json.dumps(result))
 
 
+def _child_main():
+    """Runs the real bench (TPU if it comes up). May hang in native backend
+    init — the parent kills us then."""
+    try:
+        run_bench()
+    except Exception:
+        traceback.print_exc()
+        sys.exit(1)
+    sys.exit(0)
+
+
+def main():
+    """Parent watchdog: run the bench in a killable child; if the child hangs
+    or dies without output, rerun on CPU in-process (CPU init cannot hang).
+    ALWAYS prints exactly one JSON line and exits 0."""
+    import os
+    import subprocess
+
+    timeout = int(os.environ.get("BENCH_TIMEOUT", "900"))
+    note = None
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            capture_output=True, timeout=timeout, text=True)
+        sys.stderr.write(r.stderr[-4000:] if r.stderr else "")
+        for line in reversed((r.stdout or "").splitlines()):
+            if line.startswith("{"):
+                print(line)
+                sys.exit(0)
+        note = f"bench child rc={r.returncode} with no JSON output"
+    except subprocess.TimeoutExpired:
+        note = f"bench child hung past {timeout}s (TPU tunnel down?)"
+    sys.stderr.write(f"bench: {note}; falling back to CPU\n")
+    try:
+        run_bench(force_cpu=True, init_err_note=note)
+    except Exception as e:
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bench_error",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "extra": {"error": f"{type(e).__name__}: {str(e)[:400]}",
+                      "note": note},
+        }))
+    sys.exit(0)
+
+
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        _child_main()
+    else:
+        main()

@@ -4,13 +4,12 @@ Reference: fleet/meta_parallel/pipeline_parallel.py:32 (PipelineParallel,
 train_batch:109 — F-then-B over micro-batches with p2p send/recv) and the static
 1F1B schedule in framework/section_worker.cc:149-183.
 
-TPU-native redesign: explicit per-rank p2p scheduling is replaced by a
-micro-batch loop the XLA scheduler can software-pipeline. `train_batch` runs
-micro-batches through the full layer stack (gradient accumulation), which under
-pjit + stage-sharded weights yields pipeline overlap via XLA's async collectives;
-the dedicated GPipe/1F1B shard_map schedule (ppermute-based, section_worker
-parity) lives in paddle_tpu.parallel.pipeline_schedule and is used by
-parallelize() when pp_degree > 1.
+TPU-native redesign: this dygraph wrapper runs micro-batches through the full
+layer stack (gradient accumulation, no stage distribution) and exists for the
+eager-API parity surface only. The real pipeline — a 1F1B ppermute schedule
+with stage-sharded weights (section_worker.cc parity) — lives in
+paddle_tpu.parallel.pipeline (run_1f1b / PipelinedTrainStep) and is what
+parallelize() dispatches to when the mesh's pipe axis is > 1.
 """
 from __future__ import annotations
 

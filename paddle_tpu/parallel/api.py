@@ -205,6 +205,33 @@ def parallelize(model: Layer, optimizer=None, mesh: Optional[Mesh] = None,
         mesh = get_mesh()
     if mesh is None:
         raise ValueError("no mesh: call fleet.init or pass mesh=")
+    if "pipe" in mesh.axis_names and mesh.shape["pipe"] > 1:
+        from .pipeline import PipelinedTrainStep
+        if not (hasattr(model, "llama") or hasattr(model, "gpt")):
+            raise ValueError(
+                "pp_degree > 1 requires a pipeline-stackable decoder LM "
+                f"(Llama/GPT families); {type(model).__name__} has no "
+                "stackable decoder layers. Set pp_degree=1 (the model then "
+                "trains under ShardedTrainStep) or adapt the model to the "
+                "PipelinedTrainStep layer/embed/head protocol")
+        n_micro = 4
+        if strategy is not None:
+            cfg = getattr(strategy, "pipeline_configs", None)
+            if cfg is not None and getattr(cfg, "accumulate_steps", 0) >= 1:
+                n_micro = cfg.accumulate_steps
+            if getattr(strategy, "sharding", False):
+                import warnings
+                warnings.warn(
+                    "strategy.sharding (ZeRO) is not composed with the "
+                    "pipeline path yet: parameters and optimizer state are "
+                    "replicated across the sharding axis under pp_degree>1",
+                    stacklevel=2)
+        if loss_fn is not None:
+            raise ValueError(
+                "parallelize(pp_degree>1) pipelines causal-LM models with "
+                "their built-in loss head; custom loss_fn is not supported "
+                "on the pipeline path yet")
+        return PipelinedTrainStep(model, optimizer, mesh, n_micro=n_micro)
     zero_stage = 0
     if strategy is not None and getattr(strategy, "sharding", False):
         zero_stage = strategy.sharding_configs.stage

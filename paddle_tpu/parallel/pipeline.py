@@ -1,18 +1,36 @@
-"""SPMD pipeline parallelism: microbatch schedule over the `pipe` mesh axis.
+"""SPMD pipeline parallelism: microbatch schedules over the `pipe` mesh axis.
 
 Reference: the 1F1B SectionWorker loop (framework/section_worker.cc:149-183) and
 dygraph F-then-B (fleet/meta_parallel/pipeline_parallel.py:109), which schedule
 micro-batches across per-stage processes with send_v2/recv_v2.
 
-TPU-native redesign (MPMD-pipeline paper pattern, PAPERS.md): the L decoder
-layers are stacked into per-stage parameter pytrees with a leading stage dim
-sharded over `pipe`. One shard_map program runs T = n_micro + n_stages - 1 ticks
-of a lax.scan; each tick every stage applies its segment to its activation
-register, then registers rotate one hop via lax.ppermute (ICI neighbor
-transfer). Reverse-mode AD through the scan+ppermute yields the backward
-pipeline automatically — no hand-written grad schedule, and XLA overlaps the
-permute DMA with the next tick's compute. jax.checkpoint on the stage body
-keeps live activations at O(n_micro) instead of O(n_micro · layers).
+TPU-native redesign: the L decoder layers are stacked into per-stage parameter
+pytrees with a leading stage dim sharded over `pipe`; one shard_map program
+runs a lax.scan of lockstep "ticks" with lax.ppermute moving activations
+(forward) and cotangents (backward) one hop over the ICI ring.
+
+Two schedules:
+
+- `pipeline_apply` — GPipe fill-drain forward; reverse-mode AD through the
+  scan+ppermute yields the backward pipeline automatically. Simple, but peak
+  activation memory grows with n_micro.
+
+- `PipelinedTrainStep` — true 1F1B (section_worker.cc:149 parity): each tick
+  has a forward slot and a backward slot. Stage s runs forward of microbatch
+  i at tick i+s and backward of microbatch u at tick 2(S-1)-s+u, i.e. warmup
+  of (S-1-s) extra forwards, then steady-state one-forward-one-backward,
+  then drain. Stage inputs are kept in a ring buffer of min(n_micro, 2S-1)
+  slots — the number of in-flight microbatches per stage is bounded by the
+  schedule, NOT by n_micro, which is 1F1B's defining memory property. The
+  backward slot recomputes the stage forward from the saved input via
+  jax.vjp (activation checkpointing at stage boundaries). The head loss (and
+  its cotangent) is evaluated in-cycle on the last stage so backward starts
+  the same tick its forward finishes; the embedding is recomputed per
+  microbatch inside the tick (a cheap gather) instead of materializing all
+  microbatch activations. Embedding grads exist only on stage 0 and head
+  grads only on the last stage; a pipe-axis psum of the non-stacked grads
+  restores replication (tied embed/head weights therefore accumulate both
+  contributions before the update, pp_layers.py:188 analog).
 """
 from __future__ import annotations
 
@@ -24,6 +42,20 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 PIPE_AXIS = "pipe"
+
+
+def make_stage_fn(layer_fn: Callable, remat: bool = True):
+    """One stage segment: scan layer_fn over the [per_stage, ...] param rows.
+    Shared by the GPipe and 1F1B schedules."""
+
+    def stage_fn(params, x):
+        def body(h, layer_params):
+            return layer_fn(layer_params, h), None
+
+        out, _ = lax.scan(body, x, params)
+        return out
+
+    return jax.checkpoint(stage_fn) if remat else stage_fn
 
 
 def stack_stage_params(per_layer_params: List[Dict], n_stages: int):
@@ -51,8 +83,8 @@ def stack_stage_params(per_layer_params: List[Dict], n_stages: int):
 def pipeline_apply(layer_fn: Callable, stage_params, microbatches,
                    n_stages: int, axis: str = PIPE_AXIS,
                    remat: bool = True):
-    """Run the pipelined stack. MUST be called inside shard_map with `axis`
-    mapped and stage_params' leading dim sharded over it.
+    """GPipe fill-drain schedule (AD-derived backward). MUST be called inside
+    shard_map with `axis` mapped and stage_params' leading dim sharded over it.
 
     layer_fn(layer_params, x) -> x applies ONE layer.
     stage_params: {name: [1(local stage), per_stage, ...]} local shard.
@@ -63,16 +95,7 @@ def pipeline_apply(layer_fn: Callable, stage_params, microbatches,
     stage_idx = lax.axis_index(axis)
 
     local = jax.tree_util.tree_map(lambda a: a[0], stage_params)
-
-    def stage_fn(params, x):
-        def body(h, layer_params):
-            return layer_fn(layer_params, h), None
-
-        out, _ = lax.scan(body, x, params)
-        return out
-
-    if remat:
-        stage_fn = jax.checkpoint(stage_fn)
+    stage_fn = make_stage_fn(layer_fn, remat)
 
     T = n_micro + n_stages - 1
     state0 = jnp.zeros_like(microbatches[0])
@@ -107,13 +130,111 @@ def pipeline_apply(layer_fn: Callable, stage_params, microbatches,
     return outputs
 
 
-class PipelinedTrainStep:
-    """Pipeline training for decoder-LM models (Llama/GPT family).
+def run_1f1b(stage_fn: Callable, embed_fn: Callable, head_loss_fn: Callable,
+             local_params, rest, ids_mb, labels_mb, n_micro: int,
+             n_stages: int, axis: str = PIPE_AXIS):
+    """One 1F1B sweep. MUST run inside shard_map with `axis` mapped.
 
-    The embedding and head run replicated on every pipe rank (cheap relative to
-    the decoder stack at scale; the decoder layers are pipelined). Composes
-    with dp/sharding/model axes on the same mesh: non-pipe axes work exactly as
-    in ShardedTrainStep.
+    stage_fn(local_params, x) -> x          one stage's layer segment
+    embed_fn(rest, ids) -> x                token ids -> hidden states
+    head_loss_fn(rest, x, labels) -> scalar per-microbatch MEAN loss
+    ids_mb/labels_mb: [n_micro, mb, ...]    (replicated over `axis`)
+
+    Returns (loss, d_local, d_rest): loss is the mean over all microbatches
+    (replicated); d_local is the local stage segment's grad; d_rest is the
+    pipe-replicated grad of the non-stacked params (embedding + head).
+    """
+    stage_idx = lax.axis_index(axis)
+    last = stage_idx == n_stages - 1
+
+    def scaled_head(rest_, h, y):
+        return head_loss_fn(rest_, h, y) / n_micro
+
+    # probe shapes once (embedding of microbatch 0)
+    x0 = embed_fn(rest, ids_mb[0])
+    act_dtype = x0.dtype
+
+    n_buf = min(n_micro, 2 * n_stages - 1)  # 1F1B in-flight bound
+    T = n_micro + 2 * (n_stages - 1)
+
+    zero_d_local = jax.tree_util.tree_map(jnp.zeros_like, local_params)
+    zero_d_rest = jax.tree_util.tree_map(jnp.zeros_like, rest)
+
+    def masked_add(acc, delta, on):
+        return jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(on, g, jnp.zeros_like(g)), acc, delta)
+
+    def tick(carry, t):
+        f_msg, b_msg, buf, d_local, d_rest, loss_acc = carry
+
+        # ---- forward slot: stage s runs microbatch i = t - s ----
+        i = t - stage_idx
+        f_on = (i >= 0) & (i < n_micro)
+        i_c = jnp.clip(i, 0, n_micro - 1)
+        ids_i = lax.dynamic_index_in_dim(ids_mb, i_c, 0, keepdims=False)
+        x_in = jnp.where(stage_idx == 0, embed_fn(rest, ids_i), f_msg)
+        x_out = stage_fn(local_params, x_in)
+        # save the stage input for the backward-slot recompute (ring buffer;
+        # live range per slot is <= n_buf so distinct in-flight microbatches
+        # never collide)
+        slot = i_c % n_buf
+        cur = lax.dynamic_index_in_dim(buf, slot, 0, keepdims=False)
+        buf = lax.dynamic_update_index_in_dim(
+            buf, jnp.where(f_on, x_in, cur), slot, 0)
+        # last stage: head loss + cotangent, consumed by this tick's B slot
+        y_i = lax.dynamic_index_in_dim(labels_mb, i_c, 0, keepdims=False)
+        loss_i, (d_rest_head, dh) = jax.value_and_grad(
+            scaled_head, argnums=(0, 1))(rest, x_out, y_i)
+        head_on = f_on & last
+        loss_acc = loss_acc + jnp.where(head_on, loss_i, 0.0)
+        d_rest = masked_add(d_rest, d_rest_head, head_on)
+
+        # ---- backward slot: stage s runs microbatch u = t - (2(S-1) - s) ----
+        u = t - (2 * (n_stages - 1) - stage_idx)
+        b_on = (u >= 0) & (u < n_micro)
+        u_c = jnp.clip(u, 0, n_micro - 1)
+        ct = jnp.where(last, dh, b_msg).astype(act_dtype)
+        x_saved = lax.dynamic_index_in_dim(buf, u_c % n_buf, 0,
+                                           keepdims=False)
+        _, stage_vjp = jax.vjp(stage_fn, local_params, x_saved)
+        d_local_i, dx = stage_vjp(ct)
+        d_local = masked_add(d_local, d_local_i, b_on)
+        # stage 0: backprop the incoming cotangent through the embedding
+        ids_u = lax.dynamic_index_in_dim(ids_mb, u_c, 0, keepdims=False)
+        _, embed_vjp = jax.vjp(lambda r: embed_fn(r, ids_u), rest)
+        (d_rest_emb,) = embed_vjp(dx)
+        d_rest = masked_add(d_rest, d_rest_emb, b_on & (stage_idx == 0))
+
+        # ---- ring communication: activations forward, cotangents back ----
+        fperm = [(r, (r + 1) % n_stages) for r in range(n_stages)]
+        bperm = [(r, (r - 1) % n_stages) for r in range(n_stages)]
+        f_msg = lax.ppermute(x_out, axis, fperm)
+        b_msg = lax.ppermute(dx, axis, bperm)
+        return (f_msg, b_msg, buf, d_local, d_rest, loss_acc), None
+
+    zeros_act = jnp.zeros_like(x0)
+    buf0 = jnp.zeros((n_buf,) + x0.shape, act_dtype)
+    carry0 = (zeros_act, zeros_act, buf0, zero_d_local, zero_d_rest,
+              jnp.zeros((), jnp.float32))
+    (_, _, _, d_local, d_rest, loss_acc), _ = lax.scan(
+        tick, carry0, jnp.arange(T))
+
+    # loss lives on the last stage; embed grads on stage 0; head grads on the
+    # last stage — psum over the pipe axis replicates all of them
+    loss = lax.psum(loss_acc, axis)
+    d_rest = jax.tree_util.tree_map(lambda g: lax.psum(g, axis), d_rest)
+    return loss, d_local, d_rest
+
+
+class PipelinedTrainStep:
+    """1F1B pipeline training for decoder-LM models (Llama/GPT families).
+
+    The decoder stack is stage-sharded over the `pipe` mesh axis; embedding
+    and head params are replicated but their grads are produced on exactly one
+    stage each and psum-replicated (tied weights accumulate both). Composes
+    with data parallelism: when the mesh has `data`/`sharding` axes, the batch
+    is sharded over them and grads are averaged across. Tensor parallelism
+    inside a stage is not composed here yet — use ShardedTrainStep for tp.
     """
 
     def __init__(self, model, optimizer, mesh: Mesh, n_micro: int = 4,
@@ -158,52 +279,72 @@ class PipelinedTrainStep:
         clip_fn = optimizer.clip_gradients_fn()
         self._buffers = buffers
 
-        stage_spec = {k: P(PIPE_AXIS) for k in stacked}
-        rest_spec = {k: P() for k in rest}
-
         layer_fn = self._make_layer_fn()
         embed_fn = self._make_embed_fn()
         head_fn = self._make_head_fn()
         n_micro_ = n_micro
         n_stages_ = self.n_stages
 
-        def loss_from(stacked_, rest_, ids, labels):
-            hidden = embed_fn(rest_, ids)          # [B, S, H]
-            B = hidden.shape[0]
-            mb = B // n_micro_
-            mbs = hidden.reshape((n_micro_, mb) + hidden.shape[1:])
-            outs = pipeline_apply(
-                lambda lp, x: layer_fn(lp, x), stacked_, mbs, n_stages_,
-                remat=remat)
-            hidden = outs.reshape(hidden.shape)
-            # Head loss is evaluated only on the last stage and psum-broadcast:
-            # its cotangent therefore seeds head grads on exactly one rank, and
-            # the pipe-axis psum over g_rest below restores replication (the
-            # embedding grads are likewise nonzero only on stage 0).
-            stage_idx = lax.axis_index(PIPE_AXIS)
-            loss_local = head_fn(rest_, hidden, labels)
-            return lax.psum(
-                jnp.where(stage_idx == n_stages_ - 1, loss_local, 0.0),
-                PIPE_AXIS)
+        batch_axes = tuple(
+            ax for ax in ("data", "sharding")
+            if ax in mesh.axis_names and mesh.shape[ax] > 1)
+        self._batch_axes = batch_axes
+        data_spec_entry = batch_axes if len(batch_axes) > 1 else (
+            batch_axes[0] if batch_axes else None)
+        data_spec = P(data_spec_entry) if batch_axes else P()
+
+        stage_fn = make_stage_fn(layer_fn, remat)
+
+        from ..nn.clip import ClipGradByGlobalNorm
+        grad_clip = getattr(optimizer, "_grad_clip", None)
+        use_pipe_clip = isinstance(grad_clip, ClipGradByGlobalNorm)
+
+        def pipe_global_norm_clip(g_stacked, g_rest):
+            """Global-norm clip whose norm spans ALL stages: the stacked
+            grads are pipe-local slices, so their squared norm is psum'd over
+            the pipe axis; rest grads are pipe-replicated and counted once.
+            Without this, each rank clips by a different norm and the
+            replicated params silently diverge."""
+            sq_stacked = sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree_util.tree_leaves(g_stacked))
+            sq_stacked = lax.psum(sq_stacked, PIPE_AXIS)
+            sq_rest = sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree_util.tree_leaves(g_rest))
+            gnorm = jnp.sqrt(sq_stacked + sq_rest)
+            c = grad_clip.clip_norm
+            factor = jnp.minimum(c / jnp.maximum(gnorm, c), 1.0)
+            scale = lambda g: (g.astype(jnp.float32) * factor).astype(g.dtype)
+            return (jax.tree_util.tree_map(scale, g_stacked),
+                    jax.tree_util.tree_map(scale, g_rest))
 
         def train_step(stacked_, rest_, opt_state, lr, step, arrays):
             ids, labels = arrays
-
-            def lf(ps):
-                return loss_from(ps[0], ps[1], ids, labels)
-
-            loss, grads = jax.value_and_grad(lf)((stacked_, rest_))
-            g_stacked, g_rest = grads
-            # Replicate embedding/head grads across pipe ranks (each is
-            # produced on a single stage — see loss_from); without this the
-            # replicated `rest` params and their optimizer slots diverge.
-            g_rest = jax.tree_util.tree_map(
-                lambda g: lax.psum(g, PIPE_AXIS), g_rest)
+            B = ids.shape[0]
+            mb = B // n_micro_
+            ids_mb = ids.reshape((n_micro_, mb) + ids.shape[1:])
+            labels_mb = labels.reshape((n_micro_, mb) + labels.shape[1:])
+            local = jax.tree_util.tree_map(lambda a: a[0], stacked_)
+            loss, d_local, g_rest = run_1f1b(
+                stage_fn, embed_fn, head_fn, local, rest_, ids_mb, labels_mb,
+                n_micro_, n_stages_)
+            g_stacked = jax.tree_util.tree_map(lambda g: g[None], d_local)
+            # data-parallel reduction across batch axes
+            for ax in batch_axes:
+                loss = lax.pmean(loss, ax)
+                g_stacked = jax.tree_util.tree_map(
+                    lambda g: lax.pmean(g, ax), g_stacked)
+                g_rest = jax.tree_util.tree_map(
+                    lambda g: lax.pmean(g, ax), g_rest)
+            if use_pipe_clip:
+                g_stacked, g_rest = pipe_global_norm_clip(g_stacked, g_rest)
             flat_params = {**rest_,
                            **{f"__stack__{k}": v for k, v in stacked_.items()}}
             flat_grads = {**g_rest,
                           **{f"__stack__{k}": v for k, v in g_stacked.items()}}
-            flat_grads = clip_fn(flat_grads)
+            if not use_pipe_clip:
+                flat_grads = clip_fn(flat_grads)
             new_flat, new_opt = apply_fn(flat_params, flat_grads, opt_state,
                                          lr, step)
             new_rest = {k: v for k, v in new_flat.items()
@@ -227,6 +368,7 @@ class PipelinedTrainStep:
         def put(arr, spec):
             return jax.device_put(arr, NamedSharding(mesh, spec))
 
+        stage_spec = {k: P(PIPE_AXIS) for k in stacked}
         self._stacked = {k: put(v, stage_spec[k]) for k, v in stacked.items()}
         self._rest = {k: put(v, P()) for k, v in rest.items()}
         self._opt_state = {
@@ -239,7 +381,7 @@ class PipelinedTrainStep:
             opt_specs,
             P(),
             P(),
-            (P(), P()),
+            (data_spec, data_spec),
         )
         out_specs = (P(), {k: P(PIPE_AXIS) for k in stacked},
                      {k: P() for k in rest}, opt_specs)
@@ -249,6 +391,7 @@ class PipelinedTrainStep:
                           out_specs=out_specs, check_vma=False),
             donate_argnums=(0, 1, 2))
         self._opt_specs = opt_specs
+        self._data_spec = data_spec
 
     # ---- model adapters (Llama & GPT families) ----
     def _decoder_layers(self):
@@ -327,6 +470,14 @@ class PipelinedTrainStep:
         ids = ids.data if isinstance(ids, Tensor) else jnp.asarray(ids)
         labels = (labels.data if isinstance(labels, Tensor)
                   else jnp.asarray(labels))
+        dp = 1
+        for ax in self._batch_axes:
+            dp *= self.mesh.shape[ax]
+        if ids.shape[0] % (dp * self.n_micro) != 0:
+            raise ValueError(
+                f"PipelinedTrainStep: global batch {ids.shape[0]} must be "
+                f"divisible by data_degree({dp}) * n_micro({self.n_micro}); "
+                "adjust the batch size or pipeline_configs.accumulate_steps")
         self._step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step = jnp.asarray(self._step_count, jnp.int32)
