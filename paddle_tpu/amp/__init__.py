@@ -13,39 +13,30 @@ reductions) stays fp32 — same split as AmpOperators in the reference.
 from __future__ import annotations
 
 import contextlib
-import threading
 
 import jax.numpy as jnp
 
 from ..core import dtypes
+from ..core.amp import (_AMP, BLACK_LIST, WHITE_LIST, amp_enabled, amp_state,
+                        autocast_inputs)
 from ..core.tensor import Tensor
-
-
-class _AmpState(threading.local):
-    def __init__(self):
-        self.enabled = False
-        self.dtype = jnp.bfloat16
-        self.level = "O1"
-
-
-_AMP = _AmpState()
-
-
-def amp_state():
-    return _AMP
 
 
 @contextlib.contextmanager
 def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
               level="O1", dtype="bfloat16"):
-    prev = (_AMP.enabled, _AMP.dtype, _AMP.level)
+    prev = (_AMP.enabled, _AMP.dtype, _AMP.level, _AMP.custom_white,
+            _AMP.custom_black)
     _AMP.enabled = enable
     _AMP.dtype = dtypes.convert_dtype(dtype)
     _AMP.level = level
+    _AMP.custom_white = frozenset(custom_white_list or ())
+    _AMP.custom_black = frozenset(custom_black_list or ())
     try:
         yield
     finally:
-        _AMP.enabled, _AMP.dtype, _AMP.level = prev
+        (_AMP.enabled, _AMP.dtype, _AMP.level, _AMP.custom_white,
+         _AMP.custom_black) = prev
 
 
 autocast = auto_cast

@@ -1,0 +1,99 @@
+"""Trace-time AMP O1 autocast state + input-casting helpers.
+
+Reference: paddle/fluid/imperative/amp_auto_cast.cc — AmpOperators holds white
+(run-in-fp16) and black (keep-fp32) op lists (:31) and AutoCastInputs (:171)
+casts every op's inputs at trace time according to the active list.
+
+TPU-native: the same decision is made once per op call, inside the op's traced
+jnp function, so the cast (a) participates in jax.vjp/jax.grad automatically
+and (b) bakes into the jitted HLO when the context manager is active at trace
+time — identical semantics to the reference's trace-time autocast. bfloat16 is
+the default low dtype (MXU-native; no loss scaling needed).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+# Default op lists (names mirror the reference's AmpOperators defaults:
+# white = MXU-bound matmul/conv ops, black = numerically-sensitive ops).
+WHITE_LIST = frozenset({
+    "matmul", "mul", "conv1d", "conv2d", "conv3d", "conv_transpose",
+    "linear", "bmm", "einsum", "addmm",
+})
+BLACK_LIST = frozenset({
+    "softmax", "log_softmax", "softmax_with_cross_entropy", "cross_entropy",
+    "layer_norm", "batch_norm", "instance_norm", "group_norm",
+    "exp", "log", "mean", "sum", "square", "reduce_sum", "cos_sim",
+    "sigmoid_cross_entropy_with_logits", "nll_loss", "erf", "pow",
+})
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = frozenset()
+        self.custom_black = frozenset()
+
+
+_AMP = _AmpState()
+
+
+def amp_state():
+    return _AMP
+
+
+def amp_enabled() -> bool:
+    return _AMP.enabled
+
+
+def amp_cache_key():
+    """Hashable snapshot of the autocast state, used as a static jit argument
+    so a jitted step retraces when the user toggles auto_cast between calls
+    (the thread-local is only read at trace time)."""
+    st = _AMP
+    if not st.enabled:
+        return None
+    import numpy as np
+    return (np.dtype(st.dtype).name, st.level,
+            tuple(sorted(st.custom_white)), tuple(sorted(st.custom_black)))
+
+
+def _is_low_or_f32(d):
+    return d in (jnp.float32, jnp.bfloat16, jnp.float16)
+
+
+def autocast_inputs(op_name: str, *arrays):
+    """Cast a traced op's array inputs per the active autocast lists.
+
+    White-listed op: float32 inputs -> amp dtype (bf16/fp16).
+    Black-listed op: low-precision inputs -> float32.
+    Unlisted op (gray): runs in whatever dtype its inputs already carry, like
+    the reference's "promote to widest input" fallback (we leave jnp's type
+    promotion to do that).
+
+    Returns the arrays tuple (same length). Call INSIDE the op's jnp function
+    so the cast is differentiated and jitted with the op.
+    """
+    st = _AMP
+    if not st.enabled or st.level not in ("O1", "O2"):
+        return arrays
+    in_white = (op_name in st.custom_white
+                or (op_name in WHITE_LIST and op_name not in st.custom_black))
+    in_black = (op_name in st.custom_black
+                or (op_name in BLACK_LIST and op_name not in st.custom_white))
+    if in_white:
+        return tuple(
+            a.astype(st.dtype)
+            if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+            for a in arrays)
+    if in_black:
+        return tuple(
+            a.astype(jnp.float32)
+            if hasattr(a, "dtype") and a.dtype in (jnp.bfloat16, jnp.float16)
+            else a
+            for a in arrays)
+    return arrays

@@ -38,10 +38,18 @@ class DataParallel(Layer):
         return loss
 
     def apply_collective_grads(self):
-        # reducer.cc FusedAllReduceSchedule analog: a no-op at world_size 1;
-        # under the functional runners gradient sync happens inside the step.
-        if get_world_size() <= 1 and not in_axis_context():
+        """reducer.cc FusedAllReduceSchedule analog for the eager multi-process
+        path: average grads across jax processes. No-op at world 1; under the
+        functional runners gradient sync happens inside the step (pmean)."""
+        import jax
+        if in_axis_context() or jax.process_count() <= 1:
             return
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                stacked = multihost_utils.process_allgather(p.grad.data)
+                p.grad.data = jnp.mean(stacked, axis=0)
 
     # passthrough conveniences
     def state_dict(self, *args, **kwargs):

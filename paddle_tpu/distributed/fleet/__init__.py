@@ -57,6 +57,7 @@ class Fleet:
         self._hcg: Optional[HybridCommunicateGroup] = None
         self._is_initialized = False
         self._user_defined_optimizer = None
+        self._model = None  # last distributed_model target, for save_* routing
 
     # ---- init (fleet_base.py:139) ----
     def init(self, role_maker=None, is_collective=True, strategy=None):
@@ -129,6 +130,7 @@ class Fleet:
     # ---- model/optimizer wrapping (fleet_base.py:836/783) ----
     def distributed_model(self, model):
         assert self._is_initialized, "call fleet.init first"
+        self._model = model
         mode = self._hcg.get_parallel_mode()
         if mode == ParallelMode.DATA_PARALLEL:
             return DataParallel(model,
@@ -158,7 +160,11 @@ class Fleet:
         return optimizer
 
     def distributed_scaler(self, scaler):
-        return scaler
+        """Wrap a GradScaler so found_inf is agreed across processes
+        (reference: hybrid_parallel_gradscaler.py — found_inf allreduced over
+        mp/pp groups; single-process SPMD grads are replicated so the local
+        check already sees every shard)."""
+        return _DistributedScaler(scaler)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -170,10 +176,36 @@ class Fleet:
     # ---- checkpoint routing (fleet_base.py:654-732) ----
     def save_persistables(self, executor=None, dirname=None, main_program=None,
                           mode=0):
-        pass
+        """Save the distributed model's trainable state (reference routes
+        through the runtime handle; here: state_dict → dirname/persistables)."""
+        target = main_program if main_program is not None else self._model
+        if target is None or not hasattr(target, "state_dict"):
+            raise RuntimeError(
+                "fleet.save_persistables: no model to save — pass the Layer "
+                "as main_program or call fleet.distributed_model(model) first")
+        if dirname is None:
+            raise ValueError("fleet.save_persistables requires dirname")
+        import os
+        from ...framework_io import save as _save
+        os.makedirs(dirname, exist_ok=True)
+        _save(target.state_dict(), os.path.join(dirname, "persistables"))
 
-    def save_inference_model(self, *args, **kwargs):
-        pass
+    def save_inference_model(self, executor=None, dirname=None,
+                             feeded_var_names=None, target_vars=None,
+                             main_program=None, export_for_deployment=True):
+        """Export the distributed model for serving via jit.save (weights +
+        descriptor). For a full StableHLO serving artifact with traced shapes
+        use paddle_tpu.inference.export_model directly."""
+        target = main_program if main_program is not None else self._model
+        if target is None or not hasattr(target, "state_dict"):
+            raise RuntimeError(
+                "fleet.save_inference_model: no model to export — pass the "
+                "Layer as main_program or call fleet.distributed_model first")
+        if dirname is None:
+            raise ValueError("fleet.save_inference_model requires dirname")
+        import os
+        from ...jit import save as _jit_save
+        _jit_save(target, os.path.join(dirname, "model"))
 
     # ---- PS interface stubs (out of v1 scope; SURVEY §7 item 6) ----
     def init_server(self, *args, **kwargs):
@@ -194,16 +226,68 @@ class Fleet:
         return _UtilBase()
 
 
+class _DistributedScaler:
+    """GradScaler wrapper agreeing found_inf across processes
+    (fleet_base.py:1472 distributed_scaler analog)."""
+
+    def __init__(self, scaler):
+        self._scaler = scaler
+
+    def unscale_(self, optimizer):
+        self._scaler.unscale_(optimizer)
+        import jax
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            import numpy as np
+            flags = multihost_utils.process_allgather(
+                np.asarray([self._scaler._found_inf], np.bool_))
+            self._scaler._found_inf = bool(np.any(flags))
+
+    def step(self, optimizer):
+        if not self._scaler._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._scaler._found_inf:
+            optimizer.step()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self._scaler.update()
+
+    def __getattr__(self, item):
+        return getattr(self._scaler, item)
+
+
 class _UtilBase:
+    """fleet.util (reference: fleet/base/util_factory.py:44) — process-level
+    collectives over host values, backed by jax multihost utilities."""
+
     def barrier(self, comm_world="worker"):
         from ..collective import barrier
         barrier()
 
     def all_gather(self, input, comm_world="worker"):
-        return [input]
+        import jax
+        if jax.process_count() == 1:
+            return [input]
+        import numpy as np
+        from jax.experimental import multihost_utils
+        arr = np.asarray(input)
+        gathered = multihost_utils.process_allgather(arr)  # (P, *shape)
+        return [np.asarray(g) for g in gathered]
 
     def all_reduce(self, input, mode="sum", comm_world="worker"):
-        return input
+        import jax
+        import numpy as np
+        if jax.process_count() == 1:
+            return input
+        from jax.experimental import multihost_utils
+        arr = np.asarray(input)
+        gathered = multihost_utils.process_allgather(arr)  # (P, *shape)
+        red = {"sum": np.sum, "max": np.max, "min": np.min}[mode]
+        return red(np.asarray(gathered), axis=0)
 
     def get_file_shard(self, files):
         rank, size = get_rank(), get_world_size()

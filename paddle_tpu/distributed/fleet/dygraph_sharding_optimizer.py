@@ -48,9 +48,29 @@ class DygraphShardingOptimizer:
         self._sharding_sync_parameters()
 
     def _sharding_sync_parameters(self):
-        """Broadcast updated owned params (reference :136). Under shard_map the
-        runner all-gathers; at world size 1 this is a no-op."""
-        return
+        """Broadcast each updated param from its owner (reference :136).
+
+        Eager sharding across ranks requires one process per sharding rank
+        (jax.distributed). Single-process virtual meshes use the SPMD sharding
+        path (paddle_tpu.parallel) instead, where this is a no-op."""
+        if self._sharding_world_size <= 1:
+            return
+        import jax
+        if jax.process_count() == 1:
+            # every "rank" is this process: params are already current
+            return
+        if jax.process_count() < self._sharding_world_size:
+            raise RuntimeError(
+                "eager DygraphShardingOptimizer needs one process per "
+                "sharding rank (got sharding_degree="
+                f"{self._sharding_world_size}, processes="
+                f"{jax.process_count()}); use parallelize()/ShardedTrainStep "
+                "for single-process SPMD sharding")
+        from jax.experimental import multihost_utils
+        for owner, params in self._rank2params.items():
+            for p in params:
+                p.data = multihost_utils.broadcast_one_to_all(
+                    p.data, is_source=(self._sharding_rank == owner))
 
     def clear_grad(self):
         for p in self._full_parameter_list:

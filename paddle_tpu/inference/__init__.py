@@ -68,26 +68,32 @@ class Config:
         self._use_tpu = True
         self.switch_ir_optim_ = True
 
+    @staticmethod
+    def _ignored(name):
+        import logging
+        logging.getLogger("paddle_tpu.inference").info(
+            "Config.%s is a compat no-op on TPU (XLA owns optimization)", name)
+
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
-        pass
+        self._ignored("enable_use_gpu")
 
     def disable_gpu(self):
-        pass
+        self._ignored("disable_gpu")
 
     def enable_mkldnn(self):
-        pass
+        self._ignored("enable_mkldnn")
 
     def switch_ir_optim(self, flag=True):
         self.switch_ir_optim_ = flag
 
     def enable_memory_optim(self):
-        pass
+        self._ignored("enable_memory_optim")
 
     def set_cpu_math_library_num_threads(self, n):
-        pass
+        self._ignored("set_cpu_math_library_num_threads")
 
     def enable_tensorrt_engine(self, **kwargs):
-        pass
+        self._ignored("enable_tensorrt_engine")
 
 
 class _IOHandle:

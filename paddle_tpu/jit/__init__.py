@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from ..core.amp import amp_cache_key
 from ..core.tensor import Parameter, Tensor, no_grad
 from ..nn.layer.layers import Layer
 
@@ -29,15 +30,15 @@ class StaticFunction:
         if isinstance(fn_or_layer, Layer):
             layer = fn_or_layer
 
-            def pure(params, buffers, rng, args, kwargs):
+            def pure(amp_key, params, buffers, rng, args, kwargs):
                 return layer.functional_call(params, buffers, *args, rng=rng,
                                              **kwargs)
 
-            self._pure = jax.jit(pure)
+            self._pure = jax.jit(pure, static_argnums=0)
         else:
             fn = fn_or_layer
 
-            def pure(rng, args, kwargs):
+            def pure(amp_key, rng, args, kwargs):
                 from ..core.random import key_context
                 wrapped = [Tensor(a) for a in args]
                 with no_grad(), key_context(rng):
@@ -46,7 +47,7 @@ class StaticFunction:
                     lambda o: o.data if isinstance(o, Tensor) else o, out,
                     is_leaf=lambda o: isinstance(o, Tensor))
 
-            self._pure = jax.jit(pure)
+            self._pure = jax.jit(pure, static_argnums=0)
         self._call_count = 0
 
     def _to_arrays(self, tree):
@@ -61,9 +62,9 @@ class StaticFunction:
         rng = jax.random.PRNGKey(self._call_count)
         if isinstance(self._target, Layer):
             params, buffers = self._target.functional_state()
-            out = self._pure(params, buffers, rng, arrays, kw)
+            out = self._pure(amp_cache_key(), params, buffers, rng, arrays, kw)
         else:
-            out = self._pure(rng, arrays, kw)
+            out = self._pure(amp_cache_key(), rng, arrays, kw)
         return jax.tree_util.tree_map(Tensor, out)
 
 
@@ -104,7 +105,8 @@ class TrainStep:
             loss = loss_t.data if isinstance(loss_t, Tensor) else loss_t
             return loss, new_buffers
 
-        def train_step(params, opt_state, buffers, lr, step, rng, *arrays):
+        def train_step(amp_key, params, opt_state, buffers, lr, step, rng,
+                       *arrays):
             (loss, new_buffers), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(params, buffers, rng, *arrays)
             grads = self._clip(grads)
@@ -112,8 +114,9 @@ class TrainStep:
                                               step)
             return loss, new_params, new_opt, new_buffers
 
-        donate = (0, 1, 2) if donate_state else ()
-        self._jitted = jax.jit(train_step, donate_argnums=donate)
+        donate = (1, 2, 3) if donate_state else ()
+        self._jitted = jax.jit(train_step, static_argnums=0,
+                               donate_argnums=donate)
 
     def __call__(self, *args):
         arrays = [a.data if isinstance(a, Tensor) else jnp.asarray(a)
@@ -124,7 +127,8 @@ class TrainStep:
         step = jnp.asarray(self._step_count, jnp.int32)
         rng = jax.random.PRNGKey(self._step_count)
         loss, new_params, self._opt_state, self._buffers = self._jitted(
-            params, self._opt_state, self._buffers, lr, step, rng, *arrays)
+            amp_cache_key(), params, self._opt_state, self._buffers, lr, step,
+            rng, *arrays)
         named = dict(self.model.named_parameters())
         named_b = dict(self.model.named_buffers())
         for k, arr in new_params.items():

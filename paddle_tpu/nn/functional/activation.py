@@ -4,6 +4,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ...core.amp import autocast_inputs
 from ...core.tensor import Tensor, apply
 from ...tensor.creation import _t
 
@@ -112,14 +113,24 @@ def softmax(x, axis=-1, dtype=None, name=None):
     x = _t(x)
     if dtype is not None:
         x = x.astype(dtype)
-    return apply(lambda a: jax.nn.softmax(a, axis=axis), x)
+
+    def f(a):
+        (a,) = autocast_inputs("softmax", a)
+        return jax.nn.softmax(a, axis=axis)
+
+    return apply(f, x)
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):
     x = _t(x)
     if dtype is not None:
         x = x.astype(dtype)
-    return apply(lambda a: jax.nn.log_softmax(a, axis=axis), x)
+
+    def f(a):
+        (a,) = autocast_inputs("log_softmax", a)
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return apply(f, x)
 
 
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):

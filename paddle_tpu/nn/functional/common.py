@@ -8,6 +8,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core import dtypes
+from ...core.amp import autocast_inputs
 from ...core.random import next_key
 from ...core.tensor import Tensor, apply
 from ...tensor.creation import _t
@@ -16,9 +17,15 @@ from ...tensor.creation import _t
 def linear(x, weight, bias=None, name=None):
     # paddle weight layout: [in_features, out_features] → x @ W + b, one MXU matmul
     if bias is not None:
-        return apply(lambda a, w, b: jnp.matmul(a, w) + b,
-                     _t(x), _t(weight), _t(bias))
-    return apply(lambda a, w: jnp.matmul(a, w), _t(x), _t(weight))
+        def f(a, w, b):
+            a, w, b = autocast_inputs("linear", a, w, b)
+            return jnp.matmul(a, w) + b
+        return apply(f, _t(x), _t(weight), _t(bias))
+
+    def f(a, w):
+        a, w = autocast_inputs("linear", a, w)
+        return jnp.matmul(a, w)
+    return apply(f, _t(x), _t(weight))
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",

@@ -9,6 +9,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ...core.amp import autocast_inputs
 from ...core.tensor import Tensor, apply
 from ...tensor.creation import _t
 
@@ -50,6 +51,7 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, data_format)
     dn_str = _dim_numbers(n, channel_last)
 
     def f(a, w, *maybe_bias):
+        a, w, *maybe_bias = autocast_inputs(f"conv{n}d", a, w, *maybe_bias)
         # weight layout is paddle's OIHW... convert for channel_last spec
         lhs_spec, rhs_spec, out_spec = dn_str
         if channel_last:
@@ -58,13 +60,13 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, data_format)
             w = jnp.transpose(w, perm)
         dn = jax.lax.conv_dimension_numbers(a.shape, w.shape,
                                             (lhs_spec, rhs_spec, out_spec))
+        # no preferred_element_type: the MXU accumulates bf16 convs in f32
+        # natively, and forcing f32 output breaks the vjp transpose rule
+        # (cotangent f32 vs bf16 primal in _conv_general_dilated_transpose_rhs)
         out = jax.lax.conv_general_dilated(
             a, w, window_strides=strides, padding=pad,
             rhs_dilation=dil, dimension_numbers=dn,
-            feature_group_count=groups,
-            preferred_element_type=jnp.float32
-            if a.dtype == jnp.bfloat16 else None)
-        out = out.astype(a.dtype)
+            feature_group_count=groups)
         if maybe_bias:
             b = maybe_bias[0]
             shape = [1] * out.ndim

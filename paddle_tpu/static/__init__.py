@@ -72,17 +72,62 @@ def data(name, shape, dtype="float32", lod_level=0):
     return InputSpec(shape, dtype, name)
 
 
+def _program_layer(program):
+    """Resolve the Layer behind a save/load target: a to_static-wrapped Layer,
+    a bare Layer, or None."""
+    from ..nn.layer.layers import Layer
+    if isinstance(program, StaticFunction) and isinstance(program._target,
+                                                          Layer):
+        return program._target
+    if isinstance(program, Layer):
+        return program
+    return None
+
+
 def save(program, model_path, **kwargs):
-    pass
+    """Save the state of a to_static-wrapped Layer (or a bare Layer).
+
+    Placeholder Programs own no variables (tracing replaced the IR), so saving
+    one is an error rather than a silent no-op — pass the traced callable."""
+    layer = _program_layer(program)
+    if layer is None:
+        raise TypeError(
+            "static.save: expected a paddle_tpu.jit.to_static-wrapped Layer "
+            "or a Layer; placeholder Program objects own no state (use "
+            "paddle.save(state_dict, path) for raw dicts)")
+    from ..framework_io import save as _save
+    _save(layer.state_dict(), model_path + ".pdparams")
 
 
 def load(program, model_path, executor=None, var_names=None):
-    pass
+    layer = _program_layer(program)
+    if layer is None:
+        raise TypeError(
+            "static.load: expected a to_static-wrapped Layer or a Layer "
+            "(placeholder Programs own no state)")
+    from ..framework_io import load as _load
+    layer.set_state_dict(_load(model_path + ".pdparams"))
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
-                         **kwargs):
-    pass
+                         program=None, **kwargs):
+    """Export for serving. The traced callable must be supplied via `program`
+    (a to_static-wrapped Layer or Layer); feed_vars (InputSpec) fix the traced
+    shapes, matching the reference's feeded_var contract."""
+    layer = _program_layer(program)
+    if layer is None:
+        raise TypeError(
+            "static.save_inference_model: pass the to_static-wrapped Layer "
+            "(or Layer) as program=...; placeholder Programs cannot be "
+            "exported. For full control use paddle_tpu.inference.export_model")
+    import numpy as np
+    from ..core import dtypes
+    from ..inference import export_model
+    specs = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    examples = [np.zeros([1 if s is None or s < 0 else s for s in sp.shape],
+                         dtype=np.dtype(dtypes.convert_dtype(sp.dtype)))
+                for sp in specs]
+    export_model(layer, examples, path_prefix)
 
 
 def load_inference_model(path_prefix, executor, **kwargs):

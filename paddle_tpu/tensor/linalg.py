@@ -8,6 +8,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..core.amp import autocast_inputs
 from ..core.tensor import Tensor, apply
 from .creation import _t
 
@@ -16,6 +17,7 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     x, y = _t(x), _t(y)
 
     def f(a, b):
+        a, b = autocast_inputs("matmul", a, b)
         if transpose_x:
             a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
         if transpose_y:

@@ -47,6 +47,71 @@ def test_auto_cast_context():
     assert not amp.amp_state().enabled
 
 
+def test_auto_cast_O1_casts_matmul_to_bf16():
+    # behavior, not flags: fp32 inputs to a white-listed op come out bf16
+    x = paddle.randn([4, 8])
+    w = paddle.randn([8, 4])
+    with amp.auto_cast(True, dtype="bfloat16"):
+        out = paddle.matmul(x, w)
+    assert out.dtype == np.dtype(paddle.bfloat16)
+    out_fp32 = paddle.matmul(x, w)
+    assert out_fp32.dtype == np.float32
+
+
+def test_auto_cast_O1_linear_and_conv():
+    model = nn.Linear(8, 4)
+    x = paddle.randn([2, 8])
+    with amp.auto_cast(True, dtype="bfloat16"):
+        y = model(x)
+    assert y.dtype == np.dtype(paddle.bfloat16)
+    conv = nn.Conv2D(3, 4, 3)
+    img = paddle.randn([1, 3, 8, 8])
+    with amp.auto_cast(True, dtype="bfloat16"):
+        o = conv(img)
+    assert o.dtype == np.dtype(paddle.bfloat16)
+
+
+def test_auto_cast_blacklist_softmax_runs_fp32():
+    x = paddle.randn([4, 8]).astype("bfloat16")
+    with amp.auto_cast(True, dtype="bfloat16"):
+        p = nn.functional.softmax(x)
+    assert p.dtype == np.float32
+
+
+def test_auto_cast_custom_lists_override_defaults():
+    x = paddle.randn([4, 8])
+    w = paddle.randn([8, 4])
+    with amp.auto_cast(True, dtype="bfloat16",
+                       custom_black_list={"matmul"}):
+        out = paddle.matmul(x, w)
+    assert out.dtype == np.float32
+
+
+def test_auto_cast_grads_flow_through_casts():
+    w = paddle.core.tensor.Parameter(np.ones((4, 4), np.float32))
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    with amp.auto_cast(True, dtype="bfloat16"):
+        loss = paddle.matmul(x, w).sum()
+    loss.backward()
+    assert w.grad is not None
+    assert w.grad.dtype == np.float32  # cotangent cast back to param dtype
+    np.testing.assert_allclose(w.grad.numpy(), np.full((4, 4), 2.0))
+
+
+def test_auto_cast_retraces_jit_path():
+    # the amp state is part of the jit cache key: same StaticFunction called
+    # with and without auto_cast yields different output dtypes
+    fn = paddle.jit.to_static(lambda a: paddle.matmul(a, a))
+    x = paddle.randn([4, 4])
+    y1 = fn(x)
+    with amp.auto_cast(True, dtype="bfloat16"):
+        y2 = fn(x)
+    y3 = fn(x)
+    assert y1.dtype == np.float32
+    assert y2.dtype == np.dtype(paddle.bfloat16)
+    assert y3.dtype == np.float32
+
+
 def test_grad_scaler_skips_on_inf():
     w = paddle.core.tensor.Parameter(np.array([1.0], np.float32))
     opt = optimizer.SGD(learning_rate=0.1, parameters=[w])
