@@ -40,16 +40,28 @@ class DataParallel(Layer):
     def apply_collective_grads(self):
         """reducer.cc FusedAllReduceSchedule analog for the eager multi-process
         path: average grads across jax processes. No-op at world 1; under the
-        functional runners gradient sync happens inside the step (pmean)."""
+        functional runners gradient sync happens inside the step (pmean).
+
+        Like the reference's fused buckets, all grads go through ONE
+        collective: flatten-concat, single allgather, mean, unflatten."""
         import jax
         if in_axis_context() or jax.process_count() <= 1:
             return
         import jax.numpy as jnp
         from jax.experimental import multihost_utils
-        for p in self._layers.parameters():
-            if p.grad is not None:
-                stacked = multihost_utils.process_allgather(p.grad.data)
-                p.grad.data = jnp.mean(stacked, axis=0)
+        with_grad = [p for p in self._layers.parameters()
+                     if p.grad is not None]
+        if not with_grad:
+            return
+        flat = jnp.concatenate(
+            [p.grad.data.astype(jnp.float32).reshape(-1) for p in with_grad])
+        mean = jnp.mean(multihost_utils.process_allgather(flat), axis=0)
+        offset = 0
+        for p in with_grad:
+            n = p.grad.data.size
+            p.grad.data = mean[offset:offset + n].reshape(
+                p.grad.data.shape).astype(p.grad.data.dtype)
+            offset += n
 
     # passthrough conveniences
     def state_dict(self, *args, **kwargs):

@@ -147,6 +147,13 @@ class Fleet:
     def distributed_optimizer(self, optimizer, strategy=None):
         if strategy is not None:
             self._strategy = strategy
+        if self._strategy is not None and (
+                getattr(self._strategy, "lars", False)
+                or getattr(self._strategy, "lamb", False)):
+            # meta-optimizer swap (lars_optimizer/lamb_optimizer analog)
+            from .strategy_compiler import StrategyCompiler
+            plan = StrategyCompiler().compile(self._strategy, optimizer)
+            optimizer = plan.optimizer or optimizer
         self._user_defined_optimizer = optimizer
         if self._hcg is None:
             return optimizer

@@ -59,13 +59,17 @@ class DygraphShardingOptimizer:
         if jax.process_count() == 1:
             # every "rank" is this process: params are already current
             return
-        if jax.process_count() < self._sharding_world_size:
+        if jax.process_count() != self._sharding_world_size:
+            # broadcast_one_to_all psums over ALL processes: with more than
+            # one sharding group (dp_degree > 1) every group would contribute
+            # a source and params would come back multiplied by the group
+            # count — refuse rather than corrupt
             raise RuntimeError(
-                "eager DygraphShardingOptimizer needs one process per "
-                "sharding rank (got sharding_degree="
+                "eager DygraphShardingOptimizer needs exactly one process "
+                "per sharding rank (got sharding_degree="
                 f"{self._sharding_world_size}, processes="
                 f"{jax.process_count()}); use parallelize()/ShardedTrainStep "
-                "for single-process SPMD sharding")
+                "for SPMD sharding and hybrid dp x sharding layouts")
         from jax.experimental import multihost_utils
         for owner, params in self._rank2params.items():
             for p in params:

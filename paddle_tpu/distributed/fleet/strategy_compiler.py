@@ -1,0 +1,173 @@
+"""Strategy → execution composition (the meta-optimizer framework).
+
+Reference: fleet/base/strategy_compiler.py:213 ranks and chains ~17
+meta-optimizers (AMP → recompute → ... → sharding/raw_program last), each of
+which REWRITES the static program. TPU-native: the "program" is the jitted
+train step, so each strategy flag becomes a transformation of the step
+function instead of an OpDesc rewrite:
+
+    amp             -> trace the forward under auto_cast + (fp16) dynamic
+                       loss-scale state threaded through the step
+    lars / lamb     -> swap the inner optimizer (meta_optimizers/{lars,lamb})
+    recompute       -> jax.checkpoint around the loss computation
+    gradient_merge  -> cond-gated accumulate: k-1 steps bank grads, k-th
+                       applies (gradient_merge_optimizer.py:72 analog)
+    sharding        -> ZeRO stage via sharding constraints (stage 2 adds a
+                       grad reduce-scatter distinct from stage 1)
+    localsgd        -> per-data-rank local params + periodic mesh-wide
+                       average (localsgd_optimizer.py:26 analog)
+    pipeline        -> dispatch to PipelinedTrainStep (handled by
+                       parallelize())
+
+`StrategyCompiler.compile` resolves flag conflicts the same way the
+reference's _can_apply/_disable_strategy protocol does and returns the plan
+consumed by `parallelize()`/`ShardedTrainStep`.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ..strategy import AMPConfig, DistributedStrategy
+
+# Application order mirrors the reference's rank: rewrites that change the
+# numerics of the forward first, optimizer swaps next, execution-layout
+# transforms last.
+TRANSFORM_ORDER = ("amp", "lars", "lamb", "recompute", "gradient_merge",
+                   "localsgd", "sharding", "pipeline")
+
+
+@dataclasses.dataclass
+class CompiledStrategy:
+    """The resolved execution plan for one train step."""
+
+    applied: List[str] = dataclasses.field(default_factory=list)
+    amp: Optional[AMPConfig] = None
+    remat: bool = False
+    accumulate_steps: int = 1
+    gradient_merge_avg: bool = True
+    zero_stage: int = 0
+    zero_offload: bool = False
+    zero_min_numel: int = 1024
+    localsgd_k: int = 0
+    localsgd_begin: int = 1
+    pipeline: bool = False
+    optimizer = None  # possibly swapped by lars/lamb
+
+    def describe(self) -> str:
+        return " -> ".join(self.applied) if self.applied else "(raw)"
+
+
+class StrategyCompiler:
+    """fleet/base/strategy_compiler.py analog over step transforms."""
+
+    def compile(self, strategy: Optional[DistributedStrategy], optimizer=None,
+                mesh=None) -> CompiledStrategy:
+        plan = CompiledStrategy()
+        plan.optimizer = optimizer
+        if strategy is None:
+            return plan
+
+        conflicts = []
+        if getattr(strategy, "amp", False):
+            plan.amp = strategy.amp_configs
+            plan.applied.append("amp")
+        if getattr(strategy, "lars", False) and optimizer is not None:
+            plan.optimizer = self._to_lars(optimizer, strategy.lars_configs)
+            plan.applied.append("lars")
+        if getattr(strategy, "lamb", False) and optimizer is not None:
+            plan.optimizer = self._to_lamb(plan.optimizer,
+                                           strategy.lamb_configs)
+            plan.applied.append("lamb")
+        if getattr(strategy, "recompute", False):
+            plan.remat = True
+            plan.applied.append("recompute")
+        if getattr(strategy, "gradient_merge", False):
+            plan.accumulate_steps = max(
+                strategy.gradient_merge_configs.k_steps, 1)
+            plan.gradient_merge_avg = strategy.gradient_merge_configs.avg
+            if plan.accumulate_steps > 1:
+                plan.applied.append("gradient_merge")
+        if getattr(strategy, "localsgd", False):
+            plan.localsgd_k = max(strategy.localsgd_configs.k_steps, 1)
+            plan.localsgd_begin = strategy.localsgd_configs.begin_step
+            plan.applied.append("localsgd")
+        if getattr(strategy, "sharding", False):
+            plan.zero_stage = strategy.sharding_configs.stage
+            plan.zero_offload = strategy.sharding_configs.offload
+            plan.zero_min_numel = getattr(strategy.sharding_configs,
+                                          "min_shard_numel", 1024)
+            plan.applied.append("sharding")
+        elif strategy.hybrid_configs.sharding_degree > 1:
+            plan.zero_stage = 1
+            plan.applied.append("sharding")
+        if getattr(strategy, "pipeline", False) or (
+                mesh is not None and "pipe" in mesh.axis_names
+                and mesh.shape["pipe"] > 1):
+            plan.pipeline = True
+            plan.applied.append("pipeline")
+
+        # conflict resolution (reference _disable_strategy protocol)
+        if plan.localsgd_k and (plan.amp or plan.remat
+                                or plan.accumulate_steps > 1):
+            dropped = [n for n in ("amp", "recompute", "gradient_merge")
+                       if n in plan.applied]
+            conflicts.append(
+                "LocalSGDTrainStep does not compose with "
+                f"{'/'.join(dropped)} yet; disabling them for this step")
+            plan.amp = None
+            plan.remat = False
+            plan.accumulate_steps = 1
+            for n in dropped:
+                plan.applied.remove(n)
+        if plan.localsgd_k and plan.zero_stage:
+            conflicts.append("localsgd is incompatible with ZeRO sharding "
+                             "(local params cannot also be shard-owned); "
+                             "disabling localsgd")
+            plan.localsgd_k = 0
+            plan.applied.remove("localsgd")
+        if plan.localsgd_k and plan.pipeline:
+            conflicts.append("localsgd is incompatible with pipeline "
+                             "parallelism; disabling localsgd")
+            plan.localsgd_k = 0
+            plan.applied.remove("localsgd")
+        if conflicts:
+            import warnings
+            for c in conflicts:
+                warnings.warn(c, stacklevel=3)
+
+        plan.applied.sort(key=TRANSFORM_ORDER.index)
+        return plan
+
+    @staticmethod
+    def _to_lars(optimizer, cfg):
+        """Momentum → LarsMomentum keeping lr/params (lars_optimizer.py)."""
+        from ...optimizer.optimizer import LarsMomentum, Momentum
+        if isinstance(optimizer, LarsMomentum):
+            return optimizer
+        momentum = getattr(optimizer, "_momentum", 0.9)
+        return LarsMomentum(
+            learning_rate=optimizer._learning_rate,
+            momentum=momentum, lars_coeff=cfg.lars_coeff,
+            lars_weight_decay=cfg.lars_weight_decay, epsilon=cfg.epsilon,
+            parameters=optimizer._parameter_list,
+            grad_clip=optimizer._grad_clip)
+
+    @staticmethod
+    def _to_lamb(optimizer, cfg):
+        """Adam-family → Lamb keeping lr/params (lamb_optimizer.py)."""
+        from ...optimizer.optimizer import Lamb
+        if isinstance(optimizer, Lamb):
+            return optimizer
+        exclude = set(cfg.exclude_from_weight_decay or [])
+        fn = (lambda p: any(e in (p.name or "") for e in exclude)) \
+            if exclude else None
+        return Lamb(
+            learning_rate=optimizer._learning_rate,
+            lamb_weight_decay=cfg.lamb_weight_decay,
+            beta1=getattr(optimizer, "_beta1", 0.9),
+            beta2=getattr(optimizer, "_beta2", 0.999),
+            epsilon=getattr(optimizer, "_epsilon", 1e-6),
+            parameters=optimizer._parameter_list,
+            grad_clip=optimizer._grad_clip,
+            exclude_from_weight_decay_fn=fn)

@@ -34,6 +34,9 @@ class ShardingConfig:  # proto ShardingConfig:32
     gradient_merge_acc_step: int = 1
     optimize_offload: bool = False
     pp_allreduce_in_optimize: bool = False
+    # TPU-specific: tensors below this element count stay replicated instead
+    # of ZeRO-sharded (size segmentation, segment_broadcast_MB analog)
+    min_shard_numel: int = 1024
 
 
 @dataclass

@@ -5,12 +5,21 @@ mask+softmax for GPT) and multihead_matmul_op.cu — the reference has NO flash
 attention (SURVEY header); this is a parity-plus op named in the north star.
 
 Design (pallas_guide.md):
-- forward: Pallas kernel, grid (batch*heads, q_blocks), online-softmax scan over
-  k-blocks; QK^T and PV hit the MXU with fp32 accumulation; causal blocks are
-  skipped entirely (not just masked) so the causal path does ~half the FLOPs.
-- backward: custom-vjp recomputation in k-blocks via lax.scan using the saved
-  row logsumexp — memory stays O(S·block) instead of O(S²), XLA fuses the
-  elementwise chain. (A full Pallas backward kernel is a later optimization.)
+- forward: Pallas kernel, grid (batch*heads, q_blocks, k_blocks), online-softmax
+  with VMEM scratch carried across the innermost k steps; QK^T and PV hit the
+  MXU with fp32 accumulation; causal blocks strictly in the future are skipped
+  entirely (not just masked) so the causal path does ~half the FLOPs.
+- backward: two Pallas kernels — dq over (bh, q_blocks, k_blocks) and dk/dv
+  over (bh, k_blocks, q_blocks) — recomputing probabilities from the saved row
+  logsumexp, O(S·block) memory. delta = rowsum(dO·O) is one cheap XLA reduce.
+- rectangular (cross) attention: causal masking uses the bottom-right offset
+  (q_offset = Sk - Sq), matching the XLA reference path.
+- additive mask: [B, 1|H, Sq, Sk] streamed blockwise into both kernels.
+- dropout: in-kernel TPU PRNG seeded per (bh, q_block, k_block) so forward and
+  backward regenerate identical keep-masks without storing O(S²) bits. The
+  keep-mask applies to the normalized probs (acc uses dropped p, the softmax
+  denominator uses undropped p — algebraically identical to dropout(softmax)).
+  Not available in CPU interpret mode (pltpu.prng has no CPU lowering).
 """
 from __future__ import annotations
 
@@ -31,33 +40,73 @@ _NEG_INF = -1e30
 def causal_mask(n_rows: int, n_cols: int, q_offset=0, k_offset=0):
     """Boolean [n_rows, n_cols] mask: True where query position >= key
     position (with absolute offsets). Shared by the XLA reference, the Pallas
-    kernel blocks, the chunked backward, and incubate's fused softmax."""
+    kernel blocks, and incubate's fused softmax."""
     rows = jax.lax.broadcasted_iota(jnp.int32, (n_rows, n_cols), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (n_rows, n_cols), 1)
     return (q_offset + rows) >= (k_offset + cols)
 
 
-def _attention_reference(q, k, v, causal, scale, mask=None):
-    """Plain-XLA reference (fp32 softmax). Used for short sequences and tests."""
+def _attention_reference(q, k, v, causal, scale, mask=None, dropout_p=0.0,
+                         dropout_key=None):
+    """Plain-XLA reference (fp32 softmax). Used for short sequences, CPU, and
+    as the numerics oracle in tests."""
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     Sq, Sk = logits.shape[-2], logits.shape[-1]
+    cm = None
     if causal:
-        logits = jnp.where(causal_mask(Sq, Sk, q_offset=Sk - Sq), logits,
-                           _NEG_INF)
+        cm = causal_mask(Sq, Sk, q_offset=Sk - Sq)
+        logits = jnp.where(cm, logits, _NEG_INF)
     if mask is not None:
+        if mask.ndim == 3:  # [B,Sq,Sk] -> broadcast over heads, like _mask_3d
+            mask = mask[:, None]
         logits = logits + mask.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
+    if cm is not None:
+        # rows with no causally-visible key (Sq > Sk cross attention) output
+        # zeros, matching the kernel's skipped-block convention
+        probs = jnp.where(jnp.any(cm, axis=-1, keepdims=True), probs, 0.0)
+    if dropout_p > 0.0:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, block_q, block_k):
-    """3D grid (batch*heads, q_blocks, k_blocks). TPU grids iterate
-    sequentially with the last dimension innermost, so the online-softmax
-    state lives in VMEM scratch across the k steps of one (bh, qi) cell.
-    Only [block, d]-sized K/V tiles are resident in VMEM at a time."""
+def _block_keep(seed_ref, b, qi, kb, n_qb, n_kb, shape, dropout_p):
+    """Deterministic per-block dropout keep-mask from the TPU PRNG; the same
+    (seed, block) pair regenerates the same bits in forward and backward.
+    seed_ref is a traced SMEM scalar, so a fresh per-step seed does NOT
+    retrace/recompile the kernel."""
+    pltpu.prng_seed(seed_ref[0] + ((b * n_qb + qi) * n_kb + kb))
+    bits = pltpu.prng_random_bits(shape)  # uint32
+    thresh = jnp.uint32(int(dropout_p * (2 ** 32 - 1)))
+    return bits >= thresh
+
+
+def _apply_mask_block(s, mask_ref, causal, block_q, block_k, q_start, k_start,
+                      causal_offset):
+    if causal:
+        s = jnp.where(
+            causal_mask(block_q, block_k, q_start + causal_offset, k_start),
+            s, _NEG_INF)
+    if mask_ref is not None:
+        s = s + mask_ref[0].astype(jnp.float32)
+    return s
+
+
+def _fwd_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
+                has_mask, dropout_p, seed, n_qb, n_kb):
+    """Grid (batch*heads, q_blocks, k_blocks), k innermost; online-softmax
+    state in VMEM scratch across the k steps of one (bh, qi) cell."""
+    i = 3
+    q_ref, k_ref, v_ref = refs[:3]
+    mask_ref = refs[i] if has_mask else None
+    i += 1 if has_mask else 0
+    seed_ref = refs[i] if dropout_p > 0.0 else None
+    i += 1 if dropout_p > 0.0 else 0
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[i:]
+    b = pl.program_id(0)
     qi = pl.program_id(1)
     kb = pl.program_id(2)
     num_kb = pl.num_programs(2)
@@ -70,8 +119,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # causal: skip blocks entirely in the future
-    run = (q_start + block_q - 1 >= k_start) if causal else True
+    # causal: skip blocks strictly in the future (offset-aware for Sq != Sk)
+    run = (q_start + causal_offset + block_q - 1 >= k_start) if causal \
+        else True
 
     @pl.when(run)
     def _compute():
@@ -80,16 +130,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         vblk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
-            s = jnp.where(causal_mask(block_q, block_k, q_start, k_start), s,
-                          _NEG_INF)
+        s = _apply_mask_block(s, mask_ref, causal, block_q, block_k, q_start,
+                              k_start, causal_offset)
         m_prev = m_ref[...]
         l_prev = l_ref[...]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
+        # structurally-masked entries contribute exactly 0 even when a whole
+        # row is masked (else exp(s - m) with m == s == -1e30 would give 1
+        # for every key and rows with no visible key would emit mean(v))
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, jnp.exp(s - m_new))
         alpha = jnp.exp(m_prev - m_new)
+        # denominator uses the full p; dropout applies only to the numerator
         l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_p > 0.0:
+            keep = _block_keep(seed_ref, b, qi, kb, n_qb, n_kb, p.shape,
+                               dropout_p)
+            p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p, vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -102,7 +159,139 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0] = (m_ref[...] + jnp.log(l)).astype(jnp.float32)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
+                   has_mask, dropout_p, seed, n_qb, n_kb):
+    """Grid (bh, q_blocks, k_blocks): accumulate dq for one q block."""
+    i = 6
+    q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref = refs[:6]
+    mask_ref = refs[i] if has_mask else None
+    i += 1 if has_mask else 0
+    seed_ref = refs[i] if dropout_p > 0.0 else None
+    i += 1 if dropout_p > 0.0 else 0
+    dq_ref, acc_ref = refs[i:]
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    num_kb = pl.num_programs(2)
+    q_start = qi * block_q
+    k_start = kb * block_k
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    run = (q_start + causal_offset + block_q - 1 >= k_start) if causal \
+        else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q * scale, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = _apply_mask_block(s, mask_ref, causal, block_q, block_k, q_start,
+                              k_start, causal_offset)
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, jnp.exp(s - lse_ref[0]))
+        dp = jax.lax.dot_general(g, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _block_keep(seed_ref, b, qi, kb, n_qb, n_kb, p.shape,
+                               dropout_p)
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
+        ds = p * (dp - delta_ref[0]) * scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
+                    has_mask, dropout_p, seed, n_qb, n_kb):
+    """Grid (bh, k_blocks, q_blocks): accumulate dk/dv for one k block."""
+    i = 6
+    q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref = refs[:6]
+    mask_ref = refs[i] if has_mask else None
+    i += 1 if has_mask else 0
+    seed_ref = refs[i] if dropout_p > 0.0 else None
+    i += 1 if dropout_p > 0.0 else 0
+    dk_ref, dv_ref, dk_acc, dv_acc = refs[i:]
+    b = pl.program_id(0)
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+    num_qb = pl.num_programs(2)
+    q_start = qi * block_q
+    k_start = kb * block_k
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = (q_start + causal_offset + block_q - 1 >= k_start) if causal \
+        else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q * scale, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = _apply_mask_block(s, mask_ref, causal, block_q, block_k, q_start,
+                              k_start, causal_offset)
+        p = jnp.where(s <= _NEG_INF / 2, 0.0,
+                      jnp.exp(s - lse_ref[0]))  # [bq, bk]
+        dp = jax.lax.dot_general(g, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _block_keep(seed_ref, b, qi, kb, n_qb, n_kb, p.shape,
+                               dropout_p)
+            inv = 1.0 - dropout_p
+            p_drop = jnp.where(keep, p / inv, 0.0)
+            dp = jnp.where(keep, dp / inv, 0.0)
+        else:
+            p_drop = p
+        ds = p * (dp - delta_ref[0]) * scale
+        # dv += p_drop^T @ g ; dk += ds^T @ q
+        dv_acc[...] += jax.lax.dot_general(
+            p_drop, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _mask_3d(mask, B, H, Sq, Sk):
+    """Normalize an additive mask to [rows, Sq, Sk] + the bh->row divisor for
+    the BlockSpec index map (row = bh // divisor). [B,1,Sq,Sk] stays
+    un-broadcast: every head of batch b reads row b."""
+    if mask.ndim == 3:
+        mask = mask[:, None]
+    mb, mh = mask.shape[0], mask.shape[1]
+    if mb not in (1, B):
+        raise ValueError(
+            f"additive mask batch dim {mb} must be 1 or match batch {B}")
+    if mh == 1:
+        if mb == 1:
+            return mask.reshape(1, Sq, Sk), B * H  # bh // (B*H) == 0 always
+        return mask.reshape(B, Sq, Sk), H
+    flat = jnp.broadcast_to(mask, (B, H, Sq, Sk)).reshape(B * H, Sq, Sk)
+    return flat, 1
+
+
+def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k, dropout_p,
+               seed):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bq = min(block_q, Sq)
@@ -113,17 +302,30 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     qr = q.reshape(B * H, Sq, D)
     kr = k.reshape(B * H, Sk, D)
     vr = v.reshape(B * H, Sk, D)
+    n_qb, n_kb = Sq // bq, Sk // bk
 
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=bq, block_k=bk)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        causal_offset=Sk - Sq, has_mask=mask is not None,
+        dropout_p=dropout_p, seed=seed, n_qb=n_qb, n_kb=n_kb)
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+    ]
+    operands = [qr, kr, vr]
+    if mask is not None:
+        mflat, div = _mask_3d(mask, B, H, Sq, Sk)
+        in_specs.append(pl.BlockSpec(
+            (1, bq, bk), lambda b, i, j, d=div: (b // d, i, j)))
+        operands.append(mflat)
+    if dropout_p > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(jnp.asarray(seed, jnp.int32).reshape(1))
     out, lse = pl.pallas_call(
         kernel,
-        grid=(B * H, Sq // bq, Sk // bk),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-        ],
+        grid=(B * H, n_qb, n_kb),
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
@@ -138,63 +340,182 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
             pltpu.VMEM((bq, 1), jnp.float32),   # running sum
         ],
         interpret=(jax.default_backend() == "cpu"),
-    )(qr, kr, vr)
+    )(*operands)
     return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq, 1)
 
 
-def _chunked_bwd(q, k, v, out, lse, g, causal, scale, block_k):
-    """Recompute-based backward, scanned over k-blocks (O(S·block) memory)."""
+def _flash_bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
+               dropout_p, seed):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq = min(block_q, Sq)
+    bk = min(block_k, Sk)
+    n_qb, n_kb = Sq // bq, Sk // bk
+    qr = q.reshape(B * H, Sq, D)
+    kr = k.reshape(B * H, Sk, D)
+    vr = v.reshape(B * H, Sk, D)
+    gr = g.reshape(B * H, Sq, D)
+    lser = lse.reshape(B * H, Sq, 1)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+                    keepdims=True).reshape(B * H, Sq, 1)
+    interp = jax.default_backend() == "cpu"
+    common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
+                  causal_offset=Sk - Sq, has_mask=mask is not None,
+                  dropout_p=dropout_p, seed=seed, n_qb=n_qb, n_kb=n_kb)
+
+    base_specs_q = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),   # q
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),   # k
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),   # v
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),   # g
+        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),   # lse
+        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),   # delta
+    ]
+    operands = [qr, kr, vr, gr, lser, delta]
+    if mask is not None:
+        mflat, div = _mask_3d(mask, B, H, Sq, Sk)
+        base_specs_q.append(pl.BlockSpec(
+            (1, bq, bk), lambda b, i, j, d=div: (b // d, i, j)))
+        operands.append(mflat)
+    if dropout_p > 0.0:
+        base_specs_q.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(jnp.asarray(seed, jnp.int32).reshape(1))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(B * H, n_qb, n_kb),
+        in_specs=base_specs_q,
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interp,
+    )(*operands)
+
+    # dkv grid: (bh, k_blocks, q_blocks) — q innermost, accumulators per k blk
+    base_specs_kv = [
+        pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),   # q
+        pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),   # k
+        pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),   # v
+        pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),   # g
+        pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),   # lse
+        pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),   # delta
+    ]
+    operands_kv = [qr, kr, vr, gr, lser, delta]
+    if mask is not None:
+        mflat, div = _mask_3d(mask, B, H, Sq, Sk)
+        base_specs_kv.append(pl.BlockSpec(
+            (1, bq, bk), lambda b, j, i, d=div: (b // d, i, j)))
+        operands_kv.append(mflat)
+    if dropout_p > 0.0:
+        base_specs_kv.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands_kv.append(jnp.asarray(seed, jnp.int32).reshape(1))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(B * H, n_kb, n_qb),
+        in_specs=base_specs_kv,
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interp,
+    )(*operands_kv)
+    return (dq.reshape(B, H, Sq, D), dk.reshape(B, H, Sk, D),
+            dv.reshape(B, H, Sk, D))
+
+
+def _mask_grad(q, k, v, mask, lse, g, delta, causal, scale, block_k):
+    """d(loss)/d(additive mask), chunked over k blocks (XLA): the cotangent at
+    the mask-add point is p * (dp - delta) (no scale factor — the mask is
+    added after the QK^T scaling). Reduced over the mask's broadcast dims."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bk = min(block_k, Sk)
-    n_kb = (Sk + bk - 1) // bk
+    n_kb = Sk // bk
+    was_3d = mask.ndim == 3
+    if was_3d:
+        mask = mask[:, None]
+    mb, mh = mask.shape[0], mask.shape[1]
     q32 = q.astype(jnp.float32)
     g32 = g.astype(jnp.float32)
-    # delta = rowsum(dO * O)
-    delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1, keepdims=True)
+    lse4 = lse.reshape(B, H, Sq, 1)
+    delta4 = delta.reshape(B, H, Sq, 1)
 
-    def body(carry, kb):
-        dq_acc = carry
+    def body(_, kb):
         k_start = kb * bk
-        kblk = jax.lax.dynamic_slice_in_dim(k, k_start, bk, axis=2)
-        vblk = jax.lax.dynamic_slice_in_dim(v, k_start, bk, axis=2)
-        kb32 = kblk.astype(jnp.float32)
-        vb32 = vblk.astype(jnp.float32)
+        kb32 = jax.lax.dynamic_slice_in_dim(k, k_start, bk, 2).astype(
+            jnp.float32)
+        vb32 = jax.lax.dynamic_slice_in_dim(v, k_start, bk, 2).astype(
+            jnp.float32)
+        mblk = jax.lax.dynamic_slice_in_dim(
+            mask.astype(jnp.float32), k_start, bk, 3)
         s = jnp.einsum("bhqd,bhkd->bhqk", q32, kb32) * scale
         if causal:
-            m = causal_mask(Sq, bk, k_offset=k_start)
-            s = jnp.where(m[None, None], s, _NEG_INF)
-        p = jnp.exp(s - lse)  # [B,H,Sq,bk] softmax probs via saved lse
-        dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+            cm = causal_mask(Sq, bk, q_offset=Sk - Sq, k_offset=k_start)
+            s = jnp.where(cm[None, None], s, _NEG_INF)
+        s = s + mblk
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, jnp.exp(s - lse4))
         dp = jnp.einsum("bhqd,bhkd->bhqk", g32, vb32)
-        ds = p * (dp - delta) * scale
-        dq_blk = jnp.einsum("bhqk,bhkd->bhqd", ds, kb32)
-        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32)
-        return dq_acc + dq_blk, (dk, dv)
+        dm = p * (dp - delta4)  # [B,H,Sq,bk]
+        if mh == 1:
+            dm = jnp.sum(dm, axis=1, keepdims=True)
+        if mb == 1:
+            dm = jnp.sum(dm, axis=0, keepdims=True)
+        return 0, dm
 
-    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
-        body, jnp.zeros_like(q32), jnp.arange(n_kb))
-    # scan stacks [n_kb, B, H, bk, D] → [B, H, n_kb*bk, D]
-    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(B, H, n_kb * bk, D)[:, :, :Sk]
-    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(B, H, n_kb * bk, D)[:, :, :Sk]
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    _, blocks = jax.lax.scan(body, 0, jnp.arange(n_kb))
+    dmask = jnp.concatenate(
+        [blocks[i] for i in range(n_kb)], axis=-1) if n_kb > 1 else blocks[0]
+    if was_3d:  # cotangent must match the primal's 3D shape
+        dmask = dmask[:, 0]
+    return dmask.astype(mask.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention(q, k, v, causal, scale, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_attention(q, k, v, mask, seed, causal, scale, block_q, block_k,
+                     dropout_p):
+    out, _ = _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k,
+                        dropout_p, seed)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
-    return out, (q, k, v, out, lse)
+def _flash_vjp_fwd(q, k, v, mask, seed, causal, scale, block_q, block_k,
+                   dropout_p):
+    out, lse = _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k,
+                          dropout_p, seed)
+    return out, (q, k, v, mask, seed, out, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v, out, lse = res
-    dq, dk, dv = _chunked_bwd(q, k, v, out, lse, g, causal, scale, block_k)
-    return dq, dk, dv
+def _flash_vjp_bwd(causal, scale, block_q, block_k, dropout_p, res, g):
+    import numpy as np
+    q, k, v, mask, seed, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, mask, out, lse, g, causal, scale,
+                            block_q, block_k, dropout_p, seed)
+    if mask is None:
+        dmask = None
+    elif dropout_p > 0.0:
+        # the keep-mask lives in the TPU PRNG and is not recomputable in XLA
+        # (the flash_attention wrapper routes mask+dropout to the reference
+        # path; only direct _flash_attention callers can land here)
+        raise NotImplementedError(
+            "mask gradients are unavailable with in-kernel dropout; use "
+            "flash_attention(), which falls back to the XLA reference for "
+            "mask + dropout")
+    else:
+        delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        dmask = _mask_grad(q, k, v, mask, lse, g, delta, causal, scale,
+                           block_k)
+    dseed = np.zeros(np.shape(seed), jax.dtypes.float0)
+    return dq, dk, dv, dmask, dseed
 
 
 _flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -203,24 +524,36 @@ _flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    force_pallas: bool = False, mask=None):
-    """q,k,v: [B, H, S, D] jax arrays. Returns [B, H, Sq, D].
+                    force_pallas: bool = False, mask=None,
+                    dropout_p: float = 0.0, dropout_seed: int = 0):
+    """q,k,v: [B, H, S, D] jax arrays; optional additive mask [B, 1|H, Sq, Sk].
+    Returns [B, H, Sq, D]. Supports rectangular (cross) attention: causal uses
+    bottom-right alignment when Sq != Sk.
 
-    Uses the Pallas kernel on TPU for long sequences; falls back to the fused
-    XLA reference for short sequences, CPU, or when an additive mask is given.
+    Uses the Pallas kernels (fwd + dq/dkv bwd) on TPU for seqs >= 512; falls
+    back to the fused XLA reference for short sequences and CPU. Dropout on
+    the Pallas path uses the in-kernel TPU PRNG (TPU only).
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     on_tpu = jax.default_backend() not in ("cpu",)
-    long_seq = q.shape[2] >= 1024
+    long_seq = q.shape[2] >= 512
     Sq, Sk = q.shape[2], k.shape[2]
     divisible = (Sq % min(block_q, Sq) == 0 and Sk % min(block_k, Sk) == 0)
-    square = Sq == Sk  # kernel's causal mask assumes self-attention offsets
-    eligible = divisible and (square or not causal)
-    if mask is not None or not eligible or (
-            not force_pallas and not (on_tpu and long_seq)):
-        return _attention_reference(q, k, v, causal, scale, mask)
-    return _flash_attention(q, k, v, causal, scale, block_q, block_k)
+    dropout_needs_tpu = dropout_p > 0.0 and jax.default_backend() == "cpu"
+    # mask + dropout: the keep-mask lives in the TPU PRNG and cannot be
+    # recomputed in XLA for d(mask), so a differentiable mask would silently
+    # get zero grads — route the combination to the reference path
+    mask_and_dropout = dropout_p > 0.0 and mask is not None
+    eligible = divisible and not dropout_needs_tpu and not mask_and_dropout
+    if not eligible or (not force_pallas and not (on_tpu and long_seq)):
+        key = jax.random.PRNGKey(jnp.asarray(dropout_seed, jnp.uint32)) \
+            if dropout_p > 0.0 else None
+        return _attention_reference(q, k, v, causal, scale, mask, dropout_p,
+                                    key)
+    return _flash_attention(q, k, v, mask,
+                            jnp.asarray(dropout_seed, jnp.int32), causal,
+                            scale, block_q, block_k, dropout_p)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
@@ -228,21 +561,24 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  training=True, name=None):
     """paddle.nn.functional.scaled_dot_product_attention parity wrapper.
     Tensors are [B, S, H, D] in paddle convention."""
+    from ..core.random import next_key
     from ..core.tensor import apply
     from ..tensor.creation import _t
 
-    if dropout_p > 0.0 and training:
-        raise NotImplementedError(
-            "attention dropout is not implemented in the fused path; "
-            "apply nn.Dropout outside or use dropout_p=0.0")
     q, k, v = _t(query), _t(key), _t(value)
+    pd = dropout_p if training else 0.0
+    # traced seed: fresh per call in eager, threaded through jit without
+    # retracing (it enters the Pallas kernels as an SMEM scalar)
+    seed = jax.random.randint(next_key(), (), 0, 2 ** 31 - 1) if pd > 0 \
+        else 0
 
     def f(qa, ka, va, *m):
         qt = jnp.swapaxes(qa, 1, 2)
         kt = jnp.swapaxes(ka, 1, 2)
         vt = jnp.swapaxes(va, 1, 2)
         out = flash_attention(qt, kt, vt, causal=is_causal,
-                              mask=m[0] if m else None)
+                              mask=m[0] if m else None, dropout_p=pd,
+                              dropout_seed=seed)
         return jnp.swapaxes(out, 1, 2)
 
     if attn_mask is not None:

@@ -1,1 +1,2 @@
 from .api import ShardedTrainStep, parallelize  # noqa: F401
+from .localsgd import LocalSGDTrainStep  # noqa: F401

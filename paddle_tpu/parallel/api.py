@@ -7,12 +7,18 @@ Reducer (imperative/reducer.cc:289), the sharding meta-optimizer
 one jit-compiled train step over a jax.sharding.Mesh where
 - DP   = batch dim sharded over ('data', 'sharding') — grad psum inserted by XLA,
 - TP   = weight PartitionSpecs over 'model' (declared by the mp_layers),
-- ZeRO = optimizer-state (stage 1/2) and parameter (stage 3) sharding over
-         'sharding',
+- ZeRO = optimizer-state (stage 1), +gradient (stage 2, reduce-scatter) and
+         parameter (stage 3) sharding over 'sharding',
 and XLA GSPMD materializes exactly the collectives Fleet inserts by hand.
+
+DistributedStrategy flags compose through
+distributed/fleet/strategy_compiler.py (the meta-optimizer analog): amp,
+recompute, gradient_merge, sharding stage, lars/lamb swaps all transform THIS
+step function.
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Callable, Dict, Optional
 
 import jax
@@ -41,12 +47,38 @@ def _param_spec(param, mesh: Mesh) -> P:
     return P(*cleaned)
 
 
-def _zero_spec(base: P, shape, mesh: Mesh, axis="sharding") -> P:
+def _zero_spec(base: P, shape, mesh: Mesh, axis="sharding",
+               min_numel: int = 1024) -> P:
     """Extend a param spec with the ZeRO `sharding` axis on the first dim that
-    is unsharded and divisible (sharding_optimizer.py shard.py analog)."""
+    is unsharded and divisible (sharding_optimizer.py shard.py analog).
+
+    Tensors below min_numel stay replicated: sharding a 128-element layernorm
+    vector saves nothing and forces GSPMD into a full-rematerialization
+    reshard of the backward intermediates that feed it (the reference
+    similarly segments by size, segment_broadcast_MB)."""
     if axis not in mesh.axis_names or mesh.shape[axis] == 1:
         return base
+    if int(np.prod(shape)) < min_numel:
+        return base
     spec = list(base) + [None] * (len(shape) - len(base))
+    for ax in spec:  # already ZeRO-extended (e.g. stage-3 param spec)
+        if ax == axis or (isinstance(ax, tuple) and axis in ax):
+            return P(*spec)
+    # prefer stacking onto an already-sharded dim (e.g. vocab-parallel
+    # embedding ('model', None) -> (('model','sharding'), None)): the grad
+    # arrives sharded on that dim already, so the ZeRO reshard is a local
+    # slice; a fresh dim (('model','sharding') on dim1) would force GSPMD to
+    # fully rematerialize scatter/matmul grads into a transposed layout
+    for dim, ax in enumerate(spec):
+        if ax is None or ax == axis:
+            continue
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        if axis in axes:
+            continue
+        group = int(np.prod([mesh.shape[a] for a in axes])) * mesh.shape[axis]
+        if shape[dim] % group == 0:
+            spec[dim] = tuple(axes) + (axis,)
+            return P(*spec)
     for dim, ax in enumerate(spec):
         if ax is None and shape[dim] % mesh.shape[axis] == 0 and shape[dim] > 1:
             spec[dim] = axis
@@ -62,6 +94,35 @@ def _batch_axes(mesh: Mesh):
     return tuple(axes) if len(axes) > 1 else axes[0]
 
 
+def _tree_where(pred, a_tree, b_tree):
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(pred, a, b), a_tree, b_tree)
+
+
+def make_compute_loss(model, loss_fn, amp_ctx=None):
+    """Shared (params, buffers, rng, *arrays) -> (f32 loss, new_buffers)
+    closure used by every parallel runner. loss_fn=None means the model
+    returns its own scalar loss (causal-LM style)."""
+    ctx = amp_ctx or contextlib.nullcontext
+
+    def compute_loss(params_, buffers_, rng, *arrays):
+        with ctx():
+            if loss_fn is None:
+                out, new_buffers = model.functional_call_with_state(
+                    params_, buffers_, *arrays, rng=rng)
+                loss = out
+            else:
+                out, new_buffers = model.functional_call_with_state(
+                    params_, buffers_, arrays[0], rng=rng)
+                loss_t = loss_fn(
+                    Tensor(out) if not isinstance(out, Tensor) else out,
+                    *[Tensor(a) for a in arrays[1:]])
+                loss = loss_t.data if isinstance(loss_t, Tensor) else loss_t
+        return loss.astype(jnp.float32), new_buffers
+
+    return compute_loss
+
+
 class ShardedTrainStep:
     """One compiled SPMD train step (fwd+bwd+clip+update) over a mesh.
 
@@ -69,30 +130,57 @@ class ShardedTrainStep:
         step = ShardedTrainStep(model, optimizer, mesh, loss_fn=None,
                                 zero_stage=1)
         loss = step(input_ids, labels)     # global batch; sharded by XLA
+
+    With `plan=` (a strategy_compiler.CompiledStrategy) the step additionally
+    executes amp autocast (+ fp16 dynamic loss scaling), rematerialization,
+    cond-gated gradient merge, and the stage-2 gradient reduce-scatter.
     """
 
     def __init__(self, model: Layer, optimizer, mesh: Mesh,
                  loss_fn: Optional[Callable] = None, zero_stage: int = 1,
-                 donate: bool = True):
+                 donate: bool = True, plan=None, min_shard_numel: int = 1024):
+        if plan is not None:
+            zero_stage = plan.zero_stage
+            optimizer = plan.optimizer or optimizer
+            min_shard_numel = plan.zero_min_numel
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.loss_fn = loss_fn
+        self.plan = plan
         self._step_count = 0
+        self.zero_stage = zero_stage
+
+        amp_cfg = plan.amp if plan is not None else None
+        use_scaler = bool(
+            amp_cfg is not None and amp_cfg.dtype == "float16"
+            and amp_cfg.use_dynamic_loss_scaling)
+        accum_k = plan.accumulate_steps if plan is not None else 1
+        merge_avg = plan.gradient_merge_avg if plan is not None else True
+        use_remat = bool(plan is not None and plan.remat)
 
         params, buffers = model.functional_state()
         named = dict(model.named_parameters())
 
         # --- sharding layout ---
         self.param_specs = {}
-        self.opt_specs = {}
         for k, arr in params.items():
             base = _param_spec(named[k], mesh)
             pspec = base
             if zero_stage >= 3:
-                pspec = _zero_spec(base, arr.shape, mesh)
+                pspec = _zero_spec(base, arr.shape, mesh,
+                                   min_numel=min_shard_numel)
             self.param_specs[k] = pspec
         self.buffer_specs = {k: P() for k in buffers}
+
+        # gradient layout: stage >= 2 shards grads over `sharding` (the
+        # reduce-scatter of sharding_optimizer's stage-2), stage <= 1 keeps
+        # grads in the param layout
+        self.grad_specs = {
+            k: (_zero_spec(self.param_specs[k], params[k].shape, mesh,
+                           min_numel=min_shard_numel)
+                if zero_stage >= 2 else self.param_specs[k])
+            for k in params}
 
         # optimizer slots follow the (ZeRO-extended) param layout
         opt_state = optimizer.init_state(params)
@@ -100,7 +188,8 @@ class ShardedTrainStep:
         for k, slots in opt_state.items():
             arr = params[k]
             base = self.param_specs[k]
-            zspec = (_zero_spec(base, arr.shape, mesh)
+            zspec = (_zero_spec(base, arr.shape, mesh,
+                                min_numel=min_shard_numel)
                      if zero_stage >= 1 else base)
             per = {}
             for sname, sarr in slots.items():
@@ -119,32 +208,131 @@ class ShardedTrainStep:
                 for s, a in slots.items()}
             for k, slots in opt_state.items()}
 
+        # ZeRO offload (offload_helper.py:347 analog): optimizer state lives
+        # in pinned host memory between steps and is staged to device around
+        # the update — trades a host<->HBM copy per step for HBM capacity.
+        self._offload = bool(plan is not None and plan.zero_offload)
+        self._opt_dev_sh = {
+            k: {s: NamedSharding(mesh, sp) for s, sp in per.items()}
+            for k, per in self.opt_state_specs.items()}
+        if self._offload:
+            self._opt_host_sh = {
+                k: {s: NamedSharding(mesh, sp, memory_kind="pinned_host")
+                    for s, sp in per.items()}
+                for k, per in self.opt_state_specs.items()}
+            self._opt_state = jax.device_put(self._opt_state,
+                                             self._opt_host_sh)
+
+        # extra step state: gradient-merge accumulator + loss-scale state
+        extras = {}
+        extras_specs = {}
+        if accum_k > 1:
+            extras["accum"] = {
+                k: put(jnp.zeros(v.shape, v.dtype), self.grad_specs[k])
+                for k, v in params.items()}
+            extras_specs["accum"] = {
+                k: NamedSharding(mesh, self.grad_specs[k]) for k in params}
+        if use_scaler:
+            extras["loss_scale"] = put(
+                jnp.asarray(amp_cfg.init_loss_scaling, jnp.float32), P())
+            extras["good_steps"] = put(jnp.asarray(0, jnp.int32), P())
+            extras["bad_steps"] = put(jnp.asarray(0, jnp.int32), P())
+            for k in ("loss_scale", "good_steps", "bad_steps"):
+                extras_specs[k] = NamedSharding(mesh, P())
+        self._extras = extras
+
         apply_fn = optimizer.apply_gradients_fn()
         clip_fn = optimizer.clip_gradients_fn()
         batch_axes = _batch_axes(mesh)
         self.data_spec = P(batch_axes) if batch_axes else P()
 
-        def compute_loss(params_, buffers_, rng, *arrays):
-            if loss_fn is None:
-                out, new_buffers = model.functional_call_with_state(
-                    params_, buffers_, *arrays, rng=rng)
-                loss = out
-            else:
-                out, new_buffers = model.functional_call_with_state(
-                    params_, buffers_, arrays[0], rng=rng)
-                loss_t = loss_fn(
-                    Tensor(out) if not isinstance(out, Tensor) else out,
-                    *[Tensor(a) for a in arrays[1:]])
-                loss = loss_t.data if isinstance(loss_t, Tensor) else loss_t
-            return loss, new_buffers
+        if amp_cfg is not None:
+            from ..amp import auto_cast
 
-        def train_step(params_, opt_state_, buffers_, lr, step, rng, arrays):
-            (loss, new_buffers), grads = jax.value_and_grad(
-                compute_loss, has_aux=True)(params_, buffers_, rng, *arrays)
-            grads = clip_fn(grads)
-            new_params, new_opt = apply_fn(params_, grads, opt_state_, lr,
-                                           step)
-            return loss, new_params, new_opt, new_buffers
+            def amp_ctx():
+                return auto_cast(True,
+                                 custom_white_list=amp_cfg.custom_white_list,
+                                 custom_black_list=amp_cfg.custom_black_list,
+                                 dtype=amp_cfg.dtype)
+        else:
+            amp_ctx = None
+
+        compute_loss = make_compute_loss(model, loss_fn, amp_ctx)
+
+        if use_remat:
+            # coarsest activation checkpointing: save only the step inputs,
+            # recompute the forward during backward (recompute meta-optimizer
+            # analog; per-layer policies live in the models themselves)
+            compute_loss = jax.checkpoint(compute_loss)
+
+        def scaled_loss_fn(params_, buffers_, rng, scale, *arrays):
+            loss, new_buffers = compute_loss(params_, buffers_, rng, *arrays)
+            return loss * scale, (loss, new_buffers)
+
+        def train_step(params_, opt_state_, buffers_, extras_, lr, step, rng,
+                       arrays):
+            scale = extras_.get("loss_scale", jnp.float32(1.0))
+            (_, (loss, new_buffers)), grads = jax.value_and_grad(
+                scaled_loss_fn, has_aux=True)(
+                    params_, buffers_, rng, scale, *arrays)
+            if use_scaler:
+                # unscale in fp32 (check_finite_and_unscale analog), back to
+                # the grad's dtype so the update path keeps param dtypes
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g.astype(jnp.float32) / scale).astype(g.dtype),
+                    grads)
+            if zero_stage >= 2:
+                # stage-2: pin grads to the sharded layout so GSPMD lowers the
+                # cross-data reduction as reduce-scatter, not all-reduce
+                grads = {
+                    k: jax.lax.with_sharding_constraint(
+                        g, NamedSharding(mesh, self.grad_specs[k]))
+                    for k, g in grads.items()}
+
+            new_extras = dict(extras_)
+            if use_scaler:
+                finite = jnp.all(jnp.stack([
+                    jnp.all(jnp.isfinite(g))
+                    for g in jax.tree_util.tree_leaves(grads)]))
+                good = jnp.where(finite, extras_["good_steps"] + 1, 0)
+                bad = jnp.where(finite, 0, extras_["bad_steps"] + 1)
+                grow = good >= amp_cfg.incr_every_n_steps
+                shrink = bad >= amp_cfg.decr_every_n_nan_or_inf
+                new_scale = jnp.where(
+                    shrink, jnp.maximum(scale * amp_cfg.decr_ratio, 1.0),
+                    jnp.where(grow, scale * amp_cfg.incr_ratio, scale))
+                new_extras["loss_scale"] = new_scale
+                new_extras["good_steps"] = jnp.where(grow, 0, good)
+                new_extras["bad_steps"] = jnp.where(shrink, 0, bad)
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
+            else:
+                finite = jnp.bool_(True)
+
+            if accum_k > 1:
+                # gradient merge: bank k-1 steps, apply on the k-th
+                # (gradient_merge_optimizer.py:72 cond-gated optimizer)
+                acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g, extras_["accum"], grads)
+                do_apply = (step % accum_k) == 0
+                denom = jnp.float32(accum_k if merge_avg else 1)
+                eff_grads = jax.tree_util.tree_map(
+                    lambda a: a / denom, acc)
+                new_extras["accum"] = jax.tree_util.tree_map(
+                    lambda a: jnp.where(do_apply, jnp.zeros_like(a), a), acc)
+            else:
+                do_apply = jnp.bool_(True)
+                eff_grads = grads
+
+            do_update = jnp.logical_and(do_apply, finite)
+            eff_grads = clip_fn(eff_grads)
+            cand_params, cand_opt = apply_fn(params_, eff_grads, opt_state_,
+                                             lr, step)
+            new_params = _tree_where(do_update, cand_params, params_)
+            new_opt = _tree_where(do_update, cand_opt, opt_state_)
+            return loss, new_params, new_opt, new_buffers, new_extras
+
+        self._train_step_fn = train_step  # exposed for jaxpr/HLO assertions
 
         param_sh = {k: NamedSharding(mesh, s)
                     for k, s in self.param_specs.items()}
@@ -156,10 +344,10 @@ class ShardedTrainStep:
 
         self._jitted = jax.jit(
             train_step,
-            in_shardings=(param_sh, opt_sh, buf_sh, scalar_sh, scalar_sh,
-                          scalar_sh, data_sh),  # data_sh is a tree prefix
-            out_shardings=(scalar_sh, param_sh, opt_sh, buf_sh),
-            donate_argnums=(0, 1, 2) if donate else (),
+            in_shardings=(param_sh, opt_sh, buf_sh, extras_specs, scalar_sh,
+                          scalar_sh, scalar_sh, data_sh),  # data_sh: prefix
+            out_shardings=(scalar_sh, param_sh, opt_sh, buf_sh, extras_specs),
+            donate_argnums=(0, 1, 2, 3) if donate else (),
         )
 
     def __call__(self, *args):
@@ -172,10 +360,20 @@ class ShardedTrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step = jnp.asarray(self._step_count, jnp.int32)
         rng = jax.random.PRNGKey(self._step_count)
-        loss, self._params, self._opt_state, self._buffers = self._jitted(
-            self._params, self._opt_state, self._buffers, lr, step, rng,
-            tuple(arrays))
+        opt_in = (jax.device_put(self._opt_state, self._opt_dev_sh)
+                  if self._offload else self._opt_state)
+        (loss, self._params, opt_out, self._buffers,
+         self._extras) = self._jitted(
+            self._params, opt_in, self._buffers, self._extras, lr,
+            step, rng, tuple(arrays))
+        self._opt_state = (jax.device_put(opt_out, self._opt_host_sh)
+                           if self._offload else opt_out)
         return Tensor(loss)
+
+    @property
+    def loss_scale(self):
+        s = self._extras.get("loss_scale")
+        return None if s is None else float(s)
 
     # ---- state sync back to the eager model (checkpointing etc.) ----
     def sync_to_model(self):
@@ -196,16 +394,21 @@ class ShardedTrainStep:
 
 def parallelize(model: Layer, optimizer=None, mesh: Optional[Mesh] = None,
                 strategy=None, loss_fn=None):
-    """Fleet-facade entry: build a ShardedTrainStep from strategy/topology.
+    """Fleet-facade entry: build a train step from strategy/topology.
 
     (fleet.distributed_model + distributed_optimizer + minimize, compiled.)
+    DistributedStrategy flags are resolved by StrategyCompiler (the
+    meta-optimizer composition analog) and executed by the returned step.
     """
     from ..distributed.topology import get_mesh
+    from ..distributed.fleet.strategy_compiler import StrategyCompiler
     if mesh is None:
         mesh = get_mesh()
     if mesh is None:
         raise ValueError("no mesh: call fleet.init or pass mesh=")
-    if "pipe" in mesh.axis_names and mesh.shape["pipe"] > 1:
+    plan = StrategyCompiler().compile(strategy, optimizer, mesh)
+    if plan.pipeline or ("pipe" in mesh.axis_names
+                         and mesh.shape["pipe"] > 1):
         from .pipeline import PipelinedTrainStep
         if not (hasattr(model, "llama") or hasattr(model, "gpt")):
             raise ValueError(
@@ -219,7 +422,7 @@ def parallelize(model: Layer, optimizer=None, mesh: Optional[Mesh] = None,
             cfg = getattr(strategy, "pipeline_configs", None)
             if cfg is not None and getattr(cfg, "accumulate_steps", 0) >= 1:
                 n_micro = cfg.accumulate_steps
-            if getattr(strategy, "sharding", False):
+            if plan.zero_stage:
                 import warnings
                 warnings.warn(
                     "strategy.sharding (ZeRO) is not composed with the "
@@ -231,12 +434,13 @@ def parallelize(model: Layer, optimizer=None, mesh: Optional[Mesh] = None,
                 "parallelize(pp_degree>1) pipelines causal-LM models with "
                 "their built-in loss head; custom loss_fn is not supported "
                 "on the pipeline path yet")
-        return PipelinedTrainStep(model, optimizer, mesh, n_micro=n_micro)
-    zero_stage = 0
-    if strategy is not None and getattr(strategy, "sharding", False):
-        zero_stage = strategy.sharding_configs.stage
-    elif strategy is not None and \
-            strategy.hybrid_configs.sharding_degree > 1:
-        zero_stage = 1
+        return PipelinedTrainStep(model, plan.optimizer or optimizer, mesh,
+                                  n_micro=n_micro)
+    if plan.localsgd_k:
+        from .localsgd import LocalSGDTrainStep
+        return LocalSGDTrainStep(model, plan.optimizer or optimizer, mesh,
+                                 k_steps=plan.localsgd_k,
+                                 begin_step=plan.localsgd_begin,
+                                 loss_fn=loss_fn)
     return ShardedTrainStep(model, optimizer, mesh, loss_fn=loss_fn,
-                            zero_stage=zero_stage)
+                            plan=plan)
