@@ -53,15 +53,29 @@ class DataParallel(Layer):
                      if p.grad is not None]
         if not with_grad:
             return
-        flat = jnp.concatenate(
-            [p.grad.data.astype(jnp.float32).reshape(-1) for p in with_grad])
-        mean = jnp.mean(multihost_utils.process_allgather(flat), axis=0)
-        offset = 0
+        # comm_buffer_size-MB buckets (reference default 25MB): bounds the
+        # transient (P, bucket) gather to bucket_bytes x process_count
+        bucket_elems = max(int(self.comm_buffer_size * 1024 * 1024 // 4), 1)
+        bucket, bucket_n = [], 0
+        buckets = []
         for p in with_grad:
-            n = p.grad.data.size
-            p.grad.data = mean[offset:offset + n].reshape(
-                p.grad.data.shape).astype(p.grad.data.dtype)
-            offset += n
+            bucket.append(p)
+            bucket_n += p.grad.data.size
+            if bucket_n >= bucket_elems:
+                buckets.append(bucket)
+                bucket, bucket_n = [], 0
+        if bucket:
+            buckets.append(bucket)
+        for group in buckets:
+            flat = jnp.concatenate(
+                [p.grad.data.astype(jnp.float32).reshape(-1) for p in group])
+            mean = jnp.mean(multihost_utils.process_allgather(flat), axis=0)
+            offset = 0
+            for p in group:
+                n = p.grad.data.size
+                p.grad.data = mean[offset:offset + n].reshape(
+                    p.grad.data.shape).astype(p.grad.data.dtype)
+                offset += n
 
     # passthrough conveniences
     def state_dict(self, *args, **kwargs):

@@ -1,0 +1,201 @@
+"""Elastic membership management (reference: fleet/elastic.py:90 —
+ElasticManager registers hosts in etcd, watches for scale-in/out, rewrites
+PADDLE_TRAINER_ENDPOINTS and relaunches the local trainers).
+
+etcd-free TPU redesign: membership lives in the launcher's own KV server
+(fleet/utils/http_server.py) hosted by node 0. Every node heartbeats its
+endpoint under /elastic/node/<idx>; the manager polls the full membership,
+and a change (join, leave, heartbeat expiry) triggers an endpoint rewrite +
+relaunch. Training state survives through checkpoint auto-resume
+(paddle_tpu.checkpoint), which is the same recovery contract as the
+reference's auto_checkpoint + relaunch."""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+def _text(v):
+    """KV values arrive as bytes (_LocalKV) or str (HTTP KVClient)."""
+    return v.decode() if isinstance(v, bytes) else v
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class _LocalKV:
+    """In-process KV with the KVClient interface (tests / single host)."""
+
+    def __init__(self):
+        self._kv: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key, value):
+        with self._lock:
+            self._kv[key] = value if isinstance(value, bytes) else \
+                value.encode()
+
+    def get(self, key):
+        with self._lock:
+            v = self._kv.get(key)
+        return v
+
+    def delete(self, key):
+        with self._lock:
+            self._kv.pop(key, None)
+
+    def keys(self, prefix):
+        with self._lock:
+            return [k for k in self._kv if k.startswith(prefix)]
+
+
+class ElasticManager:
+    """Membership watcher (elastic.py:90 analog).
+
+    kv: a KVClient-like object (put/get/delete); node 0 usually runs the
+    KVServer. heartbeat entries carry a timestamp; entries older than
+    `timeout` count as dead (etcd lease-TTL analog).
+    """
+
+    PREFIX = "/elastic/node/"
+
+    def __init__(self, host_endpoint: str, kv=None, np_range=(1, None),
+                 timeout: float = 10.0,
+                 on_restart: Optional[Callable[[List[str]], None]] = None):
+        self.endpoint = host_endpoint
+        self.kv = kv if kv is not None else _LocalKV()
+        self.min_np, self.max_np = np_range
+        self.timeout = timeout
+        self.on_restart = on_restart
+        self.hosts: List[str] = []
+        self._beat_stop = threading.Event()
+        self._beat_thread: Optional[threading.Thread] = None
+
+    # ---- membership registry ----
+    def register(self, retry_window: float = 30.0):
+        """First contact retries while the KV host (node 0) is still coming
+        up — peers race the server's start."""
+        deadline = time.time() + retry_window
+        while True:
+            try:
+                self._heartbeat_once()
+                self._merge_roster()
+                break
+            except Exception:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.5)
+        self._beat_thread = threading.Thread(target=self._beat_loop,
+                                             daemon=True)
+        self._beat_thread.start()
+
+    def _merge_roster(self):
+        """HTTP KV has no key listing: nodes co-maintain a roster key
+        (read-merge-write; last-writer-wins races self-heal on the next
+        heartbeat since every node re-merges itself)."""
+        if hasattr(self.kv, "keys"):
+            return
+        raw = self.kv.get(self.PREFIX + "_roster")
+        hosts = set(_text(raw).split(",")) - {""} if raw else set()
+        if self.endpoint not in hosts:
+            hosts.add(self.endpoint)
+            self.kv.put(self.PREFIX + "_roster",
+                        ",".join(sorted(hosts)).encode())
+
+    def _heartbeat_once(self):
+        self.kv.put(self.PREFIX + self.endpoint,
+                    f"{time.time()}".encode())
+
+    def _beat_loop(self):
+        while not self._beat_stop.wait(self.timeout / 3):
+            try:
+                self._heartbeat_once()
+                self._merge_roster()
+            except Exception:
+                pass  # transient KV outage; next beat retries
+
+    def deregister(self):
+        self._beat_stop.set()
+        try:
+            self.kv.delete(self.PREFIX + self.endpoint)
+            if not hasattr(self.kv, "keys"):
+                # drop ourselves from the co-maintained roster so polls don't
+                # probe dead entries forever
+                raw = self.kv.get(self.PREFIX + "_roster")
+                hosts = set(_text(raw).split(",")) - {"", self.endpoint} \
+                    if raw else set()
+                self.kv.put(self.PREFIX + "_roster",
+                            ",".join(sorted(hosts)).encode())
+        except Exception:
+            pass  # the KV host may already be gone during teardown
+
+    def alive_hosts(self) -> List[str]:
+        """Endpoints with a fresh heartbeat, sorted for stable rank order."""
+        now = time.time()
+        out = []
+        for key in self._keys():
+            raw = self.kv.get(key)
+            if raw is None:
+                continue
+            try:
+                ts = float(_text(raw))
+            except ValueError:
+                continue
+            if now - ts <= self.timeout:
+                out.append(key[len(self.PREFIX):])
+        return sorted(out)
+
+    def _keys(self):
+        if hasattr(self.kv, "keys"):
+            return self.kv.keys(self.PREFIX)
+        # HTTP KVClient has no listing; nodes mirror the roster under a
+        # well-known key maintained by node 0
+        raw = self.kv.get(self.PREFIX + "_roster")
+        if not raw:
+            return []
+        return [self.PREFIX + h for h in _text(raw).split(",") if h]
+
+    # ---- watch loop (elastic.py watch + _update_hosts analog) ----
+    def watch_once(self) -> str:
+        """One poll: compare live membership to the last seen roster."""
+        alive = self.alive_hosts()
+        if not alive:
+            return ElasticStatus.HOLD
+        if self.max_np and len(alive) > self.max_np:
+            alive = alive[:self.max_np]
+        if len(alive) < self.min_np:
+            return ElasticStatus.HOLD  # wait for enough nodes to join
+        if not self.hosts:
+            self.hosts = alive
+            self._update_env(alive)  # pod must start with the real world
+            return ElasticStatus.COMPLETED
+        if alive != self.hosts:
+            old = self.hosts
+            self.hosts = alive
+            self._update_env(alive)
+            if self.on_restart is not None:
+                self.on_restart(alive)
+            import sys
+            print(f"[elastic] membership changed {old} -> {alive}; "
+                  "relaunching", file=sys.stderr)
+            return ElasticStatus.RESTART
+        return ElasticStatus.COMPLETED
+
+    def _update_env(self, hosts: List[str]):
+        """Rewrite the reference env contract for the new world
+        (elastic.py _update_hosts:246)."""
+        import os
+        os.environ["PADDLE_TRAINER_ENDPOINTS"] = ",".join(hosts)
+        os.environ["PADDLE_TRAINERS_NUM"] = str(len(hosts))
+        if self.endpoint in hosts:
+            os.environ["PADDLE_TRAINER_ID"] = str(hosts.index(self.endpoint))
+
+    def rank(self) -> int:
+        return self.hosts.index(self.endpoint) if self.endpoint in self.hosts \
+            else -1

@@ -16,6 +16,7 @@ from ..topology import (CommunicateTopology, HybridCommunicateGroup,
                         ParallelMode, set_hybrid_communicate_group,
                         get_hybrid_communicate_group)
 from .. import meta_parallel as mp
+from . import metrics  # noqa: F401
 from . import utils  # noqa: F401
 
 

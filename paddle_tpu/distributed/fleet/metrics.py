@@ -1,0 +1,102 @@
+"""Distributed metric aggregation (reference: fleet/metrics/metric.py —
+sum/max/min/acc/mae/rmse/auc computed over a c_allreduce of local stats).
+
+TPU-native reduction tiers, chosen automatically:
+- inside a shard_map axis context: lax collectives over the mapped axes
+  (the in-graph path, e.g. metrics computed inside a step function);
+- multi-process (jax.distributed): one host-level gather via
+  multihost_utils (the reference's trainer-to-trainer allreduce);
+- single process: identity (SPMD values are already global).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..collective import current_axes, in_axis_context
+
+
+def _is_traced(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _to_array(x):
+    from ...core.tensor import Tensor
+    if isinstance(x, Tensor):
+        return x.data
+    return x
+
+
+def _reduce(value, mode: str):
+    value = _to_array(value)
+    if in_axis_context() or _is_traced(value):
+        op = {"sum": jax.lax.psum, "max": jax.lax.pmax,
+              "min": jax.lax.pmin}[mode]
+        out = value
+        for ax in current_axes():
+            out = op(out, ax)
+        return out
+    arr = np.asarray(value)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        gathered = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray(arr)))
+        red = {"sum": np.sum, "max": np.max, "min": np.min}[mode]
+        return red(gathered, axis=0)
+    return arr
+
+
+def sum(input, scope=None, util=None):  # noqa: A001 (reference name)
+    """Global element-wise sum of a local stat (metric.py sum)."""
+    return _reduce(input, "sum")
+
+
+def max(input, scope=None, util=None):  # noqa: A001
+    return _reduce(input, "max")
+
+
+def min(input, scope=None, util=None):  # noqa: A001
+    return _reduce(input, "min")
+
+
+def acc(correct, total, scope=None, util=None):
+    """Global accuracy from local (correct, total) counters."""
+    c = _reduce(correct, "sum")
+    t = _reduce(total, "sum")
+    return np.float64(c) / np.float64(t) if not _is_traced(c) else c / t
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    e = _reduce(abserr, "sum")
+    n = _reduce(total_ins_num, "sum")
+    return np.float64(e) / np.float64(n) if not _is_traced(e) else e / n
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    e = _reduce(sqrerr, "sum")
+    n = _reduce(total_ins_num, "sum")
+    return np.float64(e) / np.float64(n) if not _is_traced(e) else e / n
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    return np.sqrt(mse(sqrerr, total_ins_num))
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from per-rank positive/negative prediction-bucket counts
+    (metric.py auc: allreduce both histograms, then trapezoidal sweep)."""
+    pos = np.asarray(_reduce(stat_pos, "sum"), np.float64).ravel()
+    neg = np.asarray(_reduce(stat_neg, "sum"), np.float64).ravel()
+    # sweep buckets from highest score to lowest: standard rank-sum AUC
+    area = 0.0
+    tp = fp = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_tp = tp + pos[i]
+        new_fp = fp + neg[i]
+        area += (new_fp - fp) * (tp + new_tp) / 2.0
+        tp, fp = new_tp, new_fp
+    if tp == 0 or fp == 0:
+        return 0.5
+    return float(area / (tp * fp))

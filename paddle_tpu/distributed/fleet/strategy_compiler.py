@@ -141,9 +141,19 @@ class StrategyCompiler:
 
     @staticmethod
     def _to_lars(optimizer, cfg):
-        """Momentum → LarsMomentum keeping lr/params (lars_optimizer.py)."""
-        from ...optimizer.optimizer import LarsMomentum, Momentum
+        """Momentum → LarsMomentum keeping lr/params (lars_optimizer.py:
+        like the reference meta-optimizer, applies ONLY to Momentum — other
+        optimizers pass through with a warning, never a silent algorithm
+        swap)."""
+        from ...optimizer.optimizer import LarsMomentum, Momentum, SGD
         if isinstance(optimizer, LarsMomentum):
+            return optimizer
+        if not isinstance(optimizer, (Momentum, SGD)):
+            import warnings
+            warnings.warn(
+                f"strategy.lars applies to Momentum/SGD, not "
+                f"{type(optimizer).__name__}; keeping the user optimizer",
+                stacklevel=3)
             return optimizer
         momentum = getattr(optimizer, "_momentum", 0.9)
         return LarsMomentum(
@@ -155,9 +165,17 @@ class StrategyCompiler:
 
     @staticmethod
     def _to_lamb(optimizer, cfg):
-        """Adam-family → Lamb keeping lr/params (lamb_optimizer.py)."""
-        from ...optimizer.optimizer import Lamb
+        """Adam-family → Lamb keeping lr/params (lamb_optimizer.py; only
+        Adam-family optimizers are converted, mirroring the reference)."""
+        from ...optimizer.optimizer import Adam, Lamb
         if isinstance(optimizer, Lamb):
+            return optimizer
+        if not isinstance(optimizer, Adam):
+            import warnings
+            warnings.warn(
+                f"strategy.lamb applies to Adam-family optimizers, not "
+                f"{type(optimizer).__name__}; keeping the user optimizer",
+                stacklevel=3)
             return optimizer
         exclude = set(cfg.exclude_from_weight_decay or [])
         fn = (lambda p: any(e in (p.name or "") for e in exclude)) \

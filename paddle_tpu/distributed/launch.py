@@ -81,6 +81,14 @@ def parse_args(argv=None):
     p.add_argument("--log_dir", type=str, default=None)
     p.add_argument("--max_restarts", type=int, default=0,
                    help="elastic: restart failed workers this many times")
+    p.add_argument("--elastic", action="store_true",
+                   help="multi-host membership watch: rewrite endpoints and "
+                        "relaunch on node join/leave (elastic.py analog, "
+                        "KV-server-backed instead of etcd)")
+    p.add_argument("--np", type=str, default=None,
+                   help="elastic min[:max] node count, e.g. 2 or 2:4")
+    p.add_argument("--elastic_timeout", type=float, default=10.0,
+                   help="heartbeat expiry (seconds) for membership")
     p.add_argument("--devices", type=str, default=None,
                    help="accepted for reference-CLI parity; ignored (XLA "
                         "owns device selection)")
@@ -123,6 +131,101 @@ def watch_local_trainers(pods, max_restarts):
                 return failed[0].returncode() or 1
 
 
+def _parse_np(spec, default_n):
+    if not spec:
+        return (1, default_n)
+    parts = spec.split(":")
+    lo = int(parts[0])
+    hi = int(parts[1]) if len(parts) > 1 else None
+    return (lo, hi)
+
+
+def _elastic_host_loop(args, endpoints, rank, script_args):
+    """Membership-watched per-host worker (elastic.py:294-327 analog):
+    node 0 hosts the KV, every node heartbeats, a membership change kills
+    the local trainer and respawns it with rewritten endpoints; training
+    state returns via checkpoint auto-resume."""
+    from .elastic import ElasticManager, ElasticStatus
+    from .fleet.utils.http_server import KVClient, KVServer
+
+    me = endpoints[rank]
+    host0, port0 = endpoints[0].rsplit(":", 1)
+    kv_port = int(port0) + 1000
+    server = KVServer(kv_port) if rank == 0 else None
+    if server is not None:
+        server.start()
+    kv = KVClient(f"{host0}:{kv_port}")
+    mgr = ElasticManager(me, kv=kv,
+                         np_range=_parse_np(args.np, len(endpoints)),
+                         timeout=args.elastic_timeout)
+    mgr.register()
+    # settle initial membership: give slow-starting peers (python import
+    # time) a generous window before proceeding with whoever showed up
+    deadline = time.time() + max(args.elastic_timeout * 4, 15.0)
+    while time.time() < deadline and len(mgr.alive_hosts()) < len(endpoints):
+        time.sleep(0.2)
+    # never start a pod below min_np: HOLD until membership forms (a pod
+    # started in a too-small world would not be relaunched on first join,
+    # since the first hosts assignment is COMPLETED, not RESTART)
+    while mgr.watch_once() == ElasticStatus.HOLD:
+        time.sleep(0.5)
+    hosts = mgr.hosts
+    if me not in hosts:
+        print("[elastic] this node was truncated out by --np max; exiting",
+              file=sys.stderr)
+        mgr.deregister()
+        return 0
+
+    restarts = 0
+    pod = Pod(hosts.index(me), hosts, args.training_script, script_args,
+              args.log_dir, {})
+    pod.start()
+    try:
+        while True:
+            time.sleep(0.5)
+            rc = pod.returncode()
+            if rc == 0:
+                return 0
+            if rc not in (None, 0):
+                # a peer death usually surfaces here FIRST (collective error
+                # kills the trainer before the peer's heartbeat expires):
+                # wait out one heartbeat window so the membership watch can
+                # rewrite the world, and only charge max_restarts when the
+                # membership did NOT change (a genuine local crash)
+                deadline = time.time() + args.elastic_timeout + 1.0
+                changed = False
+                while time.time() < deadline:
+                    if mgr.watch_once() == ElasticStatus.RESTART:
+                        changed = True
+                        break
+                    time.sleep(0.5)
+                if not changed:
+                    if restarts >= args.max_restarts:
+                        return rc
+                    restarts += 1
+                    print(f"[elastic] worker failed rc={rc}; restart "
+                          f"{restarts}/{args.max_restarts}", file=sys.stderr)
+                hosts = mgr.hosts
+                if me not in hosts:
+                    return 0
+                pod = Pod(hosts.index(me), hosts, args.training_script,
+                          script_args, args.log_dir, {})
+                pod.start()
+                continue
+            if mgr.watch_once() == ElasticStatus.RESTART:
+                pod.terminate()
+                hosts = mgr.hosts
+                if me not in hosts:
+                    return 0  # this node was scaled out
+                pod = Pod(hosts.index(me), hosts, args.training_script,
+                          script_args, args.log_dir, {})
+                pod.start()
+    finally:
+        mgr.deregister()
+        if server is not None:
+            server.stop()
+
+
 def launch(argv=None):
     args = parse_args(argv)
     endpoints = get_cluster(args)
@@ -133,6 +236,8 @@ def launch(argv=None):
     if args.hosts:
         # multi-host: this process IS the single per-host worker
         rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        if args.elastic:
+            sys.exit(_elastic_host_loop(args, endpoints, rank, script_args))
         pod = Pod(rank, endpoints, args.training_script, script_args,
                   args.log_dir, {})
         pod.start()

@@ -4,7 +4,9 @@ AnalysisConfig, zero-copy tensors).
 TPU-native serving: "analysis passes" are XLA's job, so export = trace the model
 once and serialize the StableHLO module (jax.export); serve = deserialize + call
 the compiled executable with zero host copies (device arrays in/out). The C++
-predictor (csrc/) consumes the same artifact via the PJRT C API.
+predictor (csrc/predictor/predictor.cc) consumes the sibling artifacts —
+<prefix>.mlir (StableHLO bytecode), .copts.pb (CompileOptionsProto) and
+.pdweights (flat tensors in traced-arg order) — via the PJRT C API.
 
 API parity:
     config = Config(model_dir)            # AnalysisConfig analog
@@ -47,15 +49,58 @@ def export_model(layer: Layer, example_inputs, path: str):
         f.write(exported.serialize())
     from ..framework_io import save as _save
     _save({"params": params, "buffers": buffers}, path + ".pdiparams")
+
+    # --- C++ predictor artifacts (csrc/predictor consumes these) ---
+    # raw StableHLO portable bytecode: PJRT_Client_Compile format "mlir"
+    with open(path + ".mlir", "wb") as f:
+        f.write(exported.mlir_module_serialized)
+    # serialized CompileOptionsProto (built here so the C++ side needs no
+    # protobuf dependency)
+    from jax._src import compiler as _jax_compiler
+    with open(path + ".copts.pb", "wb") as f:
+        f.write(_jax_compiler.get_compile_options(
+            num_replicas=1, num_partitions=1).SerializeAsString())
+    # flat little-endian weights in traced argument order
+    weight_leaves = jax.tree_util.tree_leaves((params, buffers))
+    _write_weights(path + ".pdweights", weight_leaves)
+
     meta = {
-        "inputs": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+        "inputs": [{"shape": list(a.shape), "dtype": str(a.dtype),
+                    "pjrt_type": _PJRT_TYPE[str(a.dtype)]}
                    for a in arrays],
         "input_names": [f"x{i}" for i in range(len(arrays))],
         "output_names": ["output"],
+        "n_weights": len(weight_leaves),
     }
     with open(path + ".pdmodel.json", "w") as f:
         json.dump(meta, f)
     return path
+
+
+# PJRT_Buffer_Type enum values (pjrt_c_api.h:853-913)
+_PJRT_TYPE = {
+    "bool": 1, "int8": 2, "int16": 3, "int32": 4, "int64": 5,
+    "uint8": 6, "uint16": 7, "uint32": 8, "uint64": 9,
+    "float16": 10, "float32": 11, "float64": 12, "bfloat16": 13,
+}
+
+
+def _write_weights(path: str, leaves):
+    """Binary weights: magic 'PDW1', u32 count; per tensor u32 pjrt_type,
+    u32 ndim, u64 dims[], u64 nbytes, raw bytes (little-endian, row-major)."""
+    import struct
+    with open(path, "wb") as f:
+        f.write(b"PDW1")
+        f.write(struct.pack("<I", len(leaves)))
+        for a in leaves:
+            arr = np.asarray(a)
+            code = _PJRT_TYPE[str(arr.dtype)]
+            raw = arr.tobytes()
+            f.write(struct.pack("<II", code, arr.ndim))
+            f.write(struct.pack(f"<{arr.ndim}q", *arr.shape)
+                    if arr.ndim else b"")
+            f.write(struct.pack("<Q", len(raw)))
+            f.write(raw)
 
 
 class Config:

@@ -96,7 +96,7 @@ def _apply_mask_block(s, mask_ref, causal, block_q, block_k, q_start, k_start,
 
 
 def _fwd_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
-                has_mask, dropout_p, seed, n_qb, n_kb):
+                has_mask, dropout_p, n_qb, n_kb):
     """Grid (batch*heads, q_blocks, k_blocks), k innermost; online-softmax
     state in VMEM scratch across the k steps of one (bh, qi) cell."""
     i = 3
@@ -160,7 +160,7 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
 
 
 def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
-                   has_mask, dropout_p, seed, n_qb, n_kb):
+                   has_mask, dropout_p, n_qb, n_kb):
     """Grid (bh, q_blocks, k_blocks): accumulate dq for one q block."""
     i = 6
     q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref = refs[:6]
@@ -211,7 +211,7 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
-                    has_mask, dropout_p, seed, n_qb, n_kb):
+                    has_mask, dropout_p, n_qb, n_kb):
     """Grid (bh, k_blocks, q_blocks): accumulate dk/dv for one k block."""
     i = 6
     q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref = refs[:6]
@@ -307,7 +307,7 @@ def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k, dropout_p,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
         causal_offset=Sk - Sq, has_mask=mask is not None,
-        dropout_p=dropout_p, seed=seed, n_qb=n_qb, n_kb=n_kb)
+        dropout_p=dropout_p, n_qb=n_qb, n_kb=n_kb)
     in_specs = [
         pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
@@ -361,7 +361,7 @@ def _flash_bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
     interp = jax.default_backend() == "cpu"
     common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
                   causal_offset=Sk - Sq, has_mask=mask is not None,
-                  dropout_p=dropout_p, seed=seed, n_qb=n_qb, n_kb=n_kb)
+                  dropout_p=dropout_p, n_qb=n_qb, n_kb=n_kb)
 
     base_specs_q = [
         pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),   # q

@@ -232,6 +232,8 @@ class ShardedTrainStep:
                 for k, v in params.items()}
             extras_specs["accum"] = {
                 k: NamedSharding(mesh, self.grad_specs[k]) for k in params}
+            extras["accum_n"] = put(jnp.asarray(0, jnp.int32), P())
+            extras_specs["accum_n"] = NamedSharding(mesh, P())
         if use_scaler:
             extras["loss_scale"] = put(
                 jnp.asarray(amp_cfg.init_loss_scaling, jnp.float32), P())
@@ -311,20 +313,30 @@ class ShardedTrainStep:
 
             if accum_k > 1:
                 # gradient merge: bank k-1 steps, apply on the k-th
-                # (gradient_merge_optimizer.py:72 cond-gated optimizer)
+                # (gradient_merge_optimizer.py:72 cond-gated optimizer).
+                # accum_n counts banked micro-steps so an overflow-carried
+                # window averages over the TRUE number of banked grads, not
+                # the nominal k
                 acc = jax.tree_util.tree_map(
                     lambda a, g: a + g, extras_["accum"], grads)
+                acc_n = extras_["accum_n"] + jnp.where(finite, 1, 0)
                 do_apply = (step % accum_k) == 0
-                denom = jnp.float32(accum_k if merge_avg else 1)
+                denom = (jnp.maximum(acc_n, 1).astype(jnp.float32)
+                         if merge_avg else jnp.float32(1))
                 eff_grads = jax.tree_util.tree_map(
                     lambda a: a / denom, acc)
-                new_extras["accum"] = jax.tree_util.tree_map(
-                    lambda a: jnp.where(do_apply, jnp.zeros_like(a), a), acc)
             else:
                 do_apply = jnp.bool_(True)
                 eff_grads = grads
 
             do_update = jnp.logical_and(do_apply, finite)
+            if accum_k > 1:
+                # clear only when the update actually applied: an fp16
+                # overflow on the k-th step must not discard the k-1 banked
+                # micro-gradients (they re-apply at the next boundary)
+                new_extras["accum"] = jax.tree_util.tree_map(
+                    lambda a: jnp.where(do_update, jnp.zeros_like(a), a), acc)
+                new_extras["accum_n"] = jnp.where(do_update, 0, acc_n)
             eff_grads = clip_fn(eff_grads)
             cand_params, cand_opt = apply_fn(params_, eff_grads, opt_state_,
                                              lr, step)

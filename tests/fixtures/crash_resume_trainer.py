@@ -1,0 +1,65 @@
+"""Fixture for the elastic-restart test: trains 6 steps with step-level
+checkpointing; on the FIRST attempt it crashes hard at step 3. The launcher's
+--max_restarts respawns it; the retry must resume from the checkpoint (not
+step 0) and finish. Writes a JSON report for the parent test."""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+
+WORKDIR = sys.argv[1]
+MARKER = os.path.join(WORKDIR, "attempted")
+CKPT = os.path.join(WORKDIR, "ckpt")
+REPORT = os.path.join(WORKDIR, "report.json")
+
+
+def main():
+    paddle.seed(0)
+    model = nn.Linear(4, 4)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+
+    start_step = 0
+    if os.path.exists(CKPT + ".pdparams"):
+        state = paddle.load(CKPT + ".pdparams")
+        model.set_state_dict(state["model"])
+        start_step = int(state["step"])
+
+    first_attempt = not os.path.exists(MARKER)
+    with open(MARKER, "a") as f:
+        f.write("x\n")
+
+    steps_this_run = []
+    for step in range(start_step, 6):
+        loss = nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        paddle.save({"model": model.state_dict(), "step": step + 1},
+                    CKPT + ".pdparams")
+        steps_this_run.append(step)
+        if first_attempt and step == 2:
+            os._exit(17)  # simulated hard crash mid-training
+
+    with open(REPORT, "w") as f:
+        json.dump({"resumed_from": start_step,
+                   "steps_this_run": steps_this_run,
+                   "attempts": sum(1 for _ in open(MARKER))}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Trainer fixture for the TestDistBase analog (reference:
+python/paddle/fluid/tests/unittests/test_dist_base.py — dist_mnist.py style).
+
+Reads PADDLE_TRAINER_* env (the launch.py contract), initializes
+jax.distributed when world > 1, trains a deterministic MLP on its batch shard
+with eager DataParallel gradient sync, and prints one JSON line with the loss
+trajectory so the parent test can assert 1-proc vs N-proc parity.
+"""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+from paddle_tpu.distributed.data_parallel import DataParallel  # noqa: E402
+from paddle_tpu.distributed.parallel_env import (get_rank, get_world_size,
+                                                 init_parallel_env)  # noqa: E402
+
+
+def main():
+    init_parallel_env()
+    rank, world = get_rank(), get_world_size()
+
+    paddle.seed(0)  # identical init on every rank
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model = DataParallel(model)
+    opt = optimizer.SGD(learning_rate=0.1,
+                        parameters=model.parameters())
+
+    rng = np.random.RandomState(7)  # identical dataset on every rank
+    X = rng.randn(16, 8).astype(np.float32)
+    Y = rng.randn(16, 4).astype(np.float32)
+
+    losses = []
+    for step in range(5):
+        xs = X[rank::world]  # deterministic shard
+        ys = Y[rank::world]
+        out = model(paddle.to_tensor(xs))
+        loss = nn.functional.mse_loss(out, paddle.to_tensor(ys))
+        loss.backward()
+        model.apply_collective_grads()  # reducer parity: mean over ranks
+        opt.step()
+        opt.clear_grad()
+        # report the FULL-batch loss so 1-proc and N-proc trajectories are
+        # directly comparable (per-shard losses differ by construction)
+        with paddle.no_grad():
+            full = nn.functional.mse_loss(
+                model(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        losses.append(float(full.item()))
+
+    w = model.parameters()[0].numpy()
+    print(json.dumps({"rank": rank, "world": world, "losses": losses,
+                      "w_sum": float(np.abs(w).sum())}))
+
+
+if __name__ == "__main__":
+    main()

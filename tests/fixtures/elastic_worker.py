@@ -1,0 +1,20 @@
+"""Fixture for the elastic-launcher e2e test: under the ORIGINAL 2-node
+membership it waits (simulating training that can't finish while a peer is
+wedged); after the elastic manager detects the dead peer and relaunches with
+a rewritten 1-node world, it completes."""
+import json
+import os
+import sys
+import time
+
+world = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+out = sys.argv[1]
+
+with open(out, "a") as f:
+    f.write(json.dumps({"world": world, "rank": rank,
+                        "endpoints": os.getenv("PADDLE_TRAINER_ENDPOINTS")})
+            + "\n")
+
+if world > 1:
+    time.sleep(120)  # wait out the membership change; manager will kill us

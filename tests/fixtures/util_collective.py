@@ -1,0 +1,34 @@
+"""Fixture: fleet.util process-level collectives across real processes
+(reference collective-op test pattern, test_collective_base.py)."""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from paddle_tpu.distributed import fleet  # noqa: E402
+from paddle_tpu.distributed.parallel_env import get_rank  # noqa: E402
+
+
+def main():
+    fleet.init(is_collective=True)
+    rank = get_rank()
+    util = fleet.fleet().util
+    total = util.all_reduce(np.asarray(float(rank + 1)), mode="sum")
+    gathered = util.all_gather(np.asarray(float(rank + 1)))
+    print(json.dumps({
+        "rank": rank,
+        "sum": float(np.asarray(total)),
+        "gathered": [float(np.asarray(g)) for g in gathered]}))
+
+
+if __name__ == "__main__":
+    main()

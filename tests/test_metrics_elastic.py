@@ -1,0 +1,225 @@
+"""fleet.metrics distributed aggregation + elastic membership management."""
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.distributed.elastic import (ElasticManager, ElasticStatus,
+                                            _LocalKV)
+from paddle_tpu.distributed.fleet import metrics
+
+
+# ---- metrics ----
+
+def test_metrics_identity_single_process():
+    assert float(metrics.sum(np.asarray([1.0, 2.0])).sum()) == 3.0
+    assert float(metrics.acc(np.asarray(8.0), np.asarray(10.0))) == 0.8
+    assert float(metrics.mae(np.asarray(5.0), np.asarray(10.0))) == 0.5
+    np.testing.assert_allclose(
+        float(metrics.rmse(np.asarray(40.0), np.asarray(10.0))), 2.0)
+
+
+def test_metrics_reduce_inside_mesh():
+    """psum-backed reduction over shard_map axes — the 8-mesh parity test."""
+    from paddle_tpu.distributed.collective import axis_context
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+
+    def f(local):
+        with axis_context(("data",)):
+            s = metrics.sum(local)
+            m = metrics.max(local)
+            a = metrics.acc(local, jnp.ones_like(local))
+        return s, m, a
+
+    local = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+    s, m, a = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False))(
+        local)
+    assert float(np.asarray(s).ravel()[0]) == 28.0   # sum 0..7
+    assert float(np.asarray(m).ravel()[0]) == 7.0
+    # acc = psum(correct)/psum(total) = 28/8
+    np.testing.assert_allclose(float(np.asarray(a).ravel()[0]), 3.5)
+
+
+def test_metrics_auc_matches_direct_computation():
+    rng = np.random.RandomState(0)
+    scores = rng.rand(2000)
+    labels = (rng.rand(2000) < scores).astype(int)  # informative scores
+    n_bins = 256
+    idx = np.minimum((scores * n_bins).astype(int), n_bins - 1)
+    pos = np.bincount(idx[labels == 1], minlength=n_bins)
+    neg = np.bincount(idx[labels == 0], minlength=n_bins)
+    auc = metrics.auc(pos.astype(float), neg.astype(float))
+    # rank-sum AUC computed directly
+    order = np.argsort(scores, kind="stable")
+    ranks = np.empty_like(order, dtype=float)
+    ranks[order] = np.arange(1, len(scores) + 1)
+    n_pos = labels.sum()
+    n_neg = len(labels) - n_pos
+    direct = (ranks[labels == 1].sum() - n_pos * (n_pos + 1) / 2) / (
+        n_pos * n_neg)
+    np.testing.assert_allclose(auc, direct, atol=0.01)  # binned vs exact
+    assert auc > 0.7  # scores are informative
+
+
+# ---- elastic ----
+
+@pytest.fixture(autouse=True)
+def _restore_paddle_env():
+    """ElasticManager rewrites PADDLE_TRAINER_* by design (launcher context);
+    keep it from leaking into other tests' fleet.init."""
+    import os
+    keys = ("PADDLE_TRAINER_ENDPOINTS", "PADDLE_TRAINERS_NUM",
+            "PADDLE_TRAINER_ID", "PADDLE_CURRENT_ENDPOINT")
+    saved = {k: os.environ.get(k) for k in keys}
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _register(kv, endpoint, age=0.0):
+    kv.put(ElasticManager.PREFIX + endpoint,
+           f"{time.time() - age}".encode())
+
+
+def test_elastic_initial_membership_and_rank():
+    kv = _LocalKV()
+    mgr = ElasticManager("h1:80", kv=kv, timeout=5.0)
+    _register(kv, "h0:80")
+    _register(kv, "h1:80")
+    assert mgr.watch_once() == ElasticStatus.COMPLETED
+    assert mgr.hosts == ["h0:80", "h1:80"]
+    assert mgr.rank() == 1
+
+
+def test_elastic_scale_in_rewrites_env_and_restarts(monkeypatch):
+    import os
+    kv = _LocalKV()
+    relaunched = []
+    mgr = ElasticManager("h0:80", kv=kv, timeout=5.0,
+                         on_restart=relaunched.append)
+    _register(kv, "h0:80")
+    _register(kv, "h1:80")
+    assert mgr.watch_once() == ElasticStatus.COMPLETED
+    # h1's heartbeat expires (node died)
+    _register(kv, "h1:80", age=60.0)
+    _register(kv, "h0:80")
+    assert mgr.watch_once() == ElasticStatus.RESTART
+    assert mgr.hosts == ["h0:80"]
+    assert relaunched == [["h0:80"]]
+    assert os.environ["PADDLE_TRAINER_ENDPOINTS"] == "h0:80"
+    assert os.environ["PADDLE_TRAINERS_NUM"] == "1"
+    assert os.environ["PADDLE_TRAINER_ID"] == "0"
+
+
+def test_elastic_scale_out_detected():
+    kv = _LocalKV()
+    mgr = ElasticManager("h0:80", kv=kv, timeout=5.0)
+    _register(kv, "h0:80")
+    assert mgr.watch_once() == ElasticStatus.COMPLETED
+    _register(kv, "h2:80")  # a node joins
+    assert mgr.watch_once() == ElasticStatus.RESTART
+    assert mgr.hosts == ["h0:80", "h2:80"]
+
+
+def test_elastic_holds_below_min_np():
+    kv = _LocalKV()
+    mgr = ElasticManager("h0:80", kv=kv, np_range=(2, None), timeout=5.0)
+    _register(kv, "h0:80")
+    assert mgr.watch_once() == ElasticStatus.HOLD  # waiting for node 2
+    _register(kv, "h1:80")
+    assert mgr.watch_once() == ElasticStatus.COMPLETED
+
+
+def test_elastic_launcher_relaunches_on_scale_in(tmp_path):
+    """e2e: two --elastic launchers; node 1 dies; node 0's membership watch
+    rewrites endpoints to a 1-node world and relaunches its worker, which
+    then completes."""
+    import json
+    import os
+    import signal
+    import socket
+    import subprocess
+    import sys as _sys
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(REPO, "tests", "fixtures", "elastic_worker.py")
+    ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    hosts = f"127.0.0.1:{ports[0]},127.0.0.1:{ports[1]}"
+    outfile = str(tmp_path / "events.jsonl")
+
+    def _launch(rank):
+        env = dict(os.environ)
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        return subprocess.Popen(
+            [_sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--hosts", hosts, "--elastic", "--np", "1:2",
+             "--elastic_timeout", "3", script, outfile],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+
+    p0 = _launch(0)
+    p1 = _launch(1)
+    # wait until both workers actually ran in the 2-node world (the settle
+    # window delays the first spawn) before killing node 1
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if os.path.exists(outfile):
+            lines = [json.loads(l) for l in open(outfile)]
+            if sum(1 for e in lines if e["world"] == 2) >= 2:
+                break
+        time.sleep(0.5)
+    else:
+        p0.kill()
+        p1.kill()
+        raise AssertionError("2-node world never formed")
+    p1.send_signal(signal.SIGKILL)  # node 1 dies (heartbeat stops)
+    try:
+        out, err = p0.communicate(timeout=90)
+    except subprocess.TimeoutExpired:
+        p0.kill()
+        raise
+    assert p0.returncode == 0, err[-3000:]
+    events = [json.loads(l) for l in open(outfile)]
+    worlds = [e["world"] for e in events]
+    assert 2 in worlds and 1 in worlds, worlds  # ran in 2-world, then 1-world
+    assert events[-1]["world"] == 1
+    assert events[-1]["endpoints"] == f"127.0.0.1:{ports[0]}"
+
+
+def test_elastic_roster_over_http_kv():
+    """Two managers over the real HTTP KV server discover each other via the
+    co-maintained roster (no native key listing in the HTTP store)."""
+    import socket
+    from paddle_tpu.distributed.fleet.utils.http_server import (KVClient,
+                                                                KVServer)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    server = KVServer(port)
+    server.start()
+    try:
+        kv = KVClient(f"127.0.0.1:{port}")
+        m0 = ElasticManager("h0:80", kv=kv, timeout=5.0)
+        m1 = ElasticManager("h1:80", kv=kv, timeout=5.0)
+        m0.register()
+        m1.register()
+        time.sleep(0.2)
+        assert m0.alive_hosts() == ["h0:80", "h1:80"]
+        assert m1.alive_hosts() == ["h0:80", "h1:80"]
+        m0.deregister()
+        m1.deregister()
+    finally:
+        server.stop()
