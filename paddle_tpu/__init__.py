@@ -40,13 +40,16 @@ from . import inference  # noqa: F401,E402
 from . import io  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from . import nn  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from . import parallel  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import text  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from .flags import get_flags, set_flags  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402

@@ -1,4 +1,5 @@
 from . import dtype as dtypes
+from . import errors
 from .device import (CPUPlace, CUDAPlace, Place, TPUPlace, device_count,
                      get_device, is_compiled_with_cuda, is_compiled_with_tpu,
                      set_device)

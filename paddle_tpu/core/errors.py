@@ -1,0 +1,76 @@
+"""Typed error taxonomy (reference: paddle/fluid/platform/errors.h +
+error_codes.proto + enforce.h PADDLE_ENFORCE_* macros).
+
+The reference raises EnforceNotMet carrying an error code; its Python
+surface maps codes onto builtin exception subclasses (e.g.
+InvalidArgumentError is a ValueError). Same here, so `except ValueError`
+keeps working while `except errors.InvalidArgumentError` is precise."""
+from __future__ import annotations
+
+
+class EnforceNotMet(RuntimeError):
+    """Base of all enforced-invariant failures (enforce.h analog)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, FileNotFoundError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet, PermissionError):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet, ConnectionError):
+    pass
+
+
+class FatalError(EnforceNotMet):
+    pass
+
+
+class ExternalError(EnforceNotMet, OSError):
+    pass
+
+
+def enforce(condition, message, error=InvalidArgumentError):
+    """PADDLE_ENFORCE analog: raise a typed error when condition is false."""
+    if not condition:
+        raise error(message)
+
+
+def enforce_eq(a, b, message=None, error=InvalidArgumentError):
+    if a != b:
+        raise error(message or f"expected {a!r} == {b!r}")
+
+
+def enforce_gt(a, b, message=None, error=InvalidArgumentError):
+    if not a > b:
+        raise error(message or f"expected {a!r} > {b!r}")

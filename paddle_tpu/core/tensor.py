@@ -232,9 +232,10 @@ class Tensor:
 
     # ---- mutation (optimizer updates, state loading) ----
     def set_value(self, value):
+        from .errors import InvalidArgumentError
         arr = to_array(value)
         if tuple(arr.shape) != tuple(self.data.shape):
-            raise ValueError(
+            raise InvalidArgumentError(
                 f"set_value shape mismatch: {arr.shape} vs {self.data.shape}")
         self.data = arr.astype(self.data.dtype)
 

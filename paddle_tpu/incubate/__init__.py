@@ -1,4 +1,5 @@
 """paddle.incubate analog (reference: python/paddle/incubate/)."""
+from . import asp  # noqa: F401
 from ..nn.layer.moe import MoELayer  # noqa: F401
 from ..ops.attention import flash_attention  # noqa: F401
 

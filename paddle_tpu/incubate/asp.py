@@ -1,0 +1,109 @@
+"""ASP — automatic structured (N:M) sparsity.
+
+Reference: python/paddle/fluid/contrib/sparsity/ (utils.py create_mask /
+check_sparsity / calculate_density, asp.py prune_model + decorate) and
+fleet/meta_optimizers/asp_optimizer.py — 2:4 masks computed once and
+re-applied after every optimizer step so pruned weights stay zero.
+
+TPU-native: masks are plain arrays multiplied into weights; the per-step
+re-masking is one fused elementwise multiply under jit. (The v5p+ sparse-MXU
+path would consume the same 2:4 pattern.)
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..nn.layer.layers import Layer
+
+
+def calculate_density(mat) -> float:
+    a = np.asarray(mat)
+    return float(np.count_nonzero(a)) / max(a.size, 1)
+
+
+def create_mask(mat, n=2, m=4):
+    """Keep the n largest-|.| entries in every group of m along the last dim
+    (sparsity/utils.py get_mask_1d analog)."""
+    a = np.asarray(mat, np.float32)
+    flat = a.reshape(-1, a.shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    groups = flat.reshape(flat.shape[0], -1, m)
+    order = np.argsort(-np.abs(groups), axis=-1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
+    mask = mask.reshape(flat.shape[0], -1)[:, :cols]
+    return mask.reshape(a.shape)
+
+
+def check_sparsity(mat, n=2, m=4) -> bool:
+    """True iff every m-group along the last dim has at most n nonzeros."""
+    a = np.asarray(mat)
+    flat = a.reshape(-1, a.shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    groups = flat.reshape(flat.shape[0], -1, m)
+    return bool(np.all((groups != 0).sum(-1) <= n))
+
+
+def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d",
+                with_mask=True) -> Dict[str, np.ndarray]:
+    """Apply N:M masks to every prunable weight (Linear/Conv, ndim >= 2 and
+    last dim >= m). Returns name -> mask; the mask rides on the Parameter
+    itself (p._asp_mask) so `decorate`d optimizers keep re-applying it
+    (asp.py prune_model)."""
+    masks = {}
+    for name, p in model.named_parameters():
+        if p.ndim < 2 or p.shape[-1] < m or getattr(p, "is_bias", False):
+            continue
+        if name.endswith("bias"):
+            continue
+        mask = create_mask(p.numpy(), n, m)
+        p.set_value(p.numpy() * mask)
+        masks[name] = mask
+        if with_mask:
+            p._asp_mask = mask
+    return masks
+
+
+def reset_excluded_layers(model: Optional[Layer] = None):
+    if model is None:
+        return
+    for _, p in model.named_parameters():
+        if hasattr(p, "_asp_mask"):
+            del p._asp_mask
+
+
+class ASPOptimizer:
+    """Optimizer wrapper re-applying the sparse masks after each step
+    (asp_optimizer.py / OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def step(self):
+        self._inner.step()
+        from ..core.tensor import no_grad
+        with no_grad():
+            for p in self._inner._parameter_list or []:
+                mask = getattr(p, "_asp_mask", None)
+                if mask is not None:
+                    p.data = p.data * mask
+
+    def minimize(self, loss, *args, **kwargs):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._inner._parameter_list or []]
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+def decorate(optimizer) -> ASPOptimizer:
+    return ASPOptimizer(optimizer)

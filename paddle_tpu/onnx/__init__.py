@@ -1,0 +1,65 @@
+"""paddle.onnx parity (reference: python/paddle/onnx/export.py — a thin hook
+that delegates to the external paddle2onnx converter and raises when it is
+not installed).
+
+TPU-native: the portable serving format is the StableHLO artifact
+(paddle_tpu.inference.export_model, consumed by the C++ PJRT predictor).
+ONNX conversion remains an external-tool concern exactly as in the
+reference: when the `onnx` package is available we emit a minimal ONNX model
+wrapping the traced program as a single custom op + the weights as
+initializers; otherwise we raise the same ImportError the reference raises
+without paddle2onnx."""
+from __future__ import annotations
+
+import os
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "paddle_tpu.onnx.export needs the `onnx` package (the reference "
+            "equally requires paddle2onnx). For TPU serving use "
+            "paddle_tpu.inference.export_model, which produces a StableHLO "
+            "artifact consumable by the C++ predictor and jax runtimes"
+        ) from e
+    import numpy as np
+    from onnx import TensorProto, helper, numpy_helper
+
+    from ..core.tensor import Tensor
+    from ..inference import export_model
+
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec (example inputs)")
+    examples = [s.numpy() if isinstance(s, Tensor) else np.asarray(s)
+                for s in input_spec]
+    # reuse the serving export for the traced program + weights
+    prefix = export_model(layer, examples, path)
+    stablehlo = open(prefix + ".mlir", "rb").read()
+
+    params, buffers = layer.functional_state()
+    inits = [numpy_helper.from_array(np.asarray(v), name=k)
+             for k, v in {**params, **buffers}.items()]
+    np_to_onnx = {
+        "float32": TensorProto.FLOAT, "float64": TensorProto.DOUBLE,
+        "float16": TensorProto.FLOAT16, "bfloat16": TensorProto.BFLOAT16,
+        "int8": TensorProto.INT8, "int16": TensorProto.INT16,
+        "int32": TensorProto.INT32, "int64": TensorProto.INT64,
+        "uint8": TensorProto.UINT8, "bool": TensorProto.BOOL,
+    }
+    inputs = [helper.make_tensor_value_info(
+        f"x{i}", np_to_onnx.get(str(a.dtype), TensorProto.FLOAT),
+        list(a.shape))
+        for i, a in enumerate(examples)]
+    out = helper.make_tensor_value_info("output", TensorProto.FLOAT, None)
+    node = helper.make_node(
+        "StableHLOProgram", [f"x{i}" for i in range(len(examples))],
+        ["output"], domain="org.stablehlo",
+        program=stablehlo)
+    graph = helper.make_graph([node], "paddle_tpu_model", inputs, [out],
+                              initializer=inits)
+    model = helper.make_model(graph, opset_imports=[
+        helper.make_opsetid("", opset_version)])
+    onnx.save(model, path + ".onnx")
+    return path + ".onnx"

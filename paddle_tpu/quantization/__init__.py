@@ -1,0 +1,368 @@
+"""Quantization (slim) — QAT + post-training quantization.
+
+Reference: python/paddle/fluid/contrib/slim/quantization/ —
+imperative/qat.py:40 (ImperativeQuantAware swaps Conv2D/Linear for
+fake-quant wrappers), post_training_quantization.py (calibration-based PTQ
+with abs_max / KL threshold selection, cal_kl_threshold.py).
+
+TPU-native: fake-quant is a pure jnp quantize-dequantize with a
+straight-through-estimator custom_vjp, so QAT trains through jit/SPMD
+unchanged and XLA folds the q/dq chain at inference. Activation scales use
+the reference's moving_average_abs_max observer carried as Layer buffers
+(same state mechanism as BatchNorm running stats).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from ..tensor.creation import zeros
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fake_quant_dequant(x, scale, bits=8):
+    """Quantize-dequantize with symmetric abs-max scaling
+    (fake_quantize_abs_max op analog)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _fqdq_fwd(x, scale, bits):
+    return fake_quant_dequant(x, scale, bits), (x, scale)
+
+
+def _fqdq_bwd(bits, res, g):
+    # straight-through estimator: pass the cotangent where x is in range
+    x, scale = res
+    s = jnp.maximum(scale, 1e-9)
+    in_range = jnp.abs(x) <= s
+    return jnp.where(in_range, g, 0.0), jnp.zeros_like(scale)
+
+
+fake_quant_dequant.defvjp(_fqdq_fwd, _fqdq_bwd)
+
+
+def abs_max(x, channel_axis: Optional[int] = None):
+    if channel_axis is None:
+        return jnp.max(jnp.abs(x))
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    return jnp.max(jnp.abs(x), axis=axes)
+
+
+def quantize_weight(w: np.ndarray, bits=8, channel_wise=False,
+                    channel_axis=-1):
+    """w -> (int8 values, fp32 scales). channel_wise follows the reference's
+    channel_wise_abs_max (per output channel)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    w = np.asarray(w, np.float32)
+    if channel_wise:
+        axis = channel_axis % w.ndim
+        axes = tuple(i for i in range(w.ndim) if i != axis)
+        scale = np.maximum(np.abs(w).max(axis=axes), 1e-9)
+        shape = [1] * w.ndim
+        shape[axis] = -1
+        q = np.clip(np.round(w / scale.reshape(shape) * qmax), -qmax, qmax)
+    else:
+        scale = np.maximum(np.abs(w).max(), 1e-9)
+        q = np.clip(np.round(w / scale * qmax), -qmax, qmax)
+    return q.astype(np.int8), scale
+
+
+def dequantize_weight(q: np.ndarray, scale, bits=8, channel_axis=-1):
+    qmax = float(2 ** (bits - 1) - 1)
+    q = np.asarray(q, np.float32)
+    scale = np.asarray(scale, np.float32)
+    if scale.ndim == 0:
+        return q * scale / qmax
+    shape = [1] * q.ndim
+    shape[channel_axis % q.ndim] = -1
+    return q * scale.reshape(shape) / qmax
+
+
+def cal_kl_threshold(hist, bin_width, bits=8):
+    """KL-divergence threshold selection (cal_kl_threshold.py analog):
+    choose the clip threshold whose quantized distribution has minimal KL
+    divergence from the original histogram."""
+    n_bins = len(hist)
+    n_quant = 2 ** (bits - 1)  # 128 positive bins for int8
+    if n_bins <= n_quant:
+        return bin_width * n_bins
+    hist = hist.astype(np.float64)
+    best_kl, best_i = np.inf, n_bins
+    for i in range(n_quant, n_bins + 1, max((n_bins - n_quant) // 64, 1)):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()  # clip outliers into the last bin
+        p /= max(p.sum(), 1e-12)
+        # quantize the first i bins down to n_quant levels, then expand back
+        factor = i / n_quant
+        q = np.zeros(i)
+        for j in range(n_quant):
+            start, end = int(j * factor), max(int((j + 1) * factor),
+                                              int(j * factor) + 1)
+            chunk = hist[start:end]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[start:end] = np.where(chunk > 0, chunk.sum() / nz, 0)
+        q /= max(q.sum(), 1e-12)
+        mask = p > 1e-12
+        kl = np.sum(p[mask] * np.log(p[mask] / np.maximum(q[mask], 1e-12)))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return bin_width * best_i
+
+
+class FakeQuantAbsMax(Layer):
+    """Weight quantizer: dynamic abs-max each call (reference abs_max)."""
+
+    def __init__(self, bits=8, channel_wise=False, channel_axis=-1):
+        super().__init__()
+        self._bits = bits
+        self._channel_wise = channel_wise
+        self._channel_axis = channel_axis
+
+    def forward(self, w):
+        bits = self._bits
+        cw, ca = self._channel_wise, self._channel_axis
+
+        def f(a):
+            if cw:
+                scale = abs_max(a, channel_axis=ca % a.ndim)
+                shape = [1] * a.ndim
+                shape[ca % a.ndim] = -1
+                scale = scale.reshape(shape)
+            else:
+                scale = abs_max(a)
+            return fake_quant_dequant(a, scale, bits)
+
+        return apply(f, w)
+
+
+class MovingAverageAbsMaxObserver(Layer):
+    """Activation quantizer with a moving-average scale buffer
+    (reference moving_average_abs_max; the scale becomes a constant at
+    inference, like BN running stats)."""
+
+    def __init__(self, bits=8, moving_rate=0.9):
+        super().__init__()
+        self._bits = bits
+        self._rate = moving_rate
+        self.register_buffer("_scale", zeros([1]))
+        self.register_buffer("_state", zeros([1]))
+
+    def forward(self, x):
+        if self.training:
+            cur = jnp.max(jnp.abs(x.data)).astype(jnp.float32)
+            state = self._state.data.astype(jnp.float32)
+            scale = self._scale.data.astype(jnp.float32)
+            new_state = self._rate * state + 1.0
+            new_scale = (self._rate * scale * state + cur) / new_state
+            self._state.data = new_state.reshape(1)
+            self._scale.data = new_scale.reshape(1)
+        bits = self._bits
+
+        def f(a, s):
+            # an unobserved scale (eval before any training batch) must NOT
+            # clip activations to ~0 — pass through until calibrated
+            out = fake_quant_dequant(a, jnp.maximum(s[0], 1e-9), bits)
+            return jnp.where(s[0] > 0, out, a)
+
+        return apply(f, x, self._scale)
+
+
+class QuantedLayer(Layer):
+    """Wraps a Linear/Conv2D with weight + activation fake-quant
+    (imperative/quant_layers QuantizedLinear/QuantizedConv2D analog)."""
+
+    def __init__(self, inner: Layer, weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9):
+        super().__init__()
+        self.inner = inner
+        channel_wise = weight_quantize_type == "channel_wise_abs_max"
+        # paddle layouts: Linear [in, out] -> channel axis -1;
+        # Conv2D [out, in, kh, kw] -> channel axis 0
+        from ..nn.layer.conv import Conv2D
+        ca = 0 if isinstance(inner, Conv2D) else -1
+        self.weight_quanter = FakeQuantAbsMax(weight_bits, channel_wise, ca)
+        if activation_quantize_type == "moving_average_abs_max":
+            self.act_quanter = MovingAverageAbsMaxObserver(
+                activation_bits, moving_rate)
+        else:
+            self.act_quanter = None
+        self._act_type = activation_quantize_type
+        self._act_bits = activation_bits
+
+    def forward(self, x):
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        elif self._act_type == "abs_max":
+            bits = self._act_bits
+
+            def f(a):
+                return fake_quant_dequant(a, abs_max(a), bits)
+
+            x = apply(f, x)
+        w = self.weight_quanter(self.inner.weight)
+        from ..nn.layer.conv import Conv2D
+        from ..nn.layer.common import Linear
+        if isinstance(self.inner, Conv2D):
+            inner = self.inner
+            return F.conv2d(x, w, inner.bias, inner._stride, inner._padding,
+                            inner._dilation, inner._groups,
+                            inner._data_format)
+        return F.linear(x, w, self.inner.bias)
+
+
+class ImperativeQuantAware:
+    """QAT driver (imperative/qat.py:40): swaps quantizable sublayers for
+    fake-quant wrappers in place; train as usual; export via
+    save_quantized_model."""
+
+    def __init__(self, quantizable_layer_type=("Conv2D", "Linear"),
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9, **_):
+        self._types = tuple(quantizable_layer_type)
+        self._wq = weight_quantize_type
+        self._aq = activation_quantize_type
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+
+    def quantize(self, model: Layer):
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
+        type_map = {"Linear": Linear, "Conv2D": Conv2D}
+        targets = tuple(type_map[t] for t in self._types if t in type_map)
+
+        def swap(layer):
+            for name, child in list(layer._sub_layers.items()):
+                if isinstance(child, targets):
+                    # setattr, not _sub_layers[name]=: attribute-style models
+                    # (self.fc = Linear(...)) resolve through __dict__ first,
+                    # so both stores must see the wrapper
+                    setattr(layer, name, QuantedLayer(
+                        child, self._wq, self._aq, self._wbits, self._abits,
+                        self._rate))
+                else:
+                    swap(child)
+
+        swap(model)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from ..inference import export_model
+        if input_spec is None:
+            raise ValueError("save_quantized_model requires input_spec "
+                             "(example inputs fixing traced shapes)")
+        examples = [s if isinstance(s, (np.ndarray, Tensor)) else
+                    np.zeros([1 if d is None or d < 0 else d
+                              for d in s.shape],
+                             np.dtype(getattr(s, "dtype", "float32")))
+                    for s in input_spec]
+        model.eval()
+        return export_model(model, examples, path)
+
+
+class PostTrainingQuantization:
+    """Calibration-based PTQ (post_training_quantization.py analog, dygraph
+    form): feed calibration batches, collect activation abs-max (or KL)
+    stats and per-channel weight scales, then emit a fake-quantized model
+    plus an int8 state_dict."""
+
+    def __init__(self, model: Layer, algo="abs_max", weight_bits=8,
+                 activation_bits=8, hist_bins=2048):
+        assert algo in ("abs_max", "KL", "avg")
+        self.model = model
+        self.algo = algo
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._hist_bins = hist_bins
+        self._stats = {}
+        self._hooks = []
+
+    def _observe(self, name):
+        def hook(layer, inputs, output=None):
+            x = inputs[0]
+            amax = float(jnp.max(jnp.abs(x.data)))
+            st = self._stats.setdefault(
+                name, {"max": 0.0, "sum": 0.0, "n": 0,
+                       "hist": np.zeros(self._hist_bins), "hist_max": 1e-9})
+            st["max"] = max(st["max"], amax)
+            st["sum"] += amax
+            st["n"] += 1
+            if self.algo == "KL":
+                a = np.abs(np.asarray(x.data, np.float32)).ravel()
+                if amax > st["hist_max"]:
+                    # rescale old histogram into the new range
+                    old = st["hist"]
+                    ratio = st["hist_max"] / amax
+                    idx = (np.arange(self._hist_bins) * ratio).astype(int)
+                    newh = np.zeros_like(old)
+                    np.add.at(newh, idx, old)
+                    st["hist"] = newh
+                    st["hist_max"] = amax
+                h, _ = np.histogram(a, bins=self._hist_bins,
+                                    range=(0, st["hist_max"]))
+                st["hist"] += h
+        return hook
+
+    def quantize(self, calibration_data):
+        """calibration_data: iterable of input batches (arrays/Tensors)."""
+        from ..core.tensor import no_grad
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
+        named = [(n, l) for n, l in self.model.named_sublayers()
+                 if isinstance(l, (Linear, Conv2D))]
+        for n, l in named:
+            self._hooks.append(l.register_forward_pre_hook(self._observe(n)))
+        self.model.eval()
+        with no_grad():
+            for batch in calibration_data:
+                self.model(batch if isinstance(batch, Tensor)
+                           else Tensor(batch))
+        for h in self._hooks:
+            h.remove()
+
+        self.scales = {}
+        self.int8_state = {}
+        for n, l in named:
+            st = self._stats.get(n)
+            if st is None:
+                continue
+            if self.algo == "abs_max":
+                act_scale = st["max"]
+            elif self.algo == "avg":
+                act_scale = st["sum"] / max(st["n"], 1)
+            else:
+                act_scale = cal_kl_threshold(
+                    st["hist"], st["hist_max"] / self._hist_bins, self._abits)
+            is_conv = isinstance(l, Conv2D)
+            q, w_scale = quantize_weight(
+                l.weight.numpy(), self._wbits, channel_wise=True,
+                channel_axis=0 if is_conv else -1)
+            self.scales[n] = {"activation": float(act_scale),
+                              "weight": np.asarray(w_scale)}
+            self.int8_state[n + ".weight"] = q
+            # bake the quantization error into the model (fake-quant fold)
+            wdq = dequantize_weight(q, w_scale, self._wbits,
+                                    channel_axis=0 if is_conv else -1)
+            l.weight.set_value(wdq.astype(np.float32))
+        return self.model
+
+    def save_quantized_model(self, path, input_spec):
+        from ..framework_io import save
+        from ..inference import export_model
+        export_model(self.model, input_spec, path)
+        save({"int8_weights": self.int8_state, "scales": self.scales},
+             path + ".quant")
+        return path

@@ -13,6 +13,8 @@ from . import creation, linalg, logic, manipulation, math, random, search, stat
 from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
+from .lod import (LoDTensor, SelectedRows, sequence_expand,  # noqa: F401
+                  sequence_mask, sequence_pad, sequence_unpad)
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403

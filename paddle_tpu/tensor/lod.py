@@ -1,0 +1,169 @@
+"""Ragged sequences: LoDTensor metadata + the sequence ops models actually
+use.
+
+Reference: paddle/fluid/framework/lod_tensor.h (level-of-detail offsets over
+a packed dense tensor) and operators/sequence_ops/ (~20 ragged ops:
+sequence_pad, sequence_unpad, sequence_expand, sequence_mask, ...).
+
+TPU-native stance: XLA wants STATIC shapes, so ragged data lives as
+(packed values, offsets) on the host side and converts to padded dense +
+length mask at the device boundary — exactly what sequence_pad does. The
+ops here are the conversion layer; padded compute + masks is the idiomatic
+TPU representation (same call the reference's own NLP models make before
+dense compute).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from .creation import _t
+
+
+class LoDTensor:
+    """A packed dense tensor + level-of-detail offsets (lod_tensor.h analog).
+
+    lod is a list of levels; each level is a monotonically increasing offset
+    vector [0, ...]; level[-1] partitions the rows of `data`.
+    """
+
+    def __init__(self, data, lod: Sequence[Sequence[int]]):
+        from ..core.errors import InvalidArgumentError, enforce
+        self.tensor = data if isinstance(data, Tensor) else Tensor(data)
+        self.lod = [list(level) for level in lod]
+        for level in self.lod:
+            enforce(level[0] == 0 and all(
+                a <= b for a, b in zip(level, level[1:])),
+                "lod levels must be ascending offsets starting at 0",
+                InvalidArgumentError)
+        enforce(self.lod[-1][-1] == self.tensor.shape[0],
+                f"last lod level must cover all {self.tensor.shape[0]} "
+                f"packed rows (got offsets ending at {self.lod[-1][-1]})",
+                InvalidArgumentError)
+
+    @property
+    def data(self):
+        return self.tensor.data
+
+    def sequence_lengths(self) -> List[int]:
+        last = self.lod[-1]
+        return [b - a for a, b in zip(last, last[1:])]
+
+    def num_sequences(self) -> int:
+        return len(self.lod[-1]) - 1
+
+    @classmethod
+    def from_sequences(cls, seqs: Sequence[np.ndarray]) -> "LoDTensor":
+        lens = [0]
+        for s in seqs:
+            lens.append(lens[-1] + len(s))
+        return cls(np.concatenate([np.asarray(s) for s in seqs], axis=0),
+                   [lens])
+
+    def to_padded(self, pad_value=0.0, maxlen=None):
+        """sequence_pad_op analog: -> (padded [N, maxlen, ...], lengths)."""
+        return sequence_pad(self, pad_value, maxlen)
+
+    def __repr__(self):
+        return f"LoDTensor(shape={self.tensor.shape}, lod={self.lod})"
+
+
+def sequence_pad(x: LoDTensor, pad_value=0.0, maxlen=None):
+    """Pack -> padded dense + lengths (sequence_pad_op.cc: padded_length
+    must cover the longest sequence)."""
+    from ..core.errors import InvalidArgumentError, enforce
+    lens = x.sequence_lengths()
+    n = len(lens)
+    longest = max(lens) if lens else 0
+    if maxlen is not None:
+        enforce(maxlen >= longest,
+                f"sequence_pad maxlen={maxlen} is shorter than the longest "
+                f"sequence ({longest})", InvalidArgumentError)
+    m = maxlen or longest
+    trailing = x.tensor.shape[1:]
+    arr = np.asarray(x.tensor.data)
+    out = np.full([n, m] + trailing, pad_value, dtype=arr.dtype)
+    last = x.lod[-1]
+    for i, (a, b) in enumerate(zip(last, last[1:])):
+        out[i, :b - a] = arr[a:b]
+    return Tensor(out), Tensor(np.asarray(lens, np.int64))
+
+
+def sequence_unpad(x, length):
+    """Padded dense + lengths -> LoDTensor (sequence_unpad_op.cc)."""
+    arr = np.asarray(_t(x).data)
+    lens = [int(v) for v in np.asarray(_t(length).data)]
+    packed = np.concatenate([arr[i, :l] for i, l in enumerate(lens)], axis=0)
+    offsets = [0]
+    for l in lens:
+        offsets.append(offsets[-1] + l)
+    return LoDTensor(packed, [offsets])
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    """[N] lengths -> [N, maxlen] 0/1 mask (sequence_mask_op.cc); the device
+    op every padded-compute consumer actually needs."""
+    from ..core import dtypes
+    d = dtypes.convert_dtype(dtype)
+    lt = _t(lengths)
+    m = maxlen if maxlen is not None else int(jnp.max(lt.data))
+
+    def f(ln):
+        ar = jnp.arange(m)[None, :]
+        return (ar < ln[:, None]).astype(d)
+
+    return apply(f, lt)
+
+
+def sequence_expand(x: LoDTensor, y: LoDTensor, ref_level=-1) -> LoDTensor:
+    """Repeat each sequence of x to match y's ref_level lod
+    (sequence_expand_op.cc)."""
+    arr = np.asarray(x.tensor.data)
+    x_off = x.lod[-1]
+    y_off = y.lod[ref_level]
+    pieces = []
+    offsets = [0]
+    for i, (a, b) in enumerate(zip(x_off, x_off[1:])):
+        repeat = y_off[i + 1] - y_off[i]
+        for _ in range(max(repeat, 0)):
+            pieces.append(arr[a:b])
+            offsets.append(offsets[-1] + (b - a))
+    packed = (np.concatenate(pieces, axis=0) if pieces
+              else arr[:0])
+    return LoDTensor(packed, [offsets])
+
+
+class SelectedRows:
+    """Sparse gradient container (framework/selected_rows.h analog): a set
+    of row indices + their values over a [height, ...] dense space. Embedding
+    backward with sparse=True produces one of these; `to_dense()` scatters.
+
+    TPU stance: in-graph grads stay dense (XLA scatter-add is the fast
+    path); SelectedRows serves the eager/PS-style host pipeline where only
+    touched rows should materialize."""
+
+    def __init__(self, rows, values, height: int):
+        self.rows = np.asarray(rows, np.int64)
+        self.values = values if isinstance(values, Tensor) else Tensor(values)
+        self.height = height
+
+    def to_dense(self) -> Tensor:
+        shape = [self.height] + list(self.values.shape[1:])
+        out = jnp.zeros(shape, self.values.data.dtype)
+        out = out.at[jnp.asarray(self.rows)].add(self.values.data)
+        return Tensor(out)
+
+    def merge(self) -> "SelectedRows":
+        """Merge duplicate rows by summation (merge_selected_rows op)."""
+        uniq, inv = np.unique(self.rows, return_inverse=True)
+        vals = jnp.zeros((len(uniq),) + tuple(self.values.shape[1:]),
+                         self.values.data.dtype)
+        vals = vals.at[jnp.asarray(inv)].add(self.values.data)
+        return SelectedRows(uniq, vals, self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(nnz_rows={len(self.rows)}, "
+                f"height={self.height})")

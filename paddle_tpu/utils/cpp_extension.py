@@ -1,0 +1,83 @@
+"""Custom C++ op runtime (reference: paddle/fluid/extension/ extension.h +
+python/paddle/utils/cpp_extension — user-compiled ops loaded at runtime).
+
+TPU-native: custom ops are XLA FFI handlers. `load()` compiles the user's
+.cc against jaxlib's bundled XLA FFI headers into a shared library, dlopens
+it, registers every requested handler with jax.ffi, and returns a module-ish
+object whose attributes invoke the op through jax.ffi.ffi_call — fully
+jit-compatible (the handler becomes a custom-call in the XLA program).
+
+Handlers run on the registering platform (cpu by default; a TPU build would
+register a device handler the same way). Like the reference, autograd
+support requires the author to define and compose a grad op explicitly.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+
+
+class CustomOp:
+    """One registered FFI handler, callable on Tensors/arrays."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __call__(self, *args, out_shape=None, out_dtype=None, **attrs):
+        from ..tensor.creation import _t
+        if out_shape is None:
+            out_shape = _t(args[0]).shape
+        if out_dtype is None:
+            out_dtype = _t(args[0]).dtype
+
+        def f(*arrays):
+            call = jax.ffi.ffi_call(
+                self.name,
+                jax.ShapeDtypeStruct(tuple(out_shape), out_dtype))
+            return call(*arrays, **attrs)
+
+        return apply(f, *[_t(a) for a in args])
+
+
+class CustomOpLibrary:
+    def __init__(self, lib_path: str, handlers: Sequence[str]):
+        self._lib = ctypes.CDLL(lib_path)
+        self.lib_path = lib_path
+        for name in handlers:
+            fn = getattr(self._lib, name)
+            jax.ffi.register_ffi_target(
+                name, jax.ffi.pycapsule(fn), platform="cpu")
+            setattr(self, name, CustomOp(name))
+
+
+def load(name: str, sources: Sequence[str], handlers: Sequence[str],
+         extra_cxx_flags: Optional[Sequence[str]] = None,
+         build_directory: Optional[str] = None,
+         verbose: bool = False) -> CustomOpLibrary:
+    """Compile + load custom FFI ops (cpp_extension.load analog).
+
+    sources: .cc files defining XLA_FFI_DEFINE_HANDLER_SYMBOL handlers.
+    handlers: exported handler symbol names to register.
+    """
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), f"paddle_tpu_ext_{name}")
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, f"lib{name}.so")
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+           f"-I{jax.ffi.include_dir()}", "-o", out] + list(sources) + \
+        list(extra_cxx_flags or [])
+    if verbose:
+        print("[cpp_extension]", " ".join(cmd))
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"custom op build failed:\n{r.stderr[-2000:]}")
+    return CustomOpLibrary(out, handlers)

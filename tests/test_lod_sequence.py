@@ -1,0 +1,72 @@
+"""LoDTensor ragged metadata + sequence ops + SelectedRows sparse grads."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import (LoDTensor, SelectedRows, sequence_expand,
+                               sequence_mask, sequence_pad, sequence_unpad)
+
+
+def _ragged():
+    return LoDTensor.from_sequences([
+        np.ones((2, 3), np.float32) * 1,
+        np.ones((3, 3), np.float32) * 2,
+        np.ones((1, 3), np.float32) * 3,
+    ])
+
+
+def test_lod_from_sequences_and_lengths():
+    x = _ragged()
+    assert x.lod == [[0, 2, 5, 6]]
+    assert x.sequence_lengths() == [2, 3, 1]
+    assert x.num_sequences() == 3
+    assert x.tensor.shape == [6, 3]
+
+
+def test_sequence_pad_unpad_roundtrip():
+    x = _ragged()
+    padded, lens = sequence_pad(x, pad_value=0.0)
+    assert padded.shape == [3, 3, 3]
+    np.testing.assert_allclose(lens.numpy(), [2, 3, 1])
+    # padding positions are exactly pad_value
+    assert float(padded.numpy()[0, 2].sum()) == 0.0
+    assert float(padded.numpy()[2, 1:].sum()) == 0.0
+    back = sequence_unpad(padded, lens)
+    np.testing.assert_allclose(back.tensor.numpy(), x.tensor.numpy())
+    assert back.lod == x.lod
+
+
+def test_sequence_mask_matches_lengths():
+    m = sequence_mask(paddle.to_tensor(np.asarray([2, 3, 1])), maxlen=4,
+                      dtype="float32")
+    expected = np.array([[1, 1, 0, 0], [1, 1, 1, 0], [1, 0, 0, 0]],
+                        np.float32)
+    np.testing.assert_allclose(m.numpy(), expected)
+
+
+def test_sequence_expand_repeats_by_ref_lod():
+    x = LoDTensor.from_sequences([np.asarray([[1.0]]), np.asarray([[2.0]])])
+    y = LoDTensor.from_sequences([np.zeros((2, 1)), np.zeros((3, 1))])
+    out = sequence_expand(x, y)
+    np.testing.assert_allclose(out.tensor.numpy().ravel(),
+                               [1.0, 1.0, 2.0, 2.0, 2.0])
+
+
+def test_selected_rows_to_dense_and_merge():
+    sr = SelectedRows(rows=[1, 3, 1], values=np.ones((3, 2), np.float32),
+                      height=5)
+    merged = sr.merge()
+    assert sorted(merged.rows.tolist()) == [1, 3]
+    dense = sr.to_dense().numpy()
+    assert dense.shape == (5, 2)
+    np.testing.assert_allclose(dense[1], [2.0, 2.0])  # duplicate row summed
+    np.testing.assert_allclose(dense[3], [1.0, 1.0])
+    np.testing.assert_allclose(dense[[0, 2, 4]], 0.0)
+
+
+def test_lod_validates_offsets():
+    from paddle_tpu.core import errors
+    with pytest.raises(errors.InvalidArgumentError):
+        LoDTensor(np.zeros((4, 2)), [[0, 3]])  # does not cover all rows
+    with pytest.raises(errors.InvalidArgumentError):
+        sequence_pad(_ragged(), maxlen=2)  # shorter than longest (3)
