@@ -23,32 +23,33 @@ def calculate_density(mat) -> float:
     return float(np.count_nonzero(a)) / max(a.size, 1)
 
 
-def create_mask(mat, n=2, m=4):
-    """Keep the n largest-|.| entries in every group of m along the last dim
-    (sparsity/utils.py get_mask_1d analog)."""
-    a = np.asarray(mat, np.float32)
-    flat = a.reshape(-1, a.shape[-1])
-    cols = flat.shape[1]
-    pad = (-cols) % m
-    if pad:
-        flat = np.pad(flat, ((0, 0), (0, pad)))
-    groups = flat.reshape(flat.shape[0], -1, m)
-    order = np.argsort(-np.abs(groups), axis=-1)
-    mask = np.zeros_like(groups)
-    np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
-    mask = mask.reshape(flat.shape[0], -1)[:, :cols]
-    return mask.reshape(a.shape)
-
-
-def check_sparsity(mat, n=2, m=4) -> bool:
-    """True iff every m-group along the last dim has at most n nonzeros."""
+def _group(mat, m):
+    """Reshape to [rows, n_groups, m] padding the last dim up to a multiple
+    of m; returns (groups, original last-dim size)."""
     a = np.asarray(mat)
     flat = a.reshape(-1, a.shape[-1])
     cols = flat.shape[1]
     pad = (-cols) % m
     if pad:
         flat = np.pad(flat, ((0, 0), (0, pad)))
-    groups = flat.reshape(flat.shape[0], -1, m)
+    return flat.reshape(flat.shape[0], -1, m), cols
+
+
+def create_mask(mat, n=2, m=4):
+    """Keep the n largest-|.| entries in every group of m along the last dim
+    (sparsity/utils.py get_mask_1d analog)."""
+    a = np.asarray(mat, np.float32)
+    groups, cols = _group(a, m)
+    order = np.argsort(-np.abs(groups), axis=-1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
+    mask = mask.reshape(groups.shape[0], -1)[:, :cols]
+    return mask.reshape(a.shape)
+
+
+def check_sparsity(mat, n=2, m=4) -> bool:
+    """True iff every m-group along the last dim has at most n nonzeros."""
+    groups, _ = _group(mat, m)
     return bool(np.all((groups != 0).sum(-1) <= n))
 
 

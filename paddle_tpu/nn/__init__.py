@@ -29,3 +29,5 @@ from .layer.rnn import (GRU, LSTM, BiRNN, GRUCell, LSTMCell, RNN,  # noqa: F401
 from .layer.transformer import (MultiHeadAttention, Transformer,  # noqa: F401
                                 TransformerDecoder, TransformerDecoderLayer,
                                 TransformerEncoder, TransformerEncoderLayer)
+
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401,E402

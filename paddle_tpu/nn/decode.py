@@ -1,0 +1,139 @@
+"""Beam-search decoding (reference: python/paddle/nn/decode.py re-exporting
+fluid/layers/rnn.py — BeamSearchDecoder:866 + dynamic_decode:1584).
+
+TPU-native: the decode loop is a lockstep batched beam sweep over
+[batch*beam] states — every step is dense top-k + gathers (XLA-friendly; no
+per-beam Python branching), and finished beams are masked rather than
+removed so shapes stay static.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.lax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, no_grad
+from ..tensor.creation import _t
+
+_NEG_INF = -1e9
+
+
+class BeamSearchDecoder:
+    """Wraps an RNN cell for beam search (fluid/layers/rnn.py:866 API)."""
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size: int):
+        """[B, ...] -> [B*beam, ...] with each row repeated beam times."""
+        a = _t(x).data
+        tiled = jnp.repeat(a[:, None], beam_size, axis=1)
+        return Tensor(tiled.reshape((-1,) + a.shape[1:]))
+
+
+def _gather_beams(tree_arr, beam_idx, batch, beam):
+    """Select ancestor beams: arr [B*K, ...] indexed by beam_idx [B, K]."""
+    flat_idx = (jnp.arange(batch)[:, None] * beam + beam_idx).reshape(-1)
+    return tree_arr[flat_idx]
+
+
+@no_grad()
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
+                   max_step_num: Optional[int] = 64, batch_size=None,
+                   **kwargs):
+    """Run beam search to completion (rnn.py dynamic_decode:1584).
+
+    inits: initial cell states [B, H] (or None for zeros; requires
+    batch_size). Returns (ids Tensor [B, beam, T] best-first,
+    sequence_lengths Tensor [B, beam]).
+    """
+    import jax
+
+    K = decoder.beam_size
+    end = decoder.end_token
+
+    def _leaves(x):
+        return jax.tree_util.tree_map(
+            lambda t: t.data if isinstance(t, Tensor) else jnp.asarray(t), x,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    if inits is None:
+        if batch_size is None:
+            raise ValueError("dynamic_decode needs inits or batch_size")
+        B = batch_size
+        states = None  # the cell builds its own zeros at [B*K, ...]
+    else:
+        st = _leaves(inits)
+        B = jax.tree_util.tree_leaves(st)[0].shape[0]
+        # tile every state leaf to [B*K, ...]; one live beam per row at t=0
+        states = jax.tree_util.tree_map(
+            lambda a: jnp.repeat(a[:, None], K, axis=1).reshape(
+                (B * K,) + a.shape[1:]), st)
+    log_probs = jnp.full((B, K), _NEG_INF).at[:, 0].set(0.0)
+    finished = jnp.zeros((B, K), bool)
+    tokens = jnp.full((B * K,), decoder.start_token, jnp.int32)
+    history = []
+    lengths = jnp.zeros((B, K), jnp.int32)
+
+    def _wrap_states(s):
+        return jax.tree_util.tree_map(Tensor, s) if s is not None else None
+
+    if max_step_num is None:
+        # reference default: decode until every beam emits end_token, with a
+        # sanity ceiling so a never-ending cell cannot loop forever
+        max_step_num = 1024
+    for _ in range(max_step_num):
+        if decoder.embedding_fn is not None:
+            inp = decoder.embedding_fn(Tensor(tokens))
+        else:
+            inp = Tensor(tokens)
+        out, new_states = decoder.cell(inp, _wrap_states(states))
+        if decoder.output_fn is not None:
+            out = decoder.output_fn(out)
+        logits = out.data.astype(jnp.float32)  # [B*K, V]
+        V = logits.shape[-1]
+        m = logits.max(-1, keepdims=True)
+        step_lp = (logits - m) - jnp.log(
+            jnp.sum(jnp.exp(logits - m), -1, keepdims=True))
+        step_lp = step_lp.reshape(B, K, V)
+        # finished beams may only emit end_token at zero cost
+        fin_mask = jnp.full((V,), _NEG_INF).at[end].set(0.0)
+        step_lp = jnp.where(finished[:, :, None], fin_mask[None, None],
+                            step_lp)
+        total = log_probs[:, :, None] + step_lp  # [B, K, V]
+        flat = total.reshape(B, K * V)
+        top_scores, top_idx = jax.lax.top_k(flat, K)
+        beam_idx = top_idx // V        # ancestor beam  [B, K]
+        tok = (top_idx % V).astype(jnp.int32)
+        log_probs = top_scores
+        states = jax.tree_util.tree_map(
+            lambda a: _gather_beams(a, beam_idx, B, K), _leaves(new_states))
+        finished = _gather_beams(finished.reshape(B * K), beam_idx, B,
+                                 K).reshape(B, K)
+        lengths = _gather_beams(lengths.reshape(B * K), beam_idx, B,
+                                K).reshape(B, K)
+        lengths = jnp.where(finished, lengths, lengths + 1)
+        finished = finished | (tok == end)
+        # re-route history through the chosen ancestors
+        history = [_gather_beams(hstep.reshape(B * K), beam_idx, B,
+                                 K).reshape(B, K) for hstep in history]
+        history.append(tok)
+        tokens = tok.reshape(B * K)
+        if bool(jnp.all(finished)):
+            break
+
+    ids = jnp.stack(history, axis=-1) if history else \
+        jnp.zeros((B, K, 0), jnp.int32)
+    # best-first ordering by final score
+    order = jnp.argsort(-log_probs, axis=-1)
+    ids = jnp.take_along_axis(ids, order[:, :, None], axis=1)
+    lengths = jnp.take_along_axis(lengths, order, axis=1)
+    return Tensor(ids), Tensor(lengths)

@@ -11,8 +11,6 @@ initializers; otherwise we raise the same ImportError the reference raises
 without paddle2onnx."""
 from __future__ import annotations
 
-import os
-
 
 def export(layer, path, input_spec=None, opset_version=9, **configs):
     try:
@@ -36,7 +34,8 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
                 for s in input_spec]
     # reuse the serving export for the traced program + weights
     prefix = export_model(layer, examples, path)
-    stablehlo = open(prefix + ".mlir", "rb").read()
+    with open(prefix + ".mlir", "rb") as f:
+        stablehlo = f.read()
 
     params, buffers = layer.functional_state()
     inits = [numpy_helper.from_array(np.asarray(v), name=k)

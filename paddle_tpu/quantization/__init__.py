@@ -191,7 +191,8 @@ class QuantedLayer(Layer):
         # paddle layouts: Linear [in, out] -> channel axis -1;
         # Conv2D [out, in, kh, kw] -> channel axis 0
         from ..nn.layer.conv import Conv2D
-        ca = 0 if isinstance(inner, Conv2D) else -1
+        self._is_conv = isinstance(inner, Conv2D)
+        ca = 0 if self._is_conv else -1
         self.weight_quanter = FakeQuantAbsMax(weight_bits, channel_wise, ca)
         if activation_quantize_type == "moving_average_abs_max":
             self.act_quanter = MovingAverageAbsMaxObserver(
@@ -212,9 +213,7 @@ class QuantedLayer(Layer):
 
             x = apply(f, x)
         w = self.weight_quanter(self.inner.weight)
-        from ..nn.layer.conv import Conv2D
-        from ..nn.layer.common import Linear
-        if isinstance(self.inner, Conv2D):
+        if self._is_conv:
             inner = self.inner
             return F.conv2d(x, w, inner.bias, inner._stride, inner._padding,
                             inner._dilation, inner._groups,

@@ -34,6 +34,9 @@ class LoDTensor:
         from ..core.errors import InvalidArgumentError, enforce
         self.tensor = data if isinstance(data, Tensor) else Tensor(data)
         self.lod = [list(level) for level in lod]
+        enforce(self.lod and all(self.lod),
+                "lod must contain at least one non-empty offset level",
+                InvalidArgumentError)
         for level in self.lod:
             enforce(level[0] == 0 and all(
                 a <= b for a, b in zip(level, level[1:])),
@@ -120,10 +123,15 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
 
 def sequence_expand(x: LoDTensor, y: LoDTensor, ref_level=-1) -> LoDTensor:
     """Repeat each sequence of x to match y's ref_level lod
-    (sequence_expand_op.cc)."""
+    (sequence_expand_op.cc: x and the ref level must have equally many
+    sequences)."""
+    from ..core.errors import InvalidArgumentError, enforce
     arr = np.asarray(x.tensor.data)
     x_off = x.lod[-1]
     y_off = y.lod[ref_level]
+    enforce(len(x_off) == len(y_off),
+            f"sequence_expand: x has {len(x_off) - 1} sequences but y's "
+            f"ref level has {len(y_off) - 1}", InvalidArgumentError)
     pieces = []
     offsets = [0]
     for i, (a, b) in enumerate(zip(x_off, x_off[1:])):
