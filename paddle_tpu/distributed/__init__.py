@@ -17,6 +17,8 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F40
                        set_hybrid_communicate_group)
 from .data_parallel import DataParallel  # noqa: F401
 from .spawn import spawn  # noqa: F401
+from .trainer import DeviceWorker, MultiTrainer, train_from_dataset  # noqa: F401
+from .elastic import ElasticManager, ElasticStatus  # noqa: F401
 
 
 def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,

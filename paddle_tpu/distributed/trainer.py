@@ -1,0 +1,84 @@
+"""Dataset-driven trainer run loops.
+
+Reference: paddle/fluid/framework/trainer.h:57 (MultiTrainer — one
+device-worker thread per device pulling from DataFeed) and
+device_worker.h:150 (HogwildWorker run loop), driven by
+Executor.train_from_dataset (executor.py:1802). The pipeline counterpart
+(SectionWorker, trainer.h:292) lives in paddle_tpu.parallel.pipeline as the
+1F1B schedule.
+
+TPU-native: one PROCESS drives all local chips (jax owns dispatch), so the
+reference's thread-per-device fan-out collapses to a single host loop that
+keeps the device fed: the C++ datafeed (csrc/datafeed) prefetches records on
+reader threads, the host decodes ahead of dispatch, and the jit-compiled
+train step runs async on device — the same producer/consumer structure with
+XLA doing the device-side scheduling.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+from ..core.tensor import Tensor
+
+
+class DeviceWorker:
+    """HogwildWorker analog: runs the train fn over a batch stream."""
+
+    def __init__(self, train_fn: Callable, print_period: int = 100):
+        self.train_fn = train_fn
+        self.print_period = print_period
+        self.steps = 0
+        self.last_loss = None
+
+    def run(self, batch_iter: Iterable):
+        import sys
+        for batch in batch_iter:
+            args = batch if isinstance(batch, (tuple, list)) else (batch,)
+            loss = self.train_fn(*args)
+            self.steps += 1
+            self.last_loss = loss
+            if self.print_period and self.steps % self.print_period == 0:
+                if isinstance(loss, Tensor):
+                    val = f"{float(loss.item()):.5f}"
+                elif isinstance(loss, (int, float)):
+                    val = f"{float(loss):.5f}"
+                else:  # train fns may return None or (loss, metrics) tuples
+                    val = repr(loss)
+                print(f"[trainer] step {self.steps} loss {val}",
+                      file=sys.stderr)
+        return self.last_loss
+
+
+class MultiTrainer:
+    """trainer.h:57 analog: a dataset-driven run loop.
+
+    usage:
+        trainer = MultiTrainer(step_fn)         # e.g. a jit TrainStep
+        trainer.train_from_dataset(dataset, epochs=2, batch_decoder=fn)
+    dataset: an iterable (io.DataLoader, io.RecordFileDataset, generator);
+    batch_decoder maps a raw record/batch to the step's arguments.
+    """
+
+    def __init__(self, train_fn: Callable, print_period: int = 100):
+        self.worker = DeviceWorker(train_fn, print_period)
+
+    def train_from_dataset(self, dataset: Iterable, epochs: int = 1,
+                           batch_decoder: Optional[Callable] = None):
+        last = None
+        for _ in range(epochs):
+            it = iter(dataset)
+            if batch_decoder is not None:
+                it = (batch_decoder(b) for b in it)
+            last = self.worker.run(it)
+        return last
+
+    @property
+    def steps(self):
+        return self.worker.steps
+
+
+def train_from_dataset(train_fn, dataset, epochs=1, batch_decoder=None,
+                       print_period=100):
+    """Executor.train_from_dataset parity entry."""
+    return MultiTrainer(train_fn, print_period).train_from_dataset(
+        dataset, epochs, batch_decoder)

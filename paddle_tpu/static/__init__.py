@@ -59,6 +59,21 @@ class Executor:
             return [np.asarray(o.numpy()) for o in outs]
         return []
 
+    def train_from_dataset(self, program=None, dataset=None, epochs=1,
+                           batch_decoder=None, print_period=100, **kwargs):
+        """Executor.train_from_dataset parity (executor.py:1802): `program`
+        is the train-step callable (TrainStep / function); the dataset-driven
+        run loop lives in distributed.trainer.MultiTrainer."""
+        from ..distributed.trainer import train_from_dataset as _run
+        if not callable(program):
+            raise TypeError(
+                "train_from_dataset expects the train-step callable as "
+                "`program` (placeholder Programs own no executable body)")
+        if dataset is None:
+            raise ValueError("train_from_dataset requires a dataset")
+        return _run(program, dataset, epochs=epochs,
+                    batch_decoder=batch_decoder, print_period=print_period)
+
 
 class CompiledProgram:
     def __init__(self, program, build_strategy=None):

@@ -1,0 +1,65 @@
+"""Dataset-driven trainer run loop (MultiTrainer / train_from_dataset)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+from paddle_tpu.distributed import MultiTrainer, train_from_dataset
+
+
+def _model_step():
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    step = paddle.jit.TrainStep(
+        model, lambda o, y: nn.functional.mse_loss(o, y), opt)
+    return step
+
+
+def _batches(n=12, bs=8):
+    rng = np.random.RandomState(0)
+    for _ in range(n):
+        yield (rng.randn(bs, 8).astype(np.float32),
+               rng.randn(bs, 4).astype(np.float32))
+
+
+def test_multitrainer_runs_epochs_and_counts_steps():
+    step = _model_step()
+    trainer = MultiTrainer(step, print_period=0)
+    first = float(step(*next(_batches(1))).item())
+    last = trainer.train_from_dataset(list(_batches(12)), epochs=2)
+    assert trainer.steps == 24
+    assert float(last.item()) < first
+
+
+def test_train_from_dataset_with_decoder_and_native_feed(tmp_path):
+    # end-to-end through the C++ datafeed: records -> decoder -> train step
+    from paddle_tpu.io.native_feed import (RecordFileDataset,
+                                           write_record_file)
+    rng = np.random.RandomState(0)
+    records = []
+    for _ in range(10):
+        x = rng.randn(8, 8).astype(np.float32)
+        y = rng.randn(8, 4).astype(np.float32)
+        records.append(x.tobytes() + y.tobytes())
+    path = str(tmp_path / "train.rec")
+    write_record_file(path, records)
+
+    def decode(raw):
+        x = np.frombuffer(raw[:8 * 8 * 4], np.float32).reshape(8, 8)
+        y = np.frombuffer(raw[8 * 8 * 4:], np.float32).reshape(8, 4)
+        return x, y
+
+    step = _model_step()
+    last = train_from_dataset(step, RecordFileDataset([path]),
+                              batch_decoder=decode, print_period=0)
+    assert np.isfinite(float(last.item()))
+
+
+def test_static_executor_train_from_dataset():
+    step = _model_step()
+    exe = static.Executor()
+    last = exe.train_from_dataset(program=step, dataset=list(_batches(4)))
+    assert np.isfinite(float(last.item()))
+    with pytest.raises(TypeError):
+        exe.train_from_dataset(program=static.Program(), dataset=[])
