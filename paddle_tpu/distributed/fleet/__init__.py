@@ -155,6 +155,9 @@ class Fleet:
             from .strategy_compiler import StrategyCompiler
             plan = StrategyCompiler().compile(self._strategy, optimizer)
             optimizer = plan.optimizer or optimizer
+        if self._strategy is not None:
+            from .dgc import maybe_wrap_dgc
+            optimizer = maybe_wrap_dgc(optimizer, self._strategy)
         self._user_defined_optimizer = optimizer
         if self._hcg is None:
             return optimizer

@@ -1,0 +1,130 @@
+"""Deep Gradient Compression (reference: fleet/meta_optimizers/
+dgc_optimizer.py + fluid DGCMomentumOptimizer + operators/dgc_op.* —
+momentum-corrected top-k gradient sparsification with local error feedback
+and a sparsity ramp-up schedule).
+
+TPU stance (honest): ICI bandwidth makes DGC's wire saving moot for
+in-pod training — XLA collectives move dense bf16 grads faster than host-side
+sparsification could. The algorithm is provided for semantic parity and for
+DCN-bound multi-pod DP, where the sparsified gradients shrink the cross-pod
+allreduce: communication of the masked gradient happens through whatever
+runner hosts this optimizer (eager DataParallel.apply_collective_grads or a
+custom loop), operating on the already-sparsified .grad tensors.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, no_grad
+
+
+class DGCMomentum:
+    """DGCMomentumOptimizer analog wrapping this framework's Momentum.
+
+    Per step, per parameter (dgc_op.cc semantics):
+        u = m * u + g                (momentum correction)
+        v = v + u                    (error accumulation)
+        mask = top-k(|v|)            (k from the rampup sparsity schedule)
+        g_sparse = v * mask; v = v * (1 - mask); u = u * (1 - mask)
+    The sparsified g_sparse replaces p.grad, then the inner (plain SGD-step)
+    update applies it — matching the reference where the dgc op produces the
+    gradient the momentum op consumes.
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 rampup_begin_step=0, rampup_step=1,
+                 sparsity: Sequence[float] = (0.999,), grad_clip=None,
+                 name=None):
+        from ...optimizer.optimizer import SGD
+        # the momentum correction lives in DGC's own u buffer, so the inner
+        # update is plain SGD on the sparsified gradient
+        self._inner = SGD(learning_rate=learning_rate, parameters=parameters,
+                          grad_clip=grad_clip)
+        self._momentum = momentum
+        self._rampup_begin = rampup_begin_step
+        self._rampup_step = max(rampup_step, 1)
+        self._sparsity = list(sparsity) or [0.999]
+        self._step_count = 0
+        self._u = {}
+        self._v = {}
+
+    # ---- schedule ----
+    def current_sparsity(self) -> float:
+        """Piecewise ramp: before rampup_begin no compression; then walk the
+        sparsity list across rampup_step steps; stay at the last value."""
+        s = self._step_count
+        if s < self._rampup_begin:
+            return 0.0
+        phase = (s - self._rampup_begin) / self._rampup_step
+        idx = min(int(phase * len(self._sparsity)), len(self._sparsity) - 1)
+        return float(self._sparsity[idx])
+
+    @staticmethod
+    def _topk_mask(v: jnp.ndarray, keep: int) -> jnp.ndarray:
+        flat = jnp.abs(v).ravel()
+        if keep >= flat.size:
+            return jnp.ones_like(v)
+        thresh = jnp.sort(flat)[flat.size - keep]
+        return (jnp.abs(v) >= thresh).astype(v.dtype)
+
+    @no_grad()
+    def step(self):
+        self._step_count += 1
+        sparsity = self.current_sparsity()
+        for p in self._inner._parameter_list or []:
+            if p.stop_gradient or p.grad is None:
+                continue
+            g = p.grad.data.astype(jnp.float32)
+            pid = id(p)
+            u = self._u.get(pid)
+            v = self._v.get(pid)
+            if u is None:
+                u = jnp.zeros_like(g)
+                v = jnp.zeros_like(g)
+            u = self._momentum * u + g
+            v = v + u
+            if sparsity > 0.0 and g.size > 1:
+                keep = max(int(round(g.size * (1.0 - sparsity))), 1)
+                mask = self._topk_mask(v, keep)
+                g_out = v * mask
+                v = v * (1.0 - mask)
+                u = u * (1.0 - mask)
+            else:
+                g_out = v
+                v = jnp.zeros_like(v)
+            self._u[pid] = u
+            self._v[pid] = v
+            p.grad.data = g_out.astype(p.grad.data.dtype)
+        self._inner.step()
+
+    def minimize(self, loss, *args, **kwargs):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._inner._parameter_list or []]
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+def maybe_wrap_dgc(optimizer, strategy):
+    """dgc_optimizer.py gate: only collective mode + Momentum inner opt."""
+    from ...optimizer.optimizer import Momentum
+    if not getattr(strategy, "dgc", False):
+        return optimizer
+    if not isinstance(optimizer, Momentum):
+        import warnings
+        warnings.warn("strategy.dgc applies to Momentum only; keeping the "
+                      "user optimizer", stacklevel=2)
+        return optimizer
+    cfg = strategy.dgc_configs
+    return DGCMomentum(
+        learning_rate=optimizer._learning_rate,
+        momentum=optimizer._momentum,
+        parameters=optimizer._parameter_list,
+        rampup_begin_step=cfg.rampup_begin_step,
+        rampup_step=cfg.rampup_step,
+        sparsity=cfg.sparsity,
+        grad_clip=optimizer._grad_clip)

@@ -65,11 +65,18 @@ class MultiTrainer:
     def train_from_dataset(self, dataset: Iterable, epochs: int = 1,
                            batch_decoder: Optional[Callable] = None):
         last = None
-        for _ in range(epochs):
+        for epoch in range(epochs):
+            before = self.worker.steps
             it = iter(dataset)
             if batch_decoder is not None:
                 it = (batch_decoder(b) for b in it)
             last = self.worker.run(it)
+            if epochs > 1 and epoch > 0 and self.worker.steps == before:
+                raise ValueError(
+                    f"dataset yielded no batches in epoch {epoch + 1}: "
+                    "one-shot iterators (generators) exhaust after the first "
+                    "epoch — pass a re-iterable (list, DataLoader, "
+                    "RecordFileDataset) for epochs > 1")
         return last
 
     @property

@@ -1,0 +1,72 @@
+"""DGC momentum-corrected top-k gradient compression."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import DistributedStrategy, fleet
+from paddle_tpu.distributed.fleet.dgc import DGCMomentum, maybe_wrap_dgc
+
+
+def test_topk_sparsification_and_error_feedback():
+    w = paddle.core.tensor.Parameter(np.zeros(10, np.float32))
+    opt = DGCMomentum(learning_rate=1.0, momentum=0.0, parameters=[w],
+                      sparsity=[0.8])  # keep top 20% = 2 of 10
+    g = np.asarray([5, 4, 3, 2, 1, 1, 1, 1, 1, 1], np.float32)
+    w.grad = paddle.Tensor(g.copy())
+    opt.step()
+    # only the top-2 components applied this step
+    applied = -np.asarray(w.numpy())
+    assert np.count_nonzero(applied) == 2
+    np.testing.assert_allclose(applied[[0, 1]], [5, 4])
+    # the rest fed back into the error accumulator, applied later
+    w.grad = paddle.Tensor(np.zeros(10, np.float32))
+    opt.step()
+    applied2 = -np.asarray(w.numpy())
+    assert np.count_nonzero(applied2) > 2  # residuals eventually drain
+
+
+def test_rampup_schedule():
+    w = paddle.core.tensor.Parameter(np.zeros(4, np.float32))
+    opt = DGCMomentum(learning_rate=0.1, parameters=[w],
+                      rampup_begin_step=2, rampup_step=2,
+                      sparsity=[0.5, 0.75])
+    assert opt.current_sparsity() == 0.0  # before rampup
+    opt._step_count = 2
+    assert opt.current_sparsity() == 0.5
+    opt._step_count = 3
+    assert opt.current_sparsity() == 0.75
+    opt._step_count = 100
+    assert opt.current_sparsity() == 0.75
+
+
+def test_dgc_training_converges():
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    opt = DGCMomentum(learning_rate=0.05, momentum=0.9,
+                      parameters=model.parameters(), sparsity=[0.75])
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(32, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(32, 4).astype(np.float32))
+    losses = []
+    for _ in range(40):
+        loss = nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_fleet_gates_dgc_on_momentum():
+    s = DistributedStrategy()
+    s.dgc = True
+    m = nn.Linear(4, 4)
+    mom = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=m.parameters())
+    wrapped = maybe_wrap_dgc(mom, s)
+    assert isinstance(wrapped, DGCMomentum)
+    adam = optimizer.Adam(learning_rate=0.1, parameters=m.parameters())
+    with pytest.warns(UserWarning, match="Momentum only"):
+        kept = maybe_wrap_dgc(adam, s)
+    assert kept is adam
