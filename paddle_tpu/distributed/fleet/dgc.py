@@ -37,13 +37,16 @@ class DGCMomentum:
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  rampup_begin_step=0, rampup_step=1,
                  sparsity: Sequence[float] = (0.999,), grad_clip=None,
-                 name=None):
+                 weight_decay=None, use_nesterov=False,
+                 multi_precision=False, name=None):
         from ...optimizer.optimizer import SGD
         # the momentum correction lives in DGC's own u buffer, so the inner
         # update is plain SGD on the sparsified gradient
         self._inner = SGD(learning_rate=learning_rate, parameters=parameters,
-                          grad_clip=grad_clip)
+                          grad_clip=grad_clip, weight_decay=weight_decay,
+                          multi_precision=multi_precision)
         self._momentum = momentum
+        self._use_nesterov = use_nesterov
         self._rampup_begin = rampup_begin_step
         self._rampup_step = max(rampup_step, 1)
         self._sparsity = list(sparsity) or [0.999]
@@ -85,7 +88,9 @@ class DGCMomentum:
                 u = jnp.zeros_like(g)
                 v = jnp.zeros_like(g)
             u = self._momentum * u + g
-            v = v + u
+            # nesterov momentum correction (dgc_op.cc use_nesterov branch):
+            # the transmitted quantity looks one momentum step ahead
+            v = v + (g + self._momentum * u if self._use_nesterov else u)
             if sparsity > 0.0 and g.size > 1:
                 keep = max(int(round(g.size * (1.0 - sparsity))), 1)
                 mask = self._topk_mask(v, keep)
@@ -104,6 +109,29 @@ class DGCMomentum:
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._inner._parameter_list or []]
+
+    # ---- checkpointing: u/v residuals carry un-transmitted gradient mass
+    # and the step count drives the rampup — all must survive a resume ----
+    def state_dict(self):
+        params = self._inner._parameter_list or []
+        order = {id(p): i for i, p in enumerate(params)}
+        return {
+            "step_count": self._step_count,
+            "u": {order[pid]: np.asarray(a) for pid, a in self._u.items()
+                  if pid in order},
+            "v": {order[pid]: np.asarray(a) for pid, a in self._v.items()
+                  if pid in order},
+        }
+
+    def set_state_dict(self, state):
+        params = self._inner._parameter_list or []
+        self._step_count = int(state.get("step_count", 0))
+        self._u = {id(params[int(i)]): jnp.asarray(a)
+                   for i, a in state.get("u", {}).items()}
+        self._v = {id(params[int(i)]): jnp.asarray(a)
+                   for i, a in state.get("v", {}).items()}
+
+    load_state_dict = set_state_dict
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
@@ -127,4 +155,7 @@ def maybe_wrap_dgc(optimizer, strategy):
         rampup_begin_step=cfg.rampup_begin_step,
         rampup_step=cfg.rampup_step,
         sparsity=cfg.sparsity,
-        grad_clip=optimizer._grad_clip)
+        grad_clip=optimizer._grad_clip,
+        weight_decay=optimizer._weight_decay,
+        use_nesterov=getattr(optimizer, "_nesterov", False),
+        multi_precision=getattr(optimizer, "_multi_precision", False))

@@ -434,20 +434,21 @@ def parallelize(model: Layer, optimizer=None, mesh: Optional[Mesh] = None,
             cfg = getattr(strategy, "pipeline_configs", None)
             if cfg is not None and getattr(cfg, "accumulate_steps", 0) >= 1:
                 n_micro = cfg.accumulate_steps
-            if plan.zero_stage:
+            if plan.zero_stage >= 2:
                 import warnings
                 warnings.warn(
-                    "strategy.sharding (ZeRO) is not composed with the "
-                    "pipeline path yet: parameters and optimizer state are "
-                    "replicated across the sharding axis under pp_degree>1",
-                    stacklevel=2)
+                    "pp x ZeRO composes as optimizer-state sharding "
+                    "(stage-1 semantics): gradients stay replicated across "
+                    "the sharding axis on the pipeline path", stacklevel=2)
         if loss_fn is not None:
             raise ValueError(
                 "parallelize(pp_degree>1) pipelines causal-LM models with "
                 "their built-in loss head; custom loss_fn is not supported "
                 "on the pipeline path yet")
         return PipelinedTrainStep(model, plan.optimizer or optimizer, mesh,
-                                  n_micro=n_micro)
+                                  n_micro=n_micro,
+                                  zero_stage=plan.zero_stage,
+                                  min_shard_numel=plan.zero_min_numel)
     if plan.localsgd_k:
         from .localsgd import LocalSGDTrainStep
         return LocalSGDTrainStep(model, plan.optimizer or optimizer, mesh,

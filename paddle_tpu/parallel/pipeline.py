@@ -238,12 +238,14 @@ class PipelinedTrainStep:
     """
 
     def __init__(self, model, optimizer, mesh: Mesh, n_micro: int = 4,
-                 remat: bool = True):
+                 remat: bool = True, zero_stage: int = 0,
+                 min_shard_numel: int = 1024):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.n_micro = n_micro
         self.n_stages = mesh.shape[PIPE_AXIS]
+        self.zero_stage = zero_stage
         self._step_count = 0
 
         # --- split params: per-layer decoder params vs the rest ---
@@ -278,6 +280,82 @@ class PipelinedTrainStep:
         apply_fn = optimizer.apply_gradients_fn()
         clip_fn = optimizer.clip_gradients_fn()
         self._buffers = buffers
+
+        # --- ZeRO composition over the `sharding` axis (pp x zero) ---
+        # Optimizer-state sharding only (stage-1 semantics; gradients stay
+        # pipe-replicated — see the parallelize() warning for stage >= 2).
+        # Per flat param: the dim to shard slots over. Stacked params skip
+        # dim 0 (the per-stage layer dim the stage scan walks); tiny tensors
+        # replicate.
+        sh_n = mesh.shape.get("sharding", 1)
+        use_zero = zero_stage >= 1 and sh_n > 1
+        if use_zero:
+            from ..optimizer.optimizer import Lamb, LarsMomentum
+            if isinstance(optimizer, (Lamb, LarsMomentum)):
+                # these rules compute whole-parameter norms (trust ratios);
+                # feeding per-rank chunks would silently change the algorithm
+                import warnings
+                warnings.warn(
+                    "pp x ZeRO does not compose with norm-based optimizers "
+                    "(Lamb/LarsMomentum): trust ratios need whole-parameter "
+                    "norms. Keeping optimizer state replicated.",
+                    stacklevel=3)
+                use_zero = False
+        self._use_zero = use_zero
+        import numpy as np
+
+        def _zdim(local_shape, first_dim):
+            if int(np.prod(local_shape)) < min_shard_numel:
+                return None
+            for d in range(first_dim, len(local_shape)):
+                if local_shape[d] % sh_n == 0 and local_shape[d] >= sh_n:
+                    return d
+            return None
+
+        zdim = {}  # in APPLY-leaf coordinates (stacked leaves keep the
+        # pipe-sliced size-1 dim 0, then the scan dim 1, then param dims)
+        if use_zero:
+            for k, v in rest.items():
+                zdim[k] = _zdim(v.shape, 0)
+            for k, v in stacked.items():
+                d = _zdim(v.shape[1:], 1)  # local = global[1:]; skip scan dim
+                zdim[f"__stack__{k}"] = None if d is None else d + 1
+        wd_zero = (float(optimizer._weight_decay)
+                   if not callable(optimizer._weight_decay) else 0.0)
+
+        def _zero_apply(flat_params, flat_grads, opt_state, lr, step):
+            """ZeRO-sharded update inside shard_map: each sharding rank owns
+            a slice of every large param's optimizer state, updates only its
+            slice, and all-gathers the new params (sharding_optimizer.py
+            broadcast-on-use semantics made explicit). Unsharded keys go
+            through the optimizer's own apply_gradients_fn."""
+            idx = lax.axis_index("sharding")
+            plain = {k for k in flat_params if zdim.get(k) is None}
+            new_flat, _new_opt = apply_fn(
+                {k: flat_params[k] for k in plain},
+                {k: g for k, g in flat_grads.items() if k in plain},
+                {k: opt_state[k] for k in plain}, lr, step)
+            new_opt = dict(_new_opt)
+            for k, p in flat_params.items():
+                if k in plain:
+                    continue
+                g = flat_grads.get(k)
+                if g is None:
+                    new_flat[k], new_opt[k] = p, opt_state[k]
+                    continue
+                slots = dict(opt_state[k])
+                slots["_step"] = step
+                d = zdim[k]
+                chunk = p.shape[d] // sh_n
+                g_own = lax.dynamic_slice_in_dim(g, idx * chunk, chunk, d)
+                p_own = lax.dynamic_slice_in_dim(p, idx * chunk, chunk, d)
+                p_own_new, ns_ = optimizer._rule_mp(g_own, p_own, slots,
+                                                    lr, wd_zero)
+                np_ = lax.all_gather(p_own_new, "sharding", axis=d,
+                                     tiled=True)
+                ns_.pop("_step", None)
+                new_flat[k], new_opt[k] = np_, ns_
+            return new_flat, new_opt
 
         layer_fn = self._make_layer_fn()
         embed_fn = self._make_embed_fn()
@@ -345,8 +423,12 @@ class PipelinedTrainStep:
                           **{f"__stack__{k}": v for k, v in g_stacked.items()}}
             if not use_pipe_clip:
                 flat_grads = clip_fn(flat_grads)
-            new_flat, new_opt = apply_fn(flat_params, flat_grads, opt_state,
-                                         lr, step)
+            if use_zero:
+                new_flat, new_opt = _zero_apply(flat_params, flat_grads,
+                                                opt_state, lr, step)
+            else:
+                new_flat, new_opt = apply_fn(flat_params, flat_grads,
+                                             opt_state, lr, step)
             new_rest = {k: v for k, v in new_flat.items()
                         if not k.startswith("__stack__")}
             new_stacked = {k[len("__stack__"):]: v
@@ -354,16 +436,34 @@ class PipelinedTrainStep:
                            if k.startswith("__stack__")}
             return loss, new_stacked, new_rest, new_opt
 
-        # optimizer slots whose shape matches a stacked param are stage-sharded
+        # optimizer slots whose shape matches a stacked param are stage-
+        # sharded over pipe; under ZeRO, param-shaped slots additionally
+        # shard their zdim over `sharding` (each rank holds only its chunk)
+        def _slot_spec(ndim, pipe_dim0, zd):
+            # zd is already in apply-leaf coordinates, which match the global
+            # slot layout ([n_stages, per_stage, ...] vs [1, per_stage, ...])
+            axes = [None] * ndim
+            if pipe_dim0:
+                axes[0] = PIPE_AXIS
+            if zd is not None:
+                axes[zd] = "sharding"
+            return P(*axes)
+
         opt_specs = {}
         for k, slots in opt_all.items():
+            zd = zdim.get(k) if use_zero else None
             if k.startswith("__stack__"):
                 base = k[len("__stack__"):]
                 opt_specs[k] = {
-                    s: (P(PIPE_AXIS) if a.ndim == stacked[base].ndim else P())
+                    s: (_slot_spec(a.ndim, True, zd)
+                        if a.ndim == stacked[base].ndim else P())
                     for s, a in slots.items()}
             else:
-                opt_specs[k] = {s: P() for s in slots}
+                ref_ndim = rest[k].ndim
+                opt_specs[k] = {
+                    s: (_slot_spec(a.ndim, False, zd)
+                        if a.ndim == ref_ndim and a.ndim > 0 else P())
+                    for s, a in slots.items()}
 
         def put(arr, spec):
             return jax.device_put(arr, NamedSharding(mesh, spec))

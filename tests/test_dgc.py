@@ -58,6 +58,42 @@ def test_dgc_training_converges():
     assert losses[-1] < losses[0] * 0.5
 
 
+def test_dgc_checkpoint_roundtrip_preserves_residuals():
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    opt = DGCMomentum(learning_rate=0.05, momentum=0.9,
+                      parameters=model.parameters(), sparsity=[0.75])
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(16, 4).astype(np.float32))
+    for _ in range(5):
+        loss = nn.functional.mse_loss(model(x), y)
+        loss.backward(); opt.step(); opt.clear_grad()
+    state = opt.state_dict()
+    assert state["step_count"] == 5
+    assert state["u"] and state["v"]
+    opt2 = DGCMomentum(learning_rate=0.05, momentum=0.9,
+                       parameters=model.parameters(), sparsity=[0.75])
+    opt2.set_state_dict(state)
+    assert opt2._step_count == 5
+    for i, p in enumerate(model.parameters()):
+        np.testing.assert_allclose(np.asarray(opt2._u[id(p)]),
+                                   state["u"][i])
+
+
+def test_dgc_preserves_momentum_knobs():
+    m = nn.Linear(4, 4)
+    s = DistributedStrategy()
+    s.dgc = True
+    mom = optimizer.Momentum(learning_rate=0.1, momentum=0.8,
+                             use_nesterov=True, weight_decay=1e-4,
+                             parameters=m.parameters())
+    wrapped = maybe_wrap_dgc(mom, s)
+    assert wrapped._use_nesterov
+    assert wrapped._momentum == 0.8
+    assert wrapped._inner._weight_decay == 1e-4
+
+
 def test_fleet_gates_dgc_on_momentum():
     s = DistributedStrategy()
     s.dgc = True

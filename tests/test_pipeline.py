@@ -197,6 +197,63 @@ def test_1f1b_global_norm_clip_parity():
     np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-4)
 
 
+def test_1f1b_composes_with_zero_sharded_optimizer_state():
+    """pp x ZeRO: Adam moments sharded over `sharding`, loss parity kept."""
+    paddle.seed(0)
+    model = LlamaForCausalLM.from_preset("llama2-tiny", num_hidden_layers=2)
+    cfg = model.config
+    rng = np.random.RandomState(0)
+    B, S = 8, 16
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+
+    def build(zero):
+        paddle.seed(0)
+        m = LlamaForCausalLM.from_preset("llama2-tiny", num_hidden_layers=2)
+        opt = optim.Adam(learning_rate=1e-2, parameters=m.parameters())
+        devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+        mesh = Mesh(devs, ("data", "sharding", "pipe"))
+        return PipelinedTrainStep(m, opt, mesh, n_micro=2, zero_stage=zero,
+                                  min_shard_numel=0)
+
+    plain = build(0)
+    zero = build(1)
+    assert zero._use_zero
+    # moment slots for large params are physically sharded over `sharding`
+    sharded = [
+        (k, s) for k, slots in zero._opt_state.items()
+        for s, a in slots.items()
+        if "sharding" in str(a.sharding.spec)]
+    assert sharded, "no optimizer slot carries the sharding axis"
+    # per-device slot bytes shrink ~2x for the sharded slots
+    for (k, s) in sharded[:3]:
+        full = plain._opt_state[k][s]
+        shrd = zero._opt_state[k][s]
+        full_local = max(sh.data.size for sh in full.addressable_shards)
+        shrd_local = max(sh.data.size for sh in shrd.addressable_shards)
+        assert shrd_local * 2 == full_local, (k, s)
+    # numerics unchanged
+    l_plain = [float(plain(ids, labels).item()) for _ in range(3)]
+    l_zero = [float(zero(ids, labels).item()) for _ in range(3)]
+    np.testing.assert_allclose(l_zero, l_plain, rtol=1e-4, atol=1e-4)
+
+
+def test_parallelize_routes_zero_into_pipeline():
+    from paddle_tpu.distributed import DistributedStrategy
+    from paddle_tpu.parallel.api import parallelize
+    paddle.seed(0)
+    model = LlamaForCausalLM.from_preset("llama2-tiny", num_hidden_layers=2)
+    opt = optim.Adam(learning_rate=1e-2, parameters=model.parameters())
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("data", "sharding", "pipe"))
+    s = DistributedStrategy()
+    s.sharding = True
+    s.sharding_configs = {"stage": 1, "min_shard_numel": 0}
+    step = parallelize(model, opt, mesh=mesh, strategy=s)
+    assert isinstance(step, PipelinedTrainStep)
+    assert step._use_zero
+
+
 def test_pipeline_batch_divisibility_error():
     paddle.seed(0)
     model = LlamaForCausalLM.from_preset("llama2-tiny")
