@@ -75,13 +75,30 @@ class Optimizer:
         return new_master.astype(p.dtype), new_slots
 
     def _wd_for(self, param) -> float:
+        from ..regularizer import L1Decay, L2Decay
         wd = self._weight_decay
-        if hasattr(wd, "__call__") and not isinstance(wd, (int, float)):
-            return 0.0
         # honor per-param no-decay lists used by models (bias/norm exclusion)
         if getattr(param, "no_weight_decay", False):
             return 0.0
+        if isinstance(wd, L2Decay):
+            return wd.coeff
+        if isinstance(wd, L1Decay):
+            return 0.0  # folded into the gradient by _reg_grad instead
+        if hasattr(wd, "__call__") and not isinstance(wd, (int, float)):
+            return 0.0
         return float(wd)
+
+    def _reg_grad(self, g, p, no_decay=False):
+        """Fold non-L2 regularizer penalties into the gradient (the static
+        reference appends these ops before the optimizer op). Honors the
+        same per-param no_weight_decay exclusion as _wd_for."""
+        from ..regularizer import L1Decay
+        if no_decay:
+            return g
+        if isinstance(self._weight_decay, L1Decay):
+            return g + self._weight_decay.coeff * jnp.sign(
+                p.astype(g.dtype))
+        return g
 
     # ---- eager step ----
     @no_grad()
@@ -100,8 +117,10 @@ class Optimizer:
             slots = self._state[pid]
             lr = self.get_lr() * getattr(p, "optimize_attr",
                                          {"learning_rate": 1.0})["learning_rate"]
-            new_p, new_slots = self._rule_mp(g.data, p.data, slots, lr,
-                                            self._wd_for(p))
+            new_p, new_slots = self._rule_mp(
+                self._reg_grad(g.data, p.data,
+                               getattr(p, "no_weight_decay", False)),
+                p.data, slots, lr, self._wd_for(p))
             p.data = new_p
             self._state[pid] = new_slots
 
@@ -165,7 +184,14 @@ class Optimizer:
         optimizer's scalar setting for every param (per-param exclusions are an
         eager-path feature).
         """
-        wd = float(self._weight_decay) if not callable(self._weight_decay) else 0.0
+        from ..regularizer import L2Decay, WeightDecayRegularizer
+        if isinstance(self._weight_decay, L2Decay):
+            wd = self._weight_decay.coeff
+        elif isinstance(self._weight_decay, WeightDecayRegularizer) or \
+                callable(self._weight_decay):
+            wd = 0.0  # L1 is folded into the gradient by _reg_grad
+        else:
+            wd = float(self._weight_decay)
 
         def apply_fn(params, grads, state, lr, step):
             new_params, new_state = {}, {}
@@ -177,7 +203,8 @@ class Optimizer:
                     continue
                 ctx_slots = dict(state[k])
                 ctx_slots["_step"] = step
-                np_, ns_ = self._rule_mp(g, p, ctx_slots, lr, wd)
+                np_, ns_ = self._rule_mp(self._reg_grad(g, p), p, ctx_slots,
+                                         lr, wd)
                 ns_.pop("_step", None)
                 new_params[k] = np_
                 new_state[k] = ns_
@@ -274,21 +301,17 @@ class Adam(Optimizer):
         return False
 
     def _rule(self, g, p, slots, lr, wd):
-        g = g.astype(jnp.float32)
-        p32 = p.astype(jnp.float32)
-        if wd and not self._decoupled():
-            g = g + wd * p32
         b1, b2 = self._beta1, self._beta2
         b1p = slots["beta1_pow"] * b1
         b2p = slots["beta2_pow"] * b2
-        m1 = b1 * slots["moment1"] + (1 - b1) * g
-        m2 = b2 * slots["moment2"] + (1 - b2) * g * g
-        m1_hat = m1 / (1 - b1p)
-        m2_hat = m2 / (1 - b2p)
-        update = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
-        if wd and self._decoupled():
-            update = update + wd * p32
-        new_p = (p32 - lr * update).astype(p.dtype)
+        # one source of truth for the update math: ops.fused_adam dispatches
+        # between the Pallas single-pass kernel (opt-in, adam_op.cu parity)
+        # and the XLA formula internally
+        from ..ops.fused_adam import fused_adam
+        new_p, m1, m2 = fused_adam(
+            p, g, slots["moment1"], slots["moment2"], lr, b1p, b2p,
+            wd or 0.0, beta1=b1, beta2=b2, epsilon=self._epsilon,
+            decoupled=self._decoupled())
         return new_p, {"moment1": m1, "moment2": m2, "beta1_pow": b1p,
                        "beta2_pow": b2p}
 
