@@ -34,6 +34,7 @@ from . import amp  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 from . import checkpoint  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
@@ -46,6 +47,7 @@ from . import nn  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from . import parallel  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import text  # noqa: F401,E402

@@ -40,10 +40,21 @@ class DGCMomentum:
                  weight_decay=None, use_nesterov=False,
                  multi_precision=False, name=None):
         from ...optimizer.optimizer import SGD
+        from ...regularizer import L1Decay, L2Decay
         # the momentum correction lives in DGC's own u buffer, so the inner
-        # update is plain SGD on the sparsified gradient
+        # update is plain SGD on the sparsified gradient. Weight decay is NOT
+        # given to the inner opt: dgc_op.cc folds the regularization term
+        # into the gradient BEFORE momentum correction/top-k, so the decay
+        # mass rides the u/v accumulators like any other gradient mass
+        if isinstance(weight_decay, (L1Decay, L2Decay)):
+            self._decay_kind = ("l1" if isinstance(weight_decay, L1Decay)
+                                else "l2")
+            self._weight_decay = weight_decay.coeff
+        else:
+            self._decay_kind = "l2"
+            self._weight_decay = float(weight_decay or 0.0)
         self._inner = SGD(learning_rate=learning_rate, parameters=parameters,
-                          grad_clip=grad_clip, weight_decay=weight_decay,
+                          grad_clip=grad_clip, weight_decay=None,
                           multi_precision=multi_precision)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
@@ -81,6 +92,11 @@ class DGCMomentum:
             if p.stop_gradient or p.grad is None:
                 continue
             g = p.grad.data.astype(jnp.float32)
+            if self._weight_decay and not getattr(p, "no_weight_decay",
+                                                  False):
+                p32 = p.data.astype(jnp.float32)
+                g = g + self._weight_decay * (
+                    jnp.sign(p32) if self._decay_kind == "l1" else p32)
             pid = id(p)
             u = self._u.get(pid)
             v = self._v.get(pid)
@@ -121,6 +137,10 @@ class DGCMomentum:
                   if pid in order},
             "v": {order[pid]: np.asarray(a) for pid, a in self._v.items()
                   if pid in order},
+            # inner SGD state (LR scheduler position, step count) must
+            # survive a resume too — the rampup and the decayed LR go
+            # together
+            "inner": self._inner.state_dict(),
         }
 
     def set_state_dict(self, state):
@@ -130,6 +150,8 @@ class DGCMomentum:
                    for i, a in state.get("u", {}).items()}
         self._v = {id(params[int(i)]): jnp.asarray(a)
                    for i, a in state.get("v", {}).items()}
+        if "inner" in state:
+            self._inner.set_state_dict(state["inner"])
 
     load_state_dict = set_state_dict
 

@@ -1,0 +1,33 @@
+"""paddle.regularizer parity (reference: python/paddle/regularizer.py —
+L1Decay/L2Decay objects passed as `weight_decay=` to optimizers; the static
+graph appends them to the gradient before the optimizer op).
+
+TPU-native: the optimizer folds the penalty into the gradient inside its
+(jit-able) update rule — L2Decay contributes `coeff * p`, L1Decay
+contributes `coeff * sign(p)` — so both eager `step()` and the functional
+`apply_gradients_fn` path honor them identically.
+"""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """loss += coeff/2 * ||p||^2  ⇒  grad += coeff * p."""
+
+
+class L1Decay(WeightDecayRegularizer):
+    """loss += coeff * ||p||_1  ⇒  grad += coeff * sign(p)."""
+
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]

@@ -91,7 +91,10 @@ def test_dgc_preserves_momentum_knobs():
     wrapped = maybe_wrap_dgc(mom, s)
     assert wrapped._use_nesterov
     assert wrapped._momentum == 0.8
-    assert wrapped._inner._weight_decay == 1e-4
+    # decay is folded into the gradient BEFORE compression (dgc_op.cc
+    # ordering), not applied densely by the inner SGD
+    assert wrapped._weight_decay == 1e-4
+    assert not wrapped._inner._weight_decay
 
 
 def test_fleet_gates_dgc_on_momentum():

@@ -1,0 +1,127 @@
+"""paddle.distribution + paddle.regularizer parity tests (reference:
+python/paddle/distribution.py, python/paddle/regularizer.py,
+tests: unittests/test_distribution.py, test_regularizer.py).
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import Categorical, Normal, Uniform
+
+
+def test_uniform_log_prob_entropy():
+    u = Uniform(1.0, 3.0)
+    lp = u.log_prob(paddle.to_tensor([0.5, 2.0, 3.5]))
+    got = np.asarray(lp.data)
+    assert got[0] == -np.inf and got[2] == -np.inf
+    np.testing.assert_allclose(got[1], -math.log(2.0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(u.probs(
+        paddle.to_tensor([2.0])).data), [0.5], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(u.entropy().data), math.log(2.0),
+                               rtol=1e-6)
+
+
+def test_uniform_sample_range_and_shape():
+    u = Uniform(paddle.to_tensor([0.0, 10.0]), paddle.to_tensor([1.0, 20.0]))
+    s = u.sample((500,), seed=7)
+    arr = np.asarray(s.data)
+    assert arr.shape == (500, 2)
+    assert (arr[:, 0] >= 0).all() and (arr[:, 0] < 1).all()
+    assert (arr[:, 1] >= 10).all() and (arr[:, 1] < 20).all()
+    # seeded draws reproduce
+    s2 = u.sample((500,), seed=7)
+    np.testing.assert_array_equal(arr, np.asarray(s2.data))
+
+
+def test_normal_log_prob_entropy_kl():
+    n = Normal(0.0, 1.0)
+    lp = float(n.log_prob(paddle.to_tensor([0.0])).data[0])
+    np.testing.assert_allclose(lp, -0.5 * math.log(2 * math.pi), rtol=1e-6)
+    ent = float(n.entropy().data)
+    np.testing.assert_allclose(ent, 0.5 + 0.5 * math.log(2 * math.pi),
+                               rtol=1e-6)
+    m = Normal(1.0, 2.0)
+    kl = float(n.kl_divergence(m).data)
+    # closed form: log(s2/s1) + (s1^2 + (mu1-mu2)^2)/(2 s2^2) - 1/2
+    want = math.log(2.0) + (1.0 + 1.0) / 8.0 - 0.5
+    np.testing.assert_allclose(kl, want, rtol=1e-6)
+    assert float(n.kl_divergence(Normal(0.0, 1.0)).data) == pytest.approx(
+        0.0, abs=1e-7)
+
+
+def test_normal_sample_moments():
+    n = Normal(2.0, 3.0)
+    s = np.asarray(n.sample((20000,), seed=11).data)
+    np.testing.assert_allclose(s.mean(), 2.0, atol=0.1)
+    np.testing.assert_allclose(s.std(), 3.0, atol=0.1)
+
+
+def test_categorical_entropy_kl_probs():
+    logits = paddle.to_tensor([1.0, 2.0, 3.0])
+    c = Categorical(logits)
+    p = np.exp([1.0, 2.0, 3.0])
+    p = p / p.sum()
+    np.testing.assert_allclose(float(c.entropy().data),
+                               -(p * np.log(p)).sum(), rtol=1e-5)
+    c2 = Categorical(paddle.to_tensor([0.0, 0.0, 0.0]))
+    q = np.ones(3) / 3
+    np.testing.assert_allclose(float(c.kl_divergence(c2).data),
+                               (p * np.log(p / q)).sum(), rtol=1e-5)
+    probs = np.asarray(c.probs(paddle.to_tensor([0, 2])).data)
+    np.testing.assert_allclose(probs, p[[0, 2]], rtol=1e-5)
+    lp = np.asarray(c.log_prob(paddle.to_tensor([1])).data)
+    np.testing.assert_allclose(lp, np.log(p[1]), rtol=1e-5)
+
+
+def test_categorical_sample_distribution():
+    c = Categorical(paddle.to_tensor([0.0, math.log(3.0)]))
+    s = np.asarray(c.sample((8000,), seed=3).data)
+    frac_one = (s == 1).mean()
+    np.testing.assert_allclose(frac_one, 0.75, atol=0.03)
+
+
+# ---------------- regularizer ----------------
+
+def test_l2_decay_matches_float_weight_decay():
+    import paddle_tpu.nn as nn
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.regularizer import L2Decay
+
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(8, 4).astype(np.float32)
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+
+    def run(wd):
+        lin = nn.Linear(8, 4)
+        lin.weight.set_value(w0)
+        opt = optim.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=lin.parameters(), weight_decay=wd)
+        for _ in range(3):
+            loss = paddle.mean(lin(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return lin.weight.numpy()
+
+    np.testing.assert_allclose(run(L2Decay(0.05)), run(0.05), rtol=1e-6)
+
+
+def test_l1_decay_changes_update_by_sign():
+    import paddle_tpu.nn as nn
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.regularizer import L1Decay
+
+    w0 = np.array([[2.0, -2.0]], dtype=np.float32)
+    lin = nn.Linear(1, 2, bias_attr=False)
+    lin.weight.set_value(w0)
+    opt = optim.SGD(learning_rate=0.1, parameters=lin.parameters(),
+                    weight_decay=L1Decay(0.5))
+    x = paddle.to_tensor(np.zeros((1, 1), np.float32))
+    loss = paddle.mean(lin(x))  # zero gradient w.r.t. weight
+    loss.backward()
+    opt.step()
+    # update is purely the L1 term: w -= lr * coeff * sign(w)
+    np.testing.assert_allclose(lin.weight.numpy(),
+                               [[2.0 - 0.05, -2.0 + 0.05]], rtol=1e-6)
