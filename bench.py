@@ -89,6 +89,7 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
     # size to the hardware: single-chip CI uses gpt3-125m bf16
     preset = "gpt3-125m" if on_tpu else "gpt2-tiny"
     B, S = (8, 1024) if on_tpu else (2, 128)
+    preset = os.environ.get("BENCH_PRESET", preset)
     B = int(os.environ.get("BENCH_BS", B))
     S = int(os.environ.get("BENCH_SEQ", S))
     paddle.seed(0)

@@ -196,6 +196,22 @@ class GPTForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None):
         hidden, total_aux = self.gpt(input_ids)
+        if labels is not None and self._can_fuse_lm_ce():
+            # chunked lm-head+CE: never materializes the [B,S,V] logits
+            # (ops/softmax_ce.py); identical numerics to the dense path
+            import jax.numpy as jnp
+            from ..core.tensor import apply
+            from ..ops.softmax_ce import fused_linear_cross_entropy
+
+            def f(h, w, y):
+                hs = h.reshape(-1, h.shape[-1])
+                loss = fused_linear_cross_entropy(hs, w, y.reshape(-1))
+                return jnp.mean(loss)
+
+            loss = apply(f, hidden, self.lm_head.weight, labels)
+            if total_aux is not None:
+                loss = loss + total_aux * self.config.moe_aux_loss_weight
+            return loss
         logits = self.lm_head(hidden)
         if labels is not None:
             from ..tensor.math import mean
@@ -204,6 +220,16 @@ class GPTForCausalLM(Layer):
                 loss = loss + total_aux * self.config.moe_aux_loss_weight
             return loss
         return logits
+
+    @staticmethod
+    def _can_fuse_lm_ce():
+        import os
+        if os.environ.get("FLAGS_fused_lm_ce", "1") != "1":
+            return False
+        from ..distributed.meta_parallel.mp_layers import (_explicit_tp,
+                                                           _mp_degree)
+        # vocab-sharded weights keep the ParallelCrossEntropy path
+        return not _explicit_tp() and _mp_degree() <= 1
 
     @classmethod
     def from_preset(cls, name: str, **overrides):

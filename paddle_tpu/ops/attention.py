@@ -536,6 +536,13 @@ def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    import os
+    if os.environ.get("FLAGS_flash_attention", "1") == "0" and \
+            not force_pallas:
+        key = jax.random.PRNGKey(jnp.asarray(dropout_seed, jnp.uint32)) \
+            if dropout_p > 0.0 else None
+        return _attention_reference(q, k, v, causal, scale, mask, dropout_p,
+                                    key)
     on_tpu = jax.default_backend() not in ("cpu",)
     long_seq = q.shape[2] >= 512
     Sq, Sk = q.shape[2], k.shape[2]

@@ -1,0 +1,108 @@
+"""Memory-efficient fused lm-head + softmax cross-entropy (reference:
+operators/collective/c_softmax_with_cross_entropy_op.cu computes the CE
+against sharded logits without gathering them; operators/math/cross_entropy
++ softmax_op are the dense pair this replaces).
+
+TPU-native design: the [N, V] logits of a causal-LM head are the single
+largest activation of the model (B·S·V fp32 ≈ 1.6 GB for GPT-125M at
+bs8/seq1024) and are consumed only by the loss. This op never materializes
+them: a lax.scan walks vocab chunks, computing the chunk's logits on the
+MXU in the compute dtype, reducing a running (max, sumexp, target-logit)
+triple in fp32. The backward recomputes each chunk's logits (flash-style
+rematerialization), forms d_logits = (softmax - onehot)·g chunk-by-chunk
+and immediately contracts it into dh and dW — peak live memory is one
+[N, V/chunks] block instead of [N, V].
+
+FLOPs: +2·N·H·V recompute over the unfused 6·N·H·V — repaid by removing
+~5 full-logits HBM round trips. The vocab is padded to a multiple of the
+chunk count (one [H, pad] zero-append, ~0.2 ms for GPT-125M) so every
+chunk is uniform; padded columns are masked to -inf.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_linear_cross_entropy(h, w, labels, ignore_index=-100, n_chunks=8):
+    """h: [N, H] (compute dtype); w: [H, V]; labels: [N] int. Returns
+    per-token loss [N] fp32 with `ignore_index` tokens contributing 0.
+    Equivalent to softmax_with_cross_entropy(h @ w, labels) without ever
+    materializing the [N, V] logits."""
+    loss, _ = _fwd(h, w, labels, ignore_index, n_chunks)
+    return loss
+
+
+def _padded(w, n_chunks):
+    V = w.shape[1]
+    C = -(-V // n_chunks)
+    pad = n_chunks * C - V
+    if pad:
+        w = jnp.pad(w, ((0, 0), (0, pad)))
+    return w, C
+
+
+def _fwd(h, w, labels, ignore_index, n_chunks):
+    N, H = h.shape
+    V = w.shape[1]
+    wp, C = _padded(w, n_chunks)
+    labels = labels.astype(jnp.int32).reshape(N)
+    # [n_chunks, H, C] so the scan carries no dynamic slicing
+    wcs = jnp.moveaxis(wp.reshape(H, n_chunks, C), 1, 0)
+
+    def body(carry, xs):
+        m, s, tl = carry
+        c, w_c = xs
+        lg = jnp.dot(h, w_c,
+                     preferred_element_type=jnp.float32)  # [N, C] fp32
+        cols = c * C + lax.broadcasted_iota(jnp.int32, (1, C), 1)
+        lg = jnp.where(cols < V, lg, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(lg, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(lg - m_new[:, None]), axis=-1)
+        tl = tl + jnp.sum(jnp.where(cols == labels[:, None], lg, 0.0),
+                          axis=-1)
+        return (m_new, s, tl), None
+
+    init = (jnp.full((N,), -jnp.inf, jnp.float32),
+            jnp.zeros((N,), jnp.float32),
+            jnp.zeros((N,), jnp.float32))
+    (m, s, tl), _ = lax.scan(body, init, (jnp.arange(n_chunks), wcs))
+    loss = (m + jnp.log(s)) - tl
+    loss = jnp.where(labels == ignore_index, 0.0, loss)
+    return loss, (h, w, labels, m + jnp.log(s))
+
+
+def _bwd(ignore_index, n_chunks, res, g):
+    h, w, labels, lse = res
+    N, H = h.shape
+    V = w.shape[1]
+    wp, C = _padded(w, n_chunks)
+    wcs = jnp.moveaxis(wp.reshape(H, n_chunks, C), 1, 0)
+    gv = jnp.where(labels == ignore_index, 0.0, g).astype(jnp.float32)
+
+    def body(dh, xs):
+        c, w_c = xs
+        lg = jnp.dot(h, w_c, preferred_element_type=jnp.float32)
+        cols = c * C + lax.broadcasted_iota(jnp.int32, (1, C), 1)
+        lg = jnp.where(cols < V, lg, -jnp.inf)
+        p = jnp.exp(lg - lse[:, None])              # softmax chunk, fp32
+        d = (p - (cols == labels[:, None])) * gv[:, None]
+        d16 = d.astype(h.dtype)
+        dh = dh + jnp.dot(d16, w_c.T)
+        dw_c = jnp.dot(h.T, d16)                    # [H, C]
+        return dh, dw_c
+
+    dh, dw_stack = lax.scan(body, jnp.zeros_like(h),
+                            (jnp.arange(n_chunks), wcs))
+    dw = jnp.moveaxis(dw_stack, 0, 1).reshape(H, n_chunks * C)[:, :V]
+    return dh, dw.astype(w.dtype), None
+
+
+fused_linear_cross_entropy.defvjp(
+    lambda h, w, labels, ii, nc: _fwd(h, w, labels, ii, nc),
+    _bwd)
