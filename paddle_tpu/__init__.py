@@ -32,6 +32,12 @@ from .tensor import einsum  # noqa: F401
 
 from . import amp  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
+from . import callbacks  # noqa: F401,E402
+from . import hub  # noqa: F401,E402
+from . import linalg  # noqa: F401,E402
+from . import reader  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from .batch import batch  # noqa: F401,E402
 from . import checkpoint  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402

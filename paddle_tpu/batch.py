@@ -1,0 +1,30 @@
+"""paddle.batch (reference: python/paddle/batch.py — wraps a sample reader
+into a mini-batch reader)."""
+from __future__ import annotations
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Create a batched reader from a sample generator factory.
+
+    reader: callable returning an iterable of samples.
+    Returns a callable returning an iterable of lists of `batch_size`
+    samples (the trailing short batch is kept unless drop_last).
+    """
+    if batch_size <= 0:
+        raise ValueError("batch_size should be a positive integer, "
+                         f"got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+__all__ = ["batch"]

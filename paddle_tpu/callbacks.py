@@ -1,0 +1,8 @@
+"""paddle.callbacks namespace (reference: python/paddle/callbacks.py — the
+hapi callback set re-exported at the package root).
+"""
+from .hapi.callbacks import (Callback, EarlyStopping, LRScheduler,  # noqa
+                             ModelCheckpoint, ProgBarLogger)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRScheduler"]

@@ -212,8 +212,16 @@ class Categorical(Distribution):
         return apply(f, self.logits, value)
 
     def log_prob(self, value):
-        out = self.probs(value)
-        return apply(jnp.log, out)
+        value = _t(value)
+
+        def f(logits, idx):
+            logp = self._log_pmf(logits)  # exact: no exp/log roundtrip
+            if logp.ndim == 1:
+                return logp[idx.astype(jnp.int32)]
+            return jnp.take_along_axis(
+                logp, idx.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+
+        return apply(f, self.logits, value)
 
 
 def kl_divergence(p: Distribution, q: Distribution):

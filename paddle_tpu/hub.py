@@ -1,0 +1,67 @@
+"""paddle.hub parity (reference: python/paddle/hub.py — list/help/load of
+models published via a repo's hubconf.py).
+
+TPU-native stance: local and file:// sources are fully supported (the
+hubconf.py protocol is identical); github/gitee remote sources require
+network egress and raise a clear error in air-gapped environments when the
+download fails.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} found in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _resolve(repo_dir: str, source: str) -> str:
+    if source == "local":
+        return repo_dir
+    if source in ("github", "gitee"):
+        raise RuntimeError(
+            f"hub source '{source}' needs network access; clone the repo "
+            "and use source='local'")
+    raise ValueError(f"unknown hub source {source!r} "
+                     "(expected 'local', 'github' or 'gitee')")
+
+
+def list(repo_dir, source="github", force_reload=False):  # noqa: A001
+    """Entrypoint names exported by the repo's hubconf.py."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    """Docstring of one hubconf entrypoint."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"no entrypoint {model!r} in {repo_dir}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Instantiate a hubconf entrypoint."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"no entrypoint {model!r} in {repo_dir}")
+    return fn(**kwargs)

@@ -1,7 +1,9 @@
 """paddle.incubate analog (reference: python/paddle/incubate/)."""
 from . import asp  # noqa: F401
+from . import optimizer  # noqa: F401
 from ..nn.layer.moe import MoELayer  # noqa: F401
 from ..ops.attention import flash_attention  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
 
 
 def softmax_mask_fuse_upper_triangle(x):

@@ -1,0 +1,153 @@
+"""Incubate optimizers (reference: python/paddle/incubate/optimizer/
+{lookahead,modelaverage}.py — wrappers around an inner optimizer).
+
+LookAhead (k, alpha): keep a slow copy of each parameter; every k inner
+steps move it alpha of the way to the fast weights and reset the fast
+weights to it.
+
+ModelAverage: maintain a running average of parameters over steps;
+apply()/restore() swap the average in and out for evaluation.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core.tensor import no_grad
+
+
+class LookAhead:
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        if not isinstance(k, int) or k <= 0:
+            raise ValueError("k should be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        # slow weights start at the initial fast weights (the reference
+        # initializes the slow copy from the param's startup value)
+        self._slow = {id(p): p.data
+                      for p in (inner_optimizer._parameter_list or [])}
+        self._k_count = 0
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    @no_grad()
+    def step(self):
+        self.inner_optimizer.step()
+        self._k_count += 1
+        params = self._parameter_list or []
+        for p in params:
+            self._slow.setdefault(id(p), p.data)
+        if self._k_count % self.k == 0:
+            for p in params:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p.data - slow)
+                self._slow[id(p)] = slow
+                p.data = slow
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kwargs):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list or []]
+
+    def state_dict(self):
+        params = self._parameter_list or []
+        order = {id(p): i for i, p in enumerate(params)}
+        import numpy as np
+        return {"inner": self.inner_optimizer.state_dict(),
+                "k_count": self._k_count,
+                "slow": {order[pid]: np.asarray(a)
+                         for pid, a in self._slow.items() if pid in order}}
+
+    def set_state_dict(self, state):
+        params = self._parameter_list or []
+        self.inner_optimizer.set_state_dict(state["inner"])
+        self._k_count = int(state.get("k_count", 0))
+        self._slow = {id(params[int(i)]): jnp.asarray(a)
+                      for i, a in state.get("slow", {}).items()}
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+
+class ModelAverage:
+    """Running parameter average (reference modelaverage.py — the
+    min/max_average_window bookkeeping reduces to a windowed running sum;
+    here: uniform average over all steps since the last reset, which is the
+    reference's behavior inside one window)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None, inner_optimizer=None):
+        self.inner_optimizer = inner_optimizer
+        self._params = list(parameters) if parameters is not None else (
+            inner_optimizer._parameter_list if inner_optimizer else [])
+        self.average_window_rate = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._sum = {id(p): jnp.zeros_like(p.data) for p in self._params}
+        self._count = 0
+        self._backup = None
+
+    @no_grad()
+    def step(self):
+        if self.inner_optimizer is not None:
+            self.inner_optimizer.step()
+        self._accumulate()
+
+    def _accumulate(self):
+        self._count += 1
+        window = max(self.min_average_window,
+                     min(self.max_average_window,
+                         int(self._count * self.average_window_rate) or 1))
+        if self._count > window:
+            # restart the window (reference restart semantics)
+            self._sum = {pid: jnp.zeros_like(s)
+                         for pid, s in self._sum.items()}
+            self._count = 1
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p.data
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap in the averaged parameters (context manager, dygraph
+        style)."""
+        self._backup = {id(p): p.data for p in self._params}
+        n = max(self._count, 1)
+        for p in self._params:
+            p.data = (self._sum[id(p)] / n).astype(p.data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p in self._params:
+                p.data = self._backup[id(p)]
+            self._backup = None
+
+    def clear_grad(self, set_to_zero=True):
+        if self.inner_optimizer is not None:
+            self.inner_optimizer.clear_grad(set_to_zero)
+
+    def minimize(self, loss, **kwargs):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._params]
+
+
+__all__ = ["LookAhead", "ModelAverage"]

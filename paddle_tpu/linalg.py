@@ -1,0 +1,14 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py — a re-export
+of the tensor.linalg operator set under the stable `paddle.linalg.*` names).
+"""
+from .tensor.linalg import (bincount, bmm, cholesky, cholesky_solve,  # noqa
+                            cond, corrcoef, cross, det, dist, dot, eig, eigh,
+                            eigvals, eigvalsh, histogram, inv, lu, matmul,
+                            matrix_power, matrix_rank, mm, multi_dot, norm,
+                            pinv, qr, slogdet, solve, svd, t,
+                            triangular_solve)
+
+__all__ = ["cholesky", "cholesky_solve", "cond", "corrcoef", "cross", "det",
+           "dist", "dot", "eig", "eigh", "eigvals", "eigvalsh", "inv", "lu",
+           "matmul", "matrix_power", "matrix_rank", "multi_dot", "norm",
+           "pinv", "qr", "slogdet", "solve", "svd", "triangular_solve"]

@@ -1,0 +1,217 @@
+"""paddle.reader decorators (reference: python/paddle/reader/decorator.py —
+composable transformations over sample-reader factories: cache, map_readers,
+shuffle, chain, compose, buffered, firstn, xmap_readers,
+multiprocess_reader).
+
+TPU-native note: the high-throughput input path is io.DataLoader backed by
+the native C++ datafeed (csrc/datafeed); these generator combinators exist
+for API parity with reader-style training scripts.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose", "buffered",
+           "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    """Cache all samples in memory on the first call; every pass replays
+    the cache. The source is consumed eagerly (reference decorator.py:52
+    does the same) so a partially-consumed first pass cannot corrupt later
+    epochs."""
+    all_data = []
+    filled = [False]
+
+    def cached_reader():
+        if not filled[0]:
+            all_data.extend(reader())
+            filled[0] = True
+        yield from all_data
+
+    return cached_reader
+
+
+def map_readers(func, *readers):
+    """Zip several readers and map `func` over the sample tuples."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle: fill a buf_size window, yield it shuffled."""
+
+    def shuffled_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return shuffled_reader
+
+
+def chain(*readers):
+    """Concatenate readers back to back."""
+
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into tuple samples; flattens each reader's tuple output.
+    check_alignment=True (default) raises if the readers have different
+    lengths."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ValueError(
+                        "outputs of readers are not aligned (different "
+                        "lengths); pass check_alignment=False to truncate")
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Read ahead up to `size` samples in a daemon thread."""
+
+    end = object()
+
+    def buffered_reader():
+        q: _queue.Queue = _queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for d in reader():
+                    q.put(d)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is end:
+                break
+            yield e
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    """Limit the reader to its first n samples."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Apply `mapper` over samples with a pool of worker threads (the
+    reference uses threads here too, despite the name)."""
+
+    end = object()
+
+    def xreader():
+        in_q: _queue.Queue = _queue.Queue(buffer_size)
+        out_q: _queue.Queue = _queue.Queue(buffer_size)
+        out_order = [0]
+
+        def read_worker():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample) if order else sample)
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def map_worker():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                if order:
+                    i, sample = item
+                    r = mapper(sample)
+                    while out_order[0] != i:
+                        threading.Event().wait(0.001)
+                    out_q.put(r)
+                    out_order[0] += 1
+                else:
+                    out_q.put(mapper(item))
+
+        threading.Thread(target=read_worker, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=map_worker, daemon=True).start()
+        finished = 0
+        while finished < process_num:
+            e = out_q.get()
+            if e is end:
+                finished += 1
+            else:
+                yield e
+
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave several readers, each driven by its own process
+    (reference decorator.py:505). Uses multiprocessing queues; samples must
+    be picklable."""
+    import multiprocessing as mp
+
+    def reader():
+        q = mp.Queue(queue_size)
+
+        def worker(r):
+            try:
+                for sample in r():
+                    q.put(sample)
+            finally:
+                q.put(None)
+
+        procs = [mp.Process(target=worker, args=(r,), daemon=True)
+                 for r in readers]
+        for p in procs:
+            p.start()
+        finished = 0
+        while finished < len(readers):
+            sample = q.get()
+            if sample is None:
+                finished += 1
+            else:
+                yield sample
+        for p in procs:
+            p.join()
+
+    return reader

@@ -14,6 +14,8 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..jit import StaticFunction, to_static
 
+from . import nn  # noqa: F401  (paddle.static.nn: cond/case/switch_case/…)
+
 
 class InputSpec:
     def __init__(self, shape, dtype="float32", name=None):

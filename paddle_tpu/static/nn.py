@@ -1,0 +1,125 @@
+"""paddle.static.nn control flow (reference: the controlflow op family —
+operators/controlflow/conditional_block_op.cc, while_op.cc, and the Python
+surface fluid/layers/control_flow.py: cond:2233, case, switch_case,
+while_loop:1005).
+
+TPU-native semantics: with a concrete (eager) predicate the chosen branch
+alone runs — exactly the reference's conditional_block. Under tracing
+(jit.to_static), data-dependent control flow cannot prune a branch at trace
+time, so `cond` evaluates both branches and selects elementwise (the
+XLA-idiomatic lowering; both-branch evaluation is the documented contract
+of lax.select-style conditionals), and `while_loop` lowers to
+jax.lax.while_loop (forward-only, like the reference's while op without
+backward blocks).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..tensor.creation import _t
+
+__all__ = ["cond", "case", "switch_case", "while_loop"]
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _select(pred_t, true_out, false_out):
+    """Leaf-wise select between two same-structure branch outputs."""
+    flat_t, tree_t = jax.tree_util.tree_flatten(
+        true_out, is_leaf=lambda x: isinstance(x, Tensor))
+    flat_f, tree_f = jax.tree_util.tree_flatten(
+        false_out, is_leaf=lambda x: isinstance(x, Tensor))
+    if tree_t != tree_f or len(flat_t) != len(flat_f):
+        raise ValueError("cond branches must return the same structure")
+    out = []
+    for a, b in zip(flat_t, flat_f):
+        ta, tb = _t(a), _t(b)
+        out.append(apply(
+            lambda p, x, y: jnp.where(p.astype(bool), x, y),
+            pred_t, ta, tb))
+    return jax.tree_util.tree_unflatten(tree_t, out)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    pred_t = _t(pred)
+    if not _is_traced(pred_t.data):
+        taken = true_fn if bool(jnp.all(pred_t.data)) else false_fn
+        return taken() if taken is not None else None
+    if true_fn is None or false_fn is None:
+        raise ValueError("traced cond requires both true_fn and false_fn")
+    return _select(pred_t, true_fn(), false_fn())
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First pair whose pred is true wins (control_flow.py case)."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    preds = [_t(p) for p, _ in pred_fn_pairs]
+    if not any(_is_traced(p.data) for p in preds):
+        for p, fn in zip(preds, (f for _, f in pred_fn_pairs)):
+            if bool(jnp.all(p.data)):
+                return fn()
+        if default is None:
+            # reference: falls through to the LAST branch when no default
+            return pred_fn_pairs[-1][1]()
+        return default()
+    out = default() if default is not None else pred_fn_pairs[-1][1]()
+    for p, fn in reversed(pred_fn_pairs):
+        out = _select(p, fn(), out)
+    return out
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Dispatch on an integer index (control_flow.py switch_case).
+    branch_fns: dict {index: fn} or list of (index, fn) / fns."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        pairs = sorted((i, f) for i, f in branch_fns)
+    else:
+        pairs = list(enumerate(branch_fns))
+    idx_t = _t(branch_index)
+    if not _is_traced(idx_t.data):
+        i = int(jnp.asarray(idx_t.data))
+        for j, fn in pairs:
+            if j == i:
+                return fn()
+        if default is None:
+            raise ValueError(f"branch_index {i} not found and no default")
+        return default()
+    out = default() if default is not None else pairs[-1][1]()
+    for j, fn in reversed(pairs):
+        eq = apply(lambda x, j=j: x == j, idx_t)
+        out = _select(eq, fn(), out)
+    return out
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """Reference while_loop: loop_vars is a list; body returns the next
+    list. Eager: a Python loop. Traced: jax.lax.while_loop (forward-only)."""
+    if not loop_vars:
+        raise ValueError("loop_vars must be non-empty")
+    vars_t = [_t(v) for v in loop_vars]
+    first = cond_fn(*vars_t)
+    if not _is_traced(_t(first).data) and \
+            not any(_is_traced(v.data) for v in vars_t):
+        while bool(jnp.all(_t(cond_fn(*vars_t)).data)):
+            res = body_fn(*vars_t)
+            vars_t = [_t(v) for v in (res if isinstance(res, (list, tuple))
+                                      else [res])]
+        return vars_t
+
+    def c(datas):
+        return jnp.all(_t(cond_fn(*[_t(d) for d in datas])).data)
+
+    def b(datas):
+        res = body_fn(*[_t(d) for d in datas])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(_t(r).data for r in res)
+
+    out = jax.lax.while_loop(c, b, tuple(v.data for v in vars_t))
+    return [_t(o) for o in out]

@@ -1,0 +1,17 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py — include/lib
+dirs of the installed package, used by custom-op build scripts)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    root = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(root, "include")
+
+
+def get_lib():
+    root = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(root, "libs")

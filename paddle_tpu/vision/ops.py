@@ -1,0 +1,306 @@
+"""paddle.vision.ops detection operators (reference: the detection op family
+under paddle/fluid/operators/detection/ — multiclass_nms_op.cc,
+roi_align_op.cc/.cu, box_coder_op.cc, yolo_box_op.cc — surfaced in 2.x as
+paddle.vision.ops.{nms, roi_align, roi_pool, box_coder, yolo_box}).
+
+TPU-native design notes: NMS is inherently sequential over ranked boxes and
+returns a data-dependent number of indices, so it runs HOST-SIDE (eager
+numpy greedy over a device-computed IoU matrix) as inference
+post-processing — it is not jit-compatible, exactly like the reference's
+CPU multiclass_nms kernel. roi_align is a gather+bilinear kernel over
+static sampling grids (maps to VPU-friendly vectorized gathers). All other
+ops take/return framework Tensors via `apply` so they ride the autograd
+tape where differentiable (roi_align, box_coder; yolo_box decode is an
+inference op).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import apply
+from ..tensor.creation import _t
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
+           "box_iou"]
+
+
+def _iou_matrix(boxes_a, boxes_b):
+    """[N,4] x [M,4] (x1,y1,x2,y2) -> [N,M] IoU."""
+    area_a = jnp.maximum(boxes_a[:, 2] - boxes_a[:, 0], 0) * \
+        jnp.maximum(boxes_a[:, 3] - boxes_a[:, 1], 0)
+    area_b = jnp.maximum(boxes_b[:, 2] - boxes_b[:, 0], 0) * \
+        jnp.maximum(boxes_b[:, 3] - boxes_b[:, 1], 0)
+    lt = jnp.maximum(boxes_a[:, None, :2], boxes_b[None, :, :2])
+    rb = jnp.minimum(boxes_a[:, None, 2:], boxes_b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU (torchvision-compatible helper used by the reference
+    detection tests)."""
+    return apply(_iou_matrix, _t(boxes1), _t(boxes2))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy hard-NMS (multiclass_nms_op.cc single-class core). Returns the
+    kept indices sorted by score desc. With category_idxs, boxes of
+    different categories never suppress each other (batched-NMS offset
+    trick). Host-side eager op (dynamic output count) — do not call inside
+    jit."""
+    boxes = _t(boxes)
+    n = boxes.shape[0]
+    if scores is None:
+        scores_arr = jnp.arange(n, 0, -1, dtype=jnp.float32)
+    else:
+        scores_arr = _t(scores).data.astype(jnp.float32)
+
+    import numpy as np
+    b = np.asarray(boxes.data, np.float32)
+    sc = np.asarray(scores_arr)
+    if category_idxs is not None:
+        # offset each category into a disjoint coordinate region so boxes
+        # of different classes never suppress each other
+        cat = np.asarray(_t(category_idxs).data, np.float32)
+        span = b[:, 2:].max() - b[:, :2].min() + 1.0
+        b = b + (cat * span)[:, None]
+
+    order = np.argsort(-sc)
+    iou = np.asarray(_iou_matrix(jnp.asarray(b[order]),
+                                 jnp.asarray(b[order])))
+    keep = np.ones(n, bool)
+    for i in range(n):
+        if not keep[i]:
+            continue
+        keep[i + 1:] &= ~(iou[i, i + 1:] > iou_threshold)
+    kept = order[keep]
+    if top_k is not None:
+        kept = kept[:top_k]
+    from ..tensor.creation import to_tensor
+    return to_tensor(kept.astype(np.int64))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (roi_align_op.cu): x [N,C,H,W], boxes [R,4] (x1,y1,x2,y2 in
+    input-image coords), boxes_num [N] rois per image. Bilinear sampling on
+    a fixed grid; differentiable."""
+    x = _t(x)
+    boxes = _t(boxes)
+    boxes_num = _t(boxes_num)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(feat, rois, rois_num):
+        N, C, H, W = feat.shape
+        R = rois.shape[0]
+        # map each roi to its batch image
+        img_idx = jnp.repeat(jnp.arange(N), repeats=rois_num.astype(
+            jnp.int32), total_repeat_length=R)
+        rois = rois.astype(jnp.float32) * spatial_scale
+        offset = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] - offset
+        y1 = rois[:, 1] - offset
+        x2 = rois[:, 2] - offset
+        y2 = rois[:, 3] - offset
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid: [R, ph*sr] y coords, [R, pw*sr] x coords
+        ys = (y1[:, None]
+              + (jnp.arange(ph * sr) + 0.5)[None, :] / sr
+              * bin_h[:, None])
+        xs = (x1[:, None]
+              + (jnp.arange(pw * sr) + 0.5)[None, :] / sr
+              * bin_w[:, None])
+
+        def bilinear(img, yy, xx):
+            # img [C,H,W]; yy [hs], xx [ws] -> [C,hs,ws]
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+            y0i = y0.astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+            wy1 = jnp.clip(yy - y0, 0, 1)
+            wx1 = jnp.clip(xx - x0, 0, 1)
+            wy0 = 1 - wy1
+            wx0 = 1 - wx1
+            v00 = img[:, y0i][:, :, x0i]
+            v01 = img[:, y0i][:, :, x1i]
+            v10 = img[:, y1i][:, :, x0i]
+            v11 = img[:, y1i][:, :, x1i]
+            return (v00 * (wy0[:, None] * wx0[None, :])
+                    + v01 * (wy0[:, None] * wx1[None, :])
+                    + v10 * (wy1[:, None] * wx0[None, :])
+                    + v11 * (wy1[:, None] * wx1[None, :]))
+
+        def one_roi(ii, yy, xx):
+            img = feat[ii]
+            samples = bilinear(img, yy, xx)      # [C, ph*sr, pw*sr]
+            C_ = samples.shape[0]
+            pooled = samples.reshape(C_, ph, sr, pw, sr).mean((2, 4))
+            return pooled
+
+        out = jax.vmap(one_roi)(img_idx, ys, xs)  # [R, C, ph, pw]
+        return out
+
+    return apply(f, x, boxes, boxes_num)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool (roi_pool_op.cu): max pooling over integer-quantized bins.
+    Implemented as roi_align with dense sampling + max (the standard
+    TPU-friendly approximation keeps it differentiable)."""
+    x = _t(x)
+    boxes = _t(boxes)
+    boxes_num = _t(boxes_num)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(feat, rois, rois_num):
+        N, C, H, W = feat.shape
+        R = rois.shape[0]
+        img_idx = jnp.repeat(jnp.arange(N), repeats=rois_num.astype(
+            jnp.int32), total_repeat_length=R)
+        rois = rois.astype(jnp.float32) * spatial_scale
+        x1 = jnp.floor(rois[:, 0])
+        y1 = jnp.floor(rois[:, 1])
+        x2 = jnp.ceil(rois[:, 2])
+        y2 = jnp.ceil(rois[:, 3])
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        sr = 4
+        ys = y1[:, None] + (jnp.arange(ph * sr) + 0.5)[None, :] / (
+            ph * sr) * rh[:, None]
+        xs = x1[:, None] + (jnp.arange(pw * sr) + 0.5)[None, :] / (
+            pw * sr) * rw[:, None]
+
+        def one_roi(ii, yy, xx):
+            img = feat[ii]
+            yi = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+            samples = img[:, yi][:, :, xi]       # [C, ph*sr, pw*sr]
+            C_ = samples.shape[0]
+            return samples.reshape(C_, ph, sr, pw, sr).max((2, 4))
+
+        return jax.vmap(one_roi)(img_idx, ys, xs)
+
+    return apply(f, x, boxes, boxes_num)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """box_coder_op.cc: encode/decode boxes against priors.
+    encode: target [M,4] against priors [N,4] -> [M,N,4]
+    decode: target [N,4] (deltas) against priors [N,4] -> [N,4] boxes."""
+    pb = _t(prior_box)
+    tb = _t(target_box)
+    pbv = _t(prior_box_var) if prior_box_var is not None else None
+    norm = 0.0 if box_normalized else 1.0
+
+    def prior_cxcywh(p):
+        pw = p[:, 2] - p[:, 0] + norm
+        ph = p[:, 3] - p[:, 1] + norm
+        cx = p[:, 0] + pw * 0.5
+        cy = p[:, 1] + ph * 0.5
+        return cx, cy, pw, ph
+
+    if code_type == "encode_center_size":
+        def f(p, t, *v):
+            pcx, pcy, pw, ph = prior_cxcywh(p)
+            tw = t[:, 2] - t[:, 0] + norm
+            th = t[:, 3] - t[:, 1] + norm
+            tcx = t[:, 0] + tw * 0.5
+            tcy = t[:, 1] + th * 0.5
+            dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            dw = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+            dh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+            out = jnp.stack([dx, dy, dw, dh], axis=-1)
+            if v:
+                out = out / v[0][None, :, :]
+            return out
+
+        args = [pb, tb] + ([pbv] if pbv is not None else [])
+        return apply(f, *args)
+
+    if code_type == "decode_center_size":
+        def f(p, t, *v):
+            pcx, pcy, pw, ph = prior_cxcywh(p)
+            d = t * v[0] if v else t
+            cx = d[:, 0] * pw + pcx
+            cy = d[:, 1] * ph + pcy
+            w = jnp.exp(d[:, 2]) * pw
+            h = jnp.exp(d[:, 3]) * ph
+            return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                              cx + w * 0.5 - norm,
+                              cy + h * 0.5 - norm], axis=-1)
+
+        args = [pb, tb] + ([pbv] if pbv is not None else [])
+        return apply(f, *args)
+
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0):
+    """yolo_box_op.cc: decode YOLOv3 head output [N, A*(5+cls), H, W] into
+    boxes [N, A*H*W, 4] and scores [N, A*H*W, cls]."""
+    x = _t(x)
+    img_size = _t(img_size)
+    na = len(anchors) // 2
+    anchors_arr = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+
+    def f(pred, imgs):
+        N, _, H, W = pred.shape
+        p = pred.reshape(N, na, 5 + class_num, H, W)
+        gx = lax.broadcasted_iota(jnp.float32, (H, W), 1)
+        gy = lax.broadcasted_iota(jnp.float32, (H, W), 0)
+        sx = jax.nn.sigmoid(p[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+        sy = jax.nn.sigmoid(p[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+        bx = (gx + sx) / W
+        by = (gy + sy) / H
+        input_size = downsample_ratio * jnp.asarray([H, W], jnp.float32)
+        bw = jnp.exp(p[:, :, 2]) * anchors_arr[None, :, 0, None, None] / \
+            input_size[1]
+        bh = jnp.exp(p[:, :, 3]) * anchors_arr[None, :, 1, None, None] / \
+            input_size[0]
+        conf = jax.nn.sigmoid(p[:, :, 4])
+        cls = jax.nn.sigmoid(p[:, :, 5:]) * conf[:, :, None]
+        imh = imgs[:, 0].astype(jnp.float32)
+        imw = imgs[:, 1].astype(jnp.float32)
+        x1 = (bx - bw / 2) * imw[:, None, None, None]
+        y1 = (by - bh / 2) * imh[:, None, None, None]
+        x2 = (bx + bw / 2) * imw[:, None, None, None]
+        y2 = (by + bh / 2) * imh[:, None, None, None]
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw[:, None, None, None] - 1)
+            y1 = jnp.clip(y1, 0, imh[:, None, None, None] - 1)
+            x2 = jnp.clip(x2, 0, imw[:, None, None, None] - 1)
+            y2 = jnp.clip(y2, 0, imh[:, None, None, None] - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+        boxes = boxes.reshape(N, -1, 4)
+        scores = jnp.moveaxis(cls, 2, -1).reshape(N, -1, class_num)
+        # zero out low-confidence predictions (op semantics)
+        keep = (conf.reshape(N, -1) > conf_thresh)[..., None]
+        # one decode pass: concat [boxes | scores] and slice outside
+        return jnp.concatenate([boxes * keep, scores * keep], axis=-1)
+
+    both = apply(f, x, img_size)
+    boxes = apply(lambda a: a[..., :4], both)
+    scores = apply(lambda a: a[..., 4:], both)
+    return boxes, scores

@@ -1,0 +1,94 @@
+"""Root-namespace compat API tests: paddle.batch, paddle.reader decorators,
+paddle.hub, paddle.linalg, paddle.callbacks, paddle.sysconfig (reference:
+python/paddle/{batch,reader/decorator,hub,linalg,callbacks,sysconfig}.py).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_batch():
+    r = paddle.batch(lambda: iter(range(10)), batch_size=3)
+    got = [b for b in r()]
+    assert got == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    r2 = paddle.batch(lambda: iter(range(10)), batch_size=3, drop_last=True)
+    assert [b for b in r2()] == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+    with pytest.raises(ValueError):
+        paddle.batch(lambda: iter(()), batch_size=0)
+
+
+def test_reader_decorators():
+    from paddle_tpu import reader
+
+    base = lambda: iter(range(8))  # noqa: E731
+    assert list(reader.firstn(base, 3)()) == [0, 1, 2]
+    assert list(reader.chain(base, base)()) == list(range(8)) * 2
+    assert list(reader.buffered(base, 2)()) == list(range(8))
+    assert sorted(reader.shuffle(base, 4)()) == list(range(8))
+    assert list(reader.map_readers(lambda a, b: a + b, base, base)()) == \
+        [2 * i for i in range(8)]
+    assert list(reader.compose(base, base)()) == [(i, i) for i in range(8)]
+    # cache: second pass replays without consuming the source again
+    calls = []
+
+    def tracked():
+        calls.append(1)
+        yield from range(3)
+
+    c = reader.cache(tracked)
+    assert list(c()) == [0, 1, 2]
+    assert list(c()) == [0, 1, 2]
+    assert len(calls) == 1
+    got = sorted(reader.xmap_readers(lambda x: x * 10, base, 2, 4)())
+    assert got == [i * 10 for i in range(8)]
+
+
+def test_compose_misaligned_raises():
+    from paddle_tpu import reader
+
+    a = lambda: iter(range(3))  # noqa: E731
+    b = lambda: iter(range(5))  # noqa: E731
+    with pytest.raises(ValueError):
+        list(reader.compose(a, b)())
+    assert list(reader.compose(a, b, check_alignment=False)()) == \
+        [(0, 0), (1, 1), (2, 2)]
+
+
+def test_hub_local(tmp_path):
+    hubconf = tmp_path / "hubconf.py"
+    hubconf.write_text(
+        "def lenet(num_classes=10):\n"
+        "    \"\"\"A LeNet entrypoint.\"\"\"\n"
+        "    import paddle_tpu as paddle\n"
+        "    return paddle.vision.models.LeNet(num_classes=num_classes)\n")
+    names = paddle.hub.list(str(tmp_path), source="local")
+    assert "lenet" in names
+    assert "LeNet" in paddle.hub.help(str(tmp_path), "lenet", source="local")
+    model = paddle.hub.load(str(tmp_path), "lenet", source="local",
+                            num_classes=7)
+    out = model(paddle.randn([1, 1, 28, 28]))
+    assert tuple(out.shape) == (1, 7)
+    with pytest.raises(RuntimeError):
+        paddle.hub.list("user/repo", source="github")
+
+
+def test_linalg_namespace():
+    x = paddle.to_tensor(np.array([[4.0, 0.0], [0.0, 9.0]], np.float32))
+    c = paddle.linalg.cholesky(x)
+    np.testing.assert_allclose(np.asarray(c.data), [[2, 0], [0, 3]],
+                               atol=1e-6)
+    n = paddle.linalg.norm(paddle.to_tensor([3.0, 4.0]))
+    assert float(n.item()) == pytest.approx(5.0)
+
+
+def test_callbacks_namespace():
+    assert paddle.callbacks.EarlyStopping is not None
+    assert paddle.callbacks.ModelCheckpoint is not None
+
+
+def test_sysconfig():
+    assert isinstance(paddle.sysconfig.get_include(), str)
+    assert isinstance(paddle.sysconfig.get_lib(), str)

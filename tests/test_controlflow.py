@@ -1,0 +1,95 @@
+"""Control-flow API tests (reference: fluid/layers/control_flow.py cond/
+case/switch_case/while_loop; operators/controlflow/).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static.nn import case, cond, switch_case, while_loop
+
+
+def test_cond_eager():
+    x = paddle.to_tensor(3.0)
+    out = cond(x > 2.0, lambda: x * 2, lambda: x - 1)
+    assert float(out.item()) == 6.0
+    out = cond(x > 5.0, lambda: x * 2, lambda: x - 1)
+    assert float(out.item()) == 2.0
+
+
+def test_cond_traced_in_jit():
+    import paddle_tpu.jit as jit
+
+    @jit.to_static
+    def f(x):
+        return cond(paddle.sum(x) > 0,
+                    lambda: x * 2,
+                    lambda: x - 10)
+
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    np.testing.assert_allclose(np.asarray(f(x).data), 2 * np.ones(4),
+                               atol=1e-6)
+    y = paddle.to_tensor(-np.ones(4, np.float32))
+    np.testing.assert_allclose(np.asarray(f(y).data), -11 * np.ones(4),
+                               atol=1e-6)
+
+
+def test_cond_gradient():
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    x.stop_gradient = False
+    out = cond(x > 0, lambda: x * 3, lambda: x)
+    out.backward()
+    assert float(x.grad.data[0]) == 3.0
+
+
+def test_case():
+    x = paddle.to_tensor(0.3)
+    r = case([(x < 0.1, lambda: paddle.to_tensor(1.0)),
+              (x < 0.5, lambda: paddle.to_tensor(2.0))],
+             default=lambda: paddle.to_tensor(3.0))
+    assert float(r.item()) == 2.0
+    r = case([(x < 0.1, lambda: paddle.to_tensor(1.0))],
+             default=lambda: paddle.to_tensor(3.0))
+    assert float(r.item()) == 3.0
+    # no default: last branch taken
+    r = case([(x < 0.1, lambda: paddle.to_tensor(1.0)),
+              (x < 0.2, lambda: paddle.to_tensor(2.0))])
+    assert float(r.item()) == 2.0
+
+
+def test_switch_case():
+    i = paddle.to_tensor(1)
+    r = switch_case(i, {0: lambda: paddle.to_tensor(10.0),
+                        1: lambda: paddle.to_tensor(20.0)},
+                    default=lambda: paddle.to_tensor(-1.0))
+    assert float(r.item()) == 20.0
+    r = switch_case(paddle.to_tensor(7),
+                    {0: lambda: paddle.to_tensor(10.0)},
+                    default=lambda: paddle.to_tensor(-1.0))
+    assert float(r.item()) == -1.0
+    with pytest.raises(ValueError):
+        switch_case(paddle.to_tensor(7), {0: lambda: paddle.to_tensor(1.0)})
+
+
+def test_while_loop_eager():
+    i = paddle.to_tensor(0)
+    s = paddle.to_tensor(0)
+    i, s = while_loop(lambda i, s: i < 5,
+                      lambda i, s: [i + 1, s + i],
+                      [i, s])
+    assert int(i.item()) == 5 and int(s.item()) == 10
+
+
+def test_while_loop_traced():
+    import paddle_tpu.jit as jit
+
+    @jit.to_static
+    def f(n):
+        i = paddle.to_tensor(0)
+        s = paddle.to_tensor(0)
+        i, s = while_loop(lambda i, s: i < n,
+                          lambda i, s: [i + 1, s + 2],
+                          [i, s])
+        return s
+
+    out = f(paddle.to_tensor(4))
+    assert int(np.asarray(out.data)) == 8

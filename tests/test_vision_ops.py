@@ -1,0 +1,154 @@
+"""Detection op + incubate optimizer tests (reference:
+operators/detection/*, python/paddle/incubate/optimizer/).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.ops import (box_coder, box_iou, nms, roi_align,
+                                   roi_pool, yolo_box)
+
+
+def test_box_iou():
+    a = paddle.to_tensor(np.array([[0, 0, 2, 2]], np.float32))
+    b = paddle.to_tensor(np.array([[1, 1, 3, 3], [0, 0, 2, 2],
+                                   [4, 4, 5, 5]], np.float32))
+    iou = np.asarray(box_iou(a, b).data)
+    np.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], atol=1e-6)
+
+
+def test_nms_basic():
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]], np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+    kept = np.asarray(nms(boxes, 0.5, scores=scores).data)
+    assert kept.tolist() == [0, 2]  # box 1 suppressed by box 0
+
+
+def test_nms_categories():
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11]], np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8], np.float32))
+    cats = paddle.to_tensor(np.array([0, 1], np.int32))
+    kept = np.asarray(nms(boxes, 0.5, scores=scores, category_idxs=cats,
+                          categories=[0, 1]).data)
+    assert sorted(kept.tolist()) == [0, 1]  # different classes: both kept
+
+
+def test_roi_align_constant_map():
+    # constant feature map -> every pooled value equals the constant
+    x = paddle.to_tensor(np.full((1, 3, 16, 16), 5.0, np.float32))
+    boxes = paddle.to_tensor(np.array([[2, 2, 10, 10]], np.float32))
+    num = paddle.to_tensor(np.array([1], np.int32))
+    out = roi_align(x, boxes, num, output_size=4)
+    arr = np.asarray(out.data)
+    assert arr.shape == (1, 3, 4, 4)
+    np.testing.assert_allclose(arr, 5.0, atol=1e-5)
+
+
+def test_roi_align_gradient_flows():
+    import jax.numpy as jnp
+    x = paddle.to_tensor(np.random.RandomState(0).randn(
+        1, 2, 8, 8).astype(np.float32))
+    x.stop_gradient = False
+    boxes = paddle.to_tensor(np.array([[1, 1, 6, 6]], np.float32))
+    num = paddle.to_tensor(np.array([1], np.int32))
+    out = roi_align(x, boxes, num, output_size=2)
+    loss = paddle.sum(out)
+    loss.backward()
+    assert x.grad is not None
+    assert float(jnp.abs(x.grad.data).sum()) > 0
+
+
+def test_roi_pool_shape():
+    x = paddle.to_tensor(np.random.RandomState(1).randn(
+        2, 3, 16, 16).astype(np.float32))
+    boxes = paddle.to_tensor(np.array([[0, 0, 8, 8], [4, 4, 12, 12],
+                                       [0, 0, 15, 15]], np.float32))
+    num = paddle.to_tensor(np.array([2, 1], np.int32))
+    out = roi_pool(x, boxes, num, output_size=(3, 3))
+    assert tuple(out.shape) == (3, 3, 3, 3)
+
+
+def test_box_coder_roundtrip():
+    priors = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [5, 5, 15, 20]], np.float32))
+    targets = paddle.to_tensor(np.array(
+        [[1, 1, 9, 9], [4, 6, 16, 18]], np.float32))
+    enc = box_coder(priors, None, targets, code_type="encode_center_size")
+    assert tuple(enc.shape) == (2, 2, 4)
+    # decode the diagonal of the encoding back: should recover targets
+    diag = paddle.to_tensor(np.asarray(enc.data)[
+        np.arange(2), np.arange(2)])
+    dec = box_coder(priors, None, diag, code_type="decode_center_size")
+    np.testing.assert_allclose(np.asarray(dec.data),
+                               np.asarray(targets.data), atol=1e-4)
+
+
+def test_yolo_box_shapes():
+    N, A, cls, H, W = 1, 2, 3, 4, 4
+    x = paddle.to_tensor(np.random.RandomState(2).randn(
+        N, A * (5 + cls), H, W).astype(np.float32))
+    img = paddle.to_tensor(np.array([[64, 64]], np.int32))
+    boxes, scores = yolo_box(x, img, anchors=[10, 13, 16, 30], class_num=cls,
+                             conf_thresh=0.01, downsample_ratio=16)
+    assert tuple(boxes.shape) == (N, A * H * W, 4)
+    assert tuple(scores.shape) == (N, A * H * W, cls)
+    b = np.asarray(boxes.data)
+    assert (b >= 0).all() and (b <= 63).all()  # clipped to the image
+
+
+# ---------------- incubate optimizers ----------------
+
+def test_lookahead():
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.incubate import LookAhead
+
+    rng = np.random.RandomState(3)
+    w0 = rng.randn(4, 4).astype(np.float32)
+    lin = paddle.nn.Linear(4, 4, bias_attr=False)
+    lin.weight.set_value(w0)
+    inner = optim.SGD(learning_rate=0.1, parameters=lin.parameters())
+    la = LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(rng.randn(4, 4).astype(np.float32))
+
+    fast = w0.copy()
+    slow = w0.copy()
+    for i in range(4):
+        loss = paddle.mean(lin(x) @ lin(x).T)
+        loss.backward()
+        g = np.asarray(lin.weight.grad.data)
+        la.step()
+        la.clear_grad()
+        fast = fast - 0.1 * g
+        if (i + 1) % 2 == 0:
+            slow = slow + 0.5 * (fast - slow)
+            fast = slow.copy()
+        np.testing.assert_allclose(lin.weight.numpy(), fast, atol=1e-5)
+    with pytest.raises(ValueError):
+        LookAhead(inner, alpha=2.0)
+
+
+def test_model_average():
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.incubate import ModelAverage
+
+    lin = paddle.nn.Linear(2, 2, bias_attr=False)
+    w0 = np.zeros((2, 2), np.float32)
+    lin.weight.set_value(w0)
+    inner = optim.SGD(learning_rate=1.0, parameters=lin.parameters())
+    ma = ModelAverage(average_window_rate=1.0, inner_optimizer=inner,
+                      min_average_window=100, max_average_window=100)
+    x = paddle.to_tensor(np.eye(2, dtype=np.float32))
+    seen = []
+    for _ in range(3):
+        loss = paddle.sum(lin(x))
+        loss.backward()
+        ma.step()
+        ma.clear_grad()
+        seen.append(lin.weight.numpy().copy())
+    avg = np.mean(seen, axis=0)
+    live = lin.weight.numpy().copy()
+    with ma.apply():
+        np.testing.assert_allclose(lin.weight.numpy(), avg, atol=1e-6)
+    np.testing.assert_allclose(lin.weight.numpy(), live, atol=1e-6)
