@@ -85,20 +85,31 @@ class GradScaler:
         if not self._enable or id(optimizer) in self._unscaled:
             return
         self._unscaled.add(id(optimizer))
-        # one fused finiteness check across all grads (single host sync)
-        gs = [p.grad.data.astype(jnp.float32) / self._scale
-              for p in (optimizer._parameter_list or []) if p.grad is not None]
-        if not gs:
+        from ..core.selected_rows import SelectedRows
+        # one fused finiteness check across all grads (single host sync);
+        # SelectedRows grads unscale their values in place of the dense body
+        params = [p for p in (optimizer._parameter_list or [])
+                  if p.grad is not None]
+        new_grads, checks = [], []
+        for p in params:
+            g = p.grad
+            if isinstance(g, SelectedRows):
+                vals = g.values.astype(jnp.float32) / self._scale
+                new_grads.append(SelectedRows(g.rows, vals, g.height))
+                checks.append(jnp.all(jnp.isfinite(vals)))
+            else:
+                arr = g.data.astype(jnp.float32) / self._scale
+                new_grads.append(arr)
+                checks.append(jnp.all(jnp.isfinite(arr)))
+        if not new_grads:
             self._found_inf = False
             return
-        finite = jnp.all(
-            jnp.stack([jnp.all(jnp.isfinite(g)) for g in gs]))
-        i = 0
-        for p in optimizer._parameter_list or []:
-            if p.grad is None:
-                continue
-            p.grad.data = gs[i]
-            i += 1
+        finite = jnp.all(jnp.stack(checks))
+        for p, g in zip(params, new_grads):
+            if isinstance(g, SelectedRows):
+                p.grad = g
+            else:
+                p.grad.data = g
         self._found_inf = not bool(finite)
 
     def step(self, optimizer):

@@ -1,0 +1,58 @@
+"""SelectedRows sparse gradients (reference: framework/selected_rows.h —
+a (rows, value) pair representing a tall matrix whose only non-zero rows
+are listed; produced by lookup_table's backward when is_sparse=True and
+consumed row-wise by sgd_op/adam_op lazy_mode).
+
+TPU-native: on-device `rows` (int32 [K]) + `values` ([K, H]) jax arrays.
+Eager embedding backward emits these instead of a dense [V, H] scatter;
+SGD/Adam(lazy_mode) apply them with `at[rows]` scatter updates, so one
+step touches K·H elements instead of V·H. merge() keeps duplicate rows
+(scatter-add semantics preserve correctness); to_dense() materializes."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class SelectedRows:
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    # optimizers reach .grad.data; a SelectedRows grad yields itself so the
+    # sparse fast-path can detect it
+    @property
+    def data(self):
+        return self
+
+    def to_dense(self):
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def merge(self, other: "SelectedRows") -> "SelectedRows":
+        assert self.height == other.height
+        return SelectedRows(jnp.concatenate([self.rows, other.rows]),
+                            jnp.concatenate([self.values, other.values]),
+                            self.height)
+
+    def scale(self, factor) -> "SelectedRows":
+        return SelectedRows(self.rows, self.values * factor, self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, "
+                f"width={self.values.shape[1:]})")
+
+
+def is_selected_rows(x) -> bool:
+    return isinstance(x, SelectedRows)

@@ -224,9 +224,20 @@ class Tensor:
     def clear_gradient(self):
         self.grad = None
 
-    def _accumulate_grad(self, g: jax.Array):
+    def _accumulate_grad(self, g):
+        from .selected_rows import SelectedRows
+        if isinstance(g, SelectedRows):
+            if self.grad is None:
+                self.grad = g
+            elif isinstance(self.grad, SelectedRows):
+                self.grad = self.grad.merge(g)
+            else:
+                self.grad = Tensor(self.grad.data + g.to_dense())
+            return
         if self.grad is None:
             self.grad = Tensor(g)
+        elif isinstance(self.grad, SelectedRows):
+            self.grad = Tensor(self.grad.to_dense() + g)
         else:
             self.grad = Tensor(self.grad.data + g)
 

@@ -212,14 +212,18 @@ class Categorical(Distribution):
         return apply(f, self.logits, value)
 
     def log_prob(self, value):
+        """Same gather contract as probs(): a vector of M category indices
+        broadcasts over the batch rows -> [B, M] (or [M] unbatched), but
+        gathered from log_softmax directly so confident distributions do
+        not underflow to -inf."""
         value = _t(value)
 
         def f(logits, idx):
             logp = self._log_pmf(logits)  # exact: no exp/log roundtrip
+            ii = idx.astype(jnp.int32).reshape(-1)
             if logp.ndim == 1:
-                return logp[idx.astype(jnp.int32)]
-            return jnp.take_along_axis(
-                logp, idx.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+                return logp[ii] if idx.ndim else logp[ii][0]
+            return logp[..., ii]
 
         return apply(f, self.logits, value)
 

@@ -1,10 +1,9 @@
 """paddle.hub parity (reference: python/paddle/hub.py — list/help/load of
 models published via a repo's hubconf.py).
 
-TPU-native stance: local and file:// sources are fully supported (the
-hubconf.py protocol is identical); github/gitee remote sources require
-network egress and raise a clear error in air-gapped environments when the
-download fails.
+TPU-native stance: source='local' is fully supported (the hubconf.py
+protocol is identical); github/gitee remote sources require network egress
+and raise a clear error directing users to clone + load locally.
 """
 from __future__ import annotations
 

@@ -125,9 +125,10 @@ class ModelAverage:
         """Swap in the averaged parameters (context manager, dygraph
         style)."""
         self._backup = {id(p): p.data for p in self._params}
-        n = max(self._count, 1)
-        for p in self._params:
-            p.data = (self._sum[id(p)] / n).astype(p.data.dtype)
+        if self._count > 0:  # before any step the live weights ARE the avg
+            for p in self._params:
+                p.data = (self._sum[id(p)] / self._count).astype(
+                    p.data.dtype)
         try:
             yield
         finally:

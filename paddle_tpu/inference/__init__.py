@@ -69,6 +69,7 @@ def export_model(layer: Layer, example_inputs, path: str):
                     "pjrt_type": _PJRT_TYPE[str(a.dtype)]}
                    for a in arrays],
         "input_names": [f"x{i}" for i in range(len(arrays))],
+        "input_shapes": [list(a.shape) for a in arrays],
         "output_names": ["output"],
         "n_weights": len(weight_leaves),
     }
@@ -199,13 +200,69 @@ class Predictor:
             for h, arr in zip(self._inputs.values(), inputs):
                 h.copy_from_cpu(arr)
         args = [self._inputs[n]._array for n in self._meta["input_names"]]
-        out = self._call(self._params, self._buffers, *args)
-        outs = out if isinstance(out, (list, tuple)) else [out]
+        outs = self._run_dynamic_batch(args)
         for h, o in zip(self._outputs.values(), outs):
             h._array = o
         if inputs is not None:
             return [np.asarray(o) for o in outs]
         return None
+
+    def _run_dynamic_batch(self, args):
+        """Serve any batch size against the statically-shaped exported
+        program (AnalysisPredictor accepts arbitrary feed batches;
+        analysis_predictor.h:82): smaller batches are zero-padded to the
+        exported size, larger ones chunked — one compiled executable
+        serves them all."""
+        expected = self._meta.get("input_shapes") or [None] * len(args)
+        # an input is "batched" iff it deviates from its exported shape
+        # ONLY in the leading dim; others (lookup tables, scalars) pass
+        # through untouched
+        exp_b = got_b = None
+        batched_in = [False] * len(args)
+        for i, (a, shp) in enumerate(zip(args, expected)):
+            if (shp and getattr(a, "ndim", 0) == len(shp)
+                    and tuple(a.shape[1:]) == tuple(shp[1:])
+                    and a.shape[0] != shp[0]):
+                if exp_b is None:
+                    exp_b, got_b = shp[0], a.shape[0]
+                if a.shape[0] == got_b and shp[0] == exp_b:
+                    batched_in[i] = True
+        if exp_b is None:
+            out = self._call(self._params, self._buffers, *args)
+            return out if isinstance(out, (list, tuple)) else [out]
+
+        import math as _math
+        chunks_out = None
+        n_chunks = max(1, _math.ceil(got_b / exp_b))
+        for c in range(n_chunks):
+            lo = c * exp_b
+            hi = min(lo + exp_b, got_b)
+            part = []
+            for a, is_b in zip(args, batched_in):
+                if not is_b:
+                    part.append(a)
+                    continue
+                sl = a[lo:hi]
+                if sl.shape[0] < exp_b:  # zero-pad the tail chunk
+                    pad = [(0, exp_b - sl.shape[0])] + \
+                        [(0, 0)] * (sl.ndim - 1)
+                    sl = jnp.pad(sl, pad)
+                part.append(sl)
+            out = self._call(self._params, self._buffers, *part)
+            outs = list(out) if isinstance(out, (list, tuple)) else [out]
+            # an output rides the batch iff its leading dim is exp_b;
+            # others (scalars, reductions) keep the first chunk's value
+            if chunks_out is None:
+                batched_out = [hasattr(o, "ndim") and o.ndim > 0
+                               and o.shape[0] == exp_b for o in outs]
+                chunks_out = [[o[: hi - lo]] if b else [o]
+                              for o, b in zip(outs, batched_out)]
+            else:
+                for acc, o, b in zip(chunks_out, outs, batched_out):
+                    if b:
+                        acc.append(o[: hi - lo])
+        return [jnp.concatenate(parts, axis=0) if len(parts) > 1
+                else parts[0] for parts in chunks_out]
 
 
 def create_predictor(config: Config) -> Predictor:

@@ -88,6 +88,33 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             out = jnp.where(mask, 0.0, out)
         return out
 
+    from ...core import tensor as _ct
+    if (sparse and _ct.is_grad_enabled() and not weight.stop_gradient
+            and weight._node is None and not _ct._is_tracer(weight.data)):
+        # lookup_table_grad is_sparse=True analog: the backward emits a
+        # SelectedRows (rows=ids, values=cotangent) instead of scattering
+        # into a dense [V, H] buffer. Only for leaf weights — a derived
+        # weight needs a dense cotangent flowing further up the tape.
+        from ...core.selected_rows import SelectedRows
+        ids_arr = x.data
+        V = weight.data.shape[0]
+        out_arr = f(ids_arr, weight.data)
+
+        def sparse_vjp(cot):
+            vals = cot.reshape(-1, cot.shape[-1])
+            rows = ids_arr.reshape(-1).astype(jnp.int32)
+            if padding_idx is not None:
+                vals = jnp.where((rows == padding_idx)[:, None], 0.0, vals)
+            return (SelectedRows(rows, vals, V),)
+
+        out_t = Tensor(out_arr, stop_gradient=False)
+        _ct._STATE.seq += 1
+        node = _ct._Node(sparse_vjp, [weight], [out_t], single=True,
+                         seq=_ct._STATE.seq)
+        out_t._node = node
+        out_t._out_index = 0
+        return out_t
+
     return apply(f, x, weight)
 
 

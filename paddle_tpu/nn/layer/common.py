@@ -47,6 +47,7 @@ class Embedding(Layer):
         self._embedding_dim = embedding_dim
         self._padding_idx = (padding_idx if padding_idx is None or padding_idx >= 0
                              else num_embeddings + padding_idx)
+        self._sparse = sparse
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=I.Normal(0.0, 1.0))
@@ -55,7 +56,8 @@ class Embedding(Layer):
             self.weight.data = arr
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx,
+                           sparse=self._sparse)
 
 
 class Dropout(Layer):

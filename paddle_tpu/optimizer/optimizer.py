@@ -103,9 +103,15 @@ class Optimizer:
     # ---- eager step ----
     @no_grad()
     def step(self):
+        from ..core.selected_rows import SelectedRows
         params_grads = [(p, p.grad) for p in self._parameter_list
                         if not p.stop_gradient and p.grad is not None]
         if self._grad_clip is not None:
+            # clipping needs every gradient dense (global-norm couples them)
+            for p, g in params_grads:
+                if isinstance(g, SelectedRows):
+                    p.grad = Tensor(g.to_dense())
+            params_grads = [(p, p.grad) for p, _ in params_grads]
             params_grads = self._grad_clip(params_grads)
         self._step_count += 1
         for p, g in params_grads:
@@ -117,10 +123,21 @@ class Optimizer:
             slots = self._state[pid]
             lr = self.get_lr() * getattr(p, "optimize_attr",
                                          {"learning_rate": 1.0})["learning_rate"]
+            wd = self._wd_for(p)
+            if isinstance(g, SelectedRows):
+                sparse_rule = getattr(self, "_sparse_rule", None)
+                res = None
+                if sparse_rule is not None and not wd and \
+                        "master_weight" not in slots:
+                    res = sparse_rule(g, p.data, slots, lr)
+                if res is not None:
+                    p.data, self._state[pid] = res
+                    continue
+                g = Tensor(g.to_dense())  # wd / mp / no row-wise rule
             new_p, new_slots = self._rule_mp(
                 self._reg_grad(g.data, p.data,
                                getattr(p, "no_weight_decay", False)),
-                p.data, slots, lr, self._wd_for(p))
+                p.data, slots, lr, wd)
             p.data = new_p
             self._state[pid] = new_slots
 
@@ -252,6 +269,13 @@ class SGD(Optimizer):
             g = g + wd * p.astype(jnp.float32)
         return (p.astype(jnp.float32) - lr * g).astype(p.dtype), slots
 
+    def _sparse_rule(self, g, p, slots, lr):
+        """Row-wise update for SelectedRows grads (sgd_op.cc sparse
+        kernel): only the looked-up rows are touched; duplicate rows
+        accumulate, matching the dense scatter-add semantics."""
+        vals = g.values.astype(jnp.float32)
+        return p.at[g.rows].add((-lr * vals).astype(p.dtype)), slots
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -290,6 +314,7 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
 
     def _init_slots(self, p):
         return {"moment1": jnp.zeros(p.shape, jnp.float32),
@@ -299,6 +324,33 @@ class Adam(Optimizer):
 
     def _decoupled(self):
         return False
+
+    def _sparse_rule(self, g, p, slots, lr):
+        """lazy_mode adam (adam_op.h SparseAdamFunctor, lazy_mode=True):
+        moments and param update only on the rows present in the
+        SelectedRows grad. Duplicate rows are merge-added first (the
+        reference's scatter::MergeAdd)."""
+        if not self._lazy_mode:
+            return None
+        import numpy as np
+        rows_np = np.asarray(g.rows)
+        uniq, inv = np.unique(rows_np, return_inverse=True)
+        vals = jnp.zeros((uniq.shape[0],) + tuple(g.values.shape[1:]),
+                         jnp.float32)
+        vals = vals.at[jnp.asarray(inv)].add(g.values.astype(jnp.float32))
+        rows = jnp.asarray(uniq)
+        b1, b2 = self._beta1, self._beta2
+        b1p = slots["beta1_pow"] * b1
+        b2p = slots["beta2_pow"] * b2
+        m1r = b1 * slots["moment1"][rows] + (1 - b1) * vals
+        m2r = b2 * slots["moment2"][rows] + (1 - b2) * vals * vals
+        upd = (m1r / (1 - b1p)) / (jnp.sqrt(m2r / (1 - b2p))
+                                   + self._epsilon)
+        new_p = p.at[rows].add((-lr * upd).astype(p.dtype))
+        new_slots = {"moment1": slots["moment1"].at[rows].set(m1r),
+                     "moment2": slots["moment2"].at[rows].set(m2r),
+                     "beta1_pow": b1p, "beta2_pow": b2p}
+        return new_p, new_slots
 
     def _rule(self, g, p, slots, lr, wd):
         b1, b2 = self._beta1, self._beta2

@@ -147,28 +147,37 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         in_q: _queue.Queue = _queue.Queue(buffer_size)
         out_q: _queue.Queue = _queue.Queue(buffer_size)
         out_order = [0]
+        errors: list = []
 
         def read_worker():
-            for i, sample in enumerate(reader()):
-                in_q.put((i, sample) if order else sample)
-            for _ in range(process_num):
-                in_q.put(end)
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample) if order else sample)
+            except BaseException as e:  # surface, don't hang the consumer
+                errors.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
 
         def map_worker():
-            while True:
-                item = in_q.get()
-                if item is end:
-                    out_q.put(end)
-                    return
-                if order:
-                    i, sample = item
-                    r = mapper(sample)
-                    while out_order[0] != i:
-                        threading.Event().wait(0.001)
-                    out_q.put(r)
-                    out_order[0] += 1
-                else:
-                    out_q.put(mapper(item))
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end:
+                        return
+                    if order:
+                        i, sample = item
+                        r = mapper(sample)
+                        while out_order[0] != i:
+                            threading.Event().wait(0.001)
+                        out_q.put(r)
+                        out_order[0] += 1
+                    else:
+                        out_q.put(mapper(item))
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                out_q.put(end)
 
         threading.Thread(target=read_worker, daemon=True).start()
         for _ in range(process_num):
@@ -180,6 +189,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 finished += 1
             else:
                 yield e
+        if errors:
+            raise errors[0]
 
     return xreader
 
@@ -190,28 +201,38 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     be picklable."""
     import multiprocessing as mp
 
+    _END = "__paddle_tpu_reader_end__"
+
     def reader():
         q = mp.Queue(queue_size)
 
         def worker(r):
+            # a tagged sentinel (not None) so None samples pass through and
+            # worker crashes surface as errors instead of silent truncation
             try:
                 for sample in r():
-                    q.put(sample)
-            finally:
-                q.put(None)
+                    q.put(("sample", sample))
+                q.put((_END, None))
+            except BaseException as e:
+                q.put((_END, f"{type(e).__name__}: {e}"))
 
         procs = [mp.Process(target=worker, args=(r,), daemon=True)
                  for r in readers]
         for p in procs:
             p.start()
         finished = 0
+        failure = None
         while finished < len(readers):
-            sample = q.get()
-            if sample is None:
+            tag, payload = q.get()
+            if tag == _END:
                 finished += 1
+                failure = failure or payload
             else:
-                yield sample
+                yield payload
         for p in procs:
             p.join()
+        if failure is not None:
+            raise RuntimeError(f"multiprocess_reader worker failed: "
+                               f"{failure}")
 
     return reader

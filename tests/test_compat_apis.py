@@ -92,3 +92,53 @@ def test_callbacks_namespace():
 def test_sysconfig():
     assert isinstance(paddle.sysconfig.get_include(), str)
     assert isinstance(paddle.sysconfig.get_lib(), str)
+
+
+def test_xmap_readers_error_propagates():
+    from paddle_tpu import reader
+
+    def boom(x):
+        raise RuntimeError("mapper failed")
+
+    with pytest.raises(RuntimeError):
+        list(reader.xmap_readers(boom, lambda: iter(range(4)), 2, 4)())
+
+
+def test_multiprocess_reader_none_samples_and_errors():
+    from paddle_tpu import reader
+
+    def src_with_none():
+        yield 1
+        yield None
+        yield 2
+
+    got = list(reader.multiprocess_reader([src_with_none])())
+    assert got == [1, None, 2]
+
+    def src_crash():
+        yield 1
+        raise IOError("disk gone")
+
+    with pytest.raises(RuntimeError):
+        list(reader.multiprocess_reader([src_crash])())
+
+
+def test_categorical_log_prob_broadcast():
+    import jax.numpy as jnp
+    from paddle_tpu.distribution import Categorical
+    logits = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    c = Categorical(paddle.to_tensor(logits))
+    lp = np.asarray(c.log_prob(paddle.to_tensor([0, 1])).data)
+    assert lp.shape == (3, 2)
+    pr = np.asarray(c.probs(paddle.to_tensor([0, 1])).data)
+    np.testing.assert_allclose(lp, np.log(pr), atol=1e-5)
+
+
+def test_model_average_apply_before_step_is_noop():
+    from paddle_tpu import nn
+    from paddle_tpu.incubate import ModelAverage
+    lin = nn.Linear(2, 2)
+    w = lin.weight.numpy().copy()
+    ma = ModelAverage(parameters=lin.parameters())
+    with ma.apply():
+        np.testing.assert_allclose(lin.weight.numpy(), w)

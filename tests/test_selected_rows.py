@@ -1,0 +1,171 @@
+"""SelectedRows sparse-gradient tests (reference:
+framework/selected_rows.h; lookup_table_grad is_sparse=True;
+operators/optimizers/ sgd/adam sparse kernels).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core.selected_rows import SelectedRows
+
+
+def _sparse_grad_from(emb, ids):
+    out = emb(paddle.to_tensor(ids))
+    loss = paddle.sum(out * out)
+    loss.backward()
+    return emb.weight.grad
+
+
+def test_sparse_embedding_grad_is_selected_rows():
+    emb = nn.Embedding(50, 8, sparse=True)
+    ids = np.array([[1, 3], [3, 7]], np.int64)
+    g = _sparse_grad_from(emb, ids)
+    assert isinstance(g, SelectedRows)
+    assert g.height == 50
+    assert g.rows.shape == (4,)
+    assert g.values.shape == (4, 8)
+    # dense equivalence
+    emb2 = nn.Embedding(50, 8, sparse=False)
+    emb2.weight.set_value(emb.weight.numpy())
+    g2 = _sparse_grad_from(emb2, ids)
+    np.testing.assert_allclose(np.asarray(g.to_dense()),
+                               np.asarray(g2.data), atol=1e-6)
+
+
+def test_sparse_padding_idx_zero_grad():
+    emb = nn.Embedding(20, 4, sparse=True, padding_idx=0)
+    g = _sparse_grad_from(emb, np.array([[0, 5]], np.int64))
+    dense = np.asarray(g.to_dense())
+    assert np.abs(dense[0]).max() == 0.0
+    assert np.abs(dense[5]).max() > 0.0
+
+
+def test_sparse_sgd_matches_dense():
+    ids = np.array([[2, 9, 2]], np.int64)
+
+    def run(sparse):
+        paddle.seed(0)
+        emb = nn.Embedding(30, 4, sparse=sparse)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=emb.parameters())
+        for _ in range(2):
+            out = emb(paddle.to_tensor(ids))
+            loss = paddle.sum(out * out)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return emb.weight.numpy()
+
+    np.testing.assert_allclose(run(True), run(False), atol=1e-5)
+
+
+def test_sparse_adam_lazy_matches_dense_on_touched_rows():
+    ids = np.array([[4, 11]], np.int64)
+
+    def run(sparse, lazy):
+        paddle.seed(1)
+        emb = nn.Embedding(30, 4, sparse=sparse)
+        opt = optimizer.Adam(learning_rate=0.05, lazy_mode=lazy,
+                             parameters=emb.parameters())
+        for _ in range(3):
+            out = emb(paddle.to_tensor(ids))
+            loss = paddle.sum(out * out)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return emb.weight.numpy()
+
+    w_lazy = run(True, True)
+    w_dense = run(False, False)
+    # touched rows follow the same trajectory (untouched rows: lazy leaves
+    # them alone, dense also leaves them alone since their grad/moments
+    # stay 0 for adam with zero grads -> update = 0)
+    np.testing.assert_allclose(w_lazy[[4, 11]], w_dense[[4, 11]], atol=1e-5)
+    np.testing.assert_allclose(w_lazy[[0, 1, 29]], w_dense[[0, 1, 29]],
+                               atol=1e-6)
+
+
+def test_sparse_adam_nonlazy_densifies():
+    emb = nn.Embedding(10, 4, sparse=True)
+    opt = optimizer.Adam(learning_rate=0.1, parameters=emb.parameters())
+    out = emb(paddle.to_tensor(np.array([[1]], np.int64)))
+    paddle.sum(out).backward()
+    assert isinstance(emb.weight.grad, SelectedRows)
+    opt.step()  # falls back to the dense rule without error
+
+
+def test_sparse_grad_accumulates_and_merges():
+    emb = nn.Embedding(10, 4, sparse=True)
+    out = emb(paddle.to_tensor(np.array([[1]], np.int64)))
+    paddle.sum(out).backward()
+    out = emb(paddle.to_tensor(np.array([[2]], np.int64)))
+    paddle.sum(out).backward()
+    g = emb.weight.grad
+    assert isinstance(g, SelectedRows)
+    assert g.rows.shape == (2,)
+    dense = np.asarray(g.to_dense())
+    assert np.abs(dense[1]).max() > 0 and np.abs(dense[2]).max() > 0
+
+
+def test_sparse_with_global_clip_densifies():
+    emb = nn.Embedding(10, 4, sparse=True)
+    clip = paddle.nn.ClipGradByGlobalNorm(0.01)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=emb.parameters(),
+                        grad_clip=clip)
+    out = emb(paddle.to_tensor(np.array([[3]], np.int64)))
+    paddle.sum(out * out).backward()
+    w_before = emb.weight.numpy().copy()
+    opt.step()
+    delta = np.abs(emb.weight.numpy() - w_before)
+    # clipped: total update norm bounded by lr * clip_norm
+    assert 0 < delta.sum() <= 0.1 * 0.01 * 4 + 1e-6
+
+
+# ---------------- dynamic-batch serving ----------------
+
+def test_predictor_dynamic_batch(tmp_path):
+    """The exported program is traced at one batch size; the predictor must
+    serve smaller and larger batches (pad / chunk) with identical values
+    (analysis_predictor dynamic feed parity)."""
+    from paddle_tpu.inference import Config, create_predictor, export_model
+
+    paddle.seed(0)
+    m = nn.Linear(6, 3)
+    x8 = paddle.randn([8, 6])
+    prefix = str(tmp_path / "lin")
+    export_model(m, [x8], prefix)
+    pred = create_predictor(Config(prefix))
+
+    rng = np.random.RandomState(0)
+    for bs in (8, 3, 20):
+        xin = rng.randn(bs, 6).astype(np.float32)
+        (out,) = pred.run([xin])
+        want = np.asarray(m(paddle.to_tensor(xin)).data)
+        assert out.shape == (bs, 3)
+        np.testing.assert_allclose(out, want, atol=1e-5)
+
+
+def test_sparse_grad_with_gradscaler():
+    """amp.GradScaler must unscale SelectedRows grads (values only)."""
+    from paddle_tpu import amp
+    emb = nn.Embedding(10, 4, sparse=True)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=emb.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=2.0)
+    out = emb(paddle.to_tensor(np.array([[3]], np.int64)))
+    loss = paddle.sum(out * out)
+    w_before = emb.weight.numpy().copy()
+    scaler.scale(loss).backward()
+    g_scaled = emb.weight.grad
+    assert isinstance(g_scaled, SelectedRows)
+    vals_scaled = np.asarray(g_scaled.values).copy()
+    scaler.step(opt)
+    scaler.update()
+    assert not scaler._found_inf
+    # applied update = -lr * (scaled values / loss_scale) on row 3 only
+    delta = emb.weight.numpy() - w_before
+    np.testing.assert_allclose(delta[3], -0.1 * vals_scaled[0] / 2.0,
+                               atol=1e-6)
+    mask = np.ones(10, bool)
+    mask[3] = False
+    assert np.abs(delta[mask]).max() == 0.0
