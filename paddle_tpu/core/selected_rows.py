@@ -6,8 +6,10 @@ consumed row-wise by sgd_op/adam_op lazy_mode).
 TPU-native: on-device `rows` (int32 [K]) + `values` ([K, H]) jax arrays.
 Eager embedding backward emits these instead of a dense [V, H] scatter;
 SGD/Adam(lazy_mode) apply them with `at[rows]` scatter updates, so one
-step touches K·H elements instead of V·H. merge() keeps duplicate rows
-(scatter-add semantics preserve correctness); to_dense() materializes."""
+step touches K·H elements instead of V·H. merge(other) concatenates two
+sparse grads (duplicates are fine — scatter-add preserves correctness);
+merge() with no argument merge-adds duplicate rows into unique ones;
+to_dense() materializes."""
 from __future__ import annotations
 
 import jax.numpy as jnp
@@ -17,8 +19,13 @@ class SelectedRows:
     __slots__ = ("rows", "values", "height")
 
     def __init__(self, rows, values, height: int):
+        import numpy as np
+        if isinstance(rows, (list, tuple)) or getattr(
+                rows, "__module__", "").startswith("numpy"):
+            rows = jnp.asarray(np.asarray(rows, np.int64).astype(np.int32))
+        vdata = getattr(values, "data", values)  # accept Tensor or array
         self.rows = rows
-        self.values = values
+        self.values = jnp.asarray(vdata)
         self.height = int(height)
 
     @property
@@ -39,10 +46,21 @@ class SelectedRows:
         out = jnp.zeros(self.shape, self.values.dtype)
         return out.at[self.rows].add(self.values)
 
-    def merge(self, other: "SelectedRows") -> "SelectedRows":
-        assert self.height == other.height
-        return SelectedRows(jnp.concatenate([self.rows, other.rows]),
-                            jnp.concatenate([self.values, other.values]),
+    def merge(self, other: "SelectedRows" = None) -> "SelectedRows":
+        """merge(other): concatenate two sparse grads (gradient
+        accumulation). merge(): merge-add duplicate rows
+        (merge_selected_rows op)."""
+        if other is not None:
+            assert self.height == other.height
+            return SelectedRows(jnp.concatenate([self.rows, other.rows]),
+                                jnp.concatenate([self.values, other.values]),
+                                self.height)
+        import numpy as np
+        uniq, inv = np.unique(np.asarray(self.rows), return_inverse=True)
+        vals = jnp.zeros((len(uniq),) + tuple(self.values.shape[1:]),
+                         self.values.dtype)
+        vals = vals.at[jnp.asarray(inv)].add(self.values)
+        return SelectedRows(jnp.asarray(uniq.astype("int32")), vals,
                             self.height)
 
     def scale(self, factor) -> "SelectedRows":

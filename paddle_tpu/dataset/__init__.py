@@ -1,0 +1,15 @@
+"""paddle.dataset legacy reader-factory module (reference:
+python/paddle/dataset/ — per-dataset `train()`/`test()` generator
+factories feeding `paddle.batch`; uci_housing.py:92, mnist.py, cifar.py,
+imdb.py, imikolov.py, common.py DATA_HOME/download cache).
+
+TPU-native stance: the modern input path is io.DataLoader over
+vision/text Dataset objects; these factories wrap the same datasets in the
+v1 reader protocol. Downloads are not attempted in air-gapped
+environments — datasets fall back to the deterministic synthetic data the
+2.x dataset classes already provide.
+"""
+from . import common, imdb, imikolov, mnist, uci_housing  # noqa: F401
+from . import cifar  # noqa: F401
+
+__all__ = ["common", "uci_housing", "mnist", "cifar", "imdb", "imikolov"]

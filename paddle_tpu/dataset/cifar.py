@@ -1,0 +1,40 @@
+"""dataset.cifar (reference: dataset/cifar.py train10/test10/train100/
+test100 readers yielding (flat float image, label)). Wraps
+vision.datasets.Cifar10/Cifar100."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _reader(cls, mode):
+    def reader():
+        ds = cls(mode=mode)
+        for i in range(len(ds)):
+            img, label = ds[i]
+            # vision.datasets.Cifar* already yield [0,1] floats — exactly
+            # the legacy reader's /255 contract
+            arr = np.asarray(getattr(img, "data", img), np.float32)
+            yield arr.reshape(-1), int(
+                np.asarray(getattr(label, "data", label)).ravel()[0])
+
+    return reader
+
+
+def train10():
+    from ..vision.datasets import Cifar10
+    return _reader(Cifar10, "train")
+
+
+def test10():
+    from ..vision.datasets import Cifar10
+    return _reader(Cifar10, "test")
+
+
+def train100():
+    from ..vision.datasets import Cifar100
+    return _reader(Cifar100, "train")
+
+
+def test100():
+    from ..vision.datasets import Cifar100
+    return _reader(Cifar100, "test")

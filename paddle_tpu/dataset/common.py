@@ -1,0 +1,32 @@
+"""dataset.common (reference: python/paddle/dataset/common.py — DATA_HOME
+cache dir, download with md5 check, split helpers)."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+DATA_HOME = os.path.expanduser(os.environ.get(
+    "PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str, save_name=None) -> str:
+    """Reference download-with-cache. Network egress is unavailable in
+    air-gapped TPU environments: the cached file is used when present,
+    otherwise a clear error tells the user to place it there."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(dirname,
+                            save_name or url.split("/")[-1])
+    if os.path.exists(filename) and (not md5sum
+                                     or md5file(filename) == md5sum):
+        return filename
+    raise RuntimeError(
+        f"dataset file {filename} not cached and downloading is disabled "
+        f"in this environment; fetch {url} out of band into {dirname}")

@@ -1,0 +1,33 @@
+"""dataset.imdb (reference: dataset/imdb.py train/test readers yielding
+(token-id sequence, 0/1 label)). Wraps text.Imdb."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def word_dict():
+    from ..text import Imdb
+    ds = Imdb(mode="train")
+    vocab = getattr(ds, "vocab_size", 5000)
+    return {f"w{i}": i for i in range(vocab)}
+
+
+def _reader(mode):
+    from ..text import Imdb
+
+    def reader():
+        ds = Imdb(mode=mode)
+        for i in range(len(ds)):
+            seq, label = ds[i]
+            yield (np.asarray(getattr(seq, "data", seq)).tolist(),
+                   int(np.asarray(getattr(label, "data", label)).ravel()[0]))
+
+    return reader
+
+
+def train(word_idx=None):
+    return _reader("train")
+
+
+def test(word_idx=None):
+    return _reader("test")

@@ -1,0 +1,27 @@
+"""dataset.imikolov (reference: dataset/imikolov.py — PTB-style n-gram
+reader). Wraps text.Imikolov."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _reader(mode, n):
+    from ..text import Imikolov
+
+    def reader():
+        ds = Imikolov(mode=mode)
+        for i in range(len(ds)):
+            sample = ds[i]
+            seq = np.asarray(getattr(sample[0], "data", sample[0])).ravel()
+            for j in range(len(seq) - n + 1):
+                yield tuple(int(t) for t in seq[j:j + n])
+
+    return reader
+
+
+def train(word_idx=None, n=5):
+    return _reader("train", n)
+
+
+def test(word_idx=None, n=5):
+    return _reader("test", n)

@@ -1,0 +1,31 @@
+"""dataset.mnist (reference: dataset/mnist.py train/test readers yielding
+(flattened image [-1,1], label)). Wraps vision.datasets.MNIST (synthetic
+fallback when the real files are absent)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _reader(mode):
+    from ..vision.datasets import MNIST
+
+    ds = MNIST(mode=mode)
+
+    def reader():
+        for i in range(len(ds)):
+            img, label = ds[i]
+            # vision.datasets.MNIST yields [0,1] floats; the legacy reader
+            # contract is [-1, 1]
+            arr = np.asarray(getattr(img, "data", img), np.float32)
+            yield arr.reshape(-1) * 2.0 - 1.0, int(
+                np.asarray(getattr(label, "data", label)).ravel()[0])
+
+    return reader
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")

@@ -1,0 +1,43 @@
+"""dataset.uci_housing (reference: dataset/uci_housing.py:92 train/test —
+506 samples x 13 features + price, normalized, 80/20 split).
+
+Synthetic fallback: a fixed-seed linear-plus-noise regression problem with
+the reference's shapes and normalization, so the classic fit-a-line
+example trains out of the box."""
+from __future__ import annotations
+
+import numpy as np
+
+feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS",
+                 "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+
+_N = 506
+_SPLIT = int(_N * 0.8)
+
+
+def _data():
+    rng = np.random.RandomState(42)
+    x = rng.randn(_N, 13).astype(np.float32)
+    w = rng.randn(13, 1).astype(np.float32)
+    y = (x @ w + 0.1 * rng.randn(_N, 1)).astype(np.float32)
+    # normalize features to the reference's feature_range convention
+    x = (x - x.mean(0)) / (x.max(0) - x.min(0))
+    return x, y
+
+
+def train():
+    def reader():
+        x, y = _data()
+        for i in range(_SPLIT):
+            yield x[i], y[i]
+
+    return reader
+
+
+def test():
+    def reader():
+        x, y = _data()
+        for i in range(_SPLIT, _N):
+            yield x[i], y[i]
+
+    return reader

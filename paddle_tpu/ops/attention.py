@@ -156,7 +156,12 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
     def _finalize():
         l = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[...] + jnp.log(l)).astype(jnp.float32)
+        # lse buffer is [bh, 1, Sq]: a trailing dim of 1 would get a
+        # T(8,128) padded layout (128x HBM expansion — OOMs 1B+ models),
+        # so the whole row lives in lanes and each q block ds-writes its
+        # slice of the revisited (b, 0, 0) block
+        lse_ref[0, 0, :] = (
+            m_ref[...] + jnp.log(l)).astype(jnp.float32).reshape(block_q)
 
 
 def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
@@ -193,14 +198,16 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
                                 preferred_element_type=jnp.float32)
         s = _apply_mask_block(s, mask_ref, causal, block_q, block_k, q_start,
                               k_start, causal_offset)
-        p = jnp.where(s <= _NEG_INF / 2, 0.0, jnp.exp(s - lse_ref[0]))
+        lse_col = lse_ref[0]
+        delta_col = delta_ref[0]
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, jnp.exp(s - lse_col))
         dp = jax.lax.dot_general(g, vblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_p > 0.0:
             keep = _block_keep(seed_ref, b, qi, kb, n_qb, n_kb, p.shape,
                                dropout_p)
             dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
-        ds = p * (dp - delta_ref[0]) * scale
+        ds = p * (dp - delta_col) * scale
         acc_ref[...] += jax.lax.dot_general(
             ds, kblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -245,8 +252,10 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
                                 preferred_element_type=jnp.float32)
         s = _apply_mask_block(s, mask_ref, causal, block_q, block_k, q_start,
                               k_start, causal_offset)
+        lse_col = lse_ref[0]
+        delta_col = delta_ref[0]
         p = jnp.where(s <= _NEG_INF / 2, 0.0,
-                      jnp.exp(s - lse_ref[0]))  # [bq, bk]
+                      jnp.exp(s - lse_col))  # [bq, bk]
         dp = jax.lax.dot_general(g, vblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_p > 0.0:
@@ -257,7 +266,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
             dp = jnp.where(keep, dp / inv, 0.0)
         else:
             p_drop = p
-        ds = p * (dp - delta_ref[0]) * scale
+        ds = p * (dp - delta_col) * scale
         # dv += p_drop^T @ g ; dk += ds^T @ q
         dv_acc[...] += jax.lax.dot_general(
             p_drop, g, (((0,), (0,)), ((), ())),
@@ -328,11 +337,11 @@ def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k, dropout_p,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, 1, Sq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),   # acc
@@ -341,7 +350,7 @@ def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k, dropout_p,
         ],
         interpret=(jax.default_backend() == "cpu"),
     )(*operands)
-    return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq, 1)
+    return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)
 
 
 def _flash_bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
@@ -355,6 +364,11 @@ def _flash_bwd(q, k, v, mask, out, lse, g, causal, scale, block_q, block_k,
     kr = k.reshape(B * H, Sk, D)
     vr = v.reshape(B * H, Sk, D)
     gr = g.reshape(B * H, Sq, D)
+    # the residual lse is stored compactly as [B,H,Sq]; the kernels want a
+    # [bh, Sq, 1] column operand (its size-1 minor dim is legal because the
+    # block's trailing dim equals the array's) — materialize it transiently
+    # here (an XLA relayout, ~2x the unpadded lse bytes of traffic) rather
+    # than paying an in-kernel lane->sublane relayout every grid step
     lser = lse.reshape(B * H, Sq, 1)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
                     keepdims=True).reshape(B * H, Sq, 1)

@@ -332,13 +332,9 @@ class Adam(Optimizer):
         reference's scatter::MergeAdd)."""
         if not self._lazy_mode:
             return None
-        import numpy as np
-        rows_np = np.asarray(g.rows)
-        uniq, inv = np.unique(rows_np, return_inverse=True)
-        vals = jnp.zeros((uniq.shape[0],) + tuple(g.values.shape[1:]),
-                         jnp.float32)
-        vals = vals.at[jnp.asarray(inv)].add(g.values.astype(jnp.float32))
-        rows = jnp.asarray(uniq)
+        merged = g.merge()  # merge-add duplicate rows (scatter::MergeAdd)
+        rows = merged.rows
+        vals = merged.values.astype(jnp.float32)
         b1, b2 = self._beta1, self._beta2
         b1p = slots["beta1_pow"] * b1
         b2p = slots["beta2_pow"] * b2

@@ -160,6 +160,9 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     in_q.put(end)
 
         def map_worker():
+            # ordered mode: emit (i, result) and let the CONSUMER reorder —
+            # workers never wait on each other, so one failing worker can't
+            # strand the rest mid-busy-wait
             try:
                 while True:
                     item = in_q.get()
@@ -167,11 +170,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                         return
                     if order:
                         i, sample = item
-                        r = mapper(sample)
-                        while out_order[0] != i:
-                            threading.Event().wait(0.001)
-                        out_q.put(r)
-                        out_order[0] += 1
+                        out_q.put((i, mapper(sample)))
                     else:
                         out_q.put(mapper(item))
             except BaseException as e:
@@ -183,14 +182,24 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         for _ in range(process_num):
             threading.Thread(target=map_worker, daemon=True).start()
         finished = 0
+        pending = {}
         while finished < process_num:
             e = out_q.get()
             if e is end:
                 finished += 1
+            elif order:
+                i, r = e
+                pending[i] = r
+                while out_order[0] in pending:
+                    yield pending.pop(out_order[0])
+                    out_order[0] += 1
             else:
                 yield e
         if errors:
             raise errors[0]
+        if order:  # drain any tail still buffered (all workers done)
+            for i in sorted(pending):
+                yield pending[i]
 
     return xreader
 

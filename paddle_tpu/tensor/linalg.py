@@ -224,3 +224,13 @@ def corrcoef(x, rowvar=True, name=None):
 
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     return apply(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), _t(x))
+
+
+def mv(x, vec, name=None):
+    """Matrix-vector product (mv_op.cc)."""
+    return apply(lambda a, b: a @ b, _t(x), _t(vec))
+
+
+def inverse(x, name=None):
+    """paddle.inverse alias of linalg.inv (inverse_op.cc)."""
+    return inv(x)

@@ -144,34 +144,6 @@ def sequence_expand(x: LoDTensor, y: LoDTensor, ref_level=-1) -> LoDTensor:
     return LoDTensor(packed, [offsets])
 
 
-class SelectedRows:
-    """Sparse gradient container (framework/selected_rows.h analog): a set
-    of row indices + their values over a [height, ...] dense space. Embedding
-    backward with sparse=True produces one of these; `to_dense()` scatters.
-
-    TPU stance: in-graph grads stay dense (XLA scatter-add is the fast
-    path); SelectedRows serves the eager/PS-style host pipeline where only
-    touched rows should materialize."""
-
-    def __init__(self, rows, values, height: int):
-        self.rows = np.asarray(rows, np.int64)
-        self.values = values if isinstance(values, Tensor) else Tensor(values)
-        self.height = height
-
-    def to_dense(self) -> Tensor:
-        shape = [self.height] + list(self.values.shape[1:])
-        out = jnp.zeros(shape, self.values.data.dtype)
-        out = out.at[jnp.asarray(self.rows)].add(self.values.data)
-        return Tensor(out)
-
-    def merge(self) -> "SelectedRows":
-        """Merge duplicate rows by summation (merge_selected_rows op)."""
-        uniq, inv = np.unique(self.rows, return_inverse=True)
-        vals = jnp.zeros((len(uniq),) + tuple(self.values.shape[1:]),
-                         self.values.data.dtype)
-        vals = vals.at[jnp.asarray(inv)].add(self.values.data)
-        return SelectedRows(uniq, vals, self.height)
-
-    def __repr__(self):
-        return (f"SelectedRows(nnz_rows={len(self.rows)}, "
-                f"height={self.height})")
+# canonical implementation lives in core.selected_rows (it is also what the
+# sparse-embedding tape and the optimizers' row-wise rules produce/consume)
+from ..core.selected_rows import SelectedRows  # noqa: E402,F401

@@ -364,3 +364,77 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
         return jnp.pad(a, cfg, mode=jmode)
 
     return apply(f, x)
+
+
+# ---- root-namespace parity fns (reference python/paddle/__init__.py) ----
+
+def cast(x, dtype):
+    """paddle.cast (cast_op.cc)."""
+    return _t(x).astype(dtype)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                        axis2=axis2), _t(x))
+
+
+def numel(x, name=None):
+    from .creation import to_tensor
+    import numpy as _np
+    return to_tensor(_np.asarray(int(_t(x).data.size), _np.int64))
+
+
+def rank(input, name=None):
+    from .creation import to_tensor
+    import numpy as _np
+    return to_tensor(_np.asarray(int(_t(input).data.ndim), _np.int32))
+
+
+def shape(input, name=None):
+    """paddle.shape: the runtime shape as an int32 tensor (shape_op.cc)."""
+    from .creation import to_tensor
+    import numpy as _np
+    return to_tensor(_np.asarray(_t(input).data.shape, _np.int32))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    """In-place scatter (paddle.scatter_): x[index] = / += updates."""
+    t = _t(x)
+    res = scatter(t, index, updates, overwrite=overwrite)
+    t.data = res.data
+    return t
+
+
+def squeeze_(x, axis=None, name=None):
+    t = _t(x)
+    t.data = squeeze(t, axis=axis).data
+    return t
+
+
+def unsqueeze_(x, axis, name=None):
+    t = _t(x)
+    t.data = unsqueeze(t, axis).data
+    return t
+
+
+def tolist(x):
+    """paddle.tolist (varbase_patch_methods tolist)."""
+    import numpy as _np
+    return _np.asarray(_t(x).data).tolist()
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """shard_index_op.cc: map global indices to shard-local ones; indices
+    outside this shard become ignore_value (used to build vocab-sharded
+    softmax labels)."""
+    if shard_id < 0 or shard_id >= nshards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range [0, {nshards})")
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def f(ids):
+        lo = shard_id * shard_size
+        inside = (ids // shard_size) == shard_id
+        return jnp.where(inside, ids - lo, ignore_value)
+
+    return apply(f, _t(input))

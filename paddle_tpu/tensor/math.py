@@ -292,3 +292,25 @@ def lcm(x, y, name=None):
 
 def broadcast_shape(x_shape, y_shape):
     return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def add_n(inputs, name=None):
+    """paddle.add_n (sum_op.cc): elementwise sum of a tensor list."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    ts = [_t(x) for x in inputs]
+
+    def f(*xs):  # NB: `sum` here is this module's reduction, not builtins'
+        acc = xs[0]
+        for x in xs[1:]:
+            acc = acc + x
+        return acc
+
+    return apply(f, *ts)
+
+
+def tanh_(x, name=None):
+    """In-place tanh (paddle.tanh_)."""
+    t = _t(x)
+    t.data = jnp.tanh(t.data)
+    return t

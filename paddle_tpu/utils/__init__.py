@@ -1,1 +1,20 @@
 from . import cpp_extension  # noqa: F401
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions — forwards to numpy's print options (tensor
+    reprs render via numpy)."""
+    import numpy as np
+    kwargs = {}
+    if precision is not None:
+        kwargs["precision"] = precision
+    if threshold is not None:
+        kwargs["threshold"] = threshold
+    if edgeitems is not None:
+        kwargs["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kwargs["linewidth"] = linewidth
+    if sci_mode is not None:
+        kwargs["suppress"] = not sci_mode
+    np.set_printoptions(**kwargs)

@@ -142,3 +142,93 @@ def test_model_average_apply_before_step_is_noop():
     ma = ModelAverage(parameters=lin.parameters())
     with ma.apply():
         np.testing.assert_allclose(lin.weight.numpy(), w)
+
+
+def test_root_tensor_ops():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert int(paddle.numel(x).item()) == 6
+    assert int(paddle.rank(x).item()) == 2
+    assert paddle.shape(x).numpy().tolist() == [2, 3]
+    assert paddle.tolist(x) == [[0, 1, 2], [3, 4, 5]]
+    np.testing.assert_allclose(
+        np.asarray(paddle.diagonal(x).data), [0, 4])
+    np.testing.assert_allclose(
+        np.asarray(paddle.add_n([x, x, x]).data), 3 * np.asarray(x.data))
+    np.testing.assert_allclose(
+        np.asarray(paddle.mv(x, paddle.to_tensor(
+            np.ones(3, np.float32))).data), [3.0, 12.0])
+    m = paddle.to_tensor(np.eye(2, dtype=np.float32) * 4)
+    np.testing.assert_allclose(np.asarray(paddle.inverse(m).data),
+                               np.eye(2) * 0.25, atol=1e-6)
+    si = paddle.shard_index(paddle.to_tensor(
+        np.array([0, 5, 9], np.int64)), 10, 2, 0)
+    assert np.asarray(si.data).tolist() == [0, -1, -1]
+    si1 = paddle.shard_index(paddle.to_tensor(
+        np.array([0, 5, 9], np.int64)), 10, 2, 1)
+    assert np.asarray(si1.data).tolist() == [-1, 0, 4]
+    # in-place variants mutate and return the same tensor
+    y = paddle.to_tensor(np.ones((1, 3), np.float32))
+    z = paddle.squeeze_(y)
+    assert z is y and tuple(y.shape) == (3,)
+    t = paddle.to_tensor(np.zeros(2, np.float32))
+    assert paddle.tanh_(t) is t
+    sc = paddle.to_tensor(np.zeros(4, np.float32))
+    paddle.scatter_(sc, paddle.to_tensor(np.array([1], np.int64)),
+                    paddle.to_tensor(np.array([[5.0]], np.float32).ravel()))
+    assert np.asarray(sc.data)[1] == 5.0
+
+
+def test_legacy_dataset_readers():
+    from paddle_tpu import dataset
+
+    # uci_housing: classic fit-a-line shapes
+    sample = next(dataset.uci_housing.train()())
+    assert sample[0].shape == (13,) and sample[1].shape == (1,)
+    n_train = sum(1 for _ in dataset.uci_housing.train()())
+    n_test = sum(1 for _ in dataset.uci_housing.test()())
+    assert n_train == 404 and n_test == 102
+
+    # mnist: flattened [-1,1] images through paddle.batch
+    r = paddle.batch(dataset.mnist.train(), batch_size=4)
+    imgs_labels = next(r())
+    assert len(imgs_labels) == 4
+    img, label = imgs_labels[0]
+    assert img.shape == (784,) and -1.0 <= img.min() <= img.max() <= 1.0
+    assert isinstance(label, int)
+
+    # imdb: (sequence list, binary label)
+    seq, lab = next(dataset.imdb.train()())
+    assert isinstance(seq, list) and lab in (0, 1)
+
+    # imikolov: n-gram tuples
+    gram = next(dataset.imikolov.train(n=5)())
+    assert len(gram) == 5
+
+    # common.download refuses cleanly without cache
+    with pytest.raises(RuntimeError):
+        dataset.common.download("http://example.com/x.tgz", "x", "")
+
+
+def test_mnist_reader_range_and_xmap_order_error():
+    from paddle_tpu import dataset, reader
+
+    img, _ = next(dataset.mnist.train()())
+    assert img.min() < -0.5 and img.max() > 0.5  # real [-1,1] spread
+    c, _ = next(dataset.cifar.train10()())
+    assert c.max() > 0.1  # [0,1] images, not double-normalized
+
+    # ordered xmap: results come back in order
+    got = list(reader.xmap_readers(lambda x: x * 10,
+                                   lambda: iter(range(8)), 3, 4,
+                                   order=True)())
+    assert got == [i * 10 for i in range(8)]
+
+    # ordered xmap: a failing mapper raises instead of hanging
+    def boom(x):
+        if x == 2:
+            raise RuntimeError("bad sample")
+        return x
+
+    with pytest.raises(RuntimeError):
+        list(reader.xmap_readers(boom, lambda: iter(range(8)), 3, 4,
+                                 order=True)())

@@ -56,8 +56,8 @@ def test_selected_rows_to_dense_and_merge():
     sr = SelectedRows(rows=[1, 3, 1], values=np.ones((3, 2), np.float32),
                       height=5)
     merged = sr.merge()
-    assert sorted(merged.rows.tolist()) == [1, 3]
-    dense = sr.to_dense().numpy()
+    assert sorted(np.asarray(merged.rows).tolist()) == [1, 3]
+    dense = np.asarray(sr.to_dense())
     assert dense.shape == (5, 2)
     np.testing.assert_allclose(dense[1], [2.0, 2.0])  # duplicate row summed
     np.testing.assert_allclose(dense[3], [1.0, 1.0])
