@@ -87,6 +87,14 @@ def in_dynamic_mode():
     return True
 
 
+def flops(net, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False):
+    """paddle.flops parity (hapi/dynamic_flops.py): MACs of one forward."""
+    from .hapi.dynamic_flops import flops as _flops
+    return _flops(net, input_size=input_size, inputs=inputs,
+                  custom_ops=custom_ops, print_detail=print_detail)
+
+
 def summary(net, input_size=None, dtypes=None):
     total = sum(p.size for p in net.parameters())
     trainable = sum(p.size for p in net.parameters() if p.trainable)

@@ -46,20 +46,28 @@ class SelectedRows:
         out = jnp.zeros(self.shape, self.values.dtype)
         return out.at[self.rows].add(self.values)
 
-    def merge(self, other: "SelectedRows" = None) -> "SelectedRows":
+    def merge(self, other: "SelectedRows" = None,
+              accum_dtype=None) -> "SelectedRows":
         """merge(other): concatenate two sparse grads (gradient
         accumulation). merge(): merge-add duplicate rows
-        (merge_selected_rows op)."""
+        (merge_selected_rows op), accumulating in accum_dtype (default
+        fp32 for low-precision values so repeated-token sums keep their
+        mantissa) and casting back to the values' dtype."""
         if other is not None:
             assert self.height == other.height
             return SelectedRows(jnp.concatenate([self.rows, other.rows]),
                                 jnp.concatenate([self.values, other.values]),
                                 self.height)
         import numpy as np
+        if accum_dtype is None:
+            accum_dtype = (jnp.float32 if self.values.dtype
+                           in (jnp.bfloat16, jnp.float16)
+                           else self.values.dtype)
         uniq, inv = np.unique(np.asarray(self.rows), return_inverse=True)
         vals = jnp.zeros((len(uniq),) + tuple(self.values.shape[1:]),
-                         self.values.dtype)
-        vals = vals.at[jnp.asarray(inv)].add(self.values)
+                         accum_dtype)
+        vals = vals.at[jnp.asarray(inv)].add(
+            self.values.astype(accum_dtype))
         return SelectedRows(jnp.asarray(uniq.astype("int32")), vals,
                             self.height)
 

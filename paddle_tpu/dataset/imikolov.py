@@ -9,7 +9,7 @@ def _reader(mode, n):
     from ..text import Imikolov
 
     def reader():
-        ds = Imikolov(mode=mode)
+        ds = Imikolov(mode=mode, window_size=n)
         for i in range(len(ds)):
             sample = ds[i]
             seq = np.asarray(getattr(sample[0], "data", sample[0])).ravel()

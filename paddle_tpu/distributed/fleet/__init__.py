@@ -78,12 +78,16 @@ class Fleet:
         mp_deg = max(hc.mp_degree, 1)
         pp = max(hc.pp_degree, 1)
         sharding = max(hc.sharding_degree, 1)
+        sep = max(getattr(hc, "sep_degree", 1), 1)
         if dp == -1 or dp is None:
-            dp = max(n_dev // (mp_deg * pp * sharding), 1)
+            dp = max(n_dev // (mp_deg * pp * sharding * sep), 1)
             hc.dp_degree = dp
-        topo = CommunicateTopology(
-            ["data", "pipe", "sharding", "model"],
-            [dp, pp, sharding, mp_deg])
+        names = ["data", "pipe", "sharding", "model"]
+        dims = [dp, pp, sharding, mp_deg]
+        if sep > 1:  # parity-plus sequence/context-parallel axis
+            names.insert(3, "sep")
+            dims.insert(3, sep)
+        topo = CommunicateTopology(names, dims)
         self._hcg = HybridCommunicateGroup(topo)
         set_hybrid_communicate_group(self._hcg)
         # TP RNG streams (fleet_base.py:320-326)

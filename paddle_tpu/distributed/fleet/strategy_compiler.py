@@ -34,7 +34,7 @@ from ..strategy import AMPConfig, DistributedStrategy
 # numerics of the forward first, optimizer swaps next, execution-layout
 # transforms last.
 TRANSFORM_ORDER = ("amp", "lars", "lamb", "recompute", "gradient_merge",
-                   "localsgd", "sharding", "pipeline")
+                   "localsgd", "sequence_parallel", "sharding", "pipeline")
 
 
 @dataclasses.dataclass
@@ -52,6 +52,7 @@ class CompiledStrategy:
     localsgd_k: int = 0
     localsgd_begin: int = 1
     pipeline: bool = False
+    sequence_parallel: bool = False
     optimizer = None  # possibly swapped by lars/lamb
 
     def describe(self) -> str:
@@ -92,6 +93,13 @@ class StrategyCompiler:
             plan.localsgd_k = max(strategy.localsgd_configs.k_steps, 1)
             plan.localsgd_begin = strategy.localsgd_configs.begin_step
             plan.applied.append("localsgd")
+        if getattr(strategy, "sequence_parallel", False) or \
+                strategy.hybrid_configs.sep_degree > 1:
+            # parity-plus: shard the token/sequence dim over the `sep`
+            # mesh axis (ring/Ulysses primitives in parallel.ring_attention;
+            # the GSPMD step shards activations and gathers k/v on demand)
+            plan.sequence_parallel = True
+            plan.applied.append("sequence_parallel")
         if getattr(strategy, "sharding", False):
             plan.zero_stage = strategy.sharding_configs.stage
             plan.zero_offload = strategy.sharding_configs.offload

@@ -1,0 +1,79 @@
+"""paddle.flops (reference: python/paddle/hapi/dynamic_flops.py — forward
+hooks per leaf layer counting multiply-accumulates on a real forward pass).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _numel(t):
+    import math
+    return int(math.prod(t.shape)) if hasattr(t, "shape") else 0
+
+
+def _count(layer, inputs, output):
+    from ..nn import layer as L
+
+    cls = type(layer).__name__
+    x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+    out_n = _numel(output if not isinstance(output, (tuple, list))
+                   else output[0])
+    if cls in ("Linear",):
+        return out_n * layer.weight.shape[0]
+    if cls in ("Conv2D", "Conv1D", "Conv3D", "Conv2DTranspose"):
+        w = layer.weight
+        k = int(np.prod(w.shape[2:])) * w.shape[1]  # kernel x in_ch/groups
+        return out_n * k
+    if cls in ("BatchNorm2D", "BatchNorm1D", "BatchNorm", "LayerNorm",
+               "GroupNorm", "InstanceNorm2D", "SyncBatchNorm"):
+        return 2 * out_n
+    if cls in ("ReLU", "ReLU6", "Sigmoid", "Tanh", "GELU", "Softmax",
+               "LeakyReLU", "Hardswish", "Hardsigmoid", "SiLU"):
+        return out_n
+    if cls in ("AvgPool2D", "MaxPool2D", "AdaptiveAvgPool2D",
+               "AdaptiveMaxPool2D", "AvgPool1D", "MaxPool1D"):
+        return out_n
+    if cls == "Embedding":
+        return 0
+    return 0
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False):
+    """Count FLOPs (MACs) of one forward pass. Provide either input_size
+    (a shape for a synthetic float input) or explicit `inputs` tensors.
+    custom_ops: {LayerClass: fn(layer, inputs, output) -> flops}."""
+    from ..core.tensor import no_grad
+    from ..tensor.creation import to_tensor
+
+    counts = []
+    handles = []
+
+    def hook(layer, inputs, output):
+        fn = None
+        if custom_ops:
+            fn = custom_ops.get(type(layer))
+        n = fn(layer, inputs, output) if fn else _count(
+            layer, inputs, output)
+        counts.append((type(layer).__name__, n))
+
+    for sub in net.sublayers(include_self=True):
+        if not list(sub.children()):  # leaf layers only
+            handles.append(sub.register_forward_post_hook(hook))
+    try:
+        if inputs is None:
+            if input_size is None:
+                raise ValueError("flops() needs input_size or inputs")
+            x = to_tensor(np.zeros(input_size, np.float32))
+            inputs = [x]
+        with no_grad():
+            net(*inputs)
+    finally:
+        for h in handles:
+            h.remove()
+    total = sum(n for _, n in counts)
+    if print_detail:
+        for name, n in counts:
+            print(f"  {name}: {n:,}")
+        print(f"Total Flops: {total:,}")
+    return total

@@ -53,6 +53,8 @@ GPT_PRESETS = {
                            max_position_embeddings=512),
     "gpt3-125m": GPTConfig(hidden_size=768, num_hidden_layers=12,
                            num_attention_heads=12, intermediate_size=3072),
+    "gpt3-350m": GPTConfig(hidden_size=1024, num_hidden_layers=24,
+                           num_attention_heads=16, intermediate_size=4096),
     "gpt3-1.3b": GPTConfig(hidden_size=2048, num_hidden_layers=24,
                            num_attention_heads=16, intermediate_size=8192),
     "gpt3-6.7b": GPTConfig(hidden_size=4096, num_hidden_layers=32,
@@ -228,8 +230,12 @@ class GPTForCausalLM(Layer):
             return False
         from ..distributed.meta_parallel.mp_layers import (_explicit_tp,
                                                            _mp_degree)
-        # vocab-sharded weights keep the ParallelCrossEntropy path
-        return not _explicit_tp() and _mp_degree() <= 1
+        from ..ops.attention import sequence_sharded_trace
+        # vocab-sharded weights keep the ParallelCrossEntropy path; a
+        # sequence-sharded trace keeps the dense path (the chunk scan's
+        # [B,S]->[N] reshape would force GSPMD to regather the tokens)
+        return (not _explicit_tp() and _mp_degree() <= 1
+                and not sequence_sharded_trace())
 
     @classmethod
     def from_preset(cls, name: str, **overrides):

@@ -34,6 +34,31 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
+
+# trace-time flag: the SPMD step sets this while the sequence dim is
+# GSPMD-sharded over the `sep` axis — the Pallas kernel has no partitioning
+# rule (it would force a full replication), so attention routes to the XLA
+# reference, which the partitioner can slice (all-gathering k/v on demand)
+import threading as _threading
+
+_SEQ_SHARDED = _threading.local()
+
+
+def sequence_sharded_trace() -> bool:
+    return getattr(_SEQ_SHARDED, "on", False)
+
+
+class sequence_sharded:
+    """Context manager marking the enclosed trace as sequence-sharded."""
+
+    def __enter__(self):
+        self._prev = getattr(_SEQ_SHARDED, "on", False)
+        _SEQ_SHARDED.on = True
+        return self
+
+    def __exit__(self, *exc):
+        _SEQ_SHARDED.on = self._prev
+        return False
 _NEG_INF = -1e30
 
 
@@ -551,8 +576,8 @@ def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     import os
-    if os.environ.get("FLAGS_flash_attention", "1") == "0" and \
-            not force_pallas:
+    if (os.environ.get("FLAGS_flash_attention", "1") == "0"
+            or sequence_sharded_trace()) and not force_pallas:
         key = jax.random.PRNGKey(jnp.asarray(dropout_seed, jnp.uint32)) \
             if dropout_p > 0.0 else None
         return _attention_reference(q, k, v, causal, scale, mask, dropout_p,

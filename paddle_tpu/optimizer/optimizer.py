@@ -332,9 +332,10 @@ class Adam(Optimizer):
         reference's scatter::MergeAdd)."""
         if not self._lazy_mode:
             return None
-        merged = g.merge()  # merge-add duplicate rows (scatter::MergeAdd)
+        # merge-add duplicate rows in fp32 (scatter::MergeAdd)
+        merged = g.merge(accum_dtype=jnp.float32)
         rows = merged.rows
-        vals = merged.values.astype(jnp.float32)
+        vals = merged.values
         b1, b2 = self._beta1, self._beta2
         b1p = slots["beta1_pow"] * b1
         b2p = slots["beta2_pow"] * b2

@@ -246,7 +246,25 @@ class ShardedTrainStep:
         apply_fn = optimizer.apply_gradients_fn()
         clip_fn = optimizer.clip_gradients_fn()
         batch_axes = _batch_axes(mesh)
-        self.data_spec = P(batch_axes) if batch_axes else P()
+        # parity-plus sequence/context parallelism: token dim sharded over
+        # the `sep` axis (ring/Ulysses kernels cover the explicit shard_map
+        # mode; under GSPMD the partitioner slices the transformer and
+        # gathers k/v inside attention)
+        seq_parallel = bool(
+            (plan is not None and getattr(plan, "sequence_parallel", False))
+            or ("sep" in mesh.axis_names and mesh.shape["sep"] > 1))
+        self.sequence_parallel = seq_parallel and \
+            "sep" in mesh.axis_names and mesh.shape["sep"] > 1
+        if seq_parallel and not self.sequence_parallel:
+            import warnings
+            warnings.warn(
+                "strategy requests sequence_parallel but the mesh has no "
+                "`sep` axis (set hybrid_configs.sep_degree > 1); the step "
+                "will run WITHOUT sequence parallelism", stacklevel=2)
+        if self.sequence_parallel:
+            self.data_spec = P(batch_axes, "sep")
+        else:
+            self.data_spec = P(batch_axes) if batch_axes else P()
 
         if amp_cfg is not None:
             from ..amp import auto_cast
@@ -260,6 +278,16 @@ class ShardedTrainStep:
             amp_ctx = None
 
         compute_loss = make_compute_loss(model, loss_fn, amp_ctx)
+
+        if self.sequence_parallel:
+            # trace inside the sequence-sharded context: attention and the
+            # lm-head CE pick their GSPMD-partitionable paths
+            from ..ops.attention import sequence_sharded
+            _inner_compute_loss = compute_loss
+
+            def compute_loss(*a, **k):
+                with sequence_sharded():
+                    return _inner_compute_loss(*a, **k)
 
         if use_remat:
             # coarsest activation checkpointing: save only the step inputs,

@@ -159,10 +159,15 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 for _ in range(process_num):
                     in_q.put(end)
 
+        # ordered mode: workers wait their turn on a condition variable, so
+        # memory stays bounded by the queues (a consumer-side reorder buffer
+        # would grow unboundedly behind one slow sample). A failing worker
+        # flips `failed` and wakes everyone, so errors surface instead of
+        # stranding the turn-taking.
+        cond = threading.Condition()
+        failed = [False]
+
         def map_worker():
-            # ordered mode: emit (i, result) and let the CONSUMER reorder —
-            # workers never wait on each other, so one failing worker can't
-            # strand the rest mid-busy-wait
             try:
                 while True:
                     item = in_q.get()
@@ -170,11 +175,26 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                         return
                     if order:
                         i, sample = item
-                        out_q.put((i, mapper(sample)))
+                        r = mapper(sample)
+                        with cond:
+                            while out_order[0] != i and not failed[0]:
+                                cond.wait(0.1)
+                            if failed[0]:
+                                return
+                            # put before releasing the turn: a successor
+                            # must not enqueue ahead of this result (the
+                            # consumer drains out_q without the lock, so a
+                            # full queue here still makes progress)
+                            out_q.put(r)
+                            out_order[0] += 1
+                            cond.notify_all()
                     else:
                         out_q.put(mapper(item))
             except BaseException as e:
                 errors.append(e)
+                with cond:
+                    failed[0] = True
+                    cond.notify_all()
             finally:
                 out_q.put(end)
 
@@ -182,24 +202,14 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         for _ in range(process_num):
             threading.Thread(target=map_worker, daemon=True).start()
         finished = 0
-        pending = {}
         while finished < process_num:
             e = out_q.get()
             if e is end:
                 finished += 1
-            elif order:
-                i, r = e
-                pending[i] = r
-                while out_order[0] in pending:
-                    yield pending.pop(out_order[0])
-                    out_order[0] += 1
             else:
                 yield e
         if errors:
             raise errors[0]
-        if order:  # drain any tail still buffered (all workers done)
-            for i in sorted(pending):
-                yield pending[i]
 
     return xreader
 

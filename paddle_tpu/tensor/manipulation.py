@@ -419,8 +419,7 @@ def unsqueeze_(x, axis, name=None):
 
 def tolist(x):
     """paddle.tolist (varbase_patch_methods tolist)."""
-    import numpy as _np
-    return _np.asarray(_t(x).data).tolist()
+    return _t(x).tolist()
 
 
 def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):

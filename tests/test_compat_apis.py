@@ -232,3 +232,18 @@ def test_mnist_reader_range_and_xmap_order_error():
     with pytest.raises(RuntimeError):
         list(reader.xmap_readers(boom, lambda: iter(range(8)), 3, 4,
                                  order=True)())
+
+
+def test_flops_lenet():
+    m = paddle.vision.models.LeNet(num_classes=10)
+    n = paddle.flops(m, input_size=(1, 1, 28, 28))
+    # LeNet conv1: 6*28*28 out * (5*5*1) kernel = 117,600 MACs at least;
+    # total for LeNet ~ 400k-500k MACs
+    assert n > 100_000
+    n2 = paddle.flops(m, input_size=(2, 1, 28, 28))
+    assert n2 > n  # scales with batch
+    # custom_ops: overriding a leaf class changes the count
+    from paddle_tpu.nn import Linear
+    n3 = paddle.flops(m, input_size=(1, 1, 28, 28),
+                      custom_ops={Linear: lambda l, i, o: 0})
+    assert n3 < n
