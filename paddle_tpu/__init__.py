@@ -67,6 +67,7 @@ from . import text  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from .flags import get_flags, set_flags  # noqa: F401,E402
+from .distributed.data_parallel import DataParallel  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from .nn.layer.layers import ParamAttr  # noqa: F401,E402
 

@@ -50,9 +50,10 @@ class SelectedRows:
               accum_dtype=None) -> "SelectedRows":
         """merge(other): concatenate two sparse grads (gradient
         accumulation). merge(): merge-add duplicate rows
-        (merge_selected_rows op), accumulating in accum_dtype (default
-        fp32 for low-precision values so repeated-token sums keep their
-        mantissa) and casting back to the values' dtype."""
+        (merge_selected_rows op); the merged values KEEP the accumulator
+        dtype (default fp32 for low-precision values, so repeated-token
+        sums keep their mantissa — callers cast back if they need the
+        original dtype)."""
         if other is not None:
             assert self.height == other.height
             return SelectedRows(jnp.concatenate([self.rows, other.rows]),

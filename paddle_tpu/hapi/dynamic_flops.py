@@ -20,10 +20,16 @@ def _count(layer, inputs, output):
                    else output[0])
     if cls in ("Linear",):
         return out_n * layer.weight.shape[0]
-    if cls in ("Conv2D", "Conv1D", "Conv3D", "Conv2DTranspose"):
-        w = layer.weight
+    if cls in ("Conv2D", "Conv1D", "Conv3D"):
+        w = layer.weight  # [out_ch, in_ch/groups, *k]
         k = int(np.prod(w.shape[2:])) * w.shape[1]  # kernel x in_ch/groups
         return out_n * k
+    if cls in ("Conv2DTranspose", "Conv1DTranspose", "Conv3DTranspose"):
+        # transposed weights are [in_ch, out_ch/groups, *k]: each INPUT
+        # element scatters into kernel x out_ch/groups outputs
+        w = layer.weight
+        in_n = _numel(x)
+        return in_n * int(np.prod(w.shape[2:])) * w.shape[1]
     if cls in ("BatchNorm2D", "BatchNorm1D", "BatchNorm", "LayerNorm",
                "GroupNorm", "InstanceNorm2D", "SyncBatchNorm"):
         return 2 * out_n

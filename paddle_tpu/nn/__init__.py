@@ -4,6 +4,7 @@ Reference surface: python/paddle/nn/__init__.py (100+ layers).
 """
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
                    ClipGradByValue, GradientClipByGlobalNorm,
                    GradientClipByNorm, GradientClipByValue, clip_grad_norm_)

@@ -1,0 +1,127 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/weight_norm_hook.py,
+spectral_norm_hook.py — reparameterization via forward pre-hooks).
+
+weight_norm: w = g * v / ||v||   (g, v trainable; recomputed pre-forward)
+spectral_norm: w = w / sigma_max(w)  (power iteration on a persistent u)
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+from ..tensor.creation import _t
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm"]
+
+
+def _norm_except_dim(v, dim):
+    """L2 norm over all axes except `dim`, shaped for broadcast against v."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v)))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    n = jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+    return n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Replace layer.<name> with (name_g, name_v) and recompute the weight
+    before every forward (weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    wd = w.data
+    g0 = _norm_except_dim(wd.astype(jnp.float32), dim).astype(wd.dtype)
+    layer.add_parameter(name + "_g", Parameter(g0))
+    layer.add_parameter(name + "_v", Parameter(wd))
+    # drop the original parameter; the recomputed weight is a plain tensor
+    layer._parameters.pop(name, None)
+    object.__setattr__(layer, name, None)
+
+    from ..tensor import math as M
+
+    def hook(lyr, inputs):
+        v = getattr(lyr, name + "_v")
+        g = getattr(lyr, name + "_g")
+        # differentiable recompute through the tape: norm + scale
+        def f(vv, gg):
+            n = _norm_except_dim(vv.astype(jnp.float32), dim)
+            return (vv.astype(jnp.float32) / jnp.maximum(n, 1e-12)
+                    * gg.astype(jnp.float32)).astype(vv.dtype)
+        from ..core.tensor import apply
+        object.__setattr__(lyr, name, apply(f, v, g))
+        return None
+
+    helper = layer.register_forward_pre_hook(hook)
+    layer.__dict__.setdefault("_weight_norm_hooks", {})[name] = helper
+    hook(layer, ())  # materialize once so the attr exists pre-forward
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    helpers = layer.__dict__.get("_weight_norm_hooks", {})
+    helper = helpers.pop(name, None)
+    if helper is None:
+        raise ValueError(f"no weight_norm hook on parameter {name!r}")
+    helper.remove()
+    v = getattr(layer, name + "_v")
+    g = getattr(layer, name + "_g")
+    dim = None
+    # recover dim from shapes: the g axis with size > 1 (or 0-d -> None)
+    if g.data.ndim:
+        nz = [i for i, s in enumerate(g.data.shape) if s > 1]
+        dim = nz[0] if nz else 0
+    n = _norm_except_dim(v.data.astype(jnp.float32), dim)
+    w = (v.data.astype(jnp.float32) / jnp.maximum(n, 1e-12)
+         * g.data.astype(jnp.float32)).astype(v.data.dtype)
+    layer._parameters.pop(name + "_g", None)
+    layer._parameters.pop(name + "_v", None)
+    object.__setattr__(layer, name + "_g", None)
+    object.__setattr__(layer, name + "_v", None)
+    layer.add_parameter(name, Parameter(w))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=0):
+    """Divide the weight by its largest singular value, estimated by power
+    iteration on a persistent left vector u (spectral_norm_hook.py)."""
+    w = getattr(layer, name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    wd = w.data
+    h = wd.shape[dim]
+    rng = np.random.RandomState(0)
+    state = {"u": jnp.asarray(rng.randn(h).astype(np.float32))}
+
+    def hook(lyr, inputs):
+        p = lyr._parameters.get(name + "_orig")
+        if p is None:
+            p = getattr(lyr, name + "_orig")
+        wdat = p.data
+        mat = jnp.moveaxis(wdat.astype(jnp.float32), dim, 0).reshape(h, -1)
+        u = state["u"]
+        for _ in range(n_power_iterations):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        state["u"] = u
+        sigma = u @ (mat @ v)
+
+        from ..core.tensor import apply
+
+        def f(ww):
+            return (ww.astype(jnp.float32) / jnp.maximum(sigma, eps)
+                    ).astype(ww.dtype)
+
+        object.__setattr__(lyr, name, apply(f, p))
+        return None
+
+    layer.add_parameter(name + "_orig", Parameter(wd))
+    layer._parameters.pop(name, None)
+    object.__setattr__(layer, name, None)
+    helper = layer.register_forward_pre_hook(hook)
+    layer.__dict__.setdefault("_spectral_norm_hooks", {})[name] = helper
+    hook(layer, ())
+    return layer

@@ -261,6 +261,7 @@ class ShardedTrainStep:
                 "strategy requests sequence_parallel but the mesh has no "
                 "`sep` axis (set hybrid_configs.sep_degree > 1); the step "
                 "will run WITHOUT sequence parallelism", stacklevel=2)
+        self._batch_axes = batch_axes
         if self.sequence_parallel:
             self.data_spec = P(batch_axes, "sep")
         else:
@@ -395,7 +396,7 @@ class ShardedTrainStep:
         for a in args:
             arr = a.data if isinstance(a, Tensor) else jnp.asarray(a)
             arrays.append(jax.device_put(
-                arr, NamedSharding(self.mesh, self.data_spec)))
+                arr, NamedSharding(self.mesh, self._spec_for(arr))))
         self._step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step = jnp.asarray(self._step_count, jnp.int32)
@@ -409,6 +410,18 @@ class ShardedTrainStep:
         self._opt_state = (jax.device_put(opt_out, self._opt_host_sh)
                            if self._offload else opt_out)
         return Tensor(loss)
+
+    def _spec_for(self, arr):
+        """Per-array data sharding: the sep (token) axis only applies to
+        arrays that actually have a sep-divisible dim 1 — (B,) labels and
+        non-sequence features keep the plain batch sharding."""
+        base = self._batch_axes
+        if (self.sequence_parallel and arr.ndim >= 2
+                and arr.shape[1] % self.mesh.shape["sep"] == 0):
+            return P(base, "sep")
+        if arr.ndim >= 1 and base is not None:
+            return P(base)
+        return P()
 
     @property
     def loss_scale(self):

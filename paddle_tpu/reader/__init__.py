@@ -173,6 +173,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     item = in_q.get()
                     if item is end:
                         return
+                    if failed[0]:
+                        continue  # drain in_q so read_worker can finish
                     if order:
                         i, sample = item
                         r = mapper(sample)
@@ -180,7 +182,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                             while out_order[0] != i and not failed[0]:
                                 cond.wait(0.1)
                             if failed[0]:
-                                return
+                                continue  # keep draining in_q
                             # put before releasing the turn: a successor
                             # must not enqueue ahead of this result (the
                             # consumer drains out_q without the lock, so a

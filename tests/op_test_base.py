@@ -1,0 +1,72 @@
+"""OpTest analog (reference: python/paddle/fluid/tests/unittests/
+op_test.py:270 — per-op fixtures checking kernel outputs against a NumPy
+reference and analytic gradients against finite differences).
+
+TPU adaptation: "the kernel" is the framework op running through the eager
+tape on the CPU XLA backend; check_output compares against a NumPy
+reference fn, check_grad compares tape gradients against central
+finite differences of the op itself.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(op_fn, np_fn, inputs, atol=1e-5, rtol=1e-5):
+    """op_fn(*Tensors) -> Tensor; np_fn(*ndarrays) -> ndarray."""
+    tensors = [paddle.to_tensor(a) for a in inputs]
+    got = op_fn(*tensors)
+    want = np_fn(*inputs)
+    np.testing.assert_allclose(np.asarray(got.data), want, atol=atol,
+                               rtol=rtol)
+
+
+def check_grad(op_fn, inputs, grad_inputs=None, delta=1e-3, atol=5e-3,
+               rtol=5e-3, loss_weights=None):
+    """Analytic (tape) grads vs central finite differences.
+
+    grad_inputs: indices of inputs to differentiate (default: all).
+    The scalar loss is sum(op(*) * W) with a fixed random W so every output
+    element contributes a distinct weight (catches transposed/mis-scaled
+    grads that a plain sum would miss).
+    """
+    inputs = [np.asarray(a, np.float64).astype(np.float32) for a in inputs]
+    if grad_inputs is None:
+        grad_inputs = range(len(inputs))
+
+    rng = np.random.RandomState(7)
+    out_probe = op_fn(*[paddle.to_tensor(a) for a in inputs])
+    W = (loss_weights if loss_weights is not None
+         else np.asarray(
+             rng.randn(*np.asarray(out_probe.data).shape), np.float32))
+
+    def scalar_loss(arrays):
+        t = [paddle.to_tensor(a) for a in arrays]
+        for i in grad_inputs:
+            t[i].stop_gradient = False
+        out = op_fn(*t)
+        loss = paddle.sum(out * paddle.to_tensor(W))
+        return loss, t
+
+    # analytic
+    loss, t = scalar_loss(inputs)
+    loss.backward()
+    analytic = {i: np.asarray(t[i].grad.data) for i in grad_inputs}
+
+    # numeric: central differences on the scalar loss
+    for i in grad_inputs:
+        flat = inputs[i].reshape(-1)
+        num = np.zeros_like(flat)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + delta
+            lp = float(scalar_loss(inputs)[0].item())
+            flat[j] = orig - delta
+            lm = float(scalar_loss(inputs)[0].item())
+            flat[j] = orig
+            num[j] = (lp - lm) / (2 * delta)
+        np.testing.assert_allclose(
+            analytic[i].reshape(-1), num, atol=atol, rtol=rtol,
+            err_msg=f"gradient mismatch for input {i}")

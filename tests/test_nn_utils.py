@@ -1,0 +1,65 @@
+"""nn.utils weight_norm / spectral_norm tests (reference:
+python/paddle/nn/utils/{weight,spectral}_norm_hook.py)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn.utils import remove_weight_norm, spectral_norm, weight_norm
+
+
+def test_weight_norm_preserves_function():
+    paddle.seed(0)
+    lin = nn.Linear(6, 4)
+    x = paddle.randn([3, 6])
+    y0 = np.asarray(lin(x).data)
+    weight_norm(lin, name="weight", dim=0)
+    assert lin._parameters.get("weight_g") is not None
+    assert lin._parameters.get("weight_v") is not None
+    assert "weight" not in lin._parameters
+    y1 = np.asarray(lin(x).data)
+    np.testing.assert_allclose(y0, y1, atol=1e-5)
+
+
+def test_weight_norm_grads_flow_to_g_and_v():
+    paddle.seed(1)
+    lin = nn.Linear(5, 3)
+    weight_norm(lin)
+    x = paddle.randn([2, 5])
+    loss = paddle.sum(lin(x) ** 2)
+    loss.backward()
+    assert lin.weight_g.grad is not None
+    assert lin.weight_v.grad is not None
+    assert float(jnp.abs(lin.weight_v.grad.data).sum()) > 0
+
+
+def test_remove_weight_norm_roundtrip():
+    paddle.seed(2)
+    lin = nn.Linear(4, 4)
+    x = paddle.randn([2, 4])
+    y0 = np.asarray(lin(x).data)
+    weight_norm(lin)
+    remove_weight_norm(lin)
+    assert lin._parameters.get("weight") is not None
+    assert "weight_g" not in lin._parameters
+    y1 = np.asarray(lin(x).data)
+    np.testing.assert_allclose(y0, y1, atol=1e-5)
+
+
+def test_spectral_norm_caps_singular_value():
+    paddle.seed(3)
+    lin = nn.Linear(8, 8)
+    # scale the weight so its top singular value is big
+    lin.weight.set_value(lin.weight.numpy() * 10)
+    spectral_norm(lin, n_power_iterations=5)
+    x = paddle.randn([2, 8])
+    _ = lin(x)  # hook runs
+    w = np.asarray(lin.weight.data)
+    s = np.linalg.svd(w, compute_uv=False)
+    assert s.max() == pytest.approx(1.0, abs=0.05)
+    # training signal reaches the original parameterization
+    loss = paddle.sum(lin(x) ** 2)
+    loss.backward()
+    assert lin.weight_orig.grad is not None

@@ -1,0 +1,163 @@
+"""Per-op numeric fixtures over the OpTest base (reference test strategy
+SURVEY §4 item 2: NumPy-reference outputs + finite-difference gradient
+checks). Small shapes keep the O(n) finite-difference loop fast.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_test_base import check_grad, check_output
+
+R = np.random.RandomState(0)
+
+
+def test_matmul():
+    a = R.randn(3, 4).astype(np.float32)
+    b = R.randn(4, 5).astype(np.float32)
+    check_output(paddle.matmul, np.matmul, [a, b])
+    check_grad(paddle.matmul, [a, b])
+
+
+def test_add_broadcast():
+    a = R.randn(3, 4).astype(np.float32)
+    b = R.randn(4).astype(np.float32)
+    check_output(paddle.add, np.add, [a, b])
+    check_grad(paddle.add, [a, b])
+
+
+def test_multiply_grad():
+    a = R.randn(2, 3).astype(np.float32)
+    b = R.randn(2, 3).astype(np.float32)
+    check_output(paddle.multiply, np.multiply, [a, b])
+    check_grad(paddle.multiply, [a, b])
+
+
+def test_tanh_sigmoid_exp():
+    x = R.randn(2, 5).astype(np.float32)
+    check_output(paddle.tanh, np.tanh, [x])
+    check_grad(paddle.tanh, [x])
+    check_output(F.sigmoid, lambda a: 1 / (1 + np.exp(-a)), [x])
+    check_grad(F.sigmoid, [x])
+    check_output(paddle.exp, np.exp, [x])
+    check_grad(paddle.exp, [x])
+
+
+def test_softmax():
+    x = R.randn(3, 6).astype(np.float32)
+
+    def np_softmax(a):
+        e = np.exp(a - a.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    check_output(lambda t: F.softmax(t, axis=-1), np_softmax, [x])
+    check_grad(lambda t: F.softmax(t, axis=-1), [x])
+
+
+def test_log_softmax():
+    x = R.randn(2, 5).astype(np.float32)
+
+    def np_ls(a):
+        s = a - a.max(-1, keepdims=True)
+        return s - np.log(np.exp(s).sum(-1, keepdims=True))
+
+    check_output(lambda t: F.log_softmax(t, axis=-1), np_ls, [x])
+    check_grad(lambda t: F.log_softmax(t, axis=-1), [x])
+
+
+def test_mean_sum_max():
+    x = R.randn(3, 4).astype(np.float32)
+    check_output(paddle.mean, lambda a: np.mean(a), [x], atol=1e-6)
+    check_grad(paddle.mean, [x])
+    check_output(lambda t: paddle.sum(t, axis=1),
+                 lambda a: a.sum(1), [x])
+    check_grad(lambda t: paddle.sum(t, axis=1), [x])
+    check_output(lambda t: paddle.max(t, axis=0), lambda a: a.max(0), [x])
+
+
+def test_layer_norm_grad():
+    x = R.randn(4, 8).astype(np.float32)
+    w = R.randn(8).astype(np.float32)
+    b = R.randn(8).astype(np.float32)
+
+    def np_ln(a, ww, bb):
+        mu = a.mean(-1, keepdims=True)
+        var = ((a - mu) ** 2).mean(-1, keepdims=True)
+        return (a - mu) / np.sqrt(var + 1e-5) * ww + bb
+
+    check_output(lambda t, tw, tb: F.layer_norm(t, 8, weight=tw, bias=tb),
+                 np_ln, [x, w, b])
+    check_grad(lambda t, tw, tb: F.layer_norm(t, 8, weight=tw, bias=tb),
+               [x, w, b])
+
+
+def test_conv2d_grad():
+    x = R.randn(1, 2, 5, 5).astype(np.float32)
+    w = R.randn(3, 2, 3, 3).astype(np.float32)
+    check_grad(lambda t, tw: F.conv2d(t, tw, padding=1), [x, w],
+               atol=1e-2, rtol=1e-2)
+
+
+def test_gather_grad():
+    x = R.randn(5, 3).astype(np.float32)
+    idx = np.array([0, 2, 2], np.int64)
+    check_output(lambda t: paddle.gather(t, paddle.to_tensor(idx)),
+                 lambda a: a[idx], [x])
+    check_grad(lambda t: paddle.gather(t, paddle.to_tensor(idx)), [x])
+
+
+def test_where_grad():
+    x = R.randn(3, 3).astype(np.float32)
+    y = R.randn(3, 3).astype(np.float32)
+    cond = x > 0
+    check_output(
+        lambda a, b: paddle.where(paddle.to_tensor(cond), a, b),
+        lambda a, b: np.where(cond, a, b), [x, y])
+    check_grad(lambda a, b: paddle.where(paddle.to_tensor(cond), a, b),
+               [x, y])
+
+
+def test_cumsum_pad():
+    x = R.randn(2, 4).astype(np.float32)
+    check_output(lambda t: paddle.cumsum(t, axis=1),
+                 lambda a: np.cumsum(a, 1), [x])
+    check_grad(lambda t: paddle.cumsum(t, axis=1), [x])
+
+
+def test_cross_entropy_grad():
+    logits = R.randn(4, 6).astype(np.float32)
+    labels = np.array([0, 5, 2, 2], np.int64)
+
+    def op(t):
+        return F.cross_entropy(t, paddle.to_tensor(labels),
+                               reduction="none")
+
+    def np_ce(a):
+        s = a - a.max(-1, keepdims=True)
+        lse = np.log(np.exp(s).sum(-1)) - s[np.arange(4), labels]
+        return lse
+
+    check_output(op, np_ce, [logits])
+    check_grad(op, [logits])
+
+
+def test_sqrt_rsqrt_log():
+    x = (np.abs(R.randn(2, 4)) + 0.5).astype(np.float32)
+    check_output(paddle.sqrt, np.sqrt, [x])
+    check_grad(paddle.sqrt, [x])
+    check_output(paddle.log, np.log, [x])
+    check_grad(paddle.log, [x])
+    check_output(paddle.rsqrt, lambda a: 1 / np.sqrt(a), [x])
+
+
+def test_transpose_reshape_concat():
+    x = R.randn(2, 3, 4).astype(np.float32)
+    check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                 lambda a: a.transpose(2, 0, 1), [x])
+    check_grad(lambda t: paddle.transpose(t, [2, 0, 1]), [x])
+    a = R.randn(2, 3).astype(np.float32)
+    b = R.randn(2, 3).astype(np.float32)
+    check_output(lambda u, v: paddle.concat([u, v], axis=0),
+                 lambda u, v: np.concatenate([u, v], 0), [a, b])
+    check_grad(lambda u, v: paddle.concat([u, v], axis=0), [a, b])
