@@ -12,8 +12,8 @@ from .layer.activation import *  # noqa: F401,F403
 from .layer.common import *  # noqa: F401,F403
 from .layer.conv import (Conv1D, Conv1DTranspose, Conv2D,  # noqa: F401
                          Conv2DTranspose, Conv3D, Conv3DTranspose)
-from .layer.layers import (Layer, LayerList, ParamAttr,  # noqa: F401
-                           ParameterList, Sequential)
+from .layer.layers import (Layer, LayerDict, LayerList,  # noqa: F401
+                           ParamAttr, ParameterList, Sequential)
 from .layer.loss import *  # noqa: F401,F403
 from .layer.moe import MoELayer  # noqa: F401
 from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D,  # noqa: F401
@@ -26,7 +26,7 @@ from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,  # noqa: F401
                             AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D,
                             MaxPool3D)
 from .layer.rnn import (GRU, LSTM, BiRNN, GRUCell, LSTMCell, RNN,  # noqa: F401
-                        SimpleRNN, SimpleRNNCell)
+                        RNNCellBase, SimpleRNN, SimpleRNNCell)
 from .layer.transformer import (MultiHeadAttention, Transformer,  # noqa: F401
                                 TransformerDecoder, TransformerDecoderLayer,
                                 TransformerEncoder, TransformerEncoderLayer)

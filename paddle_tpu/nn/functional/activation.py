@@ -158,3 +158,31 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
     return apply(
         lambda a: a / jnp.maximum(
             jnp.linalg.norm(a, ord=p, axis=axis, keepdims=True), epsilon), _t(x))
+
+
+def _inplace(op):
+    """In-place variant: runs the op through the tape and rebinds the
+    tensor to the op's output node (mirroring Tensor.__setitem__'s rebind)
+    so gradients include the activation derivative."""
+    def fn(x, *args, **kwargs):
+        from ...core.tensor import is_grad_enabled
+        t = _t(x)
+        if is_grad_enabled() and not t.stop_gradient and t._node is None:
+            raise RuntimeError(
+                f"in-place {op.__name__}_ on a leaf tensor that requires "
+                "grad is not allowed (matches the reference's inplace "
+                "leaf guard)")
+        out = op(t, *args, **kwargs)
+        t.data = out.data
+        t._node = out._node
+        t._out_index = out._out_index
+        return t
+    return fn
+
+
+# in-place variants (reference exports relu_/elu_/tanh_/softmax_ which
+# mutate the input VarBase)
+relu_ = _inplace(relu)
+elu_ = _inplace(elu)
+tanh_ = _inplace(tanh)
+softmax_ = _inplace(softmax)

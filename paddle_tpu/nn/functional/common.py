@@ -277,3 +277,164 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
         return jnp.concatenate([left, right, rest], axis=2).reshape(N_T, C, H, W)
 
     return apply(f, _t(x))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """sequence_mask op: lengths [..] -> mask [.., maxlen]."""
+    x = _t(x)
+    from ...core import dtypes as _d
+
+    def f(lens):
+        m = maxlen if maxlen is not None else int(lens.max())
+        ar = jnp.arange(m)
+        return (ar[None, :] < lens.reshape(-1, 1)).reshape(
+            *lens.shape, m).astype(_d.convert_dtype(dtype))
+
+    return apply(f, x)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """diag_embed op: place the last dim on a diagonal plane (dim1, dim2)."""
+    x = _t(input)
+
+    def f(a):
+        n = a.shape[-1]
+        size = n + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (size, size), a.dtype)
+        idx = jnp.arange(n)
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        # the ROW axis goes to dim1 and the COLUMN axis to dim2: swapped
+        # dims transpose the plane (sub- vs super-diagonal for offset != 0)
+        return jnp.moveaxis(out, (-2, -1), (d1, d2))
+
+    return apply(f, x)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """affine_grid_op.cc: theta [N,2,3] -> sampling grid [N,H,W,2] in
+    normalized [-1,1] coords."""
+    import numpy as np
+    theta = _t(theta)
+    N, C, H, W = [int(s) for s in (
+        out_shape if not isinstance(out_shape, Tensor)
+        else np.asarray(out_shape.data))]
+
+    def f(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+        else:
+            ys = (jnp.arange(H) * 2 + 1) / H - 1.0
+            xs = (jnp.arange(W) * 2 + 1) / W - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)      # [H,W,3]
+        return jnp.einsum("hwk,nck->nhwc", base, th)   # [N,H,W,2]
+
+    return apply(f, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """grid_sample_op.cc: sample x [N,C,H,W] at grid [N,Ho,Wo,2]
+    (normalized [-1,1] xy)."""
+    x = _t(x)
+    grid = _t(grid)
+
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+
+    def f(img, g):
+        N, C, H, W = img.shape
+
+        def unnorm(coord, size):
+            if align_corners:
+                return (coord + 1) * (size - 1) / 2
+            return ((coord + 1) * size - 1) / 2
+
+        def reflect(coord, size):
+            if size == 1:
+                return jnp.zeros_like(coord)
+            if align_corners:  # reflect over [0, size-1]
+                period = 2.0 * (size - 1)
+                c = jnp.abs(coord) % period
+                return jnp.where(c > size - 1, period - c, c)
+            # reflect over [-0.5, size-0.5]
+            period = 2.0 * size
+            c = jnp.abs(coord + 0.5) % period
+            c = jnp.where(c > size, period - c, c) - 0.5
+            return jnp.clip(c, 0, size - 1)
+
+        gx = unnorm(g[..., 0], W)
+        gy = unnorm(g[..., 1], H)
+        if padding_mode == "border":
+            gx = jnp.clip(gx, 0, W - 1)
+            gy = jnp.clip(gy, 0, H - 1)
+        elif padding_mode == "reflection":
+            gx = reflect(gx, W)
+            gy = reflect(gy, H)
+        if mode == "nearest":
+            xi = jnp.clip(jnp.round(gx).astype(jnp.int32), 0, W - 1)
+            yi = jnp.clip(jnp.round(gy).astype(jnp.int32), 0, H - 1)
+            out = jax.vmap(lambda im, yy, xx: im[:, yy, xx])(img, yi, xi)
+            if padding_mode == "zeros":
+                inb = ((gx >= 0) & (gx <= W - 1) & (gy >= 0)
+                       & (gy <= H - 1))
+                out = out * inb[:, None]
+            return out
+
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx1 = gx - x0
+        wy1 = gy - y0
+
+        def tap(im, yy, xx):
+            yi = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+            v = im[:, yi, xi]                      # [C, Ho, Wo]
+            if padding_mode == "zeros":
+                inb = ((xx >= 0) & (xx <= W - 1) & (yy >= 0)
+                       & (yy <= H - 1))
+                v = v * inb[None]
+            return v
+
+        def one(im, y0_, x0_, wy, wx):
+            v00 = tap(im, y0_, x0_)
+            v01 = tap(im, y0_, x0_ + 1)
+            v10 = tap(im, y0_ + 1, x0_)
+            v11 = tap(im, y0_ + 1, x0_ + 1)
+            return (v00 * ((1 - wy) * (1 - wx))[None]
+                    + v01 * ((1 - wy) * wx)[None]
+                    + v10 * (wy * (1 - wx))[None]
+                    + v11 * (wy * wx)[None])
+
+        return jax.vmap(one)(img, y0, x0, wy1, wx1)
+
+    return apply(f, x, grid)
+
+
+def gather_tree(ids, parents):
+    """gather_tree_op.cc: beam-search back-tracing. ids/parents
+    [T, B, beam] -> full sequences [T, B, beam]."""
+    ids = _t(ids)
+    parents = _t(parents)
+
+    def f(i, p):
+        T = i.shape[0]
+
+        def body(carry, t):
+            beam_idx = carry                      # [B, beam]
+            step_ids = jnp.take_along_axis(i[t], beam_idx, axis=-1)
+            parent = jnp.take_along_axis(p[t], beam_idx, axis=-1)
+            return parent, step_ids
+
+        init = jnp.broadcast_to(
+            jnp.arange(i.shape[2])[None, :], i.shape[1:]).astype(i.dtype)
+        _, rev = jax.lax.scan(body, init, jnp.arange(T - 1, -1, -1))
+        return rev[::-1]
+
+    return apply(f, ids, parents)

@@ -251,3 +251,138 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return _reduce(loss, reduction)
 
     return apply(f, log_probs, labels, input_lengths, label_lengths)
+
+
+def square_error_cost(input, label):
+    """square_error_cost op: (input - label)^2, no reduction."""
+    return apply(lambda a, b: jnp.square(a - b), _t(input), _t(label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """log_loss_op.cc: -y log(p+eps) - (1-y) log(1-p+eps)."""
+    return apply(
+        lambda p, y: -y * jnp.log(p + epsilon)
+        - (1.0 - y) * jnp.log(1.0 - p + epsilon),
+        _t(input), _t(label))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """dice_loss (layers/loss.py): 1 - 2|X∩Y| / (|X|+|Y|). input: [N,...,C]
+    probabilities; label: [N,...,1] class ids."""
+    input = _t(input)
+    label = _t(label)
+
+    def f(p, y):
+        nc = p.shape[-1]
+        onehot = jax.nn.one_hot(y[..., 0].astype(jnp.int32), nc,
+                                dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * onehot, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(onehot, axis=red)
+        return jnp.mean(1.0 - 2.0 * inter / (union + epsilon))
+
+    return apply(f, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """npair_loss (layers/loss.py): cross-entropy over anchor·positiveᵀ
+    similarities with same-label targets + L2 on the embeddings."""
+    anchor = _t(anchor)
+    positive = _t(positive)
+    labels = _t(labels)
+
+    def f(a, p, y):
+        y = y.reshape(-1)
+        sim = a @ p.T  # [B, B]
+        same = (y[:, None] == y[None, :]).astype(jnp.float32)
+        targets = same / jnp.sum(same, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = jnp.mean(-jnp.sum(targets * logp, axis=1))
+        reg = jnp.mean(jnp.sum(jnp.square(a), 1)) + \
+            jnp.mean(jnp.sum(jnp.square(p), 1))
+        return ce + l2_reg * reg * 0.25
+
+    return apply(f, anchor, positive, labels)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    """sigmoid_focal_loss (RetinaNet): FL = -alpha_t (1-p_t)^gamma log(p_t)."""
+    logit = _t(logit)
+    label = _t(label)
+
+    def f(x, y, *n):
+        p = jax.nn.sigmoid(x)
+        ce = -(y * jax.nn.log_sigmoid(x)
+               + (1 - y) * jax.nn.log_sigmoid(-x))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+
+    if normalizer is not None:
+        return apply(f, logit, label, _t(normalizer))
+    return apply(f, logit, label)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid (hierarchical_sigmoid_op.cc). Default tree: the
+    complete binary tree over num_classes leaves whose internal nodes are
+    addressed by the bits of (label + num_classes) walking down from the
+    root — the reference's default coding. Custom trees come in via
+    path_table/path_code [N, L] PER-SAMPLE tables (padded with -1),
+    exactly the reference's custom-tree layout."""
+    input = _t(input)
+    label = _t(label)
+    weight = _t(weight)
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(_t(bias))
+
+    import numpy as np
+    if path_table is None:
+        depth = max(int(np.ceil(np.log2(max(num_classes, 2)))), 1)
+        # complete-tree addressing: internal node ids 1..num_classes-1
+        # (heap order), leaf for class c sits at heap index c+num_classes
+        def paths_for(codes):
+            idx = codes + num_classes
+            tables, cds = [], []
+            for _ in range(depth):
+                parent = idx // 2
+                bit = idx % 2
+                tables.append(parent - 1)   # weight row of the node
+                cds.append(bit)
+                idx = parent
+            t = jnp.stack(tables[::-1], axis=-1)
+            c = jnp.stack(cds[::-1], axis=-1)
+            valid = t >= 0
+            return jnp.where(valid, t, 0), c, valid
+    else:
+        pt = _t(path_table)
+        pc = _t(path_code)
+
+    def f(x, y, w, *b):
+        y = y.reshape(-1).astype(jnp.int32)
+        if path_table is None:
+            t, c, valid = paths_for(y)
+        else:
+            t = pt.data  # per-sample [N, L] (no shape sniffing: a batch
+            c = pc.data  # of size num_classes must not re-gather by label)
+            valid = t >= 0
+            t = jnp.where(valid, t, 0)
+        # logits of each node on the path: x @ w[t]^T (+ bias[t])
+        wt = w[t]                       # [N, L, D]
+        logits = jnp.einsum("nd,nld->nl", x, wt)
+        if b:
+            logits = logits + b[0].reshape(-1)[t]
+        # code bit 1 -> sigmoid(logit), 0 -> 1 - sigmoid(logit)
+        ce = -(c * jax.nn.log_sigmoid(logits)
+               + (1 - c) * jax.nn.log_sigmoid(-logits))
+        ce = jnp.where(valid, ce, 0.0)
+        return jnp.sum(ce, axis=-1, keepdims=True)
+
+    return apply(f, *args)

@@ -248,3 +248,26 @@ class Unfold(Layer):
     def forward(self, x):
         return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
                         self.dilations)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between x and y rows (reference: nn/layer/distance.py)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        import jax.numpy as jnp
+
+        from ...core.tensor import apply
+        from ...tensor.creation import _t
+
+        def f(a, b):
+            d = a - b + self.epsilon
+            return jnp.linalg.norm(d, ord=self.p, axis=-1,
+                                   keepdims=self.keepdim)
+
+        return apply(f, _t(x), _t(y))

@@ -158,3 +158,28 @@ class HingeEmbeddingLoss(Layer):
 
     def forward(self, input, label):
         return F.hinge_embedding_loss(input, label, self.margin, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (reference: nn/layer/loss.py
+    HSigmoidLoss over hierarchical_sigmoid_op.cc); holds the internal-node
+    weight table [num_classes-1, feature_size]."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        from .. import initializer as I
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter([num_classes - 1], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        from ..functional.loss import hsigmoid_loss
+        return hsigmoid_loss(input, label, self.num_classes, self.weight,
+                             self.bias, path_table, path_code)

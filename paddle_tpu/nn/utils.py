@@ -53,24 +53,20 @@ def weight_norm(layer, name="weight", dim=0):
         return None
 
     helper = layer.register_forward_pre_hook(hook)
-    layer.__dict__.setdefault("_weight_norm_hooks", {})[name] = helper
+    layer.__dict__.setdefault("_weight_norm_hooks", {})[name] = (helper, dim)
     hook(layer, ())  # materialize once so the attr exists pre-forward
     return layer
 
 
 def remove_weight_norm(layer, name="weight"):
     helpers = layer.__dict__.get("_weight_norm_hooks", {})
-    helper = helpers.pop(name, None)
-    if helper is None:
+    entry = helpers.pop(name, None)
+    if entry is None:
         raise ValueError(f"no weight_norm hook on parameter {name!r}")
+    helper, dim = entry
     helper.remove()
     v = getattr(layer, name + "_v")
     g = getattr(layer, name + "_g")
-    dim = None
-    # recover dim from shapes: the g axis with size > 1 (or 0-d -> None)
-    if g.data.ndim:
-        nz = [i for i, s in enumerate(g.data.shape) if s > 1]
-        dim = nz[0] if nz else 0
     n = _norm_except_dim(v.data.astype(jnp.float32), dim)
     w = (v.data.astype(jnp.float32) / jnp.maximum(n, 1e-12)
          * g.data.astype(jnp.float32)).astype(v.data.dtype)
@@ -99,6 +95,8 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
         if p is None:
             p = getattr(lyr, name + "_orig")
         wdat = p.data
+        # power iteration on CONCRETE values (u, v are constants w.r.t.
+        # the gradient, matching the reference's no-grad power iteration)
         mat = jnp.moveaxis(wdat.astype(jnp.float32), dim, 0).reshape(h, -1)
         u = state["u"]
         for _ in range(n_power_iterations):
@@ -107,13 +105,16 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
             u = mat @ v
             u = u / jnp.maximum(jnp.linalg.norm(u), eps)
         state["u"] = u
-        sigma = u @ (mat @ v)
 
         from ..core.tensor import apply
 
         def f(ww):
-            return (ww.astype(jnp.float32) / jnp.maximum(sigma, eps)
-                    ).astype(ww.dtype)
+            # sigma = u^T W v INSIDE the op: d(W/sigma)/dW carries the
+            # -W·(u v^T)/sigma^2 term like the reference
+            m = jnp.moveaxis(ww.astype(jnp.float32), dim, 0).reshape(h, -1)
+            sigma = u @ (m @ v)
+            return (ww.astype(jnp.float32)
+                    / jnp.maximum(sigma, eps)).astype(ww.dtype)
 
         object.__setattr__(lyr, name, apply(f, p))
         return None

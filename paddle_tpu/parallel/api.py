@@ -380,13 +380,16 @@ class ShardedTrainStep:
         opt_sh = {k: {s: NamedSharding(mesh, sp) for s, sp in per.items()}
                   for k, per in self.opt_state_specs.items()}
         buf_sh = {k: NamedSharding(mesh, P()) for k in buffers}
-        data_sh = NamedSharding(mesh, self.data_spec)
         scalar_sh = NamedSharding(mesh, P())
 
         self._jitted = jax.jit(
             train_step,
+            # data arrays inherit the per-array sharding applied by
+            # __call__'s device_put (_spec_for): a uniform prefix spec here
+            # would rank-mismatch (B,)-shaped labels under sequence
+            # parallelism
             in_shardings=(param_sh, opt_sh, buf_sh, extras_specs, scalar_sh,
-                          scalar_sh, scalar_sh, data_sh),  # data_sh: prefix
+                          scalar_sh, scalar_sh, None),
             out_shardings=(scalar_sh, param_sh, opt_sh, buf_sh, extras_specs),
             donate_argnums=(0, 1, 2, 3) if donate else (),
         )

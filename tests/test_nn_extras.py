@@ -1,0 +1,206 @@
+"""New nn surface: HSigmoidLoss, LayerDict, PairwiseDistance, in-place
+activations, sequence_mask/diag_embed/affine_grid/grid_sample/gather_tree,
+detection-free loss fns (reference: nn/layer/loss.py,
+nn/functional/{loss,common,activation}.py)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+def test_layer_dict():
+    d = nn.LayerDict({"a": nn.Linear(2, 3), "b": nn.ReLU()})
+    assert len(d) == 2 and "a" in d and list(d.keys()) == ["a", "b"]
+    d["c"] = nn.Linear(3, 1)
+    assert isinstance(d["c"], nn.Linear)
+    assert len(list(d.parameters())) == 4  # two Linears x (w, b)
+    d.pop("c")
+    assert len(d) == 2
+    d.clear()
+    assert len(d) == 0
+
+
+def test_pairwise_distance():
+    pd = nn.PairwiseDistance(p=2.0)
+    x = paddle.to_tensor(np.array([[0.0, 0.0], [1.0, 1.0]], np.float32))
+    y = paddle.to_tensor(np.array([[3.0, 4.0], [1.0, 1.0]], np.float32))
+    out = np.asarray(pd(x, y).data)
+    np.testing.assert_allclose(out, [5.0, 0.0], atol=1e-4)
+
+
+def test_hsigmoid_loss():
+    paddle.seed(0)
+    layer = nn.HSigmoidLoss(feature_size=8, num_classes=6)
+    x = paddle.randn([4, 8])
+    y = paddle.to_tensor(np.array([0, 2, 5, 3], np.int64))
+    loss = layer(x, y)
+    arr = np.asarray(loss.data)
+    assert arr.shape == (4, 1) and (arr > 0).all()
+    # trains: loss decreases under SGD
+    from paddle_tpu import optimizer as optim
+    opt = optim.SGD(learning_rate=0.5, parameters=layer.parameters())
+    first = float(paddle.mean(layer(x, y)).item())
+    for _ in range(20):
+        l = paddle.mean(layer(x, y))
+        l.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(paddle.mean(layer(x, y)).item()) < first
+
+
+def test_inplace_activations():
+    x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+    r = F.relu_(x)
+    assert r is x
+    np.testing.assert_allclose(np.asarray(x.data), [0.0, 2.0])
+    t = paddle.to_tensor(np.zeros(3, np.float32))
+    assert F.tanh_(t) is t and F.softmax_(t) is t and \
+        F.elu_(paddle.to_tensor(np.ones(2, np.float32))) is not None
+
+
+def test_sequence_mask():
+    m = F.sequence_mask(paddle.to_tensor(np.array([1, 3], np.int64)),
+                        maxlen=4)
+    np.testing.assert_array_equal(np.asarray(m.data),
+                                  [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+
+def test_diag_embed():
+    out = F.diag_embed(paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out.data), [[1, 0], [0, 2]])
+    off = F.diag_embed(paddle.to_tensor(np.array([1.0], np.float32)),
+                       offset=1)
+    assert off.shape[-1] == 2 and np.asarray(off.data)[0, 1] == 1.0
+
+
+def test_affine_grid_identity_and_grid_sample():
+    # identity theta reproduces the image under bilinear sampling
+    theta = paddle.to_tensor(np.array(
+        [[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]], np.float32))
+    grid = F.affine_grid(theta, (1, 1, 4, 4), align_corners=True)
+    assert tuple(grid.shape) == (1, 4, 4, 2)
+    img = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(
+        1, 1, 4, 4))
+    out = F.grid_sample(img, grid, align_corners=True)
+    np.testing.assert_allclose(np.asarray(out.data),
+                               np.asarray(img.data), atol=1e-5)
+
+
+def test_grid_sample_nearest_border():
+    img = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(
+        1, 1, 2, 2))
+    # sample far out of bounds with border padding: clamps to corner
+    g = paddle.to_tensor(np.array([[[[5.0, 5.0]]]], np.float32))
+    out = F.grid_sample(img, g, mode="nearest", padding_mode="border")
+    assert float(np.asarray(out.data).ravel()[0]) == 3.0
+
+
+def test_gather_tree():
+    # T=3, B=1, beam=2 (reference gather_tree example semantics)
+    ids = paddle.to_tensor(np.array(
+        [[[2, 2]], [[6, 1]], [[3, 9]]], np.int64))
+    parents = paddle.to_tensor(np.array(
+        [[[0, 0]], [[1, 1]], [[0, 0]]], np.int64))
+    out = np.asarray(F.gather_tree(ids, parents).data)
+    assert out.shape == (3, 1, 2)
+    # beam 0 back-trace: step2 id 3 (parent 0) <- step1 id 6 (parent 1)
+    # <- step0 id ids[0][1]=2  =>  forward sequence [2, 6, 3]
+    np.testing.assert_array_equal(out[:, 0, 0], [2, 6, 3])
+    # beam 1: 9 (parent 0) <- 6 (parent 1) <- 2  =>  [2, 6, 9]
+    np.testing.assert_array_equal(out[:, 0, 1], [2, 6, 9])
+
+
+def test_loss_fns():
+    p = paddle.to_tensor(np.array([0.9, 0.1], np.float32))
+    y = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+    ll = np.asarray(F.log_loss(p, y).data)
+    np.testing.assert_allclose(
+        ll, [-np.log(0.9 + 1e-4), -np.log(0.9 + 1e-4)], atol=1e-4)
+
+    se = F.square_error_cost(paddle.to_tensor([2.0]),
+                             paddle.to_tensor([5.0]))
+    assert float(se.item()) == 9.0
+
+    # dice loss of a perfect one-hot prediction ~ 0
+    pred = paddle.to_tensor(np.array([[[0.0, 1.0], [1.0, 0.0]]], np.float32))
+    lab = paddle.to_tensor(np.array([[[1], [0]]], np.int64))
+    dl = float(F.dice_loss(pred, lab).item())
+    assert dl < 0.01
+
+    logit = paddle.to_tensor(np.array([[2.0, -2.0]], np.float32))
+    lab2 = paddle.to_tensor(np.array([[1.0, 0.0]], np.float32))
+    fl = float(F.sigmoid_focal_loss(logit, lab2, reduction="sum").item())
+    assert 0 < fl < 0.1  # confident correct predictions: tiny focal loss
+
+    a = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype(
+        np.float32))
+    pos = paddle.to_tensor(np.random.RandomState(1).randn(4, 8).astype(
+        np.float32))
+    labs = paddle.to_tensor(np.array([0, 0, 1, 1], np.int64))
+    nl = float(F.npair_loss(a, pos, labs).item())
+    assert np.isfinite(nl)
+
+
+def test_inplace_relu_gradient_flows():
+    """relu_ must contribute its derivative to the tape (not a silent
+    data swap)."""
+    x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+    x.stop_gradient = False
+    h = x * 2.0
+    F.relu_(h)
+    paddle.sum(h).backward()
+    np.testing.assert_allclose(np.asarray(x.grad.data), [0.0, 2.0])
+    # leaf-requiring-grad guard
+    leaf = paddle.to_tensor(np.ones(2, np.float32))
+    leaf.stop_gradient = False
+    with pytest.raises(RuntimeError):
+        F.relu_(leaf)
+
+
+def test_spectral_norm_sigma_gradient():
+    """d(W/sigma)/dW must include the -W uv^T/sigma^2 term: for a 1x1
+    weight the normalized value is sign(w), whose gradient is ~0."""
+    from paddle_tpu.nn.utils import spectral_norm
+    lin = nn.Linear(1, 1, bias_attr=False)
+    lin.weight.set_value(np.array([[2.0]], np.float32))
+    spectral_norm(lin, n_power_iterations=8)
+    x = paddle.to_tensor(np.ones((1, 1), np.float32))
+    out = lin(x)
+    out.backward()
+    g = float(np.asarray(lin.weight_orig.grad.data).ravel()[0])
+    assert abs(g) < 1e-4, g
+
+
+def test_remove_weight_norm_dim1_size1():
+    from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+    lin = nn.Linear(3, 1, bias_attr=False)  # weight [3, 1]
+    x = paddle.randn([2, 3])
+    y0 = np.asarray(lin(x).data)
+    weight_norm(lin, dim=1)
+    remove_weight_norm(lin)
+    np.testing.assert_allclose(np.asarray(lin(x).data), y0, atol=1e-5)
+
+
+def test_diag_embed_dim_order():
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    sup = np.asarray(F.diag_embed(x, offset=1, dim1=-2, dim2=-1).data)
+    sub = np.asarray(F.diag_embed(x, offset=1, dim1=-1, dim2=-2).data)
+    np.testing.assert_allclose(sub, sup.T)
+    assert sup[0, 1] == 1.0 and sub[1, 0] == 1.0
+
+
+def test_grid_sample_reflection():
+    img = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(
+        1, 1, 2, 2))
+    # x just beyond the right edge reflects back inside
+    g = paddle.to_tensor(np.array([[[[1.5, -1.0]]]], np.float32))
+    out = F.grid_sample(img, g, padding_mode="reflection",
+                        align_corners=True)
+    assert 0.0 <= float(np.asarray(out.data).ravel()[0]) <= 3.0
+    with pytest.raises(ValueError):
+        F.grid_sample(img, g, padding_mode="bogus")
