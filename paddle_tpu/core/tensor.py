@@ -296,17 +296,30 @@ class Tensor:
                 def f(x):
                     return x.at[idx].set(val.astype(x.dtype))
             out = apply(f, *args)
-            self.data = out.data
-            self._node = out._node
-            self._out_index = out._out_index
-            # downstream consumers hold `self`; the node must report grads
-            # through this object, not the discarded wrapper
-            self._node.outputs[self._out_index] = self
+            _rebind_inplace(self, out)
         else:
             self.data = self.data.at[idx].set(val.astype(self.data.dtype))
 
     # arithmetic operators are patched in by paddle_tpu.tensor.math to avoid a
     # circular import; see paddle_tpu/tensor/__init__.py::monkey_patch_tensor.
+
+
+def _rebind_inplace(t: "Tensor", out: "Tensor"):
+    """Make `t` the user-visible result of an in-place op traced as `out`.
+
+    Downstream consumers hold `t`, so the new node must report gradients
+    through it — and the OLD producer node must stop listing `t` as its
+    output (else capture_ids would double-count the pre- and post-op
+    cotangents for grads w.r.t. the mutated tensor)."""
+    old_node, old_idx = t._node, t._out_index
+    if old_node is not None and old_node.outputs[old_idx] is t:
+        ph = Tensor(t.data, stop_gradient=True)  # shape donor for zeros_like
+        old_node.outputs[old_idx] = ph
+    t.data = out.data
+    t._node = out._node
+    t._out_index = out._out_index
+    if t._node is not None:
+        t._node.outputs[t._out_index] = t
 
 
 def _unwrap_index(idx):

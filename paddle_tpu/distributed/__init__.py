@@ -17,6 +17,9 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F40
                        set_hybrid_communicate_group)
 from .data_parallel import DataParallel  # noqa: F401
 from .spawn import spawn  # noqa: F401
+from . import cloud_utils  # noqa: F401
+from . import utils  # noqa: F401
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from .trainer import DeviceWorker, MultiTrainer, train_from_dataset  # noqa: F401
 from .elastic import ElasticManager, ElasticStatus  # noqa: F401
 

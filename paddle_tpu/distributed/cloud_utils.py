@@ -1,0 +1,33 @@
+"""paddle.distributed.cloud_utils (reference: distributed/cloud_utils.py —
+derive the cluster layout from PaddleCloud environment variables)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_cloud_cluster", "use_paddlecloud"]
+
+
+def use_paddlecloud() -> bool:
+    for k in ("PADDLE_TRAINERS_NUM", "POD_IP", "PADDLE_TRAINERS",
+              "PADDLE_TRAINER_ID", "PADDLE_PORT"):
+        if os.environ.get(k) is None:
+            return False
+    return True
+
+
+def get_cloud_cluster(args_node_ips=None, device_mode=None,
+                      devices_per_proc=None, args_port=None):
+    """Cluster endpoints from the PaddleCloud env contract. Returns
+    (node_ips, current_ip, trainer_endpoints)."""
+    node_ips = (os.environ.get("PADDLE_TRAINERS", "") or
+                args_node_ips or "127.0.0.1")
+    if isinstance(node_ips, str):
+        node_ips = [ip for ip in node_ips.split(",") if ip]
+    node_ip = os.environ.get("POD_IP", node_ips[0])
+    port = int(os.environ.get("PADDLE_PORT", args_port or 6170))
+    n_proc = max(int(os.environ.get("PADDLE_TRAINERS_NUM", "1")), 1)
+    n_nodes = max(len(node_ips), 1)
+    per_node = -(-n_proc // n_nodes)  # ceil: never drop a trainer
+    endpoints = [f"{ip}:{port + i}" for ip in node_ips
+                 for i in range(per_node)][:n_proc]
+    return node_ips, node_ip, endpoints

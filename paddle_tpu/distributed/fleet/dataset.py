@@ -1,0 +1,168 @@
+"""Fleet dataset factory (reference: framework/data_set.{h,cc} InMemoryDataset
+/ QueueDataset + python/paddle/distributed/fleet/dataset/dataset.py — the
+file-driven slot datasets consumed by Executor.train_from_dataset).
+
+TPU-native: file ingestion rides the native C++ datafeed
+(csrc/datafeed reader threads + bounded MPMC queue via io.native_feed);
+samples are parsed host-side by a user var-list parser. InMemoryDataset
+additionally materializes all records for local/global shuffle — exactly
+the reference's load_into_memory / local_shuffle / global_shuffle
+contract. Both are plain iterables, so MultiTrainer/train_from_dataset
+and io.DataLoader consume them directly.
+"""
+from __future__ import annotations
+
+import random as _random
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+class DatasetBase:
+    """Common knobs (dataset.py DatasetBase): var list, batch size, files,
+    a line parser (the data_feed.proto analog: text line -> sample)."""
+
+    def __init__(self):
+        self._batch_size = 1
+        self._thread_num = 1
+        self._filelist: List[str] = []
+        self._use_vars: List[str] = []
+        self._parser: Optional[Callable[[bytes], Sequence]] = None
+        self._drop_last = True
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, parser=None,
+             **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        if use_var is not None:
+            self._use_vars = [getattr(v, "name", str(v)) for v in use_var]
+        if parser is not None:
+            self._parser = parser
+        return self
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread_num = thread_num
+
+    def set_use_var(self, var_list):
+        self._use_vars = [getattr(v, "name", str(v)) for v in var_list]
+
+    def set_parser(self, parser):
+        """parser(line: bytes) -> tuple of per-var numpy arrays."""
+        self._parser = parser
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def get_filelist(self):
+        return list(self._filelist)
+
+    def _parse(self, line: bytes):
+        parser = self._parser
+        if parser is not None:
+            return parser(line)
+        return (np.asarray(line.split(), np.float32),)
+
+    def _batches(self, samples):
+        buf = []
+        for s in samples:
+            buf.append(s)
+            if len(buf) == self._batch_size:
+                yield self._collate(buf)
+                buf = []
+        if buf and not self._drop_last:
+            yield self._collate(buf)
+
+    @staticmethod
+    def _collate(buf):
+        n = len(buf[0])
+        return tuple(np.stack([np.asarray(s[i]) for s in buf])
+                     for i in range(n))
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset (data_set.cc QueueDataset): files flow through the
+    native reader threads; one pass, no shuffle buffer."""
+
+    def _lines(self):
+        from ...io.native_feed import NativeRecordReader
+        if not self._filelist:
+            return
+        reader = NativeRecordReader(self._filelist,
+                                    num_threads=self._thread_num)
+        try:
+            yield from reader
+        finally:
+            reader.close()
+
+    def __iter__(self):
+        return iter(self._batches(self._parse(ln) for ln in self._lines()))
+
+
+class InMemoryDataset(QueueDataset):
+    """data_set.cc InMemoryDataset: load_into_memory() materializes every
+    parsed record; local_shuffle() permutes them on this host;
+    global_shuffle() additionally exchanges records across ranks (here:
+    reshards by hash(rank) over the world like the reference's
+    shuffle-by-client-id, then local-shuffles)."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory: Optional[list] = None
+        self._seed = 0
+
+    def load_into_memory(self):
+        self._memory = [self._parse(ln) for ln in self._lines()]
+        return self
+
+    def set_shuffle_seed(self, seed):
+        self._seed = int(seed)
+
+    def local_shuffle(self):
+        if self._memory is None:
+            raise RuntimeError("call load_into_memory() before shuffle")
+        rng = _random.Random(self._seed or None)
+        rng.shuffle(self._memory)
+        return self
+
+    @staticmethod
+    def _record_key(sample, seed) -> int:
+        """Content hash of a parsed record — stable across ranks even when
+        the multithreaded reader delivers lines in different orders."""
+        import hashlib
+        h = hashlib.md5(str(seed).encode())
+        for part in sample:
+            h.update(np.asarray(part).tobytes())
+        return int.from_bytes(h.digest()[:8], "little")
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        if self._memory is None:
+            raise RuntimeError("call load_into_memory() before shuffle")
+        from ..collective import get_rank, get_world_size
+        world = max(get_world_size(), 1)
+        rank = get_rank()
+        if world > 1:
+            # keep records whose CONTENT hash lands on this rank (the
+            # reference sends each record to client_id = hash % world);
+            # content keys make the partition independent of per-rank
+            # load order, so no record is duplicated or dropped
+            seed = self._seed or 12345
+            self._memory = [s for s in self._memory
+                            if self._record_key(s, seed) % world == rank]
+        return self.local_shuffle()
+
+    def release_memory(self):
+        self._memory = None
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._memory or [])
+
+    def __iter__(self):
+        if self._memory is not None:
+            return iter(self._batches(iter(self._memory)))
+        return super().__iter__()
+
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]

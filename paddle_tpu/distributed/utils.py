@@ -1,0 +1,65 @@
+"""paddle.distributed.utils (reference:
+python/paddle/distributed/utils.py — cluster/pod plumbing helpers shared by
+the launchers)."""
+from __future__ import annotations
+
+import logging
+import socket
+from contextlib import closing
+
+from .launch import Pod, get_cluster  # noqa: F401  (reference re-exports)
+
+__all__ = ["get_logger", "get_host_name_ip", "find_free_ports",
+           "terminate_local_procs", "add_arguments", "Pod", "get_cluster"]
+
+
+def get_logger(log_level=20, name="root"):
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s-%(levelname)s: %(message)s"))
+        logger.addHandler(h)
+    return logger
+
+
+def get_host_name_ip():
+    try:
+        host = socket.gethostname()
+        return host, socket.gethostbyname(socket.getfqdn(host))
+    except OSError:
+        return None
+
+
+def find_free_ports(num):
+    """Reserve `num` currently-free TCP ports (launch rendezvous)."""
+    ports = set()
+    for _ in range(num * 10):
+        if len(ports) >= num:
+            break
+        with closing(socket.socket(socket.AF_INET,
+                                   socket.SOCK_STREAM)) as s:
+            s.bind(("", 0))
+            ports.add(s.getsockname()[1])
+    return ports if len(ports) >= num else None
+
+
+def terminate_local_procs(procs):
+    """Terminate launcher children (launch watch-loop failure path)."""
+    for p in procs:
+        proc = getattr(p, "proc", p)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+    for p in procs:
+        proc = getattr(p, "proc", p)
+        if proc is not None:
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+
+
+def add_arguments(argname, type, default, help, argparser, **kwargs):  # noqa: A002
+    argparser.add_argument("--" + argname, default=default, type=type,
+                           help=help + " Default: %(default)s.", **kwargs)

@@ -173,9 +173,8 @@ def _inplace(op):
                 "grad is not allowed (matches the reference's inplace "
                 "leaf guard)")
         out = op(t, *args, **kwargs)
-        t.data = out.data
-        t._node = out._node
-        t._out_index = out._out_index
+        from ...core.tensor import _rebind_inplace
+        _rebind_inplace(t, out)
         return t
     return fn
 

@@ -347,6 +347,8 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
 
     if padding_mode not in ("zeros", "border", "reflection"):
         raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unsupported mode {mode!r}")
 
     def f(img, g):
         N, C, H, W = img.shape

@@ -494,7 +494,7 @@ class LayerDict(Layer):
         return self._sub_layers.values()
 
     def update(self, sublayers):
-        pairs = (sublayers.items() if isinstance(sublayers, dict)
+        pairs = (sublayers.items() if hasattr(sublayers, "items")
                  else sublayers)
         for k, v in pairs:
             self.add_sublayer(k, v)

@@ -204,3 +204,27 @@ def test_grid_sample_reflection():
     assert 0.0 <= float(np.asarray(out.data).ravel()[0]) <= 3.0
     with pytest.raises(ValueError):
         F.grid_sample(img, g, padding_mode="bogus")
+
+
+def test_inplace_grad_wrt_intermediate():
+    """paddle.grad w.r.t. the rebound in-place tensor must see the
+    POST-activation cotangent (node.outputs rebind)."""
+    x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+    x.stop_gradient = False
+    h = x * 2.0
+    F.relu_(h)
+    (g,) = paddle.grad(paddle.sum(h), [h])
+    np.testing.assert_allclose(np.asarray(g.data), [1.0, 1.0])
+
+
+def test_layer_dict_from_layer_dict():
+    d1 = nn.LayerDict({"fc": nn.Linear(2, 3)})
+    d2 = nn.LayerDict(d1)
+    assert "fc" in d2 and isinstance(d2["fc"], nn.Linear)
+
+
+def test_grid_sample_bad_mode_raises():
+    img = paddle.to_tensor(np.zeros((1, 1, 2, 2), np.float32))
+    g = paddle.to_tensor(np.zeros((1, 1, 1, 2), np.float32))
+    with pytest.raises(ValueError):
+        F.grid_sample(img, g, mode="nearst")
