@@ -31,13 +31,18 @@ class DatasetBase:
         self._drop_last = True
 
     def init(self, batch_size=1, thread_num=1, use_var=None, parser=None,
-             **kwargs):
+             drop_last=True, **kwargs):
         self._batch_size = batch_size
         self._thread_num = thread_num
+        self._drop_last = bool(drop_last)
         if use_var is not None:
             self._use_vars = [getattr(v, "name", str(v)) for v in use_var]
         if parser is not None:
             self._parser = parser
+        if kwargs:
+            raise TypeError(
+                f"unknown dataset options: {sorted(kwargs)} (supported: "
+                "batch_size, thread_num, use_var, parser, drop_last)")
         return self
 
     def set_batch_size(self, batch_size):
@@ -111,7 +116,7 @@ class InMemoryDataset(QueueDataset):
     def __init__(self):
         super().__init__()
         self._memory: Optional[list] = None
-        self._seed = 0
+        self._seed: Optional[int] = None  # None = unseeded; 0 is a seed
 
     def load_into_memory(self):
         self._memory = [self._parse(ln) for ln in self._lines()]
@@ -123,7 +128,7 @@ class InMemoryDataset(QueueDataset):
     def local_shuffle(self):
         if self._memory is None:
             raise RuntimeError("call load_into_memory() before shuffle")
-        rng = _random.Random(self._seed or None)
+        rng = _random.Random(self._seed)
         rng.shuffle(self._memory)
         return self
 
@@ -144,14 +149,36 @@ class InMemoryDataset(QueueDataset):
         world = max(get_world_size(), 1)
         rank = get_rank()
         if world > 1:
-            # keep records whose CONTENT hash lands on this rank (the
-            # reference sends each record to client_id = hash % world);
-            # content keys make the partition independent of per-rank
-            # load order, so no record is duplicated or dropped
-            seed = self._seed or 12345
+            # true exchange (the reference ships each record to
+            # client_id = hash % world): gather EVERY rank's records so
+            # disjoint per-rank filelists still produce a full partition,
+            # then keep the records whose content hash lands here
+            self._memory = self._allgather_records(self._memory)
+            seed = 12345 if self._seed is None else self._seed
             self._memory = [s for s in self._memory
                             if self._record_key(s, seed) % world == rank]
         return self.local_shuffle()
+
+    @staticmethod
+    def _allgather_records(records):
+        """Object allgather over jax processes: pickle -> pad to the max
+        byte length -> process_allgather -> unpickle and concatenate."""
+        import pickle
+
+        import jax
+        if jax.process_count() <= 1:
+            return records
+        from jax.experimental import multihost_utils
+        blob = pickle.dumps(records)
+        n = np.asarray([len(blob)], np.int64)
+        max_n = int(np.max(multihost_utils.process_allgather(n)))
+        padded = np.frombuffer(blob.ljust(max_n, b"\0"), np.uint8)
+        gathered = np.asarray(multihost_utils.process_allgather(padded))
+        lengths = np.asarray(multihost_utils.process_allgather(n)).ravel()
+        out = []
+        for row, ln in zip(gathered, lengths):
+            out.extend(pickle.loads(row[: int(ln)].tobytes()))
+        return out
 
     def release_memory(self):
         self._memory = None

@@ -90,6 +90,29 @@ class ChainDataset(IterableDataset):
             yield from d
 
 
+class ComposeDataset(Dataset):
+    """Compose the FIELDS of same-length map-style datasets into one sample
+    tuple (reference: fluid/dataloader/dataset.py:286)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        assert self.datasets, "datasets should not be empty"
+        n = len(self.datasets[0])
+        for d in self.datasets[1:]:
+            assert len(d) == n, "composed datasets must share one length"
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        sample = []
+        for d in self.datasets:
+            item = d[idx]
+            sample.extend(item if isinstance(item, (tuple, list))
+                          else [item])
+        return tuple(sample)
+
+
 def random_split(dataset, lengths, generator=None):
     total = sum(lengths)
     assert total == len(dataset)

@@ -62,12 +62,19 @@ _METHODS = dict(
     logsumexp=math.logsumexp, all=math.all, any=math.any,
     cumsum=math.cumsum, cumprod=math.cumprod, trace=math.trace,
     kron=math.kron, inner=math.inner, outer=math.outer, lerp=math.lerp,
+    erfinv=math.erfinv, frac=math.frac, digamma=math.digamma,
+    lgamma=math.lgamma, multiplex=math.multiplex, rad2deg=math.rad2deg,
+    deg2rad=math.deg2rad, heaviside=math.heaviside, add_=math.add_,
+    subtract_=math.subtract_, clip_=math.clip_, fill_=math.fill_,
+    zero_=math.zero_,
     # stat
     var=stat.var, std=stat.std, median=stat.median, quantile=stat.quantile,
     # linalg
     matmul=linalg.matmul, mm=linalg.mm, bmm=linalg.bmm, dot=linalg.dot,
     norm=linalg.norm, dist=linalg.dist, cholesky=linalg.cholesky,
     inverse=linalg.inv, cross=linalg.cross, t=linalg.t,
+    matrix_power=linalg.matrix_power, bincount=linalg.bincount,
+    histogram=linalg.histogram, tensordot=linalg.tensordot,
     # manipulation
     reshape=manipulation.reshape, reshape_=manipulation.reshape_,
     flatten=manipulation.flatten, transpose=manipulation.transpose,
@@ -79,6 +86,9 @@ _METHODS = dict(
     split=manipulation.split, chunk=manipulation.chunk, unbind=manipulation.unbind,
     index_select=manipulation.index_select, slice=manipulation.slice,
     take_along_axis=manipulation.take_along_axis, pad=manipulation.pad,
+    put_along_axis=manipulation.put_along_axis,
+    rot90=manipulation.rot90, nonzero=logic.nonzero,
+    diag=creation.diag,
     repeat_interleave=manipulation.repeat_interleave, unique=manipulation.unique,
     # logic
     equal=logic.equal, not_equal=logic.not_equal,

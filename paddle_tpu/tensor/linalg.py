@@ -234,3 +234,16 @@ def mv(x, vec, name=None):
 def inverse(x, name=None):
     """paddle.inverse alias of linalg.inv (inverse_op.cc)."""
     return inv(x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    """paddle.tensordot (tensordot semantics over jnp)."""
+    import numpy as _np
+
+    def norm_axes(ax):
+        if isinstance(ax, Tensor):
+            ax = _np.asarray(ax.data).tolist()
+        return ax
+
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=norm_axes(axes)),
+                 _t(x), _t(y))

@@ -314,3 +314,59 @@ def tanh_(x, name=None):
     t = _t(x)
     t.data = jnp.tanh(t.data)
     return t
+
+
+def rad2deg(x, name=None):
+    return apply(lambda a: a * (180.0 / jnp.pi), _t(x))
+
+
+def deg2rad(x, name=None):
+    return apply(lambda a: a * (jnp.pi / 180.0), _t(x))
+
+
+def heaviside(x, y, name=None):
+    """heaviside_op: 0 for x<0, y for x==0, 1 for x>0."""
+    return apply(lambda a, b: jnp.where(
+        a < 0, 0.0, jnp.where(a == 0, b, 1.0)).astype(a.dtype),
+        _t(x), _t(y))
+
+
+# ---- in-place mutation ops (reference varbase_patch_methods) ----
+
+def _inplace_binary(op):
+    def fn(x, y, name=None):
+        from ..core.tensor import _rebind_inplace, is_grad_enabled
+        t = _t(x)
+        if is_grad_enabled() and not t.stop_gradient and t._node is None:
+            raise RuntimeError(
+                "in-place op on a leaf tensor that requires grad")
+        out = op(t, y)
+        _rebind_inplace(t, out)
+        return t
+    return fn
+
+
+add_ = _inplace_binary(lambda a, b: add(a, b))
+subtract_ = _inplace_binary(lambda a, b: subtract(a, b))
+
+
+def clip_(x, min=None, max=None, name=None):
+    from ..core.tensor import _rebind_inplace, is_grad_enabled
+    t = _t(x)
+    if is_grad_enabled() and not t.stop_gradient and t._node is None:
+        raise RuntimeError("in-place clip_ on a leaf tensor requiring grad")
+    _rebind_inplace(t, clip(t, min=min, max=max))
+    return t
+
+
+def fill_(x, value):
+    """No-grad fill (the reference's fill_ mutates storage)."""
+    t = _t(x)
+    t.data = jnp.full_like(t.data, value)
+    return t
+
+
+def zero_(x):
+    t = _t(x)
+    t.data = jnp.zeros_like(t.data)
+    return t

@@ -170,3 +170,243 @@ def vflip(img):
 
 def center_crop(img, output_size):
     return CenterCrop(output_size)(img)
+
+
+# ---- color / geometry functional ops (reference: transforms/functional.py,
+# functional_cv2.py — numpy reimplementations, no cv2/PIL dependency) ----
+
+def _scale_of(img):
+    return 255.0 if np.asarray(img).max() > 1.5 else 1.0
+
+
+def adjust_brightness(img, brightness_factor):
+    hwc = _as_hwc(img).astype(np.float32)
+    return np.clip(hwc * brightness_factor, 0, _scale_of(img))
+
+
+def adjust_contrast(img, contrast_factor):
+    hwc = _as_hwc(img).astype(np.float32)
+    mean = to_grayscale(hwc).mean()
+    return np.clip(mean + contrast_factor * (hwc - mean), 0, _scale_of(img))
+
+
+def adjust_saturation(img, saturation_factor):
+    hwc = _as_hwc(img).astype(np.float32)
+    gray = to_grayscale(hwc)
+    return np.clip(gray + saturation_factor * (hwc - gray), 0,
+                   _scale_of(img))
+
+
+def adjust_hue(img, hue_factor):
+    """Rotate the hue channel by hue_factor (in [-0.5, 0.5] turns)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    hwc = _as_hwc(img).astype(np.float32)
+    scale = _scale_of(img)
+    x = hwc / scale
+    if x.shape[-1] == 1:
+        return hwc
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    maxc = x[..., :3].max(-1)
+    minc = x[..., :3].min(-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0)
+    dz = np.maximum(delta, 1e-12)
+    h = np.where(maxc == r, (g - b) / dz % 6,
+                 np.where(maxc == g, (b - r) / dz + 2, (r - g) / dz + 4))
+    h = (h / 6.0 + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(np.int32) % 6
+    conds = [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+             np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+             np.stack([t, p, v], -1), np.stack([v, p, q], -1)]
+    rgb = np.select([(i == k)[..., None].repeat(3, -1) for k in range(6)],
+                    conds)
+    out = x.copy()
+    out[..., :3] = rgb
+    return np.clip(out * scale, 0, scale)
+
+
+def to_grayscale(img, num_output_channels=1):
+    hwc = _as_hwc(img).astype(np.float32)
+    if hwc.shape[-1] >= 3:
+        gray = (0.299 * hwc[..., 0] + 0.587 * hwc[..., 1]
+                + 0.114 * hwc[..., 2])[..., None]
+    else:
+        gray = hwc[..., :1]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=-1)
+    return gray
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top:top + height, left:left + width]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    if isinstance(padding, int):
+        padding = [padding] * 4
+    if len(padding) == 2:
+        padding = [padding[0], padding[1], padding[0], padding[1]]
+    left, top, right, bottom = padding
+    hwc = _as_hwc(img)
+    cfg = [(top, bottom), (left, right), (0, 0)]
+    if padding_mode == "constant":
+        return np.pad(hwc, cfg, constant_values=fill)
+    return np.pad(hwc, cfg, mode={"reflect": "reflect", "edge": "edge",
+                                  "symmetric": "symmetric"}[padding_mode])
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate counter-clockwise by `angle` degrees (nearest-neighbor
+    resampling, cv2-free)."""
+    hwc = _as_hwc(img)
+    H, W = hwc.shape[:2]
+    rad = -np.deg2rad(angle)  # inverse map for output->input lookup
+    cy, cx = ((H - 1) / 2.0, (W - 1) / 2.0) if center is None else (
+        center[1], center[0])
+    if expand:
+        corners = np.array([[-cx, -cy], [W - 1 - cx, -cy],
+                            [-cx, H - 1 - cy], [W - 1 - cx, H - 1 - cy]])
+        rot = np.array([[np.cos(rad), -np.sin(rad)],
+                        [np.sin(rad), np.cos(rad)]])
+        spread = corners @ rot.T
+        Wo = int(np.ceil(spread[:, 0].max() - spread[:, 0].min() + 1))
+        Ho = int(np.ceil(spread[:, 1].max() - spread[:, 1].min() + 1))
+        ocx, ocy = (Wo - 1) / 2.0, (Ho - 1) / 2.0
+    else:
+        Ho, Wo, ocx, ocy = H, W, cx, cy
+    ys, xs = np.meshgrid(np.arange(Ho), np.arange(Wo), indexing="ij")
+    xr = (xs - ocx) * np.cos(rad) - (ys - ocy) * np.sin(rad) + cx
+    yr = (xs - ocx) * np.sin(rad) + (ys - ocy) * np.cos(rad) + cy
+    xi = np.round(xr).astype(np.int64)
+    yi = np.round(yr).astype(np.int64)
+    inside = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+    out = np.full((Ho, Wo, hwc.shape[2]), fill, hwc.dtype)
+    out[inside] = hwc[yi[inside], xi[inside]]
+    return out
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value):
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        factor = 1 + random.uniform(-self.value, self.value)
+        return adjust_contrast(img, factor)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value):
+        if value < 0:
+            raise ValueError("saturation value must be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        factor = 1 + random.uniform(-self.value, self.value)
+        return adjust_saturation(img, factor)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Randomly jitter brightness/contrast/saturation/hue (reference
+    transforms.ColorJitter — random order of the four sub-transforms)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+        if saturation:
+            self.transforms.append(SaturationTransform(saturation))
+        if hue:
+            self.transforms.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        order = list(self.transforms)
+        random.shuffle(order)
+        for t in order:
+            img = t._apply_image(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, expand=self.expand, center=self.center,
+                      fill=self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    """Random area/aspect crop then resize (the ImageNet training
+    transform)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        hwc = _as_hwc(img)
+        H, W = hwc.shape[:2]
+        area = H * W
+        for _ in range(10):
+            target = area * random.uniform(*self.scale)
+            ar = np.exp(random.uniform(np.log(self.ratio[0]),
+                                       np.log(self.ratio[1])))
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if 0 < w <= W and 0 < h <= H:
+                top = random.randint(0, H - h)
+                left = random.randint(0, W - w)
+                patch = crop(hwc, top, left, h, w)
+                return Resize(self.size, self.interpolation)(patch)
+        return Resize(self.size, self.interpolation)(
+            CenterCrop(min(H, W))(hwc))

@@ -247,3 +247,55 @@ def test_flops_lenet():
     n3 = paddle.flops(m, input_size=(1, 1, 28, 28),
                       custom_ops={Linear: lambda l, i, o: 0})
     assert n3 < n
+
+
+def test_compose_dataset():
+    from paddle_tpu.io import ComposeDataset, TensorDataset
+    a = TensorDataset([paddle.to_tensor(np.arange(4, dtype=np.float32))])
+    b = TensorDataset([paddle.to_tensor(np.arange(4, 8, dtype=np.float32))])
+    ds = ComposeDataset([a, b])
+    assert len(ds) == 4
+    s = ds[1]
+    assert float(np.asarray(s[0].data if hasattr(s[0], 'data') else s[0])) \
+        == 1.0
+    assert float(np.asarray(s[1].data if hasattr(s[1], 'data') else s[1])) \
+        == 5.0
+
+
+def test_vision_transform_extras():
+    from paddle_tpu.vision import transforms as T
+    img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype(np.uint8)
+
+    gray = T.to_grayscale(img)
+    assert gray.shape == (8, 8, 1)
+    assert T.Grayscale(3)._apply_image(img).shape == (8, 8, 3)
+
+    padded = T.pad(img, 2)
+    assert padded.shape == (12, 12, 3)
+    assert T.Pad([1, 0])._apply_image(img).shape == (8, 10, 3)
+
+    c = T.crop(img, 2, 2, 4, 4)
+    assert c.shape == (4, 4, 3)
+
+    r = T.rotate(img, 90)
+    assert r.shape == (8, 8, 3)
+    # 90-degree rotation is exact under nearest sampling
+    np.testing.assert_array_equal(T.rotate(T.rotate(img, 90), -90), img)
+    assert T.rotate(img, 45, expand=True).shape[0] > 8
+
+    bright = T.adjust_brightness(img, 2.0)
+    assert bright.max() <= 255.0 and bright.mean() >= img.mean()
+    T.adjust_contrast(img, 0.5)
+    T.adjust_saturation(img, 0.5)
+    h = T.adjust_hue(img, 0.25)
+    assert h.shape == (8, 8, 3)
+    # hue rotation preserves value channel (max of rgb)
+    np.testing.assert_allclose(h.max(-1), img.astype(np.float32).max(-1),
+                               atol=2.0)
+
+    jit = T.ColorJitter(0.4, 0.4, 0.4, 0.2)
+    assert jit._apply_image(img).shape == (8, 8, 3)
+    rr = T.RandomRotation(30)._apply_image(img)
+    assert rr.shape == (8, 8, 3)
+    rc = T.RandomResizedCrop(4)._apply_image(img)
+    assert rc.shape[:2] == (4, 4)

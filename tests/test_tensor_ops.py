@@ -110,3 +110,33 @@ def test_cumsum_clip_scale():
                                np.clip(x.numpy(), 1.5, 3.5))
     np.testing.assert_allclose(paddle.scale(x, 2.0, 1.0).numpy(),
                                x.numpy() * 2 + 1)
+
+
+def test_tensor_method_surface_and_inplace():
+    """Root fns exposed as Tensor methods + reference in-place ops."""
+    import numpy as np
+    t = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+    for name in ("nonzero", "rot90", "matrix_power", "erfinv", "frac",
+                 "digamma", "lgamma", "histogram", "tensordot",
+                 "put_along_axis", "fill_", "zero_", "add_", "subtract_",
+                 "clip_"):
+        assert hasattr(t, name), name
+    # in-place ops are differentiable through the rebind (non-leaf)
+    x = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+    x.stop_gradient = False
+    h = x * 2.0
+    h.clip_(min=0.0)
+    paddle.sum(h).backward()
+    np.testing.assert_allclose(np.asarray(x.grad.data), [2.0, 0.0])
+    # fill_/zero_ mutate storage (no grad semantics, reference parity)
+    y = paddle.to_tensor(np.ones(3, np.float32))
+    y.fill_(7.0)
+    assert float(np.asarray(y.data).sum()) == 21.0
+    y.zero_()
+    assert float(np.asarray(y.data).sum()) == 0.0
+    np.testing.assert_allclose(
+        np.asarray(paddle.rad2deg(paddle.to_tensor(np.pi)).data), 180.0,
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(paddle.deg2rad(paddle.to_tensor(180.0)).data), np.pi,
+        rtol=1e-6)

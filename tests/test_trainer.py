@@ -173,3 +173,30 @@ def test_global_shuffle_partition_is_content_keyed(tmp_path):
     ds2 = load(99)
     keys2 = sorted(ds2._record_key(s, 7) % 2 for s in ds2._memory)
     assert keys1 == keys2  # same records -> same partition regardless of order
+
+
+def test_dataset_drop_last_and_unknown_option(tmp_path):
+    from paddle_tpu.distributed import QueueDataset
+    files = _write_slot_files(tmp_path, n_files=1, per=5)
+    ds = QueueDataset()
+    ds.init(batch_size=2, parser=_parser, drop_last=False)
+    ds.set_filelist(files)
+    assert len(list(ds)) == 3  # 2+2+1
+    with pytest.raises(TypeError):
+        QueueDataset().init(batch_size=2, bogus_option=1)
+
+
+def test_in_memory_shuffle_seed_zero_is_deterministic(tmp_path):
+    from paddle_tpu.distributed import InMemoryDataset
+    files = _write_slot_files(tmp_path, n_files=1, per=8)
+
+    def run():
+        ds = InMemoryDataset()
+        ds.init(batch_size=1, parser=_parser)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        ds.set_shuffle_seed(0)
+        ds.local_shuffle()
+        return [float(s[1][0]) for s in ds._memory]
+
+    assert run() == run()
