@@ -84,6 +84,7 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
     import paddle_tpu as paddle
     from paddle_tpu import optimizer as optim
     from paddle_tpu.models.gpt import GPTForCausalLM
+    from paddle_tpu.models.llama import LlamaForCausalLM
 
     import os
     # size to the hardware: single-chip CI uses gpt3-125m bf16
@@ -93,7 +94,9 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
     B = int(os.environ.get("BENCH_BS", B))
     S = int(os.environ.get("BENCH_SEQ", S))
     paddle.seed(0)
-    model = GPTForCausalLM.from_preset(preset)
+    family = LlamaForCausalLM if preset.startswith("llama") \
+        else GPTForCausalLM
+    model = family.from_preset(preset)
     if on_tpu:
         model.to(dtype="bfloat16")
     cfg = model.config
@@ -169,7 +172,7 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
     mfu = achieved / peak
 
     result = {
-        "metric": f"tokens/sec/chip GPT({preset}) bs{B} seq{S} "
+        "metric": f"tokens/sec/chip {preset} bs{B} seq{S} "
                   f"{'bf16' if on_tpu else 'fp32-cpu'} fused train step",
         "value": round(tokens_per_sec_chip, 1),
         "unit": "tokens/sec/chip",

@@ -45,10 +45,15 @@ class _Node:
     the graph from the loss and sweeps in reverse `seq` order."""
 
     __slots__ = ("vjp_fn", "inputs", "in_links", "outputs", "out_grads",
-                 "single", "seq")
+                 "single", "seq", "fn_info")
 
-    def __init__(self, vjp_fn, inputs, outputs, single, seq):
+    def __init__(self, vjp_fn, inputs, outputs, single, seq, fn_info=None):
         self.vjp_fn = vjp_fn
+        # (fn, raw_args, diff_idx, kwargs): enough to RE-derive the vjp as
+        # a taped computation over the primal Tensors — the create_graph
+        # (double-grad) path needs the pullback as a function of the
+        # primals, which the residual-closed vjp_fn is not
+        self.fn_info = fn_info
         self.inputs: List["Tensor"] = inputs
         # (producer node, out index) per input, snapshotted at record time:
         # in-place ops (__setitem__) rebind a Tensor's _node afterwards, and
@@ -60,11 +65,18 @@ class _Node:
         self.single = single  # forward returned a bare array (not a tuple)
         self.seq = seq
 
-    def seed(self, index: int, grad: jax.Array):
-        if self.out_grads[index] is None:
+    def seed(self, index: int, grad):
+        cur = self.out_grads[index]
+        if cur is None:
             self.out_grads[index] = grad
+            return
+        if isinstance(cur, Tensor) or isinstance(grad, Tensor):
+            # create_graph cotangents are Tensors: accumulate on the tape
+            a = cur if isinstance(cur, Tensor) else Tensor(cur)
+            b = grad if isinstance(grad, Tensor) else Tensor(grad)
+            self.out_grads[index] = a + b
         else:
-            self.out_grads[index] = self.out_grads[index] + grad
+            self.out_grads[index] = cur + grad
 
 
 def is_grad_enabled() -> bool:
@@ -226,11 +238,24 @@ class Tensor:
 
     def _accumulate_grad(self, g):
         from .selected_rows import SelectedRows
+        if isinstance(g, Tensor):
+            # create_graph gradient: KEEP its tape node so grad-of-grad
+            # can differentiate through it
+            if self.grad is None:
+                self.grad = g
+            elif isinstance(self.grad, Tensor):
+                self.grad = self.grad + g
+            else:
+                self.grad = Tensor(self.grad.to_dense()) + g
+            return
         if isinstance(g, SelectedRows):
             if self.grad is None:
                 self.grad = g
             elif isinstance(self.grad, SelectedRows):
                 self.grad = self.grad.merge(g)
+            elif self.grad._node is not None:
+                # the existing grad carries a tape (create_graph): keep it
+                self.grad = self.grad + Tensor(g.to_dense())
             else:
                 self.grad = Tensor(self.grad.data + g.to_dense())
             return
@@ -380,7 +405,7 @@ def apply(fn: Callable, *args, **kwargs):
     tensors, single = _wrap_outputs(outs, node_needed=True)
     _STATE.seq += 1
     node = _Node(vjp_fn, [args[i] for i in diff_idx], tensors, single,
-                 _STATE.seq)
+                 _STATE.seq, fn_info=(fn, raw, diff_idx, kwargs))
     for k, t in enumerate(tensors):
         t._node = node
         t._out_index = k
@@ -402,9 +427,45 @@ def _reachable_nodes(roots: List[_Node]) -> List[_Node]:
     return sorted(seen.values(), key=lambda n: -n.seq)
 
 
+def _second_order_vjp(node, cotangents):
+    """Re-derive this node's vjp THROUGH the tape (create_graph): the
+    pullback is re-expressed as a function of the primal input Tensors, so
+    the returned gradients are themselves differentiable."""
+    fn, raw, diff_idx, kwargs = node.fn_info
+    n_p = len(diff_idx)
+    single = node.single
+    for i, inp in zip(diff_idx, node.inputs):
+        if inp.data is not raw[i]:
+            # an in-place rebind replaced this input's value after the op
+            # was recorded; re-deriving at the CURRENT value would be
+            # silently wrong — the normal (create_graph=False) path handles
+            # this via the residual-closed vjp_fn + in_links snapshot
+            raise RuntimeError(
+                "create_graph through an op whose input was later mutated "
+                "in place is not supported; compute the double-grad region "
+                "without in-place updates")
+
+    def second(*vals):
+        prim = vals[:n_p]
+        cots = vals[n_p:]
+
+        def closed(*dv):
+            vv = list(raw)
+            for i, v in zip(diff_idx, dv):
+                vv[i] = v
+            return fn(*vv, **kwargs)
+
+        _, pull = jax.vjp(closed, *prim)
+        ct = cots[0] if single else tuple(cots)
+        return pull(ct)
+
+    outs = apply(second, *node.inputs, *cotangents)
+    return outs if isinstance(outs, tuple) else (outs,)
+
+
 def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
              retain_graph: bool = False, only_ids: Optional[set] = None,
-             capture_ids: Optional[set] = None):
+             capture_ids: Optional[set] = None, create_graph: bool = False):
     """Reverse graph sweep (basic_engine.cc:305 analog).
 
     only_ids: if set, restrict leaf .grad accumulation to these tensor ids
@@ -412,8 +473,12 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
     capture_ids: non-leaf tensors whose flowing cotangent should be recorded
     into .grad (paddle.grad w.r.t. intermediates).
     """
-    seed = (grad_tensor.data if grad_tensor is not None
-            else jnp.ones_like(loss.data))
+    if grad_tensor is None:
+        seed = jnp.ones_like(loss.data)
+    elif create_graph and isinstance(grad_tensor, Tensor):
+        seed = grad_tensor  # keep its tape: d(grad)/d(grad_outputs) flows
+    else:
+        seed = grad_tensor.data
     if loss._node is None:
         if not loss.stop_gradient and (only_ids is None
                                        or id(loss) in only_ids):
@@ -435,7 +500,18 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
             for t, g in zip(node.outputs, cotangents):
                 if id(t) in capture_ids:
                     t._accumulate_grad(g)
-        in_grads = node.vjp_fn(cotangents[0] if node.single else cotangents)
+        if create_graph and node.fn_info is None:
+            raise RuntimeError(
+                "create_graph through a custom tape node without re-"
+                "derivable fn_info (e.g. the sparse-embedding backward) is "
+                "not supported; use a dense embedding in double-grad "
+                "regions")
+        if create_graph and node.fn_info is not None:
+            in_grads = _second_order_vjp(node, cotangents)
+        else:
+            raw_cots = tuple(c.data if isinstance(c, Tensor) else c
+                             for c in cotangents)
+            in_grads = node.vjp_fn(raw_cots[0] if node.single else raw_cots)
         for inp, (pnode, pidx), g in zip(node.inputs, node.in_links,
                                          in_grads):
             if g is None:
@@ -445,9 +521,10 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
             elif only_ids is None or id(inp) in only_ids:
                 inp._accumulate_grad(g)
         node.out_grads = [None] * len(node.outputs)
-    if not retain_graph:
+    if not (retain_graph or create_graph):
         for node in nodes:
             node.vjp_fn = None  # free residuals; second backward is a no-op
+            node.fn_info = None  # and the primal snapshots/closures
 
 
 def grad(outputs: Sequence[Tensor], inputs: Sequence[Tensor],
@@ -467,7 +544,8 @@ def grad(outputs: Sequence[Tensor], inputs: Sequence[Tensor],
     for i, out in enumerate(outputs):
         g = None if grad_outputs is None else grad_outputs[i]
         backward(out, g, retain_graph=(retain_graph or i < len(outputs) - 1),
-                 only_ids=leaf_ids, capture_ids=cap_ids)
+                 only_ids=leaf_ids, capture_ids=cap_ids,
+                 create_graph=create_graph)
     result = [t.grad if t.grad is not None else None for t in inputs]
     for t, old in saved:
         t.grad = old

@@ -117,3 +117,74 @@ def test_pylayer_custom_backward():
     y = Double.apply(x)
     y.sum().backward()
     np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+# ---------------- double grad (partial_grad_engine.cc create_graph) -------
+
+def test_double_grad_scalar():
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    x.stop_gradient = False
+    y = x * x * x
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    assert g1._node is not None  # differentiable gradient
+    np.testing.assert_allclose(np.asarray(g1.data), [12.0], atol=1e-5)
+    (g2,) = paddle.grad(g1, [x], create_graph=True)
+    np.testing.assert_allclose(np.asarray(g2.data), [12.0], atol=1e-5)
+    (g3,) = paddle.grad(g2, [x])
+    np.testing.assert_allclose(np.asarray(g3.data), [6.0], atol=1e-5)
+
+
+def test_gradient_penalty_pattern():
+    """d/dparams of ||dL/dx||^2 — the WGAN-GP use of double grad."""
+    w = paddle.to_tensor(np.array([3.0], np.float32))
+    w.stop_gradient = False
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    x.stop_gradient = False
+    L = w * x * x
+    (gx,) = paddle.grad(L, [x], create_graph=True)
+    penalty = paddle.sum(gx * gx)        # (2wx)^2
+    (gw,) = paddle.grad(penalty, [w])
+    np.testing.assert_allclose(np.asarray(gw.data), [96.0], atol=1e-4)
+
+
+def test_double_grad_through_layer():
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    lin = nn.Linear(3, 1, bias_attr=False)
+    x = paddle.to_tensor(np.ones((1, 3), np.float32))
+    x.stop_gradient = False
+    y = paddle.sum(paddle.tanh(lin(x)))
+    (gx,) = paddle.grad(y, [x], create_graph=True)
+    gp = paddle.sum(gx * gx)
+    (gw,) = paddle.grad(gp, [lin.weight])
+    assert gw is not None
+    assert float(np.abs(np.asarray(gw.data)).sum()) > 0
+
+
+def test_double_grad_through_grad_outputs():
+    """d(grad)/d(grad_outputs): the cotangent's tape must survive the seed."""
+    u = paddle.to_tensor(np.array([3.0], np.float32))
+    u.stop_gradient = False
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    x.stop_gradient = False
+    y = x * x
+    v = u * 1.0
+    (g,) = paddle.grad(y, [x], grad_outputs=[v], create_graph=True)
+    np.testing.assert_allclose(np.asarray(g.data), [12.0], atol=1e-5)
+    (gu,) = paddle.grad(g, [u])
+    np.testing.assert_allclose(np.asarray(gu.data), [4.0], atol=1e-5)
+
+
+def test_double_grad_inplace_raises():
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    x.stop_gradient = False
+    y = x * 2.0
+    z = y * y
+    y[0] = 100.0  # in-place rebind between record and backward
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError):
+        paddle.grad(z, [x], create_graph=True)
+    # the normal path stays correct
+    z2 = (x * 2.0) * (x * 2.0)
+    (g,) = paddle.grad(z2, [x])
+    np.testing.assert_allclose(np.asarray(g.data), [16.0], atol=1e-5)
