@@ -489,6 +489,21 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
     loss._node.seed(loss._out_index, seed)
 
     nodes = _reachable_nodes([loss._node])
+    try:
+        _sweep(nodes, only_ids, capture_ids, create_graph)
+    except BaseException:
+        # leave no stale seeds behind: a caught-and-retried backward on
+        # the same graph must not double-accumulate
+        for node in nodes:
+            node.out_grads = [None] * len(node.outputs)
+        raise
+    if not (retain_graph or create_graph):
+        for node in nodes:
+            node.vjp_fn = None  # free residuals; second backward is a no-op
+            node.fn_info = None  # and the primal snapshots/closures
+
+
+def _sweep(nodes, only_ids, capture_ids, create_graph):
     for node in nodes:
         if node.vjp_fn is None or all(g is None for g in node.out_grads):
             continue
@@ -521,10 +536,6 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
             elif only_ids is None or id(inp) in only_ids:
                 inp._accumulate_grad(g)
         node.out_grads = [None] * len(node.outputs)
-    if not (retain_graph or create_graph):
-        for node in nodes:
-            node.vjp_fn = None  # free residuals; second backward is a no-op
-            node.fn_info = None  # and the primal snapshots/closures
 
 
 def grad(outputs: Sequence[Tensor], inputs: Sequence[Tensor],
@@ -541,12 +552,16 @@ def grad(outputs: Sequence[Tensor], inputs: Sequence[Tensor],
         t.grad = None
     leaf_ids = {id(t) for t in inputs if t._node is None}
     cap_ids = {id(t) for t in inputs if t._node is not None}
-    for i, out in enumerate(outputs):
-        g = None if grad_outputs is None else grad_outputs[i]
-        backward(out, g, retain_graph=(retain_graph or i < len(outputs) - 1),
-                 only_ids=leaf_ids, capture_ids=cap_ids,
-                 create_graph=create_graph)
-    result = [t.grad if t.grad is not None else None for t in inputs]
-    for t, old in saved:
-        t.grad = old
+    try:
+        for i, out in enumerate(outputs):
+            g = None if grad_outputs is None else grad_outputs[i]
+            backward(out, g,
+                     retain_graph=(retain_graph or i < len(outputs) - 1),
+                     only_ids=leaf_ids, capture_ids=cap_ids,
+                     create_graph=create_graph)
+        result = [t.grad if t.grad is not None else None for t in inputs]
+    finally:
+        # a raising backward must not clobber pre-existing .grad values
+        for t, old in saved:
+            t.grad = old
     return result

@@ -188,3 +188,27 @@ def test_double_grad_inplace_raises():
     z2 = (x * 2.0) * (x * 2.0)
     (g,) = paddle.grad(z2, [x])
     np.testing.assert_allclose(np.asarray(g.data), [16.0], atol=1e-5)
+
+
+def test_failed_create_graph_leaves_clean_state():
+    """A raising create_graph backward must not leave stale seeds or
+    clobber pre-existing .grad values."""
+    import pytest as _pytest
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    x.stop_gradient = False
+    # pre-existing grad from an earlier step
+    pre = paddle.to_tensor(np.array([1.0], np.float32))
+    pre.stop_gradient = False
+    (pre * 3.0).backward()
+    assert float(pre.grad.data[0]) == 3.0
+
+    y = x * 2.0
+    z = y * y
+    y[0] = 100.0
+    with _pytest.raises(RuntimeError):
+        paddle.grad(z, [x, pre], create_graph=True)
+    # pre's .grad untouched by the failed call
+    assert float(pre.grad.data[0]) == 3.0
+    # retry without create_graph: no doubled seed
+    (g,) = paddle.grad(z, [x])
+    np.testing.assert_allclose(np.asarray(g.data), [16.0], atol=1e-5)

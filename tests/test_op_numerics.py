@@ -161,3 +161,105 @@ def test_transpose_reshape_concat():
     check_output(lambda u, v: paddle.concat([u, v], axis=0),
                  lambda u, v: np.concatenate([u, v], 0), [a, b])
     check_grad(lambda u, v: paddle.concat([u, v], axis=0), [a, b])
+
+
+def test_pool2d_grads():
+    x = R.randn(1, 2, 6, 6).astype(np.float32)
+    check_grad(lambda t: F.avg_pool2d(t, kernel_size=2, stride=2), [x])
+    check_grad(lambda t: F.max_pool2d(t, kernel_size=2, stride=2), [x],
+               atol=1e-2, rtol=1e-2)
+
+
+def test_batch_norm_eval_output():
+    x = R.randn(4, 3, 2, 2).astype(np.float32)
+    rm = np.zeros(3, np.float32)
+    rv = np.ones(3, np.float32)
+    w = R.randn(3).astype(np.float32)
+    b = R.randn(3).astype(np.float32)
+
+    def op(t):
+        return F.batch_norm(t, paddle.to_tensor(rm), paddle.to_tensor(rv),
+                            weight=paddle.to_tensor(w),
+                            bias=paddle.to_tensor(b), training=False)
+
+    def np_bn(a):
+        return (a - rm[None, :, None, None]) / np.sqrt(
+            rv[None, :, None, None] + 1e-5) * w[None, :, None, None] + \
+            b[None, :, None, None]
+
+    check_output(op, np_bn, [x], atol=1e-5)
+
+
+def test_activation_batch():
+    x = R.randn(3, 5).astype(np.float32)
+    check_output(F.relu, lambda a: np.maximum(a, 0), [x])
+    check_grad(F.relu, [x + 0.05])  # nudge off the kink
+    import math as _math
+    check_output(F.gelu, lambda a: 0.5 * a * (1 + np.vectorize(
+        lambda v: _math.erf(v / _math.sqrt(2)))(a)), [x], atol=1e-4)
+    check_grad(F.gelu, [x])
+    check_output(F.silu, lambda a: a / (1 + np.exp(-a)), [x])
+    check_grad(F.silu, [x])
+    check_output(lambda t: F.leaky_relu(t, 0.1),
+                 lambda a: np.where(a > 0, a, 0.1 * a), [x])
+    check_output(F.softplus, lambda a: np.log1p(np.exp(a)), [x], atol=1e-5)
+    check_grad(F.softplus, [x])
+
+
+def test_reduction_dims():
+    x = R.randn(2, 3, 4).astype(np.float32)
+    check_output(lambda t: paddle.sum(t, axis=[0, 2]),
+                 lambda a: a.sum((0, 2)), [x])
+    check_grad(lambda t: paddle.sum(t, axis=[0, 2]), [x])
+    check_output(lambda t: paddle.logsumexp(t, axis=1),
+                 lambda a: np.log(np.exp(a).sum(1)), [x], atol=1e-5)
+    check_grad(lambda t: paddle.logsumexp(t, axis=1), [x])
+    check_output(lambda t: paddle.prod(t, axis=2),
+                 lambda a: a.prod(2), [x], atol=1e-5)
+
+
+def test_stack_split_squeeze():
+    a = R.randn(2, 3).astype(np.float32)
+    b = R.randn(2, 3).astype(np.float32)
+    check_output(lambda u, v: paddle.stack([u, v], axis=1),
+                 lambda u, v: np.stack([u, v], 1), [a, b])
+    check_grad(lambda u, v: paddle.stack([u, v], axis=1), [a, b])
+    x = R.randn(4, 6).astype(np.float32)
+    check_output(lambda t: paddle.split(t, 3, axis=1)[1],
+                 lambda m: np.split(m, 3, 1)[1], [x])
+    check_grad(lambda t: paddle.split(t, 3, axis=1)[1], [x])
+
+
+def test_clip_minimum_maximum_grads():
+    x = R.randn(3, 3).astype(np.float32)
+    y = R.randn(3, 3).astype(np.float32)
+    check_output(lambda t: paddle.clip(t, -0.5, 0.5),
+                 lambda a: np.clip(a, -0.5, 0.5), [x])
+    check_grad(lambda t: paddle.clip(t, -0.5, 0.5), [x + 0.02])
+    check_output(paddle.maximum, np.maximum, [x, y])
+    check_grad(paddle.maximum, [x, y])
+
+
+def test_embedding_grad():
+    w = R.randn(7, 4).astype(np.float32)
+    ids = np.array([[1, 3], [5, 1]], np.int64)
+    check_output(lambda t: F.embedding(paddle.to_tensor(ids), t),
+                 lambda m: m[ids], [w])
+    check_grad(lambda t: F.embedding(paddle.to_tensor(ids), t), [w])
+
+
+def test_mse_l1_smooth_losses():
+    x = R.randn(4, 3).astype(np.float32)
+    y = R.randn(4, 3).astype(np.float32)
+    check_output(
+        lambda a, b: F.mse_loss(a, b, reduction="none"),
+        lambda a, b: (a - b) ** 2, [x, y])
+    check_grad(lambda a, b: F.mse_loss(a, b, reduction="none"), [x, y])
+    check_output(
+        lambda a, b: F.l1_loss(a, b, reduction="none"),
+        lambda a, b: np.abs(a - b), [x, y])
+    check_output(
+        lambda a, b: F.smooth_l1_loss(a, b, reduction="none"),
+        lambda a, b: np.where(np.abs(a - b) < 1.0,
+                              0.5 * (a - b) ** 2,
+                              np.abs(a - b) - 0.5), [x, y], atol=1e-5)
