@@ -347,6 +347,17 @@ def _rebind_inplace(t: "Tensor", out: "Tensor"):
         t._node.outputs[t._out_index] = t
 
 
+def inplace_guard(t: "Tensor", opname: str = "op"):
+    """Shared leaf guard for every in-place op (relu_/tanh_/add_/clip_/
+    scatter_/…): a leaf that requires grad cannot be mutated in place
+    without orphaning its grad accumulator — fail loudly, matching the
+    reference's inplace leaf check."""
+    if _STATE.grad_enabled and not t.stop_gradient and t._node is None:
+        raise RuntimeError(
+            f"in-place {opname} on a leaf tensor that requires grad is "
+            "not allowed (matches the reference's inplace leaf guard)")
+
+
 def _unwrap_index(idx):
     if isinstance(idx, Tensor):
         return idx.data

@@ -250,17 +250,27 @@ class Predictor:
                 part.append(sl)
             out = self._call(self._params, self._buffers, *part)
             outs = list(out) if isinstance(out, (list, tuple)) else [out]
-            # an output rides the batch iff its leading dim is exp_b;
-            # others (scalars, reductions) keep the first chunk's value
             if chunks_out is None:
+                # an output rides the batch iff its leading dim is exp_b.
+                # A non-batched output (reduction/scalar head) cannot be
+                # stitched back from chunks, and a padded chunk would fold
+                # zero rows into it — refuse rather than return garbage.
+                # (Reaching here implies chunking or padding: exp_b is only
+                # set when got_b != exported batch.)
                 batched_out = [hasattr(o, "ndim") and o.ndim > 0
                                and o.shape[0] == exp_b for o in outs]
-                chunks_out = [[o[: hi - lo]] if b else [o]
-                              for o, b in zip(outs, batched_out)]
+                if not all(batched_out):
+                    raise ValueError(
+                        "Predictor dynamic-batch chunking got a non-batched "
+                        f"output (shapes {[getattr(o, 'shape', ()) for o in outs]}, "
+                        f"exported batch {exp_b}, got {got_b}): reductions "
+                        "over the batch cannot be reassembled from chunks. "
+                        "Run with the exported batch size, or re-export with "
+                        "a batch-shaped output.")
+                chunks_out = [[o[: hi - lo]] for o in outs]
             else:
-                for acc, o, b in zip(chunks_out, outs, batched_out):
-                    if b:
-                        acc.append(o[: hi - lo])
+                for acc, o in zip(chunks_out, outs):
+                    acc.append(o[: hi - lo])
         return [jnp.concatenate(parts, axis=0) if len(parts) > 1
                 else parts[0] for parts in chunks_out]
 

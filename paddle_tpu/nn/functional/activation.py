@@ -165,16 +165,10 @@ def _inplace(op):
     tensor to the op's output node (mirroring Tensor.__setitem__'s rebind)
     so gradients include the activation derivative."""
     def fn(x, *args, **kwargs):
-        from ...core.tensor import is_grad_enabled
+        from ...core.tensor import _rebind_inplace, inplace_guard
         t = _t(x)
-        if is_grad_enabled() and not t.stop_gradient and t._node is None:
-            raise RuntimeError(
-                f"in-place {op.__name__}_ on a leaf tensor that requires "
-                "grad is not allowed (matches the reference's inplace "
-                "leaf guard)")
-        out = op(t, *args, **kwargs)
-        from ...core.tensor import _rebind_inplace
-        _rebind_inplace(t, out)
+        inplace_guard(t, f"{op.__name__}_")
+        _rebind_inplace(t, op(t, *args, **kwargs))
         return t
     return fn
 

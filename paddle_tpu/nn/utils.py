@@ -99,6 +99,11 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
         # the gradient, matching the reference's no-grad power iteration)
         mat = jnp.moveaxis(wdat.astype(jnp.float32), dim, 0).reshape(h, -1)
         u = state["u"]
+        if n_power_iterations == 0:
+            # reuse the stored u (reference behavior); v must still be
+            # computed so sigma = u^T W v is well-defined
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
         for _ in range(n_power_iterations):
             v = mat.T @ u
             v = v / jnp.maximum(jnp.linalg.norm(v), eps)

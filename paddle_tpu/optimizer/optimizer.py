@@ -125,11 +125,29 @@ class Optimizer:
                                          {"learning_rate": 1.0})["learning_rate"]
             wd = self._wd_for(p)
             if isinstance(g, SelectedRows):
+                from ..regularizer import L1Decay
                 sparse_rule = getattr(self, "_sparse_rule", None)
                 res = None
                 if sparse_rule is not None and not wd and \
                         "master_weight" not in slots:
-                    res = sparse_rule(g, p.data, slots, lr)
+                    # L1Decay maps to wd=0 (its penalty lives in _reg_grad
+                    # on the dense path); fold coeff*sign(p[rows]) into the
+                    # row values so sparse updates keep the L1 pull without
+                    # touching unvisited rows. Merge duplicate rows FIRST so
+                    # a token seen k times gets the penalty once, and keep
+                    # the original g for the dense fallback below (where
+                    # _reg_grad applies L1 — no double-count).
+                    g_rule = g
+                    if isinstance(self._weight_decay, L1Decay) and \
+                            not getattr(p, "no_weight_decay", False):
+                        merged = g.merge()  # fp32 accum for low-prec grads
+                        g_rule = SelectedRows(
+                            merged.rows,
+                            merged.values + self._weight_decay.coeff
+                            * jnp.sign(p.data[merged.rows]).astype(
+                                merged.values.dtype),
+                            g.height)
+                    res = sparse_rule(g_rule, p.data, slots, lr)
                 if res is not None:
                     p.data, self._state[pid] = res
                     continue

@@ -24,8 +24,7 @@ def reshape(x, shape, name=None):
 
 
 def reshape_(x, shape, name=None):
-    x.data = jnp.reshape(x.data, _static_shape(shape))
-    return x
+    return _inplace_via_tape(_t(x), reshape(x, shape))
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
@@ -397,24 +396,28 @@ def shape(input, name=None):
     return to_tensor(_np.asarray(_t(input).data.shape, _np.int32))
 
 
+def _inplace_via_tape(t, out):
+    """Apply a traced result as an in-place update on `t`."""
+    from ..core.tensor import _rebind_inplace, inplace_guard
+    inplace_guard(t)
+    _rebind_inplace(t, out)
+    return t
+
+
 def scatter_(x, index, updates, overwrite=True, name=None):
     """In-place scatter (paddle.scatter_): x[index] = / += updates."""
     t = _t(x)
-    res = scatter(t, index, updates, overwrite=overwrite)
-    t.data = res.data
-    return t
+    return _inplace_via_tape(t, scatter(t, index, updates, overwrite=overwrite))
 
 
 def squeeze_(x, axis=None, name=None):
     t = _t(x)
-    t.data = squeeze(t, axis=axis).data
-    return t
+    return _inplace_via_tape(t, squeeze(t, axis=axis))
 
 
 def unsqueeze_(x, axis, name=None):
     t = _t(x)
-    t.data = unsqueeze(t, axis).data
-    return t
+    return _inplace_via_tape(t, unsqueeze(t, axis))
 
 
 def tolist(x):

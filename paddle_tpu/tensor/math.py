@@ -310,9 +310,11 @@ def add_n(inputs, name=None):
 
 
 def tanh_(x, name=None):
-    """In-place tanh (paddle.tanh_)."""
+    """In-place tanh (paddle.tanh_), traced through the tape."""
+    from ..core.tensor import _rebind_inplace, inplace_guard
     t = _t(x)
-    t.data = jnp.tanh(t.data)
+    inplace_guard(t, "tanh_")
+    _rebind_inplace(t, apply(jnp.tanh, t))
     return t
 
 
@@ -335,13 +337,10 @@ def heaviside(x, y, name=None):
 
 def _inplace_binary(op):
     def fn(x, y, name=None):
-        from ..core.tensor import _rebind_inplace, is_grad_enabled
+        from ..core.tensor import _rebind_inplace, inplace_guard
         t = _t(x)
-        if is_grad_enabled() and not t.stop_gradient and t._node is None:
-            raise RuntimeError(
-                "in-place op on a leaf tensor that requires grad")
-        out = op(t, y)
-        _rebind_inplace(t, out)
+        inplace_guard(t)
+        _rebind_inplace(t, op(t, y))
         return t
     return fn
 
@@ -351,22 +350,31 @@ subtract_ = _inplace_binary(lambda a, b: subtract(a, b))
 
 
 def clip_(x, min=None, max=None, name=None):
-    from ..core.tensor import _rebind_inplace, is_grad_enabled
+    from ..core.tensor import _rebind_inplace, inplace_guard
     t = _t(x)
-    if is_grad_enabled() and not t.stop_gradient and t._node is None:
-        raise RuntimeError("in-place clip_ on a leaf tensor requiring grad")
+    inplace_guard(t, "clip_")
     _rebind_inplace(t, clip(t, min=min, max=max))
     return t
 
 
-def fill_(x, value):
-    """No-grad fill (the reference's fill_ mutates storage)."""
-    t = _t(x)
-    t.data = jnp.full_like(t.data, value)
+def _overwrite_inplace(t, fill_fn, opname):
+    """fill_/zero_ overwrite the tensor with a constant: on a traced non-leaf
+    this must go through the tape (the overwrite BLOCKS upstream gradients,
+    like scatter_ overwrite); on leaves/no-grad it is a raw storage write."""
+    from ..core.tensor import _rebind_inplace, inplace_guard, is_grad_enabled
+    if is_grad_enabled() and not t.stop_gradient:
+        inplace_guard(t, opname)
+        _rebind_inplace(t, apply(fill_fn, t))
+    else:
+        t.data = fill_fn(t.data)
     return t
+
+
+def fill_(x, value):
+    t = _t(x)
+    return _overwrite_inplace(t, lambda a: jnp.full_like(a, value), "fill_")
 
 
 def zero_(x):
     t = _t(x)
-    t.data = jnp.zeros_like(t.data)
-    return t
+    return _overwrite_inplace(t, jnp.zeros_like, "zero_")

@@ -189,3 +189,163 @@ def test_setitem_no_grad_ok():
     with paddle.no_grad():
         p[0] = 2.0
     np.testing.assert_allclose(np.asarray(p.data), [2.0, 1.0, 1.0])
+
+
+# ---- round-3 advisor findings ----
+
+def test_inplace_tanh_grad_on_nonleaf():
+    """ADVICE r2 high: tanh_ on a non-leaf must contribute its Jacobian."""
+    x = Tensor(np.array([0.3, -0.7], np.float32), stop_gradient=False)
+    y = x * 2.0
+    paddle.tanh_(y)
+    z = (y * y).sum()
+    z.backward()
+    # d/dx sum(tanh(2x)^2) = 2*tanh(2x) * (1-tanh(2x)^2) * 2
+    t = np.tanh(np.array([0.6, -1.4], np.float32))
+    ref = 2.0 * t * (1.0 - t * t) * 2.0
+    np.testing.assert_allclose(np.asarray(x.grad.data), ref, rtol=1e-5)
+
+
+def test_inplace_tanh_leaf_raises():
+    x = Tensor(np.ones(2, np.float32), stop_gradient=False)
+    with pytest.raises(RuntimeError):
+        paddle.tanh_(x)
+
+
+def test_inplace_scatter_grad_on_nonleaf():
+    """scatter_ overwrite must BLOCK grad into the overwritten rows."""
+    x = Tensor(np.ones((3, 2), np.float32), stop_gradient=False)
+    y = x * 3.0
+    upd = Tensor(np.zeros((1, 2), np.float32))
+    paddle.scatter_(y, Tensor(np.array([1], np.int64)), upd)
+    y.sum().backward()
+    g = np.asarray(x.grad.data)
+    # row 1 was overwritten by a constant: no grad flows to x there
+    np.testing.assert_allclose(g[1], 0.0)
+    np.testing.assert_allclose(g[[0, 2]], 3.0)
+
+
+def test_inplace_squeeze_unsqueeze_grad():
+    x = Tensor(np.ones((2, 1, 3), np.float32), stop_gradient=False)
+    y = x * 5.0
+    paddle.squeeze_(y, axis=1)
+    assert tuple(y.shape) == (2, 3)
+    (y * 2.0).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.data), 10.0)
+
+
+def test_spectral_norm_zero_power_iterations():
+    """ADVICE r2 low: n_power_iterations=0 must reuse stored u, not crash."""
+    from paddle_tpu.nn.utils import spectral_norm
+    lin = paddle.nn.Linear(4, 3)
+    spectral_norm(lin, n_power_iterations=0)
+    out = lin(Tensor(np.ones((2, 4), np.float32)))
+    assert np.isfinite(np.asarray(out.data)).all()
+
+
+def test_l1decay_applies_to_sparse_grads():
+    """ADVICE r2 low: L1 regularization must not be skipped on the
+    SelectedRows fast path."""
+    from paddle_tpu.regularizer import L1Decay
+    emb = paddle.nn.Embedding(8, 4, sparse=True)
+    w0 = np.asarray(emb.weight.data).copy()
+    opt2 = paddle.optimizer.SGD(learning_rate=1.0,
+                                parameters=emb.parameters(),
+                                weight_decay=L1Decay(0.5))
+    ids = Tensor(np.array([2, 5], np.int64))
+    emb(ids).sum().backward()
+    assert "SelectedRows" in type(emb.weight.grad).__name__
+    opt2.step()
+    w1 = np.asarray(emb.weight.data)
+    # touched rows: grad 1.0 + 0.5*sign(w); untouched rows unchanged
+    exp = w0[2] - (1.0 + 0.5 * np.sign(w0[2]))
+    np.testing.assert_allclose(w1[2], exp, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(w1[0], w0[0])
+
+
+def test_dynamic_batch_nonbatched_output_raises():
+    """ADVICE r2 low: chunked dynamic batch + reduction output must raise,
+    not silently return the first chunk's value."""
+    import tempfile, os
+    from paddle_tpu.inference import export_model, load_predictor
+
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(4, 2)
+
+        def forward(self, x):
+            o = self.lin(x)
+            return o.mean()  # batch reduction → non-batched output
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m")
+        export_model(M(), [Tensor(np.ones((2, 4), np.float32))], path)
+        pred = load_predictor(path)
+        with pytest.raises(ValueError, match="non-batched"):
+            pred.run([np.ones((5, 4), np.float32)])
+
+
+def test_l1decay_sparse_duplicate_rows_single_penalty():
+    """A token seen k times must get the L1 penalty once, not k times."""
+    from paddle_tpu.regularizer import L1Decay
+    emb = paddle.nn.Embedding(8, 4, sparse=True)
+    w0 = np.asarray(emb.weight.data).copy()
+    opt = paddle.optimizer.SGD(learning_rate=1.0,
+                               parameters=emb.parameters(),
+                               weight_decay=L1Decay(0.5))
+    emb(Tensor(np.array([2, 2], np.int64))).sum().backward()
+    opt.step()
+    w1 = np.asarray(emb.weight.data)
+    # grad 2.0 (row hit twice) + ONE L1 pull
+    exp = w0[2] - (2.0 + 0.5 * np.sign(w0[2]))
+    np.testing.assert_allclose(w1[2], exp, rtol=1e-5, atol=1e-6)
+
+
+def test_l1decay_sparse_adam_nonlazy_no_double_penalty():
+    """Adam lazy_mode=False declines the sparse rule → densify path must
+    apply L1 exactly once (not once folded + once in _reg_grad)."""
+    from paddle_tpu.regularizer import L1Decay
+    emb = paddle.nn.Embedding(6, 3, sparse=True)
+    w0 = np.asarray(emb.weight.data).copy()
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=emb.parameters(),
+                                weight_decay=L1Decay(0.5))
+    emb(Tensor(np.array([1], np.int64))).sum().backward()
+    opt.step()
+    w1 = np.asarray(emb.weight.data)
+    # dense-path reference: g = onehot + 0.5*sign(w) everywhere, one step of Adam
+    g = np.zeros_like(w0)
+    g[1] = 1.0
+    g = g + 0.5 * np.sign(w0)
+    m1 = 0.1 * g
+    m2 = 0.001 * g * g
+    upd = (m1 / (1 - 0.9)) / (np.sqrt(m2 / (1 - 0.999)) + 1e-8)
+    exp = w0 - 0.1 * upd
+    np.testing.assert_allclose(w1, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_inplace_reshape_grad_on_nonleaf():
+    x = Tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+    y = x * 2.0
+    paddle.reshape_(y, [6])
+    (y * 3.0).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.data), 6.0)
+
+
+def test_inplace_zero_blocks_grad_on_nonleaf():
+    x = Tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = x * 3.0
+    paddle.zero_(y)
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.data), 0.0)
+    np.testing.assert_allclose(np.asarray(y.data), 0.0)
+
+
+def test_inplace_fill_no_grad_on_leaf_ok():
+    p = Tensor(np.ones(3, np.float32), stop_gradient=False)
+    with paddle.no_grad():
+        paddle.fill_(p, 7.0)
+    np.testing.assert_allclose(np.asarray(p.data), 7.0)
+    with pytest.raises(RuntimeError):
+        paddle.fill_(p, 1.0)  # leaf requiring grad outside no_grad
