@@ -144,7 +144,7 @@ class Tensor:
     """
 
     __slots__ = ("data", "stop_gradient", "grad", "name", "_node", "_out_index",
-                 "persistable", "__weakref__")
+                 "persistable", "_hooks", "__weakref__")
 
     def __init__(self, data, dtype=None, place: Optional[Place] = None,
                  stop_gradient: bool = True, name: Optional[str] = None):
@@ -155,6 +155,7 @@ class Tensor:
         self.persistable = False
         self._node: Optional[_Node] = None
         self._out_index: int = 0
+        self._hooks = None  # OrderedDict[int, hook] once register_hook called
 
     # ---- metadata ----
     @property
@@ -229,6 +230,24 @@ class Tensor:
     def backward(self, grad_tensor: Optional["Tensor"] = None,
                  retain_graph: bool = False):
         backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        """Register a backward hook fired when this tensor's gradient is
+        computed (reference imperative/hooks.h; VarBase::AddVariableWrapperHook).
+        hook(grad: Tensor) -> Tensor | None; a returned Tensor replaces the
+        gradient flowing upstream (non-leaf) / accumulated into .grad (leaf).
+        Hooks run in registration order, each seeing the previous result.
+        Returns a removable helper (.remove())."""
+        if self.stop_gradient:
+            raise RuntimeError(
+                "cannot register a gradient hook on a tensor with "
+                "stop_gradient=True (reference hooks require a grad var)")
+        if self._hooks is None:
+            from collections import OrderedDict
+            self._hooks = OrderedDict()
+        hid = (max(self._hooks) + 1) if self._hooks else 0
+        self._hooks[hid] = hook
+        return _TensorHookRemover(self, hid)
 
     def clear_grad(self):
         self.grad = None
@@ -474,6 +493,50 @@ def _second_order_vjp(node, cotangents):
     return outs if isinstance(outs, tuple) else (outs,)
 
 
+class _TensorHookRemover:
+    def __init__(self, t: "Tensor", hid: int):
+        import weakref
+        self._ref, self._hid = weakref.ref(t), hid  # don't pin the tensor
+        # (or its tape) just because a remover handle is retained
+
+    def remove(self):
+        t = self._ref()
+        if t is not None and t._hooks is not None:
+            t._hooks.pop(self._hid, None)
+
+
+def _add_grads(a, b):
+    """Sum two gradient contributions of any flavor (array/Tensor/
+    SelectedRows) — the leaf-hook buffer's accumulator."""
+    from .selected_rows import SelectedRows
+    if isinstance(a, SelectedRows) and isinstance(b, SelectedRows):
+        return a.merge(b)
+    if isinstance(a, SelectedRows):
+        a = a.to_dense()
+    if isinstance(b, SelectedRows):
+        b = b.to_dense()
+    if isinstance(a, Tensor) or isinstance(b, Tensor):
+        a = a if isinstance(a, Tensor) else Tensor(a)
+        b = b if isinstance(b, Tensor) else Tensor(b)
+    return a + b
+
+
+def _run_tensor_hooks(t: "Tensor", g):
+    """Fold a tensor's hooks over a flowing gradient. g may be a raw array,
+    a Tensor (create_graph), or a SelectedRows (densified for the hook)."""
+    from .selected_rows import SelectedRows
+    was_raw = not isinstance(g, Tensor)
+    if isinstance(g, SelectedRows):
+        g = g.to_dense()
+    for hook in list(t._hooks.values()):
+        out = hook(g if isinstance(g, Tensor) else Tensor(g))
+        if out is not None:
+            g = out
+    if was_raw and isinstance(g, Tensor):
+        return g.data
+    return g
+
+
 def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
              retain_graph: bool = False, only_ids: Optional[set] = None,
              capture_ids: Optional[set] = None, create_graph: bool = False):
@@ -493,6 +556,8 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
     if loss._node is None:
         if not loss.stop_gradient and (only_ids is None
                                        or id(loss) in only_ids):
+            if loss._hooks:
+                seed = _run_tensor_hooks(loss, seed)
             loss._accumulate_grad(seed)
         return
     if loss._node.vjp_fn is None:
@@ -500,28 +565,41 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
     loss._node.seed(loss._out_index, seed)
 
     nodes = _reachable_nodes([loss._node])
+    hook_buf: dict = {}  # id(leaf) -> [leaf, summed contributions]: leaf
+    # hooks fire ONCE on the total gradient of this sweep, not per consumer
     try:
-        _sweep(nodes, only_ids, capture_ids, create_graph)
+        _sweep(nodes, only_ids, capture_ids, create_graph, hook_buf)
     except BaseException:
         # leave no stale seeds behind: a caught-and-retried backward on
         # the same graph must not double-accumulate
         for node in nodes:
             node.out_grads = [None] * len(node.outputs)
         raise
+    for t, g in hook_buf.values():
+        t._accumulate_grad(_run_tensor_hooks(t, g))
     if not (retain_graph or create_graph):
         for node in nodes:
             node.vjp_fn = None  # free residuals; second backward is a no-op
             node.fn_info = None  # and the primal snapshots/closures
 
 
-def _sweep(nodes, only_ids, capture_ids, create_graph):
+def _sweep(nodes, only_ids, capture_ids, create_graph, hook_buf=None):
     for node in nodes:
         if node.vjp_fn is None or all(g is None for g in node.out_grads):
             continue
+        seeded = [g is not None for g in node.out_grads]
         cotangents = tuple(
             g if g is not None else jnp.zeros_like(t.data)
             for g, t in zip(node.out_grads, node.outputs)
         )
+        # non-leaf hooks: by reverse-seq order every consumer has seeded by
+        # now, so the cotangent is final — fire before capture and the vjp.
+        # Outputs that received NO cotangent (unused siblings of a multi-
+        # output node) keep their zero-fill: their hooks must not fire.
+        if any(t._hooks and s for t, s in zip(node.outputs, seeded)):
+            cotangents = tuple(
+                _run_tensor_hooks(t, g) if (t._hooks and s) else g
+                for t, g, s in zip(node.outputs, cotangents, seeded))
         if capture_ids:
             for t, g in zip(node.outputs, cotangents):
                 if id(t) in capture_ids:
@@ -545,7 +623,12 @@ def _sweep(nodes, only_ids, capture_ids, create_graph):
             if pnode is not None and pnode.vjp_fn is not None:
                 pnode.seed(pidx, g)
             elif only_ids is None or id(inp) in only_ids:
-                inp._accumulate_grad(g)
+                if inp._hooks and hook_buf is not None:
+                    # bank: leaf hooks see the SUM over consumers
+                    ent = hook_buf.setdefault(id(inp), [inp, None])
+                    ent[1] = g if ent[1] is None else _add_grads(ent[1], g)
+                else:
+                    inp._accumulate_grad(g)
         node.out_grads = [None] * len(node.outputs)
 
 

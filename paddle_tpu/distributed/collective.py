@@ -425,7 +425,7 @@ def _c_softmax_with_cross_entropy(logits, label, group=None,
         from ..nn.functional.loss import softmax_with_cross_entropy
         return softmax_with_cross_entropy(lg, lb, ignore_index=ignore_index)
 
-    def f(a, y):
+    def _fwd_math(a, y):
         n_shard = a.shape[-1]
         idx = lax.axis_index(ax)
         vocab_start = idx * n_shard
@@ -446,8 +446,35 @@ def _c_softmax_with_cross_entropy(logits, label, group=None,
         target_logit = lax.psum(local_logit, ax)
         loss = logz[..., 0] - target_logit
         loss = jnp.where(yy == ignore_index, 0.0, loss)
-        return loss[..., None] if squeeze else loss
+        out = loss[..., None] if squeeze else loss
+        return out, (a, logz, safe, in_range, yy)
 
+    # Analytic gradient (c_softmax_with_cross_entropy_op.cu bwd):
+    # d a_local = (softmax_local - onehot_local) * g. Hand-written because
+    # under shard_map(check_vma=False) AD transposes raw psum to psum,
+    # double-counting already-replicated cotangents.
+    @jax.custom_vjp
+    def f(a, y):
+        return _fwd_math(a, y)[0]
+
+    def f_fwd(a, y):
+        out, res = _fwd_math(a, y)
+        return out, res
+
+    def f_bwd(res, g):
+        a, logz, safe, in_range, yy = res
+        squeeze = g.ndim == a.ndim  # out was loss[..., None]
+        gg = g[..., 0] if squeeze else g
+        gg = jnp.where(yy == ignore_index, 0.0, gg).astype(jnp.float32)
+        a32 = a.astype(jnp.float32)
+        p = jnp.exp(a32 - logz)  # local softmax shard
+        da = p * gg[..., None]
+        sub = jnp.where(in_range, gg, 0.0)
+        da = da - jax.nn.one_hot(safe, a32.shape[-1],
+                                 dtype=jnp.float32) * sub[..., None]
+        return da.astype(a.dtype), None
+
+    f.defvjp(f_fwd, f_bwd)
     return apply(f, lg, lb)
 
 

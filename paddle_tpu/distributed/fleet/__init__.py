@@ -79,14 +79,21 @@ class Fleet:
         pp = max(hc.pp_degree, 1)
         sharding = max(hc.sharding_degree, 1)
         sep = max(getattr(hc, "sep_degree", 1), 1)
+        ep = max(getattr(hc, "ep_degree", 1), 1)
         if dp == -1 or dp is None:
-            dp = max(n_dev // (mp_deg * pp * sharding * sep), 1)
+            dp = max(n_dev // (mp_deg * pp * sharding * sep * ep), 1)
             hc.dp_degree = dp
         names = ["data", "pipe", "sharding", "model"]
         dims = [dp, pp, sharding, mp_deg]
         if sep > 1:  # parity-plus sequence/context-parallel axis
             names.insert(3, "sep")
             dims.insert(3, sep)
+        if ep > 1:   # parity-plus expert-parallel axis: experts shard over
+            # `ep`, tokens data-shard over it (GShard all_to_all emerges
+            # from GSPMD; reference has only the alltoall primitive,
+            # collective.py:1456)
+            names.insert(3, "ep")
+            dims.insert(3, ep)
         topo = CommunicateTopology(names, dims)
         self._hcg = HybridCommunicateGroup(topo)
         set_hybrid_communicate_group(self._hcg)

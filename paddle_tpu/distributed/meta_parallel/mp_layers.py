@@ -59,7 +59,11 @@ class VocabParallelEmbedding(Layer):
 
     def forward(self, x):
         if _explicit_tp():
-            # explicit mode: weight tensor holds the local shard inside shard_map
+            # explicit mode: weight tensor holds the local shard inside
+            # shard_map. The reduce goes through _mp_allreduce (custom-vjp:
+            # fwd psum, bwd identity) — a raw lax.psum here would transpose
+            # to another psum under check_vma=False and double-count the
+            # replicated cotangent.
             def f(ids, w):
                 from jax import lax
                 n_shard = w.shape[0]
@@ -69,10 +73,9 @@ class VocabParallelEmbedding(Layer):
                 in_range = (local >= 0) & (local < n_shard)
                 safe = jnp.clip(local, 0, n_shard - 1)
                 out = jnp.take(w, safe, axis=0)
-                out = jnp.where(in_range[..., None], out, 0.0)
-                return lax.psum(out, MODEL_AXIS)
+                return jnp.where(in_range[..., None], out, 0.0)
 
-            return apply(f, x, self.weight)
+            return _mp_allreduce(apply(f, x, self.weight), group=MODEL_AXIS)
         return F.embedding(x, self.weight)
 
 

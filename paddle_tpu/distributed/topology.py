@@ -114,6 +114,15 @@ class HybridCommunicateGroup:
         self._sharding_group = _AxisGroup("sharding", topology,
                                           self.global_rank)
         self._mp_group = _AxisGroup("model", topology, self.global_rank)
+        # parity-plus axes (absent from the reference topology.py:36): expert
+        # parallel (alltoall primitive, reference collective.py:1456) and
+        # sequence parallel
+        names = topology.get_hybrid_group_names()
+        self._ep_degree = topology.get_dim("ep") if "ep" in names else 1
+        self._ep_rank = getattr(coord, "ep", 0) if "ep" in names else 0
+        self._ep_group = (_AxisGroup("ep", topology, self.global_rank)
+                          if "ep" in names else None)
+        self._sep_degree = topology.get_dim("sep") if "sep" in names else 1
 
     # parallel mode dispatch (fleet_base distributed_model uses this)
     def get_parallel_mode(self):
@@ -189,6 +198,16 @@ class HybridCommunicateGroup:
 
     def get_sharding_parallel_group_src_rank(self):
         return self._sharding_group.ranks[0]
+
+    # expert parallel (parity-plus)
+    def get_expert_parallel_rank(self):
+        return self._ep_rank
+
+    def get_expert_parallel_world_size(self):
+        return self._ep_degree
+
+    def get_expert_parallel_group(self):
+        return self._ep_group
 
     # p2p neighbours (reference _build_p2p_lists:173)
     def get_p2p_groups(self):

@@ -252,25 +252,36 @@ class Predictor:
             outs = list(out) if isinstance(out, (list, tuple)) else [out]
             if chunks_out is None:
                 # an output rides the batch iff its leading dim is exp_b.
-                # A non-batched output (reduction/scalar head) cannot be
-                # stitched back from chunks, and a padded chunk would fold
-                # zero rows into it — refuse rather than return garbage.
-                # (Reaching here implies chunking or padding: exp_b is only
-                # set when got_b != exported batch.)
+                # A non-batched output is kept ONLY if it is chunk-invariant
+                # (a constant/state table); a batch reduction varies across
+                # chunks (or folds zero-padding rows) and cannot be
+                # reassembled — raise rather than return garbage.
                 batched_out = [hasattr(o, "ndim") and o.ndim > 0
                                and o.shape[0] == exp_b for o in outs]
-                if not all(batched_out):
+                if not all(batched_out) and n_chunks == 1:
+                    # single padded chunk: invariance is unobservable, and a
+                    # reduction would include the padding rows
                     raise ValueError(
-                        "Predictor dynamic-batch chunking got a non-batched "
-                        f"output (shapes {[getattr(o, 'shape', ()) for o in outs]}, "
-                        f"exported batch {exp_b}, got {got_b}): reductions "
-                        "over the batch cannot be reassembled from chunks. "
-                        "Run with the exported batch size, or re-export with "
-                        "a batch-shaped output.")
-                chunks_out = [[o[: hi - lo]] for o in outs]
+                        "Predictor got batch "
+                        f"{got_b} < exported batch {exp_b} with a "
+                        "non-batched output: a batch reduction would fold "
+                        "the zero-padding rows. Run with the exported "
+                        "batch size or re-export with a batch-shaped "
+                        "output.")
+                chunks_out = [[o[: hi - lo]] if b else [o]
+                              for o, b in zip(outs, batched_out)]
             else:
-                for acc, o in zip(chunks_out, outs):
-                    acc.append(o[: hi - lo])
+                for acc, o, b in zip(chunks_out, outs, batched_out):
+                    if b:
+                        acc.append(o[: hi - lo])
+                    elif not jnp.array_equal(acc[0], o):
+                        raise ValueError(
+                            "Predictor dynamic-batch chunking: a "
+                            "non-batched output differs across chunks "
+                            "(a batch reduction, not a constant) and "
+                            "cannot be reassembled. Run with the exported "
+                            "batch size or re-export with a batch-shaped "
+                            "output.")
         return [jnp.concatenate(parts, axis=0) if len(parts) > 1
                 else parts[0] for parts in chunks_out]
 

@@ -190,10 +190,13 @@ class GPTForCausalLM(Layer):
         super().__init__()
         self.config = config
         self.gpt = GPTModel(config)
+        # gather_output=False pairs the explicit-TP vocab-sharded logits with
+        # ParallelCrossEntropy's sharded softmax-CE (mp_layers.py:249); the
+        # GSPMD path ignores the flag.
         self.lm_head = ColumnParallelLinear(config.hidden_size,
                                             config.vocab_size,
                                             has_bias=False,
-                                            gather_output=True)
+                                            gather_output=False)
         self.loss_fn = ParallelCrossEntropy()
 
     def forward(self, input_ids, labels=None):
@@ -236,6 +239,26 @@ class GPTForCausalLM(Layer):
         # [B,S]->[N] reshape would force GSPMD to regather the tokens)
         return (not _explicit_tp() and _mp_degree() <= 1
                 and not sequence_sharded_trace())
+
+    # ---- pipeline-parallel segmentation protocol (pp_layers.py:44-76) ----
+    def pipe_layer_prefixes(self):
+        return [f"gpt.layers.{i}." for i in range(len(self.gpt.layers))]
+
+    def pipe_layers(self):
+        return list(self.gpt.layers)
+
+    def pipe_embed(self, input_ids):
+        from ..tensor.creation import arange
+        pos = arange(input_ids.shape[1], dtype="int64")
+        return self.gpt.word_embeddings(input_ids) + \
+            self.gpt.position_embeddings(pos)
+
+    def pipe_logits(self, hidden):
+        return self.lm_head(self.gpt.final_norm(hidden))
+
+    def pipe_head(self, hidden, labels):
+        from ..tensor.math import mean
+        return mean(self.loss_fn(self.pipe_logits(hidden), labels))
 
     @classmethod
     def from_preset(cls, name: str, **overrides):

@@ -222,10 +222,13 @@ class LlamaForCausalLM(Layer):
         super().__init__()
         self.config = config
         self.llama = LlamaModel(config)
+        # gather_output=False: under explicit TP the vocab-sharded logits
+        # feed ParallelCrossEntropy's sharded softmax-CE directly (Megatron
+        # pairing; mp_layers.py:249). The GSPMD path ignores the flag.
         self.lm_head = ColumnParallelLinear(config.hidden_size,
                                             config.vocab_size,
                                             has_bias=False,
-                                            gather_output=True)
+                                            gather_output=False)
         self.loss_fn = ParallelCrossEntropy()
 
     def forward(self, input_ids, labels=None):
@@ -236,6 +239,26 @@ class LlamaForCausalLM(Layer):
             from ..tensor.math import mean
             return mean(loss)
         return logits
+
+    # ---- pipeline-parallel segmentation protocol ----
+    # (the LayerDesc/SharedLayerDesc contract of reference pp_layers.py:44-76,
+    # expressed as embed/layers/head callables for the 1F1B stage scan)
+    def pipe_layer_prefixes(self):
+        return [f"llama.layers.{i}."
+                for i in range(len(self.llama.layers))]
+
+    def pipe_layers(self):
+        return list(self.llama.layers)
+
+    def pipe_embed(self, input_ids):
+        return self.llama.embed_tokens(input_ids)
+
+    def pipe_logits(self, hidden):
+        return self.lm_head(self.llama.norm(hidden))
+
+    def pipe_head(self, hidden, labels):
+        from ..tensor.math import mean
+        return mean(self.loss_fn(self.pipe_logits(hidden), labels))
 
     @classmethod
     def from_preset(cls, name: str, **overrides):

@@ -87,7 +87,10 @@ def _zero_spec(base: P, shape, mesh: Mesh, axis="sharding",
 
 
 def _batch_axes(mesh: Mesh):
-    axes = [ax for ax in ("data", "sharding") if ax in mesh.axis_names
+    """Axes the global batch shards over. `ep` counts: expert parallelism is
+    data-parallel in the token dim (each ep rank holds different tokens, the
+    expert einsum's [E,...] resharding is the GShard all_to_all)."""
+    axes = [ax for ax in ("data", "sharding", "ep") if ax in mesh.axis_names
             and mesh.shape[ax] > 1]
     if not axes:
         return None
@@ -465,14 +468,15 @@ def parallelize(model: Layer, optimizer=None, mesh: Optional[Mesh] = None,
     plan = StrategyCompiler().compile(strategy, optimizer, mesh)
     if plan.pipeline or ("pipe" in mesh.axis_names
                          and mesh.shape["pipe"] > 1):
-        from .pipeline import PipelinedTrainStep
-        if not (hasattr(model, "llama") or hasattr(model, "gpt")):
+        from .pipeline import PipelinedTrainStep, is_pipeline_stackable
+        if not is_pipeline_stackable(model):
             raise ValueError(
-                "pp_degree > 1 requires a pipeline-stackable decoder LM "
-                f"(Llama/GPT families); {type(model).__name__} has no "
-                "stackable decoder layers. Set pp_degree=1 (the model then "
-                "trains under ShardedTrainStep) or adapt the model to the "
-                "PipelinedTrainStep layer/embed/head protocol")
+                "pp_degree > 1 requires a pipeline-stackable model: "
+                f"{type(model).__name__} does not implement the pipe_* "
+                "segmentation protocol (pipe_layer_prefixes/pipe_layers/"
+                "pipe_embed/pipe_head — reference pp_layers.py LayerDesc "
+                "analog; Llama/GPT families implement it). Set pp_degree=1 "
+                "to train under ShardedTrainStep instead")
         n_micro = 4
         if strategy is not None:
             cfg = getattr(strategy, "pipeline_configs", None)
@@ -484,15 +488,11 @@ def parallelize(model: Layer, optimizer=None, mesh: Optional[Mesh] = None,
                     "pp x ZeRO composes as optimizer-state sharding "
                     "(stage-1 semantics): gradients stay replicated across "
                     "the sharding axis on the pipeline path", stacklevel=2)
-        if loss_fn is not None:
-            raise ValueError(
-                "parallelize(pp_degree>1) pipelines causal-LM models with "
-                "their built-in loss head; custom loss_fn is not supported "
-                "on the pipeline path yet")
         return PipelinedTrainStep(model, plan.optimizer or optimizer, mesh,
                                   n_micro=n_micro,
                                   zero_stage=plan.zero_stage,
-                                  min_shard_numel=plan.zero_min_numel)
+                                  min_shard_numel=plan.zero_min_numel,
+                                  amp_cfg=plan.amp, loss_fn=loss_fn)
     if plan.localsgd_k:
         from .localsgd import LocalSGDTrainStep
         return LocalSGDTrainStep(model, plan.optimizer or optimizer, mesh,

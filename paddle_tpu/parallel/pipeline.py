@@ -34,6 +34,7 @@ Two schedules:
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Callable, Dict, List
 
 import jax
@@ -42,6 +43,22 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 PIPE_AXIS = "pipe"
+MODEL_AXIS = "model"
+
+
+def is_pipeline_stackable(model) -> bool:
+    """The segmentation protocol (reference pp_layers.py:44-76 LayerDesc /
+    SharedLayerDesc, recast TPU-first): a model trains under the 1F1B stage
+    scan iff it provides
+      pipe_layer_prefixes() -> [param-name prefix per decoder layer]
+      pipe_layers()         -> [Layer]  (homogeneous; layer(x) -> x or (x, aux))
+      pipe_embed(ids)       -> hidden Tensor
+      pipe_head(hidden, labels) -> scalar loss Tensor
+      pipe_logits(hidden)   -> logits Tensor   (optional: custom loss_fn)
+    """
+    return all(hasattr(model, m) for m in
+               ("pipe_layer_prefixes", "pipe_layers", "pipe_embed",
+                "pipe_head"))
 
 
 def make_stage_fn(layer_fn: Callable, remat: bool = True):
@@ -227,19 +244,35 @@ def run_1f1b(stage_fn: Callable, embed_fn: Callable, head_loss_fn: Callable,
 
 
 class PipelinedTrainStep:
-    """1F1B pipeline training for decoder-LM models (Llama/GPT families).
+    """1F1B pipeline training for pipeline-stackable models (the pipe_*
+    protocol; Llama/GPT implement it, any homogeneous decoder LM can).
 
     The decoder stack is stage-sharded over the `pipe` mesh axis; embedding
-    and head params are replicated but their grads are produced on exactly one
-    stage each and psum-replicated (tied weights accumulate both). Composes
-    with data parallelism: when the mesh has `data`/`sharding` axes, the batch
-    is sharded over them and grads are averaged across. Tensor parallelism
-    inside a stage is not composed here yet — use ShardedTrainStep for tp.
+    and head params are replicated (or TP-sharded) but their grads are
+    produced on exactly one stage each and psum-replicated (tied weights
+    accumulate both). Composes with
+    - data parallelism: batch sharded over `data`/`sharding`, grads pmean'd;
+    - tensor parallelism: when the mesh has a `model` axis, stage segments
+      execute the mp_layers explicit-collective path inside the pipe
+      shard_map (reference pipeline_parallel.py:151 running
+      ColumnParallelLinear -> _c_identity inside a stage);
+    - AMP: plan.amp drives autocast in the stage fns plus fp16 dynamic loss
+      scaling folded into the tick loop (hybrid_parallel_gradscaler analog).
     """
 
     def __init__(self, model, optimizer, mesh: Mesh, n_micro: int = 4,
                  remat: bool = True, zero_stage: int = 0,
-                 min_shard_numel: int = 1024):
+                 min_shard_numel: int = 1024, amp_cfg=None, loss_fn=None):
+        if not is_pipeline_stackable(model):
+            raise ValueError(
+                f"{type(model).__name__} does not implement the pipeline "
+                "segmentation protocol (pipe_layer_prefixes/pipe_layers/"
+                "pipe_embed/pipe_head); see pipeline.is_pipeline_stackable")
+        if loss_fn is not None and not hasattr(model, "pipe_logits"):
+            raise ValueError(
+                "custom loss_fn under pp requires the model to implement "
+                "pipe_logits(hidden) so the head can be re-formed as "
+                "loss_fn(pipe_logits(h), labels)")
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
@@ -247,6 +280,30 @@ class PipelinedTrainStep:
         self.n_stages = mesh.shape[PIPE_AXIS]
         self.zero_stage = zero_stage
         self._step_count = 0
+        self._loss_fn = loss_fn
+        self._mp_n = mesh.shape.get(MODEL_AXIS, 1)
+        self._amp_cfg = amp_cfg
+        use_scaler = bool(amp_cfg is not None
+                          and amp_cfg.dtype == "float16"
+                          and amp_cfg.use_dynamic_loss_scaling)
+        self._use_scaler = use_scaler
+
+        if mesh.shape.get("ep", 1) > 1:
+            raise NotImplementedError(
+                "pp x ep is not composed: inside the pipe shard_map the "
+                "stage fns issue no ep collectives, so expert-sharded "
+                "weights would silently compute on a fraction of the "
+                "experts. Train MoE models with ShardedTrainStep "
+                "(ep_degree without pp_degree)")
+        if self._mp_n > 1:
+            from ..optimizer.optimizer import Lamb, LarsMomentum
+            if isinstance(optimizer, (Lamb, LarsMomentum)):
+                import warnings
+                warnings.warn(
+                    "pp x tp runs optimizer rules on model-axis weight "
+                    "shards: Lamb/LarsMomentum trust ratios would use "
+                    "per-shard norms, silently changing the algorithm",
+                    stacklevel=3)
 
         # --- split params: per-layer decoder params vs the rest ---
         params, buffers = model.functional_state()
@@ -274,6 +331,47 @@ class PipelinedTrainStep:
         stacked = stack_stage_params(per_layer, self.n_stages)
         rest = {k: v for k, v in params.items()
                 if not any(k.startswith(p) for p in layer_prefixes)}
+
+        # --- TP layout: mp_layers' partition_specs over the `model` axis ---
+        # Stacked leaves prepend (pipe, scan) dims to the per-param spec; the
+        # shard_map hands each device its (stage, tp) shard and the stage fns
+        # run the explicit-collective mp_layers path (axis_context below).
+        from .api import _param_spec
+        named_params = dict(model.named_parameters())
+        pfx0 = layer_prefixes[0]
+
+        def _full_spec(base: P, ndim: int, lead=()):
+            ax = list(lead) + list(base)
+            ax += [None] * (ndim - len(ax))
+            return P(*ax)
+
+        stacked_specs = {
+            k: _full_spec(_param_spec(named_params[pfx0 + k], mesh),
+                          stacked[k].ndim, (PIPE_AXIS, None))
+            for k in stacked}
+        rest_specs = {
+            k: _full_spec(_param_spec(named_params[k], mesh), rest[k].ndim)
+            for k in rest}
+
+        def _has_model_axis(spec: P) -> bool:
+            for ax in spec:
+                axes = ax if isinstance(ax, tuple) else (ax,)
+                if MODEL_AXIS in axes:
+                    return True
+            return False
+
+        stacked_tp = {k: _has_model_axis(s) for k, s in stacked_specs.items()}
+        rest_tp = {k: _has_model_axis(s) for k, s in rest_specs.items()}
+
+        def _local_shape(shape, spec):
+            """Per-device shard shape under `spec` (shard_map view)."""
+            out = list(shape)
+            for d, ax in enumerate(spec):
+                if ax is None:
+                    continue
+                for a in (ax if isinstance(ax, tuple) else (ax,)):
+                    out[d] //= mesh.shape[a]
+            return tuple(out)
 
         opt_all = optimizer.init_state(
             {**rest, **{f"__stack__{k}": v for k, v in stacked.items()}})
@@ -304,10 +402,16 @@ class PipelinedTrainStep:
         self._use_zero = use_zero
         import numpy as np
 
-        def _zdim(local_shape, first_dim):
+        def _zdim(local_shape, first_dim, spec):
+            """Pick the slot-sharding dim on the LOCAL (post-TP) shard; only
+            dims the param spec leaves unsharded are eligible, so the slot
+            spec can stack `sharding` there without colliding with `model`."""
             if int(np.prod(local_shape)) < min_shard_numel:
                 return None
+            spec_l = list(spec) + [None] * (len(local_shape) - len(spec))
             for d in range(first_dim, len(local_shape)):
+                if spec_l[d] is not None:
+                    continue
                 if local_shape[d] % sh_n == 0 and local_shape[d] >= sh_n:
                     return d
             return None
@@ -316,9 +420,11 @@ class PipelinedTrainStep:
         # pipe-sliced size-1 dim 0, then the scan dim 1, then param dims)
         if use_zero:
             for k, v in rest.items():
-                zdim[k] = _zdim(v.shape, 0)
+                zdim[k] = _zdim(_local_shape(v.shape, rest_specs[k]), 0,
+                                rest_specs[k])
             for k, v in stacked.items():
-                d = _zdim(v.shape[1:], 1)  # local = global[1:]; skip scan dim
+                loc = _local_shape(v.shape, stacked_specs[k])
+                d = _zdim(loc[1:], 1, list(stacked_specs[k])[1:])
                 zdim[f"__stack__{k}"] = None if d is None else d + 1
         wd_zero = (float(optimizer._weight_decay)
                    if not callable(optimizer._weight_decay) else 0.0)
@@ -377,19 +483,25 @@ class PipelinedTrainStep:
         grad_clip = getattr(optimizer, "_grad_clip", None)
         use_pipe_clip = isinstance(grad_clip, ClipGradByGlobalNorm)
 
+        mp_n = self._mp_n
+        use_scaler = self._use_scaler
+
         def pipe_global_norm_clip(g_stacked, g_rest):
             """Global-norm clip whose norm spans ALL stages: the stacked
             grads are pipe-local slices, so their squared norm is psum'd over
             the pipe axis; rest grads are pipe-replicated and counted once.
-            Without this, each rank clips by a different norm and the
-            replicated params silently diverge."""
-            sq_stacked = sum(
-                jnp.sum(jnp.square(g.astype(jnp.float32)))
-                for g in jax.tree_util.tree_leaves(g_stacked))
+            TP-sharded leaves hold model-axis shards, so their squared norm
+            is additionally psum'd over `model` (HybridParallelClipGrad:32's
+            cross-mp allreduce of the norm). Without this, each rank clips by
+            a different norm and the replicated params silently diverge."""
+            def leaf_sq(g, tp):
+                sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+                return lax.psum(sq, MODEL_AXIS) if (tp and mp_n > 1) else sq
+
+            sq_stacked = sum(leaf_sq(g, stacked_tp[k])
+                             for k, g in g_stacked.items())
             sq_stacked = lax.psum(sq_stacked, PIPE_AXIS)
-            sq_rest = sum(
-                jnp.sum(jnp.square(g.astype(jnp.float32)))
-                for g in jax.tree_util.tree_leaves(g_rest))
+            sq_rest = sum(leaf_sq(g, rest_tp[k]) for k, g in g_rest.items())
             gnorm = jnp.sqrt(sq_stacked + sq_rest)
             c = grad_clip.clip_norm
             factor = jnp.minimum(c / jnp.maximum(gnorm, c), 1.0)
@@ -397,17 +509,26 @@ class PipelinedTrainStep:
             return (jax.tree_util.tree_map(scale, g_stacked),
                     jax.tree_util.tree_map(scale, g_rest))
 
-        def train_step(stacked_, rest_, opt_state, lr, step, arrays):
+        def train_step(stacked_, rest_, opt_state, extras_, lr, step, arrays):
             ids, labels = arrays
             B = ids.shape[0]
             mb = B // n_micro_
             ids_mb = ids.reshape((n_micro_, mb) + ids.shape[1:])
             labels_mb = labels.reshape((n_micro_, mb) + labels.shape[1:])
             local = jax.tree_util.tree_map(lambda a: a[0], stacked_)
+            scale = extras_.get("loss_scale", jnp.float32(1.0))
+            head = ((lambda r, h, y: head_fn(r, h, y) * scale)
+                    if use_scaler else head_fn)
             loss, d_local, g_rest = run_1f1b(
-                stage_fn, embed_fn, head_fn, local, rest_, ids_mb, labels_mb,
+                stage_fn, embed_fn, head, local, rest_, ids_mb, labels_mb,
                 n_micro_, n_stages_)
             g_stacked = jax.tree_util.tree_map(lambda g: g[None], d_local)
+            if use_scaler:
+                loss = loss / scale
+                unscale = lambda g: (g.astype(jnp.float32) / scale).astype(
+                    g.dtype)
+                g_stacked = jax.tree_util.tree_map(unscale, g_stacked)
+                g_rest = jax.tree_util.tree_map(unscale, g_rest)
             # data-parallel reduction across batch axes
             for ax in batch_axes:
                 loss = lax.pmean(loss, ax)
@@ -415,6 +536,35 @@ class PipelinedTrainStep:
                     lambda g: lax.pmean(g, ax), g_stacked)
                 g_rest = jax.tree_util.tree_map(
                     lambda g: lax.pmean(g, ax), g_rest)
+
+            new_extras = dict(extras_)
+            if use_scaler:
+                # found-inf must agree on EVERY rank (grads are distributed
+                # over pipe/model shards) — psum the local non-finite count
+                # (hybrid_parallel_gradscaler's cross-group allreduce)
+                bad_local = sum(
+                    jnp.sum(~jnp.isfinite(g)).astype(jnp.int32)
+                    for g in (list(jax.tree_util.tree_leaves(g_stacked))
+                              + list(jax.tree_util.tree_leaves(g_rest))))
+                bad_local = lax.psum(bad_local, PIPE_AXIS)
+                if mp_n > 1:
+                    bad_local = lax.psum(bad_local, MODEL_AXIS)
+                finite = bad_local == 0
+                good = jnp.where(finite, extras_["good_steps"] + 1, 0)
+                bad = jnp.where(finite, 0, extras_["bad_steps"] + 1)
+                grow = good >= amp_cfg.incr_every_n_steps
+                shrink = bad >= amp_cfg.decr_every_n_nan_or_inf
+                new_extras["loss_scale"] = jnp.where(
+                    shrink, jnp.maximum(scale * amp_cfg.decr_ratio, 1.0),
+                    jnp.where(grow, scale * amp_cfg.incr_ratio, scale))
+                new_extras["good_steps"] = jnp.where(grow, 0, good)
+                new_extras["bad_steps"] = jnp.where(shrink, 0, bad)
+                zero_bad = lambda g: jnp.where(finite, g, jnp.zeros_like(g))
+                g_stacked = jax.tree_util.tree_map(zero_bad, g_stacked)
+                g_rest = jax.tree_util.tree_map(zero_bad, g_rest)
+            else:
+                finite = jnp.bool_(True)
+
             if use_pipe_clip:
                 g_stacked, g_rest = pipe_global_norm_clip(g_stacked, g_rest)
             flat_params = {**rest_,
@@ -429,22 +579,27 @@ class PipelinedTrainStep:
             else:
                 new_flat, new_opt = apply_fn(flat_params, flat_grads,
                                              opt_state, lr, step)
+            if use_scaler:
+                # overflow: skip the update (check_finite_and_unscale +
+                # update_loss_scaling semantics)
+                new_flat = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(finite, n, o), new_flat,
+                    flat_params)
+                new_opt = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(finite, n, o), new_opt, opt_state)
             new_rest = {k: v for k, v in new_flat.items()
                         if not k.startswith("__stack__")}
             new_stacked = {k[len("__stack__"):]: v
                            for k, v in new_flat.items()
                            if k.startswith("__stack__")}
-            return loss, new_stacked, new_rest, new_opt
+            return loss, new_stacked, new_rest, new_opt, new_extras
 
-        # optimizer slots whose shape matches a stacked param are stage-
-        # sharded over pipe; under ZeRO, param-shaped slots additionally
-        # shard their zdim over `sharding` (each rank holds only its chunk)
-        def _slot_spec(ndim, pipe_dim0, zd):
-            # zd is already in apply-leaf coordinates, which match the global
-            # slot layout ([n_stages, per_stage, ...] vs [1, per_stage, ...])
-            axes = [None] * ndim
-            if pipe_dim0:
-                axes[0] = PIPE_AXIS
+        # optimizer slots whose shape matches a param inherit its full spec
+        # (pipe stage dim + TP model axes); under ZeRO, param-shaped slots
+        # additionally shard their zdim over `sharding` (zdim only ever picks
+        # spec-free dims, so the two never collide)
+        def _slot_spec(base_spec: P, ndim: int, zd):
+            axes = list(base_spec) + [None] * (ndim - len(base_spec))
             if zd is not None:
                 axes[zd] = "sharding"
             return P(*axes)
@@ -455,63 +610,94 @@ class PipelinedTrainStep:
             if k.startswith("__stack__"):
                 base = k[len("__stack__"):]
                 opt_specs[k] = {
-                    s: (_slot_spec(a.ndim, True, zd)
+                    s: (_slot_spec(stacked_specs[base], a.ndim, zd)
                         if a.ndim == stacked[base].ndim else P())
                     for s, a in slots.items()}
             else:
                 ref_ndim = rest[k].ndim
                 opt_specs[k] = {
-                    s: (_slot_spec(a.ndim, False, zd)
+                    s: (_slot_spec(rest_specs[k], a.ndim, zd)
                         if a.ndim == ref_ndim and a.ndim > 0 else P())
                     for s, a in slots.items()}
 
         def put(arr, spec):
             return jax.device_put(arr, NamedSharding(mesh, spec))
 
-        stage_spec = {k: P(PIPE_AXIS) for k in stacked}
-        self._stacked = {k: put(v, stage_spec[k]) for k, v in stacked.items()}
-        self._rest = {k: put(v, P()) for k, v in rest.items()}
+        self._stacked = {k: put(v, stacked_specs[k])
+                         for k, v in stacked.items()}
+        self._rest = {k: put(v, rest_specs[k]) for k, v in rest.items()}
         self._opt_state = {
             k: {s: put(a, opt_specs[k][s]) for s, a in slots.items()}
             for k, slots in opt_all.items()}
 
+        extras = {}
+        extras_specs = {}
+        if use_scaler:
+            extras["loss_scale"] = put(
+                jnp.asarray(amp_cfg.init_loss_scaling, jnp.float32), P())
+            extras["good_steps"] = put(jnp.asarray(0, jnp.int32), P())
+            extras["bad_steps"] = put(jnp.asarray(0, jnp.int32), P())
+            extras_specs = {k: P() for k in extras}
+        self._extras = extras
+
         in_specs = (
-            {k: P(PIPE_AXIS) for k in stacked},
-            {k: P() for k in rest},
+            stacked_specs,
+            rest_specs,
             opt_specs,
+            extras_specs,
             P(),
             P(),
             (data_spec, data_spec),
         )
-        out_specs = (P(), {k: P(PIPE_AXIS) for k in stacked},
-                     {k: P() for k in rest}, opt_specs)
+        out_specs = (P(), stacked_specs, rest_specs, opt_specs, extras_specs)
 
         self._jitted = jax.jit(
             jax.shard_map(train_step, mesh=mesh, in_specs=in_specs,
                           out_specs=out_specs, check_vma=False),
-            donate_argnums=(0, 1, 2))
+            donate_argnums=(0, 1, 2, 3))
         self._opt_specs = opt_specs
         self._data_spec = data_spec
+        self._stacked_specs = stacked_specs
+        self._rest_specs = rest_specs
 
-    # ---- model adapters (Llama & GPT families) ----
+    # ---- model adapters: the pipe_* segmentation protocol ----
     def _decoder_layers(self):
-        core = getattr(self.model, "llama", None) or getattr(
-            self.model, "gpt", None)
-        return list(core.layers)
+        return list(self.model.pipe_layers())
 
     def _layer_prefixes(self):
-        core_name = "llama" if hasattr(self.model, "llama") else "gpt"
-        n = len(self._decoder_layers())
-        return [f"{core_name}.layers.{i}." for i in range(n)]
+        return list(self.model.pipe_layer_prefixes())
+
+    def _fn_ctx(self):
+        """Context entered around every stage-fn trace: the explicit-TP
+        axis context (mp_layers switch to shard_map collectives) and AMP
+        autocast (amp_auto_cast.h analog, consulted at trace time)."""
+        mp_on = self._mp_n > 1
+        amp_cfg = self._amp_cfg
+
+        @contextlib.contextmanager
+        def ctx():
+            with contextlib.ExitStack() as st:
+                if mp_on:
+                    from ..distributed.collective import axis_context
+                    st.enter_context(axis_context((MODEL_AXIS,)))
+                if amp_cfg is not None:
+                    from ..amp import auto_cast
+                    st.enter_context(auto_cast(
+                        True, custom_white_list=amp_cfg.custom_white_list,
+                        custom_black_list=amp_cfg.custom_black_list,
+                        dtype=amp_cfg.dtype))
+                yield
+
+        return ctx
 
     def _make_layer_fn(self):
         layer0 = self._decoder_layers()[0]
+        ctx = self._fn_ctx()
 
         def layer_fn(layer_params, x):
             from ..core.tensor import Tensor, no_grad
-            with layer0._bound_state(layer_params, {}):
-                with no_grad():
-                    out = layer0(Tensor(x))
+            with layer0._bound_state(layer_params, {}), no_grad(), ctx():
+                out = layer0(Tensor(x))
             if isinstance(out, tuple):  # GPT layers return (x, aux)
                 out = out[0]
             return out.data if hasattr(out, "data") else out
@@ -520,48 +706,32 @@ class PipelinedTrainStep:
 
     def _make_embed_fn(self):
         model = self.model
-        core_name = "llama" if hasattr(model, "llama") else "gpt"
-        core = getattr(model, core_name)
+        ctx = self._fn_ctx()
 
         def embed_fn(rest, ids):
             from ..core.tensor import Tensor, no_grad
-            emb_keys = {k: v for k, v in rest.items()
-                        if "embed" in k or "position" in k}
-            with model._bound_state(emb_keys, {}):
-                with no_grad():
-                    if core_name == "llama":
-                        h = core.embed_tokens(Tensor(ids))
-                    else:
-                        from ..tensor.creation import arange
-                        pos = arange(ids.shape[1], dtype="int64")
-                        h = core.word_embeddings(Tensor(ids)) + \
-                            core.position_embeddings(pos)
+            with model._bound_state(rest, {}), no_grad(), ctx():
+                h = model.pipe_embed(Tensor(ids))
             return h.data
 
         return embed_fn
 
     def _make_head_fn(self):
         model = self.model
-        core_name = "llama" if hasattr(model, "llama") else "gpt"
-        core = getattr(model, core_name)
+        loss_fn = self._loss_fn
+        ctx = self._fn_ctx()
 
         def head_fn(rest, hidden, labels):
             from ..core.tensor import Tensor, no_grad
-            keys = {k: v for k, v in rest.items()
-                    if k.startswith(f"{core_name}.norm")
-                    or k.startswith(f"{core_name}.final_norm")
-                    or k.startswith("lm_head")}
-            with model._bound_state(keys, {}):
-                with no_grad():
-                    if core_name == "llama":
-                        h = core.norm(Tensor(hidden))
-                    else:
-                        h = core.final_norm(Tensor(hidden))
-                    logits = model.lm_head(h)
-                    loss = model.loss_fn(logits, Tensor(labels))
-                    from ..tensor.math import mean
-                    loss = mean(loss)
-            return loss.data
+            from ..tensor.math import mean
+            with model._bound_state(rest, {}), no_grad(), ctx():
+                if loss_fn is None:
+                    loss = model.pipe_head(Tensor(hidden), Tensor(labels))
+                else:
+                    logits = model.pipe_logits(Tensor(hidden))
+                    loss = loss_fn(logits, Tensor(labels))
+                loss = mean(loss)
+            return loss.data.astype(jnp.float32)
 
         return head_fn
 
@@ -581,10 +751,16 @@ class PipelinedTrainStep:
         self._step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step = jnp.asarray(self._step_count, jnp.int32)
-        loss, self._stacked, self._rest, self._opt_state = self._jitted(
-            self._stacked, self._rest, self._opt_state, lr, step,
-            (ids, labels))
+        (loss, self._stacked, self._rest, self._opt_state,
+         self._extras) = self._jitted(
+            self._stacked, self._rest, self._opt_state, self._extras, lr,
+            step, (ids, labels))
         return Tensor(loss)
+
+    @property
+    def loss_scale(self):
+        s = self._extras.get("loss_scale")
+        return None if s is None else float(s)
 
     def sync_to_model(self):
         """Write trained weights back into the eager model (checkpointing).

@@ -349,3 +349,27 @@ def test_inplace_fill_no_grad_on_leaf_ok():
     np.testing.assert_allclose(np.asarray(p.data), 7.0)
     with pytest.raises(RuntimeError):
         paddle.fill_(p, 1.0)  # leaf requiring grad outside no_grad
+
+
+def test_dynamic_batch_constant_output_passes_through():
+    """A chunk-invariant non-batched output (constant table) must NOT be
+    rejected — only batch reductions are unreassemblable."""
+    import tempfile, os
+    from paddle_tpu.inference import export_model, load_predictor
+
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(4, 2)
+
+        def forward(self, x):
+            table = self.lin.weight * 1.0  # batch-independent output
+            return self.lin(x), table
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m")
+        export_model(M(), [Tensor(np.ones((2, 4), np.float32))], path)
+        pred = load_predictor(path)
+        outs = pred.run([np.ones((6, 4), np.float32)])
+        assert outs[0].shape[0] == 6
+        assert outs[1].shape == (4, 2)

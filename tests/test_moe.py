@@ -87,3 +87,79 @@ def test_moe_expert_parallel_sharding():
 
     out = f(jnp.asarray(x.numpy()), *put)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_ep_degree_through_fleet_facade():
+    """VERDICT r2 item 4: strategy.hybrid_configs.ep_degree builds an `ep`
+    mesh axis and fleet-facade MoE training works end-to-end."""
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.distributed import DistributedStrategy, fleet
+    from paddle_tpu.distributed.topology import _GLOBAL_HCG, _GLOBAL_MESH
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    from paddle_tpu.parallel import ShardedTrainStep, parallelize
+
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "ep_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        hcg = fleet.get_hybrid_communicate_group()
+        assert hcg.get_expert_parallel_world_size() == 4
+        mesh = hcg.build_mesh()
+        assert mesh.shape["ep"] == 4
+
+        paddle.seed(0)
+        model = GPTForCausalLM.from_preset("ernie-moe-tiny",
+                                           num_hidden_layers=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = parallelize(model, opt, mesh, strategy=strategy)
+        assert isinstance(step, ShardedTrainStep)
+        # expert weights shard over ep
+        wkey = next(k for k in step.param_specs if k.endswith("moe.w1"))
+        assert "ep" in str(step.param_specs[wkey])
+        arr = step._params[wkey]
+        assert arr.sharding.shard_shape(arr.shape)[0] == arr.shape[0] // 4
+
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, 512, (16, 16)), jnp.int32)
+        labels = jnp.asarray(rng.randint(0, 512, (16, 16)), jnp.int32)
+        losses = [float(step(ids, labels).item()) for _ in range(3)]
+        assert all(np.isfinite(l) for l in losses), losses
+        assert losses[-1] < losses[0]
+    finally:
+        _GLOBAL_HCG[0] = None
+        _GLOBAL_MESH[0] = None
+
+
+def test_ep_parity_vs_single_device():
+    """dp2 x ep4 MoE loss matches the unsharded single-device run."""
+    from paddle_tpu.distributed import DistributedStrategy, fleet
+    from paddle_tpu.distributed.topology import _GLOBAL_HCG, _GLOBAL_MESH
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    from paddle_tpu.parallel import ShardedTrainStep
+
+    paddle.seed(0)
+    model = GPTForCausalLM.from_preset("ernie-moe-tiny", num_hidden_layers=2)
+    params, buffers = model.functional_state()
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 512, (16, 16)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, 512, (16, 16)), jnp.int32)
+    ref = float(model.functional_call(params, buffers, ids, labels))
+
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "ep_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        mesh = fleet.get_hybrid_communicate_group().build_mesh()
+        opt = paddle.optimizer.SGD(learning_rate=1e-2,
+                                   parameters=model.parameters())
+        step = ShardedTrainStep(model, opt, mesh)
+        loss = float(step(ids, labels).item())
+        np.testing.assert_allclose(loss, ref, rtol=2e-5, atol=2e-5)
+    finally:
+        _GLOBAL_HCG[0] = None
+        _GLOBAL_MESH[0] = None

@@ -1,0 +1,222 @@
+"""PP x TP x AMP composition (VERDICT r2 items 2/5).
+
+Reference anchors: fleet/meta_parallel/pipeline_parallel.py:151 (TP layers
+executing inside a pipeline stage), hybrid_parallel_optimizer.py:89 (one
+optimizer correct under dp x mp x pp), pp_layers.py:44-76 (LayerDesc
+segmentation protocol — here the pipe_* methods)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as optim
+from paddle_tpu.models.llama import LlamaForCausalLM
+from paddle_tpu.models.gpt import GPTForCausalLM
+from paddle_tpu.parallel.pipeline import PipelinedTrainStep
+
+
+def _mesh(**axes):
+    names = tuple(axes)
+    sizes = list(axes.values())
+    devs = np.array(jax.devices()[:int(np.prod(sizes))]).reshape(sizes)
+    return Mesh(devs, names)
+
+
+def _ref_losses(model, ids, labels, lr, steps):
+    params, buffers = model.functional_state()
+
+    @jax.jit
+    def step_fn(p):
+        loss, g = jax.value_and_grad(
+            lambda pp: model.functional_call(pp, buffers, ids, labels))(p)
+        return loss, jax.tree_util.tree_map(lambda a, gg: a - lr * gg, p, g)
+
+    losses = []
+    for _ in range(steps):
+        loss, params = step_fn(params)
+        losses.append(float(loss))
+    return losses
+
+
+def _make(model_cls, preset, n_layers, seed=0):
+    paddle.seed(seed)
+    model = model_cls.from_preset(preset, num_hidden_layers=n_layers)
+    cfg = model.config
+    rng = np.random.RandomState(0)
+    B, S = 8, 16
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+    return model, ids, labels
+
+
+def test_pp2_mp2_parity_llama():
+    """dp-less pipe2 x model2: TP layers execute inside the pipe shard_map;
+    3-step loss parity vs the single-device run."""
+    model, ids, labels = _make(LlamaForCausalLM, "llama2-tiny", 2)
+    lr = 1e-2
+    ref = _ref_losses(model, ids, labels, lr, 3)
+    opt = optim.SGD(learning_rate=lr, parameters=model.parameters())
+    step = PipelinedTrainStep(model, opt, _mesh(pipe=2, model=2), n_micro=2)
+    losses = [float(step(ids, labels).item()) for _ in range(3)]
+    np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pp2_mp2_dp2_parity_gpt():
+    """Full 3D dp2 x pipe2 x model2 on the GPT family."""
+    model, ids, labels = _make(GPTForCausalLM, "gpt2-tiny", 2)
+    lr = 1e-2
+    ref = _ref_losses(model, ids, labels, lr, 3)
+    opt = optim.SGD(learning_rate=lr, parameters=model.parameters())
+    step = PipelinedTrainStep(model, opt,
+                              _mesh(data=2, pipe=2, model=2), n_micro=2)
+    losses = [float(step(ids, labels).item()) for _ in range(3)]
+    np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pp2_mp2_tp_weights_sharded():
+    """Stacked decoder params are sharded over BOTH pipe and model axes."""
+    model, ids, labels = _make(LlamaForCausalLM, "llama2-tiny", 2)
+    opt = optim.SGD(learning_rate=1e-2, parameters=model.parameters())
+    step = PipelinedTrainStep(model, opt, _mesh(pipe=2, model=2), n_micro=2)
+    key = "self_attn.q_proj.weight"
+    arr = step._stacked[key]
+    shard = arr.sharding.shard_shape(arr.shape)
+    assert shard[0] == 1, "not stage-sharded"
+    assert shard[-1] == arr.shape[-1] // 2, "q_proj not tp-sharded"
+    # vocab-parallel embedding in rest is model-sharded too
+    emb = step._rest["llama.embed_tokens.weight"]
+    eshard = emb.sharding.shard_shape(emb.shape)
+    assert eshard[0] == emb.shape[0] // 2
+
+
+def test_pp2_amp_bf16_trains():
+    """plan.amp drives autocast inside the stage fns (no scaler for bf16)."""
+    from paddle_tpu.distributed import DistributedStrategy
+    from paddle_tpu.distributed.fleet.strategy_compiler import StrategyCompiler
+    model, ids, labels = _make(LlamaForCausalLM, "llama2-tiny", 2)
+    strategy = DistributedStrategy()
+    strategy.amp = True
+    strategy.amp_configs = {"dtype": "bfloat16"}
+    mesh = _mesh(pipe=2)
+    opt = optim.SGD(learning_rate=1e-2, parameters=model.parameters())
+    plan = StrategyCompiler().compile(strategy, opt, mesh)
+    assert plan.amp is not None
+    step = PipelinedTrainStep(model, opt, mesh, n_micro=2, amp_cfg=plan.amp)
+    l0 = float(step(ids, labels).item())
+    l2 = None
+    for _ in range(4):
+        l2 = float(step(ids, labels).item())
+    assert np.isfinite(l0) and np.isfinite(l2) and l2 < l0
+
+
+def test_pp2_amp_fp16_scaler_state():
+    """fp16 dynamic loss scaling lives in the tick loop: scale grows after
+    incr_every_n_steps good steps and a finite loss is reported unscaled."""
+    from paddle_tpu.distributed import DistributedStrategy
+    from paddle_tpu.distributed.fleet.strategy_compiler import StrategyCompiler
+    model, ids, labels = _make(LlamaForCausalLM, "llama2-tiny", 2)
+    strategy = DistributedStrategy()
+    strategy.amp = True
+    strategy.amp_configs = {"dtype": "float16",
+                            "init_loss_scaling": 1024.0,
+                            "incr_every_n_steps": 2}
+    mesh = _mesh(pipe=2)
+    opt = optim.SGD(learning_rate=1e-3, parameters=model.parameters())
+    plan = StrategyCompiler().compile(strategy, opt, mesh)
+    step = PipelinedTrainStep(model, opt, mesh, n_micro=2, amp_cfg=plan.amp)
+    assert step.loss_scale == 1024.0
+    losses = [float(step(ids, labels).item()) for _ in range(2)]
+    assert all(np.isfinite(l) and l < 20 for l in losses), losses
+    assert step.loss_scale == 2048.0  # grew after 2 good steps
+
+
+class TinyEncoderLM(paddle.nn.Layer):
+    """A NON-Llama/GPT model implementing the pipe_* protocol (VERDICT #5:
+    'a non-Llama/GPT model trains under pp')."""
+
+    def __init__(self, vocab=64, h=32, n_layers=2, n_heads=2):
+        super().__init__()
+        self.embed = paddle.nn.Embedding(vocab, h)
+        self.blocks = paddle.nn.LayerList([
+            paddle.nn.TransformerEncoderLayer(h, n_heads, h * 4,
+                                              dropout=0.0,
+                                              activation="gelu",
+                                              normalize_before=True)
+            for _ in range(n_layers)])
+        self.head = paddle.nn.Linear(h, vocab)
+        self._ce = paddle.nn.CrossEntropyLoss()
+
+    def forward(self, ids, labels=None):
+        x = self.embed(ids)
+        for b in self.blocks:
+            x = b(x)
+        logits = self.head(x)
+        if labels is None:
+            return logits
+        from paddle_tpu.tensor.manipulation import reshape
+        v = logits.shape[-1]
+        return self._ce(reshape(logits, [-1, v]), reshape(labels, [-1]))
+
+    # pipe_* protocol
+    def pipe_layer_prefixes(self):
+        return [f"blocks.{i}." for i in range(len(self.blocks))]
+
+    def pipe_layers(self):
+        return list(self.blocks)
+
+    def pipe_embed(self, ids):
+        return self.embed(ids)
+
+    def pipe_logits(self, hidden):
+        return self.head(hidden)
+
+    def pipe_head(self, hidden, labels):
+        from paddle_tpu.tensor.manipulation import reshape
+        logits = self.pipe_logits(hidden)
+        v = logits.shape[-1]
+        return self._ce(reshape(logits, [-1, v]), reshape(labels, [-1]))
+
+
+def test_custom_model_under_pp():
+    paddle.seed(0)
+    model = TinyEncoderLM()
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 64, (8, 8)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, 64, (8, 8)), jnp.int32)
+    lr = 1e-2
+    ref = _ref_losses(model, ids, labels, lr, 3)
+    opt = optim.SGD(learning_rate=lr, parameters=model.parameters())
+    step = PipelinedTrainStep(model, opt, _mesh(pipe=2), n_micro=2)
+    losses = [float(step(ids, labels).item()) for _ in range(3)]
+    np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_custom_loss_fn_under_pp():
+    """parallelize(loss_fn=...) re-forms the head as loss_fn(pipe_logits)."""
+    paddle.seed(0)
+    model = TinyEncoderLM()
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 64, (8, 8)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, 64, (8, 8)), jnp.int32)
+
+    def my_loss(logits, labels):
+        from paddle_tpu.tensor.manipulation import reshape
+        v = logits.shape[-1]
+        return paddle.nn.functional.cross_entropy(
+            reshape(logits, [-1, v]), reshape(labels, [-1]))
+
+    opt = optim.SGD(learning_rate=1e-2, parameters=model.parameters())
+    step = PipelinedTrainStep(model, opt, _mesh(pipe=2), n_micro=2,
+                              loss_fn=my_loss)
+    losses = [float(step(ids, labels).item()) for _ in range(2)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[1] < losses[0]
+
+
+def test_unstackable_model_raises():
+    lin = paddle.nn.Linear(4, 4)
+    opt = optim.SGD(learning_rate=1e-2, parameters=lin.parameters())
+    with pytest.raises(ValueError, match="pipe_"):
+        PipelinedTrainStep(lin, opt, _mesh(pipe=2), n_micro=2)
