@@ -245,7 +245,8 @@ class Tensor:
         if self._hooks is None:
             from collections import OrderedDict
             self._hooks = OrderedDict()
-        hid = (max(self._hooks) + 1) if self._hooks else 0
+        hid = next(_HOOK_IDS)  # never reused: a stale remover handle must
+        # not be able to delete a later hook that inherited its id
         self._hooks[hid] = hook
         return _TensorHookRemover(self, hid)
 
@@ -491,6 +492,11 @@ def _second_order_vjp(node, cotangents):
 
     outs = apply(second, *node.inputs, *cotangents)
     return outs if isinstance(outs, tuple) else (outs,)
+
+
+import itertools as _itertools
+
+_HOOK_IDS = _itertools.count()
 
 
 class _TensorHookRemover:

@@ -53,6 +53,7 @@ class CompiledStrategy:
     localsgd_begin: int = 1
     pipeline: bool = False
     sequence_parallel: bool = False
+    sequence_parallel_impl: str = "ring"  # ring | ulysses | gspmd
     optimizer = None  # possibly swapped by lars/lamb
 
     def describe(self) -> str:
@@ -99,6 +100,8 @@ class StrategyCompiler:
             # mesh axis (ring/Ulysses primitives in parallel.ring_attention;
             # the GSPMD step shards activations and gathers k/v on demand)
             plan.sequence_parallel = True
+            plan.sequence_parallel_impl = getattr(
+                strategy.hybrid_configs, "sep_impl", "ring") or "ring"
             plan.applied.append("sequence_parallel")
         if getattr(strategy, "sharding", False):
             plan.zero_stage = strategy.sharding_configs.stage

@@ -46,6 +46,7 @@ class HybridConfig:  # proto HybridConfig:47
     pp_degree: int = 1
     sharding_degree: int = 1
     sep_degree: int = 1  # sequence/context parallel (parity-plus axis)
+    sep_impl: str = "ring"  # ring | ulysses | gspmd attention on the sep axis
     ep_degree: int = 1   # expert parallel (parity-plus axis)
 
 

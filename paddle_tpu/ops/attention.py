@@ -36,9 +36,12 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 
 # trace-time flag: the SPMD step sets this while the sequence dim is
-# GSPMD-sharded over the `sep` axis — the Pallas kernel has no partitioning
-# rule (it would force a full replication), so attention routes to the XLA
-# reference, which the partitioner can slice (all-gathering k/v on demand)
+# GSPMD-sharded over the `sep` axis. With a mesh attached, attention drops
+# into a shard_map island running ring/Ulysses attention over the sep axis
+# (O(S_local^2) memory, k/v rotating over ICI ppermute) — the production
+# long-context path. Without a mesh (or with an additive mask/dropout, which
+# the ring kernels don't take), it falls back to the XLA reference, which the
+# partitioner slices by all-gathering k/v.
 import threading as _threading
 
 _SEQ_SHARDED = _threading.local()
@@ -49,16 +52,60 @@ def sequence_sharded_trace() -> bool:
 
 
 class sequence_sharded:
-    """Context manager marking the enclosed trace as sequence-sharded."""
+    """Context manager marking the enclosed trace as sequence-sharded.
+
+    mesh/batch_axes/impl: when given, flash_attention routes to the
+    ring/Ulysses shard_map island over the mesh's `sep` axis."""
+
+    def __init__(self, mesh=None, batch_axes=None, impl: str = "ring"):
+        self._mesh = mesh
+        self._batch_axes = batch_axes
+        self._impl = impl
 
     def __enter__(self):
-        self._prev = getattr(_SEQ_SHARDED, "on", False)
+        self._prev = (getattr(_SEQ_SHARDED, "on", False),
+                      getattr(_SEQ_SHARDED, "mesh", None),
+                      getattr(_SEQ_SHARDED, "batch_axes", None),
+                      getattr(_SEQ_SHARDED, "impl", "ring"))
         _SEQ_SHARDED.on = True
+        _SEQ_SHARDED.mesh = self._mesh
+        _SEQ_SHARDED.batch_axes = self._batch_axes
+        _SEQ_SHARDED.impl = self._impl
         return self
 
     def __exit__(self, *exc):
-        _SEQ_SHARDED.on = self._prev
+        (_SEQ_SHARDED.on, _SEQ_SHARDED.mesh, _SEQ_SHARDED.batch_axes,
+         _SEQ_SHARDED.impl) = self._prev
         return False
+
+
+def _sequence_parallel_island(q, k, v, causal, scale, impl="ring"):
+    """Drop into a shard_map over the sep axis and run ring/Ulysses attention
+    on the local sequence shards (PAPERS.md blockwise ring attention /
+    DeepSpeed-Ulysses; no reference analog — SURVEY §5 long-context).
+    Inside the island the trace-time flag is cleared so the Ulysses inner
+    flash_attention doesn't recurse back here."""
+    from jax.sharding import PartitionSpec as P
+    mesh = _SEQ_SHARDED.mesh
+    batch_axes = _SEQ_SHARDED.batch_axes
+    from ..parallel.ring_attention import ring_attention, ulysses_attention
+    fn = ulysses_attention if impl in ("ulysses", "all_to_all") \
+        else ring_attention
+    mp = ("model" if "model" in mesh.axis_names and mesh.shape["model"] > 1
+          else None)
+    spec = P(batch_axes, mp, "sep", None)
+
+    def body(ql, kl, vl):
+        prev = _SEQ_SHARDED.on
+        _SEQ_SHARDED.on = False
+        try:
+            return fn(ql, kl, vl, axis="sep", causal=causal, scale=scale)
+        finally:
+            _SEQ_SHARDED.on = prev
+
+    island = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+    return island(q, k, v)
 _NEG_INF = -1e30
 
 
@@ -576,8 +623,25 @@ def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     import os
-    if (os.environ.get("FLAGS_flash_attention", "1") == "0"
-            or sequence_sharded_trace()) and not force_pallas:
+    if sequence_sharded_trace() and not force_pallas:
+        mesh = getattr(_SEQ_SHARDED, "mesh", None)
+        # env var overrides the strategy-configured impl; "gspmd" means the
+        # partitioner-sliced reference path (no island)
+        impl = (os.environ.get("FLAGS_sp_impl", "")
+                or getattr(_SEQ_SHARDED, "impl", "ring") or "ring")
+        # ring/Ulysses need the sep axis and take no additive mask/dropout;
+        # cross-attention (Sq != Sk) keeps the GSPMD-sliced reference too
+        if (mesh is not None and "sep" in mesh.axis_names
+                and mesh.shape["sep"] > 1 and mask is None
+                and dropout_p == 0.0 and q.shape[2] == k.shape[2]
+                and impl != "gspmd"):
+            return _sequence_parallel_island(q, k, v, causal, scale, impl)
+        key = jax.random.PRNGKey(jnp.asarray(dropout_seed, jnp.uint32)) \
+            if dropout_p > 0.0 else None
+        return _attention_reference(q, k, v, causal, scale, mask, dropout_p,
+                                    key)
+    if os.environ.get("FLAGS_flash_attention", "1") == "0" \
+            and not force_pallas:
         key = jax.random.PRNGKey(jnp.asarray(dropout_seed, jnp.uint32)) \
             if dropout_p > 0.0 else None
         return _attention_reference(q, k, v, causal, scale, mask, dropout_p,

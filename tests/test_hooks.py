@@ -119,3 +119,14 @@ def test_hook_on_unused_split_sibling_does_not_fire():
     a.sum().backward()
     assert not fired
     np.testing.assert_allclose(np.asarray(x.grad.data), [1, 1, 0, 0])
+
+
+def test_stale_remover_cannot_delete_later_hook():
+    x = Tensor(np.ones(2, np.float32), stop_gradient=False)
+    h1 = x.register_hook(lambda g: g)
+    h2 = x.register_hook(lambda g: g * 2.0)
+    h2.remove()
+    h3 = x.register_hook(lambda g: g * 10.0)
+    h2.remove()  # stale: must NOT remove h3
+    (x * 1.0).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.data), 10.0)

@@ -82,3 +82,86 @@ def test_sequence_parallel_input_actually_sharded(sep_mesh):
     sh = NamedSharding(mesh, step.data_spec)
     # each device holds a (B/2, S/4) tile of the (4, 64) batch
     assert sh.shard_shape((4, 64)) == (2, 16)
+
+
+def _compiled_hlo(step, ids, labels):
+    import jax.numpy as jnp
+    arrays = []
+    from jax.sharding import NamedSharding
+    for a in (ids, labels):
+        arr = jnp.asarray(a)
+        arrays.append(jax.device_put(
+            arr, NamedSharding(step.mesh, step._spec_for(arr))))
+    lowered = step._jitted.lower(
+        step._params, step._opt_state, step._buffers, step._extras,
+        jnp.float32(1e-3), jnp.int32(1), jax.random.PRNGKey(0),
+        tuple(arrays))
+    return lowered.compile().as_text()
+
+
+def test_ring_attention_on_production_path_no_kv_allgather():
+    """VERDICT r2 item 3: sep>1 training must NOT all-gather full-sequence
+    k/v — the ring island rotates shards via collective-permute instead."""
+    from paddle_tpu.distributed import DistributedStrategy, fleet
+    from paddle_tpu.distributed import topology as topo
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 8}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        mesh = fleet.get_hybrid_communicate_group().build_mesh()
+        paddle.seed(0)
+        model = LlamaForCausalLM.from_preset("llama2-tiny")
+        opt = optim.SGD(learning_rate=1e-3, parameters=model.parameters())
+        step = ShardedTrainStep(model, opt, mesh, zero_stage=0)
+        assert step.sequence_parallel
+        ids, labels = _data(model.config, B=2, S=64)
+        hlo = _compiled_hlo(step, ids, labels)
+        assert "collective-permute" in hlo, "ring ppermute missing from HLO"
+        assert "all-gather" not in hlo, (
+            "sep-sharded step still all-gathers (the GSPMD-sliced slow "
+            "path); ring attention must keep k/v sharded")
+    finally:
+        topo._GLOBAL_HCG[0] = None
+        topo._GLOBAL_MESH[0] = None
+
+
+def test_ulysses_impl_via_strategy(sep_mesh):
+    """sep_impl='ulysses' routes the island to all_to_all attention and
+    still matches single-device numerics."""
+    mesh, strategy = sep_mesh
+    strategy.hybrid_configs.sep_impl = "ulysses"
+    from paddle_tpu.distributed.fleet.strategy_compiler import \
+        StrategyCompiler
+    paddle.seed(0)
+    model = GPTForCausalLM.from_preset("gpt2-tiny")
+    ids, labels = _data(model.config, B=4, S=64)
+    opt1 = optim.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    ref_losses = _single_device_losses(model, opt1, ids, labels, steps=2)
+    opt2 = optim.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    plan = StrategyCompiler().compile(strategy, opt2, mesh)
+    assert plan.sequence_parallel_impl == "ulysses"
+    step = ShardedTrainStep(model, opt2, mesh, plan=plan)
+    sp_losses = [float(step(ids, labels).item()) for _ in range(2)]
+    np.testing.assert_allclose(sp_losses, ref_losses, rtol=2e-4, atol=2e-4)
+
+
+def test_sep_impl_gspmd_disables_island(sep_mesh):
+    """sep_impl='gspmd' must route to the partitioner-sliced reference (no
+    collective-permute ring island) — review finding."""
+    mesh, strategy = sep_mesh
+    strategy.hybrid_configs.sep_impl = "gspmd"
+    from paddle_tpu.distributed.fleet.strategy_compiler import \
+        StrategyCompiler
+    paddle.seed(0)
+    model = GPTForCausalLM.from_preset("gpt2-tiny")
+    opt = optim.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    plan = StrategyCompiler().compile(strategy, opt, mesh)
+    assert plan.sequence_parallel_impl == "gspmd"
+    step = ShardedTrainStep(model, opt, mesh, plan=plan)
+    ids, labels = _data(model.config, B=4, S=64)
+    hlo = _compiled_hlo(step, ids, labels)
+    # GSPMD path gathers k/v; the ring island would show collective-permute
+    assert "all-gather" in hlo
