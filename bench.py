@@ -41,6 +41,11 @@ def _peak_flops(device_kind: str, backend: str) -> float:
     return 197e12  # unknown TPU: assume the smallest current chip
 
 
+def _default_blocks():
+    from paddle_tpu.ops.attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
+    return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+
+
 def _init_backend(force_cpu: bool, max_tries: int = 2):
     """Initialize the default backend, retrying flaky TPU init (the tunneled
     axon backend can also HANG inside native code — the parent process
@@ -87,20 +92,34 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
     from paddle_tpu.models.llama import LlamaForCausalLM
 
     import os
-    # size to the hardware: single-chip CI uses gpt3-125m bf16
+    # per-preset (batch, seq, remat, moment_dtype) defaults, sized to one
+    # v5e chip (16 GB). gpt3-1.3b: fp32 adam moments alone are 10.5 GB, so
+    # the preset runs bf16 moments + remat (BASELINE config 2's model at
+    # single-chip scale; multi-chip DP is the production config).
+    _PRESETS = {
+        "gpt3-125m": (8, 1024, False, "float32"),
+        "gpt3-350m": (8, 1024, False, "float32"),
+        "gpt3-1.3b": (4, 1024, True, "bfloat16"),
+    }
     preset = "gpt3-125m" if on_tpu else "gpt2-tiny"
-    B, S = (8, 1024) if on_tpu else (2, 128)
     preset = os.environ.get("BENCH_PRESET", preset)
+    B, S, remat, moment_dtype = _PRESETS.get(
+        preset, (8, 1024, False, "float32") if on_tpu
+        else (2, 128, False, "float32"))
     B = int(os.environ.get("BENCH_BS", B))
     S = int(os.environ.get("BENCH_SEQ", S))
+    remat = os.environ.get("BENCH_REMAT", "1" if remat else "0") == "1"
+    moment_dtype = os.environ.get("BENCH_MOMENT_DTYPE", moment_dtype)
     paddle.seed(0)
     family = LlamaForCausalLM if preset.startswith("llama") \
         else GPTForCausalLM
-    model = family.from_preset(preset)
+    overrides = {"use_recompute": True} if remat else {}
+    model = family.from_preset(preset, **overrides)
     if on_tpu:
         model.to(dtype="bfloat16")
     cfg = model.config
-    opt = optim.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    opt = optim.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                      moment_dtype=moment_dtype)
 
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(
@@ -186,6 +205,12 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
             "device_kind": device_kind,
             "peak_tflops": peak / 1e12,
             "n_chips": n_chips,
+            "remat": remat,
+            "moment_dtype": moment_dtype,
+            "flash_block_q": os.environ.get(
+                "FLAGS_flash_block_q", str(_default_blocks()[0])),
+            "flash_block_k": os.environ.get(
+                "FLAGS_flash_block_k", str(_default_blocks()[1])),
             "tpu_init_error": (init_err.splitlines()[0][:200]
                                if init_err else None),
         },
@@ -204,28 +229,99 @@ def _child_main():
     sys.exit(0)
 
 
+def _probe_main():
+    """Tiny matmul + forced host read: proves the chip answers end-to-end.
+    Hangs (and gets killed by the parent) when the tunnel is down."""
+    import jax
+    import jax.numpy as jnp
+    y = jax.jit(lambda a: a @ a)(jnp.ones((1024, 1024), jnp.bfloat16))
+    print("PROBE_OK", float(np.asarray(y[0, 0])))
+    sys.exit(0)
+
+
+def _probe_tunnel(timeout: int):
+    """Returns (ok, note): a fast crash is distinguished from a hang, and
+    the probe child's stderr tail rides along for the attempt chain."""
+    import os
+    import subprocess
+    t0 = time.monotonic()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--probe"],
+            capture_output=True, timeout=timeout, text=True)
+        if "PROBE_OK" in (r.stdout or ""):
+            return True, "ok"
+        tail = (r.stderr or "").strip().splitlines()
+        return False, (f"probe rc={r.returncode} in "
+                       f"{time.monotonic() - t0:.0f}s: "
+                       f"{tail[-1][:160] if tail else 'no stderr'}")
+    except subprocess.TimeoutExpired:
+        return False, f"probe hung past {timeout}s"
+
+
 def main():
-    """Parent watchdog: run the bench in a killable child; if the child hangs
-    or dies without output, rerun on CPU in-process (CPU init cannot hang).
-    ALWAYS prints exactly one JSON line and exits 0."""
+    """Parent watchdog (round-2 verdict: retry with backoff BEFORE any CPU
+    fallback). All stages share ONE wall-clock budget (BENCH_TIMEOUT,
+    default 900s) with ~60s reserved for the CPU fallback, so an outer
+    driver timeout sized to that bound always sees the JSON line. Probe the
+    tunnel with a killable matmul child (backoff between attempts); once a
+    probe answers, run the real bench child inside the remaining budget; if
+    it hangs (tunnel dropped mid-run), re-probe and retry once. The attempt
+    chain is recorded in the artifact. ALWAYS prints one JSON line, exit 0."""
     import os
     import subprocess
 
-    timeout = int(os.environ.get("BENCH_TIMEOUT", "900"))
-    note = None
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child"],
-            capture_output=True, timeout=timeout, text=True)
-        sys.stderr.write(r.stderr[-4000:] if r.stderr else "")
-        for line in reversed((r.stdout or "").splitlines()):
-            if line.startswith("{"):
-                print(line)
-                sys.exit(0)
-        note = f"bench child rc={r.returncode} with no JSON output"
-    except subprocess.TimeoutExpired:
-        note = f"bench child hung past {timeout}s (TPU tunnel down?)"
-    sys.stderr.write(f"bench: {note}; falling back to CPU\n")
+    total = int(os.environ.get("BENCH_TIMEOUT", "900"))
+    deadline = time.monotonic() + total - 60  # reserve for CPU fallback
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
+    probe_tries = int(os.environ.get("BENCH_PROBE_TRIES", "3"))
+    attempts = []
+
+    def remaining():
+        return deadline - time.monotonic()
+
+    def run_child():
+        budget = remaining()
+        if budget < 60:
+            attempts.append("no budget left for a bench child")
+            return
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                capture_output=True, timeout=budget, text=True)
+            sys.stderr.write(r.stderr[-4000:] if r.stderr else "")
+            for line in reversed((r.stdout or "").splitlines()):
+                if line.startswith("{"):
+                    print(line)
+                    sys.exit(0)
+            tail = (r.stderr or "").strip().splitlines()
+            attempts.append(f"bench child rc={r.returncode}, no JSON "
+                            f"({tail[-1][:160] if tail else 'no stderr'})")
+        except subprocess.TimeoutExpired:
+            attempts.append(f"bench child hung past {budget:.0f}s")
+
+    for attempt in range(probe_tries):
+        ok, note = _probe_tunnel(min(probe_timeout, max(remaining(), 5)))
+        attempts.append(f"probe {attempt + 1}/{probe_tries}: {note}")
+        if ok:
+            run_child()  # exits on success
+            # tunnel answered but the bench run failed/hung: one more try
+            if remaining() > 120:
+                ok2, note2 = _probe_tunnel(probe_timeout)
+                attempts.append(f"re-probe: {note2}")
+                if ok2:
+                    run_child()
+            break
+        if attempt < probe_tries - 1 and remaining() > 200:
+            backoff = 30 * (attempt + 1)
+            sys.stderr.write(f"bench: tunnel down, backing off {backoff}s\n")
+            time.sleep(backoff)
+        elif remaining() <= 200:
+            attempts.append("budget exhausted, stopping probes")
+            break
+
+    note = "; ".join(attempts)
+    sys.stderr.write(f"bench: TPU unreachable [{note}]; falling back to CPU\n")
     try:
         run_bench(force_cpu=True, init_err_note=note)
     except Exception as e:
@@ -244,5 +340,7 @@ def main():
 if __name__ == "__main__":
     if "--child" in sys.argv:
         _child_main()
+    elif "--probe" in sys.argv:
+        _probe_main()
     else:
         main()

@@ -199,7 +199,16 @@ class Fleet:
     def save_persistables(self, executor=None, dirname=None, main_program=None,
                           mode=0):
         """Save the distributed model's trainable state (reference routes
-        through the runtime handle; here: state_dict → dirname/persistables)."""
+        through the runtime handle; here: state_dict → dirname/persistables.
+        In PS mode the sparse tables are additionally saved server-side,
+        fleet_base.py:654's runtime routing)."""
+        rt = getattr(self, "_ps_runtime", None)
+        if rt is not None:
+            if dirname is None:
+                raise ValueError("fleet.save_persistables requires dirname")
+            rt.save(dirname)
+            if main_program is None and self._model is None:
+                return  # pure-PS job: tables are the persistable state
         target = main_program if main_program is not None else self._model
         if target is None or not hasattr(target, "state_dict"):
             raise RuntimeError(
@@ -229,19 +238,42 @@ class Fleet:
         from ...jit import save as _jit_save
         _jit_save(target, os.path.join(dirname, "model"))
 
-    # ---- PS interface stubs (out of v1 scope; SURVEY §7 item 6) ----
-    def init_server(self, *args, **kwargs):
-        raise NotImplementedError("parameter-server mode is not implemented "
-                                  "in the TPU framework (see SURVEY.md §2.2)")
-
-    def init_worker(self):
-        raise NotImplementedError("parameter-server mode is not implemented")
+    # ---- parameter-server mode (minimal functional the_one_ps analog;
+    # reference fleet/runtime/the_one_ps.py:286, brpc_ps_{client,server}) ----
+    def init_server(self, dirname=None, n_shards=None, over_http=False,
+                    **kwargs):
+        """Build the PS runtime (sharded sparse tables + accessor rules).
+        dirname: load previously saved tables. n_shards: number of table
+        shards (default: PADDLE_PSERVER_NUMS env or 1). over_http: serve
+        shards over the HTTP RPC pair instead of in-process calls."""
+        import os
+        from .runtime import TheOnePSRuntime
+        if n_shards is None:
+            n_shards = int(os.environ.get("PADDLE_PSERVER_NUMS", "1"))
+        self._ps_runtime = TheOnePSRuntime(n_shards=n_shards)
+        self._ps_over_http = over_http
+        if dirname:
+            self._ps_runtime.load(dirname)
+        return self._ps_runtime
 
     def run_server(self):
-        raise NotImplementedError("parameter-server mode is not implemented")
+        if getattr(self, "_ps_runtime", None) is None:
+            raise RuntimeError("call fleet.init_server() first")
+        return self._ps_runtime.run_server(
+            over_http=getattr(self, "_ps_over_http", False))
+
+    def init_worker(self):
+        """Returns the PSClient handle workers pull/push through."""
+        if getattr(self, "_ps_runtime", None) is None:
+            raise RuntimeError(
+                "no PS runtime in this process: call fleet.init_server() + "
+                "fleet.run_server() first (single-node runtime)")
+        return self._ps_runtime.client
 
     def stop_worker(self):
-        pass
+        rt = getattr(self, "_ps_runtime", None)
+        if rt is not None:
+            rt.stop()
 
     @property
     def util(self):

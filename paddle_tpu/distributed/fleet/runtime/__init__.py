@@ -1,0 +1,5 @@
+from .the_one_ps import (PSClient, PSEmbedding, PSServer, SparseTable,
+                         TheOnePSRuntime)
+
+__all__ = ["TheOnePSRuntime", "PSServer", "PSClient", "SparseTable",
+           "PSEmbedding"]

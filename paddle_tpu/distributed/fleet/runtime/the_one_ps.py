@@ -1,0 +1,368 @@
+"""Minimal functional parameter-server runtime (the_one_ps analog).
+
+Reference: the brpc PS stack — python/paddle/distributed/fleet/runtime/
+the_one_ps.py:286 (Table proto builder), paddle/fluid/distributed/service/
+brpc_ps_client.h / brpc_ps_server.h, table/common_sparse_table.cc (demand-
+created sparse embedding rows, server-side optimizer), and the
+distributed_lookup_table op (operators/pscore/distributed_lookup_table_op.cc).
+
+TPU-native redesign: dense math stays on-device under jit; only the sparse
+embedding tables — whose working set is id-dependent and unbounded — live in
+host parameter servers. A table shards rows by `id % n_shards` across
+servers; workers pull the unique ids of a batch, run the on-device forward,
+and push the sparse row gradients back, where the accessor applies the
+update rule (SGD/AdaGrad) server-side, exactly the reference's division of
+labor. Transport is in-process (single-node) or a small HTTP RPC pair
+standing in for brpc; the wire format is npz, the contract is
+pull_sparse/push_sparse/save/load like PSClient's.
+"""
+from __future__ import annotations
+
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class SparseAccessor:
+    """Server-side update rule (the reference Accessor:55 — the optimizer
+    runs where the rows live, not on the worker)."""
+
+    def __init__(self, rule: str = "sgd", lr: float = 0.01,
+                 epsilon: float = 1e-6):
+        if rule not in ("sgd", "adagrad"):
+            raise ValueError(f"unsupported accessor rule {rule!r}")
+        self.rule = rule
+        self.lr = lr
+        self.epsilon = epsilon
+
+    def apply(self, row: np.ndarray, grad: np.ndarray,
+              slot: Optional[np.ndarray]):
+        if self.rule == "sgd":
+            return row - self.lr * grad, None
+        slot = (np.zeros_like(row) if slot is None else slot) + grad * grad
+        return row - self.lr * grad / (np.sqrt(slot) + self.epsilon), slot
+
+
+class SparseTable:
+    """Demand-created sparse embedding rows (common_sparse_table.cc): a row
+    materializes (from the initializer) the first time its id is pulled."""
+
+    def __init__(self, dim: int, accessor: SparseAccessor = None,
+                 init_std: float = 0.01, seed: int = 0):
+        self.dim = dim
+        self.accessor = accessor or SparseAccessor()
+        self._rng = np.random.RandomState(seed)
+        self._init_std = init_std
+        self._rows: Dict[int, np.ndarray] = {}
+        self._slots: Dict[int, np.ndarray] = {}
+        self._lock = threading.Lock()
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        out = np.empty((len(ids), self.dim), np.float32)
+        with self._lock:
+            for i, key in enumerate(np.asarray(ids, np.int64)):
+                k = int(key)
+                row = self._rows.get(k)
+                if row is None:
+                    row = (self._rng.randn(self.dim) *
+                           self._init_std).astype(np.float32)
+                    self._rows[k] = row
+                out[i] = row
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        ids = np.asarray(ids, np.int64)
+        # merge duplicate ids (scatter::MergeAdd) before the rule
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(merged, inv, np.asarray(grads, np.float32))
+        with self._lock:
+            for i, key in enumerate(uniq):
+                k = int(key)
+                row = self._rows.get(k)
+                if row is None:
+                    continue  # pushed before ever pulled: ignore
+                new_row, slot = self.accessor.apply(
+                    row, merged[i], self._slots.get(k))
+                self._rows[k] = new_row
+                if slot is not None:
+                    self._slots[k] = slot
+
+    def state(self):
+        with self._lock:
+            ids = np.asarray(sorted(self._rows), np.int64)
+            vals = np.stack([self._rows[int(i)] for i in ids]) if len(ids) \
+                else np.zeros((0, self.dim), np.float32)
+        return ids, vals
+
+    def load_state(self, ids, vals):
+        with self._lock:
+            for i, key in enumerate(np.asarray(ids, np.int64)):
+                self._rows[int(key)] = np.asarray(vals[i], np.float32)
+
+
+class PSCore:
+    """One server's tables (the in-process half of brpc_ps_server)."""
+
+    def __init__(self):
+        self.tables: Dict[str, SparseTable] = {}
+
+    def create_table(self, name: str, dim: int, rule="sgd", lr=0.01,
+                     init_std=0.01, seed=0):
+        if name not in self.tables:
+            self.tables[name] = SparseTable(
+                dim, SparseAccessor(rule, lr), init_std, seed)
+        return self.tables[name]
+
+    def save(self, dirname: str):
+        import os
+        os.makedirs(dirname, exist_ok=True)
+        for name, t in self.tables.items():
+            ids, vals = t.state()
+            acc = t.accessor
+            np.savez(os.path.join(dirname, f"{name}.npz"), ids=ids,
+                     vals=vals, dim=t.dim, rule=acc.rule, lr=acc.lr,
+                     epsilon=acc.epsilon)
+
+
+def _npz_bytes(**arrays) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _npz_load(data: bytes):
+    return np.load(io.BytesIO(data))
+
+
+class PSServer:
+    """HTTP RPC server exposing a PSCore (brpc_ps_server stand-in).
+
+    POST /pull   body npz{ids}        ?table=  -> npz{vals}
+    POST /push   body npz{ids, grads} ?table=  -> ok
+    POST /create ?table=&dim=&rule=&lr=        -> ok
+    """
+
+    def __init__(self, core: PSCore, port: int = 0):
+        self.core = core
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _respond(self, payload: bytes = b"ok", code=200):
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_POST(self):
+                from urllib.parse import parse_qs, urlparse
+                u = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else b""
+                try:
+                    if u.path == "/create":
+                        outer.core.create_table(
+                            q["table"], int(q["dim"]), q.get("rule", "sgd"),
+                            float(q.get("lr", 0.01)),
+                            float(q.get("init_std", 0.01)),
+                            int(q.get("seed", 0)))
+                        return self._respond()
+                    table = outer.core.tables[q["table"]]
+                    if u.path == "/pull":
+                        ids = _npz_load(body)["ids"]
+                        return self._respond(
+                            _npz_bytes(vals=table.pull(ids)))
+                    if u.path == "/push":
+                        data = _npz_load(body)
+                        table.push(data["ids"], data["grads"])
+                        return self._respond()
+                    self._respond(b"not found", 404)
+                except Exception as e:  # surface server errors to the client
+                    self._respond(str(e).encode(), 500)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+
+
+class PSClient:
+    """Worker-side handle (brpc_ps_client analog). Tables shard rows by
+    id % n_servers; a pull/push fans out per shard and reassembles."""
+
+    def __init__(self, endpoints: Optional[List[str]] = None,
+                 cores: Optional[List[PSCore]] = None):
+        if (endpoints is None) == (cores is None):
+            raise ValueError("exactly one of endpoints/cores required")
+        self._endpoints = endpoints
+        self._cores = cores
+        self.n = len(endpoints or cores)
+
+    def _rpc(self, server_idx: int, path: str, body: bytes) -> bytes:
+        import urllib.request
+        req = urllib.request.Request(
+            f"http://{self._endpoints[server_idx]}{path}", data=body,
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            if r.status != 200:
+                raise RuntimeError(f"PS rpc {path} failed: {r.status}")
+            return r.read()
+
+    def create_table(self, name: str, dim: int, rule="sgd", lr=0.01,
+                     init_std=0.01, seed=0):
+        for s in range(self.n):
+            if self._cores is not None:
+                self._cores[s].create_table(name, dim, rule, lr, init_std,
+                                            seed + s)
+            else:
+                self._rpc(s, f"/create?table={name}&dim={dim}&rule={rule}"
+                             f"&lr={lr}&init_std={init_std}&seed={seed + s}",
+                          b"")
+
+    def pull_sparse(self, table: str, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        parts = {}
+        for s in range(self.n):
+            sel = np.nonzero(ids % self.n == s)[0]
+            if not len(sel):
+                continue
+            if self._cores is not None:
+                vals = self._cores[s].tables[table].pull(ids[sel])
+            else:
+                vals = _npz_load(self._rpc(
+                    s, f"/pull?table={table}",
+                    _npz_bytes(ids=ids[sel])))["vals"]
+            parts[s] = (sel, vals)
+        dim = next(iter(parts.values()))[1].shape[1] if parts else 0
+        out = np.empty((len(ids), dim), np.float32)
+        for sel, vals in parts.values():
+            out[sel] = vals
+        return out
+
+    def push_sparse(self, table: str, ids: np.ndarray, grads: np.ndarray):
+        ids = np.asarray(ids, np.int64)
+        grads = np.asarray(grads, np.float32)
+        for s in range(self.n):
+            sel = np.nonzero(ids % self.n == s)[0]
+            if not len(sel):
+                continue
+            if self._cores is not None:
+                self._cores[s].tables[table].push(ids[sel], grads[sel])
+            else:
+                self._rpc(s, f"/push?table={table}",
+                          _npz_bytes(ids=ids[sel], grads=grads[sel]))
+
+
+class TheOnePSRuntime:
+    """Single-node runtime façade: owns the server cores and the worker
+    client (the_one_ps.py:286's responsibilities without the proto layer)."""
+
+    def __init__(self, n_shards: int = 1):
+        self.cores = [PSCore() for _ in range(n_shards)]
+        self.servers: List[PSServer] = []
+        self.client = PSClient(cores=self.cores)
+
+    def run_server(self, over_http: bool = False):
+        if over_http and not self.servers:
+            self.servers = [PSServer(c).start() for c in self.cores]
+            self.client = PSClient(
+                endpoints=[f"127.0.0.1:{s.port}" for s in self.servers])
+        return self
+
+    def save(self, dirname: str):
+        import json as _json
+        import os
+        os.makedirs(dirname, exist_ok=True)
+        with open(os.path.join(dirname, "ps_meta.json"), "w") as f:
+            _json.dump({"n_shards": len(self.cores)}, f)
+        for i, c in enumerate(self.cores):
+            c.save(os.path.join(dirname, f"shard{i}"))
+
+    def load(self, dirname: str):
+        """Re-shards on load: rows are re-distributed by id % current
+        n_shards, so a checkpoint saved with a different shard count
+        restores losslessly (a shard-count mismatch must never silently
+        drop rows back to the random initializer)."""
+        import glob
+        import json as _json
+        import os
+        meta_path = os.path.join(dirname, "ps_meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                saved_shards = _json.load(f)["n_shards"]
+        else:
+            saved_shards = len(
+                glob.glob(os.path.join(dirname, "shard*")))
+        n = len(self.cores)
+        for s in range(saved_shards):
+            for path in glob.glob(
+                    os.path.join(dirname, f"shard{s}", "*.npz")):
+                name = os.path.splitext(os.path.basename(path))[0]
+                data = np.load(path)
+                acc = SparseAccessor(str(data["rule"]), float(data["lr"]),
+                                     float(data["epsilon"]))
+                ids = np.asarray(data["ids"], np.int64)
+                vals = data["vals"]
+                for core_idx in range(n):
+                    table = self.cores[core_idx].create_table(
+                        name, int(data["dim"]), acc.rule, acc.lr)
+                    table.accessor = acc
+                    sel = ids % n == core_idx
+                    if sel.any():
+                        table.load_state(ids[sel], vals[sel])
+
+    def stop(self):
+        for s in self.servers:
+            s.stop()
+        self.servers = []
+
+
+class PSEmbedding:
+    """distributed_lookup_table analog: pulls the batch's unique rows from
+    the PS, embeds on-device, and pushes sparse row grads in backward via
+    Tensor.register_hook. Dense layers around it train with a normal
+    optimizer; this layer's rows train server-side through the accessor."""
+
+    def __init__(self, client: PSClient, table: str, num_embeddings: int,
+                 embedding_dim: int, rule="sgd", lr=0.01, init_std=0.01):
+        self.client = client
+        self.table = table
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        client.create_table(table, embedding_dim, rule, lr, init_std)
+
+    def __call__(self, ids):
+        import jax.numpy as jnp
+
+        from ....core.tensor import Tensor, apply
+        ids_np = np.asarray(
+            ids.data if isinstance(ids, Tensor) else ids).astype(np.int64)
+        shape = ids_np.shape
+        uniq, inv = np.unique(ids_np.reshape(-1), return_inverse=True)
+        rows = self.client.pull_sparse(self.table, uniq)
+        w = Tensor(rows, stop_gradient=False)
+        client, table = self.client, self.table
+
+        def _push(g):
+            client.push_sparse(table, uniq, np.asarray(g.data))
+            return None
+
+        w.register_hook(_push)
+        inv_t = Tensor(inv.reshape(shape))
+        return apply(lambda wv, iv: jnp.take(wv, iv, axis=0), w, inv_t)

@@ -107,27 +107,35 @@ def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
     opad = _norm_tuple(output_padding or 0, n)
 
     def f(a, w, *maybe_bias):
-        # paddle transpose-conv weight: [in, out/groups, *k]
+        # paddle transpose-conv weight: [in, out/groups, *k]. Express the
+        # transposed conv as a direct conv over the stride-dilated input:
+        # flip the kernel spatially and regroup [in, out/g] -> [out, in/g]
+        # (the old lax transpose_kernel=True flag did this internally; it
+        # no longer exists).
         lhs_spec = ("NCH", "NCHW", "NCDHW")[n - 1] if not channel_last else \
             ("NHC", "NHWC", "NDHWC")[n - 1]
-        rhs_spec = ("IOH", "IOHW", "IODHW")[n - 1]
+        rhs_spec = ("OIH", "OIHW", "OIDHW")[n - 1]
         out_spec = lhs_spec
+        ks = [w.shape[i] for i in range(2, 2 + n)]
+        in_ch, out_pg = w.shape[0], w.shape[1]
+        wt = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        wt = wt.reshape((groups, in_ch // groups, out_pg) + tuple(ks))
+        wt = jnp.moveaxis(wt, 2, 1)  # [g, out/g, in/g, *k]
+        wt = wt.reshape((groups * out_pg, in_ch // groups) + tuple(ks))
         dn = jax.lax.conv_dimension_numbers(
-            a.shape, w.shape, (lhs_spec, rhs_spec, out_spec))
+            a.shape, wt.shape, (lhs_spec, rhs_spec, out_spec))
         if isinstance(pad, str):
             padding_cfg = pad
         else:
             # grad-of-conv padding: k' = dilated kernel; p' = k'-1-p
-            ks = [w.shape[i] for i in range(2, 2 + n)]
             padding_cfg = [
                 (dil[i] * (ks[i] - 1) - pad[i][0],
                  dil[i] * (ks[i] - 1) - pad[i][1] + opad[i])
                 for i in range(n)]
         out = jax.lax.conv_general_dilated(
-            a, w, window_strides=(1,) * n, padding=padding_cfg,
+            a, wt, window_strides=(1,) * n, padding=padding_cfg,
             lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
-            feature_group_count=1 if groups == 1 else groups,
-            transpose_kernel=True)
+            feature_group_count=1 if groups == 1 else groups)
         if maybe_bias:
             b = maybe_bias[0]
             shape = [1] * out.ndim

@@ -32,6 +32,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# block sizes are sweepable via env (bench tuning: FLAGS_flash_block_q/k),
+# resolved per call inside flash_attention; 512x512 is the measured v5e
+# default
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 
@@ -608,8 +611,8 @@ _flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     force_pallas: bool = False, mask=None,
                     dropout_p: float = 0.0, dropout_seed: int = 0):
     """q,k,v: [B, H, S, D] jax arrays; optional additive mask [B, 1|H, Sq, Sk].
@@ -623,6 +626,12 @@ def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     import os
+    if block_q is None:  # env-sweepable (FLAGS_flash_block_q/k), per call
+        block_q = int(os.environ.get("FLAGS_flash_block_q",
+                                     str(DEFAULT_BLOCK_Q)))
+    if block_k is None:
+        block_k = int(os.environ.get("FLAGS_flash_block_k",
+                                     str(DEFAULT_BLOCK_K)))
     if sequence_sharded_trace() and not force_pallas:
         mesh = getattr(_SEQ_SHARDED, "mesh", None)
         # env var overrides the strategy-configured impl; "gspmd" means the

@@ -1,0 +1,141 @@
+"""Minimal functional PS runtime (VERDICT r2 item 9; reference
+fleet/runtime/the_one_ps.py:286, brpc_ps_{client,server},
+common_sparse_table.cc, distributed_lookup_table op)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.runtime import (PSClient, PSEmbedding,
+                                                  PSServer, SparseTable,
+                                                  TheOnePSRuntime)
+from paddle_tpu.distributed.fleet.runtime.the_one_ps import (PSCore,
+                                                             SparseAccessor)
+
+
+@pytest.fixture(autouse=True)
+def _teardown():
+    yield
+    fleet.stop_worker()
+    fleet.fleet()._ps_runtime = None
+
+
+def test_sparse_table_demand_rows_and_sgd():
+    t = SparseTable(4, SparseAccessor("sgd", lr=0.5), init_std=0.0)
+    vals = t.pull(np.array([3, 7]))
+    np.testing.assert_allclose(vals, 0.0)  # init_std=0 -> zero rows
+    t.push(np.array([3, 3, 7]),
+           np.array([[1.0] * 4, [1.0] * 4, [2.0] * 4], np.float32))
+    vals = t.pull(np.array([3, 7]))
+    # duplicate ids merge before the rule: row3 -= 0.5*2, row7 -= 0.5*2
+    np.testing.assert_allclose(vals[0], -1.0)
+    np.testing.assert_allclose(vals[1], -1.0)
+
+
+def test_client_shards_rows_across_cores():
+    cores = [PSCore(), PSCore()]
+    client = PSClient(cores=cores)
+    client.create_table("emb", 4, lr=0.1, init_std=0.01)
+    ids = np.arange(10)
+    vals = client.pull_sparse("emb", ids)
+    assert vals.shape == (10, 4)
+    # rows land on core id%2
+    assert set(cores[0].tables["emb"]._rows) == {0, 2, 4, 6, 8}
+    assert set(cores[1].tables["emb"]._rows) == {1, 3, 5, 7, 9}
+    client.push_sparse("emb", ids, np.ones((10, 4), np.float32))
+    vals2 = client.pull_sparse("emb", ids)
+    np.testing.assert_allclose(vals2, vals - 0.1, atol=1e-6)
+
+
+def test_http_transport_roundtrip():
+    """The brpc stand-in: pull/push over the HTTP RPC pair."""
+    core = PSCore()
+    server = PSServer(core).start()
+    try:
+        client = PSClient(endpoints=[f"127.0.0.1:{server.port}"])
+        client.create_table("emb", 8, rule="adagrad", lr=0.1)
+        vals = client.pull_sparse("emb", np.array([5, 9]))
+        assert vals.shape == (2, 8)
+        client.push_sparse("emb", np.array([5]),
+                           np.ones((1, 8), np.float32))
+        vals2 = client.pull_sparse("emb", np.array([5]))
+        assert not np.allclose(vals2, vals[0])
+    finally:
+        server.stop()
+
+
+def test_recommendation_fixture_trains():
+    """Sparse-embedding recommendation model: PS tables for user/item ids,
+    local dense tower, loss decreases (dist_fleet fixture analog)."""
+    rt = fleet.init_server(n_shards=2)
+    fleet.run_server()
+    client = fleet.init_worker()
+
+    paddle.seed(0)
+    user_emb = PSEmbedding(client, "user", 1000, 8, lr=0.2, init_std=0.1)
+    item_emb = PSEmbedding(client, "item", 1000, 8, lr=0.2, init_std=0.1)
+    tower = paddle.nn.Linear(16, 1)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=tower.parameters())
+    rng = np.random.RandomState(0)
+    users = rng.randint(0, 1000, (64,))
+    items = rng.randint(0, 1000, (64,))
+    labels = paddle.to_tensor(
+        rng.randint(0, 2, (64, 1)).astype(np.float32))
+    bce = paddle.nn.BCEWithLogitsLoss()
+
+    rows_before = client.pull_sparse("user", np.unique(users))
+    losses = []
+    for _ in range(25):
+        u = user_emb(paddle.to_tensor(users))
+        it = item_emb(paddle.to_tensor(items))
+        logits = tower(paddle.concat([u, it], axis=-1))
+        loss = bce(logits, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0] - 0.05, losses
+    # the sparse rows trained SERVER-side (accessor rule), not locally
+    rows_after = client.pull_sparse("user", np.unique(users))
+    assert not np.allclose(rows_before, rows_after)
+
+
+def test_ps_save_load_roundtrip(tmp_path):
+    rt = fleet.init_server(n_shards=2)
+    client = fleet.init_worker()
+    client.create_table("emb", 4, lr=0.1, init_std=0.1)
+    before = client.pull_sparse("emb", np.arange(6))
+    fleet.save_persistables(dirname=str(tmp_path))
+    fleet.stop_worker()
+    fleet.fleet()._ps_runtime = None
+
+    rt2 = fleet.init_server(dirname=str(tmp_path), n_shards=2)
+    client2 = fleet.init_worker()
+    after = client2.pull_sparse("emb", np.arange(6))
+    np.testing.assert_allclose(after, before)
+
+
+def test_init_worker_without_server_raises():
+    with pytest.raises(RuntimeError, match="init_server"):
+        fleet.init_worker()
+
+
+def test_ps_load_reshards_to_different_shard_count(tmp_path):
+    """Restoring with a different n_shards must re-distribute rows, not
+    silently lose the odd-id half (review finding)."""
+    fleet.init_server(n_shards=2)
+    client = fleet.init_worker()
+    client.create_table("emb", 4, rule="adagrad", lr=0.5, init_std=0.1)
+    before = client.pull_sparse("emb", np.arange(9))
+    fleet.save_persistables(dirname=str(tmp_path))
+    fleet.stop_worker()
+    fleet.fleet()._ps_runtime = None
+
+    fleet.init_server(dirname=str(tmp_path), n_shards=3)
+    client2 = fleet.init_worker()
+    after = client2.pull_sparse("emb", np.arange(9))
+    np.testing.assert_allclose(after, before)
+    # the accessor config came back too
+    t = fleet.fleet()._ps_runtime.cores[0].tables["emb"]
+    assert t.accessor.rule == "adagrad" and t.accessor.lr == 0.5
