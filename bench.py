@@ -104,8 +104,12 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
     preset = "gpt3-125m" if on_tpu else "gpt2-tiny"
     preset = os.environ.get("BENCH_PRESET", preset)
     B, S, remat, moment_dtype = _PRESETS.get(
-        preset, (8, 1024, False, "float32") if on_tpu
-        else (2, 128, False, "float32"))
+        preset, (8, 1024, False, "float32"))
+    if not on_tpu:
+        # the CPU fallback must stay inside the ~60s budget reserve
+        # regardless of which TPU preset was requested: sanity numbers only
+        preset = "gpt2-tiny"
+        B, S, remat, moment_dtype = 2, 128, False, "float32"
     B = int(os.environ.get("BENCH_BS", B))
     S = int(os.environ.get("BENCH_SEQ", S))
     remat = os.environ.get("BENCH_REMAT", "1" if remat else "0") == "1"
@@ -307,7 +311,8 @@ def main():
             run_child()  # exits on success
             # tunnel answered but the bench run failed/hung: one more try
             if remaining() > 120:
-                ok2, note2 = _probe_tunnel(probe_timeout)
+                ok2, note2 = _probe_tunnel(
+                    min(probe_timeout, max(int(remaining()) - 90, 5)))
                 attempts.append(f"re-probe: {note2}")
                 if ok2:
                     run_child()

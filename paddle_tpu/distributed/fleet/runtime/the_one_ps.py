@@ -55,6 +55,8 @@ class SparseTable:
                  init_std: float = 0.01, seed: int = 0):
         self.dim = dim
         self.accessor = accessor or SparseAccessor()
+        self.init_std = init_std
+        self.seed = seed
         self._rng = np.random.RandomState(seed)
         self._init_std = init_std
         self._rows: Dict[int, np.ndarray] = {}
@@ -126,7 +128,7 @@ class PSCore:
             acc = t.accessor
             np.savez(os.path.join(dirname, f"{name}.npz"), ids=ids,
                      vals=vals, dim=t.dim, rule=acc.rule, lr=acc.lr,
-                     epsilon=acc.epsilon)
+                     epsilon=acc.epsilon, init_std=t.init_std, seed=t.seed)
 
 
 def _npz_bytes(**arrays) -> bytes:
@@ -215,14 +217,19 @@ class PSClient:
         self.n = len(endpoints or cores)
 
     def _rpc(self, server_idx: int, path: str, body: bytes) -> bytes:
+        import urllib.error
         import urllib.request
         req = urllib.request.Request(
             f"http://{self._endpoints[server_idx]}{path}", data=body,
             method="POST")
-        with urllib.request.urlopen(req, timeout=30) as r:
-            if r.status != 200:
-                raise RuntimeError(f"PS rpc {path} failed: {r.status}")
-            return r.read()
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            # the handler puts the real server-side exception in the body
+            detail = e.read().decode(errors="replace")[:300]
+            raise RuntimeError(
+                f"PS rpc {path} failed ({e.code}): {detail}") from None
 
     def create_table(self, name: str, dim: int, rule="sgd", lr=0.01,
                      init_std=0.01, seed=0):
@@ -319,9 +326,13 @@ class TheOnePSRuntime:
                                      float(data["epsilon"]))
                 ids = np.asarray(data["ids"], np.int64)
                 vals = data["vals"]
+                init_std = float(data["init_std"]) \
+                    if "init_std" in data else 0.01
+                seed0 = int(data["seed"]) if "seed" in data else 0
                 for core_idx in range(n):
                     table = self.cores[core_idx].create_table(
-                        name, int(data["dim"]), acc.rule, acc.lr)
+                        name, int(data["dim"]), acc.rule, acc.lr,
+                        init_std=init_std, seed=seed0 + core_idx)
                     table.accessor = acc
                     sel = ids % n == core_idx
                     if sel.any():

@@ -23,7 +23,9 @@ from ..core.tensor import apply
 from ..tensor.creation import _t
 
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
-           "box_iou"]
+           "box_iou", "prior_box", "anchor_generator", "box_clip",
+           "iou_similarity", "bipartite_match", "multiclass_nms",
+           "matrix_nms", "distribute_fpn_proposals"]
 
 
 def _iou_matrix(boxes_a, boxes_b):
@@ -304,3 +306,289 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
     boxes = apply(lambda a: a[..., :4], both)
     scores = apply(lambda a: a[..., 4:], both)
     return boxes, scores
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes (prior_box_op.cc): one box set per feature-map cell.
+    input [N,C,H,W] (only H,W used), image [N,C,IH,IW]. Returns
+    (boxes [H,W,P,4] normalized xmin/ymin/xmax/ymax, variances [H,W,P,4])."""
+    inp, img = _t(input), _t(image)
+    H, W = inp.data.shape[2], inp.data.shape[3]
+    IH, IW = img.data.shape[2], img.data.shape[3]
+    step_h = steps[1] if steps and steps[1] > 0 else IH / H
+    step_w = steps[0] if steps and steps[0] > 0 else IW / W
+
+    import math
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    whs = []  # (w, h) per prior, reference emission order (prior_box_op.h)
+    for i, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                m = math.sqrt(ms * max_sizes[i])
+                whs.append((m, m))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+            if max_sizes:
+                m = math.sqrt(ms * max_sizes[i])
+                whs.append((m, m))
+    P = len(whs)
+    wh = jnp.asarray(whs, jnp.float32)  # [P, 2]
+
+    def f(_):
+        cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+        cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+        cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+        c = jnp.stack([cxg, cyg], -1)[:, :, None, :]  # [H, W, 1, 2]
+        half = wh[None, None] / 2.0
+        mins = (c - half) / jnp.asarray([IW, IH], jnp.float32)
+        maxs = (c + half) / jnp.asarray([IW, IH], jnp.float32)
+        boxes = jnp.concatenate([mins, maxs], axis=-1)  # [H, W, P, 4]
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        return boxes
+
+    boxes = apply(f, inp)
+    from ..tensor.creation import to_tensor
+    import numpy as np
+    var = to_tensor(np.broadcast_to(
+        np.asarray(variance, np.float32), (H, W, P, 4)).copy())
+    return boxes, var
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios,
+                     variances=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5, name=None):
+    """RPN anchors (anchor_generator_op.cc): input [N,C,H,W]; returns
+    (anchors [H,W,A,4] in x1,y1,x2,y2, variances [H,W,A,4])."""
+    inp = _t(input)
+    H, W = inp.data.shape[2], inp.data.shape[3]
+    ws, hs = [], []
+    for ar in aspect_ratios:
+        for size in anchor_sizes:
+            area = stride[0] * stride[1]
+            import math
+            base_w = math.sqrt(area / ar)
+            base_h = base_w * ar
+            scale = size / math.sqrt(area)
+            ws.append(scale * base_w)
+            hs.append(scale * base_h)
+    A = len(ws)
+    wh = jnp.asarray(list(zip(ws, hs)), jnp.float32)
+
+    def f(_):
+        cx = (jnp.arange(W, dtype=jnp.float32) + offset) * stride[0]
+        cy = (jnp.arange(H, dtype=jnp.float32) + offset) * stride[1]
+        cxg, cyg = jnp.meshgrid(cx, cy)
+        c = jnp.stack([cxg, cyg], -1)[:, :, None, :]
+        # anchor_generator_op.h pixel convention: span +-(wh - 1) / 2
+        half = (wh[None, None] - 1.0) / 2.0
+        return jnp.concatenate([c - half, c + half], axis=-1)
+
+    anchors = apply(f, inp)
+    from ..tensor.creation import to_tensor
+    import numpy as np
+    var = to_tensor(np.broadcast_to(
+        np.asarray(variances, np.float32), (H, W, A, 4)).copy())
+    return anchors, var
+
+
+def box_clip(input, im_info, name=None):
+    """box_clip_op.cc: clip [*, 4] boxes to [0, w-1] x [0, h-1] per image.
+    input [N, M, 4] or [M, 4]; im_info [N, 3] (h, w, scale)."""
+    def f(b, info):
+        # box_clip_op.h: the image was resized by im_info[2]; clip to the
+        # ORIGINAL extent round(h/scale)-1, round(w/scale)-1
+        scale = info[..., 2:3]
+        hw = jnp.round(info[..., :2] / jnp.maximum(scale, 1e-10))
+        if b.ndim == 3:
+            wmax = hw[:, 1][:, None] - 1.0
+            hmax = hw[:, 0][:, None] - 1.0
+        else:
+            wmax = hw[1] - 1.0
+            hmax = hw[0] - 1.0
+        x1 = jnp.clip(b[..., 0], 0, wmax)
+        y1 = jnp.clip(b[..., 1], 0, hmax)
+        x2 = jnp.clip(b[..., 2], 0, wmax)
+        y2 = jnp.clip(b[..., 3], 0, hmax)
+        return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+    return apply(f, _t(input), _t(im_info))
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """iou_similarity_op.cc: pairwise IoU of [N,4] x [M,4]."""
+    return box_iou(x, y)
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    """bipartite_match_op.cc greedy max matching: repeatedly take the
+    largest entry, match its row/col pair, remove both. match_type
+    'per_prediction' additionally matches unmatched columns whose best
+    row distance exceeds dist_threshold. Host-side eager op. Returns
+    (match_indices [M] int32 row per column, -1 unmatched;
+     match_dist [M] the matched distance)."""
+    import numpy as np
+    d = np.asarray(_t(dist_matrix).data, np.float32).copy()
+    N, M = d.shape
+    match_idx = np.full(M, -1, np.int32)
+    match_dist = np.zeros(M, np.float32)
+    dd = d.copy()
+    for _ in range(min(N, M)):
+        i, j = np.unravel_index(np.argmax(dd), dd.shape)
+        if dd[i, j] <= 0:
+            break
+        match_idx[j] = i
+        match_dist[j] = dd[i, j]
+        dd[i, :] = -1.0
+        dd[:, j] = -1.0
+    if match_type == "per_prediction":
+        for j in range(M):
+            if match_idx[j] == -1:
+                i = int(np.argmax(d[:, j]))
+                if d[i, j] >= dist_threshold:
+                    match_idx[j] = i
+                    match_dist[j] = d[i, j]
+    from ..tensor.creation import to_tensor
+    return to_tensor(match_idx), to_tensor(match_dist)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, return_index=False,
+                   rois_num=None, name=None):
+    """multiclass_nms_op.cc: per-image, per-class greedy NMS then global
+    keep_top_k. bboxes [N, M, 4]; scores [N, C, M]. Host-side eager op
+    (dynamic output count). Returns (out [K, 6] rows of
+    [label, score, x1, y1, x2, y2], nms_rois_num [N])."""
+    import numpy as np
+    b = np.asarray(_t(bboxes).data, np.float32)
+    s = np.asarray(_t(scores).data, np.float32)
+    N, C, M = s.shape
+    all_rows, counts = [], []
+    for n in range(N):
+        rows = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sel = np.nonzero(s[n, c] > score_threshold)[0]
+            if not len(sel):
+                continue
+            order = sel[np.argsort(-s[n, c, sel])]
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
+            boxes_c = b[n, order]
+            iou = np.asarray(_iou_matrix(jnp.asarray(boxes_c),
+                                         jnp.asarray(boxes_c)))
+            keep = np.ones(len(order), bool)
+            thresh = nms_threshold
+            for i in range(len(order)):
+                if not keep[i]:
+                    continue
+                keep[i + 1:] &= ~(iou[i, i + 1:] > thresh)
+                if nms_eta < 1.0 and thresh > 0.5:
+                    thresh *= nms_eta
+            for idx in order[keep]:
+                rows.append([float(c), s[n, c, idx], *b[n, idx]])
+        rows.sort(key=lambda r: -r[1])
+        if keep_top_k > 0:
+            rows = rows[:keep_top_k]
+        counts.append(len(rows))
+        all_rows.extend(rows)
+    out = np.asarray(all_rows, np.float32).reshape(-1, 6)
+    from ..tensor.creation import to_tensor
+    return to_tensor(out), to_tensor(np.asarray(counts, np.int32))
+
+
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """matrix_nms_op.cc (SOLOv2): fully-parallel soft suppression — every
+    score is decayed by the worst overlap with any higher-scoring box of
+    the same class; no sequential dependency, so unlike greedy NMS this is
+    one dense [k,k] matrix computation (TPU-friendly). Returns
+    (out [K, 6], rois_num [N])."""
+    import numpy as np
+    b = np.asarray(_t(bboxes).data, np.float32)
+    s = np.asarray(_t(scores).data, np.float32)
+    N, C, M = s.shape
+    all_rows, counts = [], []
+    for n in range(N):
+        rows = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sel = np.nonzero(s[n, c] > score_threshold)[0]
+            if not len(sel):
+                continue
+            order = sel[np.argsort(-s[n, c, sel])]
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
+            k = len(order)
+            iou = np.asarray(_iou_matrix(jnp.asarray(b[n, order]),
+                                         jnp.asarray(b[n, order])))
+            iou = np.triu(iou, 1)  # pairs (i<j): i higher-scoring
+            # decay_j = min_i f(iou_ij) / f(max-overlap of i)
+            comp = iou.max(axis=0)  # worst overlap of each i with any above
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - comp[:, None] ** 2)
+                               / gaussian_sigma)
+            else:
+                decay = (1.0 - iou) / np.maximum(1.0 - comp[:, None], 1e-10)
+            decay = np.where(np.triu(np.ones((k, k), bool), 1), decay, 1.0)
+            dec = decay.min(axis=0)
+            new_scores = s[n, c, order] * dec
+            for idx, ns in zip(order, new_scores):
+                if ns > post_threshold:
+                    rows.append([float(c), float(ns), *b[n, idx]])
+        rows.sort(key=lambda r: -r[1])
+        if keep_top_k > 0:
+            rows = rows[:keep_top_k]
+        counts.append(len(rows))
+        all_rows.extend(rows)
+    out = np.asarray(all_rows, np.float32).reshape(-1, 6)
+    from ..tensor.creation import to_tensor
+    return to_tensor(out), to_tensor(np.asarray(counts, np.int32))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=True, rois_num=None,
+                             name=None):
+    """distribute_fpn_proposals_op.cc: route each RoI to the FPN level
+    matching its scale: level = floor(log2(sqrt(area)/refer_scale + 1e-8))
+    + refer_level, clipped to [min, max]. Host-side eager op. Returns
+    (rois_per_level list, restore_index [R] mapping concatenated order back
+    to the input order)."""
+    import numpy as np
+    r = np.asarray(_t(fpn_rois).data, np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = np.maximum(r[:, 2] - r[:, 0] + off, 0)
+    h = np.maximum(r[:, 3] - r[:, 1] + off, 0)
+    scale = np.sqrt(w * h)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    from ..tensor.creation import to_tensor
+    outs, order = [], []
+    for L in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == L)[0]
+        outs.append(to_tensor(r[sel]))
+        order.append(sel)
+    order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore = np.argsort(order).astype(np.int32)
+    return outs, to_tensor(restore)
