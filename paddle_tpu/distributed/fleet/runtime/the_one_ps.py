@@ -58,7 +58,6 @@ class SparseTable:
         self.init_std = init_std
         self.seed = seed
         self._rng = np.random.RandomState(seed)
-        self._init_std = init_std
         self._rows: Dict[int, np.ndarray] = {}
         self._slots: Dict[int, np.ndarray] = {}
         self._lock = threading.Lock()
@@ -71,7 +70,7 @@ class SparseTable:
                 row = self._rows.get(k)
                 if row is None:
                     row = (self._rng.randn(self.dim) *
-                           self._init_std).astype(np.float32)
+                           self.init_std).astype(np.float32)
                     self._rows[k] = row
                 out[i] = row
         return out

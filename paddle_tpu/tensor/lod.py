@@ -147,3 +147,167 @@ def sequence_expand(x: LoDTensor, y: LoDTensor, ref_level=-1) -> LoDTensor:
 # canonical implementation lives in core.selected_rows (it is also what the
 # sparse-embedding tape and the optimizers' row-wise rules produce/consume)
 from ..core.selected_rows import SelectedRows  # noqa: E402,F401
+
+
+def sequence_concat(xs: Sequence[LoDTensor]) -> LoDTensor:
+    """sequence_concat_op: concatenate the i-th sequences of each input
+    (NOT a plain row concat — per-sequence interleaving)."""
+    n = xs[0].num_sequences()
+    from ..core.errors import InvalidArgumentError, enforce
+    for x in xs:
+        enforce(x.num_sequences() == n,
+                "sequence_concat inputs must hold the same sequence count",
+                InvalidArgumentError)
+    seqs = []
+    for i in range(n):
+        parts = []
+        for x in xs:
+            lo, hi = x.lod[-1][i], x.lod[-1][i + 1]
+            parts.append(np.asarray(x.data)[lo:hi])
+        seqs.append(np.concatenate(parts, axis=0))
+    return LoDTensor.from_sequences(seqs)
+
+
+def sequence_reverse(x: LoDTensor) -> LoDTensor:
+    """sequence_reverse_op: reverse rows WITHIN each sequence."""
+    d = np.asarray(x.data)
+    out = d.copy()
+    last = x.lod[-1]
+    for a, b in zip(last, last[1:]):
+        out[a:b] = d[a:b][::-1]
+    return LoDTensor(out, [list(x.lod[-1])])
+
+
+def sequence_pool(x: LoDTensor, pool_type: str = "sum"):
+    """sequence_pool_op: per-sequence reduction over the packed rows.
+    pool_type: sum | average | max | min | sqrt | last | first.
+    Returns a dense Tensor [num_seqs, ...]."""
+    d = np.asarray(x.data)
+    # mean-family reductions compute in fp32; max/min/first/last keep the
+    # input dtype (pooled int ids must stay exact ints)
+    if pool_type in ("sum", "average", "sqrt") and not np.issubdtype(
+            d.dtype, np.floating):
+        d = d.astype(np.float32)
+    last = x.lod[-1]
+    outs = []
+    for a, b in zip(last, last[1:]):
+        seg = d[a:b]
+        if b == a:  # empty sequence pools to 0 (op semantics)
+            outs.append(np.zeros(d.shape[1:], d.dtype))
+            continue
+        if pool_type == "sum":
+            outs.append(seg.sum(0))
+        elif pool_type == "average":
+            outs.append(seg.mean(0))
+        elif pool_type == "sqrt":
+            outs.append(seg.sum(0) / np.sqrt(len(seg)))
+        elif pool_type == "max":
+            outs.append(seg.max(0))
+        elif pool_type == "min":
+            outs.append(seg.min(0))
+        elif pool_type == "last":
+            outs.append(seg[-1])
+        elif pool_type == "first":
+            outs.append(seg[0])
+        else:
+            raise ValueError(f"unknown pool_type {pool_type!r}")
+    from .creation import to_tensor
+    return to_tensor(np.stack(outs))
+
+
+def sequence_softmax(x: LoDTensor) -> LoDTensor:
+    """sequence_softmax_op: softmax over each sequence's rows (x is [N] or
+    [N, 1] packed scores)."""
+    d = np.asarray(x.data, np.float32)
+    flat = d.reshape(len(d))
+    out = np.empty_like(flat)
+    last = x.lod[-1]
+    for a, b in zip(last, last[1:]):
+        seg = flat[a:b]
+        e = np.exp(seg - seg.max()) if b > a else seg
+        out[a:b] = e / e.sum() if b > a else seg
+    return LoDTensor(out.reshape(d.shape), [list(last)])
+
+
+def sequence_enumerate(x: LoDTensor, win_size: int, pad_value: int = 0):
+    """sequence_enumerate_op: sliding windows of ids per sequence,
+    padded with pad_value past the end. [N] int -> [N, win_size]."""
+    d = np.asarray(x.data).reshape(-1)
+    out = np.full((len(d), win_size), pad_value, d.dtype)
+    last = x.lod[-1]
+    for a, b in zip(last, last[1:]):
+        for i in range(a, b):
+            take = min(win_size, b - i)
+            out[i, :take] = d[i:i + take]
+    return LoDTensor(out, [list(last)])
+
+
+def sequence_erase(x: LoDTensor, tokens: Sequence[int]) -> LoDTensor:
+    """sequence_erase_op: drop the listed token ids from each sequence."""
+    d = np.asarray(x.data).reshape(-1)
+    last = x.lod[-1]
+    seqs = []
+    for a, b in zip(last, last[1:]):
+        seg = d[a:b]
+        seqs.append(seg[~np.isin(seg, list(tokens))])
+    return LoDTensor.from_sequences(seqs)
+
+
+def sequence_expand_as(x: LoDTensor, y: LoDTensor) -> LoDTensor:
+    """sequence_expand_as_op: repeat x's i-th ROW len(y_i) times."""
+    d = np.asarray(x.data)
+    lens = y.sequence_lengths()
+    from ..core.errors import InvalidArgumentError, enforce
+    enforce(len(lens) == d.shape[0],
+            "sequence_expand_as: x rows must match y's sequence count",
+            InvalidArgumentError)
+    seqs = [np.repeat(d[i:i + 1], lens[i], axis=0) for i in range(len(lens))]
+    return LoDTensor.from_sequences(seqs)
+
+
+def sequence_slice(x: LoDTensor, offset: Sequence[int],
+                   length: Sequence[int]) -> LoDTensor:
+    """sequence_slice_op: per-sequence [offset, offset+length) row slice.
+    Bounds are enforced like the reference (offset+length within the
+    sequence) — a silent out-of-range slice would read the NEXT sequence."""
+    from ..core.errors import InvalidArgumentError, enforce
+    d = np.asarray(x.data)
+    last = x.lod[-1]
+    seqs = []
+    for i, (a, b) in enumerate(zip(last, last[1:])):
+        o, L = int(offset[i]), int(length[i])
+        enforce(0 <= o and L >= 0 and o + L <= b - a,
+                f"sequence_slice out of range for sequence {i}: offset {o} "
+                f"+ length {L} > sequence length {b - a}",
+                InvalidArgumentError)
+        seqs.append(d[a + o:a + o + L])
+    return LoDTensor.from_sequences(seqs)
+
+
+def sequence_reshape(x: LoDTensor, new_dim: int) -> LoDTensor:
+    """sequence_reshape_op: re-chunk each sequence's flattened payload into
+    rows of new_dim."""
+    d = np.asarray(x.data)
+    last = x.lod[-1]
+    seqs = []
+    for a, b in zip(last, last[1:]):
+        seg = d[a:b].reshape(-1)
+        from ..core.errors import InvalidArgumentError, enforce
+        enforce(seg.size % new_dim == 0,
+                "sequence payload not divisible by new_dim",
+                InvalidArgumentError)
+        seqs.append(seg.reshape(-1, new_dim))
+    return LoDTensor.from_sequences(seqs)
+
+
+def sequence_scatter(x, index: LoDTensor, updates: LoDTensor):
+    """sequence_scatter_op: add each sequence's updates into row i of x at
+    the given column indices."""
+    out = np.asarray(_t(x).data).copy()
+    idx = np.asarray(index.data).reshape(-1)
+    upd = np.asarray(updates.data).reshape(-1)
+    last = index.lod[-1]
+    for i, (a, b) in enumerate(zip(last, last[1:])):
+        np.add.at(out[i], idx[a:b].astype(np.int64), upd[a:b])
+    from .creation import to_tensor
+    return to_tensor(out)

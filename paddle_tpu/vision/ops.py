@@ -28,15 +28,16 @@ __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
            "matrix_nms", "distribute_fpn_proposals"]
 
 
-def _iou_matrix(boxes_a, boxes_b):
-    """[N,4] x [M,4] (x1,y1,x2,y2) -> [N,M] IoU."""
-    area_a = jnp.maximum(boxes_a[:, 2] - boxes_a[:, 0], 0) * \
-        jnp.maximum(boxes_a[:, 3] - boxes_a[:, 1], 0)
-    area_b = jnp.maximum(boxes_b[:, 2] - boxes_b[:, 0], 0) * \
-        jnp.maximum(boxes_b[:, 3] - boxes_b[:, 1], 0)
+def _iou_matrix(boxes_a, boxes_b, offset=0.0):
+    """[N,4] x [M,4] (x1,y1,x2,y2) -> [N,M] IoU. offset=1 gives the
+    reference's normalized=False pixel-coordinate convention (+1 on w/h)."""
+    area_a = jnp.maximum(boxes_a[:, 2] - boxes_a[:, 0] + offset, 0) * \
+        jnp.maximum(boxes_a[:, 3] - boxes_a[:, 1] + offset, 0)
+    area_b = jnp.maximum(boxes_b[:, 2] - boxes_b[:, 0] + offset, 0) * \
+        jnp.maximum(boxes_b[:, 3] - boxes_b[:, 1] + offset, 0)
     lt = jnp.maximum(boxes_a[:, None, :2], boxes_b[None, :, :2])
     rb = jnp.minimum(boxes_a[:, None, 2:], boxes_b[None, :, 2:])
-    wh = jnp.maximum(rb - lt, 0)
+    wh = jnp.maximum(rb - lt + offset, 0)
     inter = wh[..., 0] * wh[..., 1]
     union = area_a[:, None] + area_b[None, :] - inter
     return inter / jnp.maximum(union, 1e-10)
@@ -431,8 +432,10 @@ def box_clip(input, im_info, name=None):
 
 
 def iou_similarity(x, y, box_normalized=True, name=None):
-    """iou_similarity_op.cc: pairwise IoU of [N,4] x [M,4]."""
-    return box_iou(x, y)
+    """iou_similarity_op.cc: pairwise IoU of [N,4] x [M,4];
+    box_normalized=False uses the +1 pixel-coordinate convention."""
+    off = 0.0 if box_normalized else 1.0
+    return apply(lambda a, b: _iou_matrix(a, b, off), _t(x), _t(y))
 
 
 def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
@@ -479,8 +482,9 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
     import numpy as np
     b = np.asarray(_t(bboxes).data, np.float32)
     s = np.asarray(_t(scores).data, np.float32)
+    off = 0.0 if normalized else 1.0
     N, C, M = s.shape
-    all_rows, counts = [], []
+    all_rows, all_idx, counts = [], [], []
     for n in range(N):
         rows = []
         for c in range(C):
@@ -494,7 +498,7 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
                 order = order[:nms_top_k]
             boxes_c = b[n, order]
             iou = np.asarray(_iou_matrix(jnp.asarray(boxes_c),
-                                         jnp.asarray(boxes_c)))
+                                         jnp.asarray(boxes_c), off))
             keep = np.ones(len(order), bool)
             thresh = nms_threshold
             for i in range(len(order)):
@@ -504,15 +508,20 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
                 if nms_eta < 1.0 and thresh > 0.5:
                     thresh *= nms_eta
             for idx in order[keep]:
-                rows.append([float(c), s[n, c, idx], *b[n, idx]])
-        rows.sort(key=lambda r: -r[1])
+                rows.append(([float(c), s[n, c, idx], *b[n, idx]],
+                             n * M + idx))
+        rows.sort(key=lambda r: -r[0][1])
         if keep_top_k > 0:
             rows = rows[:keep_top_k]
         counts.append(len(rows))
-        all_rows.extend(rows)
+        all_rows.extend(r for r, _ in rows)
+        all_idx.extend(i for _, i in rows)
     out = np.asarray(all_rows, np.float32).reshape(-1, 6)
     from ..tensor.creation import to_tensor
-    return to_tensor(out), to_tensor(np.asarray(counts, np.int32))
+    res = (to_tensor(out),)
+    if return_index:
+        res += (to_tensor(np.asarray(all_idx, np.int64)),)
+    return res + (to_tensor(np.asarray(counts, np.int32)),)
 
 
 def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
@@ -527,8 +536,9 @@ def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
     import numpy as np
     b = np.asarray(_t(bboxes).data, np.float32)
     s = np.asarray(_t(scores).data, np.float32)
+    off = 0.0 if normalized else 1.0
     N, C, M = s.shape
-    all_rows, counts = [], []
+    all_rows, all_idx, counts = [], [], []
     for n in range(N):
         rows = []
         for c in range(C):
@@ -542,13 +552,14 @@ def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
                 order = order[:nms_top_k]
             k = len(order)
             iou = np.asarray(_iou_matrix(jnp.asarray(b[n, order]),
-                                         jnp.asarray(b[n, order])))
+                                         jnp.asarray(b[n, order]), off))
             iou = np.triu(iou, 1)  # pairs (i<j): i higher-scoring
             # decay_j = min_i f(iou_ij) / f(max-overlap of i)
             comp = iou.max(axis=0)  # worst overlap of each i with any above
             if use_gaussian:
-                decay = np.exp(-(iou ** 2 - comp[:, None] ** 2)
-                               / gaussian_sigma)
+                # matrix_nms_op.cc: exp(-sigma * (iou^2 - comp^2))
+                decay = np.exp(-gaussian_sigma
+                               * (iou ** 2 - comp[:, None] ** 2))
             else:
                 decay = (1.0 - iou) / np.maximum(1.0 - comp[:, None], 1e-10)
             decay = np.where(np.triu(np.ones((k, k), bool), 1), decay, 1.0)
@@ -556,15 +567,22 @@ def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
             new_scores = s[n, c, order] * dec
             for idx, ns in zip(order, new_scores):
                 if ns > post_threshold:
-                    rows.append([float(c), float(ns), *b[n, idx]])
-        rows.sort(key=lambda r: -r[1])
+                    rows.append(([float(c), float(ns), *b[n, idx]],
+                                 n * M + idx))
+        rows.sort(key=lambda r: -r[0][1])
         if keep_top_k > 0:
             rows = rows[:keep_top_k]
         counts.append(len(rows))
-        all_rows.extend(rows)
+        all_rows.extend(r for r, _ in rows)
+        all_idx.extend(i for _, i in rows)
     out = np.asarray(all_rows, np.float32).reshape(-1, 6)
     from ..tensor.creation import to_tensor
-    return to_tensor(out), to_tensor(np.asarray(counts, np.int32))
+    res = (to_tensor(out),)
+    if return_index:
+        res += (to_tensor(np.asarray(all_idx, np.int64)),)
+    if return_rois_num:
+        res += (to_tensor(np.asarray(counts, np.int32)),)
+    return res if len(res) > 1 else res[0]
 
 
 def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,

@@ -70,3 +70,96 @@ def test_lod_validates_offsets():
         LoDTensor(np.zeros((4, 2)), [[0, 3]])  # does not cover all rows
     with pytest.raises(errors.InvalidArgumentError):
         sequence_pad(_ragged(), maxlen=2)  # shorter than longest (3)
+
+
+# ---- round-3 sequence-op breadth (operators/sequence_ops parity) ----
+
+def _lt(seqs):
+    from paddle_tpu.tensor.lod import LoDTensor
+    return LoDTensor.from_sequences([np.asarray(s) for s in seqs])
+
+
+def test_sequence_concat_interleaves():
+    from paddle_tpu.tensor.lod import sequence_concat
+    a = _lt([[1, 2], [5]])
+    b = _lt([[3], [6, 7]])
+    out = sequence_concat([a, b])
+    np.testing.assert_array_equal(np.asarray(out.data), [1, 2, 3, 5, 6, 7])
+    assert out.lod[-1] == [0, 3, 6]
+
+
+def test_sequence_reverse_within():
+    from paddle_tpu.tensor.lod import sequence_reverse
+    out = sequence_reverse(_lt([[1, 2, 3], [4, 5]]))
+    np.testing.assert_array_equal(np.asarray(out.data), [3, 2, 1, 5, 4])
+
+
+def test_sequence_pool_modes():
+    from paddle_tpu.tensor.lod import sequence_pool
+    x = _lt([[1.0, 2.0, 3.0], [4.0]])
+    np.testing.assert_allclose(np.asarray(sequence_pool(x, "sum").data),
+                               [6.0, 4.0])
+    np.testing.assert_allclose(np.asarray(sequence_pool(x, "average").data),
+                               [2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(sequence_pool(x, "max").data),
+                               [3.0, 4.0])
+    np.testing.assert_allclose(np.asarray(sequence_pool(x, "last").data),
+                               [3.0, 4.0])
+    np.testing.assert_allclose(np.asarray(sequence_pool(x, "sqrt").data),
+                               [6.0 / np.sqrt(3), 4.0])
+
+
+def test_sequence_softmax_per_sequence():
+    from paddle_tpu.tensor.lod import sequence_softmax
+    out = sequence_softmax(_lt([[1.0, 1.0], [0.0, 0.0, 0.0]]))
+    d = np.asarray(out.data)
+    np.testing.assert_allclose(d[:2], 0.5)
+    np.testing.assert_allclose(d[2:], 1 / 3, rtol=1e-6)
+
+
+def test_sequence_enumerate_windows():
+    from paddle_tpu.tensor.lod import sequence_enumerate
+    out = sequence_enumerate(_lt([[1, 2, 3], [7, 8]]), win_size=2,
+                             pad_value=0)
+    np.testing.assert_array_equal(
+        np.asarray(out.data),
+        [[1, 2], [2, 3], [3, 0], [7, 8], [8, 0]])
+
+
+def test_sequence_erase():
+    from paddle_tpu.tensor.lod import sequence_erase
+    out = sequence_erase(_lt([[1, 2, 1, 3], [1, 1]]), tokens=[1])
+    np.testing.assert_array_equal(np.asarray(out.data), [2, 3])
+    assert out.lod[-1] == [0, 2, 2]
+
+
+def test_sequence_expand_as():
+    from paddle_tpu.tensor.lod import sequence_expand_as
+    x = _lt([[10.0], [20.0]])
+    # x has 2 rows; y has 2 sequences of lens 2 and 3
+    y = _lt([[0, 0], [0, 0, 0]])
+    from paddle_tpu.tensor.lod import LoDTensor
+    x2 = LoDTensor(np.array([[10.0], [20.0]]), [[0, 1, 2]])
+    out = sequence_expand_as(x2, y)
+    np.testing.assert_allclose(np.asarray(out.data).reshape(-1),
+                               [10, 10, 20, 20, 20])
+
+
+def test_sequence_slice_reshape_scatter():
+    from paddle_tpu.tensor.lod import (sequence_reshape, sequence_scatter,
+                                       sequence_slice)
+    x = _lt([[1, 2, 3, 4], [5, 6]])
+    out = sequence_slice(x, offset=[1, 0], length=[2, 1])
+    np.testing.assert_array_equal(np.asarray(out.data), [2, 3, 5])
+
+    r = sequence_reshape(_lt([[1, 2, 3, 4], [5, 6]]), new_dim=2)
+    np.testing.assert_array_equal(np.asarray(r.data),
+                                  [[1, 2], [3, 4], [5, 6]])
+    assert r.lod[-1] == [0, 2, 3]
+
+    base = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    idx = _lt([[0, 1], [3]])
+    upd = _lt([[1.0, 2.0], [9.0]])
+    s = sequence_scatter(base, idx, upd)
+    np.testing.assert_allclose(np.asarray(s.data),
+                               [[1, 2, 0, 0], [0, 0, 0, 9]])

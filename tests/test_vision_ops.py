@@ -278,3 +278,49 @@ def test_iou_similarity_alias():
     out = np.asarray(iou_similarity(a, b).data)
     np.testing.assert_allclose(out[0, 0], 1.0, rtol=1e-5)
     assert 0.1 < out[0, 1] < 0.2
+
+
+def test_multiclass_nms_return_index_and_pixel_coords():
+    from paddle_tpu.vision.ops import multiclass_nms
+    boxes = np.array([[[0, 0, 10, 10], [30, 30, 40, 40]]], np.float32)
+    scores = np.zeros((1, 2, 2), np.float32)
+    scores[0, 1] = [0.9, 0.8]
+    out, index, nums = multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.1, normalized=False, return_index=True)
+    np.testing.assert_array_equal(np.asarray(index.data), [0, 1])
+    assert np.asarray(nums.data)[0] == 2
+
+
+def test_matrix_nms_gaussian_sigma_strength():
+    """Reference formula exp(-sigma*(iou^2-comp^2)): LARGER sigma means
+    STRONGER suppression."""
+    from paddle_tpu.vision.ops import matrix_nms
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11]]], np.float32)
+    scores = np.zeros((1, 2, 2), np.float32)
+    scores[0, 1] = [0.9, 0.8]
+
+    def second_score(sigma):
+        out, _ = matrix_nms(paddle.to_tensor(boxes),
+                            paddle.to_tensor(scores), score_threshold=0.1,
+                            use_gaussian=True, gaussian_sigma=sigma)
+        return np.asarray(out.data)[1, 1]
+
+    assert second_score(8.0) < second_score(0.5)
+
+
+def test_sequence_slice_out_of_range_raises():
+    from paddle_tpu.tensor.lod import LoDTensor, sequence_slice
+    x = LoDTensor.from_sequences([np.array([1, 2]), np.array([3, 4])])
+    with pytest.raises(Exception, match="out of range"):
+        sequence_slice(x, offset=[1, 0], length=[2, 2])
+
+
+def test_sequence_pool_preserves_int_dtype():
+    from paddle_tpu.tensor.lod import LoDTensor, sequence_pool
+    big = 16_777_217  # not representable in fp32
+    x = LoDTensor.from_sequences([np.array([1, big], np.int64)])
+    out = np.asarray(sequence_pool(x, "last").data)
+    # stays integral (jax runs 32-bit ints framework-wide) and exact —
+    # an fp32 round-trip would have collapsed big to 16_777_216
+    assert np.issubdtype(out.dtype, np.integer) and out[0] == big
