@@ -100,6 +100,7 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
         "gpt3-125m": (8, 1024, False, "float32"),
         "gpt3-350m": (8, 1024, False, "float32"),
         "gpt3-1.3b": (4, 1024, True, "bfloat16"),
+        "ernie-moe-base": (8, 1024, False, "float32"),  # BASELINE config 5
     }
     preset = "gpt3-125m" if on_tpu else "gpt2-tiny"
     preset = os.environ.get("BENCH_PRESET", preset)
@@ -186,9 +187,20 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
     tokens_per_step = B * S
     tokens_per_sec_chip = tokens_per_step / dt / n_chips
 
-    # MFU: 6 * params * tokens FLOPs (fwd+bwd) vs the chip's actual peak
+    # MFU: 6 * params * tokens FLOPs (fwd+bwd) vs the chip's actual peak.
+    # MoE models count ACTIVE params: each token runs top_k of E experts,
+    # so expert weights contribute top_k/E of their size (6ND would
+    # otherwise overstate the work and inflate MFU).
     n_params = sum(int(np.prod(p.shape)) for p in params.values())
-    flops_per_step = 6.0 * n_params * tokens_per_step
+    moe_E = getattr(cfg, "moe_num_experts", 0)
+    if moe_E:
+        top_k = getattr(cfg, "moe_top_k", 2)
+        expert = sum(int(np.prod(p.shape)) for k, p in params.items()
+                     if ".moe.w" in k or ".moe.b" in k)
+        n_active = n_params - expert + expert * top_k // moe_E
+    else:
+        n_active = n_params
+    flops_per_step = 6.0 * n_active * tokens_per_step
     achieved = flops_per_step / dt / n_chips
     device_kind = jax.devices()[0].device_kind
     peak = _peak_flops(device_kind, backend)

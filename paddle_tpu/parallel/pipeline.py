@@ -15,6 +15,10 @@ Two schedules:
   scan+ppermute yields the backward pipeline automatically. Simple, but peak
   activation memory grows with n_micro.
 
+  TODO(schedule): interleaved 1F1B (virtual pipeline stages) is not
+  implemented — the reference has no interleaved schedule either; add it
+  as parity-plus once a >1 layers-per-stage imbalance shows up in profiles.
+
 - `PipelinedTrainStep` — true 1F1B (section_worker.cc:149 parity): each tick
   has a forward slot and a backward slot. Stage s runs forward of microbatch
   i at tick i+s and backward of microbatch u at tick 2(S-1)-s+u, i.e. warmup

@@ -287,12 +287,12 @@ def sequence_slice(x: LoDTensor, offset: Sequence[int],
 def sequence_reshape(x: LoDTensor, new_dim: int) -> LoDTensor:
     """sequence_reshape_op: re-chunk each sequence's flattened payload into
     rows of new_dim."""
+    from ..core.errors import InvalidArgumentError, enforce
     d = np.asarray(x.data)
     last = x.lod[-1]
     seqs = []
     for a, b in zip(last, last[1:]):
         seg = d[a:b].reshape(-1)
-        from ..core.errors import InvalidArgumentError, enforce
         enforce(seg.size % new_dim == 0,
                 "sequence payload not divisible by new_dim",
                 InvalidArgumentError)
@@ -303,10 +303,21 @@ def sequence_reshape(x: LoDTensor, new_dim: int) -> LoDTensor:
 def sequence_scatter(x, index: LoDTensor, updates: LoDTensor):
     """sequence_scatter_op: add each sequence's updates into row i of x at
     the given column indices."""
+    from ..core.errors import InvalidArgumentError, enforce
     out = np.asarray(_t(x).data).copy()
     idx = np.asarray(index.data).reshape(-1)
     upd = np.asarray(updates.data).reshape(-1)
     last = index.lod[-1]
+    enforce(len(last) - 1 == out.shape[0],
+            f"sequence_scatter: index holds {len(last) - 1} sequences but "
+            f"x has {out.shape[0]} rows", InvalidArgumentError)
+    enforce(idx.shape == upd.shape,
+            f"sequence_scatter: index payload {idx.shape} != updates "
+            f"payload {upd.shape}", InvalidArgumentError)
+    enforce(len(idx) == 0 or (idx.min() >= 0
+                              and idx.max() < out.shape[1]),
+            "sequence_scatter: column index out of range",
+            InvalidArgumentError)
     for i, (a, b) in enumerate(zip(last, last[1:])):
         np.add.at(out[i], idx[a:b].astype(np.int64), upd[a:b])
     from .creation import to_tensor

@@ -478,7 +478,10 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
     """multiclass_nms_op.cc: per-image, per-class greedy NMS then global
     keep_top_k. bboxes [N, M, 4]; scores [N, C, M]. Host-side eager op
     (dynamic output count). Returns (out [K, 6] rows of
-    [label, score, x1, y1, x2, y2], nms_rois_num [N])."""
+    [label, score, x1, y1, x2, y2][, index [K] — with return_index=True],
+    nms_rois_num [N]). rois_num (the reference's LoD-input mode) is
+    accepted for signature parity but not supported — inputs here are the
+    dense batched [N, M, 4] layout."""
     import numpy as np
     b = np.asarray(_t(bboxes).data, np.float32)
     s = np.asarray(_t(scores).data, np.float32)

@@ -69,3 +69,15 @@ def test_large_record_grows_buffer(tmp_path):
     out = list(reader)
     reader.close()
     assert out == [big]
+
+
+def test_cpp_datafeed_unit_tests():
+    """Build and run the colocated C++ unit test (reference *_test.cc +
+    paddle_gtest_main.cc analog, csrc/datafeed/datafeed_test.cc)."""
+    import subprocess
+    import os
+    d = os.path.join(os.path.dirname(__file__), "..", "csrc", "datafeed")
+    r = subprocess.run(["make", "test"], cwd=d, capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ALL PASSED" in r.stdout

@@ -308,19 +308,3 @@ def test_matrix_nms_gaussian_sigma_strength():
 
     assert second_score(8.0) < second_score(0.5)
 
-
-def test_sequence_slice_out_of_range_raises():
-    from paddle_tpu.tensor.lod import LoDTensor, sequence_slice
-    x = LoDTensor.from_sequences([np.array([1, 2]), np.array([3, 4])])
-    with pytest.raises(Exception, match="out of range"):
-        sequence_slice(x, offset=[1, 0], length=[2, 2])
-
-
-def test_sequence_pool_preserves_int_dtype():
-    from paddle_tpu.tensor.lod import LoDTensor, sequence_pool
-    big = 16_777_217  # not representable in fp32
-    x = LoDTensor.from_sequences([np.array([1, big], np.int64)])
-    out = np.asarray(sequence_pool(x, "last").data)
-    # stays integral (jax runs 32-bit ints framework-wide) and exact —
-    # an fp32 round-trip would have collapsed big to 16_777_216
-    assert np.issubdtype(out.dtype, np.integer) and out[0] == big
