@@ -101,6 +101,7 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
         "gpt3-350m": (8, 1024, False, "float32"),
         "gpt3-1.3b": (4, 1024, True, "bfloat16"),
         "ernie-moe-base": (8, 1024, False, "float32"),  # BASELINE config 5
+        "resnet50": (64, 224, False, "float32"),        # BASELINE config 1
     }
     preset = "gpt3-125m" if on_tpu else "gpt2-tiny"
     preset = os.environ.get("BENCH_PRESET", preset)
@@ -109,28 +110,54 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
     if not on_tpu:
         # the CPU fallback must stay inside the ~60s budget reserve
         # regardless of which TPU preset was requested: sanity numbers only
-        preset = "gpt2-tiny"
-        B, S, remat, moment_dtype = 2, 128, False, "float32"
+        if preset == "resnet50":
+            B, S = 2, 32
+        else:
+            preset = "gpt2-tiny"
+            B, S, remat, moment_dtype = 2, 128, False, "float32"
     B = int(os.environ.get("BENCH_BS", B))
     S = int(os.environ.get("BENCH_SEQ", S))
     remat = os.environ.get("BENCH_REMAT", "1" if remat else "0") == "1"
     moment_dtype = os.environ.get("BENCH_MOMENT_DTYPE", moment_dtype)
     paddle.seed(0)
-    family = LlamaForCausalLM if preset.startswith("llama") \
-        else GPTForCausalLM
-    overrides = {"use_recompute": True} if remat else {}
-    model = family.from_preset(preset, **overrides)
-    if on_tpu:
-        model.to(dtype="bfloat16")
-    cfg = model.config
+    rng = np.random.RandomState(0)
+    if preset == "resnet50":
+        # BASELINE config 1: ResNet-50 fwd+bwd (metric: images/sec/chip).
+        # FLOPs from the hapi flops counter (fwd), x3 for fwd+bwd.
+        model = paddle.vision.models.resnet50(num_classes=1000)
+        fwd_flops = float(paddle.flops(model, input_size=[1, 3, S, S]))
+        if on_tpu:
+            model.to(dtype="bfloat16")
+        ce = paddle.nn.CrossEntropyLoss()
+
+        class _Clf(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.net = model
+
+            def forward(self, x, y):
+                return ce(self.net(x), y)
+
+        model = _Clf()
+        cfg = None
+        ids = paddle.to_tensor(rng.randn(B, 3, S, S).astype(np.float32))
+        if on_tpu:  # match the bf16-cast model (no AMP in the bench step)
+            ids = ids.astype("bfloat16")
+        labels = paddle.to_tensor(rng.randint(0, 1000, (B,)))
+    else:
+        family = LlamaForCausalLM if preset.startswith("llama") \
+            else GPTForCausalLM
+        overrides = {"use_recompute": True} if remat else {}
+        model = family.from_preset(preset, **overrides)
+        if on_tpu:
+            model.to(dtype="bfloat16")
+        cfg = model.config
+        ids = paddle.to_tensor(rng.randint(
+            0, cfg.vocab_size, (B, S)).astype(np.int32))
+        labels = paddle.to_tensor(rng.randint(
+            0, cfg.vocab_size, (B, S)).astype(np.int32))
     opt = optim.AdamW(learning_rate=1e-4, parameters=model.parameters(),
                       moment_dtype=moment_dtype)
-
-    rng = np.random.RandomState(0)
-    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(
-        np.int32))
-    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(
-        np.int32))
 
     params, buffers = model.functional_state()
     opt_state = opt.init_state(params)
@@ -184,33 +211,37 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
     dt = (time.perf_counter() - t0) / iters
 
     n_chips = jax.device_count()
-    tokens_per_step = B * S
+    unit_name = "images" if preset == "resnet50" else "tokens"
+    tokens_per_step = B if preset == "resnet50" else B * S
     tokens_per_sec_chip = tokens_per_step / dt / n_chips
 
     # MFU: 6 * params * tokens FLOPs (fwd+bwd) vs the chip's actual peak.
     # MoE models count ACTIVE params: each token runs top_k of E experts,
     # so expert weights contribute top_k/E of their size (6ND would
-    # otherwise overstate the work and inflate MFU).
+    # otherwise overstate the work and inflate MFU). Conv models use the
+    # measured fwd flops x3 (fwd + ~2x bwd) per image.
     n_params = sum(int(np.prod(p.shape)) for p in params.values())
-    moe_E = getattr(cfg, "moe_num_experts", 0)
-    if moe_E:
+    moe_E = getattr(cfg, "moe_num_experts", 0) if cfg is not None else 0
+    if preset == "resnet50":
+        flops_per_step = 3.0 * fwd_flops * B
+    elif moe_E:
         top_k = getattr(cfg, "moe_top_k", 2)
         expert = sum(int(np.prod(p.shape)) for k, p in params.items()
                      if ".moe.w" in k or ".moe.b" in k)
         n_active = n_params - expert + expert * top_k // moe_E
+        flops_per_step = 6.0 * n_active * tokens_per_step
     else:
-        n_active = n_params
-    flops_per_step = 6.0 * n_active * tokens_per_step
+        flops_per_step = 6.0 * n_params * tokens_per_step
     achieved = flops_per_step / dt / n_chips
     device_kind = jax.devices()[0].device_kind
     peak = _peak_flops(device_kind, backend)
     mfu = achieved / peak
 
     result = {
-        "metric": f"tokens/sec/chip {preset} bs{B} seq{S} "
+        "metric": f"{unit_name}/sec/chip {preset} bs{B} seq{S} "
                   f"{'bf16' if on_tpu else 'fp32-cpu'} fused train step",
         "value": round(tokens_per_sec_chip, 1),
-        "unit": "tokens/sec/chip",
+        "unit": f"{unit_name}/sec/chip",
         "vs_baseline": round(mfu, 4),
         "extra": {
             "loss": final_loss,

@@ -5,6 +5,7 @@
 // Build & run: make test  (also invoked from tests/test_native_feed.py)
 #include <cstdint>
 #include <cstdlib>
+#include <unistd.h>
 #include <cstdio>
 #include <cstring>
 #include <set>
@@ -32,7 +33,11 @@ int64_t datafeed_write_records(const char* path, const uint8_t* data,
 }
 
 static std::string write_file(const char* name, int first, int count) {
-  std::string path = std::string("/tmp/datafeed_test_") + name + ".bin";
+  // per-process suffix: concurrent runs on one host must not share fixtures
+  const char* tmp = std::getenv("TMPDIR");
+  std::string path = std::string(tmp ? tmp : "/tmp") + "/datafeed_test_" +
+                     std::to_string(static_cast<long>(getpid())) + "_" +
+                     name + ".bin";
   std::vector<uint8_t> payload;
   std::vector<int64_t> lens;
   for (int i = 0; i < count; ++i) {

@@ -314,6 +314,9 @@ def sequence_scatter(x, index: LoDTensor, updates: LoDTensor):
     enforce(idx.shape == upd.shape,
             f"sequence_scatter: index payload {idx.shape} != updates "
             f"payload {upd.shape}", InvalidArgumentError)
+    enforce(index.lod[-1] == updates.lod[-1],
+            "sequence_scatter: index and updates must share the same lod "
+            f"({index.lod[-1]} vs {updates.lod[-1]})", InvalidArgumentError)
     enforce(len(idx) == 0 or (idx.min() >= 0
                               and idx.max() < out.shape[1]),
             "sequence_scatter: column index out of range",

@@ -180,3 +180,12 @@ def test_sequence_pool_preserves_int_dtype():
     # stays integral (jax runs 32-bit ints framework-wide) and exact —
     # an fp32 round-trip would have collapsed big to 16_777_216
     assert np.issubdtype(out.dtype, np.integer) and out[0] == big
+
+
+def test_sequence_scatter_lod_mismatch_raises():
+    from paddle_tpu.tensor.lod import LoDTensor, sequence_scatter
+    base = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    idx = LoDTensor(np.array([0, 1, 3]), [[0, 2, 3]])
+    upd = LoDTensor(np.array([1.0, 2.0, 9.0]), [[0, 1, 3]])  # different lod
+    with pytest.raises(Exception, match="same lod"):
+        sequence_scatter(base, idx, upd)
