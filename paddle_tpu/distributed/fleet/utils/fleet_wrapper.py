@@ -1,0 +1,67 @@
+"""FleetWrapper — the reference's legacy PS singleton API
+(framework/fleet/fleet_wrapper.h: PullSparseVarsSync/PushSparseVarsAsync/
+SaveModel etc., exposed to Python through pybind's fleet_py.cc), mapped onto
+the TPU framework's PS runtime (distributed/fleet/runtime/the_one_ps.py).
+
+The reference keeps this around for pre-Fleet recommendation jobs; here it
+is a thin façade so those call sites port: table ids become table names
+("table_<id>"), pull/push operate on numpy id/value arrays."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class FleetWrapper:
+    _instance: Optional["FleetWrapper"] = None
+
+    def __new__(cls):
+        if cls._instance is None:  # singleton (fleet_wrapper.h S_instance_)
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def _runtime(self):
+        from .. import fleet as fleet_singleton
+        rt = getattr(fleet_singleton(), "_ps_runtime", None)
+        if rt is None:
+            raise RuntimeError(
+                "FleetWrapper: no PS runtime — call fleet.init_server() + "
+                "fleet.run_server() first")
+        return rt
+
+    def _client(self):
+        return self._runtime().client
+
+    @staticmethod
+    def _name(table_id) -> str:
+        return table_id if isinstance(table_id, str) else f"table_{table_id}"
+
+    def create_table(self, table_id, dim, rule="sgd", lr=0.01,
+                     init_std=0.01):
+        self._client().create_table(self._name(table_id), dim, rule, lr,
+                                    init_std)
+
+    def pull_sparse(self, table_id, ids) -> np.ndarray:
+        """PullSparseVarsSync analog."""
+        return self._client().pull_sparse(self._name(table_id),
+                                          np.asarray(ids, np.int64))
+
+    def push_sparse(self, table_id, ids, grads):
+        """PushSparseVarsWithLabelAsync analog (synchronous here: the
+        runtime applies the accessor rule on push)."""
+        self._client().push_sparse(self._name(table_id),
+                                   np.asarray(ids, np.int64),
+                                   np.asarray(grads, np.float32))
+
+    def save_model(self, dirname, mode=0):
+        self._runtime().save(dirname)
+
+    def load_model(self, dirname, mode=0):
+        self._runtime().load(dirname)
+
+    def shrink_sparse_table(self):  # retained no-op surface
+        pass
+
+    def stop_server(self):
+        self._runtime().stop()

@@ -139,3 +139,20 @@ def test_ps_load_reshards_to_different_shard_count(tmp_path):
     # the accessor config came back too
     t = fleet.fleet()._ps_runtime.cores[0].tables["emb"]
     assert t.accessor.rule == "adagrad" and t.accessor.lr == 0.5
+
+
+def test_fleet_wrapper_legacy_api(tmp_path):
+    """FleetWrapper (framework/fleet/fleet_wrapper.h legacy PS singleton)
+    rides the PS runtime."""
+    from paddle_tpu.distributed.fleet.utils.fleet_wrapper import FleetWrapper
+    fleet.init_server(n_shards=2)
+    fleet.run_server()
+    fw = FleetWrapper()
+    assert fw is FleetWrapper()  # singleton
+    fw.create_table(7, 4, rule="sgd", lr=0.5, init_std=0.0)
+    vals = fw.pull_sparse(7, np.array([1, 2]))
+    np.testing.assert_allclose(vals, 0.0)
+    fw.push_sparse(7, np.array([1]), np.ones((1, 4), np.float32))
+    np.testing.assert_allclose(fw.pull_sparse(7, np.array([1])), -0.5)
+    fw.save_model(str(tmp_path))
+    fw.stop_server()

@@ -1,0 +1,98 @@
+"""TPU perf sweep driver: runs bench.py --child across configs, one killable
+subprocess each (the tunnel can die mid-sweep), appending every result to
+BENCH_SWEEP.json. Run when the tunnel is up:
+
+    python tools/tpu_sweep.py [quick|full|blocks|presets]
+
+Each row records the full bench JSON (incl. mfu, step_ms, block sizes)."""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "BENCH_SWEEP.json")
+
+# (tag, env overrides)
+BLOCK_SWEEP = [
+    (f"125m-b{bq}x{bk}", {"BENCH_PRESET": "gpt3-125m",
+                          "FLAGS_flash_block_q": str(bq),
+                          "FLAGS_flash_block_k": str(bk)})
+    for bq, bk in [(256, 256), (256, 512), (512, 256), (512, 512),
+                   (512, 1024), (1024, 512), (1024, 1024)]
+]
+PRESET_SWEEP = [
+    ("125m", {"BENCH_PRESET": "gpt3-125m"}),
+    ("125m-bs16", {"BENCH_PRESET": "gpt3-125m", "BENCH_BS": "16"}),
+    ("125m-noflash", {"BENCH_PRESET": "gpt3-125m",
+                      "FLAGS_flash_attention": "0"}),
+    ("350m", {"BENCH_PRESET": "gpt3-350m"}),
+    ("350m-bs16-remat", {"BENCH_PRESET": "gpt3-350m", "BENCH_BS": "16",
+                         "BENCH_REMAT": "1"}),
+    ("350m-bs4", {"BENCH_PRESET": "gpt3-350m", "BENCH_BS": "4"}),
+    ("1.3b", {"BENCH_PRESET": "gpt3-1.3b"}),
+    ("1.3b-bs2", {"BENCH_PRESET": "gpt3-1.3b", "BENCH_BS": "2"}),
+    ("1.3b-bs8", {"BENCH_PRESET": "gpt3-1.3b", "BENCH_BS": "8"}),
+    ("moe-base", {"BENCH_PRESET": "ernie-moe-base"}),
+    ("resnet50", {"BENCH_PRESET": "resnet50"}),
+    ("125m-fused-adam", {"BENCH_PRESET": "gpt3-125m",
+                         "FLAGS_use_fused_adam": "1"}),
+]
+QUICK = [PRESET_SWEEP[0], PRESET_SWEEP[3], PRESET_SWEEP[6]]
+
+
+def run_one(tag, env_over, timeout):
+    env = dict(os.environ)
+    env.update(env_over)
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--child"],
+            capture_output=True, timeout=timeout, text=True, env=env,
+            cwd=REPO)
+        for line in reversed((r.stdout or "").splitlines()):
+            if line.startswith("{"):
+                try:  # tunnel death can truncate the result line mid-write
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                row["tag"] = tag
+                row["wall_s"] = round(time.time() - t0, 1)
+                return row
+        return {"tag": tag, "error": f"rc={r.returncode}",
+                "stderr": (r.stderr or "")[-300:]}
+    except subprocess.TimeoutExpired:
+        return {"tag": tag, "error": f"hung>{timeout}s"}
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "quick"
+    sweep = {"quick": QUICK, "blocks": BLOCK_SWEEP,
+             "presets": PRESET_SWEEP,
+             "full": PRESET_SWEEP + BLOCK_SWEEP}[mode]
+    timeout = int(os.environ.get("SWEEP_TIMEOUT", "900"))
+    rows = []
+    if os.path.exists(OUT):
+        try:
+            rows = json.load(open(OUT))
+        except (json.JSONDecodeError, OSError):
+            os.replace(OUT, OUT + ".corrupt")
+            print(f"warning: unreadable {OUT} moved aside", flush=True)
+    for tag, env_over in sweep:
+        print(f"=== {tag} ===", flush=True)
+        row = run_one(tag, env_over, timeout)
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+        with open(OUT + ".tmp", "w") as f:
+            json.dump(rows, f, indent=1)
+        os.replace(OUT + ".tmp", OUT)  # atomic: a crash can't truncate
+        if "error" in row and "hung" in row.get("error", ""):
+            print("tunnel died mid-sweep; stopping", flush=True)
+            break
+
+
+if __name__ == "__main__":
+    main()
