@@ -1,5 +1,5 @@
 from .the_one_ps import (PSClient, PSEmbedding, PSServer, SparseTable,
-                         TheOnePSRuntime)
+                         TheOnePSRuntime, distributed_lookup_table)
 
 __all__ = ["TheOnePSRuntime", "PSServer", "PSClient", "SparseTable",
-           "PSEmbedding"]
+           "PSEmbedding", "distributed_lookup_table"]

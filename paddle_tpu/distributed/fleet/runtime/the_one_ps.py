@@ -358,21 +358,42 @@ class PSEmbedding:
         client.create_table(table, embedding_dim, rule, lr, init_std)
 
     def __call__(self, ids):
-        import jax.numpy as jnp
+        return distributed_lookup_table(ids, self.table, self.client)
 
-        from ....core.tensor import Tensor, apply
-        ids_np = np.asarray(
-            ids.data if isinstance(ids, Tensor) else ids).astype(np.int64)
-        shape = ids_np.shape
-        uniq, inv = np.unique(ids_np.reshape(-1), return_inverse=True)
-        rows = self.client.pull_sparse(self.table, uniq)
-        w = Tensor(rows, stop_gradient=False)
-        client, table = self.client, self.table
 
-        def _push(g):
-            client.push_sparse(table, uniq, np.asarray(g.data))
-            return None
+def distributed_lookup_table(ids, table_name: str, client: PSClient = None,
+                             embedding_dim: int = None):
+    """Op-level entry matching operators/pscore/distributed_lookup_table_op.cc:
+    pull the rows for `ids` from the PS table and return a dense Tensor on
+    the autograd tape whose backward pushes sparse row grads (PSEmbedding's
+    pull/push pair exposed under the reference op name)."""
+    if client is None:
+        from .. import fleet as fleet_singleton
+        rt = getattr(fleet_singleton(), "_ps_runtime", None)
+        if rt is None:
+            raise RuntimeError(
+                "distributed_lookup_table: no PS runtime — call "
+                "fleet.init_server() + fleet.run_server() first")
+        client = rt.client
+    import jax.numpy as jnp
 
-        w.register_hook(_push)
-        inv_t = Tensor(inv.reshape(shape))
-        return apply(lambda wv, iv: jnp.take(wv, iv, axis=0), w, inv_t)
+    from ....core.tensor import Tensor, apply
+    ids_np = np.asarray(
+        ids.data if isinstance(ids, Tensor) else ids).astype(np.int64)
+    shape = ids_np.shape
+    uniq, inv = np.unique(ids_np.reshape(-1), return_inverse=True)
+    rows = client.pull_sparse(table_name, uniq)
+    if embedding_dim is not None and rows.shape[1] != embedding_dim:
+        raise ValueError(
+            f"distributed_lookup_table: table {table_name!r} holds dim "
+            f"{rows.shape[1]} rows but embedding_dim={embedding_dim} was "
+            "requested")
+    w = Tensor(rows, stop_gradient=False)
+
+    def _push(g):
+        client.push_sparse(table_name, uniq, np.asarray(g.data))
+        return None
+
+    w.register_hook(_push)
+    inv_t = Tensor(inv.reshape(shape))
+    return apply(lambda wv, iv: jnp.take(wv, iv, axis=0), w, inv_t)

@@ -1,0 +1,108 @@
+"""Autoregressive generation with a static-shape KV cache.
+
+Parity-plus: the reference (Paddle ~2.1 core) ships only the beam-search
+decoder primitive (fluid/contrib decoder; here nn/decode.py) — it has no
+LLM generation loop. TPU-first design: ONE jitted prefill call fills the
+cache for the prompt, then ONE jitted lax.scan runs all decode steps
+on-device (static [B, H, max_len, D] cache slabs, dynamic_update_slice
+writes, absolute-position causal masks), so the tunneled single-chip
+backend pays two dispatches total instead of one per token.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, no_grad
+
+
+def _select_token(logits, do_sample, temperature, top_k, key):
+    """logits [B, V] -> next token [B] (greedy or temperature/top-k)."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+        lg = jnp.where(lg < kth, -1e30, lg)
+    return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+
+def generate(model, input_ids, max_new_tokens=32, do_sample=False,
+             temperature=1.0, top_k=0, eos_token_id=None, seed=0):
+    """Returns a Tensor [B, S0 + max_new_tokens] of prompt + continuation.
+    With eos_token_id, finished rows pad with eos."""
+    from ..distributed.meta_parallel.mp_layers import _explicit_tp, \
+        _mp_degree
+    if _explicit_tp() or _mp_degree() > 1:
+        raise NotImplementedError(
+            "generate() is single-device: the KV cache is sized by GLOBAL "
+            "head count and the decode loop issues no TP collectives. Run "
+            "generation outside the tensor-parallel context")
+    ids = np.asarray(input_ids.data if isinstance(input_ids, Tensor)
+                     else input_ids).astype(np.int32)
+    B, S0 = ids.shape
+    if max_new_tokens <= 0:
+        return Tensor(jnp.asarray(ids))
+    L = S0 + max_new_tokens
+    params, buffers = model.functional_state()
+    caches = model.init_cache(B, L)
+    was_training = model.training
+    model.eval()
+
+    def prefill(p, prompt, caches_):
+        with model._bound_state(p, buffers), no_grad():
+            logits, new_caches = model.forward_with_cache(
+                Tensor(prompt),
+                [(Tensor(k), Tensor(v)) for k, v in caches_],
+                jnp.int32(0))
+        return logits.data[:, -1], [(k.data, v.data)
+                                    for k, v in new_caches]
+
+    def decode_step(p, tok, pos, caches_):
+        with model._bound_state(p, buffers), no_grad():
+            logits, new_caches = model.forward_with_cache(
+                Tensor(tok[:, None]),
+                [(Tensor(k), Tensor(v)) for k, v in caches_], pos)
+        return logits.data[:, 0], [(k.data, v.data)
+                                   for k, v in new_caches]
+
+    # jit cache keyed by every static knob: a fresh closure per call would
+    # recompile prefill + the decode scan on EVERY generate() invocation
+    gen_cache = model.__dict__.setdefault("_generate_jit_cache", {})
+    cache_key = (B, S0, max_new_tokens, do_sample, float(temperature),
+                 int(top_k), eos_token_id)
+
+    def run(p, prompt, caches_, key):
+        last_logits, caches_ = prefill(p, prompt, caches_)
+        key, sub = jax.random.split(key)
+        tok0 = _select_token(last_logits, do_sample, temperature, top_k,
+                             sub)
+        done0 = (jnp.zeros((B,), jnp.bool_) if eos_token_id is None
+                 else tok0 == eos_token_id)
+
+        def step(carry, i):
+            tok, done, caches_c, key_c = carry
+            pos = S0 + i
+            logits, caches_c = decode_step(p, tok, pos, caches_c)
+            key_c, sub_c = jax.random.split(key_c)
+            nxt = _select_token(logits, do_sample, temperature, top_k,
+                                sub_c)
+            if eos_token_id is not None:
+                nxt = jnp.where(done, eos_token_id, nxt)
+                done = done | (nxt == eos_token_id)
+            return (nxt, done, caches_c, key_c), nxt
+
+        (_, _, _, _), toks = jax.lax.scan(
+            step, (tok0, done0, caches_, key), jnp.arange(max_new_tokens - 1))
+        # toks: [max_new_tokens-1, B]
+        return jnp.concatenate(
+            [tok0[:, None], jnp.swapaxes(toks, 0, 1)], axis=1)
+
+    if cache_key not in gen_cache:
+        gen_cache[cache_key] = jax.jit(run)
+    new_toks = gen_cache[cache_key](params, jnp.asarray(ids), caches,
+                                    jax.random.PRNGKey(seed))
+    if was_training:
+        model.train()
+    return Tensor(jnp.concatenate([jnp.asarray(ids), new_toks], axis=1))

@@ -82,9 +82,40 @@ class GPTAttention(Layer):
                                           input_is_parallel=True)
         self.dropout_p = config.attention_dropout_prob
 
-    def forward(self, hidden):
+    def forward(self, hidden, cache=None, pos=None):
         qkv = self.qkv_proj(hidden)
         hd = self.head_dim
+        if cache is not None:
+            k_cache, v_cache = cache
+
+            def attn_dec(a, kc, vc, pos_):
+                from jax import lax
+                B, T = a.shape[0], a.shape[1]
+                Lmax = kc.shape[2]
+                n_local = a.shape[-1] // (3 * hd)
+                a4 = a.reshape(B, T, n_local, 3 * hd)
+                q, k, v = jnp.split(a4, 3, axis=-1)
+                qh = jnp.swapaxes(q, 1, 2)
+                kh = jnp.swapaxes(k, 1, 2)
+                vh = jnp.swapaxes(v, 1, 2)
+                kc = lax.dynamic_update_slice(kc, kh.astype(kc.dtype),
+                                              (0, 0, pos_, 0))
+                vc = lax.dynamic_update_slice(vc, vh.astype(vc.dtype),
+                                              (0, 0, pos_, 0))
+                scale = 1.0 / (hd ** 0.5)
+                s = jnp.einsum("bhtd,bhld->bhtl", qh.astype(jnp.float32),
+                               kc.astype(jnp.float32)) * scale
+                col = jnp.arange(Lmax)
+                valid = col[None, :] <= (pos_ + jnp.arange(T))[:, None]
+                s = jnp.where(valid[None, None], s, -1e30)
+                p = jax.nn.softmax(s, axis=-1)
+                out = jnp.einsum("bhtl,bhld->bhtd", p,
+                                 vc.astype(jnp.float32)).astype(a.dtype)
+                return (jnp.swapaxes(out, 1, 2).reshape(B, T, -1),
+                        kc, vc)
+
+            ctx, new_k, new_v = apply(attn_dec, qkv, k_cache, v_cache, pos)
+            return self.out_proj(ctx), (new_k, new_v)
 
         def attn(a):
             B, S, _ = a.shape
@@ -141,7 +172,18 @@ class GPTDecoderLayer(Layer):
             aux = None
         return x + self.dropout(h), aux
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
+        if cache is not None:
+            if self.use_moe:
+                raise NotImplementedError(
+                    "KV-cache decode is not wired through MoE layers yet")
+            h, new_cache = self.self_attn(self.norm1(x), cache=cache,
+                                          pos=pos)
+            x = x + h
+            h = self.linear1(self.norm2(x))
+            h = apply(lambda a: jax.nn.gelu(a), h)
+            x = x + self.dropout(self.linear2(h))
+            return x, new_cache
         if self._use_recompute and self.training:
             from ..distributed.fleet.utils.recompute import recompute
             if self.use_moe:
@@ -170,12 +212,27 @@ class GPTModel(Layer):
         self.final_norm = LayerNorm(config.hidden_size,
                                     epsilon=config.layer_norm_eps)
 
-    def forward(self, input_ids):
-        """Returns (hidden, total_aux_loss) — aux is None for dense models."""
+    def forward(self, input_ids, caches=None, pos=None):
+        """Returns (hidden, total_aux_loss) — aux is None for dense models.
+        With caches: (hidden, new_caches), positions offset by `pos`."""
         S = input_ids.shape[1]
+        from ..core.tensor import Tensor, apply as _apply
         from ..tensor.creation import arange
-        pos = arange(S, dtype="int64")
-        hidden = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if caches is not None:
+            # absolute learned positions for the decoded slice
+            pos_ids = _apply(
+                lambda p: (p + jnp.arange(S)).astype(jnp.int32),
+                pos if isinstance(pos, Tensor) else Tensor(pos))
+            hidden = self.word_embeddings(input_ids) + \
+                self.position_embeddings(pos_ids)
+            new_caches = []
+            for layer, cache in zip(self.layers, caches):
+                hidden, nc = layer(hidden, cache=cache, pos=pos)
+                new_caches.append(nc)
+            return self.final_norm(hidden), new_caches
+        pos_ids = arange(S, dtype="int64")
+        hidden = self.word_embeddings(input_ids) + \
+            self.position_embeddings(pos_ids)
         hidden = self.dropout(hidden)
         total_aux = None
         for layer in self.layers:
@@ -239,6 +296,24 @@ class GPTForCausalLM(Layer):
         # [B,S]->[N] reshape would force GSPMD to regather the tokens)
         return (not _explicit_tp() and _mp_degree() <= 1
                 and not sequence_sharded_trace())
+
+    # ---- KV-cache generation (parity-plus; models/generation.py) ----
+    def init_cache(self, batch_size: int, max_len: int, dtype=None):
+        cfg = self.config
+        dt = dtype or self.gpt.word_embeddings.weight.dtype
+        shape = (batch_size, cfg.num_attention_heads, max_len, cfg.head_dim)
+        return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                for _ in range(cfg.num_hidden_layers)]
+
+    def forward_with_cache(self, input_ids, caches, pos):
+        hidden, new_caches = self.gpt(input_ids, caches=caches, pos=pos)
+        return self.lm_head(hidden), new_caches
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, eos_token_id=None, seed=0):
+        from .generation import generate
+        return generate(self, input_ids, max_new_tokens, do_sample,
+                        temperature, top_k, eos_token_id, seed)
 
     # ---- pipeline-parallel segmentation protocol (pp_layers.py:44-76) ----
     def pipe_layer_prefixes(self):

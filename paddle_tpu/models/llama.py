@@ -119,7 +119,7 @@ class LlamaAttention(Layer):
         self.o_proj = RowParallelLinear(self.num_heads * self.head_dim, h,
                                         has_bias=False, input_is_parallel=True)
 
-    def forward(self, hidden, attn_mask=None):
+    def forward(self, hidden, attn_mask=None, cache=None, pos=None):
         if attn_mask is not None:
             raise NotImplementedError(
                 "padding masks are not wired into the fused attention yet; "
@@ -130,6 +130,9 @@ class LlamaAttention(Layer):
         n_rep = self.num_heads // self.num_kv_heads
         hd = self.head_dim
         theta = self.config.rope_theta
+        if cache is not None:
+            return self._forward_cached(q, k, v, cache, pos, n_rep, hd,
+                                        theta)
 
         def attn(qa, ka, va):
             qh = qa.reshape(qa.shape[0], qa.shape[1], -1, hd)
@@ -152,6 +155,51 @@ class LlamaAttention(Layer):
 
         ctx = apply(attn, q, k, v)
         return self.o_proj(ctx)
+
+    def _forward_cached(self, q, k, v, cache, pos, n_rep, hd, theta):
+        """Static-shape KV-cache decode/prefill step (jit/scan friendly):
+        new k/v are written into the [B, Hkv, Lmax, D] cache at `pos`,
+        attention runs over the FULL cache with an absolute-position causal
+        mask (cols <= pos + t). No reference analog (Paddle 2.1 core has no
+        generation loop) — TPU-first inference parity-plus."""
+        k_cache, v_cache = cache
+
+        def attn_dec(qa, ka, va, kc, vc, pos_):
+            import jax.numpy as jnp
+            from jax import lax
+            B, T = qa.shape[0], qa.shape[1]
+            Lmax = kc.shape[2]
+            qh = jnp.swapaxes(qa.reshape(B, T, -1, hd), 1, 2)
+            kh = jnp.swapaxes(ka.reshape(B, T, -1, hd), 1, 2)
+            vh = jnp.swapaxes(va.reshape(B, T, -1, hd), 1, 2)
+            cos, sin = _rope_cos_sin(Lmax, hd, theta)
+            cos_t = lax.dynamic_slice_in_dim(cos, pos_, T, 0).astype(qh.dtype)
+            sin_t = lax.dynamic_slice_in_dim(sin, pos_, T, 0).astype(qh.dtype)
+            qh = _apply_rope(qh, cos_t, sin_t)
+            kh = _apply_rope(kh, cos_t, sin_t)
+            kc = lax.dynamic_update_slice(kc, kh.astype(kc.dtype),
+                                          (0, 0, pos_, 0))
+            vc = lax.dynamic_update_slice(vc, vh.astype(vc.dtype),
+                                          (0, 0, pos_, 0))
+            krep, vrep = kc, vc
+            if n_rep > 1:
+                krep = jnp.repeat(kc, n_rep, axis=1)
+                vrep = jnp.repeat(vc, n_rep, axis=1)
+            scale = 1.0 / (hd ** 0.5)
+            s = jnp.einsum("bhtd,bhld->bhtl", qh.astype(jnp.float32),
+                           krep.astype(jnp.float32)) * scale
+            col = jnp.arange(Lmax)
+            row_pos = pos_ + jnp.arange(T)
+            valid = col[None, :] <= row_pos[:, None]
+            s = jnp.where(valid[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("bhtl,bhld->bhtd", p,
+                             vrep.astype(jnp.float32)).astype(qa.dtype)
+            out = jnp.swapaxes(out, 1, 2).reshape(B, T, -1)
+            return out, kc, vc
+
+        ctx, new_k, new_v = apply(attn_dec, q, k, v, k_cache, v_cache, pos)
+        return self.o_proj(ctx), (new_k, new_v)
 
 
 class LlamaMLP(Layer):
@@ -193,7 +241,15 @@ class LlamaDecoderLayer(Layer):
         h = self.mlp(h)
         return residual + h
 
-    def forward(self, hidden):
+    def forward(self, hidden, cache=None, pos=None):
+        if cache is not None:
+            residual = hidden
+            h, new_cache = self.self_attn(self.input_layernorm(hidden),
+                                          cache=cache, pos=pos)
+            hidden = residual + h
+            hidden = hidden + self.mlp(
+                self.post_attention_layernorm(hidden))
+            return hidden, new_cache
         if self._use_recompute and self.training:
             from ..distributed.fleet.utils.recompute import recompute
             return recompute(self._block, hidden)
@@ -210,8 +266,14 @@ class LlamaModel(Layer):
                                  for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, pos=None):
         hidden = self.embed_tokens(input_ids)
+        if caches is not None:
+            new_caches = []
+            for layer, cache in zip(self.layers, caches):
+                hidden, nc = layer(hidden, cache=cache, pos=pos)
+                new_caches.append(nc)
+            return self.norm(hidden), new_caches
         for layer in self.layers:
             hidden = layer(hidden)
         return self.norm(hidden)
@@ -239,6 +301,25 @@ class LlamaForCausalLM(Layer):
             from ..tensor.math import mean
             return mean(loss)
         return logits
+
+    # ---- KV-cache generation (parity-plus; models/generation.py) ----
+    def init_cache(self, batch_size: int, max_len: int, dtype=None):
+        cfg = self.config
+        import jax.numpy as jnp
+        dt = dtype or self.llama.embed_tokens.weight.dtype
+        shape = (batch_size, cfg.num_key_value_heads, max_len, cfg.head_dim)
+        return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                for _ in range(cfg.num_hidden_layers)]
+
+    def forward_with_cache(self, input_ids, caches, pos):
+        hidden, new_caches = self.llama(input_ids, caches=caches, pos=pos)
+        return self.lm_head(hidden), new_caches
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, eos_token_id=None, seed=0):
+        from .generation import generate
+        return generate(self, input_ids, max_new_tokens, do_sample,
+                        temperature, top_k, eos_token_id, seed)
 
     # ---- pipeline-parallel segmentation protocol ----
     # (the LayerDesc/SharedLayerDesc contract of reference pp_layers.py:44-76,

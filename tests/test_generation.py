@@ -1,0 +1,119 @@
+"""KV-cache autoregressive generation (parity-plus — the reference core has
+only the beam-search decoder primitive; see models/generation.py)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models.gpt import GPTForCausalLM
+from paddle_tpu.models.llama import LlamaForCausalLM
+
+
+def _prompt(vocab, B=2, S=5, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(1, vocab, (B, S)).astype(np.int32)
+
+
+@pytest.mark.parametrize("family,preset", [
+    (LlamaForCausalLM, "llama2-tiny"),
+    (GPTForCausalLM, "gpt2-tiny"),
+])
+def test_prefill_logits_match_training_forward(family, preset):
+    """The cached prefill path must produce the same logits as the plain
+    forward (cache math == training math)."""
+    paddle.seed(0)
+    model = family.from_preset(preset)
+    model.eval()
+    ids = _prompt(model.config.vocab_size)
+    B, S = ids.shape
+    caches = model.init_cache(B, S + 4)
+    with paddle.no_grad():
+        logits_ref = model(Tensor(ids))
+        logits_cached, _ = model.forward_with_cache(
+            Tensor(ids), [(Tensor(k), Tensor(v)) for k, v in caches],
+            jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(logits_cached.data),
+                               np.asarray(logits_ref.data),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("family,preset", [
+    (LlamaForCausalLM, "llama2-tiny"),
+    (GPTForCausalLM, "gpt2-tiny"),
+])
+def test_greedy_generation_matches_full_recompute(family, preset):
+    """Cached greedy decode == the naive loop that re-runs the full forward
+    for every token (the no-cache oracle)."""
+    paddle.seed(0)
+    model = family.from_preset(preset)
+    model.eval()
+    ids = _prompt(model.config.vocab_size)
+    out = model.generate(ids, max_new_tokens=6)
+    out = np.asarray(out.data)
+
+    # oracle: argmax over the full forward, token by token
+    cur = ids.copy()
+    with paddle.no_grad():
+        for _ in range(6):
+            logits = np.asarray(model(Tensor(cur)).data)
+            nxt = logits[:, -1].argmax(-1).astype(np.int32)
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out, cur)
+
+
+def test_generate_shapes_and_prompt_preserved():
+    paddle.seed(0)
+    model = GPTForCausalLM.from_preset("gpt2-tiny")
+    ids = _prompt(model.config.vocab_size, B=3, S=4)
+    out = np.asarray(model.generate(ids, max_new_tokens=5).data)
+    assert out.shape == (3, 9)
+    np.testing.assert_array_equal(out[:, :4], ids)
+
+
+def test_generate_eos_padding():
+    paddle.seed(0)
+    model = GPTForCausalLM.from_preset("gpt2-tiny")
+    ids = _prompt(model.config.vocab_size)
+    # force eos immediately: eos = the greedy first token of row 0
+    first = np.asarray(model.generate(ids, max_new_tokens=1).data)[0, -1]
+    out = np.asarray(model.generate(ids, max_new_tokens=6,
+                                    eos_token_id=int(first)).data)
+    row = out[0, ids.shape[1]:]
+    assert (row == first).all()  # eos then padded with eos
+
+
+def test_sampling_reproducible_and_seed_sensitive():
+    paddle.seed(0)
+    model = GPTForCausalLM.from_preset("gpt2-tiny")
+    ids = _prompt(model.config.vocab_size)
+    a = np.asarray(model.generate(ids, max_new_tokens=8, do_sample=True,
+                                  temperature=1.5, top_k=20, seed=7).data)
+    b = np.asarray(model.generate(ids, max_new_tokens=8, do_sample=True,
+                                  temperature=1.5, top_k=20, seed=7).data)
+    c = np.asarray(model.generate(ids, max_new_tokens=8, do_sample=True,
+                                  temperature=1.5, top_k=20, seed=8).data)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_generate_zero_tokens_returns_prompt():
+    paddle.seed(0)
+    model = GPTForCausalLM.from_preset("gpt2-tiny")
+    ids = _prompt(model.config.vocab_size)
+    out = np.asarray(model.generate(ids, max_new_tokens=0).data)
+    np.testing.assert_array_equal(out, ids)
+
+
+def test_generate_jit_cache_reused():
+    paddle.seed(0)
+    model = GPTForCausalLM.from_preset("gpt2-tiny")
+    ids = _prompt(model.config.vocab_size)
+    model.generate(ids, max_new_tokens=3)
+    assert len(model.__dict__["_generate_jit_cache"]) == 1
+    model.generate(ids, max_new_tokens=3)   # same knobs: cache hit
+    assert len(model.__dict__["_generate_jit_cache"]) == 1
+    model.generate(ids, max_new_tokens=4)   # new knob: second entry
+    assert len(model.__dict__["_generate_jit_cache"]) == 2

@@ -156,3 +156,22 @@ def test_fleet_wrapper_legacy_api(tmp_path):
     np.testing.assert_allclose(fw.pull_sparse(7, np.array([1])), -0.5)
     fw.save_model(str(tmp_path))
     fw.stop_server()
+
+
+def test_distributed_lookup_table_op():
+    """pscore distributed_lookup_table op contract: pull on forward, sparse
+    push on backward, default client from the fleet runtime."""
+    from paddle_tpu.distributed.fleet.runtime import (
+        distributed_lookup_table)
+    fleet.init_server(n_shards=2)
+    fleet.run_server()
+    client = fleet.init_worker()
+    client.create_table("lt", 4, rule="sgd", lr=1.0, init_std=0.0)
+    ids = paddle.to_tensor(np.array([[1, 2], [2, 3]], np.int64))
+    out = distributed_lookup_table(ids, "lt")  # client resolved from fleet
+    assert tuple(out.shape) == (2, 2, 4)
+    out.sum().backward()  # hook pushes grads: rows 1,3 grad 1; row 2 grad 2
+    after = client.pull_sparse("lt", np.array([1, 2, 3]))
+    np.testing.assert_allclose(after[0], -1.0)
+    np.testing.assert_allclose(after[1], -2.0)
+    np.testing.assert_allclose(after[2], -1.0)
