@@ -440,3 +440,61 @@ def gather_tree(ids, parents):
         return rev[::-1]
 
     return apply(f, ids, parents)
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """add_position_encoding_op: out = alpha*x + beta*sinusoid(pos, dim)
+    over [B, S, D] (even dims sin, odd dims cos, Transformer convention)."""
+    x = _t(input)
+
+    def f(a):
+        B, S, D = a.shape
+        half = D // 2
+        pos = jnp.arange(S, dtype=jnp.float32)[:, None]
+        div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32)
+                        / max(half, 1))
+        angles = pos / div[None, :]          # [S, D/2]
+        enc = jnp.zeros((S, D), jnp.float32)
+        enc = enc.at[:, :half].set(jnp.sin(angles))
+        enc = enc.at[:, half:2 * half].set(jnp.cos(angles))
+        return (alpha * a.astype(jnp.float32)
+                + beta * enc[None]).astype(a.dtype)
+
+    return apply(f, x)
+
+
+def edit_distance(input, label, input_length=None, label_length=None,
+                  normalized=True, name=None):
+    """edit_distance_op: per-pair Levenshtein distance between token
+    sequences. input/label [B, S*] int (padded); lengths select the live
+    prefix. Host-side eager op (the reference kernel is CPU-only too).
+    Returns (distance [B, 1] float, sequence_num [1])."""
+    import numpy as np_
+    a = np_.asarray(_t(input).data)
+    b = np_.asarray(_t(label).data)
+    B = a.shape[0]
+    la = (np_.asarray(_t(input_length).data).astype(np_.int64)
+          if input_length is not None
+          else np_.full((B,), a.shape[1], np_.int64))
+    lb = (np_.asarray(_t(label_length).data).astype(np_.int64)
+          if label_length is not None
+          else np_.full((B,), b.shape[1], np_.int64))
+    out = np_.zeros((B, 1), np_.float32)
+    for i in range(B):
+        s, t = a[i, :la[i]], b[i, :lb[i]]
+        m, n = len(s), len(t)
+        dp = np_.arange(n + 1, dtype=np_.int64)
+        for r in range(1, m + 1):
+            prev_diag = dp[0]
+            dp[0] = r
+            for c in range(1, n + 1):
+                cur = dp[c]
+                dp[c] = min(dp[c] + 1, dp[c - 1] + 1,
+                            prev_diag + (0 if s[r - 1] == t[c - 1] else 1))
+                prev_diag = cur
+        d = float(dp[n])
+        if normalized:
+            d = d / max(float(n), 1.0)
+        out[i, 0] = d
+    from ...tensor.creation import to_tensor
+    return to_tensor(out), to_tensor(np_.asarray([B], np_.int64))

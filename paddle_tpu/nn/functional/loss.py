@@ -386,3 +386,130 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         return jnp.sum(ce, axis=-1, keepdims=True)
 
     return apply(f, *args)
+
+
+def linear_chain_crf(emission, label, transition, length=None):
+    """Linear-chain CRF negative log-likelihood
+    (linear_chain_crf_op.cc). emission [B, S, T]; label [B, S] int;
+    transition [T+2, T] with row 0 = start scores, row 1 = stop scores,
+    rows 2.. = tag->tag transitions (the reference's parameter layout).
+    length [B] masks padded steps. Returns nll [B] (sum over sequences is
+    the training loss); differentiable w.r.t. emission and transition."""
+    emission, label = _t(emission), _t(label)
+    transition = _t(transition)
+    args = [emission, label, transition]
+    if length is not None:
+        args.append(_t(length))
+
+    def f(em, lab, trans, *maybe_len):
+        B, S, T = em.shape
+        em = em.astype(jnp.float32)
+        trans = trans.astype(jnp.float32)
+        start, stop, trans_tt = trans[0], trans[1], trans[2:]
+        lens = (maybe_len[0].astype(jnp.int32) if maybe_len
+                else jnp.full((B,), S, jnp.int32))
+        lab = lab.astype(jnp.int32)
+
+        # ---- log partition via forward algorithm ----
+        alpha0 = start[None, :] + em[:, 0]          # [B, T]
+
+        def fwd(alpha, t):
+            # [B, T, T']: alpha[i] + trans[i, j] + em[t, j]
+            scores = alpha[:, :, None] + trans_tt[None] + \
+                em[:, t][:, None, :]
+            new_alpha = jax.nn.logsumexp(scores, axis=1)
+            keep = (t < lens)[:, None]
+            return jnp.where(keep, new_alpha, alpha), None
+
+        alpha, _ = jax.lax.scan(fwd, alpha0, jnp.arange(1, S))
+        last_tag_scores = alpha + stop[None, :]
+        logz = jax.nn.logsumexp(last_tag_scores, axis=1)   # [B]
+
+        # ---- gold path score ----
+        pos = jnp.arange(S)[None, :]
+        valid = pos < lens[:, None]
+        em_score = jnp.sum(
+            jnp.where(valid,
+                      jnp.take_along_axis(em, lab[..., None], -1)[..., 0],
+                      0.0), axis=1)
+        prev, cur = lab[:, :-1], lab[:, 1:]
+        tvalid = pos[:, 1:] < lens[:, None]
+        t_score = jnp.sum(
+            jnp.where(tvalid, trans_tt[prev, cur], 0.0), axis=1)
+        first = lab[:, 0]
+        last = jnp.take_along_axis(lab, (lens - 1)[:, None], 1)[:, 0]
+        gold = em_score + t_score + start[first] + stop[last]
+        return logz - gold
+
+    return apply(f, *args)
+
+
+def crf_decoding(emission, transition, length=None):
+    """Viterbi decode (crf_decoding_op.cc): returns the max-score tag path
+    [B, S] under the linear_chain_crf parameterization (padded steps 0)."""
+    emission = _t(emission)
+    transition = _t(transition)
+    args = [emission, transition]
+    if length is not None:
+        args.append(_t(length))
+
+    def f(em, trans, *maybe_len):
+        B, S, T = em.shape
+        em = em.astype(jnp.float32)
+        trans = trans.astype(jnp.float32)
+        start, stop, trans_tt = trans[0], trans[1], trans[2:]
+        lens = (maybe_len[0].astype(jnp.int32) if maybe_len
+                else jnp.full((B,), S, jnp.int32))
+        alpha0 = start[None, :] + em[:, 0]
+
+        def step(alpha, t):
+            scores = alpha[:, :, None] + trans_tt[None] + \
+                em[:, t][:, None, :]
+            best_prev = jnp.argmax(scores, axis=1)          # [B, T]
+            new_alpha = jnp.max(scores, axis=1)
+            keep = (t < lens)[:, None]
+            return (jnp.where(keep, new_alpha, alpha),
+                    jnp.where(keep, best_prev, -1))
+
+        alpha, back = jax.lax.scan(step, alpha0, jnp.arange(1, S))
+        # back: [S-1, B, T]; final tag maximizes alpha + stop at each len
+        last = jnp.argmax(alpha + stop[None, :], axis=1)    # [B]
+
+        def backtrace(carry, t):
+            tag = carry  # [B]
+            bp = back[t]  # [B, T]
+            prev = jnp.take_along_axis(bp, tag[:, None], 1)[:, 0]
+            in_range = (t + 1) < lens
+            new_tag = jnp.where(in_range & (prev >= 0), prev, tag)
+            return new_tag, new_tag
+
+        _, path_rev = jax.lax.scan(backtrace, last,
+                                   jnp.arange(S - 2, -1, -1))
+        path = jnp.concatenate(
+            [jnp.flip(jnp.swapaxes(path_rev, 0, 1), 1), last[:, None]],
+            axis=1)
+        pos = jnp.arange(S)[None, :]
+        return jnp.where(pos < lens[:, None], path, 0).astype(jnp.int64)
+
+    return apply(f, *args)
+
+
+def center_loss(input, label, centers, alpha=0.5, update_centers=True):
+    """center_loss_op: 0.5 * ||x - c_y||^2 per sample, plus the center
+    SGD-style update c_y += alpha * mean(x - c_y) over the batch. Returns
+    (loss [B], new_centers) — thread new_centers back as the next step's
+    buffer (functional analog of the op's in-place CenterUpdate)."""
+    x, y, c = _t(input), _t(label), _t(centers)
+
+    def f(xa, ya, ca):
+        ya = ya.astype(jnp.int32).reshape(-1)
+        diff = xa.astype(jnp.float32) - ca[ya].astype(jnp.float32)
+        loss = 0.5 * jnp.sum(diff * diff, axis=1)
+        if not update_centers:
+            return loss, ca
+        counts = jnp.zeros((ca.shape[0],), jnp.float32).at[ya].add(1.0)
+        sums = jnp.zeros_like(ca, dtype=jnp.float32).at[ya].add(diff)
+        upd = alpha * sums / jnp.maximum(counts, 1.0)[:, None]
+        return loss, (ca.astype(jnp.float32) + upd).astype(ca.dtype)
+
+    return apply(f, x, y, c)

@@ -378,3 +378,15 @@ def fill_(x, value):
 def zero_(x):
     t = _t(x)
     return _overwrite_inplace(t, jnp.zeros_like, "zero_")
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """clip_by_norm_op: scale x so its L2 norm is at most max_norm."""
+    t = _t(x)
+
+    def f(a):
+        n = jnp.sqrt(jnp.sum(jnp.square(a.astype(jnp.float32))))
+        scale = jnp.minimum(max_norm / jnp.maximum(n, 1e-12), 1.0)
+        return (a.astype(jnp.float32) * scale).astype(a.dtype)
+
+    return apply(f, t)

@@ -1,0 +1,124 @@
+"""linear_chain_crf / crf_decoding / edit_distance / center_loss /
+add_position_encoding / clip_by_norm (reference: linear_chain_crf_op.cc,
+crf_decoding_op.cc, edit_distance_op.cc, center_loss_op.cc,
+add_position_encoding_op.cc, clip_by_norm_op.cc)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+
+def _brute_force_crf(em, trans, lab=None):
+    """Enumerate all paths: returns (logZ, best_path, gold_score)."""
+    S, T = em.shape
+    start, stop, tt = trans[0], trans[1], trans[2:]
+    scores = {}
+    for path in itertools.product(range(T), repeat=S):
+        sc = start[path[0]] + stop[path[-1]] + sum(em[i, path[i]]
+                                                   for i in range(S))
+        sc += sum(tt[path[i], path[i + 1]] for i in range(S - 1))
+        scores[path] = sc
+    logz = np.logaddexp.reduce(np.asarray(list(scores.values())))
+    best = max(scores, key=scores.get)
+    gold = scores[tuple(lab)] if lab is not None else None
+    return logz, np.asarray(best), gold
+
+
+def test_linear_chain_crf_nll_matches_brute_force():
+    rng = np.random.RandomState(0)
+    S, T = 4, 3
+    em = rng.randn(2, S, T).astype(np.float32)
+    trans = rng.randn(T + 2, T).astype(np.float32)
+    lab = rng.randint(0, T, (2, S)).astype(np.int32)
+    nll = F.linear_chain_crf(paddle.to_tensor(em), paddle.to_tensor(lab),
+                             paddle.to_tensor(trans))
+    for b in range(2):
+        logz, _, gold = _brute_force_crf(em[b], trans, lab[b])
+        np.testing.assert_allclose(np.asarray(nll.data)[b], logz - gold,
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_linear_chain_crf_respects_lengths():
+    rng = np.random.RandomState(1)
+    S, T = 5, 3
+    em = rng.randn(1, S, T).astype(np.float32)
+    trans = rng.randn(T + 2, T).astype(np.float32)
+    lab = rng.randint(0, T, (1, S)).astype(np.int32)
+    # length 3: must equal the brute force over the 3-step prefix
+    nll = F.linear_chain_crf(paddle.to_tensor(em), paddle.to_tensor(lab),
+                             paddle.to_tensor(trans),
+                             length=paddle.to_tensor(np.array([3])))
+    logz, _, gold = _brute_force_crf(em[0, :3], trans, lab[0, :3])
+    np.testing.assert_allclose(np.asarray(nll.data)[0], logz - gold,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_crf_decoding_matches_brute_force():
+    rng = np.random.RandomState(2)
+    S, T = 4, 3
+    em = rng.randn(2, S, T).astype(np.float32)
+    trans = rng.randn(T + 2, T).astype(np.float32)
+    path = F.crf_decoding(paddle.to_tensor(em), paddle.to_tensor(trans))
+    for b in range(2):
+        _, best, _ = _brute_force_crf(em[b], trans)
+        np.testing.assert_array_equal(np.asarray(path.data)[b], best)
+
+
+def test_crf_grads_flow():
+    rng = np.random.RandomState(3)
+    em = paddle.to_tensor(rng.randn(2, 3, 4).astype(np.float32))
+    em.stop_gradient = False
+    trans = paddle.to_tensor(rng.randn(6, 4).astype(np.float32))
+    trans.stop_gradient = False
+    lab = paddle.to_tensor(rng.randint(0, 4, (2, 3)).astype(np.int32))
+    F.linear_chain_crf(em, lab, trans).sum().backward()
+    assert em.grad is not None and trans.grad is not None
+    assert np.isfinite(np.asarray(em.grad.data)).all()
+
+
+def test_edit_distance():
+    a = paddle.to_tensor(np.array([[1, 2, 3, 0], [5, 5, 5, 5]], np.int64))
+    b = paddle.to_tensor(np.array([[1, 3, 3], [5, 5, 5]], np.int64))
+    la = paddle.to_tensor(np.array([3, 4]))
+    lb = paddle.to_tensor(np.array([3, 3]))
+    d, n = F.edit_distance(a, b, la, lb, normalized=False)
+    np.testing.assert_allclose(np.asarray(d.data)[:, 0], [1.0, 1.0])
+    dn, _ = F.edit_distance(a, b, la, lb, normalized=True)
+    np.testing.assert_allclose(np.asarray(dn.data)[:, 0], [1 / 3, 1 / 3])
+    assert int(np.asarray(n.data)[0]) == 2
+
+
+def test_center_loss_updates_centers():
+    rng = np.random.RandomState(4)
+    x = paddle.to_tensor(rng.randn(4, 3).astype(np.float32))
+    y = paddle.to_tensor(np.array([0, 0, 1, 2]))
+    c = paddle.to_tensor(np.zeros((3, 3), np.float32))
+    loss, new_c = F.center_loss(x, y, c, alpha=1.0)
+    np.testing.assert_allclose(
+        np.asarray(loss.data),
+        0.5 * (np.asarray(x.data) ** 2).sum(1), rtol=1e-5)
+    xc = np.asarray(x.data)
+    np.testing.assert_allclose(np.asarray(new_c.data)[0],
+                               xc[:2].mean(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_c.data)[1], xc[2], rtol=1e-5)
+
+
+def test_add_position_encoding():
+    x = paddle.to_tensor(np.zeros((1, 3, 4), np.float32))
+    out = np.asarray(F.add_position_encoding(x, alpha=1.0, beta=1.0).data)
+    # position 0: sin(0)=0 for first half, cos(0)=1 for second half
+    np.testing.assert_allclose(out[0, 0], [0, 0, 1, 1], atol=1e-6)
+    assert not np.allclose(out[0, 1], out[0, 2])
+
+
+def test_clip_by_norm():
+    x = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    out = np.asarray(paddle.clip_by_norm(x, 1.0).data)
+    np.testing.assert_allclose(np.linalg.norm(out), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(out, [0.6, 0.8], rtol=1e-5)
+    small = paddle.to_tensor(np.array([0.3, 0.4], np.float32))
+    np.testing.assert_allclose(np.asarray(paddle.clip_by_norm(small, 1.0).data),
+                               [0.3, 0.4], rtol=1e-6)
