@@ -226,8 +226,18 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
         flops_per_step = 3.0 * fwd_flops * B
     elif moe_E:
         top_k = getattr(cfg, "moe_top_k", 2)
+        # expert params come from the MoELayer module structure (all its
+        # params minus the gate) — not from key substring matching, which a
+        # renamed expert/gate param would silently skew
+        from paddle_tpu.nn.layer.moe import MoELayer
+        expert_keys = set()
+        for lname, sub in model.named_sublayers():
+            if isinstance(sub, MoELayer):
+                for pname, _ in sub.named_parameters(prefix=lname):
+                    if not pname.endswith("gate_weight"):
+                        expert_keys.add(pname)
         expert = sum(int(np.prod(p.shape)) for k, p in params.items()
-                     if ".moe.w" in k or ".moe.b" in k)
+                     if k in expert_keys)
         n_active = n_params - expert + expert * top_k // moe_E
         flops_per_step = 6.0 * n_active * tokens_per_step
     else:

@@ -94,16 +94,27 @@ class SparseTable:
                     self._slots[k] = slot
 
     def state(self):
+        """Rows AND optimizer slots: the reference's common sparse table
+        persists optimizer columns (g2sum) with the row values, so a
+        save/load roundtrip must not reset AdaGrad accumulators."""
         with self._lock:
             ids = np.asarray(sorted(self._rows), np.int64)
             vals = np.stack([self._rows[int(i)] for i in ids]) if len(ids) \
                 else np.zeros((0, self.dim), np.float32)
-        return ids, vals
+            slot_ids = np.asarray(sorted(self._slots), np.int64)
+            slot_vals = np.stack(
+                [self._slots[int(i)] for i in slot_ids]) if len(slot_ids) \
+                else np.zeros((0, self.dim), np.float32)
+        return ids, vals, slot_ids, slot_vals
 
-    def load_state(self, ids, vals):
+    def load_state(self, ids, vals, slot_ids=None, slot_vals=None):
         with self._lock:
             for i, key in enumerate(np.asarray(ids, np.int64)):
                 self._rows[int(key)] = np.asarray(vals[i], np.float32)
+            if slot_ids is not None:
+                for i, key in enumerate(np.asarray(slot_ids, np.int64)):
+                    self._slots[int(key)] = np.asarray(slot_vals[i],
+                                                       np.float32)
 
 
 class PSCore:
@@ -123,10 +134,11 @@ class PSCore:
         import os
         os.makedirs(dirname, exist_ok=True)
         for name, t in self.tables.items():
-            ids, vals = t.state()
+            ids, vals, slot_ids, slot_vals = t.state()
             acc = t.accessor
             np.savez(os.path.join(dirname, f"{name}.npz"), ids=ids,
-                     vals=vals, dim=t.dim, rule=acc.rule, lr=acc.lr,
+                     vals=vals, slot_ids=slot_ids, slot_vals=slot_vals,
+                     dim=t.dim, rule=acc.rule, lr=acc.lr,
                      epsilon=acc.epsilon, init_std=t.init_std, seed=t.seed)
 
 
@@ -325,6 +337,12 @@ class TheOnePSRuntime:
                                      float(data["epsilon"]))
                 ids = np.asarray(data["ids"], np.int64)
                 vals = data["vals"]
+                # pre-r4 checkpoints lack slot arrays (AdaGrad state was
+                # not persisted); treat as empty rather than failing
+                slot_ids = np.asarray(data["slot_ids"], np.int64) \
+                    if "slot_ids" in data else np.zeros((0,), np.int64)
+                slot_vals = data["slot_vals"] if "slot_vals" in data \
+                    else np.zeros((0, int(data["dim"])), np.float32)
                 init_std = float(data["init_std"]) \
                     if "init_std" in data else 0.01
                 seed0 = int(data["seed"]) if "seed" in data else 0
@@ -334,8 +352,10 @@ class TheOnePSRuntime:
                         init_std=init_std, seed=seed0 + core_idx)
                     table.accessor = acc
                     sel = ids % n == core_idx
-                    if sel.any():
-                        table.load_state(ids[sel], vals[sel])
+                    ssel = slot_ids % n == core_idx
+                    if sel.any() or ssel.any():
+                        table.load_state(ids[sel], vals[sel],
+                                         slot_ids[ssel], slot_vals[ssel])
 
     def stop(self):
         for s in self.servers:

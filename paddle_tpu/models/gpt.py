@@ -161,7 +161,7 @@ class GPTDecoderLayer(Layer):
         """Returns (x, aux_loss): the MoE aux loss must flow through the
         function OUTPUT (not a layer attribute) so it survives recompute /
         jax.checkpoint retracing."""
-        x = x + self.self_attn(self.norm1(x))
+        x = x + self.dropout(self.self_attn(self.norm1(x)))
         if self.use_moe:
             h = self.moe(self.norm2(x))
             aux = self.moe.aux_loss
@@ -179,7 +179,9 @@ class GPTDecoderLayer(Layer):
                     "KV-cache decode is not wired through MoE layers yet")
             h, new_cache = self.self_attn(self.norm1(x), cache=cache,
                                           pos=pos)
-            x = x + h
+            # same dropout as the training forward (identity in eval), so
+            # forward_with_cache on a training-mode model matches forward()
+            x = x + self.dropout(h)
             h = self.linear1(self.norm2(x))
             h = apply(lambda a: jax.nn.gelu(a), h)
             x = x + self.dropout(self.linear2(h))
@@ -225,7 +227,8 @@ class GPTModel(Layer):
                 pos if isinstance(pos, Tensor) else Tensor(pos))
             hidden = self.word_embeddings(input_ids) + \
                 self.position_embeddings(pos_ids)
-            new_caches = []
+            hidden = self.dropout(hidden)  # identity in eval; parity with
+            new_caches = []                # the training forward
             for layer, cache in zip(self.layers, caches):
                 hidden, nc = layer(hidden, cache=cache, pos=pos)
                 new_caches.append(nc)
@@ -300,6 +303,14 @@ class GPTForCausalLM(Layer):
     # ---- KV-cache generation (parity-plus; models/generation.py) ----
     def init_cache(self, batch_size: int, max_len: int, dtype=None):
         cfg = self.config
+        if max_len > cfg.max_position_embeddings:
+            # jnp.take clamps out-of-range position ids, so decoding past
+            # the learned position table would silently reuse the last
+            # position embedding instead of erroring
+            raise ValueError(
+                f"init_cache: max_len={max_len} exceeds "
+                f"max_position_embeddings={cfg.max_position_embeddings}; "
+                "GPT's learned position table cannot decode past it")
         dt = dtype or self.gpt.word_embeddings.weight.dtype
         shape = (batch_size, cfg.num_attention_heads, max_len, cfg.head_dim)
         return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))

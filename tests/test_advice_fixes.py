@@ -373,3 +373,128 @@ def test_dynamic_batch_constant_output_passes_through():
         outs = pred.run([np.ones((6, 4), np.float32)])
         assert outs[0].shape[0] == 6
         assert outs[1].shape == (4, 2)
+
+
+# ---- round-4 advisor findings ----
+
+def test_ps_adagrad_slots_survive_save_load(tmp_path):
+    """ADVICE r3 medium: a PS save/load roundtrip must persist AdaGrad
+    accumulators — otherwise the effective per-row LR silently resets."""
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import (
+        TheOnePSRuntime)
+    ids = np.array([3, 7], np.int64)
+    g = np.ones((2, 4), np.float32)
+
+    def push_twice(rt, roundtrip):
+        rt.client.create_table("emb", 4, rule="adagrad", lr=0.1)
+        rt.client.pull_sparse("emb", ids)
+        rt.client.push_sparse("emb", ids, g)
+        if roundtrip:
+            d = str(tmp_path / "ckpt")
+            rt.save(d)
+            rt = TheOnePSRuntime(n_shards=3)  # re-shard on load too
+            rt.load(d)
+        rt.client.push_sparse("emb", ids, g)
+        return rt.client.pull_sparse("emb", ids)
+
+    cont = push_twice(TheOnePSRuntime(n_shards=2), roundtrip=False)
+    saved = push_twice(TheOnePSRuntime(n_shards=2), roundtrip=True)
+    np.testing.assert_allclose(saved, cont, rtol=1e-6, atol=1e-7)
+
+
+def test_gpt_init_cache_position_bound():
+    """ADVICE r3 low: decoding past the learned position table must raise,
+    not silently clamp to the last position embedding."""
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    model = GPTForCausalLM.from_preset("gpt2-tiny",
+                                       max_position_embeddings=16)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        model.init_cache(1, 32)
+    model.init_cache(1, 16)  # at the bound: fine
+
+
+def test_gpt_cached_forward_dropout_parity_training():
+    """ADVICE r3 low: forward_with_cache on a training-mode model must
+    apply the SAME dropout calls (embedding + both residual branches) as
+    forward(). With p=0.5 and a reset seed, identical call order/shapes
+    draw identical masks, so the logits must agree exactly — a missing or
+    extra dropout call desynchronizes the RNG stream and the test fails."""
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    model = GPTForCausalLM.from_preset("gpt2-tiny",
+                                       hidden_dropout_prob=0.5)
+    model.train()
+    ids = Tensor(np.arange(6, dtype=np.int64)[None, :])
+    paddle.seed(1234)
+    ref = np.asarray(model(ids).data)
+    # sanity: the run is genuinely stochastic (different seed => different)
+    paddle.seed(99)
+    other = np.asarray(model(ids).data)
+    assert not np.allclose(ref, other)
+    paddle.seed(1234)
+    caches = model.init_cache(1, 8)
+    logits, _ = model.forward_with_cache(ids, caches, 0)
+    np.testing.assert_allclose(np.asarray(logits.data), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_dynamic_batch_single_padded_chunk_constant_ok():
+    """ADVICE r3 low: batch < exported batch with a chunk-invariant
+    constant output must pass (probed with duplicated-row padding), while
+    a batch reduction must still raise."""
+    import tempfile, os
+    from paddle_tpu.inference import export_model, load_predictor
+
+    class Const(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(5, 2)
+
+        def forward(self, x):
+            return self.lin(x), self.lin.weight * 1.0
+
+    class Reduce(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(5, 2)
+
+        def forward(self, x):
+            return self.lin(x), self.lin(x).mean()
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "c")
+        export_model(Const(), [Tensor(np.ones((4, 5), np.float32))], path)
+        pred = load_predictor(path)
+        outs = pred.run([np.ones((1, 5), np.float32)])  # batch 1 < 4
+        assert outs[0].shape[0] == 1
+        assert outs[1].shape == (5, 2)
+
+        path = os.path.join(d, "r")
+        export_model(Reduce(), [Tensor(np.ones((4, 5), np.float32))], path)
+        pred = load_predictor(path)
+        with pytest.raises(ValueError, match="non-batched"):
+            pred.run([np.ones((1, 5), np.float32)])
+
+
+def test_dynamic_batch_zero_warmup_reduction_still_raises():
+    """A zeros warmup batch must not latch a batch reduction as
+    pad-invariant: the probe perturbs padding rows (+1), so the reduction
+    is caught even when the real rows are all-zero."""
+    import tempfile, os
+    from paddle_tpu.inference import export_model, load_predictor
+
+    class Reduce(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(5, 2)
+
+        def forward(self, x):
+            return self.lin(x), self.lin(x).mean()
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "r")
+        export_model(Reduce(), [Tensor(np.ones((4, 5), np.float32))], path)
+        pred = load_predictor(path)
+        with pytest.raises(ValueError, match="non-batched"):
+            pred.run([np.zeros((1, 5), np.float32)])  # zeros warmup
+        with pytest.raises(ValueError, match="non-batched"):
+            pred.run([np.ones((1, 5), np.float32)])   # still raises after

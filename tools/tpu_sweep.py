@@ -91,7 +91,7 @@ def main():
         os.replace(OUT + ".tmp", OUT)  # atomic: a crash can't truncate
         if "error" in row and "hung" in row.get("error", ""):
             print("tunnel died mid-sweep; stopping", flush=True)
-            break
+            sys.exit(2)  # partial sweep: callers must not report success
 
 
 if __name__ == "__main__":
