@@ -84,6 +84,51 @@ def moe_forward(x, gate_w, w1, b1, w2, b2, top_k, capacity_factor,
     return out.reshape(B, S, H), aux.astype(jnp.float32)
 
 
+def moe_forward_ep(x, gate_w, w1, b1, w2, b2, top_k, capacity_factor,
+                   activation=jax.nn.gelu, axis=EXPERT_AXIS):
+    """Explicit expert-parallel MoE for MAPPED mesh axes (inside shard_map,
+    where GSPMD cannot insert the all_to_all): the GShard dispatch done by
+    hand. Each ep rank holds its local tokens [B_local, S, H] and its local
+    experts w1 [E_local, H, F]; routing runs over the full E, then a tiled
+    lax.all_to_all exchanges token buffers so every rank computes exactly
+    its own experts over everyone's tokens, and the inverse all_to_all
+    brings the results home (all_to_all is a permutation collective — its
+    AD transpose is the inverse permutation, so grads are exact; expert-
+    weight grads already sum over ALL ranks' tokens locally and need no
+    cross-ep reduction).
+
+    Reference anchor: collective.py:1456 alltoall is the one MoE primitive
+    the reference ships; this is its production use, Switch/GShard-style.
+    """
+    ep_n = jax.lax.psum(1, axis)  # static axis size
+    B, S, H = x.shape
+    E_local = w1.shape[0]
+    E = E_local * ep_n
+    T = B * S  # local tokens
+    xt = x.reshape(T, H)
+    logits = (xt.astype(jnp.float32) @ gate_w.astype(jnp.float32))
+    gates = jax.nn.softmax(logits, axis=-1)
+    capacity = max(int(capacity_factor * T * top_k / E), top_k)
+    dispatch, combine, aux = _top_k_dispatch(gates, capacity, top_k)
+    # local token → full-E buffers [E, C, H]
+    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
+    # exchange: split E into ep groups, concat on capacity → each rank now
+    # holds [E_local, ep_n*C, H]: its experts, everyone's tokens
+    expert_in = jax.lax.all_to_all(expert_in, axis, split_axis=0,
+                                   concat_axis=1, tiled=True)
+    h = activation(jnp.einsum("ech,ehf->ecf", expert_in, w1)
+                   + b1[:, None, :].astype(x.dtype))
+    expert_out = jnp.einsum("ecf,efh->ech", h, w2) \
+        + b2[:, None, :].astype(x.dtype)
+    # inverse exchange: results home to the token-owning ranks [E, C, H]
+    expert_out = jax.lax.all_to_all(expert_out, axis, split_axis=1,
+                                    concat_axis=0, tiled=True)
+    out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
+    # aux is computed from local tokens only; the caller averages over the
+    # ep (data) axis like any other batch statistic
+    return out.reshape(B, S, H), aux.astype(jnp.float32)
+
+
 class MoELayer(Layer):
     """paddle.incubate-style MoE FFN (gate + stacked experts).
 
@@ -118,9 +163,15 @@ class MoELayer(Layer):
 
     def forward(self, x):
         top_k, cf, act = self.top_k, self.capacity_factor, self._act
+        # inside a shard_map with the ep axis mapped (pipeline stage fns),
+        # GSPMD can't insert the all_to_all — take the explicit path on the
+        # rank-local expert shards (mp_layers' axis_context pattern)
+        from ...distributed.collective import current_axes, in_axis_context
+        explicit_ep = in_axis_context() and EXPERT_AXIS in current_axes()
+        fwd = moe_forward_ep if explicit_ep else moe_forward
 
         def f(xa, gw, w1, b1, w2, b2):
-            return moe_forward(xa, gw, w1, b1, w2, b2, top_k, cf, act)
+            return fwd(xa, gw, w1, b1, w2, b2, top_k, cf, act)
 
         out, aux = apply(f, x, self.gate_weight, self.w1, self.b1, self.w2,
                          self.b2)

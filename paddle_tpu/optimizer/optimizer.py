@@ -228,7 +228,7 @@ class Optimizer:
         else:
             wd = float(self._weight_decay)
 
-        def apply_fn(params, grads, state, lr, step):
+        def apply_fn(params, grads, state, lr, step, norm_meta=None):
             new_params, new_state = {}, {}
             for k, p in params.items():
                 g = grads.get(k)
@@ -238,9 +238,17 @@ class Optimizer:
                     continue
                 ctx_slots = dict(state[k])
                 ctx_slots["_step"] = step
+                if norm_meta is not None and k in norm_meta:
+                    # distributed layout hint for norm-based rules
+                    # (Lamb/LARS): mesh axes sharding this leaf + leading
+                    # stacked-layer batch dims (see _dist_norm)
+                    axes, bd = norm_meta[k]
+                    ctx_slots["_norm_axes"] = axes
+                    ctx_slots["_norm_batch_dims"] = bd
                 np_, ns_ = self._rule_mp(self._reg_grad(g, p), p, ctx_slots,
                                          lr, wd)
-                ns_.pop("_step", None)
+                for extra in ("_step", "_norm_axes", "_norm_batch_dims"):
+                    ns_.pop(extra, None)
                 new_params[k] = np_
                 new_state[k] = ns_
             return new_params, new_state
@@ -524,6 +532,28 @@ class Adadelta(Optimizer):
             {"avg_squared_grad": asg, "avg_squared_update": asu}
 
 
+def _dist_norm(x, batch_dims, axes):
+    """L2 norm of a possibly-sharded, possibly layer-stacked tensor.
+
+    `axes`: mesh axis names whose shards this leaf is split over (model/
+    sharding/ep) — the squared sum is lax.psum'd over them so trust ratios
+    see WHOLE-parameter norms (HybridParallelClipGrad's cross-group
+    allreduce, applied to the optimizer rule; reference
+    hybrid_parallel_optimizer.py:32). `batch_dims`: leading dims that stack
+    independent per-layer params (the pipeline's [pipe, per_stage, ...]
+    leaves) — norms are taken per layer row and broadcast, matching eager
+    per-parameter semantics."""
+    from jax import lax
+    if batch_dims:
+        sq = jnp.sum(jnp.square(x), axis=tuple(range(batch_dims, x.ndim)),
+                     keepdims=True)
+    else:
+        sq = jnp.sum(jnp.square(x))
+    for ax in axes or ():
+        sq = lax.psum(sq, ax)
+    return jnp.sqrt(sq)
+
+
 class Lamb(Optimizer):
     """LAMB (reference: operators/optimizers/lamb_op.cu, lamb meta-optimizer)."""
 
@@ -547,6 +577,8 @@ class Lamb(Optimizer):
         return float(self._weight_decay)
 
     def _rule(self, g, p, slots, lr, wd):
+        norm_axes = slots.pop("_norm_axes", ())
+        batch_dims = slots.pop("_norm_batch_dims", 0)
         g = g.astype(jnp.float32)
         p32 = p.astype(jnp.float32)
         b1, b2 = self._beta1, self._beta2
@@ -557,8 +589,8 @@ class Lamb(Optimizer):
         m1h = m1 / (1 - b1p)
         m2h = m2 / (1 - b2p)
         r = m1h / (jnp.sqrt(m2h) + self._epsilon) + wd * p32
-        w_norm = jnp.linalg.norm(p32)
-        r_norm = jnp.linalg.norm(r)
+        w_norm = _dist_norm(p32, batch_dims, norm_axes)
+        r_norm = _dist_norm(r, batch_dims, norm_axes)
         trust = jnp.where(w_norm > 0, jnp.where(r_norm > 0, w_norm / r_norm,
                                                 1.0), 1.0)
         new_p = (p32 - lr * trust * r).astype(p.dtype)
@@ -582,10 +614,12 @@ class LarsMomentum(Optimizer):
         return {"velocity": jnp.zeros(p.shape, jnp.float32)}
 
     def _rule(self, g, p, slots, lr, wd):
+        norm_axes = slots.pop("_norm_axes", ())
+        batch_dims = slots.pop("_norm_batch_dims", 0)
         g = g.astype(jnp.float32)
         p32 = p.astype(jnp.float32)
-        p_norm = jnp.linalg.norm(p32)
-        g_norm = jnp.linalg.norm(g)
+        p_norm = _dist_norm(p32, batch_dims, norm_axes)
+        g_norm = _dist_norm(g, batch_dims, norm_axes)
         local_lr = jnp.where(
             (p_norm > 0) & (g_norm > 0),
             self._lars_coeff * p_norm / (g_norm + wd * p_norm + self._epsilon),

@@ -487,12 +487,6 @@ def parallelize(model: Layer, optimizer=None, mesh: Optional[Mesh] = None,
             cfg = getattr(strategy, "pipeline_configs", None)
             if cfg is not None and getattr(cfg, "accumulate_steps", 0) >= 1:
                 n_micro = cfg.accumulate_steps
-            if plan.zero_stage >= 2:
-                import warnings
-                warnings.warn(
-                    "pp x ZeRO composes as optimizer-state sharding "
-                    "(stage-1 semantics): gradients stay replicated across "
-                    "the sharding axis on the pipeline path", stacklevel=2)
         return PipelinedTrainStep(model, plan.optimizer or optimizer, mesh,
                                   n_micro=n_micro,
                                   zero_stage=plan.zero_stage,

@@ -65,16 +65,29 @@ def is_pipeline_stackable(model) -> bool:
                 "pipe_head"))
 
 
-def make_stage_fn(layer_fn: Callable, remat: bool = True):
+def make_stage_fn(layer_fn: Callable, remat: bool = True,
+                  with_aux: bool = False):
     """One stage segment: scan layer_fn over the [per_stage, ...] param rows.
-    Shared by the GPipe and 1F1B schedules."""
+    Shared by the GPipe and 1F1B schedules. With `with_aux`, layer_fn
+    returns (h, aux) and the stage returns (out, summed aux) — the MoE
+    load-balance loss rides the scan carry instead of being dropped."""
 
-    def stage_fn(params, x):
-        def body(h, layer_params):
-            return layer_fn(layer_params, h), None
+    if with_aux:
+        def stage_fn(params, x):
+            def body(carry, layer_params):
+                h, aux = carry
+                h2, a = layer_fn(layer_params, h)
+                return (h2, aux + a.astype(jnp.float32)), None
 
-        out, _ = lax.scan(body, x, params)
-        return out
+            (out, aux), _ = lax.scan(body, (x, jnp.float32(0.0)), params)
+            return out, aux
+    else:
+        def stage_fn(params, x):
+            def body(h, layer_params):
+                return layer_fn(layer_params, h), None
+
+            out, _ = lax.scan(body, x, params)
+            return out
 
     return jax.checkpoint(stage_fn) if remat else stage_fn
 
@@ -153,23 +166,33 @@ def pipeline_apply(layer_fn: Callable, stage_params, microbatches,
 
 def run_1f1b(stage_fn: Callable, embed_fn: Callable, head_loss_fn: Callable,
              local_params, rest, ids_mb, labels_mb, n_micro: int,
-             n_stages: int, axis: str = PIPE_AXIS):
+             n_stages: int, axis: str = PIPE_AXIS, with_aux: bool = False,
+             aux_ct_scale=0.0):
     """One 1F1B sweep. MUST run inside shard_map with `axis` mapped.
 
     stage_fn(local_params, x) -> x          one stage's layer segment
+                 (-> (x, aux) when with_aux: MoE load-balance loss)
     embed_fn(rest, ids) -> x                token ids -> hidden states
     head_loss_fn(rest, x, labels) -> scalar per-microbatch MEAN loss
     ids_mb/labels_mb: [n_micro, mb, ...]    (replicated over `axis`)
+    aux_ct_scale: cotangent injected per stage-forward for the aux output
+                 (aux_loss_weight x loss_scale / n_micro, traced scalar ok)
 
-    Returns (loss, d_local, d_rest): loss is the mean over all microbatches
-    (replicated); d_local is the local stage segment's grad; d_rest is the
-    pipe-replicated grad of the non-stacked params (embedding + head).
+    Returns (loss, aux, d_local, d_rest): loss is the head loss mean over
+    all microbatches (replicated); aux is the summed load-balance loss mean
+    over microbatches (0 when with_aux=False); d_local is the local stage
+    segment's grad; d_rest is the pipe-replicated grad of the non-stacked
+    params (embedding + head).
     """
     stage_idx = lax.axis_index(axis)
     last = stage_idx == n_stages - 1
 
     def scaled_head(rest_, h, y):
         return head_loss_fn(rest_, h, y) / n_micro
+
+    def run_stage(params, x):
+        out = stage_fn(params, x)
+        return out if with_aux else (out, jnp.float32(0.0))
 
     # probe shapes once (embedding of microbatch 0)
     x0 = embed_fn(rest, ids_mb[0])
@@ -186,7 +209,7 @@ def run_1f1b(stage_fn: Callable, embed_fn: Callable, head_loss_fn: Callable,
             lambda a, g: a + jnp.where(on, g, jnp.zeros_like(g)), acc, delta)
 
     def tick(carry, t):
-        f_msg, b_msg, buf, d_local, d_rest, loss_acc = carry
+        f_msg, b_msg, buf, d_local, d_rest, loss_acc, aux_acc = carry
 
         # ---- forward slot: stage s runs microbatch i = t - s ----
         i = t - stage_idx
@@ -194,7 +217,8 @@ def run_1f1b(stage_fn: Callable, embed_fn: Callable, head_loss_fn: Callable,
         i_c = jnp.clip(i, 0, n_micro - 1)
         ids_i = lax.dynamic_index_in_dim(ids_mb, i_c, 0, keepdims=False)
         x_in = jnp.where(stage_idx == 0, embed_fn(rest, ids_i), f_msg)
-        x_out = stage_fn(local_params, x_in)
+        x_out, aux_i = run_stage(local_params, x_in)
+        aux_acc = aux_acc + jnp.where(f_on, aux_i, 0.0) / n_micro
         # save the stage input for the backward-slot recompute (ring buffer;
         # live range per slot is <= n_buf so distinct in-flight microbatches
         # never collide)
@@ -217,8 +241,12 @@ def run_1f1b(stage_fn: Callable, embed_fn: Callable, head_loss_fn: Callable,
         ct = jnp.where(last, dh, b_msg).astype(act_dtype)
         x_saved = lax.dynamic_index_in_dim(buf, u_c % n_buf, 0,
                                            keepdims=False)
-        _, stage_vjp = jax.vjp(stage_fn, local_params, x_saved)
-        d_local_i, dx = stage_vjp(ct)
+        _, stage_vjp = jax.vjp(run_stage, local_params, x_saved)
+        # the aux output's cotangent is its (scaled) loss weight — the MoE
+        # balance grad rides the same recompute as the activation grad
+        aux_ct = jnp.asarray(aux_ct_scale, jnp.float32) \
+            if with_aux else jnp.float32(0.0)
+        d_local_i, dx = stage_vjp((ct, aux_ct))
         d_local = masked_add(d_local, d_local_i, b_on)
         # stage 0: backprop the incoming cotangent through the embedding
         ids_u = lax.dynamic_index_in_dim(ids_mb, u_c, 0, keepdims=False)
@@ -231,20 +259,22 @@ def run_1f1b(stage_fn: Callable, embed_fn: Callable, head_loss_fn: Callable,
         bperm = [(r, (r - 1) % n_stages) for r in range(n_stages)]
         f_msg = lax.ppermute(x_out, axis, fperm)
         b_msg = lax.ppermute(dx, axis, bperm)
-        return (f_msg, b_msg, buf, d_local, d_rest, loss_acc), None
+        return (f_msg, b_msg, buf, d_local, d_rest, loss_acc, aux_acc), None
 
     zeros_act = jnp.zeros_like(x0)
     buf0 = jnp.zeros((n_buf,) + x0.shape, act_dtype)
     carry0 = (zeros_act, zeros_act, buf0, zero_d_local, zero_d_rest,
-              jnp.zeros((), jnp.float32))
-    (_, _, _, d_local, d_rest, loss_acc), _ = lax.scan(
+              jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+    (_, _, _, d_local, d_rest, loss_acc, aux_acc), _ = lax.scan(
         tick, carry0, jnp.arange(T))
 
-    # loss lives on the last stage; embed grads on stage 0; head grads on the
-    # last stage — psum over the pipe axis replicates all of them
+    # loss lives on the last stage; per-stage aux sums over stages; embed
+    # grads on stage 0; head grads on the last stage — psum over the pipe
+    # axis replicates all of them
     loss = lax.psum(loss_acc, axis)
+    aux = lax.psum(aux_acc, axis)
     d_rest = jax.tree_util.tree_map(lambda g: lax.psum(g, axis), d_rest)
-    return loss, d_local, d_rest
+    return loss, aux, d_local, d_rest
 
 
 class PipelinedTrainStep:
@@ -261,7 +291,11 @@ class PipelinedTrainStep:
       shard_map (reference pipeline_parallel.py:151 running
       ColumnParallelLinear -> _c_identity inside a stage);
     - AMP: plan.amp drives autocast in the stage fns plus fp16 dynamic loss
-      scaling folded into the tick loop (hybrid_parallel_gradscaler analog).
+      scaling folded into the tick loop (hybrid_parallel_gradscaler analog);
+    - ZeRO stages 1-3 over the `sharding` axis: slot sharding (1), grad
+      reduce-scatter to the owning chunk (2), chunked param storage with
+      gather-on-use at step start (3) — sharding_optimizer.py:745,968's
+      reduce-to-owner + broadcast-on-use inside the hybrid pipeline.
     """
 
     def __init__(self, model, optimizer, mesh: Mesh, n_micro: int = 4,
@@ -292,22 +326,7 @@ class PipelinedTrainStep:
                           and amp_cfg.use_dynamic_loss_scaling)
         self._use_scaler = use_scaler
 
-        if mesh.shape.get("ep", 1) > 1:
-            raise NotImplementedError(
-                "pp x ep is not composed: inside the pipe shard_map the "
-                "stage fns issue no ep collectives, so expert-sharded "
-                "weights would silently compute on a fraction of the "
-                "experts. Train MoE models with ShardedTrainStep "
-                "(ep_degree without pp_degree)")
-        if self._mp_n > 1:
-            from ..optimizer.optimizer import Lamb, LarsMomentum
-            if isinstance(optimizer, (Lamb, LarsMomentum)):
-                import warnings
-                warnings.warn(
-                    "pp x tp runs optimizer rules on model-axis weight "
-                    "shards: Lamb/LarsMomentum trust ratios would use "
-                    "per-shard norms, silently changing the algorithm",
-                    stacklevel=3)
+        self._ep_n = mesh.shape.get("ep", 1)
 
         # --- split params: per-layer decoder params vs the rest ---
         params, buffers = model.functional_state()
@@ -325,12 +344,15 @@ class PipelinedTrainStep:
             raise ValueError(
                 "PipelinedTrainStep requires homogeneous decoder layers "
                 "(identical parameter sets per layer); models interleaving "
-                "MoE and dense FFNs are not pipeline-stackable yet")
-        if any("moe." in k for k in per_layer[0]):
-            raise NotImplementedError(
-                "MoE layers are not supported under PipelinedTrainStep yet: "
-                "the stage scan would drop the auxiliary load-balance loss. "
-                "Use ShardedTrainStep with an ep mesh axis for MoE models.")
+                "MoE and dense FFNs are not pipeline-stackable — set "
+                "moe_every_n_layers=1 (uniform MoE stack) to pipeline an "
+                "MoE model")
+        # uniform MoE stack: stage fns return (x, aux); the tick loop
+        # accumulates the load-balance aux loss and injects its cotangent
+        self._moe_stack = any("moe." in k for k in per_layer[0])
+        aux_weight = (float(getattr(getattr(model, "config", None),
+                                    "moe_aux_loss_weight", 0.0))
+                      if self._moe_stack else 0.0)
         self._layer_prefix_list = layer_prefixes
         stacked = stack_stage_params(per_layer, self.n_stages)
         rest = {k: v for k, v in params.items()
@@ -357,15 +379,20 @@ class PipelinedTrainStep:
             k: _full_spec(_param_spec(named_params[k], mesh), rest[k].ndim)
             for k in rest}
 
-        def _has_model_axis(spec: P) -> bool:
+        def _has_axis(spec: P, name: str) -> bool:
             for ax in spec:
                 axes = ax if isinstance(ax, tuple) else (ax,)
-                if MODEL_AXIS in axes:
+                if name in axes:
                     return True
             return False
 
-        stacked_tp = {k: _has_model_axis(s) for k, s in stacked_specs.items()}
-        rest_tp = {k: _has_model_axis(s) for k, s in rest_specs.items()}
+        stacked_tp = {k: _has_axis(s, MODEL_AXIS)
+                      for k, s in stacked_specs.items()}
+        rest_tp = {k: _has_axis(s, MODEL_AXIS) for k, s in rest_specs.items()}
+        # expert-sharded leaves: their grads are rank-local (each ep rank
+        # owns different experts) — they must NOT be pmean'd over `ep`
+        stacked_ep = {k: _has_axis(s, "ep") for k, s in stacked_specs.items()}
+        rest_ep = {k: _has_axis(s, "ep") for k, s in rest_specs.items()}
 
         def _local_shape(shape, spec):
             """Per-device shard shape under `spec` (shard_map view)."""
@@ -384,25 +411,18 @@ class PipelinedTrainStep:
         self._buffers = buffers
 
         # --- ZeRO composition over the `sharding` axis (pp x zero) ---
-        # Optimizer-state sharding only (stage-1 semantics; gradients stay
-        # pipe-replicated — see the parallelize() warning for stage >= 2).
-        # Per flat param: the dim to shard slots over. Stacked params skip
-        # dim 0 (the per-stage layer dim the stage scan walks); tiny tensors
-        # replicate.
+        # Stage-1: optimizer slots sharded at zdim. Stage-2: the per-step
+        # gradients are reduce-scattered over `sharding` (each rank owns
+        # one chunk; sharding_optimizer.py:745 _add_broadcast_allreduce's
+        # reduce-to-owner made explicit as psum_scatter). Stage-3: params
+        # are STORED chunked (specs extended with `sharding` at zdim) and
+        # all-gathered once at step start — gather-on-use at per-stage-
+        # per-step granularity, since each pipe rank only ever touches its
+        # own stage's layers. Per flat param: the dim to shard over.
+        # Stacked params skip dim 0 (the per-stage layer dim the stage
+        # scan walks); tiny tensors replicate.
         sh_n = mesh.shape.get("sharding", 1)
         use_zero = zero_stage >= 1 and sh_n > 1
-        if use_zero:
-            from ..optimizer.optimizer import Lamb, LarsMomentum
-            if isinstance(optimizer, (Lamb, LarsMomentum)):
-                # these rules compute whole-parameter norms (trust ratios);
-                # feeding per-rank chunks would silently change the algorithm
-                import warnings
-                warnings.warn(
-                    "pp x ZeRO does not compose with norm-based optimizers "
-                    "(Lamb/LarsMomentum): trust ratios need whole-parameter "
-                    "norms. Keeping optimizer state replicated.",
-                    stacklevel=3)
-                use_zero = False
         self._use_zero = use_zero
         import numpy as np
 
@@ -430,21 +450,62 @@ class PipelinedTrainStep:
                 loc = _local_shape(v.shape, stacked_specs[k])
                 d = _zdim(loc[1:], 1, list(stacked_specs[k])[1:])
                 zdim[f"__stack__{k}"] = None if d is None else d + 1
+        z2 = use_zero and zero_stage >= 2
+        z3 = use_zero and zero_stage >= 3
+        self._z2, self._z3 = z2, z3
+        if z3:
+            # stage-3 param layout: the stored specs carry `sharding` at
+            # zdim, so GSPMD physically shards persistent params; the
+            # shard_map hands each rank its chunk and train_step gathers
+            def _extend(spec: P, ndim: int, zd):
+                axes = list(spec) + [None] * (ndim - len(spec))
+                axes[zd] = "sharding"
+                return P(*axes)
+
+            for k in rest:
+                zd = zdim.get(k)
+                if zd is not None:
+                    rest_specs[k] = _extend(rest_specs[k], rest[k].ndim, zd)
+            for k in stacked:
+                zd = zdim.get(f"__stack__{k}")
+                if zd is not None:
+                    stacked_specs[k] = _extend(stacked_specs[k],
+                                               stacked[k].ndim, zd)
         wd_zero = (float(optimizer._weight_decay)
                    if not callable(optimizer._weight_decay) else 0.0)
+
+        # norm-based rules (Lamb/LARS) need WHOLE-parameter norms: tell the
+        # optimizer which mesh axes shard each leaf (trust ratios psum the
+        # squared norms — hybrid_parallel_optimizer.py:32's pattern) and
+        # that stacked leaves batch per-layer params over 2 leading dims
+        from ..optimizer.optimizer import Lamb, LarsMomentum
+        norm_meta = None
+        if isinstance(optimizer, (Lamb, LarsMomentum)):
+            norm_meta = {}
+            for k in rest:
+                axes = ((MODEL_AXIS,) if rest_tp[k] else ()) + \
+                    (("ep",) if rest_ep[k] else ())
+                norm_meta[k] = (axes, 0)
+            for k in stacked:
+                axes = ((MODEL_AXIS,) if stacked_tp[k] else ()) + \
+                    (("ep",) if stacked_ep[k] else ())
+                norm_meta[f"__stack__{k}"] = (axes, 2)
 
         def _zero_apply(flat_params, flat_grads, opt_state, lr, step):
             """ZeRO-sharded update inside shard_map: each sharding rank owns
             a slice of every large param's optimizer state, updates only its
-            slice, and all-gathers the new params (sharding_optimizer.py
-            broadcast-on-use semantics made explicit). Unsharded keys go
-            through the optimizer's own apply_gradients_fn."""
+            slice, and (below stage-3) all-gathers the new params
+            (sharding_optimizer.py broadcast-on-use semantics made
+            explicit). Stage-2 grads arrive pre-chunked by the
+            reduce-scatter; stage-3 params arrive AND leave chunked.
+            Unsharded keys go through the optimizer's apply_gradients_fn."""
             idx = lax.axis_index("sharding")
             plain = {k for k in flat_params if zdim.get(k) is None}
             new_flat, _new_opt = apply_fn(
                 {k: flat_params[k] for k in plain},
                 {k: g for k, g in flat_grads.items() if k in plain},
-                {k: opt_state[k] for k in plain}, lr, step)
+                {k: opt_state[k] for k in plain}, lr, step,
+                norm_meta=norm_meta)
             new_opt = dict(_new_opt)
             for k, p in flat_params.items():
                 if k in plain:
@@ -455,15 +516,25 @@ class PipelinedTrainStep:
                     continue
                 slots = dict(opt_state[k])
                 slots["_step"] = step
+                if norm_meta is not None and k in norm_meta:
+                    # the rule sees a `sharding` chunk: whole-param norms
+                    # additionally psum over the chunk axis
+                    axes, bd = norm_meta[k]
+                    slots["_norm_axes"] = axes + ("sharding",)
+                    slots["_norm_batch_dims"] = bd
                 d = zdim[k]
-                chunk = p.shape[d] // sh_n
-                g_own = lax.dynamic_slice_in_dim(g, idx * chunk, chunk, d)
-                p_own = lax.dynamic_slice_in_dim(p, idx * chunk, chunk, d)
+                chunk = p.shape[d] if z3 else p.shape[d] // sh_n
+                g_own = (g if z2 else
+                         lax.dynamic_slice_in_dim(g, idx * chunk, chunk, d))
+                p_own = (p if z3 else
+                         lax.dynamic_slice_in_dim(p, idx * chunk, chunk, d))
                 p_own_new, ns_ = optimizer._rule_mp(g_own, p_own, slots,
                                                     lr, wd_zero)
-                np_ = lax.all_gather(p_own_new, "sharding", axis=d,
-                                     tiled=True)
-                ns_.pop("_step", None)
+                np_ = (p_own_new if z3 else
+                       lax.all_gather(p_own_new, "sharding", axis=d,
+                                      tiled=True))
+                for extra in ("_step", "_norm_axes", "_norm_batch_dims"):
+                    ns_.pop(extra, None)
                 new_flat[k], new_opt[k] = np_, ns_
             return new_flat, new_opt
 
@@ -473,15 +544,17 @@ class PipelinedTrainStep:
         n_micro_ = n_micro
         n_stages_ = self.n_stages
 
+        # `ep` is a batch axis too (expert parallelism is data-parallel in
+        # the token dim); expert-sharded param grads opt out of its pmean
         batch_axes = tuple(
-            ax for ax in ("data", "sharding")
+            ax for ax in ("data", "sharding", "ep")
             if ax in mesh.axis_names and mesh.shape[ax] > 1)
         self._batch_axes = batch_axes
         data_spec_entry = batch_axes if len(batch_axes) > 1 else (
             batch_axes[0] if batch_axes else None)
         data_spec = P(data_spec_entry) if batch_axes else P()
 
-        stage_fn = make_stage_fn(layer_fn, remat)
+        stage_fn = make_stage_fn(layer_fn, remat, with_aux=self._moe_stack)
 
         from ..nn.clip import ClipGradByGlobalNorm
         grad_clip = getattr(optimizer, "_grad_clip", None)
@@ -489,6 +562,9 @@ class PipelinedTrainStep:
 
         mp_n = self._mp_n
         use_scaler = self._use_scaler
+        moe_stack = self._moe_stack
+        aux_weight_ = aux_weight
+        ep_n_ = self._ep_n
 
         def pipe_global_norm_clip(g_stacked, g_rest):
             """Global-norm clip whose norm spans ALL stages: the stacked
@@ -496,16 +572,30 @@ class PipelinedTrainStep:
             the pipe axis; rest grads are pipe-replicated and counted once.
             TP-sharded leaves hold model-axis shards, so their squared norm
             is additionally psum'd over `model` (HybridParallelClipGrad:32's
-            cross-mp allreduce of the norm). Without this, each rank clips by
-            a different norm and the replicated params silently diverge."""
-            def leaf_sq(g, tp):
+            cross-mp allreduce of the norm). Stage-2 grads are `sharding`
+            chunks, so those leaves psum over `sharding` too. Without this,
+            each rank clips by a different norm and the replicated params
+            silently diverge."""
+            def leaf_sq(g, tp, chunked, eps):
                 sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
-                return lax.psum(sq, MODEL_AXIS) if (tp and mp_n > 1) else sq
+                if tp and mp_n > 1:
+                    sq = lax.psum(sq, MODEL_AXIS)
+                if chunked:
+                    sq = lax.psum(sq, "sharding")
+                if eps and ep_n_ > 1:  # distinct experts per ep rank
+                    sq = lax.psum(sq, "ep")
+                return sq
 
-            sq_stacked = sum(leaf_sq(g, stacked_tp[k])
-                             for k, g in g_stacked.items())
+            def _chunked(k_apply):
+                return z2 and zdim.get(k_apply) is not None
+
+            sq_stacked = sum(
+                leaf_sq(g, stacked_tp[k], _chunked(f"__stack__{k}"),
+                        stacked_ep[k])
+                for k, g in g_stacked.items())
             sq_stacked = lax.psum(sq_stacked, PIPE_AXIS)
-            sq_rest = sum(leaf_sq(g, rest_tp[k]) for k, g in g_rest.items())
+            sq_rest = sum(leaf_sq(g, rest_tp[k], _chunked(k), rest_ep[k])
+                          for k, g in g_rest.items())
             gnorm = jnp.sqrt(sq_stacked + sq_rest)
             c = grad_clip.clip_norm
             factor = jnp.minimum(c / jnp.maximum(gnorm, c), 1.0)
@@ -519,13 +609,31 @@ class PipelinedTrainStep:
             mb = B // n_micro_
             ids_mb = ids.reshape((n_micro_, mb) + ids.shape[1:])
             labels_mb = labels.reshape((n_micro_, mb) + labels.shape[1:])
-            local = jax.tree_util.tree_map(lambda a: a[0], stacked_)
+            if z3:
+                # stage-3: persistent params are `sharding` chunks;
+                # gather-on-use once per step (each pipe rank gathers only
+                # its own stage's layers)
+                def _gather(k_apply, v):
+                    zd = zdim.get(k_apply)
+                    if zd is None:
+                        return v
+                    return lax.all_gather(v, "sharding", axis=zd,
+                                          tiled=True)
+
+                stacked_f = {k: _gather(f"__stack__{k}", v)
+                             for k, v in stacked_.items()}
+                rest_f = {k: _gather(k, v) for k, v in rest_.items()}
+            else:
+                stacked_f, rest_f = stacked_, rest_
+            local = jax.tree_util.tree_map(lambda a: a[0], stacked_f)
             scale = extras_.get("loss_scale", jnp.float32(1.0))
             head = ((lambda r, h, y: head_fn(r, h, y) * scale)
                     if use_scaler else head_fn)
-            loss, d_local, g_rest = run_1f1b(
-                stage_fn, embed_fn, head, local, rest_, ids_mb, labels_mb,
-                n_micro_, n_stages_)
+            loss, aux, d_local, g_rest = run_1f1b(
+                stage_fn, embed_fn, head, local, rest_f, ids_mb, labels_mb,
+                n_micro_, n_stages_, with_aux=moe_stack,
+                aux_ct_scale=(aux_weight_ * scale / n_micro_
+                              if moe_stack else 0.0))
             g_stacked = jax.tree_util.tree_map(lambda g: g[None], d_local)
             if use_scaler:
                 loss = loss / scale
@@ -533,13 +641,45 @@ class PipelinedTrainStep:
                     g.dtype)
                 g_stacked = jax.tree_util.tree_map(unscale, g_stacked)
                 g_rest = jax.tree_util.tree_map(unscale, g_rest)
-            # data-parallel reduction across batch axes
+            # data-parallel reduction across batch axes. Stage-2 keys
+            # reduce-scatter over `sharding` instead of all-reducing: each
+            # rank keeps only the grad chunk whose optimizer state it owns
+            # (half the bytes of the pmean, and grads are never
+            # materialized replicated — ZeRO-2's defining property)
             for ax in batch_axes:
                 loss = lax.pmean(loss, ax)
-                g_stacked = jax.tree_util.tree_map(
-                    lambda g: lax.pmean(g, ax), g_stacked)
-                g_rest = jax.tree_util.tree_map(
-                    lambda g: lax.pmean(g, ax), g_rest)
+                aux = lax.pmean(aux, ax)
+            if moe_stack:
+                # report the same total the dense forward computes:
+                # CE + weight * load-balance aux
+                loss = loss + aux_weight_ * aux
+
+            def reduce_grad(k_apply, g, ep_sharded):
+                for ax in batch_axes:
+                    if ax == "sharding":
+                        continue
+                    if ax == "ep" and ep_sharded:
+                        # expert-sharded leaves: the all_to_all transpose
+                        # already SUMS every rank's token cotangents into
+                        # the owning rank's expert grad — divide by ep_n to
+                        # match the pmean (global-token-mean) convention,
+                        # but never pmean (ranks hold different experts)
+                        g = g / ep_n_
+                        continue
+                    g = lax.pmean(g, ax)
+                if "sharding" not in batch_axes:
+                    return g
+                zd = zdim.get(k_apply) if z2 else None
+                if zd is None:
+                    return lax.pmean(g, "sharding")
+                return lax.psum_scatter(g, "sharding",
+                                        scatter_dimension=zd,
+                                        tiled=True) / sh_n
+
+            g_stacked = {k: reduce_grad(f"__stack__{k}", g, stacked_ep[k])
+                         for k, g in g_stacked.items()}
+            g_rest = {k: reduce_grad(k, g, rest_ep[k])
+                      for k, g in g_rest.items()}
 
             new_extras = dict(extras_)
             if use_scaler:
@@ -553,6 +693,12 @@ class PipelinedTrainStep:
                 bad_local = lax.psum(bad_local, PIPE_AXIS)
                 if mp_n > 1:
                     bad_local = lax.psum(bad_local, MODEL_AXIS)
+                if z2:
+                    # stage-2 grads are sharding chunks: ranks must agree
+                    bad_local = lax.psum(bad_local, "sharding")
+                if ep_n_ > 1:
+                    # expert grads are rank-local: ranks must agree
+                    bad_local = lax.psum(bad_local, "ep")
                 finite = bad_local == 0
                 good = jnp.where(finite, extras_["good_steps"] + 1, 0)
                 bad = jnp.where(finite, 0, extras_["bad_steps"] + 1)
@@ -582,7 +728,8 @@ class PipelinedTrainStep:
                                                 opt_state, lr, step)
             else:
                 new_flat, new_opt = apply_fn(flat_params, flat_grads,
-                                             opt_state, lr, step)
+                                             opt_state, lr, step,
+                                             norm_meta=norm_meta)
             if use_scaler:
                 # overflow: skip the update (check_finite_and_unscale +
                 # update_loss_scaling semantics)
@@ -672,18 +819,22 @@ class PipelinedTrainStep:
         return list(self.model.pipe_layer_prefixes())
 
     def _fn_ctx(self):
-        """Context entered around every stage-fn trace: the explicit-TP
-        axis context (mp_layers switch to shard_map collectives) and AMP
-        autocast (amp_auto_cast.h analog, consulted at trace time)."""
+        """Context entered around every stage-fn trace: the explicit-TP/EP
+        axis context (mp_layers and MoELayer switch to shard_map
+        collectives) and AMP autocast (amp_auto_cast.h analog, consulted
+        at trace time)."""
         mp_on = self._mp_n > 1
+        ep_on = self._ep_n > 1
         amp_cfg = self._amp_cfg
 
         @contextlib.contextmanager
         def ctx():
             with contextlib.ExitStack() as st:
-                if mp_on:
+                if mp_on or ep_on:
                     from ..distributed.collective import axis_context
-                    st.enter_context(axis_context((MODEL_AXIS,)))
+                    axes = (((MODEL_AXIS,) if mp_on else ())
+                            + (("ep",) if ep_on else ()))
+                    st.enter_context(axis_context(axes))
                 if amp_cfg is not None:
                     from ..amp import auto_cast
                     st.enter_context(auto_cast(
@@ -697,12 +848,16 @@ class PipelinedTrainStep:
     def _make_layer_fn(self):
         layer0 = self._decoder_layers()[0]
         ctx = self._fn_ctx()
+        moe_stack = self._moe_stack
 
         def layer_fn(layer_params, x):
             from ..core.tensor import Tensor, no_grad
             with layer0._bound_state(layer_params, {}), no_grad(), ctx():
                 out = layer0(Tensor(x))
-            if isinstance(out, tuple):  # GPT layers return (x, aux)
+            if moe_stack:
+                h, aux = out  # uniform MoE stack: every layer returns aux
+                return h.data, (aux.data if hasattr(aux, "data") else aux)
+            if isinstance(out, tuple):  # GPT layers return (x, aux=None)
                 out = out[0]
             return out.data if hasattr(out, "data") else out
 

@@ -302,3 +302,250 @@ def test_parallelize_dispatches_pipeline():
     finally:
         _GLOBAL_HCG[0] = None
         _GLOBAL_MESH[0] = None
+
+
+def test_1f1b_zero_stage2_and_3_parity():
+    """pp x ZeRO-2/3 (VERDICT r3 item 2): grads reduce-scattered to the
+    owning chunk (stage-2) and params stored chunked with gather-on-use
+    (stage-3) must keep exact loss parity with the unsharded pipeline."""
+    paddle.seed(0)
+    model = LlamaForCausalLM.from_preset("llama2-tiny", num_hidden_layers=2)
+    cfg = model.config
+    rng = np.random.RandomState(1)
+    B, S = 8, 16
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+
+    def build(zero):
+        paddle.seed(0)
+        m = LlamaForCausalLM.from_preset("llama2-tiny", num_hidden_layers=2)
+        opt = optim.Adam(learning_rate=1e-2, parameters=m.parameters())
+        devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+        mesh = Mesh(devs, ("data", "sharding", "pipe"))
+        return PipelinedTrainStep(m, opt, mesh, n_micro=2, zero_stage=zero,
+                                  min_shard_numel=0)
+
+    plain = build(0)
+    l_plain = [float(plain(ids, labels).item()) for _ in range(3)]
+
+    z2 = build(2)
+    assert z2._z2 and not z2._z3
+    l_z2 = [float(z2(ids, labels).item()) for _ in range(3)]
+    np.testing.assert_allclose(l_z2, l_plain, rtol=1e-4, atol=1e-4)
+
+    z3 = build(3)
+    assert z3._z3
+    # stage-3: persistent PARAM storage is physically sharded over
+    # `sharding` (not just the optimizer slots)
+    sharded_params = [k for k, a in z3._stacked.items()
+                      if "sharding" in str(a.sharding.spec)]
+    assert sharded_params, "no stacked param carries the sharding axis"
+    for k in sharded_params[:2]:
+        full = plain._stacked[k]
+        shrd = z3._stacked[k]
+        full_local = max(sh.data.size for sh in full.addressable_shards)
+        shrd_local = max(sh.data.size for sh in shrd.addressable_shards)
+        assert shrd_local * 2 == full_local, k
+    l_z3 = [float(z3(ids, labels).item()) for _ in range(3)]
+    np.testing.assert_allclose(l_z3, l_plain, rtol=1e-4, atol=1e-4)
+
+
+def test_1f1b_zero_stage2_reduce_scatter_in_hlo():
+    """Stage-2's grad sync must lower to reduce-scatter for the chunked
+    keys — not an all-reduce followed by a slice."""
+    paddle.seed(0)
+    m = LlamaForCausalLM.from_preset("llama2-tiny", num_hidden_layers=2)
+    opt = optim.Adam(learning_rate=1e-2, parameters=m.parameters())
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("data", "sharding", "pipe"))
+    step = PipelinedTrainStep(m, opt, mesh, n_micro=2, zero_stage=2,
+                              min_shard_numel=0)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, m.config.vocab_size, (8, 16)), jnp.int32)
+    labels = jnp.asarray(
+        rng.randint(0, m.config.vocab_size, (8, 16)), jnp.int32)
+    txt = step._jitted.lower(
+        step._stacked, step._rest, step._opt_state, step._extras,
+        jnp.float32(0.01), jnp.int32(1), (ids, labels)).compile().as_text()
+    assert "reduce-scatter" in txt, "stage-2 grads did not lower to RS"
+
+
+def test_parallelize_zero_stage2_no_downgrade_warning():
+    import warnings as _w
+    from paddle_tpu.distributed import DistributedStrategy
+    from paddle_tpu.parallel.api import parallelize
+    paddle.seed(0)
+    model = LlamaForCausalLM.from_preset("llama2-tiny", num_hidden_layers=2)
+    opt = optim.Adam(learning_rate=1e-2, parameters=model.parameters())
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("data", "sharding", "pipe"))
+    s = DistributedStrategy()
+    s.sharding = True
+    s.sharding_configs = {"stage": 2, "min_shard_numel": 0}
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        step = parallelize(model, opt, mesh=mesh, strategy=s)
+    assert step._z2
+
+
+# ---- pp x ep (VERDICT r4 item 3) ----
+
+def _moe_model(**over):
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    return GPTForCausalLM.from_preset(
+        "ernie-moe-tiny", num_hidden_layers=2, moe_every_n_layers=1, **over)
+
+
+def test_1f1b_composes_with_ep_vs_dp_equivalence():
+    """pp2 x (data2 x ep2) must equal pp2 x data4 EXACTLY: same token
+    partitioning and per-rank capacity, so the only difference is whether
+    experts are physically sharded and exchanged via all_to_all. Any error
+    in the explicit-EP dispatch or its AD transpose breaks the allclose."""
+    paddle.seed(0)
+    model = _moe_model()
+    cfg = model.config
+    rng = np.random.RandomState(0)
+    B, S = 16, 16
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+
+    def build(axes):
+        paddle.seed(0)
+        m = _moe_model()
+        opt = optim.Adam(learning_rate=1e-2, parameters=m.parameters())
+        sizes = [s for _, s in axes]
+        devs = np.array(jax.devices()[:int(np.prod(sizes))]).reshape(sizes)
+        mesh = Mesh(devs, tuple(n for n, _ in axes))
+        return PipelinedTrainStep(m, opt, mesh, n_micro=2)
+
+    dp4 = build([("data", 4), ("pipe", 2)])
+    l_dp = [float(dp4(ids, labels).item()) for _ in range(3)]
+
+    ep2 = build([("data", 2), ("ep", 2), ("pipe", 2)])
+    assert ep2._moe_stack and ep2._ep_n == 2
+    # experts are physically sharded over ep
+    ep_leaves = [k for k, a in ep2._stacked.items()
+                 if "ep" in str(a.sharding.spec)]
+    assert ep_leaves, "no stacked param carries the ep axis"
+    l_ep = [float(ep2(ids, labels).item()) for _ in range(3)]
+    np.testing.assert_allclose(l_ep, l_dp, rtol=2e-4, atol=2e-4)
+
+
+def test_1f1b_ep_all_to_all_in_hlo():
+    """The explicit-EP stage fns must lower to all-to-all collectives."""
+    paddle.seed(0)
+    m = _moe_model()
+    opt = optim.Adam(learning_rate=1e-2, parameters=m.parameters())
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("ep", "pipe"))
+    step = PipelinedTrainStep(m, opt, mesh, n_micro=2)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, m.config.vocab_size, (4, 16)), jnp.int32)
+    labels = jnp.asarray(
+        rng.randint(0, m.config.vocab_size, (4, 16)), jnp.int32)
+    txt = step._jitted.lower(
+        step._stacked, step._rest, step._opt_state, step._extras,
+        jnp.float32(0.01), jnp.int32(1), (ids, labels)).compile().as_text()
+    assert "all-to-all" in txt, "explicit EP did not lower to all-to-all"
+
+
+def test_1f1b_moe_matches_eager_when_aux_weight_zero():
+    """With generous capacity (no token drops) and aux weight 0, the
+    pipelined MoE CE must match eager full-batch training exactly (routing
+    is per-token, so microbatching does not change the math)."""
+    paddle.seed(0)
+    model = _moe_model(moe_aux_loss_weight=0.0, moe_capacity_factor=8.0)
+    cfg = model.config
+    rng = np.random.RandomState(0)
+    B, S = 8, 16
+    ids_n = rng.randint(0, cfg.vocab_size, (B, S))
+    labels_n = rng.randint(0, cfg.vocab_size, (B, S))
+    lr = 1e-2
+
+    opt = optim.SGD(learning_rate=lr, parameters=model.parameters())
+    ref = []
+    for _ in range(3):
+        loss = model(paddle.to_tensor(ids_n),
+                     labels=paddle.to_tensor(labels_n))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        ref.append(float(loss.item()))
+
+    paddle.seed(0)
+    m2 = _moe_model(moe_aux_loss_weight=0.0, moe_capacity_factor=8.0)
+    opt2 = optim.SGD(learning_rate=lr, parameters=m2.parameters())
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("ep", "pipe"))
+    step = PipelinedTrainStep(m2, opt2, mesh, n_micro=2)
+    losses = [float(step(jnp.asarray(ids_n, jnp.int32),
+                         jnp.asarray(labels_n, jnp.int32)).item())
+              for _ in range(3)]
+    np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_1f1b_ep_zero2_compose():
+    """pp2 x ep2 x sharding2 with ZeRO stage-2: the full deep composition
+    (VERDICT r3 items 2+3 together) keeps parity with pp2 x data4 since
+    sharding is a batch axis and the token split is identical."""
+    paddle.seed(0)
+    model = _moe_model()
+    cfg = model.config
+    rng = np.random.RandomState(3)
+    B, S = 16, 16
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+
+    def build(axes, zero):
+        paddle.seed(0)
+        m = _moe_model()
+        opt = optim.Adam(learning_rate=1e-2, parameters=m.parameters())
+        sizes = [s for _, s in axes]
+        devs = np.array(jax.devices()[:int(np.prod(sizes))]).reshape(sizes)
+        mesh = Mesh(devs, tuple(n for n, _ in axes))
+        return PipelinedTrainStep(m, opt, mesh, n_micro=2, zero_stage=zero,
+                                  min_shard_numel=0)
+
+    ref = build([("data", 4), ("pipe", 2)], 0)
+    l_ref = [float(ref(ids, labels).item()) for _ in range(3)]
+    deep = build([("sharding", 2), ("ep", 2), ("pipe", 2)], 2)
+    assert deep._z2 and deep._moe_stack
+    l_deep = [float(deep(ids, labels).item()) for _ in range(3)]
+    np.testing.assert_allclose(l_deep, l_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_1f1b_moe_aux_weight_matches_microbatched_eager():
+    """Nonzero aux weight: the pipeline's per-microbatch aux mean and its
+    GRADIENT scaling must match an eager run over the same microbatches
+    (catches any aux-cotangent/n_micro mismatch)."""
+    paddle.seed(0)
+    model = _moe_model(moe_capacity_factor=8.0)
+    cfg = model.config
+    rng = np.random.RandomState(0)
+    B, S = 8, 16
+    ids_n = rng.randint(0, cfg.vocab_size, (B, S))
+    labels_n = rng.randint(0, cfg.vocab_size, (B, S))
+    lr = 1e-2
+
+    opt = optim.SGD(learning_rate=lr, parameters=model.parameters())
+    ref = []
+    for _ in range(3):
+        # eager over the same two microbatches the n_micro=2 pipeline uses
+        l1 = model(paddle.to_tensor(ids_n[:4]),
+                   labels=paddle.to_tensor(labels_n[:4]))
+        l2 = model(paddle.to_tensor(ids_n[4:]),
+                   labels=paddle.to_tensor(labels_n[4:]))
+        loss = (l1 + l2) * 0.5
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        ref.append(float(loss.item()))
+
+    paddle.seed(0)
+    m2 = _moe_model(moe_capacity_factor=8.0)
+    opt2 = optim.SGD(learning_rate=lr, parameters=m2.parameters())
+    step = PipelinedTrainStep(m2, opt2, _pipe_mesh(2), n_micro=2)
+    losses = [float(step(jnp.asarray(ids_n, jnp.int32),
+                         jnp.asarray(labels_n, jnp.int32)).item())
+              for _ in range(3)]
+    np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-4)

@@ -220,3 +220,79 @@ def test_unstackable_model_raises():
     opt = optim.SGD(learning_rate=1e-2, parameters=lin.parameters())
     with pytest.raises(ValueError, match="pipe_"):
         PipelinedTrainStep(lin, opt, _mesh(pipe=2), n_micro=2)
+
+
+# ---- Lamb/LARS under sharded layouts (VERDICT r3 item 4) ----
+
+def _eager_losses(model_ctor, opt_ctor, ids, labels, steps):
+    paddle.seed(0)
+    model = model_ctor()
+    opt = opt_ctor(model)
+    out = []
+    for _ in range(steps):
+        loss = model(paddle.to_tensor(np.asarray(ids)),
+                     labels=paddle.to_tensor(np.asarray(labels)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        out.append(float(loss.item()))
+    return out
+
+
+def test_lamb_pp2_mp2_matches_single_device():
+    """Lamb trust ratios over TP weight shards must psum the squared norms
+    over `model` — pp2 x mp2 must match eager single-device Lamb."""
+    _, ids, labels = _make(LlamaForCausalLM, "llama2-tiny", 2)
+
+    ctor = lambda: LlamaForCausalLM.from_preset("llama2-tiny",
+                                                num_hidden_layers=2)
+    octor = lambda m: optim.Lamb(learning_rate=1e-2, lamb_weight_decay=0.01,
+                                 parameters=m.parameters())
+    ref = _eager_losses(ctor, octor, ids, labels, 3)
+
+    paddle.seed(0)
+    model = ctor()
+    opt = octor(model)
+    step = PipelinedTrainStep(model, opt, _mesh(pipe=2, model=2), n_micro=2)
+    losses = [float(step(ids, labels).item()) for _ in range(3)]
+    np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_lamb_pp2_zero_sharded_matches_single_device():
+    """Lamb under pp x ZeRO: chunked params/slots with `sharding`-psum'd
+    norms must match eager single-device Lamb (the r3 downgrade-to-
+    replicated warning is gone)."""
+    _, ids, labels = _make(LlamaForCausalLM, "llama2-tiny", 2)
+
+    ctor = lambda: LlamaForCausalLM.from_preset("llama2-tiny",
+                                                num_hidden_layers=2)
+    octor = lambda m: optim.Lamb(learning_rate=1e-2, lamb_weight_decay=0.01,
+                                 parameters=m.parameters())
+    ref = _eager_losses(ctor, octor, ids, labels, 3)
+
+    paddle.seed(0)
+    model = ctor()
+    opt = octor(model)
+    step = PipelinedTrainStep(model, opt, _mesh(sharding=2, pipe=2),
+                              n_micro=2, zero_stage=2, min_shard_numel=0)
+    assert step._use_zero and step._z2
+    losses = [float(step(ids, labels).item()) for _ in range(3)]
+    np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_lars_pp2_mp2_matches_single_device():
+    paddle.seed(0)
+    _, ids, labels = _make(LlamaForCausalLM, "llama2-tiny", 2)
+
+    ctor = lambda: LlamaForCausalLM.from_preset("llama2-tiny",
+                                                num_hidden_layers=2)
+    octor = lambda m: optim.LarsMomentum(learning_rate=1e-2, momentum=0.9,
+                                         parameters=m.parameters())
+    ref = _eager_losses(ctor, octor, ids, labels, 3)
+
+    paddle.seed(0)
+    model = ctor()
+    opt = octor(model)
+    step = PipelinedTrainStep(model, opt, _mesh(pipe=2, model=2), n_micro=2)
+    losses = [float(step(ids, labels).item()) for _ in range(3)]
+    np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-4)
