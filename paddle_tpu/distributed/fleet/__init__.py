@@ -250,6 +250,17 @@ class Fleet:
         from .runtime import TheOnePSRuntime
         if n_shards is None:
             n_shards = int(os.environ.get("PADDLE_PSERVER_NUMS", "1"))
+        # re-init: retire any worker Communicator bound to the old runtime
+        # (otherwise its sender thread polls a dead client forever and its
+        # queued grads are silently lost)
+        comm = getattr(self, "_ps_communicator", None)
+        if comm is not None:
+            try:
+                comm.stop()
+            except Exception:
+                pass  # old servers may already be gone; drop the queue
+            self._ps_communicator = None
+            self._ps_async_client = None
         self._ps_runtime = TheOnePSRuntime(n_shards=n_shards)
         self._ps_over_http = over_http
         if dirname:
@@ -263,17 +274,51 @@ class Fleet:
             over_http=getattr(self, "_ps_over_http", False))
 
     def init_worker(self):
-        """Returns the PSClient handle workers pull/push through."""
+        """Returns the worker's PS handle. Under strategy.a_sync the pushes
+        route through a background Communicator (async grad send with
+        merge-before-push; reference communicator.h AsyncCommunicator /
+        GeoCommunicator): a_sync_configs.k_steps > 0 bounds the staleness
+        to k un-sent batches (geo mode), otherwise send_queue_size does."""
         if getattr(self, "_ps_runtime", None) is None:
             raise RuntimeError(
                 "no PS runtime in this process: call fleet.init_server() + "
                 "fleet.run_server() first (single-node runtime)")
-        return self._ps_runtime.client
+        client = self._ps_runtime.client
+        strat = self._strategy
+        if strat is not None and getattr(strat, "a_sync", False):
+            existing = getattr(self, "_ps_async_client", None)
+            if existing is not None and existing._client is client:
+                return existing  # idempotent: keep the live Communicator
+            from .runtime.the_one_ps import AsyncPSClient, Communicator
+            cfg = strat.a_sync_configs
+            k_steps = int(getattr(cfg, "k_steps", 0) or 0)
+            bound = (k_steps if k_steps > 0
+                     else max(int(getattr(cfg, "send_queue_size", 16)), 1))
+            comm = Communicator(
+                client, mode="async", send_queue_size=bound,
+                max_merge_var_num=max(
+                    int(getattr(cfg, "max_merge_var_num", 1)), 1)).start()
+            self._ps_communicator = comm
+            self._ps_async_client = AsyncPSClient(client, comm)
+            return self._ps_async_client
+        return client
 
     def stop_worker(self):
+        comm = getattr(self, "_ps_communicator", None)
+        err = None
+        if comm is not None:
+            try:
+                comm.stop()  # flush may re-raise a buffered send error
+            except Exception as e:
+                err = e
+            finally:
+                self._ps_communicator = None
+                self._ps_async_client = None
         rt = getattr(self, "_ps_runtime", None)
         if rt is not None:
             rt.stop()
+        if err is not None:
+            raise err
 
     @property
     def util(self):

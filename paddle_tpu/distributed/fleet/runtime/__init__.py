@@ -1,5 +1,7 @@
-from .the_one_ps import (PSClient, PSEmbedding, PSServer, SparseTable,
-                         TheOnePSRuntime, distributed_lookup_table)
+from .the_one_ps import (AsyncPSClient, Communicator, DenseTable, PSClient,
+                         PSEmbedding, PSServer, SparseTable, TheOnePSRuntime,
+                         distributed_lookup_table)
 
 __all__ = ["TheOnePSRuntime", "PSServer", "PSClient", "SparseTable",
-           "PSEmbedding", "distributed_lookup_table"]
+           "DenseTable", "Communicator", "AsyncPSClient", "PSEmbedding",
+           "distributed_lookup_table"]

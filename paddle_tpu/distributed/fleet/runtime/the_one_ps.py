@@ -15,6 +15,12 @@ update rule (SGD/AdaGrad) server-side, exactly the reference's division of
 labor. Transport is in-process (single-node) or a small HTTP RPC pair
 standing in for brpc; the wire format is npz, the contract is
 pull_sparse/push_sparse/save/load like PSClient's.
+
+Round 4 additions (communicator.h, common_dense_table.cc analogs):
+DenseTable (whole-block pull/push with the shared accessor rules),
+Communicator (background async grad send with merge-before-push and a
+bounded queue as the geo staleness guarantee), AsyncPSClient (the worker
+handle fleet.init_worker returns under strategy.a_sync).
 """
 from __future__ import annotations
 
@@ -117,11 +123,53 @@ class SparseTable:
                                                        np.float32)
 
 
+class DenseTable:
+    """Fixed-shape dense parameter block with a server-side update rule
+    (common_dense_table.cc analog): workers pull the whole block and push
+    whole-block gradients; the accessor applies SGD/AdaGrad where the
+    values live. Shares SparseAccessor with the sparse tables (the same
+    rule code serves both, as the reference's accessor registry does)."""
+
+    def __init__(self, shape, accessor: SparseAccessor = None,
+                 init_std: float = 0.0, seed: int = 0):
+        self.shape = tuple(int(s) for s in shape)
+        self.accessor = accessor or SparseAccessor()
+        rng = np.random.RandomState(seed)
+        self._val = (rng.randn(*self.shape) * init_std).astype(np.float32) \
+            if init_std else np.zeros(self.shape, np.float32)
+        self._slot: Optional[np.ndarray] = None
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self._val.copy()
+
+    def push(self, grad: np.ndarray):
+        grad = np.asarray(grad, np.float32).reshape(self.shape)
+        with self._lock:
+            self._val, slot = self.accessor.apply(self._val, grad,
+                                                  self._slot)
+            if slot is not None:
+                self._slot = slot
+
+    def state(self):
+        with self._lock:
+            return (self._val.copy(),
+                    None if self._slot is None else self._slot.copy())
+
+    def load_state(self, val, slot=None):
+        with self._lock:
+            self._val = np.asarray(val, np.float32).reshape(self.shape)
+            self._slot = None if slot is None else np.asarray(
+                slot, np.float32).reshape(self.shape)
+
+
 class PSCore:
     """One server's tables (the in-process half of brpc_ps_server)."""
 
     def __init__(self):
         self.tables: Dict[str, SparseTable] = {}
+        self.dense_tables: Dict[str, DenseTable] = {}
 
     def create_table(self, name: str, dim: int, rule="sgd", lr=0.01,
                      init_std=0.01, seed=0):
@@ -129,6 +177,13 @@ class PSCore:
             self.tables[name] = SparseTable(
                 dim, SparseAccessor(rule, lr), init_std, seed)
         return self.tables[name]
+
+    def create_dense_table(self, name: str, shape, rule="sgd", lr=0.01,
+                           init_std=0.0, seed=0):
+        if name not in self.dense_tables:
+            self.dense_tables[name] = DenseTable(
+                shape, SparseAccessor(rule, lr), init_std, seed)
+        return self.dense_tables[name]
 
     def save(self, dirname: str):
         import os
@@ -140,6 +195,12 @@ class PSCore:
                      vals=vals, slot_ids=slot_ids, slot_vals=slot_vals,
                      dim=t.dim, rule=acc.rule, lr=acc.lr,
                      epsilon=acc.epsilon, init_std=t.init_std, seed=t.seed)
+        for name, t in self.dense_tables.items():
+            val, slot = t.state()
+            acc = t.accessor
+            extra = {} if slot is None else {"slot": slot}
+            np.savez(os.path.join(dirname, f"{name}.dense.npz"), val=val,
+                     rule=acc.rule, lr=acc.lr, epsilon=acc.epsilon, **extra)
 
 
 def _npz_bytes(**arrays) -> bytes:
@@ -187,6 +248,22 @@ class PSServer:
                             float(q.get("lr", 0.01)),
                             float(q.get("init_std", 0.01)),
                             int(q.get("seed", 0)))
+                        return self._respond()
+                    if u.path == "/create_dense":
+                        shape = tuple(int(s) for s in
+                                      q["shape"].split(",") if s)
+                        outer.core.create_dense_table(
+                            q["table"], shape, q.get("rule", "sgd"),
+                            float(q.get("lr", 0.01)),
+                            float(q.get("init_std", 0.0)),
+                            int(q.get("seed", 0)))
+                        return self._respond()
+                    if u.path == "/pull_dense":
+                        t = outer.core.dense_tables[q["table"]]
+                        return self._respond(_npz_bytes(val=t.pull()))
+                    if u.path == "/push_dense":
+                        t = outer.core.dense_tables[q["table"]]
+                        t.push(_npz_load(body)["grad"])
                         return self._respond()
                     table = outer.core.tables[q["table"]]
                     if u.path == "/pull":
@@ -286,6 +363,259 @@ class PSClient:
                 self._rpc(s, f"/push?table={table}",
                           _npz_bytes(ids=ids[sel], grads=grads[sel]))
 
+    # ---- dense tables (common_dense_table.cc): a named block lives whole
+    # on one shard, chosen by a stable hash of its name ----
+    def _dense_shard(self, name: str) -> int:
+        import zlib
+        return zlib.adler32(name.encode()) % self.n
+
+    def create_dense_table(self, name: str, shape, rule="sgd", lr=0.01,
+                           init_std=0.0, seed=0):
+        s = self._dense_shard(name)
+        if self._cores is not None:
+            self._cores[s].create_dense_table(name, shape, rule, lr,
+                                              init_std, seed)
+        else:
+            shp = ",".join(str(int(x)) for x in shape)
+            self._rpc(s, f"/create_dense?table={name}&shape={shp}"
+                         f"&rule={rule}&lr={lr}&init_std={init_std}"
+                         f"&seed={seed}", b"")
+
+    def pull_dense(self, name: str) -> np.ndarray:
+        s = self._dense_shard(name)
+        if self._cores is not None:
+            return self._cores[s].dense_tables[name].pull()
+        return _npz_load(self._rpc(s, f"/pull_dense?table={name}",
+                                   b""))["val"]
+
+    def push_dense(self, name: str, grad: np.ndarray):
+        s = self._dense_shard(name)
+        if self._cores is not None:
+            self._cores[s].dense_tables[name].push(grad)
+        else:
+            self._rpc(s, f"/push_dense?table={name}",
+                      _npz_bytes(grad=np.asarray(grad, np.float32)))
+
+
+class Communicator:
+    """Worker-side async gradient sender (reference
+    paddle/fluid/distributed/service/communicator.h: AsyncCommunicator /
+    GeoCommunicator). Pushes enqueue into a bounded queue; a background
+    thread drains it, MERGING up to max_merge_var_num pending pushes per
+    table into one RPC (merge-before-push — duplicate sparse ids combine
+    server-side via the accessor's MergeAdd, dense grads sum here). The
+    queue bound is the geo-style staleness guarantee: a worker can run at
+    most `send_queue_size` un-sent batches ahead of the servers; when the
+    queue is full, push() blocks (send_wait_times semantics), so staleness
+    is bounded rather than unbounded.
+
+    mode="sync" shares every code path but flushes inline: push() drains
+    the queue synchronously before returning."""
+
+    def __init__(self, client: PSClient, mode: str = "async",
+                 send_queue_size: int = 16, max_merge_var_num: int = 4):
+        import queue
+        self.client = client
+        self.mode = mode
+        self.max_merge = max(1, int(max_merge_var_num))
+        self._q = queue.Queue(maxsize=max(1, int(send_queue_size)))
+        self._thread = None
+        self._stop = threading.Event()
+        self._err = None
+        # consumer-side carry slot: merging only batches CONSECUTIVE
+        # same-table items and stashes the first mismatch here — the send
+        # path never put()s back into the bounded queue, which could
+        # deadlock against producers blocked on the staleness bound
+        self._carry = None
+        # own pending counter (not Queue.join): a producer racing a dying
+        # send thread can enqueue an item nobody will ever task_done —
+        # flush() instead polls this counter and drains inline once the
+        # thread is dead, so it can never hang
+        self._pending = 0
+        self._plock = threading.Lock()
+        # serializes consumers (_next / dead-drain): the sender thread and
+        # any number of inline flush() callers share the _carry slot
+        self._clock = threading.Lock()
+
+    # ---- enqueue side (worker) ----
+    def push_sparse(self, table: str, ids, grads):
+        self._put(("sparse", table, np.asarray(ids, np.int64),
+                   np.asarray(grads, np.float32)))
+
+    def push_dense(self, table: str, grad):
+        self._put(("dense", table, None, np.asarray(grad, np.float32)))
+
+    def _put(self, item):
+        if self._err is not None:
+            raise RuntimeError(f"Communicator send thread died: {self._err}")
+        with self._plock:
+            self._pending += 1
+        self._q.put(item)  # blocks when the staleness bound is reached
+        if self.mode == "sync":
+            self.flush()
+
+    # ---- drain side (send thread) ----
+    def _drain_batch(self, first):
+        """Collect up to max_merge CONSECUTIVE pending items for the same
+        (kind, table) as `first`; the first mismatch parks in the carry
+        slot for the next round. Strict FIFO across tables, and the send
+        path never put()s into the bounded queue (a put could deadlock
+        against producers blocked on the staleness bound)."""
+        import queue
+        kind, table = first[0], first[1]
+        batch = [first]
+        while len(batch) < self.max_merge and self._carry is None:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item[0] == kind and item[1] == table:
+                batch.append(item)
+            else:
+                self._carry = item
+        return kind, table, batch
+
+    def _send(self, kind, table, batch):
+        if kind == "sparse":
+            ids = np.concatenate([b[2] for b in batch])
+            grads = np.concatenate([b[3] for b in batch])
+            self.client.push_sparse(table, ids, grads)
+        else:
+            grad = batch[0][3]
+            for b in batch[1:]:  # merged dense grads sum before one push
+                grad = grad + b[3]
+            self.client.push_dense(table, grad)
+
+    def _ack(self, n):
+        with self._plock:
+            self._pending -= n
+
+    def _next(self, timeout=None):
+        """One consume round: send one merged batch (carry first), ack it.
+        Returns False when nothing was available. Serialized by _clock —
+        the sender thread and inline flush() callers share _carry."""
+        import queue
+        with self._clock:
+            if self._carry is not None:
+                first, self._carry = self._carry, None
+            else:
+                try:
+                    first = (self._q.get(timeout=timeout) if timeout
+                             else self._q.get_nowait())
+                except queue.Empty:
+                    return False
+            kind, table, batch = self._drain_batch(first)
+            try:
+                self._send(kind, table, batch)
+            except Exception as e:  # surface on the next push/flush
+                self._err = e
+            finally:
+                # every batch item (incl. one parked in carry earlier) was
+                # counted once at _put; ack only once sent/failed
+                self._ack(len(batch))
+            return True
+
+    def _drain_dead(self):
+        """Discard-and-ack everything after the sender died, so pending
+        reaches zero and flush() can raise instead of hanging. Shared by
+        the sender loop's exit path and inline flush()."""
+        import queue
+        with self._clock:
+            if self._carry is not None:
+                self._ack(1)
+                self._carry = None
+            while True:
+                try:
+                    self._q.get_nowait()
+                    self._ack(1)
+                except queue.Empty:
+                    return
+
+    def _loop(self):
+        while (not self._stop.is_set() or not self._q.empty()
+               or self._carry is not None):
+            if not self._next(timeout=0.05):
+                continue
+            if self._err is not None:
+                return self._drain_dead()
+
+    def start(self):
+        if self._thread is None and self.mode == "async":
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def flush(self):
+        """Block until everything queued is pushed to the servers
+        (barrier_with_table analog). Polls the pending counter; if the send
+        thread is dead or absent it drains inline, so a producer racing a
+        dying sender can never hang the barrier."""
+        import time
+        while True:
+            with self._plock:
+                pending = self._pending
+            if pending <= 0:
+                break
+            alive = self._thread is not None and self._thread.is_alive()
+            if alive:
+                time.sleep(0.003)
+                continue
+            if self._err is not None:
+                # dead sender: discard-and-ack rather than retrying sends
+                # that will fail
+                self._drain_dead()
+                time.sleep(0.001)  # let a mid-put producer land
+                continue
+            if not self._next():
+                # counted at _put but not yet visible in the queue (producer
+                # mid-put) — yield and re-check
+                time.sleep(0.001)
+        if self._err is not None:
+            raise RuntimeError(f"Communicator send thread died: {self._err}")
+
+    def stop(self):
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=10)
+            self._thread = None
+        self.flush()
+
+
+class AsyncPSClient:
+    """Drop-in PSClient facade whose pushes route through a Communicator
+    (what fleet.init_worker returns under strategy.a_sync): pulls are
+    direct (possibly stale — async-PS semantics), pushes are queued."""
+
+    def __init__(self, client: PSClient, communicator: Communicator):
+        self._client = client
+        self.communicator = communicator
+
+    @property
+    def n(self):
+        return self._client.n
+
+    def create_table(self, *a, **k):
+        return self._client.create_table(*a, **k)
+
+    def create_dense_table(self, *a, **k):
+        return self._client.create_dense_table(*a, **k)
+
+    def pull_sparse(self, table, ids):
+        return self._client.pull_sparse(table, ids)
+
+    def pull_dense(self, table):
+        return self._client.pull_dense(table)
+
+    def push_sparse(self, table, ids, grads):
+        self.communicator.push_sparse(table, ids, grads)
+
+    def push_dense(self, table, grad):
+        self.communicator.push_dense(table, grad)
+
+    def flush(self):
+        self.communicator.flush()
+
 
 class TheOnePSRuntime:
     """Single-node runtime façade: owns the server cores and the worker
@@ -330,7 +660,21 @@ class TheOnePSRuntime:
         n = len(self.cores)
         for s in range(saved_shards):
             for path in glob.glob(
+                    os.path.join(dirname, f"shard{s}", "*.dense.npz")):
+                name = os.path.basename(path)[:-len(".dense.npz")]
+                data = np.load(path)
+                acc = SparseAccessor(str(data["rule"]), float(data["lr"]),
+                                     float(data["epsilon"]))
+                t = self.cores[self.client._dense_shard(name)] \
+                    .create_dense_table(name, data["val"].shape, acc.rule,
+                                        acc.lr)
+                t.accessor = acc
+                t.load_state(data["val"],
+                             data["slot"] if "slot" in data else None)
+            for path in glob.glob(
                     os.path.join(dirname, f"shard{s}", "*.npz")):
+                if path.endswith(".dense.npz"):
+                    continue
                 name = os.path.splitext(os.path.basename(path))[0]
                 data = np.load(path)
                 acc = SparseAccessor(str(data["rule"]), float(data["lr"]),
@@ -389,12 +733,15 @@ def distributed_lookup_table(ids, table_name: str, client: PSClient = None,
     pull/push pair exposed under the reference op name)."""
     if client is None:
         from .. import fleet as fleet_singleton
-        rt = getattr(fleet_singleton(), "_ps_runtime", None)
+        fs = fleet_singleton()
+        rt = getattr(fs, "_ps_runtime", None)
         if rt is None:
             raise RuntimeError(
                 "distributed_lookup_table: no PS runtime — call "
                 "fleet.init_server() + fleet.run_server() first")
-        client = rt.client
+        # honor strategy.a_sync: route pushes through the worker's
+        # Communicator handle when init_worker built one
+        client = getattr(fs, "_ps_async_client", None) or rt.client
     import jax.numpy as jnp
 
     from ....core.tensor import Tensor, apply

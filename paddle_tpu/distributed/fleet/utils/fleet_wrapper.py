@@ -31,7 +31,11 @@ class FleetWrapper:
         return rt
 
     def _client(self):
-        return self._runtime().client
+        # honor strategy.a_sync: use the Communicator-backed worker handle
+        # when fleet.init_worker built one
+        from .. import fleet as fleet_singleton
+        async_client = getattr(fleet_singleton(), "_ps_async_client", None)
+        return async_client or self._runtime().client
 
     @staticmethod
     def _name(table_id) -> str:

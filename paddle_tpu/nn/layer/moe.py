@@ -62,26 +62,40 @@ def _top_k_dispatch(gates, capacity, top_k):
     return dispatch, combine, aux
 
 
-def moe_forward(x, gate_w, w1, b1, w2, b2, top_k, capacity_factor,
-                activation=jax.nn.gelu):
-    """Pure MoE math over arrays. x: [B, S, H]; w1: [E, H, F]; w2: [E, F, H]."""
+def _moe_core(x, gate_w, w1, b1, w2, b2, top_k, capacity_factor, activation,
+              n_experts, exchange_in=None, exchange_out=None):
+    """Shared MoE math: routing over `n_experts`, dispatch to [E, C, H]
+    buffers, expert FFN, combine. The optional exchange hooks wrap the
+    expert compute — identity for the GSPMD path, all_to_all pairs for the
+    explicit expert-parallel path — so the routing/capacity math can never
+    diverge between the two."""
     B, S, H = x.shape
-    E = w1.shape[0]
     T = B * S
     xt = x.reshape(T, H)
     logits = (xt.astype(jnp.float32) @ gate_w.astype(jnp.float32))
     gates = jax.nn.softmax(logits, axis=-1)
-    capacity = max(int(capacity_factor * T * top_k / E), top_k)
+    capacity = max(int(capacity_factor * T * top_k / n_experts), top_k)
     dispatch, combine, aux = _top_k_dispatch(gates, capacity, top_k)
-    # token → expert buffers [E, C, H]; crossing the ep sharding here makes
-    # XLA emit the all_to_all
+    # token → expert buffers [E, C, H]; on the GSPMD path, crossing the ep
+    # sharding here makes XLA emit the all_to_all
     expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
+    if exchange_in is not None:
+        expert_in = exchange_in(expert_in)
     h = activation(jnp.einsum("ech,ehf->ecf", expert_in, w1)
                    + b1[:, None, :].astype(x.dtype))
     expert_out = jnp.einsum("ecf,efh->ech", h, w2) \
         + b2[:, None, :].astype(x.dtype)
+    if exchange_out is not None:
+        expert_out = exchange_out(expert_out)
     out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
     return out.reshape(B, S, H), aux.astype(jnp.float32)
+
+
+def moe_forward(x, gate_w, w1, b1, w2, b2, top_k, capacity_factor,
+                activation=jax.nn.gelu):
+    """Pure MoE math over arrays. x: [B, S, H]; w1: [E, H, F]; w2: [E, F, H]."""
+    return _moe_core(x, gate_w, w1, b1, w2, b2, top_k, capacity_factor,
+                     activation, n_experts=w1.shape[0])
 
 
 def moe_forward_ep(x, gate_w, w1, b1, w2, b2, top_k, capacity_factor,
@@ -95,38 +109,29 @@ def moe_forward_ep(x, gate_w, w1, b1, w2, b2, top_k, capacity_factor,
     brings the results home (all_to_all is a permutation collective — its
     AD transpose is the inverse permutation, so grads are exact; expert-
     weight grads already sum over ALL ranks' tokens locally and need no
-    cross-ep reduction).
+    cross-ep reduction; aux is a local-token statistic the caller averages
+    over the ep (data) axis).
 
     Reference anchor: collective.py:1456 alltoall is the one MoE primitive
     the reference ships; this is its production use, Switch/GShard-style.
     """
     ep_n = jax.lax.psum(1, axis)  # static axis size
-    B, S, H = x.shape
-    E_local = w1.shape[0]
-    E = E_local * ep_n
-    T = B * S  # local tokens
-    xt = x.reshape(T, H)
-    logits = (xt.astype(jnp.float32) @ gate_w.astype(jnp.float32))
-    gates = jax.nn.softmax(logits, axis=-1)
-    capacity = max(int(capacity_factor * T * top_k / E), top_k)
-    dispatch, combine, aux = _top_k_dispatch(gates, capacity, top_k)
-    # local token → full-E buffers [E, C, H]
-    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
-    # exchange: split E into ep groups, concat on capacity → each rank now
-    # holds [E_local, ep_n*C, H]: its experts, everyone's tokens
-    expert_in = jax.lax.all_to_all(expert_in, axis, split_axis=0,
-                                   concat_axis=1, tiled=True)
-    h = activation(jnp.einsum("ech,ehf->ecf", expert_in, w1)
-                   + b1[:, None, :].astype(x.dtype))
-    expert_out = jnp.einsum("ecf,efh->ech", h, w2) \
-        + b2[:, None, :].astype(x.dtype)
-    # inverse exchange: results home to the token-owning ranks [E, C, H]
-    expert_out = jax.lax.all_to_all(expert_out, axis, split_axis=1,
-                                    concat_axis=0, tiled=True)
-    out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
-    # aux is computed from local tokens only; the caller averages over the
-    # ep (data) axis like any other batch statistic
-    return out.reshape(B, S, H), aux.astype(jnp.float32)
+    E = w1.shape[0] * ep_n
+
+    def exchange_in(expert_in):
+        # split E into ep groups, concat on capacity → each rank now holds
+        # [E_local, ep_n*C, H]: its experts, everyone's tokens
+        return jax.lax.all_to_all(expert_in, axis, split_axis=0,
+                                  concat_axis=1, tiled=True)
+
+    def exchange_out(expert_out):
+        # inverse: results home to the token-owning ranks [E, C, H]
+        return jax.lax.all_to_all(expert_out, axis, split_axis=1,
+                                  concat_axis=0, tiled=True)
+
+    return _moe_core(x, gate_w, w1, b1, w2, b2, top_k, capacity_factor,
+                     activation, n_experts=E, exchange_in=exchange_in,
+                     exchange_out=exchange_out)
 
 
 class MoELayer(Layer):
