@@ -105,6 +105,8 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
     }
     preset = "gpt3-125m" if on_tpu else "gpt2-tiny"
     preset = os.environ.get("BENCH_PRESET", preset)
+    if preset.endswith("-decode"):
+        return _run_decode_bench(jax, jnp, backend, on_tpu, preset, init_err)
     B, S, remat, moment_dtype = _PRESETS.get(
         preset, (8, 1024, False, "float32"))
     if not on_tpu:
@@ -268,6 +270,72 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
                 "FLAGS_flash_block_q", str(_default_blocks()[0])),
             "flash_block_k": os.environ.get(
                 "FLAGS_flash_block_k", str(_default_blocks()[1])),
+            "tpu_init_error": (init_err.splitlines()[0][:200]
+                               if init_err else None),
+        },
+    }
+    print(json.dumps(result))
+
+
+def _run_decode_bench(jax, jnp, backend, on_tpu, preset, init_err):
+    """Serving-path benchmark (VERDICT r3 item 8): KV-cache autoregressive
+    decode tokens/sec via models/generation.py (prefill + one decode-scan
+    dispatch — tunnel-friendly). Decode MFU uses 2ND (fwd only)."""
+    import os
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    # preset -> (model preset, batch, prompt len, new tokens)
+    _DECODE = {
+        "llama2-tiny-decode": ("llama2-tiny", 4, 32, 32),
+        "gpt3-125m-decode": ("gpt3-125m", 8, 128, 128),
+        "gpt3-1.3b-decode": ("gpt3-1.3b", 4, 128, 128),
+    }
+    base, B, S0, new = _DECODE.get(preset, ("llama2-tiny", 4, 32, 32))
+    if not on_tpu:  # CPU fallback: sanity number inside the budget
+        base, B, S0, new = "llama2-tiny", 2, 16, 16
+    B = int(os.environ.get("BENCH_BS", B))
+    S0 = int(os.environ.get("BENCH_SEQ", S0))
+    new = int(os.environ.get("BENCH_NEW_TOKENS", new))
+    paddle.seed(0)
+    family = LlamaForCausalLM if base.startswith("llama") else GPTForCausalLM
+    model = family.from_preset(base)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, model.config.vocab_size, (B, S0)).astype(np.int32))
+    out = model.generate(ids, max_new_tokens=new)  # warmup/compile
+    _ = np.asarray(out.data)  # forced host read (tunnel barrier)
+    t0 = time.perf_counter()
+    out = model.generate(ids, max_new_tokens=new)
+    _ = np.asarray(out.data)
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    params, _b = model.functional_state()
+    n_params = sum(int(np.prod(p.shape)) for p in params.values())
+    toks = B * new
+    tok_s = toks / dt / n_chips
+    device_kind = jax.devices()[0].device_kind
+    peak = _peak_flops(device_kind, backend)
+    mfu = 2.0 * n_params * toks / dt / n_chips / peak
+    result = {
+        "metric": f"decode tokens/sec/chip {base} bs{B} prompt{S0} "
+                  f"new{new} {'bf16' if on_tpu else 'fp32-cpu'} kv-cache",
+        "value": round(tok_s, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu, 4),
+        "extra": {
+            "decode_ms_per_token": round(dt / new * 1e3, 3),
+            "params_m": round(n_params / 1e6, 1),
+            "mfu_2nd": round(mfu, 4),
+            "backend": backend,
+            "device_kind": device_kind,
+            "peak_tflops": peak / 1e12,
+            "n_chips": n_chips,
             "tpu_init_error": (init_err.splitlines()[0][:200]
                                if init_err else None),
         },

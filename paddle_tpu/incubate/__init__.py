@@ -4,6 +4,7 @@ from . import optimizer  # noqa: F401
 from ..nn.layer.moe import MoELayer  # noqa: F401
 from ..ops.attention import flash_attention  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from .fused_rnn import fusion_gru, fusion_lstm  # noqa: F401
 
 
 def softmax_mask_fuse_upper_triangle(x):

@@ -1,0 +1,159 @@
+"""Fused single-layer RNN op surface (reference
+paddle/fluid/operators/fused/fusion_gru_op.cc and fusion_lstm_op.cc).
+
+The reference fuses the sequence GEMM (x @ WeightX for every step at once)
+with the recurrence into one op. On TPU the same structure is the idiomatic
+lax.scan program: hoist the input projection out of the scan (one big MXU
+matmul over [B*T, I]), then scan the cheap recurrent part — XLA fuses the
+elementwise gates, which is exactly what the hand-fused CPU kernel does.
+
+Semantics follow the reference kernels exactly:
+- GRU (math/detail/gru_kernel.h:77): gates layout [update, reset, cell];
+  origin_mode=True:  h = u*h_prev + (1-u)*m
+  origin_mode=False: h = (1-u)*h_prev + u*m   (the fluid default)
+  with m = act(x_c + (r*h_prev) @ W_hc).
+- LSTM (math/detail/lstm_kernel.h:30, fusion_lstm_op.cc:177): gates layout
+  {c, i, f, o}; optional peephole connections (use_peepholes).
+
+Weight layouts match the fused ops: WeightX [I, G*H], WeightH [H, G*H]
+(GRU splits WeightH into [H, 2H] update/reset and [H, H] candidate),
+Bias [G*H]. Inputs are dense [B, T, I] (the LoD packing the CPU op does is
+a memory-layout concern jax arrays don't have).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import apply
+from ..tensor.creation import _t
+
+__all__ = ["fusion_gru", "fusion_lstm"]
+
+
+_ACT = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
+        "identity": lambda a: a}
+
+
+def _apply_with_optional(f, required, optional):
+    """Route `f(*required_arrays, name1=..., name2=...)` through the tape:
+    only the optional tensors actually present become tape inputs (so
+    grads flow to them), the absent ones stay None."""
+    present = [name for name, v in optional if v is not None]
+
+    def dispatch(*arrays):
+        req = arrays[:len(required)]
+        kw = {name: None for name, _ in optional}
+        for name, v in zip(present, arrays[len(required):]):
+            kw[name] = v
+        return f(*req, *[kw[name] for name, _ in optional])
+
+    return apply(dispatch, *[_t(v) for v in required],
+                 *[_t(v) for _, v in optional if v is not None])
+
+
+def fusion_gru(x, weight_x, weight_h, bias=None, h0=None,
+               is_reverse=False, origin_mode=False, activation="tanh",
+               gate_activation="sigmoid"):
+    """Fused GRU over a dense batch. x [B, T, I]; weight_x [I, 3H];
+    weight_h [H, 3H]; bias [3H]. Returns hidden states [B, T, H]."""
+    act = _ACT[activation]
+    gate_act = _ACT[gate_activation]
+
+    def f(xa, wx, wh, b, h_init):
+        B, T, _ = xa.shape
+        H = wh.shape[0]
+        xp = jnp.einsum("bti,ig->btg", xa, wx)
+        if b is not None:
+            xp = xp + b
+        xs = jnp.swapaxes(xp, 0, 1)  # [T, B, 3H]
+        if is_reverse:
+            xs = jnp.flip(xs, 0)
+        wh_ur = wh[:, :2 * H]   # update/reset recurrent weights
+        wh_c = wh[:, 2 * H:]    # candidate recurrent weights
+        h_prev0 = (jnp.zeros((B, H), xa.dtype) if h_init is None
+                   else h_init.astype(xa.dtype))
+
+        def step(h_prev, xg):
+            ur = gate_act(xg[:, :2 * H] + h_prev @ wh_ur)
+            u, r = ur[:, :H], ur[:, H:]
+            m = act(xg[:, 2 * H:] + (r * h_prev) @ wh_c)
+            if origin_mode:
+                h = u * h_prev + (1.0 - u) * m
+            else:
+                h = (1.0 - u) * h_prev + u * m
+            return h, h
+
+        _, hs = jax.lax.scan(step, h_prev0, xs)
+        if is_reverse:
+            hs = jnp.flip(hs, 0)
+        return jnp.swapaxes(hs, 0, 1)
+
+    return _apply_with_optional(f, (x, weight_x, weight_h),
+                                [("b", bias), ("h", h0)])
+
+
+def fusion_lstm(x, weight_x, weight_h, bias=None, h0=None, c0=None,
+                is_reverse=False, use_peepholes=False,
+                activation="tanh", gate_activation="sigmoid",
+                cell_activation="tanh"):
+    """Fused LSTM over a dense batch. x [B, T, I]; weight_x [I, 4H];
+    weight_h [H, 4H] (gate layout {c, i, f, o}); bias [4H] or [7H] with
+    peepholes (checkI/checkF/checkO appended, lstm_kernel.h:37-49).
+    Returns (hidden [B, T, H], cell [B, T, H])."""
+    act = _ACT[activation]          # candidate activation
+    gate_act = _ACT[gate_activation]
+    cell_act = _ACT[cell_activation]
+
+    def f(xa, wx, wh, b, h_init, c_init):
+        B, T, _ = xa.shape
+        H = wh.shape[0]
+        gate_bias = None
+        checks = None
+        if b is not None:
+            if b.shape[-1] == 7 * H:  # peephole weights ride the bias
+                gate_bias, checks = b[:4 * H], b[4 * H:]
+            else:
+                gate_bias = b
+        if use_peepholes and checks is None:
+            raise ValueError(
+                "fusion_lstm: use_peepholes=True requires a [7H] bias "
+                "carrying checkI/checkF/checkO (fusion_lstm_op.cc:186)")
+        xp = jnp.einsum("bti,ig->btg", xa, wx)
+        if gate_bias is not None:
+            xp = xp + gate_bias
+        xs = jnp.swapaxes(xp, 0, 1)
+        if is_reverse:
+            xs = jnp.flip(xs, 0)
+        h_prev0 = (jnp.zeros((B, H), xa.dtype) if h_init is None
+                   else h_init.astype(xa.dtype))
+        c_prev0 = (jnp.zeros((B, H), xa.dtype) if c_init is None
+                   else c_init.astype(xa.dtype))
+        if use_peepholes:
+            ci, cf, co = checks[:H], checks[H:2 * H], checks[2 * H:]
+
+        def step(carry, xg):
+            h_prev, c_prev = carry
+            g = xg + h_prev @ wh
+            gc, gi, gf, go = (g[:, :H], g[:, H:2 * H], g[:, 2 * H:3 * H],
+                              g[:, 3 * H:])
+            cand = act(gc)
+            if use_peepholes:
+                gi = gi + c_prev * ci
+                gf = gf + c_prev * cf
+            i = gate_act(gi)
+            fg = gate_act(gf)
+            c = cand * i + c_prev * fg
+            if use_peepholes:
+                go = go + c * co
+            o = gate_act(go)
+            h = o * cell_act(c)
+            return (h, c), (h, c)
+
+        _, (hs, cs) = jax.lax.scan(step, (h_prev0, c_prev0), xs)
+        if is_reverse:
+            hs, cs = jnp.flip(hs, 0), jnp.flip(cs, 0)
+        return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+    return _apply_with_optional(f, (x, weight_x, weight_h),
+                                [("b", bias), ("h", h0), ("c", c0)])

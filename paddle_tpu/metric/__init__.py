@@ -175,3 +175,102 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
         return jnp.mean(hit.astype(jnp.float32))
 
     return apply(f, _t(input), _t(label))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095,
+        stat_pos=None, stat_neg=None):
+    """Op-style streaming AUC (reference operators/metrics/auc_op.cc):
+    bins predictions into num_thresholds+1 histogram buckets, merges them
+    into the running stat tensors, and returns the trapezoidal AUC over
+    the accumulated stats. Returns (auc_value, new_stat_pos, new_stat_neg)
+    — thread the stat tensors through successive calls for streaming
+    evaluation (the op's Out/StatPosOut/StatNegOut contract). Jittable."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor, apply
+    from ..tensor.creation import _t
+    if curve != "ROC":
+        raise ValueError(f"auc: only curve='ROC' is supported, got {curve}")
+    nt = int(num_thresholds)
+
+    def f(p, l, sp, sn):
+        if p.ndim == 2:
+            p = p[:, -1]  # binary: P(class 1) column (auc_op.cc contract)
+        l = l.reshape(-1)
+        bins = jnp.clip(jnp.round(p * nt).astype(jnp.int32), 0, nt)
+        pos = (l > 0).astype(jnp.float32)
+        sp = sp + jnp.zeros((nt + 1,), jnp.float32).at[bins].add(pos)
+        sn = sn + jnp.zeros((nt + 1,), jnp.float32).at[bins].add(1.0 - pos)
+        tot_pos, tot_neg = jnp.sum(sp), jnp.sum(sn)
+        tp = jnp.cumsum(sp[::-1])
+        fp = jnp.cumsum(sn[::-1])
+        # trapezoid over threshold sweep high->low, with the (0,0) origin
+        tp0 = jnp.concatenate([jnp.zeros((1,)), tp])
+        fp0 = jnp.concatenate([jnp.zeros((1,)), fp])
+        area = jnp.sum((fp0[1:] - fp0[:-1]) * (tp0[1:] + tp0[:-1]) * 0.5)
+        denom = tot_pos * tot_neg
+        val = jnp.where(denom > 0, area / jnp.where(denom > 0, denom, 1.0),
+                        0.0)
+        return val.astype(jnp.float32), sp, sn
+
+    zeros = np.zeros((nt + 1,), np.float32)
+    sp_t = _t(stat_pos) if stat_pos is not None else Tensor(zeros)
+    sn_t = _t(stat_neg) if stat_neg is not None else Tensor(zeros)
+    return apply(f, _t(input), _t(label), sp_t, sn_t)
+
+
+def precision_recall(indices, labels, num_classes, weights=None,
+                     states=None):
+    """Op-style multi-class precision/recall
+    (operators/metrics/precision_recall_op.cc): per-class TP/FP/TN/FN
+    stats from predicted `indices` vs `labels`, returning
+    (batch_metrics[6], accum_metrics[6], new_states[C, 4]) where the 6
+    metrics are [macro-P, macro-R, macro-F1, micro-P, micro-R, micro-F1]
+    and states accumulate across calls. Jittable."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor, apply
+    from ..tensor.creation import _t
+    C = int(num_classes)
+
+    def metrics6(st):
+        tp, fp, tn, fn = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+
+        def safe_div(a, b):
+            return jnp.where(b > 0, a / jnp.where(b > 0, b, 1.0), 0.0)
+
+        prec_c = safe_div(tp, tp + fp)
+        rec_c = safe_div(tp, tp + fn)
+        f1_c = safe_div(2 * prec_c * rec_c, prec_c + rec_c)
+        macro = jnp.stack([jnp.mean(prec_c), jnp.mean(rec_c),
+                           jnp.mean(f1_c)])
+        tps, fps, fns = jnp.sum(tp), jnp.sum(fp), jnp.sum(fn)
+        micro_p = safe_div(tps, tps + fps)
+        micro_r = safe_div(tps, tps + fns)
+        micro_f1 = safe_div(2 * micro_p * micro_r, micro_p + micro_r)
+        return jnp.concatenate([macro, jnp.stack([micro_p, micro_r,
+                                                  micro_f1])])
+
+    import jax
+
+    def f(idx, lab, w, st):
+        idx = idx.reshape(-1).astype(jnp.int32)
+        lab = lab.reshape(-1).astype(jnp.int32)
+        w = (jnp.ones(idx.shape, jnp.float32) if w is None
+             else w.reshape(-1).astype(jnp.float32))
+        pred_1h = jax.nn.one_hot(idx, C, dtype=jnp.float32) * w[:, None]
+        lab_1h = jax.nn.one_hot(lab, C, dtype=jnp.float32) * w[:, None]
+        tp = jnp.sum(pred_1h * (idx == lab)[:, None], axis=0)
+        fp = jnp.sum(pred_1h, axis=0) - tp
+        fn = jnp.sum(lab_1h, axis=0) - tp
+        total = jnp.sum(w)
+        tn = total - tp - fp - fn
+        batch = jnp.stack([tp, fp, tn, fn], axis=1)  # [C, 4]
+        new_st = st + batch
+        return metrics6(batch), metrics6(new_st), new_st
+
+    st_t = (_t(states) if states is not None
+            else Tensor(np.zeros((C, 4), np.float32)))
+    if weights is not None:
+        return apply(lambda i, l, w, s: f(i, l, w, s), _t(indices),
+                     _t(labels), _t(weights), st_t)
+    return apply(lambda i, l, s: f(i, l, None, s), _t(indices), _t(labels),
+                 st_t)

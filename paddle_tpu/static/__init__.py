@@ -15,6 +15,8 @@ from ..core.tensor import Tensor
 from ..jit import StaticFunction, to_static
 
 from . import nn  # noqa: F401  (paddle.static.nn: cond/case/switch_case/…)
+# op-style metrics (paddle.static.accuracy/auc; operators/metrics/*)
+from ..metric import accuracy, auc  # noqa: F401
 
 
 class InputSpec:

@@ -25,7 +25,7 @@ from ..tensor.creation import _t
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
            "box_iou", "prior_box", "anchor_generator", "box_clip",
            "iou_similarity", "bipartite_match", "multiclass_nms",
-           "matrix_nms", "distribute_fpn_proposals"]
+           "matrix_nms", "distribute_fpn_proposals", "generate_proposals"]
 
 
 def _iou_matrix(boxes_a, boxes_b, offset=0.0):
@@ -50,12 +50,14 @@ def box_iou(boxes1, boxes2):
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
-        categories=None, top_k=None):
+        categories=None, top_k=None, pixel_offset=False, eta=1.0):
     """Greedy hard-NMS (multiclass_nms_op.cc single-class core). Returns the
     kept indices sorted by score desc. With category_idxs, boxes of
     different categories never suppress each other (batched-NMS offset
-    trick). Host-side eager op (dynamic output count) — do not call inside
-    jit."""
+    trick). pixel_offset uses the +1 w/h convention in the IoU
+    (normalized=False); eta < 1 decays the threshold after each kept box
+    while it exceeds 0.5 (adaptive NMS, generate_proposals_v2_op.cc).
+    Host-side eager op (dynamic output count) — do not call inside jit."""
     boxes = _t(boxes)
     n = boxes.shape[0]
     if scores is None:
@@ -75,12 +77,16 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
 
     order = np.argsort(-sc)
     iou = np.asarray(_iou_matrix(jnp.asarray(b[order]),
-                                 jnp.asarray(b[order])))
+                                 jnp.asarray(b[order]),
+                                 1.0 if pixel_offset else 0.0))
     keep = np.ones(n, bool)
+    thresh = float(iou_threshold)
     for i in range(n):
         if not keep[i]:
             continue
-        keep[i + 1:] &= ~(iou[i, i + 1:] > iou_threshold)
+        keep[i + 1:] &= ~(iou[i, i + 1:] > thresh)
+        if eta < 1.0 and thresh > 0.5:  # adaptive decay per kept box
+            thresh *= eta
     kept = order[keep]
     if top_k is not None:
         kept = kept[:top_k]
@@ -613,3 +619,88 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     order = np.concatenate(order) if order else np.zeros(0, np.int64)
     restore = np.argsort(order).astype(np.int32)
     return outs, to_tensor(restore)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (generate_proposals_v2_op.cc; 2.x surface
+    paddle.vision.ops.generate_proposals).
+
+    scores [N, A, H, W]; bbox_deltas [N, 4A, H, W]; img_size [N, 2] (h, w);
+    anchors [H, W, A, 4]; variances [H, W, A, 4]. Per image: take the
+    pre_nms_top_n highest-scoring anchors, decode deltas against them
+    (box_coder decode with per-anchor variances, dw/dh clipped to
+    log(1000/16)), clip to the image, drop boxes smaller than min_size,
+    greedy-NMS, keep post_nms_top_n. Host-side eager op (dynamic output
+    count, like nms) — do not call inside jit.
+
+    Returns (rpn_rois [R, 4], rpn_roi_probs [R, 1]) and, with
+    return_rois_num, rois_num [N]."""
+    import numpy as np
+
+    from ..tensor.creation import to_tensor
+    sc = np.asarray(_t(scores).data, np.float32)
+    dl = np.asarray(_t(bbox_deltas).data, np.float32)
+    im = np.asarray(_t(img_size).data, np.float32)
+    an = np.asarray(_t(anchors).data, np.float32).reshape(-1, 4)
+    va = np.asarray(_t(variances).data, np.float32).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    offset = 1.0 if pixel_offset else 0.0
+    clip_ratio = np.log(1000.0 / 16.0)
+
+    all_rois, all_probs, rois_num = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)            # [H*W*A]
+        d = dl[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4)                                  # [H*W*A, 4]
+        k = min(pre_nms_top_n, s.shape[0]) if pre_nms_top_n > 0 \
+            else s.shape[0]
+        order = np.argsort(-s)[:k]
+        s_k, d_k, an_k, va_k = s[order], d[order], an[order], va[order]
+        # decode (box_coder decode_center_size with variances)
+        aw = an_k[:, 2] - an_k[:, 0] + offset
+        ah = an_k[:, 3] - an_k[:, 1] + offset
+        acx = an_k[:, 0] + aw * 0.5
+        acy = an_k[:, 1] + ah * 0.5
+        cx = va_k[:, 0] * d_k[:, 0] * aw + acx
+        cy = va_k[:, 1] * d_k[:, 1] * ah + acy
+        w = aw * np.exp(np.minimum(va_k[:, 2] * d_k[:, 2], clip_ratio))
+        h = ah * np.exp(np.minimum(va_k[:, 3] * d_k[:, 3], clip_ratio))
+        boxes = np.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - offset,
+                          cy + h * 0.5 - offset], axis=1)
+        # clip to image
+        im_h, im_w = im[n]
+        boxes[:, 0] = np.clip(boxes[:, 0], 0, im_w - offset)
+        boxes[:, 1] = np.clip(boxes[:, 1], 0, im_h - offset)
+        boxes[:, 2] = np.clip(boxes[:, 2], 0, im_w - offset)
+        boxes[:, 3] = np.clip(boxes[:, 3], 0, im_h - offset)
+        # min_size filter
+        bw = boxes[:, 2] - boxes[:, 0] + offset
+        bh = boxes[:, 3] - boxes[:, 1] + offset
+        keep = (bw >= min_size) & (bh >= min_size)
+        boxes, s_k = boxes[keep], s_k[keep]
+        if boxes.shape[0] == 0:
+            rois_num.append(0)
+            continue
+        kept = np.asarray(nms(boxes, iou_threshold=nms_thresh,
+                              scores=s_k, pixel_offset=pixel_offset,
+                              eta=eta).data)
+        if post_nms_top_n > 0:
+            kept = kept[:post_nms_top_n]
+        all_rois.append(boxes[kept])
+        all_probs.append(s_k[kept, None])
+        rois_num.append(len(kept))
+
+    rois = (np.concatenate(all_rois) if all_rois
+            else np.zeros((0, 4), np.float32))
+    probs = (np.concatenate(all_probs) if all_probs
+             else np.zeros((0, 1), np.float32))
+    out = (to_tensor(rois.astype(np.float32)),
+           to_tensor(probs.astype(np.float32)))
+    if return_rois_num:
+        return out + (to_tensor(np.asarray(rois_num, np.int32)),)
+    return out

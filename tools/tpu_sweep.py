@@ -40,6 +40,8 @@ PRESET_SWEEP = [
     ("resnet50", {"BENCH_PRESET": "resnet50"}),
     ("125m-fused-adam", {"BENCH_PRESET": "gpt3-125m",
                          "FLAGS_use_fused_adam": "1"}),
+    ("125m-decode", {"BENCH_PRESET": "gpt3-125m-decode"}),
+    ("1.3b-decode", {"BENCH_PRESET": "gpt3-1.3b-decode"}),
 ]
 QUICK = [PRESET_SWEEP[0], PRESET_SWEEP[3], PRESET_SWEEP[6]]
 
