@@ -67,6 +67,25 @@ class StaticFunction:
             out = self._pure(amp_cache_key(), rng, arrays, kw)
         return jax.tree_util.tree_map(Tensor, out)
 
+    def main_program(self, *example_args):
+        """ProgramDesc-style view of the traced graph
+        (StaticFunction.concrete_program.main_program analog): returns a
+        static.TracedProgram with blocks/ops/vars over the jaxpr. Uses
+        the stored input_spec when no example args are given."""
+        from ..static.program import TracedProgram
+        if not example_args:
+            if not self._input_spec:
+                raise ValueError(
+                    "main_program needs example inputs: pass them here or "
+                    "give to_static an input_spec")
+            import numpy as np
+            example_args = tuple(
+                Tensor(np.zeros([d if d and d > 0 else 1
+                                 for d in spec.shape], spec.dtype))
+                for spec in self._input_spec)
+        return TracedProgram.from_callable(
+            lambda *a: self._target(*a), example_args)
+
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, **kwargs):

@@ -270,7 +270,6 @@ def precision_recall(indices, labels, num_classes, weights=None,
     st_t = (_t(states) if states is not None
             else Tensor(np.zeros((C, 4), np.float32)))
     if weights is not None:
-        return apply(lambda i, l, w, s: f(i, l, w, s), _t(indices),
-                     _t(labels), _t(weights), st_t)
+        return apply(f, _t(indices), _t(labels), _t(weights), st_t)
     return apply(lambda i, l, s: f(i, l, None, s), _t(indices), _t(labels),
                  st_t)

@@ -17,6 +17,10 @@ from ..jit import StaticFunction, to_static
 from . import nn  # noqa: F401  (paddle.static.nn: cond/case/switch_case/…)
 # op-style metrics (paddle.static.accuracy/auc; operators/metrics/*)
 from ..metric import accuracy, auc  # noqa: F401
+# ProgramDesc-style introspection over traced jaxprs (framework.py
+# Program/Block/Operator/Variable analog)
+from .program import (Block, Operator, TracedProgram,  # noqa: F401
+                      Variable)
 
 
 class InputSpec:
@@ -31,7 +35,9 @@ class InputSpec:
 
 
 class Program:
-    """Placeholder program object (a traced callable owns the real graph)."""
+    """Placeholder program object (a traced callable owns the real graph).
+    For op/var-level introspection of an actual graph, trace one:
+    `static.TracedProgram.from_callable(fn, example_args)`."""
 
     def __init__(self):
         self._fn = None

@@ -1,0 +1,235 @@
+"""Program/Block/Operator/Variable introspection over traced graphs.
+
+Reference: the ProgramDesc IR (framework/program_desc.h, block_desc.h,
+op_desc.h, python/paddle/fluid/framework.py Program/Block/Operator/
+Variable). The reference builds this IR op-by-op at construction time; on
+TPU the IR is the jaxpr jax produces by tracing, so the introspection
+model here is a VIEW over a jaxpr: blocks wrap (sub-)jaxprs, operators
+wrap eqns (control-flow primitives like scan/cond/while carry their body
+jaxprs as sub-blocks, exactly the reference's nested-Block encoding of
+control flow), and variables wrap typed jaxpr vars with shape/dtype.
+
+    prog = TracedProgram.from_callable(fn, example_args)
+    prog.global_block().ops          # [Operator]
+    prog.blocks                      # nested control-flow bodies included
+    prog.to_string()                 # framework.py Program.to_string analog
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def _is_literal(v):
+    return type(v).__name__ == "Literal" or hasattr(v, "val")
+
+
+class Variable:
+    """VarDesc analog: a typed value in a block."""
+
+    def __init__(self, name: str, shape, dtype, persistable: bool = False):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = str(dtype)
+        self.persistable = persistable
+
+    def __repr__(self):
+        return (f"var {self.name} : shape{list(self.shape)} "
+                f"dtype({self.dtype})")
+
+
+class Operator:
+    """OpDesc analog: one primitive application."""
+
+    def __init__(self, type: str, input_arg_names: List[str],
+                 output_arg_names: List[str], attrs: Dict[str, Any],
+                 sub_block_ids: List[int]):
+        self.type = type
+        self.input_arg_names = input_arg_names
+        self.output_arg_names = output_arg_names
+        self._attrs = attrs
+        self.sub_block_ids = sub_block_ids  # control-flow body blocks
+
+    def attr(self, name):
+        return self._attrs.get(name)
+
+    def attr_names(self):
+        return sorted(self._attrs)
+
+    def __repr__(self):
+        ins = ", ".join(self.input_arg_names)
+        outs = ", ".join(self.output_arg_names)
+        sub = (f" sub_blocks={self.sub_block_ids}"
+               if self.sub_block_ids else "")
+        return f"{{{outs}}} = {self.type}({ins}){sub}"
+
+
+class Block:
+    """BlockDesc analog: ordered ops + the vars they define/use."""
+
+    def __init__(self, idx: int, parent_idx: Optional[int]):
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.ops: List[Operator] = []
+        self._vars: Dict[str, Variable] = {}
+
+    def var(self, name: str) -> Variable:
+        if name not in self._vars:
+            raise ValueError(f"block {self.idx} has no variable {name!r}")
+        return self._vars[name]
+
+    def has_var(self, name: str) -> bool:
+        return name in self._vars
+
+    def all_vars(self):
+        return list(self._vars.values())
+
+    def __repr__(self):
+        lines = [f"block {self.idx} (parent {self.parent_idx}):"]
+        lines += [f"  {v!r}" for v in self._vars.values()]
+        lines += [f"  {op!r}" for op in self.ops]
+        return "\n".join(lines)
+
+
+def _aval_of(v):
+    aval = getattr(v, "aval", None)
+    return ((), "?") if aval is None else (getattr(aval, "shape", ()),
+                                           getattr(aval, "dtype", "?"))
+
+
+class TracedProgram:
+    """Program analog backed by a traced jaxpr (the real IR)."""
+
+    def __init__(self):
+        self.blocks: List[Block] = []
+        self._feed_names: List[str] = []
+        self._fetch_names: List[str] = []
+        self._var_names: Dict[int, str] = {}  # id(jaxpr var) -> name
+        self._counter = 0
+
+    # ---- construction ----
+    @classmethod
+    def from_jaxpr(cls, closed_jaxpr) -> "TracedProgram":
+        prog = cls()
+        root = prog._add_block(closed_jaxpr.jaxpr, parent_idx=None,
+                               const_persistable=True)
+        prog._feed_names = [prog._name_of(v)
+                            for v in closed_jaxpr.jaxpr.invars]
+        prog._fetch_names = [prog._name_of(v)
+                             for v in closed_jaxpr.jaxpr.outvars
+                             if not _is_literal(v)]
+        assert root == 0
+        return prog
+
+    def _name_of(self, v, kind="tmp"):
+        key = id(v)
+        if key not in self._var_names:
+            self._var_names[key] = f"{kind}_{self._counter}"
+            self._counter += 1
+        return self._var_names[key]
+
+    @classmethod
+    def from_callable(cls, fn, example_args) -> "TracedProgram":
+        import jax
+
+        from ..core.tensor import Tensor, no_grad
+
+        def pure(*arrays):
+            wrapped = [Tensor(a) for a in arrays]
+            with no_grad():
+                out = fn(*wrapped)
+            return jax.tree_util.tree_map(
+                lambda o: o.data if isinstance(o, Tensor) else o, out,
+                is_leaf=lambda o: isinstance(o, Tensor))
+
+        arrays = [a.data if isinstance(a, Tensor) else a
+                  for a in example_args]
+        return cls.from_jaxpr(jax.make_jaxpr(pure)(*arrays))
+
+    def _add_block(self, jaxpr, parent_idx, const_persistable=False) -> int:
+        idx = len(self.blocks)
+        block = Block(idx, parent_idx)
+        self.blocks.append(block)
+
+        def declare(v, persistable=False, kind="tmp"):
+            if _is_literal(v):  # inline constant, not a named variable
+                val = getattr(v, "val", v)
+                s = np.array2string(np.asarray(val), threshold=4) \
+                    if hasattr(val, "shape") else repr(val)
+                return f"lit({s})"
+            name = self._name_of(v, kind)
+            if name not in block._vars:
+                shape, dtype = _aval_of(v)
+                block._vars[name] = Variable(name, shape, dtype,
+                                             persistable)
+            return name
+
+        for v in jaxpr.invars:
+            declare(v, kind="feed" if parent_idx is None else "in")
+        for v in jaxpr.constvars:
+            declare(v, persistable=const_persistable, kind="param")
+        for eqn in jaxpr.eqns:
+            ins = [declare(v) for v in eqn.invars]
+            outs = [declare(v) for v in eqn.outvars]
+            attrs = {}
+            sub_ids = []
+            for k, p in eqn.params.items():
+                sub = self._maybe_subjaxprs(p)
+                if sub:
+                    for s in sub:
+                        sub_ids.append(self._add_block(s, idx))
+                else:
+                    attrs[k] = p
+            block.ops.append(Operator(eqn.primitive.name, ins, outs, attrs,
+                                      sub_ids))
+        return idx
+
+    @staticmethod
+    def _maybe_subjaxprs(p):
+        """Control-flow params carry body jaxprs (scan/while: `jaxpr`,
+        cond: `branches` tuple) — these become nested blocks."""
+        import jax.extend as jex
+
+        def unwrap(x):
+            if isinstance(x, jex.core.ClosedJaxpr):
+                return x.jaxpr
+            if isinstance(x, jex.core.Jaxpr):
+                return x
+            return None
+
+        one = unwrap(p)
+        if one is not None:
+            return [one]
+        if isinstance(p, (tuple, list)):
+            subs = [unwrap(x) for x in p]
+            if subs and all(s is not None for s in subs):
+                return subs
+        return None
+
+    # ---- framework.py Program surface ----
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def block(self, idx: int) -> Block:
+        return self.blocks[idx]
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.blocks)
+
+    def all_parameters(self):
+        return [v for v in self.global_block().all_vars() if v.persistable]
+
+    def feed_names(self):
+        return list(self._feed_names)
+
+    def fetch_names(self):
+        return list(self._fetch_names)
+
+    def to_string(self, throw_on_error=False, with_details=False) -> str:
+        return "\n".join(repr(b) for b in self.blocks)
+
+    def __repr__(self):
+        return (f"TracedProgram(blocks={self.num_blocks}, "
+                f"ops={sum(len(b.ops) for b in self.blocks)})")

@@ -79,15 +79,19 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     iou = np.asarray(_iou_matrix(jnp.asarray(b[order]),
                                  jnp.asarray(b[order]),
                                  1.0 if pixel_offset else 0.0))
-    keep = np.ones(n, bool)
+    # candidate-driven greedy pass (NMSFast): each candidate is tested
+    # against all kept boxes at the CURRENT adaptive threshold; the eta
+    # decay after a keep therefore applies to every later candidate
+    kept_rows = []
     thresh = float(iou_threshold)
-    for i in range(n):
-        if not keep[i]:
+    for j in range(n):
+        if any(iou[k, j] > thresh for k in kept_rows):
             continue
-        keep[i + 1:] &= ~(iou[i, i + 1:] > thresh)
+        kept_rows.append(j)
         if eta < 1.0 and thresh > 0.5:  # adaptive decay per kept box
             thresh *= eta
-    kept = order[keep]
+    kept = order[np.asarray(kept_rows, np.int64)] if kept_rows else \
+        np.zeros((0,), np.int64)
     if top_k is not None:
         kept = kept[:top_k]
     from ..tensor.creation import to_tensor
@@ -678,10 +682,16 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         boxes[:, 1] = np.clip(boxes[:, 1], 0, im_h - offset)
         boxes[:, 2] = np.clip(boxes[:, 2], 0, im_w - offset)
         boxes[:, 3] = np.clip(boxes[:, 3], 0, im_h - offset)
-        # min_size filter
+        # min_size filter (FilterBoxes: min_size clamps to >= 1.0, and
+        # with pixel_offset the box center must lie inside the image)
+        ms = max(float(min_size), 1.0)
         bw = boxes[:, 2] - boxes[:, 0] + offset
         bh = boxes[:, 3] - boxes[:, 1] + offset
-        keep = (bw >= min_size) & (bh >= min_size)
+        keep = (bw >= ms) & (bh >= ms)
+        if pixel_offset:
+            cx = boxes[:, 0] + bw * 0.5
+            cy = boxes[:, 1] + bh * 0.5
+            keep &= (cx >= 0) & (cx < im_w) & (cy >= 0) & (cy < im_h)
         boxes, s_k = boxes[keep], s_k[keep]
         if boxes.shape[0] == 0:
             rois_num.append(0)

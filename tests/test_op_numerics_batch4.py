@@ -426,3 +426,33 @@ def test_nms_pixel_offset_changes_iou_convention():
                            pixel_offset=True).data)
     assert len(k_norm) == 2   # IoU (0,1] convention: 1/7 < 0.2
     assert len(k_pix) == 1    # +1 convention: 4/14 > 0.2
+
+
+def test_nms_eta_decays_before_later_candidates():
+    """NMSFast ordering: after keeping box A the decayed threshold applies
+    to candidate B immediately (reference suppresses B at 0.55 > 0.48)."""
+    from paddle_tpu.vision.ops import nms
+    boxes = np.array([[0, 0, 10, 10], [2.8, 0, 12.8, 10]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    keep = np.asarray(nms(boxes, iou_threshold=0.6, scores=scores,
+                          eta=0.8).data)
+    assert len(keep) == 1  # B tested at 0.48, not 0.6
+
+
+def test_generate_proposals_min_size_clamped_to_one():
+    """FilterBoxes clamps min_size to >= 1.0: sub-pixel boxes are dropped
+    even when the caller passes min_size=0.1."""
+    from paddle_tpu.vision.ops import generate_proposals
+    anchors = np.zeros((1, 1, 2, 4), np.float32)
+    anchors[0, 0, 0] = [0, 0, 8, 8]
+    anchors[0, 0, 1] = [0, 0, 0.5, 0.5]  # 0.5px box: >= 0.1 but < 1.0
+    rois, probs, num = generate_proposals(
+        paddle.to_tensor(np.full((1, 2, 1, 1), 0.9, np.float32)),
+        paddle.to_tensor(np.zeros((1, 8, 1, 1), np.float32)),
+        paddle.to_tensor(np.array([[16., 16.]], np.float32)),
+        paddle.to_tensor(anchors),
+        paddle.to_tensor(np.ones((1, 1, 2, 4), np.float32)),
+        min_size=0.1, return_rois_num=True)
+    assert int(np.asarray(num.data)[0]) == 1
+    np.testing.assert_allclose(np.asarray(rois.data)[0], [0, 0, 8, 8],
+                               atol=1e-5)

@@ -1,0 +1,90 @@
+"""ProgramDesc-style introspection over traced jaxprs (reference
+framework/program_desc.h + python framework.py Program/Block/Operator/
+Variable; here a view over the real IR, the jaxpr)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import TracedProgram
+
+
+def _mlp():
+    paddle.seed(0)
+    return paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                                paddle.nn.Linear(8, 2))
+
+
+def test_program_blocks_ops_vars():
+    model = _mlp()
+    prog = TracedProgram.from_callable(
+        lambda x: model(x),
+        [paddle.to_tensor(np.ones((2, 4), np.float32))])
+    blk = prog.global_block()
+    types = [op.type for op in blk.ops]
+    assert "dot_general" in types          # the two matmuls
+    assert types.count("dot_general") == 2
+    # model weights surface as persistable params with real shapes
+    shapes = sorted(tuple(v.shape) for v in prog.all_parameters())
+    assert shapes == [(2,), (4, 8), (8,), (8, 2)]
+    # feed/fetch
+    assert len(prog.feed_names()) == 1
+    f = blk.var(prog.feed_names()[0])
+    assert f.shape == (2, 4) and "float32" in f.dtype
+    out = blk.var(prog.fetch_names()[0])
+    assert out.shape == (2, 2)
+
+
+def test_program_ops_reference_declared_vars():
+    model = _mlp()
+    prog = TracedProgram.from_callable(
+        lambda x: model(x),
+        [paddle.to_tensor(np.ones((2, 4), np.float32))])
+    blk = prog.global_block()
+    for op in blk.ops:
+        for name in op.input_arg_names + op.output_arg_names:
+            if name.startswith("lit("):
+                continue
+            assert blk.has_var(name), (op, name)
+
+
+def test_control_flow_becomes_sub_blocks():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+
+    def fn(x):
+        def body(c, _):
+            return c * 2.0, c
+
+        out, _ = jax.lax.scan(body, x.data.sum(), None, length=4)
+        return Tensor(out)
+
+    prog = TracedProgram.from_callable(
+        fn, [paddle.to_tensor(np.ones(3, np.float32))])
+    scan_ops = [op for op in prog.global_block().ops if op.type == "scan"]
+    assert scan_ops, [op.type for op in prog.global_block().ops]
+    op = scan_ops[0]
+    assert op.attr("length") == 4
+    assert op.sub_block_ids  # the body jaxpr is a nested block
+    sub = prog.block(op.sub_block_ids[0])
+    assert sub.parent_idx == 0
+    assert [o.type for o in sub.ops] == ["mul"]
+
+
+def test_to_static_main_program():
+    model = _mlp()
+    fn = paddle.jit.to_static(model)
+    prog = fn.main_program(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    assert prog.num_blocks >= 1
+    assert prog.all_parameters()
+    s = prog.to_string()
+    assert "dot_general" in s and "param_" in s
+
+
+def test_main_program_from_input_spec():
+    from paddle_tpu.static import InputSpec
+    model = _mlp()
+    fn = paddle.jit.to_static(
+        model, input_spec=[InputSpec([None, 4], "float32")])
+    prog = fn.main_program()
+    assert prog.global_block().var(prog.feed_names()[0]).shape[1] == 4
