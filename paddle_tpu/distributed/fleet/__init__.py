@@ -259,8 +259,9 @@ class Fleet:
                 comm.stop()
             except Exception:
                 pass  # old servers may already be gone; drop the queue
-            self._ps_communicator = None
-            self._ps_async_client = None
+        self._ps_communicator = None
+        self._ps_async_client = None
+        self._ps_worker_runtime = None
         self._ps_runtime = TheOnePSRuntime(n_shards=n_shards)
         self._ps_over_http = over_http
         if dirname:
@@ -283,12 +284,16 @@ class Fleet:
             raise RuntimeError(
                 "no PS runtime in this process: call fleet.init_server() + "
                 "fleet.run_server() first (single-node runtime)")
+        # idempotent: one worker handle per runtime — a repeat call must
+        # NOT build a second Communicator (leaked thread + lost queued
+        # grads) or a second cache (independent invalidation)
+        if (getattr(self, "_ps_async_client", None) is not None
+                and getattr(self, "_ps_worker_runtime", None)
+                is self._ps_runtime):
+            return self._ps_async_client
         client = self._ps_runtime.client
         strat = self._strategy
         if strat is not None and getattr(strat, "a_sync", False):
-            existing = getattr(self, "_ps_async_client", None)
-            if existing is not None and existing._client is client:
-                return existing  # idempotent: keep the live Communicator
             from .runtime.the_one_ps import AsyncPSClient, Communicator
             cfg = strat.a_sync_configs
             k_steps = int(getattr(cfg, "k_steps", 0) or 0)
@@ -299,8 +304,18 @@ class Fleet:
                 max_merge_var_num=max(
                     int(getattr(cfg, "max_merge_var_num", 1)), 1)).start()
             self._ps_communicator = comm
-            self._ps_async_client = AsyncPSClient(client, comm)
-            return self._ps_async_client
+            client = AsyncPSClient(client, comm)
+            self._ps_async_client = client
+        if strat is not None and getattr(strat, "heter_ccl_mode", False):
+            # heterogeneous-PS analog: hot-row cache tier on the worker
+            # (heter_comm.h / ps_gpu_wrapper.cc recast — see HeterPSCache)
+            from .runtime.the_one_ps import HeterPSCache
+            client = HeterPSCache(client)
+            self._ps_async_client = client
+            # the runtime invalidates registered caches on load()
+            self._ps_runtime.register_worker_cache(client)
+        if client is not self._ps_runtime.client:
+            self._ps_worker_runtime = self._ps_runtime
         return client
 
     def stop_worker(self):
@@ -311,9 +326,11 @@ class Fleet:
                 comm.stop()  # flush may re-raise a buffered send error
             except Exception as e:
                 err = e
-            finally:
-                self._ps_communicator = None
-                self._ps_async_client = None
+        # always retire the worker handle (heter-only builds no Communicator
+        # but the cache must not survive into a new runtime)
+        self._ps_communicator = None
+        self._ps_async_client = None
+        self._ps_worker_runtime = None
         rt = getattr(self, "_ps_runtime", None)
         if rt is not None:
             rt.stop()

@@ -1,7 +1,9 @@
-from .the_one_ps import (AsyncPSClient, Communicator, DenseTable, PSClient,
+from .the_one_ps import (AsyncPSClient, Communicator, DenseTable,
+                         HeterPSCache, PSClient,
                          PSEmbedding, PSServer, SparseTable, TheOnePSRuntime,
                          distributed_lookup_table)
 
 __all__ = ["TheOnePSRuntime", "PSServer", "PSClient", "SparseTable",
-           "DenseTable", "Communicator", "AsyncPSClient", "PSEmbedding",
+           "DenseTable", "Communicator", "AsyncPSClient", "HeterPSCache",
+           "PSEmbedding",
            "distributed_lookup_table"]

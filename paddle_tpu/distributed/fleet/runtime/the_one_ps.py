@@ -582,6 +582,116 @@ class Communicator:
         self.flush()
 
 
+class HeterPSCache:
+    """Worker-side hot-row cache tier (heterogeneous-PS analog; reference
+    framework/fleet/heter_ps/heter_comm.h + ps_gpu_wrapper.cc keep hot
+    embedding rows in the accelerator-adjacent fast tier with the bulk on
+    the servers). TPU-native recast: the fast tier is worker host memory
+    next to the chip — an LRU cache of rows keyed (table, id), serving
+    repeat pulls locally within a bounded staleness window.
+
+    Consistency contract (matching the reference's async pull/push mode):
+    - pull: cache hit serves the locally-cached row if it was refreshed
+      within `max_staleness` pushes to that table, else refetches;
+    - push: forwarded to the PS AND the pushed rows are invalidated (the
+      server-side accessor owns the update rule, so the cached copy is
+      stale the moment a grad lands); the per-table push counter advances
+      the staleness clock for every other cached row of that table.
+    """
+
+    def __init__(self, client, capacity: int = 100_000,
+                 max_staleness: int = 1):
+        from collections import OrderedDict
+        self._client = client
+        self.capacity = int(capacity)
+        self.max_staleness = int(max_staleness)
+        self._rows: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._push_clock: Dict[str, int] = {}
+        self.hits = 0
+        self.misses = 0
+        self._lock = threading.Lock()
+
+    @property
+    def n(self):
+        return self._client.n
+
+    def create_table(self, *a, **k):
+        return self._client.create_table(*a, **k)
+
+    def create_dense_table(self, *a, **k):
+        return self._client.create_dense_table(*a, **k)
+
+    def pull_dense(self, table):
+        return self._client.pull_dense(table)
+
+    def push_dense(self, table, grad):
+        return self._client.push_dense(table, grad)
+
+    def pull_sparse(self, table: str, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        if len(ids) == 0:  # match PSClient's empty-batch contract
+            return np.zeros((0, 0), np.float32)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        with self._lock:
+            clock0 = self._push_clock.get(table, 0)
+            fresh = {}
+            for k_ in uniq:
+                key = (table, int(k_))
+                hit = self._rows.get(key)
+                if hit is not None and \
+                        clock0 - hit[1] <= self.max_staleness:
+                    fresh[int(k_)] = hit[0]
+                    self._rows.move_to_end(key)  # LRU touch
+            missing = np.asarray(
+                [k_ for k_ in uniq if int(k_) not in fresh], np.int64)
+            self.hits += len(uniq) - len(missing)
+            self.misses += len(missing)
+        if len(missing):
+            fetched = self._client.pull_sparse(table, missing)
+            with self._lock:
+                # stamp with the PRE-fetch clock; if a push raced the
+                # fetch the clock moved — serve the rows but do NOT cache
+                # them (they may predate the push, and caching them as
+                # fresh would break the push-invalidation contract)
+                cacheable = self._push_clock.get(table, 0) == clock0
+                for k_, row in zip(missing, fetched):
+                    row = np.array(row)  # own copy: a view would pin the
+                    fresh[int(k_)] = row  # whole fetched batch in memory
+                    if cacheable:
+                        self._rows[(table, int(k_))] = (row, clock0)
+                        self._rows.move_to_end((table, int(k_)))
+                while len(self._rows) > self.capacity:
+                    self._rows.popitem(last=False)  # evict coldest
+        out = np.stack([fresh[int(k_)] for k_ in uniq])
+        return out[inv].reshape(len(ids), -1)
+
+    def push_sparse(self, table: str, ids: np.ndarray, grads: np.ndarray):
+        self._client.push_sparse(table, ids, grads)
+        with self._lock:
+            # pushed rows are stale immediately (server-side rule applied
+            # there); every OTHER cached row of the table ages one tick
+            self._push_clock[table] = self._push_clock.get(table, 0) + 1
+            for k_ in np.unique(np.asarray(ids, np.int64)):
+                self._rows.pop((table, int(k_)), None)
+
+    def flush(self):
+        if hasattr(self._client, "flush"):
+            self._client.flush()
+
+    def invalidate(self, table: Optional[str] = None):
+        with self._lock:
+            if table is None:
+                self._rows.clear()
+            else:
+                for key in [k_ for k_ in self._rows if k_[0] == table]:
+                    self._rows.pop(key)
+
+    @property
+    def hit_rate(self):
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+
 class AsyncPSClient:
     """Drop-in PSClient facade whose pushes route through a Communicator
     (what fleet.init_worker returns under strategy.a_sync): pulls are
@@ -625,6 +735,13 @@ class TheOnePSRuntime:
         self.cores = [PSCore() for _ in range(n_shards)]
         self.servers: List[PSServer] = []
         self.client = PSClient(cores=self.cores)
+        self._worker_caches: List["HeterPSCache"] = []
+
+    def register_worker_cache(self, cache: "HeterPSCache"):
+        """Caches registered here are invalidated when load() replaces
+        table contents (otherwise they would serve pre-load rows until a
+        push happens to advance their staleness clock)."""
+        self._worker_caches.append(cache)
 
     def run_server(self, over_http: bool = False):
         if over_http and not self.servers:
@@ -646,10 +763,13 @@ class TheOnePSRuntime:
         """Re-shards on load: rows are re-distributed by id % current
         n_shards, so a checkpoint saved with a different shard count
         restores losslessly (a shard-count mismatch must never silently
-        drop rows back to the random initializer)."""
+        drop rows back to the random initializer). Registered worker
+        caches are invalidated — loaded rows replace what they hold."""
         import glob
         import json as _json
         import os
+        for cache in self._worker_caches:
+            cache.invalidate()
         meta_path = os.path.join(dirname, "ps_meta.json")
         if os.path.exists(meta_path):
             with open(meta_path) as f:

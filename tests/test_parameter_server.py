@@ -330,3 +330,129 @@ def test_fleet_a_sync_worker_trains_async():
     finally:
         fleet.stop_worker()
         fleet.fleet()._strategy = None
+
+
+# ---- heterogeneous-PS analog: worker hot-row cache tier ----
+
+def test_heter_cache_serves_hot_rows_and_counts():
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import (
+        HeterPSCache, TheOnePSRuntime)
+    rt = TheOnePSRuntime(n_shards=2)
+    rt.client.create_table("emb", 4, lr=0.5, init_std=0.1)
+    cache = HeterPSCache(rt.client, capacity=10, max_staleness=1)
+    ids = np.array([1, 2, 3], np.int64)
+    v1 = cache.pull_sparse("emb", ids)
+    assert cache.misses == 3 and cache.hits == 0
+    v2 = cache.pull_sparse("emb", ids)  # all hot now
+    assert cache.hits == 3
+    np.testing.assert_allclose(v2, v1)
+    assert cache.hit_rate == 0.5
+    # duplicate ids reassemble through the unique/inverse path
+    v3 = cache.pull_sparse("emb", np.array([2, 2, 1], np.int64))
+    np.testing.assert_allclose(v3[0], v3[1])
+    np.testing.assert_allclose(v3[2], v1[0])
+
+
+def test_heter_cache_push_invalidates_and_ages():
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import (
+        HeterPSCache, TheOnePSRuntime)
+    rt = TheOnePSRuntime(n_shards=1)
+    rt.client.create_table("emb", 2, lr=1.0, init_std=0.0)
+    cache = HeterPSCache(rt.client, max_staleness=1)
+    ids = np.array([5], np.int64)
+    cache.pull_sparse("emb", ids)
+    # push through the cache: server row moves AND the cached copy dies
+    cache.push_sparse("emb", ids, np.ones((1, 2), np.float32))
+    after = cache.pull_sparse("emb", ids)
+    np.testing.assert_allclose(after, -1.0)  # fresh from the server
+    # a different row cached now ages out after max_staleness pushes
+    cache.pull_sparse("emb", np.array([7], np.int64))
+    cache.push_sparse("emb", ids, np.ones((1, 2), np.float32))  # tick 1
+    pre = cache.hits
+    cache.pull_sparse("emb", np.array([7], np.int64))  # still fresh
+    assert cache.hits == pre + 1
+    cache.push_sparse("emb", ids, np.ones((1, 2), np.float32))  # tick 2
+    pre_m = cache.misses
+    cache.pull_sparse("emb", np.array([7], np.int64))  # staleness exceeded
+    assert cache.misses == pre_m + 1
+
+
+def test_heter_cache_lru_eviction():
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import (
+        HeterPSCache, TheOnePSRuntime)
+    rt = TheOnePSRuntime(n_shards=1)
+    rt.client.create_table("emb", 2, lr=1.0, init_std=0.1)
+    cache = HeterPSCache(rt.client, capacity=2)
+    cache.pull_sparse("emb", np.array([1], np.int64))
+    cache.pull_sparse("emb", np.array([2], np.int64))
+    cache.pull_sparse("emb", np.array([1], np.int64))  # touch 1 (hot)
+    cache.pull_sparse("emb", np.array([3], np.int64))  # evicts 2 (coldest)
+    pre_h, pre_m = cache.hits, cache.misses
+    cache.pull_sparse("emb", np.array([1], np.int64))
+    assert cache.hits == pre_h + 1
+    cache.pull_sparse("emb", np.array([2], np.int64))
+    assert cache.misses == pre_m + 1
+
+
+def test_fleet_heter_ccl_mode_wraps_worker_in_cache():
+    from paddle_tpu.distributed import DistributedStrategy
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import HeterPSCache
+    strategy = DistributedStrategy()
+    strategy.heter_ccl_mode = True
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        fleet.init_server(n_shards=2)
+        fleet.run_server()
+        client = fleet.init_worker()
+        assert isinstance(client, HeterPSCache)
+        # end to end: the PSEmbedding trains through the cache tier
+        paddle.seed(0)
+        emb = PSEmbedding(client, "u", 100, 4, lr=0.2, init_std=0.1)
+        ids = paddle.to_tensor(np.array([3, 4, 3], np.int64))
+        out = emb(ids)
+        out.sum().backward()
+        assert cache_hit_total(client) > 0  # the PSEmbedding path went through the cache
+        v = client.pull_sparse("u", np.array([3], np.int64))
+        assert np.isfinite(v).all()
+    finally:
+        fleet.stop_worker()
+        fleet.fleet()._strategy = None
+
+
+def cache_hit_total(c):
+    return c.hits + c.misses
+
+
+def test_heter_cache_empty_ids_and_load_invalidation(tmp_path):
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import (
+        HeterPSCache, TheOnePSRuntime)
+    rt = TheOnePSRuntime(n_shards=1)
+    rt.client.create_table("emb", 2, lr=1.0, init_std=0.1)
+    cache = HeterPSCache(rt.client)
+    rt.register_worker_cache(cache)
+    assert cache.pull_sparse("emb", np.array([], np.int64)).shape == (0, 0)
+    v0 = cache.pull_sparse("emb", np.array([1], np.int64))
+    rt.save(str(tmp_path / "ck"))
+    # mutate server-side, then load the checkpoint: the cache must refetch
+    rt.client.push_sparse("emb", np.array([1], np.int64),
+                          np.ones((1, 2), np.float32))
+    rt.load(str(tmp_path / "ck"))
+    v1 = cache.pull_sparse("emb", np.array([1], np.int64))
+    np.testing.assert_allclose(v1, v0)  # restored rows, not cached stale
+
+
+def test_heter_init_worker_idempotent():
+    from paddle_tpu.distributed import DistributedStrategy
+    strategy = DistributedStrategy()
+    strategy.heter_ccl_mode = True
+    strategy.a_sync = True
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        fleet.init_server(n_shards=1)
+        fleet.run_server()
+        c1 = fleet.init_worker()
+        c2 = fleet.init_worker()
+        assert c1 is c2  # no duplicate Communicator/cache
+    finally:
+        fleet.stop_worker()
+        fleet.fleet()._strategy = None
