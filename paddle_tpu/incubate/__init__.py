@@ -5,6 +5,7 @@ from ..nn.layer.moe import MoELayer  # noqa: F401
 from ..ops.attention import flash_attention  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from .fused_rnn import fusion_gru, fusion_lstm  # noqa: F401
+from .contrib_ops import cvm, data_norm, fsp_matrix, row_conv  # noqa: F401
 
 
 def softmax_mask_fuse_upper_triangle(x):

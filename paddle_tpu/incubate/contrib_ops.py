@@ -1,0 +1,78 @@
+"""Contrib op tail (reference operators/ singletons surfaced through
+fluid.layers / static.nn): fsp_matrix (distillation), row_conv
+(lookahead convolution, DeepSpeech2), cvm (continuous-value model for
+CTR), data_norm (global-statistics normalization for CTR). Each is the
+reference op's math re-expressed as jnp on the tape."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import apply
+from ..tensor.creation import _t
+
+__all__ = ["fsp_matrix", "row_conv", "cvm", "data_norm"]
+
+
+def fsp_matrix(x, y):
+    """fsp_op.cc (Flow of Solution Procedure, distillation): Gram matrix
+    between two feature maps of the same spatial size.
+    x [B, C1, H, W], y [B, C2, H, W] -> [B, C1, C2] / (H*W)."""
+    def f(a, b):
+        B, C1, H, W = a.shape
+        return jnp.einsum("bchw,bdhw->bcd", a, b) / (H * W)
+
+    return apply(f, _t(x), _t(y))
+
+
+def row_conv(x, weight):
+    """row_conv_op.cc (lookahead convolution): out[b, t] =
+    sum_{k=0..K-1} x[b, t+k] * weight[k] — a causal-into-the-future
+    depthwise conv along time. x [B, T, D], weight [K, D]."""
+    def f(a, w):
+        B, T, D = a.shape
+        K = w.shape[0]
+        pad = jnp.pad(a, ((0, 0), (0, K - 1), (0, 0)))
+        out = jnp.zeros_like(a)
+        for k in range(K):  # K is small (lookahead window)
+            out = out + pad[:, k:k + T, :] * w[k][None, None, :]
+        return out
+
+    return apply(f, _t(x), _t(weight))
+
+
+def cvm(x, use_cvm=True):
+    """cvm_op.cc (continuous value model, CTR): the first two columns of
+    each instance are show/click counters. use_cvm=True keeps all columns
+    but rewrites them to (log(show+1), log(click+1) - log(show+1));
+    use_cvm=False drops the two counter columns."""
+    def f(a):
+        show = jnp.log(a[:, 0:1] + 1.0)
+        click = jnp.log(a[:, 1:2] + 1.0) - show
+        if use_cvm:
+            return jnp.concatenate([show, click, a[:, 2:]], axis=1)
+        return a[:, 2:]
+
+    return apply(f, _t(x))
+
+
+def data_norm(x, batch_size, batch_sum, batch_square_sum):
+    """data_norm_op.cc (CTR feature normalization by GLOBAL statistics):
+    means = batch_sum / batch_size and scales =
+    sqrt(batch_size / batch_square_sum) — EXACTLY the reference kernel
+    (data_norm_op.cc:302-303: no epsilon, no mean-centering of the second
+    moment), so pretrained batch_* accumulators normalize identically.
+    Returns the batch's own contributions for the caller to accumulate
+    (the op's means/scales outputs + batch_* accumulator update contract).
+
+    Returns (y, means, scales, new_size, new_sum, new_square_sum)."""
+    def f(a, bsize, bsum, bsq):
+        means = bsum / bsize
+        scales = jnp.sqrt(bsize / bsq)
+        y = (a - means[None, :]) * scales[None, :]
+        n = jnp.asarray(a.shape[0], a.dtype)
+        return (y, means, scales, bsize + n, bsum + jnp.sum(a, axis=0),
+                bsq + jnp.sum(a * a, axis=0))
+
+    return apply(f, _t(x), _t(batch_size), _t(batch_sum),
+                 _t(batch_square_sum))

@@ -273,3 +273,92 @@ def precision_recall(indices, labels, num_classes, weights=None,
         return apply(f, _t(indices), _t(labels), _t(weights), st_t)
     return apply(lambda i, l, s: f(i, l, None, s), _t(indices), _t(labels),
                  st_t)
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """chunk_eval_op.cc: chunk-level precision/recall/F1 for sequence
+    labeling (NER-style). Tags encode (chunk_type, tag) as
+    chunk_type * num_tag_types + tag_index with O as the final label id,
+    schemes IOB (B,I), IOE (I,E), IOBES (B,I,E,S), and `plain` (label IS
+    the chunk type; maximal same-type runs are chunks). Host-side eager op.
+
+    Returns (precision, recall, f1, num_infer_chunks, num_label_chunks,
+    num_correct_chunks) as 6 scalar Tensors — the op's output contract."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    from ..tensor.creation import to_tensor
+    schemes = {"IOB": ["B", "I"], "IOE": ["I", "E"],
+               "IOBES": ["B", "I", "E", "S"], "plain": None}
+    if chunk_scheme not in schemes:
+        raise ValueError(f"chunk_eval: unknown scheme {chunk_scheme!r}")
+    tag_types = schemes[chunk_scheme]
+    excluded = set(excluded_chunk_types or [])
+
+    x = np.asarray(input.data if isinstance(input, Tensor)
+                   else input).reshape(-1)
+    y = np.asarray(label.data if isinstance(label, Tensor)
+                   else label).reshape(-1)
+    if seq_length is not None:
+        lens = np.asarray(seq_length.data if isinstance(seq_length, Tensor)
+                          else seq_length).reshape(-1)
+    else:
+        lens = np.asarray([len(x)])
+
+    def chunks_of(tags):
+        """Lenient chunk extraction -> set of (start, end, type)."""
+        out = set()
+        if tag_types is None:  # plain: maximal same-type runs
+            start = None
+            cur = None
+            for i, t in enumerate(list(tags) + [-1]):
+                if t != cur:
+                    if cur is not None and cur >= 0 and cur not in excluded:
+                        out.add((start, i - 1, int(cur)))
+                    start, cur = i, t
+            return out
+        n_tag = len(tag_types)
+        o_id = num_chunk_types * n_tag
+
+        def parse(t):
+            if t >= o_id or t < 0:
+                return None, None
+            return int(t) // n_tag, tag_types[int(t) % n_tag]
+
+        start = None
+        cur = None
+        for i, t in enumerate(list(tags) + [o_id]):
+            ctype, tag = parse(t)
+            begins = tag in ("B", "S") or (
+                ctype is not None and (cur is None or ctype != cur))
+            ends_prev = ctype is None or begins
+            if cur is not None and ends_prev:
+                if cur not in excluded:
+                    out.add((start, i - 1, cur))
+                start, cur = None, None
+            if ctype is not None and (cur is None):
+                start, cur = i, ctype
+            if tag in ("E", "S") and cur is not None:
+                if cur not in excluded:
+                    out.add((start, i, cur))
+                start, cur = None, None
+        return out
+
+    n_inf = n_lab = n_cor = 0
+    off = 0
+    for L in lens:
+        L = int(L)
+        inf_chunks = chunks_of(x[off:off + L])
+        lab_chunks = chunks_of(y[off:off + L])
+        n_inf += len(inf_chunks)
+        n_lab += len(lab_chunks)
+        n_cor += len(inf_chunks & lab_chunks)
+        off += L
+
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    mk = lambda v, dt: to_tensor(np.asarray(v, dt))
+    return (mk(p, np.float32), mk(r, np.float32), mk(f1, np.float32),
+            mk(n_inf, np.int64), mk(n_lab, np.int64), mk(n_cor, np.int64))

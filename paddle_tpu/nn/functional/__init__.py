@@ -2,7 +2,7 @@
 from .activation import *  # noqa: F401,F403
 from .common import *  # noqa: F401,F403
 from .conv import (conv1d, conv1d_transpose, conv2d,  # noqa: F401
-                   conv2d_transpose, conv3d, conv3d_transpose, unfold)
+                   conv2d_transpose, conv3d, conv3d_transpose, fold, unfold)
 from .loss import *  # noqa: F401,F403
 from .norm import (batch_norm, group_norm, instance_norm,  # noqa: F401
                    layer_norm, local_response_norm)

@@ -185,3 +185,42 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
         return patches.reshape(N, patches.shape[1], -1)
 
     return apply(f, _t(x))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im (fold_op / the inverse of unfold): x [N, C*kh*kw, L] →
+    [N, C, H, W], overlapping patches SUMMED back into place. Implemented
+    as a scatter-add over the same patch index grid unfold reads from."""
+    import jax.numpy as jnp
+    oh_w = _norm_tuple(output_sizes, 2)
+    ks = _norm_tuple(kernel_sizes, 2)
+    st = _norm_tuple(strides, 2)
+    di = _norm_tuple(dilations, 2)
+    pd = _padding(paddings, 2)
+    if isinstance(pd, str):
+        raise ValueError("fold requires explicit paddings, not " + pd)
+
+    def f(a):
+        N, CK, L = a.shape
+        kh, kw = ks
+        C = CK // (kh * kw)
+        H, W = oh_w
+        (pt, pb), (pl, pr) = pd
+        Hp, Wp = H + pt + pb, W + pl + pr
+        oh = (Hp - (kh - 1) * di[0] - 1) // st[0] + 1
+        ow = (Wp - (kw - 1) * di[1] - 1) // st[1] + 1
+        assert oh * ow == L, (oh, ow, L)
+        cols = a.reshape(N, C, kh, kw, oh, ow)
+        # padded-canvas row index of (ki, oy): oy*stride + ki*dilation
+        ys = (jnp.arange(oh)[None, :] * st[0]
+              + jnp.arange(kh)[:, None] * di[0])          # [kh, oh]
+        xs = (jnp.arange(ow)[None, :] * st[1]
+              + jnp.arange(kw)[:, None] * di[1])          # [kw, ow]
+        canvas = jnp.zeros((N, C, Hp, Wp), a.dtype)
+        yi = jnp.broadcast_to(ys[:, None, :, None], (kh, kw, oh, ow))
+        xi = jnp.broadcast_to(xs[None, :, None, :], (kh, kw, oh, ow))
+        canvas = canvas.at[:, :, yi, xi].add(cols)
+        return canvas[:, :, pt:pt + H, pl:pl + W]
+
+    return apply(f, _t(x))

@@ -621,3 +621,75 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
         from ...tensor.math import sum as _sum
         return _sum(per_sample)
     return per_sample
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    """huber_loss_op.cc: 0.5*r^2 for |r|<=delta else delta*(|r|-0.5*delta).
+    (Differs from smooth_l1_loss by the delta scaling convention.)"""
+    input, label = _t(input), _t(label)
+
+    def f(x, y):
+        r = jnp.abs(x - y)
+        return jnp.where(r <= delta, 0.5 * r * r,
+                         delta * (r - 0.5 * delta))
+
+    out = apply(f, input, label)
+    return _reduce(out, reduction)
+
+
+def hinge_loss(logits, labels):
+    """hinge_loss_op.cc: max(1 - (2*label - 1) * logits, 0), elementwise
+    (labels in {0, 1})."""
+    logits, labels = _t(logits), _t(labels)
+    return apply(
+        lambda x, y: jnp.maximum(
+            1.0 - (2.0 * y.astype(x.dtype) - 1.0) * x, 0.0),
+        logits, labels)
+
+
+def bpr_loss(input, label):
+    """bpr_loss_op.cc (Bayesian Personalized Ranking, session-based recs):
+    for each row of logits, -mean_j log(sigmoid(x[label] - x[j])) over the
+    negative items j != label. Returns [N, 1]."""
+    input, label = _t(input), _t(label)
+
+    def f(x, y):
+        N, C = x.shape
+        y = y.reshape(-1).astype(jnp.int32)
+        pos = jnp.take_along_axis(x, y[:, None], axis=1)       # [N, 1]
+        diff = pos - x                                          # [N, C]
+        lsm = jax.nn.log_sigmoid(diff)
+        mask = jax.nn.one_hot(y, C, dtype=x.dtype)
+        loss = -(jnp.sum(lsm * (1 - mask), axis=1) / (C - 1))
+        return loss[:, None]
+
+    return apply(f, input, label)
+
+
+def ctc_align(input, blank=0, merge_repeated=True, input_length=None,
+              padding_value=0):
+    """ctc_align_op.cc: collapse a ctc label sequence — merge repeats
+    (optionally), strip blanks, left-pack, pad with padding_value.
+    input [B, T] int predictions (e.g. argmax over logits)."""
+    import numpy as np
+
+    from ...tensor.creation import to_tensor
+    x = np.asarray(_t(input).data)
+    B, T = x.shape
+    lens = (np.asarray(_t(input_length).data).reshape(-1)
+            if input_length is not None else np.full(B, T))
+    out = np.full((B, T), padding_value, x.dtype)
+    out_lens = np.zeros(B, np.int32)
+    for b in range(B):
+        prev = None
+        k = 0
+        for t in range(int(lens[b])):
+            v = x[b, t]
+            if merge_repeated and prev is not None and v == prev:
+                continue
+            prev = v
+            if v != blank:
+                out[b, k] = v
+                k += 1
+        out_lens[b] = k
+    return to_tensor(out), to_tensor(out_lens)

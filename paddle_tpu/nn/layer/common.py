@@ -236,6 +236,21 @@ class ChannelShuffle(Layer):
         return F.channel_shuffle(x, self.groups, self.data_format)
 
 
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.output_sizes = output_sizes
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
+                      self.paddings, self.dilations)
+
+
 class Unfold(Layer):
     def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
                  name=None):

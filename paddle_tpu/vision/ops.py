@@ -25,7 +25,7 @@ from ..tensor.creation import _t
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
            "box_iou", "prior_box", "anchor_generator", "box_clip",
            "iou_similarity", "bipartite_match", "multiclass_nms",
-           "matrix_nms", "distribute_fpn_proposals", "generate_proposals"]
+           "matrix_nms", "distribute_fpn_proposals", "generate_proposals", "deform_conv2d", "psroi_pool"]
 
 
 def _iou_matrix(boxes_a, boxes_b, offset=0.0):
@@ -714,3 +714,155 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     if return_rois_num:
         return out + (to_tensor(np.asarray(rois_num, np.int32)),)
     return out
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (deformable_conv_op.cu /
+    deformable_conv_v1_op.cu; 2.x surface paddle.vision.ops.deform_conv2d).
+
+    x [N, Cin, H, W]; offset [N, 2*dg*kh*kw, Ho, Wo] ((dy, dx) pairs,
+    kernel-position major); weight [Cout, Cin/groups, kh, kw];
+    mask [N, dg*kh*kw, Ho, Wo] enables the v2 modulated form.
+
+    TPU-first design: instead of the CUDA per-pixel gather kernel, build
+    the deformed im2col tensor with one vectorized bilinear sample over
+    all (batch, kernel-position, output-pixel) coordinates, then hit the
+    MXU with a single einsum against the flattened weights — the deformed
+    analog of unfold+matmul. Differentiable w.r.t. x, offset, mask,
+    weight (bilinear sampling is piecewise-linear)."""
+    x, offset, weight = _t(x), _t(offset), _t(weight)
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    di = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    def f(xa, off, w, *rest):
+        m = rest[0] if mask is not None else None
+        b = (rest[-1] if bias is not None else None)
+        N, Cin, H, W = xa.shape
+        Cout, Cin_g, kh, kw = w.shape
+        dg = deformable_groups
+        Ho = (H + 2 * pd[0] - di[0] * (kh - 1) - 1) // st[0] + 1
+        Wo = (W + 2 * pd[1] - di[1] * (kw - 1) - 1) // st[1] + 1
+        K = kh * kw
+        off = off.reshape(N, dg, K, 2, Ho, Wo)
+        # base sampling grid (input coords, incl. padding offset), kept in
+        # the input dtype so bf16 inputs stay bf16 through the einsum
+        cdt = xa.dtype
+        oy = jnp.arange(Ho, dtype=cdt) * st[0] - pd[0]
+        ox = jnp.arange(Wo, dtype=cdt) * st[1] - pd[1]
+        ky = jnp.arange(kh, dtype=cdt) * di[0]
+        kx = jnp.arange(kw, dtype=cdt) * di[1]
+        base_y = oy[None, :, None] + ky[:, None, None]   # [kh, Ho, 1]
+        base_x = ox[None, None, :] + kx[:, None, None]   # [kw, 1, Wo]
+        yy = (base_y[:, None, :, :] + jnp.zeros((kh, kw, Ho, Wo), cdt)) \
+            .reshape(K, Ho, Wo)
+        xx = (base_x[None, :, :, :] + jnp.zeros((kh, kw, Ho, Wo), cdt)) \
+            .reshape(K, Ho, Wo)
+        sy = yy[None, None] + off[:, :, :, 0].astype(cdt)  # [N,dg,K,Ho,Wo]
+        sx = xx[None, None] + off[:, :, :, 1].astype(cdt)
+
+        # bilinear sample each deform group's channel slice at (sy, sx);
+        # out-of-bounds samples contribute zero (the CUDA kernel's
+        # zero-padding convention)
+        Cg = Cin // dg
+        xg = xa.reshape(N, dg, Cg, H * W)
+        L = K * Ho * Wo
+
+        def corner(iy, ix, wgt):
+            iy_c = jnp.clip(iy, 0, H - 1)
+            ix_c = jnp.clip(ix, 0, W - 1)
+            valid = ((iy >= 0) & (iy <= H - 1) & (ix >= 0)
+                     & (ix <= W - 1)).astype(xa.dtype)
+            flat = (iy_c * W + ix_c).reshape(N, dg, 1, L)
+            g = jnp.take_along_axis(
+                xg, jnp.broadcast_to(flat, (N, dg, Cg, L)), axis=3)
+            return g * (valid * wgt).reshape(N, dg, 1, L)
+
+        y0 = jnp.floor(sy).astype(jnp.int32)
+        x0 = jnp.floor(sx).astype(jnp.int32)
+        fy = sy - y0
+        fx = sx - x0
+        sampled = (corner(y0, x0, (1 - fy) * (1 - fx))
+                   + corner(y0, x0 + 1, (1 - fy) * fx)
+                   + corner(y0 + 1, x0, fy * (1 - fx))
+                   + corner(y0 + 1, x0 + 1, fy * fx))
+        # sampled: [N, dg, Cg, K*Ho*Wo] -> [N, dg, Cg, K, Ho, Wo]
+        sampled = sampled.reshape(N, dg, Cg, K, Ho, Wo)
+        if m is not None:
+            sampled = sampled * m.reshape(N, dg, 1, K, Ho, Wo)
+        col = sampled.reshape(N, Cin, K, Ho, Wo)
+        # grouped matmul against flattened weights (the MXU hit)
+        colg = col.reshape(N, groups, Cin // groups, K, Ho, Wo)
+        wg = w.reshape(groups, Cout // groups, Cin_g, K)
+        out = jnp.einsum("ngckhw,gock->ngohw", colg, wg)
+        out = out.reshape(N, Cout, Ho, Wo)
+        if b is not None:
+            out = out + b[None, :, None, None]
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(_t(mask))  # f's rest[0]
+    if bias is not None:
+        args.append(_t(bias))  # f's rest[-1]
+    return apply(f, *args)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (psroi_pool_op.cu; 2.x surface
+    paddle.vision.ops.psroi_pool): x [N, C, H, W] with C = out_c*ph*pw;
+    each output bin (i, j) of a RoI average-pools its OWN channel group
+    over the bin's area. Differentiable (pure average pooling)."""
+    x, boxes, boxes_num = _t(x), _t(boxes), _t(boxes_num)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(feat, rois, rois_num):
+        N, C, H, W = feat.shape
+        out_c = C // (ph * pw)
+        R = rois.shape[0]
+        img_idx = jnp.repeat(jnp.arange(N),
+                             repeats=rois_num.astype(jnp.int32),
+                             total_repeat_length=R)
+        r = rois.astype(jnp.float32) * spatial_scale
+        x1, y1, x2, y2 = r[:, 0], r[:, 1], r[:, 2], r[:, 3]
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # integer sampling grid per bin (avg over ceil'd spans like the
+        # reference: floor/ceil bin edges clamped to the feature map)
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def one_bin(i, j):
+            hstart = jnp.floor(y1 + i * bin_h)
+            hend = jnp.ceil(y1 + (i + 1) * bin_h)
+            wstart = jnp.floor(x1 + j * bin_w)
+            wend = jnp.ceil(x1 + (j + 1) * bin_w)
+            hmask = ((ys[None, :] >= hstart[:, None])
+                     & (ys[None, :] < hend[:, None])
+                     & (ys[None, :] >= 0) & (ys[None, :] < H))
+            wmask = ((xs[None, :] >= wstart[:, None])
+                     & (xs[None, :] < wend[:, None])
+                     & (xs[None, :] >= 0) & (xs[None, :] < W))
+            area = (jnp.sum(hmask, 1) * jnp.sum(wmask, 1)).astype(
+                feat.dtype)
+            # channel group for bin (i, j): c*ph*pw + i*pw + j
+            chans = jnp.arange(out_c) * (ph * pw) + i * pw + j   # [out_c]
+            fsel = feat[img_idx[:, None], chans[None, :]]  # [R, out_c, H, W]
+            msk = (hmask[:, None, :, None] * wmask[:, None, None, :])
+            s = jnp.sum(fsel * msk.astype(feat.dtype), axis=(2, 3))
+            return jnp.where(area[:, None] > 0, s
+                             / jnp.maximum(area[:, None], 1.0), 0.0)
+
+        bins = [[one_bin(i, j) for j in range(pw)] for i in range(ph)]
+        rows = [jnp.stack(row, axis=-1) for row in bins]  # [R, out_c, pw]
+        return jnp.stack(rows, axis=-2)  # [R, out_c, ph, pw]
+
+    return apply(f, x, boxes, boxes_num)

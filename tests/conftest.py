@@ -54,7 +54,8 @@ _SLOW_MODULES = {
     "test_multiprocess_dist", "test_metrics_elastic", "test_vision_models",
     "test_amp", "test_attention", "test_fused_ops", "test_softmax_ce",
     "test_cpp_predictor", "test_op_numerics_batch3",
-    "test_op_numerics_batch4", "test_highlevel", "test_beam_search",
+    "test_op_numerics_batch4", "test_op_numerics_batch5",
+    "test_highlevel", "test_beam_search",
 }
 
 

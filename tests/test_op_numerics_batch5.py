@@ -1,0 +1,253 @@
+"""OpTest batch 5: contrib op tail — losses (huber/hinge/bpr), ctc_align,
+fold, fsp_matrix/row_conv/cvm/data_norm, chunk_eval, deform_conv2d,
+psroi_pool. Reference anchors: huber_loss_op.cc, hinge_loss_op.cc,
+bpr_loss_op.cc, ctc_align_op.cc, fold (col2im), fsp_op.cc,
+row_conv_op.cc, cvm_op.cc, data_norm_op.cc, chunk_eval_op.cc,
+deformable_conv_op.cu, psroi_pool_op.cu."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+
+def test_huber_loss_piecewise():
+    x = paddle.to_tensor(np.array([0.0, 0.5, 3.0], np.float32))
+    y = paddle.to_tensor(np.zeros(3, np.float32))
+    out = F.huber_loss(x, y, delta=1.0, reduction="none")
+    np.testing.assert_allclose(np.asarray(out.data),
+                               [0.0, 0.125, 2.5], rtol=1e-6)
+
+
+def test_huber_loss_grad():
+    from op_test_base import check_grad
+    rng = np.random.RandomState(0)
+    check_grad(lambda a, b: F.huber_loss(a, b, delta=1.0,
+                                         reduction="none"),
+               [rng.randn(6).astype(np.float32) * 2,
+                rng.randn(6).astype(np.float32)])
+
+
+def test_hinge_and_bpr_loss():
+    logits = paddle.to_tensor(np.array([2.0, -1.0], np.float32))
+    labels = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+    h = np.asarray(F.hinge_loss(logits, labels).data)
+    np.testing.assert_allclose(h, [0.0, 0.0])  # both well-classified
+    h2 = np.asarray(F.hinge_loss(
+        paddle.to_tensor(np.array([0.3], np.float32)),
+        paddle.to_tensor(np.array([1.0], np.float32))).data)
+    np.testing.assert_allclose(h2, [0.7], rtol=1e-6)
+
+    x = np.array([[2.0, 0.0, -1.0]], np.float32)
+    b = np.asarray(F.bpr_loss(paddle.to_tensor(x),
+                              paddle.to_tensor(np.array([0]))).data)
+    sig = lambda a: 1 / (1 + np.exp(-a))
+    ref = -(np.log(sig(2.0)) + np.log(sig(3.0))) / 2
+    np.testing.assert_allclose(b, [[ref]], rtol=1e-5)
+
+
+def test_ctc_align_merge_and_blanks():
+    x = np.array([[1, 1, 0, 1, 2, 2, 0]], np.int32)
+    out, lens = F.ctc_align(paddle.to_tensor(x), blank=0)
+    np.testing.assert_array_equal(np.asarray(out.data)[0, :3], [1, 1, 2])
+    assert int(np.asarray(lens.data)[0]) == 3
+    out2, lens2 = F.ctc_align(paddle.to_tensor(x), blank=0,
+                              merge_repeated=False)
+    np.testing.assert_array_equal(np.asarray(out2.data)[0, :5],
+                                  [1, 1, 1, 2, 2])
+
+
+def test_fold_inverts_unfold_with_divisor():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 3, 8, 8).astype(np.float32))
+    u = F.unfold(x, 3, strides=2, paddings=1)
+    back = F.fold(u, (8, 8), 3, strides=2, paddings=1)
+    ones = paddle.to_tensor(np.ones((2, 3, 8, 8), np.float32))
+    div = F.fold(F.unfold(ones, 3, strides=2, paddings=1), (8, 8), 3,
+                 strides=2, paddings=1)
+    np.testing.assert_allclose(
+        np.asarray(back.data) / np.asarray(div.data), np.asarray(x.data),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_fold_layer_and_grad():
+    from op_test_base import check_grad
+    rng = np.random.RandomState(1)
+    layer = paddle.nn.Fold((4, 4), 2, strides=2)
+    cols = rng.randn(1, 3 * 4, 4).astype(np.float32)
+    out = layer(paddle.to_tensor(cols))
+    assert tuple(np.asarray(out.data).shape) == (1, 3, 4, 4)
+    check_grad(lambda c: F.fold(c, (4, 4), 2, strides=2), [cols])
+
+
+def test_fsp_matrix():
+    from paddle_tpu.incubate import fsp_matrix
+    rng = np.random.RandomState(0)
+    a = rng.randn(2, 3, 4, 5).astype(np.float32)
+    b = rng.randn(2, 6, 4, 5).astype(np.float32)
+    out = np.asarray(fsp_matrix(paddle.to_tensor(a),
+                                paddle.to_tensor(b)).data)
+    ref = np.einsum("bchw,bdhw->bcd", a, b) / 20.0
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_row_conv_lookahead():
+    from paddle_tpu.incubate import row_conv
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 5, 3).astype(np.float32)
+    w = rng.randn(2, 3).astype(np.float32)
+    out = np.asarray(row_conv(paddle.to_tensor(x),
+                              paddle.to_tensor(w)).data)
+    ref = np.zeros_like(x)
+    for t in range(5):
+        for k in range(2):
+            if t + k < 5:
+                ref[:, t] += x[:, t + k] * w[k]
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_cvm_modes():
+    from paddle_tpu.incubate import cvm
+    x = np.array([[3.0, 1.0, 7.0, 8.0]], np.float32)
+    keep = np.asarray(cvm(paddle.to_tensor(x), use_cvm=True).data)
+    np.testing.assert_allclose(
+        keep, [[np.log(4.0), np.log(2.0) - np.log(4.0), 7.0, 8.0]],
+        rtol=1e-6)
+    drop = np.asarray(cvm(paddle.to_tensor(x), use_cvm=False).data)
+    np.testing.assert_allclose(drop, [[7.0, 8.0]])
+
+
+def test_data_norm_reference_formula():
+    """data_norm_op.cc:302-303 exactly: means = sum/size, scales =
+    sqrt(size / square_sum) (no epsilon, no mean-centered variance)."""
+    from paddle_tpu.incubate import data_norm
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 4).astype(np.float32) * 3 + 1
+    size = paddle.to_tensor(np.full(4, 32.0, np.float32))
+    ssum = paddle.to_tensor(x.sum(0))
+    ssq = paddle.to_tensor((x * x).sum(0))
+    y, means, scales, n2, s2, q2 = data_norm(
+        paddle.to_tensor(x), size, ssum, ssq)
+    ref_means = x.sum(0) / 32.0
+    ref_scales = np.sqrt(32.0 / (x * x).sum(0))
+    np.testing.assert_allclose(np.asarray(means.data), ref_means,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(scales.data), ref_scales,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(y.data),
+                               (x - ref_means) * ref_scales, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(n2.data), 64.0)
+    np.testing.assert_allclose(np.asarray(s2.data), 2 * x.sum(0),
+                               rtol=1e-5)
+
+
+def test_chunk_eval_iob_and_counts():
+    from paddle_tpu.metric import chunk_eval
+    # 2 chunk types, IOB: B0=0 I0=1 B1=2 I1=3 O=4
+    y = paddle.to_tensor(np.array([0, 1, 4, 2, 3, 4]))
+    x = paddle.to_tensor(np.array([0, 1, 4, 2, 4, 4]))
+    p, r, f1, ni, nl, nc = chunk_eval(x, y, "IOB", 2)
+    assert (float(p.item()), float(r.item())) == (0.5, 0.5)
+    assert (int(ni.item()), int(nl.item()), int(nc.item())) == (2, 2, 1)
+    # excluded chunk types drop from all counts
+    p2, r2, f2, ni2, nl2, nc2 = chunk_eval(x, y, "IOB", 2,
+                                           excluded_chunk_types=[1])
+    assert (int(ni2.item()), int(nl2.item()), int(nc2.item())) == (1, 1, 1)
+    assert float(f2.item()) == 1.0
+
+
+def test_chunk_eval_iobes_and_seq_lengths():
+    from paddle_tpu.metric import chunk_eval
+    # 1 chunk type, IOBES: B=0 I=1 E=2 S=3 O=4
+    y = np.array([0, 1, 2, 4, 3,   3, 4, 4])
+    x = np.array([0, 1, 2, 4, 4,   3, 4, 4])
+    lens = paddle.to_tensor(np.array([5, 3]))
+    p, r, f1, ni, nl, nc = chunk_eval(
+        paddle.to_tensor(x), paddle.to_tensor(y), "IOBES", 1,
+        seq_length=lens)
+    # gold: (BIE), (S) in seq1; (S) in seq2 = 3 chunks; pred: (BIE), (S)
+    assert (int(ni.item()), int(nl.item()), int(nc.item())) == (2, 3, 2)
+
+
+def test_deform_conv2d_zero_offset_equals_conv2d():
+    from paddle_tpu.vision.ops import deform_conv2d
+    rng = np.random.RandomState(0)
+    N, Cin, H, W, Cout, k = 2, 4, 7, 7, 6, 3
+    x = rng.randn(N, Cin, H, W).astype(np.float32)
+    w = (rng.randn(Cout, Cin, k, k) * 0.2).astype(np.float32)
+    Ho = Wo = 7  # stride 1, padding 1
+    off = np.zeros((N, 2 * k * k, Ho, Wo), np.float32)
+    got = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                        paddle.to_tensor(w), stride=1, padding=1)
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), stride=1,
+                   padding=1)
+    np.testing.assert_allclose(np.asarray(got.data), np.asarray(ref.data),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_integer_shift_and_mask():
+    from paddle_tpu.vision.ops import deform_conv2d
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    w = np.zeros((2, 2, 1, 1), np.float32)
+    w[0, 0] = w[1, 1] = 1.0  # identity 1x1 conv
+    # constant offset (+1, +1): output = input shifted by one pixel
+    off = np.ones((1, 2, 6, 6), np.float32)
+    got = np.asarray(deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(off),
+        paddle.to_tensor(w)).data)
+    np.testing.assert_allclose(got[:, :, :5, :5], x[:, :, 1:, 1:],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got[:, :, 5, :], 0.0, atol=1e-6)  # OOB
+    # v2 mask of 0.5 halves everything
+    m = np.full((1, 1, 6, 6), 0.5, np.float32)
+    got2 = np.asarray(deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+        mask=paddle.to_tensor(m)).data)
+    np.testing.assert_allclose(got2, got * 0.5, rtol=1e-5)
+
+
+def test_deform_conv2d_grad():
+    from op_test_base import check_grad
+    from paddle_tpu.vision.ops import deform_conv2d
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    # fractional offsets away from integer grid: bilinear weights smooth
+    # (output is 6x6: (5 + 2*1 - 2)//1 + 1)
+    off = (rng.rand(1, 2 * 4, 6, 6).astype(np.float32) * 0.6 + 0.2)
+    w = (rng.randn(3, 2, 2, 2) * 0.3).astype(np.float32)
+    check_grad(lambda a, o, ww: deform_conv2d(a, o, ww, padding=1),
+               [x, off, w])
+
+
+def test_psroi_pool_constant_map_and_channels():
+    from paddle_tpu.vision.ops import psroi_pool
+    ph = pw = 2
+    out_c = 3
+    C = out_c * ph * pw
+    # channel c has constant value c: each bin must read ITS OWN group
+    x = np.arange(C, dtype=np.float32)[None, :, None, None] * \
+        np.ones((1, C, 8, 8), np.float32)
+    boxes = np.array([[0, 0, 8, 8]], np.float32)
+    out = np.asarray(psroi_pool(
+        paddle.to_tensor(x), paddle.to_tensor(boxes),
+        paddle.to_tensor(np.array([1], np.int32)), (ph, pw)).data)
+    assert out.shape == (1, out_c, ph, pw)
+    for c in range(out_c):
+        for i in range(ph):
+            for j in range(pw):
+                np.testing.assert_allclose(out[0, c, i, j],
+                                           c * ph * pw + i * pw + j)
+
+
+def test_deform_conv2d_preserves_bf16_dtype():
+    from paddle_tpu.vision.ops import deform_conv2d
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(1, 2, 5, 5).astype(np.float32)) \
+        .astype("bfloat16")
+    off = paddle.to_tensor(np.zeros((1, 8, 6, 6), np.float32)) \
+        .astype("bfloat16")
+    w = paddle.to_tensor((rng.randn(2, 2, 2, 2) * 0.2).astype(np.float32)) \
+        .astype("bfloat16")
+    out = deform_conv2d(x, off, w, padding=1)
+    assert "bfloat16" in str(out.dtype), out.dtype
