@@ -29,9 +29,10 @@ from .core.tensor import (Parameter, Tensor, enable_grad, grad,  # noqa: F401
 from .framework_io import load, save  # noqa: F401
 from .tensor import *  # noqa: F401,F403
 from .tensor import einsum  # noqa: F401
-from .tensor.manipulation import (cast, diagonal, numel, rank,  # noqa: F401,E501
-                                  scatter_, shape, shard_index,
-                                  squeeze_, tolist, unsqueeze_)
+from .tensor.manipulation import (array_length, array_read,  # noqa: F401,E501
+                                  array_write, cast, create_array, diagonal,
+                                  numel, rank, reverse, scatter_, shape,
+                                  shard_index, squeeze_, tolist, unsqueeze_)
 from .tensor.math import add_n, tanh_  # noqa: F401
 from .tensor.linalg import inverse, mv  # noqa: F401
 from .utils import set_printoptions  # noqa: F401

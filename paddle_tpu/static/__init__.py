@@ -56,16 +56,45 @@ def default_startup_program():
 
 class Executor:
     """Executor parity: run(fn, feed, fetch) where fn is a StaticFunction or a
-    plain callable; startup programs are no-ops (initialization is eager)."""
+    plain callable; startup programs are no-ops (initialization is eager).
+
+    Program-cache semantics (executor.py use_program_cache / the
+    ExecutorPrepareContext cache): the first run of a callable traces and
+    compiles it (to_static → jax.jit); repeat runs of the SAME program
+    object hit the compiled executable. use_program_cache=False forces the
+    eager path every call (the reference's uncached prepare+run)."""
 
     def __init__(self, place=None):
         self.place = place
+        self._program_cache = {}
 
-    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+    def run(self, program=None, feed=None, fetch_list=None,
+            feed_var_name="feed", fetch_var_name="fetch", scope=None,
+            return_numpy=True, use_program_cache=False, **kwargs):
+        """Signature-compatible with the reference Executor.run
+        (executor.py): use_program_cache defaults to False (eager call —
+        side effects and Python control flow behave normally); True
+        traces+compiles the callable once and reuses the executable."""
         if callable(program) and not isinstance(program, Program):
             args = [Tensor(v) for v in (feed or {}).values()]
-            out = program(*args)
+            if isinstance(program, StaticFunction):
+                fn = program  # already owns a compiled cache
+            elif use_program_cache:
+                fn = self._program_cache.get(id(program))
+                if fn is None:
+                    if len(self._program_cache) >= 64:
+                        # bound the cache: fresh closures per run would
+                        # otherwise accumulate executables forever
+                        self._program_cache.pop(
+                            next(iter(self._program_cache)))
+                    fn = StaticFunction(program)
+                    self._program_cache[id(program)] = fn
+            else:
+                fn = program
+            out = fn(*args)
             outs = out if isinstance(out, (list, tuple)) else [out]
+            if not return_numpy:
+                return list(outs)
             return [np.asarray(o.numpy()) for o in outs]
         return []
 

@@ -440,3 +440,50 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         return jnp.where(inside, ids - lo, ignore_value)
 
     return apply(f, _t(input))
+
+
+def reverse(x, axis, name=None):
+    """Pre-2.x alias of flip (reverse_op.cc; kept for fluid parity)."""
+    return flip(x, axis)
+
+
+# ---- LoDTensorArray ops (lod_tensor_array ops + control-flow arrays;
+# reference tensor_array_read_write.cc). Dygraph semantics: the array is a
+# Python list of Tensors, exactly the reference's dygraph behavior. ----
+
+def create_array(dtype="float32", initialized_list=None):
+    arr = list(initialized_list) if initialized_list is not None else []
+    for v in arr:
+        if not isinstance(v, Tensor):
+            raise TypeError(
+                "create_array initialized_list must contain Tensors, got "
+                f"{type(v).__name__}")
+    return arr
+
+
+def array_write(x, i, array=None):
+    """Write x at index i (extending like the reference: writing at
+    i == len appends; i > len errors)."""
+    idx = int(i.item() if hasattr(i, "item") else i)
+    if array is None:
+        array = []
+    if idx < 0 or idx > len(array):
+        raise IndexError(
+            f"array_write: index {idx} out of range for array length "
+            f"{len(array)} (negative indices are rejected, matching the "
+            "reference)")
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    idx = int(i.item() if hasattr(i, "item") else i)
+    return array[idx]
+
+
+def array_length(array):
+    from .creation import to_tensor
+    return to_tensor(np.asarray(len(array), np.int64))

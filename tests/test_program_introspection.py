@@ -88,3 +88,40 @@ def test_main_program_from_input_spec():
         model, input_spec=[InputSpec([None, 4], "float32")])
     prog = fn.main_program()
     assert prog.global_block().var(prog.feed_names()[0]).shape[1] == 4
+
+
+def test_executor_program_cache():
+    """Executor.run compiles a callable once and reuses it (use_program_cache
+    semantics); the eager path is taken when disabled."""
+    from paddle_tpu.static import Executor
+    calls = {"n": 0}
+
+    def prog(x):
+        calls["n"] += 1  # traced once under the cache, every call eagerly
+        return x * 2.0
+
+    exe = Executor()
+    feed = {"x": np.ones((2, 2), np.float32)}
+    # default matches the reference: eager every call
+    exe.run(prog, feed=feed)
+    exe.run(prog, feed=feed)
+    assert calls["n"] == 2, "default must be eager (use_program_cache=False)"
+    out1 = exe.run(prog, feed=feed, use_program_cache=True)
+    out2 = exe.run(prog, feed=feed, use_program_cache=True)
+    np.testing.assert_allclose(out1[0], 2.0)
+    np.testing.assert_allclose(out2[0], 2.0)
+    assert calls["n"] == 3, "program was re-traced despite the cache"
+
+
+def test_tensor_array_ops():
+    arr = paddle.create_array()
+    x = paddle.to_tensor(np.arange(3, dtype=np.float32))
+    paddle.array_write(x, 0, arr)
+    paddle.array_write(x * 2, paddle.to_tensor(np.int64(1)), arr)
+    assert int(paddle.array_length(arr).item()) == 2
+    np.testing.assert_allclose(
+        np.asarray(paddle.array_read(arr, 1).data), [0, 2, 4])
+    with pytest.raises(IndexError):
+        paddle.array_write(x, 5, arr)
+    r = paddle.reverse(paddle.to_tensor(np.array([1, 2, 3])), axis=0)
+    np.testing.assert_array_equal(np.asarray(r.data), [3, 2, 1])
