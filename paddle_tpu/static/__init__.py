@@ -20,7 +20,7 @@ from ..metric import accuracy, auc  # noqa: F401
 # ProgramDesc-style introspection over traced jaxprs (framework.py
 # Program/Block/Operator/Variable analog)
 from .program import (Block, Operator, TracedProgram,  # noqa: F401
-                      Variable)
+                      Variable, memory_usage, op_frequence)
 
 
 class InputSpec:

@@ -233,3 +233,32 @@ class TracedProgram:
     def __repr__(self):
         return (f"TracedProgram(blocks={self.num_blocks}, "
                 f"ops={sum(len(b.ops) for b in self.blocks)})")
+
+
+def op_frequence(program: TracedProgram):
+    """contrib/op_frequence.py analog: {op_type: count} over every block
+    (nested control-flow bodies included), most-frequent first."""
+    from collections import Counter
+    c = Counter(op.type for b in program.blocks for op in b.ops)
+    return dict(c.most_common())
+
+
+def memory_usage(program: TracedProgram, unit="MB"):
+    """contrib/memory_usage_calc.py analog: lower-bound memory estimate —
+    the summed byte size of every variable declared in the program
+    (params + activations at their traced shapes; XLA's actual peak is
+    lower after fusion/liveness, so this is the conservative bound the
+    reference tool also reports)."""
+    div = {"B": 1, "KB": 1024, "MB": 1024 ** 2, "GB": 1024 ** 3}[unit]
+    total = 0
+    for b in program.blocks:
+        for v in b.all_vars():
+            try:
+                itemsize = np.dtype(v.dtype).itemsize
+            except TypeError:
+                itemsize = 4
+            n = 1
+            for d in v.shape:
+                n *= max(int(d), 1)
+            total += n * itemsize
+    return total / div

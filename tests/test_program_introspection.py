@@ -125,3 +125,17 @@ def test_tensor_array_ops():
         paddle.array_write(x, 5, arr)
     r = paddle.reverse(paddle.to_tensor(np.array([1, 2, 3])), axis=0)
     np.testing.assert_array_equal(np.asarray(r.data), [3, 2, 1])
+
+
+def test_op_frequence_and_memory_usage():
+    from paddle_tpu.static import memory_usage, op_frequence
+    model = _mlp()
+    prog = TracedProgram.from_callable(
+        lambda x: model(x),
+        [paddle.to_tensor(np.ones((2, 4), np.float32))])
+    freq = op_frequence(prog)
+    assert freq["dot_general"] == 2
+    assert sum(freq.values()) == sum(len(b.ops) for b in prog.blocks)
+    mb = memory_usage(prog, unit="B")
+    # at least the four param tensors' bytes
+    assert mb >= (4 * 8 + 8 + 8 * 2 + 2) * 4
