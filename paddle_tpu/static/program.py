@@ -244,19 +244,27 @@ def op_frequence(program: TracedProgram):
 
 
 def memory_usage(program: TracedProgram, unit="MB"):
-    """contrib/memory_usage_calc.py analog: lower-bound memory estimate —
-    the summed byte size of every variable declared in the program
-    (params + activations at their traced shapes; XLA's actual peak is
-    lower after fusion/liveness, so this is the conservative bound the
-    reference tool also reports)."""
-    div = {"B": 1, "KB": 1024, "MB": 1024 ** 2, "GB": 1024 ** 3}[unit]
+    """contrib/memory_usage_calc.py analog: conservative UPPER-bound
+    memory estimate — the summed byte size of every variable declared in
+    the program (params + activations at their traced shapes; XLA's
+    actual peak is lower after fusion/liveness analysis, so real usage
+    never exceeds this figure)."""
+    units = {"B": 1, "KB": 1024, "MB": 1024 ** 2, "GB": 1024 ** 3}
+    if unit.upper() not in units:
+        raise ValueError(
+            f"memory_usage: unit must be one of {sorted(units)}, "
+            f"got {unit!r}")
+    div = units[unit.upper()]
     total = 0
     for b in program.blocks:
         for v in b.all_vars():
-            try:
-                itemsize = np.dtype(v.dtype).itemsize
-            except TypeError:
+            if v.dtype == "?":  # unknown aval: conservative 4-byte guess
                 itemsize = 4
+            else:
+                try:
+                    itemsize = np.dtype(v.dtype).itemsize
+                except TypeError:
+                    itemsize = 4
             n = 1
             for d in v.shape:
                 n *= max(int(d), 1)

@@ -139,3 +139,19 @@ def test_op_frequence_and_memory_usage():
     mb = memory_usage(prog, unit="B")
     # at least the four param tensors' bytes
     assert mb >= (4 * 8 + 8 + 8 * 2 + 2) * 4
+
+
+def test_memory_usage_units_and_unknown_dtype():
+    from paddle_tpu.static import memory_usage
+    model = _mlp()
+    prog = TracedProgram.from_callable(
+        lambda x: model(x),
+        [paddle.to_tensor(np.ones((2, 4), np.float32))])
+    b = memory_usage(prog, unit="B")
+    assert memory_usage(prog, unit="kb") == b / 1024  # case-insensitive
+    with pytest.raises(ValueError, match="unit"):
+        memory_usage(prog, unit="GiB")
+    # unknown-dtype vars count at the conservative 4 bytes, not bool's 1
+    from paddle_tpu.static.program import Variable
+    prog.blocks[0]._vars["mystery"] = Variable("mystery", (10,), "?")
+    assert memory_usage(prog, unit="B") == b + 40
