@@ -1,0 +1,35 @@
+package pd
+
+// Config mirrors the reference's goapi Config (goapi/config.go:28
+// NewConfig/SetModel) reduced to the options a PJRT predictor actually has:
+// everything the reference toggles per-backend (GPU, TensorRT, MKLDNN, IR
+// passes) is absorbed by XLA compilation of the exported StableHLO.
+type Config struct {
+	// ModelPrefix locates <prefix>.mlir (StableHLO bytecode from
+	// paddle_tpu.inference.export_model), <prefix>.pdweights and
+	// <prefix>.pdmodel.json.
+	ModelPrefix string
+	// PluginPath is the PJRT plugin shared object (libtpu.so for TPU,
+	// the bundled CPU plugin for host execution).
+	PluginPath string
+}
+
+// NewConfig returns a Config for a saved model prefix and PJRT plugin.
+func NewConfig(modelPrefix, pluginPath string) *Config {
+	return &Config{ModelPrefix: modelPrefix, PluginPath: pluginPath}
+}
+
+// SetModel resets the model prefix (goapi/config.go SetModel analog; the
+// TPU export format is a single prefix, not separate prog/params files).
+func (c *Config) SetModel(modelPrefix string) { c.ModelPrefix = modelPrefix }
+
+// ProgFile returns the path of the StableHLO program.
+func (c *Config) ProgFile() string { return c.ModelPrefix + ".mlir" }
+
+// ParamsFile returns the path of the packed weights.
+func (c *Config) ParamsFile() string { return c.ModelPrefix + ".pdweights" }
+
+// Summary renders the config (goapi/config.go:731 Summary analog).
+func (c *Config) Summary() string {
+	return "model_prefix: " + c.ModelPrefix + "\nplugin: " + c.PluginPath
+}

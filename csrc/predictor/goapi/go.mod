@@ -1,0 +1,3 @@
+module github.com/paddle-tpu/paddle-tpu/csrc/predictor/goapi
+
+go 1.19

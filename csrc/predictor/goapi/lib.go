@@ -1,0 +1,18 @@
+// Package pd is the Go inference API over the paddle_tpu C predictor ABI
+// (libpdpredictor.so, csrc/predictor/predictor.cc).
+//
+// Reference surface: paddle/fluid/inference/goapi/{lib,config,predictor,
+// tensor}.go — a cgo veneer over the C inference ABI. TPU-native version:
+// the predictor executes a StableHLO program through a PJRT plugin
+// (libtpu / CPU), so Config carries a model prefix + plugin path instead of
+// GPU/TensorRT/MKLDNN toggles (those analysis options are XLA's job).
+//
+// Build: `make` in csrc/predictor first (produces libpdpredictor.so), then
+//
+//	CGO_CFLAGS="-I${SRCDIR}/.." CGO_LDFLAGS="-L${SRCDIR}/.. -lpdpredictor" go build
+package pd
+
+/*
+#cgo LDFLAGS: -lpdpredictor
+*/
+import "C"

@@ -1,0 +1,141 @@
+package pd
+
+/*
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct PdPredictor PdPredictor;
+PdPredictor* pd_predictor_create(const char* prefix, const char* plugin);
+int  pd_predictor_run(PdPredictor*, const void** input_ptrs,
+                      const int32_t* pjrt_types, const int64_t* all_dims,
+                      const int32_t* ndims, int n_inputs);
+int  pd_predictor_num_outputs(PdPredictor*);
+long pd_predictor_output_bytes(PdPredictor*, int i);
+int  pd_predictor_copy_output(PdPredictor*, int i, void* dst, long size);
+void pd_predictor_destroy(PdPredictor*);
+*/
+import "C"
+
+import (
+	"errors"
+	"fmt"
+	"runtime"
+	"unsafe"
+)
+
+// Predictor runs an exported StableHLO model through a PJRT plugin
+// (goapi/predictor.go:30 Predictor analog; Run replaces the reference's
+// named-handle GetInputHandle/Run/GetOutputHandle three-step because the
+// exported program has positional inputs in traced-argument order).
+type Predictor struct {
+	ptr *C.PdPredictor
+}
+
+// NewPredictor loads the model and compiles it through the plugin.
+func NewPredictor(cfg *Config) (*Predictor, error) {
+	cPrefix := C.CString(cfg.ModelPrefix)
+	cPlugin := C.CString(cfg.PluginPath)
+	defer C.free(unsafe.Pointer(cPrefix))
+	defer C.free(unsafe.Pointer(cPlugin))
+	p := C.pd_predictor_create(cPrefix, cPlugin)
+	if p == nil {
+		return nil, fmt.Errorf(
+			"pd: load/compile failed for %q (see [pd_predictor] stderr)",
+			cfg.ModelPrefix)
+	}
+	pred := &Predictor{ptr: p}
+	runtime.SetFinalizer(pred, func(pr *Predictor) { pr.Destroy() })
+	return pred, nil
+}
+
+// Run uploads the inputs, executes, and returns all outputs. Output tensors
+// come back with Dtype Raw and Shape [nbytes]; reinterpret them with
+// Tensor.ReinterpretAs using the dtypes/shapes in <prefix>.pdmodel.json
+// (the C ABI reports byte sizes only).
+func (p *Predictor) Run(inputs []*Tensor) ([]*Tensor, error) {
+	// the deferred KeepAlive pins the Go object (and so holds off the
+	// SetFinalizer'd Destroy) until every C call below has returned
+	defer runtime.KeepAlive(p)
+	if p.ptr == nil {
+		return nil, errors.New("pd: predictor is destroyed")
+	}
+	n := len(inputs)
+	types := make([]C.int32_t, n+1) // +1: stay non-empty when n == 0
+	ndims := make([]C.int32_t, n+1)
+	dims := make([]C.int64_t, 1)
+	// the input pointer array and the payloads live in C memory: cgo
+	// forbids passing a Go pointer that itself points at Go pointers,
+	// and copying also decouples the C call from the Go GC entirely
+	ptrs := (*[1 << 28]unsafe.Pointer)(C.malloc(
+		C.size_t((n + 1) * int(unsafe.Sizeof(unsafe.Pointer(nil))))))
+	defer C.free(unsafe.Pointer(ptrs))
+	freeAll := func(k int) {
+		for i := 0; i < k; i++ {
+			C.free(ptrs[i])
+		}
+	}
+	for i, t := range inputs {
+		want := t.NumElements() * int64(t.Dtype.SizeOf())
+		if int64(len(t.Data)) != want {
+			freeAll(i)
+			return nil, fmt.Errorf(
+				"pd: input %d payload is %d bytes, shape %v wants %d",
+				i, len(t.Data), t.Shape, want)
+		}
+		if len(t.Data) > 0 {
+			ptrs[i] = C.CBytes(t.Data)
+		} else {
+			ptrs[i] = C.malloc(1) // zero-element tensor: valid non-nil ptr
+		}
+		types[i] = C.int32_t(t.Dtype)
+		ndims[i] = C.int32_t(len(t.Shape))
+		for _, d := range t.Shape {
+			dims = append(dims, C.int64_t(d))
+		}
+	}
+	dimsPtr := &dims[0] // index 0 is a dummy pad; real dims start at 1
+	if len(dims) > 1 {
+		dimsPtr = &dims[1]
+	}
+	rc := C.pd_predictor_run(p.ptr, &ptrs[0], &types[0], dimsPtr,
+		&ndims[0], C.int(n))
+	freeAll(n)
+	if rc != 0 {
+		return nil, errors.New(
+			"pd: run failed (see [pd_predictor] stderr)")
+	}
+	nOut := int(C.pd_predictor_num_outputs(p.ptr))
+	outs := make([]*Tensor, nOut)
+	for i := 0; i < nOut; i++ {
+		bytes := int64(C.pd_predictor_output_bytes(p.ptr, C.int(i)))
+		if bytes < 0 {
+			return nil, fmt.Errorf("pd: output %d has no buffer", i)
+		}
+		buf := make([]byte, bytes+1) // +1: valid &buf[0] when bytes == 0
+		if C.pd_predictor_copy_output(p.ptr, C.int(i),
+			unsafe.Pointer(&buf[0]), C.long(bytes)) != 0 {
+			return nil, fmt.Errorf("pd: copy of output %d failed", i)
+		}
+		outs[i] = &Tensor{Dtype: Raw, Shape: []int64{bytes},
+			Data: buf[:bytes]}
+	}
+	return outs, nil
+}
+
+// NumOutputs returns the output arity of the compiled program.
+func (p *Predictor) NumOutputs() int {
+	defer runtime.KeepAlive(p)
+	if p.ptr == nil {
+		return 0
+	}
+	return int(C.pd_predictor_num_outputs(p.ptr))
+}
+
+// Destroy releases the device buffers and the compiled executable.
+func (p *Predictor) Destroy() {
+	if p.ptr != nil {
+		C.pd_predictor_destroy(p.ptr)
+		p.ptr = nil
+	}
+}

@@ -1,0 +1,117 @@
+package pd
+
+import (
+	"encoding/binary"
+	"fmt"
+	"math"
+)
+
+// DataType enumerates the PJRT buffer element types the predictor accepts.
+// Values match PJRT_Buffer_Type (pjrt_c_api.h) — the ABI passes them through
+// untranslated, unlike the reference's own PaddleDType enum
+// (goapi/tensor.go:25), because the TPU runtime speaks PJRT natively.
+type DataType int32
+
+const (
+	// Raw marks an output whose dtype/shape the C ABI does not report;
+	// reinterpret with Tensor.ReinterpretAs using <prefix>.pdmodel.json.
+	Raw      DataType = 0
+	Pred     DataType = 1 // bool
+	Int8     DataType = 2
+	Int16    DataType = 3
+	Int32    DataType = 4
+	Int64    DataType = 5
+	Uint8    DataType = 6
+	Float16  DataType = 10
+	Float32  DataType = 11
+	Float64  DataType = 12
+	Bfloat16 DataType = 13
+)
+
+// SizeOf returns the element width in bytes.
+func (t DataType) SizeOf() int {
+	switch t {
+	case Pred, Int8, Uint8:
+		return 1
+	case Int16, Float16, Bfloat16:
+		return 2
+	case Int32, Float32:
+		return 4
+	default:
+		return 8
+	}
+}
+
+// Tensor is a host-side dense tensor handed to / received from the
+// predictor (goapi/tensor.go Tensor analog, without the zero-copy device
+// handles: PJRT owns device buffers, the ABI copies host<->device).
+type Tensor struct {
+	Dtype DataType
+	Shape []int64
+	Data  []byte // row-major raw bytes, len == NumElements*Dtype.SizeOf()
+}
+
+// NumElements returns the product of the dims.
+func (t *Tensor) NumElements() int64 {
+	n := int64(1)
+	for _, d := range t.Shape {
+		n *= d
+	}
+	return n
+}
+
+// NewFloat32Tensor packs a []float32 into a Tensor (CopyFromCpu analog).
+func NewFloat32Tensor(shape []int64, vals []float32) (*Tensor, error) {
+	t := &Tensor{Dtype: Float32, Shape: shape}
+	if int64(len(vals)) != t.NumElements() {
+		return nil, fmt.Errorf("shape %v wants %d elements, got %d",
+			shape, t.NumElements(), len(vals))
+	}
+	t.Data = make([]byte, 4*len(vals))
+	for i, v := range vals {
+		binary.LittleEndian.PutUint32(t.Data[4*i:], math.Float32bits(v))
+	}
+	return t, nil
+}
+
+// NewInt32Tensor packs a []int32 into a Tensor.
+func NewInt32Tensor(shape []int64, vals []int32) (*Tensor, error) {
+	t := &Tensor{Dtype: Int32, Shape: shape}
+	if int64(len(vals)) != t.NumElements() {
+		return nil, fmt.Errorf("shape %v wants %d elements, got %d",
+			shape, t.NumElements(), len(vals))
+	}
+	t.Data = make([]byte, 4*len(vals))
+	for i, v := range vals {
+		binary.LittleEndian.PutUint32(t.Data[4*i:], uint32(v))
+	}
+	return t, nil
+}
+
+// ReinterpretAs stamps dtype/shape metadata onto a Raw output tensor after
+// validating the payload size (outputs arrive Raw because the C ABI reports
+// byte sizes only; dtype/shape live in <prefix>.pdmodel.json).
+func (t *Tensor) ReinterpretAs(dtype DataType, shape []int64) error {
+	probe := Tensor{Dtype: dtype, Shape: shape}
+	want := probe.NumElements() * int64(dtype.SizeOf())
+	if int64(len(t.Data)) != want {
+		return fmt.Errorf(
+			"pd: %d payload bytes cannot be dtype %d shape %v (wants %d)",
+			len(t.Data), dtype, shape, want)
+	}
+	t.Dtype, t.Shape = dtype, shape
+	return nil
+}
+
+// Float32s unpacks a Float32 tensor's payload (CopyToCpu analog).
+func (t *Tensor) Float32s() ([]float32, error) {
+	if t.Dtype != Float32 {
+		return nil, fmt.Errorf("tensor dtype %d is not Float32", t.Dtype)
+	}
+	out := make([]float32, len(t.Data)/4)
+	for i := range out {
+		out[i] = math.Float32frombits(
+			binary.LittleEndian.Uint32(t.Data[4*i:]))
+	}
+	return out, nil
+}

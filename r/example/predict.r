@@ -1,0 +1,37 @@
+#!/usr/bin/env Rscript
+# paddle_tpu inference from R (reference r/example/mobilenet.r analog):
+# reticulate drives the Python Predictor. Input shapes/dtypes come from the
+# exported <prefix>.pdmodel.json (handles report shapes only after a fill),
+# and run(inputs) takes positional arrays in traced-argument order.
+
+library(reticulate)
+
+np <- import("numpy")
+builtins <- import_builtins()
+json <- import("json")
+inference <- import("paddle_tpu.inference")
+
+args <- commandArgs(trailingOnly = TRUE)
+if (length(args) < 1) {
+    stop("usage: Rscript predict.r <model_prefix>")
+}
+prefix <- args[1]
+
+meta <- json$load(builtins$open(paste0(prefix, ".pdmodel.json")))
+config <- inference$Config(prefix)
+predictor <- inference$create_predictor(config)
+
+cat("inputs:", paste(predictor$get_input_names(), collapse = ", "), "\n")
+
+inputs <- list()
+for (spec in meta$inputs) {
+    shape <- as.integer(unlist(spec$shape))
+    inputs[[length(inputs) + 1]] <- np$zeros(shape, dtype = spec$dtype)
+}
+
+outputs <- predictor$run(inputs)
+
+for (i in seq_along(outputs)) {
+    out <- outputs[[i]]
+    cat("output", i, "shape:", paste(dim(out), collapse = "x"), "\n")
+}
