@@ -4,8 +4,15 @@ from . import optimizer  # noqa: F401
 from ..nn.layer.moe import MoELayer  # noqa: F401
 from ..ops.attention import flash_attention  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
-from .fused_rnn import fusion_gru, fusion_lstm  # noqa: F401
-from .contrib_ops import cvm, data_norm, fsp_matrix, row_conv  # noqa: F401
+from .fused_rnn import attention_lstm, fusion_gru, fusion_lstm  # noqa: F401
+from .contrib_ops import (  # noqa: F401
+    batch_fc, bilateral_slice, coalesce_tensor, conv_shift, cvm, data_norm,
+    filter_by_instag, fsp_matrix, hash_op, match_matrix_tensor,
+    partial_concat, partial_sum, pyramid_hash, rank_attention, row_conv,
+    sample_logits, shuffle_batch, similarity_focus, tdm_child, tdm_sampler,
+    teacher_student_sigmoid_loss, tree_conv, var_conv_2d)
+from .segment_ops import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum)
 
 
 def softmax_mask_fuse_upper_triangle(x):

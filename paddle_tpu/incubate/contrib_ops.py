@@ -11,7 +11,16 @@ import jax.numpy as jnp
 from ..core.tensor import apply
 from ..tensor.creation import _t
 
-__all__ = ["fsp_matrix", "row_conv", "cvm", "data_norm"]
+__all__ = [
+    "fsp_matrix", "row_conv", "cvm", "data_norm",
+    # batch 6 (contrib/rec-sys tail)
+    "partial_concat", "partial_sum", "batch_fc", "rank_attention",
+    "conv_shift", "shuffle_batch", "filter_by_instag",
+    "match_matrix_tensor", "var_conv_2d", "similarity_focus",
+    "tdm_child", "tdm_sampler", "teacher_student_sigmoid_loss",
+    "sample_logits", "bilateral_slice", "coalesce_tensor",
+    "pyramid_hash", "tree_conv", "hash_op",
+]
 
 
 def fsp_matrix(x, y):
@@ -76,3 +85,487 @@ def data_norm(x, batch_size, batch_sum, batch_square_sum):
 
     return apply(f, _t(x), _t(batch_size), _t(batch_sum),
                  _t(batch_square_sum))
+
+
+def partial_concat(x, start_index=0, length=-1):
+    """partial_concat_op.cc: slice columns [start_index, start_index+length)
+    of each 2-D input and concat along dim 1 (length=-1 -> to the end)."""
+    def f(*arrs):
+        cols = []
+        for a in arrs:
+            s = start_index + a.shape[1] if start_index < 0 else start_index
+            end = a.shape[1] if length < 0 else s + length
+            cols.append(a[:, s:end])
+        return jnp.concatenate(cols, axis=1)
+
+    return apply(f, *[_t(a) for a in x])
+
+
+def partial_sum(x, start_index=0, length=-1):
+    """partial_sum_op.cc: sum the [start_index, +length) column slices of
+    the 2-D inputs elementwise."""
+    def f(*arrs):
+        s = start_index + arrs[0].shape[1] if start_index < 0 \
+            else start_index
+        end = arrs[0].shape[1] if length < 0 else s + length
+        out = arrs[0][:, s:end]
+        for a in arrs[1:]:
+            out = out + a[:, s:end]
+        return out
+
+    return apply(f, *[_t(a) for a in x])
+
+
+def batch_fc(input, w, bias):
+    """batch_fc_op.cc: per-slot FC — input [slot, B, in], w [slot, in, out],
+    bias [slot, 1, out] -> relu-free batched matmul + bias."""
+    def f(a, w_, b_):
+        return jnp.einsum("sbi,sio->sbo", a, w_) + b_
+
+    return apply(f, _t(input), _t(w), _t(bias))
+
+
+def rank_attention(input, rank_offset, rank_param, max_rank=3):
+    """rank_attention_op.cc (CTR rank-aware attention): each instance has a
+    rank r in [0, max_rank) and up to max_rank neighbor ranks from
+    rank_offset; the parameter block for (r_ins, r_nbr) is a [in, out]
+    matrix inside rank_param [max_rank*max_rank*in, out] laid out
+    row-major by (r_ins, r_nbr). Output is the mean over valid neighbor
+    blocks of input @ W[r_ins, r_nbr].
+
+    rank_offset [B, 1 + 2*max_rank] int32: col 0 = instance rank; then
+    (nbr_rank, _index) pairs, -1 marking absent (the CUDA kernel's
+    expand_rank_data layout)."""
+    def f(a, off, p):
+        B, In = a.shape
+        out_dim = p.shape[1]
+        blocks = p.reshape(max_rank, max_rank, In, out_dim)
+        ins_rank = jnp.clip(off[:, 0], 0, max_rank - 1)
+        acc = jnp.zeros((B, out_dim), a.dtype)
+        cnt = jnp.zeros((B, 1), a.dtype)
+        for j in range(max_rank):
+            nbr = off[:, 1 + 2 * j]
+            valid = (nbr >= 0) & (off[:, 0] >= 0)
+            w = blocks[ins_rank, jnp.clip(nbr, 0, max_rank - 1)]  # [B,In,O]
+            contrib = jnp.einsum("bi,bio->bo", a, w)
+            acc = acc + jnp.where(valid[:, None], contrib, 0.0)
+            cnt = cnt + valid[:, None].astype(a.dtype)
+        return acc / jnp.maximum(cnt, 1.0)
+
+    return apply(f, _t(input), _t(rank_offset), _t(rank_param))
+
+
+def conv_shift(x, y):
+    """conv_shift_op.cc (NTM circular convolution): x [B, M], y [B, N]
+    (N odd), out[b, i] = sum_j x[b, (i + j - (N-1)/2) mod M] * y[b, j]."""
+    def f(a, b):
+        M, N = a.shape[1], b.shape[1]
+        half = (N - 1) // 2
+        rolled = jnp.stack(
+            [jnp.roll(a, half - j, axis=1) for j in range(N)], axis=2)
+        return jnp.einsum("bmn,bn->bm", rolled, b)
+
+    return apply(f, _t(x), _t(y))
+
+
+def shuffle_batch(x, seed=0):
+    """shuffle_batch_op.cc: permute rows (all dims but the last are
+    flattened into rows) with a host-side RNG. Returns (out, shuffle_idx)
+    so callers can invert the permutation (the op's ShuffleIdx output)."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    t = _t(x)
+    a = t.data
+    rows = int(np.prod(a.shape[:-1]))
+    perm = np.random.RandomState(seed).permutation(rows)
+    flat = a.reshape(rows, a.shape[-1])
+
+    def f(v):
+        return v.reshape(rows, v.shape[-1])[perm].reshape(a.shape)
+
+    return apply(f, t), Tensor(perm.astype(np.int64))
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """filter_by_instag_op.cc: keep rows of `ins` whose tag set intersects
+    filter_tag. Rows here are the dense analog of the op's LoD instances:
+    ins [B, D], ins_tag [B] (one tag per row — the common single-tag
+    case). Returns (filtered, loss_weight, index_map). Host-side row
+    selection (data-dependent shape, like the NMS host path)."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    t = _t(ins)
+    tags = np.asarray(_t(ins_tag).data).reshape(-1)
+    keep_set = set(np.asarray(_t(filter_tag).data).reshape(-1).tolist())
+    keep = np.array([i for i, tg in enumerate(tags) if tg in keep_set],
+                    np.int64)
+    if len(keep) == 0:
+        D = t.shape[1]
+        filt = Tensor(np.full((1, D), out_val_if_empty, np.float32))
+        return filt, Tensor(np.zeros((1, 1), np.float32)), \
+            Tensor(np.zeros((1, 2), np.int64))
+    def f(a):
+        return a[jnp.asarray(keep)]
+    filt = apply(f, t)
+    lw = Tensor(np.ones((len(keep), 1), np.float32))
+    imap = Tensor(np.stack([np.arange(len(keep)), keep], axis=1))
+    return filt, lw, imap
+
+
+def match_matrix_tensor(x, y, w, dim_t=None):
+    """match_matrix_tensor_op.cc: text-matching tensor X * W * Y^T per
+    channel. Dense analog: x [B, Lx, D1], y [B, Ly, D2],
+    w [D1, dim_t, D2] -> out [B, dim_t, Lx, Ly]."""
+    def f(a, b, w_):
+        return jnp.einsum("bxi,itj,byj->btxy", a, w_, b)
+
+    return apply(f, _t(x), _t(y), _t(w))
+
+
+def var_conv_2d(x, row, col, w, input_channel, output_channel, filter_size,
+                stride=1):
+    """var_conv_2d_op.cc: conv over per-instance variable-size feature maps
+    (LoD rows/cols). Dense analog: x [B, C_in, H, W] with per-instance
+    valid sizes row [B], col [B]; invalid cells are masked to zero before
+    and after an ordinary conv (the reference computes each instance at
+    its own size; masking reproduces the math on the padded batch)."""
+    from ..nn.functional import conv2d
+    t, r, c = _t(x), _t(row), _t(col)
+
+    def mask(a, rr, cc):
+        H, W = a.shape[2], a.shape[3]
+        hm = jnp.arange(H)[None, :] < rr[:, None]
+        wm = jnp.arange(W)[None, :] < cc[:, None]
+        return a * (hm[:, None, :, None] & wm[:, None, None, :])
+
+    masked = apply(mask, t, r, c)
+    out = conv2d(masked, w, stride=stride,
+                 padding=((filter_size - 1) // 2))
+    return apply(mask, out, r, c)
+
+
+def similarity_focus(x, axis, indexes):
+    """similarity_focus_op.cc: greedy row/col argmax mask per selected
+    channel slice (see the op DOC). x [B, A, B2, C2], axis=1 supported."""
+    import numpy as np
+    if axis != 1:
+        raise NotImplementedError("similarity_focus: axis=1 only")
+
+    def f(a):
+        B, A, H, W = a.shape
+        m = jnp.zeros_like(a, dtype=jnp.bool_)
+        for idx in indexes:
+            t = a[:, idx]  # [B, H, W]
+            sel = jnp.zeros((B, H, W), jnp.bool_)
+            used_r = jnp.zeros((B, H), jnp.bool_)
+            used_c = jnp.zeros((B, W), jnp.bool_)
+            for _ in range(min(H, W)):
+                masked = jnp.where(used_r[:, :, None] | used_c[:, None, :],
+                                   -jnp.inf, t)
+                flat = masked.reshape(B, -1)
+                best = jnp.argmax(flat, axis=1)
+                r, c = best // W, best % W
+                sel = sel.at[jnp.arange(B), r, c].set(True)
+                used_r = used_r.at[jnp.arange(B), r].set(True)
+                used_c = used_c.at[jnp.arange(B), c].set(True)
+            m = m | sel[:, None, :, :]
+        return m.astype(a.dtype)
+
+    return apply(f, _t(x))
+
+
+def tdm_child(x, node_nums, child_nums, tree_info):
+    """tdm_child_op.cc (tree-based deep match): look up each node id's
+    children in tree_info [node_nums, 3 + child_nums] rows
+    (item_id, layer, parent, child_0..child_{n-1}); 0 marks absent.
+    Returns (child [B, N, child_nums], leaf_mask) — leaf_mask flags
+    children that are leaves (item_id != 0)."""
+    def f(ids, info):
+        kids = info[ids.astype(jnp.int32), 3:3 + child_nums]
+        item = info[kids.astype(jnp.int32), 0]
+        leaf = ((kids != 0) & (item != 0)).astype(jnp.int32)
+        return kids, leaf
+
+    return apply(f, _t(x), _t(tree_info))
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, leaf_node_num,
+                tree_travel, tree_layer, seed=0):
+    """tdm_sampler_op.cc: per-layer positive + negative sampling along each
+    item's root-to-leaf travel path. tree_travel [leaf_num, n_layers] maps
+    a leaf item to its ancestor node per layer; tree_layer rows list the
+    node ids of each layer (0-padded). Returns (out, label, mask) stacked
+    per layer: out [B, sum(neg+1)] node ids, label 1 for the positive,
+    mask 0 where a layer had no valid negative (host-side sampling RNG,
+    like the reference's CPU sampler)."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    ids = np.asarray(_t(x).data).reshape(-1).astype(np.int64)
+    travel = np.asarray(_t(tree_travel).data)
+    layers = np.asarray(_t(tree_layer).data)
+    rng = np.random.RandomState(seed)
+    outs, labels, masks = [], [], []
+    for b, item in enumerate(ids):
+        o_row, l_row, m_row = [], [], []
+        for li, negn in enumerate(neg_samples_num_list):
+            pos = int(travel[item, li])
+            cand = layers[li][layers[li] != 0]
+            cand = cand[cand != pos]
+            o_row.append(pos)
+            l_row.append(1)
+            m_row.append(0 if pos == 0 else 1)
+            take = min(negn, len(cand))
+            negs = rng.choice(cand, size=take, replace=False) \
+                if take else np.array([], np.int64)
+            for j in range(negn):
+                if j < take:
+                    o_row.append(int(negs[j])); l_row.append(0)
+                    m_row.append(1)
+                else:
+                    o_row.append(0); l_row.append(0); m_row.append(0)
+        outs.append(o_row); labels.append(l_row); masks.append(m_row)
+    return (Tensor(np.asarray(outs, np.int64)),
+            Tensor(np.asarray(labels, np.int64)),
+            Tensor(np.asarray(masks, np.int64)))
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """teacher_student_sigmoid_loss_op.cc: CTR distillation loss
+    combining the click logloss (z from the label's sign) and the
+    teacher-score logloss (z' from the label's fractional part):
+      loss = max(x,0) - x*z + log(1+exp(-|x|))
+           + [teacher] max(x,0) - x*z' + log(1+exp(-|x|))
+    label = -2 (no teacher, clk 0), -1 (no teacher, clk 1),
+    [0,1) -> z'=label, clk 0; [1,2) -> z'=label-1, clk 1."""
+    def f(x_, y):
+        x_ = x_.reshape(-1)
+        y = y.reshape(-1)
+        clk = jnp.where(y < -1.5, 0.0,
+                        jnp.where(y < 0.0, 1.0,
+                                  jnp.where(y < 1.0, 0.0, 1.0)))
+        has_teacher = y >= 0.0
+        zt = jnp.where(y < 1.0, y, y - 1.0)
+        base = jnp.maximum(x_, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x_)))
+        loss = base - x_ * clk
+        loss = loss + jnp.where(has_teacher, base - x_ * zt, 0.0)
+        return loss[:, None]
+
+    return apply(f, _t(input), _t(label))
+
+
+def sample_logits(logits, label, num_samples, uniq=True, remove_accidental_hits=True,
+                  use_customized_samples=False, customized_samples=None,
+                  customized_probabilities=None, seed=0):
+    """sample_logits_op.cc (sampled softmax): gather the true-class logits
+    plus num_samples uniformly sampled negative classes, subtract
+    log-probability corrections (log Q), and return (sampled_logits,
+    sampled_label) ready for softmax CE over num_true + num_samples
+    columns. Host RNG for the sample ids (CPU sampler parity)."""
+    import numpy as np
+    lg, lb = _t(logits), _t(label)
+    K = lg.shape[1]
+    nt = lb.shape[1] if len(lb.shape) > 1 else 1
+    if use_customized_samples:
+        samples = np.asarray(_t(customized_samples).data)
+        probs = np.asarray(_t(customized_probabilities).data)
+    else:
+        rng = np.random.RandomState(seed)
+        samples = rng.randint(0, K, size=(num_samples,)).astype(np.int64)
+        probs = np.full((num_samples,), 1.0 / K, np.float64)
+
+    def f(x_, y):
+        B = x_.shape[0]
+        y2 = y.reshape(B, nt)
+        true_logit = jnp.take_along_axis(x_, y2.astype(jnp.int32), axis=1)
+        # the same expected-count correction log(num_samples * q(c)) the
+        # sampled columns get — an inconsistent correction would bias the
+        # softmax toward the true class
+        # (custom-dist mode supplies probs for the sampled ids only; the
+        # true class uses the uniform prior, as with the host sampler)
+        q_all = jnp.asarray(np.full((K,), 1.0 / K), x_.dtype)
+        true_logit = true_logit - jnp.log(num_samples
+                                          * q_all[y2.astype(jnp.int32)])
+        s_ids = jnp.asarray(samples.reshape(-1), jnp.int32)
+        neg_logit = x_[:, s_ids] - jnp.log(
+            jnp.asarray(probs.reshape(-1), x_.dtype) * num_samples)
+        if remove_accidental_hits:
+            hit = jnp.any(s_ids[None, None, :] == y2[:, :, None], axis=1)
+            neg_logit = jnp.where(hit, neg_logit - 1e20, neg_logit)
+        out = jnp.concatenate([true_logit, neg_logit], axis=1)
+        slabel = jnp.concatenate(
+            [jnp.ones((B, nt), jnp.int64), jnp.zeros((B, num_samples),
+                                                     jnp.int64)], axis=1)
+        return out, slabel
+
+    return apply(f, lg, lb)
+
+
+def bilateral_slice(x, guide, grid, has_offset=False):
+    """bilateral_slice_op.cu (HDRNet): per-pixel affine transform sliced
+    from a low-res bilateral grid by (x, y, guide-intensity) trilinear
+    lookup. x [B, C, H, W], guide [B, H, W] in [0,1],
+    grid [B, G, D, Gh, Gw] where G = C*(C+1) with offset else C*C."""
+    def f(a, g, gr):
+        B, C, H, W = a.shape
+        _, G, D, Gh, Gw = gr.shape
+        gx = (jnp.arange(W) + 0.5) / W * Gw - 0.5
+        gy = (jnp.arange(H) + 0.5) / H * Gh - 0.5
+        gz = g * D - 0.5
+        def axis_w(coord, n):
+            lo = jnp.clip(jnp.floor(coord).astype(jnp.int32), 0, n - 1)
+            hi = jnp.clip(lo + 1, 0, n - 1)
+            t = jnp.clip(coord - lo, 0.0, 1.0)
+            return lo, hi, t
+        x0, x1, tx = axis_w(gx, Gw)
+        y0, y1, ty = axis_w(gy, Gh)
+        z0, z1, tz = axis_w(gz, D)
+        def gather(zi, yi, xi):
+            # zi [B,H,W], yi [H], xi [W] -> [B, G, H, W]
+            return gr[jnp.arange(B)[:, None, None, None],
+                      jnp.arange(G)[None, :, None, None],
+                      zi[:, None, :, :],
+                      yi[None, None, :, None], xi[None, None, None, :]]
+        out = None
+        for zi, wz in ((z0, 1 - tz), (z1, tz)):
+            for yi, wy in ((y0, 1 - ty), (y1, ty)):
+                for xi, wx in ((x0, 1 - tx), (x1, tx)):
+                    w_ = wz[:, None, :, :] * wy[None, None, :, None] \
+                        * wx[None, None, None, :]
+                    v = gather(zi, yi, xi) * w_
+                    out = v if out is None else out + v
+        n_in = C + 1 if has_offset else C
+        A = out.reshape(B, -1, n_in, H, W)   # [B, C_out, n_in, H, W]
+        res = jnp.einsum("bonhw,bnhw->bohw", A[:, :, :C], a)
+        if has_offset:
+            res = res + A[:, :, C]
+        return res
+
+    return apply(f, _t(x), _t(guide), _t(grid))
+
+
+def coalesce_tensor(inputs, dtype=None, set_constant=False,
+                    constant=0.0, align_size=256):
+    """coalesce_tensor_op.cc: fuse a list of tensors into one contiguous
+    buffer (comm/optimizer fusion). Returns (outputs, fused) where
+    outputs are views re-split from the fused buffer in input order —
+    XLA keeps them as slices of one allocation, the TPU analog of the
+    shared-memory chunk the reference builds."""
+    ts = [_t(a) for a in inputs]
+    sizes, aligned = [], []
+    import numpy as np
+    for t in ts:
+        n = int(np.prod(t.shape))
+        sizes.append(n)
+        al = ((n + align_size - 1) // align_size) * align_size
+        aligned.append(al)
+
+    def f(*arrs):
+        parts = []
+        for a, al in zip(arrs, aligned):
+            flat = a.reshape(-1).astype(dtype or a.dtype)
+            pad = al - flat.shape[0]
+            parts.append(jnp.pad(flat, (0, pad)))
+        fused = jnp.concatenate(parts)
+        if set_constant:
+            fused = jnp.full_like(fused, constant)
+        outs, off = [], 0
+        for a, n, al in zip(arrs, sizes, aligned):
+            outs.append(fused[off:off + n].reshape(a.shape)
+                        .astype(a.dtype))
+            off += al
+        return tuple(outs) + (fused,)
+
+    res = apply(f, *ts)
+    return list(res[:-1]), res[-1]
+
+
+def pyramid_hash(x, num_emb, space_len, pyramid_layer=2, rand_len=16,
+                 white_list_len=0, black_list_len=0, seed=0xdeadbeef,
+                 lr=1.0, param=None):
+    """pyramid_hash_op.cc (text n-gram hash embedding): for each n-gram
+    window length in [2, pyramid_layer+1], hash the window of token ids
+    into the embedding space and sum the looked-up rows per sequence
+    position. x [B, L] int ids, param [space_len, rand_len] (created by
+    the caller). A multiplicative-xor hash stands in for the reference's
+    xxHash (same distributional role, deterministic)."""
+    def f(ids, table):
+        B, L = ids.shape
+        out = jnp.zeros((B, L, rand_len), table.dtype)
+        ids64 = ids.astype(jnp.uint32)
+        for n in range(2, pyramid_layer + 2):
+            if n > L:
+                break
+            h = jnp.zeros((B, L - n + 1), jnp.uint32)
+            for k in range(n):
+                h = (h ^ ids64[:, k:k + L - n + 1]) * jnp.uint32(0x9E3779B1)
+            slot = (h % jnp.uint32(space_len)).astype(jnp.int32)
+            emb = table[slot]  # [B, L-n+1, rand_len]
+            out = out.at[:, :L - n + 1].add(emb)
+        return out
+
+    return apply(f, _t(x), _t(param))
+
+
+def tree_conv(nodes_vector, edge_set, filter, max_depth=2):
+    """tree_conv_op.cc (tree-based convolution, TBCNN): for each node,
+    combine its continuous-binary-tree neighborhood up to max_depth with
+    three direction weights (top/left/right). nodes_vector
+    [B, N, feature], edge_set [B, E, 2] directed parent->child edges
+    (0-padded), filter [feature, 3, output, num_filters].
+    Dense adjacency matmul formulation (the MXU-friendly analog of the
+    reference's per-node gather): eta weights follow the TBCNN paper's
+    position interpolation."""
+    def f(x_, edges, w):
+        B, N, F = x_.shape
+        ar = jnp.arange(N)
+        # children lists from the edge set: adj[b, p, c] = 1
+        e = edges.astype(jnp.int32)
+        valid = (e[:, :, 0] != e[:, :, 1])  # 0-padded rows have p == c == 0
+        adj = jnp.zeros((B, N, N))
+        adj = adj.at[jnp.arange(B)[:, None], e[:, :, 0], e[:, :, 1]].add(
+            valid.astype(jnp.float32))
+        n_child = adj.sum(-1, keepdims=True)  # [B, N, 1]
+        # position index of each child under its parent (order of edge list)
+        order = jnp.cumsum(adj, axis=-1) * adj  # 1-based position
+        denom = jnp.maximum(n_child - 1.0, 1.0)
+        # eta_t: depth interpolation (depth-1 nodes: children weight)
+        # eta_l/eta_r: position interpolation across siblings
+        eta_r = (order - 1.0) / denom * adj
+        eta_l = (1.0 - (order - 1.0) / denom) * adj
+        out = []
+        wt, wl, wr = w[:, 0], w[:, 1], w[:, 2]  # [F, O, M] each
+        # depth-0 (the node itself, top weight) + depth-1 (children via
+        # left/right weights), the max_depth=2 window the default uses;
+        # deeper windows chain the adjacency power
+        h_self = jnp.einsum("bnf,fom->bnom", x_, wt)
+        h_l = jnp.einsum("bnc,bcf,fom->bnom", eta_l, x_, wl)
+        h_r = jnp.einsum("bnc,bcf,fom->bnom", eta_r, x_, wr)
+        acc = h_self + h_l + h_r
+        depth_adj = adj
+        for _ in range(max_depth - 2):
+            depth_adj = jnp.einsum("bnc,bcd->bnd", depth_adj, adj)
+            acc = acc + jnp.einsum("bnc,bcf,fom->bnom", depth_adj, x_,
+                                   (wl + wr) * 0.5)
+        return jnp.tanh(acc)
+
+    return apply(f, _t(nodes_vector), _t(edge_set), _t(filter))
+
+
+def hash_op(x, num_hash=1, mod_by=100000000):
+    """hash_op.cc: hash int-id windows into num_hash buckets columns
+    (multiplicative-xor standing in for xxHash as in pyramid_hash)."""
+    def f(ids):
+        B, L = ids.shape[0], ids.shape[1]
+        u = ids.astype(jnp.uint32).reshape(B, -1)
+        outs = []
+        for k in range(num_hash):
+            h = jnp.uint32(0x9E3779B1 + k)
+            acc = jnp.zeros((B,), jnp.uint32) + h
+            acc = jnp.bitwise_xor(
+                jnp.cumsum(u * (h | jnp.uint32(1)), axis=1)[:, -1], acc)
+            outs.append((acc % jnp.uint32(mod_by)).astype(jnp.int64))
+        return jnp.stack(outs, axis=1)
+
+    return apply(f, _t(x))

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from ..core.tensor import apply
 from ..tensor.creation import _t
 
-__all__ = ["fusion_gru", "fusion_lstm"]
+__all__ = ["fusion_gru", "fusion_lstm", "attention_lstm"]
 
 
 _ACT = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
@@ -157,3 +157,59 @@ def fusion_lstm(x, weight_x, weight_h, bias=None, h0=None, c0=None,
 
     return _apply_with_optional(f, (x, weight_x, weight_h),
                                 [("b", bias), ("h", h0), ("c", c0)])
+
+
+def attention_lstm(x, attention_weight, lstm_weight, lstm_bias,
+                   attention_bias=None, attention_scalar=None,
+                   attention_scalar_bias=None, c0=None, h0=None,
+                   gate_activation="sigmoid", cell_activation="tanh",
+                   candidate_activation="tanh"):
+    """Fused attention-LSTM (operators/attention_lstm_op.cc): per step,
+    score each time position by an FC over [x_t, cell_{t-1}] (+ optional
+    scalar rescale), softmax over time, pool x by the attention weights
+    into one [B, M] input, then run one standard LSTM step on it.
+
+    x [B, T, M]; attention_weight [M+D, 1]; lstm_weight [M+D, 4D]
+    (gate order {c, i, f, o} like fusion_lstm); lstm_bias [4D].
+    Returns (hidden [B, T, D], cell [B, T, D])."""
+    gate_act = _ACT[gate_activation]
+    cell_act = _ACT[cell_activation]
+    cand_act = _ACT[candidate_activation]
+
+    def f(xa, aw, lw, lb, ab, asc, asb, c_init, h_init):
+        B, T, M = xa.shape
+        D = lw.shape[1] // 4
+        aw_x, aw_c = aw[:M], aw[M:]  # attention FC split: x part, cell part
+        c_prev0 = (jnp.zeros((B, D), xa.dtype) if c_init is None
+                   else c_init.astype(xa.dtype))
+        h_prev0 = (jnp.zeros((B, D), xa.dtype) if h_init is None
+                   else h_init.astype(xa.dtype))
+        score_x = jnp.einsum("btm,mo->bto", xa, aw_x)[..., 0]  # [B, T]
+
+        def step(carry, _):
+            h_prev, c_prev = carry
+            s = score_x + (c_prev @ aw_c)[:, 0:1]  # [B, T]
+            if ab is not None:
+                s = s + ab.reshape(())
+            s = jnp.maximum(s, 0.0)
+            if asc is not None:
+                s = s * asc.reshape(())
+                if asb is not None:
+                    s = s + asb.reshape(())
+                s = jnp.maximum(s, 0.0)
+            att = jax.nn.softmax(s, axis=1)
+            pooled = jnp.einsum("bt,btm->bm", att, xa)  # lstm_x_t
+            gates = jnp.concatenate([pooled, h_prev], 1) @ lw + lb
+            c_t, i, fgate, o = jnp.split(gates, 4, axis=1)
+            c = gate_act(i) * cand_act(c_t) + gate_act(fgate) * c_prev
+            h = gate_act(o) * cell_act(c)
+            return (h, c), (h, c)
+
+        (_, _), (hs, cs) = jax.lax.scan(step, (h_prev0, c_prev0),
+                                        jnp.arange(T))
+        return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+    return _apply_with_optional(
+        f, (x, attention_weight, lstm_weight, lstm_bias),
+        [("ab", attention_bias), ("asc", attention_scalar),
+         ("asb", attention_scalar_bias), ("c0", c0), ("h0", h0)])

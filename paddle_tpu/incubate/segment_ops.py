@@ -1,0 +1,65 @@
+"""paddle.incubate.segment_* (reference operators/segment_pool_op.cc +
+python/paddle/incubate/tensor/math.py segment_sum/mean/max/min): pool rows
+of `data` by the sorted segment_ids vector. TPU-native: jax.ops.segment_sum
+-class primitives (XLA scatter-add), differentiable through the tape."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import apply
+from ..tensor.creation import _t
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min"]
+
+
+def _num_segments(segment_ids):
+    import numpy as np
+    ids = segment_ids.data if hasattr(segment_ids, "data") else segment_ids
+    if isinstance(ids, jax.core.Tracer):
+        raise ValueError(
+            "segment ops need concrete segment_ids under jit; pass the "
+            "static num_segments via a wrapper or run eagerly")
+    return int(np.asarray(ids).max()) + 1 if np.asarray(ids).size else 0
+
+
+def _segment(data, segment_ids, mode):
+    n = _num_segments(segment_ids)
+
+    def f(a, ids):
+        ids = ids.astype(jnp.int32)
+        if mode == "sum":
+            return jax.ops.segment_sum(a, ids, num_segments=n)
+        if mode == "mean":
+            tot = jax.ops.segment_sum(a, ids, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones_like(a), ids,
+                                      num_segments=n)
+            return tot / jnp.maximum(cnt, 1.0)
+        # empty segments: the reference op writes 0, not the -inf/+inf
+        # reduction identity
+        cnt = jax.ops.segment_sum(jnp.ones(a.shape[:1]), ids,
+                                  num_segments=n)
+        present = (cnt > 0).reshape((-1,) + (1,) * (a.ndim - 1))
+        if mode == "max":
+            r = jax.ops.segment_max(a, ids, num_segments=n)
+        else:
+            r = jax.ops.segment_min(a, ids, num_segments=n)
+        return jnp.where(present, r, jnp.zeros_like(r))
+
+    return apply(f, _t(data), _t(segment_ids))
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "max")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "min")

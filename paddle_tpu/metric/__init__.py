@@ -362,3 +362,135 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
     mk = lambda v, dt: to_tensor(np.asarray(v, dt))
     return (mk(p, np.float32), mk(r, np.float32), mk(f1, np.float32),
             mk(n_inf, np.int64), mk(n_lab, np.int64), mk(n_cor, np.int64))
+
+
+def mean_iou(input, label, num_classes):
+    """mean-IOU for semantic segmentation (operators/mean_iou_op.cc):
+    per-class IOU = TP / (TP + FP + FN) averaged over classes that appear
+    in either prediction or label. Returns (mean_iou, out_wrong,
+    out_correct) — the op's three outputs. Jittable."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply
+    from ..tensor.creation import _t
+    nc = int(num_classes)
+
+    def f(p, l):
+        p = p.reshape(-1).astype(jnp.int32)
+        l = l.reshape(-1).astype(jnp.int32)
+        correct = jnp.zeros((nc,), jnp.int32).at[
+            jnp.where(p == l, p, nc - 1)].add(
+            (p == l).astype(jnp.int32), mode="drop")
+        pred_cnt = jnp.zeros((nc,), jnp.int32).at[p].add(1, mode="drop")
+        label_cnt = jnp.zeros((nc,), jnp.int32).at[l].add(1, mode="drop")
+        union = pred_cnt + label_cnt - correct
+        wrong = pred_cnt + label_cnt - 2 * correct
+        present = union > 0
+        iou = jnp.where(present,
+                        correct / jnp.maximum(union, 1).astype(jnp.float32),
+                        0.0)
+        miou = jnp.sum(iou) / jnp.maximum(
+            jnp.sum(present.astype(jnp.int32)), 1)
+        return miou.astype(jnp.float32), wrong, correct
+
+    return apply(f, _t(input), _t(label))
+
+
+def positive_negative_pair(score, label, query_id):
+    """LTR pair-ranking counts (operators/positive_negative_pair_op.cc):
+    within each query, item pairs with different labels count as positive
+    when the score order matches the label order, negative when it
+    opposes, neutral on score ties. Returns (positive, negative, neutral)
+    fp32 scalars. Jittable (O(N^2) pairwise mask over the batch)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply
+    from ..tensor.creation import _t
+
+    def f(s, l, q):
+        if s.ndim == 2:
+            s = s[:, -1]  # model score column (op contract)
+        s, l, q = s.reshape(-1), l.reshape(-1), q.reshape(-1)
+        same_q = q[:, None] == q[None, :]
+        lbl_gt = l[:, None] > l[None, :]          # ordered pairs (i beats j)
+        valid = same_q & lbl_gt
+        sd = s[:, None] - s[None, :]
+        pos = jnp.sum((valid & (sd > 0)).astype(jnp.float32))
+        neg = jnp.sum((valid & (sd < 0)).astype(jnp.float32))
+        neu = jnp.sum((valid & (sd == 0)).astype(jnp.float32))
+        return pos, neg, neu
+
+    return apply(f, _t(score), _t(label), _t(query_id))
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral"):
+    """Detection mAP (operators/detection/detection_map_op.cc reduced to
+    the dense single-call form): detect_res rows are
+    [image_id, class, score, xmin, ymin, xmax, ymax], label rows are
+    [image_id, class, xmin, ymax... ] -> [image_id, class, xmin, ymin,
+    xmax, ymax, (difficult)]. Host-side numpy (metric path, not jitted —
+    the same design note as the vision.ops NMS host fallback). Returns the
+    mAP scalar in [0, 1]."""
+    import numpy as np
+    from ..core.tensor import Tensor
+
+    det = np.asarray(detect_res.data if isinstance(detect_res, Tensor)
+                     else detect_res, np.float64)
+    gt = np.asarray(label.data if isinstance(label, Tensor) else label,
+                    np.float64)
+    if det.ndim != 2 or (det.size and det.shape[1] != 7):
+        raise ValueError("detect_res rows must be [img, cls, score, x0, "
+                         "y0, x1, y1]")
+    has_diff = gt.size and gt.shape[1] >= 7
+    aps = []
+    for c in range(int(class_num)):
+        if c == background_label:
+            continue
+        gt_c = gt[gt[:, 1] == c] if gt.size else gt.reshape(0, 6)
+        det_c = det[det[:, 1] == c] if det.size else det.reshape(0, 7)
+        difficult = gt_c[:, 6].astype(bool) if has_diff else \
+            np.zeros(len(gt_c), bool)
+        n_pos = int((~difficult).sum()) if not evaluate_difficult \
+            else len(gt_c)
+        if n_pos == 0:
+            continue
+        order = np.argsort(-det_c[:, 2], kind="stable")
+        det_c = det_c[order]
+        matched = np.zeros(len(gt_c), bool)
+        tp = np.zeros(len(det_c))
+        fp = np.zeros(len(det_c))
+        for i, d in enumerate(det_c):
+            cand = np.where(gt_c[:, 0] == d[0])[0]
+            best, best_iou = -1, float(overlap_threshold)
+            for j in cand:
+                g = gt_c[j]
+                ix0, iy0 = max(d[3], g[2]), max(d[4], g[3])
+                ix1, iy1 = min(d[5], g[4]), min(d[6], g[5])
+                inter = max(ix1 - ix0, 0.0) * max(iy1 - iy0, 0.0)
+                union = ((d[5] - d[3]) * (d[6] - d[4])
+                         + (g[4] - g[2]) * (g[5] - g[3]) - inter)
+                iou = inter / union if union > 0 else 0.0
+                if iou >= best_iou:
+                    best, best_iou = j, iou
+            if best >= 0 and not matched[best]:
+                if evaluate_difficult or not difficult[best]:
+                    tp[i] = 1.0
+                matched[best] = True
+            else:
+                fp[i] = 1.0
+        ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+        recall = ctp / n_pos
+        precision = ctp / np.maximum(ctp + cfp, 1e-12)
+        if ap_version == "11point":
+            ap = float(np.mean([
+                precision[recall >= t].max() if (recall >= t).any() else 0.0
+                for t in np.arange(0.0, 1.01, 0.1)]))
+        else:  # integral
+            ap = 0.0
+            prev_r = 0.0
+            for p_, r_ in zip(precision, recall):
+                ap += p_ * (r_ - prev_r)
+                prev_r = r_
+            ap = float(ap)
+        aps.append(ap)
+    return Tensor(np.float32(np.mean(aps) if aps else 0.0))

@@ -43,22 +43,143 @@ def _pool(x, fn, init, kernel, stride, padding, n, data_format, ceil_mode=False,
     return apply(f, _t(x))
 
 
+def _max_pool_mask(x, kernel, stride, padding, n):
+    """Max pool that also returns the argmax as flat indices into the
+    flattened input spatial volume per (N, C) — pool_with_index_op.cc's
+    MaxPoolWithIndex contract (what max_unpool consumes). NC*-layout only,
+    matching the reference kernel. Windows are materialized per kernel
+    offset (K = prod(kernel) slices, K is small and static), the
+    TPU-friendly alternative to a scatter-per-window argmax."""
+    ks = _norm_tuple(kernel, n)
+    st = _norm_tuple(stride if stride is not None else kernel, n)
+    pad = _padding(padding, n)
+    if isinstance(pad, str):
+        raise ValueError("return_mask does not support string padding")
+
+    def f(a):
+        spatial = a.shape[2:]
+        neg = jnp.finfo(a.dtype).min if jnp.issubdtype(a.dtype, jnp.floating) \
+            else jnp.iinfo(a.dtype).min
+        a_pad = jnp.pad(a, [(0, 0), (0, 0)] + list(pad),
+                        constant_values=neg)
+        out_sizes = tuple(
+            (spatial[d] + sum(pad[d]) - ks[d]) // st[d] + 1
+            for d in range(n))
+        vals, flats = [], []
+        for offs in np.ndindex(*ks):
+            sl = [slice(None), slice(None)]
+            for d in range(n):
+                sl.append(slice(offs[d],
+                                offs[d] + (out_sizes[d] - 1) * st[d] + 1,
+                                st[d]))
+            vals.append(a_pad[tuple(sl)])
+            # flat index of this window position in the UNPADDED volume;
+            # padded (out-of-range) cells never win (value is dtype-min)
+            flat = jnp.zeros(out_sizes, jnp.int32)
+            for d in range(n):
+                coord = (jnp.arange(out_sizes[d]) * st[d] - pad[d][0]
+                         + offs[d]).astype(jnp.int32)
+                coord = coord.reshape((-1,) + (1,) * (n - 1 - d))
+                flat = flat * spatial[d] + coord
+            flats.append(jnp.broadcast_to(flat, out_sizes))
+        stack_v = jnp.stack(vals, axis=2)       # [B, C, K, *out]
+        stack_i = jnp.stack(flats, axis=0)      # [K, *out]
+        best = jnp.argmax(stack_v, axis=2)      # [B, C, *out]
+        out = jnp.max(stack_v, axis=2)
+        mask = jnp.take_along_axis(
+            jnp.broadcast_to(stack_i[None, None],
+                             out.shape[:2] + stack_i.shape),
+            best[:, :, None], axis=2)[:, :, 0].astype(jnp.int32)
+        return out, mask
+
+    return apply(f, _t(x))
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
+    if return_mask:
+        if ceil_mode:
+            raise NotImplementedError("return_mask with ceil_mode")
+        return _max_pool_mask(x, kernel_size, stride, padding, 1)
     return _pool(x, jax.lax.max, -jnp.inf, kernel_size, stride, padding, 1, "NCL",
                  ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        if ceil_mode or data_format != "NCHW":
+            raise NotImplementedError(
+                "return_mask supports NCHW floor-mode only "
+                "(pool_with_index_op.cc parity)")
+        return _max_pool_mask(x, kernel_size, stride, padding, 2)
     return _pool(x, jax.lax.max, -jnp.inf, kernel_size, stride, padding, 2,
                  data_format, ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        if ceil_mode or data_format != "NCDHW":
+            raise NotImplementedError(
+                "return_mask supports NCDHW floor-mode only")
+        return _max_pool_mask(x, kernel_size, stride, padding, 3)
     return _pool(x, jax.lax.max, -jnp.inf, kernel_size, stride, padding, 3,
                  data_format, ceil_mode)
+
+
+def _max_unpool(x, indices, kernel, stride, padding, n, output_size):
+    """Inverse of max_pool*(return_mask=True): scatter each pooled value
+    back to its argmax position (unpool_op.cc Unpool2dMax). indices are
+    flat positions in the output spatial volume."""
+    ks = _norm_tuple(kernel, n)
+    st = _norm_tuple(stride if stride is not None else kernel, n)
+    pad = _padding(padding, n)
+    if isinstance(pad, str):
+        raise ValueError("max_unpool does not support string padding")
+
+    def f(a, idx):
+        spatial_in = a.shape[2:]
+        if output_size is not None:
+            spatial_out = tuple(output_size[-n:])
+        else:
+            spatial_out = tuple(
+                (spatial_in[d] - 1) * st[d] - pad[d][0] - pad[d][1] + ks[d]
+                for d in range(n))
+        B, C = a.shape[:2]
+        flat_n = int(np.prod(spatial_in))
+        flat_out = int(np.prod(spatial_out))
+        v = a.reshape(B * C, flat_n)
+        i = idx.reshape(B * C, flat_n)
+        rows = jnp.arange(B * C)[:, None]
+        out = jnp.zeros((B * C, flat_out), a.dtype).at[rows, i].set(v)
+        return out.reshape((B, C) + spatial_out)
+
+    return apply(f, _t(x), _t(indices))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    if data_format != "NCL":
+        raise NotImplementedError("max_unpool1d supports NCL only")
+    return _max_unpool(x, indices, kernel_size, stride, padding, 1,
+                       output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    if data_format != "NCHW":
+        raise NotImplementedError("max_unpool2d supports NCHW only")
+    return _max_unpool(x, indices, kernel_size, stride, padding, 2,
+                       output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    if data_format != "NCDHW":
+        raise NotImplementedError("max_unpool3d supports NCDHW only")
+    return _max_unpool(x, indices, kernel_size, stride, padding, 3,
+                       output_size)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,

@@ -213,3 +213,125 @@ def name_scope(prefix=None):
 def program_guard(main_program, startup_program=None):
     import contextlib
     return contextlib.nullcontext()
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """static.Print (operators/print_op.cc, tensor_formatter.cc): prints the
+    tensor at execution time and passes it through unchanged. Under jit the
+    print rides jax.debug.print (host callback on every execution, the
+    TPU-native analog of the op's CPU-side formatter); eagerly it prints
+    immediately. first_n/summarize follow the op's truncation contract."""
+    import jax
+    import numpy as _np
+    from ..core.tensor import Tensor, apply
+    from ..tensor.creation import _t
+    t = _t(input)
+    prefix = (message + " ") if message else ""
+    tname = getattr(t, "name", None)
+    name_part = f"var {tname} " if (print_tensor_name and tname) else ""
+    state = {"count": 0}
+
+    def _emit(d):
+        # host callback (not a format string: the user message must never
+        # be interpreted as {} placeholders); first_n caps emissions
+        if first_n >= 0 and state["count"] >= first_n:
+            return
+        state["count"] += 1
+        shape_part = f"shape={tuple(d.shape)} " if print_tensor_shape else ""
+        type_part = f"dtype={d.dtype} " if print_tensor_type else ""
+        n = d.size if summarize in (-1, None) else min(summarize, d.size)
+        print(prefix + name_part + shape_part + type_part
+              + f"data={_np.asarray(d).reshape(-1)[:int(n)]}", flush=True)
+
+    def f(a):
+        jax.debug.callback(_emit, a)
+        return a
+
+    return apply(f, t)
+
+
+def Assert(cond, data=None, summarize=20, name=None):
+    """static.Assert (operators/assert_op.cc): fails execution when cond is
+    False. Eager path raises ValueError immediately; under jit the check
+    becomes a jax checkify-style debug callback (TPU executes async, so the
+    error surfaces at the next host sync — the same deferred semantics as
+    the reference's device assert)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..core.tensor import Tensor
+    t = cond.data if isinstance(cond, Tensor) else cond
+    datas = [d.data if isinstance(d, Tensor) else d for d in (data or [])]
+
+    def _check(ok, *vals):
+        if not np.all(np.asarray(ok)):
+            raise ValueError(
+                "Assert failed: cond is False"
+                + (f"; data={[np.asarray(v).reshape(-1)[:summarize] for v in vals]}"
+                   if vals else ""))
+
+    if isinstance(t, jax.core.Tracer):
+        jax.debug.callback(_check, jnp.all(t), *datas)
+    else:
+        _check(t, *datas)
+    return cond
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """static.py_func (operators/py_func_op.cc): run a host Python function
+    as an op. TPU-native: jax.pure_callback with result shapes taken from
+    `out` (the op's pre-created out vars give the static shapes jit needs);
+    backward_func rides a custom VJP the same way the reference registers
+    the backward op."""
+    import jax
+    import numpy as np
+    from ..core.tensor import Tensor, apply
+    from ..tensor.creation import _t
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    single = not isinstance(out, (list, tuple))
+    specs = [jax.ShapeDtypeStruct(tuple(o.shape), o.dtype if not
+             isinstance(o, Tensor) else o.data.dtype) for o in outs]
+
+    def host(*arrays):
+        res = func(*[np.asarray(a) for a in arrays])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                     for r, s in zip(res, specs))
+
+    def f(*arrays):
+        res = jax.pure_callback(host, tuple(specs), *arrays)
+        return res[0] if single else tuple(res)
+
+    if backward_func is not None:
+        import jax.numpy as jnp
+
+        @jax.custom_vjp
+        def op(*arrays):
+            return f(*arrays)
+
+        def fwd(*arrays):
+            return f(*arrays), arrays
+
+        def bwd(arrays, g):
+            gs = g if isinstance(g, tuple) else (g,)
+            in_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                        for a in arrays]
+
+            def host_bwd(*vals):
+                n = len(arrays)
+                res = backward_func(*[np.asarray(v) for v in vals])
+                res = res if isinstance(res, (list, tuple)) else [res]
+                return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                             for r, s in zip(res, in_specs))
+
+            return jax.pure_callback(host_bwd, tuple(in_specs),
+                                     *arrays, *gs)
+
+        op.defvjp(fwd, bwd)
+        return apply(op, *[_t(a) for a in xs])
+    return apply(f, *[_t(a) for a in xs])

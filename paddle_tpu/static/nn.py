@@ -20,7 +20,8 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor, apply
 from ..tensor.creation import _t
 
-__all__ = ["cond", "case", "switch_case", "while_loop"]
+__all__ = ["cond", "case", "switch_case", "while_loop", "fc", "nce",
+           "fill_constant_batch_size_like"]
 
 
 def _is_traced(x) -> bool:
@@ -123,3 +124,103 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
 
     out = jax.lax.while_loop(c, b, tuple(v.data for v in vars_t))
     return [_t(o) for o in out]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """static.nn.fc analog (operators/fc_op.cc): flattens trailing dims and
+    applies a Linear. Static-graph fc creates one parameter per named call
+    site; here a `name` keys the layer cache (call the same name again to
+    reuse the weights, as a Program rebuild would). Without a name each
+    call creates a FRESH layer — two anonymous fc() calls never share
+    weights; for eager reuse across steps hold a paddle.nn.Linear."""
+    from ..core.tensor import Tensor
+    from .. import nn
+    import numpy as np
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    lead = t.shape[:num_flatten_dims]
+    feat = int(np.prod(t.shape[num_flatten_dims:]))
+    flat = t.reshape(list(lead) + [feat])
+    if name is not None:
+        cache = getattr(fc, "_layers", None)
+        if cache is None:
+            cache = fc._layers = {}
+        key = (name, feat, size)
+        if key not in cache:
+            cache[key] = nn.Linear(feat, size)
+        layer = cache[key]
+    else:
+        layer = nn.Linear(feat, size)
+    out = layer(flat)
+    if activation == "relu":
+        out = nn.functional.relu(out)
+    elif activation == "tanh":
+        out = out.tanh()
+    elif activation:
+        raise NotImplementedError(f"fc activation {activation}")
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    """operators/fill_constant_batch_size_like_op.cc: a constant-filled
+    tensor whose output_dim_idx dim copies input's input_dim_idx dim
+    (the dynamic batch size)."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor, apply
+    from ..tensor.creation import _t
+
+    def f(a):
+        out_shape = list(shape)
+        out_shape[output_dim_idx] = a.shape[input_dim_idx]
+        return jnp.full(out_shape, value, dtype=dtype)
+
+    return apply(f, _t(input))
+
+
+def nce(input, label, num_total_classes, weight, bias=None,
+        num_neg_samples=10, sampler="uniform", custom_dist=None, seed=0):
+    """static.nn.nce (operators/nce_op.cc): noise-contrastive estimation
+    loss. True-class and sampled-noise logits each get their expected-count
+    correction log(k*q(c)); per-sample loss is the binary logistic loss
+    over true (label 1) and noise (label 0) classes. Host RNG samples the
+    noise ids (CPU sampler parity); uniform or custom distribution.
+    input [B, D], weight [C, D], bias [C], label [B, num_true].
+    Returns [B, 1] loss."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ..core.tensor import apply
+    from ..tensor.creation import _t
+
+    rng = np.random.RandomState(seed)
+    if sampler == "uniform":
+        probs_np = np.full((num_total_classes,), 1.0 / num_total_classes)
+    elif sampler == "custom_dist":
+        probs_np = np.asarray(custom_dist, np.float64)
+        probs_np = probs_np / probs_np.sum()
+    else:
+        raise NotImplementedError(f"nce sampler {sampler!r}")
+    neg = rng.choice(num_total_classes, size=(num_neg_samples,),
+                     p=probs_np).astype(np.int64)
+
+    def f(x_, y, w, b):
+        B = x_.shape[0]
+        y2 = y.reshape(B, -1).astype(jnp.int32)
+        k = float(num_neg_samples)
+        q = jnp.asarray(probs_np, x_.dtype)
+
+        s_true = jnp.einsum("bd,bnd->bn", x_, w[y2]) \
+            + (b[y2] if b is not None else 0.0)
+        s_true = s_true - jnp.log(k * q[y2])
+        neg_ids = jnp.asarray(neg)
+        s_neg = x_ @ w[neg_ids].T + (b[neg_ids] if b is not None else 0.0)
+        s_neg = s_neg - jnp.log(k * q[neg_ids])
+        # logistic loss: true classes push sigma(s)->1, noise ->0
+        pos_loss = jnp.sum(jnp.logaddexp(0.0, -s_true), axis=1)
+        neg_loss = jnp.sum(jnp.logaddexp(0.0, s_neg), axis=1)
+        return (pos_loss + neg_loss)[:, None]
+
+    args = [_t(input), _t(label), _t(weight)]
+    if bias is not None:
+        return apply(lambda x_, y, w, b: f(x_, y, w, b), *args, _t(bias))
+    return apply(lambda x_, y, w: f(x_, y, w, None), *args)

@@ -25,7 +25,9 @@ from ..tensor.creation import _t
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
            "box_iou", "prior_box", "anchor_generator", "box_clip",
            "iou_similarity", "bipartite_match", "multiclass_nms",
-           "matrix_nms", "distribute_fpn_proposals", "generate_proposals", "deform_conv2d", "psroi_pool"]
+           "matrix_nms", "distribute_fpn_proposals", "generate_proposals",
+           "deform_conv2d", "psroi_pool", "affine_channel", "correlation",
+           "read_file", "decode_jpeg"]
 
 
 def _iou_matrix(boxes_a, boxes_b, offset=0.0):
@@ -866,3 +868,97 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         return jnp.stack(rows, axis=-2)  # [R, out_c, ph, pw]
 
     return apply(f, x, boxes, boxes_num)
+
+
+def affine_channel(x, scale, bias, data_layout="NCHW"):
+    """affine_channel_op.cc: per-channel y = scale * x + bias (the frozen
+    batch-norm form detection backbones use). scale/bias are [C]."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply
+    from ..tensor.creation import _t
+
+    def f(a, s, b):
+        if data_layout == "NCHW":
+            shape = (1, -1) + (1,) * (a.ndim - 2)
+        else:
+            shape = (1,) * (a.ndim - 1) + (-1,)
+        return a * s.reshape(shape) + b.reshape(shape)
+
+    return apply(f, _t(x), _t(scale), _t(bias))
+
+
+def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1):
+    """correlation_op.cu (FlowNet cost volume): correlate each x patch with
+    y patches displaced within max_displacement, stride2 quantized.
+    x/y [B, C, H, W] -> [B, D*D, Ho, Wo] with D = 2*(max_d/stride2)+1.
+    Shift-and-multiply formulation (dense, MXU-friendly) rather than the
+    CUDA gather kernel; kernel_size>1 averages over the patch window."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply
+    from ..tensor.creation import _t
+
+    def f(a, b):
+        B, C, H, W = a.shape
+        p = pad_size
+        ap = jnp.pad(a, ((0, 0), (0, 0), (p, p), (p, p)))
+        bp = jnp.pad(b, ((0, 0), (0, 0), (p, p), (p, p)))
+        d = max_displacement // stride2
+        rad = kernel_size // 2
+        Hp, Wp = H + 2 * p, W + 2 * p
+        # output grid: centers where the full kernel + displacement fit
+        bnd = max_displacement + rad
+        ys = jnp.arange(bnd, Hp - bnd, stride1)
+        xs = jnp.arange(bnd, Wp - bnd, stride1)
+        maps = []
+        for dy in range(-d, d + 1):
+            for dx in range(-d, d + 1):
+                sy, sx = dy * stride2, dx * stride2
+                prod = ap * jnp.roll(bp, (-sy, -sx), axis=(2, 3))
+                if kernel_size > 1:
+                    k = jnp.ones((kernel_size, kernel_size)) \
+                        / (kernel_size * kernel_size)
+                    prod = jax.lax.conv_general_dilated(
+                        prod.reshape(B * C, 1, Hp, Wp),
+                        k[None, None], (1, 1), "SAME").reshape(
+                        B, C, Hp, Wp)
+                cm = prod.mean(axis=1)  # mean over channels (corr norm)
+                maps.append(cm[:, ys][:, :, xs])
+        return jnp.stack(maps, axis=1)
+
+    import jax
+    return apply(f, _t(x), _t(y))
+
+
+def read_file(filename, name=None):
+    """read_file_op.cc: read a file's raw bytes as a uint8 1-D tensor."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    with open(filename, "rb") as fh:
+        return Tensor(np.frombuffer(fh.read(), dtype=np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """decode_jpeg_op.cu: decode an encoded-JPEG uint8 tensor to [C, H, W]
+    uint8. Host-side PIL decode (nvjpeg is CUDA-era; image decode is input
+    pipeline work that belongs on host ahead of the TPU feed)."""
+    import io as _io
+    import numpy as np
+    from ..core.tensor import Tensor
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("decode_jpeg needs Pillow on the host") from e
+    raw = np.asarray(x.data if isinstance(x, Tensor) else x,
+                     dtype=np.uint8).tobytes()
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(np.ascontiguousarray(arr))

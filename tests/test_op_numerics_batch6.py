@@ -1,0 +1,477 @@
+"""Op-zoo batch 6: contrib/rec-sys tail, pooling masks + unpool, segment
+pooling, metrics ops, static side-effect ops, vision stragglers.
+
+Reference anchors per op are in the implementation docstrings
+(operators/*_op.cc); numeric cross-checks use torch where it implements the
+same contract (max_pool indices / unpool), numpy re-derivations elsewhere.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate as I
+import paddle_tpu.metric as M
+import paddle_tpu.nn.functional as F
+import paddle_tpu.static as S
+import paddle_tpu.vision.ops as V
+
+tt = paddle.to_tensor
+
+
+@pytest.fixture()
+def rng():
+    return np.random.RandomState(0)
+
+
+class TestPoolMaskUnpool:
+    def test_max_pool2d_mask_matches_torch(self, rng):
+        x = rng.randn(2, 3, 8, 10).astype(np.float32)
+        out, mask = F.max_pool2d(tt(x), kernel_size=2, stride=2,
+                                 return_mask=True)
+        to, tm = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 2, 2, return_indices=True)
+        np.testing.assert_allclose(np.asarray(out.data), to.numpy(),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(mask.data), tm.numpy())
+
+    def test_max_pool2d_mask_padded(self, rng):
+        x = rng.randn(2, 3, 8, 10).astype(np.float32)
+        out, mask = F.max_pool2d(tt(x), 3, 2, 1, return_mask=True)
+        to, tm = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 3, 2, 1, return_indices=True)
+        np.testing.assert_allclose(np.asarray(out.data), to.numpy(),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(mask.data), tm.numpy())
+
+    def test_max_unpool2d_roundtrip(self, rng):
+        x = rng.randn(2, 3, 8, 10).astype(np.float32)
+        out, mask = F.max_pool2d(tt(x), 2, 2, return_mask=True)
+        up = F.max_unpool2d(out, mask, 2, 2)
+        to, tm = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 2, 2, return_indices=True)
+        tu = torch.nn.functional.max_unpool2d(to, tm, 2, 2)
+        np.testing.assert_allclose(np.asarray(up.data), tu.numpy(),
+                                   rtol=1e-6)
+
+    def test_max_unpool2d_output_size(self, rng):
+        x = rng.randn(2, 3, 8, 10).astype(np.float32)
+        out, mask = F.max_pool2d(tt(x), 3, 2, 1, return_mask=True)
+        up = F.max_unpool2d(out, mask, 3, 2, 1, output_size=[8, 10])
+        to, tm = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 3, 2, 1, return_indices=True)
+        tu = torch.nn.functional.max_unpool2d(to, tm, 3, 2, 1,
+                                              output_size=(8, 10))
+        np.testing.assert_allclose(np.asarray(up.data), tu.numpy(),
+                                   rtol=1e-6)
+
+    def test_max_pool1d_3d_masks(self, rng):
+        x1 = rng.randn(2, 3, 11).astype(np.float32)
+        o1, m1 = F.max_pool1d(tt(x1), 3, 2, 1, return_mask=True)
+        t1, ti1 = torch.nn.functional.max_pool1d(
+            torch.tensor(x1), 3, 2, 1, return_indices=True)
+        np.testing.assert_allclose(np.asarray(o1.data), t1.numpy(),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(m1.data), ti1.numpy())
+        x3 = rng.randn(1, 2, 6, 6, 6).astype(np.float32)
+        o3, m3 = F.max_pool3d(tt(x3), 2, 2, return_mask=True)
+        t3, ti3 = torch.nn.functional.max_pool3d(
+            torch.tensor(x3), 2, 2, return_indices=True)
+        np.testing.assert_allclose(np.asarray(o3.data), t3.numpy(),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(m3.data), ti3.numpy())
+
+    def test_unpool_grad_flows(self, rng):
+        x = tt(rng.randn(2, 3, 8, 10).astype(np.float32))
+        x.stop_gradient = False
+        o, m = F.max_pool2d(x, 2, 2, return_mask=True)
+        F.max_unpool2d(o, m, 2, 2).sum().backward()
+        g = np.asarray(x.grad.data)
+        assert np.isfinite(g).all()
+        # exactly one cell per 2x2 window received gradient 1
+        assert g.sum() == 2 * 3 * 4 * 5
+
+
+class TestSegmentOps:
+    def test_modes(self):
+        data = tt(np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32))
+        seg = tt(np.array([0, 0, 1]))
+        np.testing.assert_allclose(
+            np.asarray(I.segment_sum(data, seg).data), [[4, 6], [5, 6]])
+        np.testing.assert_allclose(
+            np.asarray(I.segment_mean(data, seg).data), [[2, 3], [5, 6]])
+        np.testing.assert_allclose(
+            np.asarray(I.segment_max(data, seg).data), [[3, 4], [5, 6]])
+        np.testing.assert_allclose(
+            np.asarray(I.segment_min(data, seg).data), [[1, 2], [5, 6]])
+
+    def test_grad(self, rng):
+        x = tt(rng.randn(4, 3).astype(np.float32))
+        x.stop_gradient = False
+        I.segment_sum(x, tt(np.array([0, 1, 1, 0]))).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.data),
+                                   np.ones((4, 3)))
+
+
+class TestContribOps:
+    def test_partial_concat_sum(self, rng):
+        a = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(3, 4).astype(np.float32)
+        pc = I.partial_concat([tt(a), tt(b)], 1, 2)
+        np.testing.assert_allclose(
+            np.asarray(pc.data),
+            np.concatenate([a[:, 1:3], b[:, 1:3]], 1))
+        ps = I.partial_sum([tt(a), tt(b)], 1, 2)
+        np.testing.assert_allclose(np.asarray(ps.data),
+                                   a[:, 1:3] + b[:, 1:3], rtol=1e-6)
+
+    def test_batch_fc(self, rng):
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        w = rng.randn(2, 4, 5).astype(np.float32)
+        b = rng.randn(2, 1, 5).astype(np.float32)
+        out = I.batch_fc(tt(x), tt(w), tt(b))
+        np.testing.assert_allclose(
+            np.asarray(out.data),
+            np.einsum("sbi,sio->sbo", x, w) + b, rtol=1e-5)
+
+    def test_conv_shift_circular(self, rng):
+        x = rng.randn(2, 7).astype(np.float32)
+        y = rng.randn(2, 3).astype(np.float32)
+        got = np.asarray(I.conv_shift(tt(x), tt(y)).data)
+        ref = np.zeros_like(x)
+        for bi in range(2):
+            for i in range(7):
+                for j in range(-1, 2):
+                    ref[bi, i] += x[bi, (i + j) % 7] * y[bi, j + 1]
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_shuffle_batch_invertible(self):
+        x = np.arange(12).reshape(4, 3).astype(np.float32)
+        out, idx = I.shuffle_batch(tt(x), seed=1)
+        perm = np.asarray(idx.data)
+        np.testing.assert_allclose(np.asarray(out.data), x[perm])
+
+    def test_filter_by_instag(self, rng):
+        ins = tt(rng.randn(4, 3).astype(np.float32))
+        out, lw, imap = I.filter_by_instag(
+            ins, tt(np.array([1, 2, 1, 3])), tt(np.array([1])))
+        assert np.asarray(out.data).shape == (2, 3)
+        np.testing.assert_array_equal(np.asarray(imap.data)[:, 1], [0, 2])
+
+    def test_match_matrix_tensor(self, rng):
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        y = rng.randn(2, 5, 6).astype(np.float32)
+        w = rng.randn(4, 2, 6).astype(np.float32)
+        mm = I.match_matrix_tensor(tt(x), tt(y), tt(w))
+        np.testing.assert_allclose(
+            np.asarray(mm.data),
+            np.einsum("bxi,itj,byj->btxy", x, w, y), rtol=1e-5,
+            atol=1e-6)
+
+    def test_teacher_student_loss(self):
+        # label -2: clk 0 no teacher; 0.7: clk 0 teacher z'=0.7
+        x = np.array([0.5, -0.3], np.float32)
+        y = np.array([-2.0, 0.7], np.float32)
+        got = np.asarray(I.teacher_student_sigmoid_loss(tt(x), tt(y)).data)
+
+        def ll(v, z):
+            return max(v, 0) - v * z + np.log1p(np.exp(-abs(v)))
+        exp = np.array([[ll(0.5, 0.0)],
+                        [ll(-0.3, 0.0) + ll(-0.3, 0.7)]])
+        np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+    def test_sample_logits_shapes(self, rng):
+        sl, lab = I.sample_logits(
+            tt(rng.randn(3, 10).astype(np.float32)),
+            tt(np.array([[1], [2], [3]])), 5)
+        assert np.asarray(sl.data).shape == (3, 6)
+        np.testing.assert_array_equal(np.asarray(lab.data)[:, 0], [1, 1, 1])
+
+    def test_tdm_child(self):
+        info = np.zeros((7, 5), np.int64)
+        info[1] = [0, 0, 0, 2, 3]
+        info[2] = [0, 1, 1, 4, 5]
+        info[3] = [7, 1, 1, 0, 0]
+        info[4] = [9, 2, 2, 0, 0]
+        info[5] = [8, 2, 2, 0, 0]
+        kids, leaf = I.tdm_child(tt(np.array([[1], [2]])), 7, 2, tt(info))
+        np.testing.assert_array_equal(np.asarray(kids.data)[0, 0], [2, 3])
+        np.testing.assert_array_equal(np.asarray(leaf.data)[1, 0], [1, 1])
+
+    def test_tdm_sampler(self):
+        travel = np.array([[0, 0], [1, 3], [1, 4], [2, 5]], np.int64)
+        layer = np.array([[1, 2], [3, 4]], np.int64)
+        out, lab, mask = I.tdm_sampler(
+            tt(np.array([1, 2])), [1, 1], [2, 2], 4, tt(travel), tt(layer))
+        o = np.asarray(out.data)
+        assert o.shape == (2, 4)
+        assert o[0, 0] == 1 and o[0, 2] == 3  # positives on the path
+        lb = np.asarray(lab.data)
+        np.testing.assert_array_equal(lb[:, 0], [1, 1])
+
+    def test_rank_attention_masks_invalid(self, rng):
+        x = rng.randn(2, 4).astype(np.float32)
+        p = rng.randn(3 * 3 * 4, 5).astype(np.float32)
+        off_none = np.array([[0, -1, 0, -1, 0, -1, 0]], np.int32)
+        out = I.rank_attention(tt(x[:1]), tt(off_none), tt(p), max_rank=3)
+        np.testing.assert_allclose(np.asarray(out.data), np.zeros((1, 5)))
+        off_one = np.array([[0, 1, 0, -1, 0, -1, 0]], np.int32)
+        got = np.asarray(I.rank_attention(tt(x[:1]), tt(off_one), tt(p),
+                                          max_rank=3).data)
+        blocks = p.reshape(3, 3, 4, 5)
+        np.testing.assert_allclose(got, x[:1] @ blocks[0, 1], rtol=1e-5)
+
+    def test_tree_conv_shape(self, rng):
+        tc = I.tree_conv(
+            tt(rng.randn(1, 5, 4).astype(np.float32)),
+            tt(np.array([[[0, 1], [0, 2], [1, 3], [1, 4], [0, 0]]],
+                        np.int32)),
+            tt(rng.randn(4, 3, 6, 2).astype(np.float32)))
+        assert tc.shape == [1, 5, 6, 2]
+        assert np.isfinite(np.asarray(tc.data)).all()
+
+    def test_pyramid_hash_and_hash(self, rng):
+        param = tt(rng.randn(50, 16).astype(np.float32))
+        ph = I.pyramid_hash(tt(rng.randint(1, 100, (2, 6))), 50, 50,
+                            param=param)
+        assert ph.shape == [2, 6, 16]
+        h = I.hash_op(tt(rng.randint(1, 100, (3, 4))), num_hash=2)
+        a = np.asarray(h.data)
+        assert a.shape == (3, 2) and (a >= 0).all()
+
+    def test_coalesce_tensor_views(self, rng):
+        a = rng.randn(3, 3).astype(np.float32)
+        b = rng.randn(5).astype(np.float32)
+        outs, fused = I.coalesce_tensor([tt(a), tt(b)])
+        np.testing.assert_allclose(np.asarray(outs[0].data), a)
+        np.testing.assert_allclose(np.asarray(outs[1].data), b)
+        assert np.asarray(fused.data).shape[0] == 512  # 256-aligned chunks
+
+    def test_bilateral_slice_constant_grid(self, rng):
+        # identity affine grid (scale 1, offset 0 rows) -> output == input
+        B, C, H, W = 1, 2, 6, 6
+        grid = np.zeros((B, C * (C + 1), 4, 4, 4), np.float32)
+        # affine matrix rows: out_c = sum_in A[c, in] * x_in + A[c, C]
+        A = grid.reshape(B, C, C + 1, 4, 4, 4)
+        for c_ in range(C):
+            A[:, c_, c_] = 1.0
+        x = rng.rand(B, C, H, W).astype(np.float32)
+        guide = rng.rand(B, H, W).astype(np.float32)
+        out = I.bilateral_slice(tt(x), tt(guide), tt(grid), has_offset=True)
+        np.testing.assert_allclose(np.asarray(out.data), x, atol=1e-5)
+
+    def test_var_conv_2d_masks(self, rng):
+        vc = I.var_conv_2d(
+            tt(rng.randn(2, 3, 6, 6).astype(np.float32)),
+            tt(np.array([4, 6])), tt(np.array([5, 6])),
+            tt(rng.randn(4, 3, 3, 3).astype(np.float32)), 3, 4, 3)
+        v = np.asarray(vc.data)
+        assert v.shape == (2, 4, 6, 6)
+        assert np.allclose(v[0, :, 4:, :], 0)
+        assert np.allclose(v[0, :, :, 5:], 0)
+
+    def test_similarity_focus_mask(self, rng):
+        sf = I.similarity_focus(
+            tt(rng.randn(2, 3, 4, 5).astype(np.float32)), 1, [0, 2])
+        m = np.asarray(sf.data)
+        assert m.shape == (2, 3, 4, 5)
+        assert set(np.unique(m)).issubset({0.0, 1.0})
+        # each selected channel contributes min(H, W)=4 cells; union <= 8
+        assert 4 <= m[0, 0].sum() <= 8
+
+    def test_attention_lstm(self, rng):
+        h, c = I.attention_lstm(
+            tt(rng.randn(2, 5, 3).astype(np.float32)),
+            tt(rng.randn(7, 1).astype(np.float32)),
+            tt(rng.randn(7, 16).astype(np.float32)),
+            tt(rng.randn(16).astype(np.float32)))
+        assert h.shape == [2, 5, 4] and c.shape == [2, 5, 4]
+        assert np.isfinite(np.asarray(h.data)).all()
+
+    def test_grads_flow(self, rng):
+        x = tt(rng.randn(2, 7).astype(np.float32))
+        x.stop_gradient = False
+        y = tt(rng.randn(2, 3).astype(np.float32))
+        I.conv_shift(x, y).sum().backward()
+        assert np.isfinite(np.asarray(x.grad.data)).all()
+
+
+class TestMetricsOps:
+    def test_mean_iou(self):
+        mi, wrong, correct = M.mean_iou(
+            tt(np.array([[0, 1], [2, 1]])), tt(np.array([[0, 1], [1, 1]])),
+            3)
+        np.testing.assert_allclose(float(mi.item()), (1 + 2 / 3 + 0) / 3,
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(correct.data), [1, 2, 0])
+
+    def test_positive_negative_pair(self):
+        pos, neg, neu = M.positive_negative_pair(
+            tt(np.array([0.9, 0.5, 0.3, 0.7], np.float32)),
+            tt(np.array([1, 0, 0, 1])), tt(np.array([0, 0, 1, 1])))
+        assert (float(pos.item()), float(neg.item()),
+                float(neu.item())) == (2.0, 0.0, 0.0)
+        pos, neg, neu = M.positive_negative_pair(
+            tt(np.array([0.2, 0.5, 0.4, 0.4], np.float32)),
+            tt(np.array([1, 0, 2, 1])), tt(np.array([0, 0, 1, 1])))
+        assert (float(pos.item()), float(neg.item()),
+                float(neu.item())) == (0.0, 1.0, 1.0)
+
+    def test_detection_map(self):
+        det = np.array([[0, 1, 0.9, 0, 0, 10, 10],
+                        [0, 1, 0.8, 20, 20, 30, 30]], np.float32)
+        gt = np.array([[0, 1, 0, 0, 10, 10]], np.float32)
+        mp = M.detection_map(tt(det), tt(gt), 2, background_label=0)
+        np.testing.assert_allclose(float(mp.item()), 1.0)
+        # a miss halves precision at the tail but AP stays 1.0 only when
+        # the hit ranks first; reversing scores drops it
+        det2 = det.copy()
+        det2[:, 2] = [0.8, 0.9]  # false positive now ranks first
+        mp2 = M.detection_map(tt(det2), tt(gt), 2, background_label=0)
+        assert float(mp2.item()) == 0.5
+
+
+class TestStaticOps:
+    def test_fc(self, rng):
+        out = S.nn.fc(tt(rng.randn(3, 4, 5).astype(np.float32)), 7)
+        assert out.shape == [3, 7]
+
+    def test_fill_constant_batch_size_like(self, rng):
+        out = S.nn.fill_constant_batch_size_like(
+            tt(rng.randn(6, 2).astype(np.float32)), [1, 9], "float32", 3.0)
+        assert out.shape == [6, 9]
+        assert np.allclose(np.asarray(out.data), 3.0)
+
+    def test_print_passthrough(self, capfd):
+        x = tt(np.array([1.0, 2.0], np.float32))
+        out = S.Print(x, message="dbg")
+        np.testing.assert_allclose(np.asarray(out.data), [1.0, 2.0])
+
+    def test_assert(self):
+        S.Assert(tt(np.array(True)))
+        with pytest.raises(ValueError):
+            S.Assert(tt(np.array(False)), data=[tt(np.array([1.0]))])
+
+    def test_py_func(self):
+        out = S.py_func(lambda a: a * a,
+                        tt(np.array([2.0, 3.0], np.float32)),
+                        np.zeros(2, np.float32))
+        np.testing.assert_allclose(np.asarray(out.data), [4.0, 9.0])
+
+    def test_py_func_backward(self):
+        x = tt(np.array([2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        out = S.py_func(lambda a: a * a, x, np.zeros(2, np.float32),
+                        backward_func=lambda a, g: 2.0 * a * g)
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.data), [4.0, 6.0])
+
+    def test_nce(self, rng):
+        loss = S.nn.nce(
+            tt(rng.randn(4, 6).astype(np.float32)),
+            tt(np.array([[1], [2], [0], [3]])), 10,
+            tt(rng.randn(10, 6).astype(np.float32)),
+            tt(rng.randn(10).astype(np.float32)), num_neg_samples=4)
+        a = np.asarray(loss.data)
+        assert a.shape == (4, 1) and np.isfinite(a).all() and (a > 0).all()
+
+
+class TestVisionBatch6:
+    def test_affine_channel(self, rng):
+        x = rng.randn(2, 3, 4, 4).astype(np.float32)
+        s = np.array([1., 2., 3.], np.float32)
+        b = np.array([0., 1., 0.], np.float32)
+        out = V.affine_channel(tt(x), tt(s), tt(b))
+        np.testing.assert_allclose(
+            np.asarray(out.data),
+            x * s[None, :, None, None] + b[None, :, None, None], rtol=1e-6)
+
+    def test_correlation_self_is_norm(self, rng):
+        x = rng.randn(1, 2, 8, 8).astype(np.float32)
+        out = np.asarray(V.correlation(
+            tt(x), tt(x), pad_size=1, kernel_size=1, max_displacement=1,
+            stride1=1, stride2=1).data)
+        assert out.shape[1] == 9
+        # zero-displacement channel (index 4) is mean over C of x*x
+        center = out[:, 4]
+        exp = (x * x).mean(axis=1)
+        np.testing.assert_allclose(center, exp, rtol=1e-5)
+
+    def test_read_file_roundtrip(self, tmp_path):
+        p = tmp_path / "blob.bin"
+        payload = bytes(range(17))
+        p.write_bytes(payload)
+        t = V.read_file(str(p))
+        np.testing.assert_array_equal(np.asarray(t.data),
+                                      np.frombuffer(payload, np.uint8))
+
+    def test_decode_jpeg(self, tmp_path):
+        pil = pytest.importorskip("PIL.Image")
+        import io as _io
+        img = pil.fromarray(
+            (np.arange(64 * 64 * 3) % 255).reshape(64, 64, 3).astype(
+                np.uint8))
+        buf = _io.BytesIO()
+        img.save(buf, format="JPEG")
+        raw = np.frombuffer(buf.getvalue(), np.uint8)
+        out = V.decode_jpeg(tt(raw), mode="rgb")
+        assert np.asarray(out.data).shape == (3, 64, 64)
+
+
+class TestReviewFixes:
+    """Regressions for the batch-6 review findings."""
+
+    def test_partial_ops_negative_start(self, rng):
+        a = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(3, 4).astype(np.float32)
+        pc = I.partial_concat([tt(a), tt(b)], start_index=-1, length=1)
+        np.testing.assert_allclose(
+            np.asarray(pc.data),
+            np.concatenate([a[:, -1:], b[:, -1:]], 1))
+        ps = I.partial_sum([tt(a), tt(b)], start_index=-1, length=1)
+        np.testing.assert_allclose(np.asarray(ps.data),
+                                   a[:, -1:] + b[:, -1:], rtol=1e-6)
+
+    def test_sample_logits_consistent_correction(self, rng):
+        # with uniform q every corrected column shifts by the same
+        # -log(num_samples/K); softmax over columns is then EXACTLY the
+        # softmax of the raw (true, sampled) logits
+        x = rng.randn(2, 8).astype(np.float32)
+        sl, _ = I.sample_logits(tt(x), tt(np.array([[1], [2]])), 4,
+                                remove_accidental_hits=False, seed=3)
+        got = np.asarray(sl.data)
+        shift = np.log(4 / 8)
+        assert np.allclose(got[0, 0], x[0, 1] - shift, atol=1e-5)
+        assert np.allclose(got[1, 0], x[1, 2] - shift, atol=1e-5)
+
+    def test_segment_max_empty_segment_is_zero(self):
+        data = tt(np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32))
+        seg = tt(np.array([0, 0, 2]))  # segment 1 empty
+        m = np.asarray(I.segment_max(data, seg).data)
+        np.testing.assert_allclose(m[1], [0.0, 0.0])
+        mn = np.asarray(I.segment_min(data, seg).data)
+        np.testing.assert_allclose(mn[1], [0.0, 0.0])
+
+    def test_fc_fresh_vs_named(self, rng):
+        x = tt(rng.randn(2, 6).astype(np.float32))
+        a = S.nn.fc(x, 4)
+        b = S.nn.fc(x, 4)  # anonymous: independent weights
+        assert not np.allclose(np.asarray(a.data), np.asarray(b.data))
+        c1 = S.nn.fc(x, 4, name="shared")
+        c2 = S.nn.fc(x, 4, name="shared")  # named: same weights
+        np.testing.assert_allclose(np.asarray(c1.data),
+                                   np.asarray(c2.data))
+
+    def test_print_braces_and_first_n(self, capfd):
+        x = tt(np.array([1.0], np.float32))
+        S.Print(x, message="step {i} loss", first_n=1)
+        S.Print(x, message="never shown", first_n=0)
+        out = capfd.readouterr().out
+        assert "step {i} loss" in out
+        assert "never shown" not in out
+
+    def test_unpool_string_padding_rejected(self, rng):
+        x = tt(rng.randn(1, 1, 4, 4).astype(np.float32))
+        o, m = F.max_pool2d(x, 2, 2, return_mask=True)
+        with pytest.raises(ValueError):
+            F.max_unpool2d(o, m, 2, 2, padding="SAME")
