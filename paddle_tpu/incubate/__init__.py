@@ -13,6 +13,12 @@ from .contrib_ops import (  # noqa: F401
     teacher_student_sigmoid_loss, tree_conv, var_conv_2d)
 from .segment_ops import (  # noqa: F401
     segment_max, segment_mean, segment_min, segment_sum)
+from .fused_ops import (  # noqa: F401
+    fused_elemwise_activation, fused_embedding_fc_lstm,
+    fused_embedding_seq_pool, fused_fc_elementwise_layernorm,
+    fusion_repeated_fc_relu, fusion_seqconv_eltadd_relu,
+    fusion_seqpool_concat, fusion_seqpool_cvm_concat,
+    fusion_squared_mat_sub, multihead_matmul, skip_layernorm)
 
 
 def softmax_mask_fuse_upper_triangle(x):

@@ -1,0 +1,262 @@
+"""Fused-op API surface (reference operators/fused/*): the reference's IR
+fusion passes materialize these as single kernels; on TPU, XLA fusion does
+the same job automatically, so each op here is the fused contract expressed
+as composed jnp — one jit region, fused by the compiler, numerically equal
+to running the composition unfused. Kept as API parity for models/exporters
+that call the fused names directly."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import apply
+from ..tensor.creation import _t
+
+__all__ = [
+    "fused_elemwise_activation", "fused_embedding_seq_pool",
+    "fused_fc_elementwise_layernorm", "fusion_repeated_fc_relu",
+    "fusion_seqconv_eltadd_relu", "fusion_seqpool_concat",
+    "fusion_seqpool_cvm_concat", "fusion_squared_mat_sub",
+    "multihead_matmul", "skip_layernorm", "fused_embedding_fc_lstm",
+    "sequence_conv",
+]
+
+_UNARY = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "scale": lambda x: x,
+    "identity": lambda x: x,
+}
+_BINARY = {
+    "elementwise_add": jnp.add,
+    "elementwise_sub": jnp.subtract,
+    "elementwise_mul": jnp.multiply,
+}
+
+
+def fused_elemwise_activation(x, y, functor_list):
+    """fused_elemwise_activation_op.h RunFunctors: the FIRST functor is the
+    OUTER op — ["elementwise_add", "unary"] -> Binary(x, Unary(y)) and
+    ["unary", "elementwise_add"] -> Unary(Binary(x, y))."""
+    f0, f1 = functor_list
+
+    def f(a, b):
+        if f0 in _BINARY:
+            return _BINARY[f0](a, _UNARY[f1](b))
+        return _UNARY[f0](_BINARY[f1](a, b))
+
+    return apply(f, _t(x), _t(y))
+
+
+def fused_embedding_seq_pool(table, ids, combiner="sum"):
+    """fused_embedding_seq_pool_op.cc: embedding lookup + sequence pool in
+    one pass. Dense analog: ids [B, L] -> pooled [B, D]."""
+    def f(w, i):
+        emb = w[i.astype(jnp.int32)]
+        if combiner == "sum":
+            return jnp.sum(emb, axis=1)
+        if combiner == "mean":
+            return jnp.mean(emb, axis=1)
+        raise ValueError(f"combiner {combiner!r}")
+
+    return apply(f, _t(table), _t(ids))
+
+
+def _layer_norm(h, scale, bias, eps):
+    mu = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(h - mu), axis=-1, keepdims=True)
+    return (h - mu) / jnp.sqrt(var + eps) * scale + bias
+
+
+def fused_fc_elementwise_layernorm(x, w, y, scale, bias, fc_bias=None,
+                                   epsilon=1e-5):
+    """fused_fc_elementwise_layernorm_op.cc: layer_norm(fc(x) + y)."""
+    def f(a, w_, y_, s, b, fb):
+        h = a @ w_
+        if fb is not None:
+            h = h + fb
+        return _layer_norm(h + y_, s, b, epsilon)
+
+    from .fused_rnn import _apply_with_optional
+    return _apply_with_optional(f, (x, w, y, scale, bias),
+                                [("fb", fc_bias)])
+
+
+def fusion_repeated_fc_relu(x, weights, biases):
+    """fusion_repeated_fc_relu_op.cc: a chain of FC+relu layers in one
+    fused region."""
+    n = len(weights)
+
+    def f(a, *wb):
+        ws, bs = wb[:n], wb[n:]
+        h = a
+        for w_, b_ in zip(ws, bs):
+            h = jax.nn.relu(h @ w_ + b_)
+        return h
+
+    return apply(f, _t(x), *[_t(w) for w in weights],
+                 *[_t(b) for b in biases])
+
+
+def sequence_conv(x, filter, context_length, context_start=None,
+                  padding_data=None, bias=None, stride=1):
+    """sequence_conv_op.cc (+ math/context_project.h): slide a context
+    window of context_length frames (starting at context_start, default
+    -context_length//2) over the time dim, concatenate the window's frames
+    feature-wise, and project by filter [context_length*D, O]. Out-of-range
+    frames read zeros. Dense analog of the LoD op: x [B, T, D] ->
+    [B, T, O]."""
+    if stride != 1:
+        raise NotImplementedError(
+            "sequence_conv: stride must be 1 (the reference op enforces "
+            "the same, sequence_conv_op.cc contextStride)")
+    if padding_data is not None:
+        raise NotImplementedError(
+            "sequence_conv: trainable padding_data rows are not "
+            "implemented; out-of-range frames read zeros")
+    if context_start is None:
+        context_start = -(context_length // 2)
+
+    def f(a, w, b):
+        B, T, D = a.shape
+        cols = []
+        for k in range(context_length):
+            off = context_start + k
+            shifted = jnp.roll(a, -off, axis=1)
+            t_idx = jnp.arange(T) + off
+            valid = ((t_idx >= 0) & (t_idx < T))[None, :, None]
+            cols.append(jnp.where(valid, shifted, 0.0))
+        ctx = jnp.concatenate(cols, axis=-1)  # [B, T, K*D]
+        out = ctx @ w
+        if b is not None:
+            out = out + b
+        return out
+
+    from .fused_rnn import _apply_with_optional
+    return _apply_with_optional(f, (x, filter), [("b", bias)])
+
+
+def fusion_seqconv_eltadd_relu(x, filter, bias, context_length,
+                               context_start=0):
+    """fusion_seqconv_eltadd_relu_op.cc: relu(sequence_conv(x) + bias)."""
+    def f(a, w, b):
+        B, T, D = a.shape
+        cols = []
+        for k in range(context_length):
+            off = context_start + k
+            shifted = jnp.roll(a, -off, axis=1)
+            t_idx = jnp.arange(T) + off
+            valid = ((t_idx >= 0) & (t_idx < T))[None, :, None]
+            cols.append(jnp.where(valid, shifted, 0.0))
+        ctx = jnp.concatenate(cols, axis=-1)
+        return jax.nn.relu(ctx @ w + b)
+
+    return apply(f, _t(x), _t(filter), _t(bias))
+
+
+def _seq_pool(a, pooltype):
+    if pooltype == "SUM":
+        return jnp.sum(a, axis=1)
+    if pooltype == "AVERAGE":
+        return jnp.mean(a, axis=1)
+    if pooltype == "MAX":
+        return jnp.max(a, axis=1)
+    if pooltype == "SQRT":
+        return jnp.sum(a, axis=1) / jnp.sqrt(jnp.asarray(a.shape[1],
+                                                         a.dtype))
+    if pooltype == "LAST":
+        return a[:, -1]
+    if pooltype == "FIRST":
+        return a[:, 0]
+    raise ValueError(f"pooltype {pooltype!r}")
+
+
+def fusion_seqpool_concat(xs, pooltype="SUM"):
+    """fusion_seqpool_concat_op.cc: pool each sequence input ([B, T, D])
+    over time and concat the pooled vectors feature-wise."""
+    def f(*arrs):
+        return jnp.concatenate([_seq_pool(a, pooltype) for a in arrs],
+                               axis=-1)
+
+    return apply(f, *[_t(a) for a in xs])
+
+
+def fusion_seqpool_cvm_concat(xs, use_cvm=True, pooltype="SUM"):
+    """fusion_seqpool_cvm_concat_op.cc: seqpool + cvm + concat (the CTR
+    triple-fusion; see contrib_ops.cvm for the counter-column rewrite)."""
+    def f(*arrs):
+        outs = []
+        for a in arrs:
+            p = _seq_pool(a, pooltype)
+            show = jnp.log(p[:, 0:1] + 1.0)
+            click = jnp.log(p[:, 1:2] + 1.0) - show
+            if use_cvm:
+                p = jnp.concatenate([show, click, p[:, 2:]], axis=1)
+            else:
+                p = p[:, 2:]
+            outs.append(p)
+        return jnp.concatenate(outs, axis=-1)
+
+    return apply(f, *[_t(a) for a in xs])
+
+
+def fusion_squared_mat_sub(x, y, scalar=1.0):
+    """fusion_squared_mat_sub_op.cc: scalar * ((x@y)^2 - (x^2)@(y^2)) —
+    the pairwise-feature interaction trick (FM models)."""
+    def f(a, b):
+        ab = a @ b
+        return scalar * (ab * ab - (a * a) @ (b * b))
+
+    return apply(f, _t(x), _t(y))
+
+
+def multihead_matmul(input, w, bias, bias_qk=None, head_number=1,
+                     alpha=None):
+    """multihead_matmul_op.cc (BERT encoder fusion): one packed QKV
+    projection + scaled-dot-product attention + context reshape.
+    input [B, S, H]; w [H, 3, N, H/N]; bias [3, N, H/N];
+    bias_qk broadcastable to [B, N, S, S]. alpha defaults to
+    1/sqrt(H/N)."""
+    def f(a, w_, b_, bqk):
+        B, S, H = a.shape
+        N = head_number
+        hd = H // N
+        qkv = jnp.einsum("bsh,htnd->btnsd", a, w_.reshape(H, 3, N, hd))
+        qkv = qkv + b_.reshape(3, N, 1, hd)[None]
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B, N, S, hd]
+        scale = alpha if alpha is not None else 1.0 / jnp.sqrt(
+            jnp.asarray(hd, a.dtype))
+        logits = jnp.einsum("bnsd,bntd->bnst", q, k) * scale
+        if bqk is not None:
+            logits = logits + bqk
+        attn = jax.nn.softmax(logits, axis=-1)
+        ctx = jnp.einsum("bnst,bntd->bnsd", attn, v)
+        return ctx.transpose(0, 2, 1, 3).reshape(B, S, H)
+
+    from .fused_rnn import _apply_with_optional
+    return _apply_with_optional(f, (input, w, bias), [("bqk", bias_qk)])
+
+
+def skip_layernorm(x, y, scale, bias, epsilon=1e-5):
+    """skip_layernorm_op.cc: layer_norm(x + y) — the residual-add+LN
+    fusion."""
+    def f(a, b, s, bb):
+        return _layer_norm(a + b, s, bb, epsilon)
+
+    return apply(f, _t(x), _t(y), _t(scale), _t(bias))
+
+
+def fused_embedding_fc_lstm(ids, embeddings, weight_h, bias, h0=None,
+                            c0=None, is_reverse=False,
+                            use_peepholes=False):
+    """fused_embedding_fc_lstm_op.cc: embedding lookup whose table already
+    contains the x->gates FC folded in (table rows are per-token gate
+    pre-activations), followed by the LSTM recurrence — lookup replaces
+    the matmul entirely. embeddings [V, 4H]; weight_h [H, 4H]."""
+    from .fused_rnn import fusion_lstm
+    emb = apply(lambda w, i: w[i.astype(jnp.int32)], _t(embeddings),
+                _t(ids))  # [B, T, 4H] pre-activations
+    # weight_x=None: the lookup already folded the FC — no matmul at all
+    return fusion_lstm(emb, None, weight_h, bias=bias, h0=h0, c0=c0,
+                       is_reverse=is_reverse, use_peepholes=use_peepholes)

@@ -100,12 +100,16 @@ def fusion_lstm(x, weight_x, weight_h, bias=None, h0=None, c0=None,
     """Fused LSTM over a dense batch. x [B, T, I]; weight_x [I, 4H];
     weight_h [H, 4H] (gate layout {c, i, f, o}); bias [4H] or [7H] with
     peepholes (checkI/checkF/checkO appended, lstm_kernel.h:37-49).
-    Returns (hidden [B, T, H], cell [B, T, H])."""
+    Returns (hidden [B, T, H], cell [B, T, H]).
+
+    weight_x=None means x already holds the [B, T, 4H] gate
+    pre-activations (fused_embedding_fc_lstm's lookup-folded table) and
+    the input projection is skipped entirely."""
     act = _ACT[activation]          # candidate activation
     gate_act = _ACT[gate_activation]
     cell_act = _ACT[cell_activation]
 
-    def f(xa, wx, wh, b, h_init, c_init):
+    def f(xa, wh, wx, b, h_init, c_init):
         B, T, _ = xa.shape
         H = wh.shape[0]
         gate_bias = None
@@ -119,7 +123,7 @@ def fusion_lstm(x, weight_x, weight_h, bias=None, h0=None, c0=None,
             raise ValueError(
                 "fusion_lstm: use_peepholes=True requires a [7H] bias "
                 "carrying checkI/checkF/checkO (fusion_lstm_op.cc:186)")
-        xp = jnp.einsum("bti,ig->btg", xa, wx)
+        xp = xa if wx is None else jnp.einsum("bti,ig->btg", xa, wx)
         if gate_bias is not None:
             xp = xp + gate_bias
         xs = jnp.swapaxes(xp, 0, 1)
@@ -155,8 +159,9 @@ def fusion_lstm(x, weight_x, weight_h, bias=None, h0=None, c0=None,
             hs, cs = jnp.flip(hs, 0), jnp.flip(cs, 0)
         return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
 
-    return _apply_with_optional(f, (x, weight_x, weight_h),
-                                [("b", bias), ("h", h0), ("c", c0)])
+    return _apply_with_optional(
+        f, (x, weight_h),
+        [("wx", weight_x), ("b", bias), ("h", h0), ("c", c0)])
 
 
 def attention_lstm(x, attention_weight, lstm_weight, lstm_bias,

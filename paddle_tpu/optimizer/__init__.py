@@ -1,3 +1,5 @@
 from . import lr  # noqa: F401
 from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,  # noqa: F401
-                        Lamb, LarsMomentum, Momentum, Optimizer, RMSProp)
+                        DecayedAdagrad, Dpsgd, Ftrl, Lamb, LarsMomentum,
+                        Momentum, Optimizer, ProximalAdagrad, ProximalGD,
+                        RMSProp)

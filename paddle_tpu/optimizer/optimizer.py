@@ -626,3 +626,138 @@ class LarsMomentum(Optimizer):
             1.0)
         v = self._momentum * slots["velocity"] + lr * local_lr * (g + wd * p32)
         return (p32 - v).astype(p.dtype), {"velocity": v}
+
+
+class DecayedAdagrad(Optimizer):
+    """Decayed Adagrad (operators/optimizers/decayed_adagrad_op.h):
+    moment = decay * moment + (1 - decay) * g^2."""
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-06,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _init_slots(self, p):
+        return {"moment": jnp.zeros(p.shape, jnp.float32)}
+
+    def _rule(self, g, p, slots, lr, wd):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if wd:
+            g = g + wd * p32
+        acc = self._decay * slots["moment"] + (1.0 - self._decay) * g * g
+        new_p = p32 - lr * g / (jnp.sqrt(acc) + self._epsilon)
+        return new_p.astype(p.dtype), {"moment": acc}
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (operators/optimizers/ftrl_op.h): accumulates squared
+    grads and the linear term, then solves the per-coordinate proximal
+    step with L1/L2 shrinkage. lr_power=-0.5 is the canonical sqrt
+    schedule (the kernel's special case)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _init_slots(self, p):
+        return {"squared": jnp.zeros(p.shape, jnp.float32),
+                "linear": jnp.zeros(p.shape, jnp.float32)}
+
+    def _rule(self, g, p, slots, lr, wd):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        sq, lin = slots["squared"], slots["linear"]
+        new_sq = sq + g * g
+        lp = -self._lr_power
+        sigma = (new_sq ** lp - sq ** lp) / lr
+        new_lin = lin + g - sigma * p32
+        x = self._l1 * jnp.sign(new_lin) - new_lin
+        y = new_sq ** lp / lr + 2.0 * self._l2
+        new_p = jnp.where(jnp.abs(new_lin) > self._l1, x / y, 0.0)
+        return new_p.astype(p.dtype), {"squared": new_sq, "linear": new_lin}
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD (operators/optimizers/dpsgd_op.h, CCS16
+    "Deep Learning with Differential Privacy"): per-parameter grad L2 clip
+    to `clip`, plus one gaussian noise draw scaled by sigma/batch_size.
+    The noise rides jax.random (folded per step) instead of the
+    reference's host minstd_rand."""
+
+    def __init__(self, learning_rate, clip=10.0, batch_size=16.0,
+                 sigma=1.0, seed=0, parameters=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._clip, self._bs, self._sigma = clip, batch_size, sigma
+        self._seed = seed
+        self._salt_counter = 0
+
+    def _init_slots(self, p):
+        # per-param salt: each parameter draws its own noise stream (the
+        # reference's per-op-instance engine), folded with the step below
+        self._salt_counter += 1
+        return {"noise_key": jnp.asarray(self._salt_counter * (1 << 16),
+                                         jnp.int32)}
+
+    def _rule(self, g, p, slots, lr, wd):
+        import jax as _jax
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        l2 = jnp.sqrt(jnp.sum(g * g))
+        scale = jnp.maximum(l2 / self._clip, 1.0)
+        key = _jax.random.fold_in(_jax.random.PRNGKey(self._seed),
+                                  slots["noise_key"])
+        # ONE scalar draw per param per step — dpsgd_op.h draws a single
+        # Box-Muller gaussian outside its element loop, same shape here
+        noise = _jax.random.normal(key, ()) * self._sigma
+        new_p = p32 - lr * (g / scale + noise / self._bs)
+        return new_p.astype(p.dtype), {
+            "noise_key": slots["noise_key"] + 1}
+
+
+class ProximalAdagrad(Optimizer):
+    """Proximal Adagrad (operators/optimizers/proximal_adagrad_op.h):
+    adagrad step followed by L1/L2 soft-threshold shrinkage."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, parameters=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._l1, self._l2 = l1, l2
+
+    def _init_slots(self, p):
+        return {"moment": jnp.zeros(p.shape, jnp.float32)}
+
+    def _rule(self, g, p, slots, lr, wd):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        acc = slots["moment"] + g * g
+        lr_t = lr / jnp.sqrt(acc)
+        prox = p32 - lr_t * g
+        new_p = jnp.sign(prox) * jnp.maximum(
+            jnp.abs(prox) - lr_t * self._l1, 0.0) / (1.0 + lr_t * self._l2)
+        return new_p.astype(p.dtype), {"moment": acc}
+
+
+class ProximalGD(Optimizer):
+    """Proximal gradient descent (operators/optimizers/proximal_gd_op.h):
+    plain SGD step then the same L1/L2 shrinkage (no accumulator)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, parameters=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._l1, self._l2 = l1, l2
+
+    def _init_slots(self, p):
+        return {}
+
+    def _rule(self, g, p, slots, lr, wd):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        prox = p32 - lr * g
+        new_p = jnp.sign(prox) * jnp.maximum(
+            jnp.abs(prox) - lr * self._l1, 0.0) / (1.0 + lr * self._l2)
+        return new_p.astype(p.dtype), {}

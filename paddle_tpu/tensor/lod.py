@@ -325,3 +325,68 @@ def sequence_scatter(x, index: LoDTensor, updates: LoDTensor):
         np.add.at(out[i], idx[a:b].astype(np.int64), upd[a:b])
     from .creation import to_tensor
     return to_tensor(out)
+
+
+def sequence_conv(x: LoDTensor, filter, context_length: int,
+                  context_start=None, bias=None):
+    """sequence_conv_op.cc (+ math/context_project.h): per sequence, slide
+    a context window of context_length frames, concatenate the window
+    feature-wise (zeros outside the sequence) and project by
+    filter [context_length*D, O]. Returns a LoDTensor with x's lod."""
+    if context_start is None:
+        context_start = -(context_length // 2)
+    d = np.asarray(x.data, np.float32)
+    w = np.asarray(filter.data if hasattr(filter, "data") else filter,
+                   np.float32)
+    b = None if bias is None else np.asarray(
+        bias.data if hasattr(bias, "data") else bias, np.float32)
+    D = d.shape[1]
+    last = x.lod[-1]
+    rows = []
+    for a, e in zip(last, last[1:]):
+        seg = d[a:e]
+        T = len(seg)
+        ctx = np.zeros((T, context_length * D), np.float32)
+        for t in range(T):
+            for k in range(context_length):
+                src = t + context_start + k
+                if 0 <= src < T:
+                    ctx[t, k * D:(k + 1) * D] = seg[src]
+        rows.append(ctx)
+    out = (np.concatenate(rows, axis=0) if rows
+           else np.zeros((0, context_length * D), np.float32)) @ w
+    if b is not None:
+        out = out + b
+    return LoDTensor(out, [list(last)])
+
+
+def sequence_topk_avg_pooling(x: LoDTensor, row_lod, col_lod, topks,
+                              channel_num: int):
+    """sequence_topk_avg_pooling_op.cc: x packs per-pair score matrices of
+    channel_num channels ([rows_i * channel_num, cols_i] blocks, the
+    match_matrix_tensor layout). For each row position and channel, sum
+    the top-k column scores and divide by k (the kernel divides by the
+    FULL k even when fewer columns exist, sequence_topk_avg_pooling_op.h:
+    164). Output layout is channel-major — per row, channel c occupies the
+    contiguous len(topks) columns [c*k_num, (c+1)*k_num) (op.h:147).
+    Returns [total_rows, channel_num * len(topks)]."""
+    d = np.asarray(x.data, np.float32)
+    k_num = len(topks)
+    outs = []
+    for (ra, rb), (ca, cb) in zip(zip(row_lod, row_lod[1:]),
+                                  zip(col_lod, col_lod[1:])):
+        n_row, n_col = rb - ra, cb - ca
+        block = d[ra * channel_num: rb * channel_num, :n_col]
+        block = block.reshape(channel_num, n_row, n_col)
+        feats = np.zeros((n_row, channel_num * k_num), np.float32)
+        srt = -np.sort(-block, axis=2)  # descending per row
+        for ki, k in enumerate(topks):
+            kk = min(k, n_col)
+            s = srt[:, :, :kk].sum(axis=2) if kk else \
+                np.zeros((channel_num, n_row), np.float32)
+            feats[:, ki::k_num] = (s / float(k)).T
+        outs.append(feats)
+    out = (np.concatenate(outs, axis=0) if outs
+           else np.zeros((0, channel_num * len(topks)), np.float32))
+    from .creation import to_tensor
+    return to_tensor(out)

@@ -1,0 +1,223 @@
+"""Fused-op API surface (operators/fused/*), sequence_conv family, and the
+optimizer tail (decayed_adagrad/ftrl/dpsgd/proximal_*). The fused ops are
+XLA-fusion-backed compositions; tests pin the numeric contract against
+numpy/torch re-derivations (see fused_ops.py docstrings for anchors)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate as I
+from paddle_tpu import optimizer as optim
+from paddle_tpu.incubate.fused_ops import sequence_conv as seq_conv_dense
+from paddle_tpu.tensor.lod import (LoDTensor, sequence_conv,
+                                   sequence_topk_avg_pooling)
+
+tt = paddle.to_tensor
+
+
+@pytest.fixture()
+def rng():
+    return np.random.RandomState(0)
+
+
+class TestFusedOps:
+    def test_fused_elemwise_activation(self, rng):
+        a = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(3, 4).astype(np.float32)
+        # first functor is the OUTER op (fused_elemwise_activation_op.h
+        # RunFunctors: binary-first => Binary(x, Unary(y)))
+        out = I.fused_elemwise_activation(tt(a), tt(b),
+                                          ["elementwise_add", "relu"])
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   a + np.maximum(b, 0))
+        out = I.fused_elemwise_activation(tt(a), tt(b),
+                                          ["relu", "elementwise_add"])
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   np.maximum(a + b, 0))
+
+    def test_fused_embedding_seq_pool(self, rng):
+        table = rng.randn(10, 4).astype(np.float32)
+        ids = rng.randint(0, 10, (2, 5))
+        out = I.fused_embedding_seq_pool(tt(table), tt(ids))
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   table[ids].sum(1), rtol=1e-6)
+
+    def test_fused_fc_elementwise_layernorm(self, rng):
+        x = rng.randn(3, 4).astype(np.float32)
+        w = rng.randn(4, 6).astype(np.float32)
+        y = rng.randn(3, 6).astype(np.float32)
+        s = rng.rand(6).astype(np.float32)
+        b = rng.randn(6).astype(np.float32)
+        out = np.asarray(I.fused_fc_elementwise_layernorm(
+            tt(x), tt(w), tt(y), tt(s), tt(b)).data)
+        h = x @ w + y
+        ref = ((h - h.mean(-1, keepdims=True))
+               / np.sqrt(h.var(-1, keepdims=True) + 1e-5) * s + b)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_fusion_repeated_fc_relu(self, rng):
+        x = rng.randn(3, 4).astype(np.float32)
+        ws = [rng.randn(4, 5).astype(np.float32),
+              rng.randn(5, 3).astype(np.float32)]
+        bs = [rng.randn(5).astype(np.float32),
+              rng.randn(3).astype(np.float32)]
+        out = np.asarray(I.fusion_repeated_fc_relu(
+            tt(x), [tt(w) for w in ws], [tt(b) for b in bs]).data)
+        ref = np.maximum(
+            np.maximum(x @ ws[0] + bs[0], 0) @ ws[1] + bs[1], 0)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_fusion_squared_mat_sub(self, rng):
+        x = rng.randn(3, 4).astype(np.float32)
+        y = rng.randn(4, 5).astype(np.float32)
+        out = np.asarray(I.fusion_squared_mat_sub(tt(x), tt(y), 0.5).data)
+        ref = 0.5 * ((x @ y) ** 2 - (x * x) @ (y * y))
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_multihead_matmul(self, rng):
+        B, S, H, N = 2, 5, 8, 2
+        inp = rng.randn(B, S, H).astype(np.float32)
+        w = rng.randn(H, 3, N, H // N).astype(np.float32)
+        bias = rng.randn(3, N, H // N).astype(np.float32)
+        out = np.asarray(I.multihead_matmul(tt(inp), tt(w), tt(bias),
+                                            head_number=N).data)
+        q = np.einsum("bsh,hnd->bnsd", inp, w[:, 0]) \
+            + bias[0][None, :, None, :]
+        k = np.einsum("bsh,hnd->bnsd", inp, w[:, 1]) \
+            + bias[1][None, :, None, :]
+        v = np.einsum("bsh,hnd->bnsd", inp, w[:, 2]) \
+            + bias[2][None, :, None, :]
+        lg = np.einsum("bnsd,bntd->bnst", q, k) / np.sqrt(H / N)
+        att = torch.softmax(torch.tensor(lg), dim=-1).numpy()
+        ref = np.einsum("bnst,bntd->bnsd", att, v).transpose(
+            0, 2, 1, 3).reshape(B, S, H)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_skip_layernorm(self, rng):
+        y = rng.randn(3, 6).astype(np.float32)
+        s = rng.rand(6).astype(np.float32)
+        b = rng.randn(6).astype(np.float32)
+        out = np.asarray(I.skip_layernorm(tt(y), tt(y), tt(s), tt(b)).data)
+        h = 2 * y
+        ref = ((h - h.mean(-1, keepdims=True))
+               / np.sqrt(h.var(-1, keepdims=True) + 1e-5) * s + b)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_embedding_fc_lstm_matches_fusion_lstm(self, rng):
+        V, H = 7, 3
+        table = rng.randn(V, 4 * H).astype(np.float32)
+        wh = rng.randn(H, 4 * H).astype(np.float32)
+        bias = rng.randn(4 * H).astype(np.float32)
+        ids = rng.randint(0, V, (2, 4))
+        h_out, c_out = I.fused_embedding_fc_lstm(tt(ids), tt(table),
+                                                 tt(wh), tt(bias))
+        pre = table[ids]
+        h_ref, c_ref = I.fusion_lstm(
+            tt(pre), tt(np.eye(4 * H, dtype=np.float32)), tt(wh),
+            bias=tt(bias))
+        np.testing.assert_allclose(np.asarray(h_out.data),
+                                   np.asarray(h_ref.data), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(c_out.data),
+                                   np.asarray(c_ref.data), rtol=1e-5)
+
+    def test_seqpool_concat(self, rng):
+        s1 = rng.randn(2, 3, 4).astype(np.float32)
+        s2 = rng.randn(2, 5, 4).astype(np.float32)
+        out = np.asarray(I.fusion_seqpool_concat(
+            [tt(s1), tt(s2)], "SUM").data)
+        np.testing.assert_allclose(
+            out, np.concatenate([s1.sum(1), s2.sum(1)], -1), rtol=1e-5)
+        out = np.asarray(I.fusion_seqpool_cvm_concat(
+            [tt(np.abs(s1)), tt(np.abs(s2))], use_cvm=True).data)
+        assert out.shape == (2, 8)
+
+
+class TestSequenceConv:
+    def test_lod_and_dense_agree(self, rng):
+        seqs = [rng.randn(3, 2).astype(np.float32),
+                rng.randn(2, 2).astype(np.float32)]
+        lt = LoDTensor.from_sequences(seqs)
+        filt = rng.randn(6, 4).astype(np.float32)
+        out = sequence_conv(lt, tt(filt), context_length=3)
+        assert np.asarray(out.data).shape == (5, 4)
+        ctx0 = np.concatenate([np.zeros(2, np.float32), seqs[0][0],
+                               seqs[0][1]])
+        np.testing.assert_allclose(np.asarray(out.data)[0], ctx0 @ filt,
+                                   rtol=1e-5)
+        dout = seq_conv_dense(tt(seqs[0][None]), tt(filt), 3)
+        np.testing.assert_allclose(np.asarray(dout.data)[0, 0],
+                                   ctx0 @ filt, rtol=1e-5)
+
+    def test_seqconv_eltadd_relu(self, rng):
+        x = rng.randn(1, 4, 2).astype(np.float32)
+        filt = rng.randn(6, 3).astype(np.float32)
+        b = rng.randn(3).astype(np.float32)
+        out = I.fusion_seqconv_eltadd_relu(tt(x), tt(filt), tt(b), 3, -1)
+        ref = np.asarray(seq_conv_dense(tt(x), tt(filt), 3, -1).data) + b
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   np.maximum(ref, 0), rtol=1e-5)
+
+    def test_topk_avg_pooling(self, rng):
+        ch = 2
+        block = rng.randn(2 * ch, 3).astype(np.float32)
+        out = sequence_topk_avg_pooling(
+            LoDTensor(block, [[0, 2 * ch]]), [0, 2], [0, 3], [1, 2], ch)
+        got = np.asarray(out.data)
+        assert got.shape == (2, 4)
+        # channel-major layout: channel c owns contiguous k_num columns
+        blk = block.reshape(ch, 2, 3)
+        np.testing.assert_allclose(got[:, 0::2], np.max(blk, axis=2).T,
+                                   rtol=1e-5)
+        top2 = -np.sort(-blk, axis=2)[:, :, :2].mean(axis=2)
+        np.testing.assert_allclose(got[:, 1::2], top2.T, rtol=1e-5)
+
+
+class TestOptimizerTail:
+    @pytest.mark.parametrize("cls,kw", [
+        (optim.DecayedAdagrad, {}),
+        (optim.Ftrl, dict(l1=0.001, l2=0.001)),
+        (optim.Dpsgd, dict(clip=100.0, sigma=0.0)),
+        (optim.ProximalAdagrad, dict(l1=0.0005, l2=0.0005)),
+        (optim.ProximalGD, dict(l1=0.0005, l2=0.0005)),
+    ])
+    def test_converges(self, cls, kw, rng):
+        paddle.seed(0)
+        w = tt(rng.randn(4, 3).astype(np.float32))
+        w.stop_gradient = False
+        target = tt(rng.randn(4, 3).astype(np.float32))
+        opt = cls(learning_rate=0.1, parameters=[w], **kw)
+        l0 = None
+        for _ in range(60):
+            loss = ((w - target) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if l0 is None:
+                l0 = float(loss.item())
+        assert float(loss.item()) < l0 * 0.5
+
+    def test_ftrl_l1_sparsifies(self, rng):
+        # strong L1 should drive small-coordinate params to EXACT zero
+        paddle.seed(0)
+        w = tt(rng.randn(10).astype(np.float32) * 0.01)
+        w.stop_gradient = False
+        opt = optim.Ftrl(learning_rate=0.5, l1=5.0, parameters=[w])
+        for _ in range(5):
+            (w * w).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        assert (np.asarray(w.data) == 0.0).all()
+
+    def test_dpsgd_noise_reproducible(self, rng):
+        def run(seed):
+            paddle.seed(0)
+            w = tt(np.ones(4, np.float32))
+            w.stop_gradient = False
+            opt = optim.Dpsgd(learning_rate=0.1, sigma=1.0, seed=seed,
+                              parameters=[w])
+            (w * 2).sum().backward()
+            opt.step()
+            return np.asarray(w.data).copy()
+        np.testing.assert_allclose(run(7), run(7))
+        assert not np.allclose(run(7), run(8))
