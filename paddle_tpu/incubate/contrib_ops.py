@@ -50,17 +50,24 @@ def row_conv(x, weight):
     return apply(f, _t(x), _t(weight))
 
 
+def _cvm_rewrite(a, use_cvm):
+    """The cvm_op.cc row rewrite on a plain array (shared with the
+    seqpool+cvm fusion): (log(show+1), log(click+1)-log(show+1), rest)
+    when use_cvm, else drop the two counter columns."""
+    if not use_cvm:
+        return a[:, 2:]
+    show = jnp.log(a[:, 0:1] + 1.0)
+    click = jnp.log(a[:, 1:2] + 1.0) - show
+    return jnp.concatenate([show, click, a[:, 2:]], axis=1)
+
+
 def cvm(x, use_cvm=True):
     """cvm_op.cc (continuous value model, CTR): the first two columns of
     each instance are show/click counters. use_cvm=True keeps all columns
     but rewrites them to (log(show+1), log(click+1) - log(show+1));
     use_cvm=False drops the two counter columns."""
     def f(a):
-        show = jnp.log(a[:, 0:1] + 1.0)
-        click = jnp.log(a[:, 1:2] + 1.0) - show
-        if use_cvm:
-            return jnp.concatenate([show, click, a[:, 2:]], axis=1)
-        return a[:, 2:]
+        return _cvm_rewrite(a, use_cvm)
 
     return apply(f, _t(x))
 

@@ -99,6 +99,20 @@ def fusion_repeated_fc_relu(x, weights, biases):
                  *[_t(b) for b in biases])
 
 
+def _context_cols(a, context_length, context_start):
+    """Shift-and-mask context window: [B, T, D] -> [B, T, K*D] with zeros
+    outside the sequence (math/context_project.h Im2Col row layout)."""
+    T = a.shape[1]
+    cols = []
+    for k in range(context_length):
+        off = context_start + k
+        shifted = jnp.roll(a, -off, axis=1)
+        t_idx = jnp.arange(T) + off
+        valid = ((t_idx >= 0) & (t_idx < T))[None, :, None]
+        cols.append(jnp.where(valid, shifted, 0.0))
+    return jnp.concatenate(cols, axis=-1)
+
+
 def sequence_conv(x, filter, context_length, context_start=None,
                   padding_data=None, bias=None, stride=1):
     """sequence_conv_op.cc (+ math/context_project.h): slide a context
@@ -119,16 +133,7 @@ def sequence_conv(x, filter, context_length, context_start=None,
         context_start = -(context_length // 2)
 
     def f(a, w, b):
-        B, T, D = a.shape
-        cols = []
-        for k in range(context_length):
-            off = context_start + k
-            shifted = jnp.roll(a, -off, axis=1)
-            t_idx = jnp.arange(T) + off
-            valid = ((t_idx >= 0) & (t_idx < T))[None, :, None]
-            cols.append(jnp.where(valid, shifted, 0.0))
-        ctx = jnp.concatenate(cols, axis=-1)  # [B, T, K*D]
-        out = ctx @ w
+        out = _context_cols(a, context_length, context_start) @ w
         if b is not None:
             out = out + b
         return out
@@ -139,18 +144,12 @@ def sequence_conv(x, filter, context_length, context_start=None,
 
 def fusion_seqconv_eltadd_relu(x, filter, bias, context_length,
                                context_start=0):
-    """fusion_seqconv_eltadd_relu_op.cc: relu(sequence_conv(x) + bias)."""
+    """fusion_seqconv_eltadd_relu_op.cc: relu(sequence_conv(x) + bias).
+    context_start defaults to 0 here (the fusion op's contextStart attr
+    default), unlike bare sequence_conv's centered window."""
     def f(a, w, b):
-        B, T, D = a.shape
-        cols = []
-        for k in range(context_length):
-            off = context_start + k
-            shifted = jnp.roll(a, -off, axis=1)
-            t_idx = jnp.arange(T) + off
-            valid = ((t_idx >= 0) & (t_idx < T))[None, :, None]
-            cols.append(jnp.where(valid, shifted, 0.0))
-        ctx = jnp.concatenate(cols, axis=-1)
-        return jax.nn.relu(ctx @ w + b)
+        return jax.nn.relu(
+            _context_cols(a, context_length, context_start) @ w + b)
 
     return apply(f, _t(x), _t(filter), _t(bias))
 
@@ -185,18 +184,12 @@ def fusion_seqpool_concat(xs, pooltype="SUM"):
 def fusion_seqpool_cvm_concat(xs, use_cvm=True, pooltype="SUM"):
     """fusion_seqpool_cvm_concat_op.cc: seqpool + cvm + concat (the CTR
     triple-fusion; see contrib_ops.cvm for the counter-column rewrite)."""
+    from .contrib_ops import _cvm_rewrite
+
     def f(*arrs):
-        outs = []
-        for a in arrs:
-            p = _seq_pool(a, pooltype)
-            show = jnp.log(p[:, 0:1] + 1.0)
-            click = jnp.log(p[:, 1:2] + 1.0) - show
-            if use_cvm:
-                p = jnp.concatenate([show, click, p[:, 2:]], axis=1)
-            else:
-                p = p[:, 2:]
-            outs.append(p)
-        return jnp.concatenate(outs, axis=-1)
+        return jnp.concatenate(
+            [_cvm_rewrite(_seq_pool(a, pooltype), use_cvm) for a in arrs],
+            axis=-1)
 
     return apply(f, *[_t(a) for a in xs])
 

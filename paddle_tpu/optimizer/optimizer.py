@@ -698,10 +698,12 @@ class Dpsgd(Optimizer):
 
     def _init_slots(self, p):
         # per-param salt: each parameter draws its own noise stream (the
-        # reference's per-op-instance engine), folded with the step below
+        # reference's per-op-instance engine); folded with the step as a
+        # (salt, step) PAIR below, so streams never collide at any step
+        # count or parameter count
         self._salt_counter += 1
-        return {"noise_key": jnp.asarray(self._salt_counter * (1 << 16),
-                                         jnp.int32)}
+        return {"noise_salt": jnp.asarray(self._salt_counter, jnp.int32),
+                "noise_step": jnp.asarray(0, jnp.int32)}
 
     def _rule(self, g, p, slots, lr, wd):
         import jax as _jax
@@ -709,14 +711,17 @@ class Dpsgd(Optimizer):
         p32 = p.astype(jnp.float32)
         l2 = jnp.sqrt(jnp.sum(g * g))
         scale = jnp.maximum(l2 / self._clip, 1.0)
-        key = _jax.random.fold_in(_jax.random.PRNGKey(self._seed),
-                                  slots["noise_key"])
+        key = _jax.random.fold_in(
+            _jax.random.fold_in(_jax.random.PRNGKey(self._seed),
+                                slots["noise_salt"]),
+            slots["noise_step"])
         # ONE scalar draw per param per step — dpsgd_op.h draws a single
         # Box-Muller gaussian outside its element loop, same shape here
         noise = _jax.random.normal(key, ()) * self._sigma
         new_p = p32 - lr * (g / scale + noise / self._bs)
         return new_p.astype(p.dtype), {
-            "noise_key": slots["noise_key"] + 1}
+            "noise_salt": slots["noise_salt"],
+            "noise_step": slots["noise_step"] + 1}
 
 
 class ProximalAdagrad(Optimizer):

@@ -335,3 +335,126 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
         op.defvjp(fwd, bwd)
         return apply(op, *[_t(a) for a in xs])
     return apply(f, *[_t(a) for a in xs])
+
+
+# ---- static-graph parameter/variable/scope facade -----------------------
+# Reference: fluid/layers/tensor.py create_parameter/create_global_var,
+# fluid/backward.py append_backward/gradients, fluid/executor.py
+# global_scope/scope_guard. The TPU runtime has no Scope-owned variables
+# (arrays are jax values); Scope here is the name->Tensor registry the
+# compat APIs need so save/load/introspection keep working.
+
+class Scope:
+    """Name -> Tensor registry (framework/scope.h facade)."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        from ..core.tensor import Tensor
+        if name not in self._vars:
+            self._vars[name] = Tensor(np.zeros((), np.float32))
+        return self._vars[name]
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def local_var_names(self):
+        return list(self._vars)
+
+
+_GLOBAL_SCOPE = Scope()
+_SCOPE_STACK = [_GLOBAL_SCOPE]
+
+
+def global_scope() -> Scope:
+    return _SCOPE_STACK[-1]
+
+
+def scope_guard(scope: Scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        _SCOPE_STACK.append(scope)
+        try:
+            yield
+        finally:
+            _SCOPE_STACK.pop()
+
+    return guard()
+
+
+_param_counter = [0]
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """fluid/layers/tensor.py create_parameter: a trainable Tensor
+    registered in the current scope. Initialized like the reference
+    (Xavier for weights, zeros for bias) unless default_initializer."""
+    from ..core.tensor import Tensor
+    from ..nn import initializer as init
+    shape = list(shape)
+    if default_initializer is None:
+        # the reference defaults: Xavier for weights, zeros for bias —
+        # reuse the initializer classes so paddle.seed drives the draw
+        # and fan computation matches every other layer
+        default_initializer = (init.Constant(0.0) if is_bias
+                               else init.XavierUniform())
+    t = default_initializer(shape, dtype)
+    if not isinstance(t, Tensor):
+        t = Tensor(np.asarray(t, dtype))
+    t.stop_gradient = False
+    _param_counter[0] += 1
+    t.name = name or f"create_parameter_{_param_counter[0]}"
+    global_scope()._vars[t.name] = t
+    return t
+
+
+def create_global_var(shape, value, dtype, persistable=False, name=None,
+                      force_cpu=False):
+    """fluid/layers/tensor.py create_global_var: a constant-initialized
+    variable in the current scope (persistable survives program resets
+    trivially here — everything is a live Tensor)."""
+    from ..core.tensor import Tensor
+    t = Tensor(np.full(list(shape), value, dtype))
+    _param_counter[0] += 1
+    t.name = name or f"create_global_var_{_param_counter[0]}"
+    global_scope()._vars[t.name] = t
+    return t
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """fluid/backward.py append_backward: build the backward and return
+    [(param, grad)] pairs. Eager facade: runs loss.backward() on the tape
+    (retaining nothing extra) and pairs parameters with their .grad —
+    the same contract optimizer.minimize consumes."""
+    from ..core.tensor import Tensor
+    loss.backward()
+    if parameter_list is None:
+        parameter_list = [v for v in global_scope()._vars.values()
+                          if isinstance(v, Tensor)
+                          and not v.stop_gradient]
+    pairs = []
+    for p in parameter_list:
+        if no_grad_set and getattr(p, "name", None) in no_grad_set:
+            continue
+        if p.grad is not None:
+            pairs.append((p, p.grad))
+    return pairs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """fluid/backward.py gradients: d(targets)/d(inputs) without touching
+    other leaves' .grad (partial_grad_engine.cc contract) — maps to the
+    tape's paddle.grad."""
+    from ..core.tensor import grad as _grad
+    outs = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return list(_grad(list(outs), list(ins),
+                      grad_outputs=target_gradients))

@@ -475,3 +475,39 @@ class TestReviewFixes:
         o, m = F.max_pool2d(x, 2, 2, return_mask=True)
         with pytest.raises(ValueError):
             F.max_unpool2d(o, m, 2, 2, padding="SAME")
+
+
+class TestStaticScopeFacade:
+    def test_create_parameter_and_scope(self, rng):
+        w = S.create_parameter([3, 2], "float32", name="tw0")
+        assert S.global_scope().find_var("tw0") is w
+        assert not w.stop_gradient
+        b = S.create_parameter([2], "float32", is_bias=True)
+        np.testing.assert_allclose(np.asarray(b.data), np.zeros(2))
+        g = S.create_global_var([2], 1.5, "float32", name="tgv")
+        np.testing.assert_allclose(np.asarray(g.data), [1.5, 1.5])
+
+    def test_append_backward_pairs(self, rng):
+        w = S.create_parameter([3, 2], "float32", name="ab_w")
+        x = tt(np.ones((4, 3), np.float32))
+        pairs = S.append_backward(x.matmul(w).sum(),
+                                  parameter_list=[w])
+        assert len(pairs) == 1 and pairs[0][0] is w
+        np.testing.assert_allclose(np.asarray(pairs[0][1].data),
+                                   np.full((3, 2), 4.0))
+
+    def test_gradients_partial(self):
+        y = tt(np.ones((3,), np.float32))
+        y.stop_gradient = False
+        (gy,) = S.gradients((y * y).sum(), y)
+        np.testing.assert_allclose(np.asarray(gy.data), 2 * np.ones(3),
+                                   rtol=1e-6)
+        assert y.grad is None  # gradients() must not touch .grad
+
+    def test_scope_guard_isolation(self):
+        sc = S.Scope()
+        with S.scope_guard(sc):
+            S.create_parameter([2], name="inner_var")
+            assert S.global_scope() is sc
+            assert S.global_scope().find_var("inner_var") is not None
+        assert S.global_scope().find_var("inner_var") is None
