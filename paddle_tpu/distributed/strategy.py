@@ -111,6 +111,9 @@ class PipelineConfig:  # proto PipelineConfig:148
     accumulate_steps: int = 1
     schedule_mode: str = "1F1B"
     p2p_cache_shape: bool = True
+    # parity-plus: Megatron-style interleaved schedule (virtual pipeline
+    # stages); 1 = plain 1F1B
+    virtual_pp_degree: int = 1
 
 
 @dataclass

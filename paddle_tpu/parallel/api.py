@@ -483,15 +483,19 @@ def parallelize(model: Layer, optimizer=None, mesh: Optional[Mesh] = None,
                 "analog; Llama/GPT families implement it). Set pp_degree=1 "
                 "to train under ShardedTrainStep instead")
         n_micro = 4
+        vpp = 1
         if strategy is not None:
             cfg = getattr(strategy, "pipeline_configs", None)
             if cfg is not None and getattr(cfg, "accumulate_steps", 0) >= 1:
                 n_micro = cfg.accumulate_steps
+            if cfg is not None:
+                vpp = int(getattr(cfg, "virtual_pp_degree", 1) or 1)
         return PipelinedTrainStep(model, plan.optimizer or optimizer, mesh,
                                   n_micro=n_micro,
                                   zero_stage=plan.zero_stage,
                                   min_shard_numel=plan.zero_min_numel,
-                                  amp_cfg=plan.amp, loss_fn=loss_fn)
+                                  amp_cfg=plan.amp, loss_fn=loss_fn,
+                                  virtual_pp_degree=vpp)
     if plan.localsgd_k:
         from .localsgd import LocalSGDTrainStep
         return LocalSGDTrainStep(model, plan.optimizer or optimizer, mesh,

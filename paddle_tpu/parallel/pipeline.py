@@ -15,9 +15,12 @@ Two schedules:
   scan+ppermute yields the backward pipeline automatically. Simple, but peak
   activation memory grows with n_micro.
 
-  TODO(schedule): interleaved 1F1B (virtual pipeline stages) is not
-  implemented — the reference has no interleaved schedule either; add it
-  as parity-plus once a >1 layers-per-stage imbalance shows up in profiles.
+  Interleaved 1F1B (virtual pipeline stages, parity-plus — the reference
+  has no interleaved schedule) is available via virtual_pp_degree > 1:
+  rank s owns V layer chunks (chunk v = logical stage v*S + s); the
+  host-simulated tick table (`_interleaved_schedule`) reproduces the
+  Megatron schedule length V*M + 2(S-1) + (V-1)*S, cutting the bubble
+  from 2(S-1)*V to 2(S-1)+(V-1)*S chunk-ticks.
 
 - `PipelinedTrainStep` — true 1F1B (section_worker.cc:149 parity): each tick
   has a forward slot and a backward slot. Stage s runs forward of microbatch
@@ -111,6 +114,31 @@ def stack_stage_params(per_layer_params: List[Dict], n_stages: int):
                 [per_layer_params[s * per_stage + i][k]
                  for i in range(per_stage)]))
         out[k] = jnp.stack(rows)  # [n_stages, per_stage, ...]
+    return out
+
+
+def stack_interleaved_params(per_layer_params: List[Dict], n_stages: int,
+                             n_chunks: int):
+    """[{name: arr} per layer] -> {name: [S, V, per_chunk, ...]} with the
+    interleaved (virtual pipeline) assignment: chunk v on stage s holds
+    layers [(v*S + s) * per_chunk, (v*S + s + 1) * per_chunk)."""
+    n_layers = len(per_layer_params)
+    S, V = n_stages, n_chunks
+    assert n_layers % (S * V) == 0
+    per_chunk = n_layers // (S * V)
+    keys = per_layer_params[0].keys()
+    out = {}
+    for k in keys:
+        rows = []
+        for s in range(S):
+            chunks = []
+            for v in range(V):
+                base = (v * S + s) * per_chunk
+                chunks.append(jnp.stack(
+                    [per_layer_params[base + i][k]
+                     for i in range(per_chunk)]))
+            rows.append(jnp.stack(chunks))
+        out[k] = jnp.stack(rows)  # [S, V, per_chunk, ...]
     return out
 
 
@@ -277,6 +305,235 @@ def run_1f1b(stage_fn: Callable, embed_fn: Callable, head_loss_fn: Callable,
     return loss, aux, d_local, d_rest
 
 
+def _interleaved_schedule(S: int, V: int, M: int):
+    """Tick-aligned interleaved-1F1B schedule table (host-side).
+
+    Megatron-style virtual pipeline stages: rank s owns V layer chunks,
+    chunk v = logical stage v*S + s. Per-rank unit order is the Megatron
+    round-robin (groups of S microbatches per chunk); execution is
+    simulated in lockstep with one fwd + one bwd slot per tick and
+    1-tick message latency, which reproduces the Megatron schedule
+    length T = V*M + 2(S-1) + (V-1)*S exactly (bubble 2(S-1)+(V-1)S
+    chunk-ticks vs the non-interleaved 2(S-1)*V — the (S-1)(V-1)*2-ish
+    saving interleaving exists for).
+
+    Returns (T, fwd_tbl, bwd_tbl, n_buf): each tbl is an int32
+    [T, S, 3] array of (chunk, microbatch, on); n_buf is the smallest
+    ring-buffer depth with collision-free slot live-ranges.
+    """
+    import numpy as np
+    if M % S != 0:
+        raise ValueError(
+            f"interleaved pipeline needs n_micro({M}) % pp_degree({S}) "
+            "== 0 (Megatron round-robin grouping)")
+    total = V * M
+
+    def chunk_mb(k, rev):
+        pos = k % (S * V)
+        c = pos // S
+        if rev:
+            c = V - 1 - c
+        return c, S * (k // (S * V)) + (k % S)
+
+    fwd_done, bwd_done = {}, {}
+    kf, kb = [0] * S, [0] * S
+    fwd_rows, bwd_rows = [], []
+    t = 0
+    while min(kb) < total:
+        if t > 4 * (total + S * V):  # pragma: no cover - safety net
+            raise RuntimeError("interleaved schedule did not converge")
+        frow, brow = [], []
+        stage_events = []
+        for s in range(S):
+            fc = fi = 0
+            fon = False
+            if kf[s] < total:
+                c, mb = chunk_mb(kf[s], rev=False)
+                lg = c * S + s
+                if lg == 0 or fwd_done.get((lg - 1, mb), 1 << 30) + 1 <= t:
+                    fc, fi, fon = c, mb, True
+            bc = bi = 0
+            bon = False
+            if kb[s] < total:
+                c, mb = chunk_mb(kb[s], rev=True)
+                lg = c * S + s
+                own_fwd = (lg, mb) in fwd_done or (fon and fc == c
+                                                  and fi == mb)
+                if lg == S * V - 1:
+                    ready = own_fwd  # head cotangent made in this tick's F
+                else:
+                    ready = bwd_done.get((lg + 1, mb), 1 << 30) + 1 <= t
+                if ready and own_fwd:
+                    bc, bi, bon = c, mb, True
+            frow.append((fc, fi, int(fon)))
+            brow.append((bc, bi, int(bon)))
+            stage_events.append((fc, fi, fon, bc, bi, bon))
+        for s, (fc, fi, fon, bc, bi, bon) in enumerate(stage_events):
+            if fon:
+                fwd_done[(fc * S + s, fi)] = t
+                kf[s] += 1
+            if bon:
+                bwd_done[(bc * S + s, bi)] = t
+                kb[s] += 1
+        fwd_rows.append(frow)
+        bwd_rows.append(brow)
+        t += 1
+    T = t
+
+    # smallest n_buf with no (rank, chunk) slot collision: a microbatch's
+    # save/ct slot is live from its fwd tick to its bwd tick
+    def collides(nb):
+        for s in range(S):
+            for c in range(V):
+                lives = {}
+                for mb in range(M):
+                    f = fwd_done.get((c * S + s, mb))
+                    b = bwd_done.get((c * S + s, mb))
+                    if f is None or b is None:
+                        continue
+                    slot = mb % nb
+                    for lo, hi in lives.get(slot, ()):  # overlap check
+                        if not (b < lo or f > hi):
+                            return True
+                    lives.setdefault(slot, []).append((f, b))
+        return False
+
+    n_buf = min(M, S + 1)
+    while collides(n_buf):
+        n_buf += 1
+    return (T, np.asarray(fwd_rows, np.int32),
+            np.asarray(bwd_rows, np.int32), n_buf)
+
+
+def run_interleaved_1f1b(stage_fn: Callable, embed_fn: Callable,
+                         head_loss_fn: Callable, local_params, rest,
+                         ids_mb, labels_mb, n_micro: int, n_stages: int,
+                         n_chunks: int, axis: str = PIPE_AXIS,
+                         with_aux: bool = False, aux_ct_scale=0.0):
+    """One interleaved-1F1B sweep (virtual pipeline stages; parity-plus —
+    the reference's schedule is plain 1F1B, section_worker.cc:149).
+
+    Same contract as run_1f1b except local_params leaves are
+    [n_chunks, per_chunk, ...] (chunk v = logical stage v*n_stages + s)
+    and d_local matches that shape. MUST run inside shard_map with `axis`
+    mapped."""
+    S, V, M = n_stages, n_chunks, n_micro
+    stage_idx = lax.axis_index(axis)
+    T, fwd_tbl, bwd_tbl, n_buf = _interleaved_schedule(S, V, M)
+    fwd_tbl = jnp.asarray(fwd_tbl)
+    bwd_tbl = jnp.asarray(bwd_tbl)
+
+    def scaled_head(rest_, h, y):
+        return head_loss_fn(rest_, h, y) / M
+
+    def run_stage(params, x):
+        out = stage_fn(params, x)
+        return out if with_aux else (out, jnp.float32(0.0))
+
+    def chunk_of(tree, c):
+        return jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+            tree)
+
+    def chunk_add(tree, c, delta, on):
+        def upd(a, g):
+            cur = lax.dynamic_index_in_dim(a, c, 0, keepdims=False)
+            new = cur + jnp.where(on, g, jnp.zeros_like(g))
+            return lax.dynamic_update_index_in_dim(a, new, c, 0)
+        return jax.tree_util.tree_map(upd, tree, delta)
+
+    x0 = embed_fn(rest, ids_mb[0])
+    act_dtype = x0.dtype
+    zero_d_local = jax.tree_util.tree_map(jnp.zeros_like, local_params)
+    zero_d_rest = jax.tree_util.tree_map(jnp.zeros_like, rest)
+
+    def masked_add(acc, delta, on):
+        return jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(on, g, jnp.zeros_like(g)), acc,
+            delta)
+
+    def buf_write(buf, c, slot, val, on):
+        cur = buf[c, slot]
+        return buf.at[c, slot].set(jnp.where(on, val, cur))
+
+    def tick(carry, t):
+        (f_msg, b_msg, in_buf, save_buf, ct_buf, d_local, d_rest,
+         loss_acc, aux_acc) = carry
+
+        # ---- deliver last tick's ring messages into the buffers ----
+        prev_r = (stage_idx - 1) % S
+        next_r = (stage_idx + 1) % S
+        t_prev = jnp.maximum(t - 1, 0)
+        pf = fwd_tbl[t_prev, prev_r]      # sender's fwd slot (c, mb, on)
+        rc = jnp.where(stage_idx == 0, pf[0] + 1, pf[0])
+        f_store = (t > 0) & (pf[2] == 1) & (rc < V)
+        in_buf = buf_write(in_buf, jnp.clip(rc, 0, V - 1),
+                           pf[1] % n_buf, f_msg, f_store)
+        nb = bwd_tbl[t_prev, next_r]      # sender's bwd slot
+        rcb = jnp.where(stage_idx == S - 1, nb[0] - 1, nb[0])
+        b_store = (t > 0) & (nb[2] == 1) & (rcb >= 0)
+        ct_buf = buf_write(ct_buf, jnp.clip(rcb, 0, V - 1),
+                           nb[1] % n_buf, b_msg, b_store)
+
+        # ---- forward slot ----
+        fc, fi, fon_i = fwd_tbl[t, stage_idx]
+        f_on = fon_i == 1
+        lgf = fc * S + stage_idx
+        ids_i = lax.dynamic_index_in_dim(ids_mb, fi, 0, keepdims=False)
+        x_in = jnp.where(lgf == 0, embed_fn(rest, ids_i),
+                         in_buf[fc, fi % n_buf])
+        x_out, aux_i = run_stage(chunk_of(local_params, fc), x_in)
+        aux_acc = aux_acc + jnp.where(f_on, aux_i, 0.0) / M
+        save_buf = buf_write(save_buf, fc, fi % n_buf, x_in, f_on)
+        # head: last logical stage computes the loss + dh this tick
+        y_i = lax.dynamic_index_in_dim(labels_mb, fi, 0, keepdims=False)
+        loss_i, (d_rest_head, dh) = jax.value_and_grad(
+            scaled_head, argnums=(0, 1))(rest, x_out, y_i)
+        head_on = f_on & (lgf == S * V - 1)
+        loss_acc = loss_acc + jnp.where(head_on, loss_i, 0.0)
+        d_rest = masked_add(d_rest, d_rest_head, head_on)
+        ct_buf = buf_write(ct_buf, fc, fi % n_buf, dh.astype(act_dtype),
+                           head_on)
+
+        # ---- backward slot ----
+        bc, bi, bon_i = bwd_tbl[t, stage_idx]
+        b_on = bon_i == 1
+        lgb = bc * S + stage_idx
+        ct = ct_buf[bc, bi % n_buf]
+        x_saved = save_buf[bc, bi % n_buf]
+        _, stage_vjp = jax.vjp(run_stage, chunk_of(local_params, bc),
+                               x_saved)
+        aux_ct = jnp.asarray(aux_ct_scale, jnp.float32) \
+            if with_aux else jnp.float32(0.0)
+        d_chunk, dx = stage_vjp((ct, aux_ct))
+        d_local = chunk_add(d_local, bc, d_chunk, b_on)
+        ids_u = lax.dynamic_index_in_dim(ids_mb, bi, 0, keepdims=False)
+        _, embed_vjp = jax.vjp(lambda r: embed_fn(r, ids_u), rest)
+        (d_rest_emb,) = embed_vjp(dx)
+        d_rest = masked_add(d_rest, d_rest_emb, b_on & (lgb == 0))
+
+        # ---- ring communication ----
+        fperm = [(r, (r + 1) % S) for r in range(S)]
+        bperm = [(r, (r - 1) % S) for r in range(S)]
+        f_msg = lax.ppermute(x_out, axis, fperm)
+        b_msg = lax.ppermute(dx, axis, bperm)
+        return (f_msg, b_msg, in_buf, save_buf, ct_buf, d_local, d_rest,
+                loss_acc, aux_acc), None
+
+    zeros_act = jnp.zeros_like(x0)
+    buf0 = jnp.zeros((V, n_buf) + x0.shape, act_dtype)
+    carry0 = (zeros_act, zeros_act, buf0, buf0, buf0, zero_d_local,
+              zero_d_rest, jnp.zeros((), jnp.float32),
+              jnp.zeros((), jnp.float32))
+    (_, _, _, _, _, d_local, d_rest, loss_acc, aux_acc), _ = lax.scan(
+        tick, carry0, jnp.arange(T))
+
+    loss = lax.psum(loss_acc, axis)
+    aux = lax.psum(aux_acc, axis)
+    d_rest = jax.tree_util.tree_map(lambda g: lax.psum(g, axis), d_rest)
+    return loss, aux, d_local, d_rest
+
+
 class PipelinedTrainStep:
     """1F1B pipeline training for pipeline-stackable models (the pipe_*
     protocol; Llama/GPT implement it, any homogeneous decoder LM can).
@@ -300,7 +557,8 @@ class PipelinedTrainStep:
 
     def __init__(self, model, optimizer, mesh: Mesh, n_micro: int = 4,
                  remat: bool = True, zero_stage: int = 0,
-                 min_shard_numel: int = 1024, amp_cfg=None, loss_fn=None):
+                 min_shard_numel: int = 1024, amp_cfg=None, loss_fn=None,
+                 virtual_pp_degree: int = 1):
         if not is_pipeline_stackable(model):
             raise ValueError(
                 f"{type(model).__name__} does not implement the pipeline "
@@ -316,6 +574,23 @@ class PipelinedTrainStep:
         self.mesh = mesh
         self.n_micro = n_micro
         self.n_stages = mesh.shape[PIPE_AXIS]
+        self.n_chunks = int(virtual_pp_degree)
+        if self.n_chunks < 1:
+            raise ValueError("virtual_pp_degree must be >= 1")
+        if self.n_chunks > 1:
+            from ..optimizer.optimizer import Lamb, LarsMomentum
+            if zero_stage >= 2:
+                raise NotImplementedError(
+                    "virtual_pp_degree > 1 composes with ZeRO stage 0/1 "
+                    "only (grad reduce-scatter over interleaved chunk "
+                    "layouts is not wired); use zero_stage<=1 or "
+                    "virtual_pp_degree=1")
+            if isinstance(optimizer, (Lamb, LarsMomentum)):
+                raise NotImplementedError(
+                    "virtual_pp_degree > 1 with norm-based rules "
+                    "(Lamb/LARS) is not wired (whole-param norms over "
+                    "the chunk dim); use Adam/SGD-family or "
+                    "virtual_pp_degree=1")
         self.zero_stage = zero_stage
         self._step_count = 0
         self._loss_fn = loss_fn
@@ -332,7 +607,11 @@ class PipelinedTrainStep:
         params, buffers = model.functional_state()
         layers = self._decoder_layers()
         n_layers = len(layers)
-        assert n_layers % self.n_stages == 0
+        if n_layers % (self.n_stages * self.n_chunks) != 0:
+            raise ValueError(
+                f"{n_layers} layers not divisible into "
+                f"{self.n_stages} stages x {self.n_chunks} virtual "
+                "chunks")
 
         layer_prefixes = self._layer_prefixes()
         per_layer = []
@@ -354,7 +633,13 @@ class PipelinedTrainStep:
                                     "moe_aux_loss_weight", 0.0))
                       if self._moe_stack else 0.0)
         self._layer_prefix_list = layer_prefixes
-        stacked = stack_stage_params(per_layer, self.n_stages)
+        if self.n_chunks > 1:
+            # interleaved chunk assignment: chunk v on stage s owns layers
+            # [(v*S + s)*per_chunk, ...) — logical stage v*S + s
+            stacked = stack_interleaved_params(per_layer, self.n_stages,
+                                               self.n_chunks)
+        else:
+            stacked = stack_stage_params(per_layer, self.n_stages)
         rest = {k: v for k, v in params.items()
                 if not any(k.startswith(p) for p in layer_prefixes)}
 
@@ -371,9 +656,11 @@ class PipelinedTrainStep:
             ax += [None] * (ndim - len(ax))
             return P(*ax)
 
+        lead_dims = ((PIPE_AXIS, None, None) if self.n_chunks > 1
+                     else (PIPE_AXIS, None))
         stacked_specs = {
             k: _full_spec(_param_spec(named_params[pfx0 + k], mesh),
-                          stacked[k].ndim, (PIPE_AXIS, None))
+                          stacked[k].ndim, lead_dims)
             for k in stacked}
         rest_specs = {
             k: _full_spec(_param_spec(named_params[k], mesh), rest[k].ndim)
@@ -446,10 +733,11 @@ class PipelinedTrainStep:
             for k, v in rest.items():
                 zdim[k] = _zdim(_local_shape(v.shape, rest_specs[k]), 0,
                                 rest_specs[k])
+            lead_n = 2 if self.n_chunks > 1 else 1  # pipe (+chunk) dims
             for k, v in stacked.items():
                 loc = _local_shape(v.shape, stacked_specs[k])
-                d = _zdim(loc[1:], 1, list(stacked_specs[k])[1:])
-                zdim[f"__stack__{k}"] = None if d is None else d + 1
+                d = _zdim(loc[lead_n:], 1, list(stacked_specs[k])[lead_n:])
+                zdim[f"__stack__{k}"] = None if d is None else d + lead_n
         z2 = use_zero and zero_stage >= 2
         z3 = use_zero and zero_stage >= 3
         self._z2, self._z3 = z2, z3
@@ -543,6 +831,7 @@ class PipelinedTrainStep:
         head_fn = self._make_head_fn()
         n_micro_ = n_micro
         n_stages_ = self.n_stages
+        n_chunks_ = self.n_chunks
 
         # `ep` is a batch axis too (expert parallelism is data-parallel in
         # the token dim); expert-sharded param grads opt out of its pmean
@@ -629,11 +918,19 @@ class PipelinedTrainStep:
             scale = extras_.get("loss_scale", jnp.float32(1.0))
             head = ((lambda r, h, y: head_fn(r, h, y) * scale)
                     if use_scaler else head_fn)
-            loss, aux, d_local, g_rest = run_1f1b(
-                stage_fn, embed_fn, head, local, rest_f, ids_mb, labels_mb,
-                n_micro_, n_stages_, with_aux=moe_stack,
-                aux_ct_scale=(aux_weight_ * scale / n_micro_
-                              if moe_stack else 0.0))
+            if n_chunks_ > 1:
+                loss, aux, d_local, g_rest = run_interleaved_1f1b(
+                    stage_fn, embed_fn, head, local, rest_f, ids_mb,
+                    labels_mb, n_micro_, n_stages_, n_chunks_,
+                    with_aux=moe_stack,
+                    aux_ct_scale=(aux_weight_ * scale / n_micro_
+                                  if moe_stack else 0.0))
+            else:
+                loss, aux, d_local, g_rest = run_1f1b(
+                    stage_fn, embed_fn, head, local, rest_f, ids_mb,
+                    labels_mb, n_micro_, n_stages_, with_aux=moe_stack,
+                    aux_ct_scale=(aux_weight_ * scale / n_micro_
+                                  if moe_stack else 0.0))
             g_stacked = jax.tree_util.tree_map(lambda g: g[None], d_local)
             if use_scaler:
                 loss = loss / scale
@@ -928,9 +1225,20 @@ class PipelinedTrainStep:
         named = dict(self.model.named_parameters())
         for k, arr in self._rest.items():
             named[k].data = arr
-        per_stage = len(self._layer_prefix_list) // self.n_stages
+        S, V = self.n_stages, self.n_chunks
+        if V > 1:
+            per_chunk = len(self._layer_prefix_list) // (S * V)
+            for key, stacked_arr in self._stacked.items():
+                for s in range(S):
+                    for v in range(V):
+                        for i in range(per_chunk):
+                            layer_idx = (v * S + s) * per_chunk + i
+                            full = self._layer_prefix_list[layer_idx] + key
+                            named[full].data = stacked_arr[s, v, i]
+            return
+        per_stage = len(self._layer_prefix_list) // S
         for key, stacked_arr in self._stacked.items():
-            for s in range(self.n_stages):
+            for s in range(S):
                 for i in range(per_stage):
                     layer_idx = s * per_stage + i
                     full = self._layer_prefix_list[layer_idx] + key

@@ -394,23 +394,29 @@ _param_counter = [0]
 def create_parameter(shape, dtype="float32", name=None, attr=None,
                      is_bias=False, default_initializer=None):
     """fluid/layers/tensor.py create_parameter: a trainable Tensor
-    registered in the current scope. Initialized like the reference
-    (Xavier for weights, zeros for bias) unless default_initializer."""
+    registered in the current scope. attr (ParamAttr) supplies
+    name/initializer/trainable exactly as the reference's primary
+    customization channel; default_initializer wins over attr.initializer
+    (the reference's precedence). Defaults: Xavier for weights, zeros for
+    bias, via the shared initializer classes so paddle.seed drives the
+    draw."""
     from ..core.tensor import Tensor
     from ..nn import initializer as init
+    from ..nn.layer.layers import ParamAttr
     shape = list(shape)
+    attr = ParamAttr._to_attr(attr) if attr is not None else None
+    if default_initializer is None and attr is not None:
+        default_initializer = attr.initializer
     if default_initializer is None:
-        # the reference defaults: Xavier for weights, zeros for bias —
-        # reuse the initializer classes so paddle.seed drives the draw
-        # and fan computation matches every other layer
         default_initializer = (init.Constant(0.0) if is_bias
                                else init.XavierUniform())
     t = default_initializer(shape, dtype)
     if not isinstance(t, Tensor):
         t = Tensor(np.asarray(t, dtype))
-    t.stop_gradient = False
+    t.stop_gradient = not (attr.trainable if attr is not None else True)
     _param_counter[0] += 1
-    t.name = name or f"create_parameter_{_param_counter[0]}"
+    t.name = (name or (attr.name if attr is not None else None)
+              or f"create_parameter_{_param_counter[0]}")
     global_scope()._vars[t.name] = t
     return t
 
@@ -432,14 +438,31 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None):
     """fluid/backward.py append_backward: build the backward and return
     [(param, grad)] pairs. Eager facade: runs loss.backward() on the tape
-    (retaining nothing extra) and pairs parameters with their .grad —
-    the same contract optimizer.minimize consumes."""
+    and pairs parameters with their .grad — the same contract
+    optimizer.minimize consumes. The default parameter_list is every
+    trainable LEAF the loss actually depends on, discovered by walking the
+    tape (the reference enumerates the program's parameters; the tape walk
+    finds the same set — incl. static.nn.fc / Layer params that are not
+    scope-registered — without a global registry)."""
     from ..core.tensor import Tensor
-    loss.backward()
     if parameter_list is None:
-        parameter_list = [v for v in global_scope()._vars.values()
-                          if isinstance(v, Tensor)
-                          and not v.stop_gradient]
+        # walk the autograd graph BEFORE backward clears it: trainable
+        # leaves (no producer node) are the program's parameters
+        seen_nodes, seen_params, parameter_list = set(), set(), []
+        frontier = [loss._node] if loss._node is not None else []
+        while frontier:
+            node = frontier.pop()
+            if node is None or id(node) in seen_nodes:
+                continue
+            seen_nodes.add(id(node))
+            for t, (producer, _idx) in zip(node.inputs, node.in_links):
+                if producer is not None:
+                    frontier.append(producer)
+                elif (isinstance(t, Tensor) and not t.stop_gradient
+                      and id(t) not in seen_params):
+                    seen_params.add(id(t))
+                    parameter_list.append(t)
+    loss.backward()
     pairs = []
     for p in parameter_list:
         if no_grad_set and getattr(p, "name", None) in no_grad_set:

@@ -511,3 +511,27 @@ class TestStaticScopeFacade:
             assert S.global_scope() is sc
             assert S.global_scope().find_var("inner_var") is not None
         assert S.global_scope().find_var("inner_var") is None
+
+    def test_create_parameter_attr(self):
+        from paddle_tpu.nn.layer.layers import ParamAttr
+        from paddle_tpu.nn import initializer as init
+        w = S.create_parameter(
+            [4], "float32",
+            attr=ParamAttr(name="attr_scale",
+                           initializer=init.Constant(1.0)))
+        np.testing.assert_allclose(np.asarray(w.data), np.ones(4))
+        assert w.name == "attr_scale"
+        assert S.global_scope().find_var("attr_scale") is w
+        frozen = S.create_parameter(
+            [2], "float32", attr=ParamAttr(trainable=False))
+        assert frozen.stop_gradient
+
+    def test_append_backward_discovers_tape_leaves(self, rng):
+        # params created OUTSIDE the scope (static.nn.fc path) must still
+        # be discovered by the default parameter_list tape walk
+        x = tt(rng.randn(4, 6).astype(np.float32))
+        out = S.nn.fc(x, 3, name="ab_fc")
+        pairs = S.append_backward((out * out).mean())
+        assert len(pairs) >= 2  # fc weight + bias
+        for p, g in pairs:
+            assert g is not None and np.isfinite(np.asarray(g.data)).all()
