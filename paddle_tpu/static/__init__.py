@@ -396,16 +396,16 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
     """fluid/layers/tensor.py create_parameter: a trainable Tensor
     registered in the current scope. attr (ParamAttr) supplies
     name/initializer/trainable exactly as the reference's primary
-    customization channel; default_initializer wins over attr.initializer
-    (the reference's precedence). Defaults: Xavier for weights, zeros for
-    bias, via the shared initializer classes so paddle.seed drives the
-    draw."""
+    customization channel; attr.initializer wins over default_initializer
+    (Layer.create_parameter's `attr.initializer or default_initializer`
+    precedence). Defaults: Xavier for weights, zeros for bias, via the
+    shared initializer classes so paddle.seed drives the draw."""
     from ..core.tensor import Tensor
     from ..nn import initializer as init
     from ..nn.layer.layers import ParamAttr
     shape = list(shape)
     attr = ParamAttr._to_attr(attr) if attr is not None else None
-    if default_initializer is None and attr is not None:
+    if attr is not None and attr.initializer is not None:
         default_initializer = attr.initializer
     if default_initializer is None:
         default_initializer = (init.Constant(0.0) if is_bias

@@ -159,3 +159,88 @@ class ImageFolder(Dataset):
 
 class DatasetFolder(ImageFolder):
     pass
+
+
+class Flowers(Dataset):
+    """Flowers-102 (reference vision/datasets/flowers.py). Local layout:
+    data_file npz {images: [N, 3, H, W] uint8, labels: [N]}; optional
+    setid_file npz {train_ids, valid_ids, test_ids} selecting the split
+    (0-based row ids). Without a setid file the split is a deterministic
+    80/10/10 partition so train/test never overlap. Synthetic fallback
+    emits the SAME contract (uint8 CHW) so a transform written against
+    either path behaves identically on the other."""
+
+    _SPLITS = ("train", "valid", "test")
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, backend=None):
+        if mode not in self._SPLITS:
+            raise ValueError(f"mode must be one of {self._SPLITS}")
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            blob = np.load(data_file, allow_pickle=False)
+            images = blob["images"]
+            labels = blob["labels"].astype(np.int64)
+            if setid_file and os.path.exists(setid_file):
+                ids = np.load(setid_file)[f"{mode}_ids"].astype(np.int64)
+            else:
+                n = len(images)
+                a, b = int(0.8 * n), int(0.9 * n)
+                ids = {"train": np.arange(0, a),
+                       "valid": np.arange(a, b),
+                       "test": np.arange(b, n)}[mode]
+            self._images = images[ids]
+            self._labels = labels[ids]
+        else:
+            n = {"train": 128, "valid": 32, "test": 32}[mode]
+            rng = np.random.RandomState(7 + self._SPLITS.index(mode))
+            self._images = rng.randint(
+                0, 256, (n, 3, 64, 64)).astype(np.uint8)
+            self._labels = rng.randint(0, 102, (n,)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img, label = self._images[idx], self._labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self._images)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation (reference vision/datasets/voc2012.py). Local
+    layout: data_file npz {images: [N, 3, H, W] uint8, masks: [N, H, W]
+    uint8 class ids}; the split is an 80/20 deterministic partition by
+    mode. Synthetic fallback emits the same uint8 CHW contract. Returns
+    (image, segmentation_mask)."""
+
+    N_CLASSES = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 backend=None):
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            blob = np.load(data_file, allow_pickle=False)
+            images, masks = blob["images"], blob["masks"]
+            n = len(images)
+            cut = int(0.8 * n)
+            sel = np.arange(0, cut) if mode == "train" \
+                else np.arange(cut, n)
+            self._images, self._masks = images[sel], masks[sel]
+        else:
+            n = 64 if mode == "train" else 16
+            rng = np.random.RandomState(11 if mode == "train" else 12)
+            self._images = rng.randint(
+                0, 256, (n, 3, 64, 64)).astype(np.uint8)
+            self._masks = rng.randint(
+                0, self.N_CLASSES, (n, 64, 64)).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img, mask = self._images[idx], self._masks[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self._images)
