@@ -200,11 +200,14 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        kblk = k_ref[0].astype(jnp.float32)
-        vblk = v_ref[0].astype(jnp.float32)
+        # dots take the input dtype (bf16 on TPU — full MXU rate; fp32 dots
+        # run at a fraction of it) and accumulate fp32 via
+        # preferred_element_type; scale applies post-dot in fp32
+        q = q_ref[0]
+        kblk = k_ref[0]
+        vblk = v_ref[0]
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         s = _apply_mask_block(s, mask_ref, causal, block_q, block_k, q_start,
                               k_start, causal_offset)
         m_prev = m_ref[...]
@@ -223,7 +226,7 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
                                dropout_p)
             p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())),
+            p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[...] = m_new
 
@@ -265,12 +268,13 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        kblk = k_ref[0].astype(jnp.float32)
-        vblk = v_ref[0].astype(jnp.float32)
-        g = g_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q * scale, kblk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        # bf16-in/fp32-accum dots (see _fwd_kernel note)
+        q = q_ref[0]
+        kblk = k_ref[0]
+        vblk = v_ref[0]
+        g = g_ref[0]
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         s = _apply_mask_block(s, mask_ref, causal, block_q, block_k, q_start,
                               k_start, causal_offset)
         lse_col = lse_ref[0]
@@ -284,7 +288,7 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
             dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
         ds = p * (dp - delta_col) * scale
         acc_ref[...] += jax.lax.dot_general(
-            ds, kblk, (((1,), (0,)), ((), ())),
+            ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kb == num_kb - 1)
@@ -319,12 +323,13 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        kblk = k_ref[0].astype(jnp.float32)
-        vblk = v_ref[0].astype(jnp.float32)
-        g = g_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q * scale, kblk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        # bf16-in/fp32-accum dots (see _fwd_kernel note)
+        q = q_ref[0]
+        kblk = k_ref[0]
+        vblk = v_ref[0]
+        g = g_ref[0]
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         s = _apply_mask_block(s, mask_ref, causal, block_q, block_k, q_start,
                               k_start, causal_offset)
         lse_col = lse_ref[0]
@@ -344,10 +349,10 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, causal_offset,
         ds = p * (dp - delta_col) * scale
         # dv += p_drop^T @ g ; dk += ds^T @ q
         dv_acc[...] += jax.lax.dot_general(
-            p_drop, g, (((0,), (0,)), ((), ())),
+            p_drop.astype(g.dtype), g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == num_qb - 1)

@@ -219,6 +219,10 @@ class VOC2012(Dataset):
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  backend=None):
+        if mode not in ("train", "test"):
+            raise ValueError(
+                f"mode must be 'train' or 'test' (no valid split in the "
+                f"80/20 partition), got {mode!r}")
         self.transform = transform
         if data_file and os.path.exists(data_file):
             blob = np.load(data_file, allow_pickle=False)

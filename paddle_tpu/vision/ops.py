@@ -27,7 +27,10 @@ __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
            "iou_similarity", "bipartite_match", "multiclass_nms",
            "matrix_nms", "distribute_fpn_proposals", "generate_proposals",
            "deform_conv2d", "psroi_pool", "affine_channel", "correlation",
-           "read_file", "decode_jpeg"]
+           "read_file", "decode_jpeg", "yolo_loss", "density_prior_box",
+           "collect_fpn_proposals", "sampling_id", "rpn_target_assign",
+           "generate_proposal_labels", "prroi_pool", "im2sequence",
+           "retinanet_target_assign", "locality_aware_nms"]
 
 
 def _iou_matrix(boxes_a, boxes_b, offset=0.0):
@@ -962,3 +965,667 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return Tensor(np.ascontiguousarray(arr))
+
+
+def _sigmoid_ce(x, label):
+    """Numerically-stable sigmoid cross-entropy used by the YOLOv3 loss
+    (yolov3_loss_op.h SigmoidCrossEntropy): max(x,0) - x*z + log1p(exp(-|x|))."""
+    return (jnp.maximum(x, 0.0) - x * label
+            + jnp.log1p(jnp.exp(-jnp.abs(x))))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (yolov3_loss_op.h Yolov3LossKernel; 2.x surface
+    paddle.vision.ops.yolo_loss).
+
+    x [N, M*(5+C), H, W] raw head output, gt_box [N, B, 4] (cx, cy, w, h,
+    normalized to the image), gt_label [N, B] int, optional gt_score [N, B]
+    (mixup weight). Returns per-image loss [N].
+
+    TPU-native design: the reference hand-writes the gradient kernel; here
+    the loss is pure jnp (the ignore/objectness masks and the gt->anchor
+    matching are stop-gradient index computations, exactly the terms the
+    reference treats as constants), so jax.grad IS the backward — one code
+    path, no grad kernel to keep in sync."""
+    import numpy as np
+    anchors = list(anchors)
+    anchor_mask = list(anchor_mask)
+    M = len(anchor_mask)
+    an_num = len(anchors) // 2
+
+    def f(xt, gb, gl, *rest):
+        gs = rest[0] if rest else None
+        N, _, H, W = xt.shape
+        C = class_num
+        input_size = downsample_ratio * H
+        xr = xt.reshape(N, M, 5 + C, H, W).astype(jnp.float32)
+        gb = gb.astype(jnp.float32)
+        scale = scale_x_y
+        bias = -0.5 * (scale - 1.0)
+        if gs is None:
+            gs = jnp.ones(gb.shape[:2], jnp.float32)
+        else:
+            gs = gs.astype(jnp.float32)
+
+        # -- decoded pred boxes (grid_size == H == W per the op contract) --
+        cols = jnp.arange(W, dtype=jnp.float32)[None, :]
+        rows = jnp.arange(H, dtype=jnp.float32)[:, None]
+        sig = jax.nn.sigmoid
+        px = (cols + sig(xr[:, :, 0]) * scale + bias) / H   # [N,M,H,W]
+        py = (rows + sig(xr[:, :, 1]) * scale + bias) / H
+        aw = jnp.asarray([anchors[2 * m] for m in anchor_mask], jnp.float32)
+        ah = jnp.asarray([anchors[2 * m + 1] for m in anchor_mask],
+                         jnp.float32)
+        pw = jnp.exp(xr[:, :, 2]) * aw[None, :, None, None] / input_size
+        ph = jnp.exp(xr[:, :, 3]) * ah[None, :, None, None] / input_size
+
+        valid = (gb[:, :, 2] >= 1e-6) & (gb[:, :, 3] >= 1e-6)  # [N,B]
+
+        # centered-box IoU of every pred vs every gt: [N,M,H,W,B]
+        def _overlap(c1, w1, c2, w2):
+            left = jnp.maximum(c1 - w1 / 2, c2 - w2 / 2)
+            right = jnp.minimum(c1 + w1 / 2, c2 + w2 / 2)
+            return right - left
+        gx = gb[:, None, None, None, :, 0]
+        gy = gb[:, None, None, None, :, 1]
+        gw = gb[:, None, None, None, :, 2]
+        gh = gb[:, None, None, None, :, 3]
+        ow = _overlap(px[..., None], pw[..., None], gx, gw)
+        oh = _overlap(py[..., None], ph[..., None], gy, gh)
+        inter = jnp.where((ow < 0) | (oh < 0), 0.0, ow * oh)
+        union = (pw * ph)[..., None] + gw * gh - inter
+        iou = inter / jnp.maximum(union, 1e-10)
+        iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+        best_iou = jnp.max(iou, axis=-1) if iou.shape[-1] else \
+            jnp.zeros_like(px)
+        # objectness mask: -1 = ignored, 0 = negative, score = positive
+        obj_mask = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)
+        obj_mask = lax.stop_gradient(obj_mask)
+
+        # -- per-gt best anchor over ALL anchors by shifted (w/h-only) IoU --
+        aw_all = jnp.asarray(anchors[0::2], jnp.float32) / input_size
+        ah_all = jnp.asarray(anchors[1::2], jnp.float32) / input_size
+        ow_a = jnp.minimum(gb[:, :, None, 2], aw_all[None, None, :])
+        oh_a = jnp.minimum(gb[:, :, None, 3], ah_all[None, None, :])
+        inter_a = ow_a * oh_a
+        union_a = gb[:, :, 2:3] * gb[:, :, 3:4] + \
+            (aw_all * ah_all)[None, None, :] - inter_a
+        best_n = jnp.argmax(inter_a / jnp.maximum(union_a, 1e-10),
+                            axis=-1)  # [N,B], first max wins like the C++
+        mask_lut = -jnp.ones(an_num, jnp.int32)
+        mask_lut = mask_lut.at[jnp.asarray(anchor_mask)].set(
+            jnp.arange(M, dtype=jnp.int32))
+        mask_idx = mask_lut[best_n]                       # [N,B]
+        matched = valid & (mask_idx >= 0)
+
+        gi = jnp.clip((gb[:, :, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gb[:, :, 1] * H).astype(jnp.int32), 0, H - 1)
+
+        if use_label_smooth:
+            smooth = min(1.0 / class_num, 1.0 / 40)
+            pos, neg = 1.0 - smooth, smooth
+        else:
+            pos, neg = 1.0, 0.0
+
+        B = gb.shape[1]
+        n_idx = jnp.arange(N)
+        loss = jnp.zeros((N,), jnp.float32)
+        safe_mask = jnp.maximum(mask_idx, 0)
+        for t in range(B):  # static small (max boxes per image)
+            m_t = safe_mask[:, t]
+            sel = matched[:, t]
+            sc = gs[:, t]
+            gi_t, gj_t = gi[:, t], gj[:, t]
+            cell = xr[n_idx, m_t, :, gj_t, gi_t]          # [N, 5+C]
+            tx = gb[:, t, 0] * W - gi_t
+            ty = gb[:, t, 1] * H - gj_t
+            tw = jnp.log(jnp.maximum(
+                gb[:, t, 2] * input_size, 1e-9) / aw[m_t] / 1.0)
+            th = jnp.log(jnp.maximum(
+                gb[:, t, 3] * input_size, 1e-9) / ah[m_t] / 1.0)
+            wscale = (2.0 - gb[:, t, 2] * gb[:, t, 3]) * sc
+            loc = (_sigmoid_ce(cell[:, 0], tx) + _sigmoid_ce(cell[:, 1], ty)
+                   + jnp.abs(cell[:, 2] - tw)
+                   + jnp.abs(cell[:, 3] - th)) * wscale
+            lbl = jax.nn.one_hot(gl[:, t], C) * (pos - neg) + neg
+            cls = jnp.sum(_sigmoid_ce(cell[:, 5:], lbl), axis=-1) * sc
+            loss = loss + jnp.where(sel, loc + cls, 0.0)
+            # positive objectness: write the mixup score (last gt wins,
+            # overwriting the ignore pass — same order as the C++ loops)
+            obj_mask = jnp.where(
+                (jnp.arange(M)[None, :, None, None] == m_t[:, None, None,
+                                                           None])
+                & (jnp.arange(H)[None, None, :, None] == gj_t[:, None, None,
+                                                              None])
+                & (jnp.arange(W)[None, None, None, :] == gi_t[:, None, None,
+                                                              None])
+                & sel[:, None, None, None],
+                sc[:, None, None, None], obj_mask)
+
+        obj_logit = xr[:, :, 4]
+        pos_l = _sigmoid_ce(obj_logit, 1.0) * obj_mask
+        neg_l = _sigmoid_ce(obj_logit, 0.0)
+        obj_loss = jnp.where(obj_mask > 1e-5, pos_l,
+                             jnp.where(obj_mask > -0.5, neg_l, 0.0))
+        loss = loss + jnp.sum(obj_loss, axis=(1, 2, 3))
+        return loss
+
+    args = [_t(x), _t(gt_box), _t(gt_label)]
+    if gt_score is not None:
+        args.append(_t(gt_score))
+    return apply(f, *args)
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """density_prior_box_op.h: SSD-style density prior boxes. input [N,C,H,W]
+    feature map, image [N,C,Hi,Wi]. Returns (boxes, variances) shaped
+    [H, W, P, 4] (or [H*W*P, 4] with flatten_to_2d)."""
+    import numpy as np
+    feat = np.asarray(_t(input).data)
+    img = np.asarray(_t(image).data)
+    H, W = feat.shape[2], feat.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    step_w = steps[0] or img_w / W
+    step_h = steps[1] or img_h / H
+    step_average = int((step_w + step_h) * 0.5)
+    P = sum(len(fixed_ratios) * (d ** 2) for d in densities)
+    boxes = np.zeros((H, W, P, 4), np.float32)
+    for h in range(H):
+        for w in range(W):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            idx = 0
+            for fs, density in zip(fixed_sizes, densities):
+                shift = step_average // density
+                for r in fixed_ratios:
+                    bw = fs * np.sqrt(r)
+                    bh = fs / np.sqrt(r)
+                    dcx = cx - step_average / 2.0 + shift / 2.0
+                    dcy = cy - step_average / 2.0 + shift / 2.0
+                    for di in range(density):
+                        for dj in range(density):
+                            x0 = dcx + dj * shift
+                            y0 = dcy + di * shift
+                            boxes[h, w, idx] = [
+                                max((x0 - bw / 2.0) / img_w, 0.0),
+                                max((y0 - bh / 2.0) / img_h, 0.0),
+                                min((x0 + bw / 2.0) / img_w, 1.0),
+                                min((y0 + bh / 2.0) / img_h, 1.0)]
+                            idx += 1
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    vars_ = np.broadcast_to(
+        np.asarray(variance, np.float32), (H, W, P, 4)).copy()
+    from ..tensor.creation import to_tensor
+    if flatten_to_2d:
+        return to_tensor(boxes.reshape(-1, 4)), to_tensor(
+            vars_.reshape(-1, 4))
+    return to_tensor(boxes), to_tensor(vars_)
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None, name=None):
+    """collect_fpn_proposals_op.h: concat per-level RPN outputs, keep the
+    global top post_nms_top_n by score (stable on ties, like the
+    reference's std::stable_sort), then regroup by image. multi_rois /
+    multi_scores: lists (one per level) of [Ni, 4] / [Ni, 1] tensors;
+    rois_num_per_level: optional list of [batch] int tensors. Returns
+    (fpn_rois [R, 4], rois_num [batch]) — rois_num only when
+    rois_num_per_level is given, mirroring the RoisNum output contract."""
+    import numpy as np
+    n_level = len(multi_rois)
+    assert len(multi_scores) == n_level
+    rois, scores, batch_ids = [], [], []
+    for i in range(n_level):
+        r = np.asarray(_t(multi_rois[i]).data, np.float32).reshape(-1, 4)
+        s = np.asarray(_t(multi_scores[i]).data, np.float32).reshape(-1)
+        rois.append(r)
+        scores.append(s)
+        if rois_num_per_level is not None:
+            counts = np.asarray(_t(rois_num_per_level[i]).data,
+                                np.int64).reshape(-1)
+            batch_ids.append(np.repeat(np.arange(len(counts)), counts))
+        else:
+            batch_ids.append(np.zeros(len(s), np.int64))
+    rois = np.concatenate(rois) if rois else np.zeros((0, 4), np.float32)
+    scores = np.concatenate(scores) if scores else np.zeros(0, np.float32)
+    batch_ids = np.concatenate(batch_ids) if batch_ids else \
+        np.zeros(0, np.int64)
+    keep = np.argsort(-scores, kind="stable")[:post_nms_top_n]
+    # regroup by image, preserving score order inside an image
+    order = np.argsort(batch_ids[keep], kind="stable")
+    keep = keep[order]
+    from ..tensor.creation import to_tensor
+    out = to_tensor(rois[keep])
+    if rois_num_per_level is None:
+        return out
+    # batch size comes from the count vectors (an image with zero rois at
+    # every level must still get a rois_num row)
+    n_batch = len(np.asarray(_t(rois_num_per_level[0]).data).reshape(-1))
+    rois_num = np.bincount(batch_ids[keep], minlength=n_batch)
+    return out, to_tensor(rois_num.astype(np.int32))
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64", name=None):
+    """sampling_id_op.h: sample one column index per row of a [batch, width]
+    probability matrix by inverse-CDF walk. Seeded jax PRNG replaces the
+    reference's std::mt19937 (bit-exactness across engines is not part of
+    the op contract; the distribution is)."""
+    import numpy as np
+    p = np.asarray(_t(x).data, np.float64)
+    rng = np.random.RandomState(seed if seed else None)
+    u = rng.uniform(min, max, size=p.shape[0])
+    cdf = np.cumsum(p, axis=1)
+    ids = (cdf < u[:, None]).sum(axis=1).clip(0, p.shape[1] - 1)
+    from ..tensor.creation import to_tensor
+    return to_tensor(ids.astype(np.int64 if dtype == "int64" else np.int32))
+
+
+def _encode_deltas(ex, gt, weights=(1.0, 1.0, 1.0, 1.0)):
+    """BoxToDelta (bbox_util.h): (x1,y1,x2,y2) ex/gt -> (dx,dy,dw,dh) with
+    per-coordinate weights; the reference's 'normalized' boxes convention
+    (no +1 on widths)."""
+    import numpy as np
+    ew = np.maximum(ex[:, 2] - ex[:, 0], 1e-6)
+    eh = np.maximum(ex[:, 3] - ex[:, 1], 1e-6)
+    ecx = ex[:, 0] + ew / 2
+    ecy = ex[:, 1] + eh / 2
+    gw = np.maximum(gt[:, 2] - gt[:, 0], 1e-6)
+    gh = np.maximum(gt[:, 3] - gt[:, 1], 1e-6)
+    gcx = gt[:, 0] + gw / 2
+    gcy = gt[:, 1] + gh / 2
+    wx, wy, ww, wh = weights
+    return np.stack([
+        (gcx - ecx) / ew / wx, (gcy - ecy) / eh / wy,
+        np.log(gw / ew) / ww, np.log(gh / eh) / wh], axis=1)
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var, gt_boxes,
+                      is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True, seed=0):
+    """rpn_target_assign_op.cc: sample fg/bg anchors and build RPN training
+    targets for ONE image. anchor_box [A, 4], gt_boxes [G, 4] (image
+    coordinates), im_info [3] = (h, w, scale). Host-side eager op (the
+    reference kernel is CPU-only too); sampling uses a seeded numpy RNG in
+    place of std::minstd_rand — set use_random=False for deterministic
+    parity with tests.
+
+    Returns (loc_index, score_index, tgt_bbox, tgt_label, bbox_inside_weight)
+    matching the reference's output contract (loc_index indexes into the
+    straddle-filtered anchor set mapped back to the full anchor ids).
+
+    Divergence note: the reference replays Detectron's double-assignment
+    quirk by inserting 'fake fg' rows when a sampled bg anchor was already
+    labelled fg; this implementation instead removes such anchors from the
+    bg pool before sampling (the statistically-intended behavior), which
+    changes nothing when the fg/bg pools are disjoint (the common case)."""
+    import numpy as np
+    anchors = np.asarray(_t(anchor_box).data, np.float32).reshape(-1, 4)
+    gts = np.asarray(_t(gt_boxes).data, np.float32).reshape(-1, 4)
+    A = anchors.shape[0]
+    rng = np.random.RandomState(seed if seed else None)
+
+    # straddle filter: keep anchors inside the image (+thresh)
+    if im_info is not None and rpn_straddle_thresh >= 0:
+        info = np.asarray(_t(im_info).data, np.float32).reshape(-1)
+        im_h, im_w = float(info[0]), float(info[1])
+        inside = ((anchors[:, 0] >= -rpn_straddle_thresh)
+                  & (anchors[:, 1] >= -rpn_straddle_thresh)
+                  & (anchors[:, 2] < im_w + rpn_straddle_thresh)
+                  & (anchors[:, 3] < im_h + rpn_straddle_thresh))
+        inds_inside = np.nonzero(inside)[0]
+    else:
+        inds_inside = np.arange(A)
+    an = anchors[inds_inside]
+    if is_crowd is not None:
+        crowd = np.asarray(_t(is_crowd).data).reshape(-1).astype(bool)
+        gts = gts[~crowd]
+    G = gts.shape[0]
+    iou = np.zeros((len(an), max(G, 1)), np.float32)
+    if G:
+        iou = np.asarray(_iou_matrix(jnp.asarray(an), jnp.asarray(gts)))
+    anchor_to_gt_max = iou.max(axis=1)
+    anchor_to_gt_argmax = iou.argmax(axis=1)
+    gt_to_anchor_max = iou.max(axis=0) if (G and len(an)) \
+        else np.zeros(G, np.float32)
+
+    # fg: max-overlap-per-gt anchors (within eps) or IoU >= pos_thresh
+    eps = 1e-5
+    is_max = (np.abs(iou - gt_to_anchor_max[None, :]) < eps).any(axis=1) \
+        if G else np.zeros(len(an), bool)
+    fg_pool = np.nonzero(is_max | (anchor_to_gt_max
+                                   >= rpn_positive_overlap))[0]
+    fg_num = int(rpn_fg_fraction * rpn_batch_size_per_im)
+    if len(fg_pool) > fg_num:
+        fg_inds = rng.choice(fg_pool, fg_num, replace=False) if use_random \
+            else fg_pool[:fg_num]
+    else:
+        fg_inds = fg_pool
+    bg_pool = np.nonzero((anchor_to_gt_max < rpn_negative_overlap)
+                         & ~np.isin(np.arange(len(an)), fg_inds))[0]
+    bg_num = rpn_batch_size_per_im - len(fg_inds)
+    if len(bg_pool) > bg_num:
+        bg_inds = rng.choice(bg_pool, bg_num, replace=False) if use_random \
+            else bg_pool[:bg_num]
+    else:
+        bg_inds = bg_pool
+
+    tgt_bbox = np.zeros((len(fg_inds), 4), np.float32)
+    if G and len(fg_inds):
+        tgt_bbox = _encode_deltas(an[fg_inds],
+                                  gts[anchor_to_gt_argmax[fg_inds]])
+    loc_index = inds_inside[fg_inds].astype(np.int32)
+    score_index = inds_inside[
+        np.concatenate([fg_inds, bg_inds]).astype(np.int64)].astype(np.int32)
+    tgt_label = np.concatenate([
+        np.ones(len(fg_inds), np.int32),
+        np.zeros(len(bg_inds), np.int32)])
+    bbox_inside_weight = np.ones_like(tgt_bbox)
+    from ..tensor.creation import to_tensor
+    return (to_tensor(loc_index), to_tensor(score_index),
+            to_tensor(tgt_bbox), to_tensor(tgt_label),
+            to_tensor(bbox_inside_weight))
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=True,
+                             is_cls_agnostic=False, seed=0):
+    """generate_proposal_labels_op.cc: sample RoIs for the RCNN head of ONE
+    image and build classification/regression targets. rpn_rois [R, 4] in
+    image coords, gt_boxes [G, 4], gt_classes [G], im_info [3] (h, w,
+    scale). Gt boxes join the candidate pool (same as the reference's
+    concat). Host-side eager, seeded sampling.
+
+    Returns (rois, labels_int32, bbox_targets, bbox_inside_weights,
+    bbox_outside_weights) with bbox_* expanded to 4*class_nums columns,
+    one-hot by class like the reference's _expand_bbox_targets."""
+    import numpy as np
+    rois = np.asarray(_t(rpn_rois).data, np.float32).reshape(-1, 4)
+    gts = np.asarray(_t(gt_boxes).data, np.float32).reshape(-1, 4)
+    cls = np.asarray(_t(gt_classes).data).reshape(-1).astype(np.int64)
+    crowd = np.asarray(_t(is_crowd).data).reshape(-1).astype(bool)
+    rng = np.random.RandomState(seed if seed else None)
+    keep_gt = ~crowd
+    gts_k, cls_k = gts[keep_gt], cls[keep_gt]
+    boxes = np.concatenate([rois, gts_k], axis=0)
+    G = gts_k.shape[0]
+    iou = np.zeros((len(boxes), max(G, 1)), np.float32)
+    if G:
+        iou = np.asarray(_iou_matrix(jnp.asarray(boxes), jnp.asarray(gts_k)))
+    max_ov = iou.max(axis=1)
+    argmax_ov = iou.argmax(axis=1)
+
+    fg_pool = np.nonzero(max_ov >= fg_thresh)[0]
+    fg_num = min(int(fg_fraction * batch_size_per_im), len(fg_pool))
+    if len(fg_pool) > fg_num:
+        fg_inds = rng.choice(fg_pool, fg_num, replace=False) if use_random \
+            else fg_pool[:fg_num]
+    else:
+        fg_inds = fg_pool
+    bg_pool = np.nonzero((max_ov < bg_thresh_hi)
+                         & (max_ov >= bg_thresh_lo))[0]
+    bg_num = min(batch_size_per_im - len(fg_inds), len(bg_pool))
+    if len(bg_pool) > bg_num:
+        bg_inds = rng.choice(bg_pool, bg_num, replace=False) if use_random \
+            else bg_pool[:bg_num]
+    else:
+        bg_inds = bg_pool
+
+    sampled = np.concatenate([fg_inds, bg_inds]).astype(np.int64)
+    out_rois = boxes[sampled]
+    labels = np.concatenate([
+        cls_k[argmax_ov[fg_inds]] if G else np.zeros(0, np.int64),
+        np.zeros(len(bg_inds), np.int64)]).astype(np.int32)
+    if is_cls_agnostic:
+        labels = np.minimum(labels, 1)
+
+    deltas = np.zeros((len(sampled), 4), np.float32)
+    if G and len(fg_inds):
+        deltas[:len(fg_inds)] = _encode_deltas(
+            boxes[fg_inds], gts_k[argmax_ov[fg_inds]], bbox_reg_weights)
+    ncls = 2 if is_cls_agnostic else class_nums
+    bbox_targets = np.zeros((len(sampled), 4 * ncls), np.float32)
+    inside_w = np.zeros_like(bbox_targets)
+    for i in range(len(fg_inds)):
+        c = int(labels[i])
+        if c > 0:
+            bbox_targets[i, 4 * c:4 * c + 4] = deltas[i]
+            inside_w[i, 4 * c:4 * c + 4] = 1.0
+    outside_w = (inside_w > 0).astype(np.float32)
+    from ..tensor.creation import to_tensor
+    return (to_tensor(out_rois), to_tensor(labels),
+            to_tensor(bbox_targets), to_tensor(inside_w),
+            to_tensor(outside_w))
+
+
+def prroi_pool(x, rois, pooled_height, pooled_width, spatial_scale=1.0,
+               batch_roi_nums=None, name=None):
+    """prroi_pool_op.h: Precise RoI pooling — each output bin is the EXACT
+    integral of the bilinearly-interpolated feature surface over the bin,
+    divided by the bin area (no sampling-point approximation). x [N,C,H,W],
+    rois [R,4] in image coords, batch_roi_nums [N] int (rois per image;
+    defaults to all rois on image 0). Host-side eager op; the per-cell
+    closed form matches PrRoIPoolingMatCalculation's separable weights."""
+    import numpy as np
+    feat = np.asarray(_t(x).data, np.float64)
+    r = np.asarray(_t(rois).data, np.float64).reshape(-1, 4)
+    N, C, H, W = feat.shape
+    R = r.shape[0]
+    if batch_roi_nums is not None:
+        counts = np.asarray(_t(batch_roi_nums).data).reshape(-1)
+        batch_ids = np.repeat(np.arange(len(counts)), counts)
+    else:
+        batch_ids = np.zeros(R, np.int64)
+
+    def cell_1d(lo, hi, s):
+        """Weights of f[s] and f[s+1] for the integral of the linear interp
+        over [lo, hi] within cell [s, s+1]."""
+        a, b = lo - s, hi - s
+        w0 = (b - 0.5 * b * b) - (a - 0.5 * a * a)
+        w1 = 0.5 * (b * b - a * a)
+        return w0, w1
+
+    def val(c_map, h, w):
+        if h < 0 or w < 0 or h >= H or w >= W:
+            return 0.0
+        return c_map[h, w]
+
+    out = np.zeros((R, C, pooled_height, pooled_width), np.float64)
+    for n in range(R):
+        bi = int(batch_ids[n])
+        x0r = r[n, 0] * spatial_scale
+        y0r = r[n, 1] * spatial_scale
+        x1r = r[n, 2] * spatial_scale
+        y1r = r[n, 3] * spatial_scale
+        bw = max(x1r - x0r, 0.0) / pooled_width
+        bh = max(y1r - y0r, 0.0) / pooled_height
+        win = bw * bh
+        if win <= 0:
+            continue
+        for c in range(C):
+            fmap = feat[bi, c]
+            for ph in range(pooled_height):
+                for pw in range(pooled_width):
+                    yy0, yy1 = y0r + ph * bh, y0r + (ph + 1) * bh
+                    xx0, xx1 = x0r + pw * bw, x0r + (pw + 1) * bw
+                    acc = 0.0
+                    sh = int(np.floor(yy0))
+                    while sh < yy1:
+                        eh = sh + 1
+                        cy0, cy1 = max(yy0, sh), min(yy1, eh)
+                        wy0, wy1 = cell_1d(cy0, cy1, sh)
+                        sw = int(np.floor(xx0))
+                        while sw < xx1:
+                            ew = sw + 1
+                            cx0, cx1 = max(xx0, sw), min(xx1, ew)
+                            wx0, wx1 = cell_1d(cx0, cx1, sw)
+                            acc += (val(fmap, sh, sw) * wy0 * wx0
+                                    + val(fmap, sh, ew) * wy0 * wx1
+                                    + val(fmap, eh, sw) * wy1 * wx0
+                                    + val(fmap, eh, ew) * wy1 * wx1)
+                            sw += 1
+                        sh += 1
+                    out[n, c, ph, pw] = acc / win
+    from ..tensor.creation import to_tensor
+    return to_tensor(out.astype(np.float32))
+
+
+def im2sequence(input, kernels, strides=(1, 1), paddings=(0, 0, 0, 0),
+                name=None):
+    """im2sequence_op.h: slide a kernels[0] x kernels[1] window over
+    [N, C, H, W] and emit one sequence row per window position:
+    [N*out_h*out_w, C*kh*kw] with (c, kh, kw) feature order — the LoD
+    groups rows by image. Differentiable (conv_general_dilated_patches)."""
+
+    def f(xt):
+        kh, kw = kernels
+        ph0, pw0, ph1, pw1 = paddings
+        patches = lax.conv_general_dilated_patches(
+            xt, (kh, kw), tuple(strides),
+            [(ph0, ph1), (pw0, pw1)])  # [N, C*kh*kw, oh, ow]
+        N, F, oh, ow = patches.shape
+        return patches.transpose(0, 2, 3, 1).reshape(N * oh * ow, F)
+
+    return apply(f, _t(input))
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None, im_info=None,
+                            positive_overlap=0.5, negative_overlap=0.4,
+                            seed=0):
+    """RetinaNet target assign (rpn_target_assign_op.cc:609): like
+    rpn_target_assign but with NO fg/bg sampling (focal loss consumes every
+    anchor), fg labels = the matched gt class, and a ForegroundNumber
+    output (fg count + 1, the reference's focal-loss normalizer). One
+    image per call, host-side eager."""
+    import numpy as np
+    anchors = np.asarray(_t(anchor_box).data, np.float32).reshape(-1, 4)
+    gts = np.asarray(_t(gt_boxes).data, np.float32).reshape(-1, 4)
+    glbl = np.asarray(_t(gt_labels).data).reshape(-1).astype(np.int64)
+    if is_crowd is not None:
+        crowd = np.asarray(_t(is_crowd).data).reshape(-1).astype(bool)
+        gts, glbl = gts[~crowd], glbl[~crowd]
+    A, G = anchors.shape[0], gts.shape[0]
+    iou = np.zeros((A, max(G, 1)), np.float32)
+    if G:
+        iou = np.asarray(_iou_matrix(jnp.asarray(anchors), jnp.asarray(gts)))
+    a2g_max = iou.max(axis=1)
+    a2g_arg = iou.argmax(axis=1)
+    g2a_max = iou.max(axis=0) if G else np.zeros(0, np.float32)
+    is_max = (np.abs(iou - g2a_max[None, :]) < 1e-5).any(axis=1) \
+        if G else np.zeros(A, bool)
+    fg_inds = np.nonzero(is_max | (a2g_max >= positive_overlap))[0]
+    bg_inds = np.nonzero((a2g_max < negative_overlap)
+                         & ~np.isin(np.arange(A), fg_inds))[0]
+    tgt_bbox = np.zeros((len(fg_inds), 4), np.float32)
+    if G and len(fg_inds):
+        tgt_bbox = _encode_deltas(anchors[fg_inds], gts[a2g_arg[fg_inds]])
+    labels = np.concatenate([
+        glbl[a2g_arg[fg_inds]] if G else np.zeros(0, np.int64),
+        np.zeros(len(bg_inds), np.int64)]).astype(np.int32)
+    score_index = np.concatenate([fg_inds, bg_inds]).astype(np.int32)
+    from ..tensor.creation import to_tensor
+    return (to_tensor(fg_inds.astype(np.int32)), to_tensor(score_index),
+            to_tensor(tgt_bbox), to_tensor(labels),
+            to_tensor(np.ones_like(tgt_bbox)),
+            to_tensor(np.array([len(fg_inds) + 1], np.int32)))
+
+
+def locality_aware_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                       keep_top_k=100, nms_threshold=0.3, normalized=True,
+                       background_label=-1, name=None):
+    """locality_aware_nms_op.cc (EAST text detection): a locality-aware
+    pre-pass scans boxes IN INPUT ORDER, score-weighted-merging each box
+    into the running accumulator while their IoU exceeds nms_threshold
+    (scores add up), then runs standard per-class greedy NMS over the
+    merged set. bboxes [1, M, 4]; scores [1, C, M]. Axis-aligned
+    (box_size 4) only — the reference's quad/polygon variants
+    (box_size 8/16/24/32, PolyIoU over gpc polygon clipping) raise.
+    Returns (out [K, 6], rois_num [1]) like multiclass_nms."""
+    import numpy as np
+    b = np.asarray(_t(bboxes).data, np.float32).copy()
+    s = np.asarray(_t(scores).data, np.float32).copy()
+    if b.shape[-1] != 4:
+        raise NotImplementedError(
+            "locality_aware_nms supports axis-aligned boxes (box_size 4); "
+            "the polygon variants need gpc-style clipping (reference "
+            "detection/poly_util.h)")
+    off = 0.0 if normalized else 1.0
+    N, C, M = s.shape
+    assert N == 1, "locality_aware_nms is single-image (reference contract)"
+
+    def _iou1(a, bb):
+        # pure numpy: the merge pass compares against a mutating
+        # accumulator box, so this runs per pair — a jnp round-trip here
+        # would cost a device dispatch per comparison
+        aw = max(a[2] - a[0] + off, 0.0) * max(a[3] - a[1] + off, 0.0)
+        bw = max(bb[2] - bb[0] + off, 0.0) * max(bb[3] - bb[1] + off, 0.0)
+        iw = min(a[2], bb[2]) - max(a[0], bb[0]) + off
+        ih = min(a[3], bb[3]) - max(a[1], bb[1]) + off
+        inter = max(iw, 0.0) * max(ih, 0.0)
+        denom = aw + bw - inter
+        return inter / denom if denom > 0 else 0.0
+
+    def _iou_np(boxes):
+        area = np.maximum(boxes[:, 2] - boxes[:, 0] + off, 0) * \
+            np.maximum(boxes[:, 3] - boxes[:, 1] + off, 0)
+        lt = np.maximum(boxes[:, None, :2], boxes[None, :, :2])
+        rb = np.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+        wh = np.maximum(rb - lt + off, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        union = area[:, None] + area[None, :] - inter
+        return inter / np.maximum(union, 1e-10)
+
+    rows = []
+    for c in range(C):
+        if c == background_label:
+            continue
+        boxes_c = b[0].copy()
+        sc = s[0, c].copy()
+        # locality-aware merge pass (GetMaxScoreIndexWithLocalityAware)
+        skip = np.ones(M, bool)
+        index = -1
+        for i in range(M):
+            if index > -1:
+                if _iou1(boxes_c[i], boxes_c[index]) > nms_threshold:
+                    s1, s2 = float(sc[i]), float(sc[index])
+                    if s1 + s2 > 0:  # both-zero: keep accumulator as-is
+                        boxes_c[index] = (boxes_c[i] * s1
+                                          + boxes_c[index] * s2) / (s1 + s2)
+                    sc[index] += sc[i]
+                else:
+                    skip[index] = False
+                    index = i
+            else:
+                index = i
+        if index > -1:
+            skip[index] = False
+        cand = np.nonzero((sc > score_threshold) & ~skip)[0]
+        order = cand[np.argsort(-sc[cand], kind="stable")]
+        if nms_top_k > -1:
+            order = order[:nms_top_k]
+        # standard greedy NMS over merged boxes: one vectorized IoU matrix
+        iou = _iou_np(boxes_c[order]) if len(order) else None
+        keep, keep_pos = [], []
+        for oi, i in enumerate(order):
+            if all(iou[oi, kj] <= nms_threshold for kj in keep_pos):
+                keep.append(i)
+                keep_pos.append(oi)
+        for i in keep:
+            rows.append([float(c), sc[i], *boxes_c[i]])
+    rows.sort(key=lambda r: -r[1])
+    if keep_top_k > -1:
+        rows = rows[:keep_top_k]
+    out = np.asarray(rows, np.float32).reshape(-1, 6)
+    from ..tensor.creation import to_tensor
+    return to_tensor(out), to_tensor(np.asarray([len(rows)], np.int32))

@@ -1,0 +1,412 @@
+"""Op-zoo batch 7 numerics: yolo_loss vs a straight numpy port of the
+reference loops (yolov3_loss_op.h), density_prior_box vs the reference's
+nested-loop semantics, collect_fpn_proposals ordering contract,
+rpn_target_assign / generate_proposal_labels invariants, sampling_id
+distribution."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+# ---------------- yolo_loss ----------------
+
+def _np_yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                    ignore_thresh, downsample_ratio, gt_score=None,
+                    use_label_smooth=True, scale_x_y=1.0):
+    """Direct port of the C++ reference loops (yolov3_loss_op.h)."""
+
+    def sce(p, z):
+        return max(p, 0.0) - p * z + np.log1p(np.exp(-abs(p)))
+
+    def box_iou_c(b1, b2):
+        def ov(c1, w1, c2, w2):
+            left = max(c1 - w1 / 2, c2 - w2 / 2)
+            right = min(c1 + w1 / 2, c2 + w2 / 2)
+            return right - left
+        w = ov(b1[0], b1[2], b2[0], b2[2])
+        h = ov(b1[1], b1[3], b2[1], b2[3])
+        inter = 0.0 if (w < 0 or h < 0) else w * h
+        union = b1[2] * b1[3] + b2[2] * b2[3] - inter
+        return inter / union if union > 0 else 0.0
+
+    N, _, H, W = x.shape
+    M = len(anchor_mask)
+    an_num = len(anchors) // 2
+    B = gt_box.shape[1]
+    input_size = downsample_ratio * H
+    scale = scale_x_y
+    bias = -0.5 * (scale - 1.0)
+    if gt_score is None:
+        gt_score = np.ones((N, B), np.float32)
+    pos, neg = 1.0, 0.0
+    if use_label_smooth:
+        sm = min(1.0 / class_num, 1.0 / 40)
+        pos, neg = 1.0 - sm, sm
+    xr = x.reshape(N, M, 5 + class_num, H, W)
+    loss = np.zeros(N, np.float64)
+    obj_mask = np.zeros((N, M, H, W), np.float64)
+    valid = (gt_box[:, :, 2] >= 1e-6) & (gt_box[:, :, 3] >= 1e-6)
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    for i in range(N):
+        for j in range(M):
+            for k in range(H):
+                for l in range(W):
+                    px = (l + sig(xr[i, j, 0, k, l]) * scale + bias) / H
+                    py = (k + sig(xr[i, j, 1, k, l]) * scale + bias) / H
+                    pw = np.exp(xr[i, j, 2, k, l]) * \
+                        anchors[2 * anchor_mask[j]] / input_size
+                    ph = np.exp(xr[i, j, 3, k, l]) * \
+                        anchors[2 * anchor_mask[j] + 1] / input_size
+                    best = 0.0
+                    for t in range(B):
+                        if not valid[i, t]:
+                            continue
+                        iou = box_iou_c((px, py, pw, ph), gt_box[i, t])
+                        best = max(best, iou)
+                    if best > ignore_thresh:
+                        obj_mask[i, j, k, l] = -1
+        for t in range(B):
+            if not valid[i, t]:
+                continue
+            gt = gt_box[i, t]
+            gi = int(gt[0] * W)
+            gj = int(gt[1] * H)
+            best_iou, best_n = 0.0, 0
+            for a in range(an_num):
+                ab = (0.0, 0.0, anchors[2 * a] / input_size,
+                      anchors[2 * a + 1] / input_size)
+                iou = box_iou_c(ab, (0.0, 0.0, gt[2], gt[3]))
+                if iou > best_iou:
+                    best_iou, best_n = iou, a
+            mask_idx = anchor_mask.index(best_n) \
+                if best_n in anchor_mask else -1
+            if mask_idx < 0:
+                continue
+            score = gt_score[i, t]
+            tx = gt[0] * W - gi
+            ty = gt[1] * H - gj
+            tw = np.log(gt[2] * input_size / anchors[2 * best_n])
+            th = np.log(gt[3] * input_size / anchors[2 * best_n + 1])
+            sc = (2.0 - gt[2] * gt[3]) * score
+            cell = xr[i, mask_idx, :, gj, gi]
+            loss[i] += sce(cell[0], tx) * sc + sce(cell[1], ty) * sc
+            loss[i] += abs(cell[2] - tw) * sc + abs(cell[3] - th) * sc
+            obj_mask[i, mask_idx, gj, gi] = score
+            lbl = gt_label[i, t]
+            for c in range(class_num):
+                loss[i] += sce(cell[5 + c], pos if c == lbl else neg) * score
+    for i in range(N):
+        for j in range(M):
+            for k in range(H):
+                for l in range(W):
+                    obj = obj_mask[i, j, k, l]
+                    p = xr[i, j, 4, k, l]
+                    if obj > 1e-5:
+                        loss[i] += sce(p, 1.0) * obj
+                    elif obj > -0.5:
+                        loss[i] += sce(p, 0.0)
+    return loss
+
+
+@pytest.mark.parametrize("use_score", [False, True])
+def test_yolo_loss_matches_reference_port(use_score):
+    rng = np.random.RandomState(0)
+    N, H, W, C = 2, 4, 4, 3
+    anchors = [10, 13, 16, 30, 33, 23, 30, 61]
+    anchor_mask = [1, 2]
+    M = len(anchor_mask)
+    x = rng.randn(N, M * (5 + C), H, W).astype(np.float32) * 0.5
+    Bx = 3
+    cx = rng.uniform(0.05, 0.95, (N, Bx))
+    cy = rng.uniform(0.05, 0.95, (N, Bx))
+    w = rng.uniform(0.05, 0.5, (N, Bx))
+    h = rng.uniform(0.05, 0.5, (N, Bx))
+    gt_box = np.stack([cx, cy, w, h], axis=-1).astype(np.float32)
+    gt_box[1, 2] = 0.0  # invalid gt row
+    gt_label = rng.randint(0, C, (N, Bx)).astype(np.int32)
+    gt_score = rng.uniform(0.5, 1.0, (N, Bx)).astype(np.float32) \
+        if use_score else None
+    ref = _np_yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, C,
+                          0.5, 32, gt_score)
+    out = V.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt_box),
+                      paddle.to_tensor(gt_label), anchors, anchor_mask, C,
+                      ignore_thresh=0.5, downsample_ratio=32,
+                      gt_score=(paddle.to_tensor(gt_score)
+                                if use_score else None))
+    np.testing.assert_allclose(np.asarray(out.data), ref, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_yolo_loss_differentiable():
+    rng = np.random.RandomState(1)
+    N, H, W, C = 1, 4, 4, 2
+    anchors = [10, 13, 16, 30]
+    anchor_mask = [0, 1]
+    x = paddle.to_tensor(
+        rng.randn(N, 2 * (5 + C), H, W).astype(np.float32) * 0.3)
+    x.stop_gradient = False
+    gt_box = paddle.to_tensor(
+        np.array([[[0.4, 0.4, 0.3, 0.3]]], np.float32))
+    gt_label = paddle.to_tensor(np.zeros((1, 1), np.int32))
+    loss = V.yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, C,
+                       ignore_thresh=0.7, downsample_ratio=32)
+    loss.sum().backward()
+    g = np.asarray(x.grad.data)
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+# ---------------- density_prior_box ----------------
+
+def test_density_prior_box_reference_semantics():
+    feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    boxes, vars_ = V.density_prior_box(
+        feat, img, densities=[2, 1], fixed_sizes=[8.0, 16.0],
+        fixed_ratios=[1.0], variance=[0.1, 0.1, 0.2, 0.2])
+    b = np.asarray(boxes.data)
+    assert b.shape == (2, 2, 2 * 2 * 1 + 1, 4)
+    # manual first cell, first fixed size (density 2): step 16, avg 16
+    step_avg = 16
+    shift = step_avg // 2
+    cx = (0 + 0.5) * 16.0
+    dcx = cx - step_avg / 2.0 + shift / 2.0
+    x0 = max((dcx - 4.0) / 32.0, 0.0)
+    np.testing.assert_allclose(b[0, 0, 0, 0], x0, rtol=1e-5)
+    v = np.asarray(vars_.data)
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+# ---------------- collect_fpn_proposals ----------------
+
+def test_collect_fpn_proposals_topk_and_grouping():
+    r1 = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 5, 5], [2, 2, 8, 8]], np.float32))
+    s1 = paddle.to_tensor(np.array([[0.9], [0.2], [0.8]], np.float32))
+    n1 = paddle.to_tensor(np.array([2, 1], np.int32))  # 2 imgs
+    r2 = paddle.to_tensor(np.array([[3, 3, 9, 9], [4, 4, 6, 6]], np.float32))
+    s2 = paddle.to_tensor(np.array([[0.95], [0.5]], np.float32))
+    n2 = paddle.to_tensor(np.array([1, 1], np.int32))
+    rois, rois_num = V.collect_fpn_proposals(
+        [r1, r2], [s1, s2], 2, 3, post_nms_top_n=3,
+        rois_num_per_level=[n1, n2])
+    out = np.asarray(rois.data)
+    # top3 scores: 0.95 (lvl2,img0), 0.9 (lvl1,img0), 0.8 (lvl1,img1)
+    # grouped by image: img0 [3,3,9,9],[0,0,10,10]; img1 [2,2,8,8]
+    np.testing.assert_allclose(out[0], [3, 3, 9, 9])
+    np.testing.assert_allclose(out[1], [0, 0, 10, 10])
+    np.testing.assert_allclose(out[2], [2, 2, 8, 8])
+    np.testing.assert_array_equal(np.asarray(rois_num.data), [2, 1])
+
+
+# ---------------- sampling_id ----------------
+
+def test_sampling_id_distribution():
+    p = np.zeros((64, 4), np.float32)
+    p[:, 2] = 1.0  # all mass on column 2
+    ids = V.sampling_id(paddle.to_tensor(p), seed=3)
+    assert np.asarray(ids.data).tolist() == [2] * 64
+
+
+# ---------------- rpn_target_assign ----------------
+
+def test_rpn_target_assign_labels_and_deltas():
+    anchors = np.array([
+        [0, 0, 10, 10],     # IoU with gt0 high
+        [0, 0, 9, 11],
+        [50, 50, 60, 60],   # background
+        [100, 100, 110, 110],
+        [-5, -5, 5, 5],     # straddles image border
+    ], np.float32)
+    gts = np.array([[0, 0, 10, 10]], np.float32)
+    im_info = np.array([120, 120, 1.0], np.float32)
+    loc_i, score_i, tgt_bbox, tgt_label, inw = V.rpn_target_assign(
+        None, None, paddle.to_tensor(anchors), None, paddle.to_tensor(gts),
+        im_info=paddle.to_tensor(im_info), rpn_batch_size_per_im=4,
+        rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+        use_random=False)
+    loc = np.asarray(loc_i.data)
+    lbl = np.asarray(tgt_label.data)
+    si = np.asarray(score_i.data)
+    assert 4 not in si  # straddle-filtered
+    assert 0 in loc  # the max-overlap anchor is fg
+    n_fg = int((lbl == 1).sum())
+    assert n_fg == len(loc)
+    # fg deltas vs the matched gt are ~0 for the identical box
+    d = np.asarray(tgt_bbox.data)
+    i0 = list(loc).index(0)
+    np.testing.assert_allclose(d[i0], np.zeros(4), atol=1e-5)
+    assert np.asarray(inw.data).shape == d.shape
+
+
+# ---------------- generate_proposal_labels ----------------
+
+def test_generate_proposal_labels_invariants():
+    rng = np.random.RandomState(0)
+    rois = np.concatenate([
+        np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32),
+        rng.uniform(40, 90, (6, 2)).astype(np.float32).repeat(2, 1)],
+        axis=0)
+    rois[2:, 2:] = rois[2:, :2] + 5
+    gts = np.array([[0, 0, 10, 10]], np.float32)
+    cls = np.array([3], np.int64)
+    crowd = np.array([0], np.int64)
+    im_info = np.array([100, 100, 1.0], np.float32)
+    out_rois, labels, bt, inw, outw = V.generate_proposal_labels(
+        paddle.to_tensor(rois), paddle.to_tensor(cls),
+        paddle.to_tensor(crowd), paddle.to_tensor(gts),
+        paddle.to_tensor(im_info), batch_size_per_im=8, fg_fraction=0.5,
+        fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=5,
+        use_random=False)
+    lbl = np.asarray(labels.data)
+    fg = lbl[lbl > 0]
+    assert (fg == 3).all() and len(fg) >= 1
+    bt = np.asarray(bt.data)
+    assert bt.shape[1] == 4 * 5
+    # fg rows have their class column populated, bg rows all-zero
+    for i, c in enumerate(lbl):
+        row = bt[i]
+        if c > 0:
+            assert np.abs(row[4 * c:4 * c + 4]).sum() >= 0  # populated slot
+            assert np.abs(np.delete(row, slice(4 * c, 4 * c + 4))).sum() == 0
+        else:
+            assert np.abs(row).sum() == 0
+    assert np.array_equal(np.asarray(inw.data) > 0,
+                          np.asarray(outw.data) > 0)
+
+
+# ---------------- prroi_pool ----------------
+
+def test_prroi_pool_matches_numerical_integral():
+    rng = np.random.RandomState(0)
+    feat = rng.randn(1, 2, 6, 6).astype(np.float32)
+    rois = np.array([[0.7, 1.1, 4.3, 5.2]], np.float32)
+    out = V.prroi_pool(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                       pooled_height=2, pooled_width=2)
+    o = np.asarray(out.data)
+
+    # dense numerical integration of the same bilinear surface
+    def bilerp(fmap, y, x):
+        h0, w0 = int(np.floor(y)), int(np.floor(x))
+        dy, dx = y - h0, x - w0
+
+        def v(h, w):
+            if h < 0 or w < 0 or h >= fmap.shape[0] or w >= fmap.shape[1]:
+                return 0.0
+            return fmap[h, w]
+        return (v(h0, w0) * (1 - dy) * (1 - dx)
+                + v(h0, w0 + 1) * (1 - dy) * dx
+                + v(h0 + 1, w0) * dy * (1 - dx)
+                + v(h0 + 1, w0 + 1) * dy * dx)
+
+    x0, y0, x1, y1 = rois[0]
+    bw, bh = (x1 - x0) / 2, (y1 - y0) / 2
+    K = 64
+    for c in range(2):
+        for ph in range(2):
+            for pw in range(2):
+                ys = y0 + ph * bh + (np.arange(K) + 0.5) * bh / K
+                xs = x0 + pw * bw + (np.arange(K) + 0.5) * bw / K
+                acc = np.mean([bilerp(feat[0, c], y, x)
+                               for y in ys for x in xs])
+                np.testing.assert_allclose(o[0, c, ph, pw], acc, atol=2e-3)
+
+
+# ---------------- im2sequence ----------------
+
+def test_im2sequence_layout():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 4, 4).astype(np.float32)
+    out = V.im2sequence(paddle.to_tensor(x), kernels=(2, 2), strides=(2, 2))
+    o = np.asarray(out.data)
+    assert o.shape == (2 * 2 * 2, 3 * 2 * 2)
+    # first row = patch at (0,0) of image 0, (c, kh, kw) feature order
+    expect = x[0, :, 0:2, 0:2].reshape(-1)
+    np.testing.assert_allclose(o[0], expect, rtol=1e-6)
+    # row order is raster over (oh, ow): second row is the (0,1) patch
+    np.testing.assert_allclose(o[1], x[0, :, 0:2, 2:4].reshape(-1),
+                               rtol=1e-6)
+
+
+# ---------------- retinanet_target_assign ----------------
+
+def test_retinanet_target_assign_no_sampling_class_labels():
+    anchors = np.array([
+        [0, 0, 10, 10],
+        [0, 0, 9, 11],
+        [50, 50, 60, 60],
+        [51, 51, 61, 61],
+        [52, 52, 62, 62],
+    ], np.float32)
+    gts = np.array([[0, 0, 10, 10]], np.float32)
+    glbl = np.array([7], np.int64)
+    loc_i, score_i, tgt_bbox, labels, inw, fg_num = \
+        V.retinanet_target_assign(
+            None, None, paddle.to_tensor(anchors), None,
+            paddle.to_tensor(gts), paddle.to_tensor(glbl),
+            positive_overlap=0.5, negative_overlap=0.4)
+    loc = np.asarray(loc_i.data)
+    lbl = np.asarray(labels.data)
+    assert 0 in loc
+    # every bg anchor is kept (no sampling): 3 far anchors + any low-IoU
+    assert len(lbl) == len(np.asarray(score_i.data))
+    assert (lbl[:len(loc)] == 7).all()
+    assert (lbl[len(loc):] == 0).all()
+    assert int(np.asarray(fg_num.data)[0]) == len(loc) + 1
+
+
+def test_collect_fpn_proposals_trailing_empty_image():
+    # image 1 has zero rois at every level: rois_num must still be [batch]
+    r1 = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+    s1 = paddle.to_tensor(np.array([[0.9]], np.float32))
+    n1 = paddle.to_tensor(np.array([1, 0], np.int32))
+    rois, rois_num = V.collect_fpn_proposals(
+        [r1], [s1], 2, 2, post_nms_top_n=5, rois_num_per_level=[n1])
+    np.testing.assert_array_equal(np.asarray(rois_num.data), [1, 0])
+
+
+def test_rpn_target_assign_all_anchors_straddle():
+    # every anchor crosses the border: empty-but-well-formed outputs
+    anchors = np.array([[-5, -5, 5, 5], [-1, 0, 11, 10]], np.float32)
+    gts = np.array([[0, 0, 10, 10]], np.float32)
+    im_info = np.array([10, 10, 1.0], np.float32)
+    loc_i, score_i, tgt_bbox, tgt_label, inw = V.rpn_target_assign(
+        None, None, paddle.to_tensor(anchors), None, paddle.to_tensor(gts),
+        im_info=paddle.to_tensor(im_info), rpn_straddle_thresh=0.0,
+        use_random=False)
+    assert len(np.asarray(loc_i.data)) == 0
+    assert len(np.asarray(score_i.data)) == 0
+    assert np.asarray(tgt_bbox.data).shape == (0, 4)
+
+
+def test_voc2012_rejects_unknown_mode():
+    from paddle_tpu.vision.datasets import VOC2012
+    with pytest.raises(ValueError):
+        VOC2012(mode="valid")
+
+
+def test_locality_aware_nms_merges_adjacent_boxes():
+    # two heavily-overlapping adjacent detections merge score-weighted;
+    # a distant third survives separately
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [50, 50, 60, 60]]], np.float32)
+    scores = np.array([[[0.6, 0.4, 0.9]]], np.float32)
+    out, num = V.locality_aware_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.1, nms_threshold=0.5)
+    o = np.asarray(out.data)
+    assert int(np.asarray(num.data)[0]) == 2
+    # merged row: score 1.0 (sum), box = weighted avg
+    merged = o[o[:, 1] > 0.95][0]
+    expect = (boxes[0, 0] * 0.6 + boxes[0, 1] * 0.4) / 1.0
+    np.testing.assert_allclose(merged[2:], expect, atol=1e-5)
+    # polygon input raises
+    with pytest.raises(NotImplementedError):
+        V.locality_aware_nms(
+            paddle.to_tensor(np.zeros((1, 2, 8), np.float32)),
+            paddle.to_tensor(np.zeros((1, 1, 2), np.float32)))

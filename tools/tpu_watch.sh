@@ -1,0 +1,29 @@
+#!/bin/bash
+# Opportunistic TPU sweep: probe the tunnel every PROBE_EVERY seconds; the
+# moment it answers, run the preset sweep then the block sweep (appending to
+# BENCH_SWEEP.json). Exits when both sweeps have completed without a hang,
+# or after MAX_WAIT seconds total. Run in the background at round start —
+# tunnel-up windows are the scarcest resource (VERDICT r3 weak 1).
+cd "$(dirname "$0")/.." || exit 1
+PROBE_EVERY=${PROBE_EVERY:-240}
+MAX_WAIT=${MAX_WAIT:-36000}
+start=$(date +%s)
+while :; do
+  now=$(date +%s)
+  if [ $((now - start)) -gt "$MAX_WAIT" ]; then
+    echo "tpu_watch: gave up after ${MAX_WAIT}s"
+    exit 1
+  fi
+  if timeout 100 python bench.py --probe 2>/dev/null | grep -q PROBE_OK; then
+    echo "tpu_watch: tunnel up at $(date -u +%H:%M:%S); sweeping"
+    if python tools/tpu_sweep.py presets && \
+       python tools/tpu_sweep.py blocks; then
+      echo "tpu_watch: sweeps complete"
+      exit 0
+    fi
+    echo "tpu_watch: sweep aborted (tunnel died?); back to probing"
+  else
+    echo "tpu_watch: tunnel down at $(date -u +%H:%M:%S)"
+  fi
+  sleep "$PROBE_EVERY"
+done
