@@ -1,0 +1,623 @@
+// Native parameter-server transport: framed TCP RPC with server-resident
+// tables and server-side optimizer rules.
+//
+// Reference anchors: paddle/fluid/distributed/service/brpc_ps_server.h /
+// brpc_ps_client.h (RPC PS pair), table/common_sparse_table.cc (demand-
+// created rows, server-side SGD/AdaGrad with g2sum slots, save/load with
+// optimizer columns), table/common_dense_table.cc (whole-block dense
+// pull/push). TPU-native redesign: the wire protocol is a minimal
+// length-prefixed binary framing instead of brpc/protobuf (no external
+// deps in the toolchain); sharding across servers stays in the Python
+// client exactly like PSClient's id % n_servers routing, so this file is
+// one shard's server plus a blocking client for it.
+//
+// Exposed C ABI (ctypes-consumed by
+// paddle_tpu/distributed/fleet/runtime/native_ps.py):
+//   ps_server_start/port/stop, ps_connect/disconnect,
+//   ps_create_sparse, ps_pull_sparse, ps_push_sparse,
+//   ps_create_dense, ps_pull_dense, ps_push_dense,
+//   ps_save_table, ps_load_table, ps_table_size
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t {
+  OP_CREATE_SPARSE = 1,
+  OP_PULL_SPARSE = 2,
+  OP_PUSH_SPARSE = 3,
+  OP_CREATE_DENSE = 4,
+  OP_PULL_DENSE = 5,
+  OP_PUSH_DENSE = 6,
+  OP_SAVE = 7,
+  OP_LOAD = 8,
+  OP_SIZE = 9,
+};
+
+enum Status : uint8_t { ST_OK = 0, ST_ERR = 1 };
+
+// ---- exact-length socket IO ----
+bool read_all(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// frame: [u32 payload_len][payload]
+bool read_frame(int fd, std::vector<char>* out) {
+  uint32_t len;
+  if (!read_all(fd, &len, 4)) return false;
+  out->resize(len);
+  return len == 0 || read_all(fd, out->data(), len);
+}
+
+bool write_frame(int fd, const void* payload, uint32_t len) {
+  if (!write_all(fd, &len, 4)) return false;
+  return len == 0 || write_all(fd, payload, len);
+}
+
+struct Table {
+  uint32_t dim = 0;
+  uint8_t rule = 0;  // 0 sgd, 1 adagrad
+  float lr = 0.01f;
+  float init_std = 0.01f;
+  float epsilon = 1e-6f;
+  bool dense = false;
+  uint64_t dense_size = 0;
+  std::mt19937_64 rng{0};
+  std::unordered_map<int64_t, std::vector<float>> rows;
+  std::unordered_map<int64_t, std::vector<float>> slots;
+  std::vector<float> dense_val;
+  std::vector<float> dense_slot;
+  std::mutex mu;
+
+  void apply(float* row, const float* grad, float* slot, size_t n) {
+    if (rule == 0) {
+      for (size_t i = 0; i < n; ++i) row[i] -= lr * grad[i];
+      return;
+    }
+    for (size_t i = 0; i < n; ++i) {
+      slot[i] += grad[i] * grad[i];
+      row[i] -= lr * grad[i] / (std::sqrt(slot[i]) + epsilon);
+    }
+  }
+
+  std::vector<float>& materialize(int64_t id) {
+    auto it = rows.find(id);
+    if (it != rows.end()) return it->second;
+    std::normal_distribution<float> d(0.0f, init_std);
+    std::vector<float> row(dim);
+    for (auto& v : row) v = d(rng);
+    return rows.emplace(id, std::move(row)).first->second;
+  }
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::unordered_map<int32_t, Table> tables;
+  std::mutex tables_mu;
+  // connection handlers are tracked (not detached) so stop() can shut the
+  // sockets down and JOIN them before the table map is freed
+  std::vector<std::thread> conn_threads;
+  std::vector<int> conn_fds;
+  std::mutex conns_mu;
+
+  Table* get(int32_t id) {
+    std::lock_guard<std::mutex> g(tables_mu);
+    auto it = tables.find(id);
+    return it == tables.end() ? nullptr : &it->second;
+  }
+};
+
+void reply_err(int fd, const char* msg) {
+  std::vector<char> resp(1 + std::strlen(msg));
+  resp[0] = ST_ERR;
+  std::memcpy(resp.data() + 1, msg, resp.size() - 1);
+  write_frame(fd, resp.data(), static_cast<uint32_t>(resp.size()));
+}
+
+void reply_ok(int fd, const void* body = nullptr, size_t n = 0) {
+  std::vector<char> resp(1 + n);
+  resp[0] = ST_OK;
+  if (n) std::memcpy(resp.data() + 1, body, n);
+  write_frame(fd, resp.data(), static_cast<uint32_t>(resp.size()));
+}
+
+template <typename T>
+T take(const char*& p) {
+  T v;
+  std::memcpy(&v, p, sizeof(T));
+  p += sizeof(T);
+  return v;
+}
+
+bool save_table(Table* t, const std::string& path) {
+  std::lock_guard<std::mutex> g(t->mu);
+  FILE* f = std::fopen(path.c_str(), "wb");
+  if (!f) return false;
+  // [u8 dense][u32 dim][u8 rule][f32 lr][f32 eps] then rows+slots (sparse)
+  // or val+slot (dense). Optimizer slots persist with the values —
+  // common_sparse_table.cc keeps g2sum columns in the row block.
+  uint8_t dense = t->dense ? 1 : 0;
+  std::fwrite(&dense, 1, 1, f);
+  std::fwrite(&t->dim, 4, 1, f);
+  std::fwrite(&t->rule, 1, 1, f);
+  std::fwrite(&t->lr, 4, 1, f);
+  std::fwrite(&t->epsilon, 4, 1, f);
+  if (t->dense) {
+    uint64_t n = t->dense_val.size();
+    std::fwrite(&n, 8, 1, f);
+    std::fwrite(t->dense_val.data(), 4, n, f);
+    uint64_t ns = t->dense_slot.size();
+    std::fwrite(&ns, 8, 1, f);
+    std::fwrite(t->dense_slot.data(), 4, ns, f);
+  } else {
+    uint64_t n = t->rows.size();
+    std::fwrite(&n, 8, 1, f);
+    for (auto& kv : t->rows) {
+      std::fwrite(&kv.first, 8, 1, f);
+      std::fwrite(kv.second.data(), 4, t->dim, f);
+    }
+    uint64_t ns = t->slots.size();
+    std::fwrite(&ns, 8, 1, f);
+    for (auto& kv : t->slots) {
+      std::fwrite(&kv.first, 8, 1, f);
+      std::fwrite(kv.second.data(), 4, t->dim, f);
+    }
+  }
+  std::fclose(f);
+  return true;
+}
+
+bool load_table(Table* t, const std::string& path) {
+  std::lock_guard<std::mutex> g(t->mu);
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return false;
+  uint8_t dense, rule;
+  uint32_t dim;
+  float lr, eps;
+  if (std::fread(&dense, 1, 1, f) != 1 || std::fread(&dim, 4, 1, f) != 1 ||
+      std::fread(&rule, 1, 1, f) != 1 || std::fread(&lr, 4, 1, f) != 1 ||
+      std::fread(&eps, 4, 1, f) != 1) {
+    std::fclose(f);
+    return false;
+  }
+  t->dim = dim;
+  t->rule = rule;
+  t->lr = lr;
+  t->epsilon = eps;
+  bool ok = true;
+  if (dense) {
+    uint64_t n = 0, ns = 0;
+    ok = std::fread(&n, 8, 1, f) == 1;
+    t->dense_val.resize(n);
+    ok = ok && (n == 0 || std::fread(t->dense_val.data(), 4, n, f) == n);
+    ok = ok && std::fread(&ns, 8, 1, f) == 1;
+    t->dense_slot.resize(ns);
+    ok = ok && (ns == 0 || std::fread(t->dense_slot.data(), 4, ns, f) == ns);
+    t->dense = true;
+    t->dense_size = n;
+  } else {
+    uint64_t n = 0;
+    ok = std::fread(&n, 8, 1, f) == 1;
+    for (uint64_t i = 0; ok && i < n; ++i) {
+      int64_t id;
+      std::vector<float> row(t->dim);
+      ok = std::fread(&id, 8, 1, f) == 1 &&
+           std::fread(row.data(), 4, t->dim, f) == t->dim;
+      if (ok) t->rows[id] = std::move(row);
+    }
+    uint64_t ns = 0;
+    ok = ok && std::fread(&ns, 8, 1, f) == 1;
+    for (uint64_t i = 0; ok && i < ns; ++i) {
+      int64_t id;
+      std::vector<float> row(t->dim);
+      ok = std::fread(&id, 8, 1, f) == 1 &&
+           std::fread(row.data(), 4, t->dim, f) == t->dim;
+      if (ok) t->slots[id] = std::move(row);
+    }
+  }
+  std::fclose(f);
+  return ok;
+}
+
+void handle_conn(Server* srv, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::vector<char> req;
+  while (!srv->stop.load() && read_frame(fd, &req)) {
+    if (req.size() < 5) break;
+    const char* p = req.data();
+    uint8_t op = take<uint8_t>(p);
+    int32_t tid = take<int32_t>(p);
+    switch (op) {
+      case OP_CREATE_SPARSE: {
+        uint32_t dim = take<uint32_t>(p);
+        uint8_t rule = take<uint8_t>(p);
+        float lr = take<float>(p);
+        float init_std = take<float>(p);
+        uint64_t seed = take<uint64_t>(p);
+        std::lock_guard<std::mutex> g(srv->tables_mu);
+        Table& t = srv->tables[tid];  // idempotent create
+        if (t.dim == 0) {
+          t.dim = dim;
+          t.rule = rule;
+          t.lr = lr;
+          t.init_std = init_std;
+          t.rng.seed(seed);
+        }
+        reply_ok(fd);
+        break;
+      }
+      case OP_PULL_SPARSE: {
+        uint64_t n = take<uint64_t>(p);
+        Table* t = srv->get(tid);
+        if (!t || t->dense) {
+          reply_err(fd, "no such sparse table");
+          break;
+        }
+        std::vector<float> out(n * t->dim);
+        {
+          std::lock_guard<std::mutex> g(t->mu);
+          for (uint64_t i = 0; i < n; ++i) {
+            int64_t id = take<int64_t>(p);
+            auto& row = t->materialize(id);
+            std::memcpy(out.data() + i * t->dim, row.data(), t->dim * 4);
+          }
+        }
+        reply_ok(fd, out.data(), out.size() * 4);
+        break;
+      }
+      case OP_PUSH_SPARSE: {
+        uint64_t n = take<uint64_t>(p);
+        Table* t = srv->get(tid);
+        if (!t || t->dense) {
+          reply_err(fd, "no such sparse table");
+          break;
+        }
+        const char* ids_p = p;
+        const char* grads_p = p + n * 8;
+        std::lock_guard<std::mutex> g(t->mu);
+        // merge duplicate ids before the rule (MergeAdd semantics)
+        std::unordered_map<int64_t, std::vector<float>> merged;
+        for (uint64_t i = 0; i < n; ++i) {
+          int64_t id;
+          std::memcpy(&id, ids_p + i * 8, 8);
+          auto& acc = merged[id];
+          if (acc.empty()) acc.assign(t->dim, 0.0f);
+          const float* gsrc =
+              reinterpret_cast<const float*>(grads_p + i * t->dim * 4);
+          for (uint32_t d = 0; d < t->dim; ++d) acc[d] += gsrc[d];
+        }
+        for (auto& kv : merged) {
+          auto it = t->rows.find(kv.first);
+          if (it == t->rows.end()) continue;  // never pulled: ignore
+          float* slot = nullptr;
+          if (t->rule == 1) {
+            auto& s = t->slots[kv.first];
+            if (s.empty()) s.assign(t->dim, 0.0f);
+            slot = s.data();
+          }
+          t->apply(it->second.data(), kv.second.data(), slot, t->dim);
+        }
+        reply_ok(fd);
+        break;
+      }
+      case OP_CREATE_DENSE: {
+        uint64_t size = take<uint64_t>(p);
+        uint8_t rule = take<uint8_t>(p);
+        float lr = take<float>(p);
+        std::lock_guard<std::mutex> g(srv->tables_mu);
+        Table& t = srv->tables[tid];
+        if (!t.dense) {
+          t.dense = true;
+          t.dense_size = size;
+          t.rule = rule;
+          t.lr = lr;
+          t.dim = 1;
+          t.dense_val.assign(size, 0.0f);
+          if (rule == 1) t.dense_slot.assign(size, 0.0f);
+        }
+        reply_ok(fd);
+        break;
+      }
+      case OP_PULL_DENSE: {
+        Table* t = srv->get(tid);
+        if (!t || !t->dense) {
+          reply_err(fd, "no such dense table");
+          break;
+        }
+        std::lock_guard<std::mutex> g(t->mu);
+        reply_ok(fd, t->dense_val.data(), t->dense_val.size() * 4);
+        break;
+      }
+      case OP_PUSH_DENSE: {
+        uint64_t n = take<uint64_t>(p);
+        Table* t = srv->get(tid);
+        if (!t || !t->dense || n != t->dense_val.size()) {
+          reply_err(fd, "dense size mismatch");
+          break;
+        }
+        std::lock_guard<std::mutex> g(t->mu);
+        t->apply(t->dense_val.data(), reinterpret_cast<const float*>(p),
+                 t->rule == 1 ? t->dense_slot.data() : nullptr, n);
+        reply_ok(fd);
+        break;
+      }
+      case OP_SAVE:
+      case OP_LOAD: {
+        uint64_t n = take<uint64_t>(p);
+        std::string path(p, p + n);
+        Table* t = srv->get(tid);
+        if (op == OP_LOAD && !t) {
+          std::lock_guard<std::mutex> g(srv->tables_mu);
+          t = &srv->tables[tid];
+        }
+        if (!t) {
+          reply_err(fd, "no such table");
+          break;
+        }
+        bool ok = op == OP_SAVE ? save_table(t, path) : load_table(t, path);
+        if (ok)
+          reply_ok(fd);
+        else
+          reply_err(fd, "file io failed");
+        break;
+      }
+      case OP_SIZE: {
+        Table* t = srv->get(tid);
+        uint64_t n = 0;
+        if (t) {
+          std::lock_guard<std::mutex> g(t->mu);
+          n = t->dense ? t->dense_val.size() : t->rows.size();
+        }
+        reply_ok(fd, &n, 8);
+        break;
+      }
+      default:
+        reply_err(fd, "bad op");
+    }
+  }
+  // fd stays open until server stop: closing here would let the kernel
+  // reuse the number while stop() still holds it in conn_fds (a shutdown
+  // on a recycled fd could hit an unrelated descriptor)
+  ::shutdown(fd, SHUT_RDWR);
+}
+
+struct Client {
+  int fd = -1;
+};
+
+bool rpc(Client* c, const std::vector<char>& req, std::vector<char>* resp) {
+  if (!write_frame(c->fd, req.data(), static_cast<uint32_t>(req.size())))
+    return false;
+  if (!read_frame(c->fd, resp)) return false;
+  return !resp->empty() && (*resp)[0] == ST_OK;
+}
+
+template <typename T>
+void put(std::vector<char>* buf, T v) {
+  size_t off = buf->size();
+  buf->resize(off + sizeof(T));
+  std::memcpy(buf->data() + off, &v, sizeof(T));
+}
+
+void put_bytes(std::vector<char>* buf, const void* p, size_t n) {
+  size_t off = buf->size();
+  buf->resize(off + n);
+  std::memcpy(buf->data() + off, p, n);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ps_server_start(int port) {
+  auto* srv = new Server();
+  srv->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (srv->listen_fd < 0) {
+    delete srv;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(srv->listen_fd, 64) != 0) {
+    ::close(srv->listen_fd);
+    delete srv;
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  srv->port = ntohs(addr.sin_port);
+  srv->accept_thread = std::thread([srv] {
+    while (!srv->stop.load()) {
+      int fd = ::accept(srv->listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      std::lock_guard<std::mutex> g(srv->conns_mu);
+      srv->conn_fds.push_back(fd);
+      srv->conn_threads.emplace_back(handle_conn, srv, fd);
+    }
+  });
+  return srv;
+}
+
+int ps_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void ps_server_stop(void* h) {
+  auto* srv = static_cast<Server*>(h);
+  srv->stop.store(true);
+  ::shutdown(srv->listen_fd, SHUT_RDWR);
+  ::close(srv->listen_fd);
+  if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  // wake every blocked handler, then JOIN them all before freeing the
+  // table map — no use-after-free window for in-flight requests
+  {
+    std::lock_guard<std::mutex> g(srv->conns_mu);
+    for (int fd : srv->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : srv->conn_threads)
+    if (t.joinable()) t.join();
+  for (int fd : srv->conn_fds) ::close(fd);
+  delete srv;
+}
+
+void* ps_connect(const char* host, int port) {
+  auto* c = new Client();
+  c->fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, host, &addr.sin_addr);
+  if (::connect(c->fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+      0) {
+    ::close(c->fd);
+    delete c;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return c;
+}
+
+void ps_disconnect(void* h) {
+  auto* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+int ps_create_sparse(void* h, int table_id, int dim, int rule, float lr,
+                     float init_std, uint64_t seed) {
+  std::vector<char> req, resp;
+  put<uint8_t>(&req, OP_CREATE_SPARSE);
+  put<int32_t>(&req, table_id);
+  put<uint32_t>(&req, static_cast<uint32_t>(dim));
+  put<uint8_t>(&req, static_cast<uint8_t>(rule));
+  put<float>(&req, lr);
+  put<float>(&req, init_std);
+  put<uint64_t>(&req, seed);
+  return rpc(static_cast<Client*>(h), req, &resp) ? 0 : -1;
+}
+
+int ps_pull_sparse(void* h, int table_id, const int64_t* ids, int64_t n,
+                   int dim, float* out) {
+  std::vector<char> req, resp;
+  put<uint8_t>(&req, OP_PULL_SPARSE);
+  put<int32_t>(&req, table_id);
+  put<uint64_t>(&req, static_cast<uint64_t>(n));
+  put_bytes(&req, ids, static_cast<size_t>(n) * 8);
+  if (!rpc(static_cast<Client*>(h), req, &resp)) return -1;
+  if (resp.size() != 1 + static_cast<size_t>(n) * dim * 4) return -2;
+  std::memcpy(out, resp.data() + 1, resp.size() - 1);
+  return 0;
+}
+
+int ps_push_sparse(void* h, int table_id, const int64_t* ids, int64_t n,
+                   int dim, const float* grads) {
+  std::vector<char> req, resp;
+  put<uint8_t>(&req, OP_PUSH_SPARSE);
+  put<int32_t>(&req, table_id);
+  put<uint64_t>(&req, static_cast<uint64_t>(n));
+  put_bytes(&req, ids, static_cast<size_t>(n) * 8);
+  put_bytes(&req, grads, static_cast<size_t>(n) * dim * 4);
+  return rpc(static_cast<Client*>(h), req, &resp) ? 0 : -1;
+}
+
+int ps_create_dense(void* h, int table_id, int64_t size, int rule, float lr) {
+  std::vector<char> req, resp;
+  put<uint8_t>(&req, OP_CREATE_DENSE);
+  put<int32_t>(&req, table_id);
+  put<uint64_t>(&req, static_cast<uint64_t>(size));
+  put<uint8_t>(&req, static_cast<uint8_t>(rule));
+  put<float>(&req, lr);
+  return rpc(static_cast<Client*>(h), req, &resp) ? 0 : -1;
+}
+
+int ps_pull_dense(void* h, int table_id, float* out, int64_t size) {
+  std::vector<char> req, resp;
+  put<uint8_t>(&req, OP_PULL_DENSE);
+  put<int32_t>(&req, table_id);
+  if (!rpc(static_cast<Client*>(h), req, &resp)) return -1;
+  if (resp.size() != 1 + static_cast<size_t>(size) * 4) return -2;
+  std::memcpy(out, resp.data() + 1, resp.size() - 1);
+  return 0;
+}
+
+int ps_push_dense(void* h, int table_id, const float* grad, int64_t size) {
+  std::vector<char> req, resp;
+  put<uint8_t>(&req, OP_PUSH_DENSE);
+  put<int32_t>(&req, table_id);
+  put<uint64_t>(&req, static_cast<uint64_t>(size));
+  put_bytes(&req, grad, static_cast<size_t>(size) * 4);
+  return rpc(static_cast<Client*>(h), req, &resp) ? 0 : -1;
+}
+
+static int save_or_load(void* h, uint8_t op, int table_id, const char* path) {
+  std::vector<char> req, resp;
+  put<uint8_t>(&req, op);
+  put<int32_t>(&req, table_id);
+  uint64_t n = std::strlen(path);
+  put<uint64_t>(&req, n);
+  put_bytes(&req, path, n);
+  return rpc(static_cast<Client*>(h), req, &resp) ? 0 : -1;
+}
+
+int ps_save_table(void* h, int table_id, const char* path) {
+  return save_or_load(h, OP_SAVE, table_id, path);
+}
+
+int ps_load_table(void* h, int table_id, const char* path) {
+  return save_or_load(h, OP_LOAD, table_id, path);
+}
+
+int64_t ps_table_size(void* h, int table_id) {
+  std::vector<char> req, resp;
+  put<uint8_t>(&req, OP_SIZE);
+  put<int32_t>(&req, table_id);
+  if (!rpc(static_cast<Client*>(h), req, &resp) || resp.size() != 9)
+    return -1;
+  uint64_t n;
+  std::memcpy(&n, resp.data() + 1, 8);
+  return static_cast<int64_t>(n);
+}
+
+}  // extern "C"

@@ -1,0 +1,90 @@
+// C++ unit test: server+client roundtrip, server-side adagrad, duplicate-id
+// merge, dense block, save/load with optimizer slots.
+#include <cassert>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+void* ps_server_start(int port);
+int ps_server_port(void* h);
+void ps_server_stop(void* h);
+void* ps_connect(const char* host, int port);
+void ps_disconnect(void* h);
+int ps_create_sparse(void* h, int t, int dim, int rule, float lr,
+                     float init_std, uint64_t seed);
+int ps_pull_sparse(void* h, int t, const int64_t* ids, int64_t n, int dim,
+                   float* out);
+int ps_push_sparse(void* h, int t, const int64_t* ids, int64_t n, int dim,
+                   const float* grads);
+int ps_create_dense(void* h, int t, int64_t size, int rule, float lr);
+int ps_pull_dense(void* h, int t, float* out, int64_t size);
+int ps_push_dense(void* h, int t, const float* grad, int64_t size);
+int ps_save_table(void* h, int t, const char* path);
+int ps_load_table(void* h, int t, const char* path);
+int64_t ps_table_size(void* h, int t);
+}
+
+int main() {
+  void* srv = ps_server_start(0);
+  assert(srv);
+  int port = ps_server_port(srv);
+  void* c = ps_connect("127.0.0.1", port);
+  assert(c);
+
+  // sparse sgd: pull materializes, push applies -lr*g, duplicate ids merge
+  assert(ps_create_sparse(c, 1, 4, 0, 0.5f, 0.0f, 7) == 0);
+  int64_t ids[3] = {10, 20, 10};
+  float vals[12];
+  assert(ps_pull_sparse(c, 1, ids, 3, 4, vals) == 0);
+  for (int i = 0; i < 12; ++i) assert(vals[i] == 0.0f);  // init_std 0
+  float grads[12];
+  for (int i = 0; i < 12; ++i) grads[i] = 1.0f;
+  assert(ps_push_sparse(c, 1, ids, 3, 4, grads) == 0);
+  int64_t one = 10;
+  assert(ps_pull_sparse(c, 1, &one, 1, 4, vals) == 0);
+  for (int i = 0; i < 4; ++i)
+    assert(std::fabs(vals[i] - (-0.5f * 2.0f)) < 1e-6);  // merged 2 grads
+  assert(ps_table_size(c, 1) == 2);
+
+  // adagrad slot accumulates across pushes
+  assert(ps_create_sparse(c, 2, 2, 1, 1.0f, 0.0f, 7) == 0);
+  int64_t id2 = 5;
+  float v2[2], g2[2] = {3.0f, 3.0f};
+  assert(ps_pull_sparse(c, 2, &id2, 1, 2, v2) == 0);
+  assert(ps_push_sparse(c, 2, &id2, 1, 2, g2) == 0);
+  assert(ps_pull_sparse(c, 2, &id2, 1, 2, v2) == 0);
+  // row = 0 - 1.0 * 3 / (sqrt(9) + 1e-6) = -1
+  assert(std::fabs(v2[0] + 1.0f) < 1e-4);
+  assert(ps_push_sparse(c, 2, &id2, 1, 2, g2) == 0);
+  assert(ps_pull_sparse(c, 2, &id2, 1, 2, v2) == 0);
+  // slot now 18: -1 - 3/sqrt(18) = -1.7071
+  assert(std::fabs(v2[0] + 1.0f + 3.0f / std::sqrt(18.0f)) < 1e-4);
+
+  // save -> mutate -> load restores row AND slot
+  assert(ps_save_table(c, 2, "/tmp/pstab2.bin") == 0);
+  assert(ps_push_sparse(c, 2, &id2, 1, 2, g2) == 0);
+  assert(ps_load_table(c, 2, "/tmp/pstab2.bin") == 0);
+  float v3[2];
+  assert(ps_pull_sparse(c, 2, &id2, 1, 2, v3) == 0);
+  assert(std::fabs(v3[0] - v2[0]) < 1e-6);
+  assert(ps_push_sparse(c, 2, &id2, 1, 2, g2) == 0);
+  assert(ps_pull_sparse(c, 2, &id2, 1, 2, v3) == 0);
+  // slot restored to 18 -> 27 after push: step 3/sqrt(27)
+  assert(std::fabs(v3[0] - (v2[0] - 3.0f / std::sqrt(27.0f))) < 1e-4);
+
+  // dense block
+  assert(ps_create_dense(c, 3, 8, 0, 0.1f) == 0);
+  float dv[8], dg[8];
+  for (int i = 0; i < 8; ++i) dg[i] = 2.0f;
+  assert(ps_push_dense(c, 3, dg, 8) == 0);
+  assert(ps_pull_dense(c, 3, dv, 8) == 0);
+  for (int i = 0; i < 8; ++i) assert(std::fabs(dv[i] + 0.2f) < 1e-6);
+
+  ps_disconnect(c);
+  ps_server_stop(srv);
+  std::printf("PSTRANSPORT_TEST_OK\n");
+  return 0;
+}

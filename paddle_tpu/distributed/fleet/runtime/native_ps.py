@@ -1,0 +1,358 @@
+"""ctypes glue for the native C++ PS transport (csrc/pstransport).
+
+Reference: brpc_ps_client.h / brpc_ps_server.h — the reference's PS wire
+layer is native C++ with server-resident tables and server-side optimizer
+application; this binds our C++ equivalent (framed TCP, see
+pstransport.cc) behind the same Python client interface as the in-process
+PSClient, so TheOnePSRuntime can swap transports without touching callers.
+Sharding stays client-side: sparse rows route by id % n_servers, dense
+tables live whole on one server picked by name hash — identical to
+PSClient's routing, so the two transports are checkpoint-compatible at the
+runtime layer above."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import zlib
+from typing import List, Optional
+
+import numpy as np
+
+_SRC_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "..", "..",
+    "csrc", "pstransport")
+_SRC_DIR = os.path.normpath(_SRC_DIR)
+_LIB_PATH = os.path.join(_SRC_DIR, "libpstransport.so")
+_lib = None
+
+
+def _load_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                       capture_output=True)
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.ps_server_start.restype = ctypes.c_void_p
+    lib.ps_server_start.argtypes = [ctypes.c_int]
+    lib.ps_server_port.restype = ctypes.c_int
+    lib.ps_server_port.argtypes = [ctypes.c_void_p]
+    lib.ps_server_stop.argtypes = [ctypes.c_void_p]
+    lib.ps_connect.restype = ctypes.c_void_p
+    lib.ps_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.ps_disconnect.argtypes = [ctypes.c_void_p]
+    lib.ps_create_sparse.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_float, ctypes.c_float, ctypes.c_uint64]
+    lib.ps_pull_sparse.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int, ctypes.POINTER(ctypes.c_float)]
+    lib.ps_push_sparse.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int, ctypes.POINTER(ctypes.c_float)]
+    lib.ps_create_dense.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_int,
+        ctypes.c_float]
+    lib.ps_pull_dense.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64]
+    lib.ps_push_dense.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64]
+    lib.ps_save_table.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                  ctypes.c_char_p]
+    lib.ps_load_table.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                  ctypes.c_char_p]
+    lib.ps_table_size.restype = ctypes.c_int64
+    lib.ps_table_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    _lib = lib
+    return lib
+
+
+def _table_id(name: str) -> int:
+    return zlib.crc32(name.encode()) & 0x7FFFFFFF
+
+
+# ---- .pstab binary format (mirror of save_table/load_table in
+# pstransport.cc): [u8 dense][u32 dim][u8 rule][f32 lr][f32 eps] then
+# sparse: [u64 n]{[i64 id][f32 x dim]}*n [u64 ns]{[i64 id][f32 x dim]}*ns ----
+
+def _read_pstab(path: str):
+    with open(path, "rb") as f:
+        raw = f.read()
+    hdr = raw[:14]
+    dense = raw[0]
+    if dense:
+        raise ValueError("dense .pstab files are not re-sharded")
+    dim = int(np.frombuffer(raw, np.uint32, 1, 1)[0])
+    off = 14
+
+    def block(off):
+        n = int(np.frombuffer(raw, np.uint64, 1, off)[0])
+        off += 8
+        rec = np.dtype([("id", np.int64), ("val", np.float32, (dim,))])
+        arr = np.frombuffer(raw, rec, n, off)
+        off += n * rec.itemsize
+        return arr["id"].copy(), arr["val"].copy().reshape(n, dim), off
+
+    ids, vals, off = block(off)
+    sids, svals, off = block(off)
+    return hdr, ids, vals, sids, svals
+
+
+def _write_pstab(path: str, hdr: bytes, ids, vals, sids, svals):
+    dim = vals.shape[1] if len(vals) else \
+        int(np.frombuffer(hdr, np.uint32, 1, 1)[0])
+    rec = np.dtype([("id", np.int64), ("val", np.float32, (dim,))])
+
+    def block(ids_, vals_):
+        arr = np.empty(len(ids_), rec)
+        arr["id"] = ids_
+        arr["val"] = vals_
+        return np.uint64(len(ids_)).tobytes() + arr.tobytes()
+
+    with open(path, "wb") as f:
+        f.write(hdr)
+        f.write(block(ids, vals))
+        f.write(block(sids, svals))
+
+
+_RULES = {"sgd": 0, "adagrad": 1}
+
+
+class NativePSServer:
+    """One C++ PS shard server on loopback. The table storage and optimizer
+    rules live in native code (brpc_ps_server.h role)."""
+
+    def __init__(self, port: int = 0):
+        self._lib = _load_lib()
+        self._h = self._lib.ps_server_start(port)
+        if not self._h:
+            raise RuntimeError("native PS server failed to bind")
+        self.port = self._lib.ps_server_port(self._h)
+
+    @property
+    def endpoint(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        if self._h:
+            self._lib.ps_server_stop(self._h)
+            self._h = None
+
+
+class NativePSClient:
+    """PSClient-compatible worker handle over the native transport: same
+    method surface (create_table/pull_sparse/push_sparse/create_dense_table/
+    pull_dense/push_dense), same id%n sparse sharding and name-hash dense
+    placement."""
+
+    def __init__(self, endpoints: List[str]):
+        self._lib = _load_lib()
+        self._conns = []
+        for ep in endpoints:
+            host, port = ep.rsplit(":", 1)
+            h = self._lib.ps_connect(host.encode(), int(port))
+            if not h:
+                raise RuntimeError(f"cannot connect to native PS at {ep}")
+            self._conns.append(h)
+        self._dims = {}
+
+    @property
+    def n(self) -> int:
+        return len(self._conns)
+
+    def close(self):
+        for h in self._conns:
+            self._lib.ps_disconnect(h)
+        self._conns = []
+
+    def create_table(self, name: str, dim: int, rule="sgd", lr=0.01,
+                     init_std=0.01, seed=0):
+        tid = _table_id(name)
+        self._dims[name] = int(dim)
+        for i, h in enumerate(self._conns):
+            rc = self._lib.ps_create_sparse(
+                h, tid, int(dim), _RULES[rule], float(lr), float(init_std),
+                int(seed) + i)
+            if rc != 0:
+                raise RuntimeError(f"create_table({name}) failed rc={rc}")
+
+    def _shard(self, ids: np.ndarray) -> np.ndarray:
+        return np.asarray(ids, np.int64) % self.n
+
+    def pull_sparse(self, table: str, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        dim = self._dims[table]
+        tid = _table_id(table)
+        out = np.empty((len(ids), dim), np.float32)
+        shard = self._shard(ids)
+        for s in range(self.n):
+            sel = np.nonzero(shard == s)[0]
+            if not len(sel):
+                continue
+            sub = np.ascontiguousarray(ids[sel])
+            buf = np.empty((len(sel), dim), np.float32)
+            rc = self._lib.ps_pull_sparse(
+                self._conns[s], tid,
+                sub.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(sel), dim,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            if rc != 0:
+                raise RuntimeError(f"pull_sparse({table}) failed rc={rc}")
+            out[sel] = buf
+        return out
+
+    def push_sparse(self, table: str, ids: np.ndarray, grads: np.ndarray):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        dim = self._dims[table]
+        tid = _table_id(table)
+        grads = np.ascontiguousarray(grads, np.float32).reshape(-1, dim)
+        shard = self._shard(ids)
+        for s in range(self.n):
+            sel = np.nonzero(shard == s)[0]
+            if not len(sel):
+                continue
+            sub = np.ascontiguousarray(ids[sel])
+            g = np.ascontiguousarray(grads[sel])
+            rc = self._lib.ps_push_sparse(
+                self._conns[s], tid,
+                sub.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(sel), dim,
+                g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            if rc != 0:
+                raise RuntimeError(f"push_sparse({table}) failed rc={rc}")
+
+    def _dense_conn(self, name: str) -> int:
+        return _table_id("dense:" + name) % self.n
+
+    def create_dense_table(self, name: str, shape, rule="sgd", lr=0.01):
+        tid = _table_id(name)
+        size = int(np.prod(shape))
+        self._dims["dense:" + name] = (tuple(shape), size)
+        rc = self._lib.ps_create_dense(
+            self._conns[self._dense_conn(name)], tid, size, _RULES[rule],
+            float(lr))
+        if rc != 0:
+            raise RuntimeError(f"create_dense_table({name}) failed rc={rc}")
+
+    def pull_dense(self, name: str) -> np.ndarray:
+        shape, size = self._dims["dense:" + name]
+        out = np.empty(size, np.float32)
+        rc = self._lib.ps_pull_dense(
+            self._conns[self._dense_conn(name)], _table_id(name),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), size)
+        if rc != 0:
+            raise RuntimeError(f"pull_dense({name}) failed rc={rc}")
+        return out.reshape(shape)
+
+    def push_dense(self, name: str, grad: np.ndarray):
+        shape, size = self._dims["dense:" + name]
+        g = np.ascontiguousarray(grad, np.float32).reshape(-1)
+        rc = self._lib.ps_push_dense(
+            self._conns[self._dense_conn(name)], _table_id(name),
+            g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), size)
+        if rc != 0:
+            raise RuntimeError(f"push_dense({name}) failed rc={rc}")
+
+    def save(self, dirname: str, tables: Optional[List[str]] = None):
+        """Server-side save: each shard writes its partition of each sparse
+        table (rows + optimizer slots) under dirname/shard{i}/; dense tables
+        are written by their single owning server. A meta file records the
+        shard count so load() can re-shard."""
+        import json
+        os.makedirs(dirname, exist_ok=True)
+        sparse = [n for n in self._dims if not n.startswith("dense:")]
+        dense = [n[len("dense:"):] for n in self._dims
+                 if n.startswith("dense:")]
+        if tables is not None:
+            sparse = [n for n in sparse if n in tables]
+            dense = [n for n in dense if n in tables]
+        with open(os.path.join(dirname, "ps_meta.json"), "w") as f:
+            json.dump({"n_shards": self.n}, f)
+        for s in range(self.n):
+            sdir = os.path.join(dirname, f"shard{s}")
+            os.makedirs(sdir, exist_ok=True)
+            for name in sparse:
+                rc = self._lib.ps_save_table(
+                    self._conns[s], _table_id(name),
+                    os.path.join(sdir, f"{name}.pstab").encode())
+                if rc != 0:
+                    raise RuntimeError(f"save({name}) failed rc={rc}")
+        for name in dense:
+            s = self._dense_conn(name)
+            sdir = os.path.join(dirname, f"shard{s}")
+            os.makedirs(sdir, exist_ok=True)
+            rc = self._lib.ps_save_table(
+                self._conns[s], _table_id(name),
+                os.path.join(sdir, f"{name}.dense.pstab").encode())
+            if rc != 0:
+                raise RuntimeError(f"save(dense {name}) failed rc={rc}")
+
+    def load(self, dirname: str):
+        """Restores server state; when the saved shard count differs from
+        the current server count, sparse rows are re-partitioned client-side
+        by id % n (the .pstab format is read/rewritten in numpy) so a
+        checkpoint never silently serves fresh random rows — the same
+        lossless-reshard contract as TheOnePSRuntime.load."""
+        import glob
+        import json
+        import tempfile
+        meta_path = os.path.join(dirname, "ps_meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                saved = json.load(f)["n_shards"]
+        else:
+            saved = len(glob.glob(os.path.join(dirname, "shard*")))
+        # dense tables: single-owner, placement depends only on name
+        for path in glob.glob(os.path.join(dirname, "shard*",
+                                           "*.dense.pstab")):
+            name = os.path.basename(path)[:-len(".dense.pstab")]
+            rc = self._lib.ps_load_table(
+                self._conns[self._dense_conn(name)], _table_id(name),
+                path.encode())
+            if rc != 0:
+                raise RuntimeError(f"load(dense {name}) failed rc={rc}")
+        sparse_files = [
+            p for p in glob.glob(os.path.join(dirname, "shard*", "*.pstab"))
+            if not p.endswith(".dense.pstab")]
+        if saved == self.n:
+            for path in sparse_files:
+                shard_dir = os.path.basename(os.path.dirname(path))
+                s = int(shard_dir[len("shard"):])
+                name = os.path.basename(path)[:-len(".pstab")]
+                rc = self._lib.ps_load_table(
+                    self._conns[s], _table_id(name), path.encode())
+                if rc != 0:
+                    raise RuntimeError(f"load({name}) failed rc={rc}")
+            return
+        # shard-count mismatch: merge all partitions per table, re-split
+        by_name = {}
+        for path in sparse_files:
+            by_name.setdefault(
+                os.path.basename(path)[:-len(".pstab")], []).append(path)
+        for name, paths in by_name.items():
+            parts = [_read_pstab(p) for p in paths]
+            hdr = parts[0][0]
+            ids = np.concatenate([p[1] for p in parts])
+            vals = np.concatenate([p[2] for p in parts])
+            sids = np.concatenate([p[3] for p in parts])
+            svals = np.concatenate([p[4] for p in parts])
+            with tempfile.TemporaryDirectory() as tmp:
+                for s in range(self.n):
+                    m = ids % self.n == s
+                    ms = sids % self.n == s
+                    path = os.path.join(tmp, f"re{s}.pstab")
+                    _write_pstab(path, hdr, ids[m], vals[m], sids[ms],
+                                 svals[ms])
+                    rc = self._lib.ps_load_table(
+                        self._conns[s], _table_id(name), path.encode())
+                    if rc != 0:
+                        raise RuntimeError(
+                            f"reshard load({name}) failed rc={rc}")
+
+    def table_size(self, table: str) -> int:
+        tid = _table_id(table)
+        return sum(self._lib.ps_table_size(h, tid) for h in self._conns)

@@ -164,12 +164,47 @@ class DenseTable:
                 slot, np.float32).reshape(self.shape)
 
 
+class BarrierTable:
+    """Trainer-sync barrier (table/barrier_table.cc): trainer i calls
+    barrier(i); the call blocks until all `trigger` distinct trainers have
+    arrived, then every waiter releases and the round resets. The reference
+    uses this to fence async-PS epochs (e.g. before a server-side save)."""
+
+    def __init__(self, trigger: int):
+        self.trigger = int(trigger)
+        self._arrived = set()
+        self._round = 0
+        self._cv = threading.Condition()
+
+    def barrier(self, trainer_id: int, timeout: float = 60.0) -> bool:
+        with self._cv:
+            my_round = self._round
+            self._arrived.add(int(trainer_id))
+            if len(self._arrived) >= self.trigger:
+                self._arrived.clear()
+                self._round += 1
+                self._cv.notify_all()
+                return True
+            ok = self._cv.wait_for(lambda: self._round > my_round, timeout)
+            if not ok and self._round == my_round:
+                # retract the arrival: a dead trainer must not count
+                # toward a later round's trigger
+                self._arrived.discard(int(trainer_id))
+            return ok
+
+
 class PSCore:
     """One server's tables (the in-process half of brpc_ps_server)."""
 
     def __init__(self):
         self.tables: Dict[str, SparseTable] = {}
         self.dense_tables: Dict[str, DenseTable] = {}
+        self.barrier_tables: Dict[str, BarrierTable] = {}
+
+    def create_barrier_table(self, name: str, trigger: int):
+        if name not in self.barrier_tables:
+            self.barrier_tables[name] = BarrierTable(trigger)
+        return self.barrier_tables[name]
 
     def create_table(self, name: str, dim: int, rule="sgd", lr=0.01,
                      init_std=0.01, seed=0):
@@ -743,16 +778,39 @@ class TheOnePSRuntime:
         push happens to advance their staleness clock)."""
         self._worker_caches.append(cache)
 
-    def run_server(self, over_http: bool = False):
-        if over_http and not self.servers:
+    def run_server(self, over_http: bool = False, transport: str = None):
+        """transport: None/'inproc' (default), 'http' (Python RPC pair), or
+        'native' (C++ framed-TCP servers with server-resident tables —
+        csrc/pstransport, the brpc_ps_server.h analog)."""
+        if transport is None:
+            transport = "http" if over_http else "inproc"
+        if transport == "http" and not self.servers:
             self.servers = [PSServer(c).start() for c in self.cores]
             self.client = PSClient(
                 endpoints=[f"127.0.0.1:{s.port}" for s in self.servers])
+        elif transport == "native" and not self.servers:
+            from .native_ps import NativePSClient, NativePSServer
+            self.servers = [NativePSServer() for _ in self.cores]
+            self.client = NativePSClient(
+                [s.endpoint for s in self.servers])
         return self
+
+    def _native_client(self):
+        from .native_ps import NativePSClient
+        c = self.client
+        if isinstance(c, AsyncPSClient):
+            c = c._client
+        return c if isinstance(c, NativePSClient) else None
 
     def save(self, dirname: str):
         import json as _json
         import os
+        native = self._native_client()
+        if native is not None:
+            # tables live in the C++ servers, not self.cores — the save
+            # must come from where the rows are
+            native.save(dirname)
+            return
         os.makedirs(dirname, exist_ok=True)
         with open(os.path.join(dirname, "ps_meta.json"), "w") as f:
             _json.dump({"n_shards": len(self.cores)}, f)
@@ -770,6 +828,10 @@ class TheOnePSRuntime:
         import os
         for cache in self._worker_caches:
             cache.invalidate()
+        native = self._native_client()
+        if native is not None:
+            native.load(dirname)
+            return
         meta_path = os.path.join(dirname, "ps_meta.json")
         if os.path.exists(meta_path):
             with open(meta_path) as f:

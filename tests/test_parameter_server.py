@@ -456,3 +456,123 @@ def test_heter_init_worker_idempotent():
     finally:
         fleet.stop_worker()
         fleet.fleet()._strategy = None
+
+
+# ---------------- native C++ transport (csrc/pstransport) ----------------
+
+def _native_pair(n=2):
+    from paddle_tpu.distributed.fleet.runtime.native_ps import (
+        NativePSClient, NativePSServer)
+    servers = [NativePSServer() for _ in range(n)]
+    client = NativePSClient([s.endpoint for s in servers])
+    return servers, client
+
+
+def test_native_transport_sparse_roundtrip():
+    """brpc-class C++ transport: server-resident tables, server-side rule."""
+    servers, client = _native_pair(2)
+    try:
+        client.create_table("emb", 8, rule="sgd", lr=0.5, init_std=0.0)
+        ids = np.array([3, 4, 7, 3])
+        vals = client.pull_sparse("emb", ids)
+        assert vals.shape == (4, 8)
+        np.testing.assert_allclose(vals, 0.0)
+        client.push_sparse("emb", ids, np.ones((4, 8), np.float32))
+        # duplicate id 3 merges: grad 2, sgd step -0.5*2
+        out = client.pull_sparse("emb", np.array([3, 4]))
+        np.testing.assert_allclose(out[0], -1.0, atol=1e-6)
+        np.testing.assert_allclose(out[1], -0.5, atol=1e-6)
+        assert client.table_size("emb") == 3
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+def test_native_transport_adagrad_and_save_load(tmp_path):
+    servers, client = _native_pair(1)
+    try:
+        client.create_table("e", 4, rule="adagrad", lr=1.0, init_std=0.0)
+        ids = np.array([5])
+        g = np.full((1, 4), 3.0, np.float32)
+        client.pull_sparse("e", ids)
+        client.push_sparse("e", ids, g)
+        v1 = client.pull_sparse("e", ids)
+        np.testing.assert_allclose(v1, -1.0, atol=1e-4)  # 3/sqrt(9)
+        client.save(str(tmp_path / "ckpt"))
+        client.push_sparse("e", ids, g)  # diverge
+        client.load(str(tmp_path / "ckpt"))
+        v2 = client.pull_sparse("e", ids)
+        np.testing.assert_allclose(v2, v1, atol=1e-6)
+        # slot restored too: next step uses sqrt(18), not sqrt(9)
+        client.push_sparse("e", ids, g)
+        v3 = client.pull_sparse("e", ids)
+        np.testing.assert_allclose(v3, v1 - 3.0 / np.sqrt(18.0), atol=1e-4)
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+def test_native_transport_dense_table():
+    servers, client = _native_pair(2)
+    try:
+        client.create_dense_table("fc.w", (2, 3), rule="sgd", lr=0.1)
+        v = client.pull_dense("fc.w")
+        assert v.shape == (2, 3)
+        client.push_dense("fc.w", np.ones((2, 3), np.float32))
+        np.testing.assert_allclose(client.pull_dense("fc.w"), -0.1,
+                                   atol=1e-6)
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+def test_native_transport_runtime_integration():
+    """TheOnePSRuntime swaps transports without touching callers."""
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import (
+        TheOnePSRuntime)
+    rt = TheOnePSRuntime(n_shards=2).run_server(transport="native")
+    try:
+        rt.client.create_table("emb", 4, rule="sgd", lr=0.1, init_std=0.0)
+        ids = np.arange(10)
+        rt.client.pull_sparse("emb", ids)
+        rt.client.push_sparse("emb", ids, np.ones((10, 4), np.float32))
+        out = rt.client.pull_sparse("emb", ids)
+        np.testing.assert_allclose(out, -0.1, atol=1e-6)
+    finally:
+        rt.client.close()
+        for s in rt.servers:
+            s.stop()
+
+
+def test_barrier_table_releases_all_waiters():
+    """barrier_table.cc analog: all trainers block until the last arrives."""
+    import threading as th
+    import time
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import PSCore
+    core = PSCore()
+    bt = core.create_barrier_table("epoch", trigger=3)
+    released = []
+
+    def worker(i):
+        assert bt.barrier(i, timeout=10.0)
+        released.append(i)
+
+    threads = [th.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    assert released == []  # 2 of 3 arrived: still fenced
+    worker(2)  # last trainer releases everyone
+    for t in threads:
+        t.join(5)
+    assert sorted(released) == [0, 1, 2]
+    # next round works (state reset)
+    t2 = [th.Thread(target=worker, args=(i,)) for i in range(3)]
+    for t in t2:
+        t.start()
+    for t in t2:
+        t.join(5)
+    assert len(released) == 6
