@@ -29,6 +29,7 @@
 #include <cstdio>
 #include <cstring>
 #include <mutex>
+#include <memory>
 #include <random>
 #include <string>
 #include <thread>
@@ -131,9 +132,15 @@ struct Server {
   std::unordered_map<int32_t, Table> tables;
   std::mutex tables_mu;
   // connection handlers are tracked (not detached) so stop() can shut the
-  // sockets down and JOIN them before the table map is freed
-  std::vector<std::thread> conn_threads;
-  std::vector<int> conn_fds;
+  // sockets down and JOIN them before the table map is freed; each slot
+  // carries a done flag so the accept loop can reap finished handlers
+  // (fd + thread) instead of growing without bound across reconnects
+  struct ConnSlot {
+    std::thread th;
+    int fd;
+    std::shared_ptr<std::atomic<bool>> done;
+  };
+  std::vector<ConnSlot> conns;
   std::mutex conns_mu;
 
   Table* get(int32_t id) {
@@ -164,6 +171,34 @@ T take(const char*& p) {
   p += sizeof(T);
   return v;
 }
+
+// bounds-checked reader: a truncated/corrupt frame must produce an error
+// reply, not a heap overread or a bad_alloc that std::terminates the
+// handler thread
+struct Reader {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  template <typename T>
+  T take() {
+    if (!ok || end - p < static_cast<ptrdiff_t>(sizeof(T))) {
+      ok = false;
+      return T{};
+    }
+    return ::take<T>(p);
+  }
+
+  const char* bytes(size_t n) {
+    if (!ok || static_cast<size_t>(end - p) < n) {
+      ok = false;
+      return nullptr;
+    }
+    const char* r = p;
+    p += n;
+    return r;
+  }
+};
 
 bool save_table(Table* t, const std::string& path) {
   std::lock_guard<std::mutex> g(t->mu);
@@ -220,6 +255,12 @@ bool load_table(Table* t, const std::string& path) {
   t->rule = rule;
   t->lr = lr;
   t->epsilon = eps;
+  // a restore replaces state: rows materialized after the save (and their
+  // slots) must not survive the load
+  t->rows.clear();
+  t->slots.clear();
+  t->dense_val.clear();
+  t->dense_slot.clear();
   bool ok = true;
   if (dense) {
     uint64_t n = 0, ns = 0;
@@ -255,22 +296,27 @@ bool load_table(Table* t, const std::string& path) {
   return ok;
 }
 
-void handle_conn(Server* srv, int fd) {
+void handle_conn(Server* srv, int fd,
+                 std::shared_ptr<std::atomic<bool>> done) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   std::vector<char> req;
   while (!srv->stop.load() && read_frame(fd, &req)) {
     if (req.size() < 5) break;
-    const char* p = req.data();
-    uint8_t op = take<uint8_t>(p);
-    int32_t tid = take<int32_t>(p);
+    Reader rd{req.data(), req.data() + req.size()};
+    uint8_t op = rd.take<uint8_t>();
+    int32_t tid = rd.take<int32_t>();
     switch (op) {
       case OP_CREATE_SPARSE: {
-        uint32_t dim = take<uint32_t>(p);
-        uint8_t rule = take<uint8_t>(p);
-        float lr = take<float>(p);
-        float init_std = take<float>(p);
-        uint64_t seed = take<uint64_t>(p);
+        uint32_t dim = rd.take<uint32_t>();
+        uint8_t rule = rd.take<uint8_t>();
+        float lr = rd.take<float>();
+        float init_std = rd.take<float>();
+        uint64_t seed = rd.take<uint64_t>();
+        if (!rd.ok || dim == 0) {
+          reply_err(fd, "malformed create_sparse");
+          break;
+        }
         std::lock_guard<std::mutex> g(srv->tables_mu);
         Table& t = srv->tables[tid];  // idempotent create
         if (t.dim == 0) {
@@ -284,17 +330,26 @@ void handle_conn(Server* srv, int fd) {
         break;
       }
       case OP_PULL_SPARSE: {
-        uint64_t n = take<uint64_t>(p);
+        uint64_t n = rd.take<uint64_t>();
+        const char* ids_p =
+            rd.ok && n <= static_cast<uint64_t>(rd.end - rd.p) / 8
+                ? rd.bytes(n * 8)
+                : nullptr;
         Table* t = srv->get(tid);
         if (!t || t->dense) {
           reply_err(fd, "no such sparse table");
+          break;
+        }
+        if (!ids_p) {
+          reply_err(fd, "malformed pull_sparse");
           break;
         }
         std::vector<float> out(n * t->dim);
         {
           std::lock_guard<std::mutex> g(t->mu);
           for (uint64_t i = 0; i < n; ++i) {
-            int64_t id = take<int64_t>(p);
+            int64_t id;
+            std::memcpy(&id, ids_p + i * 8, 8);
             auto& row = t->materialize(id);
             std::memcpy(out.data() + i * t->dim, row.data(), t->dim * 4);
           }
@@ -303,14 +358,20 @@ void handle_conn(Server* srv, int fd) {
         break;
       }
       case OP_PUSH_SPARSE: {
-        uint64_t n = take<uint64_t>(p);
+        uint64_t n = rd.take<uint64_t>();
         Table* t = srv->get(tid);
         if (!t || t->dense) {
           reply_err(fd, "no such sparse table");
           break;
         }
-        const char* ids_p = p;
-        const char* grads_p = p + n * 8;
+        uint64_t avail = static_cast<uint64_t>(rd.end - rd.p);
+        if (!rd.ok || n > avail / 8 ||
+            avail < n * 8 + n * static_cast<uint64_t>(t->dim) * 4) {
+          reply_err(fd, "malformed push_sparse");
+          break;
+        }
+        const char* ids_p = rd.bytes(n * 8);
+        const char* grads_p = rd.bytes(n * static_cast<uint64_t>(t->dim) * 4);
         std::lock_guard<std::mutex> g(t->mu);
         // merge duplicate ids before the rule (MergeAdd semantics)
         std::unordered_map<int64_t, std::vector<float>> merged;
@@ -338,9 +399,13 @@ void handle_conn(Server* srv, int fd) {
         break;
       }
       case OP_CREATE_DENSE: {
-        uint64_t size = take<uint64_t>(p);
-        uint8_t rule = take<uint8_t>(p);
-        float lr = take<float>(p);
+        uint64_t size = rd.take<uint64_t>();
+        uint8_t rule = rd.take<uint8_t>();
+        float lr = rd.take<float>();
+        if (!rd.ok || size > (1ull << 34)) {  // 64 GB of floats: insane
+          reply_err(fd, "malformed create_dense");
+          break;
+        }
         std::lock_guard<std::mutex> g(srv->tables_mu);
         Table& t = srv->tables[tid];
         if (!t.dense) {
@@ -366,22 +431,39 @@ void handle_conn(Server* srv, int fd) {
         break;
       }
       case OP_PUSH_DENSE: {
-        uint64_t n = take<uint64_t>(p);
+        uint64_t n = rd.take<uint64_t>();
+        const char* grad_p =
+            rd.ok && n <= static_cast<uint64_t>(rd.end - rd.p) / 4
+                ? rd.bytes(n * 4)
+                : nullptr;
         Table* t = srv->get(tid);
         if (!t || !t->dense || n != t->dense_val.size()) {
           reply_err(fd, "dense size mismatch");
           break;
         }
+        if (!grad_p) {
+          reply_err(fd, "malformed push_dense");
+          break;
+        }
         std::lock_guard<std::mutex> g(t->mu);
-        t->apply(t->dense_val.data(), reinterpret_cast<const float*>(p),
+        t->apply(t->dense_val.data(),
+                 reinterpret_cast<const float*>(grad_p),
                  t->rule == 1 ? t->dense_slot.data() : nullptr, n);
         reply_ok(fd);
         break;
       }
       case OP_SAVE:
       case OP_LOAD: {
-        uint64_t n = take<uint64_t>(p);
-        std::string path(p, p + n);
+        uint64_t n = rd.take<uint64_t>();
+        const char* path_p =
+            rd.ok && n <= static_cast<uint64_t>(rd.end - rd.p)
+                ? rd.bytes(n)
+                : nullptr;
+        if (!path_p) {
+          reply_err(fd, "malformed save/load");
+          break;
+        }
+        std::string path(path_p, path_p + n);
         Table* t = srv->get(tid);
         if (op == OP_LOAD && !t) {
           std::lock_guard<std::mutex> g(srv->tables_mu);
@@ -412,10 +494,11 @@ void handle_conn(Server* srv, int fd) {
         reply_err(fd, "bad op");
     }
   }
-  // fd stays open until server stop: closing here would let the kernel
-  // reuse the number while stop() still holds it in conn_fds (a shutdown
-  // on a recycled fd could hit an unrelated descriptor)
+  // fd stays open until the reaper (accept loop) or stop() closes it:
+  // closing here would let the kernel recycle the number while the server
+  // still holds it (a later shutdown could hit an unrelated descriptor)
   ::shutdown(fd, SHUT_RDWR);
+  done->store(true);
 }
 
 struct Client {
@@ -474,8 +557,22 @@ void* ps_server_start(int port) {
       int fd = ::accept(srv->listen_fd, nullptr, nullptr);
       if (fd < 0) break;
       std::lock_guard<std::mutex> g(srv->conns_mu);
-      srv->conn_fds.push_back(fd);
-      srv->conn_threads.emplace_back(handle_conn, srv, fd);
+      // reap finished handlers: join + close, then drop the slot
+      for (auto it = srv->conns.begin(); it != srv->conns.end();) {
+        if (it->done->load()) {
+          if (it->th.joinable()) it->th.join();
+          ::close(it->fd);
+          it = srv->conns.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      auto done = std::make_shared<std::atomic<bool>>(false);
+      Server::ConnSlot slot;
+      slot.fd = fd;
+      slot.done = done;
+      slot.th = std::thread(handle_conn, srv, fd, done);
+      srv->conns.push_back(std::move(slot));
     }
   });
   return srv;
@@ -486,18 +583,22 @@ int ps_server_port(void* h) { return static_cast<Server*>(h)->port; }
 void ps_server_stop(void* h) {
   auto* srv = static_cast<Server*>(h);
   srv->stop.store(true);
+  // shutdown unblocks accept(); the listen fd is CLOSED only after the
+  // accept thread joins (close-before-join would let the kernel recycle
+  // the number under a racing accept call)
   ::shutdown(srv->listen_fd, SHUT_RDWR);
-  ::close(srv->listen_fd);
   if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  ::close(srv->listen_fd);
   // wake every blocked handler, then JOIN them all before freeing the
   // table map — no use-after-free window for in-flight requests
   {
     std::lock_guard<std::mutex> g(srv->conns_mu);
-    for (int fd : srv->conn_fds) ::shutdown(fd, SHUT_RDWR);
+    for (auto& c : srv->conns) ::shutdown(c.fd, SHUT_RDWR);
   }
-  for (auto& t : srv->conn_threads)
-    if (t.joinable()) t.join();
-  for (int fd : srv->conn_fds) ::close(fd);
+  for (auto& c : srv->conns) {
+    if (c.th.joinable()) c.th.join();
+    ::close(c.fd);
+  }
   delete srv;
 }
 

@@ -318,6 +318,16 @@ class NativePSClient:
         sparse_files = [
             p for p in glob.glob(os.path.join(dirname, "shard*", "*.pstab"))
             if not p.endswith(".dense.pstab")]
+        dense_files = glob.glob(
+            os.path.join(dirname, "shard*", "*.dense.pstab"))
+        if not sparse_files and not dense_files:
+            # an inproc/http checkpoint (.npz) or an empty dir must not
+            # silently no-op into freshly-initialized random rows
+            raise FileNotFoundError(
+                f"no .pstab files under {dirname!r} — this is not a "
+                f"native-transport checkpoint (inproc/http checkpoints "
+                f"use .npz; load them through TheOnePSRuntime with the "
+                f"matching transport)")
         if saved == self.n:
             for path in sparse_files:
                 shard_dir = os.path.basename(os.path.dirname(path))
@@ -355,4 +365,11 @@ class NativePSClient:
 
     def table_size(self, table: str) -> int:
         tid = _table_id(table)
-        return sum(self._lib.ps_table_size(h, tid) for h in self._conns)
+        total = 0
+        for i, h in enumerate(self._conns):
+            n = self._lib.ps_table_size(h, tid)
+            if n < 0:
+                raise RuntimeError(
+                    f"table_size({table}) failed on shard {i}")
+            total += n
+        return total

@@ -30,7 +30,7 @@ __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
            "read_file", "decode_jpeg", "yolo_loss", "density_prior_box",
            "collect_fpn_proposals", "sampling_id", "rpn_target_assign",
            "generate_proposal_labels", "prroi_pool", "im2sequence",
-           "retinanet_target_assign", "locality_aware_nms"]
+           "retinanet_target_assign", "locality_aware_nms", "generate_mask_labels"]
 
 
 def _iou_matrix(boxes_a, boxes_b, offset=0.0):
@@ -1629,3 +1629,89 @@ def locality_aware_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
     out = np.asarray(rows, np.float32).reshape(-1, 6)
     from ..tensor.creation import to_tensor
     return to_tensor(out), to_tensor(np.asarray([len(rows)], np.int32))
+
+
+def _rasterize_polys(polys, box, resolution):
+    """Union of polygons rasterized into a resolution^2 grid over `box`
+    (Polys2MaskWrtBox, mask_util.cc): polygon coords map into the box frame,
+    filled with the even-odd rule at pixel centers."""
+    import numpy as np
+    x0, y0, x1, y1 = box
+    w = max(x1 - x0, 1e-6)
+    h = max(y1 - y0, 1e-6)
+    ys, xs = np.meshgrid(
+        (np.arange(resolution) + 0.5) * h / resolution + y0,
+        (np.arange(resolution) + 0.5) * w / resolution + x0,
+        indexing="ij")
+    mask = np.zeros((resolution, resolution), bool)
+    for poly in polys:
+        p = np.asarray(poly, np.float64).reshape(-1, 2)
+        inside = np.zeros_like(mask)
+        j = len(p) - 1
+        for i in range(len(p)):  # even-odd ray cast per edge
+            xi, yi = p[i]
+            xj, yj = p[j]
+            cond = ((yi > ys) != (yj > ys)) & \
+                (xs < (xj - xi) * (ys - yi) / (yj - yi + 1e-12) + xi)
+            inside ^= cond
+            j = i
+        mask |= inside
+    return mask.astype(np.int32)
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    """generate_mask_labels_op.cc: build Mask-RCNN mask targets for ONE
+    image. Each fg roi (label > 0) is matched to the gt polygon set whose
+    bounding box overlaps it most; the polygons are rasterized inside the
+    roi at resolution^2 and expanded to the per-class layout
+    [fg, num_classes * resolution^2] with -1 (ignore) everywhere except
+    the matched class's slot. gt_segms: list (per gt) of polygon lists,
+    each polygon a flat [x0, y0, x1, y1, ...] sequence — the python-list
+    equivalent of the reference's 3-level LoD. Returns (mask_rois,
+    roi_has_mask_int32, mask_int32); with no fg roi, one bg roi with an
+    all -1 mask (the reference's empty-blob guard)."""
+    import numpy as np
+    info = np.asarray(_t(im_info).data, np.float32).reshape(-1)
+    im_scale = float(info[2]) if len(info) >= 3 else 1.0
+    gcls = np.asarray(_t(gt_classes).data).reshape(-1).astype(np.int64)
+    crowd = np.asarray(_t(is_crowd).data).reshape(-1).astype(np.int64)
+    r = np.asarray(_t(rois).data, np.float32).reshape(-1, 4)
+    lbl = np.asarray(_t(labels_int32).data).reshape(-1).astype(np.int64)
+    M = resolution * resolution
+
+    keep = [(i, gt_segms[i]) for i in range(len(gcls))
+            if gcls[i] > 0 and crowd[i] == 0]
+    fg = np.nonzero(lbl > 0)[0]
+    from ..tensor.creation import to_tensor
+    if not len(fg) or not keep:
+        # empty-blob guard: first bg roi, class 0, all-ignore mask
+        bg = np.nonzero(lbl == 0)[0]
+        sel = bg[:1] if len(bg) else np.array([0])
+        mask = -np.ones((1, num_classes * M), np.int32)
+        return (to_tensor(r[sel] / im_scale),
+                to_tensor(sel.astype(np.int32)), to_tensor(mask))
+
+    # enclosing box per gt polygon set
+    poly_boxes = np.stack([
+        np.array([min(np.asarray(p, np.float64).reshape(-1, 2)[:, 0].min()
+                      for p in polys),
+                  min(np.asarray(p, np.float64).reshape(-1, 2)[:, 1].min()
+                      for p in polys),
+                  max(np.asarray(p, np.float64).reshape(-1, 2)[:, 0].max()
+                      for p in polys),
+                  max(np.asarray(p, np.float64).reshape(-1, 2)[:, 1].max()
+                      for p in polys)], np.float32)
+        for _, polys in keep])
+    rois_fg = r[fg] / im_scale
+    iou = np.asarray(_iou_matrix(jnp.asarray(rois_fg),
+                                 jnp.asarray(poly_boxes)))
+    match = iou.argmax(axis=1)
+    out = -np.ones((len(fg), num_classes * M), np.int32)
+    for i in range(len(fg)):
+        polys = keep[match[i]][1]
+        m = _rasterize_polys(polys, rois_fg[i], resolution).reshape(-1)
+        c = int(lbl[fg[i]])
+        out[i, c * M:(c + 1) * M] = m
+    return (to_tensor(rois_fg), to_tensor(fg.astype(np.int32)),
+            to_tensor(out))

@@ -410,3 +410,59 @@ def test_locality_aware_nms_merges_adjacent_boxes():
         V.locality_aware_nms(
             paddle.to_tensor(np.zeros((1, 2, 8), np.float32)),
             paddle.to_tensor(np.zeros((1, 1, 2), np.float32)))
+
+
+def test_generate_mask_labels_square_polygon():
+    # a square polygon covering the left half of the roi rasterizes to a
+    # half-on mask in the matched class slot; other slots stay -1 (ignore)
+    im_info = paddle.to_tensor(np.array([64, 64, 1.0], np.float32))
+    gt_classes = paddle.to_tensor(np.array([2], np.int64))
+    is_crowd = paddle.to_tensor(np.array([0], np.int64))
+    segms = [[[0.0, 0.0, 8.0, 0.0, 8.0, 16.0, 0.0, 16.0]]]  # left half
+    rois = paddle.to_tensor(np.array([[0, 0, 16, 16],
+                                      [40, 40, 50, 50]], np.float32))
+    labels = paddle.to_tensor(np.array([2, 0], np.int64))
+    R = 4
+    mask_rois, has_mask, mask = V.generate_mask_labels(
+        im_info, gt_classes, is_crowd, segms, rois, labels,
+        num_classes=3, resolution=R)
+    m = np.asarray(mask.data).reshape(1, 3, R, R)
+    np.testing.assert_array_equal(np.asarray(has_mask.data), [0])
+    assert (m[0, 0] == -1).all() and (m[0, 1] == -1).all()
+    np.testing.assert_array_equal(m[0, 2][:, :2], 1)  # left half on
+    np.testing.assert_array_equal(m[0, 2][:, 2:], 0)
+
+
+def test_generate_mask_labels_no_fg_guard():
+    im_info = paddle.to_tensor(np.array([64, 64, 1.0], np.float32))
+    gt_classes = paddle.to_tensor(np.array([1], np.int64))
+    is_crowd = paddle.to_tensor(np.array([0], np.int64))
+    segms = [[[0.0, 0.0, 4.0, 0.0, 4.0, 4.0]]]
+    rois = paddle.to_tensor(np.array([[0, 0, 8, 8]], np.float32))
+    labels = paddle.to_tensor(np.array([0], np.int64))
+    mask_rois, has_mask, mask = V.generate_mask_labels(
+        im_info, gt_classes, is_crowd, segms, rois, labels,
+        num_classes=2, resolution=4)
+    assert (np.asarray(mask.data) == -1).all()
+
+
+def test_im2sequence_gradient_finite_difference():
+    rng = np.random.RandomState(0)
+    x0 = rng.randn(1, 2, 4, 4).astype(np.float32)
+
+    def loss_of(xnp):
+        t = paddle.to_tensor(xnp)
+        t.stop_gradient = False
+        out = V.im2sequence(t, kernels=(2, 2), strides=(1, 1))
+        return (out * out).sum(), t
+
+    loss, t = loss_of(x0)
+    loss.backward()
+    g = np.asarray(t.grad.data)
+    eps = 1e-3
+    for idx in [(0, 0, 0, 0), (0, 1, 2, 3), (0, 0, 1, 1)]:
+        xp = x0.copy(); xp[idx] += eps
+        xm = x0.copy(); xm[idx] -= eps
+        num = (float(loss_of(xp)[0].item())
+               - float(loss_of(xm)[0].item())) / (2 * eps)
+        np.testing.assert_allclose(g[idx], num, rtol=2e-2, atol=2e-2)

@@ -576,3 +576,25 @@ def test_barrier_table_releases_all_waiters():
     for t in t2:
         t.join(5)
     assert len(released) == 6
+
+
+def test_native_load_clears_post_save_rows(tmp_path):
+    """A restore is a restore: rows materialized after the save must not
+    survive load, and an empty/foreign checkpoint dir raises instead of
+    silently serving fresh random rows."""
+    servers, client = _native_pair(1)
+    try:
+        client.create_table("e", 4, rule="sgd", lr=0.1, init_std=0.0)
+        client.pull_sparse("e", np.array([1, 2]))
+        client.save(str(tmp_path / "ck"))
+        client.pull_sparse("e", np.array([3]))  # post-save row
+        assert client.table_size("e") == 3
+        client.load(str(tmp_path / "ck"))
+        assert client.table_size("e") == 2
+        import pytest as _pytest
+        with _pytest.raises(FileNotFoundError):
+            client.load(str(tmp_path / "nope"))
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
