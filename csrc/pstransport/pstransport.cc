@@ -75,10 +75,15 @@ bool write_all(int fd, const void* buf, size_t n) {
   return true;
 }
 
-// frame: [u32 payload_len][payload]
+// frame: [u32 payload_len][payload]; the length prefix is capped so a
+// corrupt/desynced stream drops the connection instead of forcing a 4 GB
+// allocation (the same no-bad_alloc guarantee as the Reader)
+constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GB
+
 bool read_frame(int fd, std::vector<char>* out) {
   uint32_t len;
   if (!read_all(fd, &len, 4)) return false;
+  if (len > kMaxFrame) return false;
   out->resize(len);
   return len == 0 || read_all(fd, out->data(), len);
 }
@@ -251,49 +256,59 @@ bool load_table(Table* t, const std::string& path) {
     std::fclose(f);
     return false;
   }
-  t->dim = dim;
-  t->rule = rule;
-  t->lr = lr;
-  t->epsilon = eps;
-  // a restore replaces state: rows materialized after the save (and their
-  // slots) must not survive the load
-  t->rows.clear();
-  t->slots.clear();
-  t->dense_val.clear();
-  t->dense_slot.clear();
+  if (dim == 0 || dim > (1u << 20)) {
+    std::fclose(f);
+    return false;
+  }
+  // parse into temporaries and swap only on success: a truncated file must
+  // leave the live table untouched, not cleared (a failed restore followed
+  // by a retry/continue would otherwise serve fresh random rows)
   bool ok = true;
+  std::unordered_map<int64_t, std::vector<float>> rows, slots;
+  std::vector<float> dense_val, dense_slot;
   if (dense) {
     uint64_t n = 0, ns = 0;
-    ok = std::fread(&n, 8, 1, f) == 1;
-    t->dense_val.resize(n);
-    ok = ok && (n == 0 || std::fread(t->dense_val.data(), 4, n, f) == n);
-    ok = ok && std::fread(&ns, 8, 1, f) == 1;
-    t->dense_slot.resize(ns);
-    ok = ok && (ns == 0 || std::fread(t->dense_slot.data(), 4, ns, f) == ns);
-    t->dense = true;
-    t->dense_size = n;
+    ok = std::fread(&n, 8, 1, f) == 1 && n <= (1ull << 34);
+    if (ok) dense_val.resize(n);
+    ok = ok && (n == 0 || std::fread(dense_val.data(), 4, n, f) == n);
+    ok = ok && std::fread(&ns, 8, 1, f) == 1 && ns <= (1ull << 34);
+    if (ok) dense_slot.resize(ns);
+    ok = ok && (ns == 0 || std::fread(dense_slot.data(), 4, ns, f) == ns);
   } else {
     uint64_t n = 0;
     ok = std::fread(&n, 8, 1, f) == 1;
     for (uint64_t i = 0; ok && i < n; ++i) {
       int64_t id;
-      std::vector<float> row(t->dim);
+      std::vector<float> row(dim);
       ok = std::fread(&id, 8, 1, f) == 1 &&
-           std::fread(row.data(), 4, t->dim, f) == t->dim;
-      if (ok) t->rows[id] = std::move(row);
+           std::fread(row.data(), 4, dim, f) == dim;
+      if (ok) rows[id] = std::move(row);
     }
     uint64_t ns = 0;
     ok = ok && std::fread(&ns, 8, 1, f) == 1;
     for (uint64_t i = 0; ok && i < ns; ++i) {
       int64_t id;
-      std::vector<float> row(t->dim);
+      std::vector<float> row(dim);
       ok = std::fread(&id, 8, 1, f) == 1 &&
-           std::fread(row.data(), 4, t->dim, f) == t->dim;
-      if (ok) t->slots[id] = std::move(row);
+           std::fread(row.data(), 4, dim, f) == dim;
+      if (ok) slots[id] = std::move(row);
     }
   }
   std::fclose(f);
-  return ok;
+  if (!ok) return false;
+  t->dim = dim;
+  t->rule = rule;
+  t->lr = lr;
+  t->epsilon = eps;
+  t->rows = std::move(rows);
+  t->slots = std::move(slots);
+  t->dense_val = std::move(dense_val);
+  t->dense_slot = std::move(dense_slot);
+  if (dense) {
+    t->dense = true;
+    t->dense_size = t->dense_val.size();
+  }
+  return true;
 }
 
 void handle_conn(Server* srv, int fd,
@@ -313,7 +328,7 @@ void handle_conn(Server* srv, int fd,
         float lr = rd.take<float>();
         float init_std = rd.take<float>();
         uint64_t seed = rd.take<uint64_t>();
-        if (!rd.ok || dim == 0) {
+        if (!rd.ok || dim == 0 || dim > (1u << 20)) {  // 4 MB/row cap
           reply_err(fd, "malformed create_sparse");
           break;
         }

@@ -307,8 +307,9 @@ class NativePSClient:
         else:
             saved = len(glob.glob(os.path.join(dirname, "shard*")))
         # dense tables: single-owner, placement depends only on name
-        for path in glob.glob(os.path.join(dirname, "shard*",
-                                           "*.dense.pstab")):
+        dense_files = glob.glob(
+            os.path.join(dirname, "shard*", "*.dense.pstab"))
+        for path in dense_files:
             name = os.path.basename(path)[:-len(".dense.pstab")]
             rc = self._lib.ps_load_table(
                 self._conns[self._dense_conn(name)], _table_id(name),
@@ -318,8 +319,6 @@ class NativePSClient:
         sparse_files = [
             p for p in glob.glob(os.path.join(dirname, "shard*", "*.pstab"))
             if not p.endswith(".dense.pstab")]
-        dense_files = glob.glob(
-            os.path.join(dirname, "shard*", "*.dense.pstab"))
         if not sparse_files and not dense_files:
             # an inproc/http checkpoint (.npz) or an empty dir must not
             # silently no-op into freshly-initialized random rows

@@ -157,6 +157,41 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
     jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
 
+    def _cubic_axis(out, ax, s_out, corners):
+        """Separable Keys-cubic (a = -0.75, the paddle/torch/OpenCV bicubic
+        convention — bicubic_interp_v2_op uses the same kernel; jax.image's
+        'cubic' is Catmull-Rom a = -0.5, which differs by ~0.4%)."""
+        A = -0.75
+        s_in = out.shape[ax]
+        if corners:
+            # out size 1 under align_corners maps to source index 0 (ratio
+            # is defined as 0 when out==1 in bicubic_interp_v2), not to the
+            # half-pixel window center
+            src = jnp.arange(s_out, dtype=jnp.float32) * (s_in - 1) \
+                / max(s_out - 1, 1)
+        else:
+            src = (jnp.arange(s_out, dtype=jnp.float32) + 0.5) \
+                * (s_in / s_out) - 0.5
+        s0 = jnp.floor(src).astype(jnp.int32)
+        t = (src - s0).astype(out.dtype)
+
+        def k(d):
+            ad = jnp.abs(d)
+            return jnp.where(
+                ad <= 1.0, ((A + 2) * ad - (A + 3)) * ad * ad + 1,
+                jnp.where(ad < 2.0,
+                          ((A * ad - 5 * A) * ad + 8 * A) * ad - 4 * A,
+                          0.0))
+
+        acc = 0
+        for off in (-1, 0, 1, 2):
+            idx = jnp.clip(s0 + off, 0, s_in - 1)
+            w = k(t - off)
+            shape = [1] * out.ndim
+            shape[ax] = s_out
+            acc = acc + jnp.take(out, idx, axis=ax) * w.reshape(shape)
+        return acc
+
     def f(a):
         if channel_last:
             out_shape = (a.shape[0],) + tuple(size) + (a.shape[-1],)
@@ -165,6 +200,13 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         if jmode == "nearest":
             # jax.image nearest matches paddle align_corners=False
             return jax.image.resize(a, out_shape, method="nearest")
+        spatial_axes_all = (list(range(1, 1 + nd)) if channel_last
+                            else list(range(2, 2 + nd)))
+        if jmode == "cubic":
+            out = a
+            for ax, s_out in zip(spatial_axes_all, size):
+                out = _cubic_axis(out, ax, s_out, align_corners)
+            return out
         if align_corners:
             # build index grid with corner alignment, gather per spatial dim
             out = a

@@ -86,9 +86,31 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
 
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
              name=None):
-    return cross_entropy(input, label, weight=weight, ignore_index=ignore_index,
-                         reduction=reduction, use_softmax=False,
-                         soft_label=False)
+    """paddle.nn.functional.nll_loss (nll_loss_op.cc): input is
+    LOG-probabilities [N, C, d...], loss = -input[label] (routing through
+    cross_entropy(use_softmax=False) would log() the already-log input).
+    Weighted mean divides by the summed weights of non-ignored targets."""
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+
+    def f(logp, lab, *maybe_w):
+        logp = logp.astype(jnp.float32)
+        li = lab.astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(jnp.maximum(li, 0), 1), axis=1)
+        loss = -jnp.squeeze(picked, 1)
+        mask = li != ignore_index
+        w = maybe_w[0].astype(jnp.float32)[jnp.maximum(li, 0)]             if maybe_w else jnp.ones_like(loss)
+        w = jnp.where(mask, w, 0.0)
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply(f, *args)
 
 
 def mse_loss(input, label, reduction="mean", name=None):

@@ -178,6 +178,9 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
         pad_cfg[1] = (half, size - 1 - half)
         padded = jnp.pad(sq, pad_cfg)
         acc = sum(padded[:, i:i + a.shape[1]] for i in range(size))
-        return a / jnp.power(k + alpha * acc, beta)
+        # 2.x convention (nn/functional/norm.py local_response_norm in the
+        # reference builds the window with avg_pool): alpha scales the
+        # window MEAN, matching torch — the fluid lrn_op scaled the sum
+        return a / jnp.power(k + alpha * acc / size, beta)
 
     return apply(f, x)

@@ -1685,7 +1685,12 @@ def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
     fg = np.nonzero(lbl > 0)[0]
     from ..tensor.creation import to_tensor
     if not len(fg) or not keep:
-        # empty-blob guard: first bg roi, class 0, all-ignore mask
+        # empty-blob guard: first bg roi, class 0, all-ignore mask; with
+        # zero rois at all, return well-formed empty outputs
+        if not len(r):
+            return (to_tensor(np.zeros((0, 4), np.float32)),
+                    to_tensor(np.zeros(0, np.int32)),
+                    to_tensor(np.zeros((0, num_classes * M), np.int32)))
         bg = np.nonzero(lbl == 0)[0]
         sel = bg[:1] if len(bg) else np.array([0])
         mask = -np.ones((1, num_classes * M), np.int32)

@@ -1,0 +1,296 @@
+"""OpTest fixture batch 8: output-vs-torch and finite-difference gradient
+checks for ops that had no numeric fixtures yet — interpolate modes,
+pixel (un)shuffle, loss tail (margin_ranking/bce/bce_logits/nll),
+adaptive pooling, local_response_norm, activation tail
+(prelu/selu/hardswish/hardsigmoid/mish/softsign/tanhshrink/softshrink/
+hardshrink), grid_sample grad, cosine_similarity, pad modes
+(reference protocol: unittests/op_test.py:270 check_output/check_grad)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+from op_test_base import check_grad, check_output
+
+torch = pytest.importorskip("torch")
+
+
+def _t(x):
+    return torch.from_numpy(x)
+
+
+# ---- interpolate ----
+
+@pytest.mark.parametrize("mode", ["nearest", "bilinear", "bicubic"])
+def test_interpolate_output_vs_torch(mode):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 5, 7).astype(np.float32)
+    kwargs = {} if mode == "nearest" else {"align_corners": False}
+
+    def np_ref(x_):
+        return torch.nn.functional.interpolate(
+            _t(x_), size=(10, 14), mode=mode, **kwargs).numpy()
+
+    check_output(
+        lambda xt: F.interpolate(xt, size=(10, 14), mode=mode),
+        np_ref, [x], atol=1e-4, rtol=1e-4)
+
+
+def test_interpolate_bilinear_align_corners_vs_torch():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+
+    def np_ref(x_):
+        return torch.nn.functional.interpolate(
+            _t(x_), size=(7, 9), mode="bilinear",
+            align_corners=True).numpy()
+
+    check_output(
+        lambda xt: F.interpolate(xt, size=(7, 9), mode="bilinear",
+                                 align_corners=True),
+        np_ref, [x], atol=1e-4, rtol=1e-4)
+
+
+def test_interpolate_bilinear_grad():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 2, 4, 5).astype(np.float32)
+    check_grad(lambda xt: F.interpolate(xt, size=(8, 10), mode="bilinear"),
+               [x])
+
+
+def test_interpolate_linear_and_trilinear_vs_torch():
+    rng = np.random.RandomState(3)
+    x1 = rng.randn(2, 3, 6).astype(np.float32)
+    x3 = rng.randn(1, 2, 3, 4, 5).astype(np.float32)
+
+    check_output(
+        lambda xt: F.interpolate(xt, size=[12], mode="linear"),
+        lambda x_: torch.nn.functional.interpolate(
+            _t(x_), size=12, mode="linear", align_corners=False).numpy(),
+        [x1], atol=1e-4, rtol=1e-4)
+    check_output(
+        lambda xt: F.interpolate(xt, size=(6, 8, 10), mode="trilinear"),
+        lambda x_: torch.nn.functional.interpolate(
+            _t(x_), size=(6, 8, 10), mode="trilinear",
+            align_corners=False).numpy(),
+        [x3], atol=1e-4, rtol=1e-4)
+
+
+# ---- pixel shuffle / unshuffle ----
+
+def test_pixel_shuffle_roundtrip_and_vs_torch():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 8, 3, 3).astype(np.float32)
+    check_output(
+        lambda xt: F.pixel_shuffle(xt, 2),
+        lambda x_: torch.nn.functional.pixel_shuffle(_t(x_), 2).numpy(),
+        [x])
+    y = F.pixel_shuffle(paddle.to_tensor(x), 2)
+    back = F.pixel_unshuffle(y, 2)
+    np.testing.assert_allclose(np.asarray(back.data), x, rtol=1e-6)
+
+
+def test_pixel_shuffle_grad():
+    rng = np.random.RandomState(5)
+    x = rng.randn(1, 4, 3, 3).astype(np.float32)
+    check_grad(lambda xt: F.pixel_shuffle(xt, 2), [x])
+
+
+# ---- loss tail ----
+
+def test_margin_ranking_loss_vs_torch():
+    rng = np.random.RandomState(6)
+    a = rng.randn(8).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    lbl = np.sign(rng.randn(8)).astype(np.float32)
+
+    def np_ref(a_, b_, l_):
+        return torch.nn.functional.margin_ranking_loss(
+            _t(a_), _t(b_), _t(l_), margin=0.5).numpy()
+
+    check_output(
+        lambda at, bt, lt: F.margin_ranking_loss(at, bt, lt, margin=0.5),
+        np_ref, [a, b, lbl], atol=1e-5, rtol=1e-5)
+    check_grad(
+        lambda at, bt: F.margin_ranking_loss(
+            at, bt, paddle.to_tensor(lbl), margin=0.5),
+        [a, b])
+
+
+def test_binary_cross_entropy_vs_torch():
+    rng = np.random.RandomState(7)
+    p = rng.uniform(0.05, 0.95, (4, 3)).astype(np.float32)
+    y = rng.randint(0, 2, (4, 3)).astype(np.float32)
+
+    check_output(
+        lambda pt, yt: F.binary_cross_entropy(pt, yt),
+        lambda p_, y_: torch.nn.functional.binary_cross_entropy(
+            _t(p_), _t(y_)).numpy(),
+        [p, y], atol=1e-5, rtol=1e-5)
+    check_grad(lambda pt: F.binary_cross_entropy(pt, paddle.to_tensor(y)),
+               [p])
+
+
+def test_binary_cross_entropy_with_logits_vs_torch():
+    rng = np.random.RandomState(8)
+    x = rng.randn(4, 3).astype(np.float32)
+    y = rng.randint(0, 2, (4, 3)).astype(np.float32)
+
+    check_output(
+        lambda xt, yt: F.binary_cross_entropy_with_logits(xt, yt),
+        lambda x_, y_: torch.nn.functional.binary_cross_entropy_with_logits(
+            _t(x_), _t(y_)).numpy(),
+        [x, y], atol=1e-5, rtol=1e-5)
+    check_grad(
+        lambda xt: F.binary_cross_entropy_with_logits(
+            xt, paddle.to_tensor(y)), [x])
+
+
+def test_nll_loss_vs_torch():
+    rng = np.random.RandomState(9)
+    logp = np.log(rng.dirichlet(np.ones(5), 6).astype(np.float32))
+    y = rng.randint(0, 5, (6,)).astype(np.int64)
+
+    def np_ref(lp_):
+        return torch.nn.functional.nll_loss(
+            _t(lp_), torch.from_numpy(y)).numpy()
+
+    check_output(lambda lt: F.nll_loss(lt, paddle.to_tensor(y)), np_ref,
+                 [logp], atol=1e-5, rtol=1e-5)
+    check_grad(lambda lt: F.nll_loss(lt, paddle.to_tensor(y)), [logp])
+
+
+def test_cosine_similarity_vs_torch():
+    rng = np.random.RandomState(10)
+    a = rng.randn(4, 6).astype(np.float32)
+    b = rng.randn(4, 6).astype(np.float32)
+    check_output(
+        lambda at, bt: F.cosine_similarity(at, bt, axis=1),
+        lambda a_, b_: torch.nn.functional.cosine_similarity(
+            _t(a_), _t(b_), dim=1).numpy(),
+        [a, b], atol=1e-5, rtol=1e-5)
+    check_grad(lambda at, bt: F.cosine_similarity(at, bt, axis=1), [a, b])
+
+
+# ---- adaptive pooling ----
+
+def test_adaptive_avg_pool2d_vs_torch():
+    rng = np.random.RandomState(11)
+    x = rng.randn(2, 3, 7, 9).astype(np.float32)
+    check_output(
+        lambda xt: F.adaptive_avg_pool2d(xt, (3, 4)),
+        lambda x_: torch.nn.functional.adaptive_avg_pool2d(
+            _t(x_), (3, 4)).numpy(),
+        [x], atol=1e-5, rtol=1e-5)
+    check_grad(lambda xt: F.adaptive_avg_pool2d(xt, (3, 4)), [x])
+
+
+def test_adaptive_max_pool2d_vs_torch():
+    rng = np.random.RandomState(12)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    check_output(
+        lambda xt: F.adaptive_max_pool2d(xt, (2, 2)),
+        lambda x_: torch.nn.functional.adaptive_max_pool2d(
+            _t(x_), (2, 2)).numpy(),
+        [x], atol=1e-5, rtol=1e-5)
+
+
+# ---- local response norm ----
+
+def test_local_response_norm_vs_torch():
+    rng = np.random.RandomState(13)
+    x = rng.randn(2, 6, 4, 4).astype(np.float32)
+    check_output(
+        lambda xt: F.local_response_norm(xt, size=3, alpha=1e-3, beta=0.75,
+                                         k=1.0),
+        lambda x_: torch.nn.functional.local_response_norm(
+            _t(x_), size=3, alpha=1e-3, beta=0.75, k=1.0).numpy(),
+        [x], atol=1e-5, rtol=1e-5)
+    check_grad(
+        lambda xt: F.local_response_norm(xt, size=3, alpha=1e-3,
+                                         beta=0.75, k=1.0), [x])
+
+
+# ---- activation tail ----
+
+@pytest.mark.parametrize("name,tfn", [
+    ("selu", torch.nn.functional.selu),
+    ("hardswish", torch.nn.functional.hardswish),
+    ("hardsigmoid", torch.nn.functional.hardsigmoid),
+    ("mish", torch.nn.functional.mish),
+    ("softsign", torch.nn.functional.softsign),
+    ("tanhshrink", torch.nn.functional.tanhshrink),
+])
+def test_activation_tail_vs_torch(name, tfn):
+    rng = np.random.RandomState(14)
+    # keep away from the piecewise kinks (|x|=3 for hard*) so finite
+    # differences stay clean
+    x = (rng.randn(4, 5) * 1.2).astype(np.float32)
+    x = np.where(np.abs(np.abs(x) - 3.0) < 0.1, x + 0.3, x).astype(
+        np.float32)
+    op = getattr(F, name)
+    check_output(lambda xt: op(xt), lambda x_: tfn(_t(x_)).numpy(),
+                 [x], atol=1e-5, rtol=1e-5)
+    check_grad(lambda xt: op(xt), [x])
+
+
+def test_prelu_vs_torch():
+    rng = np.random.RandomState(15)
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    w = np.asarray([0.25, 0.1, 0.9], np.float32)
+    check_output(
+        lambda xt, wt: F.prelu(xt, wt),
+        lambda x_, w_: torch.nn.functional.prelu(_t(x_), _t(w_)).numpy(),
+        [x, w], atol=1e-5, rtol=1e-5)
+    check_grad(lambda xt, wt: F.prelu(xt, wt), [x, w])
+
+
+@pytest.mark.parametrize("name,tref", [
+    ("softshrink", lambda x: torch.nn.functional.softshrink(x, 0.5)),
+    ("hardshrink", lambda x: torch.nn.functional.hardshrink(x, 0.5)),
+])
+def test_shrink_ops_vs_torch(name, tref):
+    rng = np.random.RandomState(16)
+    x = rng.randn(4, 5).astype(np.float32)
+    x = np.where(np.abs(np.abs(x) - 0.5) < 0.05, x + 0.2, x).astype(
+        np.float32)
+    op = getattr(F, name)
+    check_output(lambda xt: op(xt, 0.5), lambda x_: tref(_t(x_)).numpy(),
+                 [x], atol=1e-5, rtol=1e-5)
+    check_grad(lambda xt: op(xt, 0.5), [x])
+
+
+# ---- grid_sample grad ----
+
+def test_grid_sample_grad_both_inputs():
+    rng = np.random.RandomState(17)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    grid = rng.uniform(-0.8, 0.8, (1, 4, 4, 2)).astype(np.float32)
+    check_grad(lambda xt, gt: F.grid_sample(xt, gt, align_corners=True),
+               [x, grid], atol=1e-2, rtol=1e-2)
+
+
+# ---- pad modes ----
+
+@pytest.mark.parametrize("mode", ["reflect", "replicate"])
+def test_pad_modes_vs_torch(mode):
+    rng = np.random.RandomState(18)
+    x = rng.randn(1, 2, 4, 5).astype(np.float32)
+    check_output(
+        lambda xt: F.pad(xt, [1, 2, 2, 1], mode=mode),
+        lambda x_: torch.nn.functional.pad(
+            _t(x_), (1, 2, 2, 1), mode=mode).numpy(),
+        [x], atol=1e-6, rtol=1e-6)
+    check_grad(lambda xt: F.pad(xt, [1, 2, 2, 1], mode=mode), [x])
+
+
+def test_interpolate_bicubic_size1_align_corners():
+    # out size 1 under align_corners maps to source index 0, not the
+    # half-pixel window center
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = F.interpolate(paddle.to_tensor(x), size=[1, 1], mode="bicubic",
+                        align_corners=True)
+    ref = torch.nn.functional.interpolate(
+        _t(x), size=(1, 1), mode="bicubic", align_corners=True).numpy()
+    np.testing.assert_allclose(np.asarray(out.data), ref, atol=1e-5)

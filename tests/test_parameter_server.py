@@ -598,3 +598,28 @@ def test_native_load_clears_post_save_rows(tmp_path):
         client.close()
         for s in servers:
             s.stop()
+
+
+def test_native_load_truncated_file_preserves_table(tmp_path):
+    """A corrupt/truncated checkpoint must fail the load AND leave the live
+    table untouched (load parses into temporaries, swaps on success)."""
+    servers, client = _native_pair(1)
+    try:
+        client.create_table("e", 4, rule="sgd", lr=0.1, init_std=0.0)
+        client.pull_sparse("e", np.array([1, 2]))
+        client.push_sparse("e", np.array([1]), np.ones((1, 4), np.float32))
+        before = client.pull_sparse("e", np.array([1, 2]))
+        client.save(str(tmp_path / "ck"))
+        path = tmp_path / "ck" / "shard0" / "e.pstab"
+        raw = path.read_bytes()
+        path.write_bytes(raw[:len(raw) - 7])  # truncate mid-row
+        import pytest as _pytest
+        with _pytest.raises(RuntimeError):
+            client.load(str(tmp_path / "ck"))
+        after = client.pull_sparse("e", np.array([1, 2]))
+        np.testing.assert_allclose(after, before, atol=1e-7)
+        assert client.table_size("e") == 2
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()

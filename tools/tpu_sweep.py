@@ -33,6 +33,22 @@ PRESET_SWEEP = [
     ("350m-bs16-remat", {"BENCH_PRESET": "gpt3-350m", "BENCH_BS": "16",
                          "BENCH_REMAT": "1"}),
     ("350m-bs4", {"BENCH_PRESET": "gpt3-350m", "BENCH_BS": "4"}),
+    # block-tuned 350m rows: the 0.40-MFU target configs (bigger model =
+    # wider matmuls; blocks are the remaining knob)
+    ("350m-b256", {"BENCH_PRESET": "gpt3-350m",
+                   "FLAGS_flash_block_q": "256",
+                   "FLAGS_flash_block_k": "256"}),
+    ("350m-b1024", {"BENCH_PRESET": "gpt3-350m",
+                    "FLAGS_flash_block_q": "1024",
+                    "FLAGS_flash_block_k": "1024"}),
+    ("350m-bs16-remat-b256", {"BENCH_PRESET": "gpt3-350m", "BENCH_BS": "16",
+                              "BENCH_REMAT": "1",
+                              "FLAGS_flash_block_q": "256",
+                              "FLAGS_flash_block_k": "256"}),
+    ("350m-bs32-remat", {"BENCH_PRESET": "gpt3-350m", "BENCH_BS": "32",
+                         "BENCH_REMAT": "1"}),
+    ("350m-bf16-moments", {"BENCH_PRESET": "gpt3-350m",
+                           "BENCH_MOMENT_DTYPE": "bfloat16"}),
     ("1.3b", {"BENCH_PRESET": "gpt3-1.3b"}),
     ("1.3b-bs2", {"BENCH_PRESET": "gpt3-1.3b", "BENCH_BS": "2"}),
     ("1.3b-bs8", {"BENCH_PRESET": "gpt3-1.3b", "BENCH_BS": "8"}),
