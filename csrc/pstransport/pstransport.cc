@@ -268,10 +268,12 @@ bool load_table(Table* t, const std::string& path) {
   std::vector<float> dense_val, dense_slot;
   if (dense) {
     uint64_t n = 0, ns = 0;
-    ok = std::fread(&n, 8, 1, f) == 1 && n <= (1ull << 34);
+    // same cap as OP_CREATE_DENSE: a corrupt count must be rejected, not
+    // allocated (bad_alloc would terminate the handler thread)
+    ok = std::fread(&n, 8, 1, f) == 1 && n <= (1ull << 27);
     if (ok) dense_val.resize(n);
     ok = ok && (n == 0 || std::fread(dense_val.data(), 4, n, f) == n);
-    ok = ok && std::fread(&ns, 8, 1, f) == 1 && ns <= (1ull << 34);
+    ok = ok && std::fread(&ns, 8, 1, f) == 1 && ns <= (1ull << 27);
     if (ok) dense_slot.resize(ns);
     ok = ok && (ns == 0 || std::fread(dense_slot.data(), 4, ns, f) == ns);
   } else {
@@ -417,7 +419,10 @@ void handle_conn(Server* srv, int fd,
         uint64_t size = rd.take<uint64_t>();
         uint8_t rule = rd.take<uint8_t>();
         float lr = rd.take<float>();
-        if (!rd.ok || size > (1ull << 34)) {  // 64 GB of floats: insane
+        // cap chosen so one whole-block push/pull frame (size * 4 bytes)
+        // always fits under kMaxFrame — a larger accepted size would later
+        // fail in read_frame with a silent connection drop
+        if (!rd.ok || size > (1ull << 27)) {  // 512 MB of floats
           reply_err(fd, "malformed create_dense");
           break;
         }

@@ -155,9 +155,12 @@ def qr(x, mode="reduced", name=None):
 
 
 def svd(x, full_matrices=False, name=None):
+    """paddle.linalg.svd convention: returns (U, S, VH) with VH the
+    transpose of V, shape [..., K, N] — so x == U @ diag(S) @ VH (the
+    reference snapshot predates linalg.svd; the 2.x public contract is
+    the anchor)."""
     def f(a):
-        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
-        return u, s, jnp.swapaxes(vh, -1, -2)
+        return jnp.linalg.svd(a, full_matrices=full_matrices)
 
     return apply(f, _t(x))
 

@@ -487,3 +487,27 @@ def array_read(array, i):
 def array_length(array):
     from .creation import to_tensor
     return to_tensor(np.asarray(len(array), np.int64))
+
+
+def index_add(x, index, axis, value, name=None):
+    """paddle.index_add (2.x tail): out = x with value's rows added at the
+    given indices along axis (duplicate indices accumulate)."""
+    def f(a, idx, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v.astype(a.dtype), axis, 0)
+        out = moved.at[idx.astype(jnp.int32)].add(vm)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply(f, _t(x), _t(index), _t(value))
+
+
+def index_fill(x, index, axis, value, name=None):
+    """paddle.index_fill: out = x with the indexed slices along axis set
+    to value."""
+    def f(a, idx):
+        moved = jnp.moveaxis(a, axis, 0)
+        out = moved.at[idx.astype(jnp.int32)].set(
+            jnp.asarray(value, a.dtype))
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply(f, _t(x), _t(index))

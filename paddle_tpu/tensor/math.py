@@ -390,3 +390,71 @@ def clip_by_norm(x, max_norm, name=None):
         return (a.astype(jnp.float32) * scale).astype(a.dtype)
 
     return apply(f, t)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    """paddle.nan_to_num (2.x tail; no fluid ancestor): replace NaN/±inf
+    with finite values (dtype max/min when posinf/neginf are None)."""
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                          neginf=neginf), _t(x))
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """paddle.logcumsumexp: running log(sum(exp)) along axis (flattened
+    when axis is None), computed stably via an associative logaddexp scan
+    — never materializes exp(x)."""
+    import jax
+
+    def f(a):
+        if dtype is not None:
+            from ..core.dtypes import to_jax_dtype
+            a = a.astype(to_jax_dtype(dtype))
+        b = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        return jax.lax.associative_scan(jnp.logaddexp, b, axis=ax)
+
+    return apply(f, _t(x))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """paddle.trapezoid: trapezoidal-rule integral along axis (numpy.trapz
+    semantics; spacing from x, dx, or 1.0)."""
+    args = [_t(y)] + ([_t(x)] if x is not None else [])
+
+    def f(yv, *maybe_x):
+        yv = yv.astype(jnp.float32)
+        n = yv.shape[axis]
+        y0 = jnp.take(yv, jnp.arange(n - 1), axis=axis)
+        y1 = jnp.take(yv, jnp.arange(1, n), axis=axis)
+        if maybe_x:
+            xv = maybe_x[0].astype(jnp.float32)
+            if xv.ndim == 1:
+                shape = [1] * yv.ndim
+                shape[axis] = n
+                xv = xv.reshape(shape)
+            d = jnp.take(xv, jnp.arange(1, n), axis=axis) - \
+                jnp.take(xv, jnp.arange(n - 1), axis=axis)
+        else:
+            d = dx if dx is not None else 1.0
+        return jnp.sum((y0 + y1) * 0.5 * d, axis=axis)
+
+    return apply(f, *args)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """paddle.renorm: every slice along `axis` whose p-norm exceeds
+    max_norm is rescaled to have p-norm exactly max_norm."""
+    def f(a):
+        af = a.astype(jnp.float32)
+        reduce_axes = tuple(i for i in range(a.ndim) if i != axis)
+        if p == float("inf"):
+            norms = jnp.max(jnp.abs(af), axis=reduce_axes, keepdims=True)
+        else:
+            norms = jnp.power(
+                jnp.sum(jnp.power(jnp.abs(af), p), axis=reduce_axes,
+                        keepdims=True), 1.0 / p)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return (af * scale).astype(a.dtype)
+
+    return apply(f, _t(x))

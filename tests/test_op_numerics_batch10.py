@@ -1,0 +1,181 @@
+"""OpTest fixture batch 10: manipulation/stat tail — gather_nd/scatter_nd,
+masked_select, quantile/kthvalue/median, cumprod/cummax/cummin, lerp,
+heaviside, and the new 2.x-tail ops (nan_to_num, logcumsumexp, trapezoid,
+renorm, index_add, index_fill). Output-vs-numpy plus finite-difference
+gradients where differentiable (unittests/op_test.py:270 protocol)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from op_test_base import check_grad, check_output
+
+
+def test_gather_nd_vs_numpy_and_grad():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4, 5).astype(np.float32)
+    idx = np.array([[0, 1], [2, 3], [1, 0]], np.int64)
+    check_output(lambda xt: paddle.gather_nd(xt, paddle.to_tensor(idx)),
+                 lambda x_: x_[idx[:, 0], idx[:, 1]], [x])
+    check_grad(lambda xt: paddle.gather_nd(xt, paddle.to_tensor(idx)), [x])
+
+
+def test_scatter_nd_add_vs_numpy():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 3).astype(np.float32)
+    idx = np.array([[1], [2], [1]], np.int64)
+    upd = rng.randn(3, 3).astype(np.float32)
+
+    def np_ref(x_, u_):
+        out = x_.copy()
+        np.add.at(out, idx[:, 0], u_)
+        return out
+
+    check_output(
+        lambda xt, ut: paddle.scatter_nd_add(xt, paddle.to_tensor(idx), ut),
+        np_ref, [x, upd])
+    check_grad(
+        lambda xt, ut: paddle.scatter_nd_add(xt, paddle.to_tensor(idx), ut),
+        [x, upd])
+
+
+def test_masked_select_vs_numpy():
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 5).astype(np.float32)
+    m = x > 0
+    out = paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(m))
+    np.testing.assert_allclose(np.asarray(out.data), x[m], rtol=1e-6)
+
+
+def test_quantile_median_kthvalue_vs_numpy():
+    rng = np.random.RandomState(3)
+    x = rng.randn(5, 8).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(paddle.quantile(paddle.to_tensor(x), 0.3, axis=1).data),
+        np.quantile(x, 0.3, axis=1), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(paddle.median(paddle.to_tensor(x), axis=0).data),
+        np.median(x, axis=0), atol=1e-5)
+    vals, inds = paddle.kthvalue(paddle.to_tensor(x), k=3, axis=1)
+    want = np.sort(x, axis=1)[:, 2]
+    np.testing.assert_allclose(np.asarray(vals.data), want, atol=1e-6)
+    assert np.all(x[np.arange(5), np.asarray(inds.data)] == want)
+
+
+def test_cumprod_cummax_cummin_vs_numpy():
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 6).astype(np.float32)
+    check_output(lambda xt: paddle.cumprod(xt, dim=1),
+                 lambda x_: np.cumprod(x_, axis=1), [x], atol=1e-5,
+                 rtol=1e-5)
+    check_grad(lambda xt: paddle.cumprod(xt, dim=1), [x], atol=1e-2,
+               rtol=1e-2)
+    v, i = paddle.cummax(paddle.to_tensor(x), axis=1)
+    np.testing.assert_allclose(np.asarray(v.data),
+                               np.maximum.accumulate(x, axis=1), rtol=1e-6)
+    v2, _ = paddle.cummin(paddle.to_tensor(x), axis=1)
+    np.testing.assert_allclose(np.asarray(v2.data),
+                               np.minimum.accumulate(x, axis=1), rtol=1e-6)
+
+
+def test_lerp_heaviside_frac_vs_numpy():
+    rng = np.random.RandomState(5)
+    a = rng.randn(4, 3).astype(np.float32)
+    b = rng.randn(4, 3).astype(np.float32)
+    check_output(lambda at, bt: paddle.lerp(at, bt, 0.3),
+                 lambda a_, b_: a_ + 0.3 * (b_ - a_), [a, b], atol=1e-6,
+                 rtol=1e-6)
+    check_grad(lambda at, bt: paddle.lerp(at, bt, 0.3), [a, b])
+    y = rng.randn(4, 3).astype(np.float32)
+    check_output(lambda at, yt: paddle.heaviside(at, yt),
+                 lambda a_, y_: np.heaviside(a_, y_), [a, y])
+    check_output(lambda at: paddle.frac(at),
+                 lambda a_: a_ - np.trunc(a_), [a], atol=1e-6, rtol=1e-6)
+
+
+# ---- new 2.x-tail ops ----
+
+def test_nan_to_num():
+    x = np.array([np.nan, np.inf, -np.inf, 1.5], np.float32)
+    out = paddle.nan_to_num(paddle.to_tensor(x), nan=0.0, posinf=9.0,
+                            neginf=-9.0)
+    np.testing.assert_allclose(np.asarray(out.data), [0.0, 9.0, -9.0, 1.5])
+
+
+def test_logcumsumexp_vs_numpy_and_grad():
+    rng = np.random.RandomState(6)
+    x = rng.randn(3, 7).astype(np.float32) * 3
+
+    def np_ref(x_):
+        return np.log(np.cumsum(np.exp(x_.astype(np.float64)),
+                                axis=1)).astype(np.float32)
+
+    check_output(lambda xt: paddle.logcumsumexp(xt, axis=1), np_ref, [x],
+                 atol=1e-4, rtol=1e-4)
+    check_grad(lambda xt: paddle.logcumsumexp(xt, axis=1), [x])
+    # flattened default + stability at large magnitudes
+    big = np.array([1000.0, 1000.5, 999.0], np.float32)
+    out = np.asarray(paddle.logcumsumexp(paddle.to_tensor(big)).data)
+    assert np.isfinite(out).all() and out[-1] > 1000.0
+
+
+def test_trapezoid_vs_numpy():
+    rng = np.random.RandomState(7)
+    y = rng.randn(4, 9).astype(np.float32)
+    xs = np.sort(rng.randn(9).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(paddle.trapezoid(paddle.to_tensor(y), dx=0.5).data),
+        np.trapz(y, dx=0.5, axis=-1), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(paddle.trapezoid(paddle.to_tensor(y),
+                                    x=paddle.to_tensor(xs)).data),
+        np.trapz(y, x=xs, axis=-1), atol=1e-5)
+    check_grad(lambda yt: paddle.trapezoid(yt, dx=0.5), [y])
+
+
+def test_renorm_caps_slice_norms():
+    rng = np.random.RandomState(8)
+    x = rng.randn(3, 4, 2).astype(np.float32) * 5
+    out = np.asarray(paddle.renorm(paddle.to_tensor(x), p=2.0, axis=1,
+                                   max_norm=1.0).data)
+    for j in range(4):
+        n_in = np.linalg.norm(x[:, j, :])
+        n_out = np.linalg.norm(out[:, j, :])
+        if n_in > 1.0:
+            np.testing.assert_allclose(n_out, 1.0, rtol=1e-4)
+        else:
+            np.testing.assert_allclose(n_out, n_in, rtol=1e-5)
+    check_grad(lambda xt: paddle.renorm(xt, p=2.0, axis=1, max_norm=1.0),
+               [x], atol=1e-2, rtol=1e-2)
+
+
+def test_index_add_and_fill():
+    rng = np.random.RandomState(9)
+    x = rng.randn(4, 3).astype(np.float32)
+    idx = np.array([1, 3, 1], np.int64)
+    v = rng.randn(3, 3).astype(np.float32)
+
+    def np_ref(x_, v_):
+        out = x_.copy()
+        np.add.at(out, idx, v_)
+        return out
+
+    check_output(
+        lambda xt, vt: paddle.index_add(xt, paddle.to_tensor(idx), 0, vt),
+        np_ref, [x, v])
+    check_grad(
+        lambda xt, vt: paddle.index_add(xt, paddle.to_tensor(idx), 0, vt),
+        [x, v])
+    out = np.asarray(paddle.index_fill(
+        paddle.to_tensor(x), paddle.to_tensor(np.array([0, 2], np.int64)),
+        0, 7.0).data)
+    want = x.copy()
+    want[[0, 2]] = 7.0
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+    # axis=1 variant
+    out1 = np.asarray(paddle.index_fill(
+        paddle.to_tensor(x), paddle.to_tensor(np.array([1], np.int64)),
+        1, -1.0).data)
+    want1 = x.copy()
+    want1[:, 1] = -1.0
+    np.testing.assert_allclose(out1, want1, rtol=1e-6)
