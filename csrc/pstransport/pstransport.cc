@@ -79,6 +79,10 @@ bool write_all(int fd, const void* buf, size_t n) {
 // corrupt/desynced stream drops the connection instead of forcing a 4 GB
 // allocation (the same no-bad_alloc guarantee as the Reader)
 constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GB
+// largest dense block a push frame can carry: frame = 13-byte op header +
+// size * 4 bytes of payload, so every creatable table stays loadable and
+// pushable
+constexpr uint64_t kMaxDenseFloats = (kMaxFrame - 64) / 4;
 
 bool read_frame(int fd, std::vector<char>* out) {
   uint32_t len;
@@ -270,10 +274,10 @@ bool load_table(Table* t, const std::string& path) {
     uint64_t n = 0, ns = 0;
     // same cap as OP_CREATE_DENSE: a corrupt count must be rejected, not
     // allocated (bad_alloc would terminate the handler thread)
-    ok = std::fread(&n, 8, 1, f) == 1 && n <= (1ull << 27);
+    ok = std::fread(&n, 8, 1, f) == 1 && n <= kMaxDenseFloats;
     if (ok) dense_val.resize(n);
     ok = ok && (n == 0 || std::fread(dense_val.data(), 4, n, f) == n);
-    ok = ok && std::fread(&ns, 8, 1, f) == 1 && ns <= (1ull << 27);
+    ok = ok && std::fread(&ns, 8, 1, f) == 1 && ns <= kMaxDenseFloats;
     if (ok) dense_slot.resize(ns);
     ok = ok && (ns == 0 || std::fread(dense_slot.data(), 4, ns, f) == ns);
   } else {
@@ -419,10 +423,10 @@ void handle_conn(Server* srv, int fd,
         uint64_t size = rd.take<uint64_t>();
         uint8_t rule = rd.take<uint8_t>();
         float lr = rd.take<float>();
-        // cap chosen so one whole-block push/pull frame (size * 4 bytes)
-        // always fits under kMaxFrame — a larger accepted size would later
+        // cap = the largest block whose push frame (header + size * 4
+        // bytes) still fits under kMaxFrame — anything larger would later
         // fail in read_frame with a silent connection drop
-        if (!rd.ok || size > (1ull << 27)) {  // 512 MB of floats
+        if (!rd.ok || size > kMaxDenseFloats) {
           reply_err(fd, "malformed create_dense");
           break;
         }

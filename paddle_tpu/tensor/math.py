@@ -407,8 +407,8 @@ def logcumsumexp(x, axis=None, dtype=None, name=None):
 
     def f(a):
         if dtype is not None:
-            from ..core.dtypes import to_jax_dtype
-            a = a.astype(to_jax_dtype(dtype))
+            from ..core.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
         b = a.reshape(-1) if axis is None else a
         ax = 0 if axis is None else axis
         return jax.lax.associative_scan(jnp.logaddexp, b, axis=ax)
@@ -419,6 +419,9 @@ def logcumsumexp(x, axis=None, dtype=None, name=None):
 def trapezoid(y, x=None, dx=None, axis=-1, name=None):
     """paddle.trapezoid: trapezoidal-rule integral along axis (numpy.trapz
     semantics; spacing from x, dx, or 1.0)."""
+    if x is not None and dx is not None:
+        raise ValueError(
+            "trapezoid accepts x or dx, not both (conflicting spacings)")
     args = [_t(y)] + ([_t(x)] if x is not None else [])
 
     def f(yv, *maybe_x):
@@ -446,7 +449,8 @@ def renorm(x, p, axis, max_norm, name=None):
     max_norm is rescaled to have p-norm exactly max_norm."""
     def f(a):
         af = a.astype(jnp.float32)
-        reduce_axes = tuple(i for i in range(a.ndim) if i != axis)
+        ax = axis % a.ndim  # negative axis must still exclude its dim
+        reduce_axes = tuple(i for i in range(a.ndim) if i != ax)
         if p == float("inf"):
             norms = jnp.max(jnp.abs(af), axis=reduce_axes, keepdims=True)
         else:

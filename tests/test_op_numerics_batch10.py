@@ -179,3 +179,24 @@ def test_index_add_and_fill():
     want1 = x.copy()
     want1[:, 1] = -1.0
     np.testing.assert_allclose(out1, want1, rtol=1e-6)
+
+
+def test_renorm_negative_axis_matches_positive():
+    rng = np.random.RandomState(10)
+    x = rng.randn(3, 4).astype(np.float32) * 5
+    neg = np.asarray(paddle.renorm(paddle.to_tensor(x), p=2.0, axis=-1,
+                                   max_norm=1.0).data)
+    pos = np.asarray(paddle.renorm(paddle.to_tensor(x), p=2.0, axis=1,
+                                   max_norm=1.0).data)
+    np.testing.assert_allclose(neg, pos, rtol=1e-6)
+    for j in range(4):
+        assert np.linalg.norm(neg[:, j]) <= 1.0 + 1e-4
+
+
+def test_logcumsumexp_dtype_and_trapezoid_conflict():
+    x = np.array([0.5, 1.0], np.float32)
+    out = paddle.logcumsumexp(paddle.to_tensor(x), axis=0, dtype="float32")
+    assert np.isfinite(np.asarray(out.data)).all()
+    with pytest.raises(ValueError):
+        paddle.trapezoid(paddle.to_tensor(x), x=paddle.to_tensor(x),
+                         dx=0.5)

@@ -1,0 +1,176 @@
+"""OpTest fixture batch 11: conv1d/conv3d (+transposes) vs torch with
+finite-difference grads, temporal_shift, npair_loss, square_error_cost,
+and the paddle.distribution family (Normal/Uniform/Categorical
+log_prob/entropy/kl closed forms) — reference anchors: conv_op.cc
+(1D/3D variants), temporal_shift_op.cc, npair_loss in fluid layers,
+python/paddle/distribution.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+from op_test_base import check_grad, check_output
+
+torch = pytest.importorskip("torch")
+
+
+def _t(x):
+    return torch.from_numpy(x)
+
+
+# ---- conv 1d / 3d ----
+
+def test_conv1d_vs_torch_and_grad():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 10).astype(np.float32)
+    w = rng.randn(4, 3, 3).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+
+    check_output(
+        lambda xt, wt, bt: F.conv1d(xt, wt, bt, stride=2, padding=1),
+        lambda x_, w_, b_: torch.nn.functional.conv1d(
+            _t(x_), _t(w_), _t(b_), stride=2, padding=1).numpy(),
+        [x, w, b], atol=1e-4, rtol=1e-4)
+    check_grad(lambda xt, wt: F.conv1d(xt, wt, stride=1, padding=1),
+               [x, w], atol=1e-2, rtol=1e-2)
+
+
+def test_conv1d_dilation_groups_vs_torch():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 4, 12).astype(np.float32)
+    w = rng.randn(4, 2, 3).astype(np.float32)  # groups=2
+    check_output(
+        lambda xt, wt: F.conv1d(xt, wt, padding=2, dilation=2, groups=2),
+        lambda x_, w_: torch.nn.functional.conv1d(
+            _t(x_), _t(w_), padding=2, dilation=2, groups=2).numpy(),
+        [x, w], atol=1e-4, rtol=1e-4)
+
+
+def test_conv3d_vs_torch_and_grad():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 2, 5, 6, 7).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3, 3).astype(np.float32)
+    check_output(
+        lambda xt, wt: F.conv3d(xt, wt, stride=1, padding=1),
+        lambda x_, w_: torch.nn.functional.conv3d(
+            _t(x_), _t(w_), padding=1).numpy(),
+        [x, w], atol=1e-4, rtol=1e-4)
+    # fp32 finite differences over a 27-tap 3D window are noisy on small
+    # gradient entries: conv-family tolerance (matches reference
+    # white_list-ed conv grad tolerances)
+    check_grad(lambda xt, wt: F.conv3d(xt, wt, padding=1), [x, w],
+               atol=5e-2, rtol=5e-2)
+
+
+def test_conv1d_transpose_vs_torch():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 4, 6).astype(np.float32)
+    w = rng.randn(4, 3, 3).astype(np.float32)
+    check_output(
+        lambda xt, wt: F.conv1d_transpose(xt, wt, stride=2, padding=1),
+        lambda x_, w_: torch.nn.functional.conv_transpose1d(
+            _t(x_), _t(w_), stride=2, padding=1).numpy(),
+        [x, w], atol=1e-4, rtol=1e-4)
+
+
+def test_conv3d_transpose_vs_torch_and_grad():
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 3, 4, 4, 4).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3, 3).astype(np.float32)
+    check_output(
+        lambda xt, wt: F.conv3d_transpose(xt, wt, stride=2),
+        lambda x_, w_: torch.nn.functional.conv_transpose3d(
+            _t(x_), _t(w_), stride=2).numpy(),
+        [x, w], atol=1e-4, rtol=1e-4)
+    check_grad(lambda xt, wt: F.conv3d_transpose(xt, wt, stride=2), [x, w],
+               atol=2e-2, rtol=2e-2)
+
+
+# ---- temporal_shift ----
+
+def test_temporal_shift_reference_semantics():
+    # temporal_shift_op.cc: [N*T, C, H, W]; first C/4 channels shift t-1,
+    # next C/4 shift t+1, rest stay (zero pad at the ends)
+    N, T, C, H, W = 2, 4, 8, 2, 2
+    rng = np.random.RandomState(5)
+    x = rng.randn(N * T, C, H, W).astype(np.float32)
+    out = np.asarray(F.temporal_shift(
+        paddle.to_tensor(x), seg_num=T, shift_ratio=0.25).data)
+    xr = x.reshape(N, T, C, H, W)
+    want = np.zeros_like(xr)
+    c1 = C // 4
+    want[:, :T - 1, :c1] = xr[:, 1:, :c1]          # shift left
+    want[:, 1:, c1:2 * c1] = xr[:, :T - 1, c1:2 * c1]  # shift right
+    want[:, :, 2 * c1:] = xr[:, :, 2 * c1:]
+    np.testing.assert_allclose(out.reshape(N, T, C, H, W), want,
+                               rtol=1e-6)
+
+
+# ---- loss stragglers ----
+
+def test_npair_loss_finite_and_grad():
+    rng = np.random.RandomState(6)
+    anchor = rng.randn(4, 8).astype(np.float32)
+    positive = rng.randn(4, 8).astype(np.float32)
+    labels = np.arange(4).astype(np.float32)
+    out = F.npair_loss(paddle.to_tensor(anchor), paddle.to_tensor(positive),
+                       paddle.to_tensor(labels))
+    assert np.isfinite(float(out.item()))
+    check_grad(
+        lambda at, pt: F.npair_loss(at, pt, paddle.to_tensor(labels)),
+        [anchor, positive], atol=2e-2, rtol=2e-2)
+
+
+def test_square_error_cost_vs_numpy():
+    rng = np.random.RandomState(7)
+    a = rng.randn(5, 3).astype(np.float32)
+    b = rng.randn(5, 3).astype(np.float32)
+    check_output(lambda at, bt: F.square_error_cost(at, bt),
+                 lambda a_, b_: (a_ - b_) ** 2, [a, b], atol=1e-6,
+                 rtol=1e-6)
+
+
+# ---- distributions ----
+
+def test_normal_log_prob_entropy_kl():
+    from paddle_tpu.distribution import Normal
+    mu, sigma = 1.5, 2.0
+    d = Normal(loc=mu, scale=sigma)
+    x = np.array([0.0, 1.5, 4.0], np.float32)
+    lp = np.asarray(d.log_prob(paddle.to_tensor(x)).data)
+    want = -0.5 * ((x - mu) / sigma) ** 2 - np.log(sigma) \
+        - 0.5 * np.log(2 * np.pi)
+    np.testing.assert_allclose(lp, want, atol=1e-5)
+    ent = float(np.asarray(d.entropy().data).reshape(-1)[0])
+    np.testing.assert_allclose(
+        ent, 0.5 * np.log(2 * np.pi * np.e * sigma ** 2), atol=1e-5)
+    d2 = Normal(loc=0.0, scale=1.0)
+    kl = float(np.asarray(d.kl_divergence(d2).data).reshape(-1)[0])
+    want_kl = np.log(1.0 / sigma) + (sigma ** 2 + mu ** 2) / 2.0 - 0.5
+    np.testing.assert_allclose(kl, want_kl, atol=1e-5)
+    s = np.asarray(d.sample([2000]).data)
+    assert abs(s.mean() - mu) < 0.2 and abs(s.std() - sigma) < 0.2
+
+
+def test_uniform_log_prob_and_sample_range():
+    from paddle_tpu.distribution import Uniform
+    d = Uniform(low=-1.0, high=3.0)
+    x = np.array([-0.5, 2.0], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(d.log_prob(paddle.to_tensor(x)).data),
+        np.full(2, -np.log(4.0)), atol=1e-5)
+    s = np.asarray(d.sample([500]).data)
+    assert s.min() >= -1.0 and s.max() < 3.0
+
+
+def test_categorical_log_prob_and_entropy():
+    from paddle_tpu.distribution import Categorical
+    logits = np.log(np.array([0.1, 0.2, 0.7], np.float32))
+    d = Categorical(paddle.to_tensor(logits))
+    p = np.array([0.1, 0.2, 0.7])
+    ent = float(np.asarray(d.entropy().data).reshape(-1)[0])
+    np.testing.assert_allclose(ent, -(p * np.log(p)).sum(), atol=1e-4)
+    probs = np.asarray(d.probs(paddle.to_tensor(
+        np.array([0, 2], np.int64))).data).reshape(-1)
+    np.testing.assert_allclose(probs, [0.1, 0.7], atol=1e-4)

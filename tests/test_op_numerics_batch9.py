@@ -62,11 +62,10 @@ def test_slogdet_and_det_grad():
     a = _spd(3, 4)
     sign, logdet = np.linalg.slogdet(a)
     out = linalg.slogdet(paddle.to_tensor(a))
-    got = np.asarray(out.data) if not isinstance(out, (tuple, list)) else \
-        np.asarray([float(out[0].item()), float(out[1].item())])
-    # accept either (sign, logabsdet) pair or stacked layout
-    flat = np.asarray(got).reshape(-1)
-    assert any(np.isclose(v, logdet, atol=1e-4) for v in flat)
+    # pin the 2.x contract: stacked [sign, logabsdet] (shape [2, ...])
+    got = np.asarray(out.data).reshape(-1)
+    np.testing.assert_allclose(got[0], sign, atol=1e-5)
+    np.testing.assert_allclose(got[1], logdet, atol=1e-4)
     check_grad(lambda t: linalg.det(t), [a], atol=1e-1, rtol=1e-1)
 
 
