@@ -511,3 +511,121 @@ def index_fill(x, index, axis, value, name=None):
         return jnp.moveaxis(out, 0, axis)
 
     return apply(f, _t(x), _t(index))
+
+
+def masked_fill(x, mask, value, name=None):
+    """paddle.masked_fill: out = x with value written where the (broadcast)
+    boolean mask is True."""
+    def f(a, m):
+        return jnp.where(m.astype(bool), jnp.asarray(value, a.dtype), a)
+
+    return apply(f, _t(x), _t(mask))
+
+
+def take(x, index, mode="raise", name=None):
+    """paddle.take: gather from the FLATTENED tensor by integer index, with
+    'raise'(clips under jit — documented paddle behavior is raise; XLA has
+    no data-dependent raise, so out-of-range behaves like 'clip'),
+    'wrap' (modulo), or 'clip' semantics. Output keeps index's shape."""
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(f"take mode must be raise/wrap/clip, got {mode!r}")
+
+    def f(a, idx):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        ii = idx.astype(jnp.int64)
+        if mode == "wrap":
+            ii = ((ii % n) + n) % n
+        elif mode == "clip":
+            # clip disables negative indexing: clamp straight to [0, n-1]
+            ii = jnp.clip(ii, 0, n - 1)
+        else:  # raise: negative indices count from the end
+            ii = jnp.clip(ii, -n, n - 1)
+            ii = jnp.where(ii < 0, ii + n, ii)
+        return jnp.take(flat, ii.astype(jnp.int32))
+
+    return apply(f, _t(x), _t(index))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    """paddle.unique_consecutive: collapse ADJACENT duplicates (host-side
+    eager — the output length is data-dependent, like unique)."""
+    from .creation import to_tensor
+    idx_dtype = dtypes.convert_dtype(dtype)
+    a = np.asarray(_t(x).data)
+    if axis is None:
+        a = a.reshape(-1)
+        n = len(a)
+        change = np.concatenate([[True], a[1:] != a[:-1]]) if n \
+            else np.zeros(0, bool)
+    else:
+        a = np.moveaxis(a, axis, 0)
+        n = a.shape[0]
+        flat = a.reshape(n, -1)
+        change = np.concatenate(
+            [[True], np.any(flat[1:] != flat[:-1], axis=1)]) if n \
+            else np.zeros(0, bool)
+    starts = np.nonzero(change)[0]
+    out = a[starts]
+    if axis is not None:
+        out = np.moveaxis(out, 0, axis)
+    res = [to_tensor(out)]
+    if return_inverse:
+        inv = np.cumsum(change) - 1
+        res.append(to_tensor(inv.astype(idx_dtype)))
+    if return_counts:
+        counts = np.diff(np.concatenate([starts, [n]]))
+        res.append(to_tensor(counts.astype(idx_dtype)))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def unflatten(x, axis, shape, name=None):
+    """paddle.unflatten: expand one axis into the given shape (one -1
+    entry is inferred)."""
+    def f(a):
+        ax = axis % a.ndim
+        shp = list(_static_shape(shape))
+        if shp.count(-1) > 1:
+            raise ValueError(
+                f"unflatten shape can infer at most one -1 entry, got {shp}")
+        if -1 in shp:
+            known = 1
+            for s in shp:
+                if s != -1:
+                    known *= s
+            if known == 0 or a.shape[ax] % known:
+                raise ValueError(
+                    f"unflatten cannot infer -1: axis size {a.shape[ax]} "
+                    f"is not divisible by {known}")
+            shp[shp.index(-1)] = a.shape[ax] // known
+        return a.reshape(a.shape[:ax] + tuple(shp) + a.shape[ax + 1:])
+
+    return apply(f, _t(x))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """paddle.as_strided: strided view of the underlying buffer. XLA arrays
+    are immutable/functional, so this returns a strided GATHER (same
+    values; writes through the result do not alias x — in-place aliasing
+    is a torch/paddle storage concept with no XLA equivalent)."""
+    if len(shape) != len(stride):
+        raise ValueError(
+            f"as_strided shape ({len(shape)} dims) and stride "
+            f"({len(stride)} dims) must have the same length")
+
+    def f(a):
+        flat = a.reshape(-1)
+        idx = np.full(tuple(shape), int(offset), np.int64)
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            ar = np.arange(s, dtype=np.int64) * int(st)
+            idx = idx + ar.reshape([-1 if i == d else 1
+                                    for i in range(len(shape))])
+        if idx.size and (idx.min() < 0 or idx.max() >= flat.shape[0]):
+            raise ValueError(
+                f"as_strided indices span [{idx.min()}, {idx.max()}] "
+                f"outside the {flat.shape[0]}-element buffer")
+        return jnp.take(flat, jnp.asarray(idx.reshape(-1)),
+                        axis=0).reshape(tuple(shape))
+
+    return apply(f, _t(x))

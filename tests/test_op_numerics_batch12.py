@@ -1,0 +1,155 @@
+"""OpTest fixture batch 12: search/manipulation tail — searchsorted,
+bucketize, index_sample, repeat_interleave, moveaxis, broadcast_to, and
+the new masked_fill/take/unique_consecutive/unflatten/as_strided
+(reference protocol: unittests/op_test.py:270)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from op_test_base import check_grad, check_output
+
+
+def test_searchsorted_and_bucketize_vs_numpy():
+    edges = np.array([1.0, 3.0, 5.0, 7.0], np.float32)
+    vals = np.array([[0.5, 3.0, 6.2], [7.5, 1.0, 4.9]], np.float32)
+    out = paddle.searchsorted(paddle.to_tensor(edges),
+                              paddle.to_tensor(vals))
+    np.testing.assert_array_equal(np.asarray(out.data),
+                                  np.searchsorted(edges, vals, side="left"))
+    out_r = paddle.searchsorted(paddle.to_tensor(edges),
+                                paddle.to_tensor(vals), right=True)
+    np.testing.assert_array_equal(
+        np.asarray(out_r.data), np.searchsorted(edges, vals, side="right"))
+    b = paddle.bucketize(paddle.to_tensor(vals), paddle.to_tensor(edges))
+    np.testing.assert_array_equal(np.asarray(b.data),
+                                  np.searchsorted(edges, vals, side="left"))
+
+
+def test_index_sample_vs_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 6).astype(np.float32)
+    idx = rng.randint(0, 6, (3, 4)).astype(np.int64)
+    out = paddle.index_sample(paddle.to_tensor(x), paddle.to_tensor(idx))
+    want = np.take_along_axis(x, idx, axis=1)
+    np.testing.assert_allclose(np.asarray(out.data), want, rtol=1e-6)
+
+
+def test_repeat_interleave_and_moveaxis():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3).astype(np.float32)
+    out = paddle.repeat_interleave(paddle.to_tensor(x), 2, axis=1)
+    np.testing.assert_allclose(np.asarray(out.data),
+                               np.repeat(x, 2, axis=1), rtol=1e-6)
+    y = rng.randn(2, 3, 4).astype(np.float32)
+    out2 = paddle.moveaxis(paddle.to_tensor(y), [0, 2], [2, 0])
+    np.testing.assert_allclose(np.asarray(out2.data),
+                               np.moveaxis(y, [0, 2], [2, 0]), rtol=1e-6)
+
+
+def test_broadcast_to_and_grad():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 3).astype(np.float32)
+    check_output(lambda t: paddle.broadcast_to(t, [4, 3]),
+                 lambda a: np.broadcast_to(a, (4, 3)).copy(), [x])
+    check_grad(lambda t: paddle.broadcast_to(t, [4, 3]), [x])
+
+
+# ---- new ops ----
+
+def test_masked_fill():
+    rng = np.random.RandomState(3)
+    x = rng.randn(3, 4).astype(np.float32)
+    m = x > 0.5
+    out = paddle.masked_fill(paddle.to_tensor(x), paddle.to_tensor(m), -9.0)
+    want = np.where(m, -9.0, x)
+    np.testing.assert_allclose(np.asarray(out.data), want, rtol=1e-6)
+    # broadcast mask over rows
+    m1 = np.array([True, False, True, False])
+    out1 = paddle.masked_fill(paddle.to_tensor(x), paddle.to_tensor(m1), 0.0)
+    np.testing.assert_allclose(np.asarray(out1.data),
+                               np.where(m1[None, :], 0.0, x), rtol=1e-6)
+
+
+@pytest.mark.parametrize("mode,np_mode", [("wrap", "wrap"),
+                                          ("clip", "clip")])
+def test_take_modes_vs_numpy(mode, np_mode):
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 4).astype(np.float32)
+    idx = np.array([[0, 13, -1], [25, -30, 5]], np.int64)
+    out = paddle.take(paddle.to_tensor(x), paddle.to_tensor(idx), mode=mode)
+    want = np.take(x.reshape(-1), idx, mode=np_mode)
+    np.testing.assert_allclose(np.asarray(out.data), want, rtol=1e-6)
+
+
+def test_take_in_range_and_bad_mode():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    idx = np.array([0, 5, 2], np.int64)
+    out = paddle.take(paddle.to_tensor(x), paddle.to_tensor(idx))
+    np.testing.assert_allclose(np.asarray(out.data), [0.0, 5.0, 2.0])
+    with pytest.raises(ValueError):
+        paddle.take(paddle.to_tensor(x), paddle.to_tensor(idx), mode="nope")
+
+
+def test_unique_consecutive_flat_and_axis():
+    x = np.array([1, 1, 2, 2, 2, 3, 1, 1], np.int64)
+    out, inv, cnt = paddle.unique_consecutive(
+        paddle.to_tensor(x), return_inverse=True, return_counts=True)
+    np.testing.assert_array_equal(np.asarray(out.data), [1, 2, 3, 1])
+    np.testing.assert_array_equal(np.asarray(inv.data),
+                                  [0, 0, 1, 1, 1, 2, 3, 3])
+    np.testing.assert_array_equal(np.asarray(cnt.data), [2, 3, 1, 2])
+    m = np.array([[1, 2], [1, 2], [3, 4]], np.float32)
+    out2 = paddle.unique_consecutive(paddle.to_tensor(m), axis=0)
+    np.testing.assert_allclose(np.asarray(out2.data),
+                               [[1, 2], [3, 4]], rtol=1e-6)
+
+
+def test_unflatten_infer_and_roundtrip():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 12, 3).astype(np.float32)
+    out = paddle.unflatten(paddle.to_tensor(x), 1, [3, -1])
+    assert np.asarray(out.data).shape == (2, 3, 4, 3)
+    np.testing.assert_allclose(np.asarray(out.data),
+                               x.reshape(2, 3, 4, 3), rtol=1e-6)
+    out2 = paddle.unflatten(paddle.to_tensor(x), -1, [3, 1])
+    assert np.asarray(out2.data).shape == (2, 12, 3, 1)
+
+
+def test_as_strided_matches_numpy_view():
+    x = np.arange(12, dtype=np.float32)
+    out = paddle.as_strided(paddle.to_tensor(x), [3, 2], [4, 1], offset=1)
+    want = np.lib.stride_tricks.as_strided(
+        x[1:], shape=(3, 2), strides=(16, 4)).copy()
+    np.testing.assert_allclose(np.asarray(out.data), want, rtol=1e-6)
+    # overlapping-window trick: sliding windows of size 3
+    win = paddle.as_strided(paddle.to_tensor(x), [10, 3], [1, 1])
+    np.testing.assert_allclose(
+        np.asarray(win.data),
+        np.lib.stride_tricks.sliding_window_view(x, 3)[:10], rtol=1e-6)
+
+
+def test_unique_consecutive_empty_and_dtype():
+    out = paddle.unique_consecutive(
+        paddle.to_tensor(np.array([], np.float32)))
+    assert np.asarray(out.data).shape == (0,)
+    _, inv = paddle.unique_consecutive(
+        paddle.to_tensor(np.array([1, 1, 2], np.int64)),
+        return_inverse=True, dtype="int32")
+    assert np.asarray(inv.data).dtype == np.int32
+
+
+def test_as_strided_rejects_bad_args():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32))
+    with pytest.raises(ValueError):
+        paddle.as_strided(x, [2, 3], [4])  # length mismatch
+    with pytest.raises(ValueError):
+        paddle.as_strided(x, [5], [3])  # index 12 overruns the buffer
+
+
+def test_unflatten_rejects_bad_shape():
+    x = paddle.to_tensor(np.zeros((2, 12), np.float32))
+    with pytest.raises(ValueError):
+        paddle.unflatten(x, 1, [-1, -1])
+    with pytest.raises(ValueError):
+        paddle.unflatten(x, 1, [5, -1])
