@@ -17,14 +17,22 @@ import jax as _jax
 _jax.config.update("jax_default_matmul_precision", "highest")
 
 from .core import dtypes  # noqa: F401
-from .core.device import (CPUPlace, CUDAPlace, Place, TPUPlace,  # noqa: F401
+from .core.device import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa: F401
+    NPUPlace, Place, TPUPlace,
                           device_count, get_device, is_compiled_with_cuda,
                           is_compiled_with_tpu, set_device)
 from .core.dtype import (bfloat16, bool_, complex64, complex128,  # noqa: F401
                          float16, float32, float64, get_default_dtype, int8,
                          int16, int32, int64, set_default_dtype, uint8)
 from .core.random import get_rng_state, seed, set_rng_state  # noqa: F401
+
+# CUDA-rng compat (framework.py get/set_cuda_rng_state): on TPU there is
+# one program-level PRNG state; the cuda-named accessors alias it so
+# checkpoint/restore code written against the reference keeps working
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
 from .core.tensor import (Parameter, Tensor, enable_grad, grad,  # noqa: F401
+    set_grad_enabled,
                           is_grad_enabled, no_grad)
 from .framework_io import load, save  # noqa: F401
 from .tensor import *  # noqa: F401,F403
@@ -36,6 +44,40 @@ from .tensor.manipulation import (array_length, array_read,  # noqa: F401,E501
 from .tensor.math import add_n, tanh_  # noqa: F401
 from .tensor.linalg import inverse, mv  # noqa: F401
 from .utils import set_printoptions  # noqa: F401
+
+# root-namespace parity tail (reference python/paddle/__init__.py):
+# `bool`/`dtype` are the dtype-object aliases the reference exports at the
+# root; create_parameter mirrors the static helper at the root the way
+# fluid re-exported it; check_shape is the static-graph shape validator
+from .core.dtype import bool_ as bool  # noqa: F401,A001
+# paddle.dtype parity: Tensor.dtype returns numpy dtype objects in this
+# build, so the dtype TYPE is numpy's — isinstance(t.dtype, paddle.dtype)
+# holds, and calling it (paddle.dtype("float32")) normalizes a spec
+import numpy as _np  # noqa: E402
+
+dtype = _np.dtype
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from .static import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def check_shape(shape):
+    """framework.py check_shape: validate a shape spec (ints, with at most
+    unknown -1 entries) before building a variable."""
+    from .core.tensor import Tensor as _T
+    if isinstance(shape, _T):
+        return
+    for s in shape:
+        if isinstance(s, (list, tuple)):
+            check_shape(s)
+        elif not isinstance(s, int) or s < -1 or s == 0:
+            raise ValueError(
+                f"shape entries must be positive ints or -1, got {s!r}")
+
 
 from . import amp  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402

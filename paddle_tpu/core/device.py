@@ -56,6 +56,25 @@ class CUDAPlace(Place):
         super().__init__("gpu", index)
 
 
+class CUDAPinnedPlace(Place):
+    """Compat alias (place.h CUDAPinnedPlace): pinned host staging is a
+    CUDA-era concept; on TPU the host side is just CPU memory — so this
+    place IS the cpu kind (a batch staged here must not land on the
+    accelerator)."""
+
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class NPUPlace(Place):
+    """Compat alias (place.h NPUPlace): accepted for API parity; Ascend is
+    a non-goal backend (SURVEY), so it resolves to host CPU rather than
+    silently claiming the TPU."""
+
+    def __init__(self, index: int = 0):
+        super().__init__("cpu", index)
+
+
 @functools.lru_cache(maxsize=None)
 def _devices_of_kind(kind: str):
     all_devices = jax.devices()

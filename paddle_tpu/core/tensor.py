@@ -83,6 +83,30 @@ def is_grad_enabled() -> bool:
     return _STATE.grad_enabled
 
 
+def set_grad_enabled(mode: bool):
+    """paddle.set_grad_enabled parity: context manager (and direct call)
+    flipping tape recording on/off."""
+
+    class _Ctx:
+        def __init__(self, m, prev):
+            self._m = bool(m)
+            self._prev = prev  # captured BEFORE the mode was applied
+
+        def __enter__(self):
+            _STATE.grad_enabled = self._m
+            return self
+
+        def __exit__(self, *exc):
+            _STATE.grad_enabled = self._prev
+            return False
+
+    prev = _STATE.grad_enabled
+    # takes effect immediately when used as a plain call; as a context
+    # manager, exit restores the state from before this call
+    _STATE.grad_enabled = bool(mode)
+    return _Ctx(mode, prev)
+
+
 class no_grad:
     """Context manager + decorator disabling tape recording (paddle.no_grad parity)."""
 

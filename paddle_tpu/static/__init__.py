@@ -481,3 +481,175 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     return list(_grad(list(outs), list(ins),
                       grad_outputs=target_gradients))
+
+
+# ---- static API tail (reference python/paddle/static/__init__.py) ----
+
+def cpu_places(device_count=None):
+    """static.cpu_places: list of CPUPlace (framework.py cpu_places —
+    count from env/cores in the reference; here the jax cpu devices)."""
+    import jax
+    from ..core.device import CPUPlace
+    n = device_count or max(
+        len([d for d in jax.devices() if d.platform == "cpu"]), 1)
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """static.cuda_places parity: on this build the accelerator is TPU —
+    returns one TPUPlace per visible accelerator (the reference returns
+    CUDAPlaces for FLAGS_selected_gpus)."""
+    import jax
+    from ..core.device import TPUPlace
+    ids = device_ids if device_ids is not None else range(
+        max(len([d for d in jax.devices() if d.platform != "cpu"]), 1))
+    return [TPUPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    """Non-goal backend (SURVEY): accepted for parity, resolves to the
+    accelerator list like cuda_places."""
+    return cuda_places(device_ids)
+
+
+class _DeviceGuardCtx:
+    def __init__(self, device):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def device_guard(device=None):
+    """static.device_guard: in the reference this pins ops to a device
+    inside a program (the pipeline split reads it). Under jit the
+    partitioner owns placement, so the guard is accepted and recorded as
+    a no-op context (pipeline stage assignment uses the explicit
+    LayerDesc/segmentation protocol instead — parallel/pipeline.py)."""
+    return _DeviceGuardCtx(device)
+
+
+from ..nn.layer.layers import ParamAttr as _ParamAttr
+
+
+class WeightNormParamAttr(_ParamAttr):
+    """ParamAttr SUBCLASS requesting weight normalization
+    (fluid/param_attr.py WeightNormParamAttr — also a ParamAttr there, so
+    every attr-consuming path accepts it): carries dim; the nn.utils
+    weight_norm hook applies the reparameterization."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable)
+        self.dim = dim
+
+
+# program/persistables (de)serialization: the jit path owns the real graph,
+# so the serialized "program" is the exported inference artifact and the
+# persistables are the state_dict bytes (framework_io format)
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    """Returns bytes describing the traced program (StableHLO text when a
+    traced callable is attached via kwargs['program'], else a
+    placeholder descriptor)."""
+    import json
+    prog = kwargs.get("program")
+    if prog is not None and hasattr(prog, "hlo_text"):
+        return prog.hlo_text().encode()
+    return json.dumps({"format": "paddle_tpu.placeholder_program"}).encode()
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    """Returns the state_dict of the attached layer as bytes."""
+    import io as _io
+    import pickle
+    layer = kwargs.get("layer") or _program_layer(kwargs.get("program"))
+    state = {} if layer is None else {
+        k: __import__("numpy").asarray(v.data)
+        for k, v in layer.state_dict().items()}
+    buf = _io.BytesIO()
+    pickle.dump(state, buf, protocol=4)
+    return buf.getvalue()
+
+
+def deserialize_program(data):
+    """Inverse of serialize_program: returns a Program placeholder carrying
+    the serialized text (introspection-only, like the reference's
+    ProgramDesc parse)."""
+    prog = Program()
+    prog._serialized = data.decode() if isinstance(data, bytes) else data
+    return prog
+
+
+def deserialize_persistables(program, data, executor=None):
+    import io as _io
+    import pickle
+    return pickle.load(_io.BytesIO(data))
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content if isinstance(content, bytes) else content.encode())
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def load_program_state(model_path, var_list=None):
+    """static.load_program_state: read a saved state into a name->ndarray
+    dict (io.py load_program_state parity over the framework_io format)."""
+    import numpy as np
+    from ..framework_io import load as _load
+    path = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    state = _load(path)
+    return {k: np.asarray(v.data if hasattr(v, "data") else v)
+            for k, v in state.items()}
+
+
+def set_program_state(program, state_dict):
+    """static.set_program_state: push a name->array dict into the layer
+    behind a to_static program."""
+    layer = _program_layer(program)
+    if layer is None:
+        raise TypeError(
+            "set_program_state: expected a to_static-wrapped Layer "
+            "(placeholder Programs own no state)")
+    layer.set_state_dict(state_dict)
+    return program
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """static.normalize_program: the reference prunes the program to the
+    feed/fetch interface. Traced callables are already pruned by jit
+    (dead code never enters the jaxpr), so this returns the program."""
+    return program
+
+
+class ParallelExecutor:
+    """Compat facade (parallel_executor.cc): multi-device execution is
+    XLA SPMD under jit in this build — the facade validates construction
+    and delegates run() to the Executor path so legacy call sites keep
+    working."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        self._exe = Executor()
+        self._program = main_program
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        return self._exe.run(program or self._program, feed=feed,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)

@@ -66,7 +66,10 @@ _METHODS = dict(
     lgamma=math.lgamma, multiplex=math.multiplex, rad2deg=math.rad2deg,
     deg2rad=math.deg2rad, heaviside=math.heaviside, add_=math.add_,
     subtract_=math.subtract_, clip_=math.clip_, fill_=math.fill_,
-    zero_=math.zero_,
+    zero_=math.zero_, exp_=math.exp_, sqrt_=math.sqrt_, rsqrt_=math.rsqrt_,
+    ceil_=math.ceil_, floor_=math.floor_, round_=math.round_,
+    reciprocal_=math.reciprocal_, scale_=math.scale_,
+    flatten_=manipulation.flatten_,
     # stat
     var=stat.var, std=stat.std, median=stat.median, quantile=stat.quantile,
     # linalg

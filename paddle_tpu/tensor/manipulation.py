@@ -396,10 +396,10 @@ def shape(input, name=None):
     return to_tensor(_np.asarray(_t(input).data.shape, _np.int32))
 
 
-def _inplace_via_tape(t, out):
+def _inplace_via_tape(t, out, opname=None):
     """Apply a traced result as an in-place update on `t`."""
     from ..core.tensor import _rebind_inplace, inplace_guard
-    inplace_guard(t)
+    inplace_guard(t, opname) if opname else inplace_guard(t)
     _rebind_inplace(t, out)
     return t
 
@@ -629,3 +629,10 @@ def as_strided(x, shape, stride, offset=0, name=None):
                         axis=0).reshape(tuple(shape))
 
     return apply(f, _t(x))
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    """In-place flatten (2.x flatten_ variant): rebinds through the tape."""
+    t = _t(x)
+    return _inplace_via_tape(t, flatten(t, start_axis, stop_axis),
+                             "flatten_")

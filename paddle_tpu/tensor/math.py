@@ -462,3 +462,48 @@ def renorm(x, p, axis, max_norm, name=None):
         return (af * scale).astype(a.dtype)
 
     return apply(f, _t(x))
+
+
+def _inplace_unary(x, fn, opname):
+    """Shared body of the 2.x in-place unary variants (exp_/sqrt_/...):
+    one tape-rebind protocol (manipulation._inplace_via_tape) for all
+    in-place ops, so the semantics live in one place."""
+    from .manipulation import _inplace_via_tape
+    t = _t(x)
+    return _inplace_via_tape(t, fn(t), opname)
+
+
+def exp_(x, name=None):
+    return _inplace_unary(x, exp, "exp_")
+
+
+def sqrt_(x, name=None):
+    return _inplace_unary(x, sqrt, "sqrt_")
+
+
+def rsqrt_(x, name=None):
+    return _inplace_unary(x, rsqrt, "rsqrt_")
+
+
+def ceil_(x, name=None):
+    return _inplace_unary(x, ceil, "ceil_")
+
+
+def floor_(x, name=None):
+    return _inplace_unary(x, floor, "floor_")
+
+
+def round_(x, name=None):
+    return _inplace_unary(x, round, "round_")
+
+
+def reciprocal_(x, name=None):
+    return _inplace_unary(x, reciprocal, "reciprocal_")
+
+
+_scale_fn = scale
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, name=None):
+    return _inplace_unary(
+        x, lambda t: _scale_fn(t, scale, bias, bias_after_scale), "scale_")

@@ -3,3 +3,21 @@ from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
+
+
+_IMAGE_BACKEND = "pil"
+
+
+def set_image_backend(backend):
+    """paddle.vision.set_image_backend parity: 'pil' | 'cv2' | 'tensor'
+    accepted; the datasets in this build produce uint8 CHW arrays
+    directly, so the knob is recorded for get_image_backend symmetry."""
+    global _IMAGE_BACKEND
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"image backend must be pil/cv2/tensor, got {backend!r}")
+    _IMAGE_BACKEND = backend
+
+
+def get_image_backend():
+    return _IMAGE_BACKEND
