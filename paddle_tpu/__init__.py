@@ -66,17 +66,22 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
 
 
 def check_shape(shape):
-    """framework.py check_shape: validate a shape spec (ints, with at most
-    unknown -1 entries) before building a variable."""
+    """framework.py check_shape: validate a shape spec before building a
+    variable — entries may be ints (incl. numpy ints), -1 for unknown
+    dims, or Tensors (the reference accepts Variable dims)."""
+    import numbers
     from .core.tensor import Tensor as _T
     if isinstance(shape, _T):
         return
     for s in shape:
         if isinstance(s, (list, tuple)):
             check_shape(s)
-        elif not isinstance(s, int) or s < -1 or s == 0:
+        elif isinstance(s, _T):
+            continue
+        elif not isinstance(s, numbers.Integral) or s < -1 or s == 0:
             raise ValueError(
-                f"shape entries must be positive ints or -1, got {s!r}")
+                f"shape entries must be positive ints, -1, or Tensors, "
+                f"got {s!r}")
 
 
 from . import amp  # noqa: F401,E402

@@ -43,3 +43,6 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
         return mp.VocabParallelEmbedding(size[0], size[1],
                                          weight_attr=weight_attr)
     raise ValueError(f"unsupported split operation {operation}")
+
+from .fleet.runtime.the_one_ps import (  # noqa: F401,E402
+    CountFilterEntry, ProbabilityEntry)

@@ -437,3 +437,61 @@ get_hybrid_communicate_group = _fleet_singleton.get_hybrid_communicate_group
 
 def fleet():
     return _fleet_singleton
+
+
+# public export: fleet.UtilBase is the class behind fleet.util
+UtilBase = _UtilBase
+
+
+class Role:
+    """fleet.Role enum (role_maker.py Role): WORKER/SERVER/HETER_WORKER."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+
+
+class MultiSlotDataGenerator:
+    """fleet.MultiSlotDataGenerator (incubate data_generator): users
+    subclass and implement generate_sample(line) yielding
+    (slot_name, [ints/floats]) pairs; run_from_stdin/_generate format them
+    into the MultiSlot text protocol the PS datasets consume:
+    `slot:<n> v1 .. vn` fields joined per sample."""
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclass MultiSlotDataGenerator and implement generate_sample")
+
+    def _format(self, sample):
+        parts = []
+        for name, values in sample:
+            vals = list(values)
+            parts.append(f"{name}:{len(vals)} "
+                         + " ".join(str(v) for v in vals))
+        return " ".join(parts)
+
+    def run_from_memory(self, lines):
+        out = []
+        for line in lines:
+            gen = self.generate_sample(line)
+            for sample in (gen() if callable(gen) else gen):
+                out.append(self._format(sample))
+        return out
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            gen = self.generate_sample(line)
+            for sample in (gen() if callable(gen) else gen):
+                sys.stdout.write(self._format(sample) + "\n")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-slot variant: values pass through as strings (no numeric
+    parse), matching the reference's string protocol."""
+
+    def _format(self, sample):
+        parts = []
+        for name, values in sample:
+            vals = [str(v) for v in values]
+            parts.append(f"{name}:{len(vals)} " + " ".join(vals))
+        return " ".join(parts)

@@ -53,20 +53,65 @@ class SparseAccessor:
         return row - self.lr * grad / (np.sqrt(slot) + self.epsilon), slot
 
 
+class CountFilterEntry:
+    """Sparse-table admission policy (table/common_sparse_table.cc entry
+    configs; 2.x surface paddle.distributed.CountFilterEntry): a row only
+    PERSISTS after its id has been seen `count` times — colder ids are
+    served the initializer without being stored, bounding table growth on
+    long-tail id streams."""
+
+    def __init__(self, count: int):
+        if count < 1:
+            raise ValueError("CountFilterEntry count must be >= 1")
+        self.count = int(count)
+
+
+class ProbabilityEntry:
+    """Admission policy: a new id persists with the given probability
+    (table entry config analog)."""
+
+    def __init__(self, probability: float):
+        if not 0.0 < probability <= 1.0:
+            raise ValueError("ProbabilityEntry probability must be in "
+                             "(0, 1]")
+        self.probability = float(probability)
+
+
 class SparseTable:
     """Demand-created sparse embedding rows (common_sparse_table.cc): a row
-    materializes (from the initializer) the first time its id is pulled."""
+    materializes (from the initializer) the first time its id is pulled —
+    gated by an optional admission `entry` policy (CountFilterEntry /
+    ProbabilityEntry)."""
 
     def __init__(self, dim: int, accessor: SparseAccessor = None,
-                 init_std: float = 0.01, seed: int = 0):
+                 init_std: float = 0.01, seed: int = 0, entry=None):
         self.dim = dim
         self.accessor = accessor or SparseAccessor()
         self.init_std = init_std
         self.seed = seed
+        self.entry = entry
+        self._seen: Dict[int, int] = {}
         self._rng = np.random.RandomState(seed)
         self._rows: Dict[int, np.ndarray] = {}
         self._slots: Dict[int, np.ndarray] = {}
         self._lock = threading.Lock()
+
+    def _admit(self, k: int) -> bool:
+        """Entry-policy gate for persisting a NEW row."""
+        if self.entry is None:
+            return True
+        if isinstance(self.entry, CountFilterEntry):
+            n = self._seen.get(k, 0) + 1
+            self._seen[k] = n
+            return n >= self.entry.count
+        if isinstance(self.entry, ProbabilityEntry):
+            if k in self._seen:  # already admitted earlier
+                return True
+            if self._rng.rand() < self.entry.probability:
+                self._seen[k] = 1
+                return True
+            return False
+        return True
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
         out = np.empty((len(ids), self.dim), np.float32)
@@ -77,7 +122,8 @@ class SparseTable:
                 if row is None:
                     row = (self._rng.randn(self.dim) *
                            self.init_std).astype(np.float32)
-                    self._rows[k] = row
+                    if self._admit(k):
+                        self._rows[k] = row
                 out[i] = row
         return out
 
@@ -112,6 +158,19 @@ class SparseTable:
                 [self._slots[int(i)] for i in slot_ids]) if len(slot_ids) \
                 else np.zeros((0, self.dim), np.float32)
         return ids, vals, slot_ids, slot_vals
+
+    def seen_state(self):
+        """Admission-counter state (CountFilterEntry progress must survive
+        a checkpoint, like the optimizer slots do)."""
+        with self._lock:
+            sids = np.asarray(sorted(self._seen), np.int64)
+            scnt = np.asarray([self._seen[int(i)] for i in sids], np.int64)
+        return sids, scnt
+
+    def load_seen_state(self, seen_ids, seen_counts):
+        with self._lock:
+            for i, key in enumerate(np.asarray(seen_ids, np.int64)):
+                self._seen[int(key)] = int(seen_counts[i])
 
     def load_state(self, ids, vals, slot_ids=None, slot_vals=None):
         with self._lock:
@@ -207,10 +266,10 @@ class PSCore:
         return self.barrier_tables[name]
 
     def create_table(self, name: str, dim: int, rule="sgd", lr=0.01,
-                     init_std=0.01, seed=0):
+                     init_std=0.01, seed=0, entry=None):
         if name not in self.tables:
             self.tables[name] = SparseTable(
-                dim, SparseAccessor(rule, lr), init_std, seed)
+                dim, SparseAccessor(rule, lr), init_std, seed, entry=entry)
         return self.tables[name]
 
     def create_dense_table(self, name: str, shape, rule="sgd", lr=0.01,
@@ -225,9 +284,11 @@ class PSCore:
         os.makedirs(dirname, exist_ok=True)
         for name, t in self.tables.items():
             ids, vals, slot_ids, slot_vals = t.state()
+            seen_ids, seen_counts = t.seen_state()
             acc = t.accessor
             np.savez(os.path.join(dirname, f"{name}.npz"), ids=ids,
                      vals=vals, slot_ids=slot_ids, slot_vals=slot_vals,
+                     seen_ids=seen_ids, seen_counts=seen_counts,
                      dim=t.dim, rule=acc.rule, lr=acc.lr,
                      epsilon=acc.epsilon, init_std=t.init_std, seed=t.seed)
         for name, t in self.dense_tables.items():
@@ -872,6 +933,10 @@ class TheOnePSRuntime:
                 init_std = float(data["init_std"]) \
                     if "init_std" in data else 0.01
                 seed0 = int(data["seed"]) if "seed" in data else 0
+                seen_ids = np.asarray(data["seen_ids"], np.int64) \
+                    if "seen_ids" in data else np.zeros((0,), np.int64)
+                seen_counts = np.asarray(data["seen_counts"], np.int64) \
+                    if "seen_counts" in data else np.zeros((0,), np.int64)
                 for core_idx in range(n):
                     table = self.cores[core_idx].create_table(
                         name, int(data["dim"]), acc.rule, acc.lr,
@@ -882,6 +947,10 @@ class TheOnePSRuntime:
                     if sel.any() or ssel.any():
                         table.load_state(ids[sel], vals[sel],
                                          slot_ids[ssel], slot_vals[ssel])
+                    csel = seen_ids % n == core_idx
+                    if csel.any():
+                        table.load_seen_state(seen_ids[csel],
+                                              seen_counts[csel])
 
     def stop(self):
         for s in self.servers:

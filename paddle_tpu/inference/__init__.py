@@ -350,3 +350,61 @@ def create_predictor(config: Config) -> Predictor:
 # eager convenience mirroring paddle.inference usage with jit.save artifacts
 def load_predictor(path: str) -> Predictor:
     return Predictor(Config(path))
+
+
+# ---- inference API tail (paddle/inference/__init__.py: enums + pool) ----
+
+class DataType:
+    """paddle_infer.DataType enum parity (inference/api/paddle_api.h)."""
+    FLOAT32 = "float32"
+    INT64 = "int64"
+    INT32 = "int32"
+    UINT8 = "uint8"
+    INT8 = "int8"
+    FLOAT16 = "float16"
+
+
+class PlaceType:
+    """paddle_infer.PlaceType: kCPU/kGPU/kXPU — the accelerator here is
+    the TPU (kGPU maps to it for ported configs)."""
+    CPU = "cpu"
+    GPU = "tpu"
+    XPU = "tpu"
+    UNK = "unk"
+
+
+class PrecisionType:
+    """paddle_infer.PrecisionType (used by the TRT-era configs): on TPU
+    'Half' means bf16 — the chip's native mixed-precision format."""
+    Float32 = "float32"
+    Half = "bfloat16"
+    Int8 = "int8"
+
+
+def get_version():
+    import paddle_tpu
+    return f"paddle_tpu {paddle_tpu.__version__} (inference)"
+
+
+def get_num_bytes_of_data_type(dtype):
+    import numpy as np
+    return np.dtype({"float16": "float16", "bfloat16": "uint16"}.get(
+        str(dtype), str(dtype))).itemsize
+
+
+class PredictorPool:
+    """paddle_infer.PredictorPool: N predictor handles over ONE exported
+    model. The reference clones an AnalysisPredictor per thread because
+    its execution state is mutable; XLA executables are thread-safe, so
+    the pool loads and compiles once and every slot shares that
+    predictor (N-fold less startup latency and executable memory)."""
+
+    def __init__(self, config, size=1):
+        self._shared = create_predictor(config)
+        self._size = int(size)
+
+    def retrieve(self, idx):
+        if not 0 <= idx < self._size:
+            raise IndexError(
+                f"PredictorPool index {idx} out of range [0, {self._size})")
+        return self._shared

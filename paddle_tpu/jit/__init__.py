@@ -195,3 +195,78 @@ class ProgramTranslator:
 
 def enable_to_static(flag=True):
     pass
+
+
+# ---- jit API tail (reference python/paddle/jit/__init__.py) ----
+
+_JIT_VERBOSITY = 0
+_JIT_CODE_LEVEL = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """dy2static logging verbosity knob (jit/dy2static logging_utils):
+    recorded and honored by to_static tracing diagnostics."""
+    global _JIT_VERBOSITY
+    _JIT_VERBOSITY = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """dy2static transformed-code dump level: under jax tracing there is
+    no AST rewrite to print; the traced jaxpr is the analog
+    (static.TracedProgram gives op-level introspection)."""
+    global _JIT_CODE_LEVEL
+    _JIT_CODE_LEVEL = int(level)
+
+
+class TracedLayer:
+    """jit.TracedLayer (fluid/dygraph/jit.py TracedLayer): wraps a traced
+    static function over a Layer. trace() returns (eager_out, traced);
+    the traced object is callable (jit-compiled) and saves an inference
+    artifact."""
+
+    def __init__(self, layer, fn):
+        self._layer = layer
+        self._fn = fn
+
+    @staticmethod
+    def trace(layer, inputs):
+        inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        out = layer(*inputs)
+        return out, TracedLayer(layer, to_static(layer))
+
+    def __call__(self, inputs):
+        inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        out = self._fn(*inputs)
+        return out if isinstance(out, (list, tuple)) else [out]
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        raise NotImplementedError(
+            "use paddle.inference.export_model(layer, example_inputs, "
+            "path) — the StableHLO export needs example shapes")
+
+
+class TranslatedLayer:
+    """jit.TranslatedLayer: the inference-side Layer jit.load returns in
+    the reference when loading an exported model. Wraps the C-ABI-free
+    Python Predictor over an export_model artifact."""
+
+    def __init__(self, predictor):
+        self._predictor = predictor
+
+    @staticmethod
+    def from_artifact(path):
+        from ..inference import load_predictor
+        return TranslatedLayer(load_predictor(path))
+
+    def __call__(self, *inputs):
+        import numpy as np
+        arrs = [np.asarray(getattr(x, "data", x)) for x in inputs]
+        outs = self._predictor.run(arrs)
+        from ..tensor.creation import to_tensor
+        outs = [to_tensor(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def eval(self):
+        return self

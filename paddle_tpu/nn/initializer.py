@@ -170,3 +170,31 @@ def calculate_gain(nonlinearity, param=None):
              "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
              "selu": 3.0 / 4}
     return gains[nonlinearity]
+
+
+class Bilinear(Initializer):
+    """initializer.Bilinear (fluid/initializer.py BilinearInitializer):
+    the classic bilinear-upsampling kernel for transposed-conv weights
+    [C_out, C_in, k, k]: w[y, x] = (1 - |x/f - c|) * (1 - |y/f - c|)
+    with f = ceil(k / 2), c = (2f - 1 - f % 2) / (2f)."""
+
+    def __call__(self, shape, dtype=None):
+        import numpy as np
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D "
+                             f"conv weight shape, got {shape}")
+        k = shape[-1]
+        if shape[-2] != k:
+            raise ValueError("Bilinear initializer expects square "
+                             f"kernels, got {shape[-2:]}")
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        xs = np.arange(k)
+        w1d = 1 - np.abs(xs / f - c)
+        kern = np.outer(w1d, w1d).astype(np.float32)
+        out = np.zeros(shape, np.float32)
+        out[...] = kern
+        from ..core.dtype import convert_dtype, get_default_dtype
+        return jnp.asarray(out, convert_dtype(dtype)
+                           if dtype else get_default_dtype())

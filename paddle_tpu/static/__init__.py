@@ -546,7 +546,8 @@ class WeightNormParamAttr(_ParamAttr):
                  do_model_average=False, need_clip=True):
         super().__init__(name=name, initializer=initializer,
                          learning_rate=learning_rate,
-                         regularizer=regularizer, trainable=trainable)
+                         regularizer=regularizer, trainable=trainable,
+                         need_clip=need_clip)
         self.dim = dim
 
 

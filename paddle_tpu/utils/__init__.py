@@ -18,3 +18,66 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None,
     if sci_mode is not None:
         kwargs["suppress"] = not sci_mode
     np.set_printoptions(**kwargs)
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """paddle.utils.deprecated decorator: warns once per call site."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = (f"API {fn.__module__}.{fn.__name__} is deprecated "
+                   f"since {since or 'this release'}"
+                   + (f", use {update_to} instead" if update_to else "")
+                   + (f" ({reason})" if reason else ""))
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+
+    return deco
+
+
+def run_check():
+    """paddle.utils.run_check: prove the install works end-to-end — a tiny
+    matmul + grad on the default backend, printed like the reference's
+    install_check."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    x.stop_gradient = False
+    y = (x @ x).sum()
+    y.backward()
+    assert np.isfinite(float(y.item()))
+    print(f"PaddlePaddle(TPU-native) works on {jax.default_backend()}! "
+          f"devices={jax.device_count()}")
+
+
+def require_version(min_version, max_version=None):
+    """paddle.utils.require_version: assert the installed version is in
+    [min_version, max_version]."""
+    import paddle_tpu as paddle
+
+    def key(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = key(paddle.__version__)
+    if key(min_version) > cur:
+        raise RuntimeError(
+            f"requires paddle >= {min_version}, got {paddle.__version__}")
+    if max_version is not None and key(max_version) < cur:
+        raise RuntimeError(
+            f"requires paddle <= {max_version}, got {paddle.__version__}")
+
+
+def try_import(module_name, err_msg=None):
+    """paddle.utils.try_import: import or raise a friendly error."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed "
+                       f"(pip install {module_name})") from e

@@ -623,3 +623,22 @@ def test_native_load_truncated_file_preserves_table(tmp_path):
         client.close()
         for s in servers:
             s.stop()
+
+
+def test_count_filter_entry_admission_survives_save_load(tmp_path):
+    """CountFilterEntry progress persists like optimizer slots: a restore
+    must not reset the admission counters."""
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import (
+        CountFilterEntry, SparseAccessor, SparseTable, TheOnePSRuntime)
+    rt = TheOnePSRuntime(n_shards=1)
+    t = rt.cores[0].create_table("e", 4, entry=CountFilterEntry(3))
+    t.pull(np.array([5]))
+    t.pull(np.array([5]))          # 2 of 3 sightings
+    assert len(t._rows) == 0
+    rt.save(str(tmp_path / "ck"))
+    rt2 = TheOnePSRuntime(n_shards=1)
+    rt2.cores[0].create_table("e", 4, entry=CountFilterEntry(3))
+    rt2.load(str(tmp_path / "ck"))
+    t2 = rt2.cores[0].tables["e"]
+    t2.pull(np.array([5]))         # third sighting: admitted
+    assert len(t2._rows) == 1
