@@ -115,15 +115,22 @@ class SparseTable:
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
         out = np.empty((len(ids), self.dim), np.float32)
+        fresh: Dict[int, np.ndarray] = {}  # unadmitted rows drawn this pull
         with self._lock:
             for i, key in enumerate(np.asarray(ids, np.int64)):
                 k = int(key)
                 row = self._rows.get(k)
                 if row is None:
-                    row = (self._rng.randn(self.dim) *
-                           self.init_std).astype(np.float32)
+                    row = fresh.get(k)
+                    if row is None:
+                        row = (self._rng.randn(self.dim) *
+                               self.init_std).astype(np.float32)
                     if self._admit(k):
                         self._rows[k] = row
+                    else:
+                        # duplicates of an unadmitted id within one batch
+                        # must see ONE consistent vector
+                        fresh[k] = row
                 out[i] = row
         return out
 
@@ -286,9 +293,16 @@ class PSCore:
             ids, vals, slot_ids, slot_vals = t.state()
             seen_ids, seen_counts = t.seen_state()
             acc = t.accessor
+            if isinstance(t.entry, CountFilterEntry):
+                entry_kind, entry_arg = "count", float(t.entry.count)
+            elif isinstance(t.entry, ProbabilityEntry):
+                entry_kind, entry_arg = "prob", float(t.entry.probability)
+            else:
+                entry_kind, entry_arg = "none", 0.0
             np.savez(os.path.join(dirname, f"{name}.npz"), ids=ids,
                      vals=vals, slot_ids=slot_ids, slot_vals=slot_vals,
                      seen_ids=seen_ids, seen_counts=seen_counts,
+                     entry_kind=entry_kind, entry_arg=entry_arg,
                      dim=t.dim, rule=acc.rule, lr=acc.lr,
                      epsilon=acc.epsilon, init_std=t.init_std, seed=t.seed)
         for name, t in self.dense_tables.items():
@@ -937,10 +951,20 @@ class TheOnePSRuntime:
                     if "seen_ids" in data else np.zeros((0,), np.int64)
                 seen_counts = np.asarray(data["seen_counts"], np.int64) \
                     if "seen_counts" in data else np.zeros((0,), np.int64)
+                entry = None
+                if "entry_kind" in data:
+                    kind = str(data["entry_kind"])
+                    if kind == "count":
+                        entry = CountFilterEntry(int(data["entry_arg"]))
+                    elif kind == "prob":
+                        entry = ProbabilityEntry(float(data["entry_arg"]))
                 for core_idx in range(n):
                     table = self.cores[core_idx].create_table(
                         name, int(data["dim"]), acc.rule, acc.lr,
-                        init_std=init_std, seed=seed0 + core_idx)
+                        init_std=init_std, seed=seed0 + core_idx,
+                        entry=entry)
+                    if table.entry is None and entry is not None:
+                        table.entry = entry  # table pre-created sans policy
                     table.accessor = acc
                     sel = ids % n == core_idx
                     ssel = slot_ids % n == core_idx

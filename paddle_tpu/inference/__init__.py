@@ -394,17 +394,35 @@ def get_num_bytes_of_data_type(dtype):
 
 class PredictorPool:
     """paddle_infer.PredictorPool: N predictor handles over ONE exported
-    model. The reference clones an AnalysisPredictor per thread because
-    its execution state is mutable; XLA executables are thread-safe, so
-    the pool loads and compiles once and every slot shares that
-    predictor (N-fold less startup latency and executable memory)."""
+    model. The model is loaded and compiled ONCE (XLA executables and the
+    frozen weights are thread-safe/immutable); each slot gets its own
+    Predictor facade with PRIVATE input/output handles, because the
+    handle state around the call is mutable — two threads sharing one
+    predictor would overwrite each other's IO (the reason the reference
+    clones per thread)."""
 
     def __init__(self, config, size=1):
-        self._shared = create_predictor(config)
-        self._size = int(size)
+        base = create_predictor(config)
+        self._slots = [base]
+        for _ in range(int(size) - 1):
+            clone = _clone_predictor_shell(base)
+            self._slots.append(clone)
 
     def retrieve(self, idx):
-        if not 0 <= idx < self._size:
-            raise IndexError(
-                f"PredictorPool index {idx} out of range [0, {self._size})")
-        return self._shared
+        return self._slots[idx]
+
+
+def _clone_predictor_shell(base: "Predictor") -> "Predictor":
+    """Per-slot shallow clone: shares the compiled callable, exported
+    module, weights and meta; owns fresh IO handles and probe memo."""
+    clone = Predictor.__new__(Predictor)
+    clone.config = base.config
+    clone._exported = base._exported
+    clone._params = base._params
+    clone._buffers = base._buffers
+    clone._meta = base._meta
+    clone._call = base._call
+    clone._inputs = {n: _IOHandle(n) for n in base._meta["input_names"]}
+    clone._outputs = {n: _IOHandle(n) for n in base._meta["output_names"]}
+    clone._pad_invariant_b = set()
+    return clone

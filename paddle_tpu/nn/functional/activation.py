@@ -23,7 +23,11 @@ silu = _unary(jax.nn.silu)
 swish = silu
 mish = _unary(lambda a: a * jnp.tanh(jax.nn.softplus(a)))
 hardswish = _unary(jax.nn.hard_swish)
-hardsigmoid = _unary(lambda a: jnp.clip(a / 6.0 + 0.5, 0.0, 1.0))
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    """hard_sigmoid_op: clip(slope * x + offset, 0, 1); the reference
+    exposes slope/offset (functional/activation.py hardsigmoid), default
+    slope 1/6."""
+    return _unary(lambda a: jnp.clip(a * slope + offset, 0.0, 1.0))(x)
 tanhshrink = _unary(lambda a: a - jnp.tanh(a))
 softsign = _unary(jax.nn.soft_sign)
 log_sigmoid = _unary(jax.nn.log_sigmoid)

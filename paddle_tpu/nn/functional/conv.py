@@ -150,8 +150,11 @@ def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
 
 
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
-                     output_padding=0, dilation=1, groups=1, output_size=None,
-                     data_format="NCL", name=None):
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    # NB reference argument order: groups BEFORE dilation for the 1d/3d
+    # transposes, the opposite of conv2d_transpose (functional/conv.py:553
+    # vs :809) — positional parity requires mirroring the inconsistency
     return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
                               dilation, groups, 1, data_format, output_size)
 
@@ -164,8 +167,8 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
-                     output_padding=0, dilation=1, groups=1, output_size=None,
-                     data_format="NCDHW", name=None):
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
     return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
                               dilation, groups, 3, data_format, output_size)
 

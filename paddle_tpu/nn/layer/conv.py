@@ -94,8 +94,10 @@ class Conv1DTranspose(_ConvNd):
     def forward(self, x, output_size=None):
         return F.conv1d_transpose(x, self.weight, self.bias, self._stride,
                                   self._padding, self._output_padding,
-                                  self._dilation, self._groups, output_size,
-                                  self._data_format)
+                                  groups=self._groups,
+                                  dilation=self._dilation,
+                                  output_size=output_size,
+                                  data_format=self._data_format)
 
 
 class Conv2DTranspose(_ConvNd):
@@ -126,5 +128,7 @@ class Conv3DTranspose(_ConvNd):
     def forward(self, x, output_size=None):
         return F.conv3d_transpose(x, self.weight, self.bias, self._stride,
                                   self._padding, self._output_padding,
-                                  self._dilation, self._groups, output_size,
-                                  self._data_format)
+                                  groups=self._groups,
+                                  dilation=self._dilation,
+                                  output_size=output_size,
+                                  data_format=self._data_format)

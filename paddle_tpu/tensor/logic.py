@@ -22,17 +22,38 @@ less_equal = _cmp(jnp.less_equal)
 logical_and = _cmp(jnp.logical_and)
 logical_or = _cmp(jnp.logical_or)
 logical_xor = _cmp(jnp.logical_xor)
-bitwise_and = _cmp(jnp.bitwise_and)
-bitwise_or = _cmp(jnp.bitwise_or)
-bitwise_xor = _cmp(jnp.bitwise_xor)
+_bitwise_and_impl = _cmp(jnp.bitwise_and)
+_bitwise_or_impl = _cmp(jnp.bitwise_or)
+_bitwise_xor_impl = _cmp(jnp.bitwise_xor)
+
+
+def _with_out(result, out):
+    """Reference bitwise ops take out=None: honored as an in-place
+    overwrite of `out` (the logical_*/bitwise_* op contract)."""
+    if out is None:
+        return result
+    from .manipulation import _inplace_via_tape
+    return _inplace_via_tape(out, result, "bitwise_out")
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return _with_out(_bitwise_and_impl(x, y), out)
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return _with_out(_bitwise_or_impl(x, y), out)
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return _with_out(_bitwise_xor_impl(x, y), out)
 
 
 def logical_not(x, name=None):
     return apply(jnp.logical_not, _t(x))
 
 
-def bitwise_not(x, name=None):
-    return apply(jnp.bitwise_not, _t(x))
+def bitwise_not(x, out=None, name=None):
+    return _with_out(apply(jnp.bitwise_not, _t(x)), out)
 
 
 def equal_all(x, y, name=None):

@@ -156,8 +156,8 @@ def expand_as(x, y, name=None):
     return broadcast_to(x, _t(y).shape)
 
 
-def broadcast_tensors(inputs, name=None):
-    tensors = [_t(t) for t in inputs]
+def broadcast_tensors(input, name=None):
+    tensors = [_t(t) for t in input]
     outs = apply(lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *tensors)
     return list(outs)
 

@@ -38,7 +38,14 @@ maximum = _binary(jnp.maximum)
 minimum = _binary(jnp.minimum)
 fmax = _binary(jnp.fmax)
 fmin = _binary(jnp.fmin)
-atan2 = _binary(jnp.arctan2)
+_atan2_impl = _binary(jnp.arctan2)
+
+
+def atan2(y, x, name=None):
+    """paddle.atan2(y, x): quadrant-aware arctan(y/x) — the reference
+    names the FIRST operand y (math.py:2502), so keyword callers pass
+    y=..., x=..."""
+    return _atan2_impl(y, x)
 hypot = _binary(jnp.hypot)
 
 exp = _unary(jnp.exp)
@@ -55,7 +62,13 @@ sign = _unary(jnp.sign)
 floor = _unary(jnp.floor)
 ceil = _unary(jnp.ceil)
 round = _unary(jnp.round)
-trunc = _unary(jnp.trunc)
+_trunc_impl = _unary(jnp.trunc)
+
+
+def trunc(input, name=None):
+    """paddle.trunc(input): the reference names the operand `input`
+    (math.py trunc), unlike the x-named unary family."""
+    return _trunc_impl(input)
 sin = _unary(jnp.sin)
 cos = _unary(jnp.cos)
 tan = _unary(jnp.tan)
@@ -504,6 +517,13 @@ def reciprocal_(x, name=None):
 _scale_fn = scale
 
 
-def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, name=None):
-    return _inplace_unary(
-        x, lambda t: _scale_fn(t, scale, bias, bias_after_scale), "scale_")
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+           name=None):
+    def fn(t):
+        out = _scale_fn(t, scale, bias, bias_after_scale)
+        if act is not None:  # legacy fused-activation arg (scale_ op attr)
+            from ..nn import functional as F
+            out = getattr(F, act)(out)
+        return out
+
+    return _inplace_unary(x, fn, "scale_")

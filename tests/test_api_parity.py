@@ -29,3 +29,38 @@ def test_reference_public_names_exist(mod):
             target = getattr(target, part)
     missing = [n for n in REFERENCE_ALL[mod] if not hasattr(target, n)]
     assert not missing, f"paddle.{mod} missing reference names: {missing}"
+
+
+def test_reference_keyword_signatures():
+    """Keyword-call compatibility for signatures the reference names
+    differently from the common pattern (audited against the reference
+    sources; see the conv transpose groups/dilation order inconsistency
+    note in nn/functional/conv.py)."""
+    import numpy as np
+    from paddle_tpu.nn import functional as F
+
+    # asymmetric case pins the (y, x) binding (reference math.py:2502
+    # names the ORDINATE y — later paddle releases renamed it x):
+    # atan2(y=1, x=2) = arctan(1/2)
+    np.testing.assert_allclose(
+        float(paddle.atan2(y=paddle.to_tensor(1.0),
+                           x=paddle.to_tensor(2.0)).item()),
+        np.arctan2(1.0, 2.0), atol=1e-6)
+    assert float(paddle.trunc(input=paddle.to_tensor(1.7)).item()) == 1.0
+    out = paddle.to_tensor(np.zeros(1, np.int32))
+    paddle.bitwise_or(paddle.to_tensor(np.array([1], np.int32)),
+                      paddle.to_tensor(np.array([2], np.int32)), out=out)
+    assert int(np.asarray(out.data)[0]) == 3
+    bl = paddle.broadcast_tensors(
+        input=[paddle.to_tensor(np.zeros((1, 2))),
+               paddle.to_tensor(np.zeros((3, 1)))])
+    assert np.asarray(bl[1].data).shape == (3, 2)
+    assert abs(float(F.hardsigmoid(paddle.to_tensor(0.0), slope=0.25,
+                                   offset=0.3).item()) - 0.3) < 1e-6
+    # conv1d/3d_transpose take groups BEFORE dilation positionally
+    import inspect
+    for fn in (F.conv1d_transpose, F.conv3d_transpose):
+        params = list(inspect.signature(fn).parameters)
+        assert params.index("groups") < params.index("dilation")
+    params2 = list(inspect.signature(F.conv2d_transpose).parameters)
+    assert params2.index("dilation") < params2.index("groups")

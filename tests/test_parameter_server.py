@@ -642,3 +642,29 @@ def test_count_filter_entry_admission_survives_save_load(tmp_path):
     t2 = rt2.cores[0].tables["e"]
     t2.pull(np.array([5]))         # third sighting: admitted
     assert len(t2._rows) == 1
+
+
+def test_entry_policy_restored_from_checkpoint(tmp_path):
+    """The admission policy itself round-trips: a fresh runtime that loads
+    the checkpoint re-arms CountFilterEntry without manual re-creation."""
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import (
+        CountFilterEntry, TheOnePSRuntime)
+    rt = TheOnePSRuntime(n_shards=1)
+    rt.cores[0].create_table("e", 4, entry=CountFilterEntry(3))
+    rt.save(str(tmp_path / "ck"))
+    rt2 = TheOnePSRuntime(n_shards=1)
+    rt2.load(str(tmp_path / "ck"))
+    t2 = rt2.cores[0].tables["e"]
+    assert isinstance(t2.entry, CountFilterEntry) and t2.entry.count == 3
+    t2.pull(np.array([9]))
+    assert len(t2._rows) == 0  # still gated after restore
+
+
+def test_unadmitted_duplicate_ids_consistent_in_one_pull():
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import (
+        CountFilterEntry, SparseAccessor, SparseTable)
+    t = SparseTable(4, SparseAccessor(), init_std=0.5,
+                    entry=CountFilterEntry(10))
+    out = t.pull(np.array([5, 5, 5]))
+    np.testing.assert_allclose(out[0], out[1])
+    np.testing.assert_allclose(out[1], out[2])
