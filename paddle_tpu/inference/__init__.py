@@ -402,6 +402,8 @@ class PredictorPool:
     clones per thread)."""
 
     def __init__(self, config, size=1):
+        if int(size) < 1:
+            raise ValueError(f"PredictorPool size must be >= 1, got {size}")
         base = create_predictor(config)
         self._slots = [base]
         for _ in range(int(size) - 1):
@@ -409,6 +411,11 @@ class PredictorPool:
             self._slots.append(clone)
 
     def retrieve(self, idx):
+        # a negative index must not silently alias another thread's slot
+        if not 0 <= idx < len(self._slots):
+            raise IndexError(
+                f"PredictorPool index {idx} out of range "
+                f"[0, {len(self._slots)})")
         return self._slots[idx]
 
 

@@ -11,7 +11,7 @@ from .conv import _norm_tuple, _padding
 
 
 def _pool(x, fn, init, kernel, stride, padding, n, data_format, ceil_mode=False,
-          average=False, exclusive=True):
+          average=False, exclusive=True, divisor_override=None):
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     ks = _norm_tuple(kernel, n)
     st = _norm_tuple(stride if stride is not None else kernel, n)
@@ -29,9 +29,32 @@ def _pool(x, fn, init, kernel, stride, padding, n, data_format, ceil_mode=False,
             pads = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
         if isinstance(pads, str):
             pads = jax.lax.padtype_to_pads(a.shape, window, strides, pads)
+        if ceil_mode:
+            # pool_op ceil formula: out = ceil((in + pads - k) / s) + 1 —
+            # extend the high-side pad so the trailing partial window is
+            # emitted; reduce_window pads with `init` (the identity), so
+            # max stays -inf-padded and avg's exclusive counts stay true
+            pads = list(pads)
+            for dim in range(nd):
+                k, s_ = window[dim], strides[dim]
+                if k == 1 and s_ == 1:
+                    continue
+                lo, hi = pads[dim]
+                span = a.shape[dim] + lo + hi
+                out_floor = (span - k) // s_ + 1
+                out_ceil = -((span - k) // -s_) + 1
+                # caffe/paddle clamp: the last window must START inside
+                # input + left pad — a window lying entirely in padding
+                # would produce -inf (max) or 0/0 = NaN (exclusive avg)
+                if (out_ceil - 1) * s_ >= a.shape[dim] + lo:
+                    out_ceil -= 1
+                if out_ceil > out_floor:
+                    pads[dim] = (lo, hi + (out_ceil - 1) * s_ + k - span)
         out = jax.lax.reduce_window(a, init, fn, window, strides, pads)
         if average:
-            if exclusive and any(p != (0, 0) for p in pads):
+            if divisor_override is not None:
+                out = out / float(divisor_override)
+            elif exclusive and any(p != (0, 0) for p in pads):
                 ones = jnp.ones_like(a)
                 counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
                                                strides, pads)
@@ -192,14 +215,16 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW",
                name=None):
     return _pool(x, jax.lax.add, 0.0, kernel_size, stride, padding, 2,
-                 data_format, ceil_mode, average=True, exclusive=exclusive)
+                 data_format, ceil_mode, average=True, exclusive=exclusive,
+                 divisor_override=divisor_override)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW",
                name=None):
     return _pool(x, jax.lax.add, 0.0, kernel_size, stride, padding, 3,
-                 data_format, ceil_mode, average=True, exclusive=exclusive)
+                 data_format, ceil_mode, average=True, exclusive=exclusive,
+                 divisor_override=divisor_override)
 
 
 def _adaptive_axes(in_size, out_size):

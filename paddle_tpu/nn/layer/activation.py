@@ -80,7 +80,7 @@ class SELU(Layer):
 
 class PReLU(Layer):
     def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
-                 data_format="NCHW", name=None):
+                 name=None, data_format="NCHW"):
         super().__init__()
         self.data_format = data_format
         self.weight = self.create_parameter(

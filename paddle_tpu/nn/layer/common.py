@@ -252,7 +252,8 @@ class Fold(Layer):
 
 
 class Unfold(Layer):
-    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+    # reference arg order: dilations, paddings, strides (common.py Unfold)
+    def __init__(self, kernel_sizes, dilations=1, paddings=0, strides=1,
                  name=None):
         super().__init__()
         self.kernel_sizes = kernel_sizes

@@ -101,8 +101,10 @@ class Conv1DTranspose(_ConvNd):
 
 
 class Conv2DTranspose(_ConvNd):
+    # reference order: dilation BEFORE groups for the 2D/3D transpose
+    # layers, the opposite of Conv1DTranspose (nn/layer/conv.py:631)
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
-                 padding=0, output_padding=0, groups=1, dilation=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
                  weight_attr=None, bias_attr=None, data_format="NCHW"):
         super().__init__(in_channels, out_channels, kernel_size, stride,
                          padding, dilation, groups, "zeros", weight_attr,
@@ -118,7 +120,7 @@ class Conv2DTranspose(_ConvNd):
 
 class Conv3DTranspose(_ConvNd):
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
-                 padding=0, output_padding=0, groups=1, dilation=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
                  weight_attr=None, bias_attr=None, data_format="NCDHW"):
         super().__init__(in_channels, out_channels, kernel_size, stride,
                          padding, dilation, groups, "zeros", weight_attr,

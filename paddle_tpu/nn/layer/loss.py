@@ -8,7 +8,7 @@ from .layers import Layer
 class CrossEntropyLoss(Layer):
     def __init__(self, weight=None, ignore_index=-100, reduction="mean",
                  soft_label=False, axis=-1, use_softmax=True,
-                 label_smoothing=0.0, name=None):
+                 name=None, label_smoothing=0.0):
         super().__init__()
         self.weight = weight
         self.ignore_index = ignore_index

@@ -99,6 +99,12 @@ class SyncBatchNorm(_BatchNormBase):
     mesh; in eager single-process mode this equals BatchNorm.
     (reference: python/paddle/nn/layer/norm.py SyncBatchNorm + c_sync ops)"""
 
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, None, name)
+
     @classmethod
     def convert_sync_batchnorm(cls, layer):
         if isinstance(layer, _BatchNormBase) and not isinstance(

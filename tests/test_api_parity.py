@@ -64,3 +64,67 @@ def test_reference_keyword_signatures():
         assert params.index("groups") < params.index("dilation")
     params2 = list(inspect.signature(F.conv2d_transpose).parameters)
     assert params2.index("dilation") < params2.index("groups")
+
+
+def test_layer_class_constructor_orders():
+    """Constructor positional orders pinned for classes the audit fixed
+    (incl. the reference's own 1D-vs-2D/3D transpose inconsistency and
+    AvgPool1D's (exclusive, ceil_mode) vs AvgPool2D's (ceil_mode,
+    exclusive) swap)."""
+    import inspect
+    from paddle_tpu import nn
+
+    def order(cls, *names):
+        params = list(inspect.signature(cls.__init__).parameters)
+        idx = [params.index(n) for n in names]
+        assert idx == sorted(idx), f"{cls.__name__}: {params}"
+
+    order(nn.Conv1DTranspose, "output_padding", "groups", "dilation")
+    order(nn.Conv2DTranspose, "output_padding", "dilation", "groups")
+    order(nn.Conv3DTranspose, "output_padding", "dilation", "groups")
+    order(nn.MaxPool2D, "padding", "return_mask", "ceil_mode",
+          "data_format")
+    order(nn.AvgPool1D, "padding", "exclusive", "ceil_mode")
+    order(nn.AvgPool2D, "padding", "ceil_mode", "exclusive",
+          "divisor_override")
+    order(nn.AdaptiveMaxPool2D, "output_size", "return_mask")
+    order(nn.Unfold, "kernel_sizes", "dilations", "paddings", "strides")
+    order(nn.PReLU, "weight_attr", "name")  # data_format is post-name
+    order(nn.CrossEntropyLoss, "use_softmax", "name")
+    # SyncBatchNorm omits use_global_stats (reference signature)
+    assert "use_global_stats" not in inspect.signature(
+        nn.SyncBatchNorm.__init__).parameters
+
+
+def test_pool_layers_forward_extended_args():
+    """The layer classes actually FORWARD their extended args (they were
+    silently dropped before this audit)."""
+    import numpy as np
+    from paddle_tpu import nn
+    x = paddle.to_tensor(
+        np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out, mask = nn.MaxPool2D(2, 2, 0, True)(x)  # return_mask positional
+    assert np.asarray(out.data).shape == (1, 1, 2, 2)
+    assert np.asarray(mask.data).shape == (1, 1, 2, 2)
+    # ceil_mode changes the output grid
+    y = nn.MaxPool2D(2, 2, 0, False, True)(paddle.to_tensor(
+        np.zeros((1, 1, 5, 5), np.float32)))
+    assert np.asarray(y.data).shape == (1, 1, 3, 3)
+
+
+def test_pool_ceil_mode_all_padding_window_clamped():
+    """The trailing ceil_mode window must start inside input+left-pad
+    (caffe clamp) — never produce NaN (avg 0/0) or -inf (max)."""
+    import numpy as np
+    from paddle_tpu.nn import functional as F
+    torch = pytest.importorskip("torch")
+    x = np.ones((1, 1, 5), np.float32)
+    ours = np.asarray(F.avg_pool1d(paddle.to_tensor(x), 3, 3, 1,
+                                   exclusive=True, ceil_mode=True).data)
+    ref = torch.nn.functional.avg_pool1d(
+        torch.from_numpy(x), 3, 3, 1, ceil_mode=True,
+        count_include_pad=False).numpy()
+    assert ours.shape == ref.shape
+    np.testing.assert_allclose(ours, ref, atol=1e-6)
+    assert np.isfinite(np.asarray(F.max_pool1d(
+        paddle.to_tensor(x), 2, 4, 0, ceil_mode=True).data)).all()
