@@ -318,8 +318,8 @@ class DataLoader:
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, use_shared_memory=True,
-                 prefetch_factor=2, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 timeout=0, worker_init_fn=None,
+                 prefetch_factor=2, persistent_workers=False):
         self.dataset = dataset
         self.num_workers = num_workers
         self.collate_fn = collate_fn or default_collate_fn

@@ -16,6 +16,10 @@ def _pool(x, fn, init, kernel, stride, padding, n, data_format, ceil_mode=False,
     ks = _norm_tuple(kernel, n)
     st = _norm_tuple(stride if stride is not None else kernel, n)
     pad = _padding(padding, n)
+    if ceil_mode and isinstance(pad, str) and pad.upper() == "VALID":
+        raise ValueError(
+            'When padding is "VALID", ceil_mode must be False '
+            "(reference pooling contract)")
 
     def f(a):
         nd = a.ndim
@@ -273,13 +277,60 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     return _adaptive_pool(x, output_size, 3, jnp.mean, data_format)
 
 
+def _adaptive_max_pool_mask(x, output_size, n):
+    """Adaptive max pool returning (out, mask) with mask = flat argmax
+    into the input spatial volume (max_pool*_with_index adaptive mode).
+    NC*-layout; output grids are small and static, so the per-cell slice
+    loop stays a fixed set of fused XLA ops."""
+    sizes = _norm_tuple(output_size, n)
+
+    def f(a):
+        spatial = a.shape[2:]
+        segs = [_adaptive_axes(spatial[d], sizes[d]) for d in range(n)]
+        outs, masks = [], []
+        for cell in np.ndindex(*sizes):
+            sl = [slice(None), slice(None)]
+            starts = []
+            for d in range(n):
+                s0, e0 = segs[d][cell[d]]
+                sl.append(slice(s0, e0))
+                starts.append(s0)
+            win = a[tuple(sl)]
+            w_spatial = win.shape[2:]
+            flat = win.reshape(win.shape[0], win.shape[1], -1)
+            best = jnp.argmax(flat, axis=-1)
+            outs.append(jnp.max(flat, axis=-1))
+            # local flat idx -> global flat idx over the input volume
+            g = jnp.zeros_like(best)
+            rem = best
+            for d in range(n - 1, -1, -1):
+                coord = rem % w_spatial[d] + starts[d]
+                rem = rem // w_spatial[d]
+                mult = 1
+                for dd in range(d + 1, n):
+                    mult *= spatial[dd]
+                g = g + coord * mult
+            masks.append(g.astype(jnp.int32))
+        out = jnp.stack(outs, axis=-1).reshape(a.shape[:2] + sizes)
+        mask = jnp.stack(masks, axis=-1).reshape(a.shape[:2] + sizes)
+        return out, mask
+
+    return apply(f, _t(x))
+
+
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_pool_mask(x, output_size, 1)
     return _adaptive_pool(x, output_size, 1, jnp.max, "NCL")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_pool_mask(x, output_size, 2)
     return _adaptive_pool(x, output_size, 2, jnp.max, "NCHW")
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_pool_mask(x, output_size, 3)
     return _adaptive_pool(x, output_size, 3, jnp.max, "NCDHW")

@@ -51,6 +51,10 @@ class Optimizer:
     def _init_slots(self, p: jnp.ndarray) -> Dict[str, jnp.ndarray]:
         return {}
 
+    def _param_lr(self, p, lr):
+        """Per-parameter lr hook (AdamW lr_ratio); default: unchanged."""
+        return lr
+
     def _rule(self, g, p, slots, lr, wd):
         raise NotImplementedError
 
@@ -123,6 +127,7 @@ class Optimizer:
             slots = self._state[pid]
             lr = self.get_lr() * getattr(p, "optimize_attr",
                                          {"learning_rate": 1.0})["learning_rate"]
+            lr = self._param_lr(p, lr)
             wd = self._wd_for(p)
             if isinstance(g, SelectedRows):
                 from ..regularizer import L1Decay
@@ -306,17 +311,20 @@ class SGD(Optimizer):
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 multi_precision=False, name=None):
+                 multi_precision=False, rescale_grad=1.0, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self._momentum = momentum
         self._nesterov = use_nesterov
+        self._rescale_grad = float(rescale_grad)
 
     def _init_slots(self, p):
         return {"velocity": jnp.zeros(p.shape, jnp.float32)}
 
     def _rule(self, g, p, slots, lr, wd):
         g = g.astype(jnp.float32)
+        if self._rescale_grad != 1.0:  # momentum_op RescaleGrad attr
+            g = g * self._rescale_grad
         p32 = p.astype(jnp.float32)
         if wd:
             g = g + wd * p32
@@ -408,13 +416,23 @@ class Adam(Optimizer):
 class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=0.01,
-                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None,
-                 moment_dtype="float32"):
+                 lr_ratio=None, moment_dtype="float32"):
+        # positional prefix matches the reference (no lr_ratio in the
+        # snapshot's adamw.py); lr_ratio/moment_dtype are keyword tail.
+        # lr_ratio(param) -> float scales this param's lr (layer-wise lr
+        # decay); applied on the eager step path via _param_lr
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision,
                          name, moment_dtype)
         self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _param_lr(self, p, lr):
+        if self._lr_ratio is not None:
+            return lr * float(self._lr_ratio(p))
+        return lr
 
     def _decoupled(self):
         return True
@@ -453,8 +471,9 @@ class Adamax(Optimizer):
 
 class Adagrad(Optimizer):
     def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
-                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
-                 name=None):
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        # reference order: name BEFORE initial_accumulator_value
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
         self._epsilon = epsilon

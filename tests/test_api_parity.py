@@ -128,3 +128,60 @@ def test_pool_ceil_mode_all_padding_window_clamped():
     np.testing.assert_allclose(ours, ref, atol=1e-6)
     assert np.isfinite(np.asarray(F.max_pool1d(
         paddle.to_tensor(x), 2, 4, 0, ceil_mode=True).data)).all()
+
+
+def test_optimizer_io_signature_orders():
+    import inspect
+    import numpy as np
+    from paddle_tpu import io, optimizer
+
+    def order(target, *names):
+        params = list(inspect.signature(target).parameters)
+        idx = [params.index(n) for n in names]
+        assert idx == sorted(idx), params
+
+    order(optimizer.Adagrad.__init__, "grad_clip", "name",
+          "initial_accumulator_value")
+    order(optimizer.AdamW.__init__, "weight_decay",
+          "apply_decay_param_fun", "grad_clip", "name", "lr_ratio")
+    order(optimizer.Momentum.__init__, "multi_precision", "rescale_grad",
+          "name")
+    order(io.DataLoader.__init__, "use_shared_memory", "timeout",
+          "worker_init_fn", "prefetch_factor")
+    # rescale_grad has real behavior: grads scale before the update
+    m = paddle.nn.Linear(2, 1)
+    o = optimizer.Momentum(learning_rate=0.1, momentum=0.0,
+                           parameters=m.parameters(), rescale_grad=0.5)
+    w0 = np.asarray(m.weight.data).copy()
+    m(paddle.to_tensor(np.ones((1, 2), np.float32))).sum().backward()
+    g = np.asarray(m.weight.grad.data)
+    o.step()
+    np.testing.assert_allclose(np.asarray(m.weight.data),
+                               w0 - 0.05 * g, atol=1e-6)
+
+
+def test_adaptive_max_pool_mask_and_lr_ratio():
+    import numpy as np
+    from paddle_tpu.nn import functional as F
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 7, 9).astype(np.float32)
+    out, mask = F.adaptive_max_pool2d(paddle.to_tensor(x), (3, 4),
+                                      return_mask=True)
+    ref_out, ref_idx = torch.nn.functional.adaptive_max_pool2d(
+        torch.from_numpy(x), (3, 4), return_indices=True)
+    np.testing.assert_allclose(np.asarray(out.data), ref_out.numpy(),
+                               atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(mask.data), ref_idx.numpy())
+    with pytest.raises(ValueError):
+        F.max_pool2d(paddle.to_tensor(x), 3, 2, padding="VALID",
+                     ceil_mode=True)
+    # lr_ratio scales the per-param lr on the eager step
+    m = paddle.nn.Linear(2, 1)
+    o = paddle.optimizer.AdamW(learning_rate=0.1,
+                               parameters=m.parameters(),
+                               weight_decay=0.0, lr_ratio=lambda p: 0.0)
+    w0 = np.asarray(m.weight.data).copy()
+    m(paddle.to_tensor(np.ones((1, 2), np.float32))).sum().backward()
+    o.step()
+    np.testing.assert_allclose(np.asarray(m.weight.data), w0, atol=1e-8)
