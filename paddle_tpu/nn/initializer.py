@@ -71,7 +71,9 @@ def _fans(shape):
 
 
 class XavierNormal(Initializer):
-    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+    # reference signature: (fan_in, fan_out, name); gain is a later-2.x
+    # extension kept at the keyword tail
+    def __init__(self, fan_in=None, fan_out=None, name=None, gain=1.0):
         self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
 
     def __call__(self, shape, dtype=None):
@@ -83,7 +85,7 @@ class XavierNormal(Initializer):
 
 
 class XavierUniform(Initializer):
-    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+    def __init__(self, fan_in=None, fan_out=None, name=None, gain=1.0):
         self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
 
     def __call__(self, shape, dtype=None):

@@ -221,9 +221,16 @@ class Optimizer:
         """Returns pure fn(params, grads, state, lr, step) -> (params, state).
 
         All leaves are jax arrays; safe to jit/pjit. Weight decay uses the
-        optimizer's scalar setting for every param (per-param exclusions are an
-        eager-path feature).
+        optimizer's scalar setting for every param (per-param exclusions
+        and AdamW's lr_ratio are eager-path features — a set lr_ratio
+        raises here rather than silently training at uniform lr).
         """
+        if getattr(self, "_lr_ratio", None) is not None:
+            raise NotImplementedError(
+                "lr_ratio is applied on the eager step() path; the "
+                "functional apply_gradients_fn uses one lr for the whole "
+                "pytree — pre-scale per-layer lrs via parameter groups "
+                "(optimize_attr['learning_rate']) for the jit path")
         from ..regularizer import L2Decay, WeightDecayRegularizer
         if isinstance(self._weight_decay, L2Decay):
             wd = self._weight_decay.coeff

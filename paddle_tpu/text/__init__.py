@@ -67,14 +67,24 @@ class UCIHousing(Dataset):
 
 
 class WMT14(_SyntheticSeq):
-    def __init__(self, data_file=None, mode="train", dict_size=30000):
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True):
         super().__init__(256, 32, dict_size, dict_size, seed=14)
 
 
-class WMT16(WMT14):
-    pass
+class WMT16(_SyntheticSeq):
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=True):
+        # reference signature (text/datasets/wmt16.py); the synthetic
+        # corpus honors the separate source/target vocab sizes
+        super().__init__(256, 32, src_dict_size, trg_dict_size, seed=16)
 
 
 class Conll05st(_SyntheticSeq):
-    def __init__(self, data_file=None, mode="train", **kw):
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=True, mode="train", **kw):
+        # reference signature (text/datasets/conll05.py): the dict/emb
+        # file args are accepted per the house convention for synthetic
+        # fallbacks (real files would key the real corpus)
         super().__init__(256, 40, 8000, 67, seed=15)

@@ -68,8 +68,10 @@ class BottleneckBlock(Layer):
 
 
 class ResNet(Layer):
-    def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+    # reference order: (block, depth, num_classes, with_pool); width/groups
+    # are the wide/resnext extensions at the keyword tail
+    def __init__(self, block, depth=50, num_classes=1000, with_pool=True,
+                 width=64, groups=1):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}

@@ -18,6 +18,17 @@ REFERENCE_ALL = {'root': ['CPUPlace', 'CUDAPinnedPlace', 'CUDAPlace', 'DataParal
     'metric': ['Accuracy', 'Auc', 'Metric', 'Precision', 'Recall']}
 
 
+def _param_order(target, *names):
+    import inspect
+    params = list(inspect.signature(target).parameters)
+    idx = [params.index(n) for n in names]
+    assert idx == sorted(idx), params
+
+
+def _class_order(cls, *names):
+    _param_order(cls.__init__, *names)
+
+
 REFERENCE_ALL.update({'distributed': ['CountFilterEntry', 'InMemoryDataset', 'ParallelEnv', 'ProbabilityEntry', 'QueueDataset', 'ReduceOp', 'all_gather', 'all_reduce', 'alltoall', 'barrier', 'broadcast', 'get_group', 'get_rank', 'get_world_size', 'init_parallel_env', 'new_group', 'recv', 'reduce', 'scatter', 'send', 'spawn', 'split', 'wait'], 'distributed.fleet': ['CommunicateTopology', 'DistributedStrategy', 'Fleet', 'HybridCommunicateGroup', 'MultiSlotDataGenerator', 'MultiSlotStringDataGenerator', 'PaddleCloudRoleMaker', 'Role', 'UserDefinedRoleMaker', 'UtilBase'], 'jit': ['ProgramTranslator', 'TracedLayer', 'TranslatedLayer', 'load', 'not_to_static', 'save', 'set_code_level', 'set_verbosity', 'to_static'], 'nn.initializer': ['Assign', 'Bilinear', 'Constant', 'KaimingNormal', 'KaimingUniform', 'Normal', 'TruncatedNormal', 'Uniform', 'XavierNormal', 'XavierUniform', 'set_global_initializer'], 'utils': ['deprecated', 'require_version', 'run_check', 'try_import'], 'inference': ['Config', 'DataType', 'PlaceType', 'PrecisionType', 'Predictor', 'PredictorPool', 'Tensor', 'create_predictor', 'get_num_bytes_of_data_type', 'get_version'], 'amp': ['GradScaler', 'auto_cast'], 'autograd': ['PyLayer', 'PyLayerContext', 'backward', 'grad'], 'text': ['Conll05st', 'Imdb', 'Imikolov', 'Movielens', 'UCIHousing', 'WMT14', 'WMT16'], 'onnx': ['export']})
 
 
@@ -74,10 +85,7 @@ def test_layer_class_constructor_orders():
     import inspect
     from paddle_tpu import nn
 
-    def order(cls, *names):
-        params = list(inspect.signature(cls.__init__).parameters)
-        idx = [params.index(n) for n in names]
-        assert idx == sorted(idx), f"{cls.__name__}: {params}"
+    order = _class_order
 
     order(nn.Conv1DTranspose, "output_padding", "groups", "dilation")
     order(nn.Conv2DTranspose, "output_padding", "dilation", "groups")
@@ -135,10 +143,7 @@ def test_optimizer_io_signature_orders():
     import numpy as np
     from paddle_tpu import io, optimizer
 
-    def order(target, *names):
-        params = list(inspect.signature(target).parameters)
-        idx = [params.index(n) for n in names]
-        assert idx == sorted(idx), params
+    order = _param_order
 
     order(optimizer.Adagrad.__init__, "grad_clip", "name",
           "initial_accumulator_value")
@@ -185,3 +190,32 @@ def test_adaptive_max_pool_mask_and_lr_ratio():
     m(paddle.to_tensor(np.ones((1, 2), np.float32))).sum().backward()
     o.step()
     np.testing.assert_allclose(np.asarray(m.weight.data), w0, atol=1e-8)
+
+
+def test_misc_constructor_orders_batch2():
+    import inspect
+    from paddle_tpu import nn, text, vision
+
+    order = _param_order
+
+    order(nn.initializer.XavierNormal.__init__, "fan_out", "name", "gain")
+    order(vision.models.ResNet.__init__, "depth", "num_classes",
+          "with_pool", "width")
+    order(text.WMT16.__init__, "mode", "src_dict_size", "trg_dict_size",
+          "lang")
+    order(text.Conll05st.__init__, "data_file", "word_dict_file",
+          "verb_dict_file", "target_dict_file", "emb_file")
+    # ResNet positional (block, depth, num_classes) builds the right head
+    net = vision.models.ResNet(
+        type(vision.models.resnet18().layer1[0]), 18, 7)
+    assert net.fc.weight.shape[1] == 7
+
+
+def test_lr_ratio_raises_on_functional_path():
+    """lr_ratio is eager-only: the jit-path apply_gradients_fn must fail
+    loudly instead of silently training at uniform lr."""
+    m = paddle.nn.Linear(2, 1)
+    o = paddle.optimizer.AdamW(parameters=m.parameters(),
+                               lr_ratio=lambda p: 0.5)
+    with pytest.raises(NotImplementedError):
+        o.apply_gradients_fn()
