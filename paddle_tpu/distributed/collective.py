@@ -112,7 +112,7 @@ def _resolve_axis(group) -> Optional[str]:
     return _CTX.primary
 
 
-def get_group(gid=0):
+def get_group(id=0):
     return _DEFAULT_GROUP[0]
 
 

@@ -152,7 +152,7 @@ class Model:
         return logs
 
     def predict(self, test_data, batch_size=1, num_workers=0,
-                stack_outputs=False, callbacks=None, verbose=1):
+                stack_outputs=False, verbose=1, callbacks=None):
         loader = self._to_loader(test_data, batch_size, False, False,
                                  num_workers)
         outputs = []

@@ -227,10 +227,17 @@ class Optimizer:
         """
         if getattr(self, "_lr_ratio", None) is not None:
             raise NotImplementedError(
-                "lr_ratio is applied on the eager step() path; the "
+                "lr_ratio is applied on the eager step() path only; the "
                 "functional apply_gradients_fn uses one lr for the whole "
-                "pytree — pre-scale per-layer lrs via parameter groups "
-                "(optimize_attr['learning_rate']) for the jit path")
+                "pytree — for the jit path, split the model across "
+                "several optimizers (one per lr tier), each with its own "
+                "apply fn")
+        if getattr(self, "_apply_decay_param_fun", None) is not None:
+            raise NotImplementedError(
+                "apply_decay_param_fun is an eager-path feature; "
+                "apply_gradients_fn applies the scalar weight_decay to "
+                "every leaf — mark exclusions via param.no_weight_decay "
+                "(honored by the fused path) or use separate optimizers")
         from ..regularizer import L2Decay, WeightDecayRegularizer
         if isinstance(self._weight_decay, L2Decay):
             wd = self._weight_decay.coeff

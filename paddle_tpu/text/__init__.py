@@ -69,6 +69,8 @@ class UCIHousing(Dataset):
 class WMT14(_SyntheticSeq):
     def __init__(self, data_file=None, mode="train", dict_size=30000,
                  download=True):
+        if dict_size == -1:  # reference sentinel: full dictionary
+            dict_size = 30000
         super().__init__(256, 32, dict_size, dict_size, seed=14)
 
 
@@ -76,8 +78,11 @@ class WMT16(_SyntheticSeq):
     def __init__(self, data_file=None, mode="train", src_dict_size=30000,
                  trg_dict_size=30000, lang="en", download=True):
         # reference signature (text/datasets/wmt16.py); the synthetic
-        # corpus honors the separate source/target vocab sizes
-        super().__init__(256, 32, src_dict_size, trg_dict_size, seed=16)
+        # corpus honors the separate source/target vocab sizes; -1 is the
+        # reference's use-the-full-dict sentinel
+        src = 30000 if src_dict_size == -1 else src_dict_size
+        trg = 30000 if trg_dict_size == -1 else trg_dict_size
+        super().__init__(256, 32, src, trg, seed=16)
 
 
 class Conll05st(_SyntheticSeq):

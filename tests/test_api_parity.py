@@ -22,7 +22,7 @@ def _param_order(target, *names):
     import inspect
     params = list(inspect.signature(target).parameters)
     idx = [params.index(n) for n in names]
-    assert idx == sorted(idx), params
+    assert idx == sorted(idx), f"{getattr(target, '__qualname__', target)}: {params}"
 
 
 def _class_order(cls, *names):
@@ -139,7 +139,6 @@ def test_pool_ceil_mode_all_padding_window_clamped():
 
 
 def test_optimizer_io_signature_orders():
-    import inspect
     import numpy as np
     from paddle_tpu import io, optimizer
 
@@ -193,7 +192,6 @@ def test_adaptive_max_pool_mask_and_lr_ratio():
 
 
 def test_misc_constructor_orders_batch2():
-    import inspect
     from paddle_tpu import nn, text, vision
 
     order = _param_order
