@@ -232,12 +232,15 @@ class Optimizer:
                 "pytree — for the jit path, split the model across "
                 "several optimizers (one per lr tier), each with its own "
                 "apply fn")
-        if getattr(self, "_apply_decay_param_fun", None) is not None:
+        if getattr(self, "_apply_decay_param_fun", None) is not None or \
+                getattr(self, "_exclude_fn", None) is not None:
             raise NotImplementedError(
-                "apply_decay_param_fun is an eager-path feature; "
-                "apply_gradients_fn applies the scalar weight_decay to "
-                "every leaf — mark exclusions via param.no_weight_decay "
-                "(honored by the fused path) or use separate optimizers")
+                "per-parameter weight-decay exclusions "
+                "(apply_decay_param_fun / exclude_from_weight_decay_fn) "
+                "are eager-step features; apply_gradients_fn applies the "
+                "scalar weight_decay to every leaf — use separate "
+                "optimizers (one per decay group) for the functional/jit "
+                "path")
         from ..regularizer import L2Decay, WeightDecayRegularizer
         if isinstance(self._weight_decay, L2Decay):
             wd = self._weight_decay.coeff

@@ -109,6 +109,34 @@ _METHODS = dict(
     # random (in-place)
     uniform_=random.uniform_, normal_=random.normal_,
     exponential_=random.exponential_,
+    # method-surface tail (reference tensor/__init__.py attaches every
+    # name in its tensor list as a Tensor method; x.concat(y) binds self
+    # as the list head the way the reference's monkey-patch does)
+    acos=math.acos, asin=math.asin, atan=math.atan, sinh=math.sinh,
+    cosh=math.cosh, stanh=math.stanh, conj=math.conj, real=math.real,
+    imag=math.imag, floor_mod=math.floor_mod, add_n=math.add_n,
+    addmm=math.addmm, increment=math.increment,
+    rank=manipulation.rank,
+    is_empty=logic.is_empty, is_tensor=logic.is_tensor,
+    bitwise_and=logic.bitwise_and, bitwise_or=logic.bitwise_or,
+    bitwise_xor=logic.bitwise_xor, bitwise_not=logic.bitwise_not,
+    broadcast_shape=math.broadcast_shape,
+    mv=linalg.mv, index_sample=manipulation.index_sample,
+    scatter_=manipulation.scatter_, scatter_nd=manipulation.scatter_nd,
+    scatter_nd_add=manipulation.scatter_nd_add,
+    shard_index=manipulation.shard_index, reverse=manipulation.reverse,
+    strided_slice=manipulation.strided_slice,
+    squeeze_=manipulation.squeeze_, unsqueeze_=manipulation.unsqueeze_,
+    tanh_=math.tanh_, unstack=manipulation.unstack,
+    concat=lambda x, others, axis=0: manipulation.concat(
+        [x] + (list(others) if isinstance(others, (list, tuple))
+               else [others]), axis),
+    stack=lambda x, others, axis=0: manipulation.stack(
+        [x] + (list(others) if isinstance(others, (list, tuple))
+               else [others]), axis),
+    broadcast_tensors=lambda x, others: manipulation.broadcast_tensors(
+        [x] + (list(others) if isinstance(others, (list, tuple))
+               else [others])),
 )
 
 

@@ -67,7 +67,7 @@ class UCIHousing(Dataset):
 
 
 class WMT14(_SyntheticSeq):
-    def __init__(self, data_file=None, mode="train", dict_size=30000,
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
                  download=True):
         if dict_size == -1:  # reference sentinel: full dictionary
             dict_size = 30000
@@ -75,8 +75,8 @@ class WMT14(_SyntheticSeq):
 
 
 class WMT16(_SyntheticSeq):
-    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
-                 trg_dict_size=30000, lang="en", download=True):
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
         # reference signature (text/datasets/wmt16.py); the synthetic
         # corpus honors the separate source/target vocab sizes; -1 is the
         # reference's use-the-full-dict sentinel

@@ -217,3 +217,30 @@ def test_lr_ratio_raises_on_functional_path():
                                lr_ratio=lambda p: 0.5)
     with pytest.raises(NotImplementedError):
         o.apply_gradients_fn()
+
+
+def test_tensor_method_surface_snapshot():
+    """Every name in the reference tensor/__init__.py method list exists
+    as a Tensor method (snapshot of the 154-name list's audit tail)."""
+    import numpy as np
+    for n in ("acos add_n addmm asin atan bitwise_and bitwise_not "
+              "bitwise_or bitwise_xor broadcast_shape broadcast_tensors "
+              "concat conj cosh floor_mod imag increment index_sample "
+              "is_empty is_tensor mv rank real reverse scatter_ "
+              "scatter_nd scatter_nd_add shard_index sinh squeeze_ stack "
+              "stanh strided_slice tanh_ unsqueeze_ unstack").split():
+        assert hasattr(paddle.Tensor, n), n
+    t = paddle.to_tensor(np.array([[1.0, 2.0]], np.float32))
+    np.testing.assert_allclose(
+        np.asarray(t.concat(t, axis=0).data), [[1, 2], [1, 2]])
+    assert int(t.rank().item()) == 2
+
+
+def test_lamb_exclusion_raises_on_functional_path():
+    m = paddle.nn.Linear(2, 1)
+    o = paddle.optimizer.Lamb(parameters=m.parameters(),
+                              exclude_from_weight_decay_fn=lambda p: True)
+    with pytest.raises(NotImplementedError):
+        o.apply_gradients_fn()
+    paddle.optimizer.Lamb(
+        parameters=m.parameters()).apply_gradients_fn()  # plain ok
