@@ -181,6 +181,27 @@ class DistributedStrategy:
         self.qat = False
         self.auto = False
         self.semi_auto = False
+        # ParallelExecutor-era knobs (BuildStrategy/ExecutionStrategy
+        # messages + hierarchical-allreduce ring tuning): accepted for
+        # config-surface parity; XLA owns graph build and scheduling on
+        # TPU, and ICI collectives need no ring hierarchy
+        self.build_strategy = None
+        self.execution_strategy = None
+        self.elastic = False
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 0
+        self.fuse_grad_size_in_num = 8
+        self._calc_comm_same_stream = False
+
+    @property
+    def _fuse_grad_size_in_TFLOPS(self):
+        # the reference exposes this private-named property over the same
+        # proto field as the public name — alias, not a second copy
+        return self.fuse_grad_size_in_TFLOPS
+
+    @_fuse_grad_size_in_TFLOPS.setter
+    def _fuse_grad_size_in_TFLOPS(self, v):
+        self.fuse_grad_size_in_TFLOPS = v
 
     def _config_dict(self, obj, value: Dict[str, Any]):
         for k, v in value.items():

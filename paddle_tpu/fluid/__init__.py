@@ -1,0 +1,91 @@
+"""paddle.fluid compat layer (curated).
+
+Reference: python/paddle/fluid/ — the 1.x-era API that the 2.x snapshot
+still exports publicly and that a large body of ported user code imports
+directly. This is NOT a re-implementation of fluid's Program machinery
+(jit/tracing absorbed it — docs/ARCHITECTURE.md L2): it maps the
+most-used fluid entry points onto their modern equivalents with the
+LEGACY signatures (fc's num_flatten_dims/act, embedding's size pair,
+*Optimizer classes taking parameter_list, dygraph.guard/to_variable),
+so reference-era scripts run unmodified where the semantics carry over.
+"""
+from __future__ import annotations
+
+from .. import (CPUPlace, CUDAPinnedPlace, CUDAPlace, ParamAttr,  # noqa: F401
+                Tensor)
+from ..core.tensor import no_grad  # noqa: F401
+from ..framework_io import load, save  # noqa: F401
+from ..static import (CompiledProgram, Executor, Program,  # noqa: F401
+                      Scope, default_main_program, default_startup_program,
+                      global_scope, name_scope, program_guard, scope_guard)
+from .. import nn as _nn
+from .. import optimizer as _opt  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import layers  # noqa: F401
+
+# fluid.io: the reader/DataLoader surface
+from .. import io  # noqa: F401
+
+core = __import__("paddle_tpu.static", fromlist=["static"])  # Scope etc.
+
+
+def in_dygraph_mode():
+    """fluid.framework.in_dygraph_mode: this build is always imperative
+    (tracing happens inside jit), matching dygraph-mode semantics."""
+    return True
+
+
+# ---- fluid.initializer (legacy names over nn.initializer) ----
+class initializer:
+    from ..nn.initializer import (Assign, Bilinear, Constant,  # noqa: F401
+                                  Normal, TruncatedNormal, Uniform)
+    from ..nn.initializer import KaimingNormal as MSRA  # noqa: F401
+    from ..nn.initializer import XavierNormal as Xavier  # noqa: F401
+    ConstantInitializer = Constant
+    NormalInitializer = Normal
+    UniformInitializer = Uniform
+    XavierInitializer = Xavier
+    MSRAInitializer = MSRA
+    BilinearInitializer = Bilinear
+
+
+# ---- fluid.regularizer (legacy names) ----
+class regularizer:
+    from ..regularizer import L1Decay, L2Decay  # noqa: F401
+    L1DecayRegularizer = L1Decay
+    L2DecayRegularizer = L2Decay
+
+
+def _legacy_optimizer(cls):
+    """fluid optimizers take parameter_list= where 2.x takes parameters=."""
+
+    class _Legacy(cls):
+        def __init__(self, *args, parameter_list=None, regularization=None,
+                     **kwargs):
+            if parameter_list is not None:
+                kwargs.setdefault("parameters", parameter_list)
+            if regularization is not None:
+                kwargs.setdefault("weight_decay", regularization)
+            super().__init__(*args, **kwargs)
+
+    _Legacy.__name__ = cls.__name__ + "Optimizer"
+    return _Legacy
+
+
+class optimizer:
+    SGDOptimizer = _legacy_optimizer(_opt.SGD)
+    MomentumOptimizer = _legacy_optimizer(_opt.Momentum)
+    AdagradOptimizer = _legacy_optimizer(_opt.Adagrad)
+    AdamOptimizer = _legacy_optimizer(_opt.Adam)
+    AdamaxOptimizer = _legacy_optimizer(_opt.Adamax)
+    AdadeltaOptimizer = _legacy_optimizer(_opt.Adadelta)
+    RMSPropOptimizer = _legacy_optimizer(_opt.RMSProp)
+    FtrlOptimizer = _legacy_optimizer(_opt.Ftrl)
+    LambOptimizer = _legacy_optimizer(_opt.Lamb)
+    DecayedAdagradOptimizer = _legacy_optimizer(_opt.DecayedAdagrad)
+    DpsgdOptimizer = _legacy_optimizer(_opt.Dpsgd)
+    LarsMomentumOptimizer = _legacy_optimizer(_opt.LarsMomentum)
+    from ..incubate.optimizer import (LookAhead as  # noqa: F401
+                                      LookaheadOptimizer)
+    from ..incubate.optimizer import (ModelAverage as  # noqa: F401
+                                      ModelAverage)

@@ -1,0 +1,32 @@
+"""fluid.dygraph compat: guard/to_variable/Layer over the eager core
+(reference python/paddle/fluid/dygraph/ — the imperative mode that is
+this build's native execution model, so guard() is a no-op context)."""
+from __future__ import annotations
+
+import contextlib
+
+from ..core.tensor import Tensor, no_grad  # noqa: F401
+from ..nn.layer.layers import Layer  # noqa: F401
+from ..tensor.creation import to_tensor
+from ..distributed.data_parallel import DataParallel  # noqa: F401
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """fluid.dygraph.guard: eager mode is the only mode here."""
+    yield
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    t = to_tensor(value, dtype=dtype)
+    return t
+
+
+def enabled():
+    return True
+
+
+# legacy sublayer aliases used by fluid-era model zoos
+from ..nn import (BatchNorm1D, Conv2D, Embedding, LayerNorm,  # noqa: F401
+                  Linear)
+from ..nn import BatchNorm  # noqa: F401

@@ -1,0 +1,213 @@
+"""fluid.layers compat: the most-used 1.x functional surface with LEGACY
+signatures, mapped onto the modern ops (reference
+python/paddle/fluid/layers/{nn,tensor,ops,control_flow}.py). Semantics
+notes: fc flattens trailing dims per num_flatten_dims and applies act;
+embedding takes size=[vocab, dim]; cross_entropy takes probabilities
+(soft or index label) like the fluid op, NOT logits; data() returns an
+InputSpec-like placeholder for to_static use."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn as _nn
+from ..nn import functional as F
+from ..static import InputSpec, create_parameter  # noqa: F401
+from ..tensor.creation import _t, to_tensor
+
+# direct re-exports where the legacy name/signature already matches
+from ..tensor import (abs, cast, clip, concat, cos, exp,  # noqa: F401
+                      log, reshape, scale, sigmoid, sin, sqrt, square,
+                      stack, tanh, transpose, unsqueeze, where)
+from ..nn.functional import (dropout, log_softmax, relu,  # noqa: F401
+                             softmax)
+from ..tensor import all as reduce_all  # noqa: F401
+from ..tensor import any as reduce_any  # noqa: F401
+from ..incubate.contrib_ops import fsp_matrix  # noqa: F401
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """fluid.layers.data: a typed placeholder (InputSpec) for to_static;
+    append_batch_size semantics folded into shape (-1 leading dim)."""
+    return InputSpec(shape=[-1] + list(shape), dtype=dtype, name=name)
+
+
+def fill_constant(shape, dtype, value, name=None, out=None):
+    import jax.numpy as jnp
+    from ..core.dtype import convert_dtype
+    t = to_tensor(np.full(tuple(int(s) for s in shape), value,
+                          convert_dtype(dtype)))
+    if out is not None:
+        out.set_value(t)
+        return out
+    return t
+
+
+def assign(input, output=None):
+    t = _t(input) if not isinstance(input, np.ndarray) else to_tensor(input)
+    if output is not None:
+        output.set_value(t)
+        return output
+    from ..tensor.creation import to_tensor as _tt
+    return _tt(np.asarray(t.data))
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """fluid.layers.fc: creates (or reuses via param_attr.name) the weight
+    on the fly the way the fluid op did — here a fresh parameter per call
+    (fluid-era scripts build the layer once inside a Layer/guard)."""
+    x = _t(input)
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    lin = _nn.Linear(in_dim, size, weight_attr=param_attr,
+                     bias_attr=bias_attr)
+    flat = x.reshape(list(x.shape[:num_flatten_dims]) + [in_dim])
+    out = lin(flat)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    emb = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                        sparse=is_sparse, weight_attr=param_attr)
+    return emb(_t(input))
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    """fluid cross_entropy op: input is a PROBABILITY distribution."""
+    return F.cross_entropy(input, label, soft_label=soft_label,
+                           ignore_index=ignore_index, use_softmax=False,
+                           reduction="none")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
+                               ignore_index=-100, return_softmax=False):
+    loss = F.cross_entropy(logits, label, soft_label=soft_label, axis=axis,
+                           ignore_index=ignore_index, reduction="none")
+    loss = loss.unsqueeze(-1)
+    if return_softmax:
+        return loss, F.softmax(_t(logits), axis=axis)
+    return loss
+
+
+def mean(x, name=None):
+    return _t(x).mean()
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _t(input).mean(axis=dim, keepdim=keep_dim)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _t(input).sum(axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _t(input).max(axis=dim, keepdim=keep_dim)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _ew(x, y, "add", axis, act)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _ew(x, y, "subtract", axis, act)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _ew(x, y, "multiply", axis, act)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _ew(x, y, "divide", axis, act)
+
+
+def _ew(x, y, op, axis, act):
+    """fluid elementwise axis semantics: y broadcasts starting at `axis`
+    of x (trailing dims aligned when axis=-1, the numpy default)."""
+    from .. import tensor as T
+    xt, yt = _t(x), _t(y)
+    if axis != -1 and yt.data.ndim < xt.data.ndim:
+        pad = xt.data.ndim - axis - yt.data.ndim
+        yt = yt.reshape(list(yt.shape) + [1] * pad)
+    out = getattr(T, op)(xt, yt)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           name=None):
+    from ..tensor.linalg import matmul as _mm
+    out = _mm(x, y, transpose_x, transpose_y)
+    if alpha != 1.0:
+        out = out * alpha
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None, use_cudnn=True):
+    x = _t(input)
+    conv = _nn.Conv2D(x.shape[1], num_filters, filter_size, stride, padding,
+                      dilation, groups, weight_attr=param_attr,
+                      bias_attr=bias_attr, data_format=data_format)
+    out = conv(x)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, data_format="NCHW", name=None, use_cudnn=True):
+    x = _t(input)
+    if global_pooling:
+        pool_size = x.shape[2:]
+        pool_padding = 0
+    if pool_type == "max":
+        return F.max_pool2d(x, pool_size, pool_stride, pool_padding,
+                            ceil_mode=ceil_mode, data_format=data_format)
+    return F.avg_pool2d(x, pool_size, pool_stride, pool_padding,
+                        ceil_mode=ceil_mode, exclusive=exclusive,
+                        data_format=data_format)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, name=None, **kw):
+    x = _t(input)
+    bn = _nn.BatchNorm2D(x.shape[1], momentum=momentum, epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr,
+                         data_format=data_layout)
+    if is_test:
+        bn.eval()
+    out = bn(x)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    return F.one_hot(_t(input), depth)
+
+
+def topk(input, k, name=None):
+    from ..tensor.search import topk as _topk
+    return _topk(_t(input), k)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """fluid.layers.lstm_unit: one LSTM step (lstm_unit_op.cc). Weights
+    are created per call like the fluid op's auto-created parameters."""
+    h_in = int(hidden_t_prev.shape[-1])
+    cell = _nn.LSTMCell(int(x_t.shape[-1]), h_in)
+    h, (h2, c2) = cell(_t(x_t), (_t(hidden_t_prev), _t(cell_t_prev)))
+    return h2, c2

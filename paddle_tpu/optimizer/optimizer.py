@@ -220,27 +220,32 @@ class Optimizer:
     def apply_gradients_fn(self):
         """Returns pure fn(params, grads, state, lr, step) -> (params, state).
 
-        All leaves are jax arrays; safe to jit/pjit. Weight decay uses the
-        optimizer's scalar setting for every param (per-param exclusions
-        and AdamW's lr_ratio are eager-path features — a set lr_ratio
-        raises here rather than silently training at uniform lr).
+        All leaves are jax arrays; safe to jit/pjit. Per-param knobs
+        (AdamW's apply_decay_param_fun/lr_ratio, Lamb's
+        exclude_from_weight_decay_fn) are honored per leaf: the params
+        dict is name-keyed, so the user fn is called at trace time with
+        the name (apply_decay_param_fun) or a name-carrying proxy
+        (exclude/lr_ratio fns, which receive a param in eager mode — a
+        fn reading attributes beyond .name fails loudly here).
         """
-        if getattr(self, "_lr_ratio", None) is not None:
-            raise NotImplementedError(
-                "lr_ratio is applied on the eager step() path only; the "
-                "functional apply_gradients_fn uses one lr for the whole "
-                "pytree — for the jit path, split the model across "
-                "several optimizers (one per lr tier), each with its own "
-                "apply fn")
-        if getattr(self, "_apply_decay_param_fun", None) is not None or \
-                getattr(self, "_exclude_fn", None) is not None:
-            raise NotImplementedError(
-                "per-parameter weight-decay exclusions "
-                "(apply_decay_param_fun / exclude_from_weight_decay_fn) "
-                "are eager-step features; apply_gradients_fn applies the "
-                "scalar weight_decay to every leaf — use separate "
-                "optimizers (one per decay group) for the functional/jit "
-                "path")
+        import types
+
+        decay_fun = getattr(self, "_apply_decay_param_fun", None)
+        exclude_fn = getattr(self, "_exclude_fn", None)
+        lr_ratio = getattr(self, "_lr_ratio", None)
+
+        def _leaf_wd(k, wd):
+            if decay_fun is not None and not decay_fun(k):
+                return 0.0
+            if exclude_fn is not None and \
+                    exclude_fn(types.SimpleNamespace(name=k)):
+                return 0.0
+            return wd
+
+        def _leaf_lr(k, lr):
+            if lr_ratio is None:
+                return lr
+            return lr * float(lr_ratio(types.SimpleNamespace(name=k)))
         from ..regularizer import L2Decay, WeightDecayRegularizer
         if isinstance(self._weight_decay, L2Decay):
             wd = self._weight_decay.coeff
@@ -268,7 +273,7 @@ class Optimizer:
                     ctx_slots["_norm_axes"] = axes
                     ctx_slots["_norm_batch_dims"] = bd
                 np_, ns_ = self._rule_mp(self._reg_grad(g, p), p, ctx_slots,
-                                         lr, wd)
+                                         _leaf_lr(k, lr), _leaf_wd(k, wd))
                 for extra in ("_step", "_norm_axes", "_norm_batch_dims"):
                     ns_.pop(extra, None)
                 new_params[k] = np_

@@ -209,14 +209,23 @@ def test_misc_constructor_orders_batch2():
     assert net.fc.weight.shape[1] == 7
 
 
-def test_lr_ratio_raises_on_functional_path():
-    """lr_ratio is eager-only: the jit-path apply_gradients_fn must fail
-    loudly instead of silently training at uniform lr."""
+def test_lr_ratio_honored_on_functional_path():
+    """The functional path honors lr_ratio per leaf (params are
+    name-keyed; the fn receives a name-carrying proxy)."""
+    import jax.numpy as jnp
+    import numpy as np
     m = paddle.nn.Linear(2, 1)
-    o = paddle.optimizer.AdamW(parameters=m.parameters(),
-                               lr_ratio=lambda p: 0.5)
-    with pytest.raises(NotImplementedError):
-        o.apply_gradients_fn()
+    o = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.0,
+                               parameters=m.parameters(),
+                               lr_ratio=lambda p: 0.0)
+    apply_fn = o.apply_gradients_fn()
+    params, _ = m.functional_state()
+    st = o.init_state(params)
+    grads = {k: jnp.ones_like(jnp.asarray(v)) for k, v in params.items()}
+    new_p, _ = apply_fn(params, grads, st, 0.1, 1)
+    for k in params:  # zero ratio -> no movement
+        np.testing.assert_allclose(np.asarray(new_p[k]),
+                                   np.asarray(params[k]), atol=1e-8)
 
 
 def test_tensor_method_surface_snapshot():
@@ -236,11 +245,20 @@ def test_tensor_method_surface_snapshot():
     assert int(t.rank().item()) == 2
 
 
-def test_lamb_exclusion_raises_on_functional_path():
+def test_lamb_exclusion_honored_on_functional_path():
+    """fleet-compiled Lamb with exclude_from_weight_decay trains through
+    apply_gradients_fn with wd zeroed for excluded leaves."""
+    import jax.numpy as jnp
+    import numpy as np
     m = paddle.nn.Linear(2, 1)
-    o = paddle.optimizer.Lamb(parameters=m.parameters(),
+    o = paddle.optimizer.Lamb(learning_rate=0.0, lamb_weight_decay=0.9,
+                              parameters=m.parameters(),
                               exclude_from_weight_decay_fn=lambda p: True)
-    with pytest.raises(NotImplementedError):
-        o.apply_gradients_fn()
-    paddle.optimizer.Lamb(
-        parameters=m.parameters()).apply_gradients_fn()  # plain ok
+    apply_fn = o.apply_gradients_fn()
+    params, _ = m.functional_state()
+    st = o.init_state(params)
+    grads = {k: jnp.zeros_like(jnp.asarray(v)) for k, v in params.items()}
+    new_p, _ = apply_fn(params, grads, st, 0.0, 1)
+    for k in params:  # all excluded + zero lr/grads -> unchanged
+        np.testing.assert_allclose(np.asarray(new_p[k]),
+                                   np.asarray(params[k]), atol=1e-8)

@@ -1,0 +1,101 @@
+"""paddle.fluid compat layer: a fluid-era dygraph training script runs
+unmodified (reference python/paddle/fluid surface — guard/to_variable,
+layers.fc/conv2d/pool2d/cross_entropy with legacy signatures,
+*Optimizer classes with parameter_list, legacy initializer/regularizer
+names)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def test_fluid_dygraph_training_script():
+    """The canonical fluid-era mnist-style loop, verbatim idioms."""
+    rng = np.random.RandomState(0)
+
+    class Net(fluid.dygraph.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = fluid.dygraph.Conv2D(1, 4, 3, padding=1)
+            self.fc = fluid.dygraph.Linear(4 * 4 * 4, 10)
+
+        def forward(self, x):
+            h = fluid.layers.relu(self.conv(x))
+            h = fluid.layers.pool2d(h, 2, "max", 2)
+            h = fluid.layers.reshape(h, [h.shape[0], -1])
+            return self.fc(h)
+
+    with fluid.dygraph.guard():
+        net = Net()
+        opt = fluid.optimizer.AdamOptimizer(
+            learning_rate=1e-3, parameter_list=net.parameters())
+        losses = []
+        x = fluid.dygraph.to_variable(
+            rng.randn(8, 1, 8, 8).astype(np.float32))
+        y = fluid.dygraph.to_variable(rng.randint(0, 10, (8,)))
+        for _ in range(5):
+            logits = net(x)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y.unsqueeze(-1)))
+            loss.backward()
+            opt.minimize(loss)
+            net.clear_gradients()
+            losses.append(float(loss.item()))
+        assert losses[-1] < losses[0]
+
+
+def test_fluid_layers_legacy_signatures():
+    rng = np.random.RandomState(1)
+    x = fluid.dygraph.to_variable(rng.randn(2, 3, 5).astype(np.float32))
+    # fc flattens trailing dims per num_flatten_dims
+    out = fluid.layers.fc(x, 4, num_flatten_dims=1)
+    assert np.asarray(out.data).shape == (2, 4)
+    out2 = fluid.layers.fc(x, 4, num_flatten_dims=2)
+    assert np.asarray(out2.data).shape == (2, 3, 4)
+    # embedding with size pair
+    ids = fluid.dygraph.to_variable(np.array([[0, 2], [1, 3]]))
+    emb = fluid.layers.embedding(ids, size=[10, 6])
+    assert np.asarray(emb.data).shape == (2, 2, 6)
+    # fill_constant / assign / cast
+    c = fluid.layers.fill_constant([2, 2], "float32", 3.0)
+    assert float(c.sum().item()) == 12.0
+    d = fluid.layers.cast(c, "int32")
+    assert str(d.dtype) == "int32"
+    # elementwise axis broadcast
+    e = fluid.layers.elementwise_mul(
+        fluid.dygraph.to_variable(np.ones((2, 3, 4), np.float32)),
+        fluid.dygraph.to_variable(np.full(3, 2.0, np.float32)), axis=1)
+    assert float(e.sum().item()) == 48.0
+    # cross_entropy over PROBABILITIES (the fluid op contract)
+    probs = fluid.dygraph.to_variable(
+        np.array([[0.7, 0.3], [0.2, 0.8]], np.float32))
+    lbl = fluid.dygraph.to_variable(np.array([0, 1]))
+    ce = np.asarray(fluid.layers.cross_entropy(probs, lbl).data)
+    np.testing.assert_allclose(ce, -np.log([0.7, 0.8]), atol=1e-5)
+
+
+def test_fluid_optimizer_and_attr_names():
+    net = fluid.dygraph.Linear(4, 2)
+    opt = fluid.optimizer.MomentumOptimizer(
+        learning_rate=0.1, momentum=0.9, parameter_list=net.parameters(),
+        regularization=fluid.regularizer.L2DecayRegularizer(1e-4))
+    x = fluid.dygraph.to_variable(np.ones((2, 4), np.float32))
+    loss = fluid.layers.mean(net(x))
+    loss.backward()
+    opt.minimize(loss)
+    w = fluid.layers.create_parameter(
+        [3, 3], "float32",
+        default_initializer=fluid.initializer.MSRA())
+    assert np.asarray(w.data).std() > 0
+    assert fluid.in_dygraph_mode()
+
+
+def test_fluid_static_facade_roundtrip(tmp_path):
+    prog = fluid.Program()
+    assert fluid.default_main_program() is not None
+    with fluid.program_guard(prog):
+        pass
+    exe = fluid.Executor()
+    spec = fluid.layers.data("x", [4], "float32")
+    assert list(spec.shape) == [-1, 4]
