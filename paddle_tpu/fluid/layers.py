@@ -211,3 +211,41 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
     cell = _nn.LSTMCell(int(x_t.shape[-1]), h_in)
     h, (h2, c2) = cell(_t(x_t), (_t(hidden_t_prev), _t(cell_t_prev)))
     return h2, c2
+
+
+# ---- 1:1 alias tail: reference fluid.layers names whose modern
+# implementations keep the same name/semantics (tensor + functional
+# namespaces). Generated from the fluid.layers public-surface audit. ----
+def _install_aliases():
+    import sys
+
+    from .. import tensor as _T
+    mod = sys.modules[__name__]
+    for _n in ("argmax argmin argsort array_length array_read array_write "
+               "check_shape clip_by_norm cond create_array crop cumsum "
+               "diag equal erf expand expand_as eye flatten gather "
+               "gather_nd greater_equal greater_than increment is_empty "
+               "isfinite less_equal less_than linspace logical_and "
+               "logical_not logical_or logical_xor multiplex not_equal "
+               "ones ones_like pad pow rank reverse scatter scatter_nd "
+               "scatter_nd_add sequence_expand sequence_mask sequence_pad "
+               "sequence_unpad shape shard_index sign slice split squeeze "
+               "stanh strided_slice sum triu unbind unique unstack zeros "
+               "zeros_like").split():
+        if not hasattr(mod, _n):
+            import paddle_tpu as _root
+            setattr(mod, _n, getattr(_root, _n))
+    for _n in ("add_position_encoding affine_grid bpr_loss center_loss "
+               "conv2d_transpose conv3d conv3d_transpose crf_decoding "
+               "dice_loss edit_distance elu gather_tree gelu group_norm "
+               "huber_loss instance_norm label_smooth layer_norm "
+               "leaky_relu linear_chain_crf log_loss maxout mish mse_loss "
+               "npair_loss pixel_shuffle prelu relu6 selu "
+               "sigmoid_focal_loss softshrink square_error_cost swish "
+               "temporal_shift thresholded_relu unfold").split():
+        if not hasattr(mod, _n):
+            setattr(mod, _n, getattr(F, _n))
+
+
+_install_aliases()
+del _install_aliases
