@@ -219,7 +219,7 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
 def _install_aliases():
     import sys
 
-    from .. import tensor as _T
+    import paddle_tpu as _root
     mod = sys.modules[__name__]
     for _n in ("argmax argmin argsort array_length array_read array_write "
                "check_shape clip_by_norm cond create_array crop cumsum "
@@ -233,7 +233,6 @@ def _install_aliases():
                "stanh strided_slice sum triu unbind unique unstack zeros "
                "zeros_like").split():
         if not hasattr(mod, _n):
-            import paddle_tpu as _root
             setattr(mod, _n, getattr(_root, _n))
     for _n in ("add_position_encoding affine_grid bpr_loss center_loss "
                "conv2d_transpose conv3d conv3d_transpose crf_decoding "
@@ -249,3 +248,252 @@ def _install_aliases():
 
 _install_aliases()
 del _install_aliases
+
+
+# ---- renamed-equivalent tail: fluid names whose modern implementation
+# lives under a different name (legacy signature kept where it differs) ----
+
+def _fluid_axis_src(out_size, in_size, align_corners, align_mode):
+    """fluid interp source-index rule per axis: align_corners uses the
+    corner ratio; else align_mode=1 is the asymmetric src = i*scale rule
+    (the fluid default), align_mode=0 the half-pixel rule."""
+    import jax.numpy as jnp
+    i = jnp.arange(out_size, dtype=jnp.float32)
+    if align_corners and out_size > 1:
+        return i * (in_size - 1) / (out_size - 1)
+    if align_mode == 1:
+        return i * (in_size / out_size)
+    return jnp.clip((i + 0.5) * (in_size / out_size) - 0.5, 0, None)
+
+
+def _fluid_resize(input, out_shape, scale, align_corners, align_mode,
+                  nearest=False):
+    import jax.numpy as jnp
+    from ..core.tensor import apply
+    x = _t(input)
+    in_h, in_w = x.shape[2], x.shape[3]
+    if out_shape is None:
+        out_shape = [int(in_h * scale), int(in_w * scale)]
+    oh, ow = int(out_shape[0]), int(out_shape[1])
+
+    def f(a):
+        out = a
+        for ax, (o, n) in zip((2, 3), ((oh, in_h), (ow, in_w))):
+            src = _fluid_axis_src(o, n, align_corners, align_mode)
+            if nearest:
+                # fluid nearest with align_corners rounds the corner ratio;
+                # without it floors the asymmetric index
+                idx = (jnp.round(src) if align_corners
+                       else jnp.floor(src)).astype(jnp.int32)
+                out = jnp.take(out, jnp.clip(idx, 0, n - 1), axis=ax)
+            else:
+                lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, n - 1)
+                hi = jnp.minimum(lo + 1, n - 1)
+                w = (src - lo).astype(out.dtype)
+                shape = [1] * out.ndim
+                shape[ax] = o
+                w = w.reshape(shape)
+                out = (jnp.take(out, lo, axis=ax) * (1 - w)
+                       + jnp.take(out, hi, axis=ax) * w)
+        return out
+
+    return apply(f, x)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, align_corners=True,
+                    align_mode=1, data_format="NCHW", name=None):
+    return _fluid_resize(input, out_shape, scale, align_corners, align_mode)
+
+
+def resize_nearest(input, out_shape=None, scale=None, align_corners=True,
+                   data_format="NCHW", name=None):
+    return _fluid_resize(input, out_shape, scale, align_corners, 1,
+                         nearest=True)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, align_corners=True,
+                     align_mode=1, data_format="NCDHW", name=None):
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode="trilinear", align_corners=align_corners,
+                         data_format=data_format)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   name=None):
+    from ..tensor.random import uniform
+    return uniform(shape, dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    if seed:
+        # fluid contract: a nonzero seed reproduces the draw exactly
+        from ..core.dtype import convert_dtype
+        rng = np.random.RandomState(seed)
+        return to_tensor((rng.randn(*[int(s) for s in shape]) * std
+                          + mean).astype(convert_dtype(dtype)))
+    from ..tensor.random import normal
+    return normal(mean, std, shape).astype(dtype)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    # NB fluid default slope is 0.2 (hard_sigmoid_op), 2.x uses 1/6
+    return F.hardsigmoid(x, slope=slope, offset=offset)
+
+
+def log_sigmoid(x, name=None):
+    return F.log_sigmoid(x)
+
+
+def logsigmoid(x, name=None):
+    return F.log_sigmoid(x)
+
+
+def l2_normalize(x, axis=-1, epsilon=1e-12, name=None):
+    return F.normalize(x, p=2, axis=axis, epsilon=epsilon)
+
+
+def cos_sim(X, Y):
+    out = F.cosine_similarity(X, Y, axis=1)
+    return out.unsqueeze(-1)
+
+
+def relu_(x):
+    from ..tensor.manipulation import _inplace_via_tape
+    t = _t(x)
+    return _inplace_via_tape(t, F.relu(t), "relu_")
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    import jax.numpy as jnp
+    from ..core.tensor import apply
+    return apply(lambda a: jnp.log1p(jnp.exp(jnp.clip(a, -threshold,
+                                                      threshold))), _t(x))
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    import jax.numpy as jnp
+    from ..core.tensor import apply
+    # hard_swish_op: x * min(max(x + offset, 0), threshold) / scale
+    return apply(lambda a: a * jnp.clip(a + offset, 0.0, threshold) / scale,
+                 _t(x))
+
+
+def grid_sampler(x, grid, name=None):
+    return F.grid_sample(x, grid, align_corners=True)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    """smooth_l1_loss_op (fluid flavor): diff scales by inside_weight,
+    threshold is 1/sigma^2, per-element loss scales by outside_weight,
+    summed over trailing dims to [N, 1]."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply
+    sigma2 = float(sigma or 1.0) ** 2
+
+    def f(xa, ya, *w):
+        iw = w[0] if len(w) > 0 else None
+        ow = w[1] if len(w) > 1 else None
+        d = xa - ya
+        if iw is not None:
+            d = d * iw
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < 1.0 / sigma2, 0.5 * d * d * sigma2,
+                         ad - 0.5 / sigma2)
+        if ow is not None:
+            loss = loss * ow
+        return loss.reshape(loss.shape[0], -1).sum(
+            axis=1, keepdims=True)
+
+    args = [_t(x), _t(y)]
+    if inside_weight is not None:
+        args.append(_t(inside_weight))
+        if outside_weight is not None:
+            args.append(_t(outside_weight))
+    elif outside_weight is not None:
+        # keep positional contract: inside defaults to ones
+        import numpy as _np
+        args.append(to_tensor(_np.ones(1, _np.float32)))
+        args.append(_t(outside_weight))
+    return apply(f, *args)
+
+
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
+                 align_corners=True, align_mode=1, data_format="NCHW",
+                 name=None):
+    mode = {"BILINEAR": "bilinear", "NEAREST": "nearest",
+            "TRILINEAR": "trilinear", "BICUBIC": "bicubic"}[resample]
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode=mode, align_corners=align_corners,
+                         data_format=data_format)
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    # fluid pad2d order is [top, bottom, left, right] (pad2d_op); the 2.x
+    # F.pad 4-list is [left, right, top, bottom]
+    t, b, l, r = paddings
+    return F.pad(input, [l, r, t, b],
+                 mode={"constant": "constant", "reflect": "reflect",
+                       "edge": "replicate"}[mode],
+                 value=pad_value, data_format=data_format)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    # fluid lrn_op scales the window SUM by alpha (the 2.x api scales the
+    # mean): feed alpha*n so the modern mean-based kernel reproduces it
+    return F.local_response_norm(input, size=n, alpha=alpha * n, beta=beta,
+                                 k=k, data_format=data_format)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    from ..vision.ops import yolo_box as _yb
+    return _yb(x, img_size, anchors, class_num, conf_thresh,
+               downsample_ratio, clip_bbox, scale_x_y=scale_x_y)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None, scale_x_y=1.0):
+    from ..vision.ops import yolo_loss as _yl
+    return _yl(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+               ignore_thresh, downsample_ratio, gt_score=gt_score,
+               use_label_smooth=use_label_smooth, scale_x_y=scale_x_y)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              name=None, min_max_aspect_ratios_order=False):
+    from ..vision.ops import prior_box as _pb
+    return _pb(input, image, min_sizes, max_sizes, aspect_ratios, variance,
+               flip, clip, steps, offset,
+               min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    from ..vision.ops import density_prior_box as _dpb
+    return _dpb(input, image, densities, fixed_sizes, fixed_ratios,
+                variance, clip, steps, offset, flatten_to_2d)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    from ..vision.ops import box_coder as _bc
+    return _bc(prior_box, prior_box_var, target_box, code_type,
+               box_normalized, axis=axis)
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    from ..vision.ops import multiclass_nms as _nms
+    out, num = _nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold, normalized, nms_eta, background_label)
+    return out

@@ -99,3 +99,59 @@ def test_fluid_static_facade_roundtrip(tmp_path):
     exe = fluid.Executor()
     spec = fluid.layers.data("x", [4], "float32")
     assert list(spec.shape) == [-1, 4]
+
+
+def test_fluid_renamed_equivalents():
+    """fluid names mapped onto renamed modern ops keep the FLUID
+    conventions (lrn's sum-scaled alpha, hard_sigmoid's 0.2 slope,
+    resize_* wrappers)."""
+    rng = np.random.RandomState(2)
+    x = fluid.dygraph.to_variable(rng.randn(1, 6, 3, 3).astype(np.float32))
+    ours = np.asarray(fluid.layers.lrn(x, n=3, alpha=1e-3).data)
+    xl = np.asarray(x.data)
+    sq = np.pad(xl ** 2, ((0, 0), (1, 1), (0, 0), (0, 0)))
+    acc = sq[:, :6] + sq[:, 1:7] + sq[:, 2:8]
+    np.testing.assert_allclose(ours, xl / (1 + 1e-3 * acc) ** 0.75,
+                               atol=1e-5)
+    hs = fluid.layers.hard_sigmoid(
+        fluid.dygraph.to_variable(np.zeros(1, np.float32)))
+    assert abs(float(hs.item()) - 0.5) < 1e-6
+    img = fluid.dygraph.to_variable(rng.randn(1, 2, 4, 4).astype(np.float32))
+    assert np.asarray(fluid.layers.image_resize(
+        img, out_shape=[8, 8], resample="NEAREST").data).shape == \
+        (1, 2, 8, 8)
+    p = fluid.layers.pad2d(img, [1, 1, 2, 2], mode="reflect")
+    assert np.asarray(p.data).shape == (1, 2, 6, 8)
+    assert hasattr(fluid.layers, "yolo_box")
+    assert hasattr(fluid.layers, "multiclass_nms")
+
+
+def test_fluid_interp_and_loss_conventions():
+    """The fluid-specific numeric conventions: align_mode=1 asymmetric
+    resize, nearest corner rounding, seeded gaussian, hard_swish params,
+    smooth_l1 sigma/weights, in-place relu_."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 1, 4, 4).astype(np.float32)
+    out = np.asarray(fluid.layers.resize_bilinear(
+        fluid.dygraph.to_variable(x), out_shape=[8, 8],
+        align_corners=False).data)
+    src = np.arange(8) * (4 / 8)
+    lo = np.floor(src).astype(int)
+    hi = np.minimum(lo + 1, 3)
+    w = src - lo
+    tmp = x[0, 0][lo] * (1 - w[:, None]) + x[0, 0][hi] * w[:, None]
+    want = tmp[:, lo] * (1 - w[None, :]) + tmp[:, hi] * w[None, :]
+    np.testing.assert_allclose(out[0, 0], want, atol=1e-5)
+    a = np.asarray(fluid.layers.gaussian_random([4], seed=5).data)
+    b = np.asarray(fluid.layers.gaussian_random([4], seed=5).data)
+    np.testing.assert_array_equal(a, b)
+    xs = fluid.dygraph.to_variable(np.array([[0.1, 2.0]], np.float32))
+    ys = fluid.dygraph.to_variable(np.zeros((1, 2), np.float32))
+    iw = fluid.dygraph.to_variable(np.ones((1, 2), np.float32))
+    ow = fluid.dygraph.to_variable(np.full((1, 2), 2.0, np.float32))
+    sl = float(fluid.layers.smooth_l1(xs, ys, iw, ow, sigma=3.0).item())
+    want_sl = 2 * (0.5 * 0.01 * 9.0) + 2 * (2.0 - 0.5 / 9.0)
+    assert abs(sl - want_sl) < 1e-5
+    t = fluid.dygraph.to_variable(np.array([-1.0, 2.0], np.float32))
+    fluid.layers.relu_(t)
+    np.testing.assert_allclose(np.asarray(t.data), [0.0, 2.0])
