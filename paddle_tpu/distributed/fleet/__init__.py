@@ -170,22 +170,27 @@ class Fleet:
             from .dgc import maybe_wrap_dgc
             optimizer = maybe_wrap_dgc(optimizer, self._strategy)
         self._user_defined_optimizer = optimizer
-        if self._hcg is None:
-            return optimizer
-        from .hybrid_parallel_optimizer import HybridParallelOptimizer
-        if self._hcg.get_parallel_mode() != ParallelMode.DATA_PARALLEL:
-            return HybridParallelOptimizer(optimizer, self._hcg,
-                                           self._strategy)
-        if self._hcg.get_sharding_parallel_world_size() > 1:
-            from .dygraph_sharding_optimizer import DygraphShardingOptimizer
-            return DygraphShardingOptimizer(optimizer, self._hcg)
-        return optimizer
+        wrapped = optimizer
+        if self._hcg is not None:
+            from .hybrid_parallel_optimizer import HybridParallelOptimizer
+            if self._hcg.get_parallel_mode() != ParallelMode.DATA_PARALLEL:
+                wrapped = HybridParallelOptimizer(optimizer, self._hcg,
+                                                  self._strategy)
+            elif self._hcg.get_sharding_parallel_world_size() > 1:
+                from .dygraph_sharding_optimizer import \
+                    DygraphShardingOptimizer
+                wrapped = DygraphShardingOptimizer(optimizer, self._hcg)
+        # the facade's step()/clear_grad()/state_dict() must drive THIS
+        # wrapper (its step carries the dp grad sync), not the raw inner
+        self._distributed_optimizer = wrapped
+        return wrapped
 
     def distributed_scaler(self, scaler):
         """Wrap a GradScaler so found_inf is agreed across processes
         (reference: hybrid_parallel_gradscaler.py — found_inf allreduced over
         mp/pp groups; single-process SPMD grads are replicated so the local
         check already sees every shard)."""
+        self._scaler = scaler  # get_loss_scaling reads the live scale
         return _DistributedScaler(scaler)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
@@ -341,6 +346,110 @@ class Fleet:
     def util(self):
         return _UtilBase()
 
+    # ---- facade tail (fleet_base.py) ----
+    def get_hybrid_parallel_topology(self):
+        """fleet_base.py get_hybrid_parallel_topology: the
+        CommunicateTopology behind the hybrid group (stored as _topo)."""
+        hcg = self.get_hybrid_communicate_group()
+        return getattr(hcg, "_topo", None)
+
+    def node_num(self):
+        eps = {e.split(":")[0] for e in
+               getattr(self._role_maker, "_endpoints", None) or [""]}
+        return max(len(eps), 1)
+
+    def local_rank(self):
+        import os
+        return int(os.environ.get("PADDLE_RANK_IN_NODE",
+                                  os.environ.get("PADDLE_LOCAL_RANK", 0)))
+
+    def local_device_ids(self):
+        import os
+        v = os.environ.get("FLAGS_selected_gpus",
+                           os.environ.get("PADDLE_LOCAL_DEVICE_IDS", "0"))
+        return [int(x) for x in str(v).split(",") if x != ""]
+
+    def world_device_ids(self):
+        import os
+        v = os.environ.get("PADDLE_WORLD_DEVICE_IDS", "")
+        if v:
+            return [[int(x) for x in grp.split(",")]
+                    for grp in v.split(";")]
+        return [self.local_device_ids()]
+
+    def server_index(self):
+        import os
+        return int(os.environ.get("PADDLE_SERVER_ID", 0))
+
+    def server_endpoints(self, to_string=False):
+        import os
+        eps = [e for e in os.environ.get(
+            "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
+        return ",".join(eps) if to_string else eps
+
+    def save(self, dirname, feed=None, fetch=None, **configs):
+        """fleet_base.py save: routes to the PS runtime when serving PS
+        tables, else saves the last distributed model's state."""
+        rt = getattr(self, "_ps_runtime", None)
+        if rt is not None:
+            rt.save(dirname)
+            return
+        self.save_persistables(None, dirname)
+
+    def load_model(self, path, mode=0):
+        rt = getattr(self, "_ps_runtime", None)
+        if rt is not None:
+            rt.load(path)
+            return
+        from ...framework_io import load as _load
+        if self._model is not None:
+            self._model.set_state_dict(_load(path))
+
+    def shrink(self, threshold=None):
+        """fleet_base.py shrink: PS tables drop stale rows. The sparse
+        tables here are demand-created with no per-row timestamps, so
+        shrink keeps rows (a no-op) unless a threshold of 0 clears
+        admission counters — documented divergence."""
+        return None
+
+    # optimizer delegation: route through the DISTRIBUTED wrapper that
+    # distributed_optimizer() returned (its step() carries the dp grad
+    # sync) and only fall back to the raw user optimizer
+    @property
+    def _opt_for_facade(self):
+        return getattr(self, "_distributed_optimizer", None) \
+            or self._user_defined_optimizer
+
+    def state_dict(self):
+        return self._opt_for_facade.state_dict()
+
+    def set_state_dict(self, state):
+        return self._opt_for_facade.set_state_dict(state)
+
+    def set_lr(self, value):
+        return self._opt_for_facade.set_lr(value)
+
+    def get_lr(self):
+        return self._opt_for_facade.get_lr()
+
+    def step(self):
+        return self._opt_for_facade.step()
+
+    def clear_grad(self):
+        return self._opt_for_facade.clear_grad()
+
+    def get_loss_scaling(self):
+        scaler = getattr(self, "_scaler", None)
+        if scaler is not None:
+            return scaler.state_dict().get("scale", 1.0)
+        return 1.0
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_fp16_test=False):
+        """fleet_base.py amp_init: casts master weights for pure-fp16
+        static programs; bf16-first autocast needs no warmup cast here."""
+        return None
+
 
 class _DistributedScaler:
     """GradScaler wrapper agreeing found_inf across processes
@@ -433,6 +542,25 @@ init_worker = _fleet_singleton.init_worker
 run_server = _fleet_singleton.run_server
 stop_worker = _fleet_singleton.stop_worker
 get_hybrid_communicate_group = _fleet_singleton.get_hybrid_communicate_group
+get_hybrid_parallel_topology = _fleet_singleton.get_hybrid_parallel_topology
+node_num = _fleet_singleton.node_num
+local_rank = _fleet_singleton.local_rank
+local_device_ids = _fleet_singleton.local_device_ids
+world_device_ids = _fleet_singleton.world_device_ids
+server_index = _fleet_singleton.server_index
+server_endpoints = _fleet_singleton.server_endpoints
+save = _fleet_singleton.save
+load_model = _fleet_singleton.load_model
+shrink = _fleet_singleton.shrink
+state_dict = _fleet_singleton.state_dict
+set_state_dict = _fleet_singleton.set_state_dict
+set_lr = _fleet_singleton.set_lr
+get_lr = _fleet_singleton.get_lr
+step = _fleet_singleton.step
+clear_grad = _fleet_singleton.clear_grad
+get_loss_scaling = _fleet_singleton.get_loss_scaling
+amp_init = _fleet_singleton.amp_init
+util = _fleet_singleton.util  # property value: the UtilBase instance
 
 
 def fleet():

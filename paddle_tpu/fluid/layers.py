@@ -267,18 +267,23 @@ def _fluid_axis_src(out_size, in_size, align_corners, align_mode):
 
 
 def _fluid_resize(input, out_shape, scale, align_corners, align_mode,
-                  nearest=False):
+                  nearest=False, data_format="NCHW"):
     import jax.numpy as jnp
     from ..core.tensor import apply
+    if out_shape is None and scale is None:
+        raise ValueError("One of out_shape and scale must not be None")
     x = _t(input)
-    in_h, in_w = x.shape[2], x.shape[3]
+    nd = x.data.ndim - 2
+    spatial_axes = tuple(range(1, 1 + nd)) if data_format[-1] == "C" \
+        else tuple(range(2, 2 + nd))
+    in_sizes = [x.shape[ax] for ax in spatial_axes]
     if out_shape is None:
-        out_shape = [int(in_h * scale), int(in_w * scale)]
-    oh, ow = int(out_shape[0]), int(out_shape[1])
+        out_shape = [int(sz * scale) for sz in in_sizes]
+    out_sizes = [int(v) for v in out_shape]
 
     def f(a):
         out = a
-        for ax, (o, n) in zip((2, 3), ((oh, in_h), (ow, in_w))):
+        for ax, (o, n) in zip(spatial_axes, zip(out_sizes, in_sizes)):
             src = _fluid_axis_src(o, n, align_corners, align_mode)
             if nearest:
                 # fluid nearest with align_corners rounds the corner ratio;
@@ -302,20 +307,20 @@ def _fluid_resize(input, out_shape, scale, align_corners, align_mode,
 
 def resize_bilinear(input, out_shape=None, scale=None, align_corners=True,
                     align_mode=1, data_format="NCHW", name=None):
-    return _fluid_resize(input, out_shape, scale, align_corners, align_mode)
+    return _fluid_resize(input, out_shape, scale, align_corners,
+                         align_mode, data_format=data_format)
 
 
 def resize_nearest(input, out_shape=None, scale=None, align_corners=True,
                    data_format="NCHW", name=None):
     return _fluid_resize(input, out_shape, scale, align_corners, 1,
-                         nearest=True)
+                         nearest=True, data_format=data_format)
 
 
 def resize_trilinear(input, out_shape=None, scale=None, align_corners=True,
                      align_mode=1, data_format="NCDHW", name=None):
-    return F.interpolate(input, size=out_shape, scale_factor=scale,
-                         mode="trilinear", align_corners=align_corners,
-                         data_format=data_format)
+    return _fluid_resize(input, out_shape, scale, align_corners,
+                         align_mode, data_format=data_format)
 
 
 def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
@@ -421,10 +426,15 @@ def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
 def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
                  align_corners=True, align_mode=1, data_format="NCHW",
                  name=None):
-    mode = {"BILINEAR": "bilinear", "NEAREST": "nearest",
-            "TRILINEAR": "trilinear", "BICUBIC": "bicubic"}[resample]
+    if resample in ("BILINEAR", "TRILINEAR"):
+        # same fluid align_mode rules as resize_bilinear/trilinear
+        return _fluid_resize(input, out_shape, scale, align_corners,
+                             align_mode, data_format=data_format)
+    if resample == "NEAREST":
+        return _fluid_resize(input, out_shape, scale, align_corners, 1,
+                             nearest=True, data_format=data_format)
     return F.interpolate(input, size=out_shape, scale_factor=scale,
-                         mode=mode, align_corners=align_corners,
+                         mode="bicubic", align_corners=align_corners,
                          data_format=data_format)
 
 

@@ -262,3 +262,21 @@ def test_lamb_exclusion_honored_on_functional_path():
     for k in params:  # all excluded + zero lr/grads -> unchanged
         np.testing.assert_allclose(np.asarray(new_p[k]),
                                    np.asarray(params[k]), atol=1e-8)
+
+
+def test_fleet_facade_method_surface():
+    """Every public Fleet method from the reference fleet_base.py exists
+    at fleet module level, and the optimizer delegation works."""
+    from paddle_tpu.distributed import fleet
+    for m in ("init is_first_worker worker_index worker_num is_worker "
+              "worker_endpoints server_num is_server barrier_worker "
+              "init_worker init_server run_server stop_worker "
+              "distributed_optimizer save_inference_model "
+              "save_persistables distributed_model "
+              "get_hybrid_communicate_group get_hybrid_parallel_topology "
+              "node_num local_rank local_device_ids world_device_ids "
+              "server_index server_endpoints load_model save shrink "
+              "state_dict set_state_dict set_lr get_lr step clear_grad "
+              "get_loss_scaling amp_init distributed_scaler "
+              "minimize util").split():
+        assert hasattr(fleet, m), m
