@@ -20,6 +20,10 @@ from typing import Iterable, List, Optional
 
 import numpy as np
 
+import jax as _jax
+
+from ..core.random import next_key as _next_key
+
 from ..core.tensor import Tensor
 
 
@@ -113,10 +117,18 @@ class ComposeDataset(Dataset):
         return tuple(sample)
 
 
+def _framework_permutation(n):
+    """Permutation driven by the FRAMEWORK PRNG (paddle.seed), not numpy's
+    module-global state: shuffle order is reproducible under paddle.seed
+    and immune to unrelated np.random consumers (cross-test/global-state
+    coupling made fit() accuracy order-dependent before this)."""
+    return np.asarray(_jax.random.permutation(_next_key(), n))
+
+
 def random_split(dataset, lengths, generator=None):
     total = sum(lengths)
     assert total == len(dataset)
-    indices = np.random.permutation(total).tolist()
+    indices = _framework_permutation(total).tolist()
     out, offset = [], 0
     for ln in lengths:
         out.append(Subset(dataset, indices[offset:offset + ln]))
@@ -154,8 +166,10 @@ class RandomSampler(Sampler):
     def __iter__(self):
         n = len(self.data_source)
         if self.replacement:
-            return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(np.random.permutation(n)[:self.num_samples].tolist())
+            idx = _jax.random.randint(_next_key(), (self.num_samples,), 0, n)
+            return iter(np.asarray(idx).tolist())
+        return iter(
+            _framework_permutation(n)[:self.num_samples].tolist())
 
     def __len__(self):
         return self.num_samples
@@ -169,9 +183,13 @@ class WeightedRandomSampler(Sampler):
 
     def __iter__(self):
         p = self.weights / self.weights.sum()
-        idx = np.random.choice(len(self.weights), self.num_samples,
-                               replace=self.replacement, p=p)
-        return iter(idx.tolist())
+        # framework PRNG like its siblings — weighted order reproduces
+        # under paddle.seed and ignores numpy's global state
+        idx = _jax.random.choice(_next_key(), len(self.weights),
+                                 (self.num_samples,),
+                                 replace=self.replacement,
+                                 p=_jax.numpy.asarray(p))
+        return iter(np.asarray(idx).tolist())
 
     def __len__(self):
         return self.num_samples
