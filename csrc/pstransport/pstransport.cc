@@ -18,9 +18,13 @@
 //   ps_create_dense, ps_pull_dense, ps_push_dense,
 //   ps_save_table, ps_load_table, ps_table_size
 #include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -48,6 +52,7 @@ enum Op : uint8_t {
   OP_SAVE = 7,
   OP_LOAD = 8,
   OP_SIZE = 9,
+  OP_PING = 10,  // heartbeat (service/env.h heartbeat analog)
 };
 
 enum Status : uint8_t { ST_OK = 0, ST_ERR = 1 };
@@ -514,6 +519,17 @@ void handle_conn(Server* srv, int fd,
         reply_ok(fd, &n, 8);
         break;
       }
+      case OP_PING: {
+        // heartbeat: echo the table count so the client also learns whether
+        // a restarted (empty) server replaced the one it knew
+        uint64_t n;
+        {
+          std::lock_guard<std::mutex> g(srv->tables_mu);
+          n = srv->tables.size();
+        }
+        reply_ok(fd, &n, 8);
+        break;
+      }
       default:
         reply_err(fd, "bad op");
     }
@@ -626,15 +642,46 @@ void ps_server_stop(void* h) {
   delete srv;
 }
 
-void* ps_connect(const char* host, int port) {
+// connect with a bound wait (brpc channel connect_timeout_ms analog):
+// non-blocking connect + poll, then back to blocking with SO_RCVTIMEO/
+// SO_SNDTIMEO so a dead server fails the rpc instead of hanging the worker
+void* ps_connect_ms(const char* host, int port, int timeout_ms) {
   auto* c = new Client();
   c->fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (c->fd < 0) {
+    delete c;
+    return nullptr;
+  }
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
   inet_pton(AF_INET, host, &addr.sin_addr);
-  if (::connect(c->fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
-      0) {
+  bool ok;
+  if (timeout_ms > 0) {
+    int flags = fcntl(c->fd, F_GETFL, 0);
+    fcntl(c->fd, F_SETFL, flags | O_NONBLOCK);
+    int rc = ::connect(c->fd, reinterpret_cast<sockaddr*>(&addr),
+                       sizeof(addr));
+    if (rc == 0) {
+      ok = true;
+    } else if (errno != EINPROGRESS) {
+      ok = false;
+    } else {
+      pollfd pfd{c->fd, POLLOUT, 0};
+      ok = ::poll(&pfd, 1, timeout_ms) == 1 && (pfd.revents & POLLOUT);
+      if (ok) {
+        int err = 0;
+        socklen_t len = sizeof(err);
+        getsockopt(c->fd, SOL_SOCKET, SO_ERROR, &err, &len);
+        ok = err == 0;
+      }
+    }
+    fcntl(c->fd, F_SETFL, flags);  // back to blocking for framed IO
+  } else {
+    ok = ::connect(c->fd, reinterpret_cast<sockaddr*>(&addr),
+                   sizeof(addr)) == 0;
+  }
+  if (!ok) {
     ::close(c->fd);
     delete c;
     return nullptr;
@@ -642,6 +689,39 @@ void* ps_connect(const char* host, int port) {
   int one = 1;
   setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   return c;
+}
+
+void* ps_connect(const char* host, int port) {
+  return ps_connect_ms(host, port, 5000);
+}
+
+// per-rpc IO deadline: read_all/write_all see EAGAIN after `ms` and fail
+// the rpc (0 restores fully-blocking IO)
+int ps_set_timeout(void* h, int ms) {
+  auto* c = static_cast<Client*>(h);
+  timeval tv{};
+  tv.tv_sec = ms / 1000;
+  tv.tv_usec = (ms % 1000) * 1000;
+  if (setsockopt(c->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv)) != 0)
+    return -1;
+  if (setsockopt(c->fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv)) != 0)
+    return -1;
+  return 0;
+}
+
+// heartbeat: 0 alive (out_tables = server table count), -1 dead/timeout
+int ps_ping(void* h, int64_t* out_tables) {
+  std::vector<char> req, resp;
+  put<uint8_t>(&req, OP_PING);
+  put<int32_t>(&req, 0);
+  if (!rpc(static_cast<Client*>(h), req, &resp) || resp.size() != 9)
+    return -1;
+  if (out_tables) {
+    uint64_t n;
+    std::memcpy(&n, resp.data() + 1, 8);
+    *out_tables = static_cast<int64_t>(n);
+  }
+  return 0;
 }
 
 void ps_disconnect(void* h) {

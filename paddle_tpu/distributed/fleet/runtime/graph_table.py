@@ -1,0 +1,189 @@
+"""Graph table for the parameter-server runtime (graph-learning PS).
+
+Reference: paddle/fluid/distributed/table/common_graph_table.cc (GraphTable:
+node/edge shards, weighted neighbor sampling, node features, ordered
+pull_graph_list) and graph_node.h (Node::sample_k weighted-without-
+replacement). One GraphTable instance is ONE shard's storage — the
+client-side fan-out (route by node id % n_servers, reassemble) lives in
+the_one_ps.PSClient, exactly like the sparse tables.
+
+TPU-native notes: sampling results are numpy id/weight arrays ready to feed
+an embedding pull (PSEmbedding) — the GNN mini-batch path is sample on PS,
+gather features, then the dense model runs under jit on the chip.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class _Node:
+    __slots__ = ("nbr_ids", "nbr_weights", "feats")
+
+    def __init__(self):
+        self.nbr_ids: List[int] = []
+        self.nbr_weights: List[float] = []
+        self.feats: Dict[str, str] = {}
+
+
+class GraphTable:
+    """One shard of node/edge storage with weighted neighbor sampling."""
+
+    def __init__(self, seed: int = 0):
+        self._nodes: Dict[int, _Node] = {}
+        self._rng = np.random.RandomState(seed)
+
+    # ---- mutation (common_graph_table.cc:38 add_graph_node / :65 remove) --
+    def add_graph_node(self, ids: Sequence[int]):
+        for i in np.asarray(ids, np.int64).reshape(-1):
+            self._nodes.setdefault(int(i), _Node())
+
+    def remove_graph_node(self, ids: Sequence[int]):
+        for i in np.asarray(ids, np.int64).reshape(-1):
+            self._nodes.pop(int(i), None)
+
+    def clear_nodes(self):
+        self._nodes.clear()
+
+    def add_edges(self, src: Sequence[int], dst: Sequence[int],
+                  weights: Optional[Sequence[float]] = None):
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        if len(src) != len(dst):
+            raise ValueError("src/dst length mismatch")
+        w = (np.ones(len(src), np.float32) if weights is None
+             else np.asarray(weights, np.float32).reshape(-1))
+        for s, d, wt in zip(src, dst, w):
+            node = self._nodes.setdefault(int(s), _Node())
+            node.nbr_ids.append(int(d))
+            node.nbr_weights.append(float(wt))
+
+    # ---- file loaders (:185 load_nodes / :238 load_edges) ----
+    def load_edges(self, path: str, reverse_edge: bool = False):
+        """Lines: `src \\t dst [\\t weight]` (the reference's edge file)."""
+        srcs, dsts, ws = [], [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) < 2:
+                    continue
+                srcs.append(int(parts[0]))
+                dsts.append(int(parts[1]))
+                ws.append(float(parts[2]) if len(parts) > 2 else 1.0)
+        self.add_edges(srcs, dsts, ws)
+        if reverse_edge:
+            self.add_edges(dsts, srcs, ws)
+        return len(srcs)
+
+    def load_nodes(self, path: str):
+        """Lines: `id [\\t key:value ...]` — features as k:v columns."""
+        count = 0
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                node = self._nodes.setdefault(int(parts[0]), _Node())
+                for kv in parts[1:]:
+                    k, _, v = kv.partition(":")
+                    node.feats[k] = v
+                count += 1
+        return count
+
+    # ---- queries ----
+    def size(self) -> int:
+        return len(self._nodes)
+
+    def pull_graph_list(self, start: int, size: int) -> np.ndarray:
+        """Ordered scan window over this shard's node ids (:498)."""
+        ids = np.asarray(sorted(self._nodes), np.int64)
+        return ids[start:start + size]
+
+    def random_sample_nodes(self, sample_size: int) -> np.ndarray:
+        """`sample_size` distinct node ids from this shard (:327; the
+        reference samples contiguous ranges for speed — the contract is
+        'distinct existing ids, uniform-ish', which choice-without-
+        replacement satisfies)."""
+        ids = np.asarray(sorted(self._nodes), np.int64)
+        if sample_size >= len(ids):
+            return ids
+        sel = self._rng.choice(len(ids), size=sample_size, replace=False)
+        return ids[np.sort(sel)]
+
+    def random_sample_neighbors(
+            self, ids: Sequence[int], sample_size: int
+    ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Per queried node: up to sample_size (neighbor_id, weight) pairs,
+        weighted WITHOUT replacement (graph_node.h Node::sample_k /
+        WeightedSampler). Unknown nodes return empty arrays (:392 returns
+        actual_size 0)."""
+        out = []
+        for i in np.asarray(ids, np.int64).reshape(-1):
+            node = self._nodes.get(int(i))
+            if node is None or not node.nbr_ids:
+                out.append((np.empty(0, np.int64), np.empty(0, np.float32)))
+                continue
+            nbr = np.asarray(node.nbr_ids, np.int64)
+            w = np.asarray(node.nbr_weights, np.float64)
+            if sample_size >= len(nbr):
+                out.append((nbr.copy(),
+                            w.astype(np.float32)))
+                continue
+            p = w / w.sum()
+            sel = self._rng.choice(len(nbr), size=sample_size,
+                                   replace=False, p=p)
+            out.append((nbr[sel], w[sel].astype(np.float32)))
+        return out
+
+    # ---- node features (:434 get_node_feat) ----
+    def get_node_feat(self, ids: Sequence[int],
+                      feat_names: Sequence[str]) -> List[List[str]]:
+        res = []
+        for i in np.asarray(ids, np.int64).reshape(-1):
+            node = self._nodes.get(int(i))
+            res.append(["" if node is None else node.feats.get(n, "")
+                        for n in feat_names])
+        return res
+
+    def set_node_feat(self, ids: Sequence[int], feat_names: Sequence[str],
+                      values: Sequence[Sequence[str]]):
+        for i, row in zip(np.asarray(ids, np.int64).reshape(-1), values):
+            node = self._nodes.setdefault(int(i), _Node())
+            for n, v in zip(feat_names, row):
+                node.feats[n] = str(v)
+
+    # ---- checkpoint ----
+    def state(self):
+        ids = np.asarray(sorted(self._nodes), np.int64)
+        nbr_ids = [np.asarray(self._nodes[int(i)].nbr_ids, np.int64)
+                   for i in ids]
+        nbr_ws = [np.asarray(self._nodes[int(i)].nbr_weights, np.float32)
+                  for i in ids]
+        feats = [dict(self._nodes[int(i)].feats) for i in ids]
+        return ids, nbr_ids, nbr_ws, feats
+
+    def save(self, path: str):
+        import json
+        ids, nbr_ids, nbr_ws, feats = self.state()
+        lens = np.asarray([len(x) for x in nbr_ids], np.int64)
+        np.savez(path,
+                 ids=ids, lens=lens,
+                 nbr=np.concatenate(nbr_ids) if nbr_ids else
+                 np.empty(0, np.int64),
+                 w=np.concatenate(nbr_ws) if nbr_ws else
+                 np.empty(0, np.float32),
+                 feats=json.dumps(feats))
+
+    def load(self, path: str):
+        import json
+        data = np.load(path, allow_pickle=False)
+        self._nodes.clear()
+        offs = np.concatenate([[0], np.cumsum(data["lens"])])
+        feats = json.loads(str(data["feats"]))
+        for k, i in enumerate(np.asarray(data["ids"], np.int64)):
+            node = _Node()
+            node.nbr_ids = list(data["nbr"][offs[k]:offs[k + 1]])
+            node.nbr_weights = list(data["w"][offs[k]:offs[k + 1]])
+            node.feats = feats[k]
+            self._nodes[int(i)] = node

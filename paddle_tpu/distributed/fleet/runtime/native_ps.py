@@ -67,6 +67,12 @@ def _load_lib():
                                   ctypes.c_char_p]
     lib.ps_table_size.restype = ctypes.c_int64
     lib.ps_table_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ps_connect_ms.restype = ctypes.c_void_p
+    lib.ps_connect_ms.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                  ctypes.c_int]
+    lib.ps_set_timeout.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ps_ping.argtypes = [ctypes.c_void_p,
+                            ctypes.POINTER(ctypes.c_int64)]
     _lib = lib
     return lib
 
@@ -147,38 +153,145 @@ class NativePSClient:
     """PSClient-compatible worker handle over the native transport: same
     method surface (create_table/pull_sparse/push_sparse/create_dense_table/
     pull_dense/push_dense), same id%n sparse sharding and name-hash dense
-    placement."""
+    placement.
 
-    def __init__(self, endpoints: List[str]):
+    Robustness (service/env.h heartbeat + brpc retry analog): every rpc
+    carries a socket deadline (`timeout_ms`); a failed rpc triggers
+    reconnect + one retry per attempt (`retries`); `ping`/`start_heartbeat`
+    detect dead shards, and `reconnect(s, endpoint)` repoints a shard at a
+    replacement server (failover)."""
+
+    def __init__(self, endpoints: List[str], timeout_ms: int = 10000,
+                 retries: int = 2, retry_backoff: float = 0.2):
+        import threading
         self._lib = _load_lib()
-        self._conns = []
-        for ep in endpoints:
-            host, port = ep.rsplit(":", 1)
-            h = self._lib.ps_connect(host.encode(), int(port))
-            if not h:
-                raise RuntimeError(f"cannot connect to native PS at {ep}")
-            self._conns.append(h)
+        self._endpoints = list(endpoints)
+        self._timeout_ms = int(timeout_ms)
+        self._retries = int(retries)
+        self._backoff = float(retry_backoff)
+        self._conns = [self._dial(ep, required=True) for ep in endpoints]
         self._dims = {}
+        self._dead = [False] * len(endpoints)
+        self._hb_thread = None
+        self._hb_stop = None
+        # per-shard connection lock: the C Client is one raw socket with no
+        # framing lock, so a heartbeat ping racing a worker rpc would
+        # interleave frames (and reconnect() would free a handle the other
+        # thread is inside) — every use of _conns[s] holds _locks[s]
+        self._locks = [threading.Lock() for _ in endpoints]
+
+    def _dial(self, ep: str, required: bool = False):
+        host, port = ep.rsplit(":", 1)
+        h = self._lib.ps_connect_ms(host.encode(), int(port),
+                                    self._timeout_ms)
+        if h:
+            self._lib.ps_set_timeout(h, self._timeout_ms)
+        elif required:
+            raise RuntimeError(f"cannot connect to native PS at {ep}")
+        return h
 
     @property
     def n(self) -> int:
         return len(self._conns)
 
     def close(self):
+        self.stop_heartbeat()
         for h in self._conns:
-            self._lib.ps_disconnect(h)
+            if h:
+                self._lib.ps_disconnect(h)
         self._conns = []
+
+    # ---- liveness / failover ----
+    def ping(self, s: int) -> bool:
+        """Heartbeat one shard: True iff it answers within the deadline."""
+        with self._locks[s]:
+            h = self._conns[s]
+            if not h:
+                return False
+            n = ctypes.c_int64(0)
+            return self._lib.ps_ping(h, ctypes.byref(n)) == 0
+
+    def alive(self) -> List[bool]:
+        return [self.ping(s) for s in range(self.n)]
+
+    def reconnect(self, s: int, endpoint: Optional[str] = None) -> bool:
+        """Re-dial shard s (optionally at a replacement endpoint). The old
+        handle is dropped; returns True on success."""
+        with self._locks[s]:
+            return self._reconnect_locked(s, endpoint)
+
+    def _reconnect_locked(self, s: int,
+                          endpoint: Optional[str] = None) -> bool:
+        if endpoint is not None:
+            self._endpoints[s] = endpoint
+        old = self._conns[s]
+        if old:
+            self._lib.ps_disconnect(old)
+            self._conns[s] = None
+        h = self._dial(self._endpoints[s])
+        self._conns[s] = h
+        self._dead[s] = h is None
+        return h is not None
+
+    def start_heartbeat(self, interval_s: float = 1.0):
+        """Background heartbeat marking shards dead when they stop
+        answering (env.h heartbeat thread analog). Check `self.dead`."""
+        import threading
+        if self._hb_thread is not None:
+            return
+        self._hb_stop = threading.Event()
+
+        def loop():
+            while not self._hb_stop.wait(interval_s):
+                for s in range(self.n):
+                    self._dead[s] = not self.ping(s)
+
+        self._hb_thread = threading.Thread(target=loop, daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeat(self):
+        if self._hb_thread is not None:
+            self._hb_stop.set()
+            self._hb_thread.join()
+            self._hb_thread = None
+
+    @property
+    def dead(self) -> List[bool]:
+        return list(self._dead)
+
+    def _call(self, s: int, op: str, fn, *args):
+        """Run fn(conn, *args) with reconnect-and-retry on failure: a
+        worker must survive a transient server drop (brpc retry), and a
+        persistently-dead shard must raise a clear error, not hang."""
+        import time
+        attempt = 0
+        while True:
+            with self._locks[s]:
+                h = self._conns[s]
+                rc = fn(h, *args) if h else -1
+                if rc == 0:
+                    self._dead[s] = False
+                    return
+            attempt += 1
+            if attempt > self._retries:
+                self._dead[s] = True
+                raise RuntimeError(
+                    f"{op} failed on shard {s} ({self._endpoints[s]}) "
+                    f"after {attempt} attempts (rc={rc}); shard marked "
+                    "dead — restart it and call "
+                    f"reconnect({s}, endpoint) + load(checkpoint)")
+            time.sleep(self._backoff * attempt)
+            self.reconnect(s)
 
     def create_table(self, name: str, dim: int, rule="sgd", lr=0.01,
                      init_std=0.01, seed=0):
         tid = _table_id(name)
         self._dims[name] = int(dim)
-        for i, h in enumerate(self._conns):
-            rc = self._lib.ps_create_sparse(
-                h, tid, int(dim), _RULES[rule], float(lr), float(init_std),
+        for i in range(self.n):
+            self._call(
+                i, f"create_table({name})", self._lib.ps_create_sparse,
+                tid, int(dim), _RULES[rule], float(lr), float(init_std),
                 int(seed) + i)
-            if rc != 0:
-                raise RuntimeError(f"create_table({name}) failed rc={rc}")
 
     def _shard(self, ids: np.ndarray) -> np.ndarray:
         return np.asarray(ids, np.int64) % self.n
@@ -195,13 +308,11 @@ class NativePSClient:
                 continue
             sub = np.ascontiguousarray(ids[sel])
             buf = np.empty((len(sel), dim), np.float32)
-            rc = self._lib.ps_pull_sparse(
-                self._conns[s], tid,
+            self._call(
+                s, f"pull_sparse({table})", self._lib.ps_pull_sparse, tid,
                 sub.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
                 len(sel), dim,
                 buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
-            if rc != 0:
-                raise RuntimeError(f"pull_sparse({table}) failed rc={rc}")
             out[sel] = buf
         return out
 
@@ -217,13 +328,11 @@ class NativePSClient:
                 continue
             sub = np.ascontiguousarray(ids[sel])
             g = np.ascontiguousarray(grads[sel])
-            rc = self._lib.ps_push_sparse(
-                self._conns[s], tid,
+            self._call(
+                s, f"push_sparse({table})", self._lib.ps_push_sparse, tid,
                 sub.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
                 len(sel), dim,
                 g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
-            if rc != 0:
-                raise RuntimeError(f"push_sparse({table}) failed rc={rc}")
 
     def _dense_conn(self, name: str) -> int:
         return _table_id("dense:" + name) % self.n
@@ -232,30 +341,26 @@ class NativePSClient:
         tid = _table_id(name)
         size = int(np.prod(shape))
         self._dims["dense:" + name] = (tuple(shape), size)
-        rc = self._lib.ps_create_dense(
-            self._conns[self._dense_conn(name)], tid, size, _RULES[rule],
-            float(lr))
-        if rc != 0:
-            raise RuntimeError(f"create_dense_table({name}) failed rc={rc}")
+        self._call(self._dense_conn(name), f"create_dense_table({name})",
+                   self._lib.ps_create_dense, tid, size, _RULES[rule],
+                   float(lr))
 
     def pull_dense(self, name: str) -> np.ndarray:
         shape, size = self._dims["dense:" + name]
         out = np.empty(size, np.float32)
-        rc = self._lib.ps_pull_dense(
-            self._conns[self._dense_conn(name)], _table_id(name),
+        self._call(
+            self._dense_conn(name), f"pull_dense({name})",
+            self._lib.ps_pull_dense, _table_id(name),
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), size)
-        if rc != 0:
-            raise RuntimeError(f"pull_dense({name}) failed rc={rc}")
         return out.reshape(shape)
 
     def push_dense(self, name: str, grad: np.ndarray):
         shape, size = self._dims["dense:" + name]
         g = np.ascontiguousarray(grad, np.float32).reshape(-1)
-        rc = self._lib.ps_push_dense(
-            self._conns[self._dense_conn(name)], _table_id(name),
+        self._call(
+            self._dense_conn(name), f"push_dense({name})",
+            self._lib.ps_push_dense, _table_id(name),
             g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), size)
-        if rc != 0:
-            raise RuntimeError(f"push_dense({name}) failed rc={rc}")
 
     def save(self, dirname: str, tables: Optional[List[str]] = None):
         """Server-side save: each shard writes its partition of each sparse
@@ -276,20 +381,16 @@ class NativePSClient:
             sdir = os.path.join(dirname, f"shard{s}")
             os.makedirs(sdir, exist_ok=True)
             for name in sparse:
-                rc = self._lib.ps_save_table(
-                    self._conns[s], _table_id(name),
-                    os.path.join(sdir, f"{name}.pstab").encode())
-                if rc != 0:
-                    raise RuntimeError(f"save({name}) failed rc={rc}")
+                self._call(s, f"save({name})", self._lib.ps_save_table,
+                           _table_id(name),
+                           os.path.join(sdir, f"{name}.pstab").encode())
         for name in dense:
             s = self._dense_conn(name)
             sdir = os.path.join(dirname, f"shard{s}")
             os.makedirs(sdir, exist_ok=True)
-            rc = self._lib.ps_save_table(
-                self._conns[s], _table_id(name),
-                os.path.join(sdir, f"{name}.dense.pstab").encode())
-            if rc != 0:
-                raise RuntimeError(f"save(dense {name}) failed rc={rc}")
+            self._call(s, f"save(dense {name})", self._lib.ps_save_table,
+                       _table_id(name),
+                       os.path.join(sdir, f"{name}.dense.pstab").encode())
 
     def load(self, dirname: str):
         """Restores server state; when the saved shard count differs from
@@ -311,11 +412,9 @@ class NativePSClient:
             os.path.join(dirname, "shard*", "*.dense.pstab"))
         for path in dense_files:
             name = os.path.basename(path)[:-len(".dense.pstab")]
-            rc = self._lib.ps_load_table(
-                self._conns[self._dense_conn(name)], _table_id(name),
-                path.encode())
-            if rc != 0:
-                raise RuntimeError(f"load(dense {name}) failed rc={rc}")
+            self._call(self._dense_conn(name), f"load(dense {name})",
+                       self._lib.ps_load_table, _table_id(name),
+                       path.encode())
         sparse_files = [
             p for p in glob.glob(os.path.join(dirname, "shard*", "*.pstab"))
             if not p.endswith(".dense.pstab")]
@@ -332,10 +431,8 @@ class NativePSClient:
                 shard_dir = os.path.basename(os.path.dirname(path))
                 s = int(shard_dir[len("shard"):])
                 name = os.path.basename(path)[:-len(".pstab")]
-                rc = self._lib.ps_load_table(
-                    self._conns[s], _table_id(name), path.encode())
-                if rc != 0:
-                    raise RuntimeError(f"load({name}) failed rc={rc}")
+                self._call(s, f"load({name})", self._lib.ps_load_table,
+                           _table_id(name), path.encode())
             return
         # shard-count mismatch: merge all partitions per table, re-split
         by_name = {}
@@ -356,19 +453,84 @@ class NativePSClient:
                     path = os.path.join(tmp, f"re{s}.pstab")
                     _write_pstab(path, hdr, ids[m], vals[m], sids[ms],
                                  svals[ms])
-                    rc = self._lib.ps_load_table(
-                        self._conns[s], _table_id(name), path.encode())
-                    if rc != 0:
-                        raise RuntimeError(
-                            f"reshard load({name}) failed rc={rc}")
+                    self._call(s, f"reshard load({name})",
+                               self._lib.ps_load_table, _table_id(name),
+                               path.encode())
 
     def table_size(self, table: str) -> int:
         tid = _table_id(table)
         total = 0
-        for i, h in enumerate(self._conns):
-            n = self._lib.ps_table_size(h, tid)
+        for i in range(self.n):
+            with self._locks[i]:
+                h = self._conns[i]
+                n = self._lib.ps_table_size(h, tid) if h else -1
             if n < 0:
                 raise RuntimeError(
                     f"table_size({table}) failed on shard {i}")
             total += n
         return total
+
+
+class NativePSServerProcess:
+    """One PS shard as its own OS PROCESS (brpc_ps_server.h deployment
+    shape): spawns `python -m ...native_ps --serve`, reads the bound port
+    from its stdout, and can be killed to exercise failover."""
+
+    def __init__(self, port: int = 0):
+        import subprocess as sp
+        import sys
+        self._proc = sp.Popen(
+            [sys.executable, "-m",
+             "paddle_tpu.distributed.fleet.runtime.native_ps",
+             "--serve", "--port", str(port)],
+            stdout=sp.PIPE, stderr=sp.DEVNULL, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        line = self._proc.stdout.readline().strip()
+        if not line.startswith("PS_PORT "):
+            self._proc.kill()
+            raise RuntimeError(f"PS server process failed to start: {line!r}")
+        self.port = int(line.split()[1])
+
+    @property
+    def endpoint(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    @property
+    def pid(self) -> int:
+        return self._proc.pid
+
+    def kill(self):
+        """Hard-kill the shard (the failure the heartbeat must detect)."""
+        self._proc.kill()
+        self._proc.wait()
+
+    def stop(self):
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except Exception:
+                self._proc.kill()
+                self._proc.wait()
+
+
+def _serve_main(argv=None):
+    import argparse
+    import signal
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--serve", action="store_true")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+    if not args.serve:
+        ap.error("--serve required")
+    srv = NativePSServer(args.port)
+    print(f"PS_PORT {srv.port}", flush=True)
+    ev = __import__("threading").Event()
+    signal.signal(signal.SIGTERM, lambda *_: ev.set())
+    signal.signal(signal.SIGINT, lambda *_: ev.set())
+    ev.wait()
+    srv.stop()
+
+
+if __name__ == "__main__":
+    _serve_main()

@@ -266,11 +266,20 @@ class PSCore:
         self.tables: Dict[str, SparseTable] = {}
         self.dense_tables: Dict[str, DenseTable] = {}
         self.barrier_tables: Dict[str, BarrierTable] = {}
+        self.graph_tables: Dict[str, "GraphTable"] = {}
 
     def create_barrier_table(self, name: str, trigger: int):
         if name not in self.barrier_tables:
             self.barrier_tables[name] = BarrierTable(trigger)
         return self.barrier_tables[name]
+
+    def create_graph_table(self, name: str, seed: int = 0):
+        """Graph-learning table (common_graph_table.cc analog): node/edge
+        storage + weighted neighbor sampling on this shard."""
+        from .graph_table import GraphTable
+        if name not in self.graph_tables:
+            self.graph_tables[name] = GraphTable(seed)
+        return self.graph_tables[name]
 
     def create_table(self, name: str, dim: int, rule="sgd", lr=0.01,
                      init_std=0.01, seed=0, entry=None):
@@ -311,6 +320,8 @@ class PSCore:
             extra = {} if slot is None else {"slot": slot}
             np.savez(os.path.join(dirname, f"{name}.dense.npz"), val=val,
                      rule=acc.rule, lr=acc.lr, epsilon=acc.epsilon, **extra)
+        for name, t in self.graph_tables.items():
+            t.save(os.path.join(dirname, f"{name}.graph.npz"))
 
 
 def _npz_bytes(**arrays) -> bytes:
@@ -505,6 +516,108 @@ class PSClient:
         else:
             self._rpc(s, f"/push_dense?table={name}",
                       _npz_bytes(grad=np.asarray(grad, np.float32)))
+
+    # ---- graph table fan-out (common_graph_table.cc client half) ----
+    # Edges live on the shard owning the SOURCE node (id % n), node
+    # features on the shard owning the node — identical routing to the
+    # sparse rows, so a GNN batch can sample and pull embeddings from the
+    # same server set.
+
+    def _graph(self, s: int):
+        if self._cores is None:
+            raise NotImplementedError(
+                "graph tables run on the in-process transport (cores=); "
+                "the HTTP/native transports do not serve graph ops yet")
+        return self._cores[s]
+
+    def create_graph_table(self, name: str, seed: int = 0):
+        for s in range(self.n):
+            self._graph(s).create_graph_table(name, seed + s)
+
+    def graph_add_nodes(self, name: str, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        for s in range(self.n):
+            sel = ids[ids % self.n == s]
+            if len(sel):
+                self._graph(s).graph_tables[name].add_graph_node(sel)
+
+    def graph_add_edges(self, name: str, src, dst, weights=None):
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        w = (None if weights is None
+             else np.asarray(weights, np.float32).reshape(-1))
+        for s in range(self.n):
+            m = src % self.n == s
+            if m.any():
+                self._graph(s).graph_tables[name].add_edges(
+                    src[m], dst[m], None if w is None else w[m])
+
+    def graph_sample_neighbors(self, name: str, ids, sample_size: int):
+        """Per queried id (order preserved): (neighbor_ids, weights)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = [None] * len(ids)
+        for s in range(self.n):
+            sel = np.nonzero(ids % self.n == s)[0]
+            if not len(sel):
+                continue
+            res = self._graph(s).graph_tables[name] \
+                .random_sample_neighbors(ids[sel], sample_size)
+            for j, r in zip(sel, res):
+                out[j] = r
+        return out
+
+    def graph_sample_nodes(self, name: str, sample_size: int) -> np.ndarray:
+        """Global sample: per-shard quota proportional to shard size."""
+        sizes = [self._graph(s).graph_tables[name].size()
+                 for s in range(self.n)]
+        total = sum(sizes)
+        if total == 0:
+            return np.empty(0, np.int64)
+        sample_size = min(sample_size, total)
+        quota = [sz * sample_size // total for sz in sizes]
+        short = sample_size - sum(quota)
+        for s in np.argsort(sizes)[::-1][:short]:
+            quota[s] += 1
+        parts = [self._graph(s).graph_tables[name].random_sample_nodes(q)
+                 for s, q in enumerate(quota) if q]
+        return np.concatenate(parts) if parts else np.empty(0, np.int64)
+
+    def graph_pull_list(self, name: str, start: int, size: int) -> np.ndarray:
+        """Ordered global scan window (pull_graph_list semantics). The
+        global [start, start+size) window is contained in the union of each
+        shard's first start+size ids (per-shard lists are sorted), so only
+        that bounded prefix is gathered per call, not every node."""
+        k = start + size
+        all_ids = np.concatenate([
+            self._graph(s).graph_tables[name].pull_graph_list(0, k)
+            for s in range(self.n)])
+        all_ids.sort()
+        return all_ids[start:start + size]
+
+    def graph_get_node_feat(self, name: str, ids, feat_names):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = [None] * len(ids)
+        for s in range(self.n):
+            sel = np.nonzero(ids % self.n == s)[0]
+            if not len(sel):
+                continue
+            res = self._graph(s).graph_tables[name].get_node_feat(
+                ids[sel], feat_names)
+            for j, r in zip(sel, res):
+                out[j] = r
+        return out
+
+    def graph_set_node_feat(self, name: str, ids, feat_names, values):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        for s in range(self.n):
+            sel = np.nonzero(ids % self.n == s)[0]
+            if len(sel):
+                self._graph(s).graph_tables[name].set_node_feat(
+                    ids[sel], feat_names, [values[j] for j in sel])
+
+    def graph_size(self, name: str) -> int:
+        return sum(self._graph(s).graph_tables[name].size()
+                   for s in range(self.n))
 
 
 class Communicator:
@@ -929,8 +1042,35 @@ class TheOnePSRuntime:
                 t.load_state(data["val"],
                              data["slot"] if "slot" in data else None)
             for path in glob.glob(
+                    os.path.join(dirname, f"shard{s}", "*.graph.npz")):
+                # graph tables restore shard-for-shard when the count
+                # matches; a mismatch re-shards by node id % n below
+                name = os.path.basename(path)[:-len(".graph.npz")]
+                if saved_shards == n:
+                    self.cores[s].create_graph_table(name, seed=s)
+                    self.cores[s].graph_tables[name].load(path)
+                else:
+                    from .graph_table import GraphTable
+                    tmp = GraphTable()
+                    tmp.load(path)
+                    for core_idx in range(n):
+                        self.cores[core_idx].create_graph_table(
+                            name, seed=core_idx)
+                    gids, nbr_ids, nbr_ws, feats = tmp.state()
+                    for gid, ni, nw, ft in zip(gids, nbr_ids, nbr_ws,
+                                               feats):
+                        dstc = self.cores[int(gid) % n].graph_tables[name]
+                        dstc.add_graph_node([gid])
+                        if len(ni):
+                            dstc.add_edges(np.full(len(ni), gid), ni, nw)
+                        if ft:
+                            keys = list(ft)
+                            dstc.set_node_feat([gid], keys,
+                                               [[ft[k] for k in keys]])
+            for path in glob.glob(
                     os.path.join(dirname, f"shard{s}", "*.npz")):
-                if path.endswith(".dense.npz"):
+                if path.endswith(".dense.npz") or \
+                        path.endswith(".graph.npz"):
                     continue
                 name = os.path.splitext(os.path.basename(path))[0]
                 data = np.load(path)

@@ -204,6 +204,9 @@ class DistributedStrategy:
         self.fuse_grad_size_in_TFLOPS = v
 
     def _config_dict(self, obj, value: Dict[str, Any]):
+        if isinstance(obj, dict):  # dict-shaped configs (gradient_scale)
+            obj.update(value)
+            return
         for k, v in value.items():
             if hasattr(obj, k):
                 setattr(obj, k, v)

@@ -1,11 +1,15 @@
-"""paddle.jit analog: to_static == jax.jit over the functionalized layer.
+"""paddle.jit analog: to_static == dy2static AST pass + jax.jit over the
+functionalized layer.
 
-Reference: the 12k-LoC AST-rewriting dy2static stack
-(python/paddle/fluid/dygraph/dygraph_to_static/) collapses to jax tracing: the same
-eager code path runs on tracers, so there is no source transform at all. `to_static`
-returns a compiled callable with state_dict-backed weights; `TrainStep` fuses
-forward+backward+optimizer into one XLA executable — the TPU performance path.
-"""
+Reference: the AST-rewriting dy2static stack
+(python/paddle/fluid/dygraph/dygraph_to_static/ast_transformer.py:1,
+program_translator.py:1). Most of it collapses to jax tracing — the same
+eager code path runs on tracers — but data-dependent Python `if`/`while`
+would trace one branch only, so `to_static` first runs the AST conversion in
+`jit.dy2static` (if/while/for-range/bool ops over Tensors -> traced
+cond/while_loop helpers), then compiles. `TrainStep` fuses
+forward+backward+optimizer into one XLA executable — the TPU performance
+path."""
 from __future__ import annotations
 
 import functools
@@ -24,6 +28,12 @@ class StaticFunction:
     """Compiled wrapper around a Layer (or plain function)."""
 
     def __init__(self, fn_or_layer, input_spec=None):
+        # dy2static AST pass (ast_transformer.py analog): rewrite Python
+        # if/while/for over Tensors into traced cond/while_loop helpers so
+        # data-dependent control flow survives the jax trace; unconvertible
+        # functions fall back to plain tracing with a warning
+        from .dy2static import convert_to_static
+        fn_or_layer = convert_to_static(fn_or_layer)
         self._target = fn_or_layer
         self._input_spec = input_spec
 

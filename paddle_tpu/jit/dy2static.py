@@ -1,0 +1,752 @@
+"""dy2static: AST conversion of Python control flow over Tensors.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/ast_transformer.py:1
+and program_translator.py:1 — the reference rewrites `if`/`while`/`for` over
+framework Variables into cond/while_loop ops so a dygraph script runs as one
+static program. TPU-native analog: the same source rewrite, but the converted
+runtime helpers dispatch on whether the predicate is a jax tracer —
+
+  - eager call (concrete values): plain Python control flow, zero overhead
+    beyond one isinstance check;
+  - traced call (inside jit / to_static / a train step): `if` lowers to a
+    both-branch select (jnp.where merge of the branch-assigned locals, the
+    GSPMD-friendly form), `while`/`for range` lower to lax.while_loop with
+    the loop-assigned locals as the carry.
+
+Conversion happens once per function (cached); any unconvertible construct
+falls back to the original source with a warning, never an error — tracing
+may still succeed if the control flow turns out not to touch tensors.
+
+Supported: if/elif/else (including early `return` in branches), while,
+`for _ in range(...)`, `and`/`or`/`not` (short-circuit preserved for
+non-tensor operands). Not converted (left as plain Python, loud warning when
+relevant): loops containing break/continue/return, `for` over non-range
+iterables.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+import warnings
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["convert_function", "convert_to_static", "unsupported_reason"]
+
+
+class _Undefined:
+    """Placeholder for names not yet bound when a converted branch runs
+    (reference dy2static UndefinedVar analog)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def __bool__(self):
+        raise NameError(
+            "variable is not defined on this path (it was only assigned in "
+            "one branch of a converted `if`)")
+
+
+UNDEF = _Undefined()
+
+
+def _is_traced(x) -> bool:
+    if isinstance(x, Tensor):
+        x = x.data
+    return isinstance(x, jax.core.Tracer)
+
+
+def _is_tensorish(x) -> bool:
+    return isinstance(x, (Tensor, jax.Array, np.ndarray)) or _is_traced(x)
+
+
+def _to_bool(x) -> bool:
+    if isinstance(x, Tensor):
+        return bool(x)
+    return bool(x)
+
+
+def _raw(x):
+    return x.data if isinstance(x, Tensor) else x
+
+
+# ---------------------------------------------------------------------------
+# runtime helpers (the convert_ifelse / convert_while_loop analogs)
+# ---------------------------------------------------------------------------
+
+def _merge_leaf(pred, t, f, name=""):
+    if t is UNDEF and f is UNDEF:
+        return UNDEF
+    if t is UNDEF or f is UNDEF:
+        raise ValueError(
+            f"dy2static: variable {name!r} is defined in only one branch of "
+            "a traced `if`; define it before the `if` (or in both branches)")
+    if _is_tensorish(t) or _is_tensorish(f):
+        tr, fr = _raw(t), _raw(f)
+        out = jnp.where(_raw(pred), tr, fr)
+        return Tensor(out) if isinstance(t, Tensor) or isinstance(f, Tensor) \
+            else out
+    if isinstance(t, (int, float, bool, np.number)) and t == f:
+        return t
+    if t is f or t == f:
+        return t
+    raise ValueError(
+        f"dy2static: variable {name!r} takes non-tensor values that differ "
+        f"between the branches of a traced `if` ({t!r} vs {f!r}); a traced "
+        "branch can only select between tensors")
+
+
+def run_ifelse(pred, true_fn, false_fn, get_state, set_state, names=()):
+    """Statement-form converted `if` (reference convert_ifelse).
+
+    Eager predicate: execute exactly one branch. Traced predicate: execute
+    BOTH branches (select semantics — the standard lowering for data-
+    dependent branches on an SPMD machine) and jnp.where-merge every local
+    the branches assign."""
+    if not _is_traced(pred):
+        if _to_bool(pred):
+            true_fn()
+        else:
+            false_fn()
+        return
+    init = get_state()
+    true_fn()
+    t_state = get_state()
+    set_state(init)
+    false_fn()
+    f_state = get_state()
+    merged = tuple(
+        _merge_leaf(pred, t, f, name)
+        for t, f, name in zip(t_state, f_state,
+                              names or [""] * len(t_state)))
+    set_state(merged)
+
+
+def _merge_tree(pred, t, f):
+    tl, tdef = jax.tree_util.tree_flatten(
+        t, is_leaf=lambda x: isinstance(x, Tensor))
+    fl, fdef = jax.tree_util.tree_flatten(
+        f, is_leaf=lambda x: isinstance(x, Tensor))
+    if tdef != fdef:
+        raise ValueError(
+            "dy2static: the two branches of a traced `if` return values of "
+            f"different structure ({tdef} vs {fdef})")
+    return jax.tree_util.tree_unflatten(
+        tdef, [_merge_leaf(pred, a, b) for a, b in zip(tl, fl)])
+
+
+def ret_ifelse(pred, true_fn, false_fn):
+    """Expression-form converted `if` for branches that return."""
+    if not _is_traced(pred):
+        return true_fn() if _to_bool(pred) else false_fn()
+    return _merge_tree(pred, true_fn(), false_fn())
+
+
+def _flatten_state(state, names):
+    """state tuple -> (list of jnp arrays, rebuild fn). Each leaf must be
+    array-convertible to ride the while_loop carry."""
+    arrs, kinds = [], []
+    for v, name in zip(state, names):
+        if v is UNDEF:
+            raise ValueError(
+                f"dy2static: loop variable {name!r} is not defined before a "
+                "traced `while`; initialize it before the loop")
+        if isinstance(v, Tensor):
+            arrs.append(v.data)
+            kinds.append("tensor")
+        elif isinstance(v, (jax.Array, np.ndarray)) or _is_traced(v):
+            arrs.append(jnp.asarray(v))
+            kinds.append("array")
+        elif isinstance(v, (bool, int, float, np.number)):
+            arrs.append(jnp.asarray(v))
+            kinds.append("array")
+        else:
+            raise ValueError(
+                f"dy2static: loop variable {name!r} has untraceable type "
+                f"{type(v).__name__}; a traced `while` can only carry "
+                "tensors and numbers")
+
+    def rebuild(arr_list):
+        return tuple(Tensor(a) if k == "tensor" else a
+                     for a, k in zip(arr_list, kinds))
+
+    return list(arrs), rebuild
+
+
+def run_while(cond_fn, body_fn, get_state, set_state, names=()):
+    """Converted `while` (reference convert_while_loop): python loop when
+    the condition is concrete, lax.while_loop with the loop-assigned locals
+    as carry when traced."""
+    first = cond_fn()
+    if not _is_traced(first):
+        while _to_bool(cond_fn()):
+            body_fn()
+        return
+    init = get_state()
+    names = names or [""] * len(init)
+    arrs, rebuild = _flatten_state(init, names)
+
+    # dtype fixpoint: `s = 0` before `while ...: s = s + x` must carry the
+    # PROMOTED dtype (float32), not truncate every iteration back to int.
+    # One abstract body evaluation finds the output dtypes; the init carry
+    # is promoted to them. A body whose output cannot be reached by
+    # promotion (e.g. alternating dtypes) fails loud.
+    def _body_dtypes(carry):
+        set_state(rebuild(list(carry)))
+        body_fn()
+        out_arrs, _ = _flatten_state(get_state(), names)
+        return tuple(out_arrs)
+
+    out_shape = jax.eval_shape(_body_dtypes, tuple(arrs))
+    set_state(rebuild(list(arrs)))  # undo the abstract body's side effects
+    promoted = []
+    for a, o, name in zip(arrs, out_shape, names):
+        dt = jnp.promote_types(a.dtype, o.dtype)
+        if dt != o.dtype:
+            raise ValueError(
+                f"dy2static: loop variable {name!r} changes dtype across "
+                f"iterations of a traced `while` ({a.dtype} -> {o.dtype}, "
+                f"promoted {dt}); keep its dtype stable")
+        promoted.append(a.astype(dt) if a.dtype != dt else a)
+    arrs = promoted
+
+    def cond(carry):
+        set_state(rebuild(list(carry)))
+        return _raw(cond_fn())
+
+    def body(carry):
+        set_state(rebuild(list(carry)))
+        body_fn()
+        new_arrs, _ = _flatten_state(get_state(), names)
+        return tuple(new_arrs)
+
+    out = jax.lax.while_loop(cond, body, tuple(arrs))
+    set_state(rebuild(list(out)))
+
+
+def range_start_stop_step(*args):
+    if len(args) == 1:
+        return 0, args[0], 1
+    if len(args) == 2:
+        return args[0], args[1], 1
+    if len(args) == 3:
+        return args
+    raise TypeError(f"range expected 1-3 arguments, got {len(args)}")
+
+
+def range_cond(i, stop, step):
+    if isinstance(step, (int, float)) and not _is_tensorish(step):
+        return (i < stop) if step > 0 else (i > stop)
+    lt = _raw(i) < _raw(stop)
+    gt = _raw(i) > _raw(stop)
+    return jnp.where(_raw(step) > 0, lt, gt)
+
+
+def and_(*fns):
+    """`a and b [and c...]` with short-circuit preserved for concrete
+    operands; tensor operands combine with logical_and."""
+    val = fns[0]()
+    for f in fns[1:]:
+        if _is_tensorish(val):
+            nxt = f()
+            out = jnp.logical_and(_raw(val), _raw(nxt))
+            val = Tensor(out) if isinstance(val, Tensor) or \
+                isinstance(nxt, Tensor) else out
+        else:
+            if not val:
+                return val
+            val = f()
+    return val
+
+
+def or_(*fns):
+    val = fns[0]()
+    for f in fns[1:]:
+        if _is_tensorish(val):
+            nxt = f()
+            out = jnp.logical_or(_raw(val), _raw(nxt))
+            val = Tensor(out) if isinstance(val, Tensor) or \
+                isinstance(nxt, Tensor) else out
+        else:
+            if val:
+                return val
+            val = f()
+    return val
+
+
+def not_(x):
+    if _is_tensorish(x):
+        out = jnp.logical_not(_raw(x))
+        return Tensor(out) if isinstance(x, Tensor) else out
+    return not x
+
+
+# ---------------------------------------------------------------------------
+# AST analysis
+# ---------------------------------------------------------------------------
+
+def _target_names(t) -> List[str]:
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out = []
+        for e in t.elts:
+            out.extend(_target_names(e))
+        return out
+    if isinstance(t, ast.Starred):
+        return _target_names(t.value)
+    return []  # attribute/subscript targets bind no local
+
+
+def _assigned_names(stmts: Sequence[ast.stmt]) -> List[str]:
+    """Locals bound anywhere in these statements (not descending into nested
+    function scopes)."""
+    names: List[str] = []
+
+    def walk(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                names.extend(_target_names(t))
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            names.extend(_target_names(node.target))
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            names.extend(_target_names(node.target))
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            names.extend(_target_names(node.optional_vars))
+        elif isinstance(node, ast.NamedExpr):
+            names.extend(_target_names(node.target))
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    for s in stmts:
+        walk(s)
+    seen, out = set(), []
+    for n in names:
+        if n not in seen:
+            seen.add(n)
+            out.append(n)
+    return out
+
+
+def _contains(stmts, node_types, stop_at_loops=False) -> bool:
+    def walk(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return False
+        if stop_at_loops and isinstance(node, (ast.For, ast.While)):
+            return False
+        if isinstance(node, node_types):
+            return True
+        return any(walk(c) for c in ast.iter_child_nodes(node))
+
+    return any(walk(s) for s in stmts)
+
+
+def _ends_with_return(stmts) -> bool:
+    return bool(stmts) and isinstance(stmts[-1], ast.Return)
+
+
+class _Scope:
+    """Per-function-scope context for the transform."""
+
+    def __init__(self, fn_node: ast.FunctionDef):
+        self.bind_lineno = {}
+        args = fn_node.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            self.bind_lineno[a.arg] = 0
+
+        def walk(node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)) \
+                    and node is not fn_node:
+                return
+            nm = []
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    nm.extend(_target_names(t))
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                nm.extend(_target_names(node.target))
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                nm.extend(_target_names(node.target))
+            elif isinstance(node, ast.NamedExpr):
+                nm.extend(_target_names(node.target))
+            ln = getattr(node, "lineno", None)
+            for n in nm:
+                if ln is not None:
+                    self.bind_lineno[n] = min(
+                        self.bind_lineno.get(n, ln), ln)
+            for c in ast.iter_child_nodes(node):
+                walk(c)
+
+        walk(fn_node)
+
+    def needs_preinit(self, name: str, at_lineno: int) -> bool:
+        first = self.bind_lineno.get(name)
+        return first is None or first >= at_lineno
+
+
+def _stmt(src: str) -> ast.stmt:
+    return ast.parse(textwrap.dedent(src)).body[0]
+
+
+def _name_tuple(names):
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+                     ctx=ast.Load())
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites if/while/for-range/boolop within ONE function scope."""
+
+    def __init__(self, scope: _Scope, counter: List[int]):
+        self.scope = scope
+        self.counter = counter
+
+    def _uid(self) -> int:
+        self.counter[0] += 1
+        return self.counter[0]
+
+    # -- nested scopes: handled by their own transformer pass --
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_ClassDef(self, node):
+        return node
+
+    # -- boolean operators --
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        if any(isinstance(n, ast.NamedExpr)
+               for v in node.values for n in ast.walk(v)):
+            # a walrus inside an operand would rescope to the generated
+            # lambda (PEP 572); leave the BoolOp untouched
+            return node
+        helper = "and_" if isinstance(node.op, ast.And) else "or_"
+        args = [ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=v) for v in node.values]
+        return ast.copy_location(ast.Call(
+            func=ast.Attribute(value=ast.Name(id="_jst", ctx=ast.Load()),
+                               attr=helper, ctx=ast.Load()),
+            args=args, keywords=[]), node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if not isinstance(node.op, ast.Not):
+            return node
+        return ast.copy_location(ast.Call(
+            func=ast.Attribute(value=ast.Name(id="_jst", ctx=ast.Load()),
+                               attr="not_", ctx=ast.Load()),
+            args=[node.operand], keywords=[]), node)
+
+    # -- statement suites --
+    def _state_helpers(self, names, uid):
+        """get/set closures + pre-init lines + nonlocal stmt for `names`."""
+        get_def = _stmt(f"def __pt_get_{uid}():\n    return None")
+        get_def.body = [ast.Return(value=_name_tuple(names))]
+        set_def = _stmt(f"def __pt_set_{uid}(__pt_v):\n    pass")
+        tgt = ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+            ctx=ast.Store())
+        set_body = [ast.Assign(
+            targets=[tgt], value=ast.Name(id="__pt_v", ctx=ast.Load()))]
+        if names:
+            set_body.insert(0, ast.Nonlocal(names=list(names)))
+        set_def.body = set_body
+        return get_def, set_def
+
+    def _preinits(self, names, lineno):
+        return [_stmt(f"{n} = _jst.UNDEF")
+                for n in names if self.scope.needs_preinit(n, lineno)]
+
+    def _branch_def(self, name, suite, nonlocal_names):
+        d = _stmt(f"def {name}():\n    pass")
+        body = list(suite) or [ast.Pass()]
+        if nonlocal_names:
+            body.insert(0, ast.Nonlocal(names=list(nonlocal_names)))
+        d.body = body
+        return d
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _contains(node.body + node.orelse, (ast.Return,)):
+            # returns inside a statement-form if: the fold pass already
+            # extracted the convertible patterns; leave the rest python
+            return node
+        if _contains(node.body + node.orelse, (ast.Break, ast.Continue),
+                     stop_at_loops=True):
+            # break/continue bound to an enclosing loop cannot move into a
+            # closure; leave python (the enclosing loop stays python too)
+            return node
+        uid = self._uid()
+        names = _assigned_names(node.body + node.orelse)
+        pre = self._preinits(names, node.lineno)
+        t_def = self._branch_def(f"__pt_true_{uid}", node.body, names)
+        f_def = self._branch_def(f"__pt_false_{uid}", node.orelse, names)
+        get_def, set_def = self._state_helpers(names, uid)
+        call = _stmt(
+            f"_jst.run_ifelse(None, __pt_true_{uid}, __pt_false_{uid}, "
+            f"__pt_get_{uid}, __pt_set_{uid}, names={names!r})")
+        call.value.args[0] = node.test
+        out = pre + [t_def, f_def, get_def, set_def, call]
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _contains(node.body, (ast.Return,)) or _contains(
+                node.body, (ast.Break, ast.Continue), stop_at_loops=True):
+            return node  # python semantics (documented unsupported)
+        uid = self._uid()
+        names = _assigned_names(node.body)
+        pre = self._preinits(names, node.lineno)
+        cond_def = _stmt(f"def __pt_cond_{uid}():\n    return None")
+        cond_def.body = [ast.Return(value=node.test)]
+        body_def = self._branch_def(f"__pt_body_{uid}", node.body, names)
+        get_def, set_def = self._state_helpers(names, uid)
+        call = _stmt(
+            f"_jst.run_while(__pt_cond_{uid}, __pt_body_{uid}, "
+            f"__pt_get_{uid}, __pt_set_{uid}, names={names!r})")
+        out = pre + [cond_def, body_def, get_def, set_def, call]
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse or _contains(node.body, (ast.Return,)) or _contains(
+                node.body, (ast.Break, ast.Continue), stop_at_loops=True):
+            return node
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords):
+            return node  # only range() desugars; other iterables stay python
+        if not isinstance(node.target, ast.Name):
+            return node
+        uid = self._uid()
+        tgt = node.target.id
+        setup = _stmt(
+            f"__pt_s_{uid}, __pt_e_{uid}, __pt_st_{uid} = "
+            f"_jst.range_start_stop_step()")
+        setup.value.args = list(it.args)
+        init_i = _stmt(f"__pt_i_{uid} = __pt_s_{uid}")
+        init_t = _stmt(f"{tgt} = __pt_s_{uid}")
+        # the generated inits bind these names before the while: register
+        # them so the while conversion does not UNDEF-preinit over them
+        for n in (f"__pt_i_{uid}", f"__pt_s_{uid}", f"__pt_e_{uid}",
+                  f"__pt_st_{uid}", tgt):
+            self.scope.bind_lineno[n] = 0
+        while_src = (
+            f"while _jst.range_cond(__pt_i_{uid}, __pt_e_{uid}, "
+            f"__pt_st_{uid}):\n"
+            f"    {tgt} = __pt_i_{uid}\n"
+            f"    __pt_i_{uid} = __pt_i_{uid} + __pt_st_{uid}\n"
+            f"    pass")
+        w = _stmt(while_src)
+        w.body = w.body[:2] + list(node.body)
+        for s in (setup, init_i, init_t, w):
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        converted = self.visit_While(w)
+        if not isinstance(converted, list):
+            converted = [converted]
+        return [setup, init_i, init_t] + converted
+
+
+def _fold_returns(stmts: List[ast.stmt], counter: List[int]
+                  ) -> List[ast.stmt]:
+    """Rewrite `if` statements whose branches return into expression form:
+
+        if c: <A...; return x>      def __pt_rt(): A...; return x
+        <T...>                 =>   def __pt_rf(): T...
+                                    return _jst.ret_ifelse(c, rt, rf)
+
+    Trailing statements fold into the non-returning branch, recursively, so
+    chains of early returns convert cleanly. Bails (leaves python) when the
+    return hides inside a loop."""
+    for i, s in enumerate(stmts):
+        if not isinstance(s, ast.If):
+            continue
+        if not _contains(s.body + s.orelse, (ast.Return,)):
+            continue
+        trailing = stmts[i + 1:]
+        true_suite = _fold_returns(list(s.body), counter)
+        false_suite = _fold_returns(list(s.orelse), counter)
+        if not _ends_with_return(true_suite):
+            true_suite = _fold_returns(
+                true_suite + _clone_list(trailing), counter)
+        if not _ends_with_return(false_suite):
+            false_suite = _fold_returns(
+                false_suite + _clone_list(trailing), counter)
+        if not (_ends_with_return(true_suite)
+                and _ends_with_return(false_suite)):
+            return stmts  # couldn't normalize; leave python
+        counter[0] += 1
+        uid = counter[0]
+        t_def = _stmt(f"def __pt_rt_{uid}():\n    pass")
+        t_def.body = true_suite
+        f_def = _stmt(f"def __pt_rf_{uid}():\n    pass")
+        f_def.body = false_suite
+        ret = _stmt(
+            f"return _jst.ret_ifelse(None, __pt_rt_{uid}, __pt_rf_{uid})")
+        ret.value.args[0] = s.test
+        for n in (t_def, f_def, ret):
+            ast.copy_location(n, s)
+            ast.fix_missing_locations(n)
+        return stmts[:i] + [t_def, f_def, ret]
+    return stmts
+
+
+def _clone_list(stmts):
+    import copy
+    return [copy.deepcopy(s) for s in stmts]
+
+
+def _transform_function_scopes(node: ast.FunctionDef, counter: List[int]):
+    """Apply the conversion to `node`'s scope, then recurse into nested
+    function definitions (each gets its own scope analysis)."""
+    if not _ends_with_return(node.body):
+        node.body = node.body + [ast.Return(value=None)]
+        ast.fix_missing_locations(node)
+    node.body = _fold_returns(node.body, counter)
+    scope = _Scope(node)
+    tr = _ControlFlowTransformer(scope, counter)
+    node.body = [n for s in node.body
+                 for n in (lambda r: r if isinstance(r, list) else [r])(
+                     tr.visit(s))]
+    ast.fix_missing_locations(node)
+    # recurse into nested scopes: user-defined nested functions AND the
+    # fold-generated return closures (__pt_rt/__pt_rf — their suites moved
+    # in before phase 2, so they still carry unconverted control flow).
+    # Phase-2-generated closures (__pt_true/__pt_body/...) were converted
+    # before their suites moved, but re-running on them is harmless and
+    # keeps the recursion uniform.
+    for sub in list(ast.iter_child_nodes(node)):
+        if isinstance(sub, ast.FunctionDef):
+            _transform_function_scopes(sub, counter)
+
+
+def unsupported_reason(fn: Callable) -> str | None:
+    """Why `fn` cannot be AST-converted, or None if it can."""
+    try:
+        inspect.getsource(fn)
+    except (OSError, TypeError) as e:
+        return f"source unavailable ({e})"
+    if getattr(fn, "__closure__", None):
+        return "function closes over outer variables (free variables are " \
+               "not rebindable through exec)"
+    return None
+
+
+_CONVERT_CACHE: dict = {}
+
+
+def convert_function(fn: Callable) -> Callable:
+    """AST-convert `fn` (idempotent, cached). Falls back to `fn` with a
+    warning when conversion is impossible."""
+    if getattr(fn, "_pt_dy2static", False):
+        return fn
+    key = getattr(fn, "__code__", None)
+    if key is None:
+        # no code object (partial/builtin/callable object): nothing to
+        # convert, and caching under a shared None key would alias distinct
+        # callables — pass through uncached
+        return fn
+    if key in _CONVERT_CACHE:
+        return _CONVERT_CACHE[key]
+    reason = unsupported_reason(fn)
+    if reason is not None:
+        # only worth a warning if the source actually has control flow the
+        # conversion would have rewritten
+        try:
+            src = textwrap.dedent(inspect.getsource(fn))
+            fd = ast.parse(src).body[0]
+            has_cf = isinstance(fd, ast.FunctionDef) and _contains(
+                fd.body, (ast.If, ast.While, ast.For))
+        except Exception:
+            has_cf = False
+        if has_cf:
+            warnings.warn(
+                f"dy2static: not converting {getattr(fn, '__name__', fn)}: "
+                f"{reason}; falling back to plain tracing — data-dependent "
+                "Python control flow will trace one branch only",
+                stacklevel=3)
+        _CONVERT_CACHE[key] = fn
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        assert isinstance(fdef, ast.FunctionDef), "not a plain function"
+        fdef.decorator_list = []  # strip @to_static etc. — no recursion
+        counter = [0]
+        _transform_function_scopes(fdef, counter)
+        ast.fix_missing_locations(tree)
+        code = compile(tree, filename=f"<dy2static {fn.__name__}>",
+                       mode="exec")
+        glb = dict(fn.__globals__)
+        from . import dy2static as _jst_mod
+        glb["_jst"] = _jst_mod
+        exec(code, glb)
+        new_fn = glb[fdef.name]
+        new_fn = functools.wraps(fn)(new_fn)
+        new_fn._pt_dy2static = True
+        new_fn._pt_transformed_source = ast.unparse(tree)
+    except Exception as e:  # fail open: tracing may still work
+        warnings.warn(
+            f"dy2static: conversion of {getattr(fn, '__name__', fn)} "
+            f"failed ({type(e).__name__}: {e}); falling back to plain "
+            "tracing", stacklevel=3)
+        new_fn = fn
+    _CONVERT_CACHE[key] = new_fn
+    return new_fn
+
+
+def convert_to_static(target):
+    """Convert a function, bound method, or Layer (its forward) in place.
+
+    Returns the converted callable (for a Layer: the Layer itself, with
+    `forward` rebound to the converted function)."""
+    from ..nn.layer.layers import Layer
+    if isinstance(target, Layer):
+        fwd = target.forward
+        fn = fwd.__func__ if isinstance(fwd, types.MethodType) else fwd
+        conv = convert_function(fn)
+        if conv is not fn:
+            target.forward = types.MethodType(conv, target)
+        return target
+    if isinstance(target, types.MethodType):
+        conv = convert_function(target.__func__)
+        if conv is not target.__func__:
+            return types.MethodType(conv, target.__self__)
+        return target
+    return convert_function(target)

@@ -33,10 +33,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 # block sizes are sweepable via env (bench tuning: FLAGS_flash_block_q/k),
-# resolved per call inside flash_attention; 512x512 is the measured v5e
-# default
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+# resolved per call inside flash_attention; 256x256 is the only block config
+# that has completed a run on the real v5e (BENCH_SWEEP: 512-block configs
+# crashed rc=1 / hung on-chip) — keep the default at what hardware has proven
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
 
 # trace-time flag: the SPMD step sets this while the sequence dim is
 # GSPMD-sharded over the `sep` axis. With a mesh attached, attention drops

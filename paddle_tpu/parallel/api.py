@@ -126,6 +126,74 @@ def make_compute_loss(model, loss_fn, amp_ctx=None):
     return compute_loss
 
 
+def apply_selective_remat(model: Layer, checkpoints) -> list:
+    """Wrap the named sublayers' forwards in jax.checkpoint (selective
+    recompute, recompute_configs.checkpoints analog: the reference names
+    segment-anchor variables, the TPU analog names sublayers/prefixes).
+
+    Only the topmost match of each checkpoint entry is wrapped (wrapping a
+    child inside an already-rematted parent would remat twice). Returns the
+    wrapped sublayer names; empty means nothing matched."""
+    wrapped = []
+    for name, sub in model.named_sublayers():
+        if not any(name == c or name.startswith(c + ".")
+                   for c in checkpoints):
+            continue
+        if any(name.startswith(w + ".") for w in wrapped):
+            continue  # ancestor already wrapped
+        _wrap_forward_remat(sub)
+        wrapped.append(name)
+    return wrapped
+
+
+def _wrap_forward_remat(layer: Layer):
+    """layer.forward := jax.checkpoint(forward) at the array level (Tensor is
+    not a pytree: unwrap args to arrays, rebuild inside, unwrap outputs).
+    Parameters reach the remat region through the closure — new-style remat
+    differentiates closed-over tracers correctly."""
+    import jax as _jax
+    orig = layer.forward
+    if getattr(orig, "_is_remat_wrapped", False):
+        return
+
+    def forward(*args, **kwargs):
+        import numpy as _np
+        names = sorted(kwargs)
+        flat = list(args) + [kwargs[k] for k in names]
+        # only Tensor/array leaves ride through the checkpoint as operands;
+        # static values (strings, None, python flags) stay in the closure
+        is_tensor = [isinstance(a, Tensor) for a in flat]
+        traced = [t or isinstance(a, (jnp.ndarray, _np.ndarray))
+                  for a, t in zip(flat, is_tensor)]
+        arrs = [a.data if t else a
+                for a, t, tr in zip(flat, is_tensor, traced) if tr]
+        out_kind = {}
+
+        def inner(*inner_arrs):
+            it = iter(inner_arrs)
+            rebuilt = [(Tensor(next(it)) if t else next(it)) if tr else a
+                       for a, t, tr in zip(flat, is_tensor, traced)]
+            a_args = rebuilt[:len(args)]
+            a_kwargs = dict(zip(names, rebuilt[len(args):]))
+            out = orig(*a_args, **a_kwargs)
+            # any output pytree: Tensor leaves unwrap to arrays (Tensor is
+            # not a registered pytree node, so flatten with it as a leaf)
+            leaves, treedef = _jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            out_kind["treedef"] = treedef
+            out_kind["tensor_leaf"] = [isinstance(l, Tensor) for l in leaves]
+            return tuple(l.data if isinstance(l, Tensor) else l
+                         for l in leaves)
+
+        res = _jax.checkpoint(inner)(*arrs)
+        leaves = [Tensor(r) if t else r
+                  for r, t in zip(res, out_kind["tensor_leaf"])]
+        return _jax.tree_util.tree_unflatten(out_kind["treedef"], leaves)
+
+    forward._is_remat_wrapped = True
+    layer.forward = forward
+
+
 class ShardedTrainStep:
     """One compiled SPMD train step (fwd+bwd+clip+update) over a mesh.
 
@@ -161,6 +229,27 @@ class ShardedTrainStep:
         accum_k = plan.accumulate_steps if plan is not None else 1
         merge_avg = plan.gradient_merge_avg if plan is not None else True
         use_remat = bool(plan is not None and plan.remat)
+        # selective recompute wraps the named sublayers instead of the whole
+        # loss; parallelize() pre-wraps, but a directly-constructed step
+        # must apply the wrappers itself — never silently drop remat
+        if use_remat and getattr(plan, "recompute_checkpoints", None):
+            already = any(getattr(sub.forward, "_is_remat_wrapped", False)
+                          for _, sub in model.named_sublayers())
+            wrapped = already or bool(
+                apply_selective_remat(model, plan.recompute_checkpoints))
+            if wrapped:
+                use_remat = False
+            else:
+                import warnings
+                warnings.warn(
+                    "recompute_configs.checkpoints matched no sublayer of "
+                    f"{type(model).__name__}; falling back to whole-loss "
+                    "recompute", stacklevel=2)
+        fp16_ar = getattr(plan, "fp16_allreduce_dtype", None) \
+            if plan is not None else None
+        grad_scale = getattr(plan, "grad_scale", "avg") \
+            if plan is not None else "avg"
+        use_asp = bool(plan is not None and getattr(plan, "asp", False))
 
         params, buffers = model.functional_state()
         named = dict(model.named_parameters())
@@ -237,6 +326,24 @@ class ShardedTrainStep:
                 k: NamedSharding(mesh, self.grad_specs[k]) for k in params}
             extras["accum_n"] = put(jnp.asarray(0, jnp.int32), P())
             extras_specs["accum_n"] = NamedSharding(mesh, P())
+        if use_asp:
+            # N:M sparsity masks ride in extras (not jit constants: same
+            # size as the weights, so they follow the param sharding and the
+            # donation path instead of doubling executable const memory)
+            asp_masks = {
+                k: put(jnp.asarray(getattr(named[k], "_asp_mask"),
+                                   params[k].dtype), self.param_specs[k])
+                for k in params if getattr(named[k], "_asp_mask", None)
+                is not None}
+            if not asp_masks:
+                raise ValueError(
+                    "strategy.asp is set but no parameter carries a sparse "
+                    "mask; call incubate.asp.prune_model(model) first (or go "
+                    "through parallelize(), which does it for you)")
+            extras["asp_masks"] = asp_masks
+            extras_specs["asp_masks"] = {
+                k: NamedSharding(mesh, self.param_specs[k])
+                for k in asp_masks}
         if use_scaler:
             extras["loss_scale"] = put(
                 jnp.asarray(amp_cfg.init_loss_scaling, jnp.float32), P())
@@ -249,6 +356,9 @@ class ShardedTrainStep:
         apply_fn = optimizer.apply_gradients_fn()
         clip_fn = optimizer.clip_gradients_fn()
         batch_axes = _batch_axes(mesh)
+        _ba = (batch_axes if isinstance(batch_axes, tuple)
+               else (batch_axes,)) if batch_axes else ()
+        dp_total = int(np.prod([mesh.shape[a] for a in _ba])) if _ba else 1
         # parity-plus sequence/context parallelism: token dim sharded over
         # the `sep` axis (ring/Ulysses kernels cover the explicit shard_map
         # mode; under GSPMD the partitioner slices the transformer and
@@ -320,6 +430,15 @@ class ShardedTrainStep:
                 grads = jax.tree_util.tree_map(
                     lambda g: (g.astype(jnp.float32) / scale).astype(g.dtype),
                     grads)
+            if fp16_ar is not None:
+                # fp16_allreduce (fp16_allreduce_optimizer.py:148): the
+                # reference casts fp32 grads to fp16 around the allreduce.
+                # GSPMD inserts the reduction itself, so the step applies the
+                # same fp16 quantization at the reduction boundary
+                _qd = jnp.dtype(fp16_ar)
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g.astype(_qd).astype(g.dtype)
+                               if g.dtype == jnp.float32 else g), grads)
             if zero_stage >= 2:
                 # stage-2: pin grads to the sharded layout so GSPMD lowers the
                 # cross-data reduction as reduce-scatter, not all-reduce
@@ -374,9 +493,22 @@ class ShardedTrainStep:
                 new_extras["accum"] = jax.tree_util.tree_map(
                     lambda a: jnp.where(do_update, jnp.zeros_like(a), a), acc)
                 new_extras["accum_n"] = jnp.where(do_update, 0, acc_n)
+            if grad_scale == "sum":
+                # gradient_scale_configs scale_strategy='sum': ranks SUM
+                # grads instead of averaging. The mean-loss backward yields
+                # the global average, so sum = avg * (number of batch shards)
+                eff_grads = jax.tree_util.tree_map(
+                    lambda g: g * dp_total, eff_grads)
             eff_grads = clip_fn(eff_grads)
             cand_params, cand_opt = apply_fn(params_, eff_grads, opt_state_,
                                              lr, step)
+            if use_asp:
+                # re-apply the N:M masks so pruned weights stay zero
+                # (asp_optimizer.py / OptimizerWithSparsityGuarantee)
+                cand_params = {
+                    k: (p * extras_["asp_masks"][k]
+                        if k in extras_["asp_masks"] else p)
+                    for k, p in cand_params.items()}
             new_params = _tree_where(do_update, cand_params, params_)
             new_opt = _tree_where(do_update, cand_opt, opt_state_)
             return loss, new_params, new_opt, new_buffers, new_extras
@@ -471,6 +603,28 @@ def parallelize(model: Layer, optimizer=None, mesh: Optional[Mesh] = None,
     if mesh is None:
         raise ValueError("no mesh: call fleet.init or pass mesh=")
     plan = StrategyCompiler().compile(strategy, optimizer, mesh)
+    # model rewrites (the program-rewrite meta-optimizers' analog) happen
+    # BEFORE the step traces the model
+    if plan.qat:
+        from ..quantization import ImperativeQuantAware
+        ImperativeQuantAware().quantize(model)
+    if plan.sync_batch_norm:
+        from ..nn.layer.norm import SyncBatchNorm
+        model = SyncBatchNorm.convert_sync_batchnorm(model)
+    if plan.asp:
+        from ..incubate import asp as _asp
+        if not any(getattr(p, "_asp_mask", None) is not None
+                   for _, p in model.named_parameters()):
+            _asp.prune_model(model)
+    if plan.remat and plan.recompute_checkpoints:
+        wrapped = apply_selective_remat(model, plan.recompute_checkpoints)
+        if not wrapped:
+            import warnings
+            warnings.warn(
+                "recompute_configs.checkpoints matched no sublayer of "
+                f"{type(model).__name__}; falling back to whole-loss "
+                "recompute", stacklevel=2)
+            plan.recompute_checkpoints = []
     if plan.pipeline or ("pipe" in mesh.axis_names
                          and mesh.shape["pipe"] > 1):
         from .pipeline import PipelinedTrainStep, is_pipeline_stackable
@@ -490,17 +644,18 @@ def parallelize(model: Layer, optimizer=None, mesh: Optional[Mesh] = None,
                 n_micro = cfg.accumulate_steps
             if cfg is not None:
                 vpp = int(getattr(cfg, "virtual_pp_degree", 1) or 1)
-        return PipelinedTrainStep(model, plan.optimizer or optimizer, mesh,
-                                  n_micro=n_micro,
-                                  zero_stage=plan.zero_stage,
-                                  min_shard_numel=plan.zero_min_numel,
-                                  amp_cfg=plan.amp, loss_fn=loss_fn,
-                                  virtual_pp_degree=vpp)
+        return PipelinedTrainStep(
+            model, plan.optimizer or optimizer, mesh, n_micro=n_micro,
+            zero_stage=plan.zero_stage, min_shard_numel=plan.zero_min_numel,
+            amp_cfg=plan.amp, loss_fn=loss_fn, virtual_pp_degree=vpp,
+            fp16_allreduce_dtype=getattr(plan, "fp16_allreduce_dtype", None),
+            grad_scale=getattr(plan, "grad_scale", "avg"))
     if plan.localsgd_k:
         from .localsgd import LocalSGDTrainStep
         return LocalSGDTrainStep(model, plan.optimizer or optimizer, mesh,
                                  k_steps=plan.localsgd_k,
                                  begin_step=plan.localsgd_begin,
+                                 adaptive=plan.localsgd_adaptive,
                                  loss_fn=loss_fn)
     return ShardedTrainStep(model, optimizer, mesh, loss_fn=loss_fn,
                             plan=plan)

@@ -29,7 +29,8 @@ class LocalSGDTrainStep:
     """
 
     def __init__(self, model: Layer, optimizer, mesh: Mesh, k_steps: int = 4,
-                 begin_step: int = 1, loss_fn: Optional[Callable] = None):
+                 begin_step: int = 1, loss_fn: Optional[Callable] = None,
+                 adaptive: bool = False):
         for ax in ("model", "pipe", "sharding"):
             if ax in mesh.axis_names and mesh.shape[ax] > 1:
                 raise ValueError(
@@ -43,6 +44,7 @@ class LocalSGDTrainStep:
         self.mesh = mesh
         self.k_steps = max(k_steps, 1)
         self.begin_step = begin_step
+        self.adaptive = adaptive
         self._step_count = 0
         dp = mesh.shape["data"]
 
@@ -67,7 +69,19 @@ class LocalSGDTrainStep:
         from .api import make_compute_loss
         compute_loss = make_compute_loss(model, loss_fn)
 
-        def local_step(params_, opt_, bufs_, lr, step, rng, arrays):
+        # AdaptiveLocalSGD state (localsgd_optimizer.py:197): the sync
+        # interval itself is a traced scalar adapted from the loss/lr ratio
+        # at every sync point: k = clip(ceil(sqrt(lr_0*loss/(lr*loss_0)*k0)),
+        # 1, 16), with loss_0/lr_0 captured at step 1.
+        self._extras = {
+            "k_steps": jnp.asarray(self.k_steps, jnp.int32),
+            "last_step": jnp.asarray(0, jnp.int32),
+            "loss_0": jnp.asarray(0.0, jnp.float32),
+            "lr_0": jnp.asarray(0.0, jnp.float32),
+        } if adaptive else {}
+        init_k = self.k_steps
+
+        def local_step(params_, opt_, bufs_, extras_, lr, step, rng, arrays):
             # per-rank blocks carry leading dim 1 — peel it
             peel = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
             wrap = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
@@ -79,25 +93,46 @@ class LocalSGDTrainStep:
             # NO cross-rank grad sync — the local in LocalSGD
             grads = clip_fn(grads)
             new_p, new_o = apply_fn(p, grads, o, lr, step)
+            mean_loss = jax.lax.pmean(loss, "data")
+            if adaptive:
+                sync = jnp.logical_or(
+                    step - extras_["last_step"] >= extras_["k_steps"],
+                    step <= begin)
+            else:
+                sync = jnp.logical_or(step % k == 0, step <= begin)
             # lax.cond, not where: the predicate is replicated, so non-sync
             # steps must compile with NO collective at all — the whole point
             # of LocalSGD is paying the param all-reduce only every k steps
-            sync = jnp.logical_or(step % k == 0, step <= begin)
             new_p, new_b = jax.lax.cond(
                 sync,
                 lambda t: jax.tree_util.tree_map(
                     lambda x: jax.lax.pmean(x, "data"), t),
                 lambda t: t,
                 (new_p, new_b))
-            mean_loss = jax.lax.pmean(loss, "data")
-            return mean_loss, wrap(new_p), wrap(new_o), wrap(new_b)
+            new_extras = dict(extras_)
+            if adaptive:
+                loss_0 = jnp.where(step == 1, mean_loss, extras_["loss_0"])
+                lr_0 = jnp.where(step == 1, lr, extras_["lr_0"])
+                next_k = jnp.ceil(jnp.sqrt(
+                    lr_0 * mean_loss /
+                    jnp.maximum(lr * loss_0, 1e-12) * init_k)
+                ).astype(jnp.int32)
+                next_k = jnp.clip(next_k, 1, 16)
+                adapt = jnp.logical_and(sync, step > begin)
+                new_extras["k_steps"] = jnp.where(
+                    adapt, next_k, extras_["k_steps"])
+                new_extras["last_step"] = jnp.where(
+                    sync, step, extras_["last_step"])
+                new_extras["loss_0"] = loss_0
+                new_extras["lr_0"] = lr_0
+            return mean_loss, wrap(new_p), wrap(new_o), wrap(new_b), new_extras
 
         data_spec = P("data")
         self.data_spec = data_spec
         state_spec = P("data")
-        in_specs = (state_spec, state_spec, state_spec, P(), P(), P(),
+        in_specs = (state_spec, state_spec, state_spec, P(), P(), P(), P(),
                     data_spec)
-        out_specs = (P(), state_spec, state_spec, state_spec)
+        out_specs = (P(), state_spec, state_spec, state_spec, P())
         self._jitted = jax.jit(
             jax.shard_map(local_step, mesh=mesh, in_specs=in_specs,
                           out_specs=out_specs, check_vma=False),
@@ -113,10 +148,18 @@ class LocalSGDTrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step = jnp.asarray(self._step_count, jnp.int32)
         rng = jax.random.PRNGKey(self._step_count)
-        loss, self._params, self._opt_state, self._buffers = self._jitted(
-            self._params, self._opt_state, self._buffers, lr, step, rng,
-            tuple(arrays))
+        (loss, self._params, self._opt_state, self._buffers,
+         self._extras) = self._jitted(
+            self._params, self._opt_state, self._buffers, self._extras, lr,
+            step, rng, tuple(arrays))
         return Tensor(loss)
+
+    @property
+    def current_k_steps(self) -> int:
+        """The live sync interval (adapts under AdaptiveLocalSGD)."""
+        if not self.adaptive:
+            return self.k_steps
+        return int(self._extras["k_steps"])
 
     def param_spread(self) -> float:
         """Max abs deviation of any param copy from the rank-0 copy —

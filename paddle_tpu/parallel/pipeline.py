@@ -558,7 +558,8 @@ class PipelinedTrainStep:
     def __init__(self, model, optimizer, mesh: Mesh, n_micro: int = 4,
                  remat: bool = True, zero_stage: int = 0,
                  min_shard_numel: int = 1024, amp_cfg=None, loss_fn=None,
-                 virtual_pp_degree: int = 1):
+                 virtual_pp_degree: int = 1,
+                 fp16_allreduce_dtype: str = None, grad_scale: str = "avg"):
         if not is_pipeline_stackable(model):
             raise ValueError(
                 f"{type(model).__name__} does not implement the pipeline "
@@ -577,20 +578,6 @@ class PipelinedTrainStep:
         self.n_chunks = int(virtual_pp_degree)
         if self.n_chunks < 1:
             raise ValueError("virtual_pp_degree must be >= 1")
-        if self.n_chunks > 1:
-            from ..optimizer.optimizer import Lamb, LarsMomentum
-            if zero_stage >= 2:
-                raise NotImplementedError(
-                    "virtual_pp_degree > 1 composes with ZeRO stage 0/1 "
-                    "only (grad reduce-scatter over interleaved chunk "
-                    "layouts is not wired); use zero_stage<=1 or "
-                    "virtual_pp_degree=1")
-            if isinstance(optimizer, (Lamb, LarsMomentum)):
-                raise NotImplementedError(
-                    "virtual_pp_degree > 1 with norm-based rules "
-                    "(Lamb/LARS) is not wired (whole-param norms over "
-                    "the chunk dim); use Adam/SGD-family or "
-                    "virtual_pp_degree=1")
         self.zero_stage = zero_stage
         self._step_count = 0
         self._loss_fn = loss_fn
@@ -600,6 +587,15 @@ class PipelinedTrainStep:
                           and amp_cfg.dtype == "float16"
                           and amp_cfg.use_dynamic_loss_scaling)
         self._use_scaler = use_scaler
+        # fp16_allreduce (fp16_allreduce_optimizer.py:148): the pipeline's
+        # cross-data grad reduction is an EXPLICIT lax.pmean, so the cast
+        # genuinely halves the collective bytes (cast fp32->fp16, reduce,
+        # cast back)
+        self._fp16_ar = jnp.dtype(fp16_allreduce_dtype) \
+            if fp16_allreduce_dtype else None
+        if grad_scale not in ("avg", "sum"):
+            raise ValueError(f"grad_scale={grad_scale!r}: use 'avg' or 'sum'")
+        self._grad_scale = grad_scale
 
         self._ep_n = mesh.shape.get("ep", 1)
 
@@ -765,9 +761,12 @@ class PipelinedTrainStep:
         # norm-based rules (Lamb/LARS) need WHOLE-parameter norms: tell the
         # optimizer which mesh axes shard each leaf (trust ratios psum the
         # squared norms — hybrid_parallel_optimizer.py:32's pattern) and
-        # that stacked leaves batch per-layer params over 2 leading dims
+        # how many leading dims stack independent per-layer params — 2 for
+        # plain pp ([pipe, scan]), 3 under interleaved vpp ([pipe, chunk,
+        # scan]), so trust ratios stay per-LAYER-row in both layouts
         from ..optimizer.optimizer import Lamb, LarsMomentum
         norm_meta = None
+        stack_bd = 3 if self.n_chunks > 1 else 2
         if isinstance(optimizer, (Lamb, LarsMomentum)):
             norm_meta = {}
             for k in rest:
@@ -777,7 +776,7 @@ class PipelinedTrainStep:
             for k in stacked:
                 axes = ((MODEL_AXIS,) if stacked_tp[k] else ()) + \
                     (("ep",) if stacked_ep[k] else ())
-                norm_meta[f"__stack__{k}"] = (axes, 2)
+                norm_meta[f"__stack__{k}"] = (axes, stack_bd)
 
         def _zero_apply(flat_params, flat_grads, opt_state, lr, step):
             """ZeRO-sharded update inside shard_map: each sharding rank owns
@@ -854,6 +853,11 @@ class PipelinedTrainStep:
         moe_stack = self._moe_stack
         aux_weight_ = aux_weight
         ep_n_ = self._ep_n
+        fp16_ar_ = self._fp16_ar
+        grad_scale_sum_ = self._grad_scale == "sum"
+        import numpy as _np
+        dp_total_ = int(_np.prod([mesh.shape[ax] for ax in batch_axes])) \
+            if batch_axes else 1
 
         def pipe_global_norm_clip(g_stacked, g_rest):
             """Global-norm clip whose norm spans ALL stages: the stacked
@@ -952,6 +956,11 @@ class PipelinedTrainStep:
                 loss = loss + aux_weight_ * aux
 
             def reduce_grad(k_apply, g, ep_sharded):
+                orig_dtype = g.dtype
+                if fp16_ar_ is not None and g.dtype == jnp.float32:
+                    # cast BEFORE the explicit collectives: half the bytes
+                    # on the wire (fp16_allreduce_optimizer.py:148)
+                    g = g.astype(fp16_ar_)
                 for ax in batch_axes:
                     if ax == "sharding":
                         continue
@@ -965,18 +974,26 @@ class PipelinedTrainStep:
                         continue
                     g = lax.pmean(g, ax)
                 if "sharding" not in batch_axes:
-                    return g
+                    return g.astype(orig_dtype)
                 zd = zdim.get(k_apply) if z2 else None
                 if zd is None:
-                    return lax.pmean(g, "sharding")
-                return lax.psum_scatter(g, "sharding",
-                                        scatter_dimension=zd,
-                                        tiled=True) / sh_n
+                    return lax.pmean(g, "sharding").astype(orig_dtype)
+                out = lax.psum_scatter(g, "sharding",
+                                       scatter_dimension=zd,
+                                       tiled=True) / sh_n
+                return out.astype(orig_dtype)
 
             g_stacked = {k: reduce_grad(f"__stack__{k}", g, stacked_ep[k])
                          for k, g in g_stacked.items()}
             g_rest = {k: reduce_grad(k, g, rest_ep[k])
                       for k, g in g_rest.items()}
+            if grad_scale_sum_:
+                # gradient_scale_configs scale_strategy='sum': ranks SUM
+                # grads over data shards instead of averaging
+                g_stacked = jax.tree_util.tree_map(
+                    lambda g: g * dp_total_, g_stacked)
+                g_rest = jax.tree_util.tree_map(
+                    lambda g: g * dp_total_, g_rest)
 
             new_extras = dict(extras_)
             if use_scaler:

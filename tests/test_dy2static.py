@@ -1,0 +1,522 @@
+"""dy2static AST conversion (VERDICT r4 item 4).
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/ast_transformer.py:1
+and its 70-file test suite (test_ifelse.py, test_loop.py,
+test_break_continue.py, test_logical.py ...). Each case here follows the
+reference suite's pattern: run the function eagerly (python control flow) and
+under to_static/tracing (converted control flow) and assert identical
+numerics — including data-dependent branches, which plain tracing cannot
+handle at all."""
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import convert_function
+
+
+def t(x, dtype=None):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+def _traced(fn, *arrays):
+    """Run fn through jax.jit on Tensor-wrapped tracers (the to_static
+    execution mode) and return numpy results."""
+    def pure(*arrs):
+        out = fn(*[Tensor(a) for a in arrs])
+        return jax.tree_util.tree_map(
+            lambda o: o.data if isinstance(o, Tensor) else o, out,
+            is_leaf=lambda o: isinstance(o, Tensor))
+    return jax.tree_util.tree_map(
+        np.asarray, jax.jit(pure)(*[jnp.asarray(a) for a in arrays]))
+
+
+# ---- if/else (reference test_ifelse.py patterns) ----
+
+def test_if_else_assignment():
+    def fn(x):
+        if x.mean() > 0:
+            y = x + 1
+        else:
+            y = x - 1
+        return y
+
+    conv = convert_function(fn)
+    for data in (np.ones((3,), np.float32), -np.ones((3,), np.float32)):
+        eager = np.asarray(conv(t(data)).numpy())
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(eager, ref)
+        got = _traced(conv, data)
+        np.testing.assert_allclose(got, ref)
+
+
+def test_if_no_else():
+    def fn(x):
+        y = x * 2
+        if x.sum() > 0:
+            y = y + 10
+        return y
+
+    conv = convert_function(fn)
+    for data in (np.ones((2,), np.float32), -np.ones((2,), np.float32)):
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_nested_if():
+    def fn(x):
+        if x.sum() > 0:
+            if x.sum() > 10:
+                y = x * 100
+            else:
+                y = x * 10
+        else:
+            y = x * -1
+        return y
+
+    conv = convert_function(fn)
+    for data in (np.full((4,), 5.0, np.float32),
+                 np.full((4,), 0.5, np.float32),
+                 np.full((4,), -3.0, np.float32)):
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_if_early_return():
+    def fn(x):
+        if x.sum() > 0:
+            return x + 100
+        return x - 100
+
+    conv = convert_function(fn)
+    for data in (np.ones((2,), np.float32), -np.ones((2,), np.float32)):
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_if_both_branches_return():
+    def fn(x):
+        if x.max() > 0:
+            z = x * 2
+            return z + 1
+        else:
+            return x * -3
+
+    conv = convert_function(fn)
+    for data in (np.ones((2,), np.float32), -np.ones((2,), np.float32)):
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_chained_early_returns():
+    def fn(x):
+        s = x.sum()
+        if s > 10:
+            return x * 3
+        if s > 0:
+            return x * 2
+        return x
+
+    conv = convert_function(fn)
+    for v in (6.0, 0.5, -1.0):
+        data = np.full((4,), v, np.float32)
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_if_multiple_vars():
+    def fn(x):
+        a = x
+        b = x * 0
+        if x.mean() > 0:
+            a = a + 1
+            b = a * 2
+        else:
+            a = a - 1
+        return a + b
+
+    conv = convert_function(fn)
+    for data in (np.ones((3,), np.float32), -np.ones((3,), np.float32)):
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_if_defined_single_branch_raises_when_traced():
+    def fn(x):
+        if x.sum() > 0:
+            y = x + 1
+        return y  # noqa: F821 — defined in one branch only
+
+    conv = convert_function(fn)
+    # eager positive path works (python semantics)
+    np.testing.assert_allclose(
+        np.asarray(conv(t(np.ones(2, np.float32))).numpy()),
+        np.ones(2, np.float32) + 1)
+    with pytest.raises(ValueError, match="only one branch"):
+        _traced(conv, np.ones(2, np.float32))
+
+
+def test_elif_chain():
+    def fn(x):
+        s = x.sum()
+        if s > 10:
+            y = x * 3
+        elif s > 0:
+            y = x * 2
+        else:
+            y = x * -1
+        return y
+
+    conv = convert_function(fn)
+    for v in (6.0, 0.5, -2.0):
+        data = np.full((3,), v, np.float32)
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+# ---- while (reference test_loop.py patterns) ----
+
+def test_while_tensor_cond():
+    def fn(x):
+        while x.sum() < 10:
+            x = x * 2
+        return x
+
+    conv = convert_function(fn)
+    data = np.ones((2,), np.float32)
+    ref = np.asarray(fn(t(data)).numpy())
+    np.testing.assert_allclose(_traced(conv, data), ref)
+    # the traced while must be a lax.while_loop, not an unrolled trace:
+    # iteration count depends on data, so a second call with different data
+    # through the SAME jit cache must be right
+    def pure(a):
+        out = conv(Tensor(a))
+        return out.data
+    jitted = jax.jit(pure)
+    for scale in (1.0, 3.0):
+        d = np.full((2,), scale, np.float32)
+        np.testing.assert_allclose(np.asarray(jitted(jnp.asarray(d))),
+                                   np.asarray(fn(t(d)).numpy()))
+
+
+def test_while_counter_python_int():
+    def fn(x, n):
+        i = 0
+        while i < n:
+            x = x + 1
+            i = i + 1
+        return x
+
+    conv = convert_function(fn)
+    data = np.zeros((2,), np.float32)
+    # tensor n -> traced loop
+    ref = np.asarray(fn(t(data), t(np.int32(5))).numpy())
+    np.testing.assert_allclose(ref, np.full((2,), 5.0, np.float32))
+    got = _traced(conv, data, np.int32(5))
+    np.testing.assert_allclose(got, ref)
+
+
+def test_while_multiple_carries():
+    def fn(x):
+        s = x * 0
+        i = x.sum() * 0
+        while i < 4:
+            s = s + x
+            i = i + 1
+        return s, i
+
+    conv = convert_function(fn)
+    data = np.full((3,), 2.0, np.float32)
+    ref_s, ref_i = fn(t(data))
+    got_s, got_i = _traced(conv, data)
+    np.testing.assert_allclose(got_s, np.asarray(ref_s.numpy()))
+    np.testing.assert_allclose(got_i, np.asarray(ref_i.numpy()))
+
+
+def test_while_promotes_int_accumulator():
+    """`s = 0` before `while: s = s + x(float)` must carry float32, not
+    truncate to int each iteration (python promotes; so must the trace)."""
+    def fn(x):
+        s = 0
+        i = 0
+        while i < 3:
+            s = s + x.mean()
+            i = i + 1
+        return s
+
+    conv = convert_function(fn)
+    data = np.full((2,), 0.5, np.float32)
+    ref = float(np.asarray(fn(t(data)).numpy()))  # 1.5
+    assert ref == pytest.approx(1.5)
+    got = _traced(conv, data)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-6)
+
+
+def test_if_numpy_array_branch_value_merges():
+    def fn(x):
+        if x.sum() > 0:
+            w = np.ones(2, np.float32)
+        else:
+            w = np.zeros(2, np.float32)
+        return x * w
+
+    conv = convert_function(fn)
+    for data in (np.ones((2,), np.float32), -np.ones((2,), np.float32)):
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_partial_passthrough_not_aliased():
+    import functools
+
+    def f(a, x):
+        return x + a
+
+    def g(b, x):
+        return x * b
+
+    pf = convert_function(functools.partial(f, 1))
+    pg = convert_function(functools.partial(g, 3))
+    d = t(np.full((2,), 2.0, np.float32))
+    np.testing.assert_allclose(np.asarray(pf(d).numpy()), [3.0, 3.0])
+    np.testing.assert_allclose(np.asarray(pg(d).numpy()), [6.0, 6.0])
+
+
+def test_while_uninitialized_carry_raises():
+    def fn(x):
+        while x.sum() < 10:
+            y = x + 1
+            x = y
+        return x
+
+    conv = convert_function(fn)
+    # y is assigned only inside the loop; traced while needs it initialized
+    with pytest.raises(ValueError, match="not defined before"):
+        _traced(conv, np.ones((2,), np.float32))
+
+
+def test_while_with_break_stays_python():
+    def fn(x):
+        i = 0
+        while i < 10:
+            if i >= 3:
+                break
+            x = x + 1
+            i += 1
+        return x
+
+    conv = convert_function(fn)
+    data = np.zeros((2,), np.float32)
+    np.testing.assert_allclose(np.asarray(conv(t(data)).numpy()),
+                               np.full((2,), 3.0, np.float32))
+
+
+# ---- for range (reference test_for_enumerate.py patterns) ----
+
+def test_for_range_python_n():
+    def fn(x):
+        for i in range(3):
+            x = x + i
+        return x
+
+    conv = convert_function(fn)
+    data = np.zeros((2,), np.float32)
+    ref = np.asarray(fn(t(data)).numpy())
+    np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_for_range_tensor_stop():
+    def fn(x, n):
+        for _ in range(n):
+            x = x * 2
+        return x
+
+    conv = convert_function(fn)
+    data = np.ones((2,), np.float32)
+    ref = np.asarray(fn(t(data), 4).numpy())
+    got = _traced(conv, data, np.int32(4))
+    np.testing.assert_allclose(got, ref)
+
+
+def test_for_range_start_stop_step():
+    def fn(x):
+        acc = x * 0
+        for i in range(2, 10, 3):
+            acc = acc + i
+        return acc
+
+    conv = convert_function(fn)
+    data = np.zeros((2,), np.float32)
+    ref = np.asarray(fn(t(data)).numpy())   # 2 + 5 + 8 = 15
+    np.testing.assert_allclose(ref, np.full((2,), 15.0, np.float32))
+    np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_for_loop_var_value_after():
+    def fn(x):
+        for i in range(4):
+            x = x + 1
+        return x + i  # python leaves i == 3
+
+    conv = convert_function(fn)
+    data = np.zeros((2,), np.float32)
+    ref = np.asarray(fn(t(data)).numpy())
+    np.testing.assert_allclose(np.asarray(conv(t(data)).numpy()), ref)
+
+
+def test_for_over_list_stays_python():
+    def fn(x):
+        for w in [1.0, 2.0, 3.0]:
+            x = x * w
+        return x
+
+    conv = convert_function(fn)
+    data = np.ones((2,), np.float32)
+    np.testing.assert_allclose(_traced(conv, data),
+                               np.full((2,), 6.0, np.float32))
+
+
+# ---- logical ops (reference test_logical.py) ----
+
+def test_logical_and_or_not():
+    def fn(x):
+        if x.sum() > 0 and x.max() < 10:
+            y = x + 1
+        elif x.sum() < -5 or not (x.min() > -100):
+            y = x - 1
+        else:
+            y = x * 0
+        return y
+
+    conv = convert_function(fn)
+    for v in (1.0, -3.0, -0.5):
+        data = np.full((4,), v, np.float32)
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_short_circuit_preserved_for_python_values():
+    def fn(flag, x, calls):
+        def side():
+            calls.append(1)
+            return True
+        if flag and side():
+            return x + 1
+        return x
+
+    conv = convert_function(fn)
+    assert getattr(conv, "_pt_dy2static", False)  # really converted
+    data = np.zeros((2,), np.float32)
+    calls = []
+    out = conv(False, t(data), calls)
+    np.testing.assert_allclose(np.asarray(out.numpy()), data)
+    assert calls == []  # `and` must not evaluate side() when flag is False
+    out = conv(True, t(data), calls)
+    np.testing.assert_allclose(np.asarray(out.numpy()), data + 1)
+    assert calls == [1]
+
+
+# ---- integration through to_static ----
+
+def test_to_static_data_dependent_branch():
+    @to_static
+    def fn(x):
+        if x.mean() > 0:
+            return x * 2
+        return x * -1
+
+    pos = np.ones((3,), np.float32)
+    neg = -np.ones((3,), np.float32)
+    np.testing.assert_allclose(np.asarray(fn(t(pos))[0].numpy()
+                                          if isinstance(fn(t(pos)), tuple)
+                                          else fn(t(pos)).numpy()), pos * 2)
+    np.testing.assert_allclose(np.asarray(fn(t(neg)).numpy()), neg * -1)
+
+
+def test_to_static_layer_forward_converted():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            y = self.fc(x)
+            if y.mean() > 0:
+                return y + 1
+            return y - 1
+
+    paddle.seed(0)
+    net = Net()
+    static_net = to_static(net)
+    data = np.ones((2, 4), np.float32)
+    eager_ref = net(t(data))  # converted forward, eager values
+    got = static_net(t(data))
+    np.testing.assert_allclose(np.asarray(got.numpy()),
+                               np.asarray(eager_ref.numpy()), rtol=1e-6)
+    assert getattr(net.forward.__func__, "_pt_dy2static", False)
+
+
+def test_fluid_style_training_script_unmodified():
+    """The VERDICT acceptance case: a fluid-era script whose loss path has a
+    data-dependent `if` runs under to_static unmodified."""
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 1)
+
+        def forward(self, x, y):
+            pred = self.fc(x)
+            err = pred - y
+            # huber-style data-dependent branch over a traced scalar
+            if err.abs().mean() > 1.0:
+                loss = err.abs().mean()
+            else:
+                loss = (err * err).mean()
+            return loss
+
+    paddle.seed(0)
+    net = Net()
+    fn = to_static(net)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8, 1).astype(np.float32)
+    loss_small = float(fn(t(x * 0.01), t(y * 0.01)).numpy())
+    loss_big = float(fn(t(x * 100), t(y * 100)).numpy())
+    ref_small = float(net(t(x * 0.01), t(y * 0.01)).numpy())
+    ref_big = float(net(t(x * 100), t(y * 100)).numpy())
+    np.testing.assert_allclose(loss_small, ref_small, rtol=1e-5)
+    np.testing.assert_allclose(loss_big, ref_big, rtol=1e-5)
+
+
+def test_unconvertible_closure_warns_when_control_flow():
+    k = 3.0
+
+    def fn(x):
+        if x.sum() > 0:
+            return x * k
+        return x
+
+    with pytest.warns(UserWarning, match="dy2static"):
+        conv = convert_function(fn)
+    assert conv is fn  # fell back
+
+
+def test_transformed_source_is_recorded():
+    def fn(x):
+        if x.sum() > 0:
+            y = x + 1
+        else:
+            y = x - 1
+        return y
+
+    conv = convert_function(fn)
+    src = conv._pt_transformed_source
+    assert "_jst.run_ifelse" in src
+    assert "if " not in src.replace("elif", "")  # the If is gone

@@ -133,19 +133,72 @@ class TestIntegration:
         assert step.n_chunks == 2
         assert np.isfinite(float(step(ids, labels).item()))
 
-    def test_unsupported_combos_raise(self):
-        from paddle_tpu.nn import ClipGradByGlobalNorm
-        paddle.seed(0)
-        m = LlamaForCausalLM.from_preset("llama2-tiny",
-                                         num_hidden_layers=8)
-        lamb = optim.Lamb(learning_rate=1e-3, parameters=m.parameters())
-        with pytest.raises(NotImplementedError):
-            PipelinedTrainStep(m, lamb, _mesh([("data", 4), ("pipe", 2)]),
-                               n_micro=2, virtual_pp_degree=2)
-        adam = optim.AdamW(learning_rate=1e-3, parameters=m.parameters())
-        with pytest.raises(NotImplementedError):
-            PipelinedTrainStep(m, adam, _mesh([("data", 2),
-                                               ("sharding", 2),
-                                               ("pipe", 2)]),
-                               n_micro=2, zero_stage=2,
-                               virtual_pp_degree=2)
+    def test_vpp_zero2_and_3_parity(self, data):
+        """vpp x ZeRO-2/3 (VERDICT r4 item 6): grad reduce-scatter and
+        chunked param storage over the interleaved [pipe, chunk, scan]
+        layout must keep exact loss parity with unsharded vpp."""
+        ids, labels = data
+        axes = [("data", 2), ("sharding", 2), ("pipe", 2)]
+
+        def build(zero):
+            paddle.seed(0)
+            m = LlamaForCausalLM.from_preset("llama2-tiny",
+                                             num_hidden_layers=8)
+            o = optim.AdamW(learning_rate=1e-3, parameters=m.parameters())
+            return PipelinedTrainStep(m, o, _mesh(axes), n_micro=2,
+                                      zero_stage=zero, virtual_pp_degree=2,
+                                      min_shard_numel=0)
+
+        plain = build(0)
+        ref = [float(plain(ids, labels).item()) for _ in range(2)]
+        z2 = build(2)
+        assert z2._z2 and not z2._z3
+        got2 = [float(z2(ids, labels).item()) for _ in range(2)]
+        np.testing.assert_allclose(got2, ref, rtol=1e-4, atol=1e-4)
+        z3 = build(3)
+        assert z3._z3
+        # interleaved param storage is physically sharding-chunked
+        assert any("sharding" in str(a.sharding.spec)
+                   for a in z3._stacked.values())
+        got3 = [float(z3(ids, labels).item()) for _ in range(2)]
+        np.testing.assert_allclose(got3, ref, rtol=1e-4, atol=1e-4)
+
+    def test_lamb_under_vpp_matches_plain_pp(self, data):
+        """Lamb trust ratios must be per-LAYER-row in the interleaved
+        [pipe, chunk, scan] layout (norm batch dims 3): vpp=2 Lamb training
+        matches plain-1F1B Lamb training exactly (VERDICT r4 item 6)."""
+        ids, labels = data
+
+        def build(V):
+            paddle.seed(0)
+            m = LlamaForCausalLM.from_preset("llama2-tiny",
+                                             num_hidden_layers=8)
+            o = optim.Lamb(learning_rate=1e-3, parameters=m.parameters())
+            return PipelinedTrainStep(m, o, _mesh([("data", 4),
+                                                   ("pipe", 2)]),
+                                      n_micro=2, virtual_pp_degree=V)
+
+        ref_step = build(1)
+        ref = [float(ref_step(ids, labels).item()) for _ in range(3)]
+        vpp_step = build(2)
+        got = [float(vpp_step(ids, labels).item()) for _ in range(3)]
+        np.testing.assert_allclose(got, ref, rtol=5e-5, atol=5e-5)
+
+    def test_lars_under_vpp_matches_plain_pp(self, data):
+        ids, labels = data
+
+        def build(V):
+            paddle.seed(0)
+            m = LlamaForCausalLM.from_preset("llama2-tiny",
+                                             num_hidden_layers=8)
+            o = optim.LarsMomentum(learning_rate=1e-3, momentum=0.9,
+                                   parameters=m.parameters())
+            return PipelinedTrainStep(m, o, _mesh([("data", 4),
+                                                   ("pipe", 2)]),
+                                      n_micro=2, virtual_pp_degree=V)
+
+        ref_step = build(1)
+        ref = [float(ref_step(ids, labels).item()) for _ in range(3)]
+        vpp_step = build(2)
+        got = [float(vpp_step(ids, labels).item()) for _ in range(3)]
+        np.testing.assert_allclose(got, ref, rtol=5e-5, atol=5e-5)

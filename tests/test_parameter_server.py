@@ -668,3 +668,234 @@ def test_unadmitted_duplicate_ids_consistent_in_one_pull():
     out = t.pull(np.array([5, 5, 5]))
     np.testing.assert_allclose(out[0], out[1])
     np.testing.assert_allclose(out[1], out[2])
+
+
+# ---------------- transport hardening (VERDICT r4 item 7) ----------------
+
+def test_native_transport_ping_and_heartbeat():
+    """service/env.h heartbeat analog: ping answers on live shards, the
+    background heartbeat marks a killed shard dead."""
+    from paddle_tpu.distributed.fleet.runtime.native_ps import (
+        NativePSClient, NativePSServerProcess)
+    import time
+    servers = [NativePSServerProcess() for _ in range(2)]
+    client = NativePSClient([s.endpoint for s in servers], timeout_ms=2000,
+                            retries=1, retry_backoff=0.05)
+    try:
+        assert client.alive() == [True, True]
+        client.start_heartbeat(interval_s=0.2)
+        servers[1].kill()
+        deadline = time.time() + 10
+        while time.time() < deadline and not client.dead[1]:
+            time.sleep(0.1)
+        assert client.dead[1], "heartbeat never marked the killed shard dead"
+        assert not client.dead[0]
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+def test_native_transport_rpc_timeout_not_hang():
+    """A dead server must fail the rpc within the deadline, never hang the
+    worker (the round-4 weakness: blocking client, dead server = hang)."""
+    from paddle_tpu.distributed.fleet.runtime.native_ps import (
+        NativePSClient, NativePSServerProcess)
+    import time
+    srv = NativePSServerProcess()
+    client = NativePSClient([srv.endpoint], timeout_ms=1500, retries=1,
+                            retry_backoff=0.05)
+    try:
+        client.create_table("e", 4, rule="sgd", lr=0.5, init_std=0.0)
+        client.pull_sparse("e", np.arange(4))
+        srv.kill()
+        t0 = time.time()
+        with pytest.raises(RuntimeError, match="shard 0.*marked\n?.*dead|"
+                                               "marked"):
+            client.pull_sparse("e", np.arange(4))
+        assert time.time() - t0 < 15, "rpc to a dead server effectively hung"
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_native_transport_reconnect_after_transient_drop():
+    """brpc retry analog: the SERVER staying up but a connection dying must
+    be healed transparently by reconnect-and-retry."""
+    from paddle_tpu.distributed.fleet.runtime.native_ps import (
+        NativePSClient, NativePSServerProcess)
+    srv = NativePSServerProcess()
+    client = NativePSClient([srv.endpoint], timeout_ms=2000, retries=2,
+                            retry_backoff=0.05)
+    try:
+        client.create_table("e", 4, rule="sgd", lr=0.5, init_std=0.0)
+        client.pull_sparse("e", np.arange(4))
+        # sabotage the live connection (simulates a dropped TCP session)
+        client._lib.ps_disconnect(client._conns[0])
+        client._conns[0] = None
+        out = client.pull_sparse("e", np.arange(4))  # heals via reconnect
+        assert out.shape == (4, 4)
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_native_transport_kill_shard_failover(tmp_path):
+    """The VERDICT acceptance case: kill one shard mid-training, bring up a
+    replacement process, repoint + restore from checkpoint, and training
+    completes with shard-0 state intact and shard-1 state at the
+    checkpoint."""
+    from paddle_tpu.distributed.fleet.runtime.native_ps import (
+        NativePSClient, NativePSServerProcess)
+    servers = [NativePSServerProcess() for _ in range(2)]
+    client = NativePSClient([s.endpoint for s in servers], timeout_ms=2000,
+                            retries=1, retry_backoff=0.05)
+    ckpt = str(tmp_path / "ckpt")
+    try:
+        client.create_table("emb", 4, rule="sgd", lr=0.5, init_std=0.0)
+        ids = np.arange(8)  # even ids -> shard 0, odd -> shard 1
+        client.pull_sparse("emb", ids)
+        for _ in range(2):  # train: rows at -0.5*2 = -1.0
+            client.push_sparse("emb", ids, np.ones((8, 4), np.float32))
+        client.save(ckpt)
+
+        servers[1].kill()
+        assert client.alive() == [True, False]
+
+        # replacement shard process + repoint + checkpoint restore
+        servers[1] = NativePSServerProcess()
+        assert client.reconnect(1, servers[1].endpoint)
+        client.create_table("emb", 4, rule="sgd", lr=0.5, init_std=0.0)
+        client.load(ckpt)
+        assert client.alive() == [True, True]
+
+        # training continues to completion across BOTH shards
+        client.push_sparse("emb", ids, np.ones((8, 4), np.float32))
+        out = client.pull_sparse("emb", ids)
+        np.testing.assert_allclose(out, -1.5, atol=1e-6)
+        assert client.table_size("emb") == 8
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+# ---------------- graph table (common_graph_table.cc analog) ----------------
+
+def _graph_client(n=2):
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import (PSClient,
+                                                                 PSCore)
+    cores = [PSCore() for _ in range(n)]
+    client = PSClient(cores=cores)
+    client.create_graph_table("g", seed=7)
+    return client
+
+
+def test_graph_table_edges_and_weighted_sampling():
+    client = _graph_client(2)
+    # star: node 0 -> 1..5 with weight == dst (sharded: 0 lives on core 0)
+    src = np.zeros(5, np.int64)
+    dst = np.arange(1, 6)
+    client.graph_add_edges("g", src, dst, dst.astype(np.float32))
+    client.graph_add_edges("g", [1], [0])  # odd node -> shard 1
+    assert client.graph_size("g") == 2  # nodes 0 and 1 hold edges
+
+    # full pull: all 5 neighbors with their weights
+    (nbr, w), = client.graph_sample_neighbors("g", [0], 10)
+    order = np.argsort(nbr)
+    np.testing.assert_array_equal(nbr[order], dst)
+    np.testing.assert_allclose(w[order], dst.astype(np.float32))
+
+    # sub-sample: k distinct neighbors, weights consistent with ids
+    (nbr2, w2), = client.graph_sample_neighbors("g", [0], 3)
+    assert len(nbr2) == 3 and len(set(nbr2.tolist())) == 3
+    np.testing.assert_allclose(w2, nbr2.astype(np.float32))
+
+    # unknown node: empty result, not an error (reference actual_size 0)
+    (nbr3, w3), = client.graph_sample_neighbors("g", [99], 3)
+    assert len(nbr3) == 0 and len(w3) == 0
+
+    # weighted sampling is biased toward heavy edges: over many draws,
+    # neighbor 5 (weight 5) must appear more often than neighbor 1
+    counts = {i: 0 for i in range(1, 6)}
+    for _ in range(300):
+        (nn, _), = client.graph_sample_neighbors("g", [0], 1)
+        counts[int(nn[0])] += 1
+    assert counts[5] > counts[1]
+
+
+def test_graph_table_nodes_feats_scan_and_checkpoint(tmp_path):
+    client = _graph_client(2)
+    ids = np.arange(10)
+    client.graph_add_nodes("g", ids)
+    assert client.graph_size("g") == 10
+    np.testing.assert_array_equal(client.graph_pull_list("g", 0, 10), ids)
+    np.testing.assert_array_equal(client.graph_pull_list("g", 4, 3),
+                                  [4, 5, 6])
+
+    client.graph_set_node_feat("g", [2, 3], ["label", "deg"],
+                               [["a", "5"], ["b", "7"]])
+    feats = client.graph_get_node_feat("g", [3, 2, 9], ["label", "deg"])
+    assert feats[0] == ["b", "7"] and feats[1] == ["a", "5"]
+    assert feats[2] == ["", ""]  # present node, absent feature
+
+    sampled = client.graph_sample_nodes("g", 6)
+    assert len(sampled) == 6 and len(set(sampled.tolist())) == 6
+    assert set(sampled.tolist()) <= set(ids.tolist())
+
+    # checkpoint through PSCore.save + GraphTable.load roundtrip
+    core0 = client._cores[0]
+    core0.save(str(tmp_path))
+    from paddle_tpu.distributed.fleet.runtime.graph_table import GraphTable
+    g2 = GraphTable()
+    g2.load(str(tmp_path / "g.graph.npz"))
+    assert g2.size() == core0.graph_tables["g"].size()
+    assert g2.get_node_feat([2], ["label"]) == [["a"]]
+
+
+def test_graph_table_load_edge_file(tmp_path):
+    client = _graph_client(2)
+    p = tmp_path / "edges.txt"
+    p.write_text("0\t1\t2.0\n0\t2\t1.0\n1\t0\n")
+    # files load per shard in the reference; here: route lines client-side
+    # by loading into a host-side table then re-adding — use the per-shard
+    # loader directly on one core for the file contract
+    n = client._cores[0].graph_tables["g"].load_edges(str(p),
+                                                      reverse_edge=False)
+    assert n == 3
+    res = client._cores[0].graph_tables["g"].random_sample_neighbors([0], 5)
+    nbr, w = res[0]
+    assert set(nbr.tolist()) == {1, 2}
+
+
+def test_graph_table_runtime_checkpoint_and_reshard(tmp_path):
+    """A checkpoint containing graph tables must load (not KeyError into
+    the sparse branch) and must re-shard when the core count changes."""
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import (
+        PSClient, PSCore, TheOnePSRuntime)
+    rt = TheOnePSRuntime(n_shards=2).run_server(transport="inproc")
+    c = rt.client
+    c.create_graph_table("g")
+    c.graph_add_edges("g", [0, 1, 2, 3], [10, 11, 12, 13],
+                      [1.0, 2.0, 3.0, 4.0])
+    c.graph_set_node_feat("g", [2], ["label"], [["x"]])
+    c.create_table("emb", 4, lr=0.1, init_std=0.0)  # mixed checkpoint
+    c.pull_sparse("emb", np.arange(4))
+    rt.save(str(tmp_path / "ck"))
+
+    # same shard count: shard-for-shard restore
+    rt2 = TheOnePSRuntime(n_shards=2).run_server(transport="inproc")
+    rt2.load(str(tmp_path / "ck"))
+    assert rt2.client.graph_size("g") == 4
+    (nbr, w), = rt2.client.graph_sample_neighbors("g", [3], 5)
+    np.testing.assert_array_equal(nbr, [13])
+    assert rt2.client.graph_get_node_feat("g", [2], ["label"]) == [["x"]]
+
+    # different shard count: node-id re-shard, nothing dropped
+    rt3 = TheOnePSRuntime(n_shards=3).run_server(transport="inproc")
+    rt3.load(str(tmp_path / "ck"))
+    assert rt3.client.graph_size("g") == 4
+    (nbr3, w3), = rt3.client.graph_sample_neighbors("g", [2], 5)
+    np.testing.assert_array_equal(nbr3, [12])
+    np.testing.assert_allclose(w3, [3.0])
+    assert rt3.client.graph_get_node_feat("g", [2], ["label"]) == [["x"]]

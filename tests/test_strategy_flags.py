@@ -1,0 +1,425 @@
+"""Zero silent dead flags (VERDICT r4 weak 4 / item 3).
+
+Every public DistributedStrategy field must fall in exactly one bucket —
+consumed by the strategy compiler, consumed by another subsystem, absorbed by
+XLA/JAX by design, or GPU-only (warns when set) — and the newly wired flags
+(fp16_allreduce, adaptive_localsgd, recompute_configs.checkpoints,
+gradient_scale_configs, sync_batch_norm, asp, qat) must observably change the
+compiled step. Reference anchors: fp16_allreduce_optimizer.py:148,
+localsgd_optimizer.py:197 (AdaptiveLocalSGD), distributed_strategy.proto:26
+(RecomputeConfig), asp_optimizer.py, qat meta-optimizer."""
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import DistributedStrategy
+from paddle_tpu.distributed.fleet import strategy_compiler as sc
+from paddle_tpu.parallel import parallelize
+from paddle_tpu.parallel.localsgd import LocalSGDTrainStep
+
+
+def _mesh(data=1, sharding=1, model=1):
+    devs = np.array(jax.devices()[:data * sharding * model]).reshape(
+        data, 1, sharding, model)
+    return Mesh(devs, ("data", "pipe", "sharding", "model"))
+
+
+class TinyMLP(nn.Layer):
+    def __init__(self, d=8):
+        super().__init__()
+        self.fc1 = nn.Linear(d, d)
+        self.fc2 = nn.Linear(d, d)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def _mse(out, y):
+    return nn.functional.mse_loss(out, y)
+
+
+def _step_for(strategy, mesh=None, lr=0.1, d=8, opt_cls=optimizer.SGD):
+    paddle.seed(0)
+    model = TinyMLP(d)
+    opt = opt_cls(learning_rate=lr, parameters=model.parameters())
+    mesh = mesh or _mesh(data=2)
+    return parallelize(model, opt, mesh=mesh, strategy=strategy,
+                       loss_fn=_mse), model
+
+
+def _step_jaxpr(step):
+    lr = jnp.float32(0.1)
+    st = jnp.int32(1)
+    rng = jax.random.PRNGKey(0)
+    x = jnp.ones((4, 8), jnp.float32)
+    y = jnp.zeros((4, 8), jnp.float32)
+    return str(jax.make_jaxpr(step._train_step_fn)(
+        step._params, step._opt_state, step._buffers, step._extras, lr, st,
+        rng, (x, y)))
+
+
+def _data(seed=0, b=4, d=8):
+    r = np.random.RandomState(seed)
+    return (jnp.asarray(r.randn(b, d), jnp.float32),
+            jnp.asarray(r.randn(b, d), jnp.float32))
+
+
+# ---- the exhaustive field audit: no field can silently rot ----
+
+def test_every_public_field_is_classified():
+    s = DistributedStrategy()
+    public = {k for k in s.__dict__ if not k.startswith("__")}
+    buckets = [set(sc.CONSUMED_HERE), set(sc.CONSUMED_ELSEWHERE),
+               set(sc.ABSORBED), set(sc.GPU_ONLY)]
+    classified = set().union(*buckets)
+    unclassified = public - classified
+    assert not unclassified, (
+        f"DistributedStrategy fields with no declared consumer: "
+        f"{sorted(unclassified)} — wire them or add them to a "
+        "strategy_compiler bucket with a justification")
+    for i, a in enumerate(buckets):
+        for b in buckets[i + 1:]:
+            assert not (a & b), f"field in two buckets: {a & b}"
+    # buckets must not reference fields that no longer exist (stale docs)
+    ghost = classified - public
+    assert not ghost, f"classified but nonexistent fields: {sorted(ghost)}"
+
+
+def test_gpu_only_defaults_match_strategy_defaults():
+    s = DistributedStrategy()
+    for knob, default in sc.GPU_ONLY.items():
+        assert getattr(s, knob) == default, knob
+
+
+def test_gpu_only_knob_warns_when_set():
+    s = DistributedStrategy()
+    s.nccl_comm_num = 4
+    with pytest.warns(UserWarning, match="nccl_comm_num.*no TPU analog"):
+        sc.StrategyCompiler().compile(s)
+
+
+def test_semi_auto_warns_gspmd_owns_it():
+    s = DistributedStrategy()
+    s.semi_auto = True
+    with pytest.warns(UserWarning, match="GSPMD"):
+        sc.StrategyCompiler().compile(s)
+
+
+# ---- fp16_allreduce (fp16_allreduce_optimizer.py:148) ----
+
+def test_fp16_allreduce_casts_grads_in_step():
+    s = DistributedStrategy()
+    s.fp16_allreduce = True
+    step, _ = _step_for(s)
+    assert "fp16_allreduce" in step.plan.applied
+    assert "f16" in _step_jaxpr(step)
+    x, y = _data()
+    assert np.isfinite(float(step(x, y).item()))
+
+
+def test_fp16_allreduce_quantizes_but_tracks_fp32_training():
+    x, y = _data()
+    s0 = DistributedStrategy()
+    step0, _ = _step_for(s0)
+    s1 = DistributedStrategy()
+    s1.fp16_allreduce = True
+    step1, _ = _step_for(s1)
+    l0 = [float(step0(x, y).item()) for _ in range(3)]
+    l1 = [float(step1(x, y).item()) for _ in range(3)]
+    # fp16-quantized grads: close to, but not bit-identical with, fp32
+    np.testing.assert_allclose(l1, l0, rtol=5e-3, atol=5e-3)
+
+
+# ---- gradient_scale_configs ----
+
+def test_gradient_scale_sum_scales_update_by_dp():
+    x, y = _data()
+    s_avg = DistributedStrategy()
+    step_a, model_a = _step_for(s_avg)
+    s_sum = DistributedStrategy()
+    s_sum.gradient_scale_configs = {"scale_strategy": "sum"}
+    step_s, model_s = _step_for(s_sum)
+    p0 = {k: np.asarray(v) for k, v in step_a._params.items()}
+    step_a(x, y)
+    step_s(x, y)
+    for k in p0:
+        da = np.asarray(step_a._params[k]) - p0[k]
+        ds = np.asarray(step_s._params[k]) - p0[k]
+        if np.abs(da).max() < 1e-9:
+            continue
+        # SGD update is linear in the grad: sum = avg * n_batch_shards (2)
+        np.testing.assert_allclose(ds, da * 2.0, rtol=1e-5, atol=1e-7)
+
+
+def test_gradient_scale_customized_raises():
+    s = DistributedStrategy()
+    s.gradient_scale_configs = {"scale_strategy": "customized"}
+    with pytest.raises(ValueError, match="scale_strategy"):
+        sc.StrategyCompiler().compile(s)
+
+
+# ---- selective recompute (recompute_configs.checkpoints) ----
+
+def test_selective_recompute_inserts_remat_and_keeps_numerics():
+    x, y = _data()
+    plain = DistributedStrategy()
+    step0, _ = _step_for(plain)
+    losses0 = [float(step0(x, y).item()) for _ in range(3)]
+
+    s = DistributedStrategy()
+    s.recompute = True
+    s.recompute_configs = {"checkpoints": ["fc1"]}
+    step1, model = _step_for(s)
+    assert "recompute" in step1.plan.applied
+    assert step1.plan.recompute_checkpoints == ["fc1"]
+    assert getattr(model.fc1.forward, "_is_remat_wrapped", False)
+    assert not getattr(model.fc2.forward, "_is_remat_wrapped", False)
+    jx = _step_jaxpr(step1)
+    assert "remat" in jx  # the checkpointed sublayer shows up as remat2
+    losses1 = [float(step1(x, y).item()) for _ in range(3)]
+    # remat recomputes, never changes math
+    np.testing.assert_allclose(losses1, losses0, rtol=1e-6, atol=1e-6)
+
+
+def test_selective_recompute_no_match_warns_and_falls_back():
+    s = DistributedStrategy()
+    s.recompute = True
+    s.recompute_configs = {"checkpoints": ["nonexistent_layer"]}
+    with pytest.warns(UserWarning, match="matched no sublayer"):
+        step, _ = _step_for(s)
+    assert "remat" in _step_jaxpr(step)  # whole-loss fallback
+
+
+# ---- asp routed through the strategy ----
+
+def test_asp_strategy_prunes_and_keeps_sparsity():
+    from paddle_tpu.incubate.asp import check_sparsity
+    s = DistributedStrategy()
+    s.asp = True
+    step, model = _step_for(s, lr=0.5)
+    assert "asp" in step.plan.applied
+    x, y = _data()
+    for i in range(3):
+        step(x, y)
+    for k, arr in step._params.items():
+        if k.endswith("weight"):
+            assert check_sparsity(np.asarray(arr)), f"{k} lost 2:4 sparsity"
+
+
+# ---- qat routed through the strategy ----
+
+def test_qat_strategy_swaps_layers():
+    from paddle_tpu.quantization import QuantedLayer
+    s = DistributedStrategy()
+    s.qat = True
+    step, model = _step_for(s)
+    assert "qat" in step.plan.applied
+    assert isinstance(model.fc1, QuantedLayer)
+    assert isinstance(model.fc2, QuantedLayer)
+    x, y = _data()
+    assert np.isfinite(float(step(x, y).item()))
+
+
+# ---- sync_batch_norm routed through the strategy ----
+
+def test_sync_batch_norm_strategy_converts_model():
+    from paddle_tpu.nn.layer.norm import SyncBatchNorm
+
+    class BNNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+            self.bn = nn.BatchNorm1D(8)
+
+        def forward(self, x):
+            return self.bn(self.fc(x))
+
+    paddle.seed(0)
+    model = BNNet()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    s = DistributedStrategy()
+    s.sync_batch_norm = True
+    step = parallelize(model, opt, mesh=_mesh(data=2), strategy=s,
+                       loss_fn=_mse)
+    assert isinstance(step.model.bn, SyncBatchNorm)
+    x, y = _data()
+    assert np.isfinite(float(step(x, y).item()))
+
+
+# ---- adaptive localsgd (localsgd_optimizer.py:197) ----
+
+def test_adaptive_localsgd_routes_and_adapts_k():
+    s = DistributedStrategy()
+    s.adaptive_localsgd = True
+    s.adaptive_localsgd_configs = {"init_k_steps": 2, "begin_step": 2}
+    paddle.seed(0)
+    model = TinyMLP()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    devs = np.array(jax.devices()[:2])
+    mesh = Mesh(devs, ("data",))
+    step = parallelize(model, opt, mesh=mesh, strategy=s, loss_fn=_mse)
+    assert isinstance(step, LocalSGDTrainStep) and step.adaptive
+    x, y = _data(b=8)
+    losses = [float(step(x, y).item()) for _ in range(8)]
+    assert all(np.isfinite(losses))
+    # k is live state, adapted at sync points, clipped to [1, 16]
+    assert 1 <= step.current_k_steps <= 16
+    assert int(step._extras["last_step"]) >= 1
+    # loss_0/lr_0 captured at step 1
+    assert float(step._extras["loss_0"]) == pytest.approx(losses[0], rel=1e-5)
+
+
+def test_plain_localsgd_still_static_k():
+    s = DistributedStrategy()
+    s.localsgd = True
+    s.localsgd_configs = {"k_steps": 3}
+    paddle.seed(0)
+    model = TinyMLP()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+    step = parallelize(model, opt, mesh=mesh, strategy=s, loss_fn=_mse)
+    assert isinstance(step, LocalSGDTrainStep) and not step.adaptive
+    assert step.current_k_steps == 3
+    x, y = _data(b=8)
+    for _ in range(3):
+        assert np.isfinite(float(step(x, y).item()))
+
+
+# ---- per-execution-path consumption (no flag may die on a sub-path) ----
+
+def test_fp16_allreduce_reaches_pipeline_collectives():
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    from paddle_tpu.parallel.pipeline import PipelinedTrainStep
+    paddle.seed(0)
+    m = LlamaForCausalLM.from_preset("llama2-tiny", num_hidden_layers=2)
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("data", "pipe"))
+    step = PipelinedTrainStep(m, opt, mesh, n_micro=2,
+                              fp16_allreduce_dtype="float16")
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 512, (4, 16)), jnp.int32)
+    # the cast must be IN the compiled step, before the grad collectives
+    txt = step._jitted.lower(
+        step._stacked, step._rest, step._opt_state, step._extras,
+        jnp.float32(1e-3), jnp.int32(1), (ids, ids)).as_text()
+    assert "f16" in txt
+    assert np.isfinite(float(step(ids, ids).item()))
+
+
+def test_gradient_scale_sum_reaches_pipeline():
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    from paddle_tpu.parallel.pipeline import PipelinedTrainStep
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 512, (4, 16)), jnp.int32)
+
+    def build(gs):
+        paddle.seed(0)
+        m = LlamaForCausalLM.from_preset("llama2-tiny", num_hidden_layers=2)
+        opt = optimizer.SGD(learning_rate=1e-3, parameters=m.parameters())
+        devs = np.array(jax.devices()[:4]).reshape(2, 2)
+        return PipelinedTrainStep(m, opt, Mesh(devs, ("data", "pipe")),
+                                  n_micro=2, grad_scale=gs)
+
+    sa, ss = build("avg"), build("sum")
+    sa(ids, ids)
+    ss(ids, ids)
+    # SGD update linear in grad: sum-scaled update = avg update * dp(2);
+    # compare on a param that actually moved
+    paddle.seed(0)
+    m0 = LlamaForCausalLM.from_preset("llama2-tiny", num_hidden_layers=2)
+    init = {k: np.asarray(v.numpy(), np.float64)
+            for k, v in m0.named_parameters()}
+    checked = 0
+    for ka in sa._rest:
+        pa = np.asarray(sa._rest[ka], np.float64)
+        ps = np.asarray(ss._rest[ka], np.float64)
+        da, ds = pa - init[ka], ps - init[ka]
+        if np.abs(da).max() < 1e-9:
+            continue
+        # compare on elements big enough that fp32 update rounding (single
+        # ulps on tiny deltas) cannot dominate the ratio
+        big = np.abs(da) > 0.05 * np.abs(da).max()
+        np.testing.assert_allclose(ds[big], da[big] * 2.0, rtol=2e-2,
+                                   atol=1e-7)
+        checked += 1
+    assert checked, "no rest param moved; test is vacuous"
+
+
+def test_asp_with_pipeline_fails_loud():
+    s = DistributedStrategy()
+    s.asp = True
+    s.pipeline = True
+    with pytest.raises(ValueError, match="asp does not compose"):
+        sc.StrategyCompiler().compile(s)
+
+
+def test_localsgd_drops_fp16_allreduce_with_warning():
+    s = DistributedStrategy()
+    s.localsgd = True
+    s.localsgd_configs = {"k_steps": 2}
+    s.fp16_allreduce = True
+    with pytest.warns(UserWarning, match="fp16_allreduce"):
+        plan = sc.StrategyCompiler().compile(s)
+    assert plan.fp16_allreduce_dtype is None
+    assert "fp16_allreduce" not in plan.applied
+
+
+# ---- fp16 compression on the explicit collective path ----
+
+def test_sync_gradients_fn_fp16_compression():
+    from paddle_tpu.distributed.data_parallel import sync_gradients_fn
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+    sync16 = sync_gradients_fn("data", comm_dtype="float16")
+    sync32 = sync_gradients_fn("data")
+
+    def run(sync):
+        def f(g):
+            return sync({"w": g})["w"]
+        from jax.sharding import PartitionSpec as P
+        m = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("data"),
+                                  out_specs=P("data")))
+        g = jnp.stack([jnp.full((4,), 1.0001, jnp.float32),
+                       jnp.full((4,), 3.0001, jnp.float32)])
+        return np.asarray(m(g))
+
+    out16, out32 = run(sync16), run(sync32)
+    # both average to ~2.0001; the fp16 path quantizes (not equal bitwise)
+    np.testing.assert_allclose(out16, 2.0, atol=1e-2)
+    np.testing.assert_allclose(out32, 2.0001, atol=1e-5)
+    assert not np.array_equal(out16, out32)
+    # and the jaxpr really casts before the psum
+    from jax.sharding import PartitionSpec as P
+
+    def f16(g):
+        return sync16({"w": g})["w"]
+    jx = str(jax.make_jaxpr(jax.shard_map(
+        f16, mesh=mesh, in_specs=P("data"), out_specs=P("data")))(
+        jnp.ones((2, 4), jnp.float32)))
+    assert "f16" in jx
+
+
+def test_selective_recompute_direct_step_construction():
+    """A directly-built ShardedTrainStep (no parallelize) with
+    recompute_checkpoints must still remat — never silently drop it."""
+    from paddle_tpu.parallel import ShardedTrainStep
+    s = DistributedStrategy()
+    s.recompute = True
+    s.recompute_configs = {"checkpoints": ["fc1"]}
+    plan = sc.StrategyCompiler().compile(s)
+    paddle.seed(0)
+    model = TinyMLP()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    step = ShardedTrainStep(model, opt, _mesh(data=2), loss_fn=_mse,
+                            plan=plan)
+    assert getattr(model.fc1.forward, "_is_remat_wrapped", False)
+    assert "remat" in _step_jaxpr(step)
+    x, y = _data()
+    assert np.isfinite(float(step(x, y).item()))
