@@ -114,56 +114,67 @@ class SparseTable:
         return True
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
+        with self._lock:
+            return self._pull_locked(ids)
+
+    def _pull_locked(self, ids: np.ndarray) -> np.ndarray:
+        """Pull body with self._lock HELD by the caller (subclasses compose
+        promote/evict around it under one critical section)."""
         out = np.empty((len(ids), self.dim), np.float32)
         fresh: Dict[int, np.ndarray] = {}  # unadmitted rows drawn this pull
-        with self._lock:
-            for i, key in enumerate(np.asarray(ids, np.int64)):
-                k = int(key)
-                row = self._rows.get(k)
+        for i, key in enumerate(np.asarray(ids, np.int64)):
+            k = int(key)
+            row = self._rows.get(k)
+            if row is None:
+                row = fresh.get(k)
                 if row is None:
-                    row = fresh.get(k)
-                    if row is None:
-                        row = (self._rng.randn(self.dim) *
-                               self.init_std).astype(np.float32)
-                    if self._admit(k):
-                        self._rows[k] = row
-                    else:
-                        # duplicates of an unadmitted id within one batch
-                        # must see ONE consistent vector
-                        fresh[k] = row
-                out[i] = row
+                    row = (self._rng.randn(self.dim) *
+                           self.init_std).astype(np.float32)
+                if self._admit(k):
+                    self._rows[k] = row
+                else:
+                    # duplicates of an unadmitted id within one batch
+                    # must see ONE consistent vector
+                    fresh[k] = row
+            out[i] = row
         return out
 
     def push(self, ids: np.ndarray, grads: np.ndarray):
+        with self._lock:
+            self._push_locked(ids, grads)
+
+    def _push_locked(self, ids: np.ndarray, grads: np.ndarray):
         ids = np.asarray(ids, np.int64)
         # merge duplicate ids (scatter::MergeAdd) before the rule
         uniq, inv = np.unique(ids, return_inverse=True)
         merged = np.zeros((len(uniq), self.dim), np.float32)
         np.add.at(merged, inv, np.asarray(grads, np.float32))
-        with self._lock:
-            for i, key in enumerate(uniq):
-                k = int(key)
-                row = self._rows.get(k)
-                if row is None:
-                    continue  # pushed before ever pulled: ignore
-                new_row, slot = self.accessor.apply(
-                    row, merged[i], self._slots.get(k))
-                self._rows[k] = new_row
-                if slot is not None:
-                    self._slots[k] = slot
+        for i, key in enumerate(uniq):
+            k = int(key)
+            row = self._rows.get(k)
+            if row is None:
+                continue  # pushed before ever pulled: ignore
+            new_row, slot = self.accessor.apply(
+                row, merged[i], self._slots.get(k))
+            self._rows[k] = new_row
+            if slot is not None:
+                self._slots[k] = slot
 
     def state(self):
         """Rows AND optimizer slots: the reference's common sparse table
         persists optimizer columns (g2sum) with the row values, so a
         save/load roundtrip must not reset AdaGrad accumulators."""
         with self._lock:
-            ids = np.asarray(sorted(self._rows), np.int64)
-            vals = np.stack([self._rows[int(i)] for i in ids]) if len(ids) \
-                else np.zeros((0, self.dim), np.float32)
-            slot_ids = np.asarray(sorted(self._slots), np.int64)
-            slot_vals = np.stack(
-                [self._slots[int(i)] for i in slot_ids]) if len(slot_ids) \
-                else np.zeros((0, self.dim), np.float32)
+            return self._state_locked()
+
+    def _state_locked(self):
+        ids = np.asarray(sorted(self._rows), np.int64)
+        vals = np.stack([self._rows[int(i)] for i in ids]) if len(ids) \
+            else np.zeros((0, self.dim), np.float32)
+        slot_ids = np.asarray(sorted(self._slots), np.int64)
+        slot_vals = np.stack(
+            [self._slots[int(i)] for i in slot_ids]) if len(slot_ids) \
+            else np.zeros((0, self.dim), np.float32)
         return ids, vals, slot_ids, slot_vals
 
     def seen_state(self):
@@ -187,6 +198,140 @@ class SparseTable:
                 for i, key in enumerate(np.asarray(slot_ids, np.int64)):
                     self._slots[int(key)] = np.asarray(slot_vals[i],
                                                        np.float32)
+
+
+class SSDSparseTable(SparseTable):
+    """Beyond-RAM embedding table (ssd_sparse_table.cc analog): hot rows
+    live in memory, cold rows spill to an on-disk key-value store and are
+    promoted back on access. The reference backs this with RocksDB; this
+    toolchain has no RocksDB, so the disk tier is stdlib `dbm` — same
+    contract (persistent kv of row+slot bytes), different engine.
+
+    mem_row_budget bounds the in-memory row count; eviction is LRU over
+    the ids touched by pull/push. The budget must exceed the largest
+    single batch's unique-id count (rows of the live batch stay hot)."""
+
+    def __init__(self, dim: int, accessor: "SparseAccessor" = None,
+                 init_std: float = 0.01, seed: int = 0, entry=None,
+                 path: str = None, mem_row_budget: int = 100000):
+        super().__init__(dim, accessor, init_std, seed, entry=entry)
+        import dbm
+        import os as _os
+        import tempfile
+        from collections import OrderedDict
+        if path is None:
+            path = _os.path.join(
+                tempfile.mkdtemp(prefix="pd_ssd_table_"), "rows")
+        self._ssd_path = path
+        _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
+        self._db = dbm.open(path, "c")
+        self._budget = max(int(mem_row_budget), 1)
+        self._hot = OrderedDict()
+
+    # -- disk tier --
+    def _disk_put(self, k: int, row: np.ndarray, slot):
+        has_slot = slot is not None
+        raw = bytes([1 if has_slot else 0]) + row.tobytes() + \
+            (slot.tobytes() if has_slot else b"")
+        self._db[str(k).encode()] = raw
+
+    def _disk_pop(self, k: int):
+        key = str(k).encode()
+        raw = self._db.get(key)
+        if raw is None:
+            return None
+        del self._db[key]
+        has_slot = raw[0] == 1
+        row = np.frombuffer(raw, np.float32, self.dim, 1).copy()
+        slot = np.frombuffer(raw, np.float32, self.dim,
+                             1 + self.dim * 4).copy() if has_slot else None
+        return row, slot
+
+    def _promote(self, ids):
+        """Move disk rows of the working set into memory (under _lock)."""
+        for key in np.unique(np.asarray(ids, np.int64)):
+            k = int(key)
+            if k in self._rows:
+                continue
+            hit = self._disk_pop(k)
+            if hit is not None:
+                self._rows[k] = hit[0]
+                if hit[1] is not None:
+                    self._slots[k] = hit[1]
+
+    def _touch_and_evict(self, ids):
+        """LRU-bump the working set, spill past-budget cold rows (under
+        _lock). Rows just touched are most-recent and never evicted by
+        this call."""
+        for key in np.unique(np.asarray(ids, np.int64)):
+            k = int(key)
+            if k in self._rows:
+                self._hot[k] = True
+                self._hot.move_to_end(k)
+        for k in list(self._rows):
+            if k not in self._hot:  # e.g. load_state-restored rows
+                self._hot[k] = True
+        while len(self._rows) > self._budget:
+            k, _ = self._hot.popitem(last=False)
+            row = self._rows.pop(k, None)
+            if row is not None:
+                self._disk_put(k, row, self._slots.pop(k, None))
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        # promote + pull + evict under ONE critical section: a concurrent
+        # request must never evict a just-promoted row before the pull body
+        # reads it (the base would re-initialize it from the RNG, silently
+        # losing the trained values)
+        with self._lock:
+            self._promote(ids)
+            out = self._pull_locked(ids)
+            self._touch_and_evict(ids)
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        with self._lock:
+            self._promote(ids)
+            self._push_locked(ids, grads)
+            self._touch_and_evict(ids)
+
+    def mem_rows(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    def disk_rows(self) -> int:
+        with self._lock:
+            return len(self._db)
+
+    def state(self):
+        """Checkpoint view merges BOTH tiers under one lock (the
+        reference's save walks memory and rocksdb)."""
+        with self._lock:
+            mem_ids, mem_vals, mem_sids, mem_svals = self._state_locked()
+            # .keys() is the portable dbm iteration (gnu/ndbm/dumb all
+            # support it; firstkey/nextkey are gdbm-only)
+            disk = {int(k.decode()): self._db[k] for k in self._db.keys()}
+        if not disk:
+            return mem_ids, mem_vals, mem_sids, mem_svals
+        d_ids, d_vals, d_sids, d_svals = [], [], [], []
+        for i in sorted(disk):
+            raw = disk[i]
+            d_ids.append(i)
+            d_vals.append(np.frombuffer(raw, np.float32, self.dim, 1))
+            if raw[0] == 1:
+                d_sids.append(i)
+                d_svals.append(np.frombuffer(raw, np.float32, self.dim,
+                                             1 + self.dim * 4))
+        ids = np.concatenate([mem_ids, np.asarray(d_ids, np.int64)])
+        order = np.argsort(ids, kind="stable")
+        vals = np.concatenate([
+            mem_vals, np.stack(d_vals) if d_vals
+            else np.zeros((0, self.dim), np.float32)])
+        sids = np.concatenate([mem_sids, np.asarray(d_sids, np.int64)])
+        sorder = np.argsort(sids, kind="stable")
+        svals = np.concatenate([
+            mem_svals, np.stack(d_svals) if d_svals
+            else np.zeros((0, self.dim), np.float32)])
+        return ids[order], vals[order], sids[sorder], svals[sorder]
 
 
 class DenseTable:
@@ -282,10 +427,21 @@ class PSCore:
         return self.graph_tables[name]
 
     def create_table(self, name: str, dim: int, rule="sgd", lr=0.01,
-                     init_std=0.01, seed=0, entry=None):
+                     init_std=0.01, seed=0, entry=None,
+                     table_class="memory", ssd_path=None,
+                     mem_row_budget=100000):
+        """table_class 'memory' -> SparseTable; 'ssd' -> SSDSparseTable
+        (disk-spill tier, ssd_sparse_table.cc analog)."""
         if name not in self.tables:
-            self.tables[name] = SparseTable(
-                dim, SparseAccessor(rule, lr), init_std, seed, entry=entry)
+            if table_class == "ssd":
+                self.tables[name] = SSDSparseTable(
+                    dim, SparseAccessor(rule, lr), init_std, seed,
+                    entry=entry, path=ssd_path,
+                    mem_row_budget=mem_row_budget)
+            else:
+                self.tables[name] = SparseTable(
+                    dim, SparseAccessor(rule, lr), init_std, seed,
+                    entry=entry)
         return self.tables[name]
 
     def create_dense_table(self, name: str, shape, rule="sgd", lr=0.01,
@@ -1120,6 +1276,86 @@ class TheOnePSRuntime:
         for s in self.servers:
             s.stop()
         self.servers = []
+
+
+class HeterPSEmbeddingPass:
+    """Accelerator-resident embedding training pass (the heter-PS training
+    pipeline; reference framework/fleet/heter_ps/heter_comm.h +
+    ps_gpu_wrapper.cc: BuildGPUTask pulls the pass's rows into GPU
+    hashtables, minibatches train against the resident copy, EndPass
+    flushes updates back to the PS). TPU-native recast:
+
+      1. begin_pass(ids_of_the_pass): ONE PS pull of the pass's unique
+         rows into a device-resident [n_unique, dim] jnp array (TPU HBM);
+      2. per batch: slots_for(ids) maps ids -> row slots host-side; the
+         jitted step gathers `table[slots]` ON DEVICE and differentiates
+         w.r.t. the table arg — grads accumulate in a device buffer
+         (accumulate_grad), no host hop per batch;
+      3. end_pass(): ONE pull-to-host + push of the accumulated grads; the
+         server-side accessor applies the update rule (pass-wise sync,
+         exactly the reference's EndPass contract).
+
+    Two PS round-trips per PASS instead of two per BATCH."""
+
+    def __init__(self, client: "PSClient", table: str, embedding_dim: int,
+                 rule="sgd", lr=0.01, init_std=0.01):
+        self.client = client
+        self.table = table
+        self.embedding_dim = embedding_dim
+        client.create_table(table, embedding_dim, rule, lr, init_std)
+        self._uniq = None
+        self._device_table = None
+        self._grad_acc = None
+
+    def begin_pass(self, ids) -> None:
+        """BuildGPUTask analog: resident-load the pass's working set."""
+        import jax.numpy as jnp
+        uniq = np.unique(np.asarray(ids, np.int64).reshape(-1))
+        rows = self.client.pull_sparse(self.table, uniq)
+        self._uniq = uniq
+        self._device_table = jnp.asarray(rows)
+        self._grad_acc = jnp.zeros_like(self._device_table)
+
+    @property
+    def device_table(self):
+        """The HBM-resident rows — pass as an argument into the jitted
+        step (so donation/update work) and gather `table[slots]` inside."""
+        if self._device_table is None:
+            raise RuntimeError("call begin_pass(ids) first")
+        return self._device_table
+
+    def slots_for(self, ids) -> np.ndarray:
+        """Host-side id -> resident-slot mapping for one batch (vectorized:
+        self._uniq is sorted by np.unique, so this is one searchsorted +
+        one membership check — no per-id Python loop in the hot path)."""
+        flat = np.asarray(ids, np.int64).reshape(-1)
+        slots = np.searchsorted(self._uniq, flat).astype(np.int32)
+        in_range = slots < len(self._uniq)
+        ok = in_range.copy()
+        ok[in_range] = self._uniq[slots[in_range]] == flat[in_range]
+        if not ok.all():
+            bad = flat[~ok][0]
+            raise KeyError(
+                f"id {int(bad)} was not declared in begin_pass — the heter "
+                "pass trains only its declared working set (ps_gpu_wrapper "
+                "builds the task from the pass's dataset)")
+        return slots.reshape(np.asarray(ids).shape)
+
+    def accumulate_grad(self, d_table) -> None:
+        """Add one step's d(loss)/d(device_table) (stays on device)."""
+        self._grad_acc = self._grad_acc + d_table
+
+    def end_pass(self) -> None:
+        """EndPass analog: ONE host transfer + PS push; the accessor
+        applies the rule server-side. The resident copy is dropped (it is
+        stale the moment the push lands)."""
+        grads = np.asarray(self._grad_acc, np.float32)
+        nz = np.any(grads != 0.0, axis=1)
+        if nz.any():
+            self.client.push_sparse(self.table, self._uniq[nz], grads[nz])
+        self._uniq = None
+        self._device_table = None
+        self._grad_acc = None
 
 
 class PSEmbedding:

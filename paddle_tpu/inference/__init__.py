@@ -29,26 +29,65 @@ from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 
 
-def export_model(layer: Layer, example_inputs, path: str):
+def _qspec(entry):
+    """Normalize a qweights entry (q, scale, channel_axis[, bits])."""
+    q, scale, ca = entry[0], entry[1], entry[2]
+    bits = entry[3] if len(entry) > 3 else 8
+    return q, scale, int(ca), int(bits)
+
+
+def export_model(layer: Layer, example_inputs, path: str, qweights=None):
     """Export a Layer for serving: StableHLO module + weights + metadata.
 
     example_inputs: list of Tensors/arrays fixing the traced shapes (dynamic
     batch via jax.export symbolic dims is a follow-up).
+
+    qweights (int8 serving, post_training_quantization.py:1 output consumed
+    by the inference engine / quantization_pass.py's insert-dequant shape):
+    {param_key: (int8 ndarray, fp32 scale scalar-or-per-channel,
+    channel_axis[, bits])}. Quantized weights enter the exported StableHLO
+    graph AS INT8 arguments and are dequantized on device (convert +
+    per-channel scale, fused by XLA into the consuming matmul/conv
+    prologue); the .pdweights/.pdiparams artifacts store int8 — ~4x
+    smaller — and the C++ predictor uploads them unchanged (the PDW1
+    format is typed per tensor). Scales are baked in as constants.
     """
+    qweights = {k: _qspec(v) for k, v in (qweights or {}).items()}
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     params, buffers = layer.functional_state()
     arrays = [a.data if isinstance(a, Tensor) else jnp.asarray(a)
               for a in example_inputs]
+    missing = [k for k in qweights if k not in params]
+    if missing:
+        raise KeyError(
+            f"qweights keys not in model params: {missing[:4]} "
+            f"(known params e.g. {list(params)[:4]})")
+    qparams = dict(params)
+    for k, (q, _s, _ca, _b) in qweights.items():
+        qparams[k] = jnp.asarray(np.asarray(q, np.int8))
 
-    def fwd(params, buffers, *xs):
+    def dequant(k, qarr):
+        _q, scale, ca, bits = qweights[k]
+        qmax = float(2 ** (bits - 1) - 1)
+        s = jnp.asarray(np.asarray(scale, np.float32))
+        if s.ndim:
+            shape = [1] * qarr.ndim
+            shape[ca % qarr.ndim] = -1
+            s = s.reshape(shape)
+        return qarr.astype(jnp.float32) * (s / qmax)
+
+    def fwd(qp, buffers, *xs):
+        p = {k: (dequant(k, v) if k in qweights else v)
+             for k, v in qp.items()}
         layer.eval()
-        return layer.functional_call(params, buffers, *xs)
+        return layer.functional_call(p, buffers, *xs)
 
-    exported = jax.export.export(jax.jit(fwd))(params, buffers, *arrays)
+    exported = jax.export.export(jax.jit(fwd))(qparams, buffers, *arrays)
     with open(path + ".stablehlo", "wb") as f:
         f.write(exported.serialize())
     from ..framework_io import save as _save
-    _save({"params": params, "buffers": buffers}, path + ".pdiparams")
+    _save({"params": {k: np.asarray(v) for k, v in qparams.items()},
+           "buffers": buffers}, path + ".pdiparams")
 
     # --- C++ predictor artifacts (csrc/predictor consumes these) ---
     # raw StableHLO portable bytecode: PJRT_Client_Compile format "mlir"
@@ -61,7 +100,7 @@ def export_model(layer: Layer, example_inputs, path: str):
         f.write(_jax_compiler.get_compile_options(
             num_replicas=1, num_partitions=1).SerializeAsString())
     # flat little-endian weights in traced argument order
-    weight_leaves = jax.tree_util.tree_leaves((params, buffers))
+    weight_leaves = jax.tree_util.tree_leaves((qparams, buffers))
     _write_weights(path + ".pdweights", weight_leaves)
 
     meta = {
@@ -73,9 +112,22 @@ def export_model(layer: Layer, example_inputs, path: str):
         "output_names": ["output"],
         "n_weights": len(weight_leaves),
     }
+    if qweights:
+        meta["quantized"] = {
+            k: {"bits": b, "channel_axis": ca}
+            for k, (_q, _s, ca, b) in qweights.items()}
     with open(path + ".pdmodel.json", "w") as f:
         json.dump(meta, f)
     return path
+
+
+def export_quantized_model(layer: Layer, example_inputs, path: str,
+                           qweights: Dict[str, tuple]):
+    """Int8 serving export — see export_model's qweights contract."""
+    if not qweights:
+        raise ValueError("export_quantized_model needs non-empty qweights; "
+                         "use export_model for a float export")
+    return export_model(layer, example_inputs, path, qweights=qweights)
 
 
 # PJRT_Buffer_Type enum values (pjrt_c_api.h:853-913)

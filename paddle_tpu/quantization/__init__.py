@@ -259,7 +259,12 @@ class ImperativeQuantAware:
         return model
 
     def save_quantized_model(self, model, path, input_spec=None):
-        from ..inference import export_model
+        """QAT export: trained fake-quant weights quantize to int8 on the
+        learned grid (idempotent — the QuantedLayer re-fake-quants the
+        dequantized weight to the same values) and serve through the int8
+        predictor artifact."""
+        from ..inference import export_model, export_quantized_model
+        from ..nn.layer.conv import Conv2D
         if input_spec is None:
             raise ValueError("save_quantized_model requires input_spec "
                              "(example inputs fixing traced shapes)")
@@ -269,7 +274,23 @@ class ImperativeQuantAware:
                              np.dtype(getattr(s, "dtype", "float32")))
                     for s in input_spec]
         model.eval()
-        return export_model(model, examples, path)
+        qweights = {}
+        for n, l in model.named_sublayers():
+            if not isinstance(l, QuantedLayer):
+                continue
+            ca = 0 if isinstance(l.inner, Conv2D) else -1
+            # the LAYER's trained grid, not this exporting driver's config:
+            # a 4-bit-trained model must export on its own 4-bit grid
+            bits = getattr(l.weight_quanter, "_bits", self._wbits)
+            q, scale = quantize_weight(
+                l.inner.weight.numpy(), bits,
+                channel_wise=l.weight_quanter._channel_wise
+                if hasattr(l.weight_quanter, "_channel_wise") else True,
+                channel_axis=ca)
+            qweights[f"{n}.inner.weight"] = (q, scale, ca, bits)
+        if not qweights:
+            return export_model(model, examples, path)
+        return export_quantized_model(model, examples, path, qweights)
 
 
 class PostTrainingQuantization:
@@ -359,9 +380,20 @@ class PostTrainingQuantization:
         return self.model
 
     def save_quantized_model(self, path, input_spec):
+        """Serving export that the predictor actually consumes as int8:
+        quantized weights ride the artifact as int8 args with on-device
+        dequant (inference.export_quantized_model), plus the .quant side
+        file with raw int8 state + scales for tooling."""
         from ..framework_io import save
-        from ..inference import export_model
-        export_model(self.model, input_spec, path)
+        from ..inference import export_quantized_model
+        from ..nn.layer.conv import Conv2D
+        sub = dict(self.model.named_sublayers())
+        qweights = {}
+        for key, q in self.int8_state.items():
+            n = key[:-len(".weight")]
+            ca = 0 if isinstance(sub.get(n), Conv2D) else -1
+            qweights[key] = (q, self.scales[n]["weight"], ca, self._wbits)
+        export_quantized_model(self.model, input_spec, path, qweights)
         save({"int8_weights": self.int8_state, "scales": self.scales},
              path + ".quant")
         return path

@@ -1,0 +1,19 @@
+"""Shared test helper: walk a .pdweights (PDW1) artifact and return the
+per-tensor PJRT type codes — used by the quantization and C++ predictor
+suites to assert int8 weights really reach the serving artifact."""
+import struct
+
+
+def parse_pdweights_types(path):
+    raw = open(path, "rb").read()
+    assert raw[:4] == b"PDW1"
+    (count,) = struct.unpack_from("<I", raw, 4)
+    off, codes = 8, []
+    for _ in range(count):
+        code, ndim = struct.unpack_from("<II", raw, off)
+        off += 8 + 8 * ndim
+        (nbytes,) = struct.unpack_from("<Q", raw, off)
+        off += 8 + nbytes
+        codes.append(code)
+    assert off == len(raw)
+    return codes

@@ -899,3 +899,106 @@ def test_graph_table_runtime_checkpoint_and_reshard(tmp_path):
     np.testing.assert_array_equal(nbr3, [12])
     np.testing.assert_allclose(w3, [3.0])
     assert rt3.client.graph_get_node_feat("g", [2], ["label"]) == [["x"]]
+
+
+# ---------------- SSD spill table (ssd_sparse_table.cc analog) --------------
+
+def test_ssd_table_spills_and_restores_rows(tmp_path):
+    """Rows past the memory budget spill to disk and come back EXACTLY
+    (values + optimizer slots) when re-touched — beyond-RAM embeddings."""
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import PSCore
+    core = PSCore()
+    t = core.create_table("big", 4, rule="adagrad", lr=1.0, init_std=0.0,
+                          table_class="ssd",
+                          ssd_path=str(tmp_path / "rows"),
+                          mem_row_budget=8)
+    # touch 24 ids in 3 waves of 8: every wave evicts the previous one
+    for wave in range(3):
+        ids = np.arange(wave * 8, wave * 8 + 8)
+        t.pull(ids)
+        t.push(ids, np.full((8, 4), float(wave + 1), np.float32))
+    assert t.mem_rows() <= 8
+    assert t.disk_rows() >= 16
+    # wave-0 rows were spilled twice-removed; their adagrad state must
+    # survive the roundtrip: value = -g/sqrt(g^2) = -1.0 after one push
+    v0 = t.pull(np.arange(8))
+    np.testing.assert_allclose(v0, -1.0, atol=1e-5)
+    # push again: accumulator g2sum=1 came back from disk -> next step
+    # uses sqrt(1+1), NOT sqrt(1)
+    t.push(np.arange(8), np.ones((8, 4), np.float32))
+    v1 = t.pull(np.arange(8))
+    np.testing.assert_allclose(v1, -1.0 - 1.0 / np.sqrt(2.0), atol=1e-4)
+
+
+def test_ssd_table_checkpoint_merges_both_tiers(tmp_path):
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import PSCore
+    core = PSCore()
+    t = core.create_table("big", 4, rule="sgd", lr=0.5, init_std=0.0,
+                          table_class="ssd",
+                          ssd_path=str(tmp_path / "rows"),
+                          mem_row_budget=4)
+    t.pull(np.arange(12))
+    t.push(np.arange(12), np.ones((12, 4), np.float32))
+    ids, vals, _, _ = t.state()
+    np.testing.assert_array_equal(ids, np.arange(12))
+    np.testing.assert_allclose(vals, -0.5, atol=1e-6)
+    assert t.mem_rows() < 12  # state really did merge a disk tier
+
+
+# --------- heter-PS training pipeline (ps_gpu_wrapper.cc analog) -----------
+
+def test_heter_pass_device_resident_embedding_training():
+    """The heter training pipeline: one pull per PASS into a device-
+    resident table, jitted per-batch gather + grad accumulation on device,
+    one push per pass applied by the server-side rule."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.fleet.runtime.the_one_ps import (
+        HeterPSEmbeddingPass, PSClient, PSCore)
+    client = PSClient(cores=[PSCore(), PSCore()])
+    emb = HeterPSEmbeddingPass(client, "emb", 4, rule="sgd", lr=0.5,
+                               init_std=0.0)
+
+    pass_ids = np.arange(10)
+    emb.begin_pass(pass_ids)
+    assert emb.device_table.shape == (10, 4)
+    np.testing.assert_allclose(np.asarray(emb.device_table), 0.0)
+
+    @jax.jit
+    def step(table, slots, target):
+        def loss_fn(t):
+            e = t[slots]  # device gather from the resident table
+            return jnp.mean((e - target) ** 2)
+        loss, d_table = jax.value_and_grad(loss_fn)(table)
+        return loss, d_table
+
+    # two batches against the SAME resident copy — no PS traffic between
+    for batch in (np.array([0, 1, 2, 3]), np.array([2, 3, 8, 9])):
+        slots = emb.slots_for(batch)
+        loss, d_table = step(emb.device_table, jnp.asarray(slots),
+                             jnp.ones((len(batch), 4), jnp.float32))
+        assert np.isfinite(float(loss))
+        emb.accumulate_grad(d_table)
+
+    acc = np.asarray(emb._grad_acc)
+    # ids 2,3 appeared in both batches: their accumulated grad doubles
+    np.testing.assert_allclose(acc[2], acc[0] * 2, atol=1e-6)
+    assert np.abs(acc[4:8]).max() == 0.0  # untouched ids: no grad
+
+    emb.end_pass()
+    # the push landed server-side: sgd lr=0.5 moved the touched rows
+    rows = client.pull_sparse("emb", pass_ids)
+    assert np.abs(rows[0]).max() > 0.0
+    np.testing.assert_allclose(rows[4:8], 0.0)  # untouched rows unmoved
+    np.testing.assert_allclose(rows[2], rows[0] * 2, atol=1e-6)
+
+    # a fresh pass sees the UPDATED server rows
+    emb.begin_pass(np.array([0, 2]))
+    np.testing.assert_allclose(np.asarray(emb.device_table),
+                               rows[[0, 2]], atol=1e-7)
+    emb.end_pass()
+
+    # out-of-working-set ids fail loud, like BuildGPUTask's task scope
+    emb.begin_pass(np.array([1]))
+    with pytest.raises(KeyError, match="begin_pass"):
+        emb.slots_for(np.array([7]))

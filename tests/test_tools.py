@@ -1,0 +1,81 @@
+"""Benchmark regression gate (tools/check_bench_result.py — the
+check_op_benchmark_result.py analog, VERDICT r4 item 10): measured chip rows
+gate against pinned per-preset MFU floors; regressions fail, CPU-fallback
+rows never gate."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import check_bench_result as gate  # noqa: E402
+
+
+def _row(preset, mfu, backend="tpu", err=None):
+    if err:
+        return {"tag": preset, "error": err}
+    return {"metric": f"tokens/sec/chip {preset} bs8 seq1024 bf16",
+            "value": 1.0, "extra": {"mfu": mfu, "backend": backend}}
+
+
+def _write(tmp_path, name, obj):
+    p = str(tmp_path / name)
+    with open(p, "w") as f:
+        json.dump(obj, f)
+    return p
+
+
+def test_gate_passes_within_tolerance(tmp_path, capsys):
+    new = _write(tmp_path, "new.json", [_row("gpt3-125m", 0.31)])
+    th = _write(tmp_path, "th.json", {"gpt3-125m": {"mfu": 0.32}})
+    rc = gate.main(["--new", new, "--thresholds", th,
+                    "--max-regress", "0.05"])
+    assert rc == 0  # 0.31 >= 0.32 * 0.95
+
+
+def test_gate_fails_on_regression(tmp_path, capsys):
+    new = _write(tmp_path, "new.json", [_row("gpt3-125m", 0.25)])
+    th = _write(tmp_path, "th.json", {"gpt3-125m": {"mfu": 0.32}})
+    rc = gate.main(["--new", new, "--thresholds", th,
+                    "--max-regress", "0.05"])
+    assert rc == 2
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_cpu_fallback_and_error_rows_never_gate(tmp_path):
+    new = _write(tmp_path, "new.json", [
+        _row("gpt3-125m", 0.01, backend="cpu"),
+        _row("gpt3-350m", None, err="hung>900s")])
+    th = _write(tmp_path, "th.json", {"gpt3-125m": {"mfu": 0.32}})
+    rc = gate.main(["--new", new, "--thresholds", th])
+    assert rc == 0  # vacuous: no chip rows
+
+
+def test_gate_takes_best_row_per_preset(tmp_path):
+    new = _write(tmp_path, "new.json", [
+        _row("gpt3-125m", 0.20), _row("gpt3-125m", 0.33)])
+    th = _write(tmp_path, "th.json", {"gpt3-125m": {"mfu": 0.32}})
+    assert gate.main(["--new", new, "--thresholds", th]) == 0
+
+
+def test_update_raises_floors_only_upward(tmp_path):
+    new = _write(tmp_path, "new.json", [_row("gpt3-125m", 0.30)])
+    th = _write(tmp_path, "th.json", {"gpt3-125m": {"mfu": 0.32}})
+    gate.main(["--new", new, "--thresholds", th, "--update"])
+    assert json.load(open(th))["gpt3-125m"]["mfu"] == 0.32  # not lowered
+    new2 = _write(tmp_path, "new2.json", [_row("gpt3-125m", 0.40)])
+    gate.main(["--new", new2, "--thresholds", th, "--update"])
+    assert json.load(open(th))["gpt3-125m"]["mfu"] == 0.40
+
+
+def test_measured_json_dict_shape_parses(tmp_path):
+    new = _write(tmp_path, "m.json", {"results": [
+        {"metric": "tokens/sec/chip GPT(gpt3-125m) bs8 seq1024",
+         "value": 1.0, "mfu_6nd": 0.3227}]})
+    th = _write(tmp_path, "th.json", {"gpt3-125m": {"mfu": 0.32}})
+    assert gate.main(["--new", new, "--thresholds", th]) == 0
+
+
+def test_repo_thresholds_pass_against_history():
+    assert gate.main(["--new", os.path.join(gate.REPO,
+                                            "BENCH_MEASURED.json")]) == 0

@@ -1,0 +1,120 @@
+"""Benchmark regression gate (reference: tools/check_op_benchmark_result.py:1,
+which diffs develop-vs-PR op benchmark logs and fails the CI on speed
+regressions). TPU analog: measured chip rows (BENCH_SWEEP.json /
+BENCH_MEASURED.json style) are checked against pinned per-preset floors in
+tools/bench_thresholds.json; an MFU drop beyond --max-regress fails the gate
+(exit 2) instead of relying on judge-side JSON diffing.
+
+    python tools/check_bench_result.py                 # gate current sweep
+    python tools/check_bench_result.py --update        # raise floors to best
+    python tools/check_bench_result.py --new f.json --max-regress 0.05
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_NEW = os.path.join(REPO, "BENCH_SWEEP.json")
+THRESHOLDS = os.path.join(REPO, "tools", "bench_thresholds.json")
+
+
+def _rows(path):
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):  # BENCH_MEASURED.json shape
+        data = data.get("results", [])
+    return data
+
+
+def _preset_of(row):
+    metric = row.get("metric", "")
+    parts = metric.split()
+    # "tokens/sec/chip <preset> bs8 seq1024 ..." — the preset token
+    if len(parts) >= 2 and "/" in parts[0]:
+        p = parts[1]
+        return p[4:-1] if p.startswith("GPT(") else p
+    return row.get("tag")
+
+
+def _mfu(row):
+    extra = row.get("extra") or {}
+    v = extra.get("mfu", row.get("mfu_6nd"))
+    return float(v) if v is not None else None
+
+
+def _is_chip_row(row):
+    if "error" in row:
+        return False
+    extra = row.get("extra") or {}
+    backend = extra.get("backend", "tpu" if "mfu_6nd" in row else None)
+    return backend == "tpu"
+
+
+def best_by_preset(rows):
+    best = {}
+    for r in rows:
+        if not _is_chip_row(r):
+            continue
+        p, m = _preset_of(r), _mfu(r)
+        if p and m is not None and m > best.get(p, -1.0):
+            best[p] = m
+    return best
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--new", default=DEFAULT_NEW,
+                    help="sweep/measured JSON with fresh chip rows")
+    ap.add_argument("--thresholds", default=THRESHOLDS)
+    ap.add_argument("--max-regress", type=float, default=0.05,
+                    help="tolerated fractional MFU drop vs the pinned floor")
+    ap.add_argument("--update", action="store_true",
+                    help="raise floors to the best measured values")
+    args = ap.parse_args(argv)
+
+    floors = {}
+    if os.path.exists(args.thresholds):
+        with open(args.thresholds) as f:
+            floors = json.load(f)
+
+    measured = best_by_preset(_rows(args.new))
+    if args.update:
+        for p, m in measured.items():
+            if m > floors.get(p, {}).get("mfu", -1.0):
+                floors.setdefault(p, {})["mfu"] = round(m, 4)
+        with open(args.thresholds, "w") as f:
+            json.dump(floors, f, indent=1, sort_keys=True)
+        print(f"updated {args.thresholds}: {floors}")
+        return 0
+
+    if not measured:
+        print("no chip-measured rows in", args.new,
+              "- gate is vacuous (tunnel likely down); exit 0")
+        return 0
+
+    failures = []
+    for p, m in sorted(measured.items()):
+        floor = floors.get(p, {}).get("mfu")
+        if floor is None:
+            print(f"  {p:28s} mfu {m:.4f}  (no pinned floor - pass)")
+            continue
+        limit = floor * (1.0 - args.max_regress)
+        verdict = "OK" if m >= limit else "REGRESSION"
+        print(f"  {p:28s} mfu {m:.4f}  floor {floor:.4f} "
+              f"(limit {limit:.4f})  {verdict}")
+        if m < limit:
+            failures.append((p, m, floor))
+    if failures:
+        print(f"FAILED: {len(failures)} preset(s) regressed beyond "
+              f"{args.max_regress:.0%}:",
+              ", ".join(f"{p} {m:.4f}<{f0:.4f}" for p, m, f0 in failures))
+        return 2
+    print("bench gate passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

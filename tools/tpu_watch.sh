@@ -19,7 +19,14 @@ while :; do
     if python tools/tpu_sweep.py presets && \
        python tools/tpu_sweep.py blocks; then
       echo "tpu_watch: sweeps complete"
-      exit 0
+      # perf-regression gate (check_op_benchmark_result analog): a fresh
+      # sweep below the pinned floors must FAIL the watcher, not just log
+      python tools/check_bench_result.py
+      gate_rc=$?
+      if [ $gate_rc -ne 0 ]; then
+        echo "tpu_watch: BENCH GATE FAILED (regression vs pinned floors)"
+      fi
+      exit $gate_rc
     fi
     echo "tpu_watch: sweep aborted (tunnel died?); back to probing"
   else
