@@ -18,10 +18,12 @@ falls back to the original source with a warning, never an error — tracing
 may still succeed if the control flow turns out not to touch tensors.
 
 Supported: if/elif/else (including early `return` in branches), while,
-`for _ in range(...)`, `and`/`or`/`not` (short-circuit preserved for
-non-tensor operands). Not converted (left as plain Python, loud warning when
-relevant): loops containing break/continue/return, `for` over non-range
-iterables.
+`for _ in range(...)`, loop-level `break`/`continue` (lowered to carried
+bool flags with guarded tails, the reference break_continue_transformer
+shape), `and`/`or`/`not` (short-circuit preserved for non-tensor operands).
+Not converted (left as plain Python, loud warning when relevant): loops
+containing `return`, break/continue buried inside try/with (unguardable),
+`for` over non-range iterables.
 """
 from __future__ import annotations
 
@@ -101,8 +103,11 @@ def _merge_leaf(pred, t, f, name=""):
         out = jnp.where(_raw(pred), tr, fr)
         return Tensor(out) if isinstance(t, Tensor) or isinstance(f, Tensor) \
             else out
-    if isinstance(t, (int, float, bool, np.number)) and t == f:
-        return t
+    if isinstance(t, (int, float, bool, np.number)) and \
+            isinstance(f, (int, float, bool, np.number)):
+        # python scalars (e.g. the generated break/continue flags) select
+        # into a traced scalar when the branches disagree
+        return t if t == f else jnp.where(_raw(pred), t, f)
     if t is f or t == f:
         return t
     raise ValueError(
@@ -111,13 +116,19 @@ def _merge_leaf(pred, t, f, name=""):
         "branch can only select between tensors")
 
 
-def run_ifelse(pred, true_fn, false_fn, get_state, set_state, names=()):
+def run_ifelse(pred, true_fn, false_fn, get_state, set_state, names=(),
+               lenient_undef=False):
     """Statement-form converted `if` (reference convert_ifelse).
 
     Eager predicate: execute exactly one branch. Traced predicate: execute
     BOTH branches (select semantics — the standard lowering for data-
     dependent branches on an SPMD machine) and jnp.where-merge every local
-    the branches assign."""
+    the branches assign.
+
+    lenient_undef is set on GENERATED break/continue guard-ifs: a name
+    defined on only one side resolves to the defined side (the undefined
+    side is an aborted iteration whose value is dead — post-loop reads of
+    loop-local temporaries reset to UNDEF separately)."""
     if not _is_traced(pred):
         if _to_bool(pred):
             true_fn()
@@ -130,10 +141,15 @@ def run_ifelse(pred, true_fn, false_fn, get_state, set_state, names=()):
     set_state(init)
     false_fn()
     f_state = get_state()
+    names = names or [""] * len(t_state)
+    if lenient_undef:
+        t_state = tuple(f if t is UNDEF else t
+                        for t, f in zip(t_state, f_state))
+        f_state = tuple(t if f is UNDEF else f
+                        for t, f in zip(t_state, f_state))
     merged = tuple(
         _merge_leaf(pred, t, f, name)
-        for t, f, name in zip(t_state, f_state,
-                              names or [""] * len(t_state)))
+        for t, f, name in zip(t_state, f_state, names))
     set_state(merged)
 
 
@@ -189,17 +205,46 @@ def _flatten_state(state, names):
 
 
 def run_while(cond_fn, body_fn, get_state, set_state, names=()):
-    """Converted `while` (reference convert_while_loop): python loop when
+    """Converted `while` (reference convert_while_loop): python loop while
     the condition is concrete, lax.while_loop with the loop-assigned locals
-    as carry when traced."""
-    first = cond_fn()
-    if not _is_traced(first):
-        while _to_bool(cond_fn()):
-            body_fn()
-        return
+    as carry the moment it turns traced — which can happen MID-loop (e.g. a
+    python-range loop whose break flag becomes a traced bool on the first
+    data-dependent `if`)."""
+    while True:
+        c = cond_fn()
+        if _is_traced(c):
+            return _run_while_traced(cond_fn, body_fn, get_state,
+                                     set_state, names)
+        if not _to_bool(c):
+            return
+        body_fn()
+
+
+def _run_while_traced(cond_fn, body_fn, get_state, set_state, names=()):
     init = get_state()
     names = names or [""] * len(init)
-    arrs, rebuild = _flatten_state(init, names)
+    # names UNDEF at entry are body-local temporaries (written before read
+    # each iteration, e.g. an inner loop's counter): they are NOT carried.
+    # After the loop they reset to UNDEF, so a post-loop read raises the
+    # loud not-defined-on-this-path NameError instead of leaking a tracer.
+    carried = [i for i, v in enumerate(init) if v is not UNDEF]
+    sub_names = [names[i] for i in carried]
+
+    def sub_state():
+        s = get_state()
+        return [s[i] for i in carried]
+
+    def full_set(sub_vals, rest=UNDEF):
+        vals = list(get_state())
+        for i, v in zip(carried, sub_vals):
+            vals[i] = v
+        for i in range(len(vals)):
+            if i not in carried and rest is UNDEF:
+                vals[i] = UNDEF
+        set_state(tuple(vals))
+
+    arrs, rebuild = _flatten_state(sub_state() if carried else [],
+                                   sub_names)
 
     # dtype fixpoint: `s = 0` before `while ...: s = s + x` must carry the
     # PROMOTED dtype (float32), not truncate every iteration back to int.
@@ -207,15 +252,15 @@ def run_while(cond_fn, body_fn, get_state, set_state, names=()):
     # is promoted to them. A body whose output cannot be reached by
     # promotion (e.g. alternating dtypes) fails loud.
     def _body_dtypes(carry):
-        set_state(rebuild(list(carry)))
+        full_set(rebuild(list(carry)), rest=None)
         body_fn()
-        out_arrs, _ = _flatten_state(get_state(), names)
+        out_arrs, _ = _flatten_state(sub_state(), sub_names)
         return tuple(out_arrs)
 
     out_shape = jax.eval_shape(_body_dtypes, tuple(arrs))
-    set_state(rebuild(list(arrs)))  # undo the abstract body's side effects
+    set_state(init)  # undo the abstract body's side effects
     promoted = []
-    for a, o, name in zip(arrs, out_shape, names):
+    for a, o, name in zip(arrs, out_shape, sub_names):
         dt = jnp.promote_types(a.dtype, o.dtype)
         if dt != o.dtype:
             raise ValueError(
@@ -226,17 +271,17 @@ def run_while(cond_fn, body_fn, get_state, set_state, names=()):
     arrs = promoted
 
     def cond(carry):
-        set_state(rebuild(list(carry)))
+        full_set(rebuild(list(carry)), rest=None)
         return _raw(cond_fn())
 
     def body(carry):
-        set_state(rebuild(list(carry)))
+        full_set(rebuild(list(carry)), rest=None)
         body_fn()
-        new_arrs, _ = _flatten_state(get_state(), names)
+        new_arrs, _ = _flatten_state(sub_state(), sub_names)
         return tuple(new_arrs)
 
     out = jax.lax.while_loop(cond, body, tuple(arrs))
-    set_state(rebuild(list(out)))
+    full_set(rebuild(list(out)))  # non-carried names reset to UNDEF
 
 
 def range_start_stop_step(*args):
@@ -314,11 +359,20 @@ def _target_names(t) -> List[str]:
 
 
 def _assigned_names(stmts: Sequence[ast.stmt]) -> List[str]:
-    """Locals bound anywhere in these statements (not descending into nested
-    function scopes)."""
+    """Locals bound anywhere in these statements. Does not descend into
+    nested user scopes — EXCEPT generated __pt_* closures, whose Nonlocal
+    declarations name exactly the outer locals they mutate (an already-
+    converted `if` inside a `while` body must still contribute its
+    branch-assigned names to the loop carry)."""
     names: List[str] = []
 
     def walk(node):
+        if isinstance(node, ast.FunctionDef) and \
+                node.name.startswith("__pt_"):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Nonlocal):
+                    names.extend(sub.names)
+            return
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.Lambda, ast.ClassDef)):
             return
@@ -482,7 +536,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return get_def, set_def
 
     def _preinits(self, names, lineno):
-        return [_stmt(f"{n} = _jst.UNDEF")
+        # generated break/continue flags pre-init to False (their neutral
+        # value — UNDEF would break an enclosing traced while's carry);
+        # user names pre-init to UNDEF so one-branch definitions fail loud
+        return [_stmt(f"{n} = False"
+                      if n.startswith(("__pt_brk_", "__pt_cont_"))
+                      else f"{n} = _jst.UNDEF")
                 for n in names if self.scope.needs_preinit(n, lineno)]
 
     def _branch_def(self, name, suite, nonlocal_names):
@@ -510,9 +569,11 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         t_def = self._branch_def(f"__pt_true_{uid}", node.body, names)
         f_def = self._branch_def(f"__pt_false_{uid}", node.orelse, names)
         get_def, set_def = self._state_helpers(names, uid)
+        lenient = ", lenient_undef=True" \
+            if getattr(node, "_pt_guard", False) else ""
         call = _stmt(
             f"_jst.run_ifelse(None, __pt_true_{uid}, __pt_false_{uid}, "
-            f"__pt_get_{uid}, __pt_set_{uid}, names={names!r})")
+            f"__pt_get_{uid}, __pt_set_{uid}, names={names!r}{lenient})")
         call.value.args[0] = node.test
         out = pre + [t_def, f_def, get_def, set_def, call]
         for s in out:
@@ -520,11 +581,113 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             ast.fix_missing_locations(s)
         return out
 
+    # -- break/continue (reference break_continue_transformer.py): loop-
+    # level break/continue become carried bool flags; statements after a
+    # possible break/continue point are guarded by the flags, and the loop
+    # condition gains `not brk` --
+    def _flag_not_or(self, brk, cont):
+        """AST for `_jst.not_(_jst.or_(lambda: brk, lambda: cont))` — the
+        flags may be traced bools, so plain python `not (a or b)` (which
+        calls __bool__) is not usable in the generated guards."""
+        return ast.parse(
+            f"_jst.not_(_jst.or_(lambda: {brk}, lambda: {cont}))",
+            mode="eval").body
+
+    @staticmethod
+    def _breaks_guardable(stmts) -> bool:
+        """True iff every loop-level break/continue is reachable purely
+        through suite/If nesting — the only shapes _guard_suite rewrites.
+        A break inside try/with cannot become a flag assignment (the
+        rewrite would leave a literal `break` inside a closure: SyntaxError
+        for the WHOLE generated module), so such loops stay python."""
+        def walk(node, in_other_block):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef, ast.For,
+                                 ast.While)):
+                return True  # nested scopes/loops own their breaks
+            if isinstance(node, (ast.Break, ast.Continue)):
+                return not in_other_block
+            blocker = isinstance(node, (ast.Try, ast.With, ast.AsyncWith))
+            return all(walk(c, in_other_block or blocker)
+                       for c in ast.iter_child_nodes(node))
+
+        return all(walk(s, False) for s in stmts)
+
+    def _guard_suite(self, stmts, brk, cont):
+        """Rewrite one suite: break/continue -> flag sets; trailing
+        statements after any possible break/continue point run under an
+        `if not (brk or cont)` guard. Does not descend into nested loops
+        (their break/continue bind to them)."""
+        def hits(s):
+            return isinstance(s, (ast.Break, ast.Continue)) or (
+                isinstance(s, ast.If) and _contains(
+                    s.body + s.orelse, (ast.Break, ast.Continue),
+                    stop_at_loops=True))
+
+        out = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                repl = _stmt(f"{brk} = True")
+            elif isinstance(s, ast.Continue):
+                repl = _stmt(f"{cont} = True")
+            elif hits(s):  # an If containing break/continue for this loop
+                repl = ast.If(
+                    test=s.test,
+                    body=self._guard_suite(s.body, brk, cont)
+                    or [ast.Pass()],
+                    orelse=self._guard_suite(s.orelse, brk, cont))
+                repl._pt_guard = True
+            else:
+                repl = s
+            ast.copy_location(repl, s)
+            ast.fix_missing_locations(repl)
+            out.append(repl)
+            if hits(s) and i + 1 < len(stmts):
+                rest = self._guard_suite(stmts[i + 1:], brk, cont)
+                guard = ast.If(test=self._flag_not_or(brk, cont),
+                               body=rest or [ast.Pass()], orelse=[])
+                guard._pt_guard = True
+                ast.copy_location(guard, s)
+                ast.fix_missing_locations(guard)
+                out.append(guard)
+                break
+        return out
+
     def visit_While(self, node):
         self.generic_visit(node)
-        if node.orelse or _contains(node.body, (ast.Return,)) or _contains(
-                node.body, (ast.Break, ast.Continue), stop_at_loops=True):
+        if node.orelse or _contains(node.body, (ast.Return,)):
             return node  # python semantics (documented unsupported)
+        has_bc = _contains(node.body, (ast.Break, ast.Continue),
+                           stop_at_loops=True)
+        if has_bc and not self._breaks_guardable(node.body):
+            return node  # break inside try/with: keep this loop python
+        pre_flags = []
+        if has_bc:
+            fid = self._uid()
+            brk, cont = f"__pt_brk_{fid}", f"__pt_cont_{fid}"
+            # register the flags as bound just BEFORE this loop (half-line:
+            # the guard-if conversion inside the body must not preinit over
+            # them, but an ENCLOSING loop's conversion must still see them
+            # as needing a function-level binding for its nonlocal chain)
+            for n in (brk, cont):
+                self.scope.bind_lineno[n] = (node.lineno or 1) - 0.5
+            body = self._guard_suite(node.body, brk, cont)
+            # continue only skips the REST of this iteration: reset it at
+            # the top of the body; brk persists and gates the condition
+            body.insert(0, _stmt(f"{cont} = False"))
+            # the guards are data-dependent ifs over (possibly traced)
+            # flags: run them through the if conversion
+            body = [n for s in body
+                    for n in (lambda r: r if isinstance(r, list) else [r])(
+                        self.visit(s) if isinstance(s, ast.If) else s)]
+            cond = ast.parse(
+                f"_jst.and_(lambda: _jst.not_({brk}), lambda: None)",
+                mode="eval").body
+            cond.args[1].body = node.test
+            node = ast.copy_location(
+                ast.While(test=cond, body=body, orelse=[]), node)
+            ast.fix_missing_locations(node)
+            pre_flags = [_stmt(f"{brk} = False"), _stmt(f"{cont} = False")]
         uid = self._uid()
         names = _assigned_names(node.body)
         pre = self._preinits(names, node.lineno)
@@ -535,7 +698,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         call = _stmt(
             f"_jst.run_while(__pt_cond_{uid}, __pt_body_{uid}, "
             f"__pt_get_{uid}, __pt_set_{uid}, names={names!r})")
-        out = pre + [cond_def, body_def, get_def, set_def, call]
+        out = pre_flags + pre + [cond_def, body_def, get_def, set_def, call]
         for s in out:
             ast.copy_location(s, node)
             ast.fix_missing_locations(s)
@@ -543,8 +706,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     def visit_For(self, node):
         self.generic_visit(node)
-        if node.orelse or _contains(node.body, (ast.Return,)) or _contains(
-                node.body, (ast.Break, ast.Continue), stop_at_loops=True):
+        if node.orelse or _contains(node.body, (ast.Return,)):
             return node
         it = node.iter
         if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)

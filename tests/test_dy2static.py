@@ -289,7 +289,10 @@ def test_partial_passthrough_not_aliased():
     np.testing.assert_allclose(np.asarray(pg(d).numpy()), [6.0, 6.0])
 
 
-def test_while_uninitialized_carry_raises():
+def test_while_body_local_temporary_not_carried():
+    """A name first assigned inside the loop body (write-before-read each
+    iteration) is a body-local temporary: it must not block the traced
+    while, and the loop must still match python numerics."""
     def fn(x):
         while x.sum() < 10:
             y = x + 1
@@ -297,8 +300,24 @@ def test_while_uninitialized_carry_raises():
         return x
 
     conv = convert_function(fn)
-    # y is assigned only inside the loop; traced while needs it initialized
-    with pytest.raises(ValueError, match="not defined before"):
+    data = np.ones((2,), np.float32)
+    ref = np.asarray(fn(t(data)).numpy())
+    np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_while_temporary_read_after_loop_fails_loud():
+    def fn(x):
+        while x.sum() < 10:
+            y = x + 1
+            x = y
+        return y  # noqa: F821 — defined only on iterating paths
+
+    conv = convert_function(fn)
+    # traced: y resets to UNDEF after the loop; using it fails loud
+    # (NameError/ValueError from UNDEF ops, or jax's TypeError naming the
+    # _Undefined sentinel when returned directly) — never a silent value
+    # or a leaked-tracer crash
+    with pytest.raises((NameError, ValueError, TypeError)):
         _traced(conv, np.ones((2,), np.float32))
 
 
@@ -520,3 +539,183 @@ def test_transformed_source_is_recorded():
     src = conv._pt_transformed_source
     assert "_jst.run_ifelse" in src
     assert "if " not in src.replace("elif", "")  # the If is gone
+
+
+# ---- break/continue (reference test_break_continue.py patterns) ----
+
+def test_while_break_converts_and_traces():
+    def fn(x):
+        i = x.sum() * 0
+        while i < 100:
+            x = x * 2
+            i = i + 1
+            if x.sum() > 50:
+                break
+        return x, i
+
+    conv = convert_function(fn)
+    assert getattr(conv, "_pt_dy2static", False)
+    data = np.ones((2,), np.float32)
+    ref_x, ref_i = fn(t(data))
+    got_x, got_i = _traced(conv, data)
+    np.testing.assert_allclose(got_x, np.asarray(ref_x.numpy()))
+    np.testing.assert_allclose(got_i, np.asarray(ref_i.numpy()))
+    assert "_pt_brk" in conv._pt_transformed_source.replace("__pt_brk", "_pt_brk")
+
+
+def test_while_break_skips_trailing_statements():
+    def fn(x):
+        acc = x * 0
+        i = x.sum() * 0
+        while i < 10:
+            if i >= 3:
+                break
+            acc = acc + x   # must NOT run on the breaking iteration
+            i = i + 1
+        return acc, i
+
+    conv = convert_function(fn)
+    data = np.full((2,), 2.0, np.float32)
+    ref_acc, ref_i = fn(t(data))
+    assert float(ref_i.numpy()[()] if ref_i.numpy().shape == ()
+                 else ref_i.numpy()) == 3.0
+    got_acc, got_i = _traced(conv, data)
+    np.testing.assert_allclose(got_acc, np.asarray(ref_acc.numpy()))
+    np.testing.assert_allclose(got_i, np.asarray(ref_i.numpy()))
+
+
+def test_while_continue_converts():
+    def fn(x):
+        acc = x * 0
+        i = x.sum() * 0
+        while i < 6:
+            i = i + 1
+            if i.sum() % 2 == 0:
+                continue
+            acc = acc + i  # odd iterations only: 1 + 3 + 5 = 9
+        return acc
+
+    conv = convert_function(fn)
+    data = np.zeros((2,), np.float32)
+    ref = np.asarray(fn(t(data)).numpy())
+    np.testing.assert_allclose(ref, 9.0)
+    np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_for_range_break_converts():
+    def fn(x):
+        for i in range(100):
+            x = x + 1
+            if x.sum() > 10:
+                break
+        return x
+
+    conv = convert_function(fn)
+    data = np.zeros((2,), np.float32)
+    ref = np.asarray(fn(t(data)).numpy())
+    np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_for_range_continue_converts():
+    def fn(x):
+        for i in range(6):
+            if i % 2 == 0:
+                continue
+            x = x + i   # 1 + 3 + 5
+        return x
+
+    conv = convert_function(fn)
+    data = np.zeros((2,), np.float32)
+    ref = np.asarray(fn(t(data)).numpy())
+    np.testing.assert_allclose(ref, 9.0)
+    np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_nested_loop_break_binds_inner():
+    def fn(x):
+        total = x * 0
+        i = x.sum() * 0
+        while i < 3:
+            j = x.sum() * 0
+            while j < 10:
+                if j >= 2:
+                    break   # binds the INNER loop only
+                total = total + 1
+                j = j + 1
+            i = i + 1
+        return total  # 3 outer iterations x 2 inner adds = 6
+
+    conv = convert_function(fn)
+    data = np.zeros((2,), np.float32)
+    ref = np.asarray(fn(t(data)).numpy())
+    np.testing.assert_allclose(ref, 6.0)
+    np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_if_inside_while_carries_branch_assignments():
+    """An `if` inside a `while` assigns through converted closures; those
+    names must still ride the loop carry (regression: _assigned_names
+    descends into generated closures' nonlocal lists)."""
+    def fn(x):
+        y = x * 0
+        i = x.sum() * 0
+        while i < 4:
+            if i.sum() % 2 == 0:
+                y = y + x       # even iterations: i = 0, 2
+            else:
+                y = y - x * 10  # odd iterations: i = 1, 3
+            i = i + 1
+        return y  # 2x - 20x = -18x
+
+    conv = convert_function(fn)
+    data = np.ones((2,), np.float32)
+    ref = np.asarray(fn(t(data)).numpy())
+    np.testing.assert_allclose(ref, -18.0)
+    np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_break_then_fresh_temporary_traces():
+    """A temporary first assigned AFTER a conditional break (the guarded
+    tail) must not break tracing (lenient merge on generated guards)."""
+    def fn(x):
+        i = x.sum() * 0
+        while i < 10:
+            if i >= 3:
+                break
+            y = x + 1
+            i = i + y.sum() * 0 + 1
+        return x, i
+
+    conv = convert_function(fn)
+    data = np.ones((2,), np.float32)
+    ref_x, ref_i = fn(t(data))
+    got_x, got_i = _traced(conv, data)
+    np.testing.assert_allclose(got_x, np.asarray(ref_x.numpy()))
+    np.testing.assert_allclose(got_i, np.asarray(ref_i.numpy()))
+
+
+def test_break_inside_try_keeps_loop_python_but_converts_rest():
+    """break under try/with cannot become a flag; that LOOP stays python
+    while the rest of the function still converts (no whole-function
+    fallback via generated-module SyntaxError)."""
+    def fn(x):
+        i = 0
+        while i < 10:
+            try:
+                i = i + 1
+                if i >= 3:
+                    break
+            except ValueError:
+                break
+        if x.sum() > 0:      # this if must still convert
+            return x * 2
+        return x * -1
+
+    conv = convert_function(fn)
+    assert getattr(conv, "_pt_dy2static", False), "conversion fell back"
+    src = conv._pt_transformed_source
+    assert "break" in src          # the try-loop kept python semantics
+    assert "_jst.ret_ifelse" in src  # the trailing if converted
+    for data in (np.ones((2,), np.float32), -np.ones((2,), np.float32)):
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(_traced(conv, data), ref)

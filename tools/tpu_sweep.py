@@ -24,42 +24,44 @@ BLOCK_SWEEP = [
     for bq, bk in [(256, 256), (256, 512), (512, 256), (512, 512),
                    (512, 1024), (1024, 512), (1024, 1024)]
 ]
+# Ordered by VALUE-IF-THE-TUNNEL-DIES: tunnel-up windows historically last
+# minutes, so the first rows must be the ones BASELINE configs have never
+# measured — one row per config family first (125m validates the post-fix
+# bf16 flash kernel + the 256-block default, resnet50/moe/1.3b/decode have
+# ZERO measured rows as of round 4), tuning variants after.
 PRESET_SWEEP = [
     ("125m", {"BENCH_PRESET": "gpt3-125m"}),
-    ("125m-bs16", {"BENCH_PRESET": "gpt3-125m", "BENCH_BS": "16"}),
+    ("resnet50", {"BENCH_PRESET": "resnet50"}),
+    ("350m", {"BENCH_PRESET": "gpt3-350m"}),
+    ("moe-base", {"BENCH_PRESET": "ernie-moe-base"}),
+    ("1.3b", {"BENCH_PRESET": "gpt3-1.3b"}),
+    ("125m-decode", {"BENCH_PRESET": "gpt3-125m-decode"}),
+    ("1.3b-decode", {"BENCH_PRESET": "gpt3-1.3b-decode"}),
     ("125m-noflash", {"BENCH_PRESET": "gpt3-125m",
                       "FLAGS_flash_attention": "0"}),
-    ("350m", {"BENCH_PRESET": "gpt3-350m"}),
-    ("350m-bs16-remat", {"BENCH_PRESET": "gpt3-350m", "BENCH_BS": "16",
-                         "BENCH_REMAT": "1"}),
-    ("350m-bs4", {"BENCH_PRESET": "gpt3-350m", "BENCH_BS": "4"}),
     # block-tuned 350m rows: the 0.40-MFU target configs (bigger model =
     # wider matmuls; blocks are the remaining knob)
     ("350m-b256", {"BENCH_PRESET": "gpt3-350m",
                    "FLAGS_flash_block_q": "256",
                    "FLAGS_flash_block_k": "256"}),
-    ("350m-b1024", {"BENCH_PRESET": "gpt3-350m",
-                    "FLAGS_flash_block_q": "1024",
-                    "FLAGS_flash_block_k": "1024"}),
     ("350m-bs16-remat-b256", {"BENCH_PRESET": "gpt3-350m", "BENCH_BS": "16",
                               "BENCH_REMAT": "1",
                               "FLAGS_flash_block_q": "256",
                               "FLAGS_flash_block_k": "256"}),
+    ("350m-bs16-remat", {"BENCH_PRESET": "gpt3-350m", "BENCH_BS": "16",
+                         "BENCH_REMAT": "1"}),
     ("350m-bs32-remat", {"BENCH_PRESET": "gpt3-350m", "BENCH_BS": "32",
                          "BENCH_REMAT": "1"}),
     ("350m-bf16-moments", {"BENCH_PRESET": "gpt3-350m",
                            "BENCH_MOMENT_DTYPE": "bfloat16"}),
-    ("1.3b", {"BENCH_PRESET": "gpt3-1.3b"}),
+    ("350m-bs4", {"BENCH_PRESET": "gpt3-350m", "BENCH_BS": "4"}),
+    ("125m-bs16", {"BENCH_PRESET": "gpt3-125m", "BENCH_BS": "16"}),
     ("1.3b-bs2", {"BENCH_PRESET": "gpt3-1.3b", "BENCH_BS": "2"}),
     ("1.3b-bs8", {"BENCH_PRESET": "gpt3-1.3b", "BENCH_BS": "8"}),
-    ("moe-base", {"BENCH_PRESET": "ernie-moe-base"}),
-    ("resnet50", {"BENCH_PRESET": "resnet50"}),
     ("125m-fused-adam", {"BENCH_PRESET": "gpt3-125m",
                          "FLAGS_use_fused_adam": "1"}),
-    ("125m-decode", {"BENCH_PRESET": "gpt3-125m-decode"}),
-    ("1.3b-decode", {"BENCH_PRESET": "gpt3-1.3b-decode"}),
 ]
-QUICK = [PRESET_SWEEP[0], PRESET_SWEEP[3], PRESET_SWEEP[6]]
+QUICK = [PRESET_SWEEP[0], PRESET_SWEEP[2], PRESET_SWEEP[8]]
 
 
 def run_one(tag, env_over, timeout):
@@ -79,6 +81,8 @@ def run_one(tag, env_over, timeout):
                     continue
                 row["tag"] = tag
                 row["wall_s"] = round(time.time() - t0, 1)
+                row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())
                 return row
         return {"tag": tag, "error": f"rc={r.returncode}",
                 "stderr": (r.stderr or "")[-300:]}

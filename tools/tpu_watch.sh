@@ -19,6 +19,8 @@ while :; do
     if python tools/tpu_sweep.py presets && \
        python tools/tpu_sweep.py blocks; then
       echo "tpu_watch: sweeps complete"
+      # fold fresh chip rows into the headline artifact even unattended
+      python tools/update_measured.py
       # perf-regression gate (check_op_benchmark_result analog): a fresh
       # sweep below the pinned floors must FAIL the watcher, not just log
       python tools/check_bench_result.py
@@ -28,6 +30,8 @@ while :; do
       fi
       exit $gate_rc
     fi
+    # a partial sweep may still have produced fresh rows — record them
+    python tools/update_measured.py
     echo "tpu_watch: sweep aborted (tunnel died?); back to probing"
   else
     echo "tpu_watch: tunnel down at $(date -u +%H:%M:%S)"
