@@ -569,7 +569,11 @@ void put_bytes(std::vector<char>* buf, const void* p, size_t n) {
 
 extern "C" {
 
-void* ps_server_start(int port) {
+// bind_any=0 keeps the shard on loopback (single-host default);
+// bind_any=1 binds 0.0.0.0 so workers on other hosts reach it (the
+// multi-host brpc_ps_server deployment shape — endpoints are then
+// advertised through the PADDLE_PSERVERS_IP_PORT_LIST env contract)
+void* ps_server_start_ex(int port, int bind_any) {
   auto* srv = new Server();
   srv->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (srv->listen_fd < 0) {
@@ -580,7 +584,7 @@ void* ps_server_start(int port) {
   setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_addr.s_addr = htonl(bind_any ? INADDR_ANY : INADDR_LOOPBACK);
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr),
              sizeof(addr)) != 0 ||
@@ -617,6 +621,8 @@ void* ps_server_start(int port) {
   });
   return srv;
 }
+
+void* ps_server_start(int port) { return ps_server_start_ex(port, 0); }
 
 int ps_server_port(void* h) { return static_cast<Server*>(h)->port; }
 

@@ -236,6 +236,16 @@ class Tensor:
             raise TypeError("len() of a 0-d tensor")
         return self.data.shape[0]
 
+    def __iter__(self):
+        # without this, `for row in tensor` falls back to the __getitem__
+        # protocol, which never raises IndexError (jnp indexing clips) and
+        # loops forever; shape[0] is static, so iteration also terminates
+        # under tracing (an unrolled loop, like the reference's dygraph)
+        if not self.data.shape:
+            raise TypeError("iteration over a 0-d tensor")
+        for i in range(self.data.shape[0]):
+            yield self[i]
+
     def __hash__(self):
         return id(self)
 

@@ -31,12 +31,15 @@ def _load_lib():
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH):
-        subprocess.run(["make", "-C", _SRC_DIR], check=True,
-                       capture_output=True)
+    # ALWAYS make (a no-op when up to date): a stale prebuilt .so missing a
+    # newer symbol would otherwise fail dlsym for every native-PS user
+    subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                   capture_output=True)
     lib = ctypes.CDLL(_LIB_PATH)
     lib.ps_server_start.restype = ctypes.c_void_p
     lib.ps_server_start.argtypes = [ctypes.c_int]
+    lib.ps_server_start_ex.restype = ctypes.c_void_p
+    lib.ps_server_start_ex.argtypes = [ctypes.c_int, ctypes.c_int]
     lib.ps_server_port.restype = ctypes.c_int
     lib.ps_server_port.argtypes = [ctypes.c_void_p]
     lib.ps_server_stop.argtypes = [ctypes.c_void_p]
@@ -129,12 +132,14 @@ _RULES = {"sgd": 0, "adagrad": 1}
 
 
 class NativePSServer:
-    """One C++ PS shard server on loopback. The table storage and optimizer
-    rules live in native code (brpc_ps_server.h role)."""
+    """One C++ PS shard server (brpc_ps_server.h role: table storage and
+    optimizer rules in native code). Loopback by default; bind_any=True
+    binds 0.0.0.0 for multi-host deployments (endpoints advertised through
+    the PADDLE_PSERVERS_IP_PORT_LIST contract)."""
 
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, bind_any: bool = False):
         self._lib = _load_lib()
-        self._h = self._lib.ps_server_start(port)
+        self._h = self._lib.ps_server_start_ex(port, 1 if bind_any else 0)
         if not self._h:
             raise RuntimeError("native PS server failed to bind")
         self.port = self._lib.ps_server_port(self._h)
@@ -476,13 +481,14 @@ class NativePSServerProcess:
     shape): spawns `python -m ...native_ps --serve`, reads the bound port
     from its stdout, and can be killed to exercise failover."""
 
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, bind_any: bool = False):
         import subprocess as sp
         import sys
         self._proc = sp.Popen(
             [sys.executable, "-m",
              "paddle_tpu.distributed.fleet.runtime.native_ps",
-             "--serve", "--port", str(port)],
+             "--serve", "--port", str(port)]
+            + (["--bind-any"] if bind_any else []),
             stdout=sp.PIPE, stderr=sp.DEVNULL, text=True,
             env=dict(os.environ, JAX_PLATFORMS="cpu"))
         line = self._proc.stdout.readline().strip()
@@ -520,10 +526,12 @@ def _serve_main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--serve", action="store_true")
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--bind-any", action="store_true",
+                    help="bind 0.0.0.0 instead of loopback (multi-host)")
     args = ap.parse_args(argv)
     if not args.serve:
         ap.error("--serve required")
-    srv = NativePSServer(args.port)
+    srv = NativePSServer(args.port, bind_any=args.bind_any)
     print(f"PS_PORT {srv.port}", flush=True)
     ev = __import__("threading").Event()
     signal.signal(signal.SIGTERM, lambda *_: ev.set())

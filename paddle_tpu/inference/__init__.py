@@ -36,11 +36,22 @@ def _qspec(entry):
     return q, scale, int(ca), int(bits)
 
 
-def export_model(layer: Layer, example_inputs, path: str, qweights=None):
+def export_model(layer: Layer, example_inputs, path: str, qweights=None,
+                 dynamic_batch: bool = False):
     """Export a Layer for serving: StableHLO module + weights + metadata.
 
-    example_inputs: list of Tensors/arrays fixing the traced shapes (dynamic
-    batch via jax.export symbolic dims is a follow-up).
+    example_inputs: list of Tensors/arrays fixing the traced shapes.
+
+    dynamic_batch exports the .stablehlo module with a SYMBOLIC batch dim
+    (jax.export symbolic shapes): the Python predictor then serves any
+    batch size natively, no pad/chunk. Pass a list of bools (one per
+    example input) to say exactly which inputs carry the batch dim;
+    dynamic_batch=True uses the heuristic "every input sharing the first
+    input's leading size" — if an auxiliary input coincidentally matches
+    (e.g. a 4-row lookup table exported at batch 4), pass the explicit
+    list instead. The C++ artifact (.mlir) stays static-shaped — PJRT
+    plugins compile static entry computations — so the C++ predictor keeps
+    the exported batch.
 
     qweights (int8 serving, post_training_quantization.py:1 output consumed
     by the inference engine / quantization_pass.py's insert-dequant shape):
@@ -83,8 +94,44 @@ def export_model(layer: Layer, example_inputs, path: str, qweights=None):
         return layer.functional_call(p, buffers, *xs)
 
     exported = jax.export.export(jax.jit(fwd))(qparams, buffers, *arrays)
-    with open(path + ".stablehlo", "wb") as f:
-        f.write(exported.serialize())
+    if dynamic_batch:
+        # symbolic-batch module for the Python serving path
+        b = jax.export.symbolic_shape("b")[0]
+        if isinstance(dynamic_batch, (list, tuple)):
+            if len(dynamic_batch) != len(arrays):
+                raise ValueError(
+                    f"dynamic_batch list has {len(dynamic_batch)} entries "
+                    f"for {len(arrays)} inputs")
+            batched = [bool(d) and a.ndim >= 1
+                       for d, a in zip(dynamic_batch, arrays)]
+        else:
+            lead = arrays[0].shape[0] if arrays and arrays[0].ndim else None
+            batched = [a.ndim >= 1 and lead is not None
+                       and a.shape[0] == lead for a in arrays]
+        if not any(batched):
+            # nothing symbolized: recording dynamic_batch would make the
+            # Predictor skip its pad/chunk fallback against a fully-static
+            # module — fall back loudly instead
+            import warnings
+            warnings.warn(
+                "export_model(dynamic_batch=...) symbolized no input (no "
+                "ndim>=1 input shares the lead size); exporting STATIC",
+                stacklevel=2)
+            dynamic_batch = False
+        else:
+            specs = [
+                jax.ShapeDtypeStruct((b,) + a.shape[1:], a.dtype) if bt
+                else jax.ShapeDtypeStruct(a.shape, a.dtype)
+                for a, bt in zip(arrays, batched)]
+            as_spec = lambda t: jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t)
+            exported_dyn = jax.export.export(jax.jit(fwd))(
+                as_spec(qparams), as_spec(buffers), *specs)
+            with open(path + ".stablehlo", "wb") as f:
+                f.write(exported_dyn.serialize())
+    if not dynamic_batch:
+        with open(path + ".stablehlo", "wb") as f:
+            f.write(exported.serialize())
     from ..framework_io import save as _save
     _save({"params": {k: np.asarray(v) for k, v in qparams.items()},
            "buffers": buffers}, path + ".pdiparams")
@@ -111,6 +158,7 @@ def export_model(layer: Layer, example_inputs, path: str, qweights=None):
         "input_shapes": [list(a.shape) for a in arrays],
         "output_names": ["output"],
         "n_weights": len(weight_leaves),
+        "dynamic_batch": bool(dynamic_batch),
     }
     if qweights:
         meta["quantized"] = {
@@ -122,12 +170,14 @@ def export_model(layer: Layer, example_inputs, path: str, qweights=None):
 
 
 def export_quantized_model(layer: Layer, example_inputs, path: str,
-                           qweights: Dict[str, tuple]):
+                           qweights: Dict[str, tuple],
+                           dynamic_batch: bool = False):
     """Int8 serving export — see export_model's qweights contract."""
     if not qweights:
         raise ValueError("export_quantized_model needs non-empty qweights; "
                          "use export_model for a float export")
-    return export_model(layer, example_inputs, path, qweights=qweights)
+    return export_model(layer, example_inputs, path, qweights=qweights,
+                        dynamic_batch=dynamic_batch)
 
 
 # PJRT_Buffer_Type enum values (pjrt_c_api.h:853-913)
@@ -269,7 +319,12 @@ class Predictor:
         program (AnalysisPredictor accepts arbitrary feed batches;
         analysis_predictor.h:82): smaller batches are zero-padded to the
         exported size, larger ones chunked — one compiled executable
-        serves them all."""
+        serves them all. A symbolic-batch export (export_model
+        dynamic_batch=True) skips all of this: the module itself accepts
+        any leading size."""
+        if self._meta.get("dynamic_batch"):
+            out = self._call(self._params, self._buffers, *args)
+            return out if isinstance(out, (list, tuple)) else [out]
         expected = self._meta.get("input_shapes") or [None] * len(args)
         # an input is "batched" iff it deviates from its exported shape
         # ONLY in the leading dim; others (lookup tables, scalars) pass

@@ -191,6 +191,10 @@ def not_to_static(fn=None):
 
 
 class ProgramTranslator:
+    """dy2static on/off switch (program_translator.py ProgramTranslator):
+    enable(False) disables the AST conversion globally — to_static then
+    traces functions as-is (one branch of data-dependent control flow)."""
+
     _instance = None
 
     @classmethod
@@ -200,11 +204,12 @@ class ProgramTranslator:
         return cls._instance
 
     def enable(self, flag):
-        pass
+        from .dy2static import set_conversion_enabled
+        set_conversion_enabled(flag)
 
 
 def enable_to_static(flag=True):
-    pass
+    ProgramTranslator.get_instance().enable(flag)
 
 
 # ---- jit API tail (reference python/paddle/jit/__init__.py) ----

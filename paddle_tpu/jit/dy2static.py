@@ -831,10 +831,24 @@ def unsupported_reason(fn: Callable) -> str | None:
 
 _CONVERT_CACHE: dict = {}
 
+# ProgramTranslator.enable(False) / paddle.jit.enable_to_static(False)
+# analog: globally disables the AST pass (functions then trace as-is)
+_ENABLED = [True]
+
+
+def set_conversion_enabled(flag: bool):
+    _ENABLED[0] = bool(flag)
+
+
+def conversion_enabled() -> bool:
+    return _ENABLED[0]
+
 
 def convert_function(fn: Callable) -> Callable:
     """AST-convert `fn` (idempotent, cached). Falls back to `fn` with a
     warning when conversion is impossible."""
+    if not _ENABLED[0]:
+        return fn
     if getattr(fn, "_pt_dy2static", False):
         return fn
     key = getattr(fn, "__code__", None)
@@ -879,10 +893,23 @@ def convert_function(fn: Callable) -> Callable:
         from . import dy2static as _jst_mod
         glb["_jst"] = _jst_mod
         exec(code, glb)
-        new_fn = glb[fdef.name]
-        new_fn = functools.wraps(fn)(new_fn)
+        converted = glb[fdef.name]
+        transformed_src = ast.unparse(tree)
+
+        # a live dispatcher, not the converted fn directly: the
+        # ProgramTranslator.enable(False) debug switch must take effect on
+        # ALREADY-decorated functions' subsequent calls (eager calls
+        # immediately; jitted paths on their next trace — compiled
+        # executables are cached, same as the reference's program cache)
+        @functools.wraps(fn)
+        def new_fn(*a, **k):
+            if not _ENABLED[0]:
+                return fn(*a, **k)
+            return converted(*a, **k)
+
         new_fn._pt_dy2static = True
-        new_fn._pt_transformed_source = ast.unparse(tree)
+        new_fn._pt_converted = converted
+        new_fn._pt_transformed_source = transformed_src
     except Exception as e:  # fail open: tracing may still work
         warnings.warn(
             f"dy2static: conversion of {getattr(fn, '__name__', fn)} "
@@ -899,6 +926,8 @@ def convert_to_static(target):
     Returns the converted callable (for a Layer: the Layer itself, with
     `forward` rebound to the converted function)."""
     from ..nn.layer.layers import Layer
+    if not _ENABLED[0]:
+        return target
     if isinstance(target, Layer):
         fwd = target.forward
         fn = fwd.__func__ if isinstance(fwd, types.MethodType) else fwd

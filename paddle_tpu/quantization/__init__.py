@@ -379,7 +379,7 @@ class PostTrainingQuantization:
             l.weight.set_value(wdq.astype(np.float32))
         return self.model
 
-    def save_quantized_model(self, path, input_spec):
+    def save_quantized_model(self, path, input_spec, dynamic_batch=False):
         """Serving export that the predictor actually consumes as int8:
         quantized weights ride the artifact as int8 args with on-device
         dequant (inference.export_quantized_model), plus the .quant side
@@ -393,7 +393,8 @@ class PostTrainingQuantization:
             n = key[:-len(".weight")]
             ca = 0 if isinstance(sub.get(n), Conv2D) else -1
             qweights[key] = (q, self.scales[n]["weight"], ca, self._wbits)
-        export_quantized_model(self.model, input_spec, path, qweights)
+        export_quantized_model(self.model, input_spec, path, qweights,
+                               dynamic_batch=dynamic_batch)
         save({"int8_weights": self.int8_state, "scales": self.scales},
              path + ".quant")
         return path

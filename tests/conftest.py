@@ -56,6 +56,8 @@ _SLOW_MODULES = {
     "test_cpp_predictor", "test_op_numerics_batch3",
     "test_op_numerics_batch4", "test_op_numerics_batch5",
     "test_highlevel", "test_beam_search",
+    "test_interleaved_pipeline", "test_parameter_server",
+    "test_strategy_flags",
 }
 
 

@@ -1002,3 +1002,20 @@ def test_heter_pass_device_resident_embedding_training():
     emb.begin_pass(np.array([1]))
     with pytest.raises(KeyError, match="begin_pass"):
         emb.slots_for(np.array([7]))
+
+
+def test_native_server_bind_any_still_reachable_via_loopback():
+    """bind_any=True (the multi-host deployment shape) binds 0.0.0.0 and
+    remains reachable through loopback on the same host."""
+    from paddle_tpu.distributed.fleet.runtime.native_ps import (
+        NativePSClient, NativePSServerProcess)
+    srv = NativePSServerProcess(bind_any=True)
+    client = NativePSClient([srv.endpoint], timeout_ms=2000)
+    try:
+        client.create_table("e", 4, rule="sgd", lr=0.5, init_std=0.0)
+        out = client.pull_sparse("e", np.arange(4))
+        assert out.shape == (4, 4)
+        assert client.alive() == [True]
+    finally:
+        client.close()
+        srv.stop()

@@ -1,0 +1,76 @@
+"""Serving export/predictor features (reference AnalysisPredictor,
+analysis_predictor.h:82): symbolic-batch export (jax.export symbolic dims)
+so one artifact serves any batch size natively."""
+
+def test_dynamic_batch_symbolic_export(tmp_path):
+    """export_model(dynamic_batch=True): the exported module carries a
+    SYMBOLIC batch dim, so the predictor serves any batch size natively —
+    no pad/chunk machinery (jax.export symbolic shapes)."""
+    import json
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, nn
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    x = np.ones((2, 4), np.float32)
+    path = str(tmp_path / "dyn")
+    inference.export_model(model, [x], path, dynamic_batch=True)
+    assert json.load(open(path + ".pdmodel.json"))["dynamic_batch"]
+    pred = inference.load_predictor(path)
+    rng = np.random.RandomState(0)
+    for b in (1, 2, 7, 33):
+        data = rng.rand(b, 4).astype(np.float32)
+        (out,) = pred.run([data])
+        assert out.shape == (b, 3)
+        ref = model(paddle.to_tensor(data)).numpy()
+        np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_dynamic_batch_explicit_list_protects_aux_inputs(tmp_path):
+    """An auxiliary input that coincidentally matches the batch size must
+    stay static when the explicit per-input list says so."""
+    import json
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, nn
+    from paddle_tpu.nn.layer.layers import Layer
+
+    class WeightedNet(Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x, class_w):
+            return self.fc(x) * class_w.reshape([1, -1]).sum()
+
+    paddle.seed(0)
+    model = WeightedNet()
+    x = np.ones((2, 4), np.float32)       # batch input, lead 2
+    cw = np.ones((2,), np.float32)        # aux input, ALSO lead 2
+    path = str(tmp_path / "aux")
+    inference.export_model(model, [x, cw], path,
+                           dynamic_batch=[True, False])
+    pred = inference.load_predictor(path)
+    out = pred.run([np.ones((7, 4), np.float32), cw])[0]
+    assert out.shape == (7, 2)  # batch free, aux fixed at 2
+
+
+def test_dynamic_batch_nothing_symbolized_falls_back_static(tmp_path):
+    import json
+    import numpy as np
+    import pytest
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, nn
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 2))
+    x = np.ones((2, 4), np.float32)
+    path = str(tmp_path / "none")
+    with pytest.warns(UserWarning, match="symbolized no input"):
+        inference.export_model(model, [x], path,
+                               dynamic_batch=[False])
+    meta = json.load(open(path + ".pdmodel.json"))
+    assert meta["dynamic_batch"] is False  # pad/chunk fallback stays armed
+    pred = inference.load_predictor(path)
+    out = pred.run([np.ones((5, 4), np.float32)])[0]  # chunked static serve
+    assert out.shape == (5, 2)
