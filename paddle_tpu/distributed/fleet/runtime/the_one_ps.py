@@ -498,7 +498,8 @@ class PSServer:
     POST /create ?table=&dim=&rule=&lr=        -> ok
     """
 
-    def __init__(self, core: PSCore, port: int = 0):
+    def __init__(self, core: PSCore, port: int = 0,
+                 host: str = "127.0.0.1"):
         self.core = core
         outer = self
 
@@ -555,7 +556,7 @@ class PSServer:
                 except Exception as e:  # surface server errors to the client
                     self._respond(str(e).encode(), 500)
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = None
 

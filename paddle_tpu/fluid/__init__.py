@@ -22,6 +22,9 @@ from .. import nn as _nn
 from .. import optimizer as _opt  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import layers  # noqa: F401
+from . import transpiler  # noqa: F401
+from .transpiler import (DistributeTranspiler,  # noqa: F401
+                         DistributeTranspilerConfig)
 
 # fluid.io: the reader/DataLoader surface
 from .. import io  # noqa: F401

@@ -1,0 +1,232 @@
+"""fluid.transpiler — the legacy DistributeTranspiler surface.
+
+Reference: python/paddle/fluid/transpiler/distribute_transpiler.py:256
+(DistributeTranspiler.transpile/get_trainer_program/get_pserver_program)
+and ps_dispatcher.py:18 (PSDispatcher/HashName/RoundRobin). The reference
+rewrites a static ProgramDesc into trainer programs (send/recv ops) and
+pserver programs (listen_and_serv + optimize blocks).
+
+TPU-native recast: there is no ProgramDesc to rewrite — the transpiler's
+JOB (split training into parameter-server processes serving the id-keyed
+tables and trainer processes that pull/push against them) maps directly
+onto the PS runtime (`distributed/fleet/runtime/the_one_ps.py`):
+
+  - get_pserver_program(endpoint) -> a runnable server handle: `.run()`
+    serves that endpoint's shard over the HTTP transport (listen_and_serv
+    analog), `.stop()` shuts it down;
+  - get_trainer_program() -> a trainer handle exposing the PSClient
+    (pull_sparse/push_sparse/...) routed across ALL pserver endpoints —
+    the send/recv-op half;
+  - get_startup_program(endpoint, ...) -> the table-creation hook the
+    reference's startup program performs on each pserver.
+
+The legacy 1.x scripts' CALL SHAPE works unchanged; the program objects
+they pass through (`fluid.default_main_program()`) are accepted and not
+rewritten (the jit/trace pipeline owns graph building on TPU).
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Optional
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "PSDispatcher", "HashName", "RoundRobin"]
+
+
+def _wait_ports(endpoints, timeout_s: float = 30.0):
+    """Block until each endpoint accepts a TCP connection (the reference's
+    wait_server_ready); a clear TimeoutError beats a raw connection-refused
+    from the first RPC."""
+    import socket
+    import time
+    deadline = time.time() + timeout_s
+    for ep in endpoints:
+        host, port = ep.rsplit(":", 1)
+        while True:
+            try:
+                with socket.create_connection((host, int(port)),
+                                              timeout=1.0):
+                    break
+            except OSError:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"pserver {ep} did not open its port within "
+                        f"{timeout_s:.0f}s — is its get_pserver_program("
+                        ").run() running?") from None
+                time.sleep(0.1)
+
+
+class PSDispatcher:
+    """ps_dispatcher.py:18 — maps variables to pserver endpoints."""
+
+    def __init__(self, pserver_endpoints):
+        self._eplist = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eplist(self):
+        return self._eplist
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class HashName(PSDispatcher):
+    """ps_dispatcher.py:49 — endpoint by name hash."""
+
+    def dispatch(self, varlist):
+        return [self._eplist[zlib.crc32(
+            getattr(v, "name", str(v)).encode()) % len(self._eplist)]
+            for v in varlist]
+
+
+class RoundRobin(PSDispatcher):
+    """ps_dispatcher.py:91 — endpoints in rotation."""
+
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eplist[self._step % len(self._eplist)])
+            self._step += 1
+        return out
+
+
+class DistributeTranspilerConfig:
+    """distribute_transpiler.py:141 — knobs accepted for call-shape parity.
+    slice_var_up/min_block_size tuned ProgramDesc var splitting; row
+    sharding here is id % n_servers (the PSClient contract), so they are
+    recorded but do not change the layout."""
+
+    slice_var_up = True
+    split_method = RoundRobin
+    min_block_size = 8192
+    enable_dc_asgd = False
+    mode = "pserver"
+    print_log = False
+    wait_port = True
+
+
+class _PServerProgram:
+    """The get_pserver_program result: a runnable shard (listen_and_serv
+    analog over the HTTP PS transport)."""
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+        self._server = None
+        self.core = None
+
+    def run(self):
+        """Serve this shard (Executor.run(pserver_program) analog) — bound
+        to the endpoint's OWN host, so non-loopback deployments serve on
+        the advertised interface (run this on the endpoint's machine)."""
+        from ..distributed.fleet.runtime.the_one_ps import PSCore, PSServer
+        host, port = self.endpoint.rsplit(":", 1)
+        self.core = PSCore()
+        self._server = PSServer(self.core, int(port), host=host).start()
+        return self
+
+    def stop(self):
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+
+class _TrainerProgram:
+    """The get_trainer_program result: the worker half — a PSClient routed
+    across every pserver endpoint (the send/recv ops' contract)."""
+
+    def __init__(self, endpoints: List[str], trainer_id: int,
+                 trainers: int, sync_mode: bool):
+        self.endpoints = list(endpoints)
+        self.trainer_id = trainer_id
+        self.trainers = trainers
+        self.sync_mode = sync_mode
+        self._client = None
+
+    @property
+    def client(self):
+        from ..distributed.fleet.runtime.the_one_ps import PSClient
+        if self._client is None:
+            self._client = PSClient(endpoints=self.endpoints)
+        return self._client
+
+    # convenience passthroughs matching the PSClient worker surface
+    def create_table(self, *a, **k):
+        return self.client.create_table(*a, **k)
+
+    def pull_sparse(self, *a, **k):
+        return self.client.pull_sparse(*a, **k)
+
+    def push_sparse(self, *a, **k):
+        return self.client.push_sparse(*a, **k)
+
+
+class DistributeTranspiler:
+    """distribute_transpiler.py:256 facade over the TPU PS runtime."""
+
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._endpoints: List[str] = []
+        self._trainer_id = 0
+        self._trainers = 1
+        self._sync_mode = True
+        self._transpiled = False
+
+    def transpile(self, trainer_id, program=None,
+                  pservers="127.0.0.1:6174", trainers=1, sync_mode=True,
+                  startup_program=None, current_endpoint="127.0.0.1:6174"):
+        """Record the deployment; `program` is accepted untouched (there is
+        no ProgramDesc to rewrite — jit/tracing owns graph building)."""
+        self._trainer_id = int(trainer_id)
+        self._endpoints = [e.strip() for e in str(pservers).split(",")
+                           if e.strip()]
+        if not self._endpoints:
+            raise ValueError("transpile needs at least one pserver "
+                             "endpoint (pservers='ip:port,...')")
+        self._trainers = trainers
+        self._sync_mode = bool(sync_mode)
+        self._transpiled = True
+        return self
+
+    def _check(self):
+        if not self._transpiled:
+            raise RuntimeError("call transpile() before requesting "
+                               "programs (same contract as the reference)")
+
+    def get_trainer_program(self, wait_port=True) -> _TrainerProgram:
+        """wait_port=True blocks until every pserver port answers (the
+        reference's trainer/pserver process-ordering contract — trainers
+        may start before the servers have bound)."""
+        self._check()
+        if wait_port and self.config.wait_port:
+            _wait_ports(self._endpoints)
+        return _TrainerProgram(self._endpoints, self._trainer_id,
+                               self._trainers, self._sync_mode)
+
+    def get_pserver_program(self, endpoint: str) -> _PServerProgram:
+        self._check()
+        if endpoint not in self._endpoints:
+            raise ValueError(
+                f"{endpoint!r} is not one of the transpiled pserver "
+                f"endpoints {self._endpoints}")
+        return _PServerProgram(endpoint)
+
+    def get_pserver_programs(self, endpoint: str):
+        prog = self.get_pserver_program(endpoint)
+        return prog, self.get_startup_program(endpoint, prog)
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        """The reference's pserver startup program creates the tables; here
+        table creation is demand-driven through create_table, so the
+        startup hook is a no-op handle with the same call shape."""
+        self._check()
+
+        class _Startup:
+            def run(self):
+                return self
+
+        return _Startup()

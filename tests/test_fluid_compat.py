@@ -155,3 +155,65 @@ def test_fluid_interp_and_loss_conventions():
     t = fluid.dygraph.to_variable(np.array([-1.0, 2.0], np.float32))
     fluid.layers.relu_(t)
     np.testing.assert_allclose(np.asarray(t.data), [0.0, 2.0])
+
+
+# ---- legacy transpiler (distribute_transpiler.py:256 facade) ----
+
+def test_distribute_transpiler_pserver_trainer_roundtrip():
+    """The 1.x PS deployment script shape: transpile -> run pserver
+    programs -> trainer program pulls/pushes across both shards."""
+    import numpy as np
+    from paddle_tpu import fluid
+
+    config = fluid.DistributeTranspilerConfig()
+    config.slice_var_up = False
+    t = fluid.DistributeTranspiler(config=config)
+    # port 0 is not usable for the endpoint list (the trainer must know
+    # the ports); reserve two via the shared launch helper
+    from paddle_tpu.distributed.utils import find_free_ports
+    eps = [f"127.0.0.1:{p}" for p in sorted(find_free_ports(2))]
+    t.transpile(trainer_id=0, pservers=",".join(eps), trainers=1)
+
+    servers = []
+    try:
+        for ep in eps:
+            prog, startup = t.get_pserver_programs(ep)
+            startup.run()
+            servers.append(prog.run())
+        trainer = t.get_trainer_program()
+        trainer.create_table("emb", 4, rule="sgd", lr=0.5, init_std=0.0)
+        ids = np.arange(8)
+        trainer.pull_sparse("emb", ids)
+        trainer.push_sparse("emb", ids, np.ones((8, 4), np.float32))
+        out = trainer.pull_sparse("emb", ids)
+        np.testing.assert_allclose(out, -0.5, atol=1e-6)
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_transpiler_dispatchers_and_guards():
+    from paddle_tpu.fluid.transpiler import HashName, RoundRobin
+    import pytest as _pytest
+    from paddle_tpu import fluid
+
+    eps = ["a:1", "b:2", "c:3"]
+    rr = RoundRobin(eps)
+    assert rr.dispatch([1, 2, 3, 4]) == ["a:1", "b:2", "c:3", "a:1"]
+    rr.reset()
+    assert rr.dispatch([1]) == ["a:1"]
+
+    class V:
+        def __init__(self, name):
+            self.name = name
+
+    hn = HashName(eps)
+    d1 = hn.dispatch([V("w1"), V("w2"), V("w1")])
+    assert d1[0] == d1[2]  # deterministic by name
+
+    t = fluid.DistributeTranspiler()
+    with _pytest.raises(RuntimeError, match="transpile"):
+        t.get_trainer_program()
+    t.transpile(0, pservers="127.0.0.1:7777")
+    with _pytest.raises(ValueError, match="not one of"):
+        t.get_pserver_program("127.0.0.1:9999")
