@@ -212,12 +212,12 @@ def histogram(input, bins=100, min=0, max=0, name=None):
 
 def bincount(x, weights=None, minlength=0, name=None):
     x = _t(x)
+    # jnp.bincount IGNORES minlength once `length` is passed (the static-
+    # shape form) — fold it into length so minlength really pads
+    length = max(int(x.numpy().max()) + 1 if x.size else 0, int(minlength))
     if weights is None:
-        return apply(lambda a: jnp.bincount(a, minlength=minlength,
-                                            length=int(x.numpy().max()) + 1
-                                            if x.size else minlength), x)
-    return apply(lambda a, w: jnp.bincount(a, w, minlength=minlength,
-                                           length=int(x.numpy().max()) + 1),
+        return apply(lambda a: jnp.bincount(a, length=length), x)
+    return apply(lambda a, w: jnp.bincount(a, w, length=length),
                  x, _t(weights))
 
 

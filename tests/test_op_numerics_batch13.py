@@ -1,0 +1,155 @@
+"""Op numerics batch 13 — indexing/statistics tail.
+
+Fixture strategy (SURVEY §4): outputs against torch/numpy oracles and
+gradients against finite differences / torch autograd. Covers the
+implemented-but-previously-unpinned ops: histogram (reference
+tensor/linalg.py:845), bincount, take_along_axis, put_along_axis,
+index_fill, nanmedian, corrcoef (parity-plus tail)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def test_histogram_vs_torch():
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-3, 7, size=(100,)).astype(np.float32)
+    got = paddle.histogram(t(x), bins=16, min=-2, max=6).numpy()
+    ref = torch.histc(torch.tensor(x), bins=16, min=-2, max=6).numpy()
+    np.testing.assert_allclose(np.asarray(got), ref)
+    # default min=max=0: range spans the data (reference contract)
+    got2 = paddle.histogram(t(x), bins=10).numpy()
+    ref2 = torch.histc(torch.tensor(x), bins=10,
+                       min=float(x.min()), max=float(x.max())).numpy()
+    np.testing.assert_allclose(np.asarray(got2), ref2)
+    assert int(np.asarray(got2).sum()) == 100
+
+
+def test_bincount_vs_numpy():
+    rng = np.random.RandomState(1)
+    x = rng.randint(0, 9, size=(50,))
+    np.testing.assert_array_equal(
+        np.asarray(paddle.bincount(t(x)).numpy()), np.bincount(x))
+    w = rng.rand(50).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(paddle.bincount(t(x), weights=t(w)).numpy()),
+        np.bincount(x, weights=w), rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(paddle.bincount(t(x), minlength=20).numpy()),
+        np.bincount(x, minlength=20))
+
+
+def test_take_along_axis_vs_torch_and_grad():
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 6).astype(np.float32)
+    idx = rng.randint(0, 6, size=(4, 3))
+    got = paddle.take_along_axis(t(x), t(idx), axis=1)
+    ref = torch.take_along_dim(torch.tensor(x), torch.tensor(idx), dim=1)
+    np.testing.assert_allclose(np.asarray(got.numpy()), ref.numpy())
+
+    xt = t(x)
+    xt.stop_gradient = False
+    out = paddle.take_along_axis(xt, t(idx), axis=1)
+    out.sum().backward()
+    tx = torch.tensor(x, requires_grad=True)
+    torch.take_along_dim(tx, torch.tensor(idx), dim=1).sum().backward()
+    np.testing.assert_allclose(np.asarray(xt.grad.numpy()),
+                               tx.grad.numpy(), rtol=1e-6)
+
+
+def test_put_along_axis_vs_torch():
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 6).astype(np.float32)
+    idx = np.stack([rng.permutation(6)[:3] for _ in range(4)])
+    v = rng.randn(4, 3).astype(np.float32)
+    got = paddle.put_along_axis(t(x), t(idx), t(v), axis=1)
+    ref = torch.tensor(x).scatter(1, torch.tensor(idx), torch.tensor(v))
+    np.testing.assert_allclose(np.asarray(got.numpy()), ref.numpy())
+
+
+def test_index_fill_vs_torch():
+    rng = np.random.RandomState(4)
+    x = rng.randn(5, 4).astype(np.float32)
+    idx = np.array([0, 3])
+    got = paddle.index_fill(t(x), t(idx), axis=0, value=-7.0)
+    ref = torch.tensor(x).index_fill(0, torch.tensor(idx), -7.0)
+    np.testing.assert_allclose(np.asarray(got.numpy()), ref.numpy())
+    got1 = paddle.index_fill(t(x), t(idx), axis=1, value=2.5)
+    ref1 = torch.tensor(x).index_fill(1, torch.tensor(idx), 2.5)
+    np.testing.assert_allclose(np.asarray(got1.numpy()), ref1.numpy())
+
+
+def test_nanmedian_vs_numpy():
+    x = np.array([[1.0, np.nan, 3.0, 2.0],
+                  [np.nan, np.nan, 5.0, 1.0]], np.float32)
+    got = paddle.nanmedian(t(x))
+    np.testing.assert_allclose(float(got.numpy()), np.nanmedian(x))
+    got_ax = paddle.nanmedian(t(x), axis=1)
+    np.testing.assert_allclose(np.asarray(got_ax.numpy()),
+                               np.nanmedian(x, axis=1))
+
+
+def test_corrcoef_vs_numpy():
+    rng = np.random.RandomState(5)
+    x = rng.randn(3, 40).astype(np.float32)
+    got = paddle.linalg.corrcoef(t(x))
+    np.testing.assert_allclose(np.asarray(got.numpy()), np.corrcoef(x),
+                               rtol=1e-4, atol=1e-5)
+    d = np.asarray(got.numpy()).diagonal()
+    np.testing.assert_allclose(d, 1.0, atol=1e-5)
+
+
+def test_hinge_embedding_loss_vs_torch():
+    rng = np.random.RandomState(6)
+    x = rng.randn(8, 5).astype(np.float32)
+    y = rng.choice([-1.0, 1.0], size=(8, 5)).astype(np.float32)
+    for red in ("mean", "sum", "none"):
+        got = paddle.nn.functional.hinge_embedding_loss(
+            t(x), t(y), margin=0.7, reduction=red)
+        ref = torch.nn.functional.hinge_embedding_loss(
+            torch.tensor(x), torch.tensor(y), margin=0.7, reduction=red)
+        np.testing.assert_allclose(np.asarray(got.numpy()), ref.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_cosine_embedding_loss_vs_torch():
+    rng = np.random.RandomState(7)
+    a = rng.randn(6, 10).astype(np.float32)
+    b = rng.randn(6, 10).astype(np.float32)
+    y = rng.choice([-1, 1], size=(6,)).astype(np.int64)
+    for red in ("mean", "sum", "none"):
+        got = paddle.nn.functional.cosine_embedding_loss(
+            t(a), t(b), t(y), margin=0.3, reduction=red)
+        ref = torch.nn.functional.cosine_embedding_loss(
+            torch.tensor(a), torch.tensor(b), torch.tensor(y),
+            margin=0.3, reduction=red)
+        np.testing.assert_allclose(np.asarray(got.numpy()), ref.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_triplet_margin_loss_vs_torch_and_grad():
+    rng = np.random.RandomState(8)
+    a = rng.randn(5, 8).astype(np.float32)
+    p = rng.randn(5, 8).astype(np.float32)
+    n = rng.randn(5, 8).astype(np.float32)
+    got = paddle.nn.functional.triplet_margin_loss(
+        t(a), t(p), t(n), margin=1.2, p=2)
+    ref = torch.nn.functional.triplet_margin_loss(
+        torch.tensor(a), torch.tensor(p), torch.tensor(n), margin=1.2, p=2)
+    np.testing.assert_allclose(float(got.numpy()), float(ref), rtol=1e-5)
+
+    at = t(a)
+    at.stop_gradient = False
+    loss = paddle.nn.functional.triplet_margin_loss(
+        at, t(p), t(n), margin=1.2)
+    loss.backward()
+    ta = torch.tensor(a, requires_grad=True)
+    torch.nn.functional.triplet_margin_loss(
+        ta, torch.tensor(p), torch.tensor(n), margin=1.2).backward()
+    np.testing.assert_allclose(np.asarray(at.grad.numpy()),
+                               ta.grad.numpy(), rtol=1e-4, atol=1e-6)
