@@ -565,6 +565,14 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             return node
         uid = self._uid()
         names = _assigned_names(node.body + node.orelse)
+        # drop branch-local temporaries: unbound before the if AND loaded
+        # nowhere outside its subtree — they stay plain locals of the
+        # branch closure (reference true_fn locals), never merge state
+        total = getattr(self.scope, "total_loads", {})
+        inside = getattr(node, "_pt_subtree_loads", {})
+        names = [n for n in names
+                 if not (self.scope.needs_preinit(n, node.lineno)
+                         and total.get(n, 0) == inside.get(n, 0))]
         pre = self._preinits(names, node.lineno)
         t_def = self._branch_def(f"__pt_true_{uid}", node.body, names)
         f_def = self._branch_def(f"__pt_false_{uid}", node.orelse, names)
@@ -801,6 +809,32 @@ def _transform_function_scopes(node: ast.FunctionDef, counter: List[int]):
         ast.fix_missing_locations(node)
     node.body = _fold_returns(node.body, counter)
     scope = _Scope(node)
+    # branch-local-temporary detection: a name assigned inside an `if` that
+    # is LOADED nowhere outside that if's subtree is a temp of the branch —
+    # it must not join the select-merge state (one-sided definition of a
+    # real variable still fails loud). Counted on the pre-transform tree;
+    # the annotations ride the If nodes into visit_If.
+    from collections import Counter
+
+    def _loads(root):
+        cnt = Counter()
+        for n in ast.walk(root):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                cnt[n.id] += 1
+            elif isinstance(n, ast.AugAssign):
+                # `c += 3` READS c even though its target ctx is Store
+                for nm in _target_names(n.target):
+                    cnt[nm] += 1
+            elif isinstance(n, ast.Delete):
+                for tgt in n.targets:
+                    for nm in _target_names(tgt):
+                        cnt[nm] += 1
+        return cnt
+
+    scope.total_loads = _loads(node)
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.If):
+            sub._pt_subtree_loads = _loads(sub)
     tr = _ControlFlowTransformer(scope, counter)
     node.body = [n for s in node.body
                  for n in (lambda r: r if isinstance(r, list) else [r])(

@@ -777,3 +777,144 @@ def test_tensor_iteration_terminates():
 
     with pytest.raises(TypeError, match="0-d"):
         next(iter(t(np.float32(1.0))))
+
+
+# ---- reference ifelse_simple_func.py ports (2.x API) ----
+
+def test_ref_if_else_with_optional_label():
+    """dyfunc_with_if_else: tensor-cond if + python `is not None` if with
+    an early return."""
+    def fn(x_v, label=None):
+        if x_v.mean() > 5:
+            x_v = x_v - 1
+        else:
+            x_v = x_v + 1
+        if label is not None:
+            return ((x_v - label) ** 2).mean()
+        return x_v
+
+    conv = convert_function(fn)
+    for base in (10.0, 0.0):
+        data = np.full((4,), base, np.float32)
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(_traced(conv, data), ref)
+    # label path (python-None dispatch must survive conversion)
+    lab = np.zeros((4,), np.float32)
+    ref = float(np.asarray(fn(t(np.full((4,), 10.0, np.float32)),
+                              t(lab)).numpy()))
+    got = _traced(conv, np.full((4,), 10.0, np.float32), lab)
+    np.testing.assert_allclose(got, ref)
+
+
+def test_ref_nested_three_levels_mixed_conditions():
+    """nested_if_else: python shape conditions mixed with tensor-mean
+    conditions across three nesting levels."""
+    def fn(x_v):
+        batch_size = 16
+        feat = x_v.shape[-1]
+        bias = x_v.sum() * 0 + 1
+        if x_v.shape[0] != batch_size:   # python condition
+            batch_size = x_v.shape[0]
+        if x_v.mean() < 0:               # tensor condition
+            y = x_v + bias
+            w = x_v * 0 + 10
+            if y.sum() < 10:             # tensor condition
+                y = (y * w).abs()
+            else:
+                y = y - 1
+        else:
+            y = x_v - bias
+        return y
+
+    conv = convert_function(fn)
+    for base in (-1.0, -0.001, 3.0):
+        data = np.full((4, 3), base, np.float32)
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(_traced(conv, data), ref, rtol=1e-6)
+
+
+def test_ref_if_with_and_or_mixed_python_tensor():
+    """if_with_and_or: `is not None` / python bools / tensor conditions in
+    one and/or chain (short-circuit keeps the python parts python)."""
+    def fn(x_v, label=None):
+        if x_v is not None and (x_v.mean() > 0 or label is not None) \
+                and x_v.shape[0] > 1 and True:
+            x_v = x_v - 1
+        else:
+            x_v = x_v + 1
+        return x_v
+
+    conv = convert_function(fn)
+    for base in (2.0, -2.0):
+        data = np.full((4,), base, np.float32)
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_ref_if_with_class_var():
+    """if_with_class_var: object attributes inside condition and body."""
+    def fn(x):
+        class Foo:
+            def __init__(self):
+                self.a = 1.0
+                self.b = 2.0
+
+        foo = Foo()
+        if x.mean() > foo.a:
+            x = x + foo.b
+        else:
+            x = x - foo.b
+        return x
+
+    conv = convert_function(fn)
+    for base in (3.0, 0.0):
+        data = np.full((4,), base, np.float32)
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(_traced(conv, data), ref)
+
+
+def test_ref_net_with_control_flow_forward():
+    """The reference's NetWithControlFlowIf shape: a Layer whose forward
+    picks different sublayers per branch, trained through to_static."""
+    class Net(nn.Layer):
+        def __init__(self, d=8):
+            super().__init__()
+            self.hot = nn.Linear(d, d)
+            self.cold = nn.Linear(d, d)
+            self.alpha = 10.0
+
+        def forward(self, x):
+            h = x
+            if h.mean() > 0:
+                out = self.hot(h) + self.alpha
+            else:
+                out = self.cold(h) - self.alpha
+            return out.mean()
+
+    paddle.seed(0)
+    net = Net()
+    static_net = to_static(net)
+    for base in (1.0, -1.0):
+        data = np.full((2, 8), base, np.float32)
+        ref = float(np.asarray(net(t(data)).numpy()))
+        got = float(np.asarray(static_net(t(data)).numpy()))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_augassign_after_if_keeps_merge_name():
+    """`c += 3` after an if that defines c must count as a USE of c — an
+    AugAssign target reads its name even with Store ctx (regression)."""
+    def fn(x):
+        if x.mean() > 0:
+            c = x * 1.0
+        else:
+            c = x * 2.0
+        c += 3
+        return c
+
+    conv = convert_function(fn)
+    for base in (1.0, -1.0):
+        data = np.full((3,), base, np.float32)
+        ref = np.asarray(fn(t(data)).numpy())
+        np.testing.assert_allclose(np.asarray(conv(t(data)).numpy()), ref)
+        np.testing.assert_allclose(_traced(conv, data), ref)

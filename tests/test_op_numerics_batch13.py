@@ -6,7 +6,6 @@ implemented-but-previously-unpinned ops: histogram (reference
 tensor/linalg.py:845), bincount, take_along_axis, put_along_axis,
 index_fill, nanmedian, corrcoef (parity-plus tail)."""
 import numpy as np
-import pytest
 import torch
 
 import paddle_tpu as paddle

@@ -1,7 +1,6 @@
 """Op numerics batch 14 — weight reparameterization, vision rearrangers,
 activation tail. Torch oracles throughout (SURVEY §4 fixture strategy)."""
 import numpy as np
-import pytest
 import torch
 
 import paddle_tpu as paddle
