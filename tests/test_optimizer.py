@@ -115,3 +115,132 @@ def test_optimizer_state_dict_roundtrip():
     np.testing.assert_allclose(
         np.asarray(opt2._state[id(w2)]["moment1"]),
         np.asarray(opt._state[id(w)]["moment1"]))
+
+
+# ---- exact reference-kernel oracles (operators/optimizers/*.h) ----
+
+def _run_steps(opt, w, grads):
+    for g in grads:
+        w.grad = paddle.to_tensor(np.asarray(g, np.float32))
+        opt.step()
+    return np.asarray(w.numpy())
+
+
+def test_rmsprop_matches_reference_kernel():
+    """rmsprop_op.h:194 — ms = rho*ms+(1-rho)g^2;
+    mom = mu*mom + lr*g/sqrt(ms+eps); p -= mom (eps INSIDE the sqrt,
+    unlike torch)."""
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(4).astype(np.float32)
+    grads = [rng.randn(4).astype(np.float32) for _ in range(5)]
+    lr, rho, eps, mu = 0.02, 0.95, 1e-6, 0.9
+
+    w = paddle.core.tensor.Parameter(w0.copy())
+    opt = optimizer.RMSProp(learning_rate=lr, rho=rho, epsilon=eps,
+                            momentum=mu, parameters=[w])
+    got = _run_steps(opt, w, grads)
+
+    ms = np.zeros(4, np.float64)
+    mom = np.zeros(4, np.float64)
+    ref = w0.astype(np.float64)
+    for g in grads:
+        ms = rho * ms + (1 - rho) * g.astype(np.float64) ** 2
+        mom = mu * mom + lr * g / np.sqrt(ms + eps)
+        ref = ref - mom
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_rmsprop_centered_matches_reference_kernel():
+    """rmsprop_op.h:189-191 — centered: denominator
+    sqrt(ms - mg^2 + eps) with mg = rho*mg+(1-rho)g."""
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(3).astype(np.float32)
+    grads = [rng.randn(3).astype(np.float32) for _ in range(4)]
+    lr, rho, eps, mu = 0.01, 0.9, 1e-6, 0.8
+
+    w = paddle.core.tensor.Parameter(w0.copy())
+    opt = optimizer.RMSProp(learning_rate=lr, rho=rho, epsilon=eps,
+                            momentum=mu, centered=True, parameters=[w])
+    got = _run_steps(opt, w, grads)
+
+    ms = np.zeros(3, np.float64)
+    mg = np.zeros(3, np.float64)
+    mom = np.zeros(3, np.float64)
+    ref = w0.astype(np.float64)
+    for g in grads:
+        g64 = g.astype(np.float64)
+        ms = rho * ms + (1 - rho) * g64 ** 2
+        mg = rho * mg + (1 - rho) * g64
+        mom = mu * mom + lr * g64 / np.sqrt(ms - mg ** 2 + eps)
+        ref = ref - mom
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_adadelta_matches_reference_kernel():
+    """adadelta_op.h:71-79 — asg = rho*asg+(1-rho)g^2;
+    update = -sqrt((asu+eps)/(asg+eps))*g; asu = rho*asu+(1-rho)update^2;
+    p += update."""
+    rng = np.random.RandomState(2)
+    w0 = rng.randn(4).astype(np.float32)
+    grads = [rng.randn(4).astype(np.float32) for _ in range(5)]
+    rho, eps = 0.95, 1e-6
+
+    w = paddle.core.tensor.Parameter(w0.copy())
+    opt = optimizer.Adadelta(learning_rate=1.0, rho=rho, epsilon=eps,
+                             parameters=[w])
+    got = _run_steps(opt, w, grads)
+
+    asg = np.zeros(4, np.float64)
+    asu = np.zeros(4, np.float64)
+    ref = w0.astype(np.float64)
+    for g in grads:
+        g64 = g.astype(np.float64)
+        asg = rho * asg + (1 - rho) * g64 ** 2
+        upd = -np.sqrt((asu + eps) / (asg + eps)) * g64
+        asu = rho * asu + (1 - rho) * upd ** 2
+        ref = ref + upd
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_adagrad_matches_reference_kernel():
+    """adagrad_op.cc:93 — moment += g^2;
+    p -= lr*g/(sqrt(moment)+eps) (eps OUTSIDE the sqrt)."""
+    rng = np.random.RandomState(3)
+    w0 = rng.randn(4).astype(np.float32)
+    grads = [rng.randn(4).astype(np.float32) for _ in range(5)]
+    lr, eps = 0.05, 1e-6
+
+    w = paddle.core.tensor.Parameter(w0.copy())
+    opt = optimizer.Adagrad(learning_rate=lr, epsilon=eps, parameters=[w])
+    got = _run_steps(opt, w, grads)
+
+    mom = np.zeros(4, np.float64)
+    ref = w0.astype(np.float64)
+    for g in grads:
+        g64 = g.astype(np.float64)
+        mom = mom + g64 ** 2
+        ref = ref - lr * g64 / (np.sqrt(mom) + eps)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_momentum_nesterov_matches_reference_kernel():
+    """momentum_op.h:47-49 — v = mu*v + g;
+    nesterov: p -= (g + mu*v)*lr; plain: p -= lr*v."""
+    rng = np.random.RandomState(4)
+    w0 = rng.randn(4).astype(np.float32)
+    grads = [rng.randn(4).astype(np.float32) for _ in range(5)]
+    lr, mu = 0.05, 0.9
+
+    for nesterov in (False, True):
+        w = paddle.core.tensor.Parameter(w0.copy())
+        opt = optimizer.Momentum(learning_rate=lr, momentum=mu,
+                                 use_nesterov=nesterov, parameters=[w])
+        got = _run_steps(opt, w, grads)
+        v = np.zeros(4, np.float64)
+        ref = w0.astype(np.float64)
+        for g in grads:
+            g64 = g.astype(np.float64)
+            v = mu * v + g64
+            ref = ref - ((g64 + mu * v) * lr if nesterov else lr * v)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"nesterov={nesterov}")
