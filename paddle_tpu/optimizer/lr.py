@@ -44,9 +44,13 @@ class NoamDecay(LRScheduler):
         super().__init__(learning_rate, last_epoch, verbose)
 
     def get_lr(self):
-        step = max(self.last_epoch, 1)
-        return self.base_lr * (self.d_model ** -0.5) * min(
-            step ** -0.5, step * self.warmup_steps ** -1.5)
+        # reference optimizer/lr.py NoamDecay.get_lr: a=1 at epoch 0, and
+        # b = warmup^-1.5 * epoch — so the FIRST lr is exactly 0 (warmup
+        # ramps from zero), not a clamped step-1 value
+        step = self.last_epoch
+        a = 1.0 if step == 0 else step ** -0.5
+        b = self.warmup_steps ** -1.5 * step
+        return self.base_lr * (self.d_model ** -0.5) * min(a, b)
 
 
 class PiecewiseDecay(LRScheduler):

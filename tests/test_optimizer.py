@@ -244,3 +244,157 @@ def test_momentum_nesterov_matches_reference_kernel():
             ref = ref - ((g64 + mu * v) * lr if nesterov else lr * v)
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6,
                                    err_msg=f"nesterov={nesterov}")
+
+
+# ---- LR scheduler oracles (reference python/paddle/optimizer/lr.py) ----
+
+def _lrs(sched, n):
+    out = []
+    for _ in range(n):
+        out.append(float(sched()))
+        sched.step()
+    return out
+
+
+def test_noam_decay_matches_reference_formula():
+    """NoamDecay.get_lr: a=1 at epoch 0 (so lr starts at exactly 0 and
+    ramps); min(step^-0.5, warmup^-1.5 * step) after."""
+    from paddle_tpu.optimizer.lr import NoamDecay
+    d_model, warmup, base = 64, 4, 2.0
+    s = NoamDecay(d_model=d_model, warmup_steps=warmup, learning_rate=base)
+    got = _lrs(s, 8)
+    ref = []
+    for e in range(8):
+        a = 1.0 if e == 0 else e ** -0.5
+        b = warmup ** -1.5 * e
+        ref.append(base * d_model ** -0.5 * min(a, b))
+    np.testing.assert_allclose(got, ref, rtol=1e-12)
+    assert got[0] == 0.0  # warmup ramps from zero
+
+
+def test_natural_exp_and_inverse_time_formulas():
+    from paddle_tpu.optimizer.lr import InverseTimeDecay, NaturalExpDecay
+    import math
+    g, base = 0.3, 0.5
+    ne = NaturalExpDecay(learning_rate=base, gamma=g)
+    np.testing.assert_allclose(
+        _lrs(ne, 5), [base * math.exp(-g * e) for e in range(5)],
+        rtol=1e-12)
+    it = InverseTimeDecay(learning_rate=base, gamma=g)
+    np.testing.assert_allclose(
+        _lrs(it, 5), [base / (1 + g * e) for e in range(5)], rtol=1e-12)
+
+
+def test_polynomial_decay_cycle_and_clamp():
+    from paddle_tpu.optimizer.lr import PolynomialDecay
+    import math
+    base, end, steps, power = 1.0, 0.1, 4, 2.0
+    # cycle=False: epoch clamps at decay_steps
+    s = PolynomialDecay(learning_rate=base, decay_steps=steps, end_lr=end,
+                        power=power, cycle=False)
+    got = _lrs(s, 7)
+    ref = []
+    for e in range(7):
+        t = min(e, steps)
+        ref.append((base - end) * (1 - t / steps) ** power + end)
+    np.testing.assert_allclose(got, ref, rtol=1e-12)
+    assert got[4] == got[5] == got[6] == end
+    # cycle=True: decay_steps stretches by ceil(epoch/steps)
+    s2 = PolynomialDecay(learning_rate=base, decay_steps=steps, end_lr=end,
+                         power=power, cycle=True)
+    got2 = _lrs(s2, 9)
+    ref2 = []
+    for e in range(9):
+        div = math.ceil(e / steps) if e > 0 else 1
+        ds = steps * div
+        ref2.append((base - end) * (1 - e / ds) ** power + end)
+    np.testing.assert_allclose(got2, ref2, rtol=1e-12)
+
+
+def test_step_multistep_exponential_vs_torch():
+    import torch
+    from paddle_tpu.optimizer.lr import (ExponentialDecay, MultiStepDecay,
+                                         StepDecay)
+
+    def torch_lrs(sched_cls, n, **kw):
+        p = torch.nn.Parameter(torch.zeros(1))
+        opt = torch.optim.SGD([p], lr=0.5)
+        s = sched_cls(opt, **kw)
+        out = []
+        for _ in range(n):
+            out.append(opt.param_groups[0]["lr"])
+            opt.step()
+            s.step()
+        return out
+
+    np.testing.assert_allclose(
+        _lrs(StepDecay(learning_rate=0.5, step_size=3, gamma=0.2), 8),
+        torch_lrs(torch.optim.lr_scheduler.StepLR, 8, step_size=3,
+                  gamma=0.2), rtol=1e-10)
+    np.testing.assert_allclose(
+        _lrs(MultiStepDecay(learning_rate=0.5, milestones=[2, 5],
+                            gamma=0.3), 8),
+        torch_lrs(torch.optim.lr_scheduler.MultiStepLR, 8,
+                  milestones=[2, 5], gamma=0.3), rtol=1e-10)
+    np.testing.assert_allclose(
+        _lrs(ExponentialDecay(learning_rate=0.5, gamma=0.8), 6),
+        torch_lrs(torch.optim.lr_scheduler.ExponentialLR, 6, gamma=0.8),
+        rtol=1e-10)
+
+
+def test_lambda_and_multiplicative_decay():
+    from paddle_tpu.optimizer.lr import LambdaDecay, MultiplicativeDecay
+    lam = _lrs(LambdaDecay(learning_rate=0.5,
+                           lr_lambda=lambda e: 0.9 ** e), 5)
+    np.testing.assert_allclose(lam, [0.5 * 0.9 ** e for e in range(5)],
+                               rtol=1e-12)
+    mul = _lrs(MultiplicativeDecay(learning_rate=0.5,
+                                   lr_lambda=lambda e: 0.9), 5)
+    np.testing.assert_allclose(mul, [0.5 * 0.9 ** e for e in range(5)],
+                               rtol=1e-6)
+
+
+def test_cyclic_lr_triangular_shapes():
+    from paddle_tpu.optimizer.lr import CyclicLR
+    s = CyclicLR(base_learning_rate=0.1, max_learning_rate=0.5,
+                 step_size_up=4, step_size_down=4)
+    got = _lrs(s, 17)
+    assert got[0] == pytest.approx(0.1)
+    assert got[4] == pytest.approx(0.5)   # peak after step_size_up
+    assert got[8] == pytest.approx(0.1)   # back to base after a cycle
+    assert got[16] == pytest.approx(0.1)  # periodic
+    # triangular2 halves the amplitude each cycle
+    s2 = CyclicLR(base_learning_rate=0.1, max_learning_rate=0.5,
+                  step_size_up=4, step_size_down=4, mode="triangular2")
+    got2 = _lrs(s2, 17)
+    assert got2[4] == pytest.approx(0.5)
+    assert got2[12] == pytest.approx(0.1 + 0.4 / 2)
+
+
+def test_one_cycle_lr_phases():
+    from paddle_tpu.optimizer.lr import OneCycleLR
+    s = OneCycleLR(max_learning_rate=1.0, total_steps=10,
+                   divide_factor=25.0, end_learning_rate=0.01,
+                   phase_pct=0.3)
+    got = _lrs(s, 11)
+    assert got[0] == pytest.approx(1.0 / 25.0)
+    assert got[3] == pytest.approx(1.0)      # peak at phase_pct boundary
+    assert got[10] == pytest.approx(0.01)    # annealed to end lr
+    assert all(got[i] <= got[i + 1] + 1e-9 for i in range(3))   # ramp up
+    assert all(got[i] >= got[i + 1] - 1e-9 for i in range(3, 10))  # anneal
+
+
+def test_reduce_on_plateau_patience_cooldown_minlr():
+    from paddle_tpu.optimizer.lr import ReduceOnPlateau
+    s = ReduceOnPlateau(learning_rate=1.0, factor=0.5, patience=2,
+                        threshold=0.0, threshold_mode="abs", cooldown=1,
+                        min_lr=0.2)
+    lrs = []
+    # metrics stop improving after the first value
+    for m in [1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0]:
+        s.step(m)
+        lrs.append(s.get_lr())
+    assert lrs[0] == 1.0
+    assert 0.5 in lrs          # first reduction after patience exceeded
+    assert min(lrs) >= 0.2     # floor respected
+    assert lrs[-1] == pytest.approx(0.25)  # second reduction really fired
