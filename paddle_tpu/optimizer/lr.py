@@ -47,7 +47,8 @@ class NoamDecay(LRScheduler):
         # reference optimizer/lr.py NoamDecay.get_lr: a=1 at epoch 0, and
         # b = warmup^-1.5 * epoch — so the FIRST lr is exactly 0 (warmup
         # ramps from zero), not a clamped step-1 value
-        step = self.last_epoch
+        step = max(self.last_epoch, 0)  # negative epochs clamp to the
+        # ramp start instead of raising on a complex power
         a = 1.0 if step == 0 else step ** -0.5
         b = self.warmup_steps ** -1.5 * step
         return self.base_lr * (self.d_model ** -0.5) * min(a, b)

@@ -1,6 +1,9 @@
 """Op numerics batch 14 — weight reparameterization, vision rearrangers,
-activation tail. Torch oracles throughout (SURVEY §4 fixture strategy)."""
+activation tail, and initializer conventions (fan computation, MSRA/Xavier
+scales, TruncatedNormal clipping, Orthogonal). Torch/closed-form oracles
+throughout (SURVEY §4 fixture strategy)."""
 import numpy as np
+import pytest
 import torch
 
 import paddle_tpu as paddle
@@ -162,3 +165,47 @@ def test_alpha_dropout_preserves_statistics():
     out_eval = np.asarray(paddle.nn.functional.alpha_dropout(
         t(x), p=0.3, training=False).numpy())
     np.testing.assert_allclose(out_eval, x)
+
+
+# ---- initializer conventions (fluid/initializer.py _compute_fans, MSRA/
+# Xavier formulas) ----
+
+def test_initializer_fan_and_scale_conventions():
+    import math
+    import paddle_tpu.nn.initializer as I
+    paddle.seed(0)
+
+    # Linear weight [in=400, out=300]: fan_in=400, fan_out=300
+    w = np.asarray(I.XavierUniform()([400, 300]))
+    limit = math.sqrt(6.0 / (400 + 300))
+    assert abs(np.abs(w).max() - limit) < limit * 0.05
+    assert w.std() == pytest.approx(limit / math.sqrt(3.0), rel=0.05)
+
+    # conv kernel [out=64, in=32, 3, 3]: fan_in = 32*9 (reference
+    # _compute_fans: shape[1] * receptive)
+    k = np.asarray(I.KaimingNormal()([64, 32, 3, 3]))
+    assert k.std() == pytest.approx(math.sqrt(2.0 / (32 * 9)), rel=0.05)
+
+    ku = np.asarray(I.KaimingUniform()([64, 32, 3, 3]))
+    klim = math.sqrt(6.0 / (32 * 9))  # MSRA uniform limit sqrt(6/fan_in)
+    assert abs(np.abs(ku).max() - klim) < klim * 0.05
+
+    xn = np.asarray(I.XavierNormal()([400, 300]))
+    assert xn.std() == pytest.approx(math.sqrt(2.0 / 700), rel=0.05)
+
+    tn = np.asarray(I.TruncatedNormal(mean=1.0, std=2.0)([100000]))
+    assert np.abs(tn - 1.0).max() <= 2.0 * 2.0 + 1e-5  # hard +/-2 sigma
+    assert tn.mean() == pytest.approx(1.0, abs=0.05)
+
+    # explicit fan override wins over the shape-derived one
+    kf = np.asarray(I.KaimingNormal(fan_in=50)([64, 32, 3, 3]))
+    assert kf.std() == pytest.approx(math.sqrt(2.0 / 50), rel=0.05)
+
+
+def test_orthogonal_initializer_is_orthogonal():
+    import paddle_tpu.nn.initializer as I
+    paddle.seed(0)
+    w = np.asarray(I.Orthogonal()([40, 40]))
+    np.testing.assert_allclose(w @ w.T, np.eye(40), atol=1e-4)
+    r = np.asarray(I.Orthogonal(gain=3.0)([20, 60]))  # wide: rows orthonormal
+    np.testing.assert_allclose(r @ r.T, 9.0 * np.eye(20), atol=1e-3)
