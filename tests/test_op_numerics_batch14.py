@@ -209,3 +209,31 @@ def test_orthogonal_initializer_is_orthogonal():
     np.testing.assert_allclose(w @ w.T, np.eye(40), atol=1e-4)
     r = np.asarray(I.Orthogonal(gain=3.0)([20, 60]))  # wide: rows orthonormal
     np.testing.assert_allclose(r @ r.T, 9.0 * np.eye(20), atol=1e-3)
+
+
+def test_pad_modes_vs_torch():
+    x = np.arange(24, dtype=np.float32).reshape(1, 2, 3, 4)
+    import paddle_tpu.nn.functional as F
+    for mode in ("circular", "replicate", "reflect"):
+        got = np.asarray(F.pad(t(x), [1, 1, 1, 1], mode=mode).numpy())
+        ref = torch.nn.functional.pad(torch.tensor(x), (1, 1, 1, 1),
+                                      mode=mode).numpy()
+        np.testing.assert_allclose(got, ref, err_msg=mode)
+    got_c = np.asarray(F.pad(t(x), [2, 1, 0, 2], mode="constant",
+                             value=7.0).numpy())
+    ref_c = torch.nn.functional.pad(torch.tensor(x), (2, 1, 0, 2),
+                                    mode="constant", value=7.0).numpy()
+    np.testing.assert_allclose(got_c, ref_c)
+
+
+def test_tensordot_vs_numpy():
+    rng = np.random.RandomState(9)
+    a = rng.randn(3, 4, 5).astype(np.float32)
+    b = rng.randn(4, 5, 6).astype(np.float32)
+    got = paddle.tensordot(t(a), t(b), axes=2)
+    np.testing.assert_allclose(np.asarray(got.numpy()),
+                               np.tensordot(a, b, axes=2), rtol=1e-5)
+    got2 = paddle.tensordot(t(a), t(b), axes=[[1, 2], [0, 1]])
+    np.testing.assert_allclose(np.asarray(got2.numpy()),
+                               np.tensordot(a, b, axes=[[1, 2], [0, 1]]),
+                               rtol=1e-5)
