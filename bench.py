@@ -125,7 +125,7 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
     rng = np.random.RandomState(0)
     if preset == "resnet50":
         # BASELINE config 1: ResNet-50 fwd+bwd (metric: images/sec/chip).
-        # FLOPs from the hapi flops counter (fwd), x3 for fwd+bwd.
+        # MACs from the hapi flops counter (fwd); x2 MAC->FLOP, x3 fwd+bwd.
         model = paddle.vision.models.resnet50(num_classes=1000)
         fwd_flops = float(paddle.flops(model, input_size=[1, 3, S, S]))
         if on_tpu:
@@ -221,11 +221,13 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
     # MoE models count ACTIVE params: each token runs top_k of E experts,
     # so expert weights contribute top_k/E of their size (6ND would
     # otherwise overstate the work and inflate MFU). Conv models use the
-    # measured fwd flops x3 (fwd + ~2x bwd) per image.
+    # measured fwd MACs x2 (MAC->FLOP) x3 (fwd + ~2x bwd) per image.
     n_params = sum(int(np.prod(p.shape)) for p in params.values())
     moe_E = getattr(cfg, "moe_num_experts", 0) if cfg is not None else 0
     if preset == "resnet50":
-        flops_per_step = 3.0 * fwd_flops * B
+        # paddle.flops counts MACs (one multiply-add = 1); true FLOPs are
+        # 2x that, and fwd+bwd ~ 3x the forward
+        flops_per_step = 3.0 * (2.0 * fwd_flops) * B
     elif moe_E:
         top_k = getattr(cfg, "moe_top_k", 2)
         # expert params come from the MoELayer module structure (all its
