@@ -16,12 +16,14 @@ from __future__ import annotations
 import json
 import os
 import time
+import zlib
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
 from .core.tensor import Tensor
+from .utils import fault_injection
 
 try:
     import orbax.checkpoint as ocp
@@ -45,6 +47,27 @@ def _is_sharded(tree) -> bool:
     return False
 
 
+def _leaf_specs(state) -> Dict[str, Dict[str, Any]]:
+    """Per-leaf {path: {shape, dtype}} for the integrity manifest."""
+    leaves = jax.tree_util.tree_leaves_with_path(state)
+    out = {}
+    for path, leaf in leaves:
+        key = jax.tree_util.keystr(path)
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            out[key] = {"shape": list(leaf.shape), "dtype": str(leaf.dtype)}
+        else:
+            out[key] = {"shape": [], "dtype": type(leaf).__name__}
+    return out
+
+
+def _file_crc(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
 class CheckpointManager:
     """Step-indexed checkpoint directory with retention + async save.
 
@@ -52,30 +75,79 @@ class CheckpointManager:
         mgr = CheckpointManager(dir, max_to_keep=3, async_save=True)
         mgr.save(step, {"params": ..., "opt": ..., "meta": {...}})
         state = mgr.restore(step=None)   # latest
+
+    The non-orbax fallback path is torn-write safe: the pickle is written to
+    a temp name, a JSON manifest (per-leaf shapes/dtypes + CRC32 of the data
+    file) is written alongside, and both land via atomic os.replace — data
+    first, manifest last, so a manifest's existence certifies a complete
+    data file. restore()/latest_step() only consider steps whose manifest
+    exists and whose checksum matches, so a process killed mid-save (or a
+    corrupted file) falls back to the latest *valid* step.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 async_save: bool = False):
+                 async_save: bool = False, use_orbax: bool = True):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._max_to_keep = max_to_keep
-        self._async = async_save and _HAS_ORBAX
-        if _HAS_ORBAX:
+        use_orbax = use_orbax and _HAS_ORBAX
+        self._async = async_save and use_orbax
+        if use_orbax:
             opts = ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, enable_async_checkpointing=self._async)
             self._mgr = ocp.CheckpointManager(self.directory, options=opts)
         else:
             self._mgr = None
 
+    # ---- fallback-path file layout ----
+    def _data_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step}.pdckpt")
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step}.manifest.json")
+
     def save(self, step: int, state: Dict[str, Any], force: bool = False):
         state = _to_arrays(state)
         if self._mgr is not None:
             self._mgr.save(step, args=ocp.args.StandardSave(state),
                            force=force)
-        else:  # fallback: pickle per step (replicated arrays only)
-            from .framework_io import save as _save
-            _save(state, os.path.join(self.directory, f"step_{step}.pdckpt"))
-            self._gc()
+            return
+        # fallback: pickle per step (replicated arrays only), atomic +
+        # manifest-certified so torn writes are detectable on restore
+        from .framework_io import save as _save
+        plan = fault_injection.global_plan()
+        data, manifest = self._data_path(step), self._manifest_path(step)
+        tmp_data, tmp_manifest = data + ".tmp", manifest + ".tmp"
+        _save(state, tmp_data)
+        plan.maybe_kill(step, fault_injection.KILL_POINT_MID_SAVE)
+        spec = {"step": step, "format": "pdckpt.v1",
+                "crc32": _file_crc(tmp_data), "time": time.time(),
+                "leaves": _leaf_specs(state)}
+        with open(tmp_manifest, "w") as f:
+            json.dump(spec, f)
+        os.replace(tmp_data, data)
+        plan.maybe_kill(step, fault_injection.KILL_POINT_AFTER_DATA)
+        os.replace(tmp_manifest, manifest)
+        self._gc()
+
+    def verify(self, step: int) -> bool:
+        """True iff the fallback files for `step` are complete and the data
+        file matches its manifest checksum. FLAGS_ckpt_integrity_check=False
+        skips the CRC pass (huge checkpoints) but still requires the
+        manifest, whose presence certifies the save sequence finished."""
+        data, manifest = self._data_path(step), self._manifest_path(step)
+        if not (os.path.exists(data) and os.path.exists(manifest)):
+            return False
+        from .flags import get_flags
+        if not get_flags("FLAGS_ckpt_integrity_check")[
+                "FLAGS_ckpt_integrity_check"]:
+            return True
+        try:
+            with open(manifest) as f:
+                spec = json.load(f)
+            return _file_crc(data) == spec["crc32"]
+        except (OSError, ValueError, KeyError):
+            return False
 
     def restore(self, step: Optional[int] = None,
                 template: Optional[Dict[str, Any]] = None):
@@ -88,30 +160,45 @@ class CheckpointManager:
                     step, args=ocp.args.StandardRestore(_to_arrays(template)))
             return self._mgr.restore(step)
         from .framework_io import load as _load
-        step = self.latest_step() if step is None else step
+        if step is not None:
+            if not self.verify(step):
+                raise ValueError(
+                    f"checkpoint step {step} in {self.directory} is missing "
+                    "or fails integrity verification (torn write?)")
+            return _load(self._data_path(step))
+        step = self.latest_step()
         if step is None:
             return None
-        return _load(os.path.join(self.directory, f"step_{step}.pdckpt"))
+        return _load(self._data_path(step))
 
-    def latest_step(self) -> Optional[int]:
+    def all_steps(self) -> list:
+        """Steps present on disk (fallback: valid, manifest-certified only)."""
         if self._mgr is not None:
-            return self._mgr.latest_step()
+            return sorted(self._mgr.all_steps())
         steps = [int(f[len("step_"):-len(".pdckpt")])
                  for f in os.listdir(self.directory)
                  if f.startswith("step_") and f.endswith(".pdckpt")]
-        return max(steps) if steps else None
+        return sorted(s for s in steps if self.verify(s))
+
+    def latest_step(self) -> Optional[int]:
+        """Latest *valid* step: fallback checkpoints that are torn or fail
+        their checksum are skipped, not returned."""
+        if self._mgr is not None:
+            return self._mgr.latest_step()
+        steps = self.all_steps()
+        return steps[-1] if steps else None
 
     def wait_until_finished(self):
         if self._mgr is not None:
             self._mgr.wait_until_finished()
 
     def _gc(self):
-        steps = sorted(s for s in [self.latest_step()] if s is not None)
-        files = sorted(
-            (f for f in os.listdir(self.directory) if f.startswith("step_")),
-            key=lambda f: int(f[len("step_"):-len(".pdckpt")]))
-        while len(files) > self._max_to_keep:
-            os.remove(os.path.join(self.directory, files.pop(0)))
+        valid = self.all_steps()
+        while len(valid) > self._max_to_keep:
+            s = valid.pop(0)
+            for p in (self._data_path(s), self._manifest_path(s)):
+                if os.path.exists(p):
+                    os.remove(p)
 
     def close(self):
         if self._mgr is not None:

@@ -22,6 +22,8 @@ from . import utils  # noqa: F401
 from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from .trainer import DeviceWorker, MultiTrainer, train_from_dataset  # noqa: F401
 from .elastic import ElasticManager, ElasticStatus  # noqa: F401
+from .resilient import (ResilientConfig, ResilientTrainer,  # noqa: F401
+                        UnrecoverableError)
 
 
 def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,

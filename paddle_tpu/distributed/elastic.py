@@ -67,7 +67,13 @@ class ElasticManager:
 
     def __init__(self, host_endpoint: str, kv=None, np_range=(1, None),
                  timeout: float = 10.0,
-                 on_restart: Optional[Callable[[List[str]], None]] = None):
+                 on_restart: Optional[Callable[[List[str]], None]] = None,
+                 kv_retries: int = 3, kv_backoff: float = 0.1,
+                 expiry_grace: Optional[int] = None):
+        if expiry_grace is None:
+            from ..flags import get_flags
+            expiry_grace = get_flags("FLAGS_elastic_expiry_grace")[
+                "FLAGS_elastic_expiry_grace"]
         self.endpoint = host_endpoint
         self.kv = kv if kv is not None else _LocalKV()
         self.min_np, self.max_np = np_range
@@ -76,6 +82,27 @@ class ElasticManager:
         self.hosts: List[str] = []
         self._beat_stop = threading.Event()
         self._beat_thread: Optional[threading.Thread] = None
+        # hardening: transient KV hiccups must not look like mass death.
+        # KV ops retry with bounded backoff, and a previously-alive host is
+        # only declared dead after `expiry_grace` consecutive stale polls.
+        self._kv_retries = kv_retries
+        self._kv_backoff = kv_backoff
+        self.expiry_grace = max(1, int(expiry_grace))
+        self._miss_counts: Dict[str, int] = {}
+
+    def _kv_call(self, fn, *args):
+        """Run a KV op with bounded exponential-backoff retry; transient
+        server hiccups (connection reset, restart) self-heal instead of
+        bubbling up as membership events."""
+        attempt = 0
+        while True:
+            try:
+                return fn(*args)
+            except Exception:
+                attempt += 1
+                if attempt > self._kv_retries:
+                    raise
+                time.sleep(self._kv_backoff * (2 ** (attempt - 1)))
 
     # ---- membership registry ----
     def register(self, retry_window: float = 30.0):
@@ -101,16 +128,16 @@ class ElasticManager:
         heartbeat since every node re-merges itself)."""
         if hasattr(self.kv, "keys"):
             return
-        raw = self.kv.get(self.PREFIX + "_roster")
+        raw = self._kv_call(self.kv.get, self.PREFIX + "_roster")
         hosts = set(_text(raw).split(",")) - {""} if raw else set()
         if self.endpoint not in hosts:
             hosts.add(self.endpoint)
-            self.kv.put(self.PREFIX + "_roster",
-                        ",".join(sorted(hosts)).encode())
+            self._kv_call(self.kv.put, self.PREFIX + "_roster",
+                          ",".join(sorted(hosts)).encode())
 
     def _heartbeat_once(self):
-        self.kv.put(self.PREFIX + self.endpoint,
-                    f"{time.time()}".encode())
+        self._kv_call(self.kv.put, self.PREFIX + self.endpoint,
+                      f"{time.time()}".encode())
 
     def _beat_loop(self):
         while not self._beat_stop.wait(self.timeout / 3):
@@ -135,10 +162,10 @@ class ElasticManager:
         except Exception:
             pass  # the KV host may already be gone during teardown
 
-    def alive_hosts(self) -> List[str]:
-        """Endpoints with a fresh heartbeat, sorted for stable rank order."""
+    def _host_ages(self) -> Dict[str, float]:
+        """Heartbeat age in seconds per registered endpoint."""
         now = time.time()
-        out = []
+        ages = {}
         for key in self._keys():
             raw = self.kv.get(key)
             if raw is None:
@@ -147,9 +174,13 @@ class ElasticManager:
                 ts = float(_text(raw))
             except ValueError:
                 continue
-            if now - ts <= self.timeout:
-                out.append(key[len(self.PREFIX):])
-        return sorted(out)
+            ages[key[len(self.PREFIX):]] = now - ts
+        return ages
+
+    def alive_hosts(self) -> List[str]:
+        """Endpoints with a fresh heartbeat, sorted for stable rank order."""
+        return sorted(h for h, age in self._host_ages().items()
+                      if age <= self.timeout)
 
     def _keys(self):
         if hasattr(self.kv, "keys"):
@@ -163,8 +194,31 @@ class ElasticManager:
 
     # ---- watch loop (elastic.py watch + _update_hosts analog) ----
     def watch_once(self) -> str:
-        """One poll: compare live membership to the last seen roster."""
-        alive = self.alive_hosts()
+        """One poll: compare live membership to the last seen roster.
+
+        Expiry hardening: a host that was in the roster keeps its seat for
+        up to `expiry_grace` consecutive *slightly*-stale polls before its
+        absence triggers a relaunch — one missed heartbeat (GC pause, KV
+        restart, packet loss) is not a membership event. A heartbeat older
+        than `timeout * expiry_grace` is past any transient hiccup and
+        evicts immediately. A KV outage during the poll itself HOLDs with
+        the old roster instead of reading as everyone-died."""
+        try:
+            ages = self._host_ages()
+        except Exception:
+            return ElasticStatus.HOLD  # KV unreachable: keep the old world
+        alive = sorted(h for h, a in ages.items() if a <= self.timeout)
+        # grace: re-add known hosts whose heartbeat is stale but young
+        for h in self.hosts:
+            if h in alive:
+                self._miss_counts.pop(h, None)
+            else:
+                misses = self._miss_counts.get(h, 0) + 1
+                self._miss_counts[h] = misses
+                hard_dead = ages.get(h, float("inf")) \
+                    > self.timeout * self.expiry_grace
+                if misses < self.expiry_grace and not hard_dead:
+                    alive = sorted(set(alive) | {h})
         if not alive:
             return ElasticStatus.HOLD
         if self.max_np and len(alive) > self.max_np:

@@ -264,20 +264,37 @@ class NativePSClient:
     def dead(self) -> List[bool]:
         return list(self._dead)
 
-    def _call(self, s: int, op: str, fn, *args):
+    def _call(self, s: int, op: str, fn, *args, idempotent: bool = True):
         """Run fn(conn, *args) with reconnect-and-retry on failure: a
         worker must survive a transient server drop (brpc retry), and a
-        persistently-dead shard must raise a clear error, not hang."""
+        persistently-dead shard must raise a clear error, not hang.
+
+        Automatic retry is restricted to idempotent RPCs (pull/save/load/
+        create/size). A mutating op (push_sparse/push_dense) that fails
+        AFTER being issued may have been applied server-side with only the
+        reply lost; blindly replaying it would double-apply the gradient.
+        Such failures raise immediately and the caller decides. Retrying
+        is still safe when the connection was down before the send (the
+        RPC was never issued)."""
         import time
         attempt = 0
         while True:
             with self._locks[s]:
                 h = self._conns[s]
+                issued = h is not None
                 rc = fn(h, *args) if h else -1
                 if rc == 0:
                     self._dead[s] = False
                     return
             attempt += 1
+            if not idempotent and issued:
+                self._dead[s] = not self.ping(s)
+                raise RuntimeError(
+                    f"{op} failed on shard {s} ({self._endpoints[s]}) "
+                    f"(rc={rc}) after the request was issued; not retrying "
+                    "a non-idempotent RPC (the server may have applied it "
+                    "— the reply, not the push, may be what was lost). "
+                    "Re-pull and recompute before pushing again.")
             if attempt > self._retries:
                 self._dead[s] = True
                 raise RuntimeError(
@@ -337,7 +354,8 @@ class NativePSClient:
                 s, f"push_sparse({table})", self._lib.ps_push_sparse, tid,
                 sub.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
                 len(sel), dim,
-                g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+                g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                idempotent=False)
 
     def _dense_conn(self, name: str) -> int:
         return _table_id("dense:" + name) % self.n
@@ -365,7 +383,8 @@ class NativePSClient:
         self._call(
             self._dense_conn(name), f"push_dense({name})",
             self._lib.ps_push_dense, _table_id(name),
-            g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), size)
+            g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), size,
+            idempotent=False)
 
     def save(self, dirname: str, tables: Optional[List[str]] = None):
         """Server-side save: each shard writes its partition of each sparse

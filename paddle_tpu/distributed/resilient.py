@@ -1,0 +1,350 @@
+"""Resilient training runtime: step-level failure recovery over the
+existing trainer/checkpoint stack.
+
+TPU fleets run on preemptible capacity, so the recovery contract has to
+cover more than the reference's auto_checkpoint epoch-range resume
+(fluid/incubate/checkpoint/auto_checkpoint.py) + elastic relaunch:
+
+- **NaN/Inf loss sentinel** — a poisoned step is skipped (optimizer state
+  untouched by the caller's convention below); too many consecutive skips
+  escalate to a rollback onto the last valid checkpoint.
+- **Hung-step watchdog** — a daemon thread interrupts the main thread when
+  a step exceeds the deadline (stuck host transfer, wedged collective);
+  the step is retried and escalates like any other transient failure.
+- **Bounded exponential-backoff retry** — transient host-side exceptions
+  retry in place before escalating to rollback, then abort.
+- **Preemption handling** — SIGTERM/SIGINT set a flag checked at every
+  step boundary; the runtime performs a final synchronous
+  CheckpointManager.save(force=True), writes a resumable marker, and
+  exits 143 so the scheduler sees a clean preemption.
+
+Recovery works at step granularity because CheckpointManager's fallback
+path certifies each step with an integrity manifest (paddle_tpu.checkpoint)
+— a process killed mid-save restores from the latest *valid* step.
+
+Fault paths are exercised deterministically via
+paddle_tpu.utils.fault_injection (PDTPU_FAULTS env spec).
+"""
+from __future__ import annotations
+
+import math
+import os
+import json
+import signal
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..checkpoint import CheckpointManager
+from ..profiler import RecordEvent, record_instant
+from ..utils import fault_injection
+from .trainer import DeviceWorker
+
+PREEMPT_MARKER = "preempted.json"
+
+
+class UnrecoverableError(RuntimeError):
+    """Raised when the retry → rollback escalation budget is exhausted."""
+
+
+class WatchdogTimeout(RuntimeError):
+    """Internal: a step exceeded the watchdog deadline."""
+
+
+class ResilientConfig:
+    """Escalation policy knobs (defaults tuned for tests/small runs)."""
+
+    def __init__(self, nan_policy: str = "skip",
+                 max_consecutive_skips: int = 3,
+                 max_rollbacks: int = 2,
+                 max_step_retries: int = 2,
+                 retry_backoff: float = 0.25,
+                 watchdog_timeout: Optional[float] = None,
+                 save_interval: int = 1):
+        if nan_policy not in ("skip", "rollback", "abort"):
+            raise ValueError(f"unknown nan_policy {nan_policy!r}")
+        if watchdog_timeout is None:
+            # fall back to the framework flag (0.0 = disabled)
+            from ..flags import get_flags
+            watchdog_timeout = get_flags("FLAGS_step_watchdog_timeout")[
+                "FLAGS_step_watchdog_timeout"] or None
+        self.nan_policy = nan_policy
+        self.max_consecutive_skips = max_consecutive_skips
+        self.max_rollbacks = max_rollbacks
+        self.max_step_retries = max_step_retries
+        self.retry_backoff = retry_backoff
+        self.watchdog_timeout = watchdog_timeout
+        self.save_interval = save_interval
+
+
+class _Watchdog:
+    """Daemon thread that interrupts the main thread when the in-flight
+    step exceeds `timeout` seconds (no beat). `fire` delivers the
+    interruption — the runtime wires it to pthread_kill(main, SIGUSR1)
+    whose handler raises WatchdogTimeout, which also breaks out of a
+    time.sleep-style hang. (interrupt_main is NOT used: it simulates
+    SIGINT, which the preemption handler owns.)"""
+
+    def __init__(self, timeout: float, fire: Callable[[], None]):
+        self.timeout = timeout
+        self._fire = fire
+        self.fired = False
+        self._beat = time.monotonic()
+        self._in_step = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def step_begin(self):
+        self.fired = False
+        self._beat = time.monotonic()
+        self._in_step = True
+
+    def step_end(self):
+        self._in_step = False
+
+    def _loop(self):
+        poll = max(self.timeout / 4.0, 0.01)
+        while not self._stop.wait(poll):
+            if (self._in_step and not self.fired
+                    and time.monotonic() - self._beat > self.timeout):
+                self.fired = True
+                self._in_step = False
+                self._fire()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+def _loss_value(loss) -> Optional[float]:
+    """Scalar view of a step's loss, or None if it has no scalar form."""
+    try:
+        if hasattr(loss, "item"):
+            return float(loss.item())
+        if isinstance(loss, (int, float)):
+            return float(loss)
+        if isinstance(loss, (tuple, list)) and loss:
+            return _loss_value(loss[0])
+    except (TypeError, ValueError):
+        pass
+    return None
+
+
+class ResilientTrainer:
+    """Wraps a DeviceWorker-style train fn with step-level recovery.
+
+    usage:
+        trainer = ResilientTrainer(
+            train_fn, ckpt_dir,
+            get_state=lambda: {"model": model.state_dict(), ...},
+            set_state=lambda s: model.set_state_dict(s["model"]),
+            config=ResilientConfig(watchdog_timeout=30))
+        summary = trainer.run(batch_fn, num_steps=1000)
+
+    `batch_fn` maps a 0-based step index to the step's batch (so the same
+    data is replayed after rollback); a sequence works too. `get_state`
+    must capture everything needed to resume (params, optimizer, RNG).
+    Checkpoints are indexed by *completed step count*: step k's checkpoint
+    is saved under k+1, so `latest_step()` is also the resume index.
+    """
+
+    def __init__(self, train_fn: Callable, checkpoint: Any,
+                 get_state: Callable[[], Dict[str, Any]],
+                 set_state: Callable[[Dict[str, Any]], None],
+                 config: Optional[ResilientConfig] = None,
+                 fault_plan: Optional[fault_injection.FaultPlan] = None,
+                 callbacks: Optional[List] = None,
+                 use_orbax: bool = True):
+        self.worker = DeviceWorker(train_fn, print_period=0)
+        if isinstance(checkpoint, CheckpointManager):
+            self.ckpt = checkpoint
+        else:
+            self.ckpt = CheckpointManager(checkpoint, use_orbax=use_orbax)
+        self.get_state = get_state
+        self.set_state = set_state
+        self.config = config or ResilientConfig()
+        self.plan = fault_plan if fault_plan is not None \
+            else fault_injection.global_plan()
+        self.callbacks = callbacks or []
+        self.events: List[Dict[str, Any]] = []
+        self._preempt_signal: Optional[int] = None
+
+    # ---- event plumbing ----
+    def _event(self, kind: str, step: int, **info):
+        rec = {"kind": kind, "step": step, **info}
+        self.events.append(rec)
+        record_instant(f"resilient/{kind}", args=rec)
+        for cb in self.callbacks:
+            on_fault = getattr(cb, "on_fault", None)
+            if on_fault is not None:
+                on_fault(kind, step, dict(info))
+        print(f"[resilient] {kind} at step {step} {info}", file=sys.stderr)
+
+    # ---- preemption ----
+    def _install_signal_handlers(self):
+        def handler(signum, frame):
+            self._preempt_signal = signum
+        self._old_handlers = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._old_handlers[sig] = signal.signal(sig, handler)
+            except ValueError:  # not the main thread
+                pass
+
+    def _restore_signal_handlers(self):
+        for sig, old in getattr(self, "_old_handlers", {}).items():
+            signal.signal(sig, old)
+
+    def _preempt_exit(self, completed: int):
+        """Final synchronous save + resumable marker, then exit 143."""
+        with RecordEvent("resilient/preempt_save"):
+            self.ckpt.save(completed, self.get_state(), force=True)
+            self.ckpt.wait_until_finished()
+        marker = os.path.join(self.ckpt.directory, PREEMPT_MARKER)
+        with open(marker, "w") as f:
+            json.dump({"step": completed, "resumable": True,
+                       "signal": self._preempt_signal,
+                       "time": time.time()}, f)
+        self._event("preempted", completed, signal=self._preempt_signal)
+        raise SystemExit(143)
+
+    # ---- recovery actions ----
+    def _rollback(self, state: Dict[str, int]) -> int:
+        state["rollbacks"] += 1
+        if state["rollbacks"] > self.config.max_rollbacks:
+            raise UnrecoverableError(
+                f"rollback budget exhausted ({self.config.max_rollbacks}); "
+                "aborting")
+        latest = self.ckpt.latest_step()
+        restored = self.ckpt.restore(latest) if latest is not None else None
+        if restored is not None:
+            self.set_state(restored)
+        target = latest if latest is not None else 0
+        self._event("rollback", target, rollbacks=state["rollbacks"])
+        state["skips"] = 0
+        return target
+
+    def run(self, batches, num_steps: Optional[int] = None) -> Dict[str, Any]:
+        """Drive `num_steps` steps with recovery; returns a summary dict."""
+        batch_fn = batches if callable(batches) else \
+            (lambda i, _b=batches: _b[i])
+        if num_steps is None:
+            if callable(batches):
+                raise ValueError("num_steps is required with a batch_fn")
+            num_steps = len(batches)
+
+        self._install_signal_handlers()
+        watchdog = None
+        old_usr1 = None
+        if self.config.watchdog_timeout:
+            def _usr1_handler(signum, frame):
+                raise WatchdogTimeout(
+                    f"step exceeded {self.config.watchdog_timeout}s")
+
+            main_id = threading.main_thread().ident
+
+            def _fire():
+                signal.pthread_kill(main_id, signal.SIGUSR1)
+
+            try:
+                old_usr1 = signal.signal(signal.SIGUSR1, _usr1_handler)
+            except ValueError:  # not the main thread: no watchdog delivery
+                pass
+            else:
+                watchdog = _Watchdog(self.config.watchdog_timeout, _fire)
+                watchdog.start()
+
+        # resume from the latest valid checkpoint
+        completed = self.ckpt.latest_step() or 0
+        if completed:
+            restored = self.ckpt.restore(completed)
+            if restored is not None:
+                self.set_state(restored)
+            self._event("resumed", completed)
+        marker = os.path.join(self.ckpt.directory, PREEMPT_MARKER)
+        if os.path.exists(marker):
+            os.remove(marker)
+
+        esc = {"skips": 0, "rollbacks": 0}
+        retries_total = 0
+        last_loss = None
+        try:
+            step = completed
+            while step < num_steps:
+                if self._preempt_signal is not None:
+                    self._preempt_exit(step)
+                attempts = 0
+                while True:  # retry loop for one step
+                    try:
+                        self.plan.maybe_kill(
+                            step, fault_injection.KILL_POINT_STEP)
+                        self.plan.maybe_raise(step)
+                        if watchdog is not None:
+                            watchdog.step_begin()
+                        with RecordEvent("resilient/step"):
+                            self.plan.maybe_delay(step)
+                            loss = self.worker.run_step(batch_fn(step))
+                        if watchdog is not None:
+                            watchdog.step_end()
+                        loss = self.plan.corrupt_loss(step, loss)
+                        break
+                    except WatchdogTimeout:
+                        self._event("watchdog_timeout", step)
+                        loss = None
+                    except (KeyboardInterrupt, SystemExit,
+                            UnrecoverableError):
+                        raise
+                    except Exception as e:
+                        self._event("step_error", step,
+                                    error=f"{type(e).__name__}: {e}")
+                    # transient failure: bounded backoff retry, then rollback
+                    attempts += 1
+                    if attempts <= self.config.max_step_retries:
+                        retries_total += 1
+                        self._event("retry", step, attempt=attempts)
+                        time.sleep(self.config.retry_backoff
+                                   * (2 ** (attempts - 1)))
+                        continue
+                    step = self._rollback(esc)
+                    attempts = 0
+
+                # NaN/Inf sentinel
+                val = _loss_value(loss)
+                if val is not None and not math.isfinite(val):
+                    self._event("bad_loss", step, value=str(val))
+                    if self.config.nan_policy == "abort":
+                        raise UnrecoverableError(
+                            f"non-finite loss {val} at step {step} "
+                            "(nan_policy=abort)")
+                    esc["skips"] += 1
+                    if (self.config.nan_policy == "rollback"
+                            or esc["skips"] > self.config.max_consecutive_skips):
+                        step = self._rollback(esc)
+                    else:
+                        self._event("skip", step, consecutive=esc["skips"])
+                        step += 1  # skip the batch, don't checkpoint it
+                    continue
+                esc["skips"] = 0
+                last_loss = loss
+                step += 1
+                if step % self.config.save_interval == 0 or step == num_steps:
+                    with RecordEvent("resilient/save"):
+                        self.ckpt.save(step, self.get_state())
+            if self._preempt_signal is not None:
+                self._preempt_exit(step)
+            self.ckpt.wait_until_finished()
+            return {"completed_steps": step, "last_loss": last_loss,
+                    "retries": retries_total, "rollbacks": esc["rollbacks"],
+                    "preempted": False, "events": list(self.events)}
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
+            if old_usr1 is not None:
+                signal.signal(signal.SIGUSR1, old_usr1)
+            self._restore_signal_handlers()

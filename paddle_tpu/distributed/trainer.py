@@ -30,22 +30,29 @@ class DeviceWorker:
         self.steps = 0
         self.last_loss = None
 
-    def run(self, batch_iter: Iterable):
+    def run_step(self, batch):
+        """One step: unpack the batch, run the train fn, track the loss.
+        Step-level drivers (ResilientTrainer) call this directly so they
+        can checkpoint/retry/rollback between steps."""
         import sys
+        args = batch if isinstance(batch, (tuple, list)) else (batch,)
+        loss = self.train_fn(*args)
+        self.steps += 1
+        self.last_loss = loss
+        if self.print_period and self.steps % self.print_period == 0:
+            if isinstance(loss, Tensor):
+                val = f"{float(loss.item()):.5f}"
+            elif isinstance(loss, (int, float)):
+                val = f"{float(loss):.5f}"
+            else:  # train fns may return None or (loss, metrics) tuples
+                val = repr(loss)
+            print(f"[trainer] step {self.steps} loss {val}",
+                  file=sys.stderr)
+        return loss
+
+    def run(self, batch_iter: Iterable):
         for batch in batch_iter:
-            args = batch if isinstance(batch, (tuple, list)) else (batch,)
-            loss = self.train_fn(*args)
-            self.steps += 1
-            self.last_loss = loss
-            if self.print_period and self.steps % self.print_period == 0:
-                if isinstance(loss, Tensor):
-                    val = f"{float(loss.item()):.5f}"
-                elif isinstance(loss, (int, float)):
-                    val = f"{float(loss):.5f}"
-                else:  # train fns may return None or (loss, metrics) tuples
-                    val = repr(loss)
-                print(f"[trainer] step {self.steps} loss {val}",
-                      file=sys.stderr)
+            self.run_step(batch)
         return self.last_loss
 
 

@@ -30,6 +30,11 @@ _FLAGS: Dict[str, object] = {
     "FLAGS_enable_rpc_profiler": False,
     "FLAGS_selected_gpus": "",
     "FLAGS_selected_tpus": "",
+    # resilient runtime (paddle_tpu.distributed.resilient)
+    "FLAGS_fault_injection_spec": "",       # PDTPU_FAULTS grammar
+    "FLAGS_step_watchdog_timeout": 0.0,     # seconds; 0 disables
+    "FLAGS_ckpt_integrity_check": True,     # verify manifests on restore
+    "FLAGS_elastic_expiry_grace": 2,        # stale polls before relaunch
 }
 
 # env-var overrides at import (gflags behavior)
@@ -61,7 +66,13 @@ def set_flags(flags: Dict[str, object]):
         if k not in _FLAGS:
             raise ValueError(f"unknown flag {k!r}")
         _FLAGS[k] = v
-        if k == "FLAGS_check_nan_inf":
+        if k == "FLAGS_fault_injection_spec":
+            # install the schedule process-globally so CheckpointManager
+            # kill points and ResilientTrainer share it
+            from .utils import fault_injection
+            fault_injection.set_global_plan(
+                fault_injection.FaultPlan.from_spec(v) if v else None)
+        elif k == "FLAGS_check_nan_inf":
             # nan_inf_utils_detail analog: XLA checks every op result
             jax.config.update("jax_debug_nans", bool(v))
         elif k in ("FLAGS_cudnn_deterministic",

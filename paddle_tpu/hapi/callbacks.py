@@ -47,6 +47,12 @@ class Callback:
     def on_eval_batch_end(self, step, logs=None):
         pass
 
+    def on_fault(self, kind, step, logs=None):
+        """Resilient-runtime notification: kind is one of bad_loss / skip /
+        retry / rollback / watchdog_timeout / step_error / resumed /
+        preempted (paddle_tpu.distributed.resilient)."""
+        pass
+
 
 class CallbackList:
     def __init__(self, callbacks: List[Callback]):

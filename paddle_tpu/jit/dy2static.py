@@ -290,6 +290,11 @@ def range_start_stop_step(*args):
     if len(args) == 2:
         return args[0], args[1], 1
     if len(args) == 3:
+        step = args[2]
+        # builtin-range parity: a concrete zero step must raise, not spin
+        # the converted while loop forever (range_cond never advances)
+        if not _is_tensorish(step) and step == 0:
+            raise ValueError("range() arg 3 must not be zero")
         return args
     raise TypeError(f"range expected 1-3 arguments, got {len(args)}")
 

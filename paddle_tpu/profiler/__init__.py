@@ -52,6 +52,20 @@ class RecordEvent:
         self.begin = None
 
 
+def record_instant(name: str, args: Optional[dict] = None):
+    """Zero-duration instant event (chrome 'i' phase) — used for fault /
+    recovery markers (resilient runtime) so they land on the same timeline
+    as the step spans."""
+    if not _P.enabled:
+        return
+    _P.events.append({
+        "name": name, "ts": time.perf_counter_ns() / 1e3,
+        "ph": "i", "s": "p", "pid": 0,
+        "tid": threading.get_ident() % 10000,
+        "args": args or {},
+    })
+
+
 def start_profiler(state="All", tracer_option="Default", trace_dir=None):
     _P.enabled = True
     _P.events.clear()

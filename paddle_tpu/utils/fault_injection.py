@@ -1,0 +1,161 @@
+"""Deterministic fault-injection harness for the resilient runtime.
+
+Faults are declared as a spec string — via the ``PDTPU_FAULTS`` env var or
+``FLAGS_fault_injection_spec`` — and fire at exact step indices, so every
+recovery path in paddle_tpu.distributed.resilient can be exercised
+end-to-end by tests without flaky timing games.
+
+Spec grammar (';'-separated clauses, each ``kind@step[:arg]``):
+
+    nan_loss@3            inject a NaN loss at step 3
+    inf_loss@3            inject an Inf loss at step 3
+    raise@5               raise RuntimeError at step 5 (transient-failure path)
+    raise@5:OSError       raise a named builtin exception instead
+    delay@7:2.5           sleep 2.5s inside step 7 (trips the watchdog)
+    kill@4:mid_save       SIGKILL self at step 4 when the 'mid_save' kill
+                          point is reached (torn-write path); the point name
+                          matches CheckpointManager's kill points
+    kill@4:step           SIGKILL self at the top of step 4
+
+Each clause fires exactly once per process (a restarted process re-arms,
+which is what crash-resume tests want). ``FaultPlan`` is also usable
+programmatically for in-process tests.
+"""
+from __future__ import annotations
+
+import builtins
+import os
+import time
+from typing import Dict, List, Optional
+
+ENV_VAR = "PDTPU_FAULTS"
+
+# kill points recognised by CheckpointManager.save (fallback path)
+KILL_POINT_MID_SAVE = "mid_save"        # after data write, before any rename
+KILL_POINT_AFTER_DATA = "after_data"    # after data rename, before manifest
+KILL_POINT_STEP = "step"                # top of the training step
+
+
+class Fault:
+    __slots__ = ("kind", "step", "arg", "fired")
+
+    def __init__(self, kind: str, step: int, arg: Optional[str] = None):
+        self.kind = kind
+        self.step = step
+        self.arg = arg
+        self.fired = False
+
+    def __repr__(self):
+        a = f":{self.arg}" if self.arg else ""
+        return f"{self.kind}@{self.step}{a}"
+
+
+def _parse(spec: str) -> List[Fault]:
+    faults = []
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        head, _, arg = clause.partition(":")
+        kind, _, step = head.partition("@")
+        if not step:
+            raise ValueError(
+                f"fault clause {clause!r} missing '@step' (grammar: "
+                "kind@step[:arg])")
+        faults.append(Fault(kind.strip(), int(step), arg.strip() or None))
+    return faults
+
+
+class FaultPlan:
+    """A deterministic schedule of faults keyed by (kind, step)."""
+
+    def __init__(self, faults: Optional[List[Fault]] = None):
+        self.faults = faults or []
+        self.log: List[str] = []   # what actually fired, for assertions
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        return cls(_parse(spec))
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan":
+        """Build from PDTPU_FAULTS, falling back to the framework flag."""
+        spec = os.environ.get(ENV_VAR, "")
+        if not spec:
+            try:
+                from ..flags import get_flags
+                spec = get_flags("FLAGS_fault_injection_spec")[
+                    "FLAGS_fault_injection_spec"]
+            except Exception:
+                spec = ""
+        return cls.from_spec(spec) if spec else cls()
+
+    def add(self, kind: str, step: int, arg: Optional[str] = None):
+        self.faults.append(Fault(kind, step, arg))
+        return self
+
+    def _take(self, kind: str, step: int,
+              arg: Optional[str] = None) -> Optional[Fault]:
+        for f in self.faults:
+            if f.fired or f.kind != kind or f.step != step:
+                continue
+            if arg is not None and f.arg != arg:
+                continue
+            f.fired = True
+            self.log.append(repr(f))
+            return f
+        return None
+
+    # ---- injection points ----
+    def corrupt_loss(self, step: int, loss):
+        """Return a NaN/Inf-poisoned loss if one is scheduled for `step`."""
+        f = self._take("nan_loss", step) or self._take("inf_loss", step)
+        if f is None:
+            return loss
+        val = float("nan") if f.kind == "nan_loss" else float("inf")
+        try:
+            import jax.numpy as jnp
+            from ..core.tensor import Tensor
+            if isinstance(loss, Tensor):
+                return Tensor(jnp.full_like(loss.data, val))
+        except Exception:
+            pass
+        return val
+
+    def maybe_raise(self, step: int):
+        """Raise a transient-failure exception if scheduled for `step`."""
+        f = self._take("raise", step)
+        if f is not None:
+            exc = getattr(builtins, f.arg or "RuntimeError", RuntimeError)
+            raise exc(f"injected fault at step {step}")
+
+    def maybe_delay(self, step: int):
+        """Sleep inside the step if scheduled (watchdog-trip path)."""
+        f = self._take("delay", step)
+        if f is not None:
+            time.sleep(float(f.arg or "1.0"))
+
+    def maybe_kill(self, step: int, point: str = KILL_POINT_STEP):
+        """SIGKILL the current process at a named kill point. Used to
+        simulate hard preemption / crash mid-checkpoint; os._exit-level
+        death so no cleanup (atexit, finally) can mask the tear."""
+        if self._take("kill", step, point) is not None:
+            os._exit(137)
+
+
+# process-global plan: lazily built from the environment so library code
+# (CheckpointManager kill points) sees the same schedule as the trainer.
+_GLOBAL: Optional[FaultPlan] = None
+
+
+def global_plan() -> FaultPlan:
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = FaultPlan.from_env()
+    return _GLOBAL
+
+
+def set_global_plan(plan: Optional[FaultPlan]):
+    """Install (or clear, with None) the process-global plan — test hook."""
+    global _GLOBAL
+    _GLOBAL = plan

@@ -65,6 +65,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: heavy multi-device/model tests (excluded from the "
         "smoke tier via -m 'not slow'; full suite remains the gate)")
+    config.addinivalue_line(
+        "markers", "fault_matrix: end-to-end fault-injection recovery "
+        "scenarios (subprocess-based); run standalone via "
+        "tools/check_fault_matrix.py, and in tier-1 as part of "
+        "tests/test_resilient.py")
 
 
 def pytest_collection_modifyitems(config, items):

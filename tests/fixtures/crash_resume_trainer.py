@@ -1,7 +1,11 @@
 """Fixture for the elastic-restart test: trains 6 steps with step-level
 checkpointing; on the FIRST attempt it crashes hard at step 3. The launcher's
 --max_restarts respawns it; the retry must resume from the checkpoint (not
-step 0) and finish. Writes a JSON report for the parent test."""
+step 0) and finish. Writes a JSON report for the parent test.
+
+Checkpoints go through CheckpointManager's non-orbax fallback path so the
+atomic-rename + integrity-manifest machinery is exercised under a real
+process crash, not just in-process tests."""
 import json
 import os
 import sys
@@ -18,6 +22,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 import paddle_tpu as paddle  # noqa: E402
 from paddle_tpu import nn, optimizer  # noqa: E402
+from paddle_tpu.checkpoint import CheckpointManager  # noqa: E402
 
 WORKDIR = sys.argv[1]
 MARKER = os.path.join(WORKDIR, "attempted")
@@ -33,11 +38,11 @@ def main():
     x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
     y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
 
-    start_step = 0
-    if os.path.exists(CKPT + ".pdparams"):
-        state = paddle.load(CKPT + ".pdparams")
+    mgr = CheckpointManager(CKPT, max_to_keep=10, use_orbax=False)
+    start_step = mgr.latest_step() or 0
+    if start_step:
+        state = mgr.restore(start_step)
         model.set_state_dict(state["model"])
-        start_step = int(state["step"])
 
     first_attempt = not os.path.exists(MARKER)
     with open(MARKER, "a") as f:
@@ -49,8 +54,7 @@ def main():
         loss.backward()
         opt.step()
         opt.clear_grad()
-        paddle.save({"model": model.state_dict(), "step": step + 1},
-                    CKPT + ".pdparams")
+        mgr.save(step + 1, {"model": model.state_dict()})
         steps_this_run.append(step)
         if first_attempt and step == 2:
             os._exit(17)  # simulated hard crash mid-training

@@ -1,0 +1,80 @@
+"""Subprocess fixture for tests/test_resilient.py: runs ResilientTrainer
+on a tiny model with the fault schedule taken from PDTPU_FAULTS, so the
+parent test can kill it (or let the schedule kill it) and assert on what
+a fresh process recovers.
+
+    python resilient_worker.py WORKDIR MODE
+
+modes:
+    fast    train NUM_STEPS (env, default 6) steps back-to-back
+    slow    sleep 0.15s inside every step — gives the parent a window to
+            deliver SIGTERM mid-run (preemption test)
+
+Writes WORKDIR/progress (one line per completed step, so the parent can
+wait for the run to be mid-flight) and WORKDIR/report.json on a clean
+finish. Exit codes: 0 done, 137 fault-injected SIGKILL, 143 preempted.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+from paddle_tpu.distributed.resilient import (  # noqa: E402
+    ResilientConfig, ResilientTrainer)
+
+WORKDIR = sys.argv[1]
+MODE = sys.argv[2] if len(sys.argv) > 2 else "fast"
+NUM_STEPS = int(os.environ.get("NUM_STEPS", "6"))
+PROGRESS = os.path.join(WORKDIR, "progress")
+REPORT = os.path.join(WORKDIR, "report.json")
+
+
+def main():
+    paddle.seed(0)
+    model = nn.Linear(4, 4)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+
+    def train_fn(_step_tag):
+        if MODE == "slow":
+            time.sleep(0.15)
+        loss = nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        with open(PROGRESS, "a") as f:
+            f.write("step\n")
+        return loss
+
+    trainer = ResilientTrainer(
+        train_fn, os.path.join(WORKDIR, "ckpt"),
+        get_state=lambda: {"model": model.state_dict()},
+        set_state=lambda s: model.set_state_dict(s["model"]),
+        config=ResilientConfig(save_interval=1),
+        use_orbax=False)
+    resumed_from = trainer.ckpt.latest_step() or 0
+    summary = trainer.run(lambda i: i, num_steps=NUM_STEPS)
+
+    with open(REPORT, "w") as f:
+        json.dump({"resumed_from": resumed_from,
+                   "completed": summary["completed_steps"],
+                   "event_kinds": [e["kind"] for e in summary["events"]]},
+                  f)
+
+
+if __name__ == "__main__":
+    main()

@@ -53,6 +53,19 @@ def _is_chip_row(row):
     return backend == "tpu"
 
 
+def _tag_aliases():
+    """Sweep tags ('125m') → preset names ('gpt3-125m'), from tpu_sweep's
+    PRESET_SWEEP table, so sweep-tagged rows still hit their pinned floor."""
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import tpu_sweep
+        return {tag: env["BENCH_PRESET"]
+                for tag, env in getattr(tpu_sweep, "PRESET_SWEEP", [])
+                if isinstance(env, dict) and env.get("BENCH_PRESET")}
+    except Exception:
+        return {}
+
+
 def best_by_preset(rows):
     best = {}
     for r in rows:
@@ -73,6 +86,9 @@ def main(argv=None):
                     help="tolerated fractional MFU drop vs the pinned floor")
     ap.add_argument("--update", action="store_true",
                     help="raise floors to the best measured values")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail (exit 3) when a measured row resolves to a "
+                         "key with no pinned floor while floors exist")
     args = ap.parse_args(argv)
 
     floors = {}
@@ -95,11 +111,28 @@ def main(argv=None):
               "- gate is vacuous (tunnel likely down); exit 0")
         return 0
 
+    # resolve sweep tags to preset names so tag-keyed rows still gate
+    aliases = _tag_aliases()
+    measured = {aliases.get(p, p) if p not in floors else p: m
+                for p, m in measured.items()}
+
     failures = []
+    unmapped = []
     for p, m in sorted(measured.items()):
         floor = floors.get(p, {}).get("mfu")
         if floor is None:
-            print(f"  {p:28s} mfu {m:.4f}  (no pinned floor - pass)")
+            if floors:
+                # a row that matches no pinned floor silently weakens the
+                # gate — shout, so a renamed metric/tag can't make the
+                # regression check vacuous without anyone noticing
+                unmapped.append(p)
+                print(f"WARNING: measured key {p!r} has no pinned floor in "
+                      f"{args.thresholds} (known: "
+                      f"{', '.join(sorted(floors))}); this row does NOT "
+                      "gate — fix the tag mapping or pin a floor",
+                      file=sys.stderr)
+            else:
+                print(f"  {p:28s} mfu {m:.4f}  (no pinned floor - pass)")
             continue
         limit = floor * (1.0 - args.max_regress)
         verdict = "OK" if m >= limit else "REGRESSION"
@@ -112,6 +145,10 @@ def main(argv=None):
               f"{args.max_regress:.0%}:",
               ", ".join(f"{p} {m:.4f}<{f0:.4f}" for p, m, f0 in failures))
         return 2
+    if unmapped and args.strict:
+        print(f"FAILED (--strict): {len(unmapped)} measured key(s) gate "
+              f"nothing: {', '.join(unmapped)}")
+        return 3
     print("bench gate passed")
     return 0
 
