@@ -54,17 +54,7 @@ class DataParallel(Layer):
             return
         # comm_buffer_size-MB buckets (reference default 25MB): bounds the
         # transient (P, bucket) gather to bucket_bytes x process_count
-        bucket_elems = max(int(self.comm_buffer_size * 1024 * 1024 // 4), 1)
-        bucket, bucket_n = [], 0
-        buckets = []
-        for p in with_grad:
-            bucket.append(p)
-            bucket_n += p.grad.data.size
-            if bucket_n >= bucket_elems:
-                buckets.append(bucket)
-                bucket, bucket_n = [], 0
-        if bucket:
-            buckets.append(bucket)
+        buckets = _bucket_grads(with_grad, self.comm_buffer_size)
         # one all-REDUCE per bucket (reducer.cc ncclAllReduce parity): a
         # [n_dev, n] array sharded over a device mesh, mean over the device
         # dim with a replicated output — GSPMD lowers this to all-reduce,
@@ -101,6 +91,26 @@ class DataParallel(Layer):
 
     def named_parameters(self, prefix="", include_sublayers=True):
         return self._layers.named_parameters(prefix, include_sublayers)
+
+
+def _bucket_grads(params, comm_buffer_size_mb):
+    """Group params-with-grads into ~comm_buffer_size-MB buckets sized by
+    the grads' ACTUAL bytes (size * dtype.itemsize). The old rule divided
+    the MB cap by a hard-coded 4 bytes/element, so bf16/fp16 grads filled
+    buckets to 2x the configured transient-memory bound."""
+    import numpy as np
+    cap_bytes = max(int(comm_buffer_size_mb * 1024 * 1024), 1)
+    buckets, bucket, bucket_bytes = [], [], 0
+    for p in params:
+        bucket.append(p)
+        g = p.grad.data
+        bucket_bytes += int(g.size) * int(np.dtype(g.dtype).itemsize)
+        if bucket_bytes >= cap_bytes:
+            buckets.append(bucket)
+            bucket, bucket_bytes = [], 0
+    if bucket:
+        buckets.append(bucket)
+    return buckets
 
 
 _REDUCER_CACHE = []

@@ -36,7 +36,7 @@ from ..strategy import AMPConfig, DistributedStrategy
 TRANSFORM_ORDER = ("qat", "sync_batch_norm", "amp", "lars", "lamb", "asp",
                    "recompute", "gradient_merge", "fp16_allreduce",
                    "gradient_scale", "localsgd", "adaptive_localsgd",
-                   "sequence_parallel", "sharding", "pipeline")
+                   "sequence_parallel", "sharding", "pipeline", "scan")
 
 # Every public DistributedStrategy field falls in exactly one bucket (the
 # field audit in tests/test_strategy_flags.py enforces this, so a new field
@@ -54,7 +54,7 @@ CONSUMED_HERE = frozenset({
     "adaptive_localsgd", "adaptive_localsgd_configs", "sequence_parallel",
     "sharding", "sharding_configs", "pipeline", "pipeline_configs",
     "hybrid_configs", "fp16_allreduce", "gradient_scale_configs",
-    "sync_batch_norm", "asp", "qat", "auto", "semi_auto",
+    "sync_batch_norm", "asp", "qat", "auto", "semi_auto", "scan_steps",
 })
 CONSUMED_ELSEWHERE = {
     "a_sync": "fleet.init_worker/the_one_ps (PS async communicator)",
@@ -119,6 +119,9 @@ class CompiledStrategy:
     sync_batch_norm: bool = False
     asp: bool = False
     qat: bool = False
+    # K steps fused into one lax.scan dispatch (parallel.ScanTrainStep);
+    # 1 = eager per-step dispatch
+    scan_steps: int = 1
     optimizer = None  # possibly swapped by lars/lamb
 
     def describe(self) -> str:
@@ -236,6 +239,15 @@ class StrategyCompiler:
                 and mesh.shape["pipe"] > 1):
             plan.pipeline = True
             plan.applied.append("pipeline")
+        scan_k = int(getattr(strategy, "scan_steps", 1) or 1)
+        if scan_k <= 1:
+            # strategy left at the default: the env flag may still opt in
+            from ...flags import get_flags
+            scan_k = int(get_flags("FLAGS_scan_chunk")["FLAGS_scan_chunk"]
+                         or 1)
+        if scan_k > 1:
+            plan.scan_steps = scan_k
+            plan.applied.append("scan")
 
         # conflict resolution (reference _disable_strategy protocol)
         localsgd_name = ("adaptive_localsgd" if plan.localsgd_adaptive
@@ -292,6 +304,23 @@ class StrategyCompiler:
                 conflicts.append(
                     f"{'/'.join(dropped)} do not compose with "
                     f"{localsgd_name}'s local-update step; disabling them")
+        if plan.scan_steps > 1 and plan.localsgd_k:
+            # LocalSGDTrainStep keeps per-rank host state and a host-side
+            # sync decision between steps; fusing steps on device would skip
+            # the sync points
+            conflicts.append(
+                f"scan_steps={plan.scan_steps} does not compose with "
+                f"{localsgd_name}'s host-side sync loop; disabling scan")
+            plan.scan_steps = 1
+            plan.applied.remove("scan")
+        if plan.scan_steps > 1 and plan.pipeline:
+            # PipelinedTrainStep owns its own microbatch schedule per
+            # dispatch; wrapping it in an outer scan is unimplemented
+            conflicts.append(
+                f"scan_steps={plan.scan_steps} does not compose with "
+                "pipeline parallelism; disabling scan")
+            plan.scan_steps = 1
+            plan.applied.remove("scan")
         if conflicts:
             import warnings
             for c in conflicts:

@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+import numpy as np
+
 from ..checkpoint import CheckpointManager
 from ..profiler import RecordEvent, record_instant
 from ..utils import fault_injection
@@ -152,6 +154,16 @@ class ResilientTrainer:
     must capture everything needed to resume (params, optimizer, RNG).
     Checkpoints are indexed by *completed step count*: step k's checkpoint
     is saved under k+1, so `latest_step()` is also the resume index.
+
+    Scan-fused steps (parallel.ScanTrainStep) are driven at CHUNK
+    granularity: each call covers K steps and returns the per-step loss
+    vector, so the NaN/Inf sentinel still localizes the exact bad step.
+    `batch_fn` then receives the chunk's START step and must return the
+    stacked [K, ...] chunk (a sequence is indexed by `step // K`); a bad
+    loss anywhere in a chunk always escalates to rollback, because the
+    fused later steps already consumed the poisoned params — skip is
+    impossible mid-chunk. Checkpoints land at the first chunk boundary at
+    or past each save_interval multiple.
     """
 
     def __init__(self, train_fn: Callable, checkpoint: Any,
@@ -232,12 +244,18 @@ class ResilientTrainer:
 
     def run(self, batches, num_steps: Optional[int] = None) -> Dict[str, Any]:
         """Drive `num_steps` steps with recovery; returns a summary dict."""
+        n = max(1, int(self.worker.scan_steps))
         batch_fn = batches if callable(batches) else \
-            (lambda i, _b=batches: _b[i])
+            (lambda i, _b=batches: _b[i // n])
         if num_steps is None:
             if callable(batches):
                 raise ValueError("num_steps is required with a batch_fn")
-            num_steps = len(batches)
+            num_steps = len(batches) * n
+        if num_steps % n:
+            raise ValueError(
+                f"num_steps={num_steps} must be a multiple of the fused "
+                f"chunk size scan_steps={n} (lax.scan has a static trip "
+                "count; trim or pad the run)")
 
         self._install_signal_handlers()
         watchdog = None
@@ -262,6 +280,12 @@ class ResilientTrainer:
 
         # resume from the latest valid checkpoint
         completed = self.ckpt.latest_step() or 0
+        if completed % n:
+            raise ValueError(
+                f"checkpoint at step {completed} does not sit on a "
+                f"scan_steps={n} chunk boundary (was it written by an "
+                "eager run?); resume with the same chunking it was "
+                "saved under")
         if completed:
             restored = self.ckpt.restore(completed)
             if restored is not None:
@@ -280,19 +304,25 @@ class ResilientTrainer:
                 if self._preempt_signal is not None:
                     self._preempt_exit(step)
                 attempts = 0
-                while True:  # retry loop for one step
+                while True:  # retry loop for one step (or fused chunk)
                     try:
-                        self.plan.maybe_kill(
-                            step, fault_injection.KILL_POINT_STEP)
-                        self.plan.maybe_raise(step)
+                        # host-side faults scheduled mid-chunk fire at the
+                        # chunk boundary — the host can't intervene inside
+                        # a fused dispatch
+                        for s in range(step, step + n):
+                            self.plan.maybe_kill(
+                                s, fault_injection.KILL_POINT_STEP)
+                            self.plan.maybe_raise(s)
                         if watchdog is not None:
                             watchdog.step_begin()
                         with RecordEvent("resilient/step"):
-                            self.plan.maybe_delay(step)
+                            for s in range(step, step + n):
+                                self.plan.maybe_delay(s)
                             loss = self.worker.run_step(batch_fn(step))
                         if watchdog is not None:
                             watchdog.step_end()
-                        loss = self.plan.corrupt_loss(step, loss)
+                        loss = self.plan.corrupt_loss_vector(step, loss) \
+                            if n > 1 else self.plan.corrupt_loss(step, loss)
                         break
                     except WatchdogTimeout:
                         self._event("watchdog_timeout", step)
@@ -315,25 +345,50 @@ class ResilientTrainer:
                     attempts = 0
 
                 # NaN/Inf sentinel
-                val = _loss_value(loss)
-                if val is not None and not math.isfinite(val):
-                    self._event("bad_loss", step, value=str(val))
-                    if self.config.nan_policy == "abort":
-                        raise UnrecoverableError(
-                            f"non-finite loss {val} at step {step} "
-                            "(nan_policy=abort)")
-                    esc["skips"] += 1
-                    if (self.config.nan_policy == "rollback"
-                            or esc["skips"] > self.config.max_consecutive_skips):
+                if n > 1:
+                    # per-step loss vector: localize the first bad step
+                    vec = np.atleast_1d(np.asarray(
+                        getattr(loss, "data", loss), dtype=np.float64))
+                    bad = np.flatnonzero(~np.isfinite(vec))
+                    if bad.size:
+                        bad_step = step + int(bad[0])
+                        self._event("bad_loss", bad_step,
+                                    value=str(float(vec[bad[0]])),
+                                    chunk_start=step)
+                        if self.config.nan_policy == "abort":
+                            raise UnrecoverableError(
+                                f"non-finite loss {float(vec[bad[0]])} at "
+                                f"step {bad_step} (nan_policy=abort)")
+                        # the fused steps after bad_step already consumed
+                        # the poisoned params — skip is impossible
+                        # mid-chunk, always roll back
                         step = self._rollback(esc)
-                    else:
-                        self._event("skip", step, consecutive=esc["skips"])
-                        step += 1  # skip the batch, don't checkpoint it
-                    continue
+                        continue
+                else:
+                    val = _loss_value(loss)
+                    if val is not None and not math.isfinite(val):
+                        self._event("bad_loss", step, value=str(val))
+                        if self.config.nan_policy == "abort":
+                            raise UnrecoverableError(
+                                f"non-finite loss {val} at step {step} "
+                                "(nan_policy=abort)")
+                        esc["skips"] += 1
+                        if (self.config.nan_policy == "rollback"
+                                or esc["skips"]
+                                > self.config.max_consecutive_skips):
+                            step = self._rollback(esc)
+                        else:
+                            self._event("skip", step,
+                                        consecutive=esc["skips"])
+                            step += 1  # skip the batch, don't checkpoint it
+                        continue
                 esc["skips"] = 0
                 last_loss = loss
-                step += 1
-                if step % self.config.save_interval == 0 or step == num_steps:
+                step += n
+                si = self.config.save_interval
+                # first boundary at/past each save_interval multiple (for
+                # n == 1 this is exactly `step % si == 0`)
+                if (step // si) > ((step - n) // si) or step == num_steps:
                     with RecordEvent("resilient/save"):
                         self.ckpt.save(step, self.get_state())
             if self._preempt_signal is not None:

@@ -176,6 +176,11 @@ class DistributedStrategy:
         self.conv_workspace_size_limit = 512
         self.cudnn_batchnorm_spatial_persistent = False
         self.sequence_parallel = False  # parity-plus: SP over the sep axis
+        # parity-plus: fuse K train steps into one dispatch via lax.scan
+        # over a stacked [K, ...] batch chunk (parallel.ScanTrainStep);
+        # 1 = eager per-step dispatch. FLAGS_scan_chunk overrides when left
+        # at the default.
+        self.scan_steps = 1
         self.without_graph_optimization = False
         self.asp = False
         self.qat = False

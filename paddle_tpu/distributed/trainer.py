@@ -29,13 +29,22 @@ class DeviceWorker:
         self.print_period = print_period
         self.steps = 0
         self.last_loss = None
+        # a scan-fused step (parallel.ScanTrainStep) eats [K, ...] chunks
+        # and returns the per-step loss vector; the run loop then advances
+        # K steps per call and reports losses step-by-step
+        self.scan_steps = int(getattr(train_fn, "scan_steps", 1) or 1)
+        from ..profiler import ThroughputTracker
+        self.throughput = ThroughputTracker()
 
     def run_step(self, batch):
         """One step: unpack the batch, run the train fn, track the loss.
         Step-level drivers (ResilientTrainer) call this directly so they
-        can checkpoint/retry/rollback between steps."""
+        can checkpoint/retry/rollback between steps. Over a scan-fused
+        step this is one CHUNK: K steps advance and K losses report."""
         import sys
         args = batch if isinstance(batch, (tuple, list)) else (batch,)
+        if self.scan_steps > 1:
+            return self._run_chunk(args)
         loss = self.train_fn(*args)
         self.steps += 1
         self.last_loss = loss
@@ -49,6 +58,40 @@ class DeviceWorker:
             print(f"[trainer] step {self.steps} loss {val}",
                   file=sys.stderr)
         return loss
+
+    def _run_chunk(self, args):
+        """One fused dispatch: K steps on device, per-step loss reporting
+        and throughput accounting on the host."""
+        import sys
+        import time
+
+        import numpy as np
+        t0 = time.perf_counter()
+        loss = self.train_fn(*args)
+        # materializing the loss vector blocks on the chunk, so the wall
+        # time below covers device compute, not just the dispatch
+        losses = np.atleast_1d(np.asarray(
+            loss.data if isinstance(loss, Tensor) else loss))
+        self.throughput.update(steps=losses.size,
+                               seconds=time.perf_counter() - t0,
+                               tokens=self._chunk_tokens(args))
+        for v in losses:
+            self.steps += 1
+            if self.print_period and self.steps % self.print_period == 0:
+                print(f"[trainer] step {self.steps} loss {float(v):.5f}",
+                      file=sys.stderr)
+        self.last_loss = loss
+        return loss
+
+    @staticmethod
+    def _chunk_tokens(args):
+        """Tokens per chunk = element count of the first [K, batch, seq]
+        array (the token ids); 0 when no such array is found."""
+        for a in args:
+            d = a.data if isinstance(a, Tensor) else a
+            if getattr(d, "ndim", 0) >= 2 and hasattr(d, "size"):
+                return int(d.size)
+        return 0
 
     def run(self, batch_iter: Iterable):
         for batch in batch_iter:
@@ -70,14 +113,36 @@ class MultiTrainer:
         self.worker = DeviceWorker(train_fn, print_period)
 
     def train_from_dataset(self, dataset: Iterable, epochs: int = 1,
-                           batch_decoder: Optional[Callable] = None):
+                           batch_decoder: Optional[Callable] = None,
+                           prefetch: Optional[int] = None):
+        """prefetch: when the train fn is scan-fused (scan_steps > 1), wrap
+        the per-step batch stream in an io.ChunkPrefetcher of this depth —
+        a background thread stacks the next K batches and starts their
+        sharded device_put while the current chunk computes. None/0 means
+        the dataset already yields whatever the step consumes."""
+        if prefetch and self.worker.scan_steps <= 1:
+            raise ValueError(
+                "prefetch requires a scan-fused train fn (scan_steps > 1); "
+                "this train fn dispatches one step per batch")
         last = None
         for epoch in range(epochs):
             before = self.worker.steps
             it = iter(dataset)
             if batch_decoder is not None:
                 it = (batch_decoder(b) for b in it)
-            last = self.worker.run(it)
+            if prefetch:
+                from ..io.prefetch import ChunkPrefetcher
+                pf = ChunkPrefetcher(
+                    it, scan_steps=self.worker.scan_steps,
+                    put_fn=getattr(self.worker.train_fn,
+                                   "device_put_chunk", None),
+                    depth=int(prefetch))
+                try:
+                    last = self.worker.run(pf)
+                finally:
+                    pf.close()
+            else:
+                last = self.worker.run(it)
             if epochs > 1 and epoch > 0 and self.worker.steps == before:
                 raise ValueError(
                     f"dataset yielded no batches in epoch {epoch + 1}: "
@@ -92,7 +157,7 @@ class MultiTrainer:
 
 
 def train_from_dataset(train_fn, dataset, epochs=1, batch_decoder=None,
-                       print_period=100):
+                       print_period=100, prefetch=None):
     """Executor.train_from_dataset parity entry."""
     return MultiTrainer(train_fn, print_period).train_from_dataset(
-        dataset, epochs, batch_decoder)
+        dataset, epochs, batch_decoder, prefetch=prefetch)

@@ -35,6 +35,10 @@ _FLAGS: Dict[str, object] = {
     "FLAGS_step_watchdog_timeout": 0.0,     # seconds; 0 disables
     "FLAGS_ckpt_integrity_check": True,     # verify manifests on restore
     "FLAGS_elastic_expiry_grace": 2,        # stale polls before relaunch
+    # scan-fused runner (paddle_tpu.parallel.ScanTrainStep): fuse this many
+    # steps per dispatch when DistributedStrategy.scan_steps is left at 1;
+    # 0/1 = eager per-step dispatch
+    "FLAGS_scan_chunk": 0,
 }
 
 # env-var overrides at import (gflags behavior)

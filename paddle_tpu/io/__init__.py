@@ -382,3 +382,6 @@ class DataLoader:
 
 def get_worker_info():
     return None
+
+
+from .prefetch import ChunkPrefetcher  # noqa: E402,F401

@@ -1,0 +1,161 @@
+"""Async double-buffered host→device chunk prefetcher.
+
+The scan-fused runner (parallel.ScanTrainStep) consumes [K, ...] stacked
+chunks in ONE dispatch; feeding it synchronously would serialize K batch
+decodes + one sharded device_put with the chunk's compute. This prefetcher
+moves that work onto a background thread: while chunk N computes on device,
+the thread stacks the next K host batches and *starts* their sharded
+device_put, so the H2D transfer overlaps compute instead of extending the
+step. jax transfers are async — device_put returns immediately and the
+arrays materialize on the device's transfer stream; by the time the runner
+dequeues the chunk the bytes are (usually) already resident.
+
+depth=2 is classic double buffering: one chunk in flight on device, one
+staged. Deeper queues only help when decode jitter exceeds a whole chunk's
+compute; each extra slot pins another chunk of host+device memory (see
+docs/performance.md for the tradeoff).
+
+usage:
+    pf = ChunkPrefetcher(batch_iter, scan_steps=8,
+                         put_fn=step.device_put_chunk)
+    for chunk in pf:              # tuple of device-resident [K, ...] arrays
+        losses = step(*chunk)
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+
+class _Done:
+    pass
+
+
+class _Err:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def _stack(batches):
+    """K per-step batches (tuples/lists of arrays, or bare arrays) →
+    tuple of [K, ...] numpy arrays."""
+    from ..core.tensor import Tensor
+
+    def as_np(x):
+        return np.asarray(x.data if isinstance(x, Tensor) else x)
+
+    first = batches[0]
+    if isinstance(first, (tuple, list)):
+        return tuple(np.stack([as_np(b[j]) for b in batches])
+                     for j in range(len(first)))
+    return (np.stack([as_np(b) for b in batches]),)
+
+
+class ChunkPrefetcher:
+    """Background-thread chunk stacker + async H2D stager.
+
+    source: iterable of per-step batches (what a DataLoader yields).
+    scan_steps: K — batches per fused chunk.
+    put_fn: tuple-of-stacked-np-arrays -> device arrays. Pass the runner's
+        `device_put_chunk` so chunks land pre-sharded; default jax.device_put
+        (committed to the default device layout).
+    depth: max staged chunks (2 = double buffering).
+
+    A trailing partial chunk (< K batches) is DROPPED — a lax.scan chunk has
+    a static trip count; `dropped_steps` records how many batches fell off
+    so callers can account for them (no silent truncation).
+    """
+
+    def __init__(self, source: Iterable, scan_steps: int,
+                 put_fn: Optional[Callable] = None, depth: int = 2):
+        if scan_steps < 1:
+            raise ValueError(f"scan_steps must be >= 1, got {scan_steps}")
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self.source = source
+        self.scan_steps = int(scan_steps)
+        self.depth = int(depth)
+        if put_fn is None:
+            import jax
+            put_fn = lambda stacked: tuple(jax.device_put(a)  # noqa: E731
+                                           for a in stacked)
+        self.put_fn = put_fn
+        self.dropped_steps = 0
+        self.chunks_produced = 0
+        self._q: _queue.Queue = _queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- producer ----
+    def _produce(self):
+        try:
+            it = iter(self.source)
+            pending = []
+            for batch in it:
+                if self._stop.is_set():
+                    return
+                pending.append(batch)
+                if len(pending) < self.scan_steps:
+                    continue
+                dev = self.put_fn(_stack(pending))  # starts the async H2D
+                pending = []
+                while not self._stop.is_set():
+                    try:  # bounded put, but wake up if the consumer left
+                        self._q.put(dev, timeout=0.1)
+                        break
+                    except _queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+                self.chunks_produced += 1
+            self.dropped_steps = len(pending)
+            if pending:
+                import warnings
+                warnings.warn(
+                    f"ChunkPrefetcher dropped a trailing partial chunk of "
+                    f"{len(pending)} step(s) (< scan_steps="
+                    f"{self.scan_steps})", stacklevel=2)
+        except BaseException as e:  # propagate into the consumer
+            self._q.put(_Err(e))
+            return
+        self._q.put(_Done())
+
+    # ---- consumer ----
+    def __iter__(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._produce, daemon=True,
+                name="pdtpu-chunk-prefetch")
+            self._thread.start()
+        return self
+
+    def __next__(self):
+        if self._thread is None:
+            iter(self)
+        item = self._q.get()
+        if isinstance(item, _Done):
+            raise StopIteration
+        if isinstance(item, _Err):
+            raise item.exc
+        return item
+
+    def close(self):
+        """Stop the producer thread and drain staged chunks."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except _queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def __del__(self):
+        try:
+            self._stop.set()
+        except Exception:
+            pass

@@ -1,2 +1,3 @@
-from .api import ShardedTrainStep, parallelize  # noqa: F401
+from .api import (ScanTrainStep, ShardedTrainStep,  # noqa: F401
+                  parallelize, stack_batches)
 from .localsgd import LocalSGDTrainStep  # noqa: F401

@@ -146,5 +146,61 @@ class Profiler:
         return "\n".join(lines)
 
 
+class ThroughputTracker:
+    """Per-chunk wall-time → steps/sec and tokens/sec.
+
+    The chunk run loop (trainer.DeviceWorker over a parallel.ScanTrainStep)
+    calls `update(steps=K, seconds=dt, tokens=K*B*S)` once per fused
+    dispatch, so utilization is reported from the production path without a
+    separate bench run. Rates are computed over a sliding window of recent
+    chunks (warmup/compile chunks age out) alongside lifetime totals; each
+    update also drops a `throughput` instant on the profiler timeline when
+    profiling is enabled.
+    """
+
+    def __init__(self, window: int = 32):
+        from collections import deque
+        self.window = int(window)
+        self._chunks = deque(maxlen=self.window)  # (steps, tokens, seconds)
+        self.total_steps = 0
+        self.total_tokens = 0
+        self.total_seconds = 0.0
+
+    def update(self, steps: int, seconds: float, tokens: int = 0):
+        steps, tokens, seconds = int(steps), int(tokens), float(seconds)
+        self._chunks.append((steps, tokens, seconds))
+        self.total_steps += steps
+        self.total_tokens += tokens
+        self.total_seconds += seconds
+        record_instant("throughput", {
+            "steps": steps, "tokens": tokens, "seconds": seconds,
+            "steps_per_sec": self.steps_per_sec,
+            "tokens_per_sec": self.tokens_per_sec,
+        })
+
+    def _windowed(self, idx: int) -> float:
+        secs = sum(c[2] for c in self._chunks)
+        if secs <= 0.0:
+            return 0.0
+        return sum(c[idx] for c in self._chunks) / secs
+
+    @property
+    def steps_per_sec(self) -> float:
+        return self._windowed(0)
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self._windowed(1)
+
+    def summary(self) -> dict:
+        return {
+            "steps_per_sec": self.steps_per_sec,
+            "tokens_per_sec": self.tokens_per_sec,
+            "total_steps": self.total_steps,
+            "total_tokens": self.total_tokens,
+            "total_seconds": self.total_seconds,
+        }
+
+
 def get_events():
     return list(_P.events)

@@ -122,6 +122,36 @@ class FaultPlan:
             pass
         return val
 
+    def corrupt_loss_vector(self, step0: int, losses):
+        """Chunked analog of `corrupt_loss`: `losses` is the per-step loss
+        vector of a scan-fused chunk covering global steps
+        [step0, step0 + K). A nan_loss/inf_loss clause scheduled inside
+        that range poisons its element, so mid-chunk sentinel paths are
+        testable without touching device state."""
+        import numpy as np
+
+        from ..core.tensor import Tensor
+        raw = losses.data if isinstance(losses, Tensor) else losses
+        vec = np.atleast_1d(np.asarray(raw))
+        k = vec.shape[0]
+        poisoned = None
+        for kind, val in (("nan_loss", float("nan")),
+                          ("inf_loss", float("inf"))):
+            for f in self.faults:
+                if f.fired or f.kind != kind or \
+                        not (step0 <= f.step < step0 + k):
+                    continue
+                f.fired = True
+                self.log.append(repr(f))
+                if poisoned is None:
+                    poisoned = np.array(
+                        vec, dtype=vec.dtype if vec.dtype.kind == "f"
+                        else np.float32)
+                poisoned[f.step - step0] = val
+        if poisoned is None:
+            return losses
+        return Tensor(poisoned) if isinstance(losses, Tensor) else poisoned
+
     def maybe_raise(self, step: int):
         """Raise a transient-failure exception if scheduled for `step`."""
         f = self._take("raise", step)

@@ -1,0 +1,417 @@
+"""Scan-fused multi-step runner (ISSUE 2 tentpole): ScanTrainStep must
+produce the SAME training trajectory as K eager ShardedTrainStep calls —
+including under gradient_merge (accum_k not dividing K) and AMP fp16
+loss-scale overflow — while issuing N/K jitted dispatches for N steps.
+Satellites ride along: ChunkPrefetcher semantics, chunk-aware
+DeviceWorker/MultiTrainer/ResilientTrainer run loops, dtype-accurate
+DataParallel grad bucketing, and the per-chunk throughput counters."""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as optim
+from paddle_tpu.distributed import DistributedStrategy
+from paddle_tpu.distributed.fleet.strategy_compiler import StrategyCompiler
+from paddle_tpu.parallel import (ScanTrainStep, ShardedTrainStep,
+                                 parallelize, stack_batches)
+
+K = 4
+N_STEPS = 8
+
+
+def _mesh(n=2):
+    return Mesh(np.array(jax.devices()[:n]), ("data",))
+
+
+def _model_opt(lr=1e-2):
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    opt = optim.AdamW(learning_rate=lr, parameters=model.parameters())
+    return model, opt
+
+
+def _batches(n=N_STEPS, scale=1.0, overflow_at=None):
+    rng = np.random.RandomState(0)
+    out = []
+    for i in range(n):
+        x = rng.randn(4, 8).astype(np.float32) * scale
+        y = rng.randn(4, 4).astype(np.float32)
+        if overflow_at is not None and i == overflow_at:
+            x = x * 1e4  # fp16 range is ±65504: the mse loss overflows
+        out.append((x, y))
+    return out
+
+
+def _mse(out, y):
+    return nn.functional.mse_loss(out, y)
+
+
+def _plan(mutate=None, opt=None, mesh=None):
+    s = DistributedStrategy()
+    if mutate is not None:
+        mutate(s)
+    return StrategyCompiler().compile(s, opt, mesh)
+
+
+def _run_eager(batches, mutate=None):
+    model, opt = _model_opt()
+    mesh = _mesh()
+    step = ShardedTrainStep(model, opt, mesh, loss_fn=_mse,
+                            plan=_plan(mutate, opt, mesh))
+    losses = [float(np.asarray(step(*b).data)) for b in batches]
+    return losses, step
+
+
+def _run_scan(batches, k=K, mutate=None):
+    model, opt = _model_opt()
+    mesh = _mesh()
+    step = ScanTrainStep(model, opt, mesh, scan_steps=k, loss_fn=_mse,
+                         plan=_plan(mutate, opt, mesh))
+    losses = []
+    for c in range(len(batches) // k):
+        chunk = stack_batches(batches[c * k:(c + 1) * k])
+        losses.extend(np.asarray(step(*chunk).data).tolist())
+    return losses, step
+
+
+def _assert_params_match(a, b):
+    for key in a._params:
+        np.testing.assert_allclose(
+            np.asarray(a._params[key]), np.asarray(b._params[key]),
+            rtol=1e-5, atol=1e-6, err_msg=key)
+
+
+# ---- tentpole: scan/eager parity ----
+
+def test_scan_eager_parity():
+    batches = _batches()
+    eager_losses, eager = _run_eager(batches)
+    scan_losses, scan = _run_scan(batches)
+    np.testing.assert_allclose(scan_losses, eager_losses,
+                               rtol=1e-5, atol=1e-6)
+    _assert_params_match(eager, scan)
+    assert scan.dispatch_count == N_STEPS // K
+
+
+def test_scan_parity_gradient_merge():
+    # accum_k=3 does NOT divide K=4: merge boundaries (step % 3 == 0) land
+    # mid-chunk, exercising the global-step threading through the scan
+    def mutate(s):
+        s.gradient_merge = True
+        s.gradient_merge_configs = {"k_steps": 3}
+
+    batches = _batches()
+    eager_losses, eager = _run_eager(batches, mutate)
+    scan_losses, scan = _run_scan(batches, mutate=mutate)
+    np.testing.assert_allclose(scan_losses, eager_losses,
+                               rtol=1e-5, atol=1e-6)
+    _assert_params_match(eager, scan)
+
+
+def test_scan_parity_amp_overflow():
+    # an fp16 overflow mid-chunk (step 5 of 8, inside the 2nd chunk) must
+    # shrink the loss scale and skip the update identically on both paths
+    def mutate(s):
+        s.amp = True
+        s.amp_configs = {"dtype": "float16", "init_loss_scaling": 1024.0,
+                         "decr_every_n_nan_or_inf": 1,
+                         "use_dynamic_loss_scaling": True}
+
+    batches = _batches(overflow_at=5)
+    eager_losses, eager = _run_eager(batches, mutate)
+    scan_losses, scan = _run_scan(batches, mutate=mutate)
+    np.testing.assert_allclose(scan_losses, eager_losses,
+                               rtol=1e-4, atol=1e-5)
+    assert eager.loss_scale == scan.loss_scale
+    assert scan.loss_scale < 1024.0  # the overflow actually shrank it
+    _assert_params_match(eager, scan)
+
+
+def test_scan_dispatch_count_32_steps():
+    # acceptance: a 32-step run issues exactly 32/K jitted dispatches
+    k = 8
+    batches = _batches(32)
+    model, opt = _model_opt()
+    step = ScanTrainStep(model, opt, _mesh(), scan_steps=k, loss_fn=_mse)
+    calls = []
+    inner = step._chunk_jitted
+    step._chunk_jitted = lambda *a, **kw: (calls.append(1) or inner(*a, **kw))
+    for c in range(32 // k):
+        step(*stack_batches(batches[c * k:(c + 1) * k]))
+    assert len(calls) == 32 // k
+    assert step.dispatch_count == 32 // k
+    assert step._step_count == 32
+
+
+def test_scan_rejects_unstacked_batch():
+    model, opt = _model_opt()
+    step = ScanTrainStep(model, opt, _mesh(), scan_steps=K, loss_fn=_mse)
+    with pytest.raises(ValueError, match="stacked"):
+        # a per-step [5, 8] batch, not a stacked [K=4, ...] chunk
+        step(np.zeros((5, 8), np.float32), np.zeros((5, 4), np.float32))
+
+
+def test_parallelize_routes_scan_steps():
+    model, opt = _model_opt()
+    s = DistributedStrategy()
+    s.scan_steps = K
+    step = parallelize(model, opt, mesh=_mesh(), strategy=s, loss_fn=_mse)
+    assert isinstance(step, ScanTrainStep)
+    assert step.scan_steps == K
+
+
+def test_lr_vector_advances_scheduler():
+    from types import SimpleNamespace
+    from paddle_tpu.optimizer.lr import StepDecay
+    sched = StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    model, _ = _model_opt()
+    opt = optim.SGD(learning_rate=sched, parameters=model.parameters())
+    vec = ScanTrainStep._lr_vector(SimpleNamespace(optimizer=opt), 4)
+    np.testing.assert_allclose(vec, [0.1, 0.1, 0.05, 0.05])
+    assert sched.last_epoch == 4  # runner owns the per-step advance
+
+
+def test_stack_batches_shapes():
+    cols = stack_batches(_batches(3))
+    assert [c.shape for c in cols] == [(3, 4, 8), (3, 4, 4)]
+    (single,) = stack_batches([np.zeros((2,)), np.ones((2,))])
+    assert single.shape == (2, 2)
+    with pytest.raises(ValueError):
+        stack_batches([])
+
+
+# ---- async double-buffered prefetcher ----
+
+def test_prefetcher_matches_manual_stacking():
+    from paddle_tpu.io import ChunkPrefetcher
+    batches = _batches(8)
+    pf = ChunkPrefetcher(batches, scan_steps=4, put_fn=lambda s: s)
+    chunks = list(pf)
+    assert len(chunks) == 2 and pf.dropped_steps == 0
+    for c, chunk in enumerate(chunks):
+        expect = stack_batches(batches[c * 4:(c + 1) * 4])
+        for got, want in zip(chunk, expect):
+            np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_prefetcher_drops_trailing_partial_chunk():
+    from paddle_tpu.io import ChunkPrefetcher
+    pf = ChunkPrefetcher(_batches(10), scan_steps=4, put_fn=lambda s: s)
+    assert len(list(pf)) == 2
+    assert pf.dropped_steps == 2  # accounted, not silent
+
+
+def test_prefetcher_propagates_producer_error():
+    from paddle_tpu.io import ChunkPrefetcher
+
+    def bad_source():
+        yield from _batches(4)
+        raise ValueError("decode failed")
+
+    pf = ChunkPrefetcher(bad_source(), scan_steps=4, put_fn=lambda s: s)
+    it = iter(pf)
+    next(it)
+    with pytest.raises(ValueError, match="decode failed"):
+        next(it)
+
+
+# ---- chunk-aware trainer run loop ----
+
+class _FakeScanStep:
+    """scan_steps-shaped train fn: K steps per call, per-step loss vector."""
+
+    scan_steps = 4
+
+    def __init__(self):
+        self.w = 0.0
+        self.calls = []
+
+    def __call__(self, chunk, *rest):
+        start = int(np.asarray(chunk).reshape(-1)[0])
+        self.calls.append(start)
+        self.w += float(self.scan_steps)
+        return np.array([1.0 / (start + i + 1)
+                         for i in range(self.scan_steps)], np.float32)
+
+
+def test_deviceworker_chunk_advances_k_steps(capsys):
+    from paddle_tpu.distributed.trainer import DeviceWorker
+    worker = DeviceWorker(_FakeScanStep(), print_period=2)
+    worker.run_step(np.full((4,), 0.0, np.float32))
+    assert worker.steps == 4
+    worker.run_step(np.full((4,), 4.0, np.float32))
+    assert worker.steps == 8
+    tp = worker.throughput
+    assert tp.total_steps == 8 and tp.steps_per_sec > 0
+
+
+def test_multitrainer_prefetch_end_to_end():
+    from paddle_tpu.distributed.trainer import MultiTrainer
+
+    class _TwoArg(_FakeScanStep):
+        def __call__(self, xs, ys):
+            assert np.asarray(xs).shape[0] == self.scan_steps
+            return super().__call__(np.zeros((1,)))
+
+    trainer = MultiTrainer(_TwoArg(), print_period=0)
+    trainer.train_from_dataset(_batches(9), prefetch=2)
+    assert trainer.steps == 8  # 2 chunks of 4; the 9th batch dropped
+
+
+def test_multitrainer_prefetch_requires_scan_fn():
+    from paddle_tpu.distributed.trainer import MultiTrainer
+    with pytest.raises(ValueError, match="scan-fused"):
+        MultiTrainer(lambda b: 0.0).train_from_dataset(
+            _batches(4), prefetch=2)
+
+
+def test_chunk_tokens_counts_id_elements():
+    from paddle_tpu.distributed.trainer import DeviceWorker
+    args = (np.zeros((4, 2, 16), np.int32), np.zeros((4,), np.int32))
+    assert DeviceWorker._chunk_tokens(args) == 4 * 2 * 16
+
+
+# ---- resilient runtime at chunk granularity ----
+
+def _resilient(tmp_path, fake, spec, **cfg):
+    from paddle_tpu.distributed.resilient import (ResilientConfig,
+                                                  ResilientTrainer)
+    from paddle_tpu.utils.fault_injection import FaultPlan
+    return ResilientTrainer(
+        fake, str(tmp_path / "ckpt"),
+        get_state=lambda: {"w": fake.w},
+        set_state=lambda s: setattr(fake, "w", s["w"]),
+        config=ResilientConfig(**cfg),
+        fault_plan=FaultPlan.from_spec(spec) if spec else None,
+        use_orbax=False)
+
+
+def test_resilient_nan_mid_chunk_rolls_back(tmp_path):
+    # NaN at global step 5 = index 1 of the 2nd chunk [4..8): the sentinel
+    # localizes it, and even under nan_policy='skip' the chunk rolls back —
+    # the fused steps 6..7 already consumed the poisoned params
+    fake = _FakeScanStep()
+    t = _resilient(tmp_path, fake, "nan_loss@5",
+                   nan_policy="skip", save_interval=1)
+    summary = t.run(lambda i: np.full((4,), i, np.float32), num_steps=8)
+    assert summary["completed_steps"] == 8
+    assert summary["rollbacks"] == 1
+    bad = [e for e in summary["events"] if e["kind"] == "bad_loss"]
+    assert bad and bad[0]["step"] == 5 and bad[0]["chunk_start"] == 4
+    rb = [e for e in summary["events"] if e["kind"] == "rollback"]
+    assert rb and rb[0]["step"] == 4  # back to the chunk-boundary ckpt
+    assert fake.calls == [0, 4, 4]    # chunk 2 replayed after rollback
+    assert fake.w == 8.0              # restored state + clean replay
+
+
+def test_resilient_chunk_nan_abort_policy(tmp_path):
+    from paddle_tpu.distributed.resilient import UnrecoverableError
+    fake = _FakeScanStep()
+    t = _resilient(tmp_path, fake, "nan_loss@2", nan_policy="abort")
+    with pytest.raises(UnrecoverableError, match="step 2"):
+        t.run(lambda i: np.full((4,), i, np.float32), num_steps=8)
+
+
+def test_resilient_chunk_requires_divisible_steps(tmp_path):
+    fake = _FakeScanStep()
+    t = _resilient(tmp_path, fake, "")
+    with pytest.raises(ValueError, match="multiple"):
+        t.run(lambda i: np.full((4,), i, np.float32), num_steps=6)
+
+
+def test_resilient_chunk_save_cadence(tmp_path):
+    # save_interval=3 with K=4: saves land at the first chunk boundary at or
+    # past each interval multiple (4 covers 3, 8 covers 6 + end-of-run)
+    fake = _FakeScanStep()
+    t = _resilient(tmp_path, fake, "", save_interval=3)
+    t.run(lambda i: np.full((4,), i, np.float32), num_steps=8)
+    assert t.ckpt.latest_step() == 8
+    assert t.ckpt.restore(4) is not None  # the mid-run boundary save
+
+
+def test_corrupt_loss_vector_poisons_only_scheduled_step():
+    from paddle_tpu.utils.fault_injection import FaultPlan
+    plan = FaultPlan.from_spec("nan_loss@5;inf_loss@9")
+    losses = np.ones((4,), np.float32)
+    out = plan.corrupt_loss_vector(4, losses)       # steps 4..7
+    assert np.isnan(out[1])
+    assert np.isfinite([out[0], out[2], out[3]]).all()
+    out2 = plan.corrupt_loss_vector(8, np.ones((4,), np.float32))
+    assert np.isinf(out2[1])
+    untouched = plan.corrupt_loss_vector(12, losses)
+    assert untouched is losses  # nothing scheduled: no copy, no change
+
+
+# ---- satellite: dtype-accurate grad bucketing ----
+
+def test_bucket_grads_respects_dtype_itemsize():
+    from paddle_tpu.distributed.data_parallel import _bucket_grads
+
+    class _G:
+        def __init__(self, arr):
+            self.data = arr
+
+    class _P:
+        def __init__(self, arr):
+            self.grad = _G(arr)
+
+    n = 300_000  # fp16: 600KB/grad; fp32: 1.2MB/grad
+    halves = [_P(np.zeros(n, np.float16)) for _ in range(4)]
+    fulls = [_P(np.zeros(n, np.float32)) for _ in range(4)]
+    # 1MB cap: two 600KB fp16 grads per bucket (the old hard-coded
+    # 4-bytes/elem rule closed a bucket after ONE — 2x the configured MB)
+    assert [len(b) for b in _bucket_grads(halves, 1)] == [2, 2]
+    assert [len(b) for b in _bucket_grads(fulls, 1)] == [1, 1, 1, 1]
+
+
+# ---- strategy wiring ----
+
+def test_compiler_scan_steps_and_flag_fallback():
+    import paddle_tpu.flags as flags
+    plan = _plan(lambda s: setattr(s, "scan_steps", 4))
+    assert plan.scan_steps == 4 and "scan" in plan.applied
+    assert _plan().scan_steps == 1
+    flags.set_flags({"FLAGS_scan_chunk": 8})
+    try:
+        assert _plan().scan_steps == 8  # env flag fills the default
+        # an explicit strategy value wins over the flag
+        assert _plan(lambda s: setattr(s, "scan_steps", 2)).scan_steps == 2
+    finally:
+        flags.set_flags({"FLAGS_scan_chunk": 0})
+
+
+def test_compiler_scan_conflicts_disable_with_warning():
+    def with_localsgd(s):
+        s.scan_steps = 4
+        s.localsgd = True
+        s.localsgd_configs = {"k_steps": 2}
+
+    with pytest.warns(UserWarning, match="does not compose"):
+        plan = _plan(with_localsgd)
+    assert plan.scan_steps == 1 and "scan" not in plan.applied
+
+    def with_pipeline(s):
+        s.scan_steps = 4
+        s.pipeline = True
+
+    with pytest.warns(UserWarning, match="does not compose"):
+        plan = _plan(with_pipeline)
+    assert plan.scan_steps == 1 and plan.pipeline
+
+
+# ---- satellite: per-chunk throughput counters ----
+
+def test_throughput_tracker_rates():
+    from paddle_tpu.profiler import ThroughputTracker
+    tp = ThroughputTracker(window=2)
+    tp.update(steps=4, seconds=2.0, tokens=4000)
+    assert tp.steps_per_sec == pytest.approx(2.0)
+    assert tp.tokens_per_sec == pytest.approx(2000.0)
+    tp.update(steps=4, seconds=1.0, tokens=4000)
+    tp.update(steps=4, seconds=1.0, tokens=4000)  # first chunk ages out
+    assert tp.steps_per_sec == pytest.approx(4.0)
+    assert tp.total_steps == 12 and tp.total_tokens == 12000
+    assert tp.summary()["total_seconds"] == pytest.approx(4.0)

@@ -103,3 +103,34 @@ def test_sweep_tag_maps_to_preset_floor(tmp_path):
     th = _write(tmp_path, "th.json", {"gpt3-125m": {"mfu": 0.32}})
     rc = gate.main(["--new", new, "--thresholds", th])
     assert rc == 2  # 0.10 gates against the gpt3-125m floor and fails
+
+
+def test_chunked_metric_keys_separately():
+    """Scan-fused bench rows ('... chunked32') key as <preset>-chunked so a
+    dedicated floor can be pinned for the fused path."""
+    row = {"metric": "tokens/sec/chip gpt3-125m bs8 seq1024 bf16 fused "
+                     "train step chunked32",
+           "value": 1.0, "extra": {"mfu": 0.33, "backend": "tpu"}}
+    assert gate._preset_of(row) == "gpt3-125m-chunked"
+
+
+def test_chunked_row_gates_against_base_floor(tmp_path, capsys):
+    """Without its own pinned floor a chunked row gates against the BASE
+    preset's floor (scan fusion must never be slower than eager), keeping
+    --strict green."""
+    def chunked(mfu):
+        return {"metric": "tokens/sec/chip gpt3-125m bs8 seq1024 bf16 "
+                          "fused train step chunked32",
+                "value": 1.0, "extra": {"mfu": mfu, "backend": "tpu"}}
+
+    th = _write(tmp_path, "th.json", {"gpt3-125m": {"mfu": 0.32}})
+    new = _write(tmp_path, "new.json", [chunked(0.33)])
+    assert gate.main(["--new", new, "--thresholds", th, "--strict"]) == 0
+
+    slow = _write(tmp_path, "slow.json", [chunked(0.10)])
+    assert gate.main(["--new", slow, "--thresholds", th, "--strict"]) == 2
+
+    # a dedicated chunked floor, when pinned, wins over the base fallback
+    th2 = _write(tmp_path, "th2.json", {
+        "gpt3-125m": {"mfu": 0.32}, "gpt3-125m-chunked": {"mfu": 0.05}})
+    assert gate.main(["--new", slow, "--thresholds", th2, "--strict"]) == 0

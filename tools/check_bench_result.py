@@ -35,7 +35,13 @@ def _preset_of(row):
     # "tokens/sec/chip <preset> bs8 seq1024 ..." — the preset token
     if len(parts) >= 2 and "/" in parts[0]:
         p = parts[1]
-        return p[4:-1] if p.startswith("GPT(") else p
+        p = p[4:-1] if p.startswith("GPT(") else p
+        # scan-fused rows ("... chunked32") key separately so a dedicated
+        # floor can be pinned; absent one they gate against the base
+        # preset's floor (resolved in main)
+        if any(t.startswith("chunked") for t in parts[2:]):
+            return f"{p}-chunked"
+        return p
     return row.get("tag")
 
 
@@ -120,6 +126,11 @@ def main(argv=None):
     unmapped = []
     for p, m in sorted(measured.items()):
         floor = floors.get(p, {}).get("mfu")
+        if floor is None and p.endswith("-chunked"):
+            # scan fusion must never be slower than the eager floor: a
+            # chunked row without its own pinned floor gates against the
+            # base preset's (keeps --strict meaningful for fused runs)
+            floor = floors.get(p[: -len("-chunked")], {}).get("mfu")
         if floor is None:
             if floors:
                 # a row that matches no pinned floor silently weakens the
