@@ -330,6 +330,114 @@ def _run_decode_bench(jax, jnp, backend, on_tpu, preset, init_err):
     print(json.dumps(result))
 
 
+def run_serve_bench():
+    """Serving-runtime benchmark (ISSUE 3): replays a seeded Poisson arrival
+    trace through the REAL serving stack — a static-export MLP behind
+    BatchingEngine.from_predictor on the threaded wall-clock scheduler — and
+    reports sustained req/sec plus tail latency. The row gates through
+    tools/check_bench_result.py's direction-aware keys (serve_qps floor,
+    serve_p99_ms ceiling)."""
+    import os
+    import tempfile
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, nn, serving
+
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", "512"))
+    rate_hz = float(os.environ.get("BENCH_SERVE_RATE_HZ", "3000"))
+    max_batch = int(os.environ.get("BENCH_SERVE_MAX_BATCH", "16"))
+    max_wait_ms = float(os.environ.get("BENCH_SERVE_MAX_WAIT_MS", "2.0"))
+    backend = jax.default_backend()
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 8))
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "serve_mlp")
+        inference.export_model(
+            model, [np.ones((max_batch, 16), np.float32)], path)
+        pred = inference.load_predictor(path)
+        # compile every pow2 bucket the engine can form BEFORE the timed
+        # replay — a mid-trace jit compile would show up as a fake p99 spike
+        b = 1
+        while b <= max_batch:
+            pred.run([np.zeros((b, 16), np.float32)])
+            b *= 2
+
+        engine = serving.BatchingEngine.from_predictor(
+            pred, serving.EngineConfig(
+                max_batch_size=max_batch, max_wait_ms=max_wait_ms,
+                max_queue_depth=max(4 * max_batch, 64)))
+        engine.start()
+        rng = np.random.RandomState(0)
+        gaps = rng.exponential(1.0 / rate_hz, size=n_req)
+        reqs = [rng.rand(1, 16).astype(np.float32) for _ in range(n_req)]
+
+        futs, rejected = [], 0
+        t0 = time.perf_counter()
+        t_next = t0
+        for gap, x in zip(gaps, reqs):
+            t_next += gap
+            delay = t_next - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                futs.append(engine.submit([x]))
+            except serving.RejectedError:
+                rejected += 1
+        for f in futs:
+            try:
+                f.result(timeout=60)
+            except Exception:
+                pass
+        dt = time.perf_counter() - t0
+        engine.stop(drain=True)
+
+    snap = engine.metrics.snapshot()
+    qps = snap["completed"] / dt if dt > 0 else 0.0
+    result = {
+        "metric": f"req/sec serve-mlp maxb{max_batch} wait{max_wait_ms}ms "
+                  f"poisson{int(rate_hz)}",
+        "value": round(qps, 1),
+        "unit": "req/sec",
+        "vs_baseline": 0.0,
+        "extra": {
+            "serve_qps": round(qps, 1),
+            "serve_p50_ms": round(snap["p50_ms"] or 0.0, 3),
+            "serve_p95_ms": round(snap["p95_ms"] or 0.0, 3),
+            "serve_p99_ms": round(snap["p99_ms"] or 0.0, 3),
+            "dispatches": snap["dispatches"],
+            "mean_batch_rows": round(snap["mean_batch_rows"], 2),
+            "completed": snap["completed"],
+            "rejected": snap["rejected"] + rejected,
+            "expired": snap["expired"],
+            "backend": backend,
+            "n_requests": n_req,
+            "rate_hz": rate_hz,
+            "max_batch": max_batch,
+            "max_wait_ms": max_wait_ms,
+        },
+    }
+    print(json.dumps(result))
+
+
+def _serve_main():
+    """--serve entry: like main(), ALWAYS prints one JSON line, exit 0."""
+    try:
+        run_serve_bench()
+    except Exception as e:
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "serve_bench_error",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "extra": {"error": f"{type(e).__name__}: {str(e)[:400]}"},
+        }))
+    sys.exit(0)
+
+
 def _child_main():
     """Runs the real bench (TPU if it comes up). May hang in native backend
     init — the parent kills us then."""
@@ -453,6 +561,8 @@ def main():
 if __name__ == "__main__":
     if "--child" in sys.argv:
         _child_main()
+    elif "--serve" in sys.argv:
+        _serve_main()
     elif "--probe" in sys.argv:
         _probe_main()
     else:

@@ -110,6 +110,7 @@ from . import parallel  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import regularizer  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import serving  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import utils  # noqa: F401,E402

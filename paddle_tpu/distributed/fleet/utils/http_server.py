@@ -10,6 +10,33 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib import request as urlrequest
 
 
+def read_request_body(handler, max_bytes=64 << 20):
+    """Content-Length-validated body read shared by the KV server and the
+    serving front end (paddle_tpu/serving/server.py). A malformed client —
+    missing/garbage/negative/oversized Content-Length, or a body shorter
+    than declared — gets a 4xx response instead of 500-ing the handler.
+    Returns the body bytes, or None after an error response was sent."""
+    raw = handler.headers.get("Content-Length")
+    if raw is None:
+        handler.send_response(411)  # Length Required
+        handler.end_headers()
+        return None
+    try:
+        length = int(raw)
+    except (TypeError, ValueError):
+        length = -1
+    if length < 0 or length > max_bytes:
+        handler.send_response(400)
+        handler.end_headers()
+        return None
+    body = handler.rfile.read(length) if length else b""
+    if len(body) < length:  # client hung up mid-body
+        handler.send_response(400)
+        handler.end_headers()
+        return None
+    return body
+
+
 class _KVHandler(BaseHTTPRequestHandler):
     kv = {}
     lock = threading.Lock()
@@ -30,8 +57,9 @@ class _KVHandler(BaseHTTPRequestHandler):
             self.wfile.write(val)
 
     def do_PUT(self):
-        length = int(self.headers.get("Content-Length", 0))
-        data = self.rfile.read(length)
+        data = read_request_body(self)
+        if data is None:
+            return
         with self.lock:
             self.kv[self.path] = data
         self.send_response(200)
@@ -51,6 +79,7 @@ class KVServer:
         self.port = port
         self._server = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
         self._thread = None
+        self._stopped = False
 
     def start(self):
         self._thread = threading.Thread(target=self._server.serve_forever,
@@ -58,6 +87,9 @@ class KVServer:
         self._thread.start()
 
     def stop(self):
+        if self._stopped:  # idempotent: double-stop must not raise on the
+            return         # already-closed socket
+        self._stopped = True
         self._server.shutdown()
         self._server.server_close()
 

@@ -22,6 +22,7 @@ import os
 from typing import Dict, List, Optional
 
 import jax
+import jax.export  # noqa: F401  (registers the lazy jax.export submodule)
 import jax.numpy as jnp
 import numpy as np
 
@@ -304,14 +305,24 @@ class Predictor:
 
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         if inputs is not None:
-            for h, arr in zip(self._inputs.values(), inputs):
-                h.copy_from_cpu(arr)
+            # Explicit-feed path is THREAD-SAFE: compute from the caller's
+            # arrays directly instead of bouncing them through the shared
+            # IO handles (two threads sharing one predictor would overwrite
+            # each other's feeds mid-run — the batching engine and the
+            # PredictorPool-less serving path rely on this). The handles are
+            # still updated afterwards for get_output_handle() compat
+            # (last-writer-wins, same as the reference's single-thread use).
+            args = [jnp.asarray(a) for a in inputs]
+            outs = self._run_dynamic_batch(args)
+            for h, a in zip(self._inputs.values(), args):
+                h._array = a
+            for h, o in zip(self._outputs.values(), outs):
+                h._array = o
+            return [np.asarray(o) for o in outs]
         args = [self._inputs[n]._array for n in self._meta["input_names"]]
         outs = self._run_dynamic_batch(args)
         for h, o in zip(self._outputs.values(), outs):
             h._array = o
-        if inputs is not None:
-            return [np.asarray(o) for o in outs]
         return None
 
     def _run_dynamic_batch(self, args):

@@ -87,6 +87,7 @@ class ChunkPrefetcher:
         self.chunks_produced = 0
         self._q: _queue.Queue = _queue.Queue(maxsize=self.depth)
         self._stop = threading.Event()
+        self._closed = False
         self._thread: Optional[threading.Thread] = None
 
     # ---- producer ----
@@ -119,12 +120,26 @@ class ChunkPrefetcher:
                     f"{len(pending)} step(s) (< scan_steps="
                     f"{self.scan_steps})", stacklevel=2)
         except BaseException as e:  # propagate into the consumer
-            self._q.put(_Err(e))
+            self._put_ctrl(_Err(e))
             return
-        self._q.put(_Done())
+        self._put_ctrl(_Done())
+
+    def _put_ctrl(self, item):
+        """Control-message put that never wedges the producer: a consumer
+        that closed mid-epoch leaves the bounded queue full, and a plain
+        blocking put would park this thread forever (close() could then
+        never join it)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except _queue.Full:
+                continue
 
     # ---- consumer ----
     def __iter__(self):
+        if self._closed:
+            return self       # closed: iteration terminates, never restarts
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._produce, daemon=True,
@@ -133,26 +148,54 @@ class ChunkPrefetcher:
         return self
 
     def __next__(self):
+        if self._closed:
+            raise StopIteration
         if self._thread is None:
             iter(self)
-        item = self._q.get()
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+                break
+            except _queue.Empty:
+                if self._closed:  # closed under us mid-wait
+                    raise StopIteration
         if isinstance(item, _Done):
             raise StopIteration
         if isinstance(item, _Err):
             raise item.exc
         return item
 
+    def __enter__(self):
+        """Context-manager use guarantees the drain discipline: a consumer
+        that raises mid-epoch still joins the producer thread and releases
+        every staged (in-flight device_put) chunk on the way out — the same
+        drain-on-error contract the serving engine holds itself to."""
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
     def close(self):
-        """Stop the producer thread and drain staged chunks."""
+        """Stop the producer thread, join it, and drain staged chunks so
+        their device buffers are released. Idempotent; a closed prefetcher
+        iterates as exhausted instead of blocking."""
+        self._closed = True
         self._stop.set()
+        self._drain()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            # the producer may have slipped one last control message in
+            # between the drain and its exit — release that too
+            self._drain()
+            self._thread = None
+
+    def _drain(self):
         try:
             while True:
                 self._q.get_nowait()
         except _queue.Empty:
             pass
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
-            self._thread = None
 
     def __del__(self):
         try:

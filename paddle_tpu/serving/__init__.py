@@ -1,0 +1,29 @@
+"""Online serving runtime (ISSUE 3): continuous micro-batching over an
+exported model — the scheduling layer between concurrent user requests and
+batched TPU dispatches.
+
+    training (parallel/) -> export (inference.export_model) -> serve (here)
+
+    from paddle_tpu import inference, serving
+    pred = inference.load_predictor("/models/my_model")
+    engine = serving.BatchingEngine.from_predictor(
+        pred, serving.EngineConfig(max_batch_size=16, max_wait_ms=4))
+    server = serving.ServingServer(engine, port=8000)
+    server.serve_forever()        # SIGTERM -> graceful drain, exit 0
+
+Deterministic scheduler testing (no real sleeps):
+
+    clock = serving.SimClock()
+    engine = serving.BatchingEngine(fn, cfg, clock=clock)
+    report = serving.replay(engine, serving.poisson_trace(...))
+
+See docs/serving.md for architecture and tuning (max_wait_ms vs p99,
+pow2 bucketing vs symbolic-batch exports).
+"""
+from .clock import Clock, MonotonicClock, SimClock  # noqa: F401
+from .engine import (BatchingEngine, DeadlineExceededError,  # noqa: F401
+                     EngineConfig, RejectedError)
+from .metrics import ServingMetrics, parse_exposition  # noqa: F401
+from .sim import (Arrival, ReplayReport, poisson_trace,  # noqa: F401
+                  replay, uniform_trace)
+from .server import ServingServer, serve  # noqa: F401

@@ -1,0 +1,158 @@
+"""Serving metrics: request counters, latency quantiles, batch-size
+histogram, queue-depth gauge — collected by the BatchingEngine on every
+admission/dispatch and exposed two ways:
+
+- `render()` — Prometheus text exposition for the HTTP `/metrics` endpoint;
+- `paddle_tpu.profiler.record_instant` — a `serving/dispatch` instant per
+  engine dispatch, so serving activity lands on the same chrome trace
+  timeline as training step spans when profiling is enabled.
+
+Latency quantiles come from a bounded reservoir of recent completions
+(exact over the window, not an approximation sketch); totals are lifetime
+counters so a drain snapshot reconciles against a replayed trace:
+submitted == completed + rejected + expired + failed.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+# cumulative histogram upper bounds for dispatched batch rows
+BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+class ServingMetrics:
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        self.window = int(window)
+        self._latencies_ms: deque = deque(maxlen=self.window)
+        self.counters: Dict[str, int] = {
+            "submitted": 0, "completed": 0, "rejected": 0,
+            "expired": 0, "failed": 0, "dispatches": 0,
+        }
+        self.reject_reasons: Dict[str, int] = {}
+        self.batch_hist: Dict[int, int] = {}   # exact dispatched rows -> n
+        self.queue_depth = 0
+        self.dispatched_rows = 0
+        self.padded_rows = 0
+
+    # ---- engine callbacks ----
+    def on_submit(self, queue_depth: int):
+        with self._lock:
+            self.counters["submitted"] += 1
+            self.queue_depth = queue_depth
+
+    def on_reject(self, reason: str):
+        with self._lock:
+            self.counters["rejected"] += 1
+            self.reject_reasons[reason] = self.reject_reasons.get(reason, 0) + 1
+
+    def on_expire(self, n: int = 1):
+        with self._lock:
+            self.counters["expired"] += n
+
+    def on_complete(self, latency_ms: float):
+        with self._lock:
+            self.counters["completed"] += 1
+            self._latencies_ms.append(float(latency_ms))
+
+    def on_fail(self, n: int = 1):
+        with self._lock:
+            self.counters["failed"] += n
+
+    def on_dispatch(self, rows: int, n_requests: int, padded_rows: int,
+                    dispatch_ms: float, queue_depth: int):
+        with self._lock:
+            self.counters["dispatches"] += 1
+            self.batch_hist[rows] = self.batch_hist.get(rows, 0) + 1
+            self.dispatched_rows += rows
+            self.padded_rows += padded_rows - rows
+            self.queue_depth = queue_depth
+        from ..profiler import record_instant
+        record_instant("serving/dispatch", {
+            "rows": rows, "requests": n_requests,
+            "padded_rows": padded_rows, "dispatch_ms": dispatch_ms,
+            "queue_depth": queue_depth,
+        })
+
+    def set_queue_depth(self, depth: int):
+        with self._lock:
+            self.queue_depth = depth
+
+    # ---- views ----
+    def quantile_ms(self, q: float) -> Optional[float]:
+        with self._lock:
+            lat = sorted(self._latencies_ms)
+        if not lat:
+            return None
+        idx = min(len(lat) - 1, max(0, int(round(q * (len(lat) - 1)))))
+        return lat[idx]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self.counters)
+            hist = dict(self.batch_hist)
+            depth = self.queue_depth
+            rows, padded = self.dispatched_rows, self.padded_rows
+        mean_batch = rows / counters["dispatches"] if counters["dispatches"] \
+            else 0.0
+        return {
+            **counters,
+            "queue_depth": depth,
+            "batch_hist": hist,
+            "mean_batch_rows": mean_batch,
+            "pad_overhead_rows": padded,
+            "p50_ms": self.quantile_ms(0.50),
+            "p95_ms": self.quantile_ms(0.95),
+            "p99_ms": self.quantile_ms(0.99),
+        }
+
+    def render(self) -> str:
+        """Prometheus text exposition (served at /metrics)."""
+        s = self.snapshot()
+        lines = [
+            "# TYPE pdtpu_serving_requests_total counter",
+        ]
+        for outcome in ("submitted", "completed", "rejected", "expired",
+                        "failed"):
+            lines.append("pdtpu_serving_requests_total"
+                         f'{{outcome="{outcome}"}} {s[outcome]}')
+        lines += [
+            "# TYPE pdtpu_serving_dispatches_total counter",
+            f"pdtpu_serving_dispatches_total {s['dispatches']}",
+            "# TYPE pdtpu_serving_queue_depth gauge",
+            f"pdtpu_serving_queue_depth {s['queue_depth']}",
+            "# TYPE pdtpu_serving_latency_ms summary",
+        ]
+        for q, key in ((0.5, "p50_ms"), (0.95, "p95_ms"), (0.99, "p99_ms")):
+            v = s[key]
+            lines.append(f'pdtpu_serving_latency_ms{{quantile="{q}"}} '
+                         f"{'NaN' if v is None else round(v, 3)}")
+        lines.append("# TYPE pdtpu_serving_batch_rows histogram")
+        cum = 0
+        hist = s["batch_hist"]
+        for le in BATCH_BUCKETS:
+            cum = sum(n for rows, n in hist.items() if rows <= le)
+            lines.append(f'pdtpu_serving_batch_rows_bucket{{le="{le}"}} {cum}')
+        lines.append('pdtpu_serving_batch_rows_bucket{le="+Inf"} '
+                     f"{sum(hist.values())}")
+        lines.append(f"pdtpu_serving_batch_rows_count {sum(hist.values())}")
+        lines.append("pdtpu_serving_batch_rows_sum "
+                     f"{sum(r * n for r, n in hist.items())}")
+        return "\n".join(lines) + "\n"
+
+
+def parse_exposition(text: str) -> Dict[str, float]:
+    """Inverse of render() for tests/tools: flat {metric{labels}: value}."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, val = line.rpartition(" ")
+        try:
+            out[name] = float(val)
+        except ValueError:
+            continue
+    return out

@@ -1,0 +1,258 @@
+"""Threaded stdlib-HTTP serving front end over a BatchingEngine.
+
+Same idiom as the fleet KV server (distributed/fleet/utils/http_server.py —
+ThreadingHTTPServer + BaseHTTPRequestHandler, whose hardened
+`read_request_body` this module reuses):
+
+    POST /predict   {"inputs": [[...], ...], "deadline_ms": 50}
+                    -> 200 {"outputs": [...]}; 503 rejected (queue full /
+                    draining); 504 deadline expired before dispatch
+    GET  /healthz   -> 200 {"status": "ok"|"draining"}
+    GET  /metrics   -> 200 Prometheus text exposition (serving/metrics.py)
+
+Graceful drain mirrors the ResilientTrainer preemption contract
+(distributed/resilient.py): SIGTERM/SIGINT → stop admissions (new requests
+get 503), flush every in-flight batch through the engine, let the attached
+handler threads finish writing their responses, then exit 0 — no accepted
+request is ever dropped. A `final_metrics_path` snapshot is written on the
+way out so an external supervisor (or the drain test) can reconcile the
+served totals against the replayed trace.
+
+    python -m paddle_tpu.serving.server --model /path/prefix --port 8000
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from ..distributed.fleet.utils.http_server import read_request_body
+from .engine import (BatchingEngine, DeadlineExceededError, EngineConfig,
+                     RejectedError)
+
+
+def _decode_inputs(payload: dict):
+    """JSON request body -> list of np arrays (leading batch dim). Each
+    entry is either a nested list (float32) or {"data": ..., "dtype": ...}."""
+    inputs = payload.get("inputs")
+    if inputs is None:
+        raise ValueError('request body needs an "inputs" list')
+    arrays = []
+    for entry in inputs:
+        if isinstance(entry, dict):
+            arrays.append(np.asarray(entry["data"],
+                                     dtype=entry.get("dtype", "float32")))
+        else:
+            arrays.append(np.asarray(entry, dtype=np.float32))
+    return arrays
+
+
+class ServingServer:
+    """HTTP front end + drain orchestration around one BatchingEngine."""
+
+    def __init__(self, engine: BatchingEngine, host: str = "127.0.0.1",
+                 port: int = 0, final_metrics_path: Optional[str] = None,
+                 request_timeout_s: float = 60.0):
+        self.engine = engine
+        self._thread: Optional[threading.Thread] = None
+        self.final_metrics_path = final_metrics_path
+        self.request_timeout_s = float(request_timeout_s)
+        self._draining = False
+        self._stop_lock = threading.Lock()
+        self._stopped_event = threading.Event()
+        self._active = 0                 # handler threads inside /predict
+        self._active_lock = threading.Lock()
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code: int, body: bytes,
+                       ctype: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_json(self, code: int, obj):
+                self._reply(code, json.dumps(obj).encode())
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply_json(200, {
+                        "status": "draining" if outer._draining else "ok",
+                        "queue_depth": outer.engine.metrics.queue_depth,
+                    })
+                elif self.path == "/metrics":
+                    self._reply(200, outer.engine.metrics.render().encode(),
+                                ctype="text/plain; version=0.0.4")
+                else:
+                    self._reply_json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply_json(404, {"error": "not found"})
+                    return
+                body = read_request_body(self)
+                if body is None:
+                    return
+                with outer._active_lock:
+                    outer._active += 1
+                try:
+                    self._predict(body)
+                finally:
+                    with outer._active_lock:
+                        outer._active -= 1
+
+            def _predict(self, body: bytes):
+                try:
+                    payload = json.loads(body or b"{}")
+                    arrays = _decode_inputs(payload)
+                except (ValueError, KeyError, TypeError) as e:
+                    self._reply_json(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    fut = outer.engine.submit(
+                        arrays, deadline_ms=payload.get("deadline_ms"))
+                    outs = fut.result(timeout=outer.request_timeout_s)
+                except RejectedError as e:
+                    self._reply_json(503, {"error": str(e)})
+                    return
+                except DeadlineExceededError as e:
+                    self._reply_json(504, {"error": str(e)})
+                    return
+                except Exception as e:  # model/dispatch failure
+                    self._reply_json(
+                        500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                self._reply_json(200, {
+                    "outputs": [np.asarray(o).tolist() for o in outs]})
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.host, self.port = self._server.server_address[:2]
+
+    # ---- lifecycle ----
+    def start(self) -> "ServingServer":
+        """Engine scheduler + HTTP accept loop on background threads."""
+        self.engine.start()
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name="pdtpu-serving-http")
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True):
+        """Stop admissions, flush the engine, stop the HTTP server. Safe to
+        call twice (idempotent, same contract as KVServer.stop); the loser
+        of a concurrent stop race waits for the winner to finish."""
+        with self._stop_lock:
+            if self._draining:
+                already = True
+            else:
+                self._draining = True    # /predict now rejects via engine
+                already = False
+        if already:
+            self._stopped_event.wait(timeout=self.engine.config
+                                     .drain_timeout_s + 15.0)
+            return
+        self.engine.stop(drain=drain)
+        self._wait_active_settled()
+        self._server.shutdown()
+        self._server.server_close()
+        if self.final_metrics_path:
+            tmp = self.final_metrics_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(self.engine.metrics.render())
+            os.replace(tmp, self.final_metrics_path)
+        self._stopped_event.set()
+
+    def _wait_active_settled(self, timeout: float = 10.0):
+        """Let handler threads holding already-resolved futures finish
+        writing their responses before the accept loop dies — the 'no
+        accepted request is dropped' half of the drain contract."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._active_lock:
+                if self._active == 0:
+                    # brief double-check window for a just-accepted socket
+                    time.sleep(0.05)
+                    if self._active == 0:
+                        return
+                    continue
+            time.sleep(0.01)
+
+    def serve_forever(self, install_signal_handlers: bool = True):
+        """Foreground serve loop with the SIGTERM drain contract: returns
+        after a graceful drain (caller exits 0), mirroring ResilientTrainer's
+        preemption path."""
+        if install_signal_handlers:
+            def _on_term(signum, frame):
+                # drain from a helper thread: shutdown() would deadlock if
+                # called on the main thread blocked inside serve_forever
+                threading.Thread(target=self.stop, daemon=True,
+                                 name="pdtpu-serving-drain").start()
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                signal.signal(sig, _on_term)
+        self.engine.start()
+        try:
+            if self._thread is not None:
+                # start() already owns an accept loop; a SECOND
+                # serve_forever on the same socket would survive shutdown()
+                # (the first loop's exit resets the shutdown flag) — block
+                # until drain instead
+                self._stopped_event.wait()
+            else:
+                self._server.serve_forever(poll_interval=0.05)
+        finally:
+            # signal case: the drain thread owns stop() — wait for it so the
+            # process doesn't exit with the final snapshot half-written.
+            # Direct shutdown() callers get the same flush here.
+            self.stop()
+
+
+def serve(model_path: str, host: str = "127.0.0.1", port: int = 8000,
+          config: Optional[EngineConfig] = None,
+          final_metrics_path: Optional[str] = None) -> ServingServer:
+    """Load an exported model (inference.export_model artifacts) and return
+    a ready-to-start ServingServer."""
+    from ..inference import load_predictor
+    predictor = load_predictor(model_path)
+    engine = BatchingEngine.from_predictor(predictor, config=config)
+    return ServingServer(engine, host=host, port=port,
+                         final_metrics_path=final_metrics_path)
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True,
+                    help="export_model artifact prefix")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--max-batch-size", type=int, default=8)
+    ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    ap.add_argument("--max-queue-depth", type=int, default=256)
+    ap.add_argument("--final-metrics", default=None)
+    args = ap.parse_args(argv)
+    server = serve(args.model, host=args.host, port=args.port,
+                   config=EngineConfig(max_batch_size=args.max_batch_size,
+                                       max_wait_ms=args.max_wait_ms,
+                                       max_queue_depth=args.max_queue_depth),
+                   final_metrics_path=args.final_metrics)
+    print(f"serving {args.model} on {server.host}:{server.port}",
+          file=sys.stderr)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

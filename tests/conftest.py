@@ -69,7 +69,10 @@ def pytest_configure(config):
         "markers", "fault_matrix: end-to-end fault-injection recovery "
         "scenarios (subprocess-based); run standalone via "
         "tools/check_fault_matrix.py, and in tier-1 as part of "
-        "tests/test_resilient.py")
+        "tests/test_resilient.py and tests/test_serving.py")
+    config.addinivalue_line(
+        "markers", "serving: online-serving runtime tests (batching engine, "
+        "HTTP front end, drain); select with -m serving")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -77,3 +80,5 @@ def pytest_collection_modifyitems(config, items):
         mod = item.module.__name__ if item.module else ""
         if mod in _SLOW_MODULES:
             item.add_marker(pytest.mark.slow)
+        if mod == "test_serving":
+            item.add_marker(pytest.mark.serving)

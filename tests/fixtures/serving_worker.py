@@ -1,0 +1,64 @@
+"""Subprocess fixture for tests/test_serving.py: runs a ServingServer on an
+ephemeral port with a numpy-only predict fn, so the parent test can drive
+live HTTP traffic at it and deliver SIGTERM mid-flight to assert the
+graceful-drain contract (admissions stop, every accepted request answered,
+exit 0, final metrics reconcile with what the parent observed).
+
+    python serving_worker.py WORKDIR
+
+env knobs:
+    SERVE_DISPATCH_SLEEP_S  per-dispatch sleep (default 0.05) — widens the
+                            drain window so SIGTERM lands with work in flight
+    SERVE_MAX_BATCH         engine max_batch_size (default 4)
+    SERVE_MAX_WAIT_MS       engine max_wait_ms (default 10)
+
+Writes WORKDIR/port once the socket is bound (the parent polls for it) and
+WORKDIR/metrics_final.txt (Prometheus text) during drain. Exit 0 on a clean
+drain.
+"""
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from paddle_tpu import serving  # noqa: E402
+
+WORKDIR = sys.argv[1]
+DISPATCH_SLEEP_S = float(os.environ.get("SERVE_DISPATCH_SLEEP_S", "0.05"))
+MAX_BATCH = int(os.environ.get("SERVE_MAX_BATCH", "4"))
+MAX_WAIT_MS = float(os.environ.get("SERVE_MAX_WAIT_MS", "10"))
+
+# deterministic weights: the parent recomputes x @ W to verify responses
+W = np.random.RandomState(0).randn(3, 2).astype(np.float32)
+
+
+def predict(args):
+    time.sleep(DISPATCH_SLEEP_S)
+    return [np.asarray(args[0], np.float32) @ W]
+
+
+def main():
+    engine = serving.BatchingEngine(
+        predict, serving.EngineConfig(
+            max_batch_size=MAX_BATCH, max_wait_ms=MAX_WAIT_MS,
+            max_queue_depth=256))
+    server = serving.ServingServer(
+        engine, port=0,
+        final_metrics_path=os.path.join(WORKDIR, "metrics_final.txt"))
+    # the socket is bound (and server.port real) at construction, so the
+    # handshake file can be written before the serve loop starts; written
+    # atomically so the parent never reads a half-written file
+    tmp = os.path.join(WORKDIR, "port.tmp")
+    with open(tmp, "w") as f:
+        f.write(str(server.port))
+    os.replace(tmp, os.path.join(WORKDIR, "port"))
+    server.serve_forever()  # installs SIGTERM/SIGINT drain handlers
+
+
+if __name__ == "__main__":
+    main()

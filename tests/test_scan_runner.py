@@ -218,6 +218,30 @@ def test_prefetcher_propagates_producer_error():
         next(it)
 
 
+def test_prefetcher_context_manager_drains_on_consumer_error():
+    """ISSUE 3 satellite: a consumer that raises mid-epoch must not leak the
+    producer thread or the staged (in-flight device_put) chunks — the
+    context manager joins the thread and releases every pending chunk."""
+    import threading
+    from paddle_tpu.io import ChunkPrefetcher
+
+    staged = []
+    before = {t.ident for t in threading.enumerate()}
+    with pytest.raises(RuntimeError, match="consumer blew up"):
+        with ChunkPrefetcher(_batches(64), scan_steps=4, depth=2,
+                             put_fn=lambda s: staged.append(s) or s) as pf:
+            it = iter(pf)
+            next(it)
+            raise RuntimeError("consumer blew up")
+    assert staged, "producer never ran"
+    leaked = [t for t in threading.enumerate()
+              if t.ident not in before and t.name == "pdtpu-chunk-prefetch"]
+    assert not leaked, "producer thread leaked past close()"
+    assert pf._q.empty()          # staged chunks released, not pinned
+    assert list(pf) == []         # closed: iterates as exhausted
+    pf.close()                    # idempotent
+
+
 # ---- chunk-aware trainer run loop ----
 
 class _FakeScanStep:

@@ -74,3 +74,419 @@ def test_dynamic_batch_nothing_symbolized_falls_back_static(tmp_path):
     pred = inference.load_predictor(path)
     out = pred.run([np.ones((5, 4), np.float32)])[0]  # chunked static serve
     assert out.shape == (5, 2)
+
+
+# ---- batching engine (ISSUE 3 tentpole): deterministic sim harness ----
+#
+# Every engine test runs the PRODUCTION scheduler (BatchingEngine.pump)
+# under a SimClock — scripted instants, no sleeps, no thread flake.
+
+def _engine(fn, **cfg):
+    from paddle_tpu import serving
+    clock = serving.SimClock()
+    eng = serving.BatchingEngine(
+        fn, serving.EngineConfig(**cfg), clock=clock)
+    return eng, clock
+
+
+def test_engine_coalesces_burst_into_batched_dispatches():
+    """The acceptance bar: 64 arrivals at max_batch_size=8 coalesce into
+    <= 9 dispatches (64/8 full batches + at most one max_wait flush), and
+    every request still gets ITS OWN rows back."""
+    import numpy as np
+    from paddle_tpu import serving
+
+    calls = []
+
+    def fn(args):
+        calls.append(args[0].shape[0])
+        return [args[0] * 2.0]
+
+    eng, _clock = _engine(fn, max_batch_size=8, max_wait_ms=10.0)
+    mk = lambda i: [np.full((1, 4), float(i), np.float32)]  # noqa: E731
+    trace = serving.poisson_trace(64, rate_hz=2000.0, make_inputs=mk, seed=0)
+    report = serving.replay(eng, trace)
+
+    assert report.outcomes == ["completed"] * 64
+    assert report.dispatches <= 9, report.dispatches
+    assert len(calls) == report.dispatches
+    assert report.metrics["dispatches"] == report.dispatches
+    for i, res in enumerate(report.results):
+        np.testing.assert_allclose(res[0], np.full((1, 4), 2.0 * i))
+
+
+def test_engine_flushes_partial_batch_on_max_wait():
+    """A lone request must not wait for a full batch: the max_wait_ms timer
+    flushes it — at the exact flush instant, on the SimClock."""
+    import numpy as np
+    from paddle_tpu import serving
+
+    eng, clock = _engine(lambda a: [a[0] + 1.0],
+                         max_batch_size=8, max_wait_ms=5.0)
+    fut = eng.submit([np.zeros((1, 2), np.float32)])
+    assert eng.pump() == 0          # not due yet: 1 row, no time passed
+    clock.advance(0.005)            # exactly max_wait_ms
+    assert eng.pump() == 1
+    np.testing.assert_allclose(fut.result(timeout=0)[0], np.ones((1, 2)))
+    eng.stop()
+
+
+def test_engine_deadline_dropped_before_dispatch():
+    """An expired request is dropped at batch formation: its rows NEVER
+    reach predict_fn, and its future fails with DeadlineExceededError."""
+    import numpy as np
+    import pytest
+    from paddle_tpu import serving
+
+    seen_rows = []
+
+    def fn(args):
+        seen_rows.append(args[0][:, 0].tolist())
+        return [args[0]]
+
+    eng, clock = _engine(fn, max_batch_size=8, max_wait_ms=50.0)
+    doomed = eng.submit([np.full((1, 1), -1.0, np.float32)], deadline_ms=2.0)
+    clock.advance(0.003)            # past the deadline, before any flush
+    ok = eng.submit([np.full((1, 1), 7.0, np.float32)])
+    clock.advance(0.050)
+    eng.pump()
+    eng.stop()
+    with pytest.raises(serving.DeadlineExceededError):
+        doomed.result(timeout=0)
+    np.testing.assert_allclose(ok.result(timeout=0)[0], [[7.0]])
+    assert all(-1.0 not in rows for rows in seen_rows)  # never dispatched
+    assert eng.metrics.counters["expired"] == 1
+
+
+def test_engine_admission_fast_fails_when_queue_full():
+    import numpy as np
+    import pytest
+    from paddle_tpu import serving
+
+    eng, _clock = _engine(lambda a: [a[0]], max_batch_size=64,
+                          max_wait_ms=1000.0, max_queue_depth=2)
+    x = [np.zeros((1, 1), np.float32)]
+    eng.submit(x)
+    eng.submit(x)
+    with pytest.raises(serving.RejectedError):
+        eng.submit(x)
+    assert eng.metrics.counters["rejected"] == 1
+    assert eng.metrics.reject_reasons.get("queue_full") == 1
+    eng.stop()  # drains the two accepted requests
+    assert eng.metrics.counters["completed"] == 2
+    with pytest.raises(serving.RejectedError):  # stopped engine rejects
+        eng.submit(x)
+
+
+def test_engine_pow2_bucketing_static_vs_native_dynamic():
+    """Static exports get pow2-padded dispatch shapes (bounded executable
+    cache); a dynamic_batch engine dispatches the exact coalesced size."""
+    import numpy as np
+    from paddle_tpu import serving
+
+    for dynamic, expect in ((False, 8), (True, 5)):
+        shapes = []
+
+        def fn(args, _s=shapes):
+            _s.append(args[0].shape[0])
+            return [args[0] * 3.0]
+
+        clock = serving.SimClock()
+        eng = serving.BatchingEngine(
+            fn, serving.EngineConfig(max_batch_size=8, max_wait_ms=1.0),
+            clock=clock, dynamic_batch=dynamic)
+        futs = [eng.submit([np.full((1, 2), float(i), np.float32)])
+                for i in range(5)]
+        clock.advance(0.001)
+        eng.pump()
+        eng.stop()
+        assert shapes == [expect], (dynamic, shapes)
+        for i, f in enumerate(futs):  # padding never leaks into results
+            np.testing.assert_allclose(f.result(timeout=0)[0],
+                                       np.full((1, 2), 3.0 * i))
+
+
+def test_engine_from_predictor_static_and_dynamic(tmp_path):
+    """End-to-end over REAL export artifacts, both flavors: from_predictor
+    picks the bucketing mode from the export's dynamic_batch flag and the
+    coalesced results match the eager model exactly."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, nn, serving
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    x0 = np.ones((4, 4), np.float32)
+    rng = np.random.RandomState(1)
+    mk = lambda i: [rng.rand(1, 4).astype(np.float32)]  # noqa: E731
+
+    for name, dyn in (("static", False), ("dynamic", True)):
+        path = str(tmp_path / name)
+        inference.export_model(model, [x0], path, dynamic_batch=dyn)
+        pred = inference.load_predictor(path)
+        eng = serving.BatchingEngine.from_predictor(
+            pred, serving.EngineConfig(max_batch_size=8, max_wait_ms=2.0),
+            clock=serving.SimClock())
+        assert eng.dynamic_batch is dyn
+        trace = serving.uniform_trace(12, 0.0001, mk)
+        report = serving.replay(eng, trace)
+        assert report.outcomes == ["completed"] * 12
+        assert report.dispatches <= 3  # 12 rows / max_batch 8 -> 2-3
+        for a, res in zip(trace, report.results):
+            ref = model(paddle.to_tensor(a.inputs[0])).numpy()
+            np.testing.assert_allclose(res[0], np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_concurrent_explicit_feed_is_thread_safe(tmp_path):
+    """Two threads hammering ONE predictor with explicit feeds must each get
+    their own answers (run() computes from caller arrays, not the shared IO
+    handles) — the property the batching engine's dispatch path relies on."""
+    import threading
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, nn
+
+    paddle.seed(0)
+    model = nn.Linear(6, 2)
+    path = str(tmp_path / "mt")
+    inference.export_model(model, [np.ones((2, 6), np.float32)], path)
+    pred = inference.load_predictor(path)
+    rng = np.random.RandomState(0)
+    feeds = [rng.rand(2, 6).astype(np.float32) for _ in range(40)]
+    refs = [np.asarray(model(paddle.to_tensor(f)).numpy()) for f in feeds]
+    errs = []
+
+    def worker(idx):
+        try:
+            for i in range(idx, len(feeds), 2):
+                (out,) = pred.run([feeds[i]])
+                np.testing.assert_allclose(out, refs[i], rtol=1e-5,
+                                           atol=1e-5)
+        except Exception as e:  # pragma: no cover - failure path
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    [t.start() for t in ts]
+    [t.join(timeout=60) for t in ts]
+    assert not errs, errs
+
+
+# ---- serving metrics ----
+
+def test_metrics_render_parse_roundtrip():
+    from paddle_tpu import serving
+
+    m = serving.ServingMetrics()
+    m.on_submit(1)
+    m.on_complete(4.0)
+    m.on_reject("queue_full")
+    m.on_dispatch(rows=6, n_requests=3, padded_rows=8, dispatch_ms=2.0,
+                  queue_depth=0)
+    flat = serving.parse_exposition(m.render())
+    assert flat['pdtpu_serving_requests_total{outcome="submitted"}'] == 1
+    assert flat['pdtpu_serving_requests_total{outcome="completed"}'] == 1
+    assert flat['pdtpu_serving_requests_total{outcome="rejected"}'] == 1
+    assert flat["pdtpu_serving_dispatches_total"] == 1
+    assert flat['pdtpu_serving_batch_rows_bucket{le="8"}'] == 1
+    assert flat["pdtpu_serving_batch_rows_sum"] == 6
+    snap = m.snapshot()
+    assert snap["mean_batch_rows"] == 6.0
+    assert snap["p50_ms"] == 4.0
+
+
+# ---- HTTP front end (in-process) ----
+
+def test_serving_server_endpoints_and_hardening():
+    """/predict round-trips through the engine; /healthz and /metrics
+    report; a malformed POST (no Content-Length) gets 411 — the shared
+    fleet read_request_body hardening — and the server survives it."""
+    import json
+    import socket
+    import urllib.error
+    import urllib.request
+    import numpy as np
+    from paddle_tpu import serving
+
+    W = np.arange(6, dtype=np.float32).reshape(3, 2)
+    eng = serving.BatchingEngine(
+        lambda a: [a[0] @ W],
+        serving.EngineConfig(max_batch_size=4, max_wait_ms=2.0))
+    srv = serving.ServingServer(eng, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def post(obj):
+        req = urllib.request.Request(
+            base + "/predict", data=json.dumps(obj).encode(), method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        code, out = post({"inputs": [[[1.0, 2.0, 3.0]]]})
+        assert code == 200
+        np.testing.assert_allclose(
+            out["outputs"][0], (np.array([[1.0, 2.0, 3.0]]) @ W).tolist())
+
+        code, out = post({"wrong_key": 1})
+        assert code == 400 and "inputs" in out["error"]
+
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            flat = serving.parse_exposition(r.read().decode())
+        assert flat['pdtpu_serving_requests_total{outcome="completed"}'] == 1
+
+        # malformed client: POST with no Content-Length -> 411, not a 500
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        s.sendall(b"POST /predict HTTP/1.1\r\nHost: x\r\n\r\n")
+        status = s.recv(200).decode().splitlines()[0]
+        s.close()
+        assert "411" in status, status
+
+        code, _ = post({"inputs": [[[0.0, 0.0, 1.0]]]})  # still serving
+        assert code == 200
+    finally:
+        srv.stop()
+        srv.stop()  # idempotent, same contract as KVServer.stop
+
+
+def test_kv_server_put_hardening_and_idempotent_stop():
+    """Satellite: the fleet KV server itself survives a malformed PUT
+    (missing / garbage Content-Length) and double-stop."""
+    import socket
+    from paddle_tpu.distributed.fleet.utils import http_server
+
+    kv = http_server.KVServer(0)
+    kv.start()
+    port = kv._server.server_address[1]
+
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    s.sendall(b"PUT /k HTTP/1.1\r\nHost: x\r\n\r\n")       # no length
+    assert "411" in s.recv(200).decode().splitlines()[0]
+    s.close()
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    s.sendall(b"PUT /k HTTP/1.1\r\nHost: x\r\n"
+              b"Content-Length: banana\r\n\r\n")           # garbage length
+    assert "400" in s.recv(200).decode().splitlines()[0]
+    s.close()
+
+    client = http_server.KVClient(f"127.0.0.1:{port}")
+    assert client.put("/k", "v") and client.get("/k") == "v"  # still alive
+    kv.stop()
+    kv.stop()  # must not raise on the closed socket
+
+
+# ---- graceful drain (the fault-matrix scenario) ----
+
+import os     # noqa: E402
+import signal  # noqa: E402
+import subprocess  # noqa: E402
+import sys    # noqa: E402
+import time   # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+
+
+def _start_serving_worker(workdir, env_extra=None):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(FIXTURES, "serving_worker.py"),
+         str(workdir)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    port_file = os.path.join(str(workdir), "port")
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if os.path.exists(port_file):
+            return proc, int(open(port_file).read())
+        if proc.poll() is not None:
+            break
+        time.sleep(0.05)
+    proc.kill()
+    _, err = proc.communicate(timeout=30)
+    raise AssertionError(f"serving worker never bound a port: {err[-3000:]}")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.mark.fault_matrix
+def test_sigterm_drains_accepted_requests_and_exits_zero(tmp_path):
+    """Drain contract (docs/serving.md, mirroring the ResilientTrainer
+    preemption matrix): SIGTERM mid-traffic → admissions stop (late
+    requests get 503 or connection-refused), every ACCEPTED request still
+    gets its answer, the process exits 0, and the final metrics snapshot
+    reconciles exactly with what the clients observed."""
+    import json
+    import threading
+    import urllib.error
+    import urllib.request
+    import numpy as np
+    from paddle_tpu import serving
+
+    proc, port = _start_serving_worker(
+        tmp_path, {"SERVE_DISPATCH_SLEEP_S": "0.05", "SERVE_MAX_BATCH": "4"})
+    base = f"http://127.0.0.1:{port}"
+    W = np.random.RandomState(0).randn(3, 2).astype(np.float32)
+
+    lock = threading.Lock()
+    oks, rejected, conn_failed = [], [], []
+
+    def client(tid):
+        rng = np.random.RandomState(tid)
+        t_end = time.time() + 20
+        while time.time() < t_end:
+            x = rng.rand(1, 3).astype(np.float32)
+            req = urllib.request.Request(
+                base + "/predict",
+                data=json.dumps({"inputs": [x.tolist()]}).encode(),
+                method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    out = json.loads(r.read())["outputs"][0]
+                np.testing.assert_allclose(out, (x @ W).tolist(),
+                                           rtol=1e-5, atol=1e-5)
+                with lock:
+                    oks.append(tid)
+            except urllib.error.HTTPError as e:
+                assert e.code == 503, e.code  # draining fast-fail only
+                with lock:
+                    rejected.append(tid)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                with lock:  # accept loop closed: request never admitted
+                    conn_failed.append(tid)
+                return
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+    [t.start() for t in threads]
+    deadline = time.time() + 30
+    while time.time() < deadline:  # let real traffic build up first
+        with lock:
+            if len(oks) >= 8:
+                break
+        time.sleep(0.02)
+    proc.send_signal(signal.SIGTERM)   # lands with requests in flight
+    _, err = proc.communicate(timeout=60)
+    [t.join(timeout=60) for t in threads]
+
+    assert proc.returncode == 0, err[-3000:]   # graceful drain, not a crash
+    assert len(oks) >= 8
+    metrics_path = tmp_path / "metrics_final.txt"
+    assert metrics_path.exists(), "drain must write the final snapshot"
+    flat = serving.parse_exposition(metrics_path.read_text())
+    # every client-observed 200 is a completed request and vice versa: no
+    # accepted request was dropped, no response was fabricated
+    assert flat['pdtpu_serving_requests_total{outcome="completed"}'] == \
+        len(oks)
+    assert flat['pdtpu_serving_requests_total{outcome="rejected"}'] == \
+        len(rejected)
+    assert flat['pdtpu_serving_requests_total{outcome="submitted"}'] == \
+        len(oks)  # accepted == answered; nothing pending at exit
+    assert flat["pdtpu_serving_queue_depth"] == 0

@@ -134,3 +134,65 @@ def test_chunked_row_gates_against_base_floor(tmp_path, capsys):
     th2 = _write(tmp_path, "th2.json", {
         "gpt3-125m": {"mfu": 0.32}, "gpt3-125m-chunked": {"mfu": 0.05}})
     assert gate.main(["--new", slow, "--thresholds", th2, "--strict"]) == 0
+
+
+# ---- serving rows (ISSUE 3): direction-aware keys ----
+
+def _serve_row(qps, p99, backend="tpu"):
+    return {"metric": "req/sec serve-mlp maxb16 wait2.0ms poisson3000",
+            "value": qps, "extra": {"serve_qps": qps, "serve_p99_ms": p99,
+                                    "backend": backend}}
+
+
+def test_serve_qps_gates_as_floor(tmp_path, capsys):
+    th = _write(tmp_path, "th.json",
+                {"serve-mlp": {"serve_qps": 2000.0}})
+    ok = _write(tmp_path, "ok.json", [_serve_row(1950.0, 3.0)])
+    assert gate.main(["--new", ok, "--thresholds", th,
+                      "--max-regress", "0.05"]) == 0  # within 5%
+    bad = _write(tmp_path, "bad.json", [_serve_row(1500.0, 3.0)])
+    assert gate.main(["--new", bad, "--thresholds", th,
+                      "--max-regress", "0.05"]) == 2
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_serve_p99_gates_as_ceiling(tmp_path, capsys):
+    """serve_p99_ms pins a CEILING: tail latency growing past it fails even
+    while throughput holds."""
+    th = _write(tmp_path, "th.json",
+                {"serve-mlp": {"serve_qps": 2000.0, "serve_p99_ms": 3.0}})
+    ok = _write(tmp_path, "ok.json", [_serve_row(2100.0, 3.1)])
+    assert gate.main(["--new", ok, "--thresholds", th,
+                      "--max-regress", "0.05"]) == 0  # 3.1 <= 3.0 * 1.05
+    bad = _write(tmp_path, "bad.json", [_serve_row(2100.0, 4.5)])
+    assert gate.main(["--new", bad, "--thresholds", th,
+                      "--max-regress", "0.05"]) == 2
+    assert "serve_p99_ms" in capsys.readouterr().out
+
+
+def test_update_tightens_serving_keys_favorably_only(tmp_path):
+    """--update raises the qps floor and LOWERS the p99 ceiling; it never
+    loosens either direction."""
+    th = _write(tmp_path, "th.json",
+                {"serve-mlp": {"serve_qps": 2000.0, "serve_p99_ms": 3.0}})
+    worse = _write(tmp_path, "worse.json", [_serve_row(1800.0, 4.0)])
+    gate.main(["--new", worse, "--thresholds", th, "--update"])
+    pinned = json.load(open(th))["serve-mlp"]
+    assert pinned == {"serve_qps": 2000.0, "serve_p99_ms": 3.0}  # unchanged
+    better = _write(tmp_path, "better.json", [_serve_row(2400.0, 2.2)])
+    gate.main(["--new", better, "--thresholds", th, "--update"])
+    pinned = json.load(open(th))["serve-mlp"]
+    assert pinned == {"serve_qps": 2400.0, "serve_p99_ms": 2.2}
+
+
+def test_mixed_train_and_serve_rows_gate_independently(tmp_path):
+    th = _write(tmp_path, "th.json", {
+        "gpt3-125m": {"mfu": 0.32},
+        "serve-mlp": {"serve_qps": 2000.0, "serve_p99_ms": 3.0}})
+    new = _write(tmp_path, "new.json",
+                 [_row("gpt3-125m", 0.33), _serve_row(2100.0, 2.8)])
+    assert gate.main(["--new", new, "--thresholds", th, "--strict"]) == 0
+    # the serving row regressing must fail even with training green
+    new2 = _write(tmp_path, "new2.json",
+                  [_row("gpt3-125m", 0.33), _serve_row(900.0, 2.8)])
+    assert gate.main(["--new", new2, "--thresholds", th]) == 2

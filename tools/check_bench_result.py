@@ -5,6 +5,11 @@ BENCH_MEASURED.json style) are checked against pinned per-preset floors in
 tools/bench_thresholds.json; an MFU drop beyond --max-regress fails the gate
 (exit 2) instead of relying on judge-side JSON diffing.
 
+Serving rows (`bench.py --serve`, ISSUE 3) gate through the same floors
+file with direction-aware keys: `serve_qps` is a floor (throughput must not
+drop) and `serve_p99_ms` is a CEILING (tail latency must not grow) —
+`--update` only ever tightens in the favorable direction for each.
+
     python tools/check_bench_result.py                 # gate current sweep
     python tools/check_bench_result.py --update        # raise floors to best
     python tools/check_bench_result.py --new f.json --max-regress 0.05
@@ -45,10 +50,28 @@ def _preset_of(row):
     return row.get("tag")
 
 
-def _mfu(row):
+# gate-able metric keys and which direction is "better": a "higher" key
+# pins a floor (regression = measured below it), a "lower" key pins a
+# ceiling (regression = measured above it)
+GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower"}
+
+
+def _metrics_of(row):
+    """Every gate-able metric a row carries: {key: value}."""
     extra = row.get("extra") or {}
+    out = {}
     v = extra.get("mfu", row.get("mfu_6nd"))
-    return float(v) if v is not None else None
+    if v is not None:
+        out["mfu"] = float(v)
+    for k in ("serve_qps", "serve_p99_ms"):
+        if extra.get(k) is not None:
+            out[k] = float(extra[k])
+    return out
+
+
+def _better(key, a, b):
+    """True when measured value `a` beats `b` for this key's direction."""
+    return a > b if GATE_KEYS[key] == "higher" else a < b
 
 
 def _is_chip_row(row):
@@ -73,14 +96,19 @@ def _tag_aliases():
 
 
 def best_by_preset(rows):
+    """{preset: {key: best value}} — best per key in its own direction."""
     best = {}
     for r in rows:
         if not _is_chip_row(r):
             continue
-        p, m = _preset_of(r), _mfu(r)
-        if p and m is not None and m > best.get(p, -1.0):
-            best[p] = m
-    return best
+        p = _preset_of(r)
+        if not p:
+            continue
+        for k, v in _metrics_of(r).items():
+            cur = best.setdefault(p, {})
+            if k not in cur or _better(k, v, cur[k]):
+                cur[k] = v
+    return {p: vals for p, vals in best.items() if vals}
 
 
 def main(argv=None):
@@ -104,9 +132,11 @@ def main(argv=None):
 
     measured = best_by_preset(_rows(args.new))
     if args.update:
-        for p, m in measured.items():
-            if m > floors.get(p, {}).get("mfu", -1.0):
-                floors.setdefault(p, {})["mfu"] = round(m, 4)
+        for p, vals in measured.items():
+            for k, v in vals.items():
+                cur = floors.get(p, {}).get(k)
+                if cur is None or _better(k, v, cur):
+                    floors.setdefault(p, {})[k] = round(v, 4)
         with open(args.thresholds, "w") as f:
             json.dump(floors, f, indent=1, sort_keys=True)
         print(f"updated {args.thresholds}: {floors}")
@@ -124,14 +154,30 @@ def main(argv=None):
 
     failures = []
     unmapped = []
-    for p, m in sorted(measured.items()):
-        floor = floors.get(p, {}).get("mfu")
-        if floor is None and p.endswith("-chunked"):
-            # scan fusion must never be slower than the eager floor: a
-            # chunked row without its own pinned floor gates against the
-            # base preset's (keeps --strict meaningful for fused runs)
-            floor = floors.get(p[: -len("-chunked")], {}).get("mfu")
-        if floor is None:
+    for p, vals in sorted(measured.items()):
+        gated_any = False
+        for k, m in sorted(vals.items()):
+            floor = floors.get(p, {}).get(k)
+            if floor is None and k == "mfu" and p.endswith("-chunked"):
+                # scan fusion must never be slower than the eager floor: a
+                # chunked row without its own pinned floor gates against the
+                # base preset's (keeps --strict meaningful for fused runs)
+                floor = floors.get(p[: -len("-chunked")], {}).get("mfu")
+            if floor is None:
+                continue
+            gated_any = True
+            if GATE_KEYS[k] == "higher":
+                limit = floor * (1.0 - args.max_regress)
+                ok = m >= limit
+            else:  # ceiling key (serve_p99_ms): growing past it regresses
+                limit = floor * (1.0 + args.max_regress)
+                ok = m <= limit
+            verdict = "OK" if ok else "REGRESSION"
+            print(f"  {p:28s} {k} {m:.4f}  pinned {floor:.4f} "
+                  f"(limit {limit:.4f})  {verdict}")
+            if not ok:
+                failures.append((p, k, m, floor))
+        if not gated_any:
             if floors:
                 # a row that matches no pinned floor silently weakens the
                 # gate — shout, so a renamed metric/tag can't make the
@@ -143,18 +189,14 @@ def main(argv=None):
                       "gate — fix the tag mapping or pin a floor",
                       file=sys.stderr)
             else:
-                print(f"  {p:28s} mfu {m:.4f}  (no pinned floor - pass)")
-            continue
-        limit = floor * (1.0 - args.max_regress)
-        verdict = "OK" if m >= limit else "REGRESSION"
-        print(f"  {p:28s} mfu {m:.4f}  floor {floor:.4f} "
-              f"(limit {limit:.4f})  {verdict}")
-        if m < limit:
-            failures.append((p, m, floor))
+                stats = " ".join(f"{k} {m:.4f}" for k, m in sorted(
+                    vals.items()))
+                print(f"  {p:28s} {stats}  (no pinned floor - pass)")
     if failures:
-        print(f"FAILED: {len(failures)} preset(s) regressed beyond "
+        print(f"FAILED: {len(failures)} metric(s) regressed beyond "
               f"{args.max_regress:.0%}:",
-              ", ".join(f"{p} {m:.4f}<{f0:.4f}" for p, m, f0 in failures))
+              ", ".join(f"{p}.{k} {m:.4f} vs {f0:.4f}"
+                        for p, k, m, f0 in failures))
         return 2
     if unmapped and args.strict:
         print(f"FAILED (--strict): {len(unmapped)} measured key(s) gate "

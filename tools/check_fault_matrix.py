@@ -1,16 +1,19 @@
 #!/usr/bin/env python
-"""Fault-injection recovery matrix (ISSUE 1 CI gate).
+"""Fault-injection recovery matrix (ISSUE 1 CI gate, + ISSUE 3 drain).
 
-Runs every `fault_matrix`-marked scenario in tests/test_resilient.py —
-each one drives a real subprocess through an injected fault (SIGKILL
-mid-checkpoint, SIGTERM preemption, NaN loss) and asserts the recovery
-contract documented in docs/fault_tolerance.md — then prints a pass/fail
-table. Exit 0 iff every scenario recovered.
+Runs every `fault_matrix`-marked scenario — each one drives a real
+subprocess through an injected fault and asserts the recovery contract:
+the training scenarios in tests/test_resilient.py (SIGKILL
+mid-checkpoint, SIGTERM preemption, NaN loss; docs/fault_tolerance.md)
+and the serving graceful-drain scenario in tests/test_serving.py
+(SIGTERM to a live server: admissions stop, every accepted request is
+answered, exit 0; docs/serving.md) — then prints a pass/fail table.
+Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
     python tools/check_fault_matrix.py --list     # show scenarios only
 
-tier-1 already picks these up (test_resilient.py is not slow-marked);
+tier-1 already picks these up (neither test file is slow-marked);
 this tool is the human/CI-facing view of the same matrix.
 """
 from __future__ import annotations
@@ -23,12 +26,15 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 MARKER = "fault_matrix"
-TEST_FILE = os.path.join("tests", "test_resilient.py")
+TEST_FILES = [
+    os.path.join("tests", "test_resilient.py"),
+    os.path.join("tests", "test_serving.py"),
+]
 
 
 def list_scenarios():
     r = subprocess.run(
-        [sys.executable, "-m", "pytest", TEST_FILE, "-m", MARKER,
+        [sys.executable, "-m", "pytest", *TEST_FILES, "-m", MARKER,
          "--collect-only", "-q", "-p", "no:cacheprovider"],
         cwd=REPO, capture_output=True, text=True)
     return [ln.strip() for ln in r.stdout.splitlines()
