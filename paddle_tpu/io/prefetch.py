@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+import time
+import warnings
 from typing import Callable, Iterable, Optional
 
 import numpy as np
@@ -67,17 +69,28 @@ class ChunkPrefetcher:
     A trailing partial chunk (< K batches) is DROPPED — a lax.scan chunk has
     a static trip count; `dropped_steps` records how many batches fell off
     so callers can account for them (no silent truncation).
+
+    stall_timeout_s: if the consumer takes nothing for this long while the
+    queue is full (iteration abandoned without close() and no context
+    manager), the producer gives up and exits instead of busy-polling
+    forever with staged device buffers pinned. Raise it when a single
+    chunk's device compute can legitimately exceed the default.
     """
 
     def __init__(self, source: Iterable, scan_steps: int,
-                 put_fn: Optional[Callable] = None, depth: int = 2):
+                 put_fn: Optional[Callable] = None, depth: int = 2,
+                 stall_timeout_s: float = 60.0):
         if scan_steps < 1:
             raise ValueError(f"scan_steps must be >= 1, got {scan_steps}")
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
+        if stall_timeout_s <= 0:
+            raise ValueError(
+                f"stall_timeout_s must be > 0, got {stall_timeout_s}")
         self.source = source
         self.scan_steps = int(scan_steps)
         self.depth = int(depth)
+        self.stall_timeout_s = float(stall_timeout_s)
         if put_fn is None:
             import jax
             put_fn = lambda stacked: tuple(jax.device_put(a)  # noqa: E731
@@ -103,38 +116,43 @@ class ChunkPrefetcher:
                     continue
                 dev = self.put_fn(_stack(pending))  # starts the async H2D
                 pending = []
-                while not self._stop.is_set():
-                    try:  # bounded put, but wake up if the consumer left
-                        self._q.put(dev, timeout=0.1)
-                        break
-                    except _queue.Full:
-                        continue
-                if self._stop.is_set():
+                if not self._bounded_put(dev):
                     return
                 self.chunks_produced += 1
             self.dropped_steps = len(pending)
             if pending:
-                import warnings
                 warnings.warn(
                     f"ChunkPrefetcher dropped a trailing partial chunk of "
                     f"{len(pending)} step(s) (< scan_steps="
                     f"{self.scan_steps})", stacklevel=2)
         except BaseException as e:  # propagate into the consumer
-            self._put_ctrl(_Err(e))
+            self._bounded_put(_Err(e))
             return
-        self._put_ctrl(_Done())
+        self._bounded_put(_Done())
 
-    def _put_ctrl(self, item):
-        """Control-message put that never wedges the producer: a consumer
-        that closed mid-epoch leaves the bounded queue full, and a plain
-        blocking put would park this thread forever (close() could then
-        never join it)."""
+    def _bounded_put(self, item) -> bool:
+        """Queue put that can never wedge the producer. Wakes every 100ms so
+        close() can join promptly, and — for a consumer that abandoned
+        iteration without close() (no context manager) — gives up after
+        `stall_timeout_s` of continuous queue-full, dropping the item and
+        stopping production so staged device buffers aren't pinned for the
+        process lifetime. Returns True iff the item was enqueued."""
+        deadline = time.monotonic() + self.stall_timeout_s
         while not self._stop.is_set():
             try:
                 self._q.put(item, timeout=0.1)
-                return
+                return True
             except _queue.Full:
-                continue
+                if time.monotonic() >= deadline:
+                    self._stop.set()  # before warn(): filters may raise
+                    warnings.warn(
+                        f"ChunkPrefetcher consumer took nothing for "
+                        f"{self.stall_timeout_s:.0f}s with a full queue; "
+                        "assuming iteration was abandoned without close() — "
+                        "stopping the producer and dropping staged chunks",
+                        stacklevel=2)
+                    return False
+        return False
 
     # ---- consumer ----
     def __iter__(self):

@@ -30,6 +30,7 @@ sources — the unit tests exercise exactly the production scheduler.
 """
 from __future__ import annotations
 
+import logging
 import threading
 from collections import deque
 from concurrent.futures import Future
@@ -40,6 +41,8 @@ import numpy as np
 
 from .clock import Clock, MonotonicClock, SimClock
 from .metrics import ServingMetrics
+
+_log = logging.getLogger("paddle_tpu.serving")
 
 
 class RejectedError(RuntimeError):
@@ -56,6 +59,9 @@ class EngineConfig:
     max_batch_size: int = 8        # flush when coalesced rows reach this
     max_wait_ms: float = 5.0       # ...or the oldest request waited this long
     max_queue_depth: int = 256     # pending-request cap (admission control)
+    max_request_rows: Optional[int] = None  # per-request row cap (None: an
+    #                                         oversized request dispatches
+    #                                         alone, pow2-padded)
     default_deadline_ms: Optional[float] = None  # per-request override wins
     bucket_pow2: Optional[bool] = None  # None: True for static exports /
     #                                     plain callables, False for
@@ -72,6 +78,10 @@ class EngineConfig:
         if self.max_queue_depth < 1:
             raise ValueError(
                 f"max_queue_depth must be >= 1, got {self.max_queue_depth}")
+        if self.max_request_rows is not None and self.max_request_rows < 1:
+            raise ValueError(
+                f"max_request_rows must be >= 1, got "
+                f"{self.max_request_rows}")
 
 
 class _Request:
@@ -90,6 +100,18 @@ def _next_pow2(n: int) -> int:
     while p < n:
         p *= 2
     return p
+
+
+def _coalescable(head: "_Request", r: "_Request") -> bool:
+    """Two requests may share a dispatch only when their inputs concatenate
+    cleanly AND mean the same thing to the executable: same input count,
+    same trailing shapes, same dtypes. Independent HTTP clients owe each
+    other nothing — without this check one client's odd shapes would poison
+    a stranger's batch."""
+    if len(head.inputs) != len(r.inputs):
+        return False
+    return all(a.shape[1:] == b.shape[1:] and a.dtype == b.dtype
+               for a, b in zip(head.inputs, r.inputs))
 
 
 class BatchingEngine:
@@ -174,13 +196,31 @@ class BatchingEngine:
             self._cond.notify_all()
             thread = self._thread
         if thread is not None:
-            thread.join(timeout if timeout is not None
-                        else self.config.drain_timeout_s)
+            join_s = (timeout if timeout is not None
+                      else self.config.drain_timeout_s)
+            thread.join(join_s)
+            if thread.is_alive():
+                _log.warning(
+                    "serving drain did not complete within %.1fs; failing "
+                    "requests still queued", join_s)
         else:
             # threadless (sim) mode: flush inline — draining makes every
             # pending batch due
             self.pump()
         with self._cond:
+            # a timed-out (or dead) scheduler leaves accepted requests
+            # queued forever — fail them now so waiting callers get a
+            # definite answer instead of blocking until their own future
+            # timeouts (after a clean drain this deque is already empty)
+            stranded = 0
+            while self._pending:
+                req = self._pending.popleft()
+                req.future.set_exception(RejectedError(
+                    "engine drain timed out before dispatch"))
+                self.metrics.on_reject("drain_timeout")
+                stranded += 1
+            if stranded:
+                self.metrics.set_queue_depth(0)
             self._stopped = True
             self._cond.notify_all()
 
@@ -214,6 +254,12 @@ class BatchingEngine:
                     f"all request inputs must share the leading batch dim "
                     f"({rows}); got shapes "
                     f"{[tuple(x.shape) for x in arrays]}")
+        if (self.config.max_request_rows is not None
+                and rows > self.config.max_request_rows):
+            self.metrics.on_reject("too_many_rows")
+            raise RejectedError(
+                f"request rows ({rows}) exceed max_request_rows "
+                f"({self.config.max_request_rows})")
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
         now = self.clock.now()
@@ -286,8 +332,9 @@ class BatchingEngine:
             batch, rows = [], 0
             while self._pending:
                 r = self._pending[0]
-                if batch and rows + r.rows > self.config.max_batch_size:
-                    break
+                if batch and (rows + r.rows > self.config.max_batch_size
+                              or not _coalescable(batch[0], r)):
+                    break       # incompatible request starts its own batch
                 batch.append(self._pending.popleft())
                 rows += r.rows
             self.metrics.set_queue_depth(len(self._pending))
@@ -315,20 +362,29 @@ class BatchingEngine:
     # ---- dispatch ----
     def _dispatch(self, batch: List[_Request]):
         t0 = self.clock.now()
-        rows = [r.rows for r in batch]
-        total = sum(rows)
-        n_inputs = len(batch[0].inputs)
-        args = [np.concatenate([r.inputs[i] for r in batch], axis=0)
-                for i in range(n_inputs)]
+        total = sum(r.rows for r in batch)
         padded = total
-        if self._bucket and total > 1:
-            padded = min(_next_pow2(total),
-                         max(self.config.max_batch_size, total))
-            if padded > total:
-                args = [np.concatenate(
-                    [a, np.zeros((padded - total,) + a.shape[1:], a.dtype)],
-                    axis=0) for a in args]
+        # batch assembly sits INSIDE the try: an exception anywhere between
+        # here and predict_fn must fail this batch's futures, never escape
+        # into (and kill) the scheduler thread
         try:
+            n_inputs = len(batch[0].inputs)
+            args = [np.concatenate([r.inputs[i] for r in batch], axis=0)
+                    for i in range(n_inputs)]
+            if self._bucket:
+                if total <= self.config.max_batch_size:
+                    padded = min(_next_pow2(total),
+                                 self.config.max_batch_size)
+                else:
+                    # a single request larger than max_batch_size still
+                    # dispatches on a pow2 shape, keeping the number of
+                    # distinct compiled shapes logarithmic
+                    padded = _next_pow2(total)
+                if padded > total:
+                    args = [np.concatenate(
+                        [a,
+                         np.zeros((padded - total,) + a.shape[1:], a.dtype)],
+                        axis=0) for a in args]
             outs = list(self.predict_fn(args))
         except Exception as e:
             for r in batch:
@@ -382,4 +438,11 @@ class BatchingEngine:
                         self.clock.wait(self._cond, max(0.0, wake - now))
                     else:
                         self.clock.wait(self._cond, None)
-            self.pump()
+            try:
+                self.pump()
+            except Exception:
+                # _dispatch already routes per-batch errors to the batch's
+                # futures; anything escaping pump() is a scheduler bug. Log
+                # and keep scheduling — a dead scheduler would wedge every
+                # queued and future request until their own timeouts.
+                _log.exception("serving scheduler pump failed; continuing")

@@ -23,6 +23,7 @@ served totals against the replayed trace.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import signal
 import sys
@@ -182,13 +183,22 @@ class ServingServer:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._active_lock:
-                if self._active == 0:
-                    # brief double-check window for a just-accepted socket
-                    time.sleep(0.05)
+                settled = self._active == 0
+            if settled:
+                # brief double-check window for a just-accepted socket
+                # whose handler hasn't registered itself yet
+                time.sleep(0.05)
+                with self._active_lock:
                     if self._active == 0:
                         return
-                    continue
+                continue
             time.sleep(0.01)
+        with self._active_lock:
+            still = self._active
+        logging.getLogger("paddle_tpu.serving").warning(
+            "drain settle window (%.1fs) expired with %d /predict "
+            "handler(s) still active; their clients may see a connection "
+            "reset", timeout, still)
 
     def serve_forever(self, install_signal_handlers: bool = True):
         """Foreground serve loop with the SIGTERM drain contract: returns
@@ -241,12 +251,15 @@ def main(argv=None):
     ap.add_argument("--max-batch-size", type=int, default=8)
     ap.add_argument("--max-wait-ms", type=float, default=5.0)
     ap.add_argument("--max-queue-depth", type=int, default=256)
+    ap.add_argument("--max-request-rows", type=int, default=None,
+                    help="reject single requests larger than this many rows")
     ap.add_argument("--final-metrics", default=None)
     args = ap.parse_args(argv)
     server = serve(args.model, host=args.host, port=args.port,
                    config=EngineConfig(max_batch_size=args.max_batch_size,
                                        max_wait_ms=args.max_wait_ms,
-                                       max_queue_depth=args.max_queue_depth),
+                                       max_queue_depth=args.max_queue_depth,
+                                       max_request_rows=args.max_request_rows),
                    final_metrics_path=args.final_metrics)
     print(f"serving {args.model} on {server.host}:{server.port}",
           file=sys.stderr)

@@ -242,6 +242,30 @@ def test_prefetcher_context_manager_drains_on_consumer_error():
     pf.close()                    # idempotent
 
 
+def test_prefetcher_abandoned_consumer_producer_gives_up():
+    """A consumer that walks away WITHOUT close() (no context manager) must
+    not leave the producer busy-polling a full queue forever: after
+    stall_timeout_s of no progress it drops the chunk and exits, releasing
+    the staged buffers for the rest of the process lifetime."""
+    import threading
+    import time
+    import warnings as _warnings
+    from paddle_tpu.io import ChunkPrefetcher
+
+    pf = ChunkPrefetcher(_batches(64), scan_steps=4, depth=1,
+                         put_fn=lambda s: s, stall_timeout_s=0.3)
+    it = iter(pf)
+    next(it)                      # producer running, queue refills to full
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore")   # the give-up warning fires on
+        deadline = time.monotonic() + 10.0  # the producer thread
+        while pf._thread.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.05)
+    assert not pf._thread.is_alive(), \
+        "producer still spinning after the consumer abandoned iteration"
+    pf.close()                    # still safe after the give-up
+
+
 # ---- chunk-aware trainer run loop ----
 
 class _FakeScanStep:

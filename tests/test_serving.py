@@ -206,6 +206,128 @@ def test_engine_pow2_bucketing_static_vs_native_dynamic():
                                        np.full((1, 2), 3.0 * i))
 
 
+def test_engine_incompatible_requests_never_share_a_dispatch():
+    """Independent clients posting different trailing shapes / dtypes /
+    input counts must not poison each other's batch (or kill the scheduler
+    with a failed cross-request concatenate): each incompatible request
+    forms its own batch and every future resolves."""
+    import numpy as np
+    from paddle_tpu import serving
+
+    shapes = []
+
+    def fn(args):
+        shapes.append(tuple(a.shape for a in args))
+        return [args[0] * 2.0]
+
+    eng, clock = _engine(fn, max_batch_size=8, max_wait_ms=1.0)
+    f_a = eng.submit([np.ones((1, 2), np.float32)])
+    f_e = eng.submit([np.ones((1, 2), np.float32)])   # coalesces with f_a
+    f_b = eng.submit([np.ones((1, 3), np.float32)])   # different trailing
+    f_c = eng.submit([np.ones((1, 2), np.float64)])   # different dtype
+    f_d = eng.submit([np.ones((1, 2), np.float32),    # different arity
+                      np.ones((1,), np.float32)])
+    clock.advance(0.001)
+    assert eng.pump() == 4            # [a+e], [b], [c], [d]
+    eng.stop()
+    for f in (f_a, f_b, f_c, f_d, f_e):
+        f.result(timeout=0)           # all answered, none stranded
+    np.testing.assert_allclose(f_e.result(timeout=0)[0],
+                               np.full((1, 2), 2.0))
+    assert shapes[0][0][0] == 2       # a+e genuinely shared one dispatch
+
+
+def test_engine_dispatch_failure_does_not_kill_scheduler():
+    """A predict_fn blow-up fails that batch's futures and the engine keeps
+    serving later requests on the same (production) scheduler thread."""
+    import numpy as np
+    import pytest
+    from paddle_tpu import serving
+
+    def fn(args):
+        if float(args[0].flat[0]) < 0:
+            raise RuntimeError("model exploded")
+        return [args[0] + 1.0]
+
+    eng = serving.BatchingEngine(
+        fn, serving.EngineConfig(max_batch_size=1, max_wait_ms=0.0))
+    eng.start()
+    bad = eng.submit([np.full((1, 1), -1.0, np.float32)])
+    with pytest.raises(RuntimeError, match="model exploded"):
+        bad.result(timeout=10)
+    ok = eng.submit([np.full((1, 1), 3.0, np.float32)])
+    np.testing.assert_allclose(ok.result(timeout=10)[0], [[4.0]])
+    eng.stop()
+    assert eng.metrics.counters["failed"] == 1
+
+
+def test_engine_stop_drain_timeout_fails_queued_requests():
+    """A drain that exceeds its timeout must not strand queued futures:
+    they fail with RejectedError instead of blocking their callers until
+    the per-request future timeout."""
+    import threading
+    import numpy as np
+    import pytest
+    from paddle_tpu import serving
+
+    started = threading.Event()
+    release = threading.Event()
+
+    def fn(args):
+        started.set()
+        release.wait(20.0)          # wedge the in-flight dispatch
+        return [args[0]]
+
+    eng = serving.BatchingEngine(
+        fn, serving.EngineConfig(max_batch_size=1, max_wait_ms=0.0))
+    eng.start()
+    f1 = eng.submit([np.zeros((1, 1), np.float32)])
+    f2 = eng.submit([np.zeros((1, 1), np.float32)])
+    assert started.wait(10.0)           # f1's dispatch is in flight
+    eng.stop(drain=True, timeout=0.2)   # scheduler stuck dispatching f1
+    with pytest.raises(serving.RejectedError):
+        f2.result(timeout=5)
+    assert eng.metrics.reject_reasons.get("drain_timeout", 0) >= 1
+    release.set()
+    f1.result(timeout=10)               # in-flight dispatch still lands
+
+
+def test_engine_oversized_request_pads_to_pow2():
+    """A single request larger than max_batch_size dispatches on a pow2
+    shape (bounded executable cache even for oversized traffic) and the
+    padding never leaks into its result."""
+    import numpy as np
+    from paddle_tpu import serving
+
+    shapes = []
+
+    def fn(args):
+        shapes.append(args[0].shape[0])
+        return [args[0] + 1.0]
+
+    eng, _clock = _engine(fn, max_batch_size=8, max_wait_ms=1.0)
+    fut = eng.submit([np.zeros((11, 2), np.float32)])
+    assert eng.pump() == 1           # 11 rows >= max_batch: due immediately
+    eng.stop()
+    assert shapes == [16], shapes
+    assert fut.result(timeout=0)[0].shape == (11, 2)
+
+
+def test_engine_max_request_rows_admission_cap():
+    import numpy as np
+    import pytest
+    from paddle_tpu import serving
+
+    eng, _clock = _engine(lambda a: [a[0]], max_batch_size=8,
+                          max_request_rows=4)
+    with pytest.raises(serving.RejectedError, match="max_request_rows"):
+        eng.submit([np.zeros((5, 1), np.float32)])
+    assert eng.metrics.reject_reasons.get("too_many_rows") == 1
+    eng.submit([np.zeros((4, 1), np.float32)])   # at the cap: admitted
+    eng.stop()
+    assert eng.metrics.counters["completed"] == 1
+
+
 def test_engine_from_predictor_static_and_dynamic(tmp_path):
     """End-to-end over REAL export artifacts, both flavors: from_predictor
     picks the bucketing mode from the export's dynamic_batch flag and the
