@@ -422,6 +422,100 @@ def run_serve_bench():
     print(json.dumps(result))
 
 
+def run_comm_bench():
+    """Communication microbenchmark (ISSUE 4): times one grad-sized
+    all-reduce over the full device mesh — fp32 pmean vs the blockwise int8
+    quantized reduce-scatter/all-gather (distributed/compression.py) — and
+    reports the analytic bytes-on-wire for both. The row gates through
+    tools/check_bench_result.py's CEILING keys (comm_bytes_per_step,
+    allreduce_ms), so the compression ratio is a pinned, regression-proof
+    number."""
+    import os
+
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.compression import (
+        QuantAllreduceConfig, comm_bytes_per_step, quantized_allreduce)
+
+    backend = jax.default_backend()
+    # ~a gpt3-125m gradient's worth of elements by default
+    numel = int(os.environ.get("BENCH_COMM_NUMEL", str(4 * 1024 * 1024)))
+    block = int(os.environ.get("BENCH_COMM_BLOCK", "256"))
+    iters = int(os.environ.get("BENCH_COMM_ITERS", "20"))
+    cfg = QuantAllreduceConfig(block_size=block)
+    devs = jax.devices()
+    W = len(devs)
+    mesh = Mesh(np.array(devs), ("data",))
+    rng = np.random.RandomState(0)
+    x = rng.randn(W, numel).astype(np.float32)
+
+    def fp32_sync(g):
+        return jax.lax.pmean(g, "data")
+
+    def quant_sync(g):
+        return quantized_allreduce(g, "data", cfg, jax.random.PRNGKey(0))
+
+    def sm(f):
+        return jax.jit(shard_map(
+            f, mesh=mesh, in_specs=P("data"), out_specs=P("data")))
+
+    xd = jax.device_put(x, NamedSharding(mesh, P("data")))
+
+    def time_fn(fn):
+        jax.block_until_ready(fn(xd))  # compile + warmup
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(xd)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    fp32_ms = time_fn(sm(fp32_sync))
+    quant_ms = time_fn(sm(quant_sync))
+    bytes_fp32 = comm_bytes_per_step(numel, W)
+    bytes_q = comm_bytes_per_step(numel, W, cfg)
+    ratio = (bytes_fp32 / bytes_q) if bytes_q else 0.0
+    result = {
+        "metric": f"bytes/step comm-allreduce n{numel} w{W} block{block} "
+                  "int8-rs-ag",
+        "value": bytes_q,
+        "unit": "bytes/step",
+        "vs_baseline": round(ratio, 2),
+        "tag": "comm-allreduce",
+        "extra": {
+            "comm_bytes_per_step": bytes_q,
+            "comm_bytes_fp32": bytes_fp32,
+            "bytes_ratio": round(ratio, 2),
+            "allreduce_ms": round(quant_ms, 3),
+            "allreduce_fp32_ms": round(fp32_ms, 3),
+            "backend": backend,
+            "world": W,
+            "numel": numel,
+            "block_size": block,
+            "iters": iters,
+        },
+    }
+    print(json.dumps(result))
+
+
+def _comm_main():
+    """--comm entry: like main(), ALWAYS prints one JSON line, exit 0."""
+    try:
+        run_comm_bench()
+    except Exception as e:
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "comm_bench_error",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "extra": {"error": f"{type(e).__name__}: {str(e)[:400]}"},
+        }))
+    sys.exit(0)
+
+
 def _serve_main():
     """--serve entry: like main(), ALWAYS prints one JSON line, exit 0."""
     try:
@@ -563,6 +657,8 @@ if __name__ == "__main__":
         _child_main()
     elif "--serve" in sys.argv:
         _serve_main()
+    elif "--comm" in sys.argv:
+        _comm_main()
     elif "--probe" in sys.argv:
         _probe_main()
     else:

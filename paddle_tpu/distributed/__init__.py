@@ -10,7 +10,10 @@ from .collective import (Group, ReduceOp, all_gather, all_reduce,  # noqa: F401
                          reduce_scatter, scatter, send, wait)
 from .parallel_env import (ParallelEnv, get_rank, get_world_size,  # noqa: F401
                            init_parallel_env, is_initialized)
-from .strategy import DistributedStrategy  # noqa: F401
+from .strategy import DistributedStrategy, QuantAllreduceConfig  # noqa: F401
+from .compression import (quantized_allreduce, quantized_pmean,  # noqa: F401
+                          quantize_blockwise, dequantize_blockwise,
+                          comm_bytes_per_step)
 from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F401
                        ParallelMode, build_mesh_from_dims,
                        get_hybrid_communicate_group, get_mesh, set_mesh,

@@ -70,11 +70,28 @@ class DygraphShardingOptimizer:
                 f"{self._sharding_world_size}, processes="
                 f"{jax.process_count()}); use parallelize()/ShardedTrainStep "
                 "for SPMD sharding and hybrid dp x sharding layouts")
+        # one flattened broadcast per (owner, dtype) instead of one per
+        # param: an owner's whole shard crosses the wire in a single
+        # collective (a 100-param shard used to issue 100 broadcasts, each
+        # paying the multihost barrier + launch latency)
+        import jax.numpy as jnp
         from jax.experimental import multihost_utils
         for owner, params in self._rank2params.items():
+            if not params:
+                continue
+            groups: Dict = {}
             for p in params:
-                p.data = multihost_utils.broadcast_one_to_all(
-                    p.data, is_source=(self._sharding_rank == owner))
+                arr = jnp.asarray(p.data)
+                groups.setdefault(arr.dtype, []).append((p, arr))
+            for dtype, group in groups.items():
+                flat = jnp.concatenate(
+                    [arr.reshape(-1) for _, arr in group])
+                flat = multihost_utils.broadcast_one_to_all(
+                    flat, is_source=(self._sharding_rank == owner))
+                offset = 0
+                for p, arr in group:
+                    p.data = flat[offset:offset + arr.size].reshape(arr.shape)
+                    offset += arr.size
 
     def clear_grad(self):
         for p in self._full_parameter_list:

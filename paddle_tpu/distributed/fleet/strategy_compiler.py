@@ -28,15 +28,16 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional
 
-from ..strategy import AMPConfig, DistributedStrategy
+from ..strategy import AMPConfig, DistributedStrategy, QuantAllreduceConfig
 
 # Application order mirrors the reference's rank: rewrites that change the
 # numerics of the forward first, optimizer swaps next, execution-layout
 # transforms last.
 TRANSFORM_ORDER = ("qat", "sync_batch_norm", "amp", "lars", "lamb", "asp",
                    "recompute", "gradient_merge", "fp16_allreduce",
-                   "gradient_scale", "localsgd", "adaptive_localsgd",
-                   "sequence_parallel", "sharding", "pipeline", "scan")
+                   "quant_allreduce", "gradient_scale", "localsgd",
+                   "adaptive_localsgd", "sequence_parallel", "sharding",
+                   "pipeline", "scan")
 
 # Every public DistributedStrategy field falls in exactly one bucket (the
 # field audit in tests/test_strategy_flags.py enforces this, so a new field
@@ -55,6 +56,7 @@ CONSUMED_HERE = frozenset({
     "sharding", "sharding_configs", "pipeline", "pipeline_configs",
     "hybrid_configs", "fp16_allreduce", "gradient_scale_configs",
     "sync_batch_norm", "asp", "qat", "auto", "semi_auto", "scan_steps",
+    "quant_allreduce", "quant_allreduce_configs",
 })
 CONSUMED_ELSEWHERE = {
     "a_sync": "fleet.init_worker/the_one_ps (PS async communicator)",
@@ -115,6 +117,9 @@ class CompiledStrategy:
     # grads pass through this dtype around the cross-rank reduction
     # (fp16_allreduce_optimizer.py:148 analog)
     fp16_allreduce_dtype: Optional[str] = None
+    # EQuARX-style blockwise int8 quantized grad all-reduce
+    # (distributed/compression.py); None = full-precision sync
+    comm_quant: Optional[QuantAllreduceConfig] = None
     grad_scale: str = "avg"  # gradient_scale_configs: avg | sum
     sync_batch_norm: bool = False
     asp: bool = False
@@ -186,6 +191,27 @@ class StrategyCompiler:
             # offers the same knob for custom shard_map steps).
             plan.fp16_allreduce_dtype = "float16"
             plan.applied.append("fp16_allreduce")
+        quant_on = bool(getattr(strategy, "quant_allreduce", False))
+        if not quant_on:
+            # strategy left at the default: the env flag may still opt in
+            # (FLAGS_scan_chunk pattern)
+            from ...flags import get_flags
+            quant_on = bool(
+                get_flags("FLAGS_quant_allreduce")["FLAGS_quant_allreduce"])
+        if quant_on:
+            cfg = getattr(strategy, "quant_allreduce_configs", None)
+            plan.comm_quant = (cfg if isinstance(cfg, QuantAllreduceConfig)
+                               else QuantAllreduceConfig()).validate()
+            plan.applied.append("quant_allreduce")
+            if plan.fp16_allreduce_dtype:
+                # int8 wire subsumes the fp16 cast: quantizing an
+                # already-fp16-rounded grad would just stack rounding error
+                conflicts.append(
+                    "quant_allreduce supersedes fp16_allreduce (the int8 "
+                    "wire already compresses past fp16); disabling "
+                    "fp16_allreduce")
+                plan.fp16_allreduce_dtype = None
+                plan.applied.remove("fp16_allreduce")
         gsc = getattr(strategy, "gradient_scale_configs", None) or {}
         scale_strategy = gsc.get("scale_strategy", "avg") \
             if isinstance(gsc, dict) else getattr(gsc, "scale_strategy", "avg")
@@ -292,6 +318,11 @@ class StrategyCompiler:
                 plan.fp16_allreduce_dtype = None
                 plan.applied.remove("fp16_allreduce")
                 dropped.append("fp16_allreduce")
+            if plan.comm_quant is not None:
+                # same reason: no per-step grad collective to quantize
+                plan.comm_quant = None
+                plan.applied.remove("quant_allreduce")
+                dropped.append("quant_allreduce")
             if plan.grad_scale != "avg":
                 plan.grad_scale = "avg"
                 plan.applied.remove("gradient_scale")

@@ -123,6 +123,30 @@ class TensorParallelConfig:  # proto TensorParallelConfig:154
 
 
 @dataclass
+class QuantAllreduceConfig:  # TPU-specific (EQuARX-style quantized grad sync)
+    block_size: int = 256          # elements per absmax scale block
+    dtype: str = "int8"            # wire payload dtype (int8 only for now)
+    error_feedback: bool = False   # carry the rounding residual forward
+    stochastic_rounding: bool = True
+    # tensors below this element count sync in full precision: a bias or
+    # layernorm vector saves nothing on the wire and the scale overhead +
+    # quantization noise dominate (same size-segmentation rationale as
+    # ShardingConfig.min_shard_numel)
+    min_quant_numel: int = 1024
+
+    def validate(self) -> "QuantAllreduceConfig":
+        if self.dtype != "int8":
+            raise ValueError(
+                f"quant_allreduce dtype {self.dtype!r} is not supported "
+                "(int8 is the only wire payload implemented)")
+        if self.block_size < 1:
+            raise ValueError(
+                f"quant_allreduce block_size must be >= 1, got "
+                f"{self.block_size}")
+        return self
+
+
+@dataclass
 class AsyncConfig:  # proto AsyncConfig:133 (PS mode; interface parity only)
     k_steps: int = -1
     max_merge_var_num: int = 1
@@ -162,6 +186,12 @@ class DistributedStrategy:
         self.a_sync = False
         self.a_sync_configs = AsyncConfig()
         self.fp16_allreduce = False
+        # parity-plus: EQuARX-style blockwise int8 quantized gradient
+        # all-reduce (distributed/compression.py). Off by default — zero
+        # behavior change; FLAGS_quant_allreduce fills the default when the
+        # strategy is left untouched.
+        self.quant_allreduce = False
+        self.quant_allreduce_configs = QuantAllreduceConfig()
         self.find_unused_parameters = False
         self.last_comm_group_size_MB = 1.0
         self.fuse_grad_size_in_MB = 32

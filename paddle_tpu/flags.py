@@ -39,6 +39,10 @@ _FLAGS: Dict[str, object] = {
     # steps per dispatch when DistributedStrategy.scan_steps is left at 1;
     # 0/1 = eager per-step dispatch
     "FLAGS_scan_chunk": 0,
+    # quantized gradient collectives (paddle_tpu.distributed.compression):
+    # opt in to blockwise int8 grad all-reduce when
+    # DistributedStrategy.quant_allreduce is left at its default
+    "FLAGS_quant_allreduce": False,
 }
 
 # env-var overrides at import (gflags behavior)

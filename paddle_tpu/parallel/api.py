@@ -315,6 +315,20 @@ class ShardedTrainStep:
             self._opt_state = jax.device_put(self._opt_state,
                                              self._opt_host_sh)
 
+        batch_axes = _batch_axes(mesh)
+        _ba = (batch_axes if isinstance(batch_axes, tuple)
+               else (batch_axes,)) if batch_axes else ()
+        dp_total = int(np.prod([mesh.shape[a] for a in _ba])) if _ba else 1
+        # quantized grad collective (EQuARX analog, distributed/compression):
+        # gate on an actual cross-rank reduction existing — at dp_total == 1
+        # there is no wire, so the step stays bit-exact with quant off
+        comm_quant = getattr(plan, "comm_quant", None) \
+            if plan is not None else None
+        use_quant = bool(comm_quant is not None and dp_total > 1)
+        use_ef = bool(use_quant and comm_quant.error_feedback)
+        if use_quant:
+            from ..distributed.compression import quant_dequant
+
         # extra step state: gradient-merge accumulator + loss-scale state
         extras = {}
         extras_specs = {}
@@ -351,14 +365,21 @@ class ShardedTrainStep:
             extras["bad_steps"] = put(jnp.asarray(0, jnp.int32), P())
             for k in ("loss_scale", "good_steps", "bad_steps"):
                 extras_specs[k] = NamedSharding(mesh, P())
+        if use_ef:
+            # error-feedback residual: the rounding error of each synced
+            # grad, re-injected into the next sync; only tensors large
+            # enough to be quantized (min_quant_numel) carry one
+            ef_keys = [k for k, v in params.items()
+                       if v.size >= comm_quant.min_quant_numel]
+            extras["quant_ef"] = {
+                k: put(jnp.zeros(params[k].shape, jnp.float32),
+                       self.grad_specs[k]) for k in ef_keys}
+            extras_specs["quant_ef"] = {
+                k: NamedSharding(mesh, self.grad_specs[k]) for k in ef_keys}
         self._extras = extras
 
         apply_fn = optimizer.apply_gradients_fn()
         clip_fn = optimizer.clip_gradients_fn()
-        batch_axes = _batch_axes(mesh)
-        _ba = (batch_axes if isinstance(batch_axes, tuple)
-               else (batch_axes,)) if batch_axes else ()
-        dp_total = int(np.prod([mesh.shape[a] for a in _ba])) if _ba else 1
         # parity-plus sequence/context parallelism: token dim sharded over
         # the `sep` axis (ring/Ulysses kernels cover the explicit shard_map
         # mode; under GSPMD the partitioner slices the transformer and
@@ -493,6 +514,31 @@ class ShardedTrainStep:
                 new_extras["accum"] = jax.tree_util.tree_map(
                     lambda a: jnp.where(do_update, jnp.zeros_like(a), a), acc)
                 new_extras["accum_n"] = jnp.where(do_update, 0, acc_n)
+            if use_quant:
+                # the wire sync of the MERGED grad: round-trip through the
+                # blockwise int8 quantization exactly where GSPMD lands the
+                # cross-rank reduce (same boundary treatment as
+                # fp16_allreduce above) — once per merge window / scan
+                # chunk, never per banked micro-step, since the banked
+                # accumulator above stays full precision
+                qkey = jax.random.fold_in(rng, 0x71)
+                q_grads = {}
+                new_ef = {}
+                for qi, k in enumerate(sorted(eff_grads)):
+                    g = eff_grads[k]
+                    lk = jax.random.fold_in(qkey, qi)
+                    if use_ef and k in extras_["quant_ef"]:
+                        g32 = g.astype(jnp.float32) + extras_["quant_ef"][k]
+                        qg = quant_dequant(g32, comm_quant, lk)
+                        # residual advances only when this sync applied
+                        new_ef[k] = jnp.where(do_update, g32 - qg,
+                                              extras_["quant_ef"][k])
+                        q_grads[k] = qg.astype(g.dtype)
+                    else:
+                        q_grads[k] = quant_dequant(g, comm_quant, lk)
+                if use_ef:
+                    new_extras["quant_ef"] = new_ef
+                eff_grads = q_grads
             if grad_scale == "sum":
                 # gradient_scale_configs scale_strategy='sum': ranks SUM
                 # grads instead of averaging. The mean-loss backward yields

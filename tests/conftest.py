@@ -73,6 +73,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "serving: online-serving runtime tests (batching engine, "
         "HTTP front end, drain); select with -m serving")
+    config.addinivalue_line(
+        "markers", "comm: communication-compression tests (quantized "
+        "gradient collectives, distributed/compression.py); select with "
+        "-m comm")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -82,3 +86,5 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
         if mod == "test_serving":
             item.add_marker(pytest.mark.serving)
+        if mod == "test_compression":
+            item.add_marker(pytest.mark.comm)

@@ -185,6 +185,74 @@ def test_update_tightens_serving_keys_favorably_only(tmp_path):
     assert pinned == {"serve_qps": 2400.0, "serve_p99_ms": 2.2}
 
 
+# ---- comm rows (ISSUE 4): bytes-on-wire and latency ceilings ----
+
+def _comm_row(bytes_q, ms, backend="tpu"):
+    return {"metric": "bytes/step comm-allreduce n4194304 w8 block256 "
+                      "int8-rs-ag",
+            "value": bytes_q, "tag": "comm-allreduce",
+            "extra": {"comm_bytes_per_step": bytes_q,
+                      "comm_bytes_fp32": 4 * bytes_q,
+                      "allreduce_ms": ms, "backend": backend}}
+
+
+def test_comm_row_keys_by_metric_tag():
+    assert gate._preset_of(_comm_row(1000, 1.0)) == "comm-allreduce"
+
+
+def test_comm_bytes_gates_as_ceiling(tmp_path, capsys):
+    """comm_bytes_per_step pins a CEILING: bytes on the wire growing past
+    the pinned value (someone fattening the quantized payload) fails."""
+    th = _write(tmp_path, "th.json",
+                {"comm-allreduce": {"comm_bytes_per_step": 15_000_000.0}})
+    ok = _write(tmp_path, "ok.json", [_comm_row(14_800_000, 5.0)])
+    assert gate.main(["--new", ok, "--thresholds", th,
+                      "--max-regress", "0.05"]) == 0
+    bad = _write(tmp_path, "bad.json", [_comm_row(60_000_000, 5.0)])
+    assert gate.main(["--new", bad, "--thresholds", th,
+                      "--max-regress", "0.05"]) == 2
+    assert "comm_bytes_per_step" in capsys.readouterr().out
+
+
+def test_allreduce_ms_gates_as_ceiling(tmp_path, capsys):
+    th = _write(tmp_path, "th.json",
+                {"comm-allreduce": {"comm_bytes_per_step": 15_000_000.0,
+                                    "allreduce_ms": 5.0}})
+    ok = _write(tmp_path, "ok.json", [_comm_row(14_000_000, 5.2)])
+    assert gate.main(["--new", ok, "--thresholds", th,
+                      "--max-regress", "0.05"]) == 0  # 5.2 <= 5.0 * 1.05
+    bad = _write(tmp_path, "bad.json", [_comm_row(14_000_000, 9.0)])
+    assert gate.main(["--new", bad, "--thresholds", th,
+                      "--max-regress", "0.05"]) == 2
+    assert "allreduce_ms" in capsys.readouterr().out
+
+
+def test_update_tightens_comm_keys_favorably_only(tmp_path):
+    """--update only ever LOWERS the comm ceilings (both keys are 'lower'
+    direction); a worse measurement never loosens them."""
+    th = _write(tmp_path, "th.json",
+                {"comm-allreduce": {"comm_bytes_per_step": 15_000_000.0,
+                                    "allreduce_ms": 5.0}})
+    worse = _write(tmp_path, "worse.json", [_comm_row(20_000_000, 7.0)])
+    gate.main(["--new", worse, "--thresholds", th, "--update"])
+    pinned = json.load(open(th))["comm-allreduce"]
+    assert pinned == {"comm_bytes_per_step": 15_000_000.0,
+                      "allreduce_ms": 5.0}
+    better = _write(tmp_path, "better.json", [_comm_row(12_000_000, 3.5)])
+    gate.main(["--new", better, "--thresholds", th, "--update"])
+    pinned = json.load(open(th))["comm-allreduce"]
+    assert pinned == {"comm_bytes_per_step": 12_000_000.0,
+                      "allreduce_ms": 3.5}
+
+
+def test_comm_cpu_rows_never_gate(tmp_path):
+    th = _write(tmp_path, "th.json",
+                {"comm-allreduce": {"comm_bytes_per_step": 15_000_000.0}})
+    new = _write(tmp_path, "new.json",
+                 [_comm_row(60_000_000, 50.0, backend="cpu")])
+    assert gate.main(["--new", new, "--thresholds", th]) == 0
+
+
 def test_mixed_train_and_serve_rows_gate_independently(tmp_path):
     th = _write(tmp_path, "th.json", {
         "gpt3-125m": {"mfu": 0.32},

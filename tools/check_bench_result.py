@@ -52,8 +52,11 @@ def _preset_of(row):
 
 # gate-able metric keys and which direction is "better": a "higher" key
 # pins a floor (regression = measured below it), a "lower" key pins a
-# ceiling (regression = measured above it)
-GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower"}
+# ceiling (regression = measured above it). comm_* keys come from
+# `bench.py --comm` (ISSUE 4): bytes-on-wire and quantized-allreduce
+# latency must never grow past their pinned ceilings.
+GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
+             "comm_bytes_per_step": "lower", "allreduce_ms": "lower"}
 
 
 def _metrics_of(row):
@@ -63,7 +66,8 @@ def _metrics_of(row):
     v = extra.get("mfu", row.get("mfu_6nd"))
     if v is not None:
         out["mfu"] = float(v)
-    for k in ("serve_qps", "serve_p99_ms"):
+    for k in ("serve_qps", "serve_p99_ms", "comm_bytes_per_step",
+              "allreduce_ms"):
         if extra.get(k) is not None:
             out[k] = float(extra[k])
     return out
