@@ -422,6 +422,113 @@ def run_serve_bench():
     print(json.dumps(result))
 
 
+def run_llm_bench():
+    """LLM decode-engine benchmark (ISSUE 5): replays a seeded Poisson
+    prompt trace through the REAL continuous-batching stack — a tiny
+    GPT/LLaMA causal-LM behind serving.llm.LLMEngine on the threaded
+    wall-clock scheduler with a slot-paged KV pool — and reports sustained
+    generated tokens/sec plus TTFT tail. The row gates through
+    tools/check_bench_result.py's direction-aware keys (llm_tok_s floor,
+    llm_ttft_ms CEILING)."""
+    import os
+
+    import jax
+
+    from paddle_tpu.serving import LLMMetrics, RejectedError
+    from paddle_tpu.serving.llm import LLMEngine, LLMEngineConfig
+
+    preset = os.environ.get("BENCH_LLM_PRESET", "gpt2-tiny")
+    n_req = int(os.environ.get("BENCH_LLM_REQUESTS", "24"))
+    rate_hz = float(os.environ.get("BENCH_LLM_RATE_HZ", "50"))
+    num_slots = int(os.environ.get("BENCH_LLM_SLOTS", "4"))
+    max_new = int(os.environ.get("BENCH_LLM_MAX_NEW", "16"))
+    backend = jax.default_backend()
+
+    if preset.startswith("llama"):
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        model = LlamaForCausalLM.from_preset(preset)
+    else:
+        from paddle_tpu.models.gpt import GPTForCausalLM
+        model = GPTForCausalLM.from_preset(preset)
+    vocab = model.config.vocab_size if hasattr(model, "config") else 512
+
+    engine = LLMEngine(model, LLMEngineConfig(
+        num_slots=num_slots, block_len=8,
+        n_blocks=max(4, -(-(16 + max_new) // 8)),
+        max_queue_depth=max(4 * num_slots, 64)))
+    engine.start()
+
+    rng = np.random.RandomState(0)
+    prompt_lens = rng.randint(3, 13, size=n_req)
+    gaps = rng.exponential(1.0 / rate_hz, size=n_req)
+    prompts = [rng.randint(1, vocab, size=s).astype(np.int32)
+               for s in prompt_lens]
+    new_lens = rng.randint(max(2, max_new // 4), max_new + 1, size=n_req)
+
+    # compile every prefill bucket + the decode executable BEFORE the timed
+    # trace — a mid-trace jit compile would show up as a fake TTFT spike
+    for s in sorted({len(p) for p in prompts}):
+        engine.generate(prompts[0][:s] if s <= len(prompts[0])
+                        else np.ones((s,), np.int32), max_new_tokens=2,
+                        timeout=300)
+    engine.metrics = LLMMetrics()   # warmup rows don't count
+    engine.metrics.set_slots(engine.pool.active_slots(),
+                             engine.pool.num_slots)
+
+    handles, rejected = [], 0
+    t0 = time.perf_counter()
+    t_next = t0
+    for gap, p, m in zip(gaps, prompts, new_lens):
+        t_next += gap
+        delay = t_next - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            handles.append(engine.submit(p, max_new_tokens=int(m)))
+        except RejectedError:
+            rejected += 1
+    for h in handles:
+        try:
+            h.result(timeout=120)
+        except Exception:
+            pass
+    dt = time.perf_counter() - t0
+    engine.stop(drain=True)
+
+    snap = engine.metrics.snapshot()
+    # generated tokens include each sequence's first (prefill) token
+    total_tokens = snap["tokens_out"] + snap["prefills"]
+    tok_s = total_tokens / dt if dt > 0 else 0.0
+    ttft_p95 = snap["ttft_p95_ms"] or 0.0
+    result = {
+        "metric": f"tok/sec llm-{preset} slots{num_slots} "
+                  f"poisson{int(rate_hz)}",
+        "value": round(tok_s, 1),
+        "unit": "tok/sec",
+        "vs_baseline": 0.0,
+        "extra": {
+            "llm_tok_s": round(tok_s, 1),
+            "llm_ttft_ms": round(ttft_p95, 3),
+            "llm_ttft_p50_ms": round(snap["ttft_p50_ms"] or 0.0, 3),
+            "llm_intertoken_p50_ms": round(
+                snap["intertoken_p50_ms"] or 0.0, 3),
+            "llm_intertoken_p99_ms": round(
+                snap["intertoken_p99_ms"] or 0.0, 3),
+            "decode_steps": snap["decode_steps"],
+            "mean_active_rows": round(snap["mean_batch_rows"], 2),
+            "completed": snap["completed"],
+            "rejected": snap["rejected"] + rejected,
+            "expired": snap["expired"],
+            "backend": backend,
+            "n_requests": n_req,
+            "rate_hz": rate_hz,
+            "num_slots": num_slots,
+            "max_new_tokens": max_new,
+        },
+    }
+    print(json.dumps(result))
+
+
 def run_comm_bench():
     """Communication microbenchmark (ISSUE 4): times one grad-sized
     all-reduce over the full device mesh — fp32 pmean vs the blockwise int8
@@ -524,6 +631,22 @@ def _serve_main():
         traceback.print_exc()
         print(json.dumps({
             "metric": "serve_bench_error",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "extra": {"error": f"{type(e).__name__}: {str(e)[:400]}"},
+        }))
+    sys.exit(0)
+
+
+def _llm_main():
+    """--llm entry: like main(), ALWAYS prints one JSON line, exit 0."""
+    try:
+        run_llm_bench()
+    except Exception as e:
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "llm_bench_error",
             "value": 0.0,
             "unit": "error",
             "vs_baseline": 0.0,
@@ -659,6 +782,8 @@ if __name__ == "__main__":
         _serve_main()
     elif "--comm" in sys.argv:
         _comm_main()
+    elif "--llm" in sys.argv:
+        _llm_main()
     elif "--probe" in sys.argv:
         _probe_main()
     else:

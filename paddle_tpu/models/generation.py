@@ -3,18 +3,32 @@
 Parity-plus: the reference (Paddle ~2.1 core) ships only the beam-search
 decoder primitive (fluid/contrib decoder; here nn/decode.py) — it has no
 LLM generation loop. TPU-first design: ONE jitted prefill call fills the
-cache for the prompt, then ONE jitted lax.scan runs all decode steps
+cache for the prompt, then ONE jitted lax.while_loop runs the decode steps
 on-device (static [B, H, max_len, D] cache slabs, dynamic_update_slice
 writes, absolute-position causal masks), so the tunneled single-chip
-backend pays two dispatches total instead of one per token.
+backend pays two dispatches total instead of one per token — and the loop
+exits as soon as every row has emitted EOS instead of always paying all
+max_new_tokens steps.
+
+The prefill/decode-step builders are exposed (make_decoder_fns) so the
+serving LLM engine (serving/llm/) and one-shot generate() share one cache
+layout and one numeric path: continuous-batched decode is bit-identical
+per row to batch-locked greedy generate().
 """
 from __future__ import annotations
+
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor, no_grad
+
+# varied (B, S0, max_new_tokens, ...) shapes each compile their own
+# prefill+decode executable; an LRU bound keeps a shape-churning caller
+# from growing compiled programs without limit
+_GENERATE_JIT_CACHE_CAP = 8
 
 
 def _select_token(logits, do_sample, temperature, top_k, key):
@@ -23,15 +37,60 @@ def _select_token(logits, do_sample, temperature, top_k, key):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     lg = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
     if top_k and top_k > 0:
-        kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+        # kth-largest via lax.top_k (O(V·k-ish)) instead of a full
+        # O(V log V) sort; ties at the threshold keep identical semantics
+        # (every logit >= kth survives)
+        kth = jax.lax.top_k(lg, top_k)[0][:, -1][:, None]
         lg = jnp.where(lg < kth, -1e30, lg)
     return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+
+def make_decoder_fns(model):
+    """Expose the prefill/decode-step builders for a cached-decode model.
+
+    Returns (params, prefill, decode_step) where both functions are pure
+    (jit-able) over raw arrays:
+
+      prefill(params, prompt [B, S], caches, pos) -> (logits [B, S, V],
+          new_caches) — runs the whole prompt through the cache at offset
+          `pos` (normally 0) and returns per-position logits;
+      decode_step(params, tok [B], pos, caches) -> (logits [B, V],
+          new_caches) — one token per row, written at `pos`.
+
+    `pos` may be a scalar (whole batch at one offset — the batch-locked
+    generate() path) or a [B] int32 vector (per-row offsets — the
+    slot-paged serving engine, where each cache row sits at its own
+    length). `caches` is model.init_cache() layout: a list of
+    (k [B, Hkv, L, D], v) slabs, one per layer. The model is captured for
+    its buffers/structure; call with the model already in eval mode.
+    """
+    params, buffers = model.functional_state()
+
+    def prefill(p, prompt, caches_, pos):
+        with model._bound_state(p, buffers), no_grad():
+            logits, new_caches = model.forward_with_cache(
+                Tensor(prompt),
+                [(Tensor(k), Tensor(v)) for k, v in caches_], pos)
+        return logits.data, [(k.data, v.data) for k, v in new_caches]
+
+    def decode_step(p, tok, pos, caches_):
+        with model._bound_state(p, buffers), no_grad():
+            logits, new_caches = model.forward_with_cache(
+                Tensor(tok[:, None]),
+                [(Tensor(k), Tensor(v)) for k, v in caches_], pos)
+        return logits.data[:, 0], [(k.data, v.data)
+                                   for k, v in new_caches]
+
+    return params, prefill, decode_step
 
 
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, eos_token_id=None, seed=0):
     """Returns a Tensor [B, S0 + max_new_tokens] of prompt + continuation.
-    With eos_token_id, finished rows pad with eos."""
+    With eos_token_id, finished rows pad with eos and the decode loop
+    stops early once every row has finished. The number of decode-step
+    dispatches actually executed is recorded on the model as
+    `_last_decode_steps` (prefill's token excluded)."""
     from ..distributed.meta_parallel.mp_layers import _explicit_tp, \
         _mp_degree
     if _explicit_tp() or _mp_degree() > 1:
@@ -45,64 +104,64 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     if max_new_tokens <= 0:
         return Tensor(jnp.asarray(ids))
     L = S0 + max_new_tokens
-    params, buffers = model.functional_state()
     caches = model.init_cache(B, L)
     was_training = model.training
     model.eval()
-
-    def prefill(p, prompt, caches_):
-        with model._bound_state(p, buffers), no_grad():
-            logits, new_caches = model.forward_with_cache(
-                Tensor(prompt),
-                [(Tensor(k), Tensor(v)) for k, v in caches_],
-                jnp.int32(0))
-        return logits.data[:, -1], [(k.data, v.data)
-                                    for k, v in new_caches]
-
-    def decode_step(p, tok, pos, caches_):
-        with model._bound_state(p, buffers), no_grad():
-            logits, new_caches = model.forward_with_cache(
-                Tensor(tok[:, None]),
-                [(Tensor(k), Tensor(v)) for k, v in caches_], pos)
-        return logits.data[:, 0], [(k.data, v.data)
-                                   for k, v in new_caches]
+    params, prefill, decode_step = make_decoder_fns(model)
 
     # jit cache keyed by every static knob: a fresh closure per call would
-    # recompile prefill + the decode scan on EVERY generate() invocation
-    gen_cache = model.__dict__.setdefault("_generate_jit_cache", {})
+    # recompile prefill + the decode loop on EVERY generate() invocation
+    gen_cache = model.__dict__.setdefault("_generate_jit_cache",
+                                          OrderedDict())
     cache_key = (B, S0, max_new_tokens, do_sample, float(temperature),
                  int(top_k), eos_token_id)
+    # token buffer pre-filled with eos so rows finished before the loop
+    # exits keep the documented eos padding
+    eos_fill = 0 if eos_token_id is None else int(eos_token_id)
 
     def run(p, prompt, caches_, key):
-        last_logits, caches_ = prefill(p, prompt, caches_)
+        logits, caches_ = prefill(p, prompt, caches_, jnp.int32(0))
         key, sub = jax.random.split(key)
-        tok0 = _select_token(last_logits, do_sample, temperature, top_k,
+        tok0 = _select_token(logits[:, -1], do_sample, temperature, top_k,
                              sub)
         done0 = (jnp.zeros((B,), jnp.bool_) if eos_token_id is None
                  else tok0 == eos_token_id)
+        buf = jnp.full((B, max_new_tokens), eos_fill, jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, tok0[:, None], (0, 0))
 
-        def step(carry, i):
-            tok, done, caches_c, key_c = carry
-            pos = S0 + i
-            logits, caches_c = decode_step(p, tok, pos, caches_c)
+        def cond(carry):
+            i, _tok, done, _caches, _key, _buf = carry
+            return jnp.logical_and(i < max_new_tokens - 1,
+                                   jnp.logical_not(jnp.all(done)))
+
+        def body(carry):
+            i, tok, done, caches_c, key_c, buf_c = carry
+            step_logits, caches_c = decode_step(p, tok, S0 + i, caches_c)
             key_c, sub_c = jax.random.split(key_c)
-            nxt = _select_token(logits, do_sample, temperature, top_k,
+            nxt = _select_token(step_logits, do_sample, temperature, top_k,
                                 sub_c)
             if eos_token_id is not None:
                 nxt = jnp.where(done, eos_token_id, nxt)
                 done = done | (nxt == eos_token_id)
-            return (nxt, done, caches_c, key_c), nxt
+            buf_c = jax.lax.dynamic_update_slice(buf_c, nxt[:, None],
+                                                 (0, i + 1))
+            return (i + 1, nxt, done, caches_c, key_c, buf_c)
 
-        (_, _, _, _), toks = jax.lax.scan(
-            step, (tok0, done0, caches_, key), jnp.arange(max_new_tokens - 1))
-        # toks: [max_new_tokens-1, B]
-        return jnp.concatenate(
-            [tok0[:, None], jnp.swapaxes(toks, 0, 1)], axis=1)
+        steps, _, _, _, _, buf = jax.lax.while_loop(
+            cond, body,
+            (jnp.int32(0), tok0, done0, caches_, key, buf))
+        return buf, steps
 
-    if cache_key not in gen_cache:
+    if cache_key in gen_cache:
+        gen_cache.move_to_end(cache_key)
+    else:
         gen_cache[cache_key] = jax.jit(run)
-    new_toks = gen_cache[cache_key](params, jnp.asarray(ids), caches,
-                                    jax.random.PRNGKey(seed))
+        while len(gen_cache) > _GENERATE_JIT_CACHE_CAP:
+            gen_cache.popitem(last=False)
+    new_toks, steps = gen_cache[cache_key](params, jnp.asarray(ids),
+                                           caches,
+                                           jax.random.PRNGKey(seed))
+    model.__dict__["_last_decode_steps"] = int(steps)
     if was_training:
         model.train()
     return Tensor(jnp.concatenate([jnp.asarray(ids), new_toks], axis=1))

@@ -19,7 +19,8 @@ from ..distributed.meta_parallel.mp_layers import (ColumnParallelLinear,
 from ..nn import Dropout, Embedding, LayerNorm
 from ..nn import functional as F
 from ..nn.layer.layers import Layer, LayerList
-from ..ops.attention import flash_attention
+from ..ops.attention import decode_attention, flash_attention, \
+    update_kv_cache
 
 
 @dataclass
@@ -89,28 +90,18 @@ class GPTAttention(Layer):
             k_cache, v_cache = cache
 
             def attn_dec(a, kc, vc, pos_):
-                from jax import lax
+                # pos_ scalar: whole batch at one offset (generate());
+                # pos_ [B]: per-row offsets (slot-paged decode, ISSUE 5)
                 B, T = a.shape[0], a.shape[1]
-                Lmax = kc.shape[2]
                 n_local = a.shape[-1] // (3 * hd)
                 a4 = a.reshape(B, T, n_local, 3 * hd)
                 q, k, v = jnp.split(a4, 3, axis=-1)
                 qh = jnp.swapaxes(q, 1, 2)
                 kh = jnp.swapaxes(k, 1, 2)
                 vh = jnp.swapaxes(v, 1, 2)
-                kc = lax.dynamic_update_slice(kc, kh.astype(kc.dtype),
-                                              (0, 0, pos_, 0))
-                vc = lax.dynamic_update_slice(vc, vh.astype(vc.dtype),
-                                              (0, 0, pos_, 0))
-                scale = 1.0 / (hd ** 0.5)
-                s = jnp.einsum("bhtd,bhld->bhtl", qh.astype(jnp.float32),
-                               kc.astype(jnp.float32)) * scale
-                col = jnp.arange(Lmax)
-                valid = col[None, :] <= (pos_ + jnp.arange(T))[:, None]
-                s = jnp.where(valid[None, None], s, -1e30)
-                p = jax.nn.softmax(s, axis=-1)
-                out = jnp.einsum("bhtl,bhld->bhtd", p,
-                                 vc.astype(jnp.float32)).astype(a.dtype)
+                kc, vc = update_kv_cache(kc, vc, kh, vh, pos_)
+                out = decode_attention(qh, kc, vc, pos_,
+                                       scale=1.0 / (hd ** 0.5))
                 return (jnp.swapaxes(out, 1, 2).reshape(B, T, -1),
                         kc, vc)
 
@@ -221,9 +212,12 @@ class GPTModel(Layer):
         from ..core.tensor import Tensor, apply as _apply
         from ..tensor.creation import arange
         if caches is not None:
-            # absolute learned positions for the decoded slice
+            # absolute learned positions for the decoded slice; scalar pos
+            # broadcasts one offset, a [B] vector gives per-row offsets
+            # ([B, S] position ids) for slot-paged decode
             pos_ids = _apply(
-                lambda p: (p + jnp.arange(S)).astype(jnp.int32),
+                lambda p: ((p[:, None] if jnp.ndim(p) else p)
+                           + jnp.arange(S)).astype(jnp.int32),
                 pos if isinstance(pos, Tensor) else Tensor(pos))
             hidden = self.word_embeddings(input_ids) + \
                 self.position_embeddings(pos_ids)

@@ -26,7 +26,8 @@ from ..distributed.meta_parallel.mp_layers import (ColumnParallelLinear,
                                                    VocabParallelEmbedding)
 from ..nn import functional as F
 from ..nn.layer.layers import Layer, LayerList
-from ..ops.attention import flash_attention
+from ..ops.attention import decode_attention, flash_attention, \
+    update_kv_cache
 
 
 @dataclass
@@ -95,10 +96,13 @@ def _rope_cos_sin(seq_len, head_dim, theta, dtype=jnp.float32):
 
 
 def _apply_rope(x, cos, sin):
-    # x: [B, H, S, D]
+    # x: [B, H, S, D]; cos/sin [S, D] (shared positions) or [B, S, D]
+    # (per-row positions, slot-paged decode)
     half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
     rotated = jnp.concatenate([-x2, x1], -1)
+    if cos.ndim == 3:
+        return x * cos[:, None] + rotated * sin[:, None]
     return x * cos[None, None] + rotated * sin[None, None]
 
 
@@ -173,28 +177,22 @@ class LlamaAttention(Layer):
             kh = jnp.swapaxes(ka.reshape(B, T, -1, hd), 1, 2)
             vh = jnp.swapaxes(va.reshape(B, T, -1, hd), 1, 2)
             cos, sin = _rope_cos_sin(Lmax, hd, theta)
-            cos_t = lax.dynamic_slice_in_dim(cos, pos_, T, 0).astype(qh.dtype)
-            sin_t = lax.dynamic_slice_in_dim(sin, pos_, T, 0).astype(qh.dtype)
+            if jnp.ndim(pos_) == 0:
+                cos_t = lax.dynamic_slice_in_dim(cos, pos_, T, 0)
+                sin_t = lax.dynamic_slice_in_dim(sin, pos_, T, 0)
+            else:
+                # per-row rotation angles for slot-paged decode: each row
+                # sits at its own absolute position → cos/sin [B, T, D]
+                row = jax.vmap(
+                    lambda tab, p: lax.dynamic_slice_in_dim(tab, p, T, 0),
+                    in_axes=(None, 0))
+                cos_t, sin_t = row(cos, pos_), row(sin, pos_)
+            cos_t, sin_t = cos_t.astype(qh.dtype), sin_t.astype(qh.dtype)
             qh = _apply_rope(qh, cos_t, sin_t)
             kh = _apply_rope(kh, cos_t, sin_t)
-            kc = lax.dynamic_update_slice(kc, kh.astype(kc.dtype),
-                                          (0, 0, pos_, 0))
-            vc = lax.dynamic_update_slice(vc, vh.astype(vc.dtype),
-                                          (0, 0, pos_, 0))
-            krep, vrep = kc, vc
-            if n_rep > 1:
-                krep = jnp.repeat(kc, n_rep, axis=1)
-                vrep = jnp.repeat(vc, n_rep, axis=1)
-            scale = 1.0 / (hd ** 0.5)
-            s = jnp.einsum("bhtd,bhld->bhtl", qh.astype(jnp.float32),
-                           krep.astype(jnp.float32)) * scale
-            col = jnp.arange(Lmax)
-            row_pos = pos_ + jnp.arange(T)
-            valid = col[None, :] <= row_pos[:, None]
-            s = jnp.where(valid[None, None], s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            out = jnp.einsum("bhtl,bhld->bhtd", p,
-                             vrep.astype(jnp.float32)).astype(qa.dtype)
+            kc, vc = update_kv_cache(kc, vc, kh, vh, pos_)
+            out = decode_attention(qh, kc, vc, pos_,
+                                   scale=1.0 / (hd ** 0.5))
             out = jnp.swapaxes(out, 1, 2).reshape(B, T, -1)
             return out, kc, vc
 

@@ -709,3 +709,63 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if attn_mask is not None:
         return apply(f, q, k, v, _t(attn_mask))
     return apply(f, q, k, v)
+
+
+# ---- static-cache decode primitives (ISSUE 5: slot-paged LLM decode) ----
+# One numeric path shared by GPTAttention/LlamaAttention decode and the
+# serving LLM engine, so one-shot generate() and continuous batching are
+# bit-identical per row: masked columns score _NEG_INF, and
+# exp(-1e30 - row_max) underflows to exact fp32 0.0, so padded cache tail
+# and foreign batch rows contribute nothing to any softmax numerator or
+# denominator.
+
+def update_kv_cache(k_cache, v_cache, k_new, v_new, pos):
+    """Write k/v [B, Hkv, T, D] into static [B, Hkv, L, D] caches at `pos`.
+
+    `pos` is the absolute position of the first new token: a scalar writes
+    every row at the same offset (the batch-locked generate() path); a [B]
+    vector writes each row at its own offset (slot-paged decode, where each
+    slot sits at a different sequence length). All shapes stay static —
+    vector writes are a vmapped dynamic_update_slice, not a gather/scatter
+    with dynamic extents.
+    """
+    from jax import lax
+    pos = jnp.asarray(pos)
+    k_new = k_new.astype(k_cache.dtype)
+    v_new = v_new.astype(v_cache.dtype)
+    if pos.ndim == 0:
+        return (lax.dynamic_update_slice(k_cache, k_new, (0, 0, pos, 0)),
+                lax.dynamic_update_slice(v_cache, v_new, (0, 0, pos, 0)))
+    row_write = jax.vmap(
+        lambda c, u, p: lax.dynamic_update_slice(c, u, (0, p, 0)))
+    return row_write(k_cache, k_new, pos), row_write(v_cache, v_new, pos)
+
+
+def decode_attention(q, k_cache, v_cache, pos, scale=None):
+    """Length-masked attention of q [B, H, T, D] over padded static caches
+    [B, Hkv, L, D] (GQA: Hkv divides H; kv heads are repeated).
+
+    `pos` — scalar or [B] — is the absolute position of q's first token in
+    each row; cache columns beyond pos+t are masked to _NEG_INF, so slots
+    longer than a row's real length (and garbage beyond it) never perturb
+    the output. fp32 QK^T / softmax / PV with the result cast back to
+    q.dtype, matching the training-side reference attention.
+    """
+    B, H, T, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    k, v = k_cache, v_cache
+    if H != k.shape[1]:
+        n_rep = H // k.shape[1]
+        k = jnp.repeat(k, n_rep, axis=1)
+        v = jnp.repeat(v, n_rep, axis=1)
+    s = jnp.einsum("bhtd,bhld->bhtl", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    col = jnp.arange(k.shape[2])
+    row_pos = jnp.asarray(pos)[..., None] + jnp.arange(T)  # [T] or [B, T]
+    valid = col <= row_pos[..., None]
+    valid = valid[None, None] if valid.ndim == 2 else valid[:, None]
+    s = jnp.where(valid, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhtl,bhld->bhtd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

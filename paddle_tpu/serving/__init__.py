@@ -23,7 +23,10 @@ pow2 bucketing vs symbolic-batch exports).
 from .clock import Clock, MonotonicClock, SimClock  # noqa: F401
 from .engine import (BatchingEngine, DeadlineExceededError,  # noqa: F401
                      EngineConfig, RejectedError)
-from .metrics import ServingMetrics, parse_exposition  # noqa: F401
+from .metrics import LLMMetrics, ServingMetrics, parse_exposition  # noqa: F401
 from .sim import (Arrival, ReplayReport, poisson_trace,  # noqa: F401
                   replay, uniform_trace)
 from .server import ServingServer, serve  # noqa: F401
+from . import llm  # noqa: F401
+from .llm import (GenerationHandle, LLMEngine,  # noqa: F401
+                  LLMEngineConfig, SlotPagedKVPool, SlotsExhaustedError)

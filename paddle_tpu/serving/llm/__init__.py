@@ -1,0 +1,23 @@
+"""Continuous-batching LLM decode engine over a slot-paged static KV
+cache (ISSUE 5): the autoregressive counterpart of the stateless
+BatchingEngine.
+
+    model = GPTForCausalLM(PRESETS["gpt2-tiny"])
+    engine = serving.llm.LLMEngine(
+        model, serving.llm.LLMEngineConfig(num_slots=8, eos_token_id=2))
+    engine.start()
+    handle = engine.submit(prompt_ids, max_new_tokens=64)
+    tokens = handle.result(timeout=30)      # or handle.tokens_so_far()
+
+Deterministic scheduler testing (no threads, no sleeps):
+
+    engine = LLMEngine(model, cfg, clock=serving.SimClock())
+    while engine.has_work():
+        engine.pump()               # decode iterations are countable facts
+
+See docs/serving.md (LLM decode engine section) for slot-pool sizing and
+block_len tradeoffs.
+"""
+from .kv_pool import SlotPagedKVPool, SlotsExhaustedError  # noqa: F401
+from .llm_engine import (GenerationHandle, LLMEngine,  # noqa: F401
+                         LLMEngineConfig)

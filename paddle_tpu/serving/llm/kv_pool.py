@@ -1,0 +1,175 @@
+"""Slot-paged static KV cache pool (ISSUE 5 tentpole).
+
+A fixed pool of `num_slots` cache slots backed by one static slab per
+layer: `[num_slots, Hkv, block_len * n_blocks, D]` (exactly the model's
+`init_cache(num_slots, capacity)` layout, so the pool, one-shot
+`generate()` and the training-side cached forward share one cache
+format). Slots are the unit of admission — a sequence owns one slot from
+prefill to eviction — and blocks are the unit of *accounting*: the
+per-slot block table tracks which `block_len`-sized stripes of the slab a
+sequence's KV actually occupies, which is what slot-occupancy metrics and
+defrag hygiene reason about (Ragged Paged Attention keeps the same split:
+static shapes for the compiler, block tables for the scheduler).
+
+All device writes stay static-shape: rows are filled via
+`dynamic_update_slice` (per-row vmapped in the decode hot path), never a
+dynamic-extent scatter, so one compiled prefill executable per prompt
+bucket plus ONE decode executable serve every request mix. The pool
+itself is host-side bookkeeping (numpy tables + stats); the slabs it owns
+are jax arrays threaded through the engine's jitted calls.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SlotsExhaustedError(RuntimeError):
+    """allocate() found no free slot — every slot is decoding. The engine
+    maps this to queueing (and ultimately RejectedError admission control),
+    never to a dynamic reallocation: pool size is a compile-time shape."""
+
+
+class SlotPagedKVPool:
+    """Fixed pool of KV cache slots with block/length accounting.
+
+    init_cache_fn(batch, max_len) must return the model's cache pytree — a
+    list of (k, v) arrays shaped [batch, Hkv, max_len, D] — and is called
+    once with batch=num_slots, max_len=block_len*n_blocks. Models enforce
+    their own limits here (GPT refuses capacity beyond its learned
+    position table).
+    """
+
+    def __init__(self, init_cache_fn: Callable, num_slots: int,
+                 block_len: int, n_blocks: int, dtype=None):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if block_len < 1 or n_blocks < 1:
+            raise ValueError(
+                f"block_len/n_blocks must be >= 1, got "
+                f"{block_len}/{n_blocks}")
+        self.num_slots = int(num_slots)
+        self.block_len = int(block_len)
+        self.n_blocks = int(n_blocks)
+        self.capacity = self.block_len * self.n_blocks  # tokens per slot
+        kwargs = {} if dtype is None else {"dtype": dtype}
+        self.slabs: List[Tuple[jnp.ndarray, jnp.ndarray]] = [
+            (k, v) for k, v in init_cache_fn(self.num_slots, self.capacity,
+                                             **kwargs)]
+        self.lengths = np.zeros((self.num_slots,), np.int32)
+        self.active = np.zeros((self.num_slots,), bool)
+        # freed-but-not-scrubbed slots: their blocks still hold stale KV
+        # until defrag() zeroes them (hygiene, not correctness — prefill
+        # overwrites the whole row on reuse)
+        self.dirty = np.zeros((self.num_slots,), bool)
+        # slot -> global block ids backing its current length (contiguous
+        # within the slot's stripe: slot*n_blocks + i)
+        self.block_table: Dict[int, List[int]] = {}
+        self.stats = {"allocs": 0, "frees": 0, "reuses": 0,
+                      "alloc_failures": 0, "defrags": 0, "peak_active": 0}
+        self._scrub = None   # lazily-jitted defrag kernel
+
+    # ---- allocation ----
+    def allocate(self, need_tokens: int) -> int:
+        """Claim a free slot for a sequence that will grow to
+        `need_tokens` (prompt + max_new_tokens). Raises ValueError when the
+        request can never fit and SlotsExhaustedError when the pool is
+        momentarily full."""
+        if need_tokens > self.capacity:
+            raise ValueError(
+                f"sequence needs {need_tokens} tokens but slot capacity is "
+                f"{self.capacity} (block_len={self.block_len} x "
+                f"n_blocks={self.n_blocks})")
+        free = np.flatnonzero(~self.active)
+        if free.size == 0:
+            self.stats["alloc_failures"] += 1
+            raise SlotsExhaustedError(
+                f"all {self.num_slots} slots active")
+        slot = int(free[0])
+        self.active[slot] = True
+        if self.dirty[slot]:
+            self.stats["reuses"] += 1
+            self.dirty[slot] = False
+        self.lengths[slot] = 0
+        self.block_table[slot] = []
+        self.stats["allocs"] += 1
+        self.stats["peak_active"] = max(self.stats["peak_active"],
+                                        int(self.active.sum()))
+        return slot
+
+    def free(self, slot: int):
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        self.active[slot] = False
+        self.dirty[slot] = True
+        self.lengths[slot] = 0
+        self.block_table.pop(slot, None)
+        self.stats["frees"] += 1
+
+    def set_length(self, slot: int, length: int):
+        """Record `length` valid tokens in `slot`, growing its block table
+        to ceil(length / block_len) blocks."""
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        if length > self.capacity:
+            raise ValueError(
+                f"length {length} exceeds slot capacity {self.capacity}")
+        self.lengths[slot] = length
+        blocks = -(-int(length) // self.block_len)
+        self.block_table[slot] = [slot * self.n_blocks + i
+                                  for i in range(blocks)]
+
+    # ---- views ----
+    def free_slots(self) -> int:
+        return int((~self.active).sum())
+
+    def active_slots(self) -> int:
+        return int(self.active.sum())
+
+    def occupancy(self) -> float:
+        return self.active_slots() / self.num_slots
+
+    def used_blocks(self) -> int:
+        return sum(len(b) for b in self.block_table.values())
+
+    def dirty_blocks(self) -> int:
+        return int(self.dirty.sum()) * self.n_blocks
+
+    def lengths_array(self) -> jnp.ndarray:
+        return jnp.asarray(self.lengths)
+
+    def snapshot(self) -> dict:
+        return {
+            **self.stats,
+            "num_slots": self.num_slots,
+            "active_slots": self.active_slots(),
+            "capacity_tokens": self.capacity,
+            "used_blocks": self.used_blocks(),
+            "dirty_blocks": self.dirty_blocks(),
+            "total_blocks": self.num_slots * self.n_blocks,
+        }
+
+    # ---- hygiene ----
+    def defrag(self) -> int:
+        """Scrub stale KV out of freed slots (one jitted masked multiply
+        over each slab) and return the number of blocks reclaimed. Purely
+        hygienic — correctness never depends on it because prefill
+        overwrites a slot's whole stripe on reuse — but it keeps dirty
+        blocks from aging in HBM snapshots/checkpoints and makes the
+        free-block gauge mean 'zeroed and ready'."""
+        reclaimed = int(self.dirty.sum()) * self.n_blocks
+        if reclaimed == 0:
+            return 0
+        if self._scrub is None:
+            self._scrub = jax.jit(
+                lambda slab, keep: slab * keep[:, None, None, None])
+        keep = jnp.asarray(~self.dirty)
+        self.slabs = [(self._scrub(k, keep.astype(k.dtype)),
+                       self._scrub(v, keep.astype(v.dtype)))
+                      for k, v in self.slabs]
+        self.dirty[:] = False
+        self.stats["defrags"] += 1
+        return reclaimed

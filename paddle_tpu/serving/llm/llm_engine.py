@@ -1,0 +1,509 @@
+"""Continuous-batching LLM decode engine over the slot-paged KV pool
+(ISSUE 5 tentpole).
+
+The batch-locked `models.generation.generate()` loop makes every sequence
+enter together, share one prompt length and pay the batch's full
+`max_new_tokens` — one long request holds the whole batch's KV slabs
+hostage. This engine schedules the same numeric path (the
+`make_decoder_fns` prefill/decode builders, so outputs are bit-identical
+per row) as a continuously-batched service:
+
+- `prefill_into_slot` — one jitted call per pow2 prompt bucket: runs the
+  prompt through a fresh cache row, writes the row into the pool slab at
+  the allocated slot, and emits the first greedy token (TTFT ends here);
+- `decode_step` — ONE jitted fixed-width call over all `num_slots` rows
+  (the active-slot gather is a host-side table; inactive rows decode a
+  harmless token-0 at position 0 of their own free slot, which the next
+  prefill overwrites wholesale). Per-row positions ride the [B]-vector
+  `pos` support in the cached attention path;
+- between decode iterations the scheduler admits queued requests into
+  freed slots and evicts finished rows (EOS / per-request max-tokens /
+  deadline), so a short request never waits for a long one;
+- admission control reuses the serving vocabulary: bounded queue →
+  `RejectedError`, absolute deadlines → `DeadlineExceededError` (queued
+  requests are dropped before prefill; decoding rows are evicted
+  mid-stream with their partial tokens still readable off the handle).
+
+Determinism: every decision is a pure function of `clock.now()` and the
+queue/pool tables. Under a `SimClock` the engine runs threadless and a
+test harness calls `pump()` directly — slot churn and decode-iteration
+counts are provable facts, not timing accidents. Under the default
+`MonotonicClock`, `start()` runs the same `pump()` from a scheduler
+thread. Decoding is greedy (argmax): that is what makes continuous
+batching bit-reproducible against one-shot generate() for free; sampling
+belongs to the one-shot API.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..clock import Clock, MonotonicClock, SimClock
+from ..engine import DeadlineExceededError, RejectedError
+from ..metrics import LLMMetrics
+from .kv_pool import SlotPagedKVPool, SlotsExhaustedError
+
+_log = logging.getLogger("paddle_tpu.serving.llm")
+
+
+@dataclass
+class LLMEngineConfig:
+    num_slots: int = 4             # decode width == KV pool size
+    block_len: int = 16            # tokens per accounting block
+    n_blocks: int = 8              # blocks per slot (capacity = 128 tokens)
+    max_queue_depth: int = 64      # pending-request cap (admission control)
+    max_new_tokens: int = 32       # default per-request generation cap
+    eos_token_id: Optional[int] = None   # per-request override wins
+    default_deadline_ms: Optional[float] = None
+    prompt_bucket_pow2: bool = True  # pad prompts to pow2 buckets so the
+    #                                  number of prefill executables stays
+    #                                  logarithmic in slot capacity
+    min_prompt_bucket: int = 8
+    drain_timeout_s: float = 60.0
+    cache_dtype: Optional[object] = None  # pool slab dtype override
+
+    def __post_init__(self):
+        if self.num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {self.num_slots}")
+        if self.max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {self.max_queue_depth}")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+
+
+class GenerationHandle:
+    """Per-request streaming view + completion future.
+
+    Tokens stream into `tokens_so_far()` as decode iterations retire them;
+    `future` resolves with the full np.int32 array on EOS/max-tokens, or
+    with DeadlineExceededError / RejectedError on eviction (partial tokens
+    stay readable off the handle either way)."""
+
+    def __init__(self, prompt_len: int, max_new_tokens: int):
+        self.prompt_len = prompt_len
+        self.max_new_tokens = max_new_tokens
+        self.future: Future = Future()
+        self.ttft_ms: Optional[float] = None
+        self._lock = threading.Lock()
+        self._tokens: List[int] = []
+
+    def _append(self, tok: int):
+        with self._lock:
+            self._tokens.append(int(tok))
+
+    def tokens_so_far(self) -> List[int]:
+        with self._lock:
+            return list(self._tokens)
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        return self.future.result(timeout)
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new_tokens", "eos_token_id", "arrival",
+                 "deadline", "handle", "slot", "emitted", "last_tok")
+
+    def __init__(self, prompt, max_new_tokens, eos_token_id, arrival,
+                 deadline):
+        self.prompt = prompt              # np.int32 [S]
+        self.max_new_tokens = max_new_tokens
+        self.eos_token_id = eos_token_id
+        self.arrival = arrival            # clock seconds
+        self.deadline = deadline          # absolute clock seconds or None
+        self.handle = GenerationHandle(len(prompt), max_new_tokens)
+        self.slot: Optional[int] = None
+        self.emitted: List[int] = []
+        self.last_tok: int = 0
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class LLMEngine:
+    """submit() a prompt, get a GenerationHandle streaming greedy tokens.
+
+    The model must implement the cached-decode contract
+    (`init_cache` / `forward_with_cache`, e.g. GPTForCausalLM /
+    LlamaForCausalLM); it is switched to eval mode and its functional
+    state captured once at construction.
+    """
+
+    def __init__(self, model, config: Optional[LLMEngineConfig] = None,
+                 clock: Optional[Clock] = None,
+                 metrics: Optional[LLMMetrics] = None):
+        from ...models.generation import make_decoder_fns
+        self.model = model
+        model.eval()
+        self.config = config or LLMEngineConfig()
+        self.clock = clock or MonotonicClock()
+        self.metrics = metrics or LLMMetrics()
+        self.params, self._prefill_fn, self._decode_fn = \
+            make_decoder_fns(model)
+        self.pool = SlotPagedKVPool(
+            model.init_cache, self.config.num_slots, self.config.block_len,
+            self.config.n_blocks, dtype=self.config.cache_dtype)
+        self.metrics.set_slots(0, self.pool.num_slots)
+        self._queue: deque = deque()
+        self._active: Dict[int, _GenRequest] = {}   # slot -> request
+        self._cond = threading.Condition()
+        self._draining = False
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        self._prefill_jit: Dict[int, object] = {}   # prompt bucket -> fn
+        self._decode_jit = None
+        self.decode_iterations = 0   # lifetime decode_step dispatches
+
+    # ---- jitted executables ----
+    def _prefill_for_bucket(self, bucket: int):
+        if bucket not in self._prefill_jit:
+            slab_specs = [(k.shape, k.dtype, v.shape, v.dtype)
+                          for k, v in self.pool.slabs]
+
+            def prefill_into_slot(params, prompt, length, slot, slabs):
+                # prompt [1, bucket] (zero-padded past `length`); a fresh
+                # single-row cache is filled, then written over the slot's
+                # WHOLE stripe (so stale KV from the previous occupant is
+                # wiped) and the first greedy token read at length-1.
+                rows = [(jnp.zeros((1,) + ks[1:], kd),
+                         jnp.zeros((1,) + vs[1:], vd))
+                        for ks, kd, vs, vd in slab_specs]
+                logits, rows = self._prefill_fn(params, prompt, rows,
+                                                jnp.int32(0))
+                new_slabs = [
+                    (jax.lax.dynamic_update_slice(ks, rk, (slot, 0, 0, 0)),
+                     jax.lax.dynamic_update_slice(vs, rv, (slot, 0, 0, 0)))
+                    for (ks, vs), (rk, rv) in zip(slabs, rows)]
+                last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
+                                                    axis=0, keepdims=False)
+                tok0 = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                return tok0, new_slabs
+
+            self._prefill_jit[bucket] = jax.jit(prefill_into_slot)
+        return self._prefill_jit[bucket]
+
+    def _decode(self):
+        if self._decode_jit is None:
+            def decode_step(params, toks, pos, slabs):
+                # toks/pos [num_slots]: every slot decodes every iteration
+                # (fixed width, ONE executable); inactive rows carry
+                # (tok=0, pos=0) and scribble on their own free slot only.
+                logits, slabs = self._decode_fn(params, toks, pos, slabs)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), slabs
+
+            self._decode_jit = jax.jit(decode_step)
+        return self._decode_jit
+
+    # ---- lifecycle ----
+    def start(self) -> "LLMEngine":
+        """Run the scheduler on a background thread (production mode). Not
+        needed under a SimClock — the harness calls pump() itself."""
+        if isinstance(self.clock, SimClock):
+            raise RuntimeError(
+                "LLMEngine.start() with a SimClock would busy-spin: drive "
+                "pump() from the simulation harness instead")
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("engine already stopped")
+            if self._thread is not None:
+                return self
+            self._thread = threading.Thread(
+                target=self._scheduler_main, daemon=True,
+                name="pdtpu-llm-scheduler")
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None):
+        """Graceful drain: stop admissions (submit -> RejectedError), then
+        finish EVERY admitted sequence — queued requests still get
+        prefilled and decoded to completion — before stopping the
+        scheduler. With drain=False, queued and decoding requests fail
+        with RejectedError instead."""
+        with self._cond:
+            if self._stopped:
+                return
+            self._draining = True
+            if not drain:
+                while self._queue:
+                    req = self._queue.popleft()
+                    req.handle.future.set_exception(
+                        RejectedError("engine shut down before prefill"))
+                    self.metrics.on_reject("shutdown")
+                for slot, req in list(self._active.items()):
+                    req.handle.future.set_exception(
+                        RejectedError("engine shut down mid-decode"))
+                    self.metrics.on_reject("shutdown")
+                    self.pool.free(slot)
+                self._active.clear()
+                self.metrics.set_queue_depth(0)
+                self.metrics.set_slots(0, self.pool.num_slots)
+            self._cond.notify_all()
+            thread = self._thread
+        if thread is not None:
+            join_s = (timeout if timeout is not None
+                      else self.config.drain_timeout_s)
+            thread.join(join_s)
+            if thread.is_alive():
+                _log.warning(
+                    "llm drain did not complete within %.1fs; failing "
+                    "sequences still in flight", join_s)
+        else:
+            # threadless (sim) mode: run the scheduler inline to completion
+            while self._queue or self._active:
+                if self.pump() == 0 and not self._queue and not self._active:
+                    break
+        with self._cond:
+            stranded = 0
+            while self._queue:
+                req = self._queue.popleft()
+                req.handle.future.set_exception(RejectedError(
+                    "engine drain timed out before prefill"))
+                self.metrics.on_reject("drain_timeout")
+                stranded += 1
+            for slot, req in list(self._active.items()):
+                req.handle.future.set_exception(RejectedError(
+                    "engine drain timed out mid-decode"))
+                self.metrics.on_reject("drain_timeout")
+                self.pool.free(slot)
+                stranded += 1
+            self._active.clear()
+            if stranded:
+                self.metrics.set_queue_depth(0)
+                self.metrics.set_slots(0, self.pool.num_slots)
+            self._stopped = True
+            self._cond.notify_all()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop(drain=True)
+        return False
+
+    # ---- admission ----
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> GenerationHandle:
+        """Admit one prompt (1-D int token ids). Raises RejectedError when
+        the sequence can never fit a slot, the queue is full, or the engine
+        is draining."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must contain at least one token")
+        mnt = (self.config.max_new_tokens if max_new_tokens is None
+               else int(max_new_tokens))
+        if mnt < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {mnt}")
+        eos = (self.config.eos_token_id if eos_token_id is None
+               else eos_token_id)
+        if prompt.size + mnt > self.pool.capacity:
+            self.metrics.on_reject("prompt_too_long")
+            raise RejectedError(
+                f"prompt ({prompt.size}) + max_new_tokens ({mnt}) exceeds "
+                f"slot capacity ({self.pool.capacity} tokens)")
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        now = self.clock.now()
+        deadline = None if deadline_ms is None else now + deadline_ms / 1e3
+        with self._cond:
+            if self._draining or self._stopped:
+                self.metrics.on_reject("draining")
+                raise RejectedError("engine is draining; request rejected")
+            if len(self._queue) >= self.config.max_queue_depth:
+                self.metrics.on_reject("queue_full")
+                raise RejectedError(
+                    f"queue at capacity ({self.config.max_queue_depth} "
+                    "pending requests)")
+            req = _GenRequest(prompt, mnt, eos, now, deadline)
+            self._queue.append(req)
+            self.metrics.on_submit(len(self._queue))
+            self._cond.notify_all()
+        return req.handle
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 eos_token_id: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 timeout: Optional[float] = None) -> np.ndarray:
+        """Synchronous convenience: submit + wait for the full sequence."""
+        return self.submit(prompt, max_new_tokens=max_new_tokens,
+                           eos_token_id=eos_token_id,
+                           deadline_ms=deadline_ms).result(timeout)
+
+    # ---- scheduling ----
+    def has_work(self) -> bool:
+        with self._cond:
+            return bool(self._queue or self._active)
+
+    def next_event_time(self) -> Optional[float]:
+        """Clock instant of the next scheduler action — `now` whenever any
+        sequence is queued or decoding (decode/admission work is always
+        immediately due), None when idle. The sim harness advances its
+        clock here between scripted arrivals."""
+        with self._cond:
+            if self._queue or self._active:
+                return self.clock.now()
+            return None
+
+    def pump(self) -> int:
+        """One scheduler pass: drop expired queued requests, admit queued
+        requests into free slots (one jitted prefill each), then run at
+        most ONE fixed-width decode iteration and retire finished/evicted
+        rows. Returns the number of decode iterations executed (0 or 1) —
+        the quantity the continuous-batching tests count. This is THE
+        scheduler: the background thread and the sim harness both call
+        it."""
+        now = self.clock.now()
+        self._drop_expired_queued(now)
+        self._admit()
+        return self._decode_once()
+
+    def _drop_expired_queued(self, now: float):
+        with self._cond:
+            if not self._queue:
+                return
+            alive = deque()
+            expired = 0
+            for r in self._queue:
+                if r.deadline is not None and now >= r.deadline:
+                    r.handle.future.set_exception(DeadlineExceededError(
+                        f"deadline expired after "
+                        f"{(now - r.arrival) * 1e3:.1f}ms in queue "
+                        "(dropped before prefill)"))
+                    expired += 1
+                else:
+                    alive.append(r)
+            if expired:
+                self._queue = alive
+                self.metrics.on_expire(expired)
+                self.metrics.set_queue_depth(len(alive))
+
+    def _admit(self):
+        """Prefill queued requests into free slots. Runs between decode
+        iterations — each admission is one jitted prefill_into_slot call
+        that also emits the request's first token (TTFT)."""
+        while True:
+            with self._cond:
+                if not self._queue or self.pool.free_slots() == 0:
+                    return
+                req = self._queue.popleft()
+                self.metrics.set_queue_depth(len(self._queue))
+                slot = self.pool.allocate(
+                    len(req.prompt) + req.max_new_tokens)
+            length = len(req.prompt)
+            bucket = self._bucket_of(length)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :length] = req.prompt
+            fn = self._prefill_for_bucket(bucket)
+            tok0, self.pool.slabs = fn(self.params, jnp.asarray(padded),
+                                       jnp.int32(length), jnp.int32(slot),
+                                       self.pool.slabs)
+            now = self.clock.now()
+            req.slot = slot
+            req.handle.ttft_ms = (now - req.arrival) * 1e3
+            self.metrics.on_prefill(req.handle.ttft_ms)
+            self._emit(req, int(tok0))
+            with self._cond:
+                if self._finish_if_done(req, now):
+                    continue
+                self.pool.set_length(slot, length)
+                self._active[slot] = req
+                self.metrics.set_slots(self.pool.active_slots(),
+                                       self.pool.num_slots)
+
+    def _bucket_of(self, length: int) -> int:
+        if not self.config.prompt_bucket_pow2:
+            return length
+        return max(self.config.min_prompt_bucket,
+                   min(_next_pow2(length), self.pool.capacity))
+
+    def _decode_once(self) -> int:
+        with self._cond:
+            if not self._active:
+                return 0
+            toks = np.zeros((self.pool.num_slots,), np.int32)
+            pos = np.zeros((self.pool.num_slots,), np.int32)
+            for slot, req in self._active.items():
+                toks[slot] = req.last_tok
+                pos[slot] = self.pool.lengths[slot]
+        t0 = self.clock.now()
+        nxt, self.pool.slabs = self._decode()(
+            self.params, jnp.asarray(toks), jnp.asarray(pos),
+            self.pool.slabs)
+        nxt = np.asarray(nxt)
+        now = self.clock.now()
+        with self._cond:
+            rows = len(self._active)
+            self.decode_iterations += 1
+            for slot, req in list(self._active.items()):
+                # the decode wrote last_tok's KV at pos[slot]
+                self.pool.set_length(slot, int(pos[slot]) + 1)
+                self._emit(req, int(nxt[slot]))
+                if self._finish_if_done(req, now):
+                    del self._active[slot]
+                elif req.deadline is not None and now >= req.deadline:
+                    # mid-decode eviction: partial tokens stay readable on
+                    # the handle; the future fails with the deadline error
+                    req.handle.future.set_exception(DeadlineExceededError(
+                        f"deadline expired after {len(req.emitted)} of "
+                        f"{req.max_new_tokens} tokens (evicted mid-decode)"))
+                    self.metrics.on_expire()
+                    self.pool.free(slot)
+                    del self._active[slot]
+            self.metrics.set_slots(self.pool.active_slots(),
+                                   self.pool.num_slots)
+        self.metrics.on_decode_step(rows, (now - t0) * 1e3)
+        return 1
+
+    def _emit(self, req: _GenRequest, tok: int):
+        req.emitted.append(tok)
+        req.last_tok = tok
+        req.handle._append(tok)
+
+    def _finish_if_done(self, req: _GenRequest, now: float) -> bool:
+        """Retire a request whose last emitted token ended it (EOS or
+        max-tokens). Frees its slot when it held one."""
+        done = (len(req.emitted) >= req.max_new_tokens
+                or (req.eos_token_id is not None
+                    and req.emitted[-1] == req.eos_token_id))
+        if not done:
+            return False
+        req.handle.future.set_result(np.asarray(req.emitted, np.int32))
+        self.metrics.on_complete((now - req.arrival) * 1e3)
+        if req.slot is not None and self.pool.active[req.slot]:
+            self.pool.free(req.slot)
+        return True
+
+    # ---- scheduler thread (production mode) ----
+    def _scheduler_main(self):
+        while True:
+            with self._cond:
+                while True:
+                    if self._stopped:
+                        return
+                    if (self._draining and not self._queue
+                            and not self._active):
+                        return          # drained: stop() joins us
+                    if self._queue or self._active:
+                        break
+                    self.clock.wait(self._cond, None)
+            try:
+                self.pump()
+            except Exception:
+                _log.exception("llm scheduler pump failed; continuing")

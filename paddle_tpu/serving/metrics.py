@@ -23,6 +23,10 @@ BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
 
 class ServingMetrics:
+    # metric family prefix — subclasses (LLMMetrics) override it so two
+    # engines behind one server scrape without name collisions
+    _PREFIX = "pdtpu_serving"
+
     def __init__(self, window: int = 4096):
         self._lock = threading.Lock()
         self.window = int(window)
@@ -111,35 +115,158 @@ class ServingMetrics:
     def render(self) -> str:
         """Prometheus text exposition (served at /metrics)."""
         s = self.snapshot()
+        px = self._PREFIX
         lines = [
-            "# TYPE pdtpu_serving_requests_total counter",
+            f"# TYPE {px}_requests_total counter",
         ]
         for outcome in ("submitted", "completed", "rejected", "expired",
                         "failed"):
-            lines.append("pdtpu_serving_requests_total"
+            lines.append(f"{px}_requests_total"
                          f'{{outcome="{outcome}"}} {s[outcome]}')
         lines += [
-            "# TYPE pdtpu_serving_dispatches_total counter",
-            f"pdtpu_serving_dispatches_total {s['dispatches']}",
-            "# TYPE pdtpu_serving_queue_depth gauge",
-            f"pdtpu_serving_queue_depth {s['queue_depth']}",
-            "# TYPE pdtpu_serving_latency_ms summary",
+            f"# TYPE {px}_dispatches_total counter",
+            f"{px}_dispatches_total {s['dispatches']}",
+            f"# TYPE {px}_queue_depth gauge",
+            f"{px}_queue_depth {s['queue_depth']}",
+            f"# TYPE {px}_latency_ms summary",
         ]
         for q, key in ((0.5, "p50_ms"), (0.95, "p95_ms"), (0.99, "p99_ms")):
             v = s[key]
-            lines.append(f'pdtpu_serving_latency_ms{{quantile="{q}"}} '
+            lines.append(f'{px}_latency_ms{{quantile="{q}"}} '
                          f"{'NaN' if v is None else round(v, 3)}")
-        lines.append("# TYPE pdtpu_serving_batch_rows histogram")
+        lines.append(f"# TYPE {px}_batch_rows histogram")
         cum = 0
         hist = s["batch_hist"]
         for le in BATCH_BUCKETS:
             cum = sum(n for rows, n in hist.items() if rows <= le)
-            lines.append(f'pdtpu_serving_batch_rows_bucket{{le="{le}"}} {cum}')
-        lines.append('pdtpu_serving_batch_rows_bucket{le="+Inf"} '
+            lines.append(f'{px}_batch_rows_bucket{{le="{le}"}} {cum}')
+        lines.append(f'{px}_batch_rows_bucket{{le="+Inf"}} '
                      f"{sum(hist.values())}")
-        lines.append(f"pdtpu_serving_batch_rows_count {sum(hist.values())}")
-        lines.append("pdtpu_serving_batch_rows_sum "
+        lines.append(f"{px}_batch_rows_count {sum(hist.values())}")
+        lines.append(f"{px}_batch_rows_sum "
                      f"{sum(r * n for r, n in hist.items())}")
+        return "\n".join(lines) + "\n"
+
+
+def _quantile(sorted_vals, q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class LLMMetrics(ServingMetrics):
+    """ServingMetrics extended for the continuous-batching LLM engine
+    (ISSUE 5): TTFT and inter-token latency summaries, decode-throughput
+    (tokens/sec) and slot-occupancy gauges, prefill/decode-step/token
+    counters. Rendered under the `pdtpu_llm` family prefix so an LLM
+    engine can share a /metrics endpoint with a predictor BatchingEngine
+    without name collisions. The inherited batch-rows histogram counts
+    ACTIVE rows per decode iteration — i.e. how well continuous batching
+    keeps the fixed-width decode full."""
+
+    _PREFIX = "pdtpu_llm"
+
+    def __init__(self, window: int = 4096):
+        super().__init__(window)
+        self._ttft_ms: deque = deque(maxlen=self.window)
+        self._intertoken_ms: deque = deque(maxlen=self.window)
+        # (active_rows, step_ms) pairs: tokens/sec over the recent window
+        self._decode_window: deque = deque(maxlen=self.window)
+        self.counters.update({"prefills": 0, "decode_steps": 0,
+                              "tokens_out": 0})
+        self.slots_active = 0
+        self.slots_total = 0
+
+    # ---- engine callbacks ----
+    def on_prefill(self, ttft_ms: float):
+        with self._lock:
+            self.counters["prefills"] += 1
+            self._ttft_ms.append(float(ttft_ms))
+
+    def on_decode_step(self, active_rows: int, step_ms: float):
+        with self._lock:
+            self.counters["decode_steps"] += 1
+            self.counters["tokens_out"] += int(active_rows)
+            self.batch_hist[active_rows] = \
+                self.batch_hist.get(active_rows, 0) + 1
+            self.dispatched_rows += int(active_rows)
+            self.counters["dispatches"] += 1
+            self._intertoken_ms.append(float(step_ms))
+            self._decode_window.append((int(active_rows), float(step_ms)))
+        from ..profiler import record_instant
+        record_instant("serving/llm_decode", {
+            "active_rows": active_rows, "step_ms": step_ms,
+        })
+
+    def set_slots(self, active: int, total: int):
+        with self._lock:
+            self.slots_active = int(active)
+            self.slots_total = int(total)
+
+    # ---- views ----
+    def ttft_quantile_ms(self, q: float) -> Optional[float]:
+        with self._lock:
+            vals = sorted(self._ttft_ms)
+        return _quantile(vals, q)
+
+    def intertoken_quantile_ms(self, q: float) -> Optional[float]:
+        with self._lock:
+            vals = sorted(self._intertoken_ms)
+        return _quantile(vals, q)
+
+    def tokens_per_s(self) -> float:
+        """Decode throughput over the recent window: generated tokens per
+        second of decode-step wall time (idle gaps excluded, so the gauge
+        means 'how fast the decode loop moves when it moves')."""
+        with self._lock:
+            pairs = list(self._decode_window)
+        total_ms = sum(ms for _, ms in pairs)
+        if total_ms <= 0:
+            return 0.0
+        return sum(rows for rows, _ in pairs) / (total_ms / 1e3)
+
+    def snapshot(self) -> dict:
+        s = super().snapshot()
+        with self._lock:
+            s["slots_active"] = self.slots_active
+            s["slots_total"] = self.slots_total
+        s["slot_occupancy"] = (self.slots_active / self.slots_total
+                               if self.slots_total else 0.0)
+        s["tokens_per_s"] = self.tokens_per_s()
+        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            s[f"ttft_{key}_ms"] = self.ttft_quantile_ms(q)
+            s[f"intertoken_{key}_ms"] = self.intertoken_quantile_ms(q)
+        return s
+
+    def render(self) -> str:
+        s = self.snapshot()
+        px = self._PREFIX
+        lines = [super().render().rstrip("\n")]
+        for fam, prefix in ((f"{px}_ttft_ms", "ttft"),
+                            (f"{px}_intertoken_ms", "intertoken")):
+            lines.append(f"# TYPE {fam} summary")
+            for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                v = s[f"{prefix}_{key}_ms"]
+                lines.append(f'{fam}{{quantile="{q}"}} '
+                             f"{'NaN' if v is None else round(v, 3)}")
+        lines += [
+            f"# TYPE {px}_tokens_per_s gauge",
+            f"{px}_tokens_per_s {round(s['tokens_per_s'], 3)}",
+            f"# TYPE {px}_slots_active gauge",
+            f"{px}_slots_active {s['slots_active']}",
+            f"# TYPE {px}_slots_total gauge",
+            f"{px}_slots_total {s['slots_total']}",
+            f"# TYPE {px}_slot_occupancy gauge",
+            f"{px}_slot_occupancy {round(s['slot_occupancy'], 4)}",
+            f"# TYPE {px}_tokens_total counter",
+            f"{px}_tokens_total {s['tokens_out']}",
+            f"# TYPE {px}_decode_steps_total counter",
+            f"{px}_decode_steps_total {s['decode_steps']}",
+            f"# TYPE {px}_prefills_total counter",
+            f"{px}_prefills_total {s['prefills']}",
+        ]
         return "\n".join(lines) + "\n"
 
 

@@ -7,6 +7,11 @@ ThreadingHTTPServer + BaseHTTPRequestHandler, whose hardened
     POST /predict   {"inputs": [[...], ...], "deadline_ms": 50}
                     -> 200 {"outputs": [...]}; 503 rejected (queue full /
                     draining); 504 deadline expired before dispatch
+    POST /generate  {"input_ids": [...], "max_new_tokens": 32,
+                    "eos_token_id": 2, "deadline_ms": 500}
+                    -> 200 {"tokens": [...], "ttft_ms": ...} from the
+                    continuous-batching LLMEngine (serving/llm/); same
+                    503/504 admission-control mapping
     GET  /healthz   -> 200 {"status": "ok"|"draining"}
     GET  /metrics   -> 200 Prometheus text exposition (serving/metrics.py)
 
@@ -56,12 +61,23 @@ def _decode_inputs(payload: dict):
 
 
 class ServingServer:
-    """HTTP front end + drain orchestration around one BatchingEngine."""
+    """HTTP front end + drain orchestration around a BatchingEngine
+    (stateless /predict) and/or an LLMEngine (autoregressive /generate,
+    ISSUE 5). At least one engine must be attached; each route 404s when
+    its engine is absent. Both engines share the SIGTERM drain contract:
+    stop admissions, finish every admitted request/sequence, snapshot
+    final metrics, exit 0."""
 
-    def __init__(self, engine: BatchingEngine, host: str = "127.0.0.1",
+    def __init__(self, engine: Optional[BatchingEngine] = None,
+                 host: str = "127.0.0.1",
                  port: int = 0, final_metrics_path: Optional[str] = None,
-                 request_timeout_s: float = 60.0):
+                 request_timeout_s: float = 60.0, llm_engine=None):
+        if engine is None and llm_engine is None:
+            raise ValueError(
+                "ServingServer needs a BatchingEngine (/predict), an "
+                "LLMEngine (/generate), or both")
         self.engine = engine
+        self.llm_engine = llm_engine
         self._thread: Optional[threading.Thread] = None
         self.final_metrics_path = final_metrics_path
         self.request_timeout_s = float(request_timeout_s)
@@ -89,18 +105,34 @@ class ServingServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._reply_json(200, {
+                    health = {
                         "status": "draining" if outer._draining else "ok",
-                        "queue_depth": outer.engine.metrics.queue_depth,
-                    })
+                    }
+                    if outer.engine is not None:
+                        health["queue_depth"] = \
+                            outer.engine.metrics.queue_depth
+                    if outer.llm_engine is not None:
+                        m = outer.llm_engine.metrics
+                        health["llm_queue_depth"] = m.queue_depth
+                        health["llm_slots_active"] = m.slots_active
+                        health["llm_slots_total"] = m.slots_total
+                    self._reply_json(200, health)
                 elif self.path == "/metrics":
-                    self._reply(200, outer.engine.metrics.render().encode(),
+                    # both engines scrape from one endpoint; the llm family
+                    # renders under pdtpu_llm_* so names never collide
+                    text = "".join(e.metrics.render() for e in
+                                   (outer.engine, outer.llm_engine)
+                                   if e is not None)
+                    self._reply(200, text.encode(),
                                 ctype="text/plain; version=0.0.4")
                 else:
                     self._reply_json(404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path != "/predict":
+                routes = {"/predict": (outer.engine, self._predict),
+                          "/generate": (outer.llm_engine, self._generate)}
+                route = routes.get(self.path)
+                if route is None or route[0] is None:
                     self._reply_json(404, {"error": "not found"})
                     return
                 body = read_request_body(self)
@@ -109,10 +141,42 @@ class ServingServer:
                 with outer._active_lock:
                     outer._active += 1
                 try:
-                    self._predict(body)
+                    route[1](body)
                 finally:
                     with outer._active_lock:
                         outer._active -= 1
+
+            def _generate(self, body: bytes):
+                try:
+                    payload = json.loads(body or b"{}")
+                    prompt = np.asarray(payload["input_ids"],
+                                        dtype=np.int32).reshape(-1)
+                    if prompt.size < 1:
+                        raise ValueError("input_ids must be non-empty")
+                except (ValueError, KeyError, TypeError) as e:
+                    self._reply_json(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    handle = outer.llm_engine.submit(
+                        prompt,
+                        max_new_tokens=payload.get("max_new_tokens"),
+                        eos_token_id=payload.get("eos_token_id"),
+                        deadline_ms=payload.get("deadline_ms"))
+                    toks = handle.result(timeout=outer.request_timeout_s)
+                except RejectedError as e:
+                    self._reply_json(503, {"error": str(e)})
+                    return
+                except DeadlineExceededError as e:
+                    self._reply_json(504, {"error": str(e)})
+                    return
+                except Exception as e:  # model/decode failure
+                    self._reply_json(
+                        500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                self._reply_json(200, {
+                    "tokens": np.asarray(toks).tolist(),
+                    "ttft_ms": handle.ttft_ms,
+                })
 
             def _predict(self, body: bytes):
                 try:
@@ -138,13 +202,28 @@ class ServingServer:
                 self._reply_json(200, {
                     "outputs": [np.asarray(o).tolist() for o in outs]})
 
+        # socket-level cap so a stalled client can't pin a handler thread
+        # past the drain settle window
+        _Handler.timeout = self.request_timeout_s + 30.0
         self._server = ThreadingHTTPServer((host, port), _Handler)
+        # ThreadingHTTPServer defaults to daemon handler threads, which
+        # server_close() does NOT join — a handler rejecting a late request
+        # after the final snapshot was written would break the snapshot's
+        # client-for-client reconciliation. Non-daemon + block_on_close
+        # makes server_close() wait for every in-flight handler, so the
+        # snapshot is written strictly after the last response.
+        self._server.daemon_threads = False
+        self._server.block_on_close = True
         self.host, self.port = self._server.server_address[:2]
 
     # ---- lifecycle ----
+    def _engines(self):
+        return [e for e in (self.engine, self.llm_engine) if e is not None]
+
     def start(self) -> "ServingServer":
-        """Engine scheduler + HTTP accept loop on background threads."""
-        self.engine.start()
+        """Engine scheduler(s) + HTTP accept loop on background threads."""
+        for e in self._engines():
+            e.start()
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True,
                                         name="pdtpu-serving-http")
@@ -161,18 +240,20 @@ class ServingServer:
             else:
                 self._draining = True    # /predict now rejects via engine
                 already = False
+        drain_s = max(e.config.drain_timeout_s for e in self._engines())
         if already:
-            self._stopped_event.wait(timeout=self.engine.config
-                                     .drain_timeout_s + 15.0)
+            self._stopped_event.wait(timeout=drain_s + 15.0)
             return
-        self.engine.stop(drain=drain)
+        for e in self._engines():
+            e.stop(drain=drain)
         self._wait_active_settled()
         self._server.shutdown()
         self._server.server_close()
         if self.final_metrics_path:
             tmp = self.final_metrics_path + ".tmp"
             with open(tmp, "w") as f:
-                f.write(self.engine.metrics.render())
+                f.write("".join(e.metrics.render()
+                                for e in self._engines()))
             os.replace(tmp, self.final_metrics_path)
         self._stopped_event.set()
 
@@ -212,7 +293,8 @@ class ServingServer:
                                  name="pdtpu-serving-drain").start()
             for sig in (signal.SIGTERM, signal.SIGINT):
                 signal.signal(sig, _on_term)
-        self.engine.start()
+        for e in self._engines():
+            e.start()
         try:
             if self._thread is not None:
                 # start() already owns an accept loop; a SECOND

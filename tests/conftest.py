@@ -77,6 +77,9 @@ def pytest_configure(config):
         "markers", "comm: communication-compression tests (quantized "
         "gradient collectives, distributed/compression.py); select with "
         "-m comm")
+    config.addinivalue_line(
+        "markers", "llm: continuous-batching LLM decode-engine tests "
+        "(slot-paged KV pool, serving/llm/); select with -m llm")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -88,3 +91,5 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.serving)
         if mod == "test_compression":
             item.add_marker(pytest.mark.comm)
+        if mod == "test_llm_engine":
+            item.add_marker(pytest.mark.llm)

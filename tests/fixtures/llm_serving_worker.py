@@ -1,0 +1,63 @@
+"""Subprocess fixture for tests/test_llm_engine.py: runs a ServingServer
+fronting an LLMEngine (gpt2-tiny, slot-paged KV pool) on an ephemeral
+port, so the parent test can drive live /generate traffic and deliver
+SIGTERM mid-decode to assert the LLM drain contract: admissions stop
+(late requests get 503 or connection-refused), every ADMITTED sequence
+still decodes to completion, the process exits 0, and the final metrics
+snapshot reconciles with what the clients observed.
+
+    python llm_serving_worker.py WORKDIR
+
+env knobs:
+    LLM_SLOTS     KV pool size (default 2)
+    LLM_MAX_NEW   default max_new_tokens (default 12)
+
+Writes WORKDIR/port once the socket is bound (the parent polls for it)
+and WORKDIR/metrics_final.txt (Prometheus text) during drain. Exit 0 on
+a clean drain.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import serving  # noqa: E402
+from paddle_tpu.models.gpt import GPTForCausalLM  # noqa: E402
+
+WORKDIR = sys.argv[1]
+SLOTS = int(os.environ.get("LLM_SLOTS", "2"))
+MAX_NEW = int(os.environ.get("LLM_MAX_NEW", "12"))
+
+
+def main():
+    paddle.seed(0)
+    model = GPTForCausalLM.from_preset("gpt2-tiny")
+    engine = serving.LLMEngine(
+        model, serving.LLMEngineConfig(
+            num_slots=SLOTS, block_len=8, n_blocks=8,
+            max_new_tokens=MAX_NEW, max_queue_depth=64))
+    engine.start()
+    # warm every executable the traffic will hit (bucket-8 prefill + the
+    # decode step), so SIGTERM lands mid-decode rather than mid-compile;
+    # then reset metrics so the final snapshot reconciles client-for-client
+    engine.generate([1, 2, 3], max_new_tokens=2, timeout=300)
+    engine.metrics = serving.LLMMetrics()
+    engine.metrics.set_slots(0, engine.pool.num_slots)
+
+    server = serving.ServingServer(
+        llm_engine=engine, port=0,
+        final_metrics_path=os.path.join(WORKDIR, "metrics_final.txt"))
+    # socket bound at construction: write the handshake file atomically so
+    # the parent never reads a half-written port
+    tmp = os.path.join(WORKDIR, "port.tmp")
+    with open(tmp, "w") as f:
+        f.write(str(server.port))
+    os.replace(tmp, os.path.join(WORKDIR, "port"))
+    server.serve_forever()  # installs SIGTERM/SIGINT drain handlers
+
+
+if __name__ == "__main__":
+    main()

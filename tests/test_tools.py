@@ -264,3 +264,66 @@ def test_mixed_train_and_serve_rows_gate_independently(tmp_path):
     new2 = _write(tmp_path, "new2.json",
                   [_row("gpt3-125m", 0.33), _serve_row(900.0, 2.8)])
     assert gate.main(["--new", new2, "--thresholds", th]) == 2
+
+
+# ---- llm rows (ISSUE 5): decode throughput floor, TTFT ceiling ----
+
+def _llm_row(tok_s, ttft_ms, backend="tpu"):
+    return {"metric": "tok/sec llm-gpt2-tiny slots4 poisson50",
+            "value": tok_s, "extra": {"llm_tok_s": tok_s,
+                                      "llm_ttft_ms": ttft_ms,
+                                      "backend": backend}}
+
+
+def test_llm_row_keys_by_preset():
+    assert gate._preset_of(_llm_row(200.0, 5.0)) == "llm-gpt2-tiny"
+
+
+def test_llm_tok_s_gates_as_floor(tmp_path, capsys):
+    """llm_tok_s pins a FLOOR: generated tokens/sec dropping beyond
+    --max-regress fails the gate."""
+    th = _write(tmp_path, "th.json", {"llm-gpt2-tiny": {"llm_tok_s": 200.0}})
+    ok = _write(tmp_path, "ok.json", [_llm_row(195.0, 5.0)])
+    assert gate.main(["--new", ok, "--thresholds", th,
+                      "--max-regress", "0.05"]) == 0  # within 5%
+    bad = _write(tmp_path, "bad.json", [_llm_row(150.0, 5.0)])
+    assert gate.main(["--new", bad, "--thresholds", th,
+                      "--max-regress", "0.05"]) == 2
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_llm_ttft_gates_as_ceiling(tmp_path, capsys):
+    """llm_ttft_ms pins a CEILING: p95 time-to-first-token growing past it
+    fails even while decode throughput holds."""
+    th = _write(tmp_path, "th.json",
+                {"llm-gpt2-tiny": {"llm_tok_s": 200.0, "llm_ttft_ms": 5.0}})
+    ok = _write(tmp_path, "ok.json", [_llm_row(210.0, 5.2)])
+    assert gate.main(["--new", ok, "--thresholds", th,
+                      "--max-regress", "0.05"]) == 0  # 5.2 <= 5.0 * 1.05
+    bad = _write(tmp_path, "bad.json", [_llm_row(210.0, 8.0)])
+    assert gate.main(["--new", bad, "--thresholds", th,
+                      "--max-regress", "0.05"]) == 2
+    assert "llm_ttft_ms" in capsys.readouterr().out
+
+
+def test_update_tightens_llm_keys_favorably_only(tmp_path):
+    """--update raises the tok/s floor and LOWERS the TTFT ceiling; a worse
+    measurement never loosens either."""
+    th = _write(tmp_path, "th.json",
+                {"llm-gpt2-tiny": {"llm_tok_s": 200.0, "llm_ttft_ms": 5.0}})
+    worse = _write(tmp_path, "worse.json", [_llm_row(150.0, 9.0)])
+    gate.main(["--new", worse, "--thresholds", th, "--update"])
+    assert json.load(open(th))["llm-gpt2-tiny"] == \
+        {"llm_tok_s": 200.0, "llm_ttft_ms": 5.0}      # unchanged
+    better = _write(tmp_path, "better.json", [_llm_row(260.0, 3.1)])
+    gate.main(["--new", better, "--thresholds", th, "--update"])
+    assert json.load(open(th))["llm-gpt2-tiny"] == \
+        {"llm_tok_s": 260.0, "llm_ttft_ms": 3.1}
+
+
+def test_llm_cpu_rows_never_gate(tmp_path):
+    """`bench.py --llm` on CPU emits backend="cpu" rows: the gate stays
+    vacuous-green (chip floors only bind chip rows)."""
+    th = _write(tmp_path, "th.json", {"llm-gpt2-tiny": {"llm_tok_s": 200.0}})
+    cpu = _write(tmp_path, "cpu.json", [_llm_row(10.0, 50.0, backend="cpu")])
+    assert gate.main(["--new", cpu, "--thresholds", th]) == 0

@@ -54,9 +54,12 @@ def _preset_of(row):
 # pins a floor (regression = measured below it), a "lower" key pins a
 # ceiling (regression = measured above it). comm_* keys come from
 # `bench.py --comm` (ISSUE 4): bytes-on-wire and quantized-allreduce
-# latency must never grow past their pinned ceilings.
+# latency must never grow past their pinned ceilings. llm_* keys come from
+# `bench.py --llm` (ISSUE 5): generated tokens/sec is a floor, p95
+# time-to-first-token a ceiling.
 GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
-             "comm_bytes_per_step": "lower", "allreduce_ms": "lower"}
+             "comm_bytes_per_step": "lower", "allreduce_ms": "lower",
+             "llm_tok_s": "higher", "llm_ttft_ms": "lower"}
 
 
 def _metrics_of(row):
@@ -67,7 +70,7 @@ def _metrics_of(row):
     if v is not None:
         out["mfu"] = float(v)
     for k in ("serve_qps", "serve_p99_ms", "comm_bytes_per_step",
-              "allreduce_ms"):
+              "allreduce_ms", "llm_tok_s", "llm_ttft_ms"):
         if extra.get(k) is not None:
             out[k] = float(extra[k])
     return out

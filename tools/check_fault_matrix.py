@@ -7,8 +7,10 @@ the training scenarios in tests/test_resilient.py (SIGKILL
 mid-checkpoint, SIGTERM preemption, NaN loss; docs/fault_tolerance.md)
 and the serving graceful-drain scenario in tests/test_serving.py
 (SIGTERM to a live server: admissions stop, every accepted request is
-answered, exit 0; docs/serving.md) — then prints a pass/fail table.
-Exit 0 iff every scenario recovered.
+answered, exit 0; docs/serving.md), plus the LLM-engine scenarios in
+tests/test_llm_engine.py (slot exhaustion → queueing + admission
+rejects, and SIGTERM drain of in-flight /generate sequences) — then
+prints a pass/fail table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
     python tools/check_fault_matrix.py --list     # show scenarios only
@@ -29,6 +31,7 @@ MARKER = "fault_matrix"
 TEST_FILES = [
     os.path.join("tests", "test_resilient.py"),
     os.path.join("tests", "test_serving.py"),
+    os.path.join("tests", "test_llm_engine.py"),
 ]
 
 
