@@ -18,12 +18,19 @@ Deterministic scheduler testing (no real sleeps):
     report = serving.replay(engine, serving.poisson_trace(...))
 
 See docs/serving.md for architecture and tuning (max_wait_ms vs p99,
-pow2 bucketing vs symbolic-batch exports).
+pow2 bucketing vs symbolic-batch exports) and its reliability section
+(ISSUE 6) for the supervision + overload-control layer: EngineSupervisor
+(hung-dispatch watchdog, typed DispatchFailedError, circuit breaker ->
+/healthz 503 + drain), SLO classes with shed-lowest-first admission,
+token-budget backpressure (HTTP 429 + Retry-After) and brownout.
 """
 from .clock import Clock, MonotonicClock, SimClock  # noqa: F401
 from .engine import (BatchingEngine, DeadlineExceededError,  # noqa: F401
                      EngineConfig, RejectedError)
-from .metrics import LLMMetrics, ServingMetrics, parse_exposition  # noqa: F401
+from .metrics import (SLO_CLASSES, LLMMetrics, ServingMetrics,  # noqa: F401
+                      parse_exposition)
+from .supervisor import (DispatchFailedError, DispatchHungError,  # noqa: F401
+                         EngineSupervisor)
 from .sim import (Arrival, ReplayReport, poisson_trace,  # noqa: F401
                   replay, uniform_trace)
 from .server import ServingServer, serve  # noqa: F401

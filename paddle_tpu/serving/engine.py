@@ -41,12 +41,23 @@ import numpy as np
 
 from .clock import Clock, MonotonicClock, SimClock
 from .metrics import ServingMetrics
+from .supervisor import DispatchFailedError, EngineSupervisor
 
 _log = logging.getLogger("paddle_tpu.serving")
 
 
 class RejectedError(RuntimeError):
-    """Admission control fast-fail: queue at capacity or engine draining."""
+    """Admission control fast-fail. `reason` is machine-readable and
+    matches the reject-reason metric label ("queue_full", "draining",
+    "shed", "token_budget", "circuit_open", "drain_timeout", ...);
+    `retry_after_s`, when set, is the backpressure hint the HTTP layer
+    surfaces as a Retry-After header on 429 responses."""
+
+    def __init__(self, msg: str, reason: str = "rejected",
+                 retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 class DeadlineExceededError(TimeoutError):
@@ -67,6 +78,13 @@ class EngineConfig:
     #                                     plain callables, False for
     #                                     symbolic-batch (dynamic) exports
     drain_timeout_s: float = 30.0
+    dispatch_timeout_s: Optional[float] = None  # hung-dispatch watchdog
+    #                                  (None: a wedged predict_fn blocks the
+    #                                  scheduler until drain_timeout_s bails
+    #                                  the queue out)
+    breaker_threshold: int = 3     # consecutive failed dispatches that open
+    #                                the engine circuit breaker
+    retry_after_s: float = 1.0     # backpressure hint on overload rejects
 
     def __post_init__(self):
         if self.max_batch_size < 1:
@@ -82,6 +100,10 @@ class EngineConfig:
             raise ValueError(
                 f"max_request_rows must be >= 1, got "
                 f"{self.max_request_rows}")
+        if self.breaker_threshold < 1:
+            raise ValueError(
+                f"breaker_threshold must be >= 1, got "
+                f"{self.breaker_threshold}")
 
 
 class _Request:
@@ -132,7 +154,8 @@ class BatchingEngine:
     def __init__(self, predict_fn: Callable, config: Optional[EngineConfig]
                  = None, clock: Optional[Clock] = None,
                  metrics: Optional[ServingMetrics] = None,
-                 dynamic_batch: bool = False):
+                 dynamic_batch: bool = False, fault_plan=None,
+                 on_break: Optional[Callable[[], None]] = None):
         self.predict_fn = predict_fn
         self.config = config or EngineConfig()
         self.clock = clock or MonotonicClock()
@@ -146,6 +169,19 @@ class BatchingEngine:
         self._draining = False
         self._stopped = False
         self._thread: Optional[threading.Thread] = None
+        # supervision (ISSUE 6): watchdog-bounded dispatches, a circuit
+        # breaker over consecutive dispatch failures, and the shared
+        # fault-injection plan (None -> the env-driven global plan)
+        if fault_plan is None:
+            from ..utils.fault_injection import global_plan
+            fault_plan = global_plan()
+        self._fault_plan = fault_plan
+        self.on_break = on_break
+        self.supervisor = EngineSupervisor(
+            dispatch_timeout_s=self.config.dispatch_timeout_s,
+            breaker_threshold=self.config.breaker_threshold,
+            on_trip=self._on_breaker_trip, name="serving")
+        self._dispatch_idx = 0   # running count of supervised dispatches
 
     @classmethod
     def from_predictor(cls, predictor, config: Optional[EngineConfig] = None,
@@ -190,7 +226,8 @@ class BatchingEngine:
                 while self._pending:
                     req = self._pending.popleft()
                     req.future.set_exception(
-                        RejectedError("engine shut down before dispatch"))
+                        RejectedError("engine shut down before dispatch",
+                                      reason="shutdown"))
                     self.metrics.on_reject("shutdown")
                 self.metrics.set_queue_depth(0)
             self._cond.notify_all()
@@ -216,7 +253,8 @@ class BatchingEngine:
             while self._pending:
                 req = self._pending.popleft()
                 req.future.set_exception(RejectedError(
-                    "engine drain timed out before dispatch"))
+                    "engine drain timed out before dispatch",
+                    reason="drain_timeout"))
                 self.metrics.on_reject("drain_timeout")
                 stranded += 1
             if stranded:
@@ -227,6 +265,34 @@ class BatchingEngine:
     @property
     def draining(self) -> bool:
         return self._draining
+
+    @property
+    def broken(self) -> bool:
+        """Circuit breaker open: the engine saw `breaker_threshold`
+        consecutive dispatch failures and has stopped admitting."""
+        return self.supervisor.open
+
+    def _on_breaker_trip(self):
+        """Repeated engine-level failures: stop admitting (submit ->
+        RejectedError reason "circuit_open"), fail everything still queued
+        — each pending dispatch would only fail again — and notify the
+        front end (which flips /healthz to 503 and starts a drain on its
+        own thread)."""
+        with self._cond:
+            while self._pending:
+                req = self._pending.popleft()
+                req.future.set_exception(RejectedError(
+                    "engine circuit breaker open after repeated dispatch "
+                    "failures", reason="circuit_open"))
+                self.metrics.on_reject("circuit_open")
+            self.metrics.set_queue_depth(0)
+            self._cond.notify_all()
+        self.metrics.set_circuit_open(True)
+        if self.on_break is not None:
+            try:
+                self.on_break()
+            except Exception:
+                _log.exception("on_break callback failed")
 
     def __enter__(self):
         return self
@@ -259,20 +325,27 @@ class BatchingEngine:
             self.metrics.on_reject("too_many_rows")
             raise RejectedError(
                 f"request rows ({rows}) exceed max_request_rows "
-                f"({self.config.max_request_rows})")
+                f"({self.config.max_request_rows})", reason="too_many_rows")
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
         now = self.clock.now()
         deadline = None if deadline_ms is None else now + deadline_ms / 1e3
         with self._cond:
+            if self.supervisor.open:
+                self.metrics.on_reject("circuit_open")
+                raise RejectedError(
+                    "engine circuit breaker open after repeated dispatch "
+                    "failures; request rejected", reason="circuit_open")
             if self._draining or self._stopped:
                 self.metrics.on_reject("draining")
-                raise RejectedError("engine is draining; request rejected")
+                raise RejectedError("engine is draining; request rejected",
+                                    reason="draining")
             if len(self._pending) >= self.config.max_queue_depth:
                 self.metrics.on_reject("queue_full")
                 raise RejectedError(
                     f"queue at capacity ({self.config.max_queue_depth} "
-                    "pending requests)")
+                    "pending requests)", reason="queue_full",
+                    retry_after_s=self.config.retry_after_s)
             req = _Request(arrays, rows, now, deadline)
             self._pending.append(req)
             self.metrics.on_submit(len(self._pending))
@@ -360,6 +433,31 @@ class BatchingEngine:
             self.metrics.set_queue_depth(len(alive))
 
     # ---- dispatch ----
+    def _supervised_predict(self, args):
+        """One watchdog-bounded, fault-injectable predict dispatch. Raises
+        DispatchFailedError / DispatchHungError, counted by the circuit
+        breaker: the stateless engine has no per-request retry (a batch's
+        rows left the queue; re-running them after a partial failure could
+        double-apply side-effectful predictors), so every failed dispatch
+        is an engine-level failure."""
+        idx = self._dispatch_idx
+        self._dispatch_idx += 1
+        plan = self._fault_plan
+
+        def guarded():
+            if plan is not None:
+                plan.maybe_dispatch_fault(idx, kind="predict")
+            return self.predict_fn(args)
+
+        try:
+            outs = self.supervisor.run(guarded, label="predict")
+        except DispatchFailedError as e:
+            self.metrics.on_dispatch_failure(e.reason)
+            self.supervisor.record_failure()
+            raise
+        self.supervisor.record_success()
+        return outs
+
     def _dispatch(self, batch: List[_Request]):
         t0 = self.clock.now()
         total = sum(r.rows for r in batch)
@@ -385,7 +483,7 @@ class BatchingEngine:
                         [a,
                          np.zeros((padded - total,) + a.shape[1:], a.dtype)],
                         axis=0) for a in args]
-            outs = list(self.predict_fn(args))
+            outs = list(self._supervised_predict(args))
         except Exception as e:
             for r in batch:
                 r.future.set_exception(e)

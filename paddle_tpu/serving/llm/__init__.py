@@ -19,5 +19,6 @@ See docs/serving.md (LLM decode engine section) for slot-pool sizing and
 block_len tradeoffs.
 """
 from .kv_pool import SlotPagedKVPool, SlotsExhaustedError  # noqa: F401
-from .llm_engine import (GenerationHandle, LLMEngine,  # noqa: F401
+from .llm_engine import (DispatchFailedError,  # noqa: F401
+                         DispatchHungError, GenerationHandle, LLMEngine,
                          LLMEngineConfig)

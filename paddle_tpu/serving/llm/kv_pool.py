@@ -152,6 +152,21 @@ class SlotPagedKVPool:
             "total_blocks": self.num_slots * self.n_blocks,
         }
 
+    def check_balance(self) -> bool:
+        """Slot-accounting invariant the fault matrix proves: every slot
+        ever allocated was either freed or is still active —
+        `allocs == frees + active_slots` — i.e. no failure path leaked a
+        slot. Raises AssertionError with the ledger on violation."""
+        allocs = self.stats["allocs"]
+        frees = self.stats["frees"]
+        active = self.active_slots()
+        if allocs != frees + active:
+            raise AssertionError(
+                f"KV pool slot ledger out of balance: allocs={allocs} != "
+                f"frees={frees} + active={active} "
+                f"(leaked {allocs - frees - active})")
+        return True
+
     # ---- hygiene ----
     def defrag(self) -> int:
         """Scrub stale KV out of freed slots (one jitted masked multiply

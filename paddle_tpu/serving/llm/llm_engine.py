@@ -1,5 +1,5 @@
 """Continuous-batching LLM decode engine over the slot-paged KV pool
-(ISSUE 5 tentpole).
+(ISSUE 5 tentpole; ISSUE 6 supervision + overload control).
 
 The batch-locked `models.generation.generate()` loop makes every sequence
 enter together, share one prompt length and pay the batch's full
@@ -24,6 +24,27 @@ per row) as a continuously-batched service:
   requests are dropped before prefill; decoding rows are evicted
   mid-stream with their partial tokens still readable off the handle).
 
+Supervision (ISSUE 6): every jitted dispatch runs through an
+`EngineSupervisor` — failures arrive as typed `DispatchFailedError`s, a
+hung dispatch trips the watchdog (`DispatchHungError`), and the failure
+protocol keeps faults request-scoped: a failing prefill retries and then
+quarantines ONLY its request (reason "poisoned", slot freed); a failing
+decode retries whole, then blame-probes each active row in isolation and
+quarantines the implicated ones, so survivors' streams stay bit-identical
+to a fault-free run; non-attributable decode failures fail the active
+rows and count toward the engine circuit breaker, which opens after
+`breaker_threshold` consecutive engine-level failures (admissions reject
+with reason "circuit_open", /healthz flips to 503, the server drains).
+
+Overload control (ISSUE 6): requests carry an SLO class —
+`interactive` > `batch` > `best_effort` — admitted in strict priority
+order from per-class queues. A full queue or an exceeded token budget
+(`max_inflight_tokens`, estimated cost = prompt_len + max_new_tokens over
+queued + active) sheds the NEWEST queued request of the lowest class
+below the submitter (reason "shed") before rejecting; sustained queue
+pressure enters brownout, capping newly-admitted `max_new_tokens` so the
+backlog drains at interactive-friendly latency.
+
 Determinism: every decision is a pure function of `clock.now()` and the
 queue/pool tables. Under a `SimClock` the engine runs threadless and a
 test harness calls `pump()` directly — slot churn and decode-iteration
@@ -40,7 +61,7 @@ import threading
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -48,7 +69,9 @@ import numpy as np
 
 from ..clock import Clock, MonotonicClock, SimClock
 from ..engine import DeadlineExceededError, RejectedError
-from ..metrics import LLMMetrics
+from ..metrics import LLMMetrics, SLO_CLASSES
+from ..supervisor import (DispatchFailedError, DispatchHungError,  # noqa: F401
+                          EngineSupervisor)
 from .kv_pool import SlotPagedKVPool, SlotsExhaustedError
 
 _log = logging.getLogger("paddle_tpu.serving.llm")
@@ -69,6 +92,22 @@ class LLMEngineConfig:
     min_prompt_bucket: int = 8
     drain_timeout_s: float = 60.0
     cache_dtype: Optional[object] = None  # pool slab dtype override
+    # ---- overload control (ISSUE 6) ----
+    default_slo: str = "batch"     # SLO class when submit() names none
+    max_inflight_tokens: Optional[int] = None  # token-budget admission:
+    #                                  sum of (prompt + max_new_tokens) over
+    #                                  queued + active requests (None: off)
+    brownout_queue_depth: Optional[int] = None  # queued requests at/above
+    #                                  this enter brownout (None: off);
+    #                                  exits at half the threshold
+    brownout_max_new_tokens: int = 8  # admission-time cap while browned out
+    retry_after_s: float = 1.0     # backpressure hint on overload rejects
+    # ---- supervision (ISSUE 6) ----
+    dispatch_timeout_s: Optional[float] = None  # hung-dispatch watchdog
+    prefill_retries: int = 2       # per-request retries before quarantine
+    dispatch_retries: int = 2      # whole-decode retries before blame/fail
+    breaker_threshold: int = 3     # consecutive engine-level failures that
+    #                                open the circuit breaker
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -79,6 +118,20 @@ class LLMEngineConfig:
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if self.default_slo not in SLO_CLASSES:
+            raise ValueError(
+                f"default_slo must be one of {SLO_CLASSES}, got "
+                f"{self.default_slo!r}")
+        if self.brownout_max_new_tokens < 1:
+            raise ValueError(
+                f"brownout_max_new_tokens must be >= 1, got "
+                f"{self.brownout_max_new_tokens}")
+        if self.prefill_retries < 0 or self.dispatch_retries < 0:
+            raise ValueError("retry counts must be >= 0")
+        if self.breaker_threshold < 1:
+            raise ValueError(
+                f"breaker_threshold must be >= 1, got "
+                f"{self.breaker_threshold}")
 
 
 class GenerationHandle:
@@ -86,12 +139,14 @@ class GenerationHandle:
 
     Tokens stream into `tokens_so_far()` as decode iterations retire them;
     `future` resolves with the full np.int32 array on EOS/max-tokens, or
-    with DeadlineExceededError / RejectedError on eviction (partial tokens
-    stay readable off the handle either way)."""
+    with DeadlineExceededError / RejectedError / DispatchFailedError on
+    eviction (partial tokens stay readable off the handle either way)."""
 
-    def __init__(self, prompt_len: int, max_new_tokens: int):
+    def __init__(self, prompt_len: int, max_new_tokens: int,
+                 slo: str = "batch"):
         self.prompt_len = prompt_len
         self.max_new_tokens = max_new_tokens
+        self.slo = slo
         self.future: Future = Future()
         self.ttft_ms: Optional[float] = None
         self._lock = threading.Lock()
@@ -111,16 +166,21 @@ class GenerationHandle:
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "eos_token_id", "arrival",
-                 "deadline", "handle", "slot", "emitted", "last_tok")
+                 "deadline", "handle", "slot", "emitted", "last_tok",
+                 "slo", "submit_idx", "cost")
 
     def __init__(self, prompt, max_new_tokens, eos_token_id, arrival,
-                 deadline):
+                 deadline, slo, submit_idx):
         self.prompt = prompt              # np.int32 [S]
         self.max_new_tokens = max_new_tokens
         self.eos_token_id = eos_token_id
         self.arrival = arrival            # clock seconds
         self.deadline = deadline          # absolute clock seconds or None
-        self.handle = GenerationHandle(len(prompt), max_new_tokens)
+        self.slo = slo                    # SLO class name
+        self.submit_idx = submit_idx      # lifetime admission index (fault
+        #                                   injection keys poison on this)
+        self.cost = len(prompt) + max_new_tokens  # token-budget estimate
+        self.handle = GenerationHandle(len(prompt), max_new_tokens, slo)
         self.slot: Optional[int] = None
         self.emitted: List[int] = []
         self.last_tok: int = 0
@@ -140,11 +200,18 @@ class LLMEngine:
     (`init_cache` / `forward_with_cache`, e.g. GPTForCausalLM /
     LlamaForCausalLM); it is switched to eval mode and its functional
     state captured once at construction.
+
+    `fault_plan` (None → the PDTPU_FAULTS-driven global plan) injects
+    deterministic dispatch faults for the fault-matrix tests; `on_break`
+    fires once when the circuit breaker opens (the server wires it to a
+    drain on its own thread).
     """
 
     def __init__(self, model, config: Optional[LLMEngineConfig] = None,
                  clock: Optional[Clock] = None,
-                 metrics: Optional[LLMMetrics] = None):
+                 metrics: Optional[LLMMetrics] = None,
+                 fault_plan=None,
+                 on_break: Optional[Callable[[], None]] = None):
         from ...models.generation import make_decoder_fns
         self.model = model
         model.eval()
@@ -157,15 +224,28 @@ class LLMEngine:
             model.init_cache, self.config.num_slots, self.config.block_len,
             self.config.n_blocks, dtype=self.config.cache_dtype)
         self.metrics.set_slots(0, self.pool.num_slots)
-        self._queue: deque = deque()
+        self._queues: Dict[str, deque] = {c: deque() for c in SLO_CLASSES}
         self._active: Dict[int, _GenRequest] = {}   # slot -> request
         self._cond = threading.Condition()
         self._draining = False
         self._stopped = False
+        self._brownout = False
         self._thread: Optional[threading.Thread] = None
         self._prefill_jit: Dict[int, object] = {}   # prompt bucket -> fn
         self._decode_jit = None
         self.decode_iterations = 0   # lifetime decode_step dispatches
+        self._submit_idx = 0         # lifetime admissions (poison keying)
+        self._dispatch_idx = 0       # lifetime dispatch attempts (fault
+        #                              clauses key on this index)
+        if fault_plan is None:
+            from ...utils.fault_injection import global_plan
+            fault_plan = global_plan()
+        self._fault_plan = fault_plan
+        self.on_break = on_break
+        self.supervisor = EngineSupervisor(
+            dispatch_timeout_s=self.config.dispatch_timeout_s,
+            breaker_threshold=self.config.breaker_threshold,
+            on_trip=self._on_breaker_trip, name="llm")
 
     # ---- jitted executables ----
     def _prefill_for_bucket(self, bucket: int):
@@ -207,6 +287,23 @@ class LLMEngine:
             self._decode_jit = jax.jit(decode_step)
         return self._decode_jit
 
+    # ---- supervised dispatch ----
+    def _run_dispatch(self, kind: str, fn, args, request_ids=()):
+        """One supervised jitted dispatch attempt. Every attempt — retries
+        and blame probes included — consumes a dispatch index, which is
+        what deterministic fault clauses key on."""
+        idx = self._dispatch_idx
+        self._dispatch_idx += 1
+        plan = self._fault_plan
+
+        def guarded():
+            if plan is not None:
+                plan.maybe_dispatch_fault(idx, kind=kind,
+                                          request_ids=request_ids)
+            return fn(*args)
+
+        return self.supervisor.run(guarded, label=kind)
+
     # ---- lifecycle ----
     def start(self) -> "LLMEngine":
         """Run the scheduler on a background thread (production mode). Not
@@ -231,20 +328,26 @@ class LLMEngine:
         finish EVERY admitted sequence — queued requests still get
         prefilled and decoded to completion — before stopping the
         scheduler. With drain=False, queued and decoding requests fail
-        with RejectedError instead."""
+        with RejectedError instead. A drain that cannot finish inside
+        `timeout` (default config.drain_timeout_s) fails the stragglers
+        with RejectedError(reason="drain_timeout") rather than joining
+        forever on futures that can never resolve."""
         with self._cond:
             if self._stopped:
                 return
             self._draining = True
             if not drain:
-                while self._queue:
-                    req = self._queue.popleft()
-                    req.handle.future.set_exception(
-                        RejectedError("engine shut down before prefill"))
-                    self.metrics.on_reject("shutdown")
+                for q in self._queues.values():
+                    while q:
+                        req = q.popleft()
+                        req.handle.future.set_exception(
+                            RejectedError("engine shut down before prefill",
+                                          reason="shutdown"))
+                        self.metrics.on_reject("shutdown")
                 for slot, req in list(self._active.items()):
                     req.handle.future.set_exception(
-                        RejectedError("engine shut down mid-decode"))
+                        RejectedError("engine shut down mid-decode",
+                                      reason="shutdown"))
                     self.metrics.on_reject("shutdown")
                     self.pool.free(slot)
                 self._active.clear()
@@ -261,21 +364,35 @@ class LLMEngine:
                     "llm drain did not complete within %.1fs; failing "
                     "sequences still in flight", join_s)
         else:
-            # threadless (sim) mode: run the scheduler inline to completion
-            while self._queue or self._active:
-                if self.pump() == 0 and not self._queue and not self._active:
+            # threadless (sim) mode: run the scheduler inline to
+            # completion, with a no-progress guard so a pump that can no
+            # longer advance anything (e.g. breaker open mid-drain) falls
+            # through to the stranded-future cleanup instead of spinning
+            prev = None
+            while True:
+                with self._cond:
+                    if not (self._queue_len_locked() or self._active):
+                        break
+                self.pump()
+                state = (self._queue_len_locked(), len(self._active),
+                         self._dispatch_idx)
+                if state == prev:
                     break
+                prev = state
         with self._cond:
             stranded = 0
-            while self._queue:
-                req = self._queue.popleft()
-                req.handle.future.set_exception(RejectedError(
-                    "engine drain timed out before prefill"))
-                self.metrics.on_reject("drain_timeout")
-                stranded += 1
+            for q in self._queues.values():
+                while q:
+                    req = q.popleft()
+                    req.handle.future.set_exception(RejectedError(
+                        "engine drain timed out before prefill",
+                        reason="drain_timeout"))
+                    self.metrics.on_reject("drain_timeout")
+                    stranded += 1
             for slot, req in list(self._active.items()):
                 req.handle.future.set_exception(RejectedError(
-                    "engine drain timed out mid-decode"))
+                    "engine drain timed out mid-decode",
+                    reason="drain_timeout"))
                 self.metrics.on_reject("drain_timeout")
                 self.pool.free(slot)
                 stranded += 1
@@ -290,6 +407,34 @@ class LLMEngine:
     def draining(self) -> bool:
         return self._draining
 
+    @property
+    def broken(self) -> bool:
+        """Circuit breaker open: repeated engine-level dispatch failures;
+        admissions reject and /healthz reports 503."""
+        return self.supervisor.open
+
+    def _on_breaker_trip(self):
+        """Repeated engine-level failures: admissions stop (submit ->
+        "circuit_open"), queued requests fail now — their dispatches would
+        only fail again — and the front end is notified so it can flip
+        /healthz and drain on its own thread."""
+        with self._cond:
+            for q in self._queues.values():
+                while q:
+                    req = q.popleft()
+                    req.handle.future.set_exception(RejectedError(
+                        "engine circuit breaker open after repeated "
+                        "dispatch failures", reason="circuit_open"))
+                    self.metrics.on_reject("circuit_open")
+            self.metrics.set_queue_depth(0)
+            self._cond.notify_all()
+        self.metrics.set_circuit_open(True)
+        if self.on_break is not None:
+            try:
+                self.on_break()
+            except Exception:
+                _log.exception("llm on_break callback failed")
+
     def __enter__(self):
         return self
 
@@ -298,12 +443,77 @@ class LLMEngine:
         return False
 
     # ---- admission ----
+    def _queue_len_locked(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _pop_next_locked(self) -> Optional[_GenRequest]:
+        for cls in SLO_CLASSES:     # strict priority order
+            if self._queues[cls]:
+                return self._queues[cls].popleft()
+        return None
+
+    def _inflight_tokens_locked(self) -> int:
+        """Estimated token cost of everything admitted: queued + active.
+        Recomputed from the tables (never incrementally maintained), so a
+        failure path can never leak budget."""
+        return (sum(r.cost for q in self._queues.values() for r in q)
+                + sum(r.cost for r in self._active.values()))
+
+    def _update_brownout_locked(self):
+        if self.config.brownout_queue_depth is None:
+            return
+        depth = self._queue_len_locked()
+        if not self._brownout and depth >= self.config.brownout_queue_depth:
+            self._brownout = True
+            self.metrics.set_brownout(True)
+            _log.warning(
+                "llm engine entering brownout at queue depth %d: capping "
+                "admitted max_new_tokens to %d", depth,
+                self.config.brownout_max_new_tokens)
+        elif self._brownout and depth <= self.config.brownout_queue_depth // 2:
+            self._brownout = False
+            self.metrics.set_brownout(False)
+            _log.info("llm engine exiting brownout at queue depth %d", depth)
+
+    def _make_room_locked(self, slo: str, cost: int) -> Optional[str]:
+        """Shed-lowest-first: while the queue or token budget blocks this
+        admission, fail the NEWEST queued request of the lowest class
+        strictly below `slo` (reason "shed"). Returns None when the
+        request can be admitted, else the reject reason."""
+        pri = SLO_CLASSES.index(slo)
+        while True:
+            depth_full = (self._queue_len_locked()
+                          >= self.config.max_queue_depth)
+            budget = self.config.max_inflight_tokens
+            over_budget = (budget is not None
+                           and self._inflight_tokens_locked() + cost > budget)
+            if not depth_full and not over_budget:
+                return None
+            victim = None
+            for cls in reversed(SLO_CLASSES):   # lowest class first
+                if SLO_CLASSES.index(cls) <= pri:
+                    break
+                if self._queues[cls]:
+                    victim = self._queues[cls].pop()   # newest of its class
+                    break
+            if victim is None:
+                return "queue_full" if depth_full else "token_budget"
+            victim.handle.future.set_exception(RejectedError(
+                f"shed ({victim.slo}) to admit {slo} traffic under "
+                "overload", reason="shed",
+                retry_after_s=self.config.retry_after_s))
+            self.metrics.on_reject("shed")
+            self.metrics.on_shed(victim.slo)
+
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
-               deadline_ms: Optional[float] = None) -> GenerationHandle:
-        """Admit one prompt (1-D int token ids). Raises RejectedError when
-        the sequence can never fit a slot, the queue is full, or the engine
-        is draining."""
+               deadline_ms: Optional[float] = None,
+               slo: Optional[str] = None) -> GenerationHandle:
+        """Admit one prompt (1-D int token ids). `slo` names the request's
+        SLO class (config.default_slo when None). Raises RejectedError
+        when the sequence can never fit a slot, the queue/token budget is
+        exhausted and nothing lower-priority can be shed, the engine is
+        draining, or the circuit breaker is open."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must contain at least one token")
@@ -311,45 +521,70 @@ class LLMEngine:
                else int(max_new_tokens))
         if mnt < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {mnt}")
+        slo = self.config.default_slo if slo is None else slo
+        if slo not in SLO_CLASSES:
+            raise ValueError(
+                f"slo must be one of {SLO_CLASSES}, got {slo!r}")
         eos = (self.config.eos_token_id if eos_token_id is None
                else eos_token_id)
         if prompt.size + mnt > self.pool.capacity:
             self.metrics.on_reject("prompt_too_long")
             raise RejectedError(
                 f"prompt ({prompt.size}) + max_new_tokens ({mnt}) exceeds "
-                f"slot capacity ({self.pool.capacity} tokens)")
+                f"slot capacity ({self.pool.capacity} tokens)",
+                reason="prompt_too_long")
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
         now = self.clock.now()
         deadline = None if deadline_ms is None else now + deadline_ms / 1e3
         with self._cond:
+            if self.supervisor.open:
+                self.metrics.on_reject("circuit_open")
+                raise RejectedError(
+                    "engine circuit breaker open after repeated dispatch "
+                    "failures; request rejected", reason="circuit_open")
             if self._draining or self._stopped:
                 self.metrics.on_reject("draining")
-                raise RejectedError("engine is draining; request rejected")
-            if len(self._queue) >= self.config.max_queue_depth:
-                self.metrics.on_reject("queue_full")
+                raise RejectedError("engine is draining; request rejected",
+                                    reason="draining")
+            self._update_brownout_locked()
+            if self._brownout and mnt > self.config.brownout_max_new_tokens:
+                mnt = self.config.brownout_max_new_tokens
+            reason = self._make_room_locked(slo, prompt.size + mnt)
+            if reason is not None:
+                self.metrics.on_reject(reason)
+                detail = (f"queue at capacity ({self.config.max_queue_depth} "
+                          "pending requests)" if reason == "queue_full" else
+                          f"token budget exhausted "
+                          f"({self.config.max_inflight_tokens} in-flight "
+                          "tokens)")
                 raise RejectedError(
-                    f"queue at capacity ({self.config.max_queue_depth} "
-                    "pending requests)")
-            req = _GenRequest(prompt, mnt, eos, now, deadline)
-            self._queue.append(req)
-            self.metrics.on_submit(len(self._queue))
+                    f"{detail}; nothing below class {slo!r} to shed",
+                    reason=reason,
+                    retry_after_s=self.config.retry_after_s)
+            req = _GenRequest(prompt, mnt, eos, now, deadline, slo,
+                              self._submit_idx)
+            self._submit_idx += 1
+            self._queues[slo].append(req)
+            self.metrics.on_submit(self._queue_len_locked(), slo=slo)
+            self.metrics.set_inflight_tokens(self._inflight_tokens_locked())
             self._cond.notify_all()
         return req.handle
 
     def generate(self, prompt, max_new_tokens: Optional[int] = None,
                  eos_token_id: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
-                 timeout: Optional[float] = None) -> np.ndarray:
+                 timeout: Optional[float] = None,
+                 slo: Optional[str] = None) -> np.ndarray:
         """Synchronous convenience: submit + wait for the full sequence."""
         return self.submit(prompt, max_new_tokens=max_new_tokens,
                            eos_token_id=eos_token_id,
-                           deadline_ms=deadline_ms).result(timeout)
+                           deadline_ms=deadline_ms, slo=slo).result(timeout)
 
     # ---- scheduling ----
     def has_work(self) -> bool:
         with self._cond:
-            return bool(self._queue or self._active)
+            return bool(self._queue_len_locked() or self._active)
 
     def next_event_time(self) -> Optional[float]:
         """Clock instant of the next scheduler action — `now` whenever any
@@ -357,7 +592,7 @@ class LLMEngine:
         immediately due), None when idle. The sim harness advances its
         clock here between scripted arrivals."""
         with self._cond:
-            if self._queue or self._active:
+            if self._queue_len_locked() or self._active:
                 return self.clock.now()
             return None
 
@@ -372,60 +607,110 @@ class LLMEngine:
         now = self.clock.now()
         self._drop_expired_queued(now)
         self._admit()
-        return self._decode_once()
+        n = self._decode_once()
+        with self._cond:
+            self.metrics.set_inflight_tokens(self._inflight_tokens_locked())
+        return n
 
     def _drop_expired_queued(self, now: float):
         with self._cond:
-            if not self._queue:
-                return
-            alive = deque()
             expired = 0
-            for r in self._queue:
-                if r.deadline is not None and now >= r.deadline:
-                    r.handle.future.set_exception(DeadlineExceededError(
-                        f"deadline expired after "
-                        f"{(now - r.arrival) * 1e3:.1f}ms in queue "
-                        "(dropped before prefill)"))
-                    expired += 1
-                else:
-                    alive.append(r)
+            for cls, q in self._queues.items():
+                if not q:
+                    continue
+                alive = deque()
+                for r in q:
+                    if r.deadline is not None and now >= r.deadline:
+                        r.handle.future.set_exception(DeadlineExceededError(
+                            f"deadline expired after "
+                            f"{(now - r.arrival) * 1e3:.1f}ms in queue "
+                            "(dropped before prefill)"))
+                        expired += 1
+                    else:
+                        alive.append(r)
+                if len(alive) != len(q):
+                    self._queues[cls] = alive
             if expired:
-                self._queue = alive
                 self.metrics.on_expire(expired)
-                self.metrics.set_queue_depth(len(alive))
+                self.metrics.set_queue_depth(self._queue_len_locked())
 
     def _admit(self):
-        """Prefill queued requests into free slots. Runs between decode
-        iterations — each admission is one jitted prefill_into_slot call
-        that also emits the request's first token (TTFT)."""
+        """Prefill queued requests into free slots, highest SLO class
+        first. Runs between decode iterations — each admission is one
+        supervised jitted prefill_into_slot call that also emits the
+        request's first token (TTFT)."""
         while True:
             with self._cond:
-                if not self._queue or self.pool.free_slots() == 0:
+                self._update_brownout_locked()
+                if self.supervisor.open or self.pool.free_slots() == 0:
                     return
-                req = self._queue.popleft()
-                self.metrics.set_queue_depth(len(self._queue))
-                slot = self.pool.allocate(
-                    len(req.prompt) + req.max_new_tokens)
-            length = len(req.prompt)
-            bucket = self._bucket_of(length)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :length] = req.prompt
-            fn = self._prefill_for_bucket(bucket)
-            tok0, self.pool.slabs = fn(self.params, jnp.asarray(padded),
-                                       jnp.int32(length), jnp.int32(slot),
-                                       self.pool.slabs)
-            now = self.clock.now()
-            req.slot = slot
-            req.handle.ttft_ms = (now - req.arrival) * 1e3
-            self.metrics.on_prefill(req.handle.ttft_ms)
-            self._emit(req, int(tok0))
+                req = self._pop_next_locked()
+                if req is None:
+                    return
+                self.metrics.set_queue_depth(self._queue_len_locked())
+                slot = self.pool.allocate(req.cost)
+            self._prefill_into(req, slot)
+
+    def _prefill_into(self, req: _GenRequest, slot: int) -> bool:
+        """Supervised prefill with the per-request failure protocol: retry
+        up to config.prefill_retries times; exhaustion quarantines THIS
+        request (prefill carries exactly one, so attribution is exact) —
+        its future fails with reason "poisoned", its slot is freed, and
+        the breaker is absolved (a poisoned request is not an engine
+        fault). Returns True when the request prefilled."""
+        length = len(req.prompt)
+        bucket = self._bucket_of(length)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :length] = req.prompt
+        fn = self._prefill_for_bucket(bucket)
+        args = (self.params, jnp.asarray(padded), jnp.int32(length),
+                jnp.int32(slot), self.pool.slabs)
+        attempts = self.config.prefill_retries + 1
+        last_err = None
+        for attempt in range(attempts):
+            try:
+                tok0, new_slabs = self._run_dispatch(
+                    "prefill", fn, args, request_ids=(req.submit_idx,))
+            except DispatchFailedError as e:
+                last_err = e
+                self.metrics.on_dispatch_failure(e.reason)
+                _log.warning(
+                    "prefill dispatch failed for request %d "
+                    "(attempt %d/%d): %s", req.submit_idx, attempt + 1,
+                    attempts, e)
+                continue
+            self.pool.slabs = new_slabs
+            # NOTE: a prefill success does not record_success() — the
+            # breaker tracks ENGINE-level (decode-protocol) failures, and a
+            # broken engine that still lands per-request prefills must not
+            # have its failure streak laundered between decode attempts
+            break
+        else:
             with self._cond:
-                if self._finish_if_done(req, now):
-                    continue
-                self.pool.set_length(slot, length)
-                self._active[slot] = req
+                self.pool.free(slot)
                 self.metrics.set_slots(self.pool.active_slots(),
                                        self.pool.num_slots)
+            req.handle.future.set_exception(DispatchFailedError(
+                f"request {req.submit_idx} quarantined: prefill failed "
+                f"{attempts} consecutive times ({last_err})",
+                reason="poisoned"))
+            self.metrics.on_fail()
+            self.metrics.on_quarantine()
+            self.supervisor.absolve()
+            return False
+        now = self.clock.now()
+        req.slot = slot
+        req.handle.ttft_ms = (now - req.arrival) * 1e3
+        self.metrics.on_prefill(req.handle.ttft_ms, slo=req.slo)
+        self._emit(req, int(tok0))
+        with self._cond:
+            if self._finish_if_done(req, now):
+                return True
+            self.pool.set_length(slot, length)
+            self._active[slot] = req
+            self.metrics.set_slots(self.pool.active_slots(),
+                                   self.pool.num_slots)
+        return True
 
     def _bucket_of(self, length: int) -> int:
         if not self.config.prompt_bucket_pow2:
@@ -434,42 +719,137 @@ class LLMEngine:
                    min(_next_pow2(length), self.pool.capacity))
 
     def _decode_once(self) -> int:
-        with self._cond:
-            if not self._active:
+        while True:
+            with self._cond:
+                if not self._active:
+                    return 0
+                toks = np.zeros((self.pool.num_slots,), np.int32)
+                pos = np.zeros((self.pool.num_slots,), np.int32)
+                for slot, req in self._active.items():
+                    toks[slot] = req.last_tok
+                    pos[slot] = self.pool.lengths[slot]
+                active_ids = tuple(sorted(
+                    r.submit_idx for r in self._active.values()))
+            t0 = self.clock.now()
+            fn = self._decode()
+            args = (self.params, jnp.asarray(toks), jnp.asarray(pos),
+                    self.pool.slabs)
+            attempts = self.config.dispatch_retries + 1
+            last_err = None
+            nxt = None
+            for attempt in range(attempts):
+                try:
+                    nxt, new_slabs = self._run_dispatch(
+                        "decode", fn, args, request_ids=active_ids)
+                except DispatchFailedError as e:
+                    last_err = e
+                    self.metrics.on_dispatch_failure(e.reason)
+                    _log.warning(
+                        "decode dispatch failed over %d active rows "
+                        "(attempt %d/%d): %s", len(active_ids), attempt + 1,
+                        attempts, e)
+                    continue
+                self.pool.slabs = new_slabs
+                self.supervisor.record_success()
+                break
+            else:
+                if self._blame_and_quarantine(fn, toks, pos, last_err):
+                    continue    # survivors retry on a rebuilt row set
+                self._fail_all_active(attempts, last_err)
+                self.supervisor.record_failure()
                 return 0
-            toks = np.zeros((self.pool.num_slots,), np.int32)
-            pos = np.zeros((self.pool.num_slots,), np.int32)
-            for slot, req in self._active.items():
-                toks[slot] = req.last_tok
-                pos[slot] = self.pool.lengths[slot]
-        t0 = self.clock.now()
-        nxt, self.pool.slabs = self._decode()(
-            self.params, jnp.asarray(toks), jnp.asarray(pos),
-            self.pool.slabs)
-        nxt = np.asarray(nxt)
-        now = self.clock.now()
+            nxt = np.asarray(nxt)
+            now = self.clock.now()
+            with self._cond:
+                rows = len(self._active)
+                self.decode_iterations += 1
+                for slot, req in list(self._active.items()):
+                    # the decode wrote last_tok's KV at pos[slot]
+                    self.pool.set_length(slot, int(pos[slot]) + 1)
+                    self._emit(req, int(nxt[slot]))
+                    if self._finish_if_done(req, now):
+                        del self._active[slot]
+                    elif req.deadline is not None and now >= req.deadline:
+                        # mid-decode eviction: partial tokens stay readable
+                        # on the handle; the future fails with the error
+                        req.handle.future.set_exception(DeadlineExceededError(
+                            f"deadline expired after {len(req.emitted)} of "
+                            f"{req.max_new_tokens} tokens "
+                            "(evicted mid-decode)"))
+                        self.metrics.on_expire()
+                        self.pool.free(slot)
+                        del self._active[slot]
+                self.metrics.set_slots(self.pool.active_slots(),
+                                       self.pool.num_slots)
+            self.metrics.on_decode_step(rows, (now - t0) * 1e3)
+            return 1
+
+    def _blame_and_quarantine(self, fn, toks, pos, last_err) -> bool:
+        """Decode retries exhausted: probe each active request in
+        ISOLATION — the same fixed-width dispatch with every other row
+        masked to (tok=0, pos=0), attributed to that single request — and
+        quarantine the rows whose solo presence reproduces the failure.
+        Probe results are never committed (slabs are immutable jax arrays;
+        only a successful full decode assigns pool.slabs), so survivors'
+        streams stay bit-identical to a fault-free run.
+
+        When EVERY probe of a multi-row batch fails, the failure is not
+        attributable to any one request — that is an engine-level fault
+        and the breaker, not quarantine, must own it. A single-row batch
+        whose probe fails is quarantined: the dispatch contained exactly
+        that request, which is as exact as attribution gets."""
         with self._cond:
-            rows = len(self._active)
-            self.decode_iterations += 1
-            for slot, req in list(self._active.items()):
-                # the decode wrote last_tok's KV at pos[slot]
-                self.pool.set_length(slot, int(pos[slot]) + 1)
-                self._emit(req, int(nxt[slot]))
-                if self._finish_if_done(req, now):
-                    del self._active[slot]
-                elif req.deadline is not None and now >= req.deadline:
-                    # mid-decode eviction: partial tokens stay readable on
-                    # the handle; the future fails with the deadline error
-                    req.handle.future.set_exception(DeadlineExceededError(
-                        f"deadline expired after {len(req.emitted)} of "
-                        f"{req.max_new_tokens} tokens (evicted mid-decode)"))
-                    self.metrics.on_expire()
-                    self.pool.free(slot)
-                    del self._active[slot]
+            suspects = list(self._active.items())
+        blamed = []
+        for slot, req in suspects:
+            solo_toks = np.zeros_like(toks)
+            solo_pos = np.zeros_like(pos)
+            solo_toks[slot] = toks[slot]
+            solo_pos[slot] = pos[slot]
+            args = (self.params, jnp.asarray(solo_toks),
+                    jnp.asarray(solo_pos), self.pool.slabs)
+            try:
+                self._run_dispatch("decode", fn, args,
+                                   request_ids=(req.submit_idx,))
+            except DispatchFailedError as e:
+                blamed.append((slot, req, e))
+        if not blamed or (len(blamed) == len(suspects) and len(suspects) > 1):
+            return False
+        with self._cond:
+            for slot, req, e in blamed:
+                if slot not in self._active:
+                    continue
+                req.handle.future.set_exception(DispatchFailedError(
+                    f"request {req.submit_idx} quarantined: its rows "
+                    f"reproduce the decode failure in isolation ({e})",
+                    reason="poisoned"))
+                self.metrics.on_fail()
+                self.metrics.on_quarantine()
+                self.pool.free(slot)
+                del self._active[slot]
             self.metrics.set_slots(self.pool.active_slots(),
                                    self.pool.num_slots)
-        self.metrics.on_decode_step(rows, (now - t0) * 1e3)
-        return 1
+        self.supervisor.absolve()
+        _log.warning("quarantined %d poisoned request(s); retrying decode "
+                     "with %d survivor(s)", len(blamed),
+                     len(suspects) - len(blamed))
+        return True
+
+    def _fail_all_active(self, attempts: int, last_err):
+        """Non-attributable decode failure: fail every active request with
+        a typed error (partial tokens stay readable), free their slots,
+        and let the caller charge the circuit breaker."""
+        with self._cond:
+            for slot, req in list(self._active.items()):
+                req.handle.future.set_exception(DispatchFailedError(
+                    f"decode dispatch failed {attempts} consecutive times; "
+                    f"{len(req.emitted)} of {req.max_new_tokens} tokens "
+                    f"emitted ({last_err})", reason="engine"))
+                self.metrics.on_fail()
+                self.pool.free(slot)
+            self._active.clear()
+            self.metrics.set_slots(self.pool.active_slots(),
+                                   self.pool.num_slots)
 
     def _emit(self, req: _GenRequest, tok: int):
         req.emitted.append(tok)
@@ -485,7 +865,7 @@ class LLMEngine:
         if not done:
             return False
         req.handle.future.set_result(np.asarray(req.emitted, np.int32))
-        self.metrics.on_complete((now - req.arrival) * 1e3)
+        self.metrics.on_complete((now - req.arrival) * 1e3, slo=req.slo)
         if req.slot is not None and self.pool.active[req.slot]:
             self.pool.free(req.slot)
         return True
@@ -495,12 +875,12 @@ class LLMEngine:
         while True:
             with self._cond:
                 while True:
-                    if self._stopped:
+                    if self._stopped or self.supervisor.open:
                         return
-                    if (self._draining and not self._queue
+                    if (self._draining and not self._queue_len_locked()
                             and not self._active):
                         return          # drained: stop() joins us
-                    if self._queue or self._active:
+                    if self._queue_len_locked() or self._active:
                         break
                     self.clock.wait(self._cond, None)
             try:

@@ -21,6 +21,11 @@ from typing import Dict, Optional
 # cumulative histogram upper bounds for dispatched batch rows
 BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
+# SLO classes in strict priority order (ISSUE 6 overload control): the
+# scheduler admits interactive before batch before best_effort, and load
+# shedding walks the same list from the BOTTOM up.
+SLO_CLASSES = ("interactive", "batch", "best_effort")
+
 
 class ServingMetrics:
     # metric family prefix — subclasses (LLMMetrics) override it so two
@@ -40,6 +45,10 @@ class ServingMetrics:
         self.queue_depth = 0
         self.dispatched_rows = 0
         self.padded_rows = 0
+        # supervision (ISSUE 6): dispatch failures by kind ("raise"/"hang"/
+        # "poisoned"/"engine") and the engine circuit-breaker gauge
+        self.dispatch_failures: Dict[str, int] = {}
+        self.circuit_open = False
 
     # ---- engine callbacks ----
     def on_submit(self, queue_depth: int):
@@ -84,6 +93,15 @@ class ServingMetrics:
         with self._lock:
             self.queue_depth = depth
 
+    def on_dispatch_failure(self, kind: str):
+        with self._lock:
+            self.dispatch_failures[kind] = \
+                self.dispatch_failures.get(kind, 0) + 1
+
+    def set_circuit_open(self, open_: bool):
+        with self._lock:
+            self.circuit_open = bool(open_)
+
     # ---- views ----
     def quantile_ms(self, q: float) -> Optional[float]:
         with self._lock:
@@ -99,6 +117,8 @@ class ServingMetrics:
             hist = dict(self.batch_hist)
             depth = self.queue_depth
             rows, padded = self.dispatched_rows, self.padded_rows
+            dfail = dict(self.dispatch_failures)
+            circuit = self.circuit_open
         mean_batch = rows / counters["dispatches"] if counters["dispatches"] \
             else 0.0
         return {
@@ -107,6 +127,8 @@ class ServingMetrics:
             "batch_hist": hist,
             "mean_batch_rows": mean_batch,
             "pad_overhead_rows": padded,
+            "dispatch_failures": dfail,
+            "circuit_open": circuit,
             "p50_ms": self.quantile_ms(0.50),
             "p95_ms": self.quantile_ms(0.95),
             "p99_ms": self.quantile_ms(0.99),
@@ -145,6 +167,14 @@ class ServingMetrics:
         lines.append(f"{px}_batch_rows_count {sum(hist.values())}")
         lines.append(f"{px}_batch_rows_sum "
                      f"{sum(r * n for r, n in hist.items())}")
+        lines.append(f"# TYPE {px}_dispatch_failures_total counter")
+        for kind in sorted(s["dispatch_failures"]):
+            lines.append(f'{px}_dispatch_failures_total{{kind="{kind}"}} '
+                         f"{s['dispatch_failures'][kind]}")
+        lines += [
+            f"# TYPE {px}_circuit_open gauge",
+            f"{px}_circuit_open {int(s['circuit_open'])}",
+        ]
         return "\n".join(lines) + "\n"
 
 
@@ -175,15 +205,71 @@ class LLMMetrics(ServingMetrics):
         # (active_rows, step_ms) pairs: tokens/sec over the recent window
         self._decode_window: deque = deque(maxlen=self.window)
         self.counters.update({"prefills": 0, "decode_steps": 0,
-                              "tokens_out": 0})
+                              "tokens_out": 0, "shed": 0, "quarantined": 0,
+                              "brownout_entries": 0})
         self.slots_active = 0
         self.slots_total = 0
+        # per-SLO-class accounting (ISSUE 6 overload control): aggregate
+        # counters above stay authoritative for the drain reconciliation
+        # invariant; these break the same events down by class so the
+        # overload gates can pin e.g. interactive-only TTFT ceilings
+        self.class_counters: Dict[str, Dict[str, int]] = {
+            c: {"submitted": 0, "completed": 0, "shed": 0}
+            for c in SLO_CLASSES}
+        self._class_ttft: Dict[str, deque] = {
+            c: deque(maxlen=self.window) for c in SLO_CLASSES}
+        self.brownout = False
+        self.inflight_tokens = 0
+
+    def _class(self, slo) -> Optional[Dict[str, int]]:
+        return self.class_counters.get(slo) if slo else None
 
     # ---- engine callbacks ----
-    def on_prefill(self, ttft_ms: float):
+    def on_submit(self, queue_depth: int, slo: Optional[str] = None):
+        super().on_submit(queue_depth)
+        with self._lock:
+            c = self._class(slo)
+            if c is not None:
+                c["submitted"] += 1
+
+    def on_complete(self, latency_ms: float, slo: Optional[str] = None):
+        super().on_complete(latency_ms)
+        with self._lock:
+            c = self._class(slo)
+            if c is not None:
+                c["completed"] += 1
+
+    def on_shed(self, slo: Optional[str] = None):
+        """A queued request was load-shed to make room for higher-priority
+        work. Also counted as rejected (reason "shed") by the engine, so
+        submitted == completed + rejected + expired + failed still holds."""
+        with self._lock:
+            self.counters["shed"] += 1
+            c = self._class(slo)
+            if c is not None:
+                c["shed"] += 1
+
+    def on_quarantine(self):
+        with self._lock:
+            self.counters["quarantined"] += 1
+
+    def set_brownout(self, active: bool):
+        with self._lock:
+            entered = active and not self.brownout
+            self.brownout = bool(active)
+            if entered:
+                self.counters["brownout_entries"] += 1
+
+    def set_inflight_tokens(self, tokens: int):
+        with self._lock:
+            self.inflight_tokens = int(tokens)
+
+    def on_prefill(self, ttft_ms: float, slo: Optional[str] = None):
         with self._lock:
             self.counters["prefills"] += 1
             self._ttft_ms.append(float(ttft_ms))
+            if slo in self._class_ttft:
+                self._class_ttft[slo].append(float(ttft_ms))
 
     def on_decode_step(self, active_rows: int, step_ms: float):
         with self._lock:
@@ -206,9 +292,11 @@ class LLMMetrics(ServingMetrics):
             self.slots_total = int(total)
 
     # ---- views ----
-    def ttft_quantile_ms(self, q: float) -> Optional[float]:
+    def ttft_quantile_ms(self, q: float,
+                         slo: Optional[str] = None) -> Optional[float]:
         with self._lock:
-            vals = sorted(self._ttft_ms)
+            src = self._class_ttft[slo] if slo else self._ttft_ms
+            vals = sorted(src)
         return _quantile(vals, q)
 
     def intertoken_quantile_ms(self, q: float) -> Optional[float]:
@@ -232,12 +320,20 @@ class LLMMetrics(ServingMetrics):
         with self._lock:
             s["slots_active"] = self.slots_active
             s["slots_total"] = self.slots_total
+            s["classes"] = {c: dict(v)
+                            for c, v in self.class_counters.items()}
+            s["brownout"] = self.brownout
+            s["inflight_tokens"] = self.inflight_tokens
         s["slot_occupancy"] = (self.slots_active / self.slots_total
                                if self.slots_total else 0.0)
         s["tokens_per_s"] = self.tokens_per_s()
+        s["shed_rate"] = (s["shed"] / s["submitted"] if s["submitted"]
+                          else 0.0)
         for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
             s[f"ttft_{key}_ms"] = self.ttft_quantile_ms(q)
             s[f"intertoken_{key}_ms"] = self.intertoken_quantile_ms(q)
+        for c in SLO_CLASSES:
+            s[f"ttft_p99_ms_{c}"] = self.ttft_quantile_ms(0.99, slo=c)
         return s
 
     def render(self) -> str:
@@ -266,6 +362,30 @@ class LLMMetrics(ServingMetrics):
             f"{px}_decode_steps_total {s['decode_steps']}",
             f"# TYPE {px}_prefills_total counter",
             f"{px}_prefills_total {s['prefills']}",
+        ]
+        # ---- overload control + supervision families (ISSUE 6) ----
+        lines.append(f"# TYPE {px}_class_requests_total counter")
+        for c in SLO_CLASSES:
+            for outcome in ("submitted", "completed", "shed"):
+                lines.append(
+                    f'{px}_class_requests_total{{slo="{c}",'
+                    f'outcome="{outcome}"}} {s["classes"][c][outcome]}')
+        lines.append(f"# TYPE {px}_class_ttft_ms summary")
+        for c in SLO_CLASSES:
+            v = s[f"ttft_p99_ms_{c}"]
+            lines.append(f'{px}_class_ttft_ms{{slo="{c}",quantile="0.99"}} '
+                         f"{'NaN' if v is None else round(v, 3)}")
+        lines += [
+            f"# TYPE {px}_shed_total counter",
+            f"{px}_shed_total {s['shed']}",
+            f"# TYPE {px}_quarantined_total counter",
+            f"{px}_quarantined_total {s['quarantined']}",
+            f"# TYPE {px}_brownout gauge",
+            f"{px}_brownout {int(s['brownout'])}",
+            f"# TYPE {px}_brownout_entries_total counter",
+            f"{px}_brownout_entries_total {s['brownout_entries']}",
+            f"# TYPE {px}_inflight_tokens gauge",
+            f"{px}_inflight_tokens {s['inflight_tokens']}",
         ]
         return "\n".join(lines) + "\n"
 

@@ -8,12 +8,24 @@ ThreadingHTTPServer + BaseHTTPRequestHandler, whose hardened
                     -> 200 {"outputs": [...]}; 503 rejected (queue full /
                     draining); 504 deadline expired before dispatch
     POST /generate  {"input_ids": [...], "max_new_tokens": 32,
-                    "eos_token_id": 2, "deadline_ms": 500}
+                    "eos_token_id": 2, "deadline_ms": 500,
+                    "slo": "interactive"|"batch"|"best_effort"}
                     -> 200 {"tokens": [...], "ttft_ms": ...} from the
                     continuous-batching LLMEngine (serving/llm/); same
                     503/504 admission-control mapping
-    GET  /healthz   -> 200 {"status": "ok"|"draining"}
+    GET  /healthz   -> 200 {"status": "ok"|"draining"};
+                       503 {"status": "broken"} once an engine's circuit
+                       breaker opens (ISSUE 6)
     GET  /metrics   -> 200 Prometheus text exposition (serving/metrics.py)
+
+Backpressure (ISSUE 6): overload rejections — queue full, token budget
+exhausted, or the request itself shed for a higher class — map to HTTP
+429 with a Retry-After header, telling well-behaved clients to back off;
+503 stays reserved for "this process is going away" (draining, circuit
+breaker open). When an engine's circuit breaker trips, the server flips
+/healthz to 503 {"status": "broken"} and starts a drain on its own
+thread, so an external supervisor observes unhealthy -> drained -> exit
+and replaces the process.
 
 Graceful drain mirrors the ResilientTrainer preemption contract
 (distributed/resilient.py): SIGTERM/SIGINT → stop admissions (new requests
@@ -42,6 +54,11 @@ import numpy as np
 from ..distributed.fleet.utils.http_server import read_request_body
 from .engine import (BatchingEngine, DeadlineExceededError, EngineConfig,
                      RejectedError)
+from .metrics import SLO_CLASSES
+
+# RejectedError reasons that mean "try again later" (HTTP 429 +
+# Retry-After) rather than "this process is going away" (503)
+_RETRYABLE_REJECTS = frozenset({"queue_full", "token_budget", "shed"})
 
 
 def _decode_inputs(payload: dict):
@@ -93,20 +110,38 @@ class ServingServer:
                 pass
 
             def _reply(self, code: int, body: bytes,
-                       ctype: str = "application/json"):
+                       ctype: str = "application/json", headers=None):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _reply_json(self, code: int, obj):
-                self._reply(code, json.dumps(obj).encode())
+            def _reply_json(self, code: int, obj, headers=None):
+                self._reply(code, json.dumps(obj).encode(), headers=headers)
+
+            def _reply_rejected(self, e: RejectedError):
+                """Overload -> 429 + Retry-After (back off and come back);
+                draining/broken/structural -> 503 (find another replica)."""
+                reason = getattr(e, "reason", "rejected")
+                if reason in _RETRYABLE_REJECTS:
+                    retry_s = getattr(e, "retry_after_s", None) or 1.0
+                    self._reply_json(
+                        429, {"error": str(e), "reason": reason},
+                        headers={"Retry-After": f"{retry_s:g}"})
+                else:
+                    self._reply_json(503,
+                                     {"error": str(e), "reason": reason})
 
             def do_GET(self):
                 if self.path == "/healthz":
+                    broken = any(getattr(e, "broken", False)
+                                 for e in outer._engines())
                     health = {
-                        "status": "draining" if outer._draining else "ok",
+                        "status": ("broken" if broken else
+                                   "draining" if outer._draining else "ok"),
                     }
                     if outer.engine is not None:
                         health["queue_depth"] = \
@@ -116,7 +151,7 @@ class ServingServer:
                         health["llm_queue_depth"] = m.queue_depth
                         health["llm_slots_active"] = m.slots_active
                         health["llm_slots_total"] = m.slots_total
-                    self._reply_json(200, health)
+                    self._reply_json(503 if broken else 200, health)
                 elif self.path == "/metrics":
                     # both engines scrape from one endpoint; the llm family
                     # renders under pdtpu_llm_* so names never collide
@@ -153,6 +188,11 @@ class ServingServer:
                                         dtype=np.int32).reshape(-1)
                     if prompt.size < 1:
                         raise ValueError("input_ids must be non-empty")
+                    slo = payload.get("slo")
+                    if slo is not None and slo not in SLO_CLASSES:
+                        raise ValueError(
+                            f"slo must be one of {list(SLO_CLASSES)}, "
+                            f"got {slo!r}")
                 except (ValueError, KeyError, TypeError) as e:
                     self._reply_json(400, {"error": f"bad request: {e}"})
                     return
@@ -161,10 +201,11 @@ class ServingServer:
                         prompt,
                         max_new_tokens=payload.get("max_new_tokens"),
                         eos_token_id=payload.get("eos_token_id"),
-                        deadline_ms=payload.get("deadline_ms"))
+                        deadline_ms=payload.get("deadline_ms"),
+                        slo=slo)
                     toks = handle.result(timeout=outer.request_timeout_s)
                 except RejectedError as e:
-                    self._reply_json(503, {"error": str(e)})
+                    self._reply_rejected(e)
                     return
                 except DeadlineExceededError as e:
                     self._reply_json(504, {"error": str(e)})
@@ -190,7 +231,7 @@ class ServingServer:
                         arrays, deadline_ms=payload.get("deadline_ms"))
                     outs = fut.result(timeout=outer.request_timeout_s)
                 except RejectedError as e:
-                    self._reply_json(503, {"error": str(e)})
+                    self._reply_rejected(e)
                     return
                 except DeadlineExceededError as e:
                     self._reply_json(504, {"error": str(e)})
@@ -215,6 +256,19 @@ class ServingServer:
         self._server.daemon_threads = False
         self._server.block_on_close = True
         self.host, self.port = self._server.server_address[:2]
+        # circuit-breaker escalation: the trip fires on the engine's
+        # scheduler thread, which cannot join itself — drain from a fresh
+        # thread so /healthz reports "broken" while the drain runs and the
+        # process exits for the supervisor to replace (ISSUE 6)
+        for e in self._engines():
+            if hasattr(e, "on_break") and e.on_break is None:
+                e.on_break = self._drain_on_break
+
+    def _drain_on_break(self):
+        logging.getLogger("paddle_tpu.serving").error(
+            "engine circuit breaker open; draining server")
+        threading.Thread(target=self.stop, daemon=True,
+                         name="pdtpu-serving-breaker-drain").start()
 
     # ---- lifecycle ----
     def _engines(self):

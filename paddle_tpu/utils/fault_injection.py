@@ -17,9 +17,29 @@ Spec grammar (';'-separated clauses, each ``kind@step[:arg]``):
                           matches CheckpointManager's kill points
     kill@4:step           SIGKILL self at the top of step 4
 
+Serving-side clauses (ISSUE 6) key on the engine's *dispatch index* (the
+running count of jitted prefill/decode attempts) or on a request's
+*submit index*, so the supervision protocol in ``serving/supervisor.py``
+and the engines' retry/quarantine paths are provable at exact points:
+
+    dispatch_raise@5      raise inside the 5th dispatch (transient failure:
+                          fires once, so the engine's retry succeeds)
+    dispatch_hang@5:3.0   the 5th dispatch "hangs" for 3.0s — raised as
+                          InjectedDispatchHang, which EngineSupervisor maps
+                          onto its hung-dispatch watchdog path without
+                          burning real wall time under a SimClock
+    poison_request@2      every dispatch carrying submit-index-2's rows
+                          raises — PERSISTENTLY, across retries (that is
+                          what makes a request poisoned rather than the
+                          fault transient); the engine must quarantine it
+    poison_request@2:decode   only decode dispatches are poisoned (the
+                          request survives prefill, exercising the decode
+                          blame-isolation protocol)
+
 Each clause fires exactly once per process (a restarted process re-arms,
-which is what crash-resume tests want). ``FaultPlan`` is also usable
-programmatically for in-process tests.
+which is what crash-resume tests want) — except ``poison_request``, whose
+defining property is persistence: it logs once but keeps firing.
+``FaultPlan`` is also usable programmatically for in-process tests.
 """
 from __future__ import annotations
 
@@ -34,6 +54,17 @@ ENV_VAR = "PDTPU_FAULTS"
 KILL_POINT_MID_SAVE = "mid_save"        # after data write, before any rename
 KILL_POINT_AFTER_DATA = "after_data"    # after data rename, before manifest
 KILL_POINT_STEP = "step"                # top of the training step
+
+
+class InjectedDispatchHang(RuntimeError):
+    """A dispatch_hang clause fired: the dispatch would have blocked for
+    `seconds`. EngineSupervisor converts this to its DispatchHungError
+    watchdog path so SimClock tests prove the hang protocol with zero real
+    sleeps; it is never meant to escape the supervisor."""
+
+    def __init__(self, seconds: float):
+        super().__init__(f"injected dispatch hang ({seconds:.1f}s)")
+        self.seconds = float(seconds)
 
 
 class Fault:
@@ -164,6 +195,41 @@ class FaultPlan:
         f = self._take("delay", step)
         if f is not None:
             time.sleep(float(f.arg or "1.0"))
+
+    def maybe_dispatch_fault(self, dispatch_idx: int, kind: str = "dispatch",
+                             request_ids=()):
+        """Serving-engine injection point, called at the top of every
+        supervised jitted dispatch attempt. `dispatch_idx` is the engine's
+        running dispatch counter (every attempt — retries included —
+        increments it), `kind` names the dispatch flavor ("prefill" /
+        "decode" / "predict"), `request_ids` the submit indices riding this
+        dispatch. Raises RuntimeError for dispatch_raise / poison_request
+        and InjectedDispatchHang for dispatch_hang."""
+        for f in self.faults:
+            if f.fired or f.step != dispatch_idx:
+                continue
+            if f.kind == "dispatch_raise":
+                f.fired = True
+                self.log.append(repr(f))
+                raise RuntimeError(
+                    f"injected dispatch_raise at {kind} dispatch "
+                    f"{dispatch_idx}")
+            if f.kind == "dispatch_hang":
+                f.fired = True
+                self.log.append(repr(f))
+                raise InjectedDispatchHang(float(f.arg or "1.0"))
+        for rid in request_ids:
+            for f in self.faults:
+                if f.kind != "poison_request" or f.step != rid:
+                    continue
+                if f.arg is not None and f.arg != kind:
+                    continue
+                if not f.fired:     # log once, fire forever (persistent)
+                    f.fired = True
+                    self.log.append(repr(f))
+                raise RuntimeError(
+                    f"injected poison: request {rid} at {kind} dispatch "
+                    f"{dispatch_idx}")
 
     def maybe_kill(self, step: int, point: str = KILL_POINT_STEP):
         """SIGKILL the current process at a named kill point. Used to

@@ -336,6 +336,355 @@ def test_llm_metrics_prometheus_round_trip():
     assert not any(k.startswith("pdtpu_serving_") for k in flat)
 
 
+# ---- supervision + failure protocol (ISSUE 6 fault matrix) ----
+# Every scenario is deterministic: faults fire at exact dispatch/submit
+# indices from a programmatic FaultPlan, the engine runs threadless under
+# a SimClock, and the proofs are exact (bit-identical survivor streams,
+# balanced KV-pool slot ledger, no unresolved futures).
+
+
+def _sup_engine(gpt_tiny, plan, clock, **cfg_kw):
+    from paddle_tpu import serving
+    kw = dict(num_slots=2, block_len=8, n_blocks=4)
+    kw.update(cfg_kw)
+    return serving.LLMEngine(gpt_tiny, serving.LLMEngineConfig(**kw),
+                             clock=clock, fault_plan=plan)
+
+
+def _drain_all(eng):
+    while eng.has_work():
+        eng.pump()
+
+
+@pytest.mark.fault_matrix
+def test_dispatch_raise_mid_decode_retries_bit_identically(gpt_tiny):
+    """Transient decode failure: dispatch_raise fires once inside the 2nd
+    decode attempt; the supervised retry succeeds and every stream is
+    bit-identical to a fault-free run (the fault raises before the jitted
+    call commits, so no state was corrupted). The slot ledger balances and
+    the breaker never charges (retry succeeded)."""
+    from paddle_tpu import serving
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.utils.fault_injection import FaultPlan
+
+    prompts = [np.arange(1, 5, dtype=np.int32),
+               np.arange(11, 15, dtype=np.int32)]
+    ref = np.asarray(generate(gpt_tiny, np.stack(prompts),
+                              max_new_tokens=6).numpy())[:, 4:]
+    # dispatch indices: 0 = prefill r0, 1 = prefill r1, 2 = decode (ok),
+    # 3 = decode (raises once), 4 = the retry (succeeds)
+    plan = FaultPlan.from_spec("dispatch_raise@3")
+    eng = _sup_engine(gpt_tiny, plan, serving.SimClock())
+    handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    _drain_all(eng)
+    for h, r in zip(handles, ref):
+        assert np.array_equal(h.result(timeout=0), r)
+    assert plan.log == ["dispatch_raise@3"]
+    assert eng.supervisor.stats["dispatch_failures"] == 1
+    assert not eng.broken
+    snap = eng.metrics.snapshot()
+    assert snap["dispatch_failures"] == {"raise": 1}
+    assert snap["completed"] == 2 and snap["failed"] == 0
+    assert snap["submitted"] == snap["completed"]
+    eng.pool.check_balance()
+    eng.stop()
+
+
+@pytest.mark.fault_matrix
+def test_dispatch_hang_maps_to_watchdog_and_recovers(gpt_tiny):
+    """Hung decode: dispatch_hang arrives as the supervisor's
+    DispatchHungError watchdog path (zero real sleeping under SimClock);
+    the retry succeeds and the stream is bit-identical."""
+    from paddle_tpu import serving
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.utils.fault_injection import FaultPlan
+
+    prompt = np.arange(1, 7, dtype=np.int32)
+    ref = np.asarray(generate(gpt_tiny, prompt[None, :],
+                              max_new_tokens=5).numpy())[0, 6:]
+    # idx 0 = prefill, idx 1 = first decode "hangs", idx 2 = retry
+    plan = FaultPlan.from_spec("dispatch_hang@1:30.0")
+    eng = _sup_engine(gpt_tiny, plan, serving.SimClock(), num_slots=1)
+    h = eng.submit(prompt, max_new_tokens=5)
+    _drain_all(eng)
+    assert np.array_equal(h.result(timeout=0), ref)
+    assert eng.supervisor.stats["watchdog_fires"] == 1
+    assert eng.metrics.snapshot()["dispatch_failures"] == {"hang": 1}
+    assert not eng.broken
+    eng.pool.check_balance()
+    eng.stop()
+
+
+@pytest.mark.fault_matrix
+def test_poisoned_prefill_quarantines_only_its_request(gpt_tiny):
+    """poison_request fires on EVERY dispatch carrying submit-index 0:
+    its prefill fails all prefill_retries+1 attempts, the request is
+    quarantined (typed reason 'poisoned', slot freed, breaker absolved)
+    and the innocent request streams bit-identically."""
+    from paddle_tpu import serving
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.utils.fault_injection import FaultPlan
+
+    prompts = [np.arange(1, 5, dtype=np.int32),
+               np.arange(21, 25, dtype=np.int32)]
+    ref1 = np.asarray(generate(gpt_tiny, prompts[1][None, :],
+                               max_new_tokens=4).numpy())[0, 4:]
+    plan = FaultPlan.from_spec("poison_request@0")
+    eng = _sup_engine(gpt_tiny, plan, serving.SimClock())
+    bad = eng.submit(prompts[0], max_new_tokens=4)      # submit idx 0
+    good = eng.submit(prompts[1], max_new_tokens=4)     # submit idx 1
+    _drain_all(eng)
+    with pytest.raises(serving.DispatchFailedError,
+                       match="quarantined") as exc:
+        bad.result(timeout=0)
+    assert exc.value.reason == "poisoned"
+    assert bad.tokens_so_far() == []                    # never prefilled
+    assert np.array_equal(good.result(timeout=0), ref1)
+    snap = eng.metrics.snapshot()
+    assert snap["quarantined"] == 1 and snap["failed"] == 1
+    assert snap["completed"] == 1
+    # invariant: every submitted request is accounted for exactly once
+    assert snap["submitted"] == (snap["completed"] + snap["rejected"]
+                                 + snap["expired"] + snap["failed"])
+    assert eng.supervisor.stats["quarantines"] == 1
+    assert not eng.broken                               # absolved
+    eng.pool.check_balance()
+    assert eng.pool.active_slots() == 0
+    eng.stop()
+
+
+@pytest.mark.fault_matrix
+def test_decode_poison_blame_isolation_quarantines_culprit(gpt_tiny):
+    """poison_request@1:decode survives prefill and poisons every decode
+    carrying submit-index 1. The whole-batch retries exhaust, the blame
+    probes (solo masked dispatches, results discarded) implicate exactly
+    request 1, it is quarantined mid-stream, and the survivor's FULL
+    stream is bit-identical to a fault-free run — the probes committed
+    nothing."""
+    from paddle_tpu import serving
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.utils.fault_injection import FaultPlan
+
+    prompts = [np.arange(1, 5, dtype=np.int32),
+               np.arange(11, 15, dtype=np.int32)]
+    ref0 = np.asarray(generate(gpt_tiny, prompts[0][None, :],
+                               max_new_tokens=6).numpy())[0, 4:]
+    plan = FaultPlan.from_spec("poison_request@1:decode")
+    eng = _sup_engine(gpt_tiny, plan, serving.SimClock())
+    survivor = eng.submit(prompts[0], max_new_tokens=6)  # submit idx 0
+    poisoned = eng.submit(prompts[1], max_new_tokens=6)  # submit idx 1
+    _drain_all(eng)
+    assert np.array_equal(survivor.result(timeout=0), ref0)
+    with pytest.raises(serving.DispatchFailedError, match="isolation") as exc:
+        poisoned.result(timeout=0)
+    assert exc.value.reason == "poisoned"
+    # it DID prefill (poison was decode-scoped): first token is readable
+    assert len(poisoned.tokens_so_far()) >= 1
+    snap = eng.metrics.snapshot()
+    assert snap["quarantined"] == 1 and snap["completed"] == 1
+    assert snap["submitted"] == (snap["completed"] + snap["rejected"]
+                                 + snap["expired"] + snap["failed"])
+    assert not eng.broken
+    eng.pool.check_balance()
+    assert eng.pool.active_slots() == 0
+    eng.stop()
+
+
+@pytest.mark.fault_matrix
+def test_repeated_engine_failures_trip_circuit_breaker(gpt_tiny):
+    """Non-attributable decode failures (the raise reproduces for EVERY
+    blame probe, so no single request is implicated) charge the breaker;
+    at breaker_threshold consecutive engine-level failures it opens
+    terminally: active+queued requests fail typed, new submits reject
+    with reason 'circuit_open', on_break fires exactly once."""
+    from paddle_tpu import serving
+    from paddle_tpu.utils.fault_injection import FaultPlan
+
+    # round 1: idx 0/1 prefills, idx 2 decode raises, probes idx 3 and 4
+    # raise too -> unattributable -> engine failure #1.
+    # round 2: idx 5/6 prefills, idx 7 decode + probes 8/9 raise ->
+    # engine failure #2 -> breaker opens (threshold 2).
+    plan = FaultPlan.from_spec(
+        "dispatch_raise@2;dispatch_raise@3;dispatch_raise@4;"
+        "dispatch_raise@7;dispatch_raise@8;dispatch_raise@9")
+    trips = []
+    clock = serving.SimClock()
+    from paddle_tpu.serving import LLMEngine, LLMEngineConfig
+    eng = LLMEngine(
+        gpt_tiny,
+        LLMEngineConfig(num_slots=2, block_len=8, n_blocks=4,
+                        dispatch_retries=0, breaker_threshold=2),
+        clock=clock, fault_plan=plan, on_break=lambda: trips.append(1))
+    r0 = [eng.submit([i + 1, i + 2], max_new_tokens=4) for i in range(2)]
+    eng.pump()
+    for h in r0:
+        with pytest.raises(serving.DispatchFailedError) as exc:
+            h.result(timeout=0)
+        assert exc.value.reason == "engine"
+    assert not eng.broken                   # one failure, threshold is 2
+    r1 = [eng.submit([i + 5, i + 6], max_new_tokens=4) for i in range(2)]
+    eng.pump()
+    assert eng.broken and trips == [1]
+    for h in r1:
+        with pytest.raises(serving.DispatchFailedError) as exc:
+            h.result(timeout=0)
+        assert exc.value.reason == "engine"
+    with pytest.raises(serving.RejectedError, match="circuit") as exc:
+        eng.submit([9], max_new_tokens=2)
+    assert exc.value.reason == "circuit_open"
+    snap = eng.metrics.snapshot()
+    assert snap["circuit_open"] is True
+    assert snap["failed"] == 4 and snap["quarantined"] == 0
+    assert eng.metrics.reject_reasons["circuit_open"] == 1
+    assert snap["submitted"] == (snap["completed"] + snap["rejected"]
+                                 + snap["expired"] + snap["failed"]) - 1
+    eng.pool.check_balance()
+    assert eng.pool.active_slots() == 0
+    eng.stop()
+
+
+@pytest.mark.fault_matrix
+def test_overload_sheds_lowest_class_first(gpt_tiny):
+    """Scripted overload: with the queue full, an interactive submit sheds
+    the NEWEST queued best_effort request (typed reason 'shed') and is
+    admitted; with nothing lower-priority queued the submit rejects
+    'queue_full' with a Retry-After hint. Shedding never touches the
+    submitter's own class or above."""
+    from paddle_tpu import serving
+
+    clock = serving.SimClock()
+    eng = _sup_engine(gpt_tiny, None, clock, num_slots=1, max_queue_depth=2)
+    hog = eng.submit([1, 2], max_new_tokens=8)
+    eng.pump()                              # hog owns THE slot
+    be1 = eng.submit([3, 3], max_new_tokens=2, slo="best_effort")
+    be2 = eng.submit([4, 4], max_new_tokens=2, slo="best_effort")
+    inter = eng.submit([5, 5], max_new_tokens=2, slo="interactive")
+    with pytest.raises(serving.RejectedError, match="shed") as exc:
+        be2.result(timeout=0)               # newest best_effort was shed
+    assert exc.value.reason == "shed"
+    assert exc.value.retry_after_s is not None
+    # queue full again (be1 + inter): a second interactive sheds be1 —
+    # never its own class
+    inter2 = eng.submit([6, 6], max_new_tokens=2, slo="interactive")
+    with pytest.raises(serving.RejectedError) as exc:
+        be1.result(timeout=0)
+    assert exc.value.reason == "shed"
+    # queue now holds ONLY interactive work: best_effort has nothing below
+    # it and interactive will not shed its own class — both reject
+    # queue_full with backpressure
+    for slo in ("best_effort", "interactive"):
+        with pytest.raises(serving.RejectedError, match="queue") as exc:
+            eng.submit([7], max_new_tokens=2, slo=slo)
+        assert exc.value.reason == "queue_full"
+        assert exc.value.retry_after_s is not None
+    _drain_all(eng)
+    assert hog.result(timeout=0).shape == (8,)
+    assert len(inter.result(timeout=0)) == 2
+    assert len(inter2.result(timeout=0)) == 2
+    snap = eng.metrics.snapshot()
+    assert snap["shed"] == 2
+    assert snap["classes"]["best_effort"]["shed"] == 2
+    assert snap["classes"]["interactive"]["shed"] == 0
+    assert eng.metrics.reject_reasons == {"shed": 2, "queue_full": 2}
+    assert snap["submitted"] == (snap["completed"] + snap["rejected"]
+                                 + snap["expired"] + snap["failed"]) - 2
+    eng.pool.check_balance()
+    eng.stop()
+
+
+def test_token_budget_admission_and_shed(gpt_tiny):
+    """max_inflight_tokens bounds sum(prompt + max_new_tokens) over
+    queued + active; an over-budget high-class submit sheds lower-class
+    queued work, an over-budget submit with nothing to shed rejects
+    'token_budget'."""
+    from paddle_tpu import serving
+
+    clock = serving.SimClock()
+    eng = _sup_engine(gpt_tiny, None, clock, num_slots=1,
+                      max_inflight_tokens=14)
+    active = eng.submit([1, 2], max_new_tokens=6)       # cost 8
+    eng.pump()                                          # mid-generation
+    be = eng.submit([3, 3], max_new_tokens=2, slo="best_effort")  # cost 4
+    assert eng.metrics.inflight_tokens == 12
+    inter = eng.submit([5, 5], max_new_tokens=2, slo="interactive")
+    with pytest.raises(serving.RejectedError) as exc:   # 16 > budget: shed
+        be.result(timeout=0)
+    assert exc.value.reason == "shed"
+    with pytest.raises(serving.RejectedError, match="token budget") as exc:
+        eng.submit([6, 6], max_new_tokens=2, slo="interactive")
+    assert exc.value.reason == "token_budget"
+    _drain_all(eng)
+    assert len(active.result(timeout=0)) == 6
+    assert len(inter.result(timeout=0)) == 2
+    assert eng.metrics.inflight_tokens == 0             # leak-proof: empty
+    eng.pool.check_balance()
+    eng.stop()
+
+
+def test_brownout_caps_admitted_max_new_tokens(gpt_tiny):
+    """Queue depth at/above brownout_queue_depth enters brownout: newly
+    admitted requests get max_new_tokens capped; the mode exits with
+    hysteresis at half the threshold and later submits are uncapped."""
+    from paddle_tpu import serving
+
+    clock = serving.SimClock()
+    eng = _sup_engine(gpt_tiny, None, clock, num_slots=1,
+                      brownout_queue_depth=2, brownout_max_new_tokens=2)
+    hog = eng.submit([1, 2], max_new_tokens=6)
+    eng.pump()
+    q = [eng.submit([3, 3], max_new_tokens=6) for _ in range(2)]
+    capped = eng.submit([4, 4], max_new_tokens=6)   # depth 2 >= 2: brownout
+    assert eng.metrics.brownout is True
+    assert capped.max_new_tokens == 2
+    _drain_all(eng)
+    assert len(capped.result(timeout=0)) == 2       # capped, not 6
+    assert len(hog.result(timeout=0)) == 6
+    for h in q:
+        assert len(h.result(timeout=0)) == 6        # admitted pre-brownout
+    assert eng.metrics.brownout is False            # exited as queue drained
+    assert eng.metrics.snapshot()["brownout_entries"] == 1
+    uncapped = eng.submit([5, 5], max_new_tokens=6)
+    _drain_all(eng)
+    assert len(uncapped.result(timeout=0)) == 6
+    eng.pool.check_balance()
+    eng.stop()
+
+
+def test_llm_drain_timeout_fails_stragglers_typed(gpt_tiny):
+    """stop(drain=True, timeout=) on a wedged engine: the scheduler join
+    times out and every straggler — queued AND mid-decode — fails with
+    RejectedError(reason='drain_timeout') instead of hanging its client
+    forever."""
+    from paddle_tpu import serving
+
+    release = threading.Event()
+    eng = serving.LLMEngine(
+        gpt_tiny, serving.LLMEngineConfig(num_slots=1, block_len=8,
+                                          n_blocks=4))
+
+    def wedged_decode(params, toks, pos, slabs):
+        release.wait(60)
+        raise RuntimeError("released")
+    eng._decode_jit = wedged_decode
+
+    eng.start()
+    h1 = eng.submit([1, 2], max_new_tokens=4)       # will wedge mid-decode
+    h2 = eng.submit([3, 4], max_new_tokens=4)       # stuck behind h1
+    deadline = time.time() + 30
+    while not h1.tokens_so_far() and time.time() < deadline:
+        time.sleep(0.01)                            # h1 prefilled (TTFT out)
+    assert h1.tokens_so_far(), "prefill never landed"
+    eng.stop(drain=True, timeout=0.5)
+    for h in (h1, h2):
+        with pytest.raises(serving.RejectedError, match="drain") as exc:
+            h.result(timeout=0)
+        assert exc.value.reason == "drain_timeout"
+    assert h1.tokens_so_far()                       # partials stay readable
+    assert eng.metrics.reject_reasons["drain_timeout"] == 2
+    assert eng.pool.active_slots() == 0
+    release.set()                                   # unwedge the daemon
+
+
 # ---- /generate SIGTERM drain (the fault-matrix scenario) ----
 
 def _start_llm_worker(workdir, env_extra=None):

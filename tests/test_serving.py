@@ -612,3 +612,186 @@ def test_sigterm_drains_accepted_requests_and_exits_zero(tmp_path):
     assert flat['pdtpu_serving_requests_total{outcome="submitted"}'] == \
         len(oks)  # accepted == answered; nothing pending at exit
     assert flat["pdtpu_serving_queue_depth"] == 0
+
+
+# ---- engine supervision: watchdog + circuit breaker (ISSUE 6) ----
+
+def test_supervisor_watchdog_abandons_hung_dispatch():
+    """Real wall-clock watchdog: a dispatch that blocks past the budget
+    raises DispatchHungError and the worker thread is abandoned."""
+    import threading
+    import time as _time
+    from paddle_tpu.serving import DispatchHungError, EngineSupervisor
+
+    release = threading.Event()
+    sup = EngineSupervisor(dispatch_timeout_s=0.2)
+    t0 = _time.monotonic()
+    with pytest.raises(DispatchHungError, match="watchdog"):
+        sup.run(lambda: release.wait(30), label="decode")
+    assert _time.monotonic() - t0 < 10          # did NOT wait the full 30s
+    assert sup.stats["watchdog_fires"] == 1
+    release.set()
+    # a healthy dispatch under the same supervisor still works
+    assert sup.run(lambda: 42) == 42
+
+
+def test_supervisor_types_failures_and_breaker_protocol():
+    from paddle_tpu.serving import (DispatchFailedError, EngineSupervisor)
+
+    trips = []
+    sup = EngineSupervisor(breaker_threshold=2,
+                           on_trip=lambda: trips.append(1))
+    with pytest.raises(DispatchFailedError, match="ValueError") as exc:
+        sup.run(lambda: (_ for _ in ()).throw(ValueError("boom")))
+    assert exc.value.reason == "raise"
+    assert isinstance(exc.value.__cause__, ValueError)
+    # breaker counts CONSECUTIVE engine-level failures only
+    assert sup.record_failure() is False and not sup.open
+    sup.record_success()                        # success resets the streak
+    assert sup.record_failure() is False
+    sup.absolve()                               # quarantine resets it too
+    assert sup.stats["quarantines"] == 1
+    assert sup.record_failure() is False
+    assert sup.record_failure() is True         # 2nd consecutive: trips
+    assert sup.open and trips == [1]
+    sup.record_failure()                        # already open: no re-trip
+    assert sup.stats["breaker_trips"] == 1 and trips == [1]
+    snap = sup.snapshot()
+    assert snap["circuit_open"] is True
+
+
+@pytest.mark.fault_matrix
+def test_engine_breaker_opens_after_repeated_dispatch_failures():
+    """BatchingEngine supervision: every batch dispatch failure charges
+    the breaker; at breaker_threshold it opens — pending requests fail
+    typed, new submits reject 'circuit_open', metrics expose the gauge."""
+    import numpy as np
+    from paddle_tpu import serving
+    from paddle_tpu.utils.fault_injection import FaultPlan
+
+    plan = FaultPlan.from_spec("dispatch_raise@0;dispatch_raise@1")
+    clock = serving.SimClock()
+    broke = []
+    eng = serving.BatchingEngine(
+        lambda a: [a[0] * 2],
+        serving.EngineConfig(max_batch_size=2, max_wait_ms=0.0,
+                             breaker_threshold=2),
+        clock=clock, fault_plan=plan, on_break=lambda: broke.append(1))
+    f1 = eng.submit([np.ones((1, 2), np.float32)])
+    eng.pump()                                  # dispatch 0 raises
+    with pytest.raises(serving.DispatchFailedError):
+        f1.result(timeout=0)
+    assert not eng.broken
+    f2 = eng.submit([np.ones((1, 2), np.float32)])
+    eng.pump()                                  # dispatch 1 raises: trips
+    with pytest.raises(serving.DispatchFailedError):
+        f2.result(timeout=0)
+    assert eng.broken and broke == [1]
+    with pytest.raises(serving.RejectedError, match="circuit") as exc:
+        eng.submit([np.ones((1, 2), np.float32)])
+    assert exc.value.reason == "circuit_open"
+    snap = eng.metrics.snapshot()
+    assert snap["circuit_open"] is True
+    assert snap["dispatch_failures"] == {"raise": 2}
+    flat = serving.parse_exposition(eng.metrics.render())
+    assert flat["pdtpu_serving_circuit_open"] == 1
+    assert flat['pdtpu_serving_dispatch_failures_total{kind="raise"}'] == 2
+    # a recovered dispatch never un-trips it: the breaker is terminal
+    eng.stop(drain=False)
+
+
+def test_http_backpressure_429_and_broken_healthz():
+    """Overload rejects surface as HTTP 429 + Retry-After (back off, come
+    back), while a tripped breaker flips /healthz to 503 'broken'."""
+    import json
+    import threading
+    import urllib.error
+    import urllib.request
+    import numpy as np
+    from paddle_tpu import serving
+
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def slow_predict(arrays):
+        entered.set()
+        gate.wait(30)
+        return [arrays[0] * 2]
+
+    eng = serving.BatchingEngine(
+        slow_predict,
+        serving.EngineConfig(max_batch_size=1, max_wait_ms=0.0,
+                             max_queue_depth=1, retry_after_s=2.5),
+        on_break=lambda: None)      # keep the server up after the trip
+    srv = serving.ServingServer(eng, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def post_async(results):
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"inputs": [[[1.0, 2.0]]]}).encode(),
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                results.append(r.status)
+        except urllib.error.HTTPError as e:
+            results.append(e.code)
+
+    try:
+        # rq A occupies the (blocked) dispatch, rq B fills the queue
+        done_a, done_b = [], []
+        threading.Thread(target=post_async, args=(done_a,)).start()
+        assert entered.wait(20)               # A is inside slow_predict
+        threading.Thread(target=post_async, args=(done_b,)).start()
+        deadline = time.time() + 20
+        while eng.metrics.queue_depth < 1 and time.time() < deadline:
+            time.sleep(0.01)                  # B is queued (depth 1/1)
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"inputs": [[[9.0, 9.0]]]}).encode(),
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=30)
+        assert exc.value.code == 429          # overload: typed backpressure
+        assert exc.value.headers["Retry-After"] == "2.5"
+        assert json.loads(exc.value.read())["reason"] == "queue_full"
+        gate.set()                            # unblock A, then B completes
+        deadline = time.time() + 30
+        while (not done_a or not done_b) and time.time() < deadline:
+            time.sleep(0.01)
+        assert done_a == [200] and done_b == [200]
+
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        # trip the breaker: /healthz must flip to 503 {"status": "broken"}
+        for _ in range(eng.config.breaker_threshold):
+            eng.supervisor.record_failure()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/healthz", timeout=10)
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["status"] == "broken"
+        # and /predict now fast-fails 503 circuit_open (not retryable)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=30)
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["reason"] == "circuit_open"
+    finally:
+        gate.set()
+        srv.stop()
+
+
+def test_breaker_trip_drains_server_via_on_break():
+    """Default wiring: a breaker trip starts the server drain on its own
+    thread, so an external supervisor sees unhealthy -> drained."""
+    import numpy as np
+    from paddle_tpu import serving
+
+    eng = serving.BatchingEngine(
+        lambda a: [a[0]],
+        serving.EngineConfig(max_batch_size=1, max_wait_ms=0.0,
+                             breaker_threshold=1))
+    srv = serving.ServingServer(eng, port=0).start()
+    assert eng.on_break is not None           # server claimed the hook
+    eng.supervisor.record_failure()           # trips at threshold 1
+    assert srv._stopped_event.wait(timeout=30), "breaker drain never ran"
+    assert eng.broken and eng.draining

@@ -327,3 +327,28 @@ def test_llm_cpu_rows_never_gate(tmp_path):
     th = _write(tmp_path, "th.json", {"llm-gpt2-tiny": {"llm_tok_s": 200.0}})
     cpu = _write(tmp_path, "cpu.json", [_llm_row(10.0, 50.0, backend="cpu")])
     assert gate.main(["--new", cpu, "--thresholds", th]) == 0
+
+def test_llm_overload_keys_gate_as_ceilings(tmp_path, capsys):
+    """ISSUE 6 overload gates: interactive p99 TTFT under the bench's 2x
+    overload phase and the shed rate are both CEILINGS — the premium tail
+    growing or shedding turning into panic fails the gate."""
+    row = _llm_row(210.0, 5.0)
+    row["extra"].update({"llm_interactive_ttft_p99_ms": 20.0,
+                         "llm_shed_rate": 0.10})
+    th = _write(tmp_path, "th.json",
+                {"llm-gpt2-tiny": {"llm_interactive_ttft_p99_ms": 25.0,
+                                   "llm_shed_rate": 0.20}})
+    ok = _write(tmp_path, "ok.json", [row])
+    assert gate.main(["--new", ok, "--thresholds", th,
+                      "--max-regress", "0.05"]) == 0
+    worse = dict(row, extra=dict(row["extra"],
+                                 llm_interactive_ttft_p99_ms=40.0))
+    bad = _write(tmp_path, "bad.json", [worse])
+    assert gate.main(["--new", bad, "--thresholds", th,
+                      "--max-regress", "0.05"]) == 2
+    assert "llm_interactive_ttft_p99_ms" in capsys.readouterr().out
+    panicking = dict(row, extra=dict(row["extra"], llm_shed_rate=0.50))
+    bad2 = _write(tmp_path, "bad2.json", [panicking])
+    assert gate.main(["--new", bad2, "--thresholds", th,
+                      "--max-regress", "0.05"]) == 2
+    assert "llm_shed_rate" in capsys.readouterr().out

@@ -59,7 +59,13 @@ def _preset_of(row):
 # time-to-first-token a ceiling.
 GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
              "comm_bytes_per_step": "lower", "allreduce_ms": "lower",
-             "llm_tok_s": "higher", "llm_ttft_ms": "lower"}
+             "llm_tok_s": "higher", "llm_ttft_ms": "lower",
+             # ISSUE 6 overload-control gates: under the bench's 2x
+             # overload phase, interactive-class p99 TTFT is a CEILING
+             # (shedding must protect the premium tail) and the shed rate
+             # itself is a ceiling (overload control, not overload panic)
+             "llm_interactive_ttft_p99_ms": "lower",
+             "llm_shed_rate": "lower"}
 
 
 def _metrics_of(row):
@@ -70,7 +76,8 @@ def _metrics_of(row):
     if v is not None:
         out["mfu"] = float(v)
     for k in ("serve_qps", "serve_p99_ms", "comm_bytes_per_step",
-              "allreduce_ms", "llm_tok_s", "llm_ttft_ms"):
+              "allreduce_ms", "llm_tok_s", "llm_ttft_ms",
+              "llm_interactive_ttft_p99_ms", "llm_shed_rate"):
         if extra.get(k) is not None:
             out[k] = float(extra[k])
     return out

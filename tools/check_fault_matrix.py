@@ -9,7 +9,12 @@ and the serving graceful-drain scenario in tests/test_serving.py
 (SIGTERM to a live server: admissions stop, every accepted request is
 answered, exit 0; docs/serving.md), plus the LLM-engine scenarios in
 tests/test_llm_engine.py (slot exhaustion → queueing + admission
-rejects, and SIGTERM drain of in-flight /generate sequences) — then
+rejects, SIGTERM drain of in-flight /generate sequences, and the
+ISSUE 6 supervision matrix: dispatch_raise mid-decode with survivor
+streams bit-identical to a fault-free run, dispatch_hang → watchdog,
+poison_request → quarantine after retries with the KV-pool slot ledger
+balanced, repeated engine failures → circuit breaker → drain, and
+shed-under-overload confined to the lowest SLO class) — then
 prints a pass/fail table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
