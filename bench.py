@@ -454,7 +454,8 @@ def run_llm_bench():
 
     engine = LLMEngine(model, LLMEngineConfig(
         num_slots=num_slots, block_len=8,
-        n_blocks=max(4, -(-(16 + max_new) // 8)),
+        # slots must fit the mixed phase's long prompts (<= 64 tokens)
+        n_blocks=max(4, -(-(64 + max_new) // 8)),
         max_queue_depth=max(4 * num_slots, 64)))
     engine.start()
 
@@ -465,12 +466,11 @@ def run_llm_bench():
                for s in prompt_lens]
     new_lens = rng.randint(max(2, max_new // 4), max_new + 1, size=n_req)
 
-    # compile every prefill bucket + the decode executable BEFORE the timed
-    # trace — a mid-trace jit compile would show up as a fake TTFT spike
-    for s in sorted({len(p) for p in prompts}):
-        engine.generate(prompts[0][:s] if s <= len(prompts[0])
-                        else np.ones((s,), np.int32), max_new_tokens=2,
-                        timeout=300)
+    # ONE warmup request compiles the engine's single unified mixed
+    # prefill+decode executable (ISSUE 7: the per-pow2-bucket prefill zoo
+    # is gone — prompt length no longer selects an executable), so no
+    # mid-trace jit compile can show up as a fake TTFT spike
+    engine.generate(prompts[0], max_new_tokens=2, timeout=300)
     engine.metrics = LLMMetrics()   # warmup rows don't count
     engine.metrics.set_slots(engine.pool.active_slots(),
                              engine.pool.num_slots)
@@ -525,6 +525,57 @@ def run_llm_bench():
             "max_new_tokens": max_new,
         },
     }
+
+    # ---- mixed long/short phase (ISSUE 7): Poisson trace where every 4th
+    # prompt is LONG (40-56 tokens) and the rest are short. Chunked prefill
+    # admits long prompts as fixed-width chunks folded into the decode
+    # dispatch, so a short prompt arriving behind a long one is never
+    # head-of-line blocked behind a whole-prompt prefill. Gates (lower is
+    # better): llm_mixed_ttft_p99_ms (short-prompt TTFT tail) and
+    # llm_prefill_dispatches (steps carrying ONLY prefill rows — chunk
+    # folding should keep this near the slot count, not the request count)
+    if os.environ.get("BENCH_LLM_MIXED", "1") != "0":
+        n_mixed = int(os.environ.get("BENCH_LLM_MIXED_REQUESTS",
+                                     str(max(n_req, 16))))
+        mixed_hz = float(os.environ.get("BENCH_LLM_MIXED_RATE_HZ",
+                                        str(rate_hz)))
+        engine.metrics = LLMMetrics()
+        engine.metrics.set_slots(engine.pool.active_slots(),
+                                 engine.pool.num_slots)
+        pd0 = engine.prefill_dispatches
+        m_gaps = rng.exponential(1.0 / mixed_hz, size=n_mixed)
+        m_handles, m_rejected = [], 0
+        m_new = max(2, max_new // 2)
+        t_next = time.perf_counter()
+        for i, gap in enumerate(m_gaps):
+            t_next += gap
+            delay = t_next - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            plen = int(rng.randint(40, 57)) if i % 4 == 0 \
+                else int(rng.randint(3, 9))
+            try:
+                m_handles.append((plen, engine.submit(
+                    rng.randint(1, vocab, size=plen).astype(np.int32),
+                    max_new_tokens=m_new)))
+            except RejectedError:
+                m_rejected += 1
+        for _, h in m_handles:
+            try:
+                h.result(timeout=120)
+            except Exception:
+                pass
+        short_ttfts = [h.ttft_ms for plen, h in m_handles
+                       if plen <= 8 and h.ttft_ms is not None]
+        mixed_p99 = (float(np.percentile(short_ttfts, 99))
+                     if short_ttfts else 0.0)
+        result["extra"].update({
+            "llm_mixed_ttft_p99_ms": round(mixed_p99, 3),
+            "llm_prefill_dispatches":
+                int(engine.prefill_dispatches - pd0),
+            "mixed_requests": n_mixed,
+            "mixed_rejected": m_rejected,
+        })
 
     # ---- overload phase (ISSUE 6): drive the SAME warm engine at ~2x its
     # measured service rate with a mixed-SLO trace and tight admission

@@ -17,17 +17,16 @@ per row to batch-locked greedy generate().
 """
 from __future__ import annotations
 
-from collections import OrderedDict
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor, no_grad
+from ..utils.jit_cache import JitLRUCache
 
 # varied (B, S0, max_new_tokens, ...) shapes each compile their own
-# prefill+decode executable; an LRU bound keeps a shape-churning caller
-# from growing compiled programs without limit
+# prefill+decode executable; the shared JitLRUCache policy (ISSUE 7)
+# bounds the compiled-program count and warns when callers churn shapes
 _GENERATE_JIT_CACHE_CAP = 8
 
 
@@ -63,21 +62,30 @@ def make_decoder_fns(model):
     length). `caches` is model.init_cache() layout: a list of
     (k [B, Hkv, L, D], v) slabs, one per layer. The model is captured for
     its buffers/structure; call with the model already in eval mode.
+
+    Both functions accept an optional `paged=(block_table [B, max_blocks],
+    seq_lens [B], block_len, pages_per_row)` routing attention through the
+    ragged paged kernel against slot-pool page tables (ISSUE 7; the
+    engine's chunked-prefill mixed dispatch). Left as None, attention runs
+    the trivial contiguous-table path — the same kernel, so streams stay
+    bit-identical across the two callers at a shared block size.
     """
     params, buffers = model.functional_state()
 
-    def prefill(p, prompt, caches_, pos):
+    def prefill(p, prompt, caches_, pos, paged=None):
         with model._bound_state(p, buffers), no_grad():
             logits, new_caches = model.forward_with_cache(
                 Tensor(prompt),
-                [(Tensor(k), Tensor(v)) for k, v in caches_], pos)
+                [(Tensor(k), Tensor(v)) for k, v in caches_], pos,
+                paged=paged)
         return logits.data, [(k.data, v.data) for k, v in new_caches]
 
-    def decode_step(p, tok, pos, caches_):
+    def decode_step(p, tok, pos, caches_, paged=None):
         with model._bound_state(p, buffers), no_grad():
             logits, new_caches = model.forward_with_cache(
                 Tensor(tok[:, None]),
-                [(Tensor(k), Tensor(v)) for k, v in caches_], pos)
+                [(Tensor(k), Tensor(v)) for k, v in caches_], pos,
+                paged=paged)
         return logits.data[:, 0], [(k.data, v.data)
                                    for k, v in new_caches]
 
@@ -111,8 +119,9 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
 
     # jit cache keyed by every static knob: a fresh closure per call would
     # recompile prefill + the decode loop on EVERY generate() invocation
-    gen_cache = model.__dict__.setdefault("_generate_jit_cache",
-                                          OrderedDict())
+    gen_cache = model.__dict__.setdefault(
+        "_generate_jit_cache",
+        JitLRUCache(_GENERATE_JIT_CACHE_CAP, name="generate"))
     cache_key = (B, S0, max_new_tokens, do_sample, float(temperature),
                  int(top_k), eos_token_id)
     # token buffer pre-filled with eos so rows finished before the loop
@@ -152,15 +161,9 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             (jnp.int32(0), tok0, done0, caches_, key, buf))
         return buf, steps
 
-    if cache_key in gen_cache:
-        gen_cache.move_to_end(cache_key)
-    else:
-        gen_cache[cache_key] = jax.jit(run)
-        while len(gen_cache) > _GENERATE_JIT_CACHE_CAP:
-            gen_cache.popitem(last=False)
-    new_toks, steps = gen_cache[cache_key](params, jnp.asarray(ids),
-                                           caches,
-                                           jax.random.PRNGKey(seed))
+    run_jit = gen_cache.get_or_build(cache_key, lambda: jax.jit(run))
+    new_toks, steps = run_jit(params, jnp.asarray(ids), caches,
+                              jax.random.PRNGKey(seed))
     model.__dict__["_last_decode_steps"] = int(steps)
     if was_training:
         model.train()

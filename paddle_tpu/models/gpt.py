@@ -83,7 +83,7 @@ class GPTAttention(Layer):
                                           input_is_parallel=True)
         self.dropout_p = config.attention_dropout_prob
 
-    def forward(self, hidden, cache=None, pos=None):
+    def forward(self, hidden, cache=None, pos=None, paged=None):
         qkv = self.qkv_proj(hidden)
         hd = self.head_dim
         if cache is not None:
@@ -91,7 +91,9 @@ class GPTAttention(Layer):
 
             def attn_dec(a, kc, vc, pos_):
                 # pos_ scalar: whole batch at one offset (generate());
-                # pos_ [B]: per-row offsets (slot-paged decode, ISSUE 5)
+                # pos_ [B]: per-row offsets (slot-paged decode, ISSUE 5).
+                # `paged` (closed over — constants, not Tensors) routes
+                # attention through the slot-pool block tables (ISSUE 7)
                 B, T = a.shape[0], a.shape[1]
                 n_local = a.shape[-1] // (3 * hd)
                 a4 = a.reshape(B, T, n_local, 3 * hd)
@@ -101,7 +103,8 @@ class GPTAttention(Layer):
                 vh = jnp.swapaxes(v, 1, 2)
                 kc, vc = update_kv_cache(kc, vc, kh, vh, pos_)
                 out = decode_attention(qh, kc, vc, pos_,
-                                       scale=1.0 / (hd ** 0.5))
+                                       scale=1.0 / (hd ** 0.5),
+                                       paged=paged)
                 return (jnp.swapaxes(out, 1, 2).reshape(B, T, -1),
                         kc, vc)
 
@@ -163,13 +166,13 @@ class GPTDecoderLayer(Layer):
             aux = None
         return x + self.dropout(h), aux
 
-    def forward(self, x, cache=None, pos=None):
+    def forward(self, x, cache=None, pos=None, paged=None):
         if cache is not None:
             if self.use_moe:
                 raise NotImplementedError(
                     "KV-cache decode is not wired through MoE layers yet")
             h, new_cache = self.self_attn(self.norm1(x), cache=cache,
-                                          pos=pos)
+                                          pos=pos, paged=paged)
             # same dropout as the training forward (identity in eval), so
             # forward_with_cache on a training-mode model matches forward()
             x = x + self.dropout(h)
@@ -205,7 +208,7 @@ class GPTModel(Layer):
         self.final_norm = LayerNorm(config.hidden_size,
                                     epsilon=config.layer_norm_eps)
 
-    def forward(self, input_ids, caches=None, pos=None):
+    def forward(self, input_ids, caches=None, pos=None, paged=None):
         """Returns (hidden, total_aux_loss) — aux is None for dense models.
         With caches: (hidden, new_caches), positions offset by `pos`."""
         S = input_ids.shape[1]
@@ -224,7 +227,8 @@ class GPTModel(Layer):
             hidden = self.dropout(hidden)  # identity in eval; parity with
             new_caches = []                # the training forward
             for layer, cache in zip(self.layers, caches):
-                hidden, nc = layer(hidden, cache=cache, pos=pos)
+                hidden, nc = layer(hidden, cache=cache, pos=pos,
+                                   paged=paged)
                 new_caches.append(nc)
             return self.final_norm(hidden), new_caches
         pos_ids = arange(S, dtype="int64")
@@ -310,8 +314,9 @@ class GPTForCausalLM(Layer):
         return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
                 for _ in range(cfg.num_hidden_layers)]
 
-    def forward_with_cache(self, input_ids, caches, pos):
-        hidden, new_caches = self.gpt(input_ids, caches=caches, pos=pos)
+    def forward_with_cache(self, input_ids, caches, pos, paged=None):
+        hidden, new_caches = self.gpt(input_ids, caches=caches, pos=pos,
+                                      paged=paged)
         return self.lm_head(hidden), new_caches
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,

@@ -123,7 +123,8 @@ class LlamaAttention(Layer):
         self.o_proj = RowParallelLinear(self.num_heads * self.head_dim, h,
                                         has_bias=False, input_is_parallel=True)
 
-    def forward(self, hidden, attn_mask=None, cache=None, pos=None):
+    def forward(self, hidden, attn_mask=None, cache=None, pos=None,
+                paged=None):
         if attn_mask is not None:
             raise NotImplementedError(
                 "padding masks are not wired into the fused attention yet; "
@@ -136,7 +137,7 @@ class LlamaAttention(Layer):
         theta = self.config.rope_theta
         if cache is not None:
             return self._forward_cached(q, k, v, cache, pos, n_rep, hd,
-                                        theta)
+                                        theta, paged=paged)
 
         def attn(qa, ka, va):
             qh = qa.reshape(qa.shape[0], qa.shape[1], -1, hd)
@@ -160,7 +161,8 @@ class LlamaAttention(Layer):
         ctx = apply(attn, q, k, v)
         return self.o_proj(ctx)
 
-    def _forward_cached(self, q, k, v, cache, pos, n_rep, hd, theta):
+    def _forward_cached(self, q, k, v, cache, pos, n_rep, hd, theta,
+                        paged=None):
         """Static-shape KV-cache decode/prefill step (jit/scan friendly):
         new k/v are written into the [B, Hkv, Lmax, D] cache at `pos`,
         attention runs over the FULL cache with an absolute-position causal
@@ -191,8 +193,10 @@ class LlamaAttention(Layer):
             qh = _apply_rope(qh, cos_t, sin_t)
             kh = _apply_rope(kh, cos_t, sin_t)
             kc, vc = update_kv_cache(kc, vc, kh, vh, pos_)
+            # `paged` closed over (constants): slot-pool block-table
+            # routing for the ragged kernel (ISSUE 7)
             out = decode_attention(qh, kc, vc, pos_,
-                                   scale=1.0 / (hd ** 0.5))
+                                   scale=1.0 / (hd ** 0.5), paged=paged)
             out = jnp.swapaxes(out, 1, 2).reshape(B, T, -1)
             return out, kc, vc
 
@@ -239,11 +243,12 @@ class LlamaDecoderLayer(Layer):
         h = self.mlp(h)
         return residual + h
 
-    def forward(self, hidden, cache=None, pos=None):
+    def forward(self, hidden, cache=None, pos=None, paged=None):
         if cache is not None:
             residual = hidden
             h, new_cache = self.self_attn(self.input_layernorm(hidden),
-                                          cache=cache, pos=pos)
+                                          cache=cache, pos=pos,
+                                          paged=paged)
             hidden = residual + h
             hidden = hidden + self.mlp(
                 self.post_attention_layernorm(hidden))
@@ -264,12 +269,13 @@ class LlamaModel(Layer):
                                  for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids, caches=None, pos=None):
+    def forward(self, input_ids, caches=None, pos=None, paged=None):
         hidden = self.embed_tokens(input_ids)
         if caches is not None:
             new_caches = []
             for layer, cache in zip(self.layers, caches):
-                hidden, nc = layer(hidden, cache=cache, pos=pos)
+                hidden, nc = layer(hidden, cache=cache, pos=pos,
+                                   paged=paged)
                 new_caches.append(nc)
             return self.norm(hidden), new_caches
         for layer in self.layers:
@@ -309,8 +315,9 @@ class LlamaForCausalLM(Layer):
         return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
                 for _ in range(cfg.num_hidden_layers)]
 
-    def forward_with_cache(self, input_ids, caches, pos):
-        hidden, new_caches = self.llama(input_ids, caches=caches, pos=pos)
+    def forward_with_cache(self, input_ids, caches, pos, paged=None):
+        hidden, new_caches = self.llama(input_ids, caches=caches, pos=pos,
+                                        paged=paged)
         return self.lm_head(hidden), new_caches
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,

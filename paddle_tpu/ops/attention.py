@@ -741,31 +741,46 @@ def update_kv_cache(k_cache, v_cache, k_new, v_new, pos):
     return row_write(k_cache, k_new, pos), row_write(v_cache, v_new, pos)
 
 
-def decode_attention(q, k_cache, v_cache, pos, scale=None):
+def decode_attention(q, k_cache, v_cache, pos, scale=None, paged=None):
     """Length-masked attention of q [B, H, T, D] over padded static caches
     [B, Hkv, L, D] (GQA: Hkv divides H; kv heads are repeated).
 
     `pos` — scalar or [B] — is the absolute position of q's first token in
     each row; cache columns beyond pos+t are masked to _NEG_INF, so slots
     longer than a row's real length (and garbage beyond it) never perturb
-    the output. fp32 QK^T / softmax / PV with the result cast back to
-    q.dtype, matching the training-side reference attention.
+    the output.
+
+    Both shapes route through `ops.paged_attention.ragged_paged_attention`
+    (ISSUE 7): with `paged=None` each row attends its own contiguous cache
+    via a trivial block table at DEFAULT_KV_BLOCK; `paged=(block_table,
+    seq_lens, block_len)` addresses slot-pool pages directly (the serving
+    engine's chunked-prefill/decode mixed dispatch). One numeric path means
+    continuous-batched streams stay bit-identical to one-shot generate()
+    whenever both sides use the same kv block size — the flash-accumulation
+    grouping, and therefore the bits, depend on block_len alone.
     """
+    from .paged_attention import (DEFAULT_KV_BLOCK, ragged_paged_attention,
+                                  trivial_block_table)
     B, H, T, D = q.shape
     if scale is None:
         scale = 1.0 / (D ** 0.5)
-    k, v = k_cache, v_cache
-    if H != k.shape[1]:
-        n_rep = H // k.shape[1]
-        k = jnp.repeat(k, n_rep, axis=1)
-        v = jnp.repeat(v, n_rep, axis=1)
-    s = jnp.einsum("bhtd,bhld->bhtl", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    col = jnp.arange(k.shape[2])
-    row_pos = jnp.asarray(pos)[..., None] + jnp.arange(T)  # [T] or [B, T]
-    valid = col <= row_pos[..., None]
-    valid = valid[None, None] if valid.ndim == 2 else valid[:, None]
-    s = jnp.where(valid, s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhtl,bhld->bhtd", p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    if paged is not None:
+        # pool slabs may carry chunk write-padding past the page region,
+        # so the caller names the addressable page geometry explicitly
+        block_table, seq_lens, block_len, pages_per_row = paged
+        return ragged_paged_attention(
+            q, k_cache, v_cache, block_table, seq_lens, jnp.asarray(pos),
+            block_len=int(block_len), pages_per_row=int(pages_per_row),
+            scale=scale)
+    L = k_cache.shape[2]
+    table, nb = trivial_block_table(B, L, DEFAULT_KV_BLOCK)
+    pad = nb * DEFAULT_KV_BLOCK - L
+    if pad:
+        k_cache = jnp.pad(k_cache, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v_cache = jnp.pad(v_cache, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    pos = jnp.asarray(pos)
+    q_pos = jnp.broadcast_to(pos, (B,)).astype(jnp.int32)
+    seq_lens = q_pos + T
+    return ragged_paged_attention(q, k_cache, v_cache, table, seq_lens,
+                                  q_pos, block_len=DEFAULT_KV_BLOCK,
+                                  pages_per_row=nb, scale=scale)

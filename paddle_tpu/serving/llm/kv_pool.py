@@ -13,10 +13,23 @@ static shapes for the compiler, block tables for the scheduler).
 
 All device writes stay static-shape: rows are filled via
 `dynamic_update_slice` (per-row vmapped in the decode hot path), never a
-dynamic-extent scatter, so one compiled prefill executable per prompt
-bucket plus ONE decode executable serve every request mix. The pool
-itself is host-side bookkeeping (numpy tables + stats); the slabs it owns
-are jax arrays threaded through the engine's jitted calls.
+dynamic-extent scatter, so ONE mixed prefill+decode executable serves
+every request mix. The pool is host-side bookkeeping (numpy tables +
+stats); the slabs it owns are jax arrays threaded through the engine's
+jitted calls.
+
+ISSUE 7: the block tables are additionally exposed as padded DEVICE
+arrays — `device_block_table() [num_slots, n_blocks]` and
+`device_seq_lens() [num_slots]` — consumed directly by the ragged paged
+attention kernel. Uploads are version-gated and incremental: the table
+holds each slot's identity stripe (slot*n_blocks + i) and is uploaded
+once (rows change only via `set_block_row`, e.g. future prefix sharing),
+while seq_lens re-uploads lazily only when some length actually changed
+since the last fetch — never a host-side rebuild per iteration.
+`pad_tokens` extends each slab past the addressable capacity so chunked
+prefill's fixed-width `dynamic_update_slice` writes near the capacity
+edge land in scratch columns instead of clamping back onto valid KV;
+block tables never address the pad region.
 """
 from __future__ import annotations
 
@@ -44,20 +57,27 @@ class SlotPagedKVPool:
     """
 
     def __init__(self, init_cache_fn: Callable, num_slots: int,
-                 block_len: int, n_blocks: int, dtype=None):
+                 block_len: int, n_blocks: int, dtype=None,
+                 pad_tokens: int = 0):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if block_len < 1 or n_blocks < 1:
             raise ValueError(
                 f"block_len/n_blocks must be >= 1, got "
                 f"{block_len}/{n_blocks}")
+        if pad_tokens < 0:
+            raise ValueError(f"pad_tokens must be >= 0, got {pad_tokens}")
         self.num_slots = int(num_slots)
         self.block_len = int(block_len)
         self.n_blocks = int(n_blocks)
         self.capacity = self.block_len * self.n_blocks  # tokens per slot
+        # slab columns past `capacity` are write-scratch for fixed-width
+        # chunked-prefill stripes; never addressed by any block table
+        self.pad_tokens = int(pad_tokens)
+        self.slab_len = self.capacity + self.pad_tokens
         kwargs = {} if dtype is None else {"dtype": dtype}
         self.slabs: List[Tuple[jnp.ndarray, jnp.ndarray]] = [
-            (k, v) for k, v in init_cache_fn(self.num_slots, self.capacity,
+            (k, v) for k, v in init_cache_fn(self.num_slots, self.slab_len,
                                              **kwargs)]
         self.lengths = np.zeros((self.num_slots,), np.int32)
         self.active = np.zeros((self.num_slots,), bool)
@@ -71,6 +91,20 @@ class SlotPagedKVPool:
         self.stats = {"allocs": 0, "frees": 0, "reuses": 0,
                       "alloc_failures": 0, "defrags": 0, "peak_active": 0}
         self._scrub = None   # lazily-jitted defrag kernel
+        # device-array mirrors for the ragged kernel: identity stripes
+        # (slot s owns global pages s*n_blocks..s*n_blocks+n_blocks-1);
+        # version counters gate re-upload so the hot loop pays a transfer
+        # only when something actually changed
+        self._host_table = (
+            np.arange(self.num_slots, dtype=np.int32)[:, None]
+            * self.n_blocks
+            + np.arange(self.n_blocks, dtype=np.int32)[None, :])
+        self._table_version = 1
+        self._table_uploaded = 0
+        self._dev_table: Optional[jnp.ndarray] = None
+        self._lens_version = 1
+        self._lens_uploaded = 0
+        self._dev_lens: Optional[jnp.ndarray] = None
 
     # ---- allocation ----
     def allocate(self, need_tokens: int) -> int:
@@ -93,6 +127,8 @@ class SlotPagedKVPool:
         if self.dirty[slot]:
             self.stats["reuses"] += 1
             self.dirty[slot] = False
+        if self.lengths[slot] != 0:
+            self._lens_version += 1
         self.lengths[slot] = 0
         self.block_table[slot] = []
         self.stats["allocs"] += 1
@@ -105,6 +141,8 @@ class SlotPagedKVPool:
             raise ValueError(f"slot {slot} is not active")
         self.active[slot] = False
         self.dirty[slot] = True
+        if self.lengths[slot] != 0:
+            self._lens_version += 1
         self.lengths[slot] = 0
         self.block_table.pop(slot, None)
         self.stats["frees"] += 1
@@ -117,10 +155,47 @@ class SlotPagedKVPool:
         if length > self.capacity:
             raise ValueError(
                 f"length {length} exceeds slot capacity {self.capacity}")
+        if int(self.lengths[slot]) != int(length):
+            self._lens_version += 1
         self.lengths[slot] = length
         blocks = -(-int(length) // self.block_len)
         self.block_table[slot] = [slot * self.n_blocks + i
                                   for i in range(blocks)]
+
+    def set_block_row(self, slot: int, blocks: List[int]):
+        """Point `slot`'s device-table row at an explicit page list
+        (incremental update — only this row changes; padding pages past
+        len(blocks) are don't-cares masked by seq_lens). The escape hatch
+        for non-identity layouts: defragged pools in tests today, prefix
+        sharing tomorrow."""
+        if len(blocks) > self.n_blocks:
+            raise ValueError(
+                f"slot row holds at most {self.n_blocks} pages, got "
+                f"{len(blocks)}")
+        row = np.zeros((self.n_blocks,), np.int32)
+        row[:len(blocks)] = np.asarray(blocks, np.int32)
+        if not np.array_equal(self._host_table[slot], row):
+            self._host_table[slot] = row
+            self._table_version += 1
+
+    # ---- device mirrors (ragged paged attention inputs) ----
+    def device_block_table(self) -> jnp.ndarray:
+        """[num_slots, n_blocks] int32 page ids, uploaded lazily on
+        version change (identity stripes → effectively uploaded once)."""
+        if self._dev_table is None \
+                or self._table_uploaded != self._table_version:
+            self._dev_table = jnp.asarray(self._host_table)
+            self._table_uploaded = self._table_version
+        return self._dev_table
+
+    def device_seq_lens(self) -> jnp.ndarray:
+        """[num_slots] int32 committed lengths, uploaded lazily only when
+        some set_length() actually changed a value."""
+        if self._dev_lens is None \
+                or self._lens_uploaded != self._lens_version:
+            self._dev_lens = jnp.asarray(self.lengths)
+            self._lens_uploaded = self._lens_version
+        return self._dev_lens
 
     # ---- views ----
     def free_slots(self) -> int:
@@ -140,6 +215,15 @@ class SlotPagedKVPool:
 
     def lengths_array(self) -> jnp.ndarray:
         return jnp.asarray(self.lengths)
+
+    def fragmentation_ratio(self) -> float:
+        """Fraction of allocated block tokens not holding valid KV:
+        1 - sum(lengths) / (used_blocks * block_len). 0.0 when idle —
+        exported as the LLMMetrics fragmentation gauge."""
+        used = self.used_blocks()
+        if used == 0:
+            return 0.0
+        return 1.0 - float(self.lengths.sum()) / (used * self.block_len)
 
     def snapshot(self) -> dict:
         return {

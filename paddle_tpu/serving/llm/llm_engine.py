@@ -1,40 +1,49 @@
 """Continuous-batching LLM decode engine over the slot-paged KV pool
-(ISSUE 5 tentpole; ISSUE 6 supervision + overload control).
+(ISSUE 5 tentpole; ISSUE 6 supervision + overload control; ISSUE 7
+ragged paged attention + chunked prefill).
 
 The batch-locked `models.generation.generate()` loop makes every sequence
 enter together, share one prompt length and pay the batch's full
 `max_new_tokens` — one long request holds the whole batch's KV slabs
 hostage. This engine schedules the same numeric path (the
-`make_decoder_fns` prefill/decode builders, so outputs are bit-identical
-per row) as a continuously-batched service:
+`make_decoder_fns` prefill builder routed through the ragged
+paged-attention kernel, so outputs are bit-identical per row) as a
+continuously-batched service:
 
-- `prefill_into_slot` — one jitted call per pow2 prompt bucket: runs the
-  prompt through a fresh cache row, writes the row into the pool slab at
-  the allocated slot, and emits the first greedy token (TTFT ends here);
-- `decode_step` — ONE jitted fixed-width call over all `num_slots` rows
-  (the active-slot gather is a host-side table; inactive rows decode a
-  harmless token-0 at position 0 of their own free slot, which the next
-  prefill overwrites wholesale). Per-row positions ride the [B]-vector
-  `pos` support in the cached attention path;
-- between decode iterations the scheduler admits queued requests into
-  freed slots and evicts finished rows (EOS / per-request max-tokens /
-  deadline), so a short request never waits for a long one;
+- ONE unified mixed-row dispatch per pump iteration (`_step_once`): every
+  slot contributes a fixed-width `[prefill_chunk]` row — a prompt chunk
+  for prefilling requests, `[last_tok, 0, ...]` for decoding requests,
+  zeros for free slots — and the single jitted executable writes all KV
+  stripes, runs ragged paged attention over the pool's block tables +
+  per-row target lengths, and emits each row's next greedy token. No
+  per-pow2-bucket prefill executable zoo, no bucket padding FLOPs: the
+  engine compiles exactly one step program for its lifetime;
+- **chunked prefill**: prompts longer than `prefill_chunk` are admitted
+  as fixed-size chunks interleaved with the decode loop, so a short
+  prompt's TTFT is bounded by a couple of chunk-width steps instead of a
+  long neighbor's whole-prompt prefill. A row's first token is emitted by
+  the step that lands its final chunk (TTFT ends there);
+- between iterations the scheduler admits queued requests into freed
+  slots and evicts finished rows (EOS / per-request max-tokens /
+  deadline — queued, mid-prefill and mid-decode alike), so a short
+  request never waits for a long one;
 - admission control reuses the serving vocabulary: bounded queue →
-  `RejectedError`, absolute deadlines → `DeadlineExceededError` (queued
-  requests are dropped before prefill; decoding rows are evicted
-  mid-stream with their partial tokens still readable off the handle).
+  `RejectedError`, absolute deadlines → `DeadlineExceededError`.
 
-Supervision (ISSUE 6): every jitted dispatch runs through an
-`EngineSupervisor` — failures arrive as typed `DispatchFailedError`s, a
-hung dispatch trips the watchdog (`DispatchHungError`), and the failure
-protocol keeps faults request-scoped: a failing prefill retries and then
-quarantines ONLY its request (reason "poisoned", slot freed); a failing
-decode retries whole, then blame-probes each active row in isolation and
-quarantines the implicated ones, so survivors' streams stay bit-identical
-to a fault-free run; non-attributable decode failures fail the active
-rows and count toward the engine circuit breaker, which opens after
-`breaker_threshold` consecutive engine-level failures (admissions reject
-with reason "circuit_open", /healthz flips to 503, the server drains).
+Supervision (ISSUE 6, chunk-granular under ISSUE 7): every jitted
+dispatch runs through an `EngineSupervisor` — failures arrive as typed
+`DispatchFailedError`s, a hung dispatch trips the watchdog
+(`DispatchHungError`). The failure protocol keeps faults request-scoped
+at CHUNK granularity: a failing step retries whole, then blame-probes
+each active row in isolation (prefilling rows probe as "prefill" kind at
+their current chunk offset, decoding rows as "decode") and quarantines
+the implicated requests — a request poisoned in chunk k>0 is evicted
+without touching co-scheduled decode rows, whose streams stay
+bit-identical to a fault-free run because probe results are never
+committed. Non-attributable failures fail the active rows and count
+toward the engine circuit breaker, which opens after `breaker_threshold`
+consecutive engine-level failures (admissions reject with reason
+"circuit_open", /healthz flips to 503, the server drains).
 
 Overload control (ISSUE 6): requests carry an SLO class —
 `interactive` > `batch` > `best_effort` — admitted in strict priority
@@ -61,7 +70,7 @@ import threading
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -86,10 +95,10 @@ class LLMEngineConfig:
     max_new_tokens: int = 32       # default per-request generation cap
     eos_token_id: Optional[int] = None   # per-request override wins
     default_deadline_ms: Optional[float] = None
-    prompt_bucket_pow2: bool = True  # pad prompts to pow2 buckets so the
-    #                                  number of prefill executables stays
-    #                                  logarithmic in slot capacity
-    min_prompt_bucket: int = 8
+    prefill_chunk: int = 16        # prompt tokens prefilled per step; also
+    #                                the unified step's fixed row width, so
+    #                                it bounds how long a long prompt can
+    #                                stall its neighbors (TTFT knob)
     drain_timeout_s: float = 60.0
     cache_dtype: Optional[object] = None  # pool slab dtype override
     # ---- overload control (ISSUE 6) ----
@@ -104,8 +113,7 @@ class LLMEngineConfig:
     retry_after_s: float = 1.0     # backpressure hint on overload rejects
     # ---- supervision (ISSUE 6) ----
     dispatch_timeout_s: Optional[float] = None  # hung-dispatch watchdog
-    prefill_retries: int = 2       # per-request retries before quarantine
-    dispatch_retries: int = 2      # whole-decode retries before blame/fail
+    dispatch_retries: int = 2      # whole-step retries before blame/fail
     breaker_threshold: int = 3     # consecutive engine-level failures that
     #                                open the circuit breaker
 
@@ -118,6 +126,9 @@ class LLMEngineConfig:
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {self.prefill_chunk}")
         if self.default_slo not in SLO_CLASSES:
             raise ValueError(
                 f"default_slo must be one of {SLO_CLASSES}, got "
@@ -126,7 +137,7 @@ class LLMEngineConfig:
             raise ValueError(
                 f"brownout_max_new_tokens must be >= 1, got "
                 f"{self.brownout_max_new_tokens}")
-        if self.prefill_retries < 0 or self.dispatch_retries < 0:
+        if self.dispatch_retries < 0:
             raise ValueError("retry counts must be >= 0")
         if self.breaker_threshold < 1:
             raise ValueError(
@@ -167,7 +178,7 @@ class GenerationHandle:
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "eos_token_id", "arrival",
                  "deadline", "handle", "slot", "emitted", "last_tok",
-                 "slo", "submit_idx", "cost")
+                 "slo", "submit_idx", "cost", "chunk_off")
 
     def __init__(self, prompt, max_new_tokens, eos_token_id, arrival,
                  deadline, slo, submit_idx):
@@ -184,13 +195,9 @@ class _GenRequest:
         self.slot: Optional[int] = None
         self.emitted: List[int] = []
         self.last_tok: int = 0
-
-
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+        self.chunk_off: int = 0           # prompt tokens already prefilled;
+        #                                   < len(prompt) means the request
+        #                                   is still in chunked prefill
 
 
 class LLMEngine:
@@ -220,9 +227,14 @@ class LLMEngine:
         self.metrics = metrics or LLMMetrics()
         self.params, self._prefill_fn, self._decode_fn = \
             make_decoder_fns(model)
+        # pad_tokens=prefill_chunk: the fixed-width KV stripe written at a
+        # row's position needs chunk-width scratch past the last
+        # addressable block so near-capacity writes never clamp back onto
+        # valid KV (block tables never point into the pad region)
         self.pool = SlotPagedKVPool(
             model.init_cache, self.config.num_slots, self.config.block_len,
-            self.config.n_blocks, dtype=self.config.cache_dtype)
+            self.config.n_blocks, dtype=self.config.cache_dtype,
+            pad_tokens=self.config.prefill_chunk)
         self.metrics.set_slots(0, self.pool.num_slots)
         self._queues: Dict[str, deque] = {c: deque() for c in SLO_CLASSES}
         self._active: Dict[int, _GenRequest] = {}   # slot -> request
@@ -231,9 +243,12 @@ class LLMEngine:
         self._stopped = False
         self._brownout = False
         self._thread: Optional[threading.Thread] = None
-        self._prefill_jit: Dict[int, object] = {}   # prompt bucket -> fn
-        self._decode_jit = None
-        self.decode_iterations = 0   # lifetime decode_step dispatches
+        self._step_jit = None        # the ONE unified step executable
+        self.decode_iterations = 0   # lifetime steps carrying >=1 decode row
+        self.prefill_dispatches = 0  # lifetime steps carrying ONLY prefill
+        #                              rows — near-zero under mixed load,
+        #                              which is what proves the per-bucket
+        #                              prefill executable zoo is gone
         self._submit_idx = 0         # lifetime admissions (poison keying)
         self._dispatch_idx = 0       # lifetime dispatch attempts (fault
         #                              clauses key on this index)
@@ -247,62 +262,58 @@ class LLMEngine:
             breaker_threshold=self.config.breaker_threshold,
             on_trip=self._on_breaker_trip, name="llm")
 
-    # ---- jitted executables ----
-    def _prefill_for_bucket(self, bucket: int):
-        if bucket not in self._prefill_jit:
-            slab_specs = [(k.shape, k.dtype, v.shape, v.dtype)
-                          for k, v in self.pool.slabs]
+    # ---- the one jitted executable ----
+    def _step(self):
+        """Unified mixed-row step: `toks [N, C]` carries each slot's chunk
+        (prompt tokens for prefilling rows, [last_tok, 0...] for decoding
+        rows, zeros for free slots), `pos [N]` the row's committed length
+        (= write offset), `adv [N]` how many of the C columns are real
+        (chunk size / 1 / 0). KV stripes are written at `pos` (garbage
+        columns past `adv` land in cols the row's validity never reaches
+        or in the slab's pad region, and are overwritten before any
+        seq_len admits them); ragged paged attention masks every row to
+        `col <= pos+t` and `col < pos+adv`; each row's next greedy token
+        is read at query index `adv-1` (free rows emit a harmless argmax
+        of a fully-masked zero row)."""
+        if self._step_jit is None:
+            block_len = self.pool.block_len
+            pages_per_row = self.pool.n_blocks
 
-            def prefill_into_slot(params, prompt, length, slot, slabs):
-                # prompt [1, bucket] (zero-padded past `length`); a fresh
-                # single-row cache is filled, then written over the slot's
-                # WHOLE stripe (so stale KV from the previous occupant is
-                # wiped) and the first greedy token read at length-1.
-                rows = [(jnp.zeros((1,) + ks[1:], kd),
-                         jnp.zeros((1,) + vs[1:], vd))
-                        for ks, kd, vs, vd in slab_specs]
-                logits, rows = self._prefill_fn(params, prompt, rows,
-                                                jnp.int32(0))
-                new_slabs = [
-                    (jax.lax.dynamic_update_slice(ks, rk, (slot, 0, 0, 0)),
-                     jax.lax.dynamic_update_slice(vs, rv, (slot, 0, 0, 0)))
-                    for (ks, vs), (rk, rv) in zip(slabs, rows)]
-                last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
-                                                    axis=0, keepdims=False)
-                tok0 = jnp.argmax(last, axis=-1).astype(jnp.int32)
-                return tok0, new_slabs
+            def step(params, toks, pos, adv, table, slabs):
+                seq_lens = (pos + adv).astype(jnp.int32)
+                paged = (table, seq_lens, block_len, pages_per_row)
+                logits, slabs = self._prefill_fn(params, toks, slabs, pos,
+                                                 paged=paged)
+                sel = jnp.maximum(adv - 1, 0)
+                last = jnp.take_along_axis(
+                    logits, sel[:, None, None], axis=1)[:, 0]
+                return jnp.argmax(last, axis=-1).astype(jnp.int32), slabs
 
-            self._prefill_jit[bucket] = jax.jit(prefill_into_slot)
-        return self._prefill_jit[bucket]
-
-    def _decode(self):
-        if self._decode_jit is None:
-            def decode_step(params, toks, pos, slabs):
-                # toks/pos [num_slots]: every slot decodes every iteration
-                # (fixed width, ONE executable); inactive rows carry
-                # (tok=0, pos=0) and scribble on their own free slot only.
-                logits, slabs = self._decode_fn(params, toks, pos, slabs)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), slabs
-
-            self._decode_jit = jax.jit(decode_step)
-        return self._decode_jit
+            self._step_jit = jax.jit(step)
+        return self._step_jit
 
     # ---- supervised dispatch ----
-    def _run_dispatch(self, kind: str, fn, args, request_ids=()):
+    def _run_dispatch(self, kinds, fn, args):
         """One supervised jitted dispatch attempt. Every attempt — retries
         and blame probes included — consumes a dispatch index, which is
-        what deterministic fault clauses key on."""
+        what deterministic fault clauses key on. `kinds` is the ordered
+        (kind, request_ids) pairs riding this dispatch — prefill rows
+        announce first, then decode rows, both at the SAME index (a
+        dispatch_raise clause fires once, at the first announcement;
+        poison_request clauses match their kind)."""
         idx = self._dispatch_idx
         self._dispatch_idx += 1
         plan = self._fault_plan
+        label = "+".join(k for k, _ in kinds) or "step"
 
         def guarded():
             if plan is not None:
-                plan.maybe_dispatch_fault(idx, kind=kind,
-                                          request_ids=request_ids)
+                for kind, rids in kinds:
+                    plan.maybe_dispatch_fault(idx, kind=kind,
+                                              request_ids=rids)
             return fn(*args)
 
-        return self.supervisor.run(guarded, label=kind)
+        return self.supervisor.run(guarded, label=label)
 
     # ---- lifecycle ----
     def start(self) -> "LLMEngine":
@@ -598,18 +609,20 @@ class LLMEngine:
 
     def pump(self) -> int:
         """One scheduler pass: drop expired queued requests, admit queued
-        requests into free slots (one jitted prefill each), then run at
-        most ONE fixed-width decode iteration and retire finished/evicted
-        rows. Returns the number of decode iterations executed (0 or 1) —
+        requests into free slots (bookkeeping only — no dispatch), then
+        run ONE unified mixed prefill+decode step and retire
+        finished/evicted rows. Returns the number of decode iterations
+        executed (0 or 1; a step carrying only prefill chunks returns 0) —
         the quantity the continuous-batching tests count. This is THE
         scheduler: the background thread and the sim harness both call
         it."""
         now = self.clock.now()
         self._drop_expired_queued(now)
         self._admit()
-        n = self._decode_once()
+        n = self._step_once()
         with self._cond:
             self.metrics.set_inflight_tokens(self._inflight_tokens_locked())
+        self.metrics.set_fragmentation(self.pool.fragmentation_ratio())
         return n
 
     def _drop_expired_queued(self, now: float):
@@ -635,12 +648,12 @@ class LLMEngine:
                 self.metrics.set_queue_depth(self._queue_len_locked())
 
     def _admit(self):
-        """Prefill queued requests into free slots, highest SLO class
-        first. Runs between decode iterations — each admission is one
-        supervised jitted prefill_into_slot call that also emits the
-        request's first token (TTFT)."""
-        while True:
-            with self._cond:
+        """Move queued requests into free slots, highest SLO class first —
+        pure bookkeeping (slot allocation + chunk_off=0); their prompt
+        chunks ride the next unified step alongside everyone else's
+        decode rows."""
+        with self._cond:
+            while True:
                 self._update_brownout_locked()
                 if self.supervisor.open or self.pool.free_slots() == 0:
                     return
@@ -649,111 +662,92 @@ class LLMEngine:
                     return
                 self.metrics.set_queue_depth(self._queue_len_locked())
                 slot = self.pool.allocate(req.cost)
-            self._prefill_into(req, slot)
-
-    def _prefill_into(self, req: _GenRequest, slot: int) -> bool:
-        """Supervised prefill with the per-request failure protocol: retry
-        up to config.prefill_retries times; exhaustion quarantines THIS
-        request (prefill carries exactly one, so attribution is exact) —
-        its future fails with reason "poisoned", its slot is freed, and
-        the breaker is absolved (a poisoned request is not an engine
-        fault). Returns True when the request prefilled."""
-        length = len(req.prompt)
-        bucket = self._bucket_of(length)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :length] = req.prompt
-        fn = self._prefill_for_bucket(bucket)
-        args = (self.params, jnp.asarray(padded), jnp.int32(length),
-                jnp.int32(slot), self.pool.slabs)
-        attempts = self.config.prefill_retries + 1
-        last_err = None
-        for attempt in range(attempts):
-            try:
-                tok0, new_slabs = self._run_dispatch(
-                    "prefill", fn, args, request_ids=(req.submit_idx,))
-            except DispatchFailedError as e:
-                last_err = e
-                self.metrics.on_dispatch_failure(e.reason)
-                _log.warning(
-                    "prefill dispatch failed for request %d "
-                    "(attempt %d/%d): %s", req.submit_idx, attempt + 1,
-                    attempts, e)
-                continue
-            self.pool.slabs = new_slabs
-            # NOTE: a prefill success does not record_success() — the
-            # breaker tracks ENGINE-level (decode-protocol) failures, and a
-            # broken engine that still lands per-request prefills must not
-            # have its failure streak laundered between decode attempts
-            break
-        else:
-            with self._cond:
-                self.pool.free(slot)
+                req.slot = slot
+                req.chunk_off = 0
+                self._active[slot] = req
                 self.metrics.set_slots(self.pool.active_slots(),
                                        self.pool.num_slots)
-            req.handle.future.set_exception(DispatchFailedError(
-                f"request {req.submit_idx} quarantined: prefill failed "
-                f"{attempts} consecutive times ({last_err})",
-                reason="poisoned"))
-            self.metrics.on_fail()
-            self.metrics.on_quarantine()
-            self.supervisor.absolve()
-            return False
-        now = self.clock.now()
-        req.slot = slot
-        req.handle.ttft_ms = (now - req.arrival) * 1e3
-        self.metrics.on_prefill(req.handle.ttft_ms, slo=req.slo)
-        self._emit(req, int(tok0))
-        with self._cond:
-            if self._finish_if_done(req, now):
-                return True
-            self.pool.set_length(slot, length)
-            self._active[slot] = req
-            self.metrics.set_slots(self.pool.active_slots(),
-                                   self.pool.num_slots)
-        return True
 
-    def _bucket_of(self, length: int) -> int:
-        if not self.config.prompt_bucket_pow2:
-            return length
-        return max(self.config.min_prompt_bucket,
-                   min(_next_pow2(length), self.pool.capacity))
+    def _build_rows_locked(self):
+        """Assemble the unified step's host-side row set from the active
+        table: (toks [N, C], pos [N], adv [N], prefill_slots,
+        decode_slots). Free slots stay all-zero (adv=0 → fully masked)."""
+        N = self.pool.num_slots
+        C = self.config.prefill_chunk
+        toks = np.zeros((N, C), np.int32)
+        pos = np.zeros((N,), np.int32)
+        adv = np.zeros((N,), np.int32)
+        prefill_slots: List[int] = []
+        decode_slots: List[int] = []
+        for slot, req in self._active.items():
+            plen = len(req.prompt)
+            if req.chunk_off < plen:
+                off = req.chunk_off
+                n = min(C, plen - off)
+                toks[slot, :n] = req.prompt[off:off + n]
+                pos[slot] = off
+                adv[slot] = n
+                prefill_slots.append(slot)
+            else:
+                toks[slot, 0] = req.last_tok
+                pos[slot] = self.pool.lengths[slot]
+                adv[slot] = 1
+                decode_slots.append(slot)
+        return toks, pos, adv, prefill_slots, decode_slots
 
-    def _decode_once(self) -> int:
+    def _kinds_of(self, prefill_slots, decode_slots) -> Tuple:
+        """(kind, request_ids) announcement order for fault injection:
+        prefill rows first, then decode rows, both at one dispatch idx."""
+        kinds = []
+        if prefill_slots:
+            kinds.append(("prefill", tuple(sorted(
+                self._active[s].submit_idx for s in prefill_slots))))
+        if decode_slots:
+            kinds.append(("decode", tuple(sorted(
+                self._active[s].submit_idx for s in decode_slots))))
+        return tuple(kinds)
+
+    def _step_once(self) -> int:
+        """Run ONE unified mixed prefill+decode dispatch over every slot
+        and commit its results. Returns 1 when the committed step carried
+        at least one decode row (the decode-iteration count the
+        continuous-batching invariants pin), else 0."""
         while True:
             with self._cond:
                 if not self._active:
                     return 0
-                toks = np.zeros((self.pool.num_slots,), np.int32)
-                pos = np.zeros((self.pool.num_slots,), np.int32)
-                for slot, req in self._active.items():
-                    toks[slot] = req.last_tok
-                    pos[slot] = self.pool.lengths[slot]
-                active_ids = tuple(sorted(
-                    r.submit_idx for r in self._active.values()))
+                toks, pos, adv, prefill_slots, decode_slots = \
+                    self._build_rows_locked()
+                kinds = self._kinds_of(prefill_slots, decode_slots)
             t0 = self.clock.now()
-            fn = self._decode()
+            fn = self._step()
             args = (self.params, jnp.asarray(toks), jnp.asarray(pos),
+                    jnp.asarray(adv), self.pool.device_block_table(),
                     self.pool.slabs)
             attempts = self.config.dispatch_retries + 1
             last_err = None
             nxt = None
             for attempt in range(attempts):
                 try:
-                    nxt, new_slabs = self._run_dispatch(
-                        "decode", fn, args, request_ids=active_ids)
+                    nxt, new_slabs = self._run_dispatch(kinds, fn, args)
                 except DispatchFailedError as e:
                     last_err = e
                     self.metrics.on_dispatch_failure(e.reason)
                     _log.warning(
-                        "decode dispatch failed over %d active rows "
-                        "(attempt %d/%d): %s", len(active_ids), attempt + 1,
+                        "unified step dispatch failed over %d prefill + %d "
+                        "decode row(s) (attempt %d/%d): %s",
+                        len(prefill_slots), len(decode_slots), attempt + 1,
                         attempts, e)
                     continue
                 self.pool.slabs = new_slabs
-                self.supervisor.record_success()
+                if decode_slots:
+                    # the breaker tracks ENGINE-level (decode-protocol)
+                    # failures; prefill-only successes must not launder a
+                    # failure streak between decode attempts
+                    self.supervisor.record_success()
                 break
             else:
-                if self._blame_and_quarantine(fn, toks, pos, last_err):
+                if self._blame_and_quarantine(fn, toks, pos, adv, last_err):
                     continue    # survivors retry on a rebuilt row set
                 self._fail_all_active(attempts, last_err)
                 self.supervisor.record_failure()
@@ -761,37 +755,73 @@ class LLMEngine:
             nxt = np.asarray(nxt)
             now = self.clock.now()
             with self._cond:
-                rows = len(self._active)
-                self.decode_iterations += 1
-                for slot, req in list(self._active.items()):
+                n_decode = len(decode_slots)
+                if n_decode:
+                    self.decode_iterations += 1
+                elif prefill_slots:
+                    self.prefill_dispatches += 1
+                for slot in prefill_slots:
+                    req = self._active[slot]
+                    n = int(adv[slot])
+                    self.pool.set_length(slot, req.chunk_off + n)
+                    req.chunk_off += n
+                    if req.chunk_off >= len(req.prompt):
+                        # final chunk landed: first token emitted, TTFT
+                        # ends here
+                        req.handle.ttft_ms = (now - req.arrival) * 1e3
+                        self.metrics.on_prefill(req.handle.ttft_ms,
+                                                slo=req.slo)
+                        self._emit(req, int(nxt[slot]))
+                        if self._finish_if_done(req, now):
+                            del self._active[slot]
+                        elif req.deadline is not None and now >= req.deadline:
+                            self._evict_expired_locked(req, slot, now)
+                    elif req.deadline is not None and now >= req.deadline:
+                        # mid-prefill eviction: no tokens yet, but the slot
+                        # must not keep absorbing chunk work
+                        self._evict_expired_locked(req, slot, now)
+                for slot in decode_slots:
+                    req = self._active[slot]
                     # the decode wrote last_tok's KV at pos[slot]
                     self.pool.set_length(slot, int(pos[slot]) + 1)
                     self._emit(req, int(nxt[slot]))
                     if self._finish_if_done(req, now):
                         del self._active[slot]
                     elif req.deadline is not None and now >= req.deadline:
-                        # mid-decode eviction: partial tokens stay readable
-                        # on the handle; the future fails with the error
-                        req.handle.future.set_exception(DeadlineExceededError(
-                            f"deadline expired after {len(req.emitted)} of "
-                            f"{req.max_new_tokens} tokens "
-                            "(evicted mid-decode)"))
-                        self.metrics.on_expire()
-                        self.pool.free(slot)
-                        del self._active[slot]
+                        self._evict_expired_locked(req, slot, now)
                 self.metrics.set_slots(self.pool.active_slots(),
                                        self.pool.num_slots)
-            self.metrics.on_decode_step(rows, (now - t0) * 1e3)
-            return 1
+            if n_decode:
+                self.metrics.on_decode_step(n_decode, (now - t0) * 1e3)
+                return 1
+            return 0
 
-    def _blame_and_quarantine(self, fn, toks, pos, last_err) -> bool:
-        """Decode retries exhausted: probe each active request in
-        ISOLATION — the same fixed-width dispatch with every other row
-        masked to (tok=0, pos=0), attributed to that single request — and
-        quarantine the rows whose solo presence reproduces the failure.
-        Probe results are never committed (slabs are immutable jax arrays;
-        only a successful full decode assigns pool.slabs), so survivors'
-        streams stay bit-identical to a fault-free run.
+    def _evict_expired_locked(self, req: _GenRequest, slot: int,
+                              now: float):
+        """Deadline eviction of an active row (mid-prefill or mid-decode):
+        partial tokens stay readable on the handle; the future fails with
+        the deadline error."""
+        stage = ("mid-prefill" if req.chunk_off < len(req.prompt)
+                 else "mid-decode")
+        req.handle.future.set_exception(DeadlineExceededError(
+            f"deadline expired after {len(req.emitted)} of "
+            f"{req.max_new_tokens} tokens (evicted {stage})"))
+        self.metrics.on_expire()
+        self.pool.free(slot)
+        del self._active[slot]
+
+    def _blame_and_quarantine(self, fn, toks, pos, adv, last_err) -> bool:
+        """Step retries exhausted: probe each active request in ISOLATION
+        — the same fixed-width dispatch with every other row masked to
+        (toks=0, pos=0, adv=0), announced as that single request's kind
+        ("prefill" for a row still in chunked prefill, "decode"
+        otherwise) — and quarantine the rows whose solo presence
+        reproduces the failure. Probe results are never committed (slabs
+        are immutable jax arrays; only a successful full step assigns
+        pool.slabs), so survivors' streams stay bit-identical to a
+        fault-free run — including decode rows co-scheduled with a
+        request poisoned in prefill chunk k>0, which lose nothing but the
+        failed step's wall time.
 
         When EVERY probe of a multi-row batch fails, the failure is not
         attributable to any one request — that is an engine-level fault
@@ -804,13 +834,17 @@ class LLMEngine:
         for slot, req in suspects:
             solo_toks = np.zeros_like(toks)
             solo_pos = np.zeros_like(pos)
+            solo_adv = np.zeros_like(adv)
             solo_toks[slot] = toks[slot]
             solo_pos[slot] = pos[slot]
+            solo_adv[slot] = adv[slot]
+            kind = ("prefill" if req.chunk_off < len(req.prompt)
+                    else "decode")
             args = (self.params, jnp.asarray(solo_toks),
-                    jnp.asarray(solo_pos), self.pool.slabs)
+                    jnp.asarray(solo_pos), jnp.asarray(solo_adv),
+                    self.pool.device_block_table(), self.pool.slabs)
             try:
-                self._run_dispatch("decode", fn, args,
-                                   request_ids=(req.submit_idx,))
+                self._run_dispatch(((kind, (req.submit_idx,)),), fn, args)
             except DispatchFailedError as e:
                 blamed.append((slot, req, e))
         if not blamed or (len(blamed) == len(suspects) and len(suspects) > 1):
@@ -830,13 +864,13 @@ class LLMEngine:
             self.metrics.set_slots(self.pool.active_slots(),
                                    self.pool.num_slots)
         self.supervisor.absolve()
-        _log.warning("quarantined %d poisoned request(s); retrying decode "
-                     "with %d survivor(s)", len(blamed),
+        _log.warning("quarantined %d poisoned request(s); retrying the "
+                     "unified step with %d survivor(s)", len(blamed),
                      len(suspects) - len(blamed))
         return True
 
     def _fail_all_active(self, attempts: int, last_err):
-        """Non-attributable decode failure: fail every active request with
+        """Non-attributable step failure: fail every active request with
         a typed error (partial tokens stay readable), free their slots,
         and let the caller charge the circuit breaker."""
         with self._cond:

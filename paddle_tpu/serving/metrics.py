@@ -220,6 +220,10 @@ class LLMMetrics(ServingMetrics):
             c: deque(maxlen=self.window) for c in SLO_CLASSES}
         self.brownout = False
         self.inflight_tokens = 0
+        # KV-pool block fragmentation (ISSUE 7): fraction of allocated
+        # block tokens not holding valid KV, from
+        # SlotPagedKVPool.fragmentation_ratio()
+        self.fragmentation = 0.0
 
     def _class(self, slo) -> Optional[Dict[str, int]]:
         return self.class_counters.get(slo) if slo else None
@@ -263,6 +267,10 @@ class LLMMetrics(ServingMetrics):
     def set_inflight_tokens(self, tokens: int):
         with self._lock:
             self.inflight_tokens = int(tokens)
+
+    def set_fragmentation(self, ratio: float):
+        with self._lock:
+            self.fragmentation = float(ratio)
 
     def on_prefill(self, ttft_ms: float, slo: Optional[str] = None):
         with self._lock:
@@ -324,6 +332,7 @@ class LLMMetrics(ServingMetrics):
                             for c, v in self.class_counters.items()}
             s["brownout"] = self.brownout
             s["inflight_tokens"] = self.inflight_tokens
+            s["kv_fragmentation"] = self.fragmentation
         s["slot_occupancy"] = (self.slots_active / self.slots_total
                                if self.slots_total else 0.0)
         s["tokens_per_s"] = self.tokens_per_s()
@@ -386,6 +395,8 @@ class LLMMetrics(ServingMetrics):
             f"{px}_brownout_entries_total {s['brownout_entries']}",
             f"# TYPE {px}_inflight_tokens gauge",
             f"{px}_inflight_tokens {s['inflight_tokens']}",
+            f"# TYPE {px}_kv_fragmentation gauge",
+            f"{px}_kv_fragmentation {round(s['kv_fragmentation'], 4)}",
         ]
         return "\n".join(lines) + "\n"
 

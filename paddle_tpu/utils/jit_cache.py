@@ -1,0 +1,83 @@
+"""Bounded LRU cache for jitted executables (ISSUE 7 satellite).
+
+Every place the runtime builds a jax.jit program per static shape/knob
+combination (one-shot `generate()`'s prefill+decode loop; historically the
+LLM engine's per-pow2-bucket prefill zoo, now gone) shares this one
+policy: hold at most `cap` executables, evict least-recently-used, and
+WARN when the caller's shapes churn — a cache that keeps evicting is a
+cache that keeps recompiling, and on TPU each recompile is seconds of
+dead time that should be fixed at the call site (bucket the shapes) rather
+than hidden by a bigger cap.
+"""
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from typing import Callable, Hashable
+
+_log = logging.getLogger("paddle_tpu.jit_cache")
+
+# evictions within one `churn_window` builds that trigger the warning
+_CHURN_FRACTION = 0.5
+
+
+class JitLRUCache:
+    """OrderedDict-backed LRU of compiled callables.
+
+    get_or_build(key, build) returns the cached executable for `key`,
+    building (and possibly evicting) on miss. `evictions` is a lifetime
+    counter the tests pin; the churn warning fires (once per window) when
+    at least half the last `churn_window` builds caused an eviction."""
+
+    def __init__(self, cap: int, name: str = "jit", churn_window: int = 8):
+        if cap < 1:
+            raise ValueError(f"cap must be >= 1, got {cap}")
+        self.cap = int(cap)
+        self.name = name
+        self.churn_window = int(churn_window)
+        self._cache: "OrderedDict[Hashable, object]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self._recent_evictions = 0
+        self._recent_builds = 0
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+    def __contains__(self, key: Hashable) -> bool:
+        return key in self._cache
+
+    def get_or_build(self, key: Hashable, build: Callable[[], object]):
+        if key in self._cache:
+            self.hits += 1
+            self._cache.move_to_end(key)
+            return self._cache[key]
+        self.misses += 1
+        fn = build()
+        self._cache[key] = fn
+        self._recent_builds += 1
+        while len(self._cache) > self.cap:
+            evicted_key, _ = self._cache.popitem(last=False)
+            self.evictions += 1
+            self._recent_evictions += 1
+            _log.debug("%s cache evicted %r (cap %d)", self.name,
+                       evicted_key, self.cap)
+        if self._recent_builds >= self.churn_window:
+            if (self._recent_evictions
+                    >= self._recent_builds * _CHURN_FRACTION):
+                _log.warning(
+                    "%s jit cache churning: %d of the last %d builds "
+                    "evicted a compiled executable (cap %d). Callers are "
+                    "cycling more static shapes than the cache holds — "
+                    "bucket the shapes or raise the cap",
+                    self.name, self._recent_evictions, self._recent_builds,
+                    self.cap)
+            self._recent_builds = 0
+            self._recent_evictions = 0
+        return fn
+
+    def stats(self) -> dict:
+        return {"size": len(self._cache), "cap": self.cap,
+                "hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions}

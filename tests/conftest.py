@@ -80,6 +80,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "llm: continuous-batching LLM decode-engine tests "
         "(slot-paged KV pool, serving/llm/); select with -m llm")
+    config.addinivalue_line(
+        "markers", "paged: ragged paged attention + chunked prefill tests "
+        "(ops/paged_attention.py parity suite, device block tables, "
+        "chunk-granular scheduling); select with -m paged")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -93,3 +97,5 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.comm)
         if mod == "test_llm_engine":
             item.add_marker(pytest.mark.llm)
+        if mod == "test_paged_attention":
+            item.add_marker(pytest.mark.paged)

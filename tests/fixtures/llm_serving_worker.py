@@ -40,8 +40,8 @@ def main():
             num_slots=SLOTS, block_len=8, n_blocks=8,
             max_new_tokens=MAX_NEW, max_queue_depth=64))
     engine.start()
-    # warm every executable the traffic will hit (bucket-8 prefill + the
-    # decode step), so SIGTERM lands mid-decode rather than mid-compile;
+    # warm the unified mixed prefill+decode step executable the traffic
+    # will hit, so SIGTERM lands mid-decode rather than mid-compile;
     # then reset metrics so the final snapshot reconciles client-for-client
     engine.generate([1, 2, 3], max_new_tokens=2, timeout=300)
     engine.metrics = serving.LLMMetrics()

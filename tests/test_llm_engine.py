@@ -259,7 +259,7 @@ def test_mid_decode_eviction_keeps_partial_tokens(gpt_tiny):
         gpt_tiny, serving.LLMEngineConfig(num_slots=1, block_len=8,
                                           n_blocks=4), clock=clock)
     h = eng.submit([1, 2, 3, 4], max_new_tokens=16, deadline_ms=50.0)
-    eng.pump()                             # prefill + 1 decode, t=0
+    eng.pump()                             # prefill chunk lands: tok0, t=0
     clock.advance(0.1)                     # blow the deadline mid-stream
     eng.pump()                             # decodes once more, then evicts
     with pytest.raises(serving.DeadlineExceededError, match="evicted"):
@@ -371,8 +371,8 @@ def test_dispatch_raise_mid_decode_retries_bit_identically(gpt_tiny):
                np.arange(11, 15, dtype=np.int32)]
     ref = np.asarray(generate(gpt_tiny, np.stack(prompts),
                               max_new_tokens=6).numpy())[:, 4:]
-    # dispatch indices: 0 = prefill r0, 1 = prefill r1, 2 = decode (ok),
-    # 3 = decode (raises once), 4 = the retry (succeeds)
+    # dispatch indices: 0 = the mixed prefill step (both rows, tok0 out),
+    # 1/2 = decodes (ok), 3 = decode (raises once), 4 = retry (succeeds)
     plan = FaultPlan.from_spec("dispatch_raise@3")
     eng = _sup_engine(gpt_tiny, plan, serving.SimClock())
     handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
@@ -418,7 +418,7 @@ def test_dispatch_hang_maps_to_watchdog_and_recovers(gpt_tiny):
 @pytest.mark.fault_matrix
 def test_poisoned_prefill_quarantines_only_its_request(gpt_tiny):
     """poison_request fires on EVERY dispatch carrying submit-index 0:
-    its prefill fails all prefill_retries+1 attempts, the request is
+    its prefill chunk fails all dispatch_retries+1 attempts, the request is
     quarantined (typed reason 'poisoned', slot freed, breaker absolved)
     and the innocent request streams bit-identically."""
     from paddle_tpu import serving
@@ -500,13 +500,14 @@ def test_repeated_engine_failures_trip_circuit_breaker(gpt_tiny):
     from paddle_tpu import serving
     from paddle_tpu.utils.fault_injection import FaultPlan
 
-    # round 1: idx 0/1 prefills, idx 2 decode raises, probes idx 3 and 4
-    # raise too -> unattributable -> engine failure #1.
-    # round 2: idx 5/6 prefills, idx 7 decode + probes 8/9 raise ->
+    # round 1: idx 0 = prefill step (ok, tok0 out), idx 1 = decode raises
+    # (dispatch_retries=0), blame probes idx 2 and 3 raise too ->
+    # unattributable -> engine failure #1.
+    # round 2: idx 4 prefill ok, idx 5 decode + probes 6/7 raise ->
     # engine failure #2 -> breaker opens (threshold 2).
     plan = FaultPlan.from_spec(
-        "dispatch_raise@2;dispatch_raise@3;dispatch_raise@4;"
-        "dispatch_raise@7;dispatch_raise@8;dispatch_raise@9")
+        "dispatch_raise@1;dispatch_raise@2;dispatch_raise@3;"
+        "dispatch_raise@5;dispatch_raise@6;dispatch_raise@7")
     trips = []
     clock = serving.SimClock()
     from paddle_tpu.serving import LLMEngine, LLMEngineConfig
@@ -516,14 +517,16 @@ def test_repeated_engine_failures_trip_circuit_breaker(gpt_tiny):
                         dispatch_retries=0, breaker_threshold=2),
         clock=clock, fault_plan=plan, on_break=lambda: trips.append(1))
     r0 = [eng.submit([i + 1, i + 2], max_new_tokens=4) for i in range(2)]
-    eng.pump()
+    eng.pump()                              # prefill-only step succeeds
+    eng.pump()                              # decode fails unattributably
     for h in r0:
         with pytest.raises(serving.DispatchFailedError) as exc:
             h.result(timeout=0)
         assert exc.value.reason == "engine"
     assert not eng.broken                   # one failure, threshold is 2
     r1 = [eng.submit([i + 5, i + 6], max_new_tokens=4) for i in range(2)]
-    eng.pump()
+    eng.pump()                              # prefill-only step succeeds
+    eng.pump()                              # second unattributable failure
     assert eng.broken and trips == [1]
     for h in r1:
         with pytest.raises(serving.DispatchFailedError) as exc:
@@ -662,10 +665,16 @@ def test_llm_drain_timeout_fails_stragglers_typed(gpt_tiny):
         gpt_tiny, serving.LLMEngineConfig(num_slots=1, block_len=8,
                                           n_blocks=4))
 
-    def wedged_decode(params, toks, pos, slabs):
+    real_step = eng._step()                 # build the real unified step
+    calls = []
+
+    def wedged_step(*args):
+        if not calls:                       # let h1's prefill chunk land
+            calls.append(1)
+            return real_step(*args)
         release.wait(60)
         raise RuntimeError("released")
-    eng._decode_jit = wedged_decode
+    eng._step_jit = wedged_step             # _step() now returns the wedge
 
     eng.start()
     h1 = eng.submit([1, 2], max_new_tokens=4)       # will wedge mid-decode

@@ -65,7 +65,14 @@ GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
              # (shedding must protect the premium tail) and the shed rate
              # itself is a ceiling (overload control, not overload panic)
              "llm_interactive_ttft_p99_ms": "lower",
-             "llm_shed_rate": "lower"}
+             "llm_shed_rate": "lower",
+             # ISSUE 7 chunked-prefill gates: short-prompt p99 TTFT under
+             # the mixed long/short trace is a CEILING (chunk folding must
+             # keep shorts from queueing behind long prefills), and so is
+             # the count of prefill-ONLY dispatches (prefill chunks should
+             # ride decode steps, not spend dispatches of their own)
+             "llm_mixed_ttft_p99_ms": "lower",
+             "llm_prefill_dispatches": "lower"}
 
 
 def _metrics_of(row):
@@ -77,7 +84,8 @@ def _metrics_of(row):
         out["mfu"] = float(v)
     for k in ("serve_qps", "serve_p99_ms", "comm_bytes_per_step",
               "allreduce_ms", "llm_tok_s", "llm_ttft_ms",
-              "llm_interactive_ttft_p99_ms", "llm_shed_rate"):
+              "llm_interactive_ttft_p99_ms", "llm_shed_rate",
+              "llm_mixed_ttft_p99_ms", "llm_prefill_dispatches"):
         if extra.get(k) is not None:
             out[k] = float(extra[k])
     return out

@@ -14,7 +14,11 @@ ISSUE 6 supervision matrix: dispatch_raise mid-decode with survivor
 streams bit-identical to a fault-free run, dispatch_hang → watchdog,
 poison_request → quarantine after retries with the KV-pool slot ledger
 balanced, repeated engine failures → circuit breaker → drain, and
-shed-under-overload confined to the lowest SLO class) — then
+shed-under-overload confined to the lowest SLO class), and the ISSUE 7
+chunked-prefill blame scenarios in tests/test_paged_attention.py
+(`paged`-marked module: a request poisoned mid-chunked-prefill — chunk
+k>0 included — is quarantined without evicting co-scheduled decode
+rows, whose streams stay bit-identical) — then
 prints a pass/fail table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
@@ -37,6 +41,7 @@ TEST_FILES = [
     os.path.join("tests", "test_resilient.py"),
     os.path.join("tests", "test_serving.py"),
     os.path.join("tests", "test_llm_engine.py"),
+    os.path.join("tests", "test_paged_attention.py"),
 ]
 
 
