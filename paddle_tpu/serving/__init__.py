@@ -36,4 +36,5 @@ from .sim import (Arrival, ReplayReport, poisson_trace,  # noqa: F401
 from .server import ServingServer, serve  # noqa: F401
 from . import llm  # noqa: F401
 from .llm import (GenerationHandle, LLMEngine,  # noqa: F401
-                  LLMEngineConfig, SlotPagedKVPool, SlotsExhaustedError)
+                  LLMEngineConfig, PrefixCache, SlotPagedKVPool,
+                  SlotsExhaustedError)

@@ -22,3 +22,4 @@ from .kv_pool import SlotPagedKVPool, SlotsExhaustedError  # noqa: F401
 from .llm_engine import (DispatchFailedError,  # noqa: F401
                          DispatchHungError, GenerationHandle, LLMEngine,
                          LLMEngineConfig)
+from .prefix_cache import AttachPlan, PrefixCache  # noqa: F401

@@ -1,15 +1,14 @@
-"""Slot-paged static KV cache pool (ISSUE 5 tentpole).
+"""Slot-paged static KV cache pool (ISSUE 5 tentpole; ISSUE 8 shared
+block pool).
 
 A fixed pool of `num_slots` cache slots backed by one static slab per
-layer: `[num_slots, Hkv, block_len * n_blocks, D]` (exactly the model's
-`init_cache(num_slots, capacity)` layout, so the pool, one-shot
+layer: `[num_slots, Hkv, block_len * n_blocks (+ pad), D]` (exactly the
+model's `init_cache(num_slots, capacity)` layout, so the pool, one-shot
 `generate()` and the training-side cached forward share one cache
 format). Slots are the unit of admission — a sequence owns one slot from
-prefill to eviction — and blocks are the unit of *accounting*: the
-per-slot block table tracks which `block_len`-sized stripes of the slab a
-sequence's KV actually occupies, which is what slot-occupancy metrics and
-defrag hygiene reason about (Ragged Paged Attention keeps the same split:
-static shapes for the compiler, block tables for the scheduler).
+prefill to eviction — and blocks are the unit of *accounting and
+sharing*: the per-slot block table tracks which `block_len`-sized pages
+of the slabs back a sequence's KV.
 
 All device writes stay static-shape: rows are filled via
 `dynamic_update_slice` (per-row vmapped in the decode hot path), never a
@@ -21,19 +20,43 @@ jitted calls.
 ISSUE 7: the block tables are additionally exposed as padded DEVICE
 arrays — `device_block_table() [num_slots, n_blocks]` and
 `device_seq_lens() [num_slots]` — consumed directly by the ragged paged
-attention kernel. Uploads are version-gated and incremental: the table
-holds each slot's identity stripe (slot*n_blocks + i) and is uploaded
-once (rows change only via `set_block_row`, e.g. future prefix sharing),
-while seq_lens re-uploads lazily only when some length actually changed
-since the last fetch — never a host-side rebuild per iteration.
-`pad_tokens` extends each slab past the addressable capacity so chunked
-prefill's fixed-width `dynamic_update_slice` writes near the capacity
-edge land in scratch columns instead of clamping back onto valid KV;
-block tables never address the pad region.
+attention kernel. Uploads are version-gated and incremental. `pad_tokens`
+extends each slab past the addressable capacity so chunked prefill's
+fixed-width writes near the capacity edge land in scratch columns; block
+tables never address the pad region.
+
+ISSUE 8 — the shared block pool under the prefix cache. The KV write
+path (`ops/attention.update_kv_cache`) always lands a dispatch row's new
+KV in that row's own slab stripe at its logical column offset, so a
+slot's OWN page for logical block j is invariably the physical page
+`slot * n_blocks + j`; only the READ side (the ragged kernel's block
+table) redirects. Prefix sharing is therefore expressed as:
+
+- `attach_blocks(slot, pages)` points a slot's leading logical blocks at
+  pages physically living in OTHER rows (the row of the slot that
+  originally prefilled them), refcounting every shared page;
+- `cow_copy(src_page, dst_slot)` copies one shared *partial* block into
+  the slot's own page so the suffix can diverge in place (copy-on-write);
+- a prefix cache pins pages via `register_cached`/`release_cached`; rows
+  holding pinned pages are never handed out by `allocate` (a fresh
+  prefill would overwrite the cached KV) — under pressure `allocate`
+  invokes the `on_pressure` hook so the cache can evict refcount-0
+  entries LRU-first, and pages with live readers are structurally
+  un-evictable;
+- `defrag` is refcount-aware at PAGE granularity: it scrubs the stale
+  columns of freed rows while leaving cached pages bit-intact;
+- the ledger extends from slots to blocks: every page ever claimed is
+  freed, active, or cached — `check_balance()` proves both ledgers.
+
+Ownership: a page claimed by a slot counts as *active* while the slot
+lives. When the slot frees, each own page either transfers to the cache
+(it was registered: now *cached*) or is *freed*. Evicting a cache-owned
+page frees it. `blocks_allocated == blocks_freed + blocks_active +
+blocks_cached` at every quiescent point.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,19 +64,21 @@ import numpy as np
 
 
 class SlotsExhaustedError(RuntimeError):
-    """allocate() found no free slot — every slot is decoding. The engine
-    maps this to queueing (and ultimately RejectedError admission control),
-    never to a dynamic reallocation: pool size is a compile-time shape."""
+    """allocate() found no usable free slot — every row is decoding or
+    pinned by cached blocks with live readers. The engine maps this to
+    queueing (and ultimately RejectedError admission control), never to a
+    dynamic reallocation: pool size is a compile-time shape."""
 
 
 class SlotPagedKVPool:
-    """Fixed pool of KV cache slots with block/length accounting.
+    """Fixed pool of KV cache slots with block/length accounting and a
+    shared, refcounted block pool for prefix sharing.
 
     init_cache_fn(batch, max_len) must return the model's cache pytree — a
     list of (k, v) arrays shaped [batch, Hkv, max_len, D] — and is called
-    once with batch=num_slots, max_len=block_len*n_blocks. Models enforce
-    their own limits here (GPT refuses capacity beyond its learned
-    position table).
+    once with batch=num_slots, max_len=block_len*n_blocks (+pad). Models
+    enforce their own limits here (GPT refuses capacity beyond its
+    learned position table).
     """
 
     def __init__(self, init_cache_fn: Callable, num_slots: int,
@@ -81,24 +106,35 @@ class SlotPagedKVPool:
                                              **kwargs)]
         self.lengths = np.zeros((self.num_slots,), np.int32)
         self.active = np.zeros((self.num_slots,), bool)
-        # freed-but-not-scrubbed slots: their blocks still hold stale KV
-        # until defrag() zeroes them (hygiene, not correctness — prefill
-        # overwrites the whole row on reuse)
+        # freed-but-not-scrubbed rows: their non-cached pages still hold
+        # stale KV until defrag() zeroes them (hygiene, not correctness —
+        # prefill overwrites the written range on reuse)
         self.dirty = np.zeros((self.num_slots,), bool)
-        # slot -> global block ids backing its current length (contiguous
-        # within the slot's stripe: slot*n_blocks + i)
+        # slot -> global page ids backing its current length: leading
+        # entries may be attached (shared) pages in other rows, the rest
+        # are the slot's own identity pages (slot*n_blocks + j)
         self.block_table: Dict[int, List[int]] = {}
+        # ---- shared-block state (ISSUE 8) ----
+        self._attached: Dict[int, List[int]] = {}   # slot -> shared pages
+        self._own_claimed: Dict[int, int] = {}      # slot -> own pages
+        self.refcount: Dict[int, int] = {}          # page -> live readers
+        self.cached: Set[int] = set()               # pages pinned by cache
+        self._cache_owned: Set[int] = set()         # cached, owner freed
+        # cache-pressure hook: called by allocate() when free rows exist
+        # but every one is pinned; the prefix cache wires its LRU
+        # eviction here and returns the number of pages released
+        self.on_pressure: Optional[Callable[[], int]] = None
         self.stats = {"allocs": 0, "frees": 0, "reuses": 0,
-                      "alloc_failures": 0, "defrags": 0, "peak_active": 0}
-        self._scrub = None   # lazily-jitted defrag kernel
+                      "alloc_failures": 0, "defrags": 0, "peak_active": 0,
+                      "blocks_allocated": 0, "blocks_freed": 0,
+                      "cow_copies": 0}
+        self._scrub = None   # lazily-jitted defrag kernel (page mask)
+        self._cow = None     # lazily-jitted copy-on-write block copy
         # device-array mirrors for the ragged kernel: identity stripes
-        # (slot s owns global pages s*n_blocks..s*n_blocks+n_blocks-1);
-        # version counters gate re-upload so the hot loop pays a transfer
-        # only when something actually changed
-        self._host_table = (
-            np.arange(self.num_slots, dtype=np.int32)[:, None]
-            * self.n_blocks
-            + np.arange(self.n_blocks, dtype=np.int32)[None, :])
+        # (slot s owns global pages s*n_blocks..s*n_blocks+n_blocks-1)
+        # until attach_blocks redirects a row; version counters gate
+        # re-upload so the hot loop pays a transfer only on change
+        self._host_table = self._identity_table()
         self._table_version = 1
         self._table_uploaded = 0
         self._dev_table: Optional[jnp.ndarray] = None
@@ -106,12 +142,33 @@ class SlotPagedKVPool:
         self._lens_uploaded = 0
         self._dev_lens: Optional[jnp.ndarray] = None
 
+    def _identity_table(self) -> np.ndarray:
+        return (np.arange(self.num_slots, dtype=np.int32)[:, None]
+                * self.n_blocks
+                + np.arange(self.n_blocks, dtype=np.int32)[None, :])
+
+    def _identity_row(self, slot: int) -> List[int]:
+        return [slot * self.n_blocks + j for j in range(self.n_blocks)]
+
+    def _row_pinned(self, row: int) -> bool:
+        """A row holding ANY cached page cannot be handed to a fresh
+        sequence: its prefill would overwrite shared KV in place."""
+        base = row * self.n_blocks
+        return any((base + j) in self.cached for j in range(self.n_blocks))
+
+    def has_allocatable_row(self) -> bool:
+        return any(not self.active[r] and not self._row_pinned(r)
+                   for r in range(self.num_slots))
+
     # ---- allocation ----
     def allocate(self, need_tokens: int) -> int:
-        """Claim a free slot for a sequence that will grow to
-        `need_tokens` (prompt + max_new_tokens). Raises ValueError when the
-        request can never fit and SlotsExhaustedError when the pool is
-        momentarily full."""
+        """Claim a free, unpinned slot for a sequence that will grow to
+        `need_tokens` (prompt + max_new_tokens). Raises ValueError when
+        the request can never fit and SlotsExhaustedError when the pool
+        is momentarily full. When every free row is pinned by cached
+        blocks, the `on_pressure` hook (the prefix cache's LRU eviction)
+        gets one chance to release refcount-0 entries before the
+        exhaustion verdict — pages with live readers are never touched."""
         if need_tokens > self.capacity:
             raise ValueError(
                 f"sequence needs {need_tokens} tokens but slot capacity is "
@@ -122,7 +179,16 @@ class SlotPagedKVPool:
             self.stats["alloc_failures"] += 1
             raise SlotsExhaustedError(
                 f"all {self.num_slots} slots active")
-        slot = int(free[0])
+        slot = next((int(r) for r in free if not self._row_pinned(r)), None)
+        if slot is None and self.on_pressure is not None:
+            self.on_pressure()
+            slot = next((int(r) for r in free if not self._row_pinned(r)),
+                        None)
+        if slot is None:
+            self.stats["alloc_failures"] += 1
+            raise SlotsExhaustedError(
+                f"every free slot is pinned by cached blocks with live "
+                f"readers ({free.size} free of {self.num_slots})")
         self.active[slot] = True
         if self.dirty[slot]:
             self.stats["reuses"] += 1
@@ -131,14 +197,31 @@ class SlotPagedKVPool:
             self._lens_version += 1
         self.lengths[slot] = 0
         self.block_table[slot] = []
+        self._attached[slot] = []
+        self._own_claimed[slot] = 0
+        self.set_block_row(slot, self._identity_row(slot))
         self.stats["allocs"] += 1
         self.stats["peak_active"] = max(self.stats["peak_active"],
                                         int(self.active.sum()))
         return slot
 
     def free(self, slot: int):
+        """Release a slot: drop the refcount it held on every attached
+        (shared) page, and settle its OWN pages' ledger — pages the cache
+        registered transfer ownership to the cache, the rest are freed."""
         if not self.active[slot]:
             raise ValueError(f"slot {slot} is not active")
+        for p in self._attached.get(slot, ()):
+            self.release_block(p)
+        n_att = len(self._attached.get(slot, ()))
+        for j in range(n_att, n_att + self._own_claimed.get(slot, 0)):
+            p = slot * self.n_blocks + j
+            if p in self.cached:
+                self._cache_owned.add(p)
+            else:
+                self.stats["blocks_freed"] += 1
+        self._attached.pop(slot, None)
+        self._own_claimed.pop(slot, None)
         self.active[slot] = False
         self.dirty[slot] = True
         if self.lengths[slot] != 0:
@@ -148,8 +231,10 @@ class SlotPagedKVPool:
         self.stats["frees"] += 1
 
     def set_length(self, slot: int, length: int):
-        """Record `length` valid tokens in `slot`, growing its block table
-        to ceil(length / block_len) blocks."""
+        """Record `length` valid tokens in `slot`, growing its block
+        table to ceil(length / block_len) pages: the attached shared
+        prefix first, then the slot's own identity pages. Newly-claimed
+        own pages charge the block ledger."""
         if not self.active[slot]:
             raise ValueError(f"slot {slot} is not active")
         if length > self.capacity:
@@ -159,15 +244,118 @@ class SlotPagedKVPool:
             self._lens_version += 1
         self.lengths[slot] = length
         blocks = -(-int(length) // self.block_len)
-        self.block_table[slot] = [slot * self.n_blocks + i
-                                  for i in range(blocks)]
+        attached = self._attached.get(slot, [])
+        own_needed = max(0, blocks - len(attached))
+        claimed = self._own_claimed.get(slot, 0)
+        if own_needed > claimed:
+            self.stats["blocks_allocated"] += own_needed - claimed
+            self._own_claimed[slot] = own_needed
+        self.block_table[slot] = (
+            attached[:blocks]
+            + [slot * self.n_blocks + j
+               for j in range(len(attached), blocks)])
+
+    # ---- prefix sharing (ISSUE 8) ----
+    def attach_blocks(self, slot: int, pages: List[int]):
+        """Point `slot`'s leading logical blocks at shared pages computed
+        by other slots, taking a refcount on each for this slot's
+        lifetime. Every shared page must be cache-registered and must sit
+        at its logical block offset (`page % n_blocks == j` — the write
+        path guarantees a slot's block j is physically at column j of its
+        own row, so cached pages always satisfy this)."""
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        if len(pages) > self.n_blocks:
+            raise ValueError(
+                f"cannot attach {len(pages)} pages to a "
+                f"{self.n_blocks}-block slot")
+        for j, p in enumerate(pages):
+            if p not in self.cached:
+                raise ValueError(
+                    f"page {p} is not cache-registered; only cached "
+                    "blocks can be shared")
+            if p % self.n_blocks != j:
+                raise ValueError(
+                    f"page {p} lives at block offset {p % self.n_blocks}, "
+                    f"cannot back logical block {j}")
+        for p in pages:
+            self.refcount[p] = self.refcount.get(p, 0) + 1
+        self._attached[slot] = list(pages)
+        self.set_block_row(
+            slot, list(pages) + [slot * self.n_blocks + j
+                                 for j in range(len(pages), self.n_blocks)])
+
+    def release_block(self, page: int):
+        """Drop one reader's refcount on a shared page."""
+        n = self.refcount.get(page, 0)
+        if n <= 1:
+            self.refcount.pop(page, None)
+        else:
+            self.refcount[page] = n - 1
+
+    def cow_copy(self, src_page: int, dst_slot: int):
+        """Copy-on-write: copy one shared (partial) block's KV into
+        `dst_slot`'s own page at the same logical offset, so the slot can
+        append divergent tokens into it. One jitted two-op copy
+        (dynamic_slice + dynamic_update_slice) per slab; traced row/col
+        offsets keep it a single executable per slab shape."""
+        if not self.active[dst_slot]:
+            raise ValueError(f"slot {dst_slot} is not active")
+        block_idx = src_page % self.n_blocks
+        src_row = src_page // self.n_blocks
+        if src_row == dst_slot:
+            return
+        if self._cow is None:
+            blk_len = self.block_len
+
+            def _cow(slab, src_r, dst_r, c0):
+                blk = jax.lax.dynamic_slice(
+                    slab, (src_r, 0, c0, 0),
+                    (1, slab.shape[1], blk_len, slab.shape[3]))
+                return jax.lax.dynamic_update_slice(
+                    slab, blk, (dst_r, 0, c0, 0))
+
+            self._cow = jax.jit(_cow)
+        sr = jnp.int32(src_row)
+        dr = jnp.int32(dst_slot)
+        c0 = jnp.int32(block_idx * self.block_len)
+        self.slabs = [(self._cow(k, sr, dr, c0), self._cow(v, sr, dr, c0))
+                      for k, v in self.slabs]
+        self.stats["cow_copies"] += 1
+
+    def register_cached(self, page: int):
+        """Pin a page on behalf of the prefix cache: its row leaves the
+        allocatable set and defrag will never scrub its columns."""
+        if not (0 <= page < self.num_slots * self.n_blocks):
+            raise ValueError(f"page {page} out of range")
+        if page in self.cached:
+            raise ValueError(f"page {page} already cache-registered")
+        self.cached.add(page)
+
+    def release_cached(self, page: int):
+        """Cache eviction: unpin a page. Refuses while readers hold it.
+        A cache-owned page (its slot freed) settles to the freed side of
+        the block ledger; its row becomes scrub-eligible again."""
+        if page not in self.cached:
+            raise ValueError(f"page {page} is not cache-registered")
+        if self.refcount.get(page, 0) > 0:
+            raise ValueError(
+                f"page {page} has {self.refcount[page]} live reader(s); "
+                "evicting it would corrupt active streams")
+        self.cached.discard(page)
+        if page in self._cache_owned:
+            self._cache_owned.discard(page)
+            self.stats["blocks_freed"] += 1
+        row = page // self.n_blocks
+        if not self.active[row]:
+            self.dirty[row] = True
 
     def set_block_row(self, slot: int, blocks: List[int]):
         """Point `slot`'s device-table row at an explicit page list
         (incremental update — only this row changes; padding pages past
-        len(blocks) are don't-cares masked by seq_lens). The escape hatch
-        for non-identity layouts: defragged pools in tests today, prefix
-        sharing tomorrow."""
+        len(blocks) are don't-cares masked by seq_lens). The mechanism
+        under attach_blocks, and the escape hatch for non-identity
+        layouts in tests."""
         if len(blocks) > self.n_blocks:
             raise ValueError(
                 f"slot row holds at most {self.n_blocks} pages, got "
@@ -181,7 +369,8 @@ class SlotPagedKVPool:
     # ---- device mirrors (ragged paged attention inputs) ----
     def device_block_table(self) -> jnp.ndarray:
         """[num_slots, n_blocks] int32 page ids, uploaded lazily on
-        version change (identity stripes → effectively uploaded once)."""
+        version change (identity stripes → effectively uploaded once for
+        cold traffic; attach/restore bump the version per changed row)."""
         if self._dev_table is None \
                 or self._table_uploaded != self._table_version:
             self._dev_table = jnp.asarray(self._host_table)
@@ -210,8 +399,30 @@ class SlotPagedKVPool:
     def used_blocks(self) -> int:
         return sum(len(b) for b in self.block_table.values())
 
+    def blocks_active(self) -> int:
+        """Own pages claimed by currently-active slots (shared attached
+        pages are accounted by their owner or the cache, never twice)."""
+        return sum(n for s, n in self._own_claimed.items()
+                   if self.active[s])
+
+    def blocks_cached(self) -> int:
+        """Pages whose owning slot freed while the cache held them: the
+        cache is now the owner of record."""
+        return len(self._cache_owned)
+
+    def cached_blocks(self) -> int:
+        """Every page currently pinned by the prefix cache (owner active
+        or not)."""
+        return len(self.cached)
+
     def dirty_blocks(self) -> int:
-        return int(self.dirty.sum()) * self.n_blocks
+        """Scrubable pages: pages of freed rows NOT pinned by the cache."""
+        total = 0
+        for r in np.flatnonzero(self.dirty):
+            base = int(r) * self.n_blocks
+            total += sum(1 for j in range(self.n_blocks)
+                         if (base + j) not in self.cached)
+        return total
 
     def lengths_array(self) -> jnp.ndarray:
         return jnp.asarray(self.lengths)
@@ -234,13 +445,19 @@ class SlotPagedKVPool:
             "used_blocks": self.used_blocks(),
             "dirty_blocks": self.dirty_blocks(),
             "total_blocks": self.num_slots * self.n_blocks,
+            "blocks_active": self.blocks_active(),
+            "blocks_cached": self.blocks_cached(),
+            "cached_pages": self.cached_blocks(),
         }
 
     def check_balance(self) -> bool:
-        """Slot-accounting invariant the fault matrix proves: every slot
-        ever allocated was either freed or is still active —
-        `allocs == frees + active_slots` — i.e. no failure path leaked a
-        slot. Raises AssertionError with the ledger on violation."""
+        """The two accounting invariants the fault matrix proves after
+        every scenario. Slots: every slot ever allocated was freed or is
+        still active (`allocs == frees + active_slots`). Blocks: every
+        page ever claimed is freed, active in a living slot, or owned by
+        the cache (`blocks_allocated == blocks_freed + blocks_active +
+        blocks_cached`) — i.e. no failure path leaked a slot OR a page.
+        Raises AssertionError with the offending ledger on violation."""
         allocs = self.stats["allocs"]
         frees = self.stats["frees"]
         active = self.active_slots()
@@ -249,25 +466,50 @@ class SlotPagedKVPool:
                 f"KV pool slot ledger out of balance: allocs={allocs} != "
                 f"frees={frees} + active={active} "
                 f"(leaked {allocs - frees - active})")
+        b_alloc = self.stats["blocks_allocated"]
+        b_freed = self.stats["blocks_freed"]
+        b_active = self.blocks_active()
+        b_cached = self.blocks_cached()
+        if b_alloc != b_freed + b_active + b_cached:
+            raise AssertionError(
+                f"KV pool block ledger out of balance: "
+                f"blocks_allocated={b_alloc} != blocks_freed={b_freed} + "
+                f"blocks_active={b_active} + blocks_cached={b_cached} "
+                f"(leaked {b_alloc - b_freed - b_active - b_cached})")
         return True
 
     # ---- hygiene ----
     def defrag(self) -> int:
-        """Scrub stale KV out of freed slots (one jitted masked multiply
-        over each slab) and return the number of blocks reclaimed. Purely
-        hygienic — correctness never depends on it because prefill
-        overwrites a slot's whole stripe on reuse — but it keeps dirty
-        blocks from aging in HBM snapshots/checkpoints and makes the
-        free-block gauge mean 'zeroed and ready'."""
-        reclaimed = int(self.dirty.sum()) * self.n_blocks
+        """Scrub stale KV out of freed rows (one jitted masked multiply
+        over each slab) and return the number of pages reclaimed.
+        Refcount-aware at PAGE granularity: a freed row whose pages the
+        prefix cache still pins keeps those pages' columns bit-intact —
+        shared blocks are never scrubbed — while the rest of the row is
+        zeroed. Purely hygienic — correctness never depends on it because
+        prefill overwrites the written range on reuse — but it keeps
+        dirty blocks from aging in HBM snapshots and makes the free-block
+        gauge mean 'zeroed and ready'."""
+        rows = np.flatnonzero(self.dirty)
+        if rows.size == 0:
+            return 0
+        keep = np.ones((self.num_slots, self.slab_len), np.float32)
+        reclaimed = 0
+        for r in rows:
+            keep[r, :] = 0.0
+            base = int(r) * self.n_blocks
+            for j in range(self.n_blocks):
+                if (base + j) in self.cached:
+                    keep[r, j * self.block_len:(j + 1) * self.block_len] = 1.0
+                else:
+                    reclaimed += 1
         if reclaimed == 0:
             return 0
         if self._scrub is None:
             self._scrub = jax.jit(
-                lambda slab, keep: slab * keep[:, None, None, None])
-        keep = jnp.asarray(~self.dirty)
-        self.slabs = [(self._scrub(k, keep.astype(k.dtype)),
-                       self._scrub(v, keep.astype(v.dtype)))
+                lambda slab, keep: slab * keep[:, None, :, None])
+        keep_j = jnp.asarray(keep)
+        self.slabs = [(self._scrub(k, keep_j.astype(k.dtype)),
+                       self._scrub(v, keep_j.astype(v.dtype)))
                       for k, v in self.slabs]
         self.dirty[:] = False
         self.stats["defrags"] += 1

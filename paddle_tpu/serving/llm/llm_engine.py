@@ -1,6 +1,17 @@
 """Continuous-batching LLM decode engine over the slot-paged KV pool
 (ISSUE 5 tentpole; ISSUE 6 supervision + overload control; ISSUE 7
-ragged paged attention + chunked prefill).
+ragged paged attention + chunked prefill; ISSUE 8 prefix-sharing radix
+KV cache + multi-tenant scheduling).
+
+Prefix sharing (ISSUE 8): admission consults a per-tenant radix
+`PrefixCache` — a prompt hitting a cached prefix attaches the donor's
+refcounted KV pages (partial blocks copy-on-write into the slot's own
+page) and chunk-prefills only the suffix, so N requests sharing a prefix
+pay ~one prefill total and a full hit's TTFT is one chunk-wide step.
+Chunk-invariance (PR 7) makes warm streams bit-identical to cold ones.
+Multi-tenancy: requests carry a tenant id; dequeue is tenant-fair within
+each SLO class, an optional per-tenant in-flight token quota rejects
+with reason "tenant_quota", and tenants never share cached KV.
 
 The batch-locked `models.generation.generate()` loop makes every sequence
 enter together, share one prompt length and pay the batch's full
@@ -82,6 +93,7 @@ from ..metrics import LLMMetrics, SLO_CLASSES
 from ..supervisor import (DispatchFailedError, DispatchHungError,  # noqa: F401
                           EngineSupervisor)
 from .kv_pool import SlotPagedKVPool, SlotsExhaustedError
+from .prefix_cache import PrefixCache
 
 _log = logging.getLogger("paddle_tpu.serving.llm")
 
@@ -111,6 +123,16 @@ class LLMEngineConfig:
     #                                  exits at half the threshold
     brownout_max_new_tokens: int = 8  # admission-time cap while browned out
     retry_after_s: float = 1.0     # backpressure hint on overload rejects
+    # ---- prefix cache + multi-tenancy (ISSUE 8) ----
+    enable_prefix_cache: bool = True   # radix KV prefix sharing on admission
+    default_tenant: str = "default"    # tenant when submit() names none
+    tenant_max_inflight_tokens: Optional[int] = None  # per-tenant quota:
+    #                                  sum of (prompt + max_new_tokens) over
+    #                                  one tenant's queued + active requests
+    #                                  (None: off); exceeding it is a typed
+    #                                  "tenant_quota" reject — shedding other
+    #                                  tenants can never help, so it is
+    #                                  checked before shed logic runs
     # ---- supervision (ISSUE 6) ----
     dispatch_timeout_s: Optional[float] = None  # hung-dispatch watchdog
     dispatch_retries: int = 2      # whole-step retries before blame/fail
@@ -139,6 +161,13 @@ class LLMEngineConfig:
                 f"{self.brownout_max_new_tokens}")
         if self.dispatch_retries < 0:
             raise ValueError("retry counts must be >= 0")
+        if not self.default_tenant:
+            raise ValueError("default_tenant must be a non-empty string")
+        if (self.tenant_max_inflight_tokens is not None
+                and self.tenant_max_inflight_tokens < 1):
+            raise ValueError(
+                f"tenant_max_inflight_tokens must be >= 1, got "
+                f"{self.tenant_max_inflight_tokens}")
         if self.breaker_threshold < 1:
             raise ValueError(
                 f"breaker_threshold must be >= 1, got "
@@ -178,10 +207,11 @@ class GenerationHandle:
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "eos_token_id", "arrival",
                  "deadline", "handle", "slot", "emitted", "last_tok",
-                 "slo", "submit_idx", "cost", "chunk_off")
+                 "slo", "submit_idx", "cost", "chunk_off", "tenant",
+                 "attached_pages")
 
     def __init__(self, prompt, max_new_tokens, eos_token_id, arrival,
-                 deadline, slo, submit_idx):
+                 deadline, slo, submit_idx, tenant="default"):
         self.prompt = prompt              # np.int32 [S]
         self.max_new_tokens = max_new_tokens
         self.eos_token_id = eos_token_id
@@ -197,7 +227,13 @@ class _GenRequest:
         self.last_tok: int = 0
         self.chunk_off: int = 0           # prompt tokens already prefilled;
         #                                   < len(prompt) means the request
-        #                                   is still in chunked prefill
+        #                                   is still in chunked prefill —
+        #                                   starts at attach_len on a prefix
+        #                                   cache hit (those tokens' KV is
+        #                                   attached/COW'd, never recomputed)
+        self.tenant = tenant
+        self.attached_pages: List[int] = []   # shared pages this request
+        #                                       reads (refcounted in pool)
 
 
 class LLMEngine:
@@ -235,6 +271,11 @@ class LLMEngine:
             model.init_cache, self.config.num_slots, self.config.block_len,
             self.config.n_blocks, dtype=self.config.cache_dtype,
             pad_tokens=self.config.prefill_chunk)
+        # radix prefix cache (ISSUE 8): wires itself as the pool's
+        # on_pressure hook so pinned rows free up under allocation pressure
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.pool) if self.config.enable_prefix_cache
+            else None)
         self.metrics.set_slots(0, self.pool.num_slots)
         self._queues: Dict[str, deque] = {c: deque() for c in SLO_CLASSES}
         self._active: Dict[int, _GenRequest] = {}   # slot -> request
@@ -249,6 +290,11 @@ class LLMEngine:
         #                              rows — near-zero under mixed load,
         #                              which is what proves the per-bucket
         #                              prefill executable zoo is gone
+        self.prefill_tokens = 0      # lifetime prompt tokens actually
+        #                              prefilled (sum of committed chunk
+        #                              widths) — the prefix-cache acceptance
+        #                              observable: N shared-prefix requests
+        #                              should pay ~1 prompt's worth
         self._submit_idx = 0         # lifetime admissions (poison keying)
         self._dispatch_idx = 0       # lifetime dispatch attempts (fault
         #                              clauses key on this index)
@@ -458,10 +504,37 @@ class LLMEngine:
         return sum(len(q) for q in self._queues.values())
 
     def _pop_next_locked(self) -> Optional[_GenRequest]:
+        """Strict SLO-class priority, tenant-fair WITHIN a class: among
+        the highest non-empty class's queue, dequeue the oldest request
+        of the tenant with the least active token usage (sum of cost over
+        its slot-holding requests), so one tenant's burst cannot starve
+        another at equal priority. With a single tenant queued this
+        degenerates to exact FIFO."""
         for cls in SLO_CLASSES:     # strict priority order
-            if self._queues[cls]:
-                return self._queues[cls].popleft()
+            q = self._queues[cls]
+            if not q:
+                continue
+            if len({r.tenant for r in q}) <= 1:
+                return q.popleft()
+            usage: Dict[str, int] = {}
+            for r in self._active.values():
+                usage[r.tenant] = usage.get(r.tenant, 0) + r.cost
+            best_i = 0
+            best_u = None
+            for i, r in enumerate(q):           # FIFO tie-break
+                u = usage.get(r.tenant, 0)
+                if best_u is None or u < best_u:
+                    best_i, best_u = i, u
+            req = q[best_i]
+            del q[best_i]
+            return req
         return None
+
+    def _tenant_inflight_locked(self, tenant: str) -> int:
+        return (sum(r.cost for q in self._queues.values()
+                    for r in q if r.tenant == tenant)
+                + sum(r.cost for r in self._active.values()
+                      if r.tenant == tenant))
 
     def _inflight_tokens_locked(self) -> int:
         """Estimated token cost of everything admitted: queued + active.
@@ -513,18 +586,22 @@ class LLMEngine:
                 f"shed ({victim.slo}) to admit {slo} traffic under "
                 "overload", reason="shed",
                 retry_after_s=self.config.retry_after_s))
-            self.metrics.on_reject("shed")
+            self.metrics.on_reject("shed", tenant=victim.tenant)
             self.metrics.on_shed(victim.slo)
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
                deadline_ms: Optional[float] = None,
-               slo: Optional[str] = None) -> GenerationHandle:
+               slo: Optional[str] = None,
+               tenant: Optional[str] = None) -> GenerationHandle:
         """Admit one prompt (1-D int token ids). `slo` names the request's
-        SLO class (config.default_slo when None). Raises RejectedError
-        when the sequence can never fit a slot, the queue/token budget is
-        exhausted and nothing lower-priority can be shed, the engine is
-        draining, or the circuit breaker is open."""
+        SLO class (config.default_slo when None); `tenant` its isolation
+        domain (config.default_tenant when None) — tenants get fair
+        dequeue within a class, an optional in-flight token quota, and a
+        private prefix-cache namespace. Raises RejectedError when the
+        sequence can never fit a slot, the queue/token budget/tenant
+        quota is exhausted and nothing lower-priority can be shed, the
+        engine is draining, or the circuit breaker is open."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must contain at least one token")
@@ -536,6 +613,9 @@ class LLMEngine:
         if slo not in SLO_CLASSES:
             raise ValueError(
                 f"slo must be one of {SLO_CLASSES}, got {slo!r}")
+        tenant = self.config.default_tenant if tenant is None else tenant
+        if not isinstance(tenant, str) or not tenant:
+            raise ValueError("tenant must be a non-empty string")
         eos = (self.config.eos_token_id if eos_token_id is None
                else eos_token_id)
         if prompt.size + mnt > self.pool.capacity:
@@ -561,6 +641,17 @@ class LLMEngine:
             self._update_brownout_locked()
             if self._brownout and mnt > self.config.brownout_max_new_tokens:
                 mnt = self.config.brownout_max_new_tokens
+            quota = self.config.tenant_max_inflight_tokens
+            if quota is not None and (
+                    self._tenant_inflight_locked(tenant)
+                    + prompt.size + mnt > quota):
+                # checked BEFORE shed logic: shedding OTHER tenants'
+                # requests cannot relieve this tenant's own quota
+                self.metrics.on_reject("tenant_quota", tenant=tenant)
+                raise RejectedError(
+                    f"tenant {tenant!r} in-flight token quota exhausted "
+                    f"({quota} tokens)", reason="tenant_quota",
+                    retry_after_s=self.config.retry_after_s)
             reason = self._make_room_locked(slo, prompt.size + mnt)
             if reason is not None:
                 self.metrics.on_reject(reason)
@@ -574,10 +665,11 @@ class LLMEngine:
                     reason=reason,
                     retry_after_s=self.config.retry_after_s)
             req = _GenRequest(prompt, mnt, eos, now, deadline, slo,
-                              self._submit_idx)
+                              self._submit_idx, tenant=tenant)
             self._submit_idx += 1
             self._queues[slo].append(req)
-            self.metrics.on_submit(self._queue_len_locked(), slo=slo)
+            self.metrics.on_submit(self._queue_len_locked(), slo=slo,
+                                   tenant=tenant)
             self.metrics.set_inflight_tokens(self._inflight_tokens_locked())
             self._cond.notify_all()
         return req.handle
@@ -586,11 +678,13 @@ class LLMEngine:
                  eos_token_id: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
                  timeout: Optional[float] = None,
-                 slo: Optional[str] = None) -> np.ndarray:
+                 slo: Optional[str] = None,
+                 tenant: Optional[str] = None) -> np.ndarray:
         """Synchronous convenience: submit + wait for the full sequence."""
         return self.submit(prompt, max_new_tokens=max_new_tokens,
                            eos_token_id=eos_token_id,
-                           deadline_ms=deadline_ms, slo=slo).result(timeout)
+                           deadline_ms=deadline_ms, slo=slo,
+                           tenant=tenant).result(timeout)
 
     # ---- scheduling ----
     def has_work(self) -> bool:
@@ -622,6 +716,20 @@ class LLMEngine:
         n = self._step_once()
         with self._cond:
             self.metrics.set_inflight_tokens(self._inflight_tokens_locked())
+            per_tenant: Dict[str, int] = {}
+            for q in self._queues.values():
+                for r in q:
+                    per_tenant[r.tenant] = \
+                        per_tenant.get(r.tenant, 0) + r.cost
+            for r in self._active.values():
+                per_tenant[r.tenant] = per_tenant.get(r.tenant, 0) + r.cost
+            self.metrics.set_tenant_inflight(per_tenant)
+        if self.prefix_cache is not None:
+            self.metrics.set_prefix_cache(
+                self.prefix_cache.stats["cached_blocks"],
+                self.prefix_cache.stats["evictions"],
+                {t: s["cached_blocks"]
+                 for t, s in self.prefix_cache.tenant_stats.items()})
         self.metrics.set_fragmentation(self.pool.fragmentation_ratio())
         return n
 
@@ -661,9 +769,39 @@ class LLMEngine:
                 if req is None:
                     return
                 self.metrics.set_queue_depth(self._queue_len_locked())
-                slot = self.pool.allocate(req.cost)
+                try:
+                    slot = self.pool.allocate(req.cost)
+                except SlotsExhaustedError:
+                    # every free row is pinned by cached blocks with live
+                    # readers (pressure eviction couldn't help); requeue
+                    # at the front and retry once readers drain
+                    self._queues[req.slo].appendleft(req)
+                    self.metrics.set_queue_depth(self._queue_len_locked())
+                    return
                 req.slot = slot
                 req.chunk_off = 0
+                req.attached_pages = []
+                if self.prefix_cache is not None:
+                    # cap at plen-1 so at least one prompt token always
+                    # prefills (that step produces the first output
+                    # token's logits); an over-cap full block degrades to
+                    # a COW tail, so an exact-duplicate prompt still
+                    # costs only a one-token prefill
+                    plan = self.prefix_cache.acquire(
+                        req.tenant, req.prompt,
+                        max_tokens=len(req.prompt) - 1)
+                    if plan.pages:
+                        self.pool.attach_blocks(slot, plan.pages)
+                        req.attached_pages = list(plan.pages)
+                    if plan.tail_page is not None:
+                        self.pool.cow_copy(plan.tail_page, slot)
+                    req.chunk_off = plan.attach_len
+                    # the slot now holds its own refs (attach_blocks) and
+                    # its own copy of the tail — drop acquire's transient
+                    # refcounts so eviction sees the true reader count
+                    self.prefix_cache.release(plan)
+                    self.metrics.on_prefix_lookup(
+                        req.tenant, plan.attach_len, len(req.prompt))
                 self._active[slot] = req
                 self.metrics.set_slots(self.pool.active_slots(),
                                        self.pool.num_slots)
@@ -675,7 +813,12 @@ class LLMEngine:
         N = self.pool.num_slots
         C = self.config.prefill_chunk
         toks = np.zeros((N, C), np.int32)
-        pos = np.zeros((N,), np.int32)
+        # free rows still get a (discarded) C-wide KV stripe written at
+        # their pos by the unified step; park it in the slab's pad region
+        # (block tables never address cols >= n_blocks*block_len) so it
+        # cannot clobber cached prefix pages living in freed rows
+        pos = np.full((N,), self.pool.n_blocks * self.pool.block_len,
+                      np.int32)
         adv = np.zeros((N,), np.int32)
         prefill_slots: List[int] = []
         decode_slots: List[int] = []
@@ -765,12 +908,20 @@ class LLMEngine:
                     n = int(adv[slot])
                     self.pool.set_length(slot, req.chunk_off + n)
                     req.chunk_off += n
+                    self.prefill_tokens += n
                     if req.chunk_off >= len(req.prompt):
                         # final chunk landed: first token emitted, TTFT
                         # ends here
                         req.handle.ttft_ms = (now - req.arrival) * 1e3
                         self.metrics.on_prefill(req.handle.ttft_ms,
                                                 slo=req.slo)
+                        if self.prefix_cache is not None:
+                            # index the completed prefill while the slot
+                            # is still active: siblings queued behind it
+                            # attach without waiting for it to finish
+                            self.prefix_cache.insert(
+                                req.tenant, req.prompt, slot,
+                                req.attached_pages)
                         self._emit(req, int(nxt[slot]))
                         if self._finish_if_done(req, now):
                             del self._active[slot]
@@ -899,7 +1050,8 @@ class LLMEngine:
         if not done:
             return False
         req.handle.future.set_result(np.asarray(req.emitted, np.int32))
-        self.metrics.on_complete((now - req.arrival) * 1e3, slo=req.slo)
+        self.metrics.on_complete((now - req.arrival) * 1e3, slo=req.slo,
+                                 tenant=req.tenant)
         if req.slot is not None and self.pool.active[req.slot]:
             self.pool.free(req.slot)
         return True

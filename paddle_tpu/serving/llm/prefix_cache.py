@@ -1,0 +1,328 @@
+"""Radix prefix cache over shared KV blocks (ISSUE 8 tentpole).
+
+A per-tenant radix/trie index over token prefixes, one level per full
+`block_len`-token chunk, each node naming the global KV page that holds
+that chunk's keys/values. On admission the engine looks the prompt up:
+every matched full block is ATTACHED (the new slot's block table points
+at the donor's physical pages, refcounted for the reader's lifetime) and
+a matched *partial* block — a trie tail, or a full block truncated by
+the always-prefill-one-token cap — is COPY-ON-WRITten into the slot's
+own page so the divergent suffix can append in place. The engine then
+chunk-prefills only the uncovered suffix: at a full hit TTFT collapses
+to one chunk-wide step, and N requests sharing a prefix cost ~1
+prefill's worth of prefill work in total.
+
+Correctness lever: chunked prefill is bit-invariant to chunking (PR 7),
+and a row's KV depends only on that row's own tokens/positions, so KV
+attached from a donor row — or COW-copied out of one — is bitwise the KV
+the request would have computed itself. Warm streams are therefore
+bit-identical to cold-path greedy `generate()`.
+
+Lifecycle and safety:
+
+- Pages enter the cache only when their prefill COMPLETED (the blocks
+  provably hold the full chunk's KV); insertion registers them with the
+  pool (`register_cached`), pinning the owning row against reallocation.
+- Readers take a refcount per attached page (`SlotPagedKVPool.refcount`)
+  held until the reader's slot frees. Eviction refuses refcount>0 pages
+  structurally — `release_cached` raises — so cache pressure can never
+  reclaim a block out from under a live stream.
+- Eviction is LRU over refcount-0 leaves and tails (a deterministic
+  monotonic tick, no wall clock), driven by the pool's `on_pressure`
+  hook from inside `allocate()`: evict just enough to unpin one row.
+- Tenant namespacing is structural: each tenant gets its own root, so
+  one tenant's prompts can never attach another tenant's KV.
+
+The index is host-side pure-python bookkeeping — dict hops per block, no
+device work — sized by cached blocks, not tokens.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .kv_pool import SlotPagedKVPool
+
+
+class _Node:
+    """One radix node = one full cached block. `children` is keyed by the
+    next block's token tuple; `page` is the global KV page holding THIS
+    node's block (None only at roots). A node may also carry one cached
+    partial-block `tail` — the sub-block remainder of some inserted
+    prompt — usable by COW up to its longest common prefix with a new
+    prompt's remainder."""
+
+    __slots__ = ("children", "page", "tick",
+                 "tail_tokens", "tail_page", "tail_tick")
+
+    def __init__(self, page: Optional[int] = None):
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.page = page
+        self.tick = 0
+        self.tail_tokens: Optional[Tuple[int, ...]] = None
+        self.tail_page: Optional[int] = None
+        self.tail_tick = 0
+
+
+class AttachPlan:
+    """Result of a cache lookup, increfs already taken.
+
+    `pages` back the prompt's leading full blocks (held until the
+    reader's slot frees — `SlotPagedKVPool.free` drops them). `tail_page`
+    holds `tail_len` further tokens to COW into the slot's own page; its
+    refcount is transient — release via `PrefixCache.release_tail` right
+    after the copy. `attach_len = len(pages) * block_len + tail_len` is
+    the number of prompt tokens the engine may skip prefilling."""
+
+    __slots__ = ("pages", "attach_len", "tail_page", "tail_len")
+
+    def __init__(self, pages: List[int], attach_len: int,
+                 tail_page: Optional[int], tail_len: int):
+        self.pages = pages
+        self.attach_len = attach_len
+        self.tail_page = tail_page
+        self.tail_len = tail_len
+
+
+def _tenant_stats() -> dict:
+    return {"hits": 0, "misses": 0, "hit_tokens": 0, "lookup_tokens": 0,
+            "insertions": 0, "evictions": 0, "cached_blocks": 0}
+
+
+class PrefixCache:
+    """Per-tenant radix index over cached KV pages in a SlotPagedKVPool.
+
+    Constructing the cache wires itself as the pool's `on_pressure` hook
+    so allocation pressure transparently evicts cold entries."""
+
+    def __init__(self, pool: SlotPagedKVPool):
+        self.pool = pool
+        self.block_len = pool.block_len
+        self._roots: Dict[str, _Node] = {}
+        self._tick = 0
+        self.stats = _tenant_stats()
+        self.tenant_stats: Dict[str, dict] = {}
+        pool.on_pressure = self.evict_for_pressure
+
+    def _ts(self, tenant: str) -> dict:
+        return self.tenant_stats.setdefault(tenant, _tenant_stats())
+
+    # ---- lookup ----
+    def acquire(self, tenant: str, tokens, max_tokens: int) -> AttachPlan:
+        """Match `tokens` against the tenant's trie and take refcounts on
+        every matched page. `max_tokens` caps the covered length — the
+        engine passes len(prompt)-1 so at least one prompt token is
+        always prefilled (the step that produces the first output
+        token's logits). A full matched block pushed over the cap
+        becomes a partially-used COW tail, which is what makes an
+        exact-duplicate prompt still cost only a one-token prefill."""
+        self._tick += 1
+        ts = self._ts(tenant)
+        n = len(tokens)
+        ts["lookup_tokens"] += n
+        self.stats["lookup_tokens"] += n
+        bl = self.block_len
+        node = self._roots.get(tenant)
+        chain: List[int] = []
+        i = 0
+        if node is not None:
+            while i + bl <= n:
+                child = node.children.get(
+                    tuple(int(t) for t in tokens[i:i + bl]))
+                if child is None:
+                    break
+                child.tick = self._tick
+                chain.append(child.page)
+                node = child
+                i += bl
+        n_full = min(len(chain), max(0, int(max_tokens)) // bl)
+        pages = chain[:n_full]
+        attach_len = n_full * bl
+        tail_page: Optional[int] = None
+        tail_len = 0
+        if n_full < len(chain):
+            # next matched block exists but the cap truncates it
+            u = int(max_tokens) - attach_len
+            if u > 0:
+                tail_page = chain[n_full]
+                tail_len = u
+        elif node is not None and node.tail_tokens is not None:
+            rem = [int(t) for t in tokens[attach_len:]]
+            m = 0
+            for a, b in zip(node.tail_tokens, rem):
+                if a != b:
+                    break
+                m += 1
+            u = min(m, int(max_tokens) - attach_len)
+            if u > 0:
+                tail_page = node.tail_page
+                tail_len = u
+                node.tail_tick = self._tick
+        hit_tokens = attach_len + tail_len
+        if hit_tokens > 0:
+            ts["hits"] += 1
+            self.stats["hits"] += 1
+            ts["hit_tokens"] += hit_tokens
+            self.stats["hit_tokens"] += hit_tokens
+        else:
+            ts["misses"] += 1
+            self.stats["misses"] += 1
+        for p in pages:
+            self.pool.refcount[p] = self.pool.refcount.get(p, 0) + 1
+        if tail_page is not None:
+            self.pool.refcount[tail_page] = \
+                self.pool.refcount.get(tail_page, 0) + 1
+        return AttachPlan(pages, attach_len + tail_len, tail_page, tail_len)
+
+    def release_tail(self, plan: AttachPlan):
+        """Drop the transient tail refcount once its KV has been COW'd
+        into the reader's own page."""
+        if plan.tail_page is not None:
+            self.pool.release_block(plan.tail_page)
+            plan.tail_page = None
+
+    def release(self, plan: AttachPlan):
+        """Drop ALL of acquire()'s transient refcounts: call after the
+        reader holds its own protection — attach_blocks() took per-slot
+        refs on the full pages and the tail was COW'd into the slot's
+        own page. Idempotent (the plan is cleared as it is released)."""
+        for p in plan.pages:
+            self.pool.release_block(p)
+        plan.pages = []
+        self.release_tail(plan)
+
+    # ---- insertion ----
+    def insert(self, tenant: str, tokens, slot: int,
+               attached_pages: List[int]):
+        """Index a completed prefill. Called by the engine the moment the
+        final prefill chunk commits (slot still active, full prompt KV
+        provably in place). Path nodes the prompt attached from already
+        exist (their refcounts kept them alive); every NEW node claims
+        the slot's own page for that block index and pins it via
+        `register_cached`. The sub-block remainder becomes the terminal
+        node's tail, replacing a shorter refcount-0 tail only."""
+        self._tick += 1
+        ts = self._ts(tenant)
+        bl = self.block_len
+        nb_pool = self.pool.n_blocks
+        node = self._roots.setdefault(tenant, _Node())
+        n_full = len(tokens) // bl
+        for j in range(n_full):
+            key = tuple(int(t) for t in tokens[j * bl:(j + 1) * bl])
+            child = node.children.get(key)
+            if child is None:
+                page = (attached_pages[j] if j < len(attached_pages)
+                        else slot * nb_pool + j)
+                if page in self.pool.cached:
+                    # defensive: never double-register (an attached page
+                    # is only reachable through an existing node)
+                    node = node.children.setdefault(key, _Node(page))
+                    continue
+                self.pool.register_cached(page)
+                child = _Node(page)
+                node.children[key] = child
+                ts["insertions"] += 1
+                self.stats["insertions"] += 1
+                ts["cached_blocks"] += 1
+                self.stats["cached_blocks"] += 1
+            child.tick = self._tick
+            node = child
+        rem = tuple(int(t) for t in tokens[n_full * bl:])
+        if rem:
+            if node.tail_tokens is None or (
+                    len(rem) > len(node.tail_tokens)
+                    and self.pool.refcount.get(node.tail_page, 0) == 0):
+                page = slot * nb_pool + n_full
+                if page in self.pool.cached or page == node.tail_page:
+                    return
+                if node.tail_page is not None:
+                    self.pool.release_cached(node.tail_page)
+                    ts["cached_blocks"] -= 1
+                    self.stats["cached_blocks"] -= 1
+                self.pool.register_cached(page)
+                node.tail_tokens = rem
+                node.tail_page = page
+                node.tail_tick = self._tick
+                ts["insertions"] += 1
+                self.stats["insertions"] += 1
+                ts["cached_blocks"] += 1
+                self.stats["cached_blocks"] += 1
+
+    # ---- eviction ----
+    def _lru_victim(self):
+        """Least-recently-touched evictable entry across all tenants:
+        refcount-0 tails, and refcount-0 leaf nodes (no children AND no
+        tail — interior nodes and tailed nodes are structurally pinned
+        until their descendants go first)."""
+        best = None   # (tick, kind, tenant, node_or_parent, key)
+        for tenant, root in self._roots.items():
+            stack: List[Tuple[_Node, Optional[_Node],
+                              Optional[Tuple[int, ...]]]] = \
+                [(root, None, None)]
+            while stack:
+                node, parent, key = stack.pop()
+                if (node.tail_page is not None
+                        and self.pool.refcount.get(node.tail_page, 0) == 0):
+                    cand = (node.tail_tick, "tail", tenant, node, None)
+                    if best is None or cand[0] < best[0]:
+                        best = cand
+                if (parent is not None and not node.children
+                        and node.tail_page is None
+                        and self.pool.refcount.get(node.page, 0) == 0):
+                    cand = (node.tick, "node", tenant, parent, key)
+                    if best is None or cand[0] < best[0]:
+                        best = cand
+                for k, c in node.children.items():
+                    stack.append((c, node, k))
+        return best
+
+    def evict_for_pressure(self) -> int:
+        """Pool pressure hook: evict LRU refcount-0 entries until the
+        pool has an allocatable row (or nothing evictable remains).
+        Returns pages released. Pages with live readers never qualify,
+        so eviction under slot pressure cannot reclaim a block a stream
+        is still reading — the fault matrix proves this."""
+        released = 0
+        while not self.pool.has_allocatable_row():
+            victim = self._lru_victim()
+            if victim is None:
+                break
+            _, kind, tenant, holder, key = victim
+            ts = self._ts(tenant)
+            if kind == "tail":
+                self.pool.release_cached(holder.tail_page)
+                holder.tail_tokens = None
+                holder.tail_page = None
+                holder.tail_tick = 0
+            else:
+                child = holder.children.pop(key)
+                self.pool.release_cached(child.page)
+            ts["evictions"] += 1
+            self.stats["evictions"] += 1
+            ts["cached_blocks"] -= 1
+            self.stats["cached_blocks"] -= 1
+            released += 1
+        return released
+
+    # ---- views ----
+    def cached_blocks(self, tenant: Optional[str] = None) -> int:
+        if tenant is None:
+            return self.stats["cached_blocks"]
+        return self._ts(tenant)["cached_blocks"]
+
+    def hit_rate(self, tenant: Optional[str] = None) -> float:
+        s = self.stats if tenant is None else self._ts(tenant)
+        if s["lookup_tokens"] == 0:
+            return 0.0
+        return s["hit_tokens"] / s["lookup_tokens"]
+
+    def snapshot(self) -> dict:
+        return {
+            **self.stats,
+            "hit_rate": self.hit_rate(),
+            "tenants": {t: {**s, "hit_rate":
+                            (s["hit_tokens"] / s["lookup_tokens"]
+                             if s["lookup_tokens"] else 0.0)}
+                        for t, s in self.tenant_stats.items()},
+        }

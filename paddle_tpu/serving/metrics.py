@@ -206,7 +206,10 @@ class LLMMetrics(ServingMetrics):
         self._decode_window: deque = deque(maxlen=self.window)
         self.counters.update({"prefills": 0, "decode_steps": 0,
                               "tokens_out": 0, "shed": 0, "quarantined": 0,
-                              "brownout_entries": 0})
+                              "brownout_entries": 0,
+                              "prefix_hits": 0, "prefix_misses": 0,
+                              "prefix_hit_tokens": 0,
+                              "prefix_lookup_tokens": 0})
         self.slots_active = 0
         self.slots_total = 0
         # per-SLO-class accounting (ISSUE 6 overload control): aggregate
@@ -224,24 +227,93 @@ class LLMMetrics(ServingMetrics):
         # block tokens not holding valid KV, from
         # SlotPagedKVPool.fragmentation_ratio()
         self.fragmentation = 0.0
+        # prefix cache + multi-tenancy (ISSUE 8): aggregate cache gauges
+        # plus a per-tenant breakdown (lazily created per tenant id) —
+        # aggregate counters above stay authoritative for the drain
+        # reconciliation invariant
+        self.cached_blocks = 0
+        self.cache_evictions = 0
+        self.tenants: Dict[str, Dict[str, int]] = {}
 
     def _class(self, slo) -> Optional[Dict[str, int]]:
         return self.class_counters.get(slo) if slo else None
 
+    def _tenant(self, tenant) -> Optional[Dict[str, int]]:
+        if not tenant:
+            return None
+        return self.tenants.setdefault(tenant, {
+            "submitted": 0, "completed": 0, "rejected": 0,
+            "prefix_hits": 0, "prefix_misses": 0, "prefix_hit_tokens": 0,
+            "prefix_lookup_tokens": 0, "inflight_tokens": 0,
+            "cached_blocks": 0})
+
     # ---- engine callbacks ----
-    def on_submit(self, queue_depth: int, slo: Optional[str] = None):
+    def on_submit(self, queue_depth: int, slo: Optional[str] = None,
+                  tenant: Optional[str] = None):
         super().on_submit(queue_depth)
         with self._lock:
             c = self._class(slo)
             if c is not None:
                 c["submitted"] += 1
+            t = self._tenant(tenant)
+            if t is not None:
+                t["submitted"] += 1
 
-    def on_complete(self, latency_ms: float, slo: Optional[str] = None):
+    def on_complete(self, latency_ms: float, slo: Optional[str] = None,
+                    tenant: Optional[str] = None):
         super().on_complete(latency_ms)
         with self._lock:
             c = self._class(slo)
             if c is not None:
                 c["completed"] += 1
+            t = self._tenant(tenant)
+            if t is not None:
+                t["completed"] += 1
+
+    def on_reject(self, reason: str, tenant: Optional[str] = None):
+        super().on_reject(reason)
+        with self._lock:
+            t = self._tenant(tenant)
+            if t is not None:
+                t["rejected"] += 1
+
+    def on_prefix_lookup(self, tenant: Optional[str], hit_tokens: int,
+                         prompt_tokens: int):
+        """One admission-time prefix-cache lookup: `hit_tokens` prompt
+        tokens were served from cached KV (attach + COW) out of
+        `prompt_tokens` looked up. The token-weighted ratio of these two
+        counters is the cache hit rate the bench gates pin."""
+        with self._lock:
+            hit = hit_tokens > 0
+            self.counters["prefix_hits" if hit else "prefix_misses"] += 1
+            self.counters["prefix_hit_tokens"] += int(hit_tokens)
+            self.counters["prefix_lookup_tokens"] += int(prompt_tokens)
+            t = self._tenant(tenant)
+            if t is not None:
+                t["prefix_hits" if hit else "prefix_misses"] += 1
+                t["prefix_hit_tokens"] += int(hit_tokens)
+                t["prefix_lookup_tokens"] += int(prompt_tokens)
+
+    def set_tenant_inflight(self, per_tenant: Dict[str, int]):
+        """Refresh per-tenant in-flight token gauges; tenants absent from
+        the map (fully drained) read 0."""
+        with self._lock:
+            for t in self.tenants.values():
+                t["inflight_tokens"] = 0
+            for tenant, tokens in per_tenant.items():
+                t = self._tenant(tenant)
+                if t is not None:
+                    t["inflight_tokens"] = int(tokens)
+
+    def set_prefix_cache(self, cached_blocks: int, evictions: int,
+                         per_tenant_cached: Optional[Dict[str, int]] = None):
+        with self._lock:
+            self.cached_blocks = int(cached_blocks)
+            self.cache_evictions = int(evictions)
+            for tenant, n in (per_tenant_cached or {}).items():
+                t = self._tenant(tenant)
+                if t is not None:
+                    t["cached_blocks"] = int(n)
 
     def on_shed(self, slo: Optional[str] = None):
         """A queued request was load-shed to make room for higher-priority
@@ -333,6 +405,16 @@ class LLMMetrics(ServingMetrics):
             s["brownout"] = self.brownout
             s["inflight_tokens"] = self.inflight_tokens
             s["kv_fragmentation"] = self.fragmentation
+            s["cached_blocks"] = self.cached_blocks
+            s["cache_evictions"] = self.cache_evictions
+            s["tenants"] = {t: dict(v) for t, v in self.tenants.items()}
+        for t in s["tenants"].values():
+            t["cache_hit_rate"] = (
+                t["prefix_hit_tokens"] / t["prefix_lookup_tokens"]
+                if t["prefix_lookup_tokens"] else 0.0)
+        s["prefix_hit_rate"] = (
+            s["prefix_hit_tokens"] / s["prefix_lookup_tokens"]
+            if s["prefix_lookup_tokens"] else 0.0)
         s["slot_occupancy"] = (self.slots_active / self.slots_total
                                if self.slots_total else 0.0)
         s["tokens_per_s"] = self.tokens_per_s()
@@ -398,6 +480,40 @@ class LLMMetrics(ServingMetrics):
             f"# TYPE {px}_kv_fragmentation gauge",
             f"{px}_kv_fragmentation {round(s['kv_fragmentation'], 4)}",
         ]
+        # ---- prefix cache + multi-tenancy families (ISSUE 8) ----
+        lines += [
+            f"# TYPE {px}_prefix_hits_total counter",
+            f"{px}_prefix_hits_total {s['prefix_hits']}",
+            f"# TYPE {px}_prefix_misses_total counter",
+            f"{px}_prefix_misses_total {s['prefix_misses']}",
+            f"# TYPE {px}_prefix_hit_tokens_total counter",
+            f"{px}_prefix_hit_tokens_total {s['prefix_hit_tokens']}",
+            f"# TYPE {px}_prefix_hit_rate gauge",
+            f"{px}_prefix_hit_rate {round(s['prefix_hit_rate'], 4)}",
+            f"# TYPE {px}_cached_blocks gauge",
+            f"{px}_cached_blocks {s['cached_blocks']}",
+            f"# TYPE {px}_cache_evictions_total counter",
+            f"{px}_cache_evictions_total {s['cache_evictions']}",
+        ]
+        if s["tenants"]:
+            lines.append(f"# TYPE {px}_tenant_requests_total counter")
+            for tenant in sorted(s["tenants"]):
+                tv = s["tenants"][tenant]
+                for outcome in ("submitted", "completed", "rejected"):
+                    lines.append(
+                        f'{px}_tenant_requests_total{{tenant="{tenant}",'
+                        f'outcome="{outcome}"}} {tv[outcome]}')
+            for fam, key, typ, rnd in (
+                    ("tenant_cache_hit_rate", "cache_hit_rate", "gauge", 4),
+                    ("tenant_cached_blocks", "cached_blocks", "gauge", None),
+                    ("tenant_inflight_tokens", "inflight_tokens", "gauge",
+                     None)):
+                lines.append(f"# TYPE {px}_{fam} {typ}")
+                for tenant in sorted(s["tenants"]):
+                    v = s["tenants"][tenant][key]
+                    lines.append(
+                        f'{px}_{fam}{{tenant="{tenant}"}} '
+                        f"{round(v, rnd) if rnd else v}")
         return "\n".join(lines) + "\n"
 
 

@@ -12,7 +12,12 @@ ThreadingHTTPServer + BaseHTTPRequestHandler, whose hardened
                     "slo": "interactive"|"batch"|"best_effort"}
                     -> 200 {"tokens": [...], "ttft_ms": ...} from the
                     continuous-batching LLMEngine (serving/llm/); same
-                    503/504 admission-control mapping
+                    503/504 admission-control mapping. An optional
+                    X-Tenant-Id header (1-64 chars [A-Za-z0-9._-],
+                    malformed -> 400) selects the tenant: per-tenant
+                    fair scheduling, quota (429 + Retry-After on
+                    "tenant_quota"), metrics labels, and a private
+                    prefix-cache namespace (ISSUE 8)
     GET  /healthz   -> 200 {"status": "ok"|"draining"};
                        503 {"status": "broken"} once an engine's circuit
                        breaker opens (ISSUE 6)
@@ -42,6 +47,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import signal
 import sys
 import threading
@@ -58,7 +64,13 @@ from .metrics import SLO_CLASSES
 
 # RejectedError reasons that mean "try again later" (HTTP 429 +
 # Retry-After) rather than "this process is going away" (503)
-_RETRYABLE_REJECTS = frozenset({"queue_full", "token_budget", "shed"})
+_RETRYABLE_REJECTS = frozenset({"queue_full", "token_budget", "shed",
+                                "tenant_quota"})
+
+# X-Tenant-Id values the LLM routes accept (ISSUE 8): tenant ids become
+# metric labels and prefix-cache namespace keys, so they are restricted
+# to a safe charset and bounded length; anything else is a 400
+_TENANT_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 
 
 def _decode_inputs(payload: dict):
@@ -151,6 +163,17 @@ class ServingServer:
                         health["llm_queue_depth"] = m.queue_depth
                         health["llm_slots_active"] = m.slots_active
                         health["llm_slots_total"] = m.slots_total
+                        snap = m.snapshot()
+                        health["llm_prefix_hit_rate"] = round(
+                            snap.get("prefix_hit_rate", 0.0), 4)
+                        health["llm_cached_blocks"] = \
+                            snap.get("cached_blocks", 0)
+                        health["llm_tenants"] = {
+                            t: {"cache_hit_rate":
+                                round(v["cache_hit_rate"], 4),
+                                "cached_blocks": v["cached_blocks"],
+                                "inflight_tokens": v["inflight_tokens"]}
+                            for t, v in snap.get("tenants", {}).items()}
                     self._reply_json(503 if broken else 200, health)
                 elif self.path == "/metrics":
                     # both engines scrape from one endpoint; the llm family
@@ -193,6 +216,13 @@ class ServingServer:
                         raise ValueError(
                             f"slo must be one of {list(SLO_CLASSES)}, "
                             f"got {slo!r}")
+                    tenant = self.headers.get("X-Tenant-Id")
+                    if tenant is not None \
+                            and not _TENANT_ID_RE.match(tenant):
+                        raise ValueError(
+                            "malformed X-Tenant-Id (want 1-64 chars of "
+                            "[A-Za-z0-9._-], starting alphanumeric), got "
+                            f"{tenant!r}")
                 except (ValueError, KeyError, TypeError) as e:
                     self._reply_json(400, {"error": f"bad request: {e}"})
                     return
@@ -202,7 +232,7 @@ class ServingServer:
                         max_new_tokens=payload.get("max_new_tokens"),
                         eos_token_id=payload.get("eos_token_id"),
                         deadline_ms=payload.get("deadline_ms"),
-                        slo=slo)
+                        slo=slo, tenant=tenant)
                     toks = handle.result(timeout=outer.request_timeout_s)
                 except RejectedError as e:
                     self._reply_rejected(e)

@@ -84,6 +84,10 @@ def pytest_configure(config):
         "markers", "paged: ragged paged attention + chunked prefill tests "
         "(ops/paged_attention.py parity suite, device block tables, "
         "chunk-granular scheduling); select with -m paged")
+    config.addinivalue_line(
+        "markers", "prefix: prefix-sharing radix KV cache + multi-tenant "
+        "serving tests (serving/llm/prefix_cache.py, shared block pool, "
+        "COW, tenant fairness); select with -m prefix")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -99,3 +103,6 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.llm)
         if mod == "test_paged_attention":
             item.add_marker(pytest.mark.paged)
+        if mod == "test_prefix_cache":
+            item.add_marker(pytest.mark.prefix)
+            item.add_marker(pytest.mark.llm)

@@ -72,7 +72,14 @@ GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
              # the count of prefill-ONLY dispatches (prefill chunks should
              # ride decode steps, not spend dispatches of their own)
              "llm_mixed_ttft_p99_ms": "lower",
-             "llm_prefill_dispatches": "lower"}
+             "llm_prefill_dispatches": "lower",
+             # ISSUE 8 prefix-cache gates: under the 90%-shared-prefix
+             # trace the token-weighted cache hit rate is a FLOOR (radix
+             # matching must keep attaching cached blocks) and so is the
+             # effective prompt-token service rate (prefix sharing is the
+             # point: serving a prompt must not require recomputing it)
+             "llm_prefix_hit_rate": "higher",
+             "llm_shared_prefill_tok_s": "higher"}
 
 
 def _metrics_of(row):
@@ -85,7 +92,8 @@ def _metrics_of(row):
     for k in ("serve_qps", "serve_p99_ms", "comm_bytes_per_step",
               "allreduce_ms", "llm_tok_s", "llm_ttft_ms",
               "llm_interactive_ttft_p99_ms", "llm_shed_rate",
-              "llm_mixed_ttft_p99_ms", "llm_prefill_dispatches"):
+              "llm_mixed_ttft_p99_ms", "llm_prefill_dispatches",
+              "llm_prefix_hit_rate", "llm_shared_prefill_tok_s"):
         if extra.get(k) is not None:
             out[k] = float(extra[k])
     return out

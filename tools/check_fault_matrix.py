@@ -18,7 +18,14 @@ shed-under-overload confined to the lowest SLO class), and the ISSUE 7
 chunked-prefill blame scenarios in tests/test_paged_attention.py
 (`paged`-marked module: a request poisoned mid-chunked-prefill — chunk
 k>0 included — is quarantined without evicting co-scheduled decode
-rows, whose streams stay bit-identical) — then
+rows, whose streams stay bit-identical), and the ISSUE 8 prefix-cache
+scenarios in tests/test_prefix_cache.py (`prefix`-marked module: a
+poisoned request sharing cached prefix blocks is quarantined without
+corrupting its siblings' shared KV — later requests still attach the
+same blocks bit-identically — and eviction under slot pressure never
+reclaims a cached block with live readers; the block ledger
+`blocks_allocated == blocks_freed + blocks_active + blocks_cached`
+balances after every scenario) — then
 prints a pass/fail table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
@@ -42,6 +49,7 @@ TEST_FILES = [
     os.path.join("tests", "test_serving.py"),
     os.path.join("tests", "test_llm_engine.py"),
     os.path.join("tests", "test_paged_attention.py"),
+    os.path.join("tests", "test_prefix_cache.py"),
 ]
 
 
