@@ -41,6 +41,32 @@ def _peak_flops(device_kind: str, backend: str) -> float:
     return 197e12  # unknown TPU: assume the smallest current chip
 
 
+def _provenance() -> dict:
+    """Measurement provenance embedded in every row (ISSUE 9): platform,
+    device kind, git sha, and wall time — so tools/check_bench_result.py
+    can refuse to gate a CPU number against a TPU pin (and a stale pinned
+    row is traceable back to the commit that produced it)."""
+    import os
+    import subprocess
+    try:
+        import jax
+        platform = jax.default_backend()
+        device_kind = jax.devices()[0].device_kind
+    except Exception:
+        platform, device_kind = "unknown", "unknown"
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip() or "unknown"
+    except Exception:
+        sha = "unknown"
+    return {"platform": platform, "device_kind": device_kind,
+            "git_sha": sha,
+            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+
+
 def _default_blocks():
     from paddle_tpu.ops.attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
     return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
@@ -259,6 +285,7 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
                 "FLAGS_flash_block_k", str(_default_blocks()[1])),
             "tpu_init_error": (init_err.splitlines()[0][:200]
                                if init_err else None),
+            "provenance": _provenance(),
         },
     }
     print(json.dumps(result))
@@ -325,6 +352,7 @@ def _run_decode_bench(jax, jnp, backend, on_tpu, preset, init_err):
             "n_chips": n_chips,
             "tpu_init_error": (init_err.splitlines()[0][:200]
                                if init_err else None),
+            "provenance": _provenance(),
         },
     }
     print(json.dumps(result))
@@ -417,6 +445,7 @@ def run_serve_bench():
             "rate_hz": rate_hz,
             "max_batch": max_batch,
             "max_wait_ms": max_wait_ms,
+            "provenance": _provenance(),
         },
     }
     print(json.dumps(result))
@@ -523,6 +552,7 @@ def run_llm_bench():
             "rate_hz": rate_hz,
             "num_slots": num_slots,
             "max_new_tokens": max_new,
+            "provenance": _provenance(),
         },
     }
 
@@ -767,6 +797,7 @@ def run_comm_bench():
             "numel": numel,
             "block_size": block,
             "iters": iters,
+            "provenance": _provenance(),
         },
     }
     print(json.dumps(result))
@@ -783,7 +814,8 @@ def _comm_main():
             "value": 0.0,
             "unit": "error",
             "vs_baseline": 0.0,
-            "extra": {"error": f"{type(e).__name__}: {str(e)[:400]}"},
+            "extra": {"error": f"{type(e).__name__}: {str(e)[:400]}",
+                      "provenance": _provenance()},
         }))
     sys.exit(0)
 
@@ -799,7 +831,8 @@ def _serve_main():
             "value": 0.0,
             "unit": "error",
             "vs_baseline": 0.0,
-            "extra": {"error": f"{type(e).__name__}: {str(e)[:400]}"},
+            "extra": {"error": f"{type(e).__name__}: {str(e)[:400]}",
+                      "provenance": _provenance()},
         }))
     sys.exit(0)
 
@@ -815,7 +848,8 @@ def _llm_main():
             "value": 0.0,
             "unit": "error",
             "vs_baseline": 0.0,
-            "extra": {"error": f"{type(e).__name__}: {str(e)[:400]}"},
+            "extra": {"error": f"{type(e).__name__}: {str(e)[:400]}",
+                      "provenance": _provenance()},
         }))
     sys.exit(0)
 
@@ -935,7 +969,7 @@ def main():
             "unit": "error",
             "vs_baseline": 0.0,
             "extra": {"error": f"{type(e).__name__}: {str(e)[:400]}",
-                      "note": note},
+                      "note": note, "provenance": _provenance()},
         }))
     sys.exit(0)
 

@@ -39,6 +39,8 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..checkpoint import CheckpointManager
+from ..obs.flight_recorder import DUMP_DIR_ENV, flight_recorder
+from ..obs.prom import MetricsServer, TrainingMetrics
 from ..profiler import RecordEvent, record_instant
 from ..utils import fault_injection
 from .trainer import DeviceWorker
@@ -172,7 +174,8 @@ class ResilientTrainer:
                  config: Optional[ResilientConfig] = None,
                  fault_plan: Optional[fault_injection.FaultPlan] = None,
                  callbacks: Optional[List] = None,
-                 use_orbax: bool = True):
+                 use_orbax: bool = True,
+                 metrics_port: Optional[int] = None):
         self.worker = DeviceWorker(train_fn, print_period=0)
         if isinstance(checkpoint, CheckpointManager):
             self.ckpt = checkpoint
@@ -186,17 +189,41 @@ class ResilientTrainer:
         self.callbacks = callbacks or []
         self.events: List[Dict[str, Any]] = []
         self._preempt_signal: Optional[int] = None
+        # pdtpu_train_* exporter: throughput gauges read the worker's
+        # tracker, counters are fed from _event / the checkpoint sites
+        self.metrics = TrainingMetrics(tracker=self.worker.throughput)
+        env_port = os.environ.get("PDTPU_METRICS_PORT")
+        if metrics_port is None and env_port:
+            metrics_port = int(env_port)
+        self.metrics_server: Optional[MetricsServer] = None
+        if metrics_port is not None:
+            self.metrics_server = MetricsServer(
+                [self.metrics.render], port=metrics_port).start()
 
     # ---- event plumbing ----
     def _event(self, kind: str, step: int, **info):
         rec = {"kind": kind, "step": step, **info}
         self.events.append(rec)
         record_instant(f"resilient/{kind}", args=rec)
+        self.metrics.on_event(kind, step)
+        # JSON-safe subset only: info may carry exception objects
+        flight_recorder().record(
+            f"train_{kind}", step=step,
+            **{k: v for k, v in info.items()
+               if isinstance(v, (str, int, float, bool, type(None)))})
         for cb in self.callbacks:
             on_fault = getattr(cb, "on_fault", None)
             if on_fault is not None:
                 on_fault(kind, step, dict(info))
         print(f"[resilient] {kind} at step {step} {info}", file=sys.stderr)
+
+    def _on_checkpoint_save(self, step: int):
+        """Counter + black-box record for a checkpoint save. Deliberately
+        NOT routed through _event: self.events is a stable recovery-protocol
+        record (tests and callbacks consume exact sequences) and periodic
+        saves are not fault events."""
+        self.metrics.on_event("checkpoint_save", step)
+        flight_recorder().record("train_checkpoint_save", step=step)
 
     # ---- preemption ----
     def _install_signal_handlers(self):
@@ -218,12 +245,21 @@ class ResilientTrainer:
         with RecordEvent("resilient/preempt_save"):
             self.ckpt.save(completed, self.get_state(), force=True)
             self.ckpt.wait_until_finished()
+        self._on_checkpoint_save(completed)
         marker = os.path.join(self.ckpt.directory, PREEMPT_MARKER)
         with open(marker, "w") as f:
             json.dump({"step": completed, "resumable": True,
                        "signal": self._preempt_signal,
                        "time": time.time()}, f)
         self._event("preempted", completed, signal=self._preempt_signal)
+        # black-box dump next to the checkpoint (unless PDTPU_FLIGHT_DIR
+        # points elsewhere): the exiting process leaves its postmortem
+        # where the resuming one will look first
+        path = None
+        if not os.environ.get(DUMP_DIR_ENV):
+            path = os.path.join(self.ckpt.directory,
+                                f"pdtpu_flight_{os.getpid()}.json")
+        flight_recorder().try_dump(path=path, reason="preempt")
         raise SystemExit(143)
 
     # ---- recovery actions ----
@@ -388,9 +424,11 @@ class ResilientTrainer:
                 si = self.config.save_interval
                 # first boundary at/past each save_interval multiple (for
                 # n == 1 this is exactly `step % si == 0`)
+                self.metrics.set_step(step)
                 if (step // si) > ((step - n) // si) or step == num_steps:
                     with RecordEvent("resilient/save"):
                         self.ckpt.save(step, self.get_state())
+                    self._on_checkpoint_save(step)
             if self._preempt_signal is not None:
                 self._preempt_exit(step)
             self.ckpt.wait_until_finished()

@@ -1,0 +1,24 @@
+"""paddle_tpu.obs — end-to-end observability (ISSUE 9):
+
+- `trace` — per-request timelines (`traceparent` ingestion, phase spans
+  that tile the request's latency, bounded LRU timeline store);
+- `flight_recorder` — process-global black-box ring of structured fault/
+  lifecycle events, dumped atomically on breaker-open / SIGTERM /
+  pump crash (postmortem CLI: tools/flight_recorder.py);
+- `prom` — shared Prometheus text-exposition plumbing + the
+  `pdtpu_train_*` training exporter and opt-in MetricsServer.
+
+Stdlib-only and import-light: serving and training both depend on this
+package, never the other way around.
+"""
+from .flight_recorder import DUMP_DIR_ENV, FlightRecorder, flight_recorder
+from .prom import MetricsServer, PromBuilder, TrainingMetrics, parse_exposition
+from .trace import (LLM_PHASES, SERVING_PHASES, RequestTrace, TimelineStore,
+                    ingest_traceparent, new_request_id)
+
+__all__ = [
+    "DUMP_DIR_ENV", "FlightRecorder", "flight_recorder",
+    "MetricsServer", "PromBuilder", "TrainingMetrics", "parse_exposition",
+    "LLM_PHASES", "SERVING_PHASES", "RequestTrace", "TimelineStore",
+    "ingest_traceparent", "new_request_id",
+]

@@ -1,0 +1,190 @@
+"""Prometheus text-exposition plumbing (format 0.0.4), extracted from
+`serving.metrics.ServingMetrics` so trainers and servers render — and are
+scraped — the same way:
+
+- `PromBuilder` — family/sample line building shared by
+  `ServingMetrics.render`, `LLMMetrics.render`, and `TrainingMetrics`;
+- `parse_exposition` — the inverse, for tests/tools (re-exported from
+  `paddle_tpu.serving.metrics` for compatibility);
+- `TrainingMetrics` — the `pdtpu_train_*` family: step/chunk throughput
+  from `profiler.ThroughputTracker` plus rollback/retry/checkpoint
+  counters fed by `ResilientTrainer`;
+- `MetricsServer` — a tiny opt-in stdlib HTTP exporter (`metrics_port=`)
+  serving `/metrics` and `/debug/flightrecorder` for processes that are
+  not already behind `serving.ServingServer`.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+
+class PromBuilder:
+    """Accumulates exposition lines; label order is preserved."""
+
+    def __init__(self):
+        self._lines: List[str] = []
+
+    def family(self, name: str, typ: str) -> "PromBuilder":
+        self._lines.append(f"# TYPE {name} {typ}")
+        return self
+
+    def sample(self, name: str, value, labels: Optional[dict] = None,
+               round_to: Optional[int] = None) -> "PromBuilder":
+        lab = ""
+        if labels:
+            inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+            lab = "{" + inner + "}"
+        if value is None:
+            v = "NaN"
+        elif round_to is not None:
+            v = round(float(value), round_to)
+        else:
+            v = value
+        self._lines.append(f"{name}{lab} {v}")
+        return self
+
+    def raw(self, line: str) -> "PromBuilder":
+        self._lines.append(line)
+        return self
+
+    def render(self) -> str:
+        return "\n".join(self._lines) + "\n"
+
+
+def parse_exposition(text: str) -> Dict[str, float]:
+    """Inverse of render() for tests/tools: flat {metric{labels}: value}."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, val = line.rpartition(" ")
+        try:
+            out[name] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+class TrainingMetrics:
+    """Training-side counters under the `pdtpu_train_*` prefix.
+
+    Fed by `ResilientTrainer._event` (every fault/recovery event maps to a
+    counter) and its checkpoint-save sites; throughput gauges read the
+    `DeviceWorker.throughput` tracker so the /metrics scrape reports the
+    same numbers the chunk loop logs."""
+
+    _PREFIX = "pdtpu_train"
+
+    # ResilientTrainer event kind -> counter name
+    _EVENT_COUNTERS = {
+        "retry": "retries", "rollback": "rollbacks", "skip": "skips",
+        "bad_loss": "bad_losses", "watchdog_timeout": "watchdog_timeouts",
+        "step_error": "step_errors", "preempted": "preemptions",
+        "resumed": "resumes", "checkpoint_save": "checkpoint_saves",
+    }
+
+    def __init__(self, tracker=None):
+        self._lock = threading.Lock()
+        self.tracker = tracker  # profiler.ThroughputTracker or None
+        self.counters: Dict[str, int] = {
+            v: 0 for v in self._EVENT_COUNTERS.values()}
+        self.last_step = 0
+
+    def on_event(self, kind: str, step: int = 0):
+        key = self._EVENT_COUNTERS.get(kind)
+        with self._lock:
+            if key is not None:
+                self.counters[key] += 1
+            self.last_step = max(self.last_step, int(step))
+
+    def set_step(self, step: int):
+        with self._lock:
+            self.last_step = max(self.last_step, int(step))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            s = dict(self.counters)
+            s["last_step"] = self.last_step
+        if self.tracker is not None:
+            s.update(self.tracker.summary())
+        return s
+
+    def render(self) -> str:
+        s = self.snapshot()
+        px = self._PREFIX
+        b = PromBuilder()
+        for name in sorted(self._EVENT_COUNTERS.values()):
+            b.family(f"{px}_{name}_total", "counter")
+            b.sample(f"{px}_{name}_total", s[name])
+        b.family(f"{px}_last_step", "gauge")
+        b.sample(f"{px}_last_step", s["last_step"])
+        if self.tracker is not None:
+            for key, typ in (("steps_per_sec", "gauge"),
+                             ("tokens_per_sec", "gauge"),
+                             ("total_steps", "counter"),
+                             ("total_tokens", "counter"),
+                             ("total_seconds", "counter")):
+                b.family(f"{px}_{key}", typ)
+                b.sample(f"{px}_{key}", s[key], round_to=4)
+        return b.render()
+
+
+class MetricsServer:
+    """Opt-in stdlib HTTP exporter for processes without a ServingServer
+    (trainers): GET /metrics renders the given providers, GET
+    /debug/flightrecorder snapshots the global flight recorder, GET
+    /healthz answers ok. Bind port 0 for an ephemeral port (tests)."""
+
+    def __init__(self, render_fns: Sequence[Callable[[], str]],
+                 host: str = "127.0.0.1", port: int = 0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        render_fns = list(render_fns)
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _reply(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    text = "".join(fn() for fn in render_fns)
+                    self._reply(200, text.encode(),
+                                "text/plain; version=0.0.4")
+                elif self.path == "/debug/flightrecorder":
+                    from .flight_recorder import flight_recorder
+                    body = json.dumps(flight_recorder().snapshot()).encode()
+                    self._reply(200, body, "application/json")
+                elif self.path == "/healthz":
+                    self._reply(200, b"ok\n", "text/plain")
+                else:
+                    self._reply(404, b"not found\n", "text/plain")
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name="pdtpu-metrics", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

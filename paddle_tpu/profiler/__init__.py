@@ -4,6 +4,12 @@ python/paddle/fluid/profiler.py).
 TPU-native: host spans are recorded in-process (RecordEvent parity) and device
 profiling delegates to jax.profiler (xprof) which captures XLA/TPU timelines —
 replacing the CUPTI device tracer (platform/device_tracer.cc:131).
+
+The event sink is PROCESS-GLOBAL: serving pump threads, HTTP handler
+threads, and the training loop all append to one shared buffer under a
+lock, so whichever thread calls `export_chrome_tracing` sees every span.
+Only the span *stack* (nesting context) stays per-thread. The disabled
+hot path is a single predicate — no lock is taken unless profiling is on.
 """
 from __future__ import annotations
 
@@ -16,14 +22,29 @@ from typing import Dict, List, Optional
 import jax
 
 
-class _ProfState(threading.local):
+class _ProfSink:
+    """Shared event buffer. `enabled` is read without the lock (a stale
+    read drops or records one extra event, never corrupts the buffer);
+    all appends/reads of `events` and `trace_dir` hold `lock`."""
+
+    __slots__ = ("lock", "enabled", "events", "trace_dir")
+
     def __init__(self):
+        self.lock = threading.Lock()
         self.enabled = False
         self.events: List[dict] = []
-        self.stack: List[tuple] = []
+        self.trace_dir: Optional[str] = None
 
 
-_P = _ProfState()
+_SINK = _ProfSink()
+
+
+class _ThreadState(threading.local):
+    def __init__(self):
+        self.stack: List[str] = []
+
+
+_T = _ThreadState()
 
 
 class RecordEvent:
@@ -35,6 +56,7 @@ class RecordEvent:
 
     def __enter__(self):
         self.begin = time.perf_counter_ns()
+        _T.stack.append(self.name)
         return self
 
     def __exit__(self, *exc):
@@ -42,50 +64,75 @@ class RecordEvent:
         return False
 
     def end(self):
-        if self.begin is None or not _P.enabled:
+        if _T.stack and _T.stack[-1] == self.name:
+            _T.stack.pop()
+        if self.begin is None or not _SINK.enabled:
+            self.begin = None
             return
-        _P.events.append({
+        evt = {
             "name": self.name, "ts": self.begin / 1e3,
             "dur": (time.perf_counter_ns() - self.begin) / 1e3,
             "ph": "X", "pid": 0, "tid": threading.get_ident() % 10000,
-        })
+        }
         self.begin = None
+        with _SINK.lock:
+            _SINK.events.append(evt)
 
 
 def record_instant(name: str, args: Optional[dict] = None):
     """Zero-duration instant event (chrome 'i' phase) — used for fault /
     recovery markers (resilient runtime) so they land on the same timeline
     as the step spans."""
-    if not _P.enabled:
+    if not _SINK.enabled:
         return
-    _P.events.append({
+    evt = {
         "name": name, "ts": time.perf_counter_ns() / 1e3,
         "ph": "i", "s": "p", "pid": 0,
         "tid": threading.get_ident() % 10000,
         "args": args or {},
-    })
+    }
+    with _SINK.lock:
+        _SINK.events.append(evt)
+
+
+def emit_events(events: List[dict]):
+    """Append pre-built chrome events (e.g. a finished request's phase
+    spans from paddle_tpu.obs.trace) onto the shared timeline."""
+    if not _SINK.enabled or not events:
+        return
+    with _SINK.lock:
+        _SINK.events.extend(events)
+
+
+def profiler_enabled() -> bool:
+    return _SINK.enabled
 
 
 def start_profiler(state="All", tracer_option="Default", trace_dir=None):
-    _P.enabled = True
-    _P.events.clear()
+    with _SINK.lock:
+        _SINK.events.clear()
+        # module-global, NOT thread-local: stop_profiler() from any thread
+        # must see the trace_dir that start_profiler() armed
+        _SINK.trace_dir = trace_dir or None
+    _SINK.enabled = True
     if trace_dir:
         jax.profiler.start_trace(trace_dir)
-        _P.trace_dir = trace_dir
-    else:
-        _P.trace_dir = None
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
-    _P.enabled = False
-    if getattr(_P, "trace_dir", None):
+    _SINK.enabled = False
+    with _SINK.lock:
+        trace_dir, _SINK.trace_dir = _SINK.trace_dir, None
+    if trace_dir:
         jax.profiler.stop_trace()
     export_chrome_tracing(profile_path)
 
 
 def export_chrome_tracing(path: str):
+    with _SINK.lock:
+        events = list(_SINK.events)
     with open(path, "w") as f:
-        json.dump({"traceEvents": _P.events}, f)
+        json.dump({"traceEvents": events}, f)
 
 
 @contextlib.contextmanager
@@ -108,8 +155,9 @@ class Profiler:
         self._active = False
 
     def start(self):
-        _P.enabled = True
-        _P.events.clear()
+        with _SINK.lock:
+            _SINK.events.clear()
+        _SINK.enabled = True
         if not self.timer_only:
             try:
                 jax.profiler.start_trace(self.trace_dir)
@@ -118,7 +166,7 @@ class Profiler:
                 self._active = False
 
     def stop(self):
-        _P.enabled = False
+        _SINK.enabled = False
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
@@ -137,7 +185,11 @@ class Profiler:
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
         by_name: Dict[str, List[float]] = {}
-        for e in _P.events:
+        # only complete ("X") spans carry a duration; instants ("i") from
+        # record_instant share the buffer and must not crash the summary
+        for e in get_events():
+            if e.get("ph") != "X":
+                continue
             by_name.setdefault(e["name"], []).append(e["dur"])
         lines = [f"{'Event':40s} {'Calls':>8s} {'Total(us)':>12s} {'Avg(us)':>12s}"]
         for name, durs in sorted(by_name.items(), key=lambda kv: -sum(kv[1])):
@@ -203,4 +255,5 @@ class ThroughputTracker:
 
 
 def get_events():
-    return list(_P.events)
+    with _SINK.lock:
+        return list(_SINK.events)

@@ -39,6 +39,9 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs.flight_recorder import flight_recorder
+from ..obs.trace import (SERVING_PHASES, RequestTrace, TimelineStore,
+                         new_request_id)
 from .clock import Clock, MonotonicClock, SimClock
 from .metrics import ServingMetrics
 from .supervisor import DispatchFailedError, EngineSupervisor
@@ -107,7 +110,8 @@ class EngineConfig:
 
 
 class _Request:
-    __slots__ = ("inputs", "rows", "arrival", "deadline", "future")
+    __slots__ = ("inputs", "rows", "arrival", "deadline", "future", "rid",
+                 "trace")
 
     def __init__(self, inputs, rows, arrival, deadline):
         self.inputs = inputs          # list of np arrays, leading batch dim
@@ -115,6 +119,10 @@ class _Request:
         self.arrival = arrival        # clock seconds
         self.deadline = deadline      # absolute clock seconds or None
         self.future: Future = Future()
+        self.rid: Optional[str] = None
+        self.trace: Optional[RequestTrace] = None  # None: untraced (the
+        #                                            hot-path cost is one
+        #                                            `is not None` test)
 
 
 def _next_pow2(n: int) -> int:
@@ -182,6 +190,9 @@ class BatchingEngine:
             breaker_threshold=self.config.breaker_threshold,
             on_trip=self._on_breaker_trip, name="serving")
         self._dispatch_idx = 0   # running count of supervised dispatches
+        # finished-request timelines, bounded LRU (served by the HTTP
+        # layer's /debug/requests endpoint)
+        self.timelines = TimelineStore(256)
 
     @classmethod
     def from_predictor(cls, predictor, config: Optional[EngineConfig] = None,
@@ -222,9 +233,12 @@ class BatchingEngine:
             if self._stopped:
                 return
             self._draining = True
+            flight_recorder().record("drain_begin", engine="serving",
+                                     drain=drain, queued=len(self._pending))
             if not drain:
                 while self._pending:
                     req = self._pending.popleft()
+                    self._conclude(req, "rejected:shutdown")
                     req.future.set_exception(
                         RejectedError("engine shut down before dispatch",
                                       reason="shutdown"))
@@ -252,6 +266,7 @@ class BatchingEngine:
             stranded = 0
             while self._pending:
                 req = self._pending.popleft()
+                self._conclude(req, "rejected:drain_timeout")
                 req.future.set_exception(RejectedError(
                     "engine drain timed out before dispatch",
                     reason="drain_timeout"))
@@ -261,6 +276,8 @@ class BatchingEngine:
                 self.metrics.set_queue_depth(0)
             self._stopped = True
             self._cond.notify_all()
+        flight_recorder().record("drain_end", engine="serving",
+                                 stranded=stranded)
 
     @property
     def draining(self) -> bool:
@@ -278,15 +295,20 @@ class BatchingEngine:
         — each pending dispatch would only fail again — and notify the
         front end (which flips /healthz to 503 and starts a drain on its
         own thread)."""
+        flushed = 0
         with self._cond:
             while self._pending:
                 req = self._pending.popleft()
+                self._conclude(req, "rejected:circuit_open")
                 req.future.set_exception(RejectedError(
                     "engine circuit breaker open after repeated dispatch "
                     "failures", reason="circuit_open"))
                 self.metrics.on_reject("circuit_open")
+                flushed += 1
             self.metrics.set_queue_depth(0)
             self._cond.notify_all()
+        flight_recorder().record("queue_flushed", engine="serving",
+                                 reason="circuit_open", n=flushed)
         self.metrics.set_circuit_open(True)
         if self.on_break is not None:
             try:
@@ -301,11 +323,32 @@ class BatchingEngine:
         self.stop(drain=True)
         return False
 
+    # ---- tracing / black-box hooks ----
+    def _conclude(self, req: _Request, outcome: str,
+                  now: Optional[float] = None):
+        """Finalize a request's trace (if any) and publish its timeline."""
+        if req.trace is None:
+            return
+        tr = req.trace
+        tr.finish(self.clock.now() if now is None else now, outcome)
+        self.timelines.put(tr.rid, tr.to_dict())
+        tr.emit_chrome()
+
+    def _record_reject(self, reason: str, rid: Optional[str] = None):
+        flight_recorder().record("reject", engine="serving", reason=reason,
+                                 rid=rid)
+
     # ---- admission ----
-    def submit(self, inputs, deadline_ms: Optional[float] = None) -> Future:
+    def submit(self, inputs, deadline_ms: Optional[float] = None,
+               rid: Optional[str] = None, trace: bool = False) -> Future:
         """Admit one request. inputs: array or list of arrays, each with a
         leading batch dim (>= 1 rows, all inputs agreeing). Raises
-        RejectedError when the queue is full or the engine is draining."""
+        RejectedError when the queue is full or the engine is draining.
+
+        `rid` is the request id (ingested from a `traceparent` header by
+        the HTTP layer, or generated here); `trace=True` additionally
+        records a structured timeline, retrievable from
+        `engine.timelines` after the request finishes."""
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
         arrays = [np.asarray(a) for a in inputs]
@@ -320,9 +363,11 @@ class BatchingEngine:
                     f"all request inputs must share the leading batch dim "
                     f"({rows}); got shapes "
                     f"{[tuple(x.shape) for x in arrays]}")
+        rid = rid or new_request_id()
         if (self.config.max_request_rows is not None
                 and rows > self.config.max_request_rows):
             self.metrics.on_reject("too_many_rows")
+            self._record_reject("too_many_rows", rid=rid)
             raise RejectedError(
                 f"request rows ({rows}) exceed max_request_rows "
                 f"({self.config.max_request_rows})", reason="too_many_rows")
@@ -333,20 +378,28 @@ class BatchingEngine:
         with self._cond:
             if self.supervisor.open:
                 self.metrics.on_reject("circuit_open")
+                self._record_reject("circuit_open", rid=rid)
                 raise RejectedError(
                     "engine circuit breaker open after repeated dispatch "
                     "failures; request rejected", reason="circuit_open")
             if self._draining or self._stopped:
                 self.metrics.on_reject("draining")
+                self._record_reject("draining", rid=rid)
                 raise RejectedError("engine is draining; request rejected",
                                     reason="draining")
             if len(self._pending) >= self.config.max_queue_depth:
                 self.metrics.on_reject("queue_full")
+                self._record_reject("queue_full", rid=rid)
                 raise RejectedError(
                     f"queue at capacity ({self.config.max_queue_depth} "
                     "pending requests)", reason="queue_full",
                     retry_after_s=self.config.retry_after_s)
             req = _Request(arrays, rows, now, deadline)
+            req.rid = rid
+            if trace:
+                req.trace = RequestTrace(rid, now,
+                                         phase_defs=SERVING_PHASES)
+                req.trace.event("submitted", now, rows=rows)
             self._pending.append(req)
             self.metrics.on_submit(len(self._pending))
             self._cond.notify_all()
@@ -420,6 +473,7 @@ class BatchingEngine:
         expired = 0
         for r in self._pending:
             if r.deadline is not None and now >= r.deadline:
+                self._conclude(r, "expired:queued", now)
                 r.future.set_exception(DeadlineExceededError(
                     f"deadline expired after "
                     f"{(now - r.arrival) * 1e3:.1f}ms in queue "
@@ -462,6 +516,11 @@ class BatchingEngine:
         t0 = self.clock.now()
         total = sum(r.rows for r in batch)
         padded = total
+        for r in batch:
+            if r.trace is not None:
+                r.trace.mark("dispatched", t0)
+                r.trace.event("dispatched", t0, batch_rows=total,
+                              batch_requests=len(batch))
         # batch assembly sits INSIDE the try: an exception anywhere between
         # here and predict_fn must fail this batch's futures, never escape
         # into (and kill) the scheduler thread
@@ -486,6 +545,7 @@ class BatchingEngine:
             outs = list(self._supervised_predict(args))
         except Exception as e:
             for r in batch:
+                self._conclude(r, "failed:dispatch")
                 r.future.set_exception(e)
             self.metrics.on_fail(len(batch))
             return
@@ -506,6 +566,9 @@ class BatchingEngine:
                 else:  # non-batched output (constant/state table)
                     result.append(o)
             offset += r.rows
+            # finalize the trace BEFORE resolving the future: a waiter
+            # unblocked by set_result must find the completed timeline
+            self._conclude(r, "completed", now)
             r.future.set_result(result)
             self.metrics.on_complete((now - r.arrival) * 1e3)
         with self._cond:
@@ -538,9 +601,13 @@ class BatchingEngine:
                         self.clock.wait(self._cond, None)
             try:
                 self.pump()
-            except Exception:
+            except Exception as e:
                 # _dispatch already routes per-batch errors to the batch's
                 # futures; anything escaping pump() is a scheduler bug. Log
                 # and keep scheduling — a dead scheduler would wedge every
                 # queued and future request until their own timeouts.
                 _log.exception("serving scheduler pump failed; continuing")
+                fr = flight_recorder()
+                fr.record("pump_exception", engine="serving",
+                          error=f"{type(e).__name__}: {e}")
+                fr.try_dump(reason="pump_exception:serving")

@@ -87,6 +87,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...obs.flight_recorder import flight_recorder
+from ...obs.trace import RequestTrace, TimelineStore, new_request_id
 from ..clock import Clock, MonotonicClock, SimClock
 from ..engine import DeadlineExceededError, RejectedError
 from ..metrics import LLMMetrics, SLO_CLASSES
@@ -138,6 +140,9 @@ class LLMEngineConfig:
     dispatch_retries: int = 2      # whole-step retries before blame/fail
     breaker_threshold: int = 3     # consecutive engine-level failures that
     #                                open the circuit breaker
+    # ---- observability (ISSUE 9) ----
+    trace_buffer: int = 256        # finished request timelines kept for
+    #                                /debug/requests/<rid> (bounded LRU)
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -172,6 +177,9 @@ class LLMEngineConfig:
             raise ValueError(
                 f"breaker_threshold must be >= 1, got "
                 f"{self.breaker_threshold}")
+        if self.trace_buffer < 1:
+            raise ValueError(
+                f"trace_buffer must be >= 1, got {self.trace_buffer}")
 
 
 class GenerationHandle:
@@ -189,6 +197,8 @@ class GenerationHandle:
         self.slo = slo
         self.future: Future = Future()
         self.ttft_ms: Optional[float] = None
+        self.rid: Optional[str] = None       # request id (always assigned)
+        self.trace: Optional[RequestTrace] = None   # when tracing opted in
         self._lock = threading.Lock()
         self._tokens: List[int] = []
 
@@ -203,12 +213,17 @@ class GenerationHandle:
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         return self.future.result(timeout)
 
+    def timeline(self) -> Optional[dict]:
+        """Structured timeline dict when the request was traced (complete
+        once the future has resolved), else None."""
+        return self.trace.to_dict() if self.trace is not None else None
+
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "eos_token_id", "arrival",
                  "deadline", "handle", "slot", "emitted", "last_tok",
                  "slo", "submit_idx", "cost", "chunk_off", "tenant",
-                 "attached_pages")
+                 "attached_pages", "rid", "trace")
 
     def __init__(self, prompt, max_new_tokens, eos_token_id, arrival,
                  deadline, slo, submit_idx, tenant="default"):
@@ -234,6 +249,11 @@ class _GenRequest:
         self.tenant = tenant
         self.attached_pages: List[int] = []   # shared pages this request
         #                                       reads (refcounted in pool)
+        self.rid: Optional[str] = None        # request id (always assigned)
+        self.trace: Optional[RequestTrace] = None   # None unless the
+        #                                       request opted into tracing —
+        #                                       every hot-path hook guards on
+        #                                       this ONE predicate
 
 
 class LLMEngine:
@@ -298,6 +318,8 @@ class LLMEngine:
         self._submit_idx = 0         # lifetime admissions (poison keying)
         self._dispatch_idx = 0       # lifetime dispatch attempts (fault
         #                              clauses key on this index)
+        # finished request timelines for /debug/requests/<rid> (ISSUE 9)
+        self.timelines = TimelineStore(self.config.trace_buffer)
         if fault_plan is None:
             from ...utils.fault_injection import global_plan
             fault_plan = global_plan()
@@ -307,6 +329,26 @@ class LLMEngine:
             dispatch_timeout_s=self.config.dispatch_timeout_s,
             breaker_threshold=self.config.breaker_threshold,
             on_trip=self._on_breaker_trip, name="llm")
+
+    # ---- observability (ISSUE 9) ----
+    def _conclude(self, req: _GenRequest, outcome: str,
+                  now: Optional[float] = None):
+        """Finalize a traced request's timeline on ANY terminal path
+        (complete / evict / quarantine / shed / shutdown): close the
+        phase spans, store the timeline for /debug/requests/<rid>, and
+        emit the request's spans onto the chrome trace. One predicate
+        when the request was not traced."""
+        if req.trace is None:
+            return
+        tr = req.trace
+        tr.finish(self.clock.now() if now is None else now, outcome)
+        self.timelines.put(tr.rid, tr.to_dict())
+        tr.emit_chrome()
+
+    def _record_reject(self, reason: str, rid: Optional[str] = None,
+                       tenant: Optional[str] = None):
+        flight_recorder().record("reject", engine="llm", reason=reason,
+                                 rid=rid, tenant=tenant)
 
     # ---- the one jitted executable ----
     def _step(self):
@@ -393,15 +435,20 @@ class LLMEngine:
             if self._stopped:
                 return
             self._draining = True
+            flight_recorder().record(
+                "drain_begin", engine="llm", drain=bool(drain),
+                queued=self._queue_len_locked(), active=len(self._active))
             if not drain:
                 for q in self._queues.values():
                     while q:
                         req = q.popleft()
+                        self._conclude(req, "rejected:shutdown")
                         req.handle.future.set_exception(
                             RejectedError("engine shut down before prefill",
                                           reason="shutdown"))
                         self.metrics.on_reject("shutdown")
                 for slot, req in list(self._active.items()):
+                    self._conclude(req, "rejected:shutdown")
                     req.handle.future.set_exception(
                         RejectedError("engine shut down mid-decode",
                                       reason="shutdown"))
@@ -441,12 +488,14 @@ class LLMEngine:
             for q in self._queues.values():
                 while q:
                     req = q.popleft()
+                    self._conclude(req, "rejected:drain_timeout")
                     req.handle.future.set_exception(RejectedError(
                         "engine drain timed out before prefill",
                         reason="drain_timeout"))
                     self.metrics.on_reject("drain_timeout")
                     stranded += 1
             for slot, req in list(self._active.items()):
+                self._conclude(req, "rejected:drain_timeout")
                 req.handle.future.set_exception(RejectedError(
                     "engine drain timed out mid-decode",
                     reason="drain_timeout"))
@@ -459,6 +508,8 @@ class LLMEngine:
                 self.metrics.set_slots(0, self.pool.num_slots)
             self._stopped = True
             self._cond.notify_all()
+        flight_recorder().record("drain_end", engine="llm",
+                                 stranded=stranded)
 
     @property
     def draining(self) -> bool:
@@ -475,16 +526,21 @@ class LLMEngine:
         "circuit_open"), queued requests fail now — their dispatches would
         only fail again — and the front end is notified so it can flip
         /healthz and drain on its own thread."""
+        flushed = 0
         with self._cond:
             for q in self._queues.values():
                 while q:
                     req = q.popleft()
+                    self._conclude(req, "rejected:circuit_open")
                     req.handle.future.set_exception(RejectedError(
                         "engine circuit breaker open after repeated "
                         "dispatch failures", reason="circuit_open"))
                     self.metrics.on_reject("circuit_open")
+                    flushed += 1
             self.metrics.set_queue_depth(0)
             self._cond.notify_all()
+        flight_recorder().record("queue_flushed", engine="llm",
+                                 reason="circuit_open", n=flushed)
         self.metrics.set_circuit_open(True)
         if self.on_break is not None:
             try:
@@ -582,23 +638,31 @@ class LLMEngine:
                     break
             if victim is None:
                 return "queue_full" if depth_full else "token_budget"
+            self._conclude(victim, "shed")
             victim.handle.future.set_exception(RejectedError(
                 f"shed ({victim.slo}) to admit {slo} traffic under "
                 "overload", reason="shed",
                 retry_after_s=self.config.retry_after_s))
             self.metrics.on_reject("shed", tenant=victim.tenant)
             self.metrics.on_shed(victim.slo)
+            self._record_reject("shed", rid=victim.rid,
+                                tenant=victim.tenant)
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
                deadline_ms: Optional[float] = None,
                slo: Optional[str] = None,
-               tenant: Optional[str] = None) -> GenerationHandle:
+               tenant: Optional[str] = None,
+               rid: Optional[str] = None,
+               trace: bool = False) -> GenerationHandle:
         """Admit one prompt (1-D int token ids). `slo` names the request's
         SLO class (config.default_slo when None); `tenant` its isolation
         domain (config.default_tenant when None) — tenants get fair
         dequeue within a class, an optional in-flight token quota, and a
-        private prefix-cache namespace. Raises RejectedError when the
+        private prefix-cache namespace. `rid` is the request id (ingested
+        from a traceparent header by the server, generated when None);
+        `trace=True` accumulates a per-request timeline on the handle and
+        in the engine's timeline store. Raises RejectedError when the
         sequence can never fit a slot, the queue/token budget/tenant
         quota is exhausted and nothing lower-priority can be shed, the
         engine is draining, or the circuit breaker is open."""
@@ -616,10 +680,12 @@ class LLMEngine:
         tenant = self.config.default_tenant if tenant is None else tenant
         if not isinstance(tenant, str) or not tenant:
             raise ValueError("tenant must be a non-empty string")
+        rid = rid or new_request_id()
         eos = (self.config.eos_token_id if eos_token_id is None
                else eos_token_id)
         if prompt.size + mnt > self.pool.capacity:
             self.metrics.on_reject("prompt_too_long")
+            self._record_reject("prompt_too_long", rid=rid, tenant=tenant)
             raise RejectedError(
                 f"prompt ({prompt.size}) + max_new_tokens ({mnt}) exceeds "
                 f"slot capacity ({self.pool.capacity} tokens)",
@@ -631,11 +697,13 @@ class LLMEngine:
         with self._cond:
             if self.supervisor.open:
                 self.metrics.on_reject("circuit_open")
+                self._record_reject("circuit_open", rid=rid, tenant=tenant)
                 raise RejectedError(
                     "engine circuit breaker open after repeated dispatch "
                     "failures; request rejected", reason="circuit_open")
             if self._draining or self._stopped:
                 self.metrics.on_reject("draining")
+                self._record_reject("draining", rid=rid, tenant=tenant)
                 raise RejectedError("engine is draining; request rejected",
                                     reason="draining")
             self._update_brownout_locked()
@@ -648,6 +716,7 @@ class LLMEngine:
                 # checked BEFORE shed logic: shedding OTHER tenants'
                 # requests cannot relieve this tenant's own quota
                 self.metrics.on_reject("tenant_quota", tenant=tenant)
+                self._record_reject("tenant_quota", rid=rid, tenant=tenant)
                 raise RejectedError(
                     f"tenant {tenant!r} in-flight token quota exhausted "
                     f"({quota} tokens)", reason="tenant_quota",
@@ -655,6 +724,7 @@ class LLMEngine:
             reason = self._make_room_locked(slo, prompt.size + mnt)
             if reason is not None:
                 self.metrics.on_reject(reason)
+                self._record_reject(reason, rid=rid, tenant=tenant)
                 detail = (f"queue at capacity ({self.config.max_queue_depth} "
                           "pending requests)" if reason == "queue_full" else
                           f"token budget exhausted "
@@ -666,6 +736,14 @@ class LLMEngine:
                     retry_after_s=self.config.retry_after_s)
             req = _GenRequest(prompt, mnt, eos, now, deadline, slo,
                               self._submit_idx, tenant=tenant)
+            req.rid = rid
+            req.handle.rid = rid
+            if trace:
+                req.trace = RequestTrace(rid, now, slo=slo, tenant=tenant)
+                req.trace.event("submitted", now, prompt_len=int(prompt.size),
+                                max_new_tokens=mnt,
+                                submit_idx=self._submit_idx)
+                req.handle.trace = req.trace
             self._submit_idx += 1
             self._queues[slo].append(req)
             self.metrics.on_submit(self._queue_len_locked(), slo=slo,
@@ -742,6 +820,7 @@ class LLMEngine:
                 alive = deque()
                 for r in q:
                     if r.deadline is not None and now >= r.deadline:
+                        self._conclude(r, "expired:queued", now)
                         r.handle.future.set_exception(DeadlineExceededError(
                             f"deadline expired after "
                             f"{(now - r.arrival) * 1e3:.1f}ms in queue "
@@ -781,6 +860,12 @@ class LLMEngine:
                 req.slot = slot
                 req.chunk_off = 0
                 req.attached_pages = []
+                if req.trace is not None:
+                    t_adm = self.clock.now()
+                    req.trace.mark("admitted", t_adm)
+                    req.trace.event(
+                        "admitted", t_adm, slot=slot,
+                        queue_wait_ms=(t_adm - req.arrival) * 1e3)
                 if self.prefix_cache is not None:
                     # cap at plen-1 so at least one prompt token always
                     # prefills (that step produces the first output
@@ -802,6 +887,11 @@ class LLMEngine:
                     self.prefix_cache.release(plan)
                     self.metrics.on_prefix_lookup(
                         req.tenant, plan.attach_len, len(req.prompt))
+                    if req.trace is not None:
+                        req.trace.event(
+                            "prefix_lookup", self.clock.now(),
+                            attach_len=plan.attach_len,
+                            prompt_len=len(req.prompt))
                 self._active[slot] = req
                 self.metrics.set_slots(self.pool.active_slots(),
                                        self.pool.num_slots)
@@ -876,6 +966,11 @@ class LLMEngine:
                 except DispatchFailedError as e:
                     last_err = e
                     self.metrics.on_dispatch_failure(e.reason)
+                    flight_recorder().record(
+                        "dispatch_retry", engine="llm", attempt=attempt + 1,
+                        attempts=attempts, reason=e.reason,
+                        prefill_rows=len(prefill_slots),
+                        decode_rows=len(decode_slots))
                     _log.warning(
                         "unified step dispatch failed over %d prefill + %d "
                         "decode row(s) (attempt %d/%d): %s",
@@ -906,13 +1001,20 @@ class LLMEngine:
                 for slot in prefill_slots:
                     req = self._active[slot]
                     n = int(adv[slot])
-                    self.pool.set_length(slot, req.chunk_off + n)
-                    req.chunk_off += n
+                    off = req.chunk_off
+                    self.pool.set_length(slot, off + n)
+                    req.chunk_off = off + n
                     self.prefill_tokens += n
+                    if req.trace is not None:
+                        req.trace.event("prefill_chunk", now, off=off, n=n)
                     if req.chunk_off >= len(req.prompt):
                         # final chunk landed: first token emitted, TTFT
                         # ends here
                         req.handle.ttft_ms = (now - req.arrival) * 1e3
+                        if req.trace is not None:
+                            # same instant as ttft_ms, so the trace's TTFT
+                            # boundary reconciles with the handle exactly
+                            req.trace.mark("first_token", now)
                         self.metrics.on_prefill(req.handle.ttft_ms,
                                                 slo=req.slo)
                         if self.prefix_cache is not None:
@@ -935,6 +1037,10 @@ class LLMEngine:
                     req = self._active[slot]
                     # the decode wrote last_tok's KV at pos[slot]
                     self.pool.set_length(slot, int(pos[slot]) + 1)
+                    if req.trace is not None:
+                        req.trace.event("decode_step", now,
+                                        tok=int(nxt[slot]),
+                                        n_active=len(decode_slots))
                     self._emit(req, int(nxt[slot]))
                     if self._finish_if_done(req, now):
                         del self._active[slot]
@@ -954,6 +1060,7 @@ class LLMEngine:
         the deadline error."""
         stage = ("mid-prefill" if req.chunk_off < len(req.prompt)
                  else "mid-decode")
+        self._conclude(req, f"expired:{stage}", now)
         req.handle.future.set_exception(DeadlineExceededError(
             f"deadline expired after {len(req.emitted)} of "
             f"{req.max_new_tokens} tokens (evicted {stage})"))
@@ -998,18 +1105,31 @@ class LLMEngine:
                 self._run_dispatch(((kind, (req.submit_idx,)),), fn, args)
             except DispatchFailedError as e:
                 blamed.append((slot, req, e))
+                flight_recorder().record(
+                    "solo_probe", engine="llm", rid=req.rid,
+                    submit_idx=req.submit_idx, stage=kind,
+                    outcome="failed")
+            else:
+                flight_recorder().record(
+                    "solo_probe", engine="llm", rid=req.rid,
+                    submit_idx=req.submit_idx, stage=kind, outcome="ok")
         if not blamed or (len(blamed) == len(suspects) and len(suspects) > 1):
             return False
         with self._cond:
             for slot, req, e in blamed:
                 if slot not in self._active:
                     continue
+                self._conclude(req, "quarantined")
                 req.handle.future.set_exception(DispatchFailedError(
                     f"request {req.submit_idx} quarantined: its rows "
                     f"reproduce the decode failure in isolation ({e})",
                     reason="poisoned"))
                 self.metrics.on_fail()
                 self.metrics.on_quarantine()
+                flight_recorder().record(
+                    "quarantine", engine="llm", rid=req.rid,
+                    submit_idx=req.submit_idx, reason="poisoned",
+                    tokens_emitted=len(req.emitted))
                 self.pool.free(slot)
                 del self._active[slot]
             self.metrics.set_slots(self.pool.active_slots(),
@@ -1025,7 +1145,9 @@ class LLMEngine:
         a typed error (partial tokens stay readable), free their slots,
         and let the caller charge the circuit breaker."""
         with self._cond:
+            n_failed = len(self._active)
             for slot, req in list(self._active.items()):
+                self._conclude(req, "failed:engine")
                 req.handle.future.set_exception(DispatchFailedError(
                     f"decode dispatch failed {attempts} consecutive times; "
                     f"{len(req.emitted)} of {req.max_new_tokens} tokens "
@@ -1035,6 +1157,9 @@ class LLMEngine:
             self._active.clear()
             self.metrics.set_slots(self.pool.active_slots(),
                                    self.pool.num_slots)
+        flight_recorder().record(
+            "engine_failure", engine="llm", failed=n_failed,
+            attempts=attempts, error=str(last_err))
 
     def _emit(self, req: _GenRequest, tok: int):
         req.emitted.append(tok)
@@ -1049,6 +1174,9 @@ class LLMEngine:
                     and req.emitted[-1] == req.eos_token_id))
         if not done:
             return False
+        # finalize the timeline BEFORE resolving the future: a waiter that
+        # wakes on result() must see the completed trace
+        self._conclude(req, "completed", now)
         req.handle.future.set_result(np.asarray(req.emitted, np.int32))
         self.metrics.on_complete((now - req.arrival) * 1e3, slo=req.slo,
                                  tenant=req.tenant)
@@ -1071,5 +1199,11 @@ class LLMEngine:
                     self.clock.wait(self._cond, None)
             try:
                 self.pump()
-            except Exception:
+            except Exception as e:
+                # an unhandled pump exception is exactly what the black box
+                # exists for: record + dump before carrying on
+                fr = flight_recorder()
+                fr.record("pump_exception", engine="llm",
+                          error=f"{type(e).__name__}: {e}")
+                fr.try_dump(reason="pump_exception:llm")
                 _log.exception("llm scheduler pump failed; continuing")

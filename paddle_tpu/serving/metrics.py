@@ -18,6 +18,11 @@ import threading
 from collections import deque
 from typing import Dict, Optional
 
+# text-exposition plumbing lives in obs.prom (ISSUE 9) so training-side
+# exporters render the same way; parse_exposition is re-exported from
+# here for existing callers
+from ..obs.prom import PromBuilder, parse_exposition  # noqa: F401
+
 # cumulative histogram upper bounds for dispatched batch rows
 BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
@@ -136,46 +141,41 @@ class ServingMetrics:
 
     def render(self) -> str:
         """Prometheus text exposition (served at /metrics)."""
+        b = PromBuilder()
+        self._render_into(b)
+        return b.render()
+
+    def _render_into(self, b: PromBuilder):
         s = self.snapshot()
         px = self._PREFIX
-        lines = [
-            f"# TYPE {px}_requests_total counter",
-        ]
+        b.family(f"{px}_requests_total", "counter")
         for outcome in ("submitted", "completed", "rejected", "expired",
                         "failed"):
-            lines.append(f"{px}_requests_total"
-                         f'{{outcome="{outcome}"}} {s[outcome]}')
-        lines += [
-            f"# TYPE {px}_dispatches_total counter",
-            f"{px}_dispatches_total {s['dispatches']}",
-            f"# TYPE {px}_queue_depth gauge",
-            f"{px}_queue_depth {s['queue_depth']}",
-            f"# TYPE {px}_latency_ms summary",
-        ]
+            b.sample(f"{px}_requests_total", s[outcome],
+                     {"outcome": outcome})
+        b.family(f"{px}_dispatches_total", "counter")
+        b.sample(f"{px}_dispatches_total", s["dispatches"])
+        b.family(f"{px}_queue_depth", "gauge")
+        b.sample(f"{px}_queue_depth", s["queue_depth"])
+        b.family(f"{px}_latency_ms", "summary")
         for q, key in ((0.5, "p50_ms"), (0.95, "p95_ms"), (0.99, "p99_ms")):
-            v = s[key]
-            lines.append(f'{px}_latency_ms{{quantile="{q}"}} '
-                         f"{'NaN' if v is None else round(v, 3)}")
-        lines.append(f"# TYPE {px}_batch_rows histogram")
-        cum = 0
+            b.sample(f"{px}_latency_ms", s[key], {"quantile": q}, round_to=3)
+        b.family(f"{px}_batch_rows", "histogram")
         hist = s["batch_hist"]
         for le in BATCH_BUCKETS:
             cum = sum(n for rows, n in hist.items() if rows <= le)
-            lines.append(f'{px}_batch_rows_bucket{{le="{le}"}} {cum}')
-        lines.append(f'{px}_batch_rows_bucket{{le="+Inf"}} '
-                     f"{sum(hist.values())}")
-        lines.append(f"{px}_batch_rows_count {sum(hist.values())}")
-        lines.append(f"{px}_batch_rows_sum "
-                     f"{sum(r * n for r, n in hist.items())}")
-        lines.append(f"# TYPE {px}_dispatch_failures_total counter")
+            b.sample(f"{px}_batch_rows_bucket", cum, {"le": le})
+        b.sample(f"{px}_batch_rows_bucket", sum(hist.values()),
+                 {"le": "+Inf"})
+        b.sample(f"{px}_batch_rows_count", sum(hist.values()))
+        b.sample(f"{px}_batch_rows_sum",
+                 sum(r * n for r, n in hist.items()))
+        b.family(f"{px}_dispatch_failures_total", "counter")
         for kind in sorted(s["dispatch_failures"]):
-            lines.append(f'{px}_dispatch_failures_total{{kind="{kind}"}} '
-                         f"{s['dispatch_failures'][kind]}")
-        lines += [
-            f"# TYPE {px}_circuit_open gauge",
-            f"{px}_circuit_open {int(s['circuit_open'])}",
-        ]
-        return "\n".join(lines) + "\n"
+            b.sample(f"{px}_dispatch_failures_total",
+                     s["dispatch_failures"][kind], {"kind": kind})
+        b.family(f"{px}_circuit_open", "gauge")
+        b.sample(f"{px}_circuit_open", int(s["circuit_open"]))
 
 
 def _quantile(sorted_vals, q: float) -> Optional[float]:
@@ -427,106 +427,79 @@ class LLMMetrics(ServingMetrics):
             s[f"ttft_p99_ms_{c}"] = self.ttft_quantile_ms(0.99, slo=c)
         return s
 
-    def render(self) -> str:
+    def _render_into(self, b: PromBuilder):
+        super()._render_into(b)
         s = self.snapshot()
         px = self._PREFIX
-        lines = [super().render().rstrip("\n")]
         for fam, prefix in ((f"{px}_ttft_ms", "ttft"),
                             (f"{px}_intertoken_ms", "intertoken")):
-            lines.append(f"# TYPE {fam} summary")
+            b.family(fam, "summary")
             for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
-                v = s[f"{prefix}_{key}_ms"]
-                lines.append(f'{fam}{{quantile="{q}"}} '
-                             f"{'NaN' if v is None else round(v, 3)}")
-        lines += [
-            f"# TYPE {px}_tokens_per_s gauge",
-            f"{px}_tokens_per_s {round(s['tokens_per_s'], 3)}",
-            f"# TYPE {px}_slots_active gauge",
-            f"{px}_slots_active {s['slots_active']}",
-            f"# TYPE {px}_slots_total gauge",
-            f"{px}_slots_total {s['slots_total']}",
-            f"# TYPE {px}_slot_occupancy gauge",
-            f"{px}_slot_occupancy {round(s['slot_occupancy'], 4)}",
-            f"# TYPE {px}_tokens_total counter",
-            f"{px}_tokens_total {s['tokens_out']}",
-            f"# TYPE {px}_decode_steps_total counter",
-            f"{px}_decode_steps_total {s['decode_steps']}",
-            f"# TYPE {px}_prefills_total counter",
-            f"{px}_prefills_total {s['prefills']}",
-        ]
+                b.sample(fam, s[f"{prefix}_{key}_ms"], {"quantile": q},
+                         round_to=3)
+        b.family(f"{px}_tokens_per_s", "gauge")
+        b.sample(f"{px}_tokens_per_s", s["tokens_per_s"], round_to=3)
+        b.family(f"{px}_slots_active", "gauge")
+        b.sample(f"{px}_slots_active", s["slots_active"])
+        b.family(f"{px}_slots_total", "gauge")
+        b.sample(f"{px}_slots_total", s["slots_total"])
+        b.family(f"{px}_slot_occupancy", "gauge")
+        b.sample(f"{px}_slot_occupancy", s["slot_occupancy"], round_to=4)
+        b.family(f"{px}_tokens_total", "counter")
+        b.sample(f"{px}_tokens_total", s["tokens_out"])
+        b.family(f"{px}_decode_steps_total", "counter")
+        b.sample(f"{px}_decode_steps_total", s["decode_steps"])
+        b.family(f"{px}_prefills_total", "counter")
+        b.sample(f"{px}_prefills_total", s["prefills"])
         # ---- overload control + supervision families (ISSUE 6) ----
-        lines.append(f"# TYPE {px}_class_requests_total counter")
+        b.family(f"{px}_class_requests_total", "counter")
         for c in SLO_CLASSES:
             for outcome in ("submitted", "completed", "shed"):
-                lines.append(
-                    f'{px}_class_requests_total{{slo="{c}",'
-                    f'outcome="{outcome}"}} {s["classes"][c][outcome]}')
-        lines.append(f"# TYPE {px}_class_ttft_ms summary")
+                b.sample(f"{px}_class_requests_total",
+                         s["classes"][c][outcome],
+                         {"slo": c, "outcome": outcome})
+        b.family(f"{px}_class_ttft_ms", "summary")
         for c in SLO_CLASSES:
-            v = s[f"ttft_p99_ms_{c}"]
-            lines.append(f'{px}_class_ttft_ms{{slo="{c}",quantile="0.99"}} '
-                         f"{'NaN' if v is None else round(v, 3)}")
-        lines += [
-            f"# TYPE {px}_shed_total counter",
-            f"{px}_shed_total {s['shed']}",
-            f"# TYPE {px}_quarantined_total counter",
-            f"{px}_quarantined_total {s['quarantined']}",
-            f"# TYPE {px}_brownout gauge",
-            f"{px}_brownout {int(s['brownout'])}",
-            f"# TYPE {px}_brownout_entries_total counter",
-            f"{px}_brownout_entries_total {s['brownout_entries']}",
-            f"# TYPE {px}_inflight_tokens gauge",
-            f"{px}_inflight_tokens {s['inflight_tokens']}",
-            f"# TYPE {px}_kv_fragmentation gauge",
-            f"{px}_kv_fragmentation {round(s['kv_fragmentation'], 4)}",
-        ]
+            b.sample(f"{px}_class_ttft_ms", s[f"ttft_p99_ms_{c}"],
+                     {"slo": c, "quantile": "0.99"}, round_to=3)
+        b.family(f"{px}_shed_total", "counter")
+        b.sample(f"{px}_shed_total", s["shed"])
+        b.family(f"{px}_quarantined_total", "counter")
+        b.sample(f"{px}_quarantined_total", s["quarantined"])
+        b.family(f"{px}_brownout", "gauge")
+        b.sample(f"{px}_brownout", int(s["brownout"]))
+        b.family(f"{px}_brownout_entries_total", "counter")
+        b.sample(f"{px}_brownout_entries_total", s["brownout_entries"])
+        b.family(f"{px}_inflight_tokens", "gauge")
+        b.sample(f"{px}_inflight_tokens", s["inflight_tokens"])
+        b.family(f"{px}_kv_fragmentation", "gauge")
+        b.sample(f"{px}_kv_fragmentation", s["kv_fragmentation"], round_to=4)
         # ---- prefix cache + multi-tenancy families (ISSUE 8) ----
-        lines += [
-            f"# TYPE {px}_prefix_hits_total counter",
-            f"{px}_prefix_hits_total {s['prefix_hits']}",
-            f"# TYPE {px}_prefix_misses_total counter",
-            f"{px}_prefix_misses_total {s['prefix_misses']}",
-            f"# TYPE {px}_prefix_hit_tokens_total counter",
-            f"{px}_prefix_hit_tokens_total {s['prefix_hit_tokens']}",
-            f"# TYPE {px}_prefix_hit_rate gauge",
-            f"{px}_prefix_hit_rate {round(s['prefix_hit_rate'], 4)}",
-            f"# TYPE {px}_cached_blocks gauge",
-            f"{px}_cached_blocks {s['cached_blocks']}",
-            f"# TYPE {px}_cache_evictions_total counter",
-            f"{px}_cache_evictions_total {s['cache_evictions']}",
-        ]
+        b.family(f"{px}_prefix_hits_total", "counter")
+        b.sample(f"{px}_prefix_hits_total", s["prefix_hits"])
+        b.family(f"{px}_prefix_misses_total", "counter")
+        b.sample(f"{px}_prefix_misses_total", s["prefix_misses"])
+        b.family(f"{px}_prefix_hit_tokens_total", "counter")
+        b.sample(f"{px}_prefix_hit_tokens_total", s["prefix_hit_tokens"])
+        b.family(f"{px}_prefix_hit_rate", "gauge")
+        b.sample(f"{px}_prefix_hit_rate", s["prefix_hit_rate"], round_to=4)
+        b.family(f"{px}_cached_blocks", "gauge")
+        b.sample(f"{px}_cached_blocks", s["cached_blocks"])
+        b.family(f"{px}_cache_evictions_total", "counter")
+        b.sample(f"{px}_cache_evictions_total", s["cache_evictions"])
         if s["tenants"]:
-            lines.append(f"# TYPE {px}_tenant_requests_total counter")
+            b.family(f"{px}_tenant_requests_total", "counter")
             for tenant in sorted(s["tenants"]):
                 tv = s["tenants"][tenant]
                 for outcome in ("submitted", "completed", "rejected"):
-                    lines.append(
-                        f'{px}_tenant_requests_total{{tenant="{tenant}",'
-                        f'outcome="{outcome}"}} {tv[outcome]}')
+                    b.sample(f"{px}_tenant_requests_total", tv[outcome],
+                             {"tenant": tenant, "outcome": outcome})
             for fam, key, typ, rnd in (
                     ("tenant_cache_hit_rate", "cache_hit_rate", "gauge", 4),
                     ("tenant_cached_blocks", "cached_blocks", "gauge", None),
                     ("tenant_inflight_tokens", "inflight_tokens", "gauge",
                      None)):
-                lines.append(f"# TYPE {px}_{fam} {typ}")
+                b.family(f"{px}_{fam}", typ)
                 for tenant in sorted(s["tenants"]):
-                    v = s["tenants"][tenant][key]
-                    lines.append(
-                        f'{px}_{fam}{{tenant="{tenant}"}} '
-                        f"{round(v, rnd) if rnd else v}")
-        return "\n".join(lines) + "\n"
-
-
-def parse_exposition(text: str) -> Dict[str, float]:
-    """Inverse of render() for tests/tools: flat {metric{labels}: value}."""
-    out: Dict[str, float] = {}
-    for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        name, _, val = line.rpartition(" ")
-        try:
-            out[name] = float(val)
-        except ValueError:
-            continue
-    return out
+                    b.sample(f"{px}_{fam}", s["tenants"][tenant][key],
+                             {"tenant": tenant}, round_to=rnd)

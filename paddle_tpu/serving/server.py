@@ -22,6 +22,19 @@ ThreadingHTTPServer + BaseHTTPRequestHandler, whose hardened
                        503 {"status": "broken"} once an engine's circuit
                        breaker opens (ISSUE 6)
     GET  /metrics   -> 200 Prometheus text exposition (serving/metrics.py)
+    GET  /debug/requests        -> recently finished request ids (the
+                                   engines' bounded timeline LRUs)
+    GET  /debug/requests/<rid>  -> one finished request's structured
+                                   timeline (phases, marks, events)
+    GET  /debug/flightrecorder  -> the process-global black-box ring
+                                   (paddle_tpu.obs.flight_recorder)
+
+Request tracing (ISSUE 9): every /predict and /generate request gets a
+request id — ingested from a W3C `traceparent` header when present, else
+generated — echoed back as "rid" in the response body. Sending
+`X-PDTPU-Trace: 1` additionally records a structured timeline (admission
+-> queue wait -> prefill chunks -> decode -> finish) returned inline as
+"trace" and retrievable later from /debug/requests/<rid>.
 
 Backpressure (ISSUE 6): overload rejections — queue full, token budget
 exhausted, or the request itself shed for a higher class — map to HTTP
@@ -58,6 +71,8 @@ from typing import Optional
 import numpy as np
 
 from ..distributed.fleet.utils.http_server import read_request_body
+from ..obs.flight_recorder import flight_recorder
+from ..obs.trace import ingest_traceparent, new_request_id
 from .engine import (BatchingEngine, DeadlineExceededError, EngineConfig,
                      RejectedError)
 from .metrics import SLO_CLASSES
@@ -134,6 +149,15 @@ class ServingServer:
             def _reply_json(self, code: int, obj, headers=None):
                 self._reply(code, json.dumps(obj).encode(), headers=headers)
 
+            def _request_rid(self) -> str:
+                """Request id: W3C traceparent trace-id when the client
+                sent one (propagating an upstream trace), else fresh."""
+                return (ingest_traceparent(self.headers.get("traceparent"))
+                        or new_request_id())
+
+            def _trace_wanted(self) -> bool:
+                return self.headers.get("X-PDTPU-Trace", "").strip() == "1"
+
             def _reply_rejected(self, e: RejectedError):
                 """Overload -> 429 + Retry-After (back off and come back);
                 draining/broken/structural -> 503 (find another replica)."""
@@ -183,6 +207,24 @@ class ServingServer:
                                    if e is not None)
                     self._reply(200, text.encode(),
                                 ctype="text/plain; version=0.0.4")
+                elif self.path == "/debug/flightrecorder":
+                    self._reply_json(200, flight_recorder().snapshot())
+                elif self.path == "/debug/requests":
+                    ids = []
+                    for e in outer._engines():
+                        ids.extend(e.timelines.ids())
+                    self._reply_json(200, {"ids": ids})
+                elif self.path.startswith("/debug/requests/"):
+                    rid = self.path[len("/debug/requests/"):]
+                    for e in outer._engines():
+                        tl = e.timelines.get(rid)
+                        if tl is not None:
+                            self._reply_json(200, tl)
+                            return
+                    self._reply_json(
+                        404, {"error": f"no timeline for request {rid!r} "
+                              "(untraced, unfinished, or evicted from the "
+                              "bounded timeline buffer)"})
                 else:
                     self._reply_json(404, {"error": "not found"})
 
@@ -226,13 +268,15 @@ class ServingServer:
                 except (ValueError, KeyError, TypeError) as e:
                     self._reply_json(400, {"error": f"bad request: {e}"})
                     return
+                rid = self._request_rid()
+                traced = self._trace_wanted()
                 try:
                     handle = outer.llm_engine.submit(
                         prompt,
                         max_new_tokens=payload.get("max_new_tokens"),
                         eos_token_id=payload.get("eos_token_id"),
                         deadline_ms=payload.get("deadline_ms"),
-                        slo=slo, tenant=tenant)
+                        slo=slo, tenant=tenant, rid=rid, trace=traced)
                     toks = handle.result(timeout=outer.request_timeout_s)
                 except RejectedError as e:
                     self._reply_rejected(e)
@@ -244,10 +288,14 @@ class ServingServer:
                     self._reply_json(
                         500, {"error": f"{type(e).__name__}: {e}"})
                     return
-                self._reply_json(200, {
+                resp = {
                     "tokens": np.asarray(toks).tolist(),
                     "ttft_ms": handle.ttft_ms,
-                })
+                    "rid": rid,
+                }
+                if traced:
+                    resp["trace"] = handle.timeline()
+                self._reply_json(200, resp)
 
             def _predict(self, body: bytes):
                 try:
@@ -256,9 +304,12 @@ class ServingServer:
                 except (ValueError, KeyError, TypeError) as e:
                     self._reply_json(400, {"error": f"bad request: {e}"})
                     return
+                rid = self._request_rid()
+                traced = self._trace_wanted()
                 try:
                     fut = outer.engine.submit(
-                        arrays, deadline_ms=payload.get("deadline_ms"))
+                        arrays, deadline_ms=payload.get("deadline_ms"),
+                        rid=rid, trace=traced)
                     outs = fut.result(timeout=outer.request_timeout_s)
                 except RejectedError as e:
                     self._reply_rejected(e)
@@ -270,8 +321,15 @@ class ServingServer:
                     self._reply_json(
                         500, {"error": f"{type(e).__name__}: {e}"})
                     return
-                self._reply_json(200, {
-                    "outputs": [np.asarray(o).tolist() for o in outs]})
+                resp = {
+                    "outputs": [np.asarray(o).tolist() for o in outs],
+                    "rid": rid,
+                }
+                if traced:
+                    # the engine publishes the timeline before resolving
+                    # the future, so it is visible here
+                    resp["trace"] = outer.engine.timelines.get(rid)
+                self._reply_json(200, resp)
 
         # socket-level cap so a stalled client can't pin a handler thread
         # past the drain settle window
@@ -371,6 +429,12 @@ class ServingServer:
         preemption path."""
         if install_signal_handlers:
             def _on_term(signum, frame):
+                # black-box dump FIRST: if the drain wedges and the
+                # supervisor escalates to SIGKILL, the postmortem still
+                # has everything up to the signal
+                fr = flight_recorder()
+                fr.record("sigterm", signum=int(signum))
+                fr.try_dump(reason="sigterm")
                 # drain from a helper thread: shutdown() would deadlock if
                 # called on the main thread blocked inside serve_forever
                 threading.Thread(target=self.stop, daemon=True,

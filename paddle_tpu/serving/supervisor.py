@@ -36,6 +36,7 @@ import logging
 import threading
 from typing import Callable, Dict, Optional
 
+from ..obs.flight_recorder import flight_recorder
 from ..utils.fault_injection import InjectedDispatchHang
 
 _log = logging.getLogger("paddle_tpu.serving")
@@ -103,6 +104,9 @@ class EngineSupervisor:
         except InjectedDispatchHang as e:
             with self._lock:
                 self.stats["watchdog_fires"] += 1
+            flight_recorder().record(
+                "dispatch_hang", engine=self.name, label=label,
+                seconds=e.seconds)
             budget = (f"{self.dispatch_timeout_s:.1f}s watchdog budget"
                       if self.dispatch_timeout_s is not None
                       else "no watchdog configured — a real hang would "
@@ -113,6 +117,9 @@ class EngineSupervisor:
         except Exception as e:
             with self._lock:
                 self.stats["dispatch_failures"] += 1
+            flight_recorder().record(
+                "dispatch_failure", engine=self.name, label=label,
+                error=f"{type(e).__name__}: {e}")
             raise DispatchFailedError(
                 f"{self.name} {label} dispatch failed: "
                 f"{type(e).__name__}: {e}") from e
@@ -138,6 +145,9 @@ class EngineSupervisor:
         if not done.wait(self.dispatch_timeout_s):
             with self._lock:
                 self.stats["watchdog_fires"] += 1
+            flight_recorder().record(
+                "dispatch_hang", engine=self.name, label=label,
+                seconds=self.dispatch_timeout_s)
             raise DispatchHungError(
                 f"{self.name} {label} dispatch exceeded the "
                 f"{self.dispatch_timeout_s:.1f}s watchdog budget; "
@@ -162,6 +172,12 @@ class EngineSupervisor:
                 "%s circuit breaker OPEN after %d consecutive engine-level "
                 "failures; engine stops admitting and should be drained",
                 self.name, self.breaker_threshold)
+            # black-box dump BEFORE the drain callback: the postmortem must
+            # capture the failure run-up even if the drain itself wedges
+            fr = flight_recorder()
+            fr.record("breaker_open", engine=self.name,
+                      threshold=self.breaker_threshold)
+            fr.try_dump(reason=f"breaker_open:{self.name}")
             if self.on_trip is not None:
                 try:
                     self.on_trip()
@@ -179,6 +195,7 @@ class EngineSupervisor:
         with self._lock:
             self.stats["quarantines"] += 1
             self._consecutive = 0
+        flight_recorder().record("breaker_absolved", engine=self.name)
 
     @property
     def open(self) -> bool:

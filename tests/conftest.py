@@ -88,6 +88,10 @@ def pytest_configure(config):
         "markers", "prefix: prefix-sharing radix KV cache + multi-tenant "
         "serving tests (serving/llm/prefix_cache.py, shared block pool, "
         "COW, tenant fairness); select with -m prefix")
+    config.addinivalue_line(
+        "markers", "obs: observability tests (request tracing, flight "
+        "recorder, prometheus exposition; paddle_tpu/obs/); select with "
+        "-m obs")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -106,3 +110,5 @@ def pytest_collection_modifyitems(config, items):
         if mod == "test_prefix_cache":
             item.add_marker(pytest.mark.prefix)
             item.add_marker(pytest.mark.llm)
+        if mod == "test_obs":
+            item.add_marker(pytest.mark.obs)

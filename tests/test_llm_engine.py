@@ -728,7 +728,8 @@ def test_sigterm_drains_llm_generate_and_exits_zero(tmp_path):
     from paddle_tpu import serving
 
     proc, port = _start_llm_worker(
-        tmp_path, {"LLM_SLOTS": "2", "LLM_MAX_NEW": "12"})
+        tmp_path, {"LLM_SLOTS": "2", "LLM_MAX_NEW": "12",
+                   "PDTPU_FLIGHT_DIR": str(tmp_path)})
     base = f"http://127.0.0.1:{port}"
     lock = threading.Lock()
     oks, rejected, conn_failed = [], [], []
@@ -784,3 +785,10 @@ def test_sigterm_drains_llm_generate_and_exits_zero(tmp_path):
     assert flat['pdtpu_llm_requests_total{outcome="submitted"}'] == len(oks)
     assert flat["pdtpu_llm_queue_depth"] == 0
     assert flat["pdtpu_llm_slots_active"] == 0
+
+    # ISSUE 9: the SIGTERM handler dumps the flight ring before draining
+    dump_path = tmp_path / f"pdtpu_flight_{proc.pid}.json"
+    assert dump_path.exists(), "SIGTERM handler must dump the flight ring"
+    dump = json.loads(dump_path.read_text())
+    assert dump["reason"] == "sigterm"
+    assert any(e["kind"] == "sigterm" for e in dump["events"])

@@ -244,7 +244,7 @@ def test_fault_events_reach_callbacks_and_profiler(tmp_path):
     try:
         t.run(lambda i: i, num_steps=3)
     finally:
-        profiler._P.enabled = False
+        profiler._SINK.enabled = False
     assert ("bad_loss", 1) in seen and ("skip", 1) in seen
     names = [e["name"] for e in profiler.get_events()]
     assert "resilient/bad_loss" in names

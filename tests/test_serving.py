@@ -554,7 +554,8 @@ def test_sigterm_drains_accepted_requests_and_exits_zero(tmp_path):
     from paddle_tpu import serving
 
     proc, port = _start_serving_worker(
-        tmp_path, {"SERVE_DISPATCH_SLEEP_S": "0.05", "SERVE_MAX_BATCH": "4"})
+        tmp_path, {"SERVE_DISPATCH_SLEEP_S": "0.05", "SERVE_MAX_BATCH": "4",
+                   "PDTPU_FLIGHT_DIR": str(tmp_path)})
     base = f"http://127.0.0.1:{port}"
     W = np.random.RandomState(0).randn(3, 2).astype(np.float32)
 
@@ -612,6 +613,19 @@ def test_sigterm_drains_accepted_requests_and_exits_zero(tmp_path):
     assert flat['pdtpu_serving_requests_total{outcome="submitted"}'] == \
         len(oks)  # accepted == answered; nothing pending at exit
     assert flat["pdtpu_serving_queue_depth"] == 0
+
+    # ISSUE 9: SIGTERM must leave a black-box dump before the drain starts
+    # (so a wedged drain + supervisor SIGKILL still leaves evidence)
+    dump_path = tmp_path / f"pdtpu_flight_{proc.pid}.json"
+    assert dump_path.exists(), "SIGTERM handler must dump the flight ring"
+    dump = json.loads(dump_path.read_text())
+    assert dump["reason"] == "sigterm"
+    assert any(e["kind"] == "sigterm" for e in dump["events"])
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "flight_recorder.py"),
+         str(dump_path)], capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    assert "sigterm" in r.stdout
 
 
 # ---- engine supervision: watchdog + circuit breaker (ISSUE 6) ----

@@ -10,6 +10,13 @@ file with direction-aware keys: `serve_qps` is a floor (throughput must not
 drop) and `serve_p99_ms` is a CEILING (tail latency must not grow) —
 `--update` only ever tightens in the favorable direction for each.
 
+Provenance (ISSUE 9): bench rows embed `extra.provenance` (platform,
+device kind, git sha, timestamp). `--update` pins the platform/device
+kind alongside the floors (underscore keys, ignored by gating math); a
+later run on a DIFFERENT platform refuses to compare those presets — a
+CPU fallback number must never silently gate against a TPU pin. The
+refusal is a warning by default and a failure (exit 3) under --strict.
+
     python tools/check_bench_result.py                 # gate current sweep
     python tools/check_bench_result.py --update        # raise floors to best
     python tools/check_bench_result.py --new f.json --max-regress 0.05
@@ -126,7 +133,10 @@ def _tag_aliases():
 
 
 def best_by_preset(rows):
-    """{preset: {key: best value}} — best per key in its own direction."""
+    """{preset: {key: best value}} — best per key in its own direction.
+    Rows carrying `extra.provenance` contribute `_platform` /
+    `_device_kind` underscore keys (provenance metadata, never gated as
+    metrics)."""
     best = {}
     for r in rows:
         if not _is_chip_row(r):
@@ -134,10 +144,18 @@ def best_by_preset(rows):
         p = _preset_of(r)
         if not p:
             continue
-        for k, v in _metrics_of(r).items():
-            cur = best.setdefault(p, {})
+        mets = _metrics_of(r)
+        if not mets:
+            continue
+        cur = best.setdefault(p, {})
+        for k, v in mets.items():
             if k not in cur or _better(k, v, cur[k]):
                 cur[k] = v
+        prov = (r.get("extra") or {}).get("provenance") or {}
+        if prov.get("platform"):
+            cur.setdefault("_platform", prov["platform"])
+        if prov.get("device_kind"):
+            cur.setdefault("_device_kind", prov["device_kind"])
     return {p: vals for p, vals in best.items() if vals}
 
 
@@ -164,6 +182,9 @@ def main(argv=None):
     if args.update:
         for p, vals in measured.items():
             for k, v in vals.items():
+                if k.startswith("_"):  # provenance metadata: pin verbatim
+                    floors.setdefault(p, {})[k] = v
+                    continue
                 cur = floors.get(p, {}).get(k)
                 if cur is None or _better(k, v, cur):
                     floors.setdefault(p, {})[k] = round(v, 4)
@@ -184,9 +205,24 @@ def main(argv=None):
 
     failures = []
     unmapped = []
+    mismatched = []
     for p, vals in sorted(measured.items()):
+        # provenance guard: numbers measured on a different platform than
+        # the pinned floor are not comparable — refuse rather than gate a
+        # CPU-fallback row against a TPU pin (or vice versa)
+        pin_plat = floors.get(p, {}).get("_platform")
+        meas_plat = vals.get("_platform")
+        if pin_plat and meas_plat and pin_plat != meas_plat:
+            mismatched.append(p)
+            print(f"WARNING: {p!r} was measured on platform "
+                  f"{meas_plat!r} but its floors are pinned from "
+                  f"{pin_plat!r}; refusing to compare (re-pin with "
+                  "--update on the target platform)", file=sys.stderr)
+            continue
         gated_any = False
         for k, m in sorted(vals.items()):
+            if k.startswith("_"):   # provenance metadata, not a metric
+                continue
             floor = floors.get(p, {}).get(k)
             if floor is None and k == "mfu" and p.endswith("-chunked"):
                 # scan fusion must never be slower than the eager floor: a
@@ -220,7 +256,7 @@ def main(argv=None):
                       file=sys.stderr)
             else:
                 stats = " ".join(f"{k} {m:.4f}" for k, m in sorted(
-                    vals.items()))
+                    vals.items()) if not k.startswith("_"))
                 print(f"  {p:28s} {stats}  (no pinned floor - pass)")
     if failures:
         print(f"FAILED: {len(failures)} metric(s) regressed beyond "
@@ -228,9 +264,16 @@ def main(argv=None):
               ", ".join(f"{p}.{k} {m:.4f} vs {f0:.4f}"
                         for p, k, m, f0 in failures))
         return 2
-    if unmapped and args.strict:
-        print(f"FAILED (--strict): {len(unmapped)} measured key(s) gate "
-              f"nothing: {', '.join(unmapped)}")
+    if args.strict and (unmapped or mismatched):
+        parts = []
+        if unmapped:
+            parts.append(f"{len(unmapped)} measured key(s) gate nothing: "
+                         f"{', '.join(unmapped)}")
+        if mismatched:
+            parts.append(f"{len(mismatched)} preset(s) measured on a "
+                         "different platform than their pinned floors: "
+                         f"{', '.join(mismatched)}")
+        print("FAILED (--strict): " + "; ".join(parts))
         return 3
     print("bench gate passed")
     return 0

@@ -25,7 +25,12 @@ corrupting its siblings' shared KV — later requests still attach the
 same blocks bit-identically — and eviction under slot pressure never
 reclaims a cached block with live readers; the block ledger
 `blocks_allocated == blocks_freed + blocks_active + blocks_cached`
-balances after every scenario) — then
+balances after every scenario), and the ISSUE 9 flight-recorder
+scenario in tests/test_obs.py (`obs`-marked module: a breaker-open
+cascade produces an atomic black-box dump that names the quarantined
+request id and carries the blame sequence retry → solo probe →
+quarantine → breaker-open in recorded order, readable by
+tools/flight_recorder.py) — then
 prints a pass/fail table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
@@ -50,6 +55,7 @@ TEST_FILES = [
     os.path.join("tests", "test_llm_engine.py"),
     os.path.join("tests", "test_paged_attention.py"),
     os.path.join("tests", "test_prefix_cache.py"),
+    os.path.join("tests", "test_obs.py"),
 ]
 
 

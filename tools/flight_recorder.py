@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Postmortem viewer for paddle_tpu flight-recorder dumps (ISSUE 9).
+
+The serving engines, supervisor, and ResilientTrainer feed a
+process-global black-box ring (paddle_tpu.obs.flight_recorder) that is
+dumped atomically on breaker-open, SIGTERM, preemption, and scheduler
+pump crashes. This tool turns a dump into a human-readable incident
+timeline, or merges it onto an exported chrome trace so the black-box
+events land on the same timeline as the profiler spans:
+
+    python tools/flight_recorder.py dump.json            # postmortem table
+    python tools/flight_recorder.py dump.json --json     # raw snapshot
+    python tools/flight_recorder.py dump.json \
+        --merge trace.json -o merged.json                # chrome overlay
+    python tools/flight_recorder.py dump.json --kind quarantine --kind reject
+
+Exit 0 on success, 2 on an unreadable/invalid dump.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def load_dump(path: str) -> dict:
+    """Read + validate one dump. Raises ValueError on a non-dump file."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "events" not in data \
+            or "version" not in data:
+        raise ValueError(
+            f"{path} is not a flight-recorder dump (missing "
+            "'version'/'events')")
+    return data
+
+
+def _fmt_info(e: dict) -> str:
+    skip = {"kind", "seq", "t_mono", "t_wall"}
+    return " ".join(f"{k}={e[k]}" for k in e if k not in skip)
+
+
+def render_postmortem(dump: dict, kinds: Optional[List[str]] = None) -> str:
+    """Human-readable incident timeline. Times are relative to the first
+    recorded event (the monotonic clock's absolute origin is arbitrary)."""
+    events = dump.get("events", [])
+    if kinds:
+        want = set(kinds)
+        events = [e for e in events if e.get("kind") in want]
+    lines = [
+        f"flight recorder dump: reason={dump.get('reason', '?')} "
+        f"pid={dump.get('pid', '?')} recorded={dump.get('recorded', '?')} "
+        f"dropped={dump.get('dropped', 0)} shown={len(events)}",
+    ]
+    t0 = events[0]["t_mono"] if events else 0.0
+    for e in events:
+        lines.append(
+            f"  [{e.get('seq', '?'):>5}] +{e['t_mono'] - t0:10.3f}s "
+            f"{e.get('kind', '?'):24s} {_fmt_info(e)}")
+    if not events:
+        lines.append("  (no events)")
+    return "\n".join(lines)
+
+
+def merge_chrome(dump: dict, trace_path: str, out_path: str) -> int:
+    """Append the dump's events as chrome instants onto an exported
+    profiler trace (profiler.export_chrome_tracing format), so request
+    spans, step spans, and black-box fault markers share one timeline.
+    Instants are placed on the flight recorder's monotonic clock, which
+    is the engines' clock base (CLOCK_MONOTONIC) — same base RequestTrace
+    spans use."""
+    with open(trace_path) as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    added = 0
+    for e in dump.get("events", []):
+        events.append({
+            "name": f"flight/{e.get('kind', '?')}",
+            "ph": "i", "s": "p", "pid": 0, "tid": 0,
+            "ts": e["t_mono"] * 1e6,
+            "args": {k: v for k, v in e.items()
+                     if k not in ("kind", "t_mono")},
+        })
+        added += 1
+    trace["traceEvents"] = events
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    return added
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dump", help="flight-recorder dump (json)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw snapshot instead of the table")
+    ap.add_argument("--kind", action="append", default=None,
+                    help="only show events of this kind (repeatable)")
+    ap.add_argument("--merge", metavar="TRACE",
+                    help="chrome trace to overlay the dump onto")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path for --merge (default: TRACE.merged)")
+    args = ap.parse_args(argv)
+    try:
+        dump = load_dump(args.dump)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.merge:
+        out = args.out or args.merge + ".merged"
+        try:
+            added = merge_chrome(dump, args.merge, out)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(f"merged {added} flight events onto {args.merge} -> {out}")
+        return 0
+    if args.json:
+        print(json.dumps(dump, indent=2, sort_keys=True))
+        return 0
+    print(render_postmortem(dump, kinds=args.kind))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
