@@ -17,28 +17,13 @@ import traceback
 
 import numpy as np
 
-# per-chip peak bf16 FLOP/s by device_kind substring (longest match wins)
-_PEAK_BF16 = {
-    "v5 lite": 197e12,
-    "v5litepod": 197e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6 lite": 918e12,
-    "v6e": 918e12,
-    "v4": 275e12,
-    "v3": 123e12,
-    "v2": 45e12,
-}
-
-
 def _peak_flops(device_kind: str, backend: str) -> float:
-    if backend == "cpu":
-        return 1e12  # nominal: CPU numbers are sanity-only, not MFU claims
-    kind = device_kind.lower()
-    for key in sorted(_PEAK_BF16, key=len, reverse=True):
-        if key in kind:
-            return _PEAK_BF16[key]
-    return 197e12  # unknown TPU: assume the smallest current chip
+    """Per-chip peak bf16 FLOP/s — delegated to the shared accounting in
+    paddle_tpu.obs.flops (ISSUE 10) so bench-reported and live MFU use
+    one peak table. Lazy import: an error JSON line must still be
+    emittable when the package fails to import."""
+    from paddle_tpu.obs.flops import peak_flops
+    return peak_flops(device_kind, backend)
 
 
 def _provenance() -> dict:
@@ -213,29 +198,22 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
     losses = step(ids_chunk, labels_chunk)
     _ = float(np.asarray(losses.data)[-1])  # forced host read: tunnel barrier
 
-    # force a host read of the final loss: on the tunneled axon backend
-    # block_until_ready alone does not guarantee execution completed
-    t0 = time.perf_counter()
-    losses = step(ids_chunk, labels_chunk)
-    final_loss = float(np.asarray(losses.data)[-1])
-    dt = (time.perf_counter() - t0) / iters
-
     n_chips = jax.device_count()
     unit_name = "images" if preset == "resnet50" else "tokens"
     tokens_per_step = B if preset == "resnet50" else B * S
-    tokens_per_sec_chip = tokens_per_step / dt / n_chips
+    device_kind = jax.devices()[0].device_kind
+    peak = _peak_flops(device_kind, backend)
 
-    # MFU: 6 * params * tokens FLOPs (fwd+bwd) vs the chip's actual peak.
-    # MoE models count ACTIVE params: each token runs top_k of E experts,
-    # so expert weights contribute top_k/E of their size (6ND would
-    # otherwise overstate the work and inflate MFU). Conv models use the
-    # measured fwd MACs x2 (MAC->FLOP) x3 (fwd + ~2x bwd) per image.
+    # MFU: 6 * params * tokens FLOPs (fwd+bwd) vs the chip's actual peak,
+    # via the SHARED accounting (paddle_tpu.obs.flops, ISSUE 10) — the
+    # same helpers the live MFU gauge uses, so the two cannot diverge by
+    # formula. MoE models count ACTIVE params; conv models use measured
+    # fwd MACs x2 (MAC->FLOP) x3 (fwd + ~2x bwd) per image.
+    from paddle_tpu.obs import flops as flops_acct
     n_params = sum(int(np.prod(p.shape)) for p in params.values())
     moe_E = getattr(cfg, "moe_num_experts", 0) if cfg is not None else 0
     if preset == "resnet50":
-        # paddle.flops counts MACs (one multiply-add = 1); true FLOPs are
-        # 2x that, and fwd+bwd ~ 3x the forward
-        flops_per_step = 3.0 * (2.0 * fwd_flops) * B
+        flops_per_step = flops_acct.conv_train_flops_per_step(fwd_flops, B)
     elif moe_E:
         top_k = getattr(cfg, "moe_top_k", 2)
         # expert params come from the MoELayer module structure (all its
@@ -250,13 +228,38 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
                         expert_keys.add(pname)
         expert = sum(int(np.prod(p.shape)) for k, p in params.items()
                      if k in expert_keys)
-        n_active = n_params - expert + expert * top_k // moe_E
-        flops_per_step = 6.0 * n_active * tokens_per_step
+        flops_per_step = flops_acct.train_flops_per_step(
+            n_params, tokens_per_step, expert_params=expert,
+            moe_top_k=top_k, moe_num_experts=moe_E)
     else:
-        flops_per_step = 6.0 * n_params * tokens_per_step
+        flops_per_step = flops_acct.train_flops_per_step(
+            n_params, tokens_per_step)
+
+    # Goodput ledger over the timed region (ISSUE 10): warmup compiles are
+    # behind us (mark_warm), so any further compile counts as a recompile;
+    # the ledger's live MFU must agree with the offline number below
+    # because both divide the same flops_per_step by the same peak.
+    from paddle_tpu.obs.goodput import GoodputLedger, RecompileSentinel
+    ledger = GoodputLedger()
+    sentinel = RecompileSentinel(ledger).install()
+    sentinel.mark_warm()
+    step.ledger = ledger  # caller-thread H2D staging books as h2d
+    ledger.set_flops(flops_per_step, peak * n_chips)
+    ledger.start()
+
+    # force a host read of the final loss: on the tunneled axon backend
+    # block_until_ready alone does not guarantee execution completed
+    t0 = time.perf_counter()
+    with ledger.measure("compute"):
+        losses = step(ids_chunk, labels_chunk)
+        final_loss = float(np.asarray(losses.data)[-1])
+    ledger.add_steps(iters)
+    dt = (time.perf_counter() - t0) / iters
+    goodput_snap = ledger.snapshot()
+    sentinel.uninstall()
+
+    tokens_per_sec_chip = tokens_per_step / dt / n_chips
     achieved = flops_per_step / dt / n_chips
-    device_kind = jax.devices()[0].device_kind
-    peak = _peak_flops(device_kind, backend)
     mfu = achieved / peak
 
     result = {
@@ -279,6 +282,15 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
             "moment_dtype": moment_dtype,
             "scan_steps": iters,
             "dispatches": step.dispatch_count,
+            # ISSUE 10 live-telemetry rows (gated as floors; TPU-only via
+            # the provenance platform pinning)
+            "train_goodput": round(goodput_snap["goodput"], 4),
+            "train_mfu_live": (round(goodput_snap["mfu"], 4)
+                               if goodput_snap["mfu"] is not None else None),
+            "train_recompiles": sentinel.recompiles,
+            "train_phase_seconds": {
+                k: round(v, 4)
+                for k, v in goodput_snap["phase_seconds"].items()},
             "flash_block_q": os.environ.get(
                 "FLAGS_flash_block_q", str(_default_blocks()[0])),
             "flash_block_k": os.environ.get(
@@ -335,7 +347,8 @@ def _run_decode_bench(jax, jnp, backend, on_tpu, preset, init_err):
     tok_s = toks / dt / n_chips
     device_kind = jax.devices()[0].device_kind
     peak = _peak_flops(device_kind, backend)
-    mfu = 2.0 * n_params * toks / dt / n_chips / peak
+    from paddle_tpu.obs.flops import decode_flops_per_token
+    mfu = decode_flops_per_token(n_params) * toks / dt / n_chips / peak
     result = {
         "metric": f"decode tokens/sec/chip {base} bs{B} prompt{S0} "
                   f"new{new} {'bf16' if on_tpu else 'fp32-cpu'} kv-cache",

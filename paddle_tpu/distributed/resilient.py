@@ -40,6 +40,8 @@ import numpy as np
 
 from ..checkpoint import CheckpointManager
 from ..obs.flight_recorder import DUMP_DIR_ENV, flight_recorder
+from ..obs.goodput import (GoodputLedger, HBMTelemetry, RecompileSentinel,
+                           oom_forensics)
 from ..obs.prom import MetricsServer, TrainingMetrics
 from ..profiler import RecordEvent, record_instant
 from ..utils import fault_injection
@@ -175,7 +177,8 @@ class ResilientTrainer:
                  fault_plan: Optional[fault_injection.FaultPlan] = None,
                  callbacks: Optional[List] = None,
                  use_orbax: bool = True,
-                 metrics_port: Optional[int] = None):
+                 metrics_port: Optional[int] = None,
+                 goodput: bool = False):
         self.worker = DeviceWorker(train_fn, print_period=0)
         if isinstance(checkpoint, CheckpointManager):
             self.ckpt = checkpoint
@@ -189,9 +192,25 @@ class ResilientTrainer:
         self.callbacks = callbacks or []
         self.events: List[Dict[str, Any]] = []
         self._preempt_signal: Optional[int] = None
+        # goodput=True arms the wall-clock ledger + recompile sentinel +
+        # HBM gauges (ISSUE 10). Disabled (the default) every hook below
+        # and in DeviceWorker/ScanTrainStep/ChunkPrefetcher stays at
+        # exactly one `is not None` predicate.
+        self.ledger: Optional[GoodputLedger] = None
+        self.sentinel: Optional[RecompileSentinel] = None
+        self.hbm: Optional[HBMTelemetry] = None
+        if goodput:
+            self.ledger = GoodputLedger()
+            self.sentinel = RecompileSentinel(self.ledger).install()
+            self.hbm = HBMTelemetry()
+            self.worker.ledger = self.ledger
+            if hasattr(train_fn, "ledger"):  # ScanTrainStep h2d staging
+                train_fn.ledger = self.ledger
         # pdtpu_train_* exporter: throughput gauges read the worker's
         # tracker, counters are fed from _event / the checkpoint sites
-        self.metrics = TrainingMetrics(tracker=self.worker.throughput)
+        self.metrics = TrainingMetrics(tracker=self.worker.throughput,
+                                       ledger=self.ledger, hbm=self.hbm,
+                                       sentinel=self.sentinel)
         env_port = os.environ.get("PDTPU_METRICS_PORT")
         if metrics_port is None and env_port:
             metrics_port = int(env_port)
@@ -243,8 +262,13 @@ class ResilientTrainer:
     def _preempt_exit(self, completed: int):
         """Final synchronous save + resumable marker, then exit 143."""
         with RecordEvent("resilient/preempt_save"):
-            self.ckpt.save(completed, self.get_state(), force=True)
-            self.ckpt.wait_until_finished()
+            if self.ledger is not None:
+                with self.ledger.measure("checkpoint"):
+                    self.ckpt.save(completed, self.get_state(), force=True)
+                    self.ckpt.wait_until_finished()
+            else:
+                self.ckpt.save(completed, self.get_state(), force=True)
+                self.ckpt.wait_until_finished()
         self._on_checkpoint_save(completed)
         marker = os.path.join(self.ckpt.directory, PREEMPT_MARKER)
         with open(marker, "w") as f:
@@ -263,16 +287,24 @@ class ResilientTrainer:
         raise SystemExit(143)
 
     # ---- recovery actions ----
+    def _restore_latest(self):
+        latest = self.ckpt.latest_step()
+        restored = self.ckpt.restore(latest) if latest is not None else None
+        if restored is not None:
+            self.set_state(restored)
+        return latest
+
     def _rollback(self, state: Dict[str, int]) -> int:
         state["rollbacks"] += 1
         if state["rollbacks"] > self.config.max_rollbacks:
             raise UnrecoverableError(
                 f"rollback budget exhausted ({self.config.max_rollbacks}); "
                 "aborting")
-        latest = self.ckpt.latest_step()
-        restored = self.ckpt.restore(latest) if latest is not None else None
-        if restored is not None:
-            self.set_state(restored)
+        if self.ledger is not None:
+            with self.ledger.measure("checkpoint"):
+                latest = self._restore_latest()
+        else:
+            latest = self._restore_latest()
         target = latest if latest is not None else 0
         self._event("rollback", target, rollbacks=state["rollbacks"])
         state["skips"] = 0
@@ -314,6 +346,10 @@ class ResilientTrainer:
                 watchdog = _Watchdog(self.config.watchdog_timeout, _fire)
                 watchdog.start()
 
+        if self.ledger is not None:
+            self.ledger.start()  # wall clock covers the whole run() call
+            self.sentinel.install()  # no-op when already observing
+
         # resume from the latest valid checkpoint
         completed = self.ckpt.latest_step() or 0
         if completed % n:
@@ -323,7 +359,11 @@ class ResilientTrainer:
                 "eager run?); resume with the same chunking it was "
                 "saved under")
         if completed:
-            restored = self.ckpt.restore(completed)
+            if self.ledger is not None:
+                with self.ledger.measure("checkpoint"):
+                    restored = self.ckpt.restore(completed)
+            else:
+                restored = self.ckpt.restore(completed)
             if restored is not None:
                 self.set_state(restored)
             self._event("resumed", completed)
@@ -334,6 +374,10 @@ class ResilientTrainer:
         esc = {"skips": 0, "rollbacks": 0}
         retries_total = 0
         last_loss = None
+        # highest step index ever completed this run: re-running a chunk
+        # below the watermark after a rollback is rollback_waste, not
+        # productive compute
+        watermark = completed
         try:
             step = completed
             while step < num_steps:
@@ -354,7 +398,18 @@ class ResilientTrainer:
                         with RecordEvent("resilient/step"):
                             for s in range(step, step + n):
                                 self.plan.maybe_delay(s)
-                            loss = self.worker.run_step(batch_fn(step))
+                            if self.ledger is not None:
+                                # batch production (incl. a prefetcher's
+                                # blocking get) is data_wait; device time
+                                # below the watermark is rollback waste
+                                with self.ledger.measure("data_wait"):
+                                    batch = batch_fn(step)
+                                self.worker.ledger_phase = (
+                                    "rollback_waste"
+                                    if step + n <= watermark else "compute")
+                            else:
+                                batch = batch_fn(step)
+                            loss = self.worker.run_step(batch)
                         if watchdog is not None:
                             watchdog.step_end()
                         loss = self.plan.corrupt_loss_vector(step, loss) \
@@ -367,6 +422,8 @@ class ResilientTrainer:
                             UnrecoverableError):
                         raise
                     except Exception as e:
+                        if self.hbm is not None:  # RESOURCE_EXHAUSTED dump
+                            oom_forensics(e, self.hbm)
                         self._event("step_error", step,
                                     error=f"{type(e).__name__}: {e}")
                     # transient failure: bounded backoff retry, then rollback
@@ -374,8 +431,13 @@ class ResilientTrainer:
                     if attempts <= self.config.max_step_retries:
                         retries_total += 1
                         self._event("retry", step, attempt=attempts)
-                        time.sleep(self.config.retry_backoff
+                        backoff = (self.config.retry_backoff
                                    * (2 ** (attempts - 1)))
+                        if self.ledger is not None:
+                            with self.ledger.measure("rollback_waste"):
+                                time.sleep(backoff)
+                        else:
+                            time.sleep(backoff)
                         continue
                     step = self._rollback(esc)
                     attempts = 0
@@ -421,21 +483,39 @@ class ResilientTrainer:
                 esc["skips"] = 0
                 last_loss = loss
                 step += n
+                watermark = max(watermark, step)
+                if self.sentinel is not None:
+                    # first clean step ends warmup: later compiles are
+                    # recompiles (idempotent flag set)
+                    self.sentinel.mark_warm()
                 si = self.config.save_interval
                 # first boundary at/past each save_interval multiple (for
                 # n == 1 this is exactly `step % si == 0`)
                 self.metrics.set_step(step)
                 if (step // si) > ((step - n) // si) or step == num_steps:
                     with RecordEvent("resilient/save"):
-                        self.ckpt.save(step, self.get_state())
+                        if self.ledger is not None:
+                            with self.ledger.measure("checkpoint"):
+                                self.ckpt.save(step, self.get_state())
+                        else:
+                            self.ckpt.save(step, self.get_state())
                     self._on_checkpoint_save(step)
             if self._preempt_signal is not None:
                 self._preempt_exit(step)
             self.ckpt.wait_until_finished()
-            return {"completed_steps": step, "last_loss": last_loss,
-                    "retries": retries_total, "rollbacks": esc["rollbacks"],
-                    "preempted": False, "events": list(self.events)}
+            summary = {"completed_steps": step, "last_loss": last_loss,
+                       "retries": retries_total,
+                       "rollbacks": esc["rollbacks"],
+                       "preempted": False, "events": list(self.events)}
+            if self.ledger is not None:
+                summary["goodput"] = self.ledger.snapshot()
+            return summary
         finally:
+            if self.sentinel is not None:
+                # detach from the process-global compile dispatcher so a
+                # finished trainer doesn't keep counting other runs'
+                # compiles; run() re-installs on re-entry (resume)
+                self.sentinel.uninstall()
             if watchdog is not None:
                 watchdog.stop()
             if old_usr1 is not None:

@@ -35,6 +35,12 @@ class DeviceWorker:
         self.scan_steps = int(getattr(train_fn, "scan_steps", 1) or 1)
         from ..profiler import ThroughputTracker
         self.throughput = ThroughputTracker()
+        # goodput ledger (obs.goodput.GoodputLedger) — None keeps every
+        # hook below at exactly one predicate. `ledger_phase` is what the
+        # NEXT dispatch's device time books as: the resilient trainer
+        # flips it to "rollback_waste" while re-running rolled-back steps
+        self.ledger = None
+        self.ledger_phase = "compute"
 
     def run_step(self, batch):
         """One step: unpack the batch, run the train fn, track the loss.
@@ -45,7 +51,13 @@ class DeviceWorker:
         args = batch if isinstance(batch, (tuple, list)) else (batch,)
         if self.scan_steps > 1:
             return self._run_chunk(args)
-        loss = self.train_fn(*args)
+        if self.ledger is not None:
+            with self.ledger.measure(self.ledger_phase):
+                loss = self.train_fn(*args)
+            self.ledger.add_steps(
+                1, productive=(self.ledger_phase == "compute"))
+        else:
+            loss = self.train_fn(*args)
         self.steps += 1
         self.last_loss = loss
         if self.print_period and self.steps % self.print_period == 0:
@@ -67,11 +79,19 @@ class DeviceWorker:
 
         import numpy as np
         t0 = time.perf_counter()
-        loss = self.train_fn(*args)
-        # materializing the loss vector blocks on the chunk, so the wall
-        # time below covers device compute, not just the dispatch
-        losses = np.atleast_1d(np.asarray(
-            loss.data if isinstance(loss, Tensor) else loss))
+        if self.ledger is not None:
+            with self.ledger.measure(self.ledger_phase):
+                loss = self.train_fn(*args)
+                # materializing the loss vector blocks on the chunk, so
+                # the booked span covers device compute, not dispatch
+                losses = np.atleast_1d(np.asarray(
+                    loss.data if isinstance(loss, Tensor) else loss))
+            self.ledger.add_steps(
+                losses.size, productive=(self.ledger_phase == "compute"))
+        else:
+            loss = self.train_fn(*args)
+            losses = np.atleast_1d(np.asarray(
+                loss.data if isinstance(loss, Tensor) else loss))
         self.throughput.update(steps=losses.size,
                                seconds=time.perf_counter() - t0,
                                tokens=self._chunk_tokens(args))

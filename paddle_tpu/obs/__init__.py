@@ -6,18 +6,31 @@
   lifecycle events, dumped atomically on breaker-open / SIGTERM /
   pump crash (postmortem CLI: tools/flight_recorder.py);
 - `prom` — shared Prometheus text-exposition plumbing + the
-  `pdtpu_train_*` training exporter and opt-in MetricsServer.
+  `pdtpu_train_*` training exporter and opt-in MetricsServer;
+- `goodput` (ISSUE 10) — the training goodput ledger (phase seconds
+  tile wall clock), live-MFU accounting, recompile sentinel, and HBM
+  telemetry / OOM forensics;
+- `flops` — the analytic FLOPs / peak-FLOPs helpers bench.py and the
+  live MFU gauge share.
 
 Stdlib-only and import-light: serving and training both depend on this
 package, never the other way around.
 """
 from .flight_recorder import DUMP_DIR_ENV, FlightRecorder, flight_recorder
+from .flops import (conv_train_flops_per_step, decode_flops_per_token,
+                    peak_flops, train_flops_per_step)
+from .goodput import (PHASES, GoodputLedger, HBMTelemetry, RecompileSentinel,
+                      oom_forensics)
 from .prom import MetricsServer, PromBuilder, TrainingMetrics, parse_exposition
 from .trace import (LLM_PHASES, SERVING_PHASES, RequestTrace, TimelineStore,
                     ingest_traceparent, new_request_id)
 
 __all__ = [
     "DUMP_DIR_ENV", "FlightRecorder", "flight_recorder",
+    "conv_train_flops_per_step", "decode_flops_per_token", "peak_flops",
+    "train_flops_per_step",
+    "PHASES", "GoodputLedger", "HBMTelemetry", "RecompileSentinel",
+    "oom_forensics",
     "MetricsServer", "PromBuilder", "TrainingMetrics", "parse_exposition",
     "LLM_PHASES", "SERVING_PHASES", "RequestTrace", "TimelineStore",
     "ingest_traceparent", "new_request_id",

@@ -1,0 +1,76 @@
+"""Analytic FLOPs / peak-FLOPs accounting (ISSUE 10 satellite).
+
+ONE source of truth for the model-FLOPs arithmetic that used to live
+inline in bench.py: the per-chip peak table, the 6ND train-step formula
+(with MoE active-param correction), the conv MAC→FLOP convention, and
+the 2ND decode formula. bench.py's offline MFU and the goodput ledger's
+live MFU (obs.goodput) both call these helpers, so the two numbers can
+never diverge by formula — only by what they measured.
+
+Stdlib-only: callers pass device_kind/backend strings and parameter
+counts; nothing here imports jax.
+"""
+from __future__ import annotations
+
+# per-chip peak bf16 FLOP/s by device_kind substring (longest match wins)
+PEAK_BF16 = {
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+# CPU runs are sanity-only, never MFU claims — a nominal 1 TFLOP/s keeps
+# the arithmetic defined without pretending to know the host's peak
+CPU_NOMINAL_FLOPS = 1e12
+
+# unknown TPU: assume the smallest current chip rather than refusing
+_UNKNOWN_TPU_FLOPS = 197e12
+
+
+def peak_flops(device_kind: str, backend: str) -> float:
+    """Per-chip peak bf16 FLOP/s for a jax device_kind/backend pair."""
+    if backend == "cpu":
+        return CPU_NOMINAL_FLOPS
+    kind = (device_kind or "").lower()
+    for key in sorted(PEAK_BF16, key=len, reverse=True):
+        if key in kind:
+            return PEAK_BF16[key]
+    return _UNKNOWN_TPU_FLOPS
+
+
+def train_flops_per_step(n_params: int, tokens_per_step: int,
+                         expert_params: int = 0, moe_top_k: int = 2,
+                         moe_num_experts: int = 0) -> float:
+    """6ND fwd+bwd FLOPs for one dense-transformer train step.
+
+    MoE models count ACTIVE params: each token runs top_k of E experts,
+    so expert weights contribute top_k/E of their size (plain 6ND would
+    overstate the work and inflate MFU). Pass expert_params (all MoE
+    expert weights, gate excluded) and the router config to apply the
+    correction; with moe_num_experts == 0 this is exactly 6ND.
+    """
+    n_active = int(n_params)
+    if moe_num_experts:
+        n_active = (n_params - expert_params
+                    + expert_params * moe_top_k // moe_num_experts)
+    return 6.0 * n_active * tokens_per_step
+
+
+def conv_train_flops_per_step(fwd_mac_flops: float, batch: int) -> float:
+    """Conv-net train-step FLOPs from measured forward MACs.
+
+    paddle.flops counts MACs (one multiply-add = 1); true FLOPs are 2x
+    that, and fwd+bwd ~ 3x the forward.
+    """
+    return 3.0 * (2.0 * float(fwd_mac_flops)) * batch
+
+
+def decode_flops_per_token(n_params: int) -> float:
+    """2N forward-only FLOPs per generated token (KV-cache decode)."""
+    return 2.0 * n_params

@@ -1,0 +1,419 @@
+"""Training goodput ledger (ISSUE 10): where did the wall clock go?
+
+Every second of trainer wall time is attributed to exactly ONE phase:
+
+- ``compute``        — productive device work (the fused chunk dispatch
+                       plus the blocking loss read);
+- ``rollback_waste`` — device work re-running steps a rollback already
+                       completed once, and retry-backoff sleeps;
+- ``data_wait``      — the consumer blocked on ChunkPrefetcher starvation
+                       (the producer thread's decode/stage work is NOT
+                       booked: overlapping it with compute is the point);
+- ``h2d``            — synchronous host→device staging on the caller
+                       thread (ScanTrainStep.__call__ without a
+                       prefetcher);
+- ``compile``        — XLA compilation, reported by the recompile
+                       sentinel and subtracted from the enclosing phase;
+- ``checkpoint``     — CheckpointManager save/restore;
+- ``idle``           — the residual: wall minus everything booked.
+
+The invariant — phase seconds tile measured wall clock — holds by
+construction: `measure()` frames nest on a per-thread stack and each
+books only its SELF time (span minus inner frames and inner `book()`
+charges), and `idle` is defined as the unbooked residual, clamped at
+zero. Tests reconcile the sum against wall clock within 1%
+(tests/test_goodput.py), mirroring ISSUE 9's span-tiling discipline.
+
+On top of the ledger:
+
+- **live MFU** — `flops_per_step x productive_steps / wall / peak`,
+  with the FLOPs arithmetic imported from obs.flops — the SAME helpers
+  bench.py uses, so live and offline MFU can only differ by measurement;
+- **RecompileSentinel** — counts XLA compilations (jax.monitoring's
+  ``/jax/core/compile/backend_compile_duration`` where available,
+  JitLRUCache miss hooks otherwise), books compile time as
+  non-productive, and treats any compilation after ``mark_warm()`` as a
+  recompile: each drops a ``train_recompile`` flight-recorder event and
+  a storm (>= storm_threshold recompiles) logs a warning;
+- **HBMTelemetry** — ``device.memory_stats()`` watermark gauges with
+  params/opt-state/KV-slab attribution, and ``oom_forensics`` which
+  turns a RESOURCE_EXHAUSTED failure into a ``train_oom`` flight event
+  plus an atomic black-box dump.
+
+Cost discipline (the PR 9 contract): a trainer built without the ledger
+pays exactly one predicate per hook (`if ledger is not None:`) — no
+clock read, no allocation, no lock.
+
+Module import stays stdlib-only; jax and paddle_tpu.utils are imported
+lazily inside ``RecompileSentinel.install`` / the default HBM stats fn.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .flight_recorder import flight_recorder
+
+_log = logging.getLogger("paddle_tpu.goodput")
+
+# attribution order is the chrome-trace lane order
+PHASES = ("compute", "rollback_waste", "data_wait", "h2d", "compile",
+          "checkpoint", "idle")
+
+# the jax.monitoring event that fires once per XLA backend compile
+# (cache hits do not fire it)
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class GoodputLedger:
+    """Exclusive phase attribution over trainer wall clock.
+
+    `measure(phase)` frames nest on a per-thread stack; a frame books
+    its span MINUS the time inner frames (and inner `book()` charges)
+    already claimed, so nested hooks never double-count. `book(phase,
+    secs)` attributes time reported from callbacks (compile durations)
+    and charges it against the enclosing frame the same way. The clock
+    is injectable for deterministic tests.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._t0: Optional[float] = None
+        self._phase_seconds: Dict[str, float] = {
+            p: 0.0 for p in PHASES if p != "idle"}
+        self.productive_steps = 0
+        self.wasted_steps = 0
+        self.flops_per_step: Optional[float] = None
+        self.peak_flops_total: Optional[float] = None
+        self._tls = threading.local()
+
+    # ---- lifecycle ----
+    def start(self):
+        """Arm the wall clock; idempotent (first measure/book auto-arms)."""
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = self._clock()
+
+    def set_flops(self, flops_per_step: float, peak_flops_total: float):
+        """Register the analytic FLOPs (obs.flops helpers) and the mesh's
+        total peak so snapshot() can report live MFU."""
+        self.flops_per_step = float(flops_per_step)
+        self.peak_flops_total = float(peak_flops_total)
+
+    # ---- attribution ----
+    def _stack(self) -> List[list]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    @contextlib.contextmanager
+    def measure(self, phase: str):
+        """Attribute the enclosed span's SELF time to `phase`."""
+        self.start()
+        stack = self._stack()
+        frame = [phase, self._clock(), 0.0]  # [phase, t_in, inner_seconds]
+        stack.append(frame)
+        try:
+            yield self
+        finally:
+            stack.pop()
+            t_out = self._clock()
+            span = t_out - frame[1]
+            with self._lock:
+                self._phase_seconds[phase] += max(span - frame[2], 0.0)
+            if stack:  # the whole span is inner time for the parent
+                stack[-1][2] += span
+            _emit_chrome_span(phase, frame[1], t_out)
+
+    def book(self, phase: str, seconds: float):
+        """Attribute externally-measured seconds (e.g. a compile duration
+        reported by jax.monitoring while a compute measure is open); the
+        enclosing frame's self time shrinks by the same amount."""
+        seconds = max(float(seconds), 0.0)
+        self.start()
+        with self._lock:
+            self._phase_seconds[phase] += seconds
+        stack = getattr(self._tls, "stack", None)
+        if stack:
+            stack[-1][2] += seconds
+
+    def add_steps(self, k: int, productive: bool = True):
+        """Count optimizer steps; re-run steps after a rollback are waste."""
+        with self._lock:
+            if productive:
+                self.productive_steps += int(k)
+            else:
+                self.wasted_steps += int(k)
+
+    # ---- reporting ----
+    def snapshot(self) -> dict:
+        """Point-in-time view: wall, per-phase seconds (idle = residual),
+        goodput = compute/wall, and live MFU when FLOPs are registered."""
+        now = self._clock()
+        with self._lock:
+            phases = dict(self._phase_seconds)
+            t0 = self._t0
+            productive = self.productive_steps
+            wasted = self.wasted_steps
+        wall = (now - t0) if t0 is not None else 0.0
+        booked = sum(phases.values())
+        phases["idle"] = max(wall - booked, 0.0)
+        goodput = phases["compute"] / wall if wall > 0 else 0.0
+        mfu = None
+        if (self.flops_per_step and self.peak_flops_total and wall > 0
+                and productive):
+            mfu = (self.flops_per_step * productive
+                   / wall / self.peak_flops_total)
+        return {
+            "wall_seconds": wall,
+            "phase_seconds": phases,
+            "goodput": goodput,
+            "mfu": mfu,
+            "productive_steps": productive,
+            "wasted_steps": wasted,
+        }
+
+
+def _emit_chrome_span(phase: str, t_in: float, t_out: float):
+    """Drop a goodput/<phase> span onto the profiler sink so phase lanes
+    interleave with RecordEvent spans and `throughput` instants in the
+    chrome export. No-op (one predicate after the cached import) unless
+    the profiler is running; both clocks are CLOCK_MONOTONIC."""
+    try:
+        from ..profiler import emit_events, profiler_enabled
+    except Exception:  # obs stays usable without the jax-backed profiler
+        return
+    if not profiler_enabled():
+        return
+    emit_events([{
+        "name": f"goodput/{phase}", "ph": "X", "pid": 0,
+        "tid": threading.get_ident() % 10000,
+        "ts": t_in * 1e6, "dur": (t_out - t_in) * 1e6,
+    }])
+
+
+# ---- recompile sentinel ----
+#
+# jax.monitoring listeners cannot be unregistered through public API, so
+# ONE module-level dispatcher is registered (at most once per process)
+# and fans out to whichever sentinels are currently installed.
+_DISPATCH_LOCK = threading.Lock()
+_ACTIVE_SENTINELS: set = set()
+_MONITORING_REGISTERED = False
+
+
+def _monitoring_dispatch(event: str, duration: float, **_kw):
+    if event != COMPILE_EVENT:
+        return
+    with _DISPATCH_LOCK:
+        active = list(_ACTIVE_SENTINELS)
+    for s in active:
+        s.on_compile(duration)
+
+
+class RecompileSentinel:
+    """Counts XLA compilations and alarms on post-warmup recompiles.
+
+    Compilations during warmup (before `mark_warm()`) are expected; any
+    compile after it means the step function's static shapes churned —
+    each one drops a `train_recompile` flight-recorder event, and
+    reaching `storm_threshold` recompiles logs a warning naming the
+    count (shape churn is fixed at the call site, not hidden). Compile
+    seconds are booked to the ledger's `compile` phase so they are
+    subtracted from productive compute.
+    """
+
+    def __init__(self, ledger: Optional[GoodputLedger] = None,
+                 storm_threshold: int = 3):
+        if storm_threshold < 1:
+            raise ValueError(
+                f"storm_threshold must be >= 1, got {storm_threshold}")
+        self.ledger = ledger
+        self.storm_threshold = int(storm_threshold)
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.recompiles = 0
+        self.installed: Optional[str] = None  # "monitoring" | "jit_cache"
+        self._warm = False
+        self._storm_warned = False
+        self._lock = threading.Lock()
+
+    def mark_warm(self):
+        """Baseline: compilations so far were warmup, later ones are not."""
+        with self._lock:
+            self._warm = True
+
+    def on_compile(self, seconds: float = 0.0):
+        seconds = max(float(seconds), 0.0)
+        with self._lock:
+            self.compiles += 1
+            self.compile_seconds += seconds
+            is_recompile = self._warm
+            if is_recompile:
+                self.recompiles += 1
+            count = self.recompiles
+            storm = (is_recompile and count >= self.storm_threshold
+                     and not self._storm_warned)
+            if storm:
+                self._storm_warned = True
+        if self.ledger is not None:
+            self.ledger.book("compile", seconds)
+        if is_recompile:
+            flight_recorder().record(
+                "train_recompile", recompiles=count,
+                seconds=round(seconds, 6), storm=storm)
+            if storm:
+                _log.warning(
+                    "recompile storm: %d XLA compilations after warmup "
+                    "(threshold %d) — the step fn's static shapes are "
+                    "churning; bucket the shapes at the call site",
+                    count, self.storm_threshold)
+
+    # jit-cache fallback: JitLRUCache miss listeners carry (name, key,
+    # build_seconds)
+    def _on_cache_miss(self, name, key, seconds):
+        self.on_compile(seconds)
+
+    def install(self, source: str = "auto") -> "RecompileSentinel":
+        """Start observing compilations. `source`: "monitoring" (jax's
+        per-compile event), "jit_cache" (JitLRUCache miss hooks), or
+        "auto" (monitoring where available, cache hooks otherwise)."""
+        if self.installed is not None:
+            return self
+        if source in ("auto", "monitoring"):
+            try:
+                import jax.monitoring
+                global _MONITORING_REGISTERED
+                with _DISPATCH_LOCK:
+                    if not _MONITORING_REGISTERED:
+                        jax.monitoring \
+                            .register_event_duration_secs_listener(
+                                _monitoring_dispatch)
+                        _MONITORING_REGISTERED = True
+                    _ACTIVE_SENTINELS.add(self)
+                self.installed = "monitoring"
+                return self
+            except Exception:
+                if source == "monitoring":
+                    raise
+        from ..utils import jit_cache
+        jit_cache.add_miss_listener(self._on_cache_miss)
+        self.installed = "jit_cache"
+        return self
+
+    def uninstall(self):
+        if self.installed == "monitoring":
+            with _DISPATCH_LOCK:
+                _ACTIVE_SENTINELS.discard(self)
+        elif self.installed == "jit_cache":
+            from ..utils import jit_cache
+            jit_cache.remove_miss_listener(self._on_cache_miss)
+        self.installed = None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"compiles": self.compiles,
+                    "recompiles": self.recompiles,
+                    "compile_seconds": self.compile_seconds}
+
+
+# ---- HBM telemetry ----
+
+class HBMTelemetry:
+    """`device.memory_stats()` watermark gauges with static attribution.
+
+    `sample()` reads the live allocator stats (None/absent on backends
+    without them — CPU jax returns None); `attribute()` records the
+    byte sizes of the big static residents (params, optimizer state, KV
+    slab) so an OOM forensics dump can say what the HBM was holding.
+    `stats_fn` is injectable for tests and custom backends.
+    """
+
+    GAUGES = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+    def __init__(self, device=None, stats_fn: Optional[Callable] = None):
+        if stats_fn is None:
+            def stats_fn(_device=device):
+                try:
+                    import jax
+                    d = _device if _device is not None else jax.devices()[0]
+                    return d.memory_stats()
+                except Exception:
+                    return None
+        self._stats_fn = stats_fn
+        self._lock = threading.Lock()
+        self._attributed: Dict[str, int] = {}
+
+    def attribute(self, component: str, nbytes: int):
+        with self._lock:
+            self._attributed[str(component)] = int(nbytes)
+
+    @staticmethod
+    def tree_nbytes(tree) -> int:
+        """Total nbytes over a nested dict/list/tuple of arrays (works on
+        jax arrays, numpy arrays, and core.Tensor wrappers)."""
+        total = 0
+        stack = [tree]
+        while stack:
+            x = stack.pop()
+            if isinstance(x, dict):
+                stack.extend(x.values())
+            elif isinstance(x, (list, tuple)):
+                stack.extend(x)
+            else:
+                n = getattr(x, "nbytes", None)
+                if n is None:
+                    n = getattr(getattr(x, "data", None), "nbytes", None)
+                if n is not None:
+                    total += int(n)
+        return total
+
+    def sample(self) -> dict:
+        try:
+            stats = self._stats_fn()
+        except Exception:
+            stats = None
+        out = {"available": bool(stats)}
+        if stats:
+            for k in self.GAUGES:
+                if k in stats:
+                    out[k] = int(stats[k])
+        return out
+
+    def snapshot(self) -> dict:
+        s = self.sample()
+        with self._lock:
+            s["attributed"] = dict(self._attributed)
+        return s
+
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Resource exhausted")
+
+
+def oom_forensics(exc: BaseException,
+                  hbm: Optional[HBMTelemetry] = None) -> Optional[str]:
+    """If `exc` is an XLA out-of-memory failure, record a `train_oom`
+    flight event carrying the HBM watermarks + attribution and dump the
+    black-box ring (reason="oom"). Returns the dump path, or None when
+    the exception is not an OOM. Never raises."""
+    try:
+        msg = f"{type(exc).__name__}: {exc}"
+    except Exception:
+        msg = type(exc).__name__
+    if not any(m in msg for m in _OOM_MARKERS):
+        return None
+    info = {"error": msg[:400]}
+    if hbm is not None:
+        snap = hbm.snapshot()
+        for k in HBMTelemetry.GAUGES:
+            if k in snap:
+                info[f"hbm_{k}"] = snap[k]
+        for comp, n in sorted(snap.get("attributed", {}).items()):
+            info[f"attr_{comp}_bytes"] = n
+    flight_recorder().record("train_oom", **info)
+    return flight_recorder().try_dump(reason="oom")

@@ -86,9 +86,14 @@ class TrainingMetrics:
         "resumed": "resumes", "checkpoint_save": "checkpoint_saves",
     }
 
-    def __init__(self, tracker=None):
+    def __init__(self, tracker=None, ledger=None, hbm=None, sentinel=None):
         self._lock = threading.Lock()
         self.tracker = tracker  # profiler.ThroughputTracker or None
+        # ISSUE 10 goodput providers, all optional and sampled at render
+        # time (scrape-rate cost, never step-rate cost):
+        self.ledger = ledger        # obs.goodput.GoodputLedger
+        self.hbm = hbm              # obs.goodput.HBMTelemetry
+        self.sentinel = sentinel    # obs.goodput.RecompileSentinel
         self.counters: Dict[str, int] = {
             v: 0 for v in self._EVENT_COUNTERS.values()}
         self.last_step = 0
@@ -110,6 +115,12 @@ class TrainingMetrics:
             s["last_step"] = self.last_step
         if self.tracker is not None:
             s.update(self.tracker.summary())
+        if self.ledger is not None:
+            s["goodput"] = self.ledger.snapshot()
+        if self.hbm is not None:
+            s["hbm"] = self.hbm.snapshot()
+        if self.sentinel is not None:
+            s["recompile"] = self.sentinel.snapshot()
         return s
 
     def render(self) -> str:
@@ -122,13 +133,50 @@ class TrainingMetrics:
         b.family(f"{px}_last_step", "gauge")
         b.sample(f"{px}_last_step", s["last_step"])
         if self.tracker is not None:
-            for key, typ in (("steps_per_sec", "gauge"),
-                             ("tokens_per_sec", "gauge"),
-                             ("total_steps", "counter"),
-                             ("total_tokens", "counter"),
-                             ("total_seconds", "counter")):
+            keys = [("steps_per_sec", "gauge"),
+                    ("tokens_per_sec", "gauge"),
+                    ("total_steps", "counter"),
+                    ("total_tokens", "counter"),
+                    ("total_seconds", "counter"),
+                    ("last_chunk_seconds", "gauge")]
+            if "mfu" in s:  # tracker with registered flops (ISSUE 10)
+                keys.append(("mfu_window", "gauge"))
+                s["mfu_window"] = s["mfu"]
+            for key, typ in keys:
                 b.family(f"{px}_{key}", typ)
                 b.sample(f"{px}_{key}", s[key], round_to=4)
+        if self.ledger is not None:
+            g = s["goodput"]
+            b.family(f"{px}_goodput", "gauge")
+            b.sample(f"{px}_goodput", g["goodput"], round_to=4)
+            b.family(f"{px}_mfu", "gauge")
+            b.sample(f"{px}_mfu", g["mfu"], round_to=4)  # NaN when unset
+            b.family(f"{px}_wall_seconds", "gauge")
+            b.sample(f"{px}_wall_seconds", g["wall_seconds"], round_to=4)
+            b.family(f"{px}_phase_seconds_total", "counter")
+            for phase, secs in sorted(g["phase_seconds"].items()):
+                b.sample(f"{px}_phase_seconds_total", secs,
+                         labels={"phase": phase}, round_to=4)
+        if self.sentinel is not None:
+            r = s["recompile"]
+            b.family(f"{px}_compiles_total", "counter")
+            b.sample(f"{px}_compiles_total", r["compiles"])
+            b.family(f"{px}_recompiles_total", "counter")
+            b.sample(f"{px}_recompiles_total", r["recompiles"])
+            b.family(f"{px}_compile_seconds_total", "counter")
+            b.sample(f"{px}_compile_seconds_total", r["compile_seconds"],
+                     round_to=4)
+        if self.hbm is not None:
+            h = s["hbm"]
+            for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+                if key in h:  # absent on backends without memory_stats()
+                    b.family(f"{px}_hbm_{key}", "gauge")
+                    b.sample(f"{px}_hbm_{key}", h[key])
+            if h.get("attributed"):
+                b.family(f"{px}_hbm_attributed_bytes", "gauge")
+                for comp, nbytes in sorted(h["attributed"].items()):
+                    b.sample(f"{px}_hbm_attributed_bytes", nbytes,
+                             labels={"component": comp})
         return b.render()
 
 

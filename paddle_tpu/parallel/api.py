@@ -706,6 +706,9 @@ class ScanTrainStep(ShardedTrainStep):
         if self.scan_steps < 1:
             raise ValueError(f"scan_steps must be >= 1, got {scan_steps}")
         self.dispatch_count = 0  # jitted chunk dispatches issued
+        # goodput ledger (obs.goodput) — caller-thread H2D staging books
+        # to the "h2d" phase; None keeps the hook at one predicate
+        self.ledger = None
 
         train_step = self._train_step_fn
         K = self.scan_steps
@@ -774,9 +777,9 @@ class ScanTrainStep(ShardedTrainStep):
             sched.step()
         return np.asarray(vals, np.float32)
 
-    def __call__(self, *args):
-        """Run K fused steps over stacked [K, ...] inputs; returns the
-        per-step loss vector as a length-K Tensor."""
+    def _stage_chunk(self, args):
+        """Validate + stage stacked [K, ...] inputs (sync sharded
+        device_put on the caller thread)."""
         K = self.scan_steps
         arrays = []
         for a in args:
@@ -790,6 +793,17 @@ class ScanTrainStep(ShardedTrainStep):
                     "parallel.stack_batches or io.ChunkPrefetcher)")
             arrays.append(jax.device_put(
                 arr, NamedSharding(self.mesh, self._chunk_spec_for(arr))))
+        return arrays
+
+    def __call__(self, *args):
+        """Run K fused steps over stacked [K, ...] inputs; returns the
+        per-step loss vector as a length-K Tensor."""
+        K = self.scan_steps
+        if self.ledger is not None:
+            with self.ledger.measure("h2d"):
+                arrays = self._stage_chunk(args)
+        else:
+            arrays = self._stage_chunk(args)
         lr_vec = jnp.asarray(self._lr_vector(K))
         steps_vec = jnp.arange(1, K + 1, dtype=jnp.int32) + self._step_count
         self._step_count += K

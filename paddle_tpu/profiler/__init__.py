@@ -217,10 +217,26 @@ class ThroughputTracker:
         self.total_steps = 0
         self.total_tokens = 0
         self.total_seconds = 0.0
+        # duration of the most recent chunk — the watchdog and the goodput
+        # ledger read the same step-duration signal the rates use
+        self.last_chunk_seconds = 0.0
+        self._flops_per_step: Optional[float] = None
+        self._peak_flops: Optional[float] = None
+
+    def register_flops(self, flops_per_step: float, peak_flops: float):
+        """Arm the windowed MFU gauge: analytic FLOPs per step (see
+        obs.flops) and the mesh's TOTAL peak FLOP/s."""
+        self._flops_per_step = float(flops_per_step)
+        self._peak_flops = float(peak_flops)
 
     def update(self, steps: int, seconds: float, tokens: int = 0):
         steps, tokens, seconds = int(steps), int(tokens), float(seconds)
-        self._chunks.append((steps, tokens, seconds))
+        self.last_chunk_seconds = seconds
+        # a zero/negative-duration chunk flood (mocked clocks, duplicate
+        # timestamps) must not age real measurements out of the rate
+        # window; totals still count the work
+        if seconds > 0.0:
+            self._chunks.append((steps, tokens, seconds))
         self.total_steps += steps
         self.total_tokens += tokens
         self.total_seconds += seconds
@@ -244,14 +260,26 @@ class ThroughputTracker:
     def tokens_per_sec(self) -> float:
         return self._windowed(1)
 
+    @property
+    def mfu(self) -> Optional[float]:
+        """Windowed model-FLOPs utilization, or None until
+        register_flops() arms the gauge."""
+        if self._flops_per_step is None or not self._peak_flops:
+            return None
+        return self.steps_per_sec * self._flops_per_step / self._peak_flops
+
     def summary(self) -> dict:
-        return {
+        out = {
             "steps_per_sec": self.steps_per_sec,
             "tokens_per_sec": self.tokens_per_sec,
             "total_steps": self.total_steps,
             "total_tokens": self.total_tokens,
             "total_seconds": self.total_seconds,
+            "last_chunk_seconds": self.last_chunk_seconds,
         }
+        if self._flops_per_step is not None:
+            out["mfu"] = self.mfu
+        return out
 
 
 def get_events():

@@ -20,6 +20,24 @@ _log = logging.getLogger("paddle_tpu.jit_cache")
 # evictions within one `churn_window` builds that trigger the warning
 _CHURN_FRACTION = 0.5
 
+# miss listeners: cb(cache_name, key, build_seconds), called after every
+# cache-miss build across ALL JitLRUCache instances. The recompile
+# sentinel (obs.goodput) registers here on backends without
+# jax.monitoring compile events. List copy on mutation so iteration
+# never races registration; the hit path pays one truthiness check.
+_MISS_LISTENERS: list = []
+
+
+def add_miss_listener(cb):
+    global _MISS_LISTENERS
+    _MISS_LISTENERS = _MISS_LISTENERS + [cb]
+
+
+def remove_miss_listener(cb):
+    global _MISS_LISTENERS
+    # equality, not identity: bound methods are re-created per access
+    _MISS_LISTENERS = [c for c in _MISS_LISTENERS if c != cb]
+
 
 class JitLRUCache:
     """OrderedDict-backed LRU of compiled callables.
@@ -54,7 +72,19 @@ class JitLRUCache:
             self._cache.move_to_end(key)
             return self._cache[key]
         self.misses += 1
-        fn = build()
+        if _MISS_LISTENERS:
+            import time
+            t0 = time.monotonic()
+            fn = build()
+            dt = time.monotonic() - t0
+            for cb in _MISS_LISTENERS:
+                try:
+                    cb(self.name, key, dt)
+                except Exception:
+                    _log.debug("%s miss listener raised", self.name,
+                               exc_info=True)
+        else:
+            fn = build()
         self._cache[key] = fn
         self._recent_builds += 1
         while len(self._cache) > self.cap:

@@ -110,5 +110,5 @@ def pytest_collection_modifyitems(config, items):
         if mod == "test_prefix_cache":
             item.add_marker(pytest.mark.prefix)
             item.add_marker(pytest.mark.llm)
-        if mod == "test_obs":
+        if mod in ("test_obs", "test_goodput"):
             item.add_marker(pytest.mark.obs)

@@ -166,6 +166,83 @@ def test_training_metrics_counters_and_render():
     assert flat2["pdtpu_train_total_tokens"] == 8
 
 
+def test_throughput_tracker_zero_seconds_guard_and_mfu():
+    # a zero-duration chunk (clock granularity) must not poison the rate
+    # window; totals and last_chunk_seconds still advance
+    tp = profiler.ThroughputTracker(window=4)
+    tp.update(steps=2, seconds=0.0, tokens=100)
+    assert tp.total_steps == 2 and tp.total_tokens == 100
+    assert tp.last_chunk_seconds == 0.0
+    assert tp.steps_per_sec == 0.0                 # empty window, no inf
+    tp.update(steps=2, seconds=1.0, tokens=100)
+    assert tp.steps_per_sec == pytest.approx(2.0)
+    assert tp.last_chunk_seconds == 1.0
+    s = tp.summary()
+    assert s["last_chunk_seconds"] == 1.0
+    assert "mfu" not in s                          # flops not registered
+    assert tp.mfu is None
+    # register_flops arms the windowed MFU: 2 steps/s x 1e10 / 1e12
+    tp.register_flops(flops_per_step=1e10, peak_flops=1e12)
+    assert tp.mfu == pytest.approx(0.02)
+    assert tp.summary()["mfu"] == pytest.approx(0.02)
+
+
+def test_throughput_tracker_window_aging():
+    tp = profiler.ThroughputTracker(window=2)
+    tp.update(steps=1, seconds=1.0)                # will age out
+    tp.update(steps=4, seconds=1.0)
+    tp.update(steps=4, seconds=1.0)
+    assert tp.steps_per_sec == pytest.approx(4.0)  # only the last two
+    assert tp.total_steps == 9                     # totals never age
+
+
+def test_training_metrics_goodput_families_round_trip():
+    from paddle_tpu.obs.goodput import (GoodputLedger, HBMTelemetry,
+                                        RecompileSentinel)
+    t = [0.0]
+    led = GoodputLedger(clock=lambda: t[0])
+    led.start()
+    sen = RecompileSentinel(led)                   # not installed: unit feed
+    with led.measure("compute"):
+        t[0] += 3.0
+        sen.on_compile(0.25)                       # comes out of compute
+    sen.mark_warm()
+    with led.measure("checkpoint"):
+        t[0] += 1.0
+        sen.on_compile(0.25)                       # a recompile
+    led.add_steps(6)
+    hbm = HBMTelemetry(stats_fn=lambda: {
+        "bytes_in_use": 10, "peak_bytes_in_use": 20, "bytes_limit": 100})
+    hbm.attribute("kv_slab", 7)
+    tm = obs.TrainingMetrics(ledger=led, hbm=hbm, sentinel=sen)
+    flat = obs.parse_exposition(tm.render())
+    assert flat["pdtpu_train_goodput"] == pytest.approx(2.75 / 4.0)
+    assert np.isnan(flat["pdtpu_train_mfu"])       # flops not registered
+    assert flat["pdtpu_train_wall_seconds"] == pytest.approx(4.0)
+    assert flat['pdtpu_train_phase_seconds_total{phase="compute"}'] == 2.75
+    assert flat['pdtpu_train_phase_seconds_total{phase="checkpoint"}'] == 0.75
+    assert flat['pdtpu_train_phase_seconds_total{phase="compile"}'] == 0.5
+    assert flat['pdtpu_train_phase_seconds_total{phase="idle"}'] == 0.0
+    assert flat["pdtpu_train_compiles_total"] == 2
+    assert flat["pdtpu_train_recompiles_total"] == 1
+    assert flat["pdtpu_train_compile_seconds_total"] == 0.5
+    assert flat["pdtpu_train_hbm_bytes_in_use"] == 10
+    assert flat["pdtpu_train_hbm_peak_bytes_in_use"] == 20
+    assert flat["pdtpu_train_hbm_bytes_limit"] == 100
+    assert flat['pdtpu_train_hbm_attributed_bytes{component="kv_slab"}'] == 7
+    # registering flops flips the NaN to a finite gauge
+    led.set_flops(1e11, 1e12)
+    flat = obs.parse_exposition(tm.render())
+    assert flat["pdtpu_train_mfu"] == pytest.approx(
+        1e11 * 6 / 4.0 / 1e12, abs=1e-4)
+    # an unavailable HBM backend just drops the hbm_* families
+    tm2 = obs.TrainingMetrics(ledger=led,
+                              hbm=HBMTelemetry(stats_fn=lambda: None))
+    flat2 = obs.parse_exposition(tm2.render())
+    assert "pdtpu_train_hbm_bytes_in_use" not in flat2
+    assert "pdtpu_train_goodput" in flat2
+
+
 def test_metrics_server_endpoints():
     tm = obs.TrainingMetrics()
     tm.on_event("rollback", step=2)

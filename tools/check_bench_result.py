@@ -86,7 +86,15 @@ GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
              # effective prompt-token service rate (prefix sharing is the
              # point: serving a prompt must not require recomputing it)
              "llm_prefix_hit_rate": "higher",
-             "llm_shared_prefill_tok_s": "higher"}
+             "llm_shared_prefill_tok_s": "higher",
+             # ISSUE 10 goodput-ledger gates: the live goodput ratio
+             # (compute seconds / wall) and the ledger's live MFU are
+             # FLOORS — telemetry overhead or a phase-accounting bug that
+             # eats productive time must fail the gate. TPU-only by the
+             # provenance platform pinning above (a CPU row never gates
+             # against a TPU pin).
+             "train_goodput": "higher",
+             "train_mfu_live": "higher"}
 
 
 def _metrics_of(row):
@@ -100,7 +108,8 @@ def _metrics_of(row):
               "allreduce_ms", "llm_tok_s", "llm_ttft_ms",
               "llm_interactive_ttft_p99_ms", "llm_shed_rate",
               "llm_mixed_ttft_p99_ms", "llm_prefill_dispatches",
-              "llm_prefix_hit_rate", "llm_shared_prefill_tok_s"):
+              "llm_prefix_hit_rate", "llm_shared_prefill_tok_s",
+              "train_goodput", "train_mfu_live"):
         if extra.get(k) is not None:
             out[k] = float(extra[k])
     return out

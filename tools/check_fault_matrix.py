@@ -30,7 +30,12 @@ scenario in tests/test_obs.py (`obs`-marked module: a breaker-open
 cascade produces an atomic black-box dump that names the quarantined
 request id and carries the blame sequence retry → solo probe →
 quarantine → breaker-open in recorded order, readable by
-tools/flight_recorder.py) — then
+tools/flight_recorder.py), and the ISSUE 10 goodput scenario in
+tests/test_goodput.py (`obs`-marked module: an injected rollback storm
+is booked to the ledger's `rollback_waste` phase, the goodput ratio
+drops vs a clean run, and the flight-recorder dump carries the
+`train_recompile`/`train_oom` event vocabulary rendered by
+`tools/flight_recorder.py --kind 'train_*'`) — then
 prints a pass/fail table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
@@ -56,6 +61,7 @@ TEST_FILES = [
     os.path.join("tests", "test_paged_attention.py"),
     os.path.join("tests", "test_prefix_cache.py"),
     os.path.join("tests", "test_obs.py"),
+    os.path.join("tests", "test_goodput.py"),
 ]
 
 

@@ -13,12 +13,14 @@ events land on the same timeline as the profiler spans:
     python tools/flight_recorder.py dump.json \
         --merge trace.json -o merged.json                # chrome overlay
     python tools/flight_recorder.py dump.json --kind quarantine --kind reject
+    python tools/flight_recorder.py dump.json --kind 'train_*'
 
 Exit 0 on success, 2 on an unreadable/invalid dump.
 """
 from __future__ import annotations
 
 import argparse
+import fnmatch
 import json
 import sys
 from typing import List, Optional
@@ -46,8 +48,11 @@ def render_postmortem(dump: dict, kinds: Optional[List[str]] = None) -> str:
     recorded event (the monotonic clock's absolute origin is arbitrary)."""
     events = dump.get("events", [])
     if kinds:
-        want = set(kinds)
-        events = [e for e in events if e.get("kind") in want]
+        # fnmatch globs so one --kind 'train_*' selects the whole trainer
+        # vocabulary (train_rollback, train_recompile, train_oom, ...)
+        events = [e for e in events
+                  if any(fnmatch.fnmatch(e.get("kind", ""), k)
+                         for k in kinds)]
     lines = [
         f"flight recorder dump: reason={dump.get('reason', '?')} "
         f"pid={dump.get('pid', '?')} recorded={dump.get('recorded', '?')} "
@@ -95,7 +100,8 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="print the raw snapshot instead of the table")
     ap.add_argument("--kind", action="append", default=None,
-                    help="only show events of this kind (repeatable)")
+                    help="only show events matching this kind glob "
+                         "(fnmatch; repeatable — e.g. --kind 'train_*')")
     ap.add_argument("--merge", metavar="TRACE",
                     help="chrome trace to overlay the dump onto")
     ap.add_argument("-o", "--out", default=None,
