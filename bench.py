@@ -498,7 +498,17 @@ def run_llm_bench():
         num_slots=num_slots, block_len=8,
         # slots must fit the mixed phase's long prompts (<= 64 tokens)
         n_blocks=max(4, -(-(64 + max_new) // 8)),
-        max_queue_depth=max(4 * num_slots, 64)))
+        max_queue_depth=max(4 * num_slots, 64),
+        economics=True))
+    # register analytic decode FLOPs so the ledger's effective decode MFU
+    # uses the SAME obs.flops arithmetic as run_decode_bench's offline row
+    from paddle_tpu.obs.flops import decode_flops_per_token
+    params, _b = model.functional_state()
+    n_params = sum(int(np.prod(p.shape)) for p in params.values())
+    device_kind = jax.devices()[0].device_kind
+    engine.ledger.set_decode_flops(
+        decode_flops_per_token(n_params),
+        _peak_flops(device_kind, backend) * jax.device_count())
     engine.start()
 
     rng = np.random.RandomState(0)
@@ -516,6 +526,9 @@ def run_llm_bench():
     engine.metrics = LLMMetrics()   # warmup rows don't count
     engine.metrics.set_slots(engine.pool.active_slots(),
                              engine.pool.num_slots)
+    engine.metrics.ledger = engine.ledger   # re-attach economics providers
+    engine.metrics.burn = engine.burn       # after the metrics reset
+    engine.ledger.reset()   # warmup compile doesn't count as pump economics
 
     handles, rejected = [], 0
     t0 = time.perf_counter()
@@ -537,6 +550,10 @@ def run_llm_bench():
     dt = time.perf_counter() - t0
 
     snap = engine.metrics.snapshot()
+    # serving economics (ISSUE 11): the steady-state window's ledger view
+    # — token efficiency + decode MFU gate as floors, host fraction as a
+    # ceiling, through tools/check_bench_result.py
+    led = engine.ledger.snapshot()
     # generated tokens include each sequence's first (prefill) token
     total_tokens = snap["tokens_out"] + snap["prefills"]
     tok_s = total_tokens / dt if dt > 0 else 0.0
@@ -557,6 +574,15 @@ def run_llm_bench():
                 snap["intertoken_p99_ms"] or 0.0, 3),
             "decode_steps": snap["decode_steps"],
             "mean_active_rows": round(snap["mean_batch_rows"], 2),
+            "llm_token_efficiency": round(
+                led["token_efficiency"] or 0.0, 4),
+            "llm_decode_mfu": round(led["decode_mfu"] or 0.0, 6),
+            "llm_host_fraction": round(led["host_fraction"], 4),
+            "llm_dispatches": led["dispatches"],
+            "llm_compute_seconds": round(led["compute_seconds"], 4),
+            "llm_tenant_device_seconds": {
+                t: round(v["device_seconds"], 4)
+                for t, v in led["tenants"].items()},
             "completed": snap["completed"],
             "rejected": snap["rejected"] + rejected,
             "expired": snap["expired"],
@@ -585,6 +611,8 @@ def run_llm_bench():
         engine.metrics = LLMMetrics()
         engine.metrics.set_slots(engine.pool.active_slots(),
                                  engine.pool.num_slots)
+        engine.metrics.ledger = engine.ledger
+        engine.metrics.burn = engine.burn
         pd0 = engine.prefill_dispatches
         m_gaps = rng.exponential(1.0 / mixed_hz, size=n_mixed)
         m_handles, m_rejected = [], 0
@@ -639,6 +667,8 @@ def run_llm_bench():
         engine.metrics = LLMMetrics()
         engine.metrics.set_slots(engine.pool.active_slots(),
                                  engine.pool.num_slots)
+        engine.metrics.ledger = engine.ledger
+        engine.metrics.burn = engine.burn
         pt0 = engine.prefill_tokens
         p_gaps = rng.exponential(1.0 / pref_hz, size=n_pref)
         p_handles, p_rejected = [], 0
@@ -699,6 +729,8 @@ def run_llm_bench():
         engine.metrics = _LLMMetrics()
         engine.metrics.set_slots(engine.pool.active_slots(),
                                  engine.pool.num_slots)
+        engine.metrics.ledger = engine.ledger
+        engine.metrics.burn = engine.burn
         classes = ["interactive", "batch", "best_effort"]
         cls_trace = [classes[i % 4 % 3] for i in range(n_over)]  # 50% i/25/25
         o_lens = rng.randint(3, 13, size=n_over)

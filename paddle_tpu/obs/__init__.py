@@ -7,30 +7,36 @@
   pump crash (postmortem CLI: tools/flight_recorder.py);
 - `prom` — shared Prometheus text-exposition plumbing + the
   `pdtpu_train_*` training exporter and opt-in MetricsServer;
-- `goodput` (ISSUE 10) — the training goodput ledger (phase seconds
-  tile wall clock), live-MFU accounting, recompile sentinel, and HBM
-  telemetry / OOM forensics;
+- `goodput` (ISSUE 10) — the shared `PhaseLedger` frame bookkeeping and
+  the training goodput ledger (phase seconds tile wall clock), live-MFU
+  accounting, recompile sentinel, and HBM telemetry / OOM forensics;
+- `serving_ledger` (ISSUE 11) — the serving economics ledger (pump
+  phase tiling, token efficiency, per-tenant/per-class device-seconds)
+  and the SLO burn-rate monitor;
 - `flops` — the analytic FLOPs / peak-FLOPs helpers bench.py and the
-  live MFU gauge share.
+  live MFU gauges share.
 
 Stdlib-only and import-light: serving and training both depend on this
 package, never the other way around.
 """
 from .flight_recorder import DUMP_DIR_ENV, FlightRecorder, flight_recorder
 from .flops import (conv_train_flops_per_step, decode_flops_per_token,
-                    peak_flops, train_flops_per_step)
-from .goodput import (PHASES, GoodputLedger, HBMTelemetry, RecompileSentinel,
-                      oom_forensics)
+                    decode_mfu, peak_flops, train_flops_per_step)
+from .goodput import (PHASES, GoodputLedger, HBMTelemetry, PhaseLedger,
+                      RecompileSentinel, oom_forensics)
 from .prom import MetricsServer, PromBuilder, TrainingMetrics, parse_exposition
+from .serving_ledger import (SERVING_LEDGER_PHASES, ServingLedger,
+                             SLOBurnMonitor)
 from .trace import (LLM_PHASES, SERVING_PHASES, RequestTrace, TimelineStore,
                     ingest_traceparent, new_request_id)
 
 __all__ = [
     "DUMP_DIR_ENV", "FlightRecorder", "flight_recorder",
-    "conv_train_flops_per_step", "decode_flops_per_token", "peak_flops",
-    "train_flops_per_step",
-    "PHASES", "GoodputLedger", "HBMTelemetry", "RecompileSentinel",
-    "oom_forensics",
+    "conv_train_flops_per_step", "decode_flops_per_token", "decode_mfu",
+    "peak_flops", "train_flops_per_step",
+    "PHASES", "GoodputLedger", "HBMTelemetry", "PhaseLedger",
+    "RecompileSentinel", "oom_forensics",
+    "SERVING_LEDGER_PHASES", "ServingLedger", "SLOBurnMonitor",
     "MetricsServer", "PromBuilder", "TrainingMetrics", "parse_exposition",
     "LLM_PHASES", "SERVING_PHASES", "RequestTrace", "TimelineStore",
     "ingest_traceparent", "new_request_id",

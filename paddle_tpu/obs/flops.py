@@ -74,3 +74,18 @@ def conv_train_flops_per_step(fwd_mac_flops: float, batch: int) -> float:
 def decode_flops_per_token(n_params: int) -> float:
     """2N forward-only FLOPs per generated token (KV-cache decode)."""
     return 2.0 * n_params
+
+
+def decode_mfu(flops_per_token: float, tokens: int, seconds: float,
+               peak_flops_total: float):
+    """Effective decode MFU: achieved decode FLOP/s over peak.
+
+    ONE formula for bench.py's offline row and the serving ledger's live
+    gauge (ISSUE 11), mirroring how train MFU shares
+    `train_flops_per_step`. Returns None when any input is degenerate
+    (no tokens, no measured seconds, no registered peak)."""
+    if not (flops_per_token and tokens and seconds and peak_flops_total):
+        return None
+    if seconds <= 0 or peak_flops_total <= 0:
+        return None
+    return flops_per_token * tokens / seconds / peak_flops_total

@@ -68,27 +68,32 @@ PHASES = ("compute", "rollback_waste", "data_wait", "h2d", "compile",
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 
-class GoodputLedger:
-    """Exclusive phase attribution over trainer wall clock.
+class PhaseLedger:
+    """Exclusive phase attribution over wall clock — the shared frame
+    bookkeeping under both the training `GoodputLedger` and the serving
+    `obs.serving_ledger.ServingLedger` (ISSUE 11).
 
     `measure(phase)` frames nest on a per-thread stack; a frame books
     its span MINUS the time inner frames (and inner `book()` charges)
     already claimed, so nested hooks never double-count. `book(phase,
-    secs)` attributes time reported from callbacks (compile durations)
-    and charges it against the enclosing frame the same way. The clock
-    is injectable for deterministic tests.
+    secs)` attributes time reported from callbacks (compile durations,
+    per-dispatch splits) and charges it against the enclosing frame the
+    same way. The clock is injectable for deterministic tests.
+
+    Subclasses set `phases` (must end with "idle", the unbooked
+    residual) and `lane_prefix` (the chrome-trace lane family, e.g.
+    `goodput/<phase>` / `serving/<phase>`).
     """
+
+    phases: tuple = ("busy", "idle")
+    lane_prefix: str = "phase"
 
     def __init__(self, clock: Callable[[], float] = time.monotonic):
         self._clock = clock
         self._lock = threading.Lock()
         self._t0: Optional[float] = None
         self._phase_seconds: Dict[str, float] = {
-            p: 0.0 for p in PHASES if p != "idle"}
-        self.productive_steps = 0
-        self.wasted_steps = 0
-        self.flops_per_step: Optional[float] = None
-        self.peak_flops_total: Optional[float] = None
+            p: 0.0 for p in self.phases if p != "idle"}
         self._tls = threading.local()
 
     # ---- lifecycle ----
@@ -98,11 +103,18 @@ class GoodputLedger:
             if self._t0 is None:
                 self._t0 = self._clock()
 
-    def set_flops(self, flops_per_step: float, peak_flops_total: float):
-        """Register the analytic FLOPs (obs.flops helpers) and the mesh's
-        total peak so snapshot() can report live MFU."""
-        self.flops_per_step = float(flops_per_step)
-        self.peak_flops_total = float(peak_flops_total)
+    def reset(self):
+        """Zero the booked phases and re-arm the wall clock at `now` (when
+        already armed) — excludes warmup from a measurement window."""
+        with self._lock:
+            for p in self._phase_seconds:
+                self._phase_seconds[p] = 0.0
+            if self._t0 is not None:
+                self._t0 = self._clock()
+            self._reset_extra_locked()
+
+    def _reset_extra_locked(self):
+        """Subclass hook: zero per-subclass counters under the lock."""
 
     # ---- attribution ----
     def _stack(self) -> List[list]:
@@ -128,7 +140,8 @@ class GoodputLedger:
                 self._phase_seconds[phase] += max(span - frame[2], 0.0)
             if stack:  # the whole span is inner time for the parent
                 stack[-1][2] += span
-            _emit_chrome_span(phase, frame[1], t_out)
+            _emit_chrome_span(f"{self.lane_prefix}/{phase}",
+                              frame[1], t_out)
 
     def book(self, phase: str, seconds: float):
         """Attribute externally-measured seconds (e.g. a compile duration
@@ -142,6 +155,41 @@ class GoodputLedger:
         if stack:
             stack[-1][2] += seconds
 
+    # ---- reporting ----
+    def wall_and_phases(self) -> tuple:
+        """(wall_seconds, {phase: seconds}) with idle = the clamped
+        unbooked residual — the tiling invariant both subclasses build
+        their snapshots on."""
+        now = self._clock()
+        with self._lock:
+            phases = dict(self._phase_seconds)
+            t0 = self._t0
+        wall = (now - t0) if t0 is not None else 0.0
+        booked = sum(phases.values())
+        phases["idle"] = max(wall - booked, 0.0)
+        return wall, phases
+
+
+class GoodputLedger(PhaseLedger):
+    """Training-phase attribution over trainer wall clock, plus the
+    step/FLOPs accounting that turns it into goodput and live MFU."""
+
+    phases = PHASES
+    lane_prefix = "goodput"
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        super().__init__(clock=clock)
+        self.productive_steps = 0
+        self.wasted_steps = 0
+        self.flops_per_step: Optional[float] = None
+        self.peak_flops_total: Optional[float] = None
+
+    def set_flops(self, flops_per_step: float, peak_flops_total: float):
+        """Register the analytic FLOPs (obs.flops helpers) and the mesh's
+        total peak so snapshot() can report live MFU."""
+        self.flops_per_step = float(flops_per_step)
+        self.peak_flops_total = float(peak_flops_total)
+
     def add_steps(self, k: int, productive: bool = True):
         """Count optimizer steps; re-run steps after a rollback are waste."""
         with self._lock:
@@ -150,19 +198,17 @@ class GoodputLedger:
             else:
                 self.wasted_steps += int(k)
 
-    # ---- reporting ----
+    def _reset_extra_locked(self):
+        self.productive_steps = 0
+        self.wasted_steps = 0
+
     def snapshot(self) -> dict:
         """Point-in-time view: wall, per-phase seconds (idle = residual),
         goodput = compute/wall, and live MFU when FLOPs are registered."""
-        now = self._clock()
+        wall, phases = self.wall_and_phases()
         with self._lock:
-            phases = dict(self._phase_seconds)
-            t0 = self._t0
             productive = self.productive_steps
             wasted = self.wasted_steps
-        wall = (now - t0) if t0 is not None else 0.0
-        booked = sum(phases.values())
-        phases["idle"] = max(wall - booked, 0.0)
         goodput = phases["compute"] / wall if wall > 0 else 0.0
         mfu = None
         if (self.flops_per_step and self.peak_flops_total and wall > 0
@@ -179,11 +225,12 @@ class GoodputLedger:
         }
 
 
-def _emit_chrome_span(phase: str, t_in: float, t_out: float):
-    """Drop a goodput/<phase> span onto the profiler sink so phase lanes
-    interleave with RecordEvent spans and `throughput` instants in the
-    chrome export. No-op (one predicate after the cached import) unless
-    the profiler is running; both clocks are CLOCK_MONOTONIC."""
+def _emit_chrome_span(lane: str, t_in: float, t_out: float):
+    """Drop a `<lane_prefix>/<phase>` span onto the profiler sink so
+    phase lanes interleave with RecordEvent spans and `throughput`
+    instants in the chrome export. No-op (one predicate after the cached
+    import) unless the profiler is running; both clocks are
+    CLOCK_MONOTONIC."""
     try:
         from ..profiler import emit_events, profiler_enabled
     except Exception:  # obs stays usable without the jax-backed profiler
@@ -191,7 +238,7 @@ def _emit_chrome_span(phase: str, t_in: float, t_out: float):
     if not profiler_enabled():
         return
     emit_events([{
-        "name": f"goodput/{phase}", "ph": "X", "pid": 0,
+        "name": lane, "ph": "X", "pid": 0,
         "tid": threading.get_ident() % 10000,
         "ts": t_in * 1e6, "dur": (t_out - t_in) * 1e6,
     }])

@@ -20,6 +20,32 @@ import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
 
+def escape_label_value(value) -> str:
+    """Escape a label value per the Prometheus exposition spec (0.0.4):
+    backslash, double-quote, and newline. Label values reach here from
+    user-controlled strings (tenant ids via X-Tenant-Id, request ids) —
+    without this, a crafted value injects extra samples or labels into
+    the scrape (ISSUE 11 satellite)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _unescape_label_value(value: str) -> str:
+    out: List[str] = []
+    i, n = 0, len(value)
+    while i < n:
+        c = value[i]
+        if c == "\\" and i + 1 < n:
+            nxt = value[i + 1]
+            out.append({"n": "\n", '"': '"', "\\": "\\"}.get(nxt,
+                                                             "\\" + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
 class PromBuilder:
     """Accumulates exposition lines; label order is preserved."""
 
@@ -34,7 +60,8 @@ class PromBuilder:
                round_to: Optional[int] = None) -> "PromBuilder":
         lab = ""
         if labels:
-            inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+            inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                             for k, v in labels.items())
             lab = "{" + inner + "}"
         if value is None:
             v = "NaN"
@@ -53,14 +80,63 @@ class PromBuilder:
         return "\n".join(self._lines) + "\n"
 
 
+def _parse_labels(line: str, start: int) -> Optional[tuple]:
+    """Parse the `{k="v",...}` block starting at `line[start] == "{"`,
+    honoring value escapes; returns ([(key, raw_value)], index past the
+    closing brace) or None when malformed."""
+    labels: List[tuple] = []
+    i, n = start + 1, len(line)
+    while i < n and line[i] != "}":
+        eq = line.find("=", i)
+        if eq == -1 or eq + 1 >= n or line[eq + 1] != '"':
+            return None
+        key = line[i:eq].strip().lstrip(",").strip()
+        j = eq + 2
+        buf: List[str] = []
+        while j < n and line[j] != '"':
+            if line[j] == "\\" and j + 1 < n:
+                buf.append(line[j:j + 2])
+                j += 2
+            else:
+                buf.append(line[j])
+                j += 1
+        if j >= n:
+            return None
+        labels.append((key, "".join(buf)))
+        i = j + 1
+        if i < n and line[i] == ",":
+            i += 1
+    if i >= n:
+        return None
+    return labels, i + 1
+
+
 def parse_exposition(text: str) -> Dict[str, float]:
-    """Inverse of render() for tests/tools: flat {metric{labels}: value}."""
+    """Inverse of render() for tests/tools: flat {metric{labels}: value}.
+
+    Escape-aware: label values are tokenized honoring `\\"` / `\\\\` /
+    `\\n` and re-escaped canonically into the key, so
+    parse_exposition(render()) round-trips every sample — one entry per
+    sample line, whatever bytes the label values carried."""
     out: Dict[str, float] = {}
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        name, _, val = line.rpartition(" ")
+        brace = line.find("{")
+        space = line.find(" ")
+        if brace != -1 and (space == -1 or brace < space):
+            parsed = _parse_labels(line, brace)
+            if parsed is None:
+                continue
+            labels, end = parsed
+            inner = ",".join(
+                f'{k}="{escape_label_value(_unescape_label_value(v))}"'
+                for k, v in labels)
+            name = line[:brace] + "{" + inner + "}"
+            val = line[end:].strip()
+        else:
+            name, _, val = line.rpartition(" ")
         try:
             out[name] = float(val)
         except ValueError:

@@ -88,6 +88,10 @@ class EngineConfig:
     breaker_threshold: int = 3     # consecutive failed dispatches that open
     #                                the engine circuit breaker
     retry_after_s: float = 1.0     # backpressure hint on overload rejects
+    economics: bool = False        # arm the serving economics ledger
+    #                                (ISSUE 11): pump phase tiling +
+    #                                pad-waste token efficiency; off = one
+    #                                predicate per hook
 
     def __post_init__(self):
         if self.max_batch_size < 1:
@@ -193,6 +197,13 @@ class BatchingEngine:
         # finished-request timelines, bounded LRU (served by the HTTP
         # layer's /debug/requests endpoint)
         self.timelines = TimelineStore(256)
+        # serving economics (ISSUE 11): None unless armed — every hook
+        # below guards on this one predicate
+        self.ledger = None
+        if self.config.economics:
+            from ..obs.serving_ledger import ServingLedger
+            self.ledger = ServingLedger(clock=self.clock.now)
+        self.metrics.ledger = self.ledger
 
     @classmethod
     def from_predictor(cls, predictor, config: Optional[EngineConfig] = None,
@@ -429,7 +440,19 @@ class BatchingEngine:
         """One scheduler pass: drop expired requests, dispatch every batch
         that is due at clock.now(). Returns the number of dispatches. This
         is THE scheduler — the background thread and the sim harness both
-        call it."""
+        call it.
+
+        With economics armed (ISSUE 11) the pass runs inside the serving
+        ledger's ``measure("host")`` frame; `_dispatch` books each
+        predict's device span out of it, so host/compute/idle tile the
+        pump wall clock."""
+        led = self.ledger
+        if led is None:
+            return self._pump_inner()
+        with led.measure("host"):
+            return self._pump_inner()
+
+    def _pump_inner(self) -> int:
         dispatched = 0
         while True:
             batch = self._take_batch()
@@ -542,6 +565,7 @@ class BatchingEngine:
                         [a,
                          np.zeros((padded - total,) + a.shape[1:], a.dtype)],
                         axis=0) for a in args]
+            tc0 = self.clock.now() if self.ledger is not None else None
             outs = list(self._supervised_predict(args))
         except Exception as e:
             for r in batch:
@@ -549,6 +573,16 @@ class BatchingEngine:
                 r.future.set_exception(e)
             self.metrics.on_fail(len(batch))
             return
+        if self.ledger is not None:
+            # block on the device results so the measured span is
+            # execution; real rows are "prefill" positions and the pow2
+            # pad rows are the waste token_efficiency exposes. The
+            # stateless engine has no row ownership -> no owner meters.
+            import jax
+            jax.block_until_ready(outs)
+            self.ledger.book_dispatch(
+                self.clock.now() - tc0, prefill_positions=total,
+                decode_positions=0, total_positions=padded, owners=())
         # un-pad, then split batched outputs by request row counts
         trimmed = []
         for o in outs:

@@ -143,6 +143,20 @@ class LLMEngineConfig:
     # ---- observability (ISSUE 9) ----
     trace_buffer: int = 256        # finished request timelines kept for
     #                                /debug/requests/<rid> (bounded LRU)
+    # ---- serving economics (ISSUE 11) ----
+    economics: bool = False        # arm the ServingLedger + SLOBurnMonitor;
+    #                                off = one predicate per hook, no clock
+    #                                reads, no extra device syncs
+    slo_burn_budget: float = 0.05       # error budget (bad-outcome fraction)
+    slo_burn_threshold: float = 14.4    # page when burn >= this multiple
+    slo_burn_fast_window_s: float = 60.0
+    slo_burn_slow_window_s: float = 300.0
+    slo_burn_min_events: int = 10       # cold-start floor per window
+    slo_burn_capture_s: float = 0.0     # >0: bounded profiler capture on fire
+    slo_ttft_target_ms: Optional[Dict[str, float]] = None  # per-class TTFT
+    #                                targets feeding the burn monitor; a
+    #                                class absent from the dict counts every
+    #                                prefill as a good outcome
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -180,6 +194,34 @@ class LLMEngineConfig:
         if self.trace_buffer < 1:
             raise ValueError(
                 f"trace_buffer must be >= 1, got {self.trace_buffer}")
+        if not 0.0 < self.slo_burn_budget <= 1.0:
+            raise ValueError(
+                f"slo_burn_budget must be in (0, 1], got "
+                f"{self.slo_burn_budget}")
+        if self.slo_burn_threshold <= 0:
+            raise ValueError(
+                f"slo_burn_threshold must be > 0, got "
+                f"{self.slo_burn_threshold}")
+        if not (0.0 < self.slo_burn_fast_window_s
+                <= self.slo_burn_slow_window_s):
+            raise ValueError(
+                "slo_burn windows must satisfy 0 < fast <= slow, got "
+                f"fast={self.slo_burn_fast_window_s} "
+                f"slow={self.slo_burn_slow_window_s}")
+        if self.slo_burn_min_events < 1:
+            raise ValueError(
+                f"slo_burn_min_events must be >= 1, got "
+                f"{self.slo_burn_min_events}")
+        if self.slo_ttft_target_ms is not None:
+            for cls, target in self.slo_ttft_target_ms.items():
+                if cls not in SLO_CLASSES:
+                    raise ValueError(
+                        f"slo_ttft_target_ms keys must be SLO classes "
+                        f"{SLO_CLASSES}, got {cls!r}")
+                if target <= 0:
+                    raise ValueError(
+                        f"slo_ttft_target_ms[{cls!r}] must be > 0, got "
+                        f"{target}")
 
 
 class GenerationHandle:
@@ -320,6 +362,23 @@ class LLMEngine:
         #                              clauses key on this index)
         # finished request timelines for /debug/requests/<rid> (ISSUE 9)
         self.timelines = TimelineStore(self.config.trace_buffer)
+        # serving economics (ISSUE 11): both None unless armed, so every
+        # hot-path hook costs exactly one predicate when disabled
+        self.ledger = None
+        self.burn = None
+        if self.config.economics:
+            from ...obs.serving_ledger import ServingLedger, SLOBurnMonitor
+            self.ledger = ServingLedger(clock=self.clock.now)
+            self.burn = SLOBurnMonitor(
+                clock=self.clock.now,
+                budget=self.config.slo_burn_budget,
+                threshold=self.config.slo_burn_threshold,
+                fast_window_s=self.config.slo_burn_fast_window_s,
+                slow_window_s=self.config.slo_burn_slow_window_s,
+                min_events=self.config.slo_burn_min_events,
+                capture_s=self.config.slo_burn_capture_s)
+        self.metrics.ledger = self.ledger
+        self.metrics.burn = self.burn
         if fault_plan is None:
             from ...utils.fault_injection import global_plan
             fault_plan = global_plan()
@@ -645,6 +704,8 @@ class LLMEngine:
                 retry_after_s=self.config.retry_after_s))
             self.metrics.on_reject("shed", tenant=victim.tenant)
             self.metrics.on_shed(victim.slo)
+            if self.burn is not None:
+                self.burn.observe(victim.slo, False, outcome="shed")
             self._record_reject("shed", rid=victim.rid,
                                 tenant=victim.tenant)
 
@@ -787,8 +848,23 @@ class LLMEngine:
         executed (0 or 1; a step carrying only prefill chunks returns 0) —
         the quantity the continuous-batching tests count. This is THE
         scheduler: the background thread and the sim harness both call
-        it."""
+        it.
+
+        With economics armed (ISSUE 11) the whole pass runs inside the
+        serving ledger's ``measure("host")`` frame; the successful
+        dispatch's device span is booked out of it by `_step_once`, so
+        host/compute/idle tile the pump's wall clock by construction."""
+        led = self.ledger
+        if led is None:
+            return self._pump_inner()
+        with led.measure("host"):
+            return self._pump_inner()
+
+    def _pump_inner(self) -> int:
         now = self.clock.now()
+        # time-weighted slot occupancy (ISSUE 11 satellite): integrate the
+        # level held since the previous pump pass, at pump granularity
+        self.metrics.observe_occupancy(now)
         self._drop_expired_queued(now)
         self._admit()
         n = self._step_once()
@@ -825,6 +901,9 @@ class LLMEngine:
                             f"deadline expired after "
                             f"{(now - r.arrival) * 1e3:.1f}ms in queue "
                             "(dropped before prefill)"))
+                        if self.burn is not None:
+                            self.burn.observe(r.slo, False,
+                                              outcome="expired_queued")
                         expired += 1
                     else:
                         alive.append(r)
@@ -960,7 +1039,13 @@ class LLMEngine:
             attempts = self.config.dispatch_retries + 1
             last_err = None
             nxt = None
+            tc0 = None
             for attempt in range(attempts):
+                if self.ledger is not None:
+                    # re-armed per attempt: a failed round's wall time
+                    # stays in the host phase; only the successful
+                    # dispatch's span is booked as compute
+                    tc0 = self.clock.now()
                 try:
                     nxt, new_slabs = self._run_dispatch(kinds, fn, args)
                 except DispatchFailedError as e:
@@ -990,6 +1075,25 @@ class LLMEngine:
                 self._fail_all_active(attempts, last_err)
                 self.supervisor.record_failure()
                 return 0
+            if self.ledger is not None:
+                # jit dispatch is async: block on the device result so the
+                # measured span is execution, not launch; split it between
+                # the compute phases by advanced positions and meter it to
+                # the rows' tenants / SLO classes (ISSUE 11)
+                jax.block_until_ready(nxt)
+                tc1 = self.clock.now()
+                with self._cond:
+                    owners = [(self._active[s].tenant, self._active[s].slo,
+                               int(adv[s]))
+                              for s in prefill_slots + decode_slots
+                              if s in self._active]
+                self.ledger.book_dispatch(
+                    tc1 - tc0,
+                    prefill_positions=int(sum(adv[s]
+                                              for s in prefill_slots)),
+                    decode_positions=len(decode_slots),
+                    total_positions=int(toks.size),
+                    owners=owners)
             nxt = np.asarray(nxt)
             now = self.clock.now()
             with self._cond:
@@ -1017,6 +1121,14 @@ class LLMEngine:
                             req.trace.mark("first_token", now)
                         self.metrics.on_prefill(req.handle.ttft_ms,
                                                 slo=req.slo)
+                        if self.burn is not None:
+                            target = (self.config.slo_ttft_target_ms
+                                      or {}).get(req.slo)
+                            self.burn.observe(
+                                req.slo,
+                                target is None
+                                or req.handle.ttft_ms <= target,
+                                outcome="ttft")
                         if self.prefix_cache is not None:
                             # index the completed prefill while the slot
                             # is still active: siblings queued behind it
@@ -1065,6 +1177,8 @@ class LLMEngine:
             f"deadline expired after {len(req.emitted)} of "
             f"{req.max_new_tokens} tokens (evicted {stage})"))
         self.metrics.on_expire()
+        if self.burn is not None:
+            self.burn.observe(req.slo, False, outcome="deadline")
         self.pool.free(slot)
         del self._active[slot]
 
@@ -1153,6 +1267,12 @@ class LLMEngine:
                     f"{len(req.emitted)} of {req.max_new_tokens} tokens "
                     f"emitted ({last_err})", reason="engine"))
                 self.metrics.on_fail()
+                # observed BEFORE the caller charges the breaker, so a
+                # burn-rate crossing lands in the flight ring ahead of
+                # the breaker_open event it predicts
+                if self.burn is not None:
+                    self.burn.observe(req.slo, False,
+                                      outcome="engine_failure")
                 self.pool.free(slot)
             self._active.clear()
             self.metrics.set_slots(self.pool.active_slots(),

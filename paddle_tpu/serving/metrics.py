@@ -54,6 +54,11 @@ class ServingMetrics:
         # "poisoned"/"engine") and the engine circuit-breaker gauge
         self.dispatch_failures: Dict[str, int] = {}
         self.circuit_open = False
+        # economics providers (ISSUE 11), attached by the engine when
+        # built with economics=True and sampled only at snapshot/render
+        # time (scrape-rate cost, never pump-rate cost)
+        self.ledger = None   # obs.serving_ledger.ServingLedger
+        self.burn = None     # obs.serving_ledger.SLOBurnMonitor
 
     # ---- engine callbacks ----
     def on_submit(self, queue_depth: int):
@@ -137,6 +142,10 @@ class ServingMetrics:
             "p50_ms": self.quantile_ms(0.50),
             "p95_ms": self.quantile_ms(0.95),
             "p99_ms": self.quantile_ms(0.99),
+            **({"economics": self.ledger.snapshot()}
+               if self.ledger is not None else {}),
+            **({"slo_burn": self.burn.snapshot()}
+               if self.burn is not None else {}),
         }
 
     def render(self) -> str:
@@ -176,6 +185,62 @@ class ServingMetrics:
                      s["dispatch_failures"][kind], {"kind": kind})
         b.family(f"{px}_circuit_open", "gauge")
         b.sample(f"{px}_circuit_open", int(s["circuit_open"]))
+        self._render_economics_into(b, s)
+
+    def _render_economics_into(self, b: PromBuilder, s: dict):
+        """Serving-economics families (ISSUE 11): phase tiling, token
+        efficiency, decode MFU, per-tenant/per-class device-seconds and
+        SLO burn rates — rendered only when the engine attached the
+        providers, under this metrics object's own prefix (pdtpu_serving
+        for the predictor engine, pdtpu_llm for the LLM engine)."""
+        px = self._PREFIX
+        if self.ledger is not None:
+            e = s["economics"]
+            b.family(f"{px}_phase_seconds_total", "counter")
+            for phase, secs in sorted(e["phase_seconds"].items()):
+                b.sample(f"{px}_phase_seconds_total", secs,
+                         labels={"phase": phase}, round_to=4)
+            b.family(f"{px}_wall_seconds", "gauge")
+            b.sample(f"{px}_wall_seconds", e["wall_seconds"], round_to=4)
+            b.family(f"{px}_token_efficiency", "gauge")
+            b.sample(f"{px}_token_efficiency", e["token_efficiency"],
+                     round_to=4)
+            b.family(f"{px}_host_fraction", "gauge")
+            b.sample(f"{px}_host_fraction", e["host_fraction"], round_to=4)
+            b.family(f"{px}_decode_mfu", "gauge")
+            b.sample(f"{px}_decode_mfu", e["decode_mfu"], round_to=6)
+            if e["tenants"]:
+                b.family(f"{px}_tenant_device_seconds_total", "counter")
+                for tenant in sorted(e["tenants"]):
+                    b.sample(f"{px}_tenant_device_seconds_total",
+                             e["tenants"][tenant]["device_seconds"],
+                             {"tenant": tenant}, round_to=6)
+                b.family(f"{px}_tenant_device_tokens_total", "counter")
+                for tenant in sorted(e["tenants"]):
+                    b.sample(f"{px}_tenant_device_tokens_total",
+                             e["tenants"][tenant]["tokens"],
+                             {"tenant": tenant})
+            if e["classes"]:
+                b.family(f"{px}_class_device_seconds_total", "counter")
+                for cls in sorted(e["classes"]):
+                    b.sample(f"{px}_class_device_seconds_total",
+                             e["classes"][cls]["device_seconds"],
+                             {"slo": cls}, round_to=6)
+                b.family(f"{px}_class_device_tokens_total", "counter")
+                for cls in sorted(e["classes"]):
+                    b.sample(f"{px}_class_device_tokens_total",
+                             e["classes"][cls]["tokens"], {"slo": cls})
+        if self.burn is not None:
+            burn = s["slo_burn"]
+            b.family(f"{px}_slo_burn_rate", "gauge")
+            b.family(f"{px}_slo_burn_fired", "gauge")
+            for cls in sorted(burn["classes"]):
+                v = burn["classes"][cls]
+                for window in ("fast", "slow"):
+                    b.sample(f"{px}_slo_burn_rate", v[f"burn_{window}"],
+                             {"slo": cls, "window": window}, round_to=3)
+                b.sample(f"{px}_slo_burn_fired", int(v["fired"]),
+                         {"slo": cls})
 
 
 def _quantile(sorted_vals, q: float) -> Optional[float]:
@@ -234,6 +299,14 @@ class LLMMetrics(ServingMetrics):
         self.cached_blocks = 0
         self.cache_evictions = 0
         self.tenants: Dict[str, Dict[str, int]] = {}
+        # time-weighted slot occupancy (ISSUE 11 satellite): ∫occupancy·dt
+        # integrated at pump granularity, so the average weighs each
+        # occupancy level by how long it actually held — a snapshot-only
+        # gauge read at scrape time sees whatever instant the scrape hit
+        self._occ_integral = 0.0    # ∫ occupancy dt
+        self._occ_wall = 0.0        # observed seconds
+        self._occ_last_t: Optional[float] = None
+        self._occ_prev = 0.0        # occupancy held since the last observe
 
     def _class(self, slo) -> Optional[Dict[str, int]]:
         return self.class_counters.get(slo) if slo else None
@@ -371,6 +444,23 @@ class LLMMetrics(ServingMetrics):
             self.slots_active = int(active)
             self.slots_total = int(total)
 
+    def observe_occupancy(self, now: float):
+        """Advance the occupancy·dt integral to `now` (called once per
+        pump iteration): the occupancy the LAST observation left behind
+        is credited for the elapsed interval, then the current gauge
+        becomes the new level. The averaged value is the utilization the
+        ledger's `token_efficiency` is bounded by (a padded-but-occupied
+        slot still advances positions; an empty one cannot)."""
+        with self._lock:
+            if self._occ_last_t is not None:
+                dt = now - self._occ_last_t
+                if dt > 0:
+                    self._occ_integral += self._occ_prev * dt
+                    self._occ_wall += dt
+            self._occ_last_t = now
+            self._occ_prev = (self.slots_active / self.slots_total
+                              if self.slots_total else 0.0)
+
     # ---- views ----
     def ttft_quantile_ms(self, q: float,
                          slo: Optional[str] = None) -> Optional[float]:
@@ -408,6 +498,9 @@ class LLMMetrics(ServingMetrics):
             s["cached_blocks"] = self.cached_blocks
             s["cache_evictions"] = self.cache_evictions
             s["tenants"] = {t: dict(v) for t, v in self.tenants.items()}
+            s["slot_occupancy_avg"] = (
+                self._occ_integral / self._occ_wall
+                if self._occ_wall > 0 else None)
         for t in s["tenants"].values():
             t["cache_hit_rate"] = (
                 t["prefix_hit_tokens"] / t["prefix_lookup_tokens"]
@@ -445,6 +538,9 @@ class LLMMetrics(ServingMetrics):
         b.sample(f"{px}_slots_total", s["slots_total"])
         b.family(f"{px}_slot_occupancy", "gauge")
         b.sample(f"{px}_slot_occupancy", s["slot_occupancy"], round_to=4)
+        b.family(f"{px}_slot_occupancy_avg", "gauge")
+        b.sample(f"{px}_slot_occupancy_avg", s["slot_occupancy_avg"],
+                 round_to=4)
         b.family(f"{px}_tokens_total", "counter")
         b.sample(f"{px}_tokens_total", s["tokens_out"])
         b.family(f"{px}_decode_steps_total", "counter")

@@ -28,6 +28,12 @@ ThreadingHTTPServer + BaseHTTPRequestHandler, whose hardened
                                    timeline (phases, marks, events)
     GET  /debug/flightrecorder  -> the process-global black-box ring
                                    (paddle_tpu.obs.flight_recorder)
+    GET  /debug/costs           -> per-engine serving economics (ISSUE
+                                   11): pump phase tiling, token
+                                   efficiency, per-tenant / per-SLO-class
+                                   device-seconds, SLO burn-rate state
+                                   (null for engines without
+                                   economics=True)
 
 Request tracing (ISSUE 9): every /predict and /generate request gets a
 request id — ingested from a W3C `traceparent` header when present, else
@@ -209,6 +215,25 @@ class ServingServer:
                                 ctype="text/plain; version=0.0.4")
                 elif self.path == "/debug/flightrecorder":
                     self._reply_json(200, flight_recorder().snapshot())
+                elif self.path == "/debug/costs":
+                    # serving economics (ISSUE 11): per-engine phase
+                    # tiling, token efficiency, per-tenant/per-class
+                    # device-seconds meters, and SLO burn-rate state;
+                    # engines built without economics=True report null
+                    costs = {}
+                    for name, e in (("predict", outer.engine),
+                                    ("llm", outer.llm_engine)):
+                        if e is None:
+                            continue
+                        led = getattr(e, "ledger", None)
+                        burn = getattr(e, "burn", None)
+                        costs[name] = {
+                            "economics": (led.snapshot()
+                                          if led is not None else None),
+                            "slo_burn": (burn.snapshot()
+                                         if burn is not None else None),
+                        }
+                    self._reply_json(200, costs)
                 elif self.path == "/debug/requests":
                     ids = []
                     for e in outer._engines():

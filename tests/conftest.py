@@ -110,5 +110,5 @@ def pytest_collection_modifyitems(config, items):
         if mod == "test_prefix_cache":
             item.add_marker(pytest.mark.prefix)
             item.add_marker(pytest.mark.llm)
-        if mod in ("test_obs", "test_goodput"):
+        if mod in ("test_obs", "test_goodput", "test_serving_ledger"):
             item.add_marker(pytest.mark.obs)

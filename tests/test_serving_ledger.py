@@ -1,0 +1,514 @@
+"""Serving economics ledger (ISSUE 11): pump phase attribution that
+tiles the serving engines' wall clock, token economics over the
+fixed-width unified step, per-tenant / per-SLO-class device-time cost
+metering, the SLO burn-rate monitor (multi-window multi-burn), the
+Prometheus label-escaping regression, and the dispatch-storm
+fault-matrix scenario proving a burn-rate crossing lands in the black
+box BEFORE the breaker-open it predicts.
+
+Ledger unit tests run on an injected fake clock (exact numbers); engine
+tests run the PRODUCTION pump under a SimClock — the ticking variant
+auto-advances on every read, so device spans, host spans, and idle gaps
+are all nonzero and the tiling reconciliation is a real proof."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu import obs
+from paddle_tpu.obs.serving_ledger import (SERVING_LEDGER_PHASES,
+                                           ServingLedger, SLOBurnMonitor)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "tools", "flight_recorder.py")
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def gpt_tiny():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    paddle.seed(0)
+    return GPTForCausalLM.from_preset("gpt2-tiny")
+
+
+def _ticking_sim_clock(tick=0.0005):
+    """A SimClock whose every now() read advances time by `tick`: the
+    engine stays threadless (pump-driven), but clock spans between any
+    two reads are nonzero and exactly countable."""
+    from paddle_tpu.serving.clock import SimClock
+
+    class _Ticking(SimClock):
+        def now(self):
+            self._t += tick
+            return self._t
+
+    return _Ticking()
+
+
+# ---- ServingLedger unit tests (fake clock, exact numbers) ----
+
+def test_book_dispatch_splits_compute_and_tiles_host_frame():
+    fc = FakeClock()
+    led = ServingLedger(clock=fc)
+    led.start()
+    with led.measure("host"):
+        fc.tick(0.010)
+        led.book_dispatch(0.004, prefill_positions=3, decode_positions=1,
+                          total_positions=32,
+                          owners=[("a", "interactive", 3), ("b", "batch", 1)])
+    fc.tick(0.002)
+    snap = led.snapshot()
+    ph = snap["phase_seconds"]
+    assert set(ph) == set(SERVING_LEDGER_PHASES)
+    # device span split 3:1 between the compute phases, charged OUT of
+    # the enclosing host frame; the residual is idle
+    assert ph["prefill_compute"] == pytest.approx(0.003)
+    assert ph["decode_compute"] == pytest.approx(0.001)
+    assert ph["host"] == pytest.approx(0.006)
+    assert ph["idle"] == pytest.approx(0.002)
+    assert snap["wall_seconds"] == pytest.approx(0.012)
+    assert sum(ph.values()) == pytest.approx(snap["wall_seconds"])
+    # owners carry the SAME seconds, apportioned by position weight
+    assert snap["tenants"]["a"]["device_seconds"] == pytest.approx(0.003)
+    assert snap["tenants"]["b"]["device_seconds"] == pytest.approx(0.001)
+    assert snap["classes"]["interactive"]["tokens"] == 3
+    assert snap["token_efficiency"] == pytest.approx(4 / 32)
+    assert snap["prefill_tokens"] == 3 and snap["decode_tokens"] == 1
+
+
+def test_zero_useful_dispatch_books_host_and_mfu_registration():
+    from paddle_tpu.obs.flops import decode_mfu
+    fc = FakeClock()
+    led = ServingLedger(clock=fc)
+    with led.measure("host"):
+        fc.tick(0.01)
+        led.book_dispatch(0.005, prefill_positions=0, decode_positions=0,
+                          total_positions=16, owners=[("t", "batch", 0)])
+    snap = led.snapshot()
+    # no advanced rows: the span is pure host overhead, no owner is billed
+    assert snap["phase_seconds"]["prefill_compute"] == 0.0
+    assert snap["phase_seconds"]["host"] == pytest.approx(0.01)
+    assert snap["tenants"] == {}
+    assert snap["decode_mfu"] is None          # flops not registered
+    led.set_decode_flops(2e6, 1e12)
+    with led.measure("host"):
+        fc.tick(0.01)
+        led.book_dispatch(0.004, prefill_positions=0, decode_positions=8,
+                          total_positions=16, owners=[("t", "batch", 8)])
+    snap = led.snapshot()
+    assert snap["decode_mfu"] == pytest.approx(
+        decode_mfu(2e6, 8, snap["phase_seconds"]["decode_compute"], 1e12))
+    # reset zeros the meters and re-arms the wall clock
+    led.reset()
+    snap = led.snapshot()
+    assert snap["dispatches"] == 0 and snap["tenants"] == {}
+    assert snap["wall_seconds"] == 0.0
+
+
+def test_owner_device_seconds_sum_to_compute_exactly():
+    fc = FakeClock()
+    led = ServingLedger(clock=fc)
+    rng = np.random.RandomState(7)
+    for _ in range(50):
+        with led.measure("host"):
+            fc.tick(0.002)
+            pre, dec = int(rng.randint(0, 9)), int(rng.randint(0, 3))
+            owners = []
+            left = pre + dec
+            for i, t in enumerate(("a", "b", "c")):
+                take = left if i == 2 else int(rng.randint(0, left + 1))
+                owners.append((t, "interactive" if i else "batch", take))
+                left -= take
+            led.book_dispatch(0.001, prefill_positions=pre,
+                              decode_positions=dec,
+                              total_positions=16, owners=owners)
+    snap = led.snapshot()
+    compute = (snap["phase_seconds"]["prefill_compute"]
+               + snap["phase_seconds"]["decode_compute"])
+    tenant_sum = sum(v["device_seconds"] for v in snap["tenants"].values())
+    class_sum = sum(v["device_seconds"] for v in snap["classes"].values())
+    assert tenant_sum == pytest.approx(compute, abs=1e-12)
+    assert class_sum == pytest.approx(compute, abs=1e-12)
+    assert snap["compute_seconds"] == pytest.approx(compute)
+    assert sum(v["tokens"] for v in snap["tenants"].values()) == \
+        snap["useful_positions"]
+
+
+# ---- SLO burn-rate monitor (fake clock) ----
+
+def test_burn_monitor_fires_only_when_both_windows_burn():
+    fc = FakeClock()
+    obs.flight_recorder().clear()
+    mon = SLOBurnMonitor(clock=fc, budget=0.05, threshold=14.4,
+                         fast_window_s=10.0, slow_window_s=100.0,
+                         min_events=5)
+    for _ in range(10):                     # healthy history
+        mon.observe("interactive", True)
+        fc.tick(1.0)
+    fc.tick(40.0)
+    for _ in range(10):                     # a sharp storm: fast window
+        mon.observe("interactive", False)   # burns at 20x...
+        fc.tick(0.1)
+    snap = mon.snapshot()
+    c = snap["classes"]["interactive"]
+    assert c["burn_fast"] == pytest.approx(20.0)
+    # ...but the slow window still remembers the good events, so the
+    # multi-window rule suppresses the page
+    assert c["burn_slow"] < 14.4
+    assert not c["fired"] and not snap["fired"]
+    # age the good events out of the slow window; sustained badness fires
+    fc.tick(100.0)
+    for _ in range(6):
+        mon.observe("interactive", False)
+        fc.tick(0.1)
+    snap = mon.snapshot()
+    assert snap["classes"]["interactive"]["fired"]
+    fired = snap["fired"]["interactive"]
+    assert fired["burn_fast"] >= 14.4 and fired["burn_slow"] >= 14.4
+    events = [e for e in obs.flight_recorder().snapshot()["events"]
+              if e["kind"] == "slo_burn"]
+    assert len(events) == 1                 # latched: one page, not a storm
+    assert events[0]["slo"] == "interactive"
+    # an unrelated healthy class never fires
+    mon.observe("batch", True)
+    assert not mon.snapshot()["classes"]["batch"]["fired"]
+
+
+def test_burn_monitor_min_events_guard_and_validation():
+    fc = FakeClock()
+    obs.flight_recorder().clear()
+    mon = SLOBurnMonitor(clock=fc, budget=0.05, threshold=14.4,
+                         min_events=10)
+    for _ in range(9):                      # total outage, but below the
+        mon.observe("interactive", False)   # cold-start floor
+        fc.tick(0.01)
+    c = mon.snapshot()["classes"]["interactive"]
+    assert c["burn_fast"] is None and not c["fired"]
+    with pytest.raises(ValueError, match="budget"):
+        SLOBurnMonitor(budget=0.0)
+    with pytest.raises(ValueError, match="threshold"):
+        SLOBurnMonitor(threshold=0.0)
+    with pytest.raises(ValueError, match="fast"):
+        SLOBurnMonitor(fast_window_s=300.0, slow_window_s=60.0)
+    with pytest.raises(ValueError, match="min_events"):
+        SLOBurnMonitor(min_events=0)
+
+
+# ---- Prometheus label escaping (ISSUE 11 satellite regression) ----
+
+def test_prom_label_value_injection_is_neutralized():
+    from paddle_tpu.obs.prom import (PromBuilder, escape_label_value,
+                                     parse_exposition)
+    evil = 'x",hack="1"} 99\npdtpu_injected_total 1'
+    b = PromBuilder()
+    b.family("pdtpu_llm_tenant_device_seconds_total", "counter")
+    b.sample("pdtpu_llm_tenant_device_seconds_total", 5,
+             labels={"tenant": evil})
+    text = b.render()
+    # ONE sample line: the crafted value cannot smuggle extra samples,
+    # labels, or a second metric into the scrape
+    lines = [ln for ln in text.splitlines() if not ln.startswith("#")]
+    assert len(lines) == 1
+    flat = parse_exposition(text)
+    assert len(flat) == 1
+    key, value = next(iter(flat.items()))
+    assert value == 5.0
+    assert escape_label_value(evil) in key
+    assert "pdtpu_injected_total" not in flat
+    # round-trip stability: parsing the render re-escapes canonically
+    assert parse_exposition(text) == flat
+    # backslash/newline/quote all survive a full escape->parse cycle
+    for v in ('a\\b', 'a"b', 'a\nb', 'a\\"b\\n'):
+        bb = PromBuilder()
+        bb.sample("m", 1, labels={"l": v})
+        assert parse_exposition(bb.render()) == {
+            'm{l="' + escape_label_value(v) + '"}': 1.0}
+
+
+def test_metrics_render_with_hostile_tenant_id_stays_parseable():
+    from paddle_tpu.obs.prom import parse_exposition
+    from paddle_tpu.serving.metrics import LLMMetrics
+    fc = FakeClock()
+    led = ServingLedger(clock=fc)
+    with led.measure("host"):
+        fc.tick(0.01)
+        led.book_dispatch(0.004, prefill_positions=4, decode_positions=0,
+                          total_positions=16,
+                          owners=[('t"evil\n', "interactive", 4)])
+    m = LLMMetrics()
+    m.ledger = led
+    text = m.render()
+    flat = parse_exposition(text)
+    hits = [k for k in flat
+            if k.startswith("pdtpu_llm_tenant_device_seconds_total")]
+    assert len(hits) == 1 and flat[hits[0]] > 0
+    assert not any(ln == "evil" for ln in text.splitlines())
+
+
+# ---- time-weighted slot occupancy (ISSUE 11 satellite) ----
+
+def test_time_weighted_occupancy_average():
+    from paddle_tpu.serving.metrics import LLMMetrics
+    m = LLMMetrics()
+    m.set_slots(0, 4)
+    assert m.snapshot()["slot_occupancy_avg"] is None   # no window yet
+    m.observe_occupancy(10.0)
+    m.set_slots(4, 4)
+    m.observe_occupancy(11.0)      # level 0.0 held for 1s
+    m.set_slots(2, 4)
+    m.observe_occupancy(13.0)      # level 1.0 held for 2s
+    snap = m.snapshot()
+    assert snap["slot_occupancy_avg"] == pytest.approx(2.0 / 3.0)
+    assert snap["slot_occupancy"] == pytest.approx(0.5)  # instantaneous
+    text = m.render()
+    assert "pdtpu_llm_slot_occupancy_avg 0.6667" in text
+    # a backwards/zero dt observation is a no-op, not a negative credit
+    m.observe_occupancy(13.0)
+    assert m.snapshot()["slot_occupancy_avg"] == pytest.approx(2.0 / 3.0)
+
+
+# ---- LLM engine integration (production pump, ticking SimClock) ----
+
+def test_llm_pump_phases_tile_wall_and_tenants_pay_compute(gpt_tiny):
+    """The acceptance reconciliation: with economics armed, the serving
+    ledger's phase seconds tile the engine's measured wall clock within
+    1%, per-tenant (and per-class) device seconds sum EXACTLY to
+    prefill_compute + decode_compute, and the rendered exposition
+    carries the economics families."""
+    from paddle_tpu import serving
+    from paddle_tpu.obs.prom import parse_exposition
+
+    clock = _ticking_sim_clock()
+    eng = serving.LLMEngine(
+        gpt_tiny,
+        serving.LLMEngineConfig(num_slots=2, block_len=8, n_blocks=4,
+                                economics=True),
+        clock=clock)
+    assert eng.ledger is not None and eng.burn is not None
+    rng = np.random.RandomState(0)
+    handles = []
+    for i in range(4):
+        handles.append(eng.submit(
+            rng.randint(1, 400, size=3 + i).astype(np.int32),
+            max_new_tokens=3, tenant=f"t{i % 2}",
+            slo="interactive" if i % 2 else "batch"))
+        eng.pump()
+    while eng.has_work():
+        eng.pump()
+    for h in handles:
+        h.result(timeout=0)
+
+    snap = eng.ledger.snapshot()
+    ph = snap["phase_seconds"]
+    assert set(ph) == set(SERVING_LEDGER_PHASES)
+    assert ph["host"] > 0 and snap["compute_seconds"] > 0
+    # tiling: booked phases (idle = residual) reconcile with wall within 1%
+    assert sum(ph.values()) == pytest.approx(snap["wall_seconds"],
+                                             rel=0.01, abs=1e-9)
+    # cost metering: both tenants and both classes present, and their
+    # device seconds sum to the compute phases exactly
+    assert set(snap["tenants"]) == {"t0", "t1"}
+    assert set(snap["classes"]) == {"interactive", "batch"}
+    tenant_sum = sum(v["device_seconds"] for v in snap["tenants"].values())
+    class_sum = sum(v["device_seconds"] for v in snap["classes"].values())
+    assert tenant_sum == pytest.approx(snap["compute_seconds"], abs=1e-9)
+    assert class_sum == pytest.approx(snap["compute_seconds"], abs=1e-9)
+    # token economics over the fixed-width unified step
+    assert snap["dispatches"] > 0
+    assert 0 < snap["token_efficiency"] <= 1.0
+    assert snap["useful_positions"] == (snap["prefill_tokens"]
+                                        + snap["decode_tokens"])
+    assert snap["total_positions"] == snap["dispatches"] * 2 * 16
+
+    text = eng.metrics.render()
+    flat = parse_exposition(text)
+    for fam in ("pdtpu_llm_phase_seconds_total", "pdtpu_llm_wall_seconds",
+                "pdtpu_llm_token_efficiency", "pdtpu_llm_host_fraction",
+                "pdtpu_llm_tenant_device_seconds_total",
+                "pdtpu_llm_class_device_seconds_total",
+                "pdtpu_llm_slot_occupancy_avg"):
+        assert any(k.startswith(fam) for k in flat), fam
+    assert 'pdtpu_llm_tenant_device_seconds_total{tenant="t0"}' in flat
+    assert "economics" in eng.metrics.snapshot()
+    eng.stop()
+
+
+def test_streams_bit_identical_with_ledger_armed(gpt_tiny):
+    """Economics must observe, never perturb: every stream from an armed
+    engine equals one-shot greedy generate() bit-for-bit, and a default
+    engine pays one predicate per hook (ledger and burn are both None)."""
+    from paddle_tpu import serving
+    from paddle_tpu.models.generation import generate
+
+    prompts = [np.arange(1, 5, dtype=np.int32),
+               np.arange(11, 15, dtype=np.int32)]
+    ref = np.asarray(generate(gpt_tiny, np.stack(prompts),
+                              max_new_tokens=4).numpy())[:, 4:]
+    eng = serving.LLMEngine(
+        gpt_tiny,
+        serving.LLMEngineConfig(num_slots=2, block_len=8, n_blocks=4,
+                                economics=True,
+                                slo_ttft_target_ms={"batch": 50.0}),
+        clock=serving.SimClock())
+    handles = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    while eng.has_work():
+        eng.pump()
+    for h, r in zip(handles, ref):
+        assert np.array_equal(h.result(timeout=0), r)
+    assert eng.ledger.snapshot()["dispatches"] > 0
+    eng.stop()
+
+    # default config: economics fully disabled, nothing attached
+    off = serving.LLMEngine(
+        gpt_tiny,
+        serving.LLMEngineConfig(num_slots=2, block_len=8, n_blocks=4),
+        clock=serving.SimClock())
+    assert off.ledger is None and off.burn is None
+    assert off.metrics.ledger is None and off.metrics.burn is None
+    assert "economics" not in off.metrics.snapshot()
+    off.stop()
+
+
+def test_llm_config_validates_economics_knobs():
+    from paddle_tpu.serving import LLMEngineConfig
+    with pytest.raises(ValueError, match="slo_burn_budget"):
+        LLMEngineConfig(slo_burn_budget=1.5)
+    with pytest.raises(ValueError, match="slo_burn windows"):
+        LLMEngineConfig(slo_burn_fast_window_s=300.0,
+                        slo_burn_slow_window_s=60.0)
+    with pytest.raises(ValueError, match="slo_ttft_target_ms keys"):
+        LLMEngineConfig(slo_ttft_target_ms={"gold": 5.0})
+    with pytest.raises(ValueError, match="must be > 0"):
+        LLMEngineConfig(slo_ttft_target_ms={"interactive": 0.0})
+
+
+# ---- stateless BatchingEngine: pad-waste economics + /debug/costs ----
+
+@pytest.mark.serving
+def test_batching_engine_pad_waste_and_debug_costs_endpoint():
+    """The pow2-padded predict dispatch meters real rows as useful
+    positions and pad rows as waste; /debug/costs serves the ledger
+    snapshot (and null burn state) per engine."""
+    import urllib.request
+    from paddle_tpu import serving
+
+    eng = serving.BatchingEngine(
+        lambda args: [np.asarray(args[0], np.float32) * 2.0],
+        serving.EngineConfig(max_batch_size=8, max_wait_ms=1.0,
+                             economics=True))
+    server = serving.ServingServer(eng, port=0).start()
+    try:
+        x = np.ones((3, 2), np.float32)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/predict",
+            data=json.dumps({"inputs": [x.tolist()]}).encode(),
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            json.loads(r.read())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/costs",
+                timeout=30) as r:
+            costs = json.loads(r.read())
+        econ = costs["predict"]["economics"]
+        assert econ["dispatches"] >= 1
+        assert econ["useful_positions"] == 3
+        assert econ["total_positions"] == 4          # pow2 pad: 3 -> 4
+        assert econ["token_efficiency"] == pytest.approx(0.75)
+        assert sum(econ["phase_seconds"].values()) == pytest.approx(
+            econ["wall_seconds"], rel=0.01, abs=1e-6)
+        assert costs["predict"]["slo_burn"] is None  # no SLO classes here
+    finally:
+        server.stop()
+
+
+# ---- the fault-matrix scenario (tools/check_fault_matrix.py) ----
+
+@pytest.mark.fault_matrix
+def test_dispatch_storm_fires_slo_burn_before_breaker(gpt_tiny, tmp_path,
+                                                      monkeypatch):
+    """Dispatch storm: every step and every blame probe raises, so each
+    round fails ALL active interactive requests (non-attributable ->
+    engine failure). The burn monitor sees the bad outcomes BEFORE each
+    round charges the breaker, so the latched `slo_burn` flight event
+    lands in the ring — and in the breaker-open black-box dump — with a
+    smaller seq than the `breaker_open` it predicts. The postmortem CLI
+    isolates the alert with --kind 'slo_*'."""
+    from paddle_tpu import serving
+    from paddle_tpu.utils.fault_injection import FaultPlan
+
+    monkeypatch.setenv(obs.DUMP_DIR_ENV, str(tmp_path))
+    obs.flight_recorder().clear()
+    # round 1: step idx 0 raises (dispatch_retries=0), blame probes idx
+    # 1/2 raise -> non-attributable -> engine failure #1 (2 bad events,
+    # below min_events=3: no alert). round 2: idx 3 + probes 4/5 raise
+    # -> the round's FIRST bad observation is event #3: burn = 20x over
+    # both windows >= 14.4 -> slo_burn fires; THEN the round's
+    # record_failure opens the breaker (threshold 2) and dumps the ring.
+    plan = FaultPlan.from_spec(
+        "dispatch_raise@0;dispatch_raise@1;dispatch_raise@2;"
+        "dispatch_raise@3;dispatch_raise@4;dispatch_raise@5")
+    eng = serving.LLMEngine(
+        gpt_tiny,
+        serving.LLMEngineConfig(num_slots=2, block_len=8, n_blocks=4,
+                                dispatch_retries=0, breaker_threshold=2,
+                                economics=True, slo_burn_min_events=3),
+        clock=serving.SimClock(), fault_plan=plan)
+    r0 = [eng.submit([i + 1, i + 2], max_new_tokens=4, slo="interactive")
+          for i in range(2)]
+    eng.pump()                              # engine failure #1
+    for h in r0:
+        with pytest.raises(serving.DispatchFailedError):
+            h.result(timeout=0)
+    assert not eng.broken
+    assert not eng.burn.snapshot()["classes"]["interactive"]["fired"]
+    r1 = [eng.submit([i + 5, i + 6], max_new_tokens=4, slo="interactive")
+          for i in range(2)]
+    eng.pump()                              # burn fires, THEN breaker opens
+    assert eng.broken
+    for h in r1:
+        with pytest.raises(serving.DispatchFailedError):
+            h.result(timeout=0)
+    burn_snap = eng.burn.snapshot()
+    assert burn_snap["classes"]["interactive"]["fired"]
+    assert burn_snap["fired"]["interactive"]["burn_fast"] >= 14.4
+
+    # the breaker-open dump already carries the earlier slo_burn event
+    dump_path = tmp_path / f"pdtpu_flight_{os.getpid()}.json"
+    assert dump_path.exists(), "breaker open must dump the flight ring"
+    doc = json.loads(dump_path.read_text())
+    events = doc["events"]
+    kinds = [e["kind"] for e in events]
+    assert "slo_burn" in kinds and "breaker_open" in kinds
+    burn_ev = next(e for e in events if e["kind"] == "slo_burn")
+    brk_ev = next(e for e in events if e["kind"] == "breaker_open")
+    assert burn_ev["seq"] < brk_ev["seq"], \
+        "the alert must precede the breaker it predicts"
+    assert burn_ev["slo"] == "interactive"
+    assert burn_ev["burn_fast"] >= 14.4 and burn_ev["burn_slow"] >= 14.4
+
+    # postmortem CLI: --kind 'slo_*' isolates the alert
+    r = subprocess.run(
+        [sys.executable, CLI, str(dump_path), "--kind", "slo_*"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    # the dump header names the dump reason (breaker_open:llm); the
+    # FILTERED event listing must carry only the slo_* events
+    event_lines = [ln for ln in r.stdout.splitlines() if "s " in ln
+                   and ln.lstrip().startswith("[")]
+    assert event_lines and all("slo_burn" in ln for ln in event_lines)
+    assert not any("breaker_open" in ln for ln in event_lines)
+    eng.stop()

@@ -94,7 +94,16 @@ GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
              # provenance platform pinning above (a CPU row never gates
              # against a TPU pin).
              "train_goodput": "higher",
-             "train_mfu_live": "higher"}
+             "train_mfu_live": "higher",
+             # ISSUE 11 serving-economics gates: the unified mixed step's
+             # token efficiency (useful / total fixed-width positions) and
+             # the ledger's effective decode MFU are FLOORS; the pump's
+             # host fraction (host seconds / wall) is a CEILING — host
+             # bloat or a pad-waste regression must fail the gate. Same
+             # provenance platform pinning as the train_* gates.
+             "llm_token_efficiency": "higher",
+             "llm_decode_mfu": "higher",
+             "llm_host_fraction": "lower"}
 
 
 def _metrics_of(row):
@@ -109,7 +118,9 @@ def _metrics_of(row):
               "llm_interactive_ttft_p99_ms", "llm_shed_rate",
               "llm_mixed_ttft_p99_ms", "llm_prefill_dispatches",
               "llm_prefix_hit_rate", "llm_shared_prefill_tok_s",
-              "train_goodput", "train_mfu_live"):
+              "train_goodput", "train_mfu_live",
+              "llm_token_efficiency", "llm_decode_mfu",
+              "llm_host_fraction"):
         if extra.get(k) is not None:
             out[k] = float(extra[k])
     return out

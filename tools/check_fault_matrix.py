@@ -35,7 +35,13 @@ tests/test_goodput.py (`obs`-marked module: an injected rollback storm
 is booked to the ledger's `rollback_waste` phase, the goodput ratio
 drops vs a clean run, and the flight-recorder dump carries the
 `train_recompile`/`train_oom` event vocabulary rendered by
-`tools/flight_recorder.py --kind 'train_*'`) — then
+`tools/flight_recorder.py --kind 'train_*'`), and the ISSUE 11
+SLO-burn scenario in tests/test_serving_ledger.py (`obs`-marked
+module: an injected dispatch_raise storm drives the interactive
+class's error-budget burn rate over the multi-window threshold, the
+latched `slo_burn` flight event lands in the black-box dump BEFORE the
+breaker_open it predicts, and the dump filters via
+`tools/flight_recorder.py --kind 'slo_*'`) — then
 prints a pass/fail table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
@@ -62,6 +68,7 @@ TEST_FILES = [
     os.path.join("tests", "test_prefix_cache.py"),
     os.path.join("tests", "test_obs.py"),
     os.path.join("tests", "test_goodput.py"),
+    os.path.join("tests", "test_serving_ledger.py"),
 ]
 
 
