@@ -194,9 +194,18 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
         return np.broadcast_to(arr, (iters,) + arr.shape).copy()
 
     ids_chunk, labels_chunk = chunk(ids), chunk(labels)
+    # compile observatory (ISSUE 12): armed BEFORE warmup so the one-time
+    # AOT lower/compile for the chunk executable lands in the warmup
+    # region, keeping the timed region unpolluted; the registry rows
+    # (executable count, compile seconds) are gated as CEILINGs
+    from paddle_tpu.obs.compile_observatory import compile_observatory
+    observatory = compile_observatory().enable()
+    observatory.reset()
+    step.observatory = observatory
     # warmup / compile (one full chunk; scan compiles the body once)
     losses = step(ids_chunk, labels_chunk)
     _ = float(np.asarray(losses.data)[-1])  # forced host read: tunnel barrier
+    observatory.mark_warm()
 
     n_chips = jax.device_count()
     unit_name = "images" if preset == "resnet50" else "tokens"
@@ -257,6 +266,8 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
     dt = (time.perf_counter() - t0) / iters
     goodput_snap = ledger.snapshot()
     sentinel.uninstall()
+    compile_snap = observatory.snapshot()
+    observatory.disable()
 
     tokens_per_sec_chip = tokens_per_step / dt / n_chips
     achieved = flops_per_step / dt / n_chips
@@ -288,6 +299,11 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
             "train_mfu_live": (round(goodput_snap["mfu"], 4)
                                if goodput_snap["mfu"] is not None else None),
             "train_recompiles": sentinel.recompiles,
+            # ISSUE 12 compile-observatory rows (gated as ceilings: more
+            # executables or compile seconds than the baseline means the
+            # bench step sprouted extra program variants)
+            "compile_executables": compile_snap["executables"],
+            "compile_seconds_total": compile_snap["compile_seconds_total"],
             "train_phase_seconds": {
                 k: round(v, 4)
                 for k, v in goodput_snap["phase_seconds"].items()},

@@ -178,7 +178,8 @@ class ResilientTrainer:
                  callbacks: Optional[List] = None,
                  use_orbax: bool = True,
                  metrics_port: Optional[int] = None,
-                 goodput: bool = False):
+                 goodput: bool = False,
+                 observatory: bool = False):
         self.worker = DeviceWorker(train_fn, print_period=0)
         if isinstance(checkpoint, CheckpointManager):
             self.ckpt = checkpoint
@@ -206,6 +207,17 @@ class ResilientTrainer:
             self.worker.ledger = self.ledger
             if hasattr(train_fn, "ledger"):  # ScanTrainStep h2d staging
                 train_fn.ledger = self.ledger
+        # observatory=True registers every executable this trainer builds
+        # with the process-global CompileObservatory (ISSUE 12): signature
+        # fingerprints, AOT cost/memory analyses, culprit-named recompile
+        # events. Off = the same one-predicate contract as goodput.
+        self.observatory = None
+        if observatory:
+            from ..obs.compile_observatory import compile_observatory
+            self.observatory = compile_observatory().enable()
+            self.worker.observatory = self.observatory
+            if hasattr(train_fn, "observatory"):  # Sharded/ScanTrainStep
+                train_fn.observatory = self.observatory
         # pdtpu_train_* exporter: throughput gauges read the worker's
         # tracker, counters are fed from _event / the checkpoint sites
         self.metrics = TrainingMetrics(tracker=self.worker.throughput,

@@ -41,6 +41,11 @@ class DeviceWorker:
         # flips it to "rollback_waste" while re-running rolled-back steps
         self.ledger = None
         self.ledger_phase = "compute"
+        # compile observatory (obs.compile_observatory) — None keeps the
+        # hook at one predicate; when armed, every dispatch's abstract
+        # signature is fingerprinted/registered before the train fn runs
+        # (before, because sharded steps donate their arguments)
+        self.observatory = None
 
     def run_step(self, batch):
         """One step: unpack the batch, run the train fn, track the loss.
@@ -51,6 +56,11 @@ class DeviceWorker:
         args = batch if isinstance(batch, (tuple, list)) else (batch,)
         if self.scan_steps > 1:
             return self._run_chunk(args)
+        if self.observatory is not None:
+            import time
+            self.observatory.observe_call(
+                "train/device_worker", self.train_fn, args)
+            t0 = time.perf_counter()
         if self.ledger is not None:
             with self.ledger.measure(self.ledger_phase):
                 loss = self.train_fn(*args)
@@ -58,6 +68,12 @@ class DeviceWorker:
                 1, productive=(self.ledger_phase == "compute"))
         else:
             loss = self.train_fn(*args)
+        if self.observatory is not None:
+            # async dispatch: this span is launch (+ any blocking the fn
+            # itself does), a floor on device execution for the registry
+            import time
+            self.observatory.note_device_seconds(
+                "train/device_worker", time.perf_counter() - t0)
         self.steps += 1
         self.last_loss = loss
         if self.print_period and self.steps % self.print_period == 0:
@@ -78,6 +94,9 @@ class DeviceWorker:
         import time
 
         import numpy as np
+        if self.observatory is not None:
+            self.observatory.observe_call(
+                "train/device_worker", self.train_fn, args)
         t0 = time.perf_counter()
         if self.ledger is not None:
             with self.ledger.measure(self.ledger_phase):
@@ -92,9 +111,13 @@ class DeviceWorker:
             loss = self.train_fn(*args)
             losses = np.atleast_1d(np.asarray(
                 loss.data if isinstance(loss, Tensor) else loss))
-        self.throughput.update(steps=losses.size,
-                               seconds=time.perf_counter() - t0,
+        dt = time.perf_counter() - t0
+        self.throughput.update(steps=losses.size, seconds=dt,
                                tokens=self._chunk_tokens(args))
+        if self.observatory is not None:
+            # the loss vector was materialized above, so dt covers the
+            # device execution of this chunk's executable
+            self.observatory.note_device_seconds("train/device_worker", dt)
         for v in losses:
             self.steps += 1
             if self.print_period and self.steps % self.print_period == 0:

@@ -13,12 +13,20 @@
 - `serving_ledger` (ISSUE 11) — the serving economics ledger (pump
   phase tiling, token efficiency, per-tenant/per-class device-seconds)
   and the SLO burn-rate monitor;
+- `compile_observatory` (ISSUE 12) — the process-global registry of
+  every jitted executable (signature fingerprints, AOT cost/memory
+  analyses, dispatch + device-seconds accounting) and the recompile
+  explainer that names the culprit leaf behind every post-warmup
+  recompile;
 - `flops` — the analytic FLOPs / peak-FLOPs helpers bench.py and the
   live MFU gauges share.
 
 Stdlib-only and import-light: serving and training both depend on this
 package, never the other way around.
 """
+from .compile_observatory import (CompileObservatory, compile_observatory,
+                                  diff_signatures, fingerprint_of,
+                                  signature_of)
 from .flight_recorder import DUMP_DIR_ENV, FlightRecorder, flight_recorder
 from .flops import (conv_train_flops_per_step, decode_flops_per_token,
                     decode_mfu, peak_flops, train_flops_per_step)
@@ -31,6 +39,8 @@ from .trace import (LLM_PHASES, SERVING_PHASES, RequestTrace, TimelineStore,
                     ingest_traceparent, new_request_id)
 
 __all__ = [
+    "CompileObservatory", "compile_observatory", "diff_signatures",
+    "fingerprint_of", "signature_of",
     "DUMP_DIR_ENV", "FlightRecorder", "flight_recorder",
     "conv_train_flops_per_step", "decode_flops_per_token", "decode_mfu",
     "peak_flops", "train_flops_per_step",

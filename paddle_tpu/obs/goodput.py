@@ -248,19 +248,37 @@ def _emit_chrome_span(lane: str, t_in: float, t_out: float):
 #
 # jax.monitoring listeners cannot be unregistered through public API, so
 # ONE module-level dispatcher is registered (at most once per process)
-# and fans out to whichever sentinels are currently installed.
+# and fans out to whichever sentinels are currently installed. The
+# jit-cache fallback mirrors the same shape: one module-level miss
+# listener fanning out, never a per-sentinel registration. Each
+# dispatcher only feeds sentinels installed on ITS source, and "auto"
+# resolution is pinned process-wide on first use — a JitLRUCache build
+# that also fires jax's backend_compile event can therefore never reach
+# the same sentinel through both paths (ISSUE 12 satellite: the
+# double-counting fix).
 _DISPATCH_LOCK = threading.Lock()
 _ACTIVE_SENTINELS: set = set()
 _MONITORING_REGISTERED = False
+_JIT_CACHE_REGISTERED = False
+_PROCESS_SOURCE: Optional[str] = None   # pinned by the first "auto" install
 
 
 def _monitoring_dispatch(event: str, duration: float, **_kw):
     if event != COMPILE_EVENT:
         return
     with _DISPATCH_LOCK:
-        active = list(_ACTIVE_SENTINELS)
+        active = [s for s in _ACTIVE_SENTINELS
+                  if s.installed == "monitoring"]
     for s in active:
         s.on_compile(duration)
+
+
+def _jit_cache_dispatch(name, key, seconds):
+    with _DISPATCH_LOCK:
+        active = [s for s in _ACTIVE_SENTINELS
+                  if s.installed == "jit_cache"]
+    for s in active:
+        s.on_compile(seconds)
 
 
 class RecompileSentinel:
@@ -315,52 +333,88 @@ class RecompileSentinel:
                 "train_recompile", recompiles=count,
                 seconds=round(seconds, 6), storm=storm)
             if storm:
+                # the compile observatory (when armed) knows WHICH leaf
+                # churned; grouping by culprit turns "3 recompiles" into
+                # an actionable shape to bucket (ISSUE 12)
+                from .compile_observatory import culprit_summary
+                grouped = culprit_summary()
                 _log.warning(
                     "recompile storm: %d XLA compilations after warmup "
                     "(threshold %d) — the step fn's static shapes are "
-                    "churning; bucket the shapes at the call site",
-                    count, self.storm_threshold)
+                    "churning; bucket the shapes at the call site%s",
+                    count, self.storm_threshold,
+                    f" (recompiles by culprit: {grouped})" if grouped
+                    else "")
 
     # jit-cache fallback: JitLRUCache miss listeners carry (name, key,
-    # build_seconds)
+    # build_seconds). Kept for back-compat with callers that registered
+    # the bound method directly; the install() path now routes through
+    # the module-level _jit_cache_dispatch instead.
     def _on_cache_miss(self, name, key, seconds):
         self.on_compile(seconds)
 
     def install(self, source: str = "auto") -> "RecompileSentinel":
         """Start observing compilations. `source`: "monitoring" (jax's
         per-compile event), "jit_cache" (JitLRUCache miss hooks), or
-        "auto" (monitoring where available, cache hooks otherwise)."""
+        "auto" (monitoring where available, cache hooks otherwise —
+        resolved ONCE per process so both sources can never observe the
+        same build)."""
+        global _MONITORING_REGISTERED, _JIT_CACHE_REGISTERED
+        global _PROCESS_SOURCE
         if self.installed is not None:
             return self
+        if source == "auto":
+            with _DISPATCH_LOCK:
+                if _PROCESS_SOURCE is not None:
+                    source = _PROCESS_SOURCE
         if source in ("auto", "monitoring"):
             try:
                 import jax.monitoring
-                global _MONITORING_REGISTERED
                 with _DISPATCH_LOCK:
                     if not _MONITORING_REGISTERED:
                         jax.monitoring \
                             .register_event_duration_secs_listener(
                                 _monitoring_dispatch)
                         _MONITORING_REGISTERED = True
+                    # installed is tagged before the sentinel joins the
+                    # set: the dispatchers filter on it, and an untagged
+                    # member would be invisible to both
+                    self.installed = "monitoring"
                     _ACTIVE_SENTINELS.add(self)
-                self.installed = "monitoring"
+                    if _PROCESS_SOURCE is None:
+                        _PROCESS_SOURCE = "monitoring"
                 return self
             except Exception:
                 if source == "monitoring":
                     raise
         from ..utils import jit_cache
-        jit_cache.add_miss_listener(self._on_cache_miss)
-        self.installed = "jit_cache"
+        with _DISPATCH_LOCK:
+            if not _JIT_CACHE_REGISTERED:
+                jit_cache.add_miss_listener(_jit_cache_dispatch)
+                _JIT_CACHE_REGISTERED = True
+            self.installed = "jit_cache"
+            _ACTIVE_SENTINELS.add(self)
+            if _PROCESS_SOURCE is None and source == "auto":
+                _PROCESS_SOURCE = "jit_cache"
         return self
 
     def uninstall(self):
-        if self.installed == "monitoring":
-            with _DISPATCH_LOCK:
-                _ACTIVE_SENTINELS.discard(self)
-        elif self.installed == "jit_cache":
+        global _JIT_CACHE_REGISTERED
+        with _DISPATCH_LOCK:
+            was = self.installed
+            self.installed = None
+            _ACTIVE_SENTINELS.discard(self)
+            # the monitoring listener cannot be unregistered (jax has no
+            # API for it); the jit-cache one can, so drop it when the
+            # last jit_cache sentinel leaves
+            drop = (was == "jit_cache" and _JIT_CACHE_REGISTERED
+                    and not any(s.installed == "jit_cache"
+                                for s in _ACTIVE_SENTINELS))
+            if drop:
+                _JIT_CACHE_REGISTERED = False
+        if drop:
             from ..utils import jit_cache
-            jit_cache.remove_miss_listener(self._on_cache_miss)
-        self.installed = None
+            jit_cache.remove_miss_listener(_jit_cache_dispatch)
 
     def snapshot(self) -> dict:
         with self._lock:

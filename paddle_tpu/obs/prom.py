@@ -282,11 +282,21 @@ class MetricsServer:
             def do_GET(self):
                 if self.path == "/metrics":
                     text = "".join(fn() for fn in render_fns)
+                    # pdtpu_compile_* families ride the same scrape; ""
+                    # unless the process armed the observatory (ISSUE 12)
+                    from .compile_observatory import \
+                        render_prom as _compile_render_prom
+                    text += _compile_render_prom()
                     self._reply(200, text.encode(),
                                 "text/plain; version=0.0.4")
                 elif self.path == "/debug/flightrecorder":
                     from .flight_recorder import flight_recorder
                     body = json.dumps(flight_recorder().snapshot()).encode()
+                    self._reply(200, body, "application/json")
+                elif self.path == "/debug/compiles":
+                    from .compile_observatory import compile_observatory
+                    body = json.dumps(
+                        compile_observatory().snapshot(top=50)).encode()
                     self._reply(200, body, "application/json")
                 elif self.path == "/healthz":
                     self._reply(200, b"ok\n", "text/plain")

@@ -221,6 +221,11 @@ class ShardedTrainStep:
         self.plan = plan
         self._step_count = 0
         self.zero_stage = zero_stage
+        # compile observatory (obs.compile_observatory) — None keeps the
+        # dispatch hook at one predicate. The observe runs BEFORE the
+        # jitted call: donate_argnums consumes params/opt/buffers, so a
+        # post-dispatch signature walk would touch deleted buffers
+        self.observatory = None
 
         amp_cfg = plan.amp if plan is not None else None
         use_scaler = bool(
@@ -603,6 +608,11 @@ class ShardedTrainStep:
         rng = jax.random.fold_in(self._base_rng, self._step_count)
         opt_in = (jax.device_put(self._opt_state, self._opt_dev_sh)
                   if self._offload else self._opt_state)
+        if self.observatory is not None:
+            self.observatory.observe_call(
+                "train/sharded_step", self._jitted,
+                (self._params, opt_in, self._buffers, self._extras, lr,
+                 step, rng, tuple(arrays)))
         (loss, self._params, opt_out, self._buffers,
          self._extras) = self._jitted(
             self._params, opt_in, self._buffers, self._extras, lr,
@@ -809,6 +819,11 @@ class ScanTrainStep(ShardedTrainStep):
         self._step_count += K
         opt_in = (jax.device_put(self._opt_state, self._opt_dev_sh)
                   if self._offload else self._opt_state)
+        if self.observatory is not None:
+            self.observatory.observe_call(
+                "train/scan_chunk", self._chunk_jitted,
+                (self._params, opt_in, self._buffers, self._extras, lr_vec,
+                 steps_vec, self._base_rng, tuple(arrays)))
         (losses, self._params, opt_out, self._buffers,
          self._extras) = self._chunk_jitted(
             self._params, opt_in, self._buffers, self._extras, lr_vec,

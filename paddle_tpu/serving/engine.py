@@ -92,6 +92,9 @@ class EngineConfig:
     #                                (ISSUE 11): pump phase tiling +
     #                                pad-waste token efficiency; off = one
     #                                predicate per hook
+    observatory: bool = False      # register every predict executable with
+    #                                the process-global CompileObservatory
+    #                                (ISSUE 12); off = one predicate
 
     def __post_init__(self):
         if self.max_batch_size < 1:
@@ -204,6 +207,11 @@ class BatchingEngine:
             from ..obs.serving_ledger import ServingLedger
             self.ledger = ServingLedger(clock=self.clock.now)
         self.metrics.ledger = self.ledger
+        # compile observatory (ISSUE 12): None unless armed
+        self.observatory = None
+        if self.config.observatory:
+            from ..obs.compile_observatory import compile_observatory
+            self.observatory = compile_observatory().enable()
 
     @classmethod
     def from_predictor(cls, predictor, config: Optional[EngineConfig] = None,
@@ -565,7 +573,12 @@ class BatchingEngine:
                         [a,
                          np.zeros((padded - total,) + a.shape[1:], a.dtype)],
                         axis=0) for a in args]
-            tc0 = self.clock.now() if self.ledger is not None else None
+            if self.observatory is not None:
+                self.observatory.observe_call(
+                    "serve/predict", self.predict_fn, tuple(args))
+            tc0 = self.clock.now() \
+                if self.ledger is not None or self.observatory is not None \
+                else None
             outs = list(self._supervised_predict(args))
         except Exception as e:
             for r in batch:
@@ -573,16 +586,21 @@ class BatchingEngine:
                 r.future.set_exception(e)
             self.metrics.on_fail(len(batch))
             return
-        if self.ledger is not None:
+        if self.ledger is not None or self.observatory is not None:
             # block on the device results so the measured span is
             # execution; real rows are "prefill" positions and the pow2
             # pad rows are the waste token_efficiency exposes. The
             # stateless engine has no row ownership -> no owner meters.
             import jax
             jax.block_until_ready(outs)
-            self.ledger.book_dispatch(
-                self.clock.now() - tc0, prefill_positions=total,
-                decode_positions=0, total_positions=padded, owners=())
+            dt = self.clock.now() - tc0
+            if self.ledger is not None:
+                self.ledger.book_dispatch(
+                    dt, prefill_positions=total,
+                    decode_positions=0, total_positions=padded, owners=())
+            if self.observatory is not None:
+                # blocked above, so dt is device execution (ISSUE 12)
+                self.observatory.note_device_seconds("serve/predict", dt)
         # un-pad, then split batched outputs by request row counts
         trimmed = []
         for o in outs:

@@ -157,6 +157,11 @@ class LLMEngineConfig:
     #                                targets feeding the burn monitor; a
     #                                class absent from the dict counts every
     #                                prefill as a good outcome
+    # ---- compile observatory (ISSUE 12) ----
+    observatory: bool = False      # register every unified-step executable
+    #                                (signature fingerprint + AOT cost/memory
+    #                                analyses) with the process-global
+    #                                CompileObservatory; off = one predicate
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -379,6 +384,11 @@ class LLMEngine:
                 capture_s=self.config.slo_burn_capture_s)
         self.metrics.ledger = self.ledger
         self.metrics.burn = self.burn
+        # compile observatory (ISSUE 12): None unless armed
+        self.observatory = None
+        if self.config.observatory:
+            from ...obs.compile_observatory import compile_observatory
+            self.observatory = compile_observatory().enable()
         if fault_plan is None:
             from ...utils.fault_injection import global_plan
             fault_plan = global_plan()
@@ -1036,12 +1046,14 @@ class LLMEngine:
             args = (self.params, jnp.asarray(toks), jnp.asarray(pos),
                     jnp.asarray(adv), self.pool.device_block_table(),
                     self.pool.slabs)
+            if self.observatory is not None:
+                self.observatory.observe_call("llm/unified_step", fn, args)
             attempts = self.config.dispatch_retries + 1
             last_err = None
             nxt = None
             tc0 = None
             for attempt in range(attempts):
-                if self.ledger is not None:
+                if self.ledger is not None or self.observatory is not None:
                     # re-armed per attempt: a failed round's wall time
                     # stays in the host phase; only the successful
                     # dispatch's span is booked as compute
@@ -1075,25 +1087,32 @@ class LLMEngine:
                 self._fail_all_active(attempts, last_err)
                 self.supervisor.record_failure()
                 return 0
-            if self.ledger is not None:
+            if self.ledger is not None or self.observatory is not None:
                 # jit dispatch is async: block on the device result so the
                 # measured span is execution, not launch; split it between
                 # the compute phases by advanced positions and meter it to
                 # the rows' tenants / SLO classes (ISSUE 11)
                 jax.block_until_ready(nxt)
                 tc1 = self.clock.now()
-                with self._cond:
-                    owners = [(self._active[s].tenant, self._active[s].slo,
-                               int(adv[s]))
-                              for s in prefill_slots + decode_slots
-                              if s in self._active]
-                self.ledger.book_dispatch(
-                    tc1 - tc0,
-                    prefill_positions=int(sum(adv[s]
-                                              for s in prefill_slots)),
-                    decode_positions=len(decode_slots),
-                    total_positions=int(toks.size),
-                    owners=owners)
+                if self.ledger is not None:
+                    with self._cond:
+                        owners = [(self._active[s].tenant,
+                                   self._active[s].slo, int(adv[s]))
+                                  for s in prefill_slots + decode_slots
+                                  if s in self._active]
+                    self.ledger.book_dispatch(
+                        tc1 - tc0,
+                        prefill_positions=int(sum(adv[s]
+                                                  for s in prefill_slots)),
+                        decode_positions=len(decode_slots),
+                        total_positions=int(toks.size),
+                        owners=owners)
+                if self.observatory is not None:
+                    # the span above already blocked on the result, so it
+                    # is pure device execution — attribute it to this
+                    # call site's latest executable (ISSUE 12)
+                    self.observatory.note_device_seconds(
+                        "llm/unified_step", tc1 - tc0)
             nxt = np.asarray(nxt)
             now = self.clock.now()
             with self._cond:

@@ -211,6 +211,11 @@ class ServingServer:
                     text = "".join(e.metrics.render() for e in
                                    (outer.engine, outer.llm_engine)
                                    if e is not None)
+                    # pdtpu_compile_* families ride the same scrape; ""
+                    # unless some engine armed the observatory (ISSUE 12)
+                    from ..obs.compile_observatory import \
+                        render_prom as _compile_render_prom
+                    text += _compile_render_prom()
                     self._reply(200, text.encode(),
                                 ctype="text/plain; version=0.0.4")
                 elif self.path == "/debug/flightrecorder":
@@ -234,6 +239,15 @@ class ServingServer:
                                          if burn is not None else None),
                         }
                     self._reply_json(200, costs)
+                elif self.path == "/debug/compiles":
+                    # compile observatory (ISSUE 12): every registered
+                    # executable (fingerprint, compile seconds, AOT
+                    # cost/memory analyses, dispatches, device-seconds)
+                    # plus recompiles grouped by culprit — the registry is
+                    # process-global, so one table covers both engines
+                    from ..obs.compile_observatory import compile_observatory
+                    self._reply_json(
+                        200, compile_observatory().snapshot(top=50))
                 elif self.path == "/debug/requests":
                     ids = []
                     for e in outer._engines():

@@ -39,6 +39,31 @@ def remove_miss_listener(cb):
     _MISS_LISTENERS = [c for c in _MISS_LISTENERS if c != cb]
 
 
+# listeners that already got their one WARNING for raising (by id of the
+# registered callable); later raises from the same listener log at debug
+# so a persistently-broken observer cannot flood the build path's logs
+_WARNED_LISTENERS: set = set()
+
+
+def _notify_miss(name: str, key, seconds: float):
+    """Fan a miss out to every listener, isolating each: a listener that
+    raises must never poison the build, drop the executable, or starve
+    the listeners after it (ISSUE 12 satellite)."""
+    for cb in _MISS_LISTENERS:
+        try:
+            cb(name, key, seconds)
+        except Exception:
+            if id(cb) not in _WARNED_LISTENERS:
+                _WARNED_LISTENERS.add(id(cb))
+                _log.warning(
+                    "%s jit-cache miss listener %r raised; executable "
+                    "kept, listener isolated (further raises from it "
+                    "log at debug)", name, cb, exc_info=True)
+            else:
+                _log.debug("%s miss listener raised", name,
+                           exc_info=True)
+
+
 class JitLRUCache:
     """OrderedDict-backed LRU of compiled callables.
 
@@ -77,12 +102,7 @@ class JitLRUCache:
             t0 = time.monotonic()
             fn = build()
             dt = time.monotonic() - t0
-            for cb in _MISS_LISTENERS:
-                try:
-                    cb(self.name, key, dt)
-                except Exception:
-                    _log.debug("%s miss listener raised", self.name,
-                               exc_info=True)
+            _notify_miss(self.name, key, dt)
         else:
             fn = build()
         self._cache[key] = fn

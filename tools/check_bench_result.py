@@ -103,7 +103,14 @@ GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
              # provenance platform pinning as the train_* gates.
              "llm_token_efficiency": "higher",
              "llm_decode_mfu": "higher",
-             "llm_host_fraction": "lower"}
+             "llm_host_fraction": "lower",
+             # ISSUE 12 compile-observatory gates: the number of distinct
+             # executables the fused train step builds and the total XLA
+             # compile seconds it pays are CEILINGs — a change that
+             # sprouts extra program variants (shape churn, lost cache
+             # hits) or slower compiles must fail the gate
+             "compile_executables": "lower",
+             "compile_seconds_total": "lower"}
 
 
 def _metrics_of(row):
@@ -120,7 +127,8 @@ def _metrics_of(row):
               "llm_prefix_hit_rate", "llm_shared_prefill_tok_s",
               "train_goodput", "train_mfu_live",
               "llm_token_efficiency", "llm_decode_mfu",
-              "llm_host_fraction"):
+              "llm_host_fraction",
+              "compile_executables", "compile_seconds_total"):
         if extra.get(k) is not None:
             out[k] = float(extra[k])
     return out

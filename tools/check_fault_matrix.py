@@ -41,7 +41,13 @@ module: an injected dispatch_raise storm drives the interactive
 class's error-budget burn rate over the multi-window threshold, the
 latched `slo_burn` flight event lands in the black-box dump BEFORE the
 breaker_open it predicts, and the dump filters via
-`tools/flight_recorder.py --kind 'slo_*'`) — then
+`tools/flight_recorder.py --kind 'slo_*'`), and the ISSUE 12
+shape-churn scenario in tests/test_compile_observatory.py (`obs`-marked
+module: a post-warmup batch-size churn produces `compile_recompile`
+flight events that each NAME the culprit leaf (path + before→after
+shape), the per-culprit storm drops an atomic dump, and
+`tools/flight_recorder.py --kind 'compile_*'` renders the
+recompiles-grouped-by-culprit table) — then
 prints a pass/fail table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
@@ -69,6 +75,7 @@ TEST_FILES = [
     os.path.join("tests", "test_obs.py"),
     os.path.join("tests", "test_goodput.py"),
     os.path.join("tests", "test_serving_ledger.py"),
+    os.path.join("tests", "test_compile_observatory.py"),
 ]
 
 

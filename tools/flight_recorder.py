@@ -14,6 +14,9 @@ events land on the same timeline as the profiler spans:
         --merge trace.json -o merged.json                # chrome overlay
     python tools/flight_recorder.py dump.json --kind quarantine --kind reject
     python tools/flight_recorder.py dump.json --kind 'train_*'
+    python tools/flight_recorder.py dump.json --kind 'compile_*'
+    # compile_* selections append a recompiles-grouped-by-culprit table
+    # (ISSUE 12): which leaf churned, how often, at which call site
 
 Exit 0 on success, 2 on an unreadable/invalid dump.
 """
@@ -65,7 +68,31 @@ def render_postmortem(dump: dict, kinds: Optional[List[str]] = None) -> str:
             f"{e.get('kind', '?'):24s} {_fmt_info(e)}")
     if not events:
         lines.append("  (no events)")
+    culprits = group_recompiles(events)
+    if culprits:
+        lines.append("")
+        lines.append("recompiles by culprit:")
+        lines.append(f"  {'count':>5}  {'callsite':24s} culprit")
+        for (callsite, culprit), count in culprits:
+            lines.append(f"  {count:>5}  {callsite:24s} {culprit}")
     return "\n".join(lines)
+
+
+def group_recompiles(events: List[dict]) -> List[tuple]:
+    """Group compile_recompile events by (callsite, culprit leaf), most
+    frequent first — the table that turns a recompile storm from a count
+    into the specific argument to bucket. The culprit is grouped by its
+    leaf path (the part before the changed values), so `...shape:
+    (8,)→(16,)` and `...shape: (16,)→(24,)` land in one row."""
+    groups: dict = {}
+    for e in events:
+        if e.get("kind") != "compile_recompile":
+            continue
+        culprit = str(e.get("culprit", "unknown"))
+        leaf = culprit.split(": ")[0].strip() or "unknown"
+        key = (str(e.get("callsite", "?")), leaf)
+        groups[key] = groups.get(key, 0) + 1
+    return sorted(groups.items(), key=lambda kv: (-kv[1], kv[0]))
 
 
 def merge_chrome(dump: dict, trace_path: str, out_path: str) -> int:
