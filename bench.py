@@ -273,6 +273,25 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
     achieved = flops_per_step / dt / n_chips
     mfu = achieved / peak
 
+    # numerics-observatory overhead ceiling (ISSUE 13): rebuild the SAME
+    # chunked runner with in-step telemetry armed (per-group grad/param
+    # norms + update ratios computed inside the jitted chunk), warm it,
+    # and time one chunk. The delta vs the unarmed timed region above is
+    # the price of arming — gated as a CEILING so the telemetry can never
+    # silently grow into the step. The unarmed region keeps the existing
+    # floors untouched.
+    step_armed = ScanTrainStep(model, opt, mesh, scan_steps=iters,
+                               zero_stage=0, numerics=True)
+    warm = step_armed(ids_chunk, labels_chunk)
+    _ = float(np.asarray(warm.data)[-1])
+    t1 = time.perf_counter()
+    losses_armed = step_armed(ids_chunk, labels_chunk)
+    _ = float(np.asarray(losses_armed.data)[-1])
+    dt_armed = (time.perf_counter() - t1) / iters
+    numerics_overhead_pct = max(0.0, (dt_armed - dt) / dt * 100.0)
+    numerics_sample = step_armed.numerics_host_sample() or {}
+    train_grad_norm = numerics_sample.get("grad_norm/_total")
+
     result = {
         "metric": f"{unit_name}/sec/chip {preset} bs{B} seq{S} "
                   f"{'bf16' if on_tpu else 'fp32-cpu'} fused train step "
@@ -304,6 +323,12 @@ def run_bench(force_cpu: bool = False, init_err_note: str = None):
             # bench step sprouted extra program variants)
             "compile_executables": compile_snap["executables"],
             "compile_seconds_total": compile_snap["compile_seconds_total"],
+            # ISSUE 13 numerics-observatory rows: the armed-step overhead
+            # is gated as a CEILING; the grad norm is a provenance-stamped
+            # info row (never gated — it tracks the model, not the code)
+            "train_numerics_overhead_pct": round(numerics_overhead_pct, 2),
+            "train_grad_norm": (round(train_grad_norm, 4)
+                                if train_grad_norm is not None else None),
             "train_phase_seconds": {
                 k: round(v, 4)
                 for k, v in goodput_snap["phase_seconds"].items()},

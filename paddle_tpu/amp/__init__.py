@@ -86,25 +86,27 @@ class GradScaler:
             return
         self._unscaled.add(id(optimizer))
         from ..core.selected_rows import SelectedRows
-        # one fused finiteness check across all grads (single host sync);
-        # SelectedRows grads unscale their values in place of the dense body
+        # one fused finiteness check across all grads (single host sync,
+        # census shared with obs.numerics, ISSUE 13); SelectedRows grads
+        # unscale their values in place of the dense body
+        from ..obs.numerics import all_finite
         params = [p for p in (optimizer._parameter_list or [])
                   if p.grad is not None]
-        new_grads, checks = [], []
+        new_grads, checked = [], []
         for p in params:
             g = p.grad
             if isinstance(g, SelectedRows):
                 vals = g.values.astype(jnp.float32) / self._scale
                 new_grads.append(SelectedRows(g.rows, vals, g.height))
-                checks.append(jnp.all(jnp.isfinite(vals)))
+                checked.append(vals)
             else:
                 arr = g.data.astype(jnp.float32) / self._scale
                 new_grads.append(arr)
-                checks.append(jnp.all(jnp.isfinite(arr)))
+                checked.append(arr)
         if not new_grads:
             self._found_inf = False
             return
-        finite = jnp.all(jnp.stack(checks))
+        finite = all_finite(checked)
         for p, g in zip(params, new_grads):
             if isinstance(g, SelectedRows):
                 p.grad = g

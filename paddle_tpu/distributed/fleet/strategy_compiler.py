@@ -37,7 +37,7 @@ TRANSFORM_ORDER = ("qat", "sync_batch_norm", "amp", "lars", "lamb", "asp",
                    "recompute", "gradient_merge", "fp16_allreduce",
                    "quant_allreduce", "gradient_scale", "localsgd",
                    "adaptive_localsgd", "sequence_parallel", "sharding",
-                   "pipeline", "scan")
+                   "pipeline", "scan", "numerics")
 
 # Every public DistributedStrategy field falls in exactly one bucket (the
 # field audit in tests/test_strategy_flags.py enforces this, so a new field
@@ -56,7 +56,7 @@ CONSUMED_HERE = frozenset({
     "sharding", "sharding_configs", "pipeline", "pipeline_configs",
     "hybrid_configs", "fp16_allreduce", "gradient_scale_configs",
     "sync_batch_norm", "asp", "qat", "auto", "semi_auto", "scan_steps",
-    "quant_allreduce", "quant_allreduce_configs",
+    "quant_allreduce", "quant_allreduce_configs", "numerics",
 })
 CONSUMED_ELSEWHERE = {
     "a_sync": "fleet.init_worker/the_one_ps (PS async communicator)",
@@ -127,6 +127,9 @@ class CompiledStrategy:
     # K steps fused into one lax.scan dispatch (parallel.ScanTrainStep);
     # 1 = eager per-step dispatch
     scan_steps: int = 1
+    # training numerics observatory (obs.numerics): per-group grad/param
+    # norms + update ratios traced into the step's extras when armed
+    numerics: bool = False
     optimizer = None  # possibly swapped by lars/lamb
 
     def describe(self) -> str:
@@ -274,6 +277,9 @@ class StrategyCompiler:
         if scan_k > 1:
             plan.scan_steps = scan_k
             plan.applied.append("scan")
+        if getattr(strategy, "numerics", False):
+            plan.numerics = True
+            plan.applied.append("numerics")
 
         # conflict resolution (reference _disable_strategy protocol)
         localsgd_name = ("adaptive_localsgd" if plan.localsgd_adaptive

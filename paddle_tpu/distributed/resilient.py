@@ -179,7 +179,9 @@ class ResilientTrainer:
                  use_orbax: bool = True,
                  metrics_port: Optional[int] = None,
                  goodput: bool = False,
-                 observatory: bool = False):
+                 observatory: bool = False,
+                 numerics: bool = False,
+                 numerics_interval: int = 10):
         self.worker = DeviceWorker(train_fn, print_period=0)
         if isinstance(checkpoint, CheckpointManager):
             self.ckpt = checkpoint
@@ -218,11 +220,39 @@ class ResilientTrainer:
             self.worker.observatory = self.observatory
             if hasattr(train_fn, "observatory"):  # Sharded/ScanTrainStep
                 train_fn.observatory = self.observatory
+        # numerics=True arms the training numerics observatory (ISSUE 13):
+        # loss-spike sentinel, downsampled in-step telemetry reads, and the
+        # culprit-named non-finite blame probe on bad_loss. Off = the same
+        # one-predicate contract as goodput/observatory. Pass a
+        # NumericsObservatory to share/configure one; in-step telemetry
+        # additionally requires the step to be BUILT armed (strategy
+        # `numerics` flag or ShardedTrainStep(numerics=True)) — blame and
+        # the spike sentinel work either way.
+        self.numerics = None
+        if numerics:
+            from ..obs.numerics import NumericsObservatory
+            self.numerics = (numerics if isinstance(
+                numerics, NumericsObservatory)
+                else NumericsObservatory(interval=numerics_interval))
+            from ..flags import get_flags
+            if get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]:
+                import warnings
+                warnings.warn(
+                    "FLAGS_check_nan_inf (jax_debug_nans) and the numerics "
+                    "observatory are both armed: debug_nans re-runs the "
+                    "first non-finite op un-jitted and RAISES there, so the "
+                    "step never returns a loss and the observatory's "
+                    "culprit-named blame probe (and rollback) never runs. "
+                    "Prefer numerics=True alone in production; reserve "
+                    "FLAGS_check_nan_inf for op-level debugging "
+                    "(docs/observability.md#training-numerics)",
+                    stacklevel=2)
         # pdtpu_train_* exporter: throughput gauges read the worker's
         # tracker, counters are fed from _event / the checkpoint sites
         self.metrics = TrainingMetrics(tracker=self.worker.throughput,
                                        ledger=self.ledger, hbm=self.hbm,
-                                       sentinel=self.sentinel)
+                                       sentinel=self.sentinel,
+                                       numerics=self.numerics)
         env_port = os.environ.get("PDTPU_METRICS_PORT")
         if metrics_port is None and env_port:
             metrics_port = int(env_port)
@@ -255,6 +285,47 @@ class ResilientTrainer:
         saves are not fault events."""
         self.metrics.on_event("checkpoint_save", step)
         flight_recorder().record("train_checkpoint_save", step=step)
+
+    # ---- numerics observatory hooks (obs.numerics, ISSUE 13) ----
+    def _numerics_tick(self, step: int, n: int, losses):
+        """Clean-step feed: per-step losses into the spike sentinel, plus
+        a downsampled host read of the in-step telemetry scalars. Callers
+        guard with the one-predicate `self.numerics is not None`."""
+        for i, v in enumerate(losses):
+            self.numerics.observe_loss(step + i, float(v))
+        if not self.numerics.should_sample(step + n, n):
+            return
+        fn = getattr(self.worker.train_fn, "numerics_host_sample", None)
+        sample = fn() if fn is not None else None
+        if sample:
+            self.numerics.observe_sample(step + n, sample)
+
+    def _numerics_blame(self, bad_step: int, batch, idx: Optional[int]):
+        """Culprit-named non-finite blame: re-run the bad step's batch
+        through the step's jitted blame probe (grad/param leaf census,
+        no update) and emit the `train_nonfinite` flight event + dump —
+        BEFORE the rollback destroys the evidence. Probe wall time is
+        booked as rollback_waste: it is recovery overhead, not training.
+        `idx` selects the poisoned row of a stacked chunk batch."""
+        probe = getattr(self.worker.train_fn, "nonfinite_blame", None)
+        if probe is None:
+            return  # plain train fns have no loss closure to probe
+        args = batch if isinstance(batch, (tuple, list)) else (batch,)
+        if idx is not None:
+            from ..core.tensor import Tensor
+            args = tuple(
+                (a.data if isinstance(a, Tensor) else a)[idx] for a in args)
+        try:
+            if self.ledger is not None:
+                with self.ledger.measure("rollback_waste"):
+                    report = probe(bad_step + 1, *args)
+            else:
+                report = probe(bad_step + 1, *args)
+        except Exception as e:  # never let forensics mask the recovery
+            print(f"[resilient] non-finite blame probe failed at step "
+                  f"{bad_step}: {type(e).__name__}: {e}", file=sys.stderr)
+            return
+        self.numerics.observe_nonfinite(bad_step, report)
 
     # ---- preemption ----
     def _install_signal_handlers(self):
@@ -421,6 +492,10 @@ class ResilientTrainer:
                                     if step + n <= watermark else "compute")
                             else:
                                 batch = batch_fn(step)
+                            # nan_input/inf_input faults poison the batch
+                            # itself so the blame probe sees genuinely
+                            # non-finite device gradients
+                            batch = self.plan.corrupt_batch(step, batch, n)
                             loss = self.worker.run_step(batch)
                         if watchdog is not None:
                             watchdog.step_end()
@@ -465,6 +540,11 @@ class ResilientTrainer:
                         self._event("bad_loss", bad_step,
                                     value=str(float(vec[bad[0]])),
                                     chunk_start=step)
+                        if self.numerics is not None:
+                            # blame BEFORE abort/rollback destroys the
+                            # evidence (params are about to be restored)
+                            self._numerics_blame(bad_step, batch,
+                                                 idx=int(bad[0]))
                         if self.config.nan_policy == "abort":
                             raise UnrecoverableError(
                                 f"non-finite loss {float(vec[bad[0]])} at "
@@ -478,6 +558,8 @@ class ResilientTrainer:
                     val = _loss_value(loss)
                     if val is not None and not math.isfinite(val):
                         self._event("bad_loss", step, value=str(val))
+                        if self.numerics is not None:
+                            self._numerics_blame(step, batch, idx=None)
                         if self.config.nan_policy == "abort":
                             raise UnrecoverableError(
                                 f"non-finite loss {val} at step {step} "
@@ -492,6 +574,12 @@ class ResilientTrainer:
                                         consecutive=esc["skips"])
                             step += 1  # skip the batch, don't checkpoint it
                         continue
+                if self.numerics is not None:
+                    # clean step(s): feed the spike sentinel and (on the
+                    # numerics_interval) sample the in-step telemetry
+                    self._numerics_tick(
+                        step, n, [float(v) for v in vec] if n > 1
+                        else ([] if val is None else [val]))
                 esc["skips"] = 0
                 last_loss = loss
                 step += n

@@ -211,6 +211,11 @@ class DistributedStrategy:
         # 1 = eager per-step dispatch. FLAGS_scan_chunk overrides when left
         # at the default.
         self.scan_steps = 1
+        # parity-plus: arm the training numerics observatory (obs.numerics,
+        # ISSUE 13) — per-group grad/param norms and update ratios traced
+        # into the jitted step's extras. Off by default: the disarmed step
+        # is bit-identical to one built before the flag existed.
+        self.numerics = False
         self.without_graph_optimization = False
         self.asp = False
         self.qat = False

@@ -88,6 +88,17 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=Fals
             sum(jnp.sum(jnp.power(jnp.abs(p.grad.data.astype(jnp.float32)),
                                   norm_type)) for p in params),
             1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        # torch parity: the default (False) silently scales by the
+        # non-finite norm (factor underflows to 0 against inf, and NaN
+        # poisons the grads — which the numerics observatory then blames);
+        # True turns the condition into an immediate, named failure. The
+        # host sync only happens when the caller opted into the check.
+        raise RuntimeError(
+            f"The total norm of order {norm_type} for gradients from "
+            "`parameters` is non-finite, so it cannot be clipped. To "
+            "disable this error and scale the gradients with the "
+            "non-finite norm anyway, set error_if_nonfinite=False")
     factor = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
     for p in params:
         p.grad.data = (p.grad.data.astype(jnp.float32) * factor).astype(

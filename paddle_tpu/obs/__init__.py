@@ -19,7 +19,11 @@
   explainer that names the culprit leaf behind every post-warmup
   recompile;
 - `flops` — the analytic FLOPs / peak-FLOPs helpers bench.py and the
-  live MFU gauges share.
+  live MFU gauges share;
+- `numerics` (ISSUE 13) — the training numerics observatory: in-step
+  grad/param/update-ratio telemetry, the culprit-named non-finite blame
+  report, and the loss-spike sentinel, plus the shared non-finite
+  counting helpers amp/pipeline reuse.
 
 Stdlib-only and import-light: serving and training both depend on this
 package, never the other way around.
@@ -32,6 +36,9 @@ from .flops import (conv_train_flops_per_step, decode_flops_per_token,
                     decode_mfu, peak_flops, train_flops_per_step)
 from .goodput import (PHASES, GoodputLedger, HBMTelemetry, PhaseLedger,
                       RecompileSentinel, oom_forensics)
+from .numerics import (NumericsObservatory, all_finite, bracket_path,
+                       current_numerics, nonfinite_count, nonfinite_total,
+                       telemetry_groups)
 from .prom import MetricsServer, PromBuilder, TrainingMetrics, parse_exposition
 from .serving_ledger import (SERVING_LEDGER_PHASES, ServingLedger,
                              SLOBurnMonitor)
@@ -46,6 +53,8 @@ __all__ = [
     "peak_flops", "train_flops_per_step",
     "PHASES", "GoodputLedger", "HBMTelemetry", "PhaseLedger",
     "RecompileSentinel", "oom_forensics",
+    "NumericsObservatory", "all_finite", "bracket_path", "current_numerics",
+    "nonfinite_count", "nonfinite_total", "telemetry_groups",
     "SERVING_LEDGER_PHASES", "ServingLedger", "SLOBurnMonitor",
     "MetricsServer", "PromBuilder", "TrainingMetrics", "parse_exposition",
     "LLM_PHASES", "SERVING_PHASES", "RequestTrace", "TimelineStore",

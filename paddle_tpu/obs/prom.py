@@ -10,8 +10,9 @@ scraped — the same way:
   from `profiler.ThroughputTracker` plus rollback/retry/checkpoint
   counters fed by `ResilientTrainer`;
 - `MetricsServer` — a tiny opt-in stdlib HTTP exporter (`metrics_port=`)
-  serving `/metrics` and `/debug/flightrecorder` for processes that are
-  not already behind `serving.ServingServer`.
+  serving `/metrics`, `/debug/flightrecorder`, `/debug/compiles`, and
+  `/debug/numerics` for processes that are not already behind
+  `serving.ServingServer`.
 """
 from __future__ import annotations
 
@@ -162,7 +163,8 @@ class TrainingMetrics:
         "resumed": "resumes", "checkpoint_save": "checkpoint_saves",
     }
 
-    def __init__(self, tracker=None, ledger=None, hbm=None, sentinel=None):
+    def __init__(self, tracker=None, ledger=None, hbm=None, sentinel=None,
+                 numerics=None):
         self._lock = threading.Lock()
         self.tracker = tracker  # profiler.ThroughputTracker or None
         # ISSUE 10 goodput providers, all optional and sampled at render
@@ -170,6 +172,7 @@ class TrainingMetrics:
         self.ledger = ledger        # obs.goodput.GoodputLedger
         self.hbm = hbm              # obs.goodput.HBMTelemetry
         self.sentinel = sentinel    # obs.goodput.RecompileSentinel
+        self.numerics = numerics    # obs.numerics.NumericsObservatory
         self.counters: Dict[str, int] = {
             v: 0 for v in self._EVENT_COUNTERS.values()}
         self.last_step = 0
@@ -197,6 +200,8 @@ class TrainingMetrics:
             s["hbm"] = self.hbm.snapshot()
         if self.sentinel is not None:
             s["recompile"] = self.sentinel.snapshot()
+        if self.numerics is not None:
+            s["numerics"] = self.numerics.snapshot()
         return s
 
     def render(self) -> str:
@@ -253,7 +258,12 @@ class TrainingMetrics:
                 for comp, nbytes in sorted(h["attributed"].items()):
                     b.sample(f"{px}_hbm_attributed_bytes", nbytes,
                              labels={"component": comp})
-        return b.render()
+        text = b.render()
+        if self.numerics is not None:
+            # pdtpu_train_numerics_* families; "" until the observatory
+            # has recorded anything, so unarmed scrapes stay byte-identical
+            text += self.numerics.render_prom()
+        return text
 
 
 class MetricsServer:
@@ -297,6 +307,10 @@ class MetricsServer:
                     from .compile_observatory import compile_observatory
                     body = json.dumps(
                         compile_observatory().snapshot(top=50)).encode()
+                    self._reply(200, body, "application/json")
+                elif self.path == "/debug/numerics":
+                    from .numerics import debug_snapshot
+                    body = json.dumps(debug_snapshot()).encode()
                     self._reply(200, body, "application/json")
                 elif self.path == "/healthz":
                     self._reply(200, b"ok\n", "text/plain")

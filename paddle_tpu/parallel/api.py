@@ -209,11 +209,13 @@ class ShardedTrainStep:
 
     def __init__(self, model: Layer, optimizer, mesh: Mesh,
                  loss_fn: Optional[Callable] = None, zero_stage: int = 1,
-                 donate: bool = True, plan=None, min_shard_numel: int = 1024):
+                 donate: bool = True, plan=None, min_shard_numel: int = 1024,
+                 numerics: bool = False):
         if plan is not None:
             zero_stage = plan.zero_stage
             optimizer = plan.optimizer or optimizer
             min_shard_numel = plan.zero_min_numel
+            numerics = numerics or bool(getattr(plan, "numerics", False))
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
@@ -226,6 +228,11 @@ class ShardedTrainStep:
         # jitted call: donate_argnums consumes params/opt/buffers, so a
         # post-dispatch signature walk would touch deleted buffers
         self.observatory = None
+        # numerics observatory (obs.numerics, ISSUE 13): armed, the step
+        # traces per-group grad/param norms and update ratios into the
+        # extras carry — a DIFFERENT executable, so the disarmed step's
+        # outputs stay bit-identical to a never-armed trainer's
+        self.numerics_armed = bool(numerics)
 
         amp_cfg = plan.amp if plan is not None else None
         use_scaler = bool(
@@ -370,6 +377,16 @@ class ShardedTrainStep:
             extras["bad_steps"] = put(jnp.asarray(0, jnp.int32), P())
             for k in ("loss_scale", "good_steps", "bad_steps"):
                 extras_specs[k] = NamedSharding(mesh, P())
+        if self.numerics_armed:
+            from ..obs.numerics import (in_step_telemetry, telemetry_groups,
+                                        telemetry_keys)
+            num_groups = telemetry_groups(params.keys())
+            extras["numerics"] = {
+                key: put(jnp.float32(0.0), P())
+                for key in telemetry_keys(num_groups)}
+            extras_specs["numerics"] = {
+                key: NamedSharding(mesh, P())
+                for key in extras["numerics"]}
         if use_ef:
             # error-feedback residual: the rounding error of each synced
             # grad, re-injected into the next sync; only tensors large
@@ -440,6 +457,15 @@ class ShardedTrainStep:
             # analog; per-layer policies live in the models themselves)
             compute_loss = jax.checkpoint(compute_loss)
 
+        # kept for the non-finite blame probe (nonfinite_blame): the same
+        # loss closure — autocast/remat/sequence-parallel wrapping and all
+        # — re-differentiated on the poisoned batch, but WITHOUT donation
+        # or an update, so the census runs on the exact params that blew up
+        self._compute_loss_fn = compute_loss
+        self._blame_jitted = None
+        self._param_sizes = {k: int(np.prod(v.shape)) or 1
+                             for k, v in params.items()}
+
         def scaled_loss_fn(params_, buffers_, rng, scale, *arrays):
             loss, new_buffers = compute_loss(params_, buffers_, rng, *arrays)
             return loss * scale, (loss, new_buffers)
@@ -475,9 +501,10 @@ class ShardedTrainStep:
 
             new_extras = dict(extras_)
             if use_scaler:
-                finite = jnp.all(jnp.stack([
-                    jnp.all(jnp.isfinite(g))
-                    for g in jax.tree_util.tree_leaves(grads)]))
+                # shared non-finite census (obs.numerics, ISSUE 13): one
+                # implementation with GradScaler and the pipeline psum
+                from ..obs.numerics import all_finite as _all_finite
+                finite = _all_finite(jax.tree_util.tree_leaves(grads))
                 good = jnp.where(finite, extras_["good_steps"] + 1, 0)
                 bad = jnp.where(finite, 0, extras_["bad_steps"] + 1)
                 grow = good >= amp_cfg.incr_every_n_steps
@@ -562,6 +589,14 @@ class ShardedTrainStep:
                     for k, p in cand_params.items()}
             new_params = _tree_where(do_update, cand_params, params_)
             new_opt = _tree_where(do_update, cand_opt, opt_state_)
+            if self.numerics_armed:
+                # traced INTO this executable: the telemetry scalars ride
+                # the extras carry, so sampling them host-side costs a
+                # transfer of a few floats, never an extra dispatch.
+                # Norms read the unscaled pre-clip grads; update ratios
+                # read the actually-applied delta (zero on skipped steps)
+                new_extras["numerics"] = in_step_telemetry(
+                    num_groups, grads, params_, new_params)
             return loss, new_params, new_opt, new_buffers, new_extras
 
         self._train_step_fn = train_step  # exposed for jaxpr/HLO assertions
@@ -638,6 +673,75 @@ class ShardedTrainStep:
         s = self._extras.get("loss_scale")
         return None if s is None else float(s)
 
+    # ---- numerics observatory hooks (obs.numerics, ISSUE 13) ----
+    def numerics_host_sample(self) -> Optional[Dict[str, float]]:
+        """Host view of the in-step telemetry scalars the armed step left
+        in the extras carry (plus AMP loss-scale state when present).
+        Blocks only on a handful of replicated f32 scalars — the
+        downsampled read the trainer issues every numerics_interval
+        steps. None when the step was built without numerics."""
+        tele = self._extras.get("numerics")
+        if tele is None:
+            return None
+        import jax as _jax
+        sample = {k: float(v) for k, v in _jax.device_get(tele).items()}
+        for key in ("loss_scale", "good_steps", "bad_steps"):
+            if key in self._extras:
+                sample[key] = float(self._extras[key])
+        return sample
+
+    def nonfinite_blame(self, step: int, *args) -> Dict:
+        """Jitted per-leaf non-finite census on the CURRENT device params
+        and the given single-step batch: re-differentiates the step's own
+        loss closure (no update, no donation) and counts non-finite
+        elements per grad and param leaf. Returns ``{"loss": float,
+        "sizes": {name: numel}, "grads": {name: count>0}, "params":
+        {name: count>0}, "probe_seconds": float}``.
+
+        Compiled lazily on first use — a process that never sees a bad
+        loss never pays the probe's compile. ``step`` seeds the same
+        fold_in rng derivation the train step uses, so dropout masks
+        match when the step counters are aligned (deterministic models
+        reproduce exactly either way)."""
+        import time as _time
+        t0 = _time.perf_counter()
+        if self._blame_jitted is None:
+            compute_loss = self._compute_loss_fn
+            from ..obs.numerics import nonfinite_count
+
+            def probe(params_, buffers_, rng, arrays):
+                def loss_only(p):
+                    return compute_loss(p, buffers_, rng, *arrays)[0]
+
+                loss, grads = jax.value_and_grad(loss_only)(params_)
+                return (loss,
+                        {k: nonfinite_count(g) for k, g in grads.items()},
+                        {k: nonfinite_count(v)
+                         for k, v in params_.items()})
+
+            param_sh, _, buf_sh, _ = self._state_shardings
+            self._blame_jitted = jax.jit(
+                probe,
+                in_shardings=(param_sh, buf_sh, None, None),
+                out_shardings=self._scalar_sh)
+        arrays = []
+        for a in args:
+            arr = a.data if isinstance(a, Tensor) else jnp.asarray(a)
+            arrays.append(jax.device_put(
+                arr, NamedSharding(self.mesh, self._spec_for(arr))))
+        rng = jax.random.fold_in(self._base_rng, int(step))
+        loss, g, p = self._blame_jitted(
+            self._params, self._buffers, rng, tuple(arrays))
+        g = jax.device_get(g)
+        p = jax.device_get(p)
+        return {
+            "loss": float(loss),
+            "sizes": dict(self._param_sizes),
+            "grads": {k: int(v) for k, v in g.items() if int(v)},
+            "params": {k: int(v) for k, v in p.items() if int(v)},
+            "probe_seconds": round(_time.perf_counter() - t0, 6),
+        }
+
     # ---- state sync back to the eager model (checkpointing etc.) ----
     def sync_to_model(self):
         named = dict(self.model.named_parameters())
@@ -706,12 +810,12 @@ class ScanTrainStep(ShardedTrainStep):
     def __init__(self, model: Layer, optimizer, mesh: Mesh,
                  scan_steps: int = 8, loss_fn: Optional[Callable] = None,
                  zero_stage: int = 1, donate: bool = True, plan=None,
-                 min_shard_numel: int = 1024):
+                 min_shard_numel: int = 1024, numerics: bool = False):
         if plan is not None and getattr(plan, "scan_steps", 1) > 1:
             scan_steps = plan.scan_steps
         super().__init__(model, optimizer, mesh, loss_fn=loss_fn,
                          zero_stage=zero_stage, donate=donate, plan=plan,
-                         min_shard_numel=min_shard_numel)
+                         min_shard_numel=min_shard_numel, numerics=numerics)
         self.scan_steps = int(scan_steps)
         if self.scan_steps < 1:
             raise ValueError(f"scan_steps must be >= 1, got {scan_steps}")

@@ -999,11 +999,12 @@ class PipelinedTrainStep:
             if use_scaler:
                 # found-inf must agree on EVERY rank (grads are distributed
                 # over pipe/model shards) — psum the local non-finite count
-                # (hybrid_parallel_gradscaler's cross-group allreduce)
-                bad_local = sum(
-                    jnp.sum(~jnp.isfinite(g)).astype(jnp.int32)
-                    for g in (list(jax.tree_util.tree_leaves(g_stacked))
-                              + list(jax.tree_util.tree_leaves(g_rest))))
+                # (hybrid_parallel_gradscaler's cross-group allreduce;
+                # census shared with obs.numerics, ISSUE 13)
+                from ..obs.numerics import nonfinite_total
+                bad_local = nonfinite_total(
+                    list(jax.tree_util.tree_leaves(g_stacked))
+                    + list(jax.tree_util.tree_leaves(g_rest)))
                 bad_local = lax.psum(bad_local, PIPE_AXIS)
                 if mp_n > 1:
                     bad_local = lax.psum(bad_local, MODEL_AXIS)

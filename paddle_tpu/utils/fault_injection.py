@@ -9,6 +9,12 @@ Spec grammar (';'-separated clauses, each ``kind@step[:arg]``):
 
     nan_loss@3            inject a NaN loss at step 3
     inf_loss@3            inject an Inf loss at step 3
+    nan_input@3:1         poison batch element 1 with NaN at step 3 (the
+                          poison flows through the device forward/backward,
+                          so the numerics observatory's non-finite blame
+                          probe sees genuinely bad grad leaves — unlike
+                          nan_loss, which corrupts only the host-side loss)
+    inf_input@3           poison batch element 0 with Inf at step 3
     raise@5               raise RuntimeError at step 5 (transient-failure path)
     raise@5:OSError       raise a named builtin exception instead
     delay@7:2.5           sleep 2.5s inside step 7 (trips the watchdog)
@@ -182,6 +188,43 @@ class FaultPlan:
         if poisoned is None:
             return losses
         return Tensor(poisoned) if isinstance(losses, Tensor) else poisoned
+
+    def corrupt_batch(self, step0: int, batch, k: int = 1):
+        """Poison one batch array with NaN/Inf if a nan_input/inf_input
+        clause is scheduled in [step0, step0 + k): the real-data analog
+        of corrupt_loss — the poison flows through the device
+        forward/backward, so the non-finite blame probe (obs.numerics)
+        sees genuinely bad gradient leaves. ``arg`` selects the batch
+        element index (default 0); integer arrays are promoted to float32
+        so the poison is representable. For a stacked [K, ...] chunk
+        (k > 1) only the scheduled step's row is poisoned."""
+        hits = [f for f in self.faults
+                if not f.fired and f.kind in ("nan_input", "inf_input")
+                and step0 <= f.step < step0 + k]
+        if not hits:
+            return batch
+        import numpy as np
+
+        from ..core.tensor import Tensor
+        seq = isinstance(batch, (tuple, list))
+        items = list(batch) if seq else [batch]
+        for f in hits:
+            f.fired = True
+            self.log.append(repr(f))
+            idx = int(f.arg or 0)
+            if not (0 <= idx < len(items)):
+                continue
+            a = items[idx]
+            arr = np.asarray(a.data if isinstance(a, Tensor) else a)
+            arr = (arr.astype(np.float32) if arr.dtype.kind != "f"
+                   else arr.copy())
+            val = np.nan if f.kind == "nan_input" else np.inf
+            if k > 1:
+                arr[f.step - step0] = val
+            else:
+                arr[...] = val
+            items[idx] = Tensor(arr) if isinstance(a, Tensor) else arr
+        return (type(batch)(items) if seq else items[0])
 
     def maybe_raise(self, step: int):
         """Raise a transient-failure exception if scheduled for `step`."""

@@ -1,0 +1,568 @@
+"""Training numerics observatory (ISSUE 13): in-step grad/update
+telemetry riding the jitted step's extras carry, the culprit-named
+non-finite blame probe, the loss-spike sentinel, and the shared
+non-finite census helpers amp/pipeline/clip now delegate to — plus the
+fault-matrix scenario proving an injected inf_input poisons exactly one
+grad leaf and the `train_nonfinite` dump names it BEFORE the rollback
+restores the params."""
+import json
+import math
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, obs, optimizer as optim
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.obs import numerics as N
+from paddle_tpu.obs.numerics import NumericsObservatory
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "tools", "flight_recorder.py")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring_and_current():
+    obs.flight_recorder().clear()
+    yield
+    N.set_current(None)
+    obs.flight_recorder().clear()
+
+
+# ---- shared non-finite census helpers ----
+
+def test_nonfinite_count_and_total():
+    a = jnp.array([1.0, np.nan, np.inf, -np.inf])
+    assert int(N.nonfinite_count(a)) == 3
+    assert int(N.nonfinite_count(jnp.ones((2, 2)))) == 0
+    total = N.nonfinite_total([a, jnp.array([np.nan]), jnp.zeros(3)])
+    assert int(total) == 4
+    assert int(N.nonfinite_total([])) == 0
+
+
+def test_all_finite_matches_per_leaf_reference():
+    leaves = [jnp.ones((3, 2)), jnp.zeros(5), jnp.array([[2.0]])]
+    bad = [jnp.ones(3), jnp.array([1.0, np.nan])]
+    # parity pin vs the leaf-stacked formulation amp.GradScaler used
+    # before the unification (jnp.all over per-leaf jnp.all(isfinite))
+    ref = jnp.all(jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves]))
+    assert bool(N.all_finite(leaves)) == bool(ref) is True
+    assert bool(N.all_finite(bad)) is False
+    assert bool(N.all_finite([])) is True
+
+
+def test_gradscaler_unscale_uses_shared_census():
+    """Behavior pin for the amp unification: found_inf flips on a single
+    NaN element and stays clear for finite grads, through the shared
+    all_finite helper."""
+    from paddle_tpu.amp import GradScaler
+    paddle.seed(0)
+    lin = nn.Linear(4, 2)
+    opt = optim.SGD(learning_rate=0.1, parameters=lin.parameters())
+    scaler = GradScaler(init_loss_scaling=8.0)
+    for p in lin.parameters():
+        p.grad = Tensor(jnp.ones(p.shape, jnp.float32))
+    scaler.unscale_(opt)
+    assert scaler._found_inf is False
+    ps = list(lin.parameters())
+    assert float(np.asarray(ps[0].grad.data)[0, 0]) == pytest.approx(1 / 8)
+    scaler2 = GradScaler(init_loss_scaling=8.0)
+    bad = np.ones(ps[0].shape, np.float32)
+    bad[0, 0] = np.nan
+    ps[0].grad = Tensor(jnp.asarray(bad))
+    scaler2.unscale_(opt)
+    assert scaler2._found_inf is True
+
+
+# ---- telemetry grouping + culprit formatting ----
+
+def test_telemetry_groups_layer_granularity():
+    groups = N.telemetry_groups(
+        ["h.0.attn.wq.weight", "h.0.mlp.w1.weight", "h.11.attn.wq.weight",
+         "embed.weight", "lm_head.weight"])
+    assert set(groups) == {"h.0", "h.11", "embed", "lm_head"}
+    assert groups["h.0"] == ["h.0.attn.wq.weight", "h.0.mlp.w1.weight"]
+
+
+def test_telemetry_keys_order_is_deterministic():
+    keys = N.telemetry_keys({"b": ["b.x"], "a": ["a.y"]})
+    assert keys == [
+        "grad_norm/a", "grad_norm/b", "grad_norm/_total",
+        "param_norm/a", "param_norm/b", "param_norm/_total",
+        "update_ratio/a", "update_ratio/b", "update_ratio/_total"]
+
+
+def test_in_step_telemetry_norms_and_ratio():
+    grads = {"w": jnp.full((2, 2), 3.0), "b": jnp.zeros(4)}
+    old = {"w": jnp.full((2, 2), 4.0), "b": jnp.ones(4)}
+    new = {"w": jnp.full((2, 2), 4.0) + 0.4, "b": jnp.ones(4)}
+    out = N.in_step_telemetry(N.telemetry_groups(grads), grads, old, new)
+    assert float(out["grad_norm/w"]) == pytest.approx(6.0)      # sqrt(4*9)
+    assert float(out["param_norm/b"]) == pytest.approx(2.0)
+    assert float(out["update_ratio/w"]) == pytest.approx(
+        math.sqrt(4 * 0.4 ** 2) / 8.0)
+    assert float(out["update_ratio/b"]) == pytest.approx(0.0)
+    assert float(out["grad_norm/_total"]) == pytest.approx(6.0)
+
+
+def test_bracket_path_and_culprit_spelling():
+    assert N.bracket_path("h.3.attn.wq.weight") == \
+        "params['h'][3]['attn']['wq']['weight']"
+    assert N._human_count(1234567) == "1.2e6"
+    assert N._human_count(128) == "128"
+    assert N.format_leaf("h.3.attn.wq", "grad", 128, 1234567) == \
+        "params['h'][3]['attn']['wq'].grad: 128 non-finite of 1.2e6"
+
+
+# ---- the observatory: sampling cadence + spike sentinel ----
+
+def test_should_sample_eager_and_chunked_agree():
+    o = NumericsObservatory(interval=4)
+    eager = [s for s in range(1, 17) if o.should_sample(s, 1)]
+    assert eager == [4, 8, 12, 16]
+    chunked = [s for s in range(4, 17, 4) if o.should_sample(s, 4)]
+    assert chunked == [4, 8, 12, 16]
+    with pytest.raises(ValueError):
+        NumericsObservatory(interval=0)
+
+
+def test_spike_sentinel_fires_and_storm_dumps(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs.DUMP_DIR_ENV, str(tmp_path))
+    o = NumericsObservatory(spike_window=16, spike_zscore=6.0,
+                            spike_min_points=4, storm_threshold=2)
+    assert o.observe_loss(0, 1.0) is None          # warming up
+    for s in range(1, 8):
+        z = o.observe_loss(s, 1.0 + 0.01 * s)      # gentle drift: no fire
+    assert z is not None and abs(z) < 6.0
+    assert o.observe_loss(8, float("nan")) is None  # bad_loss path owns it
+    z = o.observe_loss(9, 40.0)
+    assert abs(z) >= 6.0 and o.loss_spikes == 1
+    events = obs.flight_recorder().snapshot()["events"]
+    spike = [e for e in events if e["kind"] == "train_loss_spike"]
+    assert spike and spike[0]["step"] == 9 and spike[0]["storm"] is False
+    # second spike reaches storm_threshold: warn once + dump
+    o.observe_loss(10, 55.0)
+    assert o.loss_spikes == 2
+    dump = tmp_path / f"pdtpu_flight_{os.getpid()}.json"
+    assert dump.exists()
+    assert json.loads(dump.read_text())["reason"] == "loss_spike_storm"
+
+
+def test_flat_window_never_fires_on_identical_losses():
+    o = NumericsObservatory(spike_min_points=3, spike_zscore=6.0)
+    for s in range(20):
+        o.observe_loss(s, 0.5)                      # MAD == 0 window
+    assert o.loss_spikes == 0
+    # but a genuine jump off the flat window still registers
+    assert abs(o.observe_loss(20, 1.0)) >= 6.0
+
+
+# ---- culprit-named blame digestion ----
+
+def test_observe_nonfinite_picks_worst_leaf_grad_first(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setenv(obs.DUMP_DIR_ENV, str(tmp_path))
+    o = NumericsObservatory()
+    culprit = o.observe_nonfinite(7, {
+        "loss": float("nan"),
+        "sizes": {"h.3.attn.wq": 1234567, "b": 4},
+        "grads": {"h.3.attn.wq": 128, "b": 4},
+        "params": {"h.3.attn.wq": 128},            # tie -> grad wins
+    })
+    assert culprit == \
+        "params['h'][3]['attn']['wq'].grad: 128 non-finite of 1.2e6"
+    assert o.nonfinite_events == 1
+    assert o.nonfinite_by_culprit == {
+        "params['h'][3]['attn']['wq'].grad": 1}
+    ev = [e for e in obs.flight_recorder().snapshot()["events"]
+          if e["kind"] == "train_nonfinite"][0]
+    assert ev["step"] == 7 and ev["culprit"] == culprit
+    assert ev["grad_nonfinite"] == 132 and ev["grad_leaves"] == 2
+    # blame always drops the black box (evidence outlives the rollback)
+    assert (tmp_path / f"pdtpu_flight_{os.getpid()}.json").exists()
+
+
+def test_observe_nonfinite_with_clean_leaves_says_downstream(tmp_path,
+                                                             monkeypatch):
+    monkeypatch.setenv(obs.DUMP_DIR_ENV, str(tmp_path))
+    o = NumericsObservatory()
+    culprit = o.observe_nonfinite(3, {"loss": float("inf"),
+                                      "sizes": {"w": 8},
+                                      "grads": {}, "params": {}})
+    assert "downstream of the gradients" in culprit
+    assert o.nonfinite_by_culprit == {"(none)": 1}
+
+
+# ---- exposition: prom families + /debug/numerics ----
+
+def test_render_prom_empty_until_first_record_then_families():
+    o = NumericsObservatory()
+    assert o.render_prom() == ""                   # scrape-identical off
+    o.observe_sample(10, {"grad_norm/h.0": 1.5, "grad_norm/_total": 2.0,
+                          "loss_scale": 1024.0})
+    flat = obs.parse_exposition(o.render_prom())
+    assert flat['pdtpu_train_numerics_grad_norm{group="h.0"}'] == 1.5
+    assert flat['pdtpu_train_numerics_grad_norm{group="_total"}'] == 2.0
+    assert flat["pdtpu_train_numerics_loss_scale"] == 1024.0
+    assert flat["pdtpu_train_numerics_sample_step"] == 10
+    assert flat["pdtpu_train_numerics_loss_spikes_total"] == 0
+
+
+def test_debug_snapshot_and_http_route(tmp_path):
+    from paddle_tpu.obs.prom import MetricsServer, TrainingMetrics
+    import urllib.request
+    N.set_current(None)
+    assert N.debug_snapshot() == {"armed": False}
+    o = NumericsObservatory(interval=2)            # ctor registers current
+    o.observe_sample(2, {"grad_norm/_total": 1.0})
+    o.observe_nonfinite(3, {"loss": float("nan"), "sizes": {"w": 4},
+                            "grads": {"w": 4}, "params": {}})
+    tm = TrainingMetrics(numerics=o)
+    srv = MetricsServer([tm.render]).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/debug/numerics",
+                                    timeout=30) as r:
+            doc = json.loads(r.read())
+        assert doc["armed"] is True and doc["nonfinite_events"] == 1
+        assert doc["nonfinite_by_culprit"] == {"params['w'].grad": 1}
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            flat = obs.parse_exposition(r.read().decode())
+        assert flat["pdtpu_train_numerics_nonfinite_events_total"] == 1
+        key = ('pdtpu_train_numerics_nonfinite_by_culprit_total'
+               '{culprit="params[\'w\'].grad"}')
+        assert flat[key] == 1
+    finally:
+        srv.stop()
+
+
+# ---- clip_grad_norm_ error_if_nonfinite semantics ----
+
+def test_clip_grad_norm_error_if_nonfinite():
+    from paddle_tpu.nn.clip import clip_grad_norm_
+    paddle.seed(0)
+    lin = nn.Linear(4, 2)
+    params = list(lin.parameters())
+    for p in params:
+        p.grad = Tensor(jnp.ones(p.shape, jnp.float32))
+    total = clip_grad_norm_(params, max_norm=1.0, error_if_nonfinite=True)
+    assert math.isfinite(float(np.asarray(total.data)))  # finite: no raise
+    bad = np.ones(params[0].shape, np.float32)
+    bad[0, 0] = np.inf
+    params[0].grad = Tensor(jnp.asarray(bad))
+    with pytest.raises(RuntimeError, match="non-finite"):
+        clip_grad_norm_(params, max_norm=1.0, error_if_nonfinite=True)
+    # default keeps torch's silent behavior (scale by the non-finite norm)
+    total = clip_grad_norm_(params, max_norm=1.0)
+    assert not math.isfinite(float(np.asarray(total.data)))
+
+
+# ---- armed step: extras carry, host sample, bit-identity, blame ----
+
+def _mesh(n=2):
+    return Mesh(np.array(jax.devices()[:n]), ("data",))
+
+
+def _sharded_step(numerics):
+    from paddle_tpu.distributed import DistributedStrategy
+    from paddle_tpu.distributed.fleet.strategy_compiler import \
+        StrategyCompiler
+    from paddle_tpu.parallel import ShardedTrainStep
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    opt = optim.AdamW(learning_rate=1e-2, parameters=model.parameters())
+    mesh = _mesh()
+    s = DistributedStrategy()
+    s.numerics = numerics
+    plan = StrategyCompiler().compile(s, opt, mesh)
+    if numerics:
+        assert plan.numerics is True and "numerics" in plan.applied
+    step = ShardedTrainStep(
+        model, opt, mesh,
+        loss_fn=lambda o, y: nn.functional.mse_loss(o, y), plan=plan)
+    return step, mesh
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(4, 8).astype(np.float32),
+            rng.randn(4, 4).astype(np.float32))
+
+
+def test_armed_step_telemetry_matches_host_recompute():
+    step, _ = _sharded_step(numerics=True)
+    before = {k: np.asarray(v) for k, v in step._params.items()}
+    x, y = _batch()
+    step(x, y)
+    sample = step.numerics_host_sample()
+    after = {k: np.asarray(v) for k, v in step._params.items()}
+    pn = math.sqrt(sum(float((a.astype(np.float64) ** 2).sum())
+                       for a in after.values()))
+    assert sample["param_norm/_total"] == pytest.approx(pn, rel=1e-4)
+    dn = math.sqrt(sum(float(((after[k] - before[k]).astype(
+        np.float64) ** 2).sum()) for k in after))
+    wn = math.sqrt(sum(float((b.astype(np.float64) ** 2).sum())
+                       for b in before.values()))
+    assert sample["update_ratio/_total"] == pytest.approx(dn / wn, rel=1e-3)
+    assert sample["grad_norm/_total"] > 0.0
+    assert set(sample) == set(N.telemetry_keys(
+        N.telemetry_groups(step._params.keys())))
+
+
+def test_unarmed_step_is_bit_identical_and_predicate_free():
+    armed, _ = _sharded_step(numerics=True)
+    plain, _ = _sharded_step(numerics=False)
+    assert plain._extras.get("numerics") is None
+    assert plain.numerics_host_sample() is None
+    x, y = _batch()
+    for _ in range(3):
+        la = armed(x, y)
+        lp = plain(x, y)
+        # arming must not perturb the training computation by one bit
+        assert np.asarray(la.data).tobytes() == np.asarray(lp.data).tobytes()
+    for k in plain._params:
+        assert np.asarray(plain._params[k]).tobytes() == \
+            np.asarray(armed._params[k]).tobytes()
+
+
+def test_nonfinite_blame_names_poisoned_leaf():
+    step, _ = _sharded_step(numerics=True)
+    x, y = _batch()
+    step(x, y)                                      # healthy step first
+    xbad = np.full_like(x, np.inf)
+    report = step.nonfinite_blame(1, xbad, y)
+    assert not math.isfinite(report["loss"])
+    assert report["grads"]["weight"] == 32           # every element of w
+    assert report["sizes"]["weight"] == 32
+    assert report["probe_seconds"] > 0.0
+    # healthy batch on healthy params: census comes back empty
+    clean = step.nonfinite_blame(2, x, y)
+    assert clean["grads"] == {} and clean["params"] == {}
+    assert math.isfinite(clean["loss"])
+
+
+def test_scan_step_carries_numerics_extras():
+    from paddle_tpu.distributed import DistributedStrategy
+    from paddle_tpu.distributed.fleet.strategy_compiler import \
+        StrategyCompiler
+    from paddle_tpu.parallel import ScanTrainStep, stack_batches
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    opt = optim.AdamW(learning_rate=1e-2, parameters=model.parameters())
+    mesh = _mesh()
+    s = DistributedStrategy()
+    s.numerics = True
+    plan = StrategyCompiler().compile(s, opt, mesh)
+    step = ScanTrainStep(model, opt, mesh, scan_steps=2,
+                         loss_fn=lambda o, y: nn.functional.mse_loss(o, y),
+                         plan=plan)
+    chunk = stack_batches([_batch(0), _batch(1)])
+    losses = step(*chunk)
+    assert np.asarray(losses.data).shape == (2,)
+    sample = step.numerics_host_sample()
+    assert sample is not None and sample["grad_norm/_total"] > 0.0
+
+
+# ---- corrupt_batch fault clauses ----
+
+def test_corrupt_batch_poisons_named_element_once():
+    from paddle_tpu.utils.fault_injection import FaultPlan
+    plan = FaultPlan.from_spec("inf_input@3:1")
+    x, y = np.ones((4, 8), np.float32), np.ones((4, 4), np.float32)
+    bx, by = plan.corrupt_batch(2, (x, y))
+    assert np.isfinite(by).all()                    # wrong step: untouched
+    bx, by = plan.corrupt_batch(3, (x, y))
+    assert np.isfinite(bx).all()
+    assert np.isinf(by).all()                       # element 1 poisoned
+    assert plan.log == ["inf_input@3:1"]
+    bx, by = plan.corrupt_batch(3, (x, y))
+    assert np.isfinite(by).all()                    # fires exactly once
+
+
+def test_corrupt_batch_chunk_row_and_int_promotion():
+    from paddle_tpu.utils.fault_injection import FaultPlan
+    plan = FaultPlan.from_spec("nan_input@5")
+    ids = np.ones((4, 2, 3), np.int32)              # [K, ...] chunk
+    (out,) = plan.corrupt_batch(4, (ids,), k=4)
+    assert out.dtype == np.float32                  # poison representable
+    assert np.isnan(out[1]).all()                   # row = step 5 - 4
+    assert np.isfinite(out[0]).all() and np.isfinite(out[2:]).all()
+    t = Tensor(jnp.ones((2, 2)))
+    plan2 = FaultPlan.from_spec("nan_input@0")
+    out2 = plan2.corrupt_batch(0, t)
+    assert isinstance(out2, Tensor)                 # wrapping preserved
+    assert np.isnan(np.asarray(out2.data)).all()
+
+
+# ---- ResilientTrainer arming ----
+
+def test_trainer_numerics_off_is_one_predicate(tmp_path):
+    from paddle_tpu.distributed.resilient import (ResilientConfig,
+                                                  ResilientTrainer)
+    from paddle_tpu.utils.fault_injection import FaultPlan
+    t = ResilientTrainer(
+        lambda step: 1.0, str(tmp_path / "ckpt"),
+        get_state=lambda: {}, set_state=lambda s: None,
+        config=ResilientConfig(), fault_plan=FaultPlan(), use_orbax=False)
+    assert t.numerics is None
+    assert t.metrics.numerics is None
+    summary = t.run(lambda i: i, num_steps=2)
+    assert summary["completed_steps"] == 2
+
+
+def test_trainer_feeds_sentinel_and_warns_on_debug_nans(tmp_path):
+    from paddle_tpu.distributed.resilient import (ResilientConfig,
+                                                  ResilientTrainer)
+    from paddle_tpu.utils.fault_injection import FaultPlan
+
+    losses = {0: 1.0, 1: 1.01, 2: 0.99, 3: 1.0, 4: 1.02, 5: 0.98,
+              6: 1.01, 7: 80.0}                     # step 7 spikes
+
+    def make(numerics_obs):
+        return ResilientTrainer(
+            lambda step: losses[step], str(tmp_path / "ckpt"),
+            get_state=lambda: {}, set_state=lambda s: None,
+            config=ResilientConfig(), fault_plan=FaultPlan(),
+            use_orbax=False, numerics=numerics_obs)
+
+    o = NumericsObservatory(interval=2, spike_window=8, spike_zscore=6.0,
+                            spike_min_points=4)
+    t = make(o)
+    assert t.numerics is o                          # shared instance wins
+    summary = t.run(lambda i: i, num_steps=8)
+    assert summary["completed_steps"] == 8
+    assert o.loss_spikes == 1
+    kinds = [e["kind"] for e in obs.flight_recorder().snapshot()["events"]]
+    assert "train_loss_spike" in kinds
+    # composing with FLAGS_check_nan_inf warns: debug_nans raises before
+    # the blame probe can ever run
+    from paddle_tpu.flags import set_flags
+    set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            make(True)
+        assert any("FLAGS_check_nan_inf" in str(x.message) for x in w)
+    finally:
+        set_flags({"FLAGS_check_nan_inf": False})
+
+
+# ---- postmortem CLI: non-finite-by-culprit table ----
+
+def test_cli_groups_nonfinite_by_culprit(tmp_path):
+    fr = obs.FlightRecorder()
+    for s, leaf in ((3, "params['h'][3]['wq'].grad: 128 non-finite of "
+                        "1.2e6"),
+                    (9, "params['h'][3]['wq'].grad: 512 non-finite of "
+                        "1.2e6"),
+                    (12, "params['embed'].grad: 4 non-finite of 1000")):
+        fr.record("train_nonfinite", step=s, culprit=leaf)
+    fr.record("train_rollback", step=3)
+    dump = fr.dump(path=str(tmp_path / "dump.json"), reason="unit")
+    r = subprocess.run([sys.executable, CLI, dump, "--kind", "train_*"],
+                       capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "non-finite events by culprit leaf:" in r.stdout
+    lines = r.stdout.splitlines()
+    table = [ln.strip() for ln in
+             lines[lines.index("non-finite events by culprit leaf:") + 2:]]
+    assert table[0].startswith("2  params['h'][3]['wq'].grad")
+    assert table[1].startswith("1  params['embed'].grad")
+    assert "train_rollback" in r.stdout             # glob caught it too
+
+
+# ---- the fault-matrix scenario (tools/check_fault_matrix.py) ----
+
+@pytest.mark.fault_matrix
+def test_inf_input_blame_names_leaf_before_rollback(tmp_path, monkeypatch):
+    """ISSUE 13 acceptance: an inf_input fault poisons the step-3 batch,
+    the armed trainer's blame probe runs on that batch BEFORE the
+    rollback restores the params, the `train_nonfinite` dump names
+    exactly the poisoned weight leaf, and the postmortem CLI renders the
+    non-finite-by-culprit table. The dump predates the rollback — it
+    must not contain the `train_rollback` event that follows it."""
+    monkeypatch.setenv(obs.DUMP_DIR_ENV, str(tmp_path))
+    obs.flight_recorder().clear()
+    step, mesh = _sharded_step(numerics=True)
+
+    def _np(v):
+        return np.asarray(v.data if isinstance(v, Tensor) else v)
+
+    def get_state():
+        return {"params": {k: np.asarray(v)
+                           for k, v in step._params.items()},
+                "opt": {k: {s: np.asarray(a) for s, a in d.items()}
+                        for k, d in step._opt_state.items()}}
+
+    def set_state(st):
+        step._params = {
+            k: jax.device_put(_np(v),
+                              NamedSharding(mesh, step.param_specs[k]))
+            for k, v in st["params"].items()}
+        step._opt_state = {
+            k: {s: jax.device_put(
+                _np(a), NamedSharding(mesh, step.opt_state_specs[k][s]))
+                for s, a in d.items()}
+            for k, d in st["opt"].items()}
+
+    from paddle_tpu.distributed.resilient import (ResilientConfig,
+                                                  ResilientTrainer)
+    from paddle_tpu.utils.fault_injection import FaultPlan
+    batches = [_batch(i) for i in range(6)]
+    t = ResilientTrainer(
+        step, str(tmp_path / "ckpt"), get_state=get_state,
+        set_state=set_state,
+        config=ResilientConfig(save_interval=1, nan_policy="rollback"),
+        fault_plan=FaultPlan.from_spec("inf_input@3"),
+        use_orbax=False, numerics=True, numerics_interval=2,
+        goodput=True)
+    summary = t.run(lambda i: batches[i], num_steps=6)
+    assert summary["completed_steps"] == 6
+    assert summary["rollbacks"] == 1
+    assert any(e["kind"] == "bad_loss" and e["step"] == 3
+               for e in summary["events"])
+
+    # probe wall time books as recovery overhead, not training
+    assert summary["goodput"]["phase_seconds"]["rollback_waste"] > 0.0
+
+    # the observatory blamed exactly the poisoned leaf: inf inputs drive
+    # every element of the weight grad non-finite
+    snap = t.numerics.snapshot()
+    assert snap["nonfinite_events"] == 1
+    assert list(snap["nonfinite_by_culprit"]) == ["params['weight'].grad"]
+    # ...and the in-step telemetry sampled the clean steps around it
+    assert snap["samples"] >= 1
+    assert snap["last_sample"]["grad_norm/_total"] > 0.0
+
+    # the dump was cut at blame time: it names the culprit and does NOT
+    # yet contain the rollback that follows
+    dump_path = tmp_path / f"pdtpu_flight_{os.getpid()}.json"
+    assert dump_path.exists()
+    doc = json.loads(dump_path.read_text())
+    assert doc["reason"] == "train_nonfinite"
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "train_nonfinite" in kinds and "train_bad_loss" in kinds
+    assert "train_rollback" not in kinds            # blame BEFORE rollback
+    nfe = [e for e in doc["events"] if e["kind"] == "train_nonfinite"][0]
+    assert nfe["step"] == 3
+    assert nfe["culprit"].startswith(
+        "params['weight'].grad: 32 non-finite of 32")
+    assert nfe["probe_seconds"] > 0.0
+
+    # postmortem CLI renders the grouped table from the same dump
+    r = subprocess.run(
+        [sys.executable, CLI, str(dump_path), "--kind", "train_*"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "non-finite events by culprit leaf:" in r.stdout
+    assert "params['weight'].grad" in r.stdout

@@ -110,7 +110,12 @@ GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
              # sprouts extra program variants (shape churn, lost cache
              # hits) or slower compiles must fail the gate
              "compile_executables": "lower",
-             "compile_seconds_total": "lower"}
+             "compile_seconds_total": "lower",
+             # ISSUE 13 numerics-observatory gate: the armed in-step
+             # telemetry's step-time overhead (percent vs the unarmed
+             # fused step) is a CEILING — the observatory must stay
+             # effectively free, and growth past the pin fails the gate
+             "train_numerics_overhead_pct": "lower"}
 
 
 def _metrics_of(row):
@@ -128,7 +133,8 @@ def _metrics_of(row):
               "train_goodput", "train_mfu_live",
               "llm_token_efficiency", "llm_decode_mfu",
               "llm_host_fraction",
-              "compile_executables", "compile_seconds_total"):
+              "compile_executables", "compile_seconds_total",
+              "train_numerics_overhead_pct"):
         if extra.get(k) is not None:
             out[k] = float(extra[k])
     return out

@@ -47,8 +47,14 @@ module: a post-warmup batch-size churn produces `compile_recompile`
 flight events that each NAME the culprit leaf (path + before→after
 shape), the per-culprit storm drops an atomic dump, and
 `tools/flight_recorder.py --kind 'compile_*'` renders the
-recompiles-grouped-by-culprit table) — then
-prints a pass/fail table. Exit 0 iff every scenario recovered.
+recompiles-grouped-by-culprit table), and the ISSUE 13 non-finite
+blame scenario in tests/test_train_numerics.py (`obs`-marked module:
+an `inf_input` fault poisons ONE named batch input so exactly one
+grad leaf goes non-finite, the armed trainer's blame probe emits a
+`train_nonfinite` flight event naming exactly that leaf BEFORE the
+rollback restores the params, the atomic dump carries it, and
+`tools/flight_recorder.py` renders the non-finite-by-culprit table) —
+then prints a pass/fail table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
     python tools/check_fault_matrix.py --list     # show scenarios only
@@ -76,6 +82,7 @@ TEST_FILES = [
     os.path.join("tests", "test_goodput.py"),
     os.path.join("tests", "test_serving_ledger.py"),
     os.path.join("tests", "test_compile_observatory.py"),
+    os.path.join("tests", "test_train_numerics.py"),
 ]
 
 

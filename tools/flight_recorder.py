@@ -17,6 +17,8 @@ events land on the same timeline as the profiler spans:
     python tools/flight_recorder.py dump.json --kind 'compile_*'
     # compile_* selections append a recompiles-grouped-by-culprit table
     # (ISSUE 12): which leaf churned, how often, at which call site
+    # train_nonfinite events append a non-finite-by-culprit table
+    # (ISSUE 13): which grad/param leaf went bad, how often, worst count
 
 Exit 0 on success, 2 on an unreadable/invalid dump.
 """
@@ -75,6 +77,13 @@ def render_postmortem(dump: dict, kinds: Optional[List[str]] = None) -> str:
         lines.append(f"  {'count':>5}  {'callsite':24s} culprit")
         for (callsite, culprit), count in culprits:
             lines.append(f"  {count:>5}  {callsite:24s} {culprit}")
+    nonfinite = group_nonfinite(events)
+    if nonfinite:
+        lines.append("")
+        lines.append("non-finite events by culprit leaf:")
+        lines.append(f"  {'count':>5}  culprit")
+        for leaf, count in nonfinite:
+            lines.append(f"  {count:>5}  {leaf}")
     return "\n".join(lines)
 
 
@@ -92,6 +101,22 @@ def group_recompiles(events: List[dict]) -> List[tuple]:
         leaf = culprit.split(": ")[0].strip() or "unknown"
         key = (str(e.get("callsite", "?")), leaf)
         groups[key] = groups.get(key, 0) + 1
+    return sorted(groups.items(), key=lambda kv: (-kv[1], kv[0]))
+
+
+def group_nonfinite(events: List[dict]) -> List[tuple]:
+    """Group train_nonfinite events by culprit leaf path, most frequent
+    first — the table that turns a NaN storm into the one parameter to
+    stare at. The culprit is grouped by its leaf path (the part before
+    the ': N non-finite of M' counts), so repeat blames of the same leaf
+    with different censuses land in one row."""
+    groups: dict = {}
+    for e in events:
+        if e.get("kind") != "train_nonfinite":
+            continue
+        culprit = str(e.get("culprit", "unknown"))
+        leaf = culprit.split(": ")[0].strip() or "unknown"
+        groups[leaf] = groups.get(leaf, 0) + 1
     return sorted(groups.items(), key=lambda kv: (-kv[1], kv[0]))
 
 
