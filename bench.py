@@ -889,6 +889,174 @@ def run_comm_bench():
     print(json.dumps(result))
 
 
+def run_fleet_bench():
+    """Multi-replica serving-tier benchmark (ISSUE 14): replays ONE seeded
+    Poisson prompt trace through a ReplicaRouter over 1, 2, and 4
+    in-process LLMEngine replicas (each on its own threaded wall-clock
+    scheduler; XLA releases the GIL during dispatch, so replica compute
+    overlaps) and reports the throughput scaling vs the single-replica
+    run — then kills a replica mid-decode on the largest fleet and times
+    the zero-dropped-streams failover: crash to every victim stream
+    re-placed on a survivor. Gates through tools/check_bench_result.py:
+    fleet_qps_scaling is a FLOOR, fleet_failover_resume_ms a CEILING."""
+    import os
+
+    import jax
+
+    from paddle_tpu.serving import (InProcessReplica, LLMMetrics,
+                                    RejectedError, ReplicaRouter,
+                                    RouterConfig)
+    from paddle_tpu.serving.llm import LLMEngine, LLMEngineConfig
+
+    preset = os.environ.get("BENCH_FLEET_PRESET", "gpt2-tiny")
+    n_req = int(os.environ.get("BENCH_FLEET_REQUESTS", "24"))
+    rate_hz = float(os.environ.get("BENCH_FLEET_RATE_HZ", "400"))
+    num_slots = int(os.environ.get("BENCH_FLEET_SLOTS", "4"))
+    max_new = int(os.environ.get("BENCH_FLEET_MAX_NEW", "8"))
+    failover_new = int(os.environ.get("BENCH_FLEET_FAILOVER_NEW", "32"))
+    sizes = [int(s) for s in os.environ.get(
+        "BENCH_FLEET_SIZES", "1,2,4").split(",")]
+    backend = jax.default_backend()
+
+    if preset.startswith("llama"):
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        model = LlamaForCausalLM.from_preset(preset)
+    else:
+        from paddle_tpu.models.gpt import GPTForCausalLM
+        model = GPTForCausalLM.from_preset(preset)
+    vocab = model.config.vocab_size if hasattr(model, "config") else 512
+
+    def mk_replica(i):
+        eng = LLMEngine(model, LLMEngineConfig(
+            num_slots=num_slots, block_len=8,
+            # slots must fit the failover phase's longest stream
+            n_blocks=max(4, -(-(16 + max(max_new, failover_new)) // 8)),
+            max_queue_depth=max(8 * num_slots, 64)))
+        eng.start()
+        # warm each replica's unified step executable so no mid-trace jit
+        # compile shows up as fake routing latency
+        eng.generate([1, 2, 3], max_new_tokens=2, timeout=300)
+        eng.metrics = LLMMetrics()
+        eng.metrics.set_slots(0, eng.pool.num_slots)
+        return InProcessReplica(eng, i)
+
+    # ONE seeded trace replayed identically over every fleet size — the
+    # scaling numbers compare fleets, never traces
+    rng = np.random.RandomState(0)
+    prompt_lens = rng.randint(3, 13, size=n_req)
+    prompts = [rng.randint(1, vocab, size=s).astype(np.int32)
+               for s in prompt_lens]
+    gaps = rng.exponential(1.0 / rate_hz, size=n_req)
+
+    qps = {}
+    rejected_total = 0
+    last_router, last_reps = None, None
+    for n in sizes:
+        reps = [mk_replica(i) for i in range(n)]
+        router = ReplicaRouter(
+            reps, RouterConfig(poll_interval_s=0.002)).start()
+        handles = []
+        t0 = time.perf_counter()
+        t_next = t0
+        for gap, p in zip(gaps, prompts):
+            t_next += gap
+            delay = t_next - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                handles.append(router.submit(p, max_new_tokens=max_new))
+            except RejectedError:
+                rejected_total += 1
+        for h in handles:
+            h.result(timeout=300)
+        qps[n] = len(handles) / (time.perf_counter() - t0)
+        if n == sizes[-1]:
+            last_router, last_reps = router, reps
+        else:
+            router.stop(drain=True)
+
+    # ---- failover resume timing: kill replica0 mid-decode on the
+    # largest fleet; the ceiling is crash -> every victim stream either
+    # finished from its harvest or re-placed on a survivor
+    resume_ms = None
+    n_victims = resumed_delta = 0
+    if last_reps is not None and len(last_reps) >= 2:
+        fh = [last_router.submit(p, max_new_tokens=failover_new)
+              for p in prompts[:2 * len(last_reps)]]
+        # wait for first-token emission fleet-wide so the kill provably
+        # lands MID-decode (a fixed sleep lets fast backends finish early)
+        t_wait = time.perf_counter()
+        while (any(len(h.tokens_so_far()) == 0 for h in fh)
+               and time.perf_counter() - t_wait < 30):
+            time.sleep(0.001)
+        dead = last_reps[0]
+        victims = [h for h in fh
+                   if h._replica is dead and not h.future.done()]
+        n_victims = len(victims)
+        base_resumed = last_router.metrics.snapshot()["resumed_streams"]
+        t0 = time.perf_counter()
+        dead.crash()
+        while any(not h.future.done()
+                  and (h._replica is None or h._replica is dead)
+                  for h in victims):
+            if time.perf_counter() - t0 > 120:
+                break
+            time.sleep(0.002)
+        resume_ms = (time.perf_counter() - t0) * 1e3
+        for h in fh:                # zero dropped: every stream completes
+            assert h.result(timeout=300).size == failover_new
+        resumed_delta = (last_router.metrics.snapshot()["resumed_streams"]
+                         - base_resumed)
+    if last_router is not None:
+        last_router.stop(drain=True)
+
+    base = qps[sizes[0]]
+    scaling = {n: (qps[n] / base if base > 0 else 0.0) for n in sizes}
+    result = {
+        "metric": f"qps/fleet fleet-{preset} x{sizes[-1]} "
+                  f"slots{num_slots}",
+        "value": round(scaling[sizes[-1]], 3),
+        "unit": "x vs 1 replica",
+        "vs_baseline": 0.0,
+        "extra": {
+            "fleet_qps_scaling": round(scaling[sizes[-1]], 4),
+            "fleet_failover_resume_ms": (round(resume_ms, 3)
+                                         if resume_ms is not None else None),
+            "fleet_qps": {str(n): round(q, 2) for n, q in qps.items()},
+            "fleet_scaling": {str(n): round(s, 4)
+                              for n, s in scaling.items()},
+            "fleet_victims": n_victims,
+            "fleet_resumed_streams": resumed_delta,
+            "rejected": rejected_total,
+            "backend": backend,
+            "n_requests": n_req,
+            "rate_hz": rate_hz,
+            "num_slots": num_slots,
+            "max_new_tokens": max_new,
+            "fleet_sizes": sizes,
+            "provenance": _provenance(),
+        },
+    }
+    print(json.dumps(result))
+
+
+def _fleet_main():
+    """--fleet entry: like main(), ALWAYS prints one JSON line, exit 0."""
+    try:
+        run_fleet_bench()
+    except Exception as e:
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "fleet_bench_error",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "extra": {"error": f"{type(e).__name__}: {str(e)[:400]}",
+                      "provenance": _provenance()},
+        }))
+    sys.exit(0)
+
+
 def _comm_main():
     """--comm entry: like main(), ALWAYS prints one JSON line, exit 0."""
     try:
@@ -1069,6 +1237,8 @@ if __name__ == "__main__":
         _comm_main()
     elif "--llm" in sys.argv:
         _llm_main()
+    elif "--fleet" in sys.argv:
+        _fleet_main()
     elif "--probe" in sys.argv:
         _probe_main()
     else:

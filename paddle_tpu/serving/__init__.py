@@ -27,13 +27,15 @@ token-budget backpressure (HTTP 429 + Retry-After) and brownout.
 from .clock import Clock, MonotonicClock, SimClock  # noqa: F401
 from .engine import (BatchingEngine, DeadlineExceededError,  # noqa: F401
                      EngineConfig, RejectedError)
-from .metrics import (SLO_CLASSES, LLMMetrics, ServingMetrics,  # noqa: F401
-                      parse_exposition)
+from .metrics import (SLO_CLASSES, LLMMetrics, RouterMetrics,  # noqa: F401
+                      ServingMetrics, parse_exposition)
 from .supervisor import (DispatchFailedError, DispatchHungError,  # noqa: F401
                          EngineSupervisor)
 from .sim import (Arrival, ReplayReport, poisson_trace,  # noqa: F401
                   replay, uniform_trace)
 from .server import ServingServer, serve  # noqa: F401
+from .router import (InProcessReplica, ReplicaRouter,  # noqa: F401
+                     RouterConfig, RouterHandle, RouterServer)
 from . import llm  # noqa: F401
 from .llm import (GenerationHandle, LLMEngine,  # noqa: F401
                   LLMEngineConfig, PrefixCache, SlotPagedKVPool,

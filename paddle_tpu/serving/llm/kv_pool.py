@@ -478,6 +478,73 @@ class SlotPagedKVPool:
                 f"(leaked {b_alloc - b_freed - b_active - b_cached})")
         return True
 
+    # ---- row serialization (ISSUE 14: KV handoff groundwork) ----
+    def export_rows(self, slots: List[int]) -> dict:
+        """Serialize the committed KV of active `slots` to host numpy:
+        per slot, its valid length and per-layer [Hkv, length, D] K/V
+        arrays assembled page-by-page through the block table (attached
+        shared pages read from their physical row, exactly as the ragged
+        kernel would). The payload is self-describing enough for
+        `import_rows` on ANOTHER pool with the same slab geometry — the
+        groundwork for prefill/decode-disaggregated KV handoff."""
+        rows: Dict[int, dict] = {}
+        for slot in slots:
+            slot = int(slot)
+            if not self.active[slot]:
+                raise ValueError(f"slot {slot} is not active")
+            length = int(self.lengths[slot])
+            pages = list(self.block_table.get(slot, []))
+            layers = []
+            for k, v in self.slabs:
+                kn, vn = np.asarray(k), np.asarray(v)
+                kparts, vparts = [], []
+                for j, p in enumerate(pages):
+                    prow = p // self.n_blocks
+                    c0 = (p % self.n_blocks) * self.block_len
+                    w = min(self.block_len, length - j * self.block_len)
+                    kparts.append(kn[prow, :, c0:c0 + w, :])
+                    vparts.append(vn[prow, :, c0:c0 + w, :])
+                if kparts:
+                    layers.append((np.concatenate(kparts, axis=1),
+                                   np.concatenate(vparts, axis=1)))
+                else:
+                    layers.append((kn[slot, :, :0, :], vn[slot, :, :0, :]))
+            rows[slot] = {"length": length, "layers": layers}
+        return {"block_len": self.block_len, "capacity": self.capacity,
+                "rows": rows}
+
+    def import_rows(self, exported: dict) -> Dict[int, int]:
+        """Materialize `export_rows` payload rows into THIS pool: each
+        exported row allocates a fresh slot, commits its length (own
+        identity pages — attachment structure is not preserved, the KV
+        bytes are), and lands the K/V columns bitwise via
+        dynamic_update_slice. Returns {source_slot: destination_slot}."""
+        if int(exported["block_len"]) != self.block_len:
+            raise ValueError(
+                f"block_len mismatch: exported {exported['block_len']} "
+                f"vs pool {self.block_len}")
+        mapping: Dict[int, int] = {}
+        for src in sorted(exported["rows"]):
+            row = exported["rows"][src]
+            length = int(row["length"])
+            if length > self.capacity:
+                raise ValueError(
+                    f"row {src} holds {length} tokens but this pool's "
+                    f"capacity is {self.capacity}")
+            dst = self.allocate(length)
+            self.set_length(dst, length)
+            if length > 0:
+                new_slabs = []
+                for (k, v), (ke, ve) in zip(self.slabs, row["layers"]):
+                    ku = jnp.asarray(ke, dtype=k.dtype)[None]
+                    vu = jnp.asarray(ve, dtype=v.dtype)[None]
+                    k = jax.lax.dynamic_update_slice(k, ku, (dst, 0, 0, 0))
+                    v = jax.lax.dynamic_update_slice(v, vu, (dst, 0, 0, 0))
+                    new_slabs.append((k, v))
+                self.slabs = new_slabs
+            mapping[int(src)] = dst
+        return mapping
+
     # ---- hygiene ----
     def defrag(self) -> int:
         """Scrub stale KV out of freed rows (one jitted masked multiply

@@ -835,6 +835,26 @@ class LLMEngine:
                            deadline_ms=deadline_ms, slo=slo,
                            tenant=tenant).result(timeout)
 
+    def prefix_probe(self, prompt, tenant: Optional[str] = None) -> int:
+        """Longest block-aligned cached-prefix match for `prompt` in this
+        engine's radix cache, in tokens — 0 with the cache disabled.
+        Read-only (no refcounts, ticks, or stats move): the replica
+        router calls this on every candidate per admission to steer a
+        request to the replica already holding its prefix KV, and a
+        probe on a losing candidate must leave that replica's cache
+        untouched. Surfaced over HTTP via /healthz `llm_prefix_probe`."""
+        if self.prefix_cache is None:
+            return 0
+        tenant = self.config.default_tenant if tenant is None else tenant
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        return self.prefix_cache.probe(tenant, prompt)
+
+    def inflight_tokens(self) -> int:
+        """Current admitted token cost (queued + active): the router's
+        load tie-breaker."""
+        with self._cond:
+            return self._inflight_tokens_locked()
+
     # ---- scheduling ----
     def has_work(self) -> bool:
         with self._cond:

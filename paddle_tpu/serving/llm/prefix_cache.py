@@ -175,6 +175,28 @@ class PrefixCache:
                 self.pool.refcount.get(tail_page, 0) + 1
         return AttachPlan(pages, attach_len + tail_len, tail_page, tail_len)
 
+    def probe(self, tenant: str, tokens) -> int:
+        """Read-only lookup: the longest block-aligned cached prefix of
+        `tokens` in the tenant's trie, in tokens. Unlike `acquire` it
+        takes no refcounts and touches no ticks or stats — the router
+        probes every candidate replica per admission, and a probe must
+        never distort LRU order or hit-rate accounting, let alone pin
+        pages on replicas that lose the election."""
+        node = self._roots.get(tenant)
+        if node is None:
+            return 0
+        bl = self.block_len
+        n = len(tokens)
+        i = 0
+        while i + bl <= n:
+            child = node.children.get(
+                tuple(int(t) for t in tokens[i:i + bl]))
+            if child is None:
+                break
+            node = child
+            i += bl
+        return i
+
     def release_tail(self, plan: AttachPlan):
         """Drop the transient tail refcount once its KV has been COW'd
         into the reader's own page."""

@@ -599,3 +599,145 @@ class LLMMetrics(ServingMetrics):
                 for tenant in sorted(s["tenants"]):
                     b.sample(f"{px}_{fam}", s["tenants"][tenant][key],
                              {"tenant": tenant}, round_to=rnd)
+
+
+class RouterMetrics:
+    """Front-of-fleet router counters (ISSUE 14): routing decisions per
+    replica, prefix-affinity hit rate, per-replica health/quarantine
+    state, failovers with resumed-stream totals, and router-level
+    rejects. Rendered under the `pdtpu_router_*` prefix so the router's
+    /metrics can concatenate the replicas' `pdtpu_llm_*` families
+    without a name collision."""
+
+    _PREFIX = "pdtpu_router"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {
+            "submitted": 0, "completed": 0, "rejected": 0, "failed": 0,
+        }
+        self.reject_reasons: Dict[str, int] = {}
+        self.routed: Dict[str, int] = {}           # replica -> decisions
+        self.replica_state: Dict[str, str] = {}    # replica -> health word
+        self.quarantines: Dict[str, int] = {}      # replica -> times down
+        self.failovers: Dict[str, int] = {}        # dead replica -> events
+        self.resumed_streams = 0
+        self.readmissions: Dict[str, int] = {}
+        self.affinity_hits = 0                     # routed to a prefix match
+        self.affinity_decisions = 0
+        self.replica_inflight: Dict[str, int] = {}
+
+    # ---- router callbacks ----
+    def on_submit(self):
+        with self._lock:
+            self.counters["submitted"] += 1
+
+    def on_route(self, replica: str, prefix_hit: bool):
+        with self._lock:
+            self.routed[replica] = self.routed.get(replica, 0) + 1
+            self.affinity_decisions += 1
+            if prefix_hit:
+                self.affinity_hits += 1
+
+    def on_reject(self, reason: str):
+        with self._lock:
+            self.counters["rejected"] += 1
+            self.reject_reasons[reason] = \
+                self.reject_reasons.get(reason, 0) + 1
+
+    def on_complete(self):
+        with self._lock:
+            self.counters["completed"] += 1
+
+    def on_fail(self):
+        with self._lock:
+            self.counters["failed"] += 1
+
+    def set_replica(self, replica: str, state: str, inflight_tokens: int):
+        with self._lock:
+            self.replica_state[replica] = state
+            self.replica_inflight[replica] = int(inflight_tokens)
+
+    def on_quarantine(self, replica: str):
+        with self._lock:
+            self.quarantines[replica] = self.quarantines.get(replica, 0) + 1
+
+    def on_readmit(self, replica: str):
+        with self._lock:
+            self.readmissions[replica] = \
+                self.readmissions.get(replica, 0) + 1
+
+    def on_failover(self, replica: str, resumed: int):
+        with self._lock:
+            self.failovers[replica] = self.failovers.get(replica, 0) + 1
+            self.resumed_streams += resumed
+
+    # ---- views ----
+    def affinity_hit_rate(self) -> float:
+        with self._lock:
+            if self.affinity_decisions == 0:
+                return 0.0
+            return self.affinity_hits / self.affinity_decisions
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                **self.counters,
+                "reject_reasons": dict(self.reject_reasons),
+                "routed": dict(self.routed),
+                "replica_state": dict(self.replica_state),
+                "replica_inflight": dict(self.replica_inflight),
+                "quarantines": dict(self.quarantines),
+                "readmissions": dict(self.readmissions),
+                "failovers": dict(self.failovers),
+                "resumed_streams": self.resumed_streams,
+                "affinity_hit_rate": (
+                    self.affinity_hits / self.affinity_decisions
+                    if self.affinity_decisions else 0.0),
+            }
+
+    def render(self) -> str:
+        b = PromBuilder()
+        self._render_into(b)
+        return b.render()
+
+    def _render_into(self, b: PromBuilder):
+        s = self.snapshot()
+        px = self._PREFIX
+        b.family(f"{px}_requests_total", "counter")
+        for outcome in ("submitted", "completed", "rejected", "failed"):
+            b.sample(f"{px}_requests_total", s[outcome],
+                     {"outcome": outcome})
+        b.family(f"{px}_rejects_total", "counter")
+        for reason in sorted(s["reject_reasons"]):
+            b.sample(f"{px}_rejects_total", s["reject_reasons"][reason],
+                     {"reason": reason})
+        b.family(f"{px}_routed_total", "counter")
+        for replica in sorted(s["routed"]):
+            b.sample(f"{px}_routed_total", s["routed"][replica],
+                     {"replica": replica})
+        b.family(f"{px}_replica_up", "gauge")
+        for replica in sorted(s["replica_state"]):
+            up = int(s["replica_state"][replica] == "ok")
+            b.sample(f"{px}_replica_up", up, {"replica": replica})
+        b.family(f"{px}_replica_inflight_tokens", "gauge")
+        for replica in sorted(s["replica_inflight"]):
+            b.sample(f"{px}_replica_inflight_tokens",
+                     s["replica_inflight"][replica], {"replica": replica})
+        b.family(f"{px}_quarantines_total", "counter")
+        for replica in sorted(s["quarantines"]):
+            b.sample(f"{px}_quarantines_total", s["quarantines"][replica],
+                     {"replica": replica})
+        b.family(f"{px}_readmissions_total", "counter")
+        for replica in sorted(s["readmissions"]):
+            b.sample(f"{px}_readmissions_total",
+                     s["readmissions"][replica], {"replica": replica})
+        b.family(f"{px}_failovers_total", "counter")
+        for replica in sorted(s["failovers"]):
+            b.sample(f"{px}_failovers_total", s["failovers"][replica],
+                     {"replica": replica})
+        b.family(f"{px}_resumed_streams_total", "counter")
+        b.sample(f"{px}_resumed_streams_total", s["resumed_streams"])
+        b.family(f"{px}_prefix_affinity_hit_rate", "gauge")
+        b.sample(f"{px}_prefix_affinity_hit_rate", s["affinity_hit_rate"],
+                 round_to=4)

@@ -181,9 +181,16 @@ class ServingServer:
                 if self.path == "/healthz":
                     broken = any(getattr(e, "broken", False)
                                  for e in outer._engines())
+                    # an engine-initiated drain (stop(), breaker escalation)
+                    # leaves outer._draining False while submissions already
+                    # 503 "draining" — a router must see the drain HERE,
+                    # before it eats rejects (ISSUE 14 fix)
+                    draining = outer._draining or any(
+                        getattr(e, "draining", False)
+                        for e in outer._engines())
                     health = {
                         "status": ("broken" if broken else
-                                   "draining" if outer._draining else "ok"),
+                                   "draining" if draining else "ok"),
                     }
                     if outer.engine is not None:
                         health["queue_depth"] = \
@@ -193,6 +200,10 @@ class ServingServer:
                         health["llm_queue_depth"] = m.queue_depth
                         health["llm_slots_active"] = m.slots_active
                         health["llm_slots_total"] = m.slots_total
+                        health["llm_inflight_tokens"] = \
+                            outer.llm_engine.inflight_tokens()
+                        health["llm_prefix_probe"] = bool(
+                            outer.llm_engine.prefix_cache is not None)
                         snap = m.snapshot()
                         health["llm_prefix_hit_rate"] = round(
                             snap.get("prefix_hit_rate", 0.0), 4)

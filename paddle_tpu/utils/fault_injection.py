@@ -42,9 +42,24 @@ and the engines' retry/quarantine paths are provable at exact points:
                           request survives prefill, exercising the decode
                           blame-isolation protocol)
 
+Replica-tier clauses (ISSUE 14) key on the REPLICA INDEX instead of a
+step counter — the router polls ``maybe_replica_fault(i)`` at the top of
+every replica pump, so replica death is placeable without real signals:
+
+    replica_crash@1       replica 1 hard-crashes at its next pump (fires
+                          once): every in-flight stream on it must be
+                          failed over to a survivor, not dropped
+    replica_hang@1:30.0   replica 1 stops making forward progress for
+                          30.0 simulated seconds — the router's
+                          hung-forward watchdog must fire
+    replica_slow@1:50     replica 1 adds 50ms latency to EVERY pump —
+                          persistent (logs once), the load-aware tier of
+                          the routing policy must steer around it
+
 Each clause fires exactly once per process (a restarted process re-arms,
-which is what crash-resume tests want) — except ``poison_request``, whose
-defining property is persistence: it logs once but keeps firing.
+which is what crash-resume tests want) — except ``poison_request`` and
+``replica_slow``, whose defining property is persistence: they log once
+but keep firing.
 ``FaultPlan`` is also usable programmatically for in-process tests.
 """
 from __future__ import annotations
@@ -273,6 +288,29 @@ class FaultPlan:
                 raise RuntimeError(
                     f"injected poison: request {rid} at {kind} dispatch "
                     f"{dispatch_idx}")
+
+    def maybe_replica_fault(self, replica_idx: int):
+        """Router-tier injection point (ISSUE 14), polled at the top of
+        every replica pump. Clauses key on the replica INDEX, not a step
+        counter. Returns None, or a (kind, arg) verdict the replica
+        applies to itself: ("crash", None) — hard-crash now, fail every
+        in-flight stream (fires once); ("hang", seconds) — make no
+        forward progress for that long (fires once; the router watchdog
+        must notice); ("slow", ms) — add per-pump latency, persistently
+        (logs once, keeps firing)."""
+        f = self._take("replica_crash", replica_idx)
+        if f is not None:
+            return ("crash", None)
+        f = self._take("replica_hang", replica_idx)
+        if f is not None:
+            return ("hang", float(f.arg or "1.0"))
+        for f in self.faults:
+            if f.kind == "replica_slow" and f.step == replica_idx:
+                if not f.fired:     # log once, fire forever (persistent)
+                    f.fired = True
+                    self.log.append(repr(f))
+                return ("slow", float(f.arg or "1.0"))
+        return None
 
     def maybe_kill(self, step: int, point: str = KILL_POINT_STEP):
         """SIGKILL the current process at a named kill point. Used to

@@ -92,6 +92,10 @@ def pytest_configure(config):
         "markers", "obs: observability tests (request tracing, flight "
         "recorder, prometheus exposition; paddle_tpu/obs/); select with "
         "-m obs")
+    config.addinivalue_line(
+        "markers", "router: multi-replica serving tier tests (breaker-aware "
+        "router, failover re-prefill, quarantine ladder; serving/router.py); "
+        "select with -m router")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -112,3 +116,6 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.llm)
         if mod in ("test_obs", "test_goodput", "test_serving_ledger"):
             item.add_marker(pytest.mark.obs)
+        if mod == "test_router":
+            item.add_marker(pytest.mark.router)
+            item.add_marker(pytest.mark.serving)

@@ -1,0 +1,98 @@
+"""Subprocess fixture for tests/test_router.py: runs a RouterServer over
+TWO in-process LLMEngine replicas (gpt2-tiny) on an ephemeral port, with
+a replica-tier fault plan taken from PDTPU_FAULTS — e.g.
+`replica_crash@0` kills replica0 after the warmup reset, so the parent
+can drive live /generate traffic across a real mid-traffic replica loss
+and reconcile: every accepted request returns 200 with the full token
+stream (zero dropped), and the router's /metrics account for the
+quarantine + failovers client-for-client.
+
+    python router_worker.py WORKDIR
+
+env knobs:
+    LLM_SLOTS             per-replica KV pool size (default 4)
+    LLM_MAX_NEW           default max_new_tokens (default 8)
+    ROUTER_FAULTS         replica-tier fault clauses (replica_crash@i, ...)
+    ROUTER_FAULT_DELAY_S  arm the clauses this long after serving starts
+                          (default 1.0) — the supervision loop polls the
+                          plan every pump, so arming late is what makes
+                          the loss land MID-traffic
+
+Writes WORKDIR/port once the socket is bound (the parent polls for it)
+and WORKDIR/metrics_final.txt (router Prometheus text) during drain.
+Exit 0 on a clean drain.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import serving  # noqa: E402
+from paddle_tpu.models.gpt import GPTForCausalLM  # noqa: E402
+from paddle_tpu.utils.fault_injection import (FaultPlan,  # noqa: E402
+                                              set_global_plan)
+
+WORKDIR = sys.argv[1]
+SLOTS = int(os.environ.get("LLM_SLOTS", "4"))
+MAX_NEW = int(os.environ.get("LLM_MAX_NEW", "8"))
+FAULTS = os.environ.get("ROUTER_FAULTS", "")
+FAULT_DELAY_S = float(os.environ.get("ROUTER_FAULT_DELAY_S", "1.0"))
+
+
+def main():
+    paddle.seed(0)
+    model = GPTForCausalLM.from_preset("gpt2-tiny")
+    replicas = []
+    for i in range(2):
+        engine = serving.LLMEngine(
+            model, serving.LLMEngineConfig(
+                num_slots=SLOTS, block_len=8, n_blocks=8,
+                max_new_tokens=MAX_NEW, max_queue_depth=64))
+        # warm the unified step executable BEFORE handing the engine to
+        # the router, so the injected replica loss lands mid-decode
+        # rather than mid-compile
+        engine.start()
+        engine.generate([1, 2, 3], max_new_tokens=2, timeout=300)
+        engine.metrics = serving.LLMMetrics()
+        engine.metrics.set_slots(0, engine.pool.num_slots)
+        # fault_plan=None: replicas poll the GLOBAL plan each pump, so
+        # the timer below can arm the loss mid-traffic
+        replicas.append(serving.InProcessReplica(engine, i))
+
+    router = serving.ReplicaRouter(
+        replicas, serving.RouterConfig(poll_interval_s=0.002))
+    server = serving.RouterServer(router, port=0, request_timeout_s=120.0)
+    server.start()   # supervision thread + HTTP thread
+
+    if FAULTS:
+        import threading as _t
+        _t.Timer(FAULT_DELAY_S,
+                 lambda: set_global_plan(
+                     FaultPlan.from_spec(FAULTS))).start()
+
+    # socket bound at construction: write the handshake file atomically so
+    # the parent never reads a half-written port
+    tmp = os.path.join(WORKDIR, "port.tmp")
+    with open(tmp, "w") as f:
+        f.write(str(server.port))
+    os.replace(tmp, os.path.join(WORKDIR, "port"))
+
+    import signal
+    import threading
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: done.set())
+    signal.signal(signal.SIGINT, lambda *a: done.set())
+    done.wait()
+    # drain contract: finish every admitted stream, snapshot metrics, exit 0
+    server.stop(drain=True)
+    tmp = os.path.join(WORKDIR, "metrics_final.tmp")
+    with open(tmp, "w") as f:
+        f.write(router.metrics.render())
+    os.replace(tmp, os.path.join(WORKDIR, "metrics_final.txt"))
+
+
+if __name__ == "__main__":
+    main()

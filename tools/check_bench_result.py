@@ -115,7 +115,15 @@ GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
              # telemetry's step-time overhead (percent vs the unarmed
              # fused step) is a CEILING — the observatory must stay
              # effectively free, and growth past the pin fails the gate
-             "train_numerics_overhead_pct": "lower"}
+             "train_numerics_overhead_pct": "lower",
+             # ISSUE 14 fleet gates (`bench.py --fleet`): replayed-trace
+             # qps scaling vs one replica is a FLOOR (adding replicas
+             # must keep buying near-linear throughput; routing overhead
+             # or accidental serialization fails the gate), and the
+             # crash-to-all-streams-resumed failover time is a CEILING
+             # (the zero-dropped-streams dance must stay fast)
+             "fleet_qps_scaling": "higher",
+             "fleet_failover_resume_ms": "lower"}
 
 
 def _metrics_of(row):
@@ -134,7 +142,8 @@ def _metrics_of(row):
               "llm_token_efficiency", "llm_decode_mfu",
               "llm_host_fraction",
               "compile_executables", "compile_seconds_total",
-              "train_numerics_overhead_pct"):
+              "train_numerics_overhead_pct",
+              "fleet_qps_scaling", "fleet_failover_resume_ms"):
         if extra.get(k) is not None:
             out[k] = float(extra[k])
     return out

@@ -53,7 +53,16 @@ an `inf_input` fault poisons ONE named batch input so exactly one
 grad leaf goes non-finite, the armed trainer's blame probe emits a
 `train_nonfinite` flight event naming exactly that leaf BEFORE the
 rollback restores the params, the atomic dump carries it, and
-`tools/flight_recorder.py` renders the non-finite-by-culprit table) —
+`tools/flight_recorder.py` renders the non-finite-by-culprit table),
+and the ISSUE 14 multi-replica scenarios in tests/test_router.py
+(`router`-marked module: a replica killed MID-decode via the
+`replica_crash@i` grammar has every in-flight stream re-prefilled on a
+survivor and finished bit-identical to an uninterrupted greedy
+generate(), with `router_failover` flight events naming the dead
+replica and each resumed rid in submit order; a `replica_hang@i:s`
+freeze walks the watchdog → quarantine → exponential-backoff →
+re-admission ladder; and a fleet-wide brownout sheds best_effort at the
+router's door while interactive work still completes on survivors) —
 then prints a pass/fail table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
@@ -83,6 +92,7 @@ TEST_FILES = [
     os.path.join("tests", "test_serving_ledger.py"),
     os.path.join("tests", "test_compile_observatory.py"),
     os.path.join("tests", "test_train_numerics.py"),
+    os.path.join("tests", "test_router.py"),
 ]
 
 
