@@ -1040,6 +1040,144 @@ def run_fleet_bench():
     print(json.dumps(result))
 
 
+def run_ckpt_bench():
+    """Continuous-checkpointing benchmark (ISSUE 15): the same train fn
+    runs twice under ResilientTrainer with the goodput ledger armed —
+    once with a synchronous CheckpointManager at interval K, once with
+    an AsyncCheckpointManager at K/4 (4x MORE frequent saves). The async
+    tier must keep step-thread stalls strictly below the sync baseline's
+    even while checkpointing 4x as often: its per-boundary blocking cost
+    is only the device→host snapshot fetch, the pickle+fsync+CRC persist
+    runs on the background writer. Gates through
+    tools/check_bench_result.py: `train_ckpt_stall_ms` (worst blocking
+    ms at any async save boundary) is a CEILING, `train_goodput` (async
+    run) is a FLOOR; the sync baseline numbers ride along ungated."""
+    import os
+    import tempfile
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.checkpoint import (AsyncCheckpointManager,
+                                       CheckpointManager)
+    from paddle_tpu.distributed.resilient import (ResilientConfig,
+                                                  ResilientTrainer)
+    from paddle_tpu.obs.flight_recorder import flight_recorder
+    from paddle_tpu.optimizer import SGD
+
+    backend = jax.default_backend()
+    width = int(os.environ.get("BENCH_CKPT_WIDTH", "1024"))
+    num_steps = int(os.environ.get("BENCH_CKPT_STEPS", "32"))
+    sync_interval = int(os.environ.get("BENCH_CKPT_INTERVAL", "8"))
+    async_interval = max(1, sync_interval // 4)
+
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+
+    class MLP(nn.Layer):
+        # ~2*width^2 fp32 params (8 MB at width=1024): big enough that a
+        # synchronous pickle+fsync+CRC save has a visible step-thread cost
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(width, width)
+            self.fc2 = nn.Linear(width, width)
+
+        def forward(self, x):
+            return self.fc2(self.fc1(x))
+
+    x = paddle.to_tensor(rng.randn(8, width).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, width).astype(np.float32))
+
+    def run_one(make_ckpt, interval):
+        paddle.seed(0)
+        model = MLP()
+        opt = SGD(learning_rate=0.1, parameters=model.parameters())
+
+        def train_fn(_i):
+            loss = nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        with tempfile.TemporaryDirectory() as d:
+            ckpt = make_ckpt(d)
+            trainer = ResilientTrainer(
+                train_fn, ckpt,
+                get_state=lambda: {"model": model.state_dict()},
+                set_state=lambda s: model.set_state_dict(s["model"]),
+                config=ResilientConfig(save_interval=interval),
+                goodput=True)
+            summary = trainer.run(lambda i: i, num_steps=num_steps)
+            stats = summary.get("checkpoint")
+            if hasattr(ckpt, "close"):
+                ckpt.close()
+        return summary["goodput"], stats
+
+    sync_g, _ = run_one(
+        lambda d: CheckpointManager(d, max_to_keep=2, use_orbax=False),
+        sync_interval)
+    flight_recorder().clear()  # scope ckpt_snapshot events to the async run
+    async_g, async_stats = run_one(
+        lambda d: AsyncCheckpointManager(d, max_to_keep=2), async_interval)
+    # worst single-boundary stall the step thread ever saw (the ceiling):
+    # per-boundary blocking_ms rides on the ckpt_snapshot flight events
+    snap_ms = [e["blocking_ms"] for e in
+               flight_recorder().snapshot()["events"]
+               if e["kind"] == "ckpt_snapshot"]
+    stall_ms = max(snap_ms) if snap_ms else 0.0
+
+    sync_blocking = sync_g["checkpoint_blocking_seconds"]
+    async_blocking = async_g["checkpoint_blocking_seconds"]
+    result = {
+        "metric": f"ckpt_stall/boundary ckpt-async steps{num_steps} "
+                  f"sync{sync_interval} async{async_interval} "
+                  f"width{width}",
+        "value": round(stall_ms, 3),
+        "unit": "ms worst blocking per async save boundary",
+        # headline comparison: total step-thread blocking seconds, async
+        # tier at 4x the save frequency vs the sync baseline
+        "vs_baseline": round(async_blocking / sync_blocking, 4)
+        if sync_blocking > 0 else None,
+        "extra": {
+            "backend": backend,
+            "device_kind": jax.devices()[0].device_kind,
+            "train_ckpt_stall_ms": round(stall_ms, 3),
+            "train_goodput": round(async_g["goodput"], 4),
+            "ckpt_sync_goodput": round(sync_g["goodput"], 4),
+            "ckpt_sync_blocking_s": round(sync_blocking, 4),
+            "ckpt_async_blocking_s": round(async_blocking, 4),
+            "ckpt_async_background_s": round(
+                async_g["checkpoint_async_seconds"], 4),
+            "ckpt_snapshots": async_stats["snapshots"],
+            "ckpt_persisted": async_stats["persisted"],
+            "ckpt_dropped": async_stats["dropped"],
+            "ckpt_sync_interval": sync_interval,
+            "ckpt_async_interval": async_interval,
+            "provenance": _provenance(),
+        },
+    }
+    print(json.dumps(result))
+
+
+def _ckpt_main():
+    """--ckpt entry: like main(), ALWAYS prints one JSON line, exit 0."""
+    try:
+        run_ckpt_bench()
+    except Exception as e:
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "ckpt_bench_error",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "extra": {"error": f"{type(e).__name__}: {str(e)[:400]}",
+                      "provenance": _provenance()},
+        }))
+    sys.exit(0)
+
+
 def _fleet_main():
     """--fleet entry: like main(), ALWAYS prints one JSON line, exit 0."""
     try:
@@ -1239,6 +1377,8 @@ if __name__ == "__main__":
         _llm_main()
     elif "--fleet" in sys.argv:
         _fleet_main()
+    elif "--ckpt" in sys.argv:
+        _ckpt_main()
     elif "--probe" in sys.argv:
         _probe_main()
     else:

@@ -17,6 +17,15 @@ cover more than the reference's auto_checkpoint epoch-range resume
   step boundary; the runtime performs a final synchronous
   CheckpointManager.save(force=True), writes a resumable marker, and
   exits 143 so the scheduler sees a clean preemption.
+- **Continuous checkpointing (ISSUE 15)** — pass an
+  `AsyncCheckpointManager` as `checkpoint` and save boundaries become
+  host snapshots (blocking only for the device→host fetch) persisted by
+  a background writer; preemption/watchdog escalation emergency-saves
+  the newest ring snapshot with no device round-trip, NaN rollback is
+  served from the ring before touching disk, resume runs the corrupt-
+  checkpoint scrubber first, and the `get_cursor`/`set_cursor` hooks
+  carry data-stream state (iterator index, RNG) through the manifest so
+  a resumed run replays the identical batch sequence.
 
 Recovery works at step granularity because CheckpointManager's fallback
 path certifies each step with an integrity manifest (paddle_tpu.checkpoint)
@@ -38,7 +47,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..checkpoint import CheckpointManager
+from ..checkpoint import AsyncCheckpointManager, CheckpointManager
 from ..obs.flight_recorder import DUMP_DIR_ENV, flight_recorder
 from ..obs.goodput import (GoodputLedger, HBMTelemetry, RecompileSentinel,
                            oom_forensics)
@@ -181,14 +190,27 @@ class ResilientTrainer:
                  goodput: bool = False,
                  observatory: bool = False,
                  numerics: bool = False,
-                 numerics_interval: int = 10):
+                 numerics_interval: int = 10,
+                 get_cursor: Optional[Callable[[], Dict[str, Any]]] = None,
+                 set_cursor: Optional[
+                     Callable[[Dict[str, Any]], None]] = None):
         self.worker = DeviceWorker(train_fn, print_period=0)
-        if isinstance(checkpoint, CheckpointManager):
+        if isinstance(checkpoint, (AsyncCheckpointManager,
+                                   CheckpointManager)):
             self.ckpt = checkpoint
         else:
             self.ckpt = CheckpointManager(checkpoint, use_orbax=use_orbax)
+        # continuous tier (ISSUE 15): save boundaries snapshot instead of
+        # blocking on a full save, save_interval IS the snapshot interval
+        self._async_ckpt = isinstance(self.ckpt, AsyncCheckpointManager)
         self.get_state = get_state
         self.set_state = set_state
+        # exact-resume cursor hooks: get_cursor captures JSON-safe data-
+        # stream state (iterator index, RNG — see checkpoint.rng_cursor)
+        # at each save boundary; set_cursor rewinds the stream on resume
+        # AND after a rollback, so replayed steps consume the same batches
+        self.get_cursor = get_cursor
+        self.set_cursor = set_cursor
         self.config = config or ResilientConfig()
         self.plan = fault_plan if fault_plan is not None \
             else fault_injection.global_plan()
@@ -209,6 +231,10 @@ class ResilientTrainer:
             self.worker.ledger = self.ledger
             if hasattr(train_fn, "ledger"):  # ScanTrainStep h2d staging
                 train_fn.ledger = self.ledger
+            if self._async_ckpt:
+                # writer-thread persist seconds feed the non-phase
+                # checkpoint_async counter (blocking stays the phase)
+                self.ckpt.ledger = self.ledger
         # observatory=True registers every executable this trainer builds
         # with the process-global CompileObservatory (ISSUE 12): signature
         # fingerprints, AOT cost/memory analyses, culprit-named recompile
@@ -252,7 +278,9 @@ class ResilientTrainer:
         self.metrics = TrainingMetrics(tracker=self.worker.throughput,
                                        ledger=self.ledger, hbm=self.hbm,
                                        sentinel=self.sentinel,
-                                       numerics=self.numerics)
+                                       numerics=self.numerics,
+                                       ckpt=(self.ckpt if self._async_ckpt
+                                             else None))
         env_port = os.environ.get("PDTPU_METRICS_PORT")
         if metrics_port is None and env_port:
             metrics_port = int(env_port)
@@ -285,6 +313,23 @@ class ResilientTrainer:
         saves are not fault events."""
         self.metrics.on_event("checkpoint_save", step)
         flight_recorder().record("train_checkpoint_save", step=step)
+
+    def _cursor(self) -> Optional[Dict[str, Any]]:
+        return self.get_cursor() if self.get_cursor is not None else None
+
+    def _save_boundary(self, step: int):
+        """One save boundary: a ring snapshot (async tier — blocks only
+        for the host fetch, the writer persists in the background) or the
+        classic synchronous save. Either way the cursor rides along."""
+        if self._async_ckpt:
+            self.ckpt.snapshot(step, self.get_state(),
+                               cursor=self._cursor())
+        else:
+            self.ckpt.save(step, self.get_state(), cursor=self._cursor())
+
+    def _apply_cursor(self, cursor: Optional[Dict[str, Any]]):
+        if self.set_cursor is not None and cursor is not None:
+            self.set_cursor(cursor)
 
     # ---- numerics observatory hooks (obs.numerics, ISSUE 13) ----
     def _numerics_tick(self, step: int, n: int, losses):
@@ -342,16 +387,30 @@ class ResilientTrainer:
         for sig, old in getattr(self, "_old_handlers", {}).items():
             signal.signal(sig, old)
 
+    def _final_save(self, completed: int):
+        """The preemption save. Async tier: take one last boundary
+        snapshot (we are AT a step boundary, so the host fetch is safe),
+        emergency-persist the newest ring entry — the signal path proper,
+        no further device round-trips — and drain the writer so nothing
+        queued is lost. Sync tier: the classic forced save."""
+        if self._async_ckpt:
+            self.ckpt.snapshot(completed, self.get_state(),
+                               cursor=self._cursor())
+            self.ckpt.emergency_save()
+            self.ckpt.wait_until_finished()
+        else:
+            self.ckpt.save(completed, self.get_state(), force=True,
+                           cursor=self._cursor())
+            self.ckpt.wait_until_finished()
+
     def _preempt_exit(self, completed: int):
         """Final synchronous save + resumable marker, then exit 143."""
         with RecordEvent("resilient/preempt_save"):
             if self.ledger is not None:
                 with self.ledger.measure("checkpoint"):
-                    self.ckpt.save(completed, self.get_state(), force=True)
-                    self.ckpt.wait_until_finished()
+                    self._final_save(completed)
             else:
-                self.ckpt.save(completed, self.get_state(), force=True)
-                self.ckpt.wait_until_finished()
+                self._final_save(completed)
         self._on_checkpoint_save(completed)
         marker = os.path.join(self.ckpt.directory, PREEMPT_MARKER)
         with open(marker, "w") as f:
@@ -371,11 +430,23 @@ class ResilientTrainer:
 
     # ---- recovery actions ----
     def _restore_latest(self):
+        """Restore the newest recoverable state; returns (step, source).
+        Async tier: the in-memory ring first — it holds the freshest
+        snapshot (possibly newer than anything certified on disk) and
+        costs no I/O — then disk. The cursor rides along either way, so
+        the data stream rewinds with the params."""
+        if self._async_ckpt:
+            snap = self.ckpt.newest_snapshot()
+            if snap is not None:
+                self.set_state(self.ckpt.ring_state(snap))
+                self._apply_cursor(snap.cursor)
+                return snap.step, "ring"
         latest = self.ckpt.latest_step()
         restored = self.ckpt.restore(latest) if latest is not None else None
         if restored is not None:
             self.set_state(restored)
-        return latest
+            self._apply_cursor(self.ckpt.read_cursor(latest))
+        return latest, "disk"
 
     def _rollback(self, state: Dict[str, int]) -> int:
         state["rollbacks"] += 1
@@ -385,11 +456,12 @@ class ResilientTrainer:
                 "aborting")
         if self.ledger is not None:
             with self.ledger.measure("checkpoint"):
-                latest = self._restore_latest()
+                latest, source = self._restore_latest()
         else:
-            latest = self._restore_latest()
+            latest, source = self._restore_latest()
         target = latest if latest is not None else 0
-        self._event("rollback", target, rollbacks=state["rollbacks"])
+        self._event("rollback", target, rollbacks=state["rollbacks"],
+                    source=source)
         state["skips"] = 0
         return target
 
@@ -433,6 +505,16 @@ class ResilientTrainer:
             self.ledger.start()  # wall clock covers the whole run() call
             self.sentinel.install()  # no-op when already observing
 
+        # scrub BEFORE trusting latest_step: a manifest-certified step
+        # whose bytes rotted (torn block, ckpt_torn_write) must be
+        # quarantined, not restored — the scrubber walks the directory,
+        # CRC-checks every candidate and moves failures to *.corrupt/
+        if self._async_ckpt:
+            report = self.ckpt.scrub()
+            for rec in report["quarantined"]:
+                self._event("ckpt_quarantined", rec["step"],
+                            file=rec["file"], reason=rec["reason"])
+
         # resume from the latest valid checkpoint
         completed = self.ckpt.latest_step() or 0
         if completed % n:
@@ -449,6 +531,9 @@ class ResilientTrainer:
                 restored = self.ckpt.restore(completed)
             if restored is not None:
                 self.set_state(restored)
+                # rewind the data stream to the checkpoint's cursor so
+                # the resumed run replays the identical batch sequence
+                self._apply_cursor(self.ckpt.read_cursor(completed))
             self._event("resumed", completed)
         marker = os.path.join(self.ckpt.directory, PREEMPT_MARKER)
         if os.path.exists(marker):
@@ -504,6 +589,12 @@ class ResilientTrainer:
                         break
                     except WatchdogTimeout:
                         self._event("watchdog_timeout", step)
+                        if self._async_ckpt:
+                            # the device may be wedged: persist the newest
+                            # ring snapshot NOW, without touching it —
+                            # if escalation ends in abort, the operator
+                            # still has the freshest state on disk
+                            self.ckpt.emergency_save()
                         loss = None
                     except (KeyboardInterrupt, SystemExit,
                             UnrecoverableError):
@@ -595,10 +686,13 @@ class ResilientTrainer:
                 if (step // si) > ((step - n) // si) or step == num_steps:
                     with RecordEvent("resilient/save"):
                         if self.ledger is not None:
+                            # the measured span is the BLOCKING cost only:
+                            # async persists happen on the writer thread
+                            # and book checkpoint_async_seconds instead
                             with self.ledger.measure("checkpoint"):
-                                self.ckpt.save(step, self.get_state())
+                                self._save_boundary(step)
                         else:
-                            self.ckpt.save(step, self.get_state())
+                            self._save_boundary(step)
                     self._on_checkpoint_save(step)
             if self._preempt_signal is not None:
                 self._preempt_exit(step)
@@ -609,6 +703,8 @@ class ResilientTrainer:
                        "preempted": False, "events": list(self.events)}
             if self.ledger is not None:
                 summary["goodput"] = self.ledger.snapshot()
+            if self._async_ckpt:
+                summary["checkpoint"] = self.ckpt.stats()
             return summary
         finally:
             if self.sentinel is not None:

@@ -183,6 +183,14 @@ class GoodputLedger(PhaseLedger):
         self.wasted_steps = 0
         self.flops_per_step: Optional[float] = None
         self.peak_flops_total: Optional[float] = None
+        # ISSUE 15: seconds the AsyncCheckpointManager writer thread spent
+        # persisting snapshots. Deliberately NOT a phase — the writer runs
+        # concurrently with the step loop on its own thread, so booking it
+        # into phase_seconds would break the phases-tile-wall invariant.
+        # The `checkpoint` PHASE is therefore the BLOCKING cost only
+        # (host-fetch snapshot + sync saves/restores), and blocking vs
+        # async-background is directly comparable in snapshot().
+        self.checkpoint_async_seconds = 0.0
 
     def set_flops(self, flops_per_step: float, peak_flops_total: float):
         """Register the analytic FLOPs (obs.flops helpers) and the mesh's
@@ -198,9 +206,16 @@ class GoodputLedger(PhaseLedger):
             else:
                 self.wasted_steps += int(k)
 
+    def book_async_checkpoint(self, seconds: float):
+        """Background-writer persist seconds (AsyncCheckpointManager):
+        overlapped work, counted beside — never inside — the phases."""
+        with self._lock:
+            self.checkpoint_async_seconds += max(float(seconds), 0.0)
+
     def _reset_extra_locked(self):
         self.productive_steps = 0
         self.wasted_steps = 0
+        self.checkpoint_async_seconds = 0.0
 
     def snapshot(self) -> dict:
         """Point-in-time view: wall, per-phase seconds (idle = residual),
@@ -209,6 +224,7 @@ class GoodputLedger(PhaseLedger):
         with self._lock:
             productive = self.productive_steps
             wasted = self.wasted_steps
+            ckpt_async = self.checkpoint_async_seconds
         goodput = phases["compute"] / wall if wall > 0 else 0.0
         mfu = None
         if (self.flops_per_step and self.peak_flops_total and wall > 0
@@ -222,6 +238,11 @@ class GoodputLedger(PhaseLedger):
             "mfu": mfu,
             "productive_steps": productive,
             "wasted_steps": wasted,
+            # the checkpoint blocking/background split (ISSUE 15):
+            # blocking is the ledger phase (it spends wall time on the
+            # step thread), async is the overlapped writer-thread work
+            "checkpoint_blocking_seconds": phases["checkpoint"],
+            "checkpoint_async_seconds": ckpt_async,
         }
 
 

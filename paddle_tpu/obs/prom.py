@@ -164,7 +164,7 @@ class TrainingMetrics:
     }
 
     def __init__(self, tracker=None, ledger=None, hbm=None, sentinel=None,
-                 numerics=None):
+                 numerics=None, ckpt=None):
         self._lock = threading.Lock()
         self.tracker = tracker  # profiler.ThroughputTracker or None
         # ISSUE 10 goodput providers, all optional and sampled at render
@@ -173,6 +173,7 @@ class TrainingMetrics:
         self.hbm = hbm              # obs.goodput.HBMTelemetry
         self.sentinel = sentinel    # obs.goodput.RecompileSentinel
         self.numerics = numerics    # obs.numerics.NumericsObservatory
+        self.ckpt = ckpt            # checkpoint.AsyncCheckpointManager
         self.counters: Dict[str, int] = {
             v: 0 for v in self._EVENT_COUNTERS.values()}
         self.last_step = 0
@@ -202,6 +203,8 @@ class TrainingMetrics:
             s["recompile"] = self.sentinel.snapshot()
         if self.numerics is not None:
             s["numerics"] = self.numerics.snapshot()
+        if self.ckpt is not None:
+            s["ckpt"] = self.ckpt.stats()
         return s
 
     def render(self) -> str:
@@ -258,6 +261,26 @@ class TrainingMetrics:
                 for comp, nbytes in sorted(h["attributed"].items()):
                     b.sample(f"{px}_hbm_attributed_bytes", nbytes,
                              labels={"component": comp})
+        if self.ckpt is not None:
+            # pdtpu_train_ckpt_*: the continuous-checkpointing pipeline
+            # (AsyncCheckpointManager.stats) — snapshots taken, persisted,
+            # dropped under backpressure, emergency saves, scrubber
+            # quarantines, and the blocking/background seconds split
+            c = s["ckpt"]
+            for key in ("snapshots", "persisted", "dropped",
+                        "persist_errors", "emergency_saves",
+                        "corrupt_quarantined"):
+                b.family(f"{px}_ckpt_{key}_total", "counter")
+                b.sample(f"{px}_ckpt_{key}_total", c[key])
+            for key in ("lag_seconds_total", "blocking_seconds_total",
+                        "async_seconds_total"):
+                b.family(f"{px}_ckpt_{key}", "counter")
+                b.sample(f"{px}_ckpt_{key}", c[key], round_to=4)
+            b.family(f"{px}_ckpt_queue_depth", "gauge")
+            b.sample(f"{px}_ckpt_queue_depth", c["queue_depth"])
+            b.family(f"{px}_ckpt_last_lag_seconds", "gauge")
+            b.sample(f"{px}_ckpt_last_lag_seconds", c["last_lag_seconds"],
+                     round_to=4)
         text = b.render()
         if self.numerics is not None:
             # pdtpu_train_numerics_* families; "" until the observatory

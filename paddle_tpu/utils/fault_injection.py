@@ -22,6 +22,18 @@ Spec grammar (';'-separated clauses, each ``kind@step[:arg]``):
                           point is reached (torn-write path); the point name
                           matches CheckpointManager's kill points
     kill@4:step           SIGKILL self at the top of step 4
+    kill@4:persist        SIGKILL while the AsyncCheckpointManager writer
+                          thread persists snapshot 4 (kill-during-
+                          background-persist: the previous certified step
+                          must restore)
+    ckpt_io_stall@4:2.0   the background writer stalls 2.0s before
+                          persisting snapshot 4 — the writer falls behind,
+                          so the snapshot ring's drop-oldest backpressure
+                          (`ckpt_lag`) becomes observable
+    ckpt_torn_write@4     truncate checkpoint 4's data file AFTER its
+                          manifest landed: a manifest-certified-but-corrupt
+                          step (bit rot / torn block) that only the restore
+                          scrubber can catch
 
 Serving-side clauses (ISSUE 6) key on the engine's *dispatch index* (the
 running count of jitted prefill/decode attempts) or on a request's
@@ -75,6 +87,8 @@ ENV_VAR = "PDTPU_FAULTS"
 KILL_POINT_MID_SAVE = "mid_save"        # after data write, before any rename
 KILL_POINT_AFTER_DATA = "after_data"    # after data rename, before manifest
 KILL_POINT_STEP = "step"                # top of the training step
+KILL_POINT_PERSIST = "persist"          # AsyncCheckpointManager writer, at
+#                                         the top of a background persist
 
 
 class InjectedDispatchHang(RuntimeError):
@@ -253,6 +267,33 @@ class FaultPlan:
         f = self._take("delay", step)
         if f is not None:
             time.sleep(float(f.arg or "1.0"))
+
+    def maybe_ckpt_stall(self, step: int):
+        """ckpt_io_stall@step:s — stall the background checkpoint writer
+        for s seconds before it persists snapshot `step` (slow disk /
+        network filesystem hiccup). With the writer wedged, the snapshot
+        ring's drop-oldest-pending backpressure path fires."""
+        f = self._take("ckpt_io_stall", step)
+        if f is not None:
+            time.sleep(float(f.arg or "1.0"))
+
+    def maybe_torn_write(self, step: int, path: str):
+        """ckpt_torn_write@step — truncate `path` (the step's data file)
+        to half its size AFTER the save sequence completed. The manifest
+        certifies a file whose bytes no longer match its CRC: invisible to
+        latest_step()'s existence checks under
+        FLAGS_ckpt_integrity_check=False and to any protocol that trusts
+        rename atomicity — only a restore-time CRC pass (the scrubber)
+        catches it."""
+        f = self._take("ckpt_torn_write", step)
+        if f is None:
+            return
+        try:
+            size = os.path.getsize(path)
+            with open(path, "r+b") as fh:
+                fh.truncate(max(size // 2, 1))
+        except OSError:
+            pass  # injection must never break the real save path
 
     def maybe_dispatch_fault(self, dispatch_idx: int, kind: str = "dispatch",
                              request_ids=()):

@@ -1,0 +1,141 @@
+"""Subprocess fixture for tests/test_async_checkpoint.py: runs
+ResilientTrainer over an AsyncCheckpointManager with exact-resume cursor
+hooks, so the parent test can kill it mid-background-persist (or SIGTERM
+it) and assert that a fresh process resumes BIT-IDENTICALLY.
+
+    python async_ckpt_worker.py WORKDIR MODE
+
+modes:
+    fast    train NUM_STEPS (env, default 8) steps back-to-back
+    slow    sleep 0.15s inside every step — gives the parent a window to
+            deliver SIGTERM mid-run (emergency-save test)
+
+env knobs: NUM_STEPS, SNAP_INTERVAL (save_interval, default 2), and the
+fault schedule via PDTPU_FAULTS (kill@N:persist, ckpt_torn_write@N, ...).
+
+The data stream is a np.random.RandomState(7) batch generator whose
+cursor (next index + full RNG state) rides in the checkpoint manifest via
+get_cursor/set_cursor; batch() ASSERTS the requested index matches the
+cursor, so any resume that fails to rewind the stream crashes loudly
+instead of silently training on wrong data.
+
+Every completed step appends {"step", "loss"} to WORKDIR/losses.jsonl
+(flushed + fsynced so a SIGKILL can't lose lines). The parent stitches
+the killed + resumed runs' lines together: every recording of a given
+step — across processes, including replays — must be bit-identical, and
+must equal the uninterrupted run's value.
+
+Writes WORKDIR/progress (one line per step) and WORKDIR/report.json on a
+clean finish. Exit codes: 0 done, 137 fault-injected SIGKILL, 143
+preempted.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+from paddle_tpu.checkpoint import (  # noqa: E402
+    AsyncCheckpointManager, restore_rng, rng_cursor)
+from paddle_tpu.distributed.resilient import (  # noqa: E402
+    ResilientConfig, ResilientTrainer)
+
+WORKDIR = sys.argv[1]
+MODE = sys.argv[2] if len(sys.argv) > 2 else "fast"
+NUM_STEPS = int(os.environ.get("NUM_STEPS", "8"))
+SNAP_INTERVAL = int(os.environ.get("SNAP_INTERVAL", "2"))
+LOSSES = os.path.join(WORKDIR, "losses.jsonl")
+PROGRESS = os.path.join(WORKDIR, "progress")
+REPORT = os.path.join(WORKDIR, "report.json")
+
+
+class Stream:
+    """Deterministic batch stream with an exact-resume cursor."""
+
+    def __init__(self):
+        self.rs = np.random.RandomState(7)
+        self.next = 0
+
+    def batch(self, i):
+        assert i == self.next, \
+            f"stream asked for batch {i} but cursor is at {self.next}"
+        x = self.rs.randn(8, 4).astype(np.float32)
+        y = self.rs.randn(8, 4).astype(np.float32)
+        self.next = i + 1
+        return paddle.to_tensor(x), paddle.to_tensor(y)
+
+    def cursor(self):
+        return {"next": self.next, **rng_cursor(self.rs)}
+
+    def set(self, cur):
+        self.next = int(cur["next"])
+        restore_rng(self.rs, cur)
+
+
+def main():
+    paddle.seed(0)
+    model = nn.Linear(4, 4)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    stream = Stream()
+    current = {"i": None}  # batch index of the in-flight step
+
+    def batch_fn(i):
+        current["i"] = i
+        return stream.batch(i)
+
+    def train_fn(x, y):
+        if MODE == "slow":
+            time.sleep(0.15)
+        loss = nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        with open(LOSSES, "a") as f:
+            f.write(json.dumps({"step": current["i"],
+                                "loss": float(loss.item())}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        with open(PROGRESS, "a") as f:
+            f.write("step\n")
+        return loss
+
+    ckpt = AsyncCheckpointManager(os.path.join(WORKDIR, "ckpt"),
+                                  max_to_keep=50)
+    trainer = ResilientTrainer(
+        train_fn, ckpt,
+        get_state=lambda: {"model": model.state_dict()},
+        set_state=lambda s: model.set_state_dict(s["model"]),
+        get_cursor=stream.cursor,
+        set_cursor=stream.set,
+        config=ResilientConfig(save_interval=SNAP_INTERVAL))
+    summary = trainer.run(batch_fn, num_steps=NUM_STEPS)
+
+    kinds = [e["kind"] for e in summary["events"]]
+    resumed_from = next((e["step"] for e in summary["events"]
+                         if e["kind"] == "resumed"), 0)
+    with open(REPORT, "w") as f:
+        json.dump({"resumed_from": resumed_from,
+                   "completed": summary["completed_steps"],
+                   "event_kinds": kinds,
+                   "quarantined": [
+                       {"step": e["step"], "file": e["file"],
+                        "reason": e["reason"]}
+                       for e in summary["events"]
+                       if e["kind"] == "ckpt_quarantined"],
+                   "ckpt": summary["checkpoint"]}, f)
+    ckpt.close()
+
+
+if __name__ == "__main__":
+    main()

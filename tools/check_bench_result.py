@@ -95,6 +95,14 @@ GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
              # against a TPU pin).
              "train_goodput": "higher",
              "train_mfu_live": "higher",
+             # ISSUE 15 continuous-checkpointing gate (`bench.py --ckpt`):
+             # the worst step-thread stall at any async save boundary is a
+             # CEILING — the blocking cost of a snapshot is one host fetch,
+             # and anything that drags persist work back onto the step
+             # thread (lock contention, a sync fallback, CRC on the hot
+             # path) must fail the gate. The async run's train_goodput
+             # floor above gates the same row.
+             "train_ckpt_stall_ms": "lower",
              # ISSUE 11 serving-economics gates: the unified mixed step's
              # token efficiency (useful / total fixed-width positions) and
              # the ledger's effective decode MFU are FLOORS; the pump's
@@ -138,7 +146,7 @@ def _metrics_of(row):
               "llm_interactive_ttft_p99_ms", "llm_shed_rate",
               "llm_mixed_ttft_p99_ms", "llm_prefill_dispatches",
               "llm_prefix_hit_rate", "llm_shared_prefill_tok_s",
-              "train_goodput", "train_mfu_live",
+              "train_goodput", "train_mfu_live", "train_ckpt_stall_ms",
               "llm_token_efficiency", "llm_decode_mfu",
               "llm_host_fraction",
               "compile_executables", "compile_seconds_total",

@@ -62,8 +62,18 @@ generate(), with `router_failover` flight events naming the dead
 replica and each resumed rid in submit order; a `replica_hang@i:s`
 freeze walks the watchdog → quarantine → exponential-backoff →
 re-admission ladder; and a fleet-wide brownout sheds best_effort at the
-router's door while interactive work still completes on survivors) —
-then prints a pass/fail table. Exit 0 iff every scenario recovered.
+router's door while interactive work still completes on survivors),
+and the ISSUE 15 continuous-checkpointing scenarios in
+tests/test_async_checkpoint.py (a worker SIGKILLed inside the
+background writer thread — `kill@N:persist` / `kill@N:mid_save` —
+resumes from the previous certified step with the stitched loss
+trajectory BIT-IDENTICAL to an uninterrupted run; a
+`ckpt_torn_write@N` certified-but-corrupt checkpoint is quarantined to
+`step_N.corrupt/` by the restore scrubber before resume; and SIGTERM
+triggers an emergency persist of the newest ring snapshot whose
+`ckpt_emergency` flight event reconciles with the preemption marker
+and the newest certified step on disk) — then prints a pass/fail
+table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
     python tools/check_fault_matrix.py --list     # show scenarios only
@@ -93,6 +103,7 @@ TEST_FILES = [
     os.path.join("tests", "test_compile_observatory.py"),
     os.path.join("tests", "test_train_numerics.py"),
     os.path.join("tests", "test_router.py"),
+    os.path.join("tests", "test_async_checkpoint.py"),
 ]
 
 
