@@ -1040,6 +1040,153 @@ def run_fleet_bench():
     print(json.dumps(result))
 
 
+def run_deploy_bench():
+    """Rolling-deploy benchmark (ISSUE 16): replays a seeded Poisson
+    prompt trace over a live 4-replica ReplicaRouter fleet WHILE a
+    DeploymentController rolls a certified WeightSet (numerically
+    identical params published as "v2") across every replica —
+    drain → swap → canary → re-admit, one replica at a time. Reports the
+    p99 TTFT measured across the whole rollout window and the number of
+    admitted streams that failed to complete. Gates through
+    tools/check_bench_result.py: `deploy_ttft_p99_ms` is a CEILING
+    (the drain/swap churn must not starve admissions) and
+    `deploy_dropped_streams` MUST stay 0 — the zero-downtime contract
+    itself."""
+    import os
+    import tempfile
+
+    import jax
+
+    from paddle_tpu.checkpoint import WeightSet
+    from paddle_tpu.models.generation import make_decoder_fns
+    from paddle_tpu.serving import (DeployConfig, DeploymentController,
+                                    InProcessReplica, LLMMetrics,
+                                    RejectedError, ReplicaRouter,
+                                    RouterConfig)
+    from paddle_tpu.serving.llm import LLMEngine, LLMEngineConfig
+
+    preset = os.environ.get("BENCH_DEPLOY_PRESET", "gpt2-tiny")
+    n_replicas = int(os.environ.get("BENCH_DEPLOY_REPLICAS", "4"))
+    num_slots = int(os.environ.get("BENCH_DEPLOY_SLOTS", "4"))
+    max_new = int(os.environ.get("BENCH_DEPLOY_MAX_NEW", "8"))
+    rate_hz = float(os.environ.get("BENCH_DEPLOY_RATE_HZ", "200"))
+    min_req = int(os.environ.get("BENCH_DEPLOY_MIN_REQUESTS", "24"))
+    max_req = int(os.environ.get("BENCH_DEPLOY_MAX_REQUESTS", "400"))
+    backend = jax.default_backend()
+
+    if preset.startswith("llama"):
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        model = LlamaForCausalLM.from_preset(preset)
+    else:
+        from paddle_tpu.models.gpt import GPTForCausalLM
+        model = GPTForCausalLM.from_preset(preset)
+    vocab = model.config.vocab_size if hasattr(model, "config") else 512
+
+    def mk_replica(i):
+        eng = LLMEngine(model, LLMEngineConfig(
+            num_slots=num_slots, block_len=8,
+            n_blocks=max(4, -(-(16 + max_new) // 8)),
+            max_queue_depth=max(8 * num_slots, 64)))
+        eng.start()
+        eng.generate([1, 2, 3], max_new_tokens=2, timeout=300)  # warm jit
+        eng.metrics = LLMMetrics()
+        eng.metrics.set_slots(0, eng.pool.num_slots)
+        return InProcessReplica(eng, i)
+
+    reps = [mk_replica(i) for i in range(n_replicas)]
+    router = ReplicaRouter(
+        reps, RouterConfig(poll_interval_s=0.002)).start()
+
+    tmpdir = tempfile.mkdtemp(prefix="pdtpu_deploy_bench_")
+    params, _, _ = make_decoder_fns(model)
+    ws = WeightSet.publish(tmpdir, "v2", params)
+    ctrl = DeploymentController(
+        router, DeployConfig(watch_window_s=0.25, settle_timeout_s=300.0))
+
+    rng = np.random.RandomState(0)
+
+    def submit_one(handles, rejected):
+        p = rng.randint(1, vocab,
+                        size=int(rng.randint(3, 13))).astype(np.int32)
+        try:
+            handles.append(router.submit(p, max_new_tokens=max_new))
+            return rejected
+        except RejectedError:
+            return rejected + 1     # admission control, NOT a drop
+
+    handles, rejected = [], 0
+    for _ in range(n_replicas):     # pre-roll: swap lands MID-traffic
+        rejected = submit_one(handles, rejected)
+    t0 = time.perf_counter()
+    ctrl.spawn(ws)
+    # Poisson arrivals sustained across the WHOLE rollout window
+    while ((ctrl.active() or len(handles) < min_req)
+           and len(handles) < max_req):
+        time.sleep(rng.exponential(1.0 / rate_hz))
+        rejected = submit_one(handles, rejected)
+    while ctrl.active():            # trace capped out before the rollout
+        time.sleep(0.01)
+    rollout_s = time.perf_counter() - t0
+
+    dropped = 0
+    ttfts = []
+    for h in handles:
+        try:
+            toks = h.result(timeout=300)
+            assert toks.size > 0
+            if h.ttft_ms is not None:
+                ttfts.append(float(h.ttft_ms))
+        except Exception:
+            dropped += 1
+    rec = ctrl.status()["history"][-1]
+    versions = sorted({r.weight_version for r in reps if not r.crashed})
+    router.stop(drain=True)
+
+    p99 = float(np.percentile(ttfts, 99)) if ttfts else 0.0
+    result = {
+        "metric": f"ttft_p99/deploy deploy-{preset} x{n_replicas} "
+                  f"slots{num_slots}",
+        "value": round(p99, 3),
+        "unit": "ms p99 TTFT across a full rolling weight swap",
+        "vs_baseline": 0.0,
+        "extra": {
+            "deploy_ttft_p99_ms": round(p99, 3),
+            "deploy_dropped_streams": dropped,
+            "deploy_outcome": rec["outcome"],
+            "deploy_rollout_s": round(rollout_s, 3),
+            "deploy_swapped": rec["swapped"],
+            "deploy_fleet_versions": versions,
+            "deploy_requests": len(handles),
+            "deploy_failovers": sum(h.failovers for h in handles),
+            "rejected": rejected,
+            "backend": backend,
+            "n_replicas": n_replicas,
+            "rate_hz": rate_hz,
+            "num_slots": num_slots,
+            "max_new_tokens": max_new,
+            "provenance": _provenance(),
+        },
+    }
+    print(json.dumps(result))
+
+
+def _deploy_main():
+    """--deploy entry: like main(), ALWAYS prints one JSON line, exit 0."""
+    try:
+        run_deploy_bench()
+    except Exception as e:
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "deploy_bench_error",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "extra": {"error": f"{type(e).__name__}: {str(e)[:400]}",
+                      "provenance": _provenance()},
+        }))
+    sys.exit(0)
+
+
 def run_ckpt_bench():
     """Continuous-checkpointing benchmark (ISSUE 15): the same train fn
     runs twice under ResilientTrainer with the goodput ledger armed —
@@ -1377,6 +1524,8 @@ if __name__ == "__main__":
         _llm_main()
     elif "--fleet" in sys.argv:
         _fleet_main()
+    elif "--deploy" in sys.argv:
+        _deploy_main()
     elif "--ckpt" in sys.argv:
         _ckpt_main()
     elif "--probe" in sys.argv:

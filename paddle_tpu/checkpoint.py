@@ -374,6 +374,142 @@ def scrub_checkpoints(directory: str) -> Dict[str, List]:
     return {"clean": clean, "quarantined": quarantined}
 
 
+# ---- certified serving weight sets (ISSUE 16) ----
+
+class UncertifiedWeightsError(ValueError):
+    """A serving `WeightSet` failed certification: missing/unreadable
+    manifest, missing data file, wrong format, or CRC mismatch. Deploys
+    refuse uncertified weights outright — a torn or bit-rotted weight
+    file must never reach a live fleet. `reason` is machine-readable
+    and mirrors the scrubber's quarantine vocabulary."""
+
+    def __init__(self, msg: str, reason: str = "uncertified"):
+        super().__init__(msg)
+        self.reason = reason
+
+
+class WeightSet:
+    """A versioned, manifest/CRC-certified serving parameter set.
+
+    The deployable unit of ISSUE 16's rolling deploys: a params tree
+    published as `weights_<version>.pdckpt` + `weights_<version>
+    .manifest.json` under the same tmp→fsync→rename, data-first/
+    manifest-last protocol as `CheckpointManager.save`, so the manifest's
+    presence certifies the write sequence finished and its crc32 pins
+    the bytes. `certify()` ALWAYS runs the CRC pass (like
+    `scrub_checkpoints`, unlike `verify()`): a deploy is the
+    once-per-rollout moment where corrupt weights would otherwise reach
+    every replica in the fleet. The manifest may carry a `golden` block
+    (canary prompts + expected greedy tokens) published alongside the
+    weights by whoever trained them."""
+
+    FORMAT = "pdtpu.weights.v1"
+
+    def __init__(self, directory: str, version: str):
+        if not version or not all(
+                c.isalnum() or c in "._-" for c in str(version)):
+            raise ValueError(
+                f"weight version {version!r} must be non-empty and "
+                "filesystem-safe ([A-Za-z0-9._-])")
+        self.directory = os.path.abspath(directory)
+        self.version = str(version)
+
+    @property
+    def data_path(self) -> str:
+        return os.path.join(self.directory,
+                            f"weights_{self.version}.pdckpt")
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.directory,
+                            f"weights_{self.version}.manifest.json")
+
+    @classmethod
+    def publish(cls, directory: str, version: str, params,
+                golden: Optional[Dict[str, Any]] = None) -> "WeightSet":
+        """Write `params` as a certified weight set. Data lands first
+        (tmp → fsync → rename), the manifest last — a crash at any point
+        leaves either no manifest (uncertified, refused by deploys) or a
+        fully certified pair."""
+        from .framework_io import save as _save
+        ws = cls(directory, version)
+        os.makedirs(ws.directory, exist_ok=True)
+        params = _to_arrays(params)
+        tmp_data = ws.data_path + ".tmp"
+        tmp_manifest = ws.manifest_path + ".tmp"
+        _save(params, tmp_data)
+        _fsync_file(tmp_data)
+        spec = {"version": ws.version, "format": cls.FORMAT,
+                "crc32": _file_crc(tmp_data), "time": time.time(),
+                "leaves": _leaf_specs(params)}
+        if golden is not None:
+            spec["golden"] = golden
+        with open(tmp_manifest, "w") as f:
+            json.dump(spec, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp_data, ws.data_path)
+        os.replace(tmp_manifest, ws.manifest_path)
+        return ws
+
+    def certify(self) -> Dict[str, Any]:
+        """Full certification pass: manifest present + readable, format
+        recognised, version matches, data present, crc32 matches the
+        bytes on disk. Returns the manifest dict; raises
+        `UncertifiedWeightsError` (typed, with a scrubber-vocabulary
+        `reason`) on any failure."""
+        if not os.path.exists(self.manifest_path):
+            raise UncertifiedWeightsError(
+                f"weight set {self.version!r} in {self.directory} has no "
+                "manifest (torn or unfinished publish)",
+                reason="no_manifest")
+        try:
+            with open(self.manifest_path) as f:
+                spec = json.load(f)
+        except (OSError, ValueError) as e:
+            raise UncertifiedWeightsError(
+                f"weight set {self.version!r} manifest unreadable: "
+                f"{type(e).__name__}", reason="manifest_unreadable")
+        if spec.get("format") != self.FORMAT:
+            raise UncertifiedWeightsError(
+                f"weight set {self.version!r} has unknown format "
+                f"{spec.get('format')!r} (expected {self.FORMAT!r})",
+                reason="bad_format")
+        if spec.get("version") != self.version:
+            raise UncertifiedWeightsError(
+                f"manifest names version {spec.get('version')!r} but the "
+                f"deploy asked for {self.version!r}",
+                reason="version_mismatch")
+        if not os.path.exists(self.data_path):
+            raise UncertifiedWeightsError(
+                f"weight set {self.version!r} manifest without data file",
+                reason="no_data")
+        try:
+            expect = int(spec["crc32"])
+        except (KeyError, TypeError, ValueError):
+            raise UncertifiedWeightsError(
+                f"weight set {self.version!r} manifest carries no usable "
+                "crc32", reason="manifest_unreadable")
+        if _file_crc(self.data_path) != expect:
+            raise UncertifiedWeightsError(
+                f"weight set {self.version!r} crc32 mismatch "
+                "(torn write / bit rot)", reason="crc_mismatch")
+        return spec
+
+    def load(self):
+        """Certify, then load the params tree. The only sanctioned way
+        weights reach a serving engine."""
+        from .framework_io import load as _load
+        self.certify()
+        return _load(self.data_path)
+
+    @property
+    def golden(self) -> Optional[Dict[str, Any]]:
+        """The manifest's golden canary block, if published (certifies as
+        a side effect — golden data from an uncertified set is useless)."""
+        return self.certify().get("golden")
+
+
 # ---- continuous checkpointing tier ----
 
 class Snapshot:

@@ -31,6 +31,7 @@ package, never the other way around.
 from .compile_observatory import (CompileObservatory, compile_observatory,
                                   diff_signatures, fingerprint_of,
                                   signature_of)
+from .deploy_metrics import DeployMetrics
 from .flight_recorder import DUMP_DIR_ENV, FlightRecorder, flight_recorder
 from .flops import (conv_train_flops_per_step, decode_flops_per_token,
                     decode_mfu, peak_flops, train_flops_per_step)
@@ -48,6 +49,7 @@ from .trace import (LLM_PHASES, SERVING_PHASES, RequestTrace, TimelineStore,
 __all__ = [
     "CompileObservatory", "compile_observatory", "diff_signatures",
     "fingerprint_of", "signature_of",
+    "DeployMetrics",
     "DUMP_DIR_ENV", "FlightRecorder", "flight_recorder",
     "conv_train_flops_per_step", "decode_flops_per_token", "decode_mfu",
     "peak_flops", "train_flops_per_step",

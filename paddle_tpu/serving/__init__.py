@@ -25,6 +25,7 @@ pow2 bucketing vs symbolic-batch exports) and its reliability section
 token-budget backpressure (HTTP 429 + Retry-After) and brownout.
 """
 from .clock import Clock, MonotonicClock, SimClock  # noqa: F401
+from .deploy import DeployConfig, DeploymentController  # noqa: F401
 from .engine import (BatchingEngine, DeadlineExceededError,  # noqa: F401
                      EngineConfig, RejectedError)
 from .metrics import (SLO_CLASSES, LLMMetrics, RouterMetrics,  # noqa: F401
@@ -39,4 +40,4 @@ from .router import (InProcessReplica, ReplicaRouter,  # noqa: F401
 from . import llm  # noqa: F401
 from .llm import (GenerationHandle, LLMEngine,  # noqa: F401
                   LLMEngineConfig, PrefixCache, SlotPagedKVPool,
-                  SlotsExhaustedError)
+                  SlotsExhaustedError, WeightSwapError)

@@ -21,5 +21,5 @@ block_len tradeoffs.
 from .kv_pool import SlotPagedKVPool, SlotsExhaustedError  # noqa: F401
 from .llm_engine import (DispatchFailedError,  # noqa: F401
                          DispatchHungError, GenerationHandle, LLMEngine,
-                         LLMEngineConfig)
+                         LLMEngineConfig, WeightSwapError)
 from .prefix_cache import AttachPlan, PrefixCache  # noqa: F401

@@ -100,6 +100,14 @@ from .prefix_cache import PrefixCache
 _log = logging.getLogger("paddle_tpu.serving.llm")
 
 
+class WeightSwapError(ValueError):
+    """`replace_params` refused a hot swap: the engine still holds work,
+    or the new tree's abstract signature (structure / leaf shapes /
+    dtypes) differs from the serving params — a mismatched signature
+    would recompile the unified step mid-fleet, which is exactly what a
+    rolling deploy must never do."""
+
+
 @dataclass
 class LLMEngineConfig:
     num_slots: int = 4             # decode width == KV pool size
@@ -162,6 +170,9 @@ class LLMEngineConfig:
     #                                (signature fingerprint + AOT cost/memory
     #                                analyses) with the process-global
     #                                CompileObservatory; off = one predicate
+    # ---- rolling weight deployment (ISSUE 16) ----
+    weight_version: str = "v0"     # version id of the params the engine
+    #                                starts on; replace_params() advances it
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -330,6 +341,9 @@ class LLMEngine:
         self.metrics = metrics or LLMMetrics()
         self.params, self._prefill_fn, self._decode_fn = \
             make_decoder_fns(model)
+        if not self.config.weight_version:
+            raise ValueError("weight_version must be a non-empty string")
+        self.weight_version = self.config.weight_version
         # pad_tokens=prefill_chunk: the fixed-width KV stripe written at a
         # row's position needs chunk-width scratch past the last
         # addressable block so near-capacity writes never clamp back onto
@@ -616,6 +630,131 @@ class LLMEngine:
                 self.on_break()
             except Exception:
                 _log.exception("llm on_break callback failed")
+
+    # ---- rolling weight deployment (ISSUE 16) ----
+    def evacuate(self, reason: str = "deploy_drain") -> int:
+        """Deploy-drain eviction: fail every queued AND active request
+        with a typed RejectedError(reason=...) and free their KV rows,
+        WITHOUT entering the terminal stop() path — the engine keeps
+        serving afterwards. The DeploymentController calls this only
+        after the router has already re-queued the same streams for
+        failover re-prefill on a survivor, so nothing observable is
+        dropped: these engine-side rows are orphans whose handles are
+        detached. Returns rows+requests evicted."""
+        n = 0
+        with self._cond:
+            for q in self._queues.values():
+                while q:
+                    req = q.popleft()
+                    self._conclude(req, f"rejected:{reason}")
+                    if not req.handle.future.done():
+                        req.handle.future.set_exception(RejectedError(
+                            f"engine evacuated ({reason}) before prefill",
+                            reason=reason))
+                    self.metrics.on_reject(reason)
+                    n += 1
+            for slot, req in list(self._active.items()):
+                self._conclude(req, f"rejected:{reason}")
+                if not req.handle.future.done():
+                    req.handle.future.set_exception(RejectedError(
+                        f"engine evacuated ({reason}) mid-decode",
+                        reason=reason))
+                self.metrics.on_reject(reason)
+                self.pool.free(slot)
+                n += 1
+            self._active.clear()
+            self.metrics.set_queue_depth(0)
+            self.metrics.set_slots(self.pool.active_slots(),
+                                   self.pool.num_slots)
+            self._cond.notify_all()
+        if n:
+            flight_recorder().record("deploy_evacuate", engine="llm",
+                                     reason=reason, n=n)
+        return n
+
+    def replace_params(self, new_params, version: str):
+        """Hot in-place weight swap between pump iterations — NO
+        recompile. The unified step executable keys on its arguments'
+        abstract signature (shape/dtype tree), and `_step_once` reads
+        `self.params` fresh on every dispatch, so rebinding the attribute
+        with a signature-identical tree reuses the warm `_step_jit` —
+        verified end to end by the compile observatory (no
+        `compile_recompile` events for `llm/unified_step` across a
+        deploy). Refuses (typed `WeightSwapError`) if the engine still
+        holds queued/active work or if the new tree's structure, any leaf
+        shape, or any leaf dtype differs. Also flushes the prefix cache:
+        cached KV was computed under the OLD weights, and attaching it to
+        a new-version prompt would stitch two weight sets inside one
+        attention window."""
+        if not version:
+            raise ValueError("version must be a non-empty string")
+        converted = jax.tree_util.tree_map(jnp.asarray, new_params)
+        old_s = jax.tree_util.tree_structure(self.params)
+        new_s = jax.tree_util.tree_structure(converted)
+        if old_s != new_s:
+            raise WeightSwapError(
+                f"weight set {version!r} has a different tree structure "
+                f"than the serving params ({new_s} vs {old_s})")
+        old_leaves = jax.tree_util.tree_leaves_with_path(self.params)
+        new_leaves = jax.tree_util.tree_leaves(converted)
+        for (path, old), new in zip(old_leaves, new_leaves):
+            if tuple(old.shape) != tuple(new.shape) \
+                    or old.dtype != new.dtype:
+                raise WeightSwapError(
+                    f"weight set {version!r} leaf "
+                    f"{jax.tree_util.keystr(path)} is "
+                    f"{tuple(new.shape)}/{new.dtype}, serving params have "
+                    f"{tuple(old.shape)}/{old.dtype} — abstract signature "
+                    "must match exactly (swap without recompile)")
+        with self._cond:
+            if self._queue_len_locked() or self._active:
+                raise WeightSwapError(
+                    f"cannot swap to {version!r} with work in flight "
+                    f"(queued={self._queue_len_locked()}, "
+                    f"active={len(self._active)}): drain first")
+            flushed = 0
+            if self.prefix_cache is not None:
+                flushed = self.prefix_cache.clear()
+            prior = self.weight_version
+            self.params = converted
+            self.weight_version = str(version)
+            self._cond.notify_all()
+        flight_recorder().record(
+            "weight_swap", engine="llm", version=str(version),
+            prior=prior, leaves=len(new_leaves), flushed_blocks=flushed)
+
+    def canary_probe(self, prompt, max_new_tokens: int = 4):
+        """Golden-prompt canary: greedy-decode `max_new_tokens` tokens
+        directly through the prefill/decode functions on the CONTIGUOUS
+        cache path (paged=None — same kernel as the paged path at shared
+        block size, so bit-identity across replicas is meaningful),
+        checking every logits tensor for finiteness along the way.
+        Runs outside the scheduler on purpose: the gate must work on a
+        drained, placement-excluded replica before any traffic lands on
+        the new weights. Returns (tokens np.int32 [max_new_tokens],
+        logits_finite bool)."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("canary prompt must be non-empty")
+        total = int(prompt.size) + int(max_new_tokens)
+        caches = self.model.init_cache(1, total)
+        logits, caches = self._prefill_fn(
+            self.params, jnp.asarray(prompt[None, :]), caches, 0)
+        lg = np.asarray(logits)
+        finite = bool(np.isfinite(lg).all())
+        last = int(np.argmax(lg[0, -1]))
+        toks = [last]
+        pos = int(prompt.size)
+        for _ in range(int(max_new_tokens) - 1):
+            logits, caches = self._decode_fn(
+                self.params, jnp.asarray([last], dtype=jnp.int32),
+                pos, caches)
+            lg = np.asarray(logits)
+            finite = finite and bool(np.isfinite(lg).all())
+            last = int(np.argmax(lg[0]))
+            toks.append(last)
+            pos += 1
+        return np.asarray(toks, dtype=np.int32), finite
 
     def __enter__(self):
         return self
@@ -1142,7 +1281,11 @@ class LLMEngine:
                 elif prefill_slots:
                     self.prefill_dispatches += 1
                 for slot in prefill_slots:
-                    req = self._active[slot]
+                    # evacuate() (deploy drain) may have freed the slot
+                    # between row build and commit in threaded mode
+                    req = self._active.get(slot)
+                    if req is None:
+                        continue
                     n = int(adv[slot])
                     off = req.chunk_off
                     self.pool.set_length(slot, off + n)
@@ -1185,7 +1328,9 @@ class LLMEngine:
                         # must not keep absorbing chunk work
                         self._evict_expired_locked(req, slot, now)
                 for slot in decode_slots:
-                    req = self._active[slot]
+                    req = self._active.get(slot)
+                    if req is None:
+                        continue  # evacuated mid-step (deploy drain)
                     # the decode wrote last_tok's KV at pos[slot]
                     self.pool.set_length(slot, int(pos[slot]) + 1)
                     if req.trace is not None:

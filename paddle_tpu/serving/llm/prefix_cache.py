@@ -327,6 +327,39 @@ class PrefixCache:
             released += 1
         return released
 
+    def clear(self) -> int:
+        """Release EVERY cached page and drop the whole trie, keeping the
+        pool ledger balanced. Used on an in-place weight swap (ISSUE 16):
+        cached KV was computed under the old weights, and attaching it to
+        a new-version prompt would stitch two weight sets inside one
+        attention window. Caller must hold the engine idle (acquire-plan
+        refcounts all released); cached pins are dropped here. Returns
+        pages released."""
+        released = 0
+        for tenant, root in self._roots.items():
+            ts = self._ts(tenant)
+            stack: List[Tuple[_Node, bool]] = [(root, True)]
+            while stack:
+                node, is_root = stack.pop()
+                if node.tail_page is not None:
+                    self.pool.release_cached(node.tail_page)
+                    node.tail_tokens = None
+                    node.tail_page = None
+                    released += 1
+                    ts["evictions"] += 1
+                    self.stats["evictions"] += 1
+                if not is_root and node.page is not None:
+                    self.pool.release_cached(node.page)
+                    released += 1
+                    ts["evictions"] += 1
+                    self.stats["evictions"] += 1
+                for c in node.children.values():
+                    stack.append((c, False))
+            ts["cached_blocks"] = 0
+        self._roots.clear()
+        self.stats["cached_blocks"] = 0
+        return released
+
     # ---- views ----
     def cached_blocks(self, tenant: Optional[str] = None) -> int:
         if tenant is None:

@@ -626,6 +626,7 @@ class RouterMetrics:
         self.affinity_hits = 0                     # routed to a prefix match
         self.affinity_decisions = 0
         self.replica_inflight: Dict[str, int] = {}
+        self.replica_weight_version: Dict[str, str] = {}   # ISSUE 16
 
     # ---- router callbacks ----
     def on_submit(self):
@@ -653,10 +654,13 @@ class RouterMetrics:
         with self._lock:
             self.counters["failed"] += 1
 
-    def set_replica(self, replica: str, state: str, inflight_tokens: int):
+    def set_replica(self, replica: str, state: str, inflight_tokens: int,
+                    weight_version: Optional[str] = None):
         with self._lock:
             self.replica_state[replica] = state
             self.replica_inflight[replica] = int(inflight_tokens)
+            if weight_version is not None:
+                self.replica_weight_version[replica] = str(weight_version)
 
     def on_quarantine(self, replica: str):
         with self._lock:
@@ -690,6 +694,7 @@ class RouterMetrics:
                 "quarantines": dict(self.quarantines),
                 "readmissions": dict(self.readmissions),
                 "failovers": dict(self.failovers),
+                "replica_weight_version": dict(self.replica_weight_version),
                 "resumed_streams": self.resumed_streams,
                 "affinity_hit_rate": (
                     self.affinity_hits / self.affinity_decisions
@@ -736,6 +741,12 @@ class RouterMetrics:
         for replica in sorted(s["failovers"]):
             b.sample(f"{px}_failovers_total", s["failovers"][replica],
                      {"replica": replica})
+        b.family(f"{px}_replica_weight_info", "gauge")
+        for replica in sorted(s["replica_weight_version"]):
+            # info-style gauge: constant 1, the version rides the label
+            b.sample(f"{px}_replica_weight_info", 1,
+                     {"replica": replica,
+                      "version": s["replica_weight_version"][replica]})
         b.family(f"{px}_resumed_streams_total", "counter")
         b.sample(f"{px}_resumed_streams_total", s["resumed_streams"])
         b.family(f"{px}_prefix_affinity_hit_rate", "gauge")

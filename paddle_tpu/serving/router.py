@@ -89,6 +89,12 @@ class InProcessReplica:
         self._hang_until: Optional[float] = None
         self.last_progress = self.clock.now()
         self._seen_idx = engine._dispatch_idx
+        # rolling-deploy lifecycle (ISSUE 16): serving | draining |
+        # swapping | canary. Any non-serving state excludes the replica
+        # from placement (health() != "ok") but — unlike quarantine —
+        # keeps it pumped, so streams finishing in place still decode.
+        self.deploy_state = "serving"
+        self._swap_ready_at: Optional[float] = None
 
     # -- health vocabulary (same words /healthz speaks) --
 
@@ -99,7 +105,14 @@ class InProcessReplica:
             return "broken"
         if self.engine.draining:
             return "draining"
+        if self.deploy_state != "serving":
+            # deploy lifecycle word: "draining" / "swapping" / "canary"
+            return self.deploy_state
         return "ok"
+
+    @property
+    def weight_version(self) -> str:
+        return self.engine.weight_version
 
     # -- routing inputs --
 
@@ -140,6 +153,56 @@ class InProcessReplica:
             except Exception:
                 _log.exception("replica %s: engine stop after crash failed",
                                self.name)
+
+    # -- rolling-deploy lifecycle (ISSUE 16) --
+
+    def drain(self):
+        """Enter deploy-drain: placement skips this replica from now on
+        (health() reads "draining") while it keeps being pumped, so any
+        stream the router chose to leave in place decodes to completion.
+        The router's `drain_replica` is the entry point — it also moves
+        movable streams; call that, not this, unless testing."""
+        if self.crashed:
+            raise RuntimeError(f"replica {self.name} is crashed")
+        self.deploy_state = "draining"
+
+    def swap(self, params, version: str):
+        """In-place weight swap on a drained, idle replica. Applies the
+        `swap_stall@i:s` fault clause (the new weights need s more
+        seconds to be trustworthy — `swap_ready()` gates the canary),
+        then delegates to the engine's signature-checked
+        `replace_params`."""
+        if self.crashed:
+            raise RuntimeError(f"replica {self.name} is crashed")
+        if self.deploy_state != "draining":
+            raise RuntimeError(
+                f"replica {self.name} must be draining to swap "
+                f"(deploy_state={self.deploy_state!r})")
+        plan = (self._fault_plan if self._fault_plan is not None
+                else global_plan())
+        if plan is not None:
+            stall = plan.maybe_swap_stall(self.index)
+            if stall is not None:
+                self._swap_ready_at = self.clock.now() + float(stall)
+        self.engine.replace_params(params, version)
+        self.deploy_state = "swapping"
+
+    def swap_ready(self) -> bool:
+        """True once any injected swap stall has elapsed."""
+        if self._swap_ready_at is None:
+            return True
+        if self.clock.now() >= self._swap_ready_at:
+            self._swap_ready_at = None
+            return True
+        return False
+
+    def mark_canary(self):
+        self.deploy_state = "canary"
+
+    def readmit(self):
+        """Leave the deploy lifecycle: placement sees the replica again."""
+        self.deploy_state = "serving"
+        self._swap_ready_at = None
 
     def observe_progress(self, now: float):
         """Watchdog input: the dispatch counter moved, or there is
@@ -209,6 +272,11 @@ class RouterHandle:
         self.future: Future = Future()
         self.ttft_ms: Optional[float] = None
         self.failovers = 0                  # replica deaths survived
+        self.weight_version: Optional[str] = None   # pinned at placement;
+        #                                     FROZEN once any token was
+        #                                     emitted — a stream is never
+        #                                     stitched across two weight
+        #                                     sets (ISSUE 16)
         self._seq = seq                     # router submit order
         self._deadline_abs = deadline_abs
         self._prefix = np.empty(0, np.int32)   # harvested off dead replicas
@@ -438,13 +506,22 @@ class ReplicaRouter:
         """Route + admit: candidates ranked by longest block-aligned
         prefix match, then lightest in-flight token load, then index.
         Tries the ranked list in order so one replica's queue_full does
-        not fail an admission another replica could take. Returns the
-        accepting replica, or (None, last_reject)."""
+        not fail an admission another replica could take.
+
+        Version-skew safety (ISSUE 16): once a stream has emitted tokens
+        its pinned `weight_version` is frozen, and only same-version
+        replicas qualify — resuming the emitted prefix under different
+        weights would stitch two weight sets into one stream. A stream
+        with no tokens yet may re-pin (there is nothing to stitch).
+        Returns the accepting replica, or (None, last_reject)."""
         args = handle._resume_args(now)
+        pinned = (handle.weight_version
+                  if handle._prefix.size > 0 else None)
         ranked = sorted(
             ((-(r.prefix_probe(args["prompt"], tenant=handle.tenant)),
               r.inflight_tokens(), r.index, r)
-             for r in self._candidates_locked()),
+             for r in self._candidates_locked()
+             if pinned is None or r.weight_version == pinned),
             key=lambda t: t[:3])
         last_exc: Optional[Exception] = None
         for neg_match, _, _, r in ranked:
@@ -455,6 +532,7 @@ class ReplicaRouter:
                 continue
             handle._inner = inner
             handle._replica = r
+            handle.weight_version = r.weight_version
             self.metrics.on_route(r.name, prefix_hit=neg_match < 0)
             return r, None
         return None, last_exc
@@ -540,6 +618,16 @@ class ReplicaRouter:
                 continue
             h = r.health()
             if h != "ok":
+                if (r.deploy_state != "serving" and not r.crashed
+                        and not r.engine.broken
+                        and not r.engine.draining):
+                    # controller-owned deploy lifecycle, NOT a fault:
+                    # placement already skips the replica; its streams
+                    # either moved at drain time or are finishing in
+                    # place. Quarantining would stop pumping it and
+                    # freeze those streams mid-decode.
+                    st.failures = 0
+                    continue
                 self._quarantine_locked(r, st, reason=h, now=now)
                 continue
             hung = (r.engine.has_work()
@@ -603,7 +691,113 @@ class ReplicaRouter:
             st = self._state[r.name]
             state = "quarantined" if st.quarantined else r.health()
             inflight = 0 if r.crashed else r.engine.inflight_tokens()
-            self.metrics.set_replica(r.name, state, inflight)
+            self.metrics.set_replica(r.name, state, inflight,
+                                     weight_version=r.weight_version)
+
+    # ---- rolling-deploy lifecycle (ISSUE 16) ----
+
+    def _replica_by_name(self, name: str) -> InProcessReplica:
+        for r in self.replicas:
+            if r.name == name:
+                return r
+        raise ValueError(f"no replica named {name!r} "
+                         f"(fleet: {[r.name for r in self.replicas]})")
+
+    def drain_replica(self, name: str) -> int:
+        """Deploy-drain one replica: exclude it from placement, then move
+        its in-flight streams — failover re-prefill, zero dropped — onto
+        survivors IF a same-version healthy destination exists. When the
+        draining replica is the last of its version (the final replica of
+        a rollout), its streams are deliberately left attached to finish
+        in place: the replica keeps being pumped while placement-
+        excluded, which is the only way to honor both zero-drop and the
+        never-stitch-versions invariant at once. Returns streams moved;
+        the DeploymentController evacuates the engine's orphaned rows
+        iff > 0."""
+        with self._lock:
+            r = self._replica_by_name(name)
+            r.drain()
+            dest = [c for c in self._candidates_locked()
+                    if c.weight_version == r.weight_version]
+            in_place = sum(1 for h in self._inflight.values()
+                           if h._replica is r)
+            moved = 0
+            if dest:
+                victims = sorted(
+                    (h for h in self._inflight.values()
+                     if h._replica is r),
+                    key=lambda h: h._seq)
+                for h in victims:
+                    h._absorb_inner()
+                    h.failovers += 1
+                    if h._finished():
+                        h.future.set_result(h._prefix.copy())
+                        self.metrics.on_complete()
+                        del self._inflight[h.rid]
+                    else:
+                        self._pending.append(h)
+                        moved += 1
+                        flight_recorder().record(
+                            "router_failover", replica=r.name, rid=h.rid,
+                            reason="deploy_drain",
+                            emitted=int(h._prefix.size),
+                            remaining=int(h.max_new_tokens
+                                          - h._prefix.size))
+                in_place = 0
+                if moved:
+                    self.metrics.on_failover(r.name, moved)
+            flight_recorder().record(
+                "deploy_drain", replica=r.name, moved=moved,
+                finish_in_place=in_place, version=r.weight_version)
+            return moved
+
+    def readmit_replica(self, name: str):
+        """Return a replica from the deploy lifecycle to placement, on a
+        fresh watchdog epoch (swap + canary time must not count as hung
+        time) and cleared of any quarantine."""
+        with self._lock:
+            r = self._replica_by_name(name)
+            st = self._state[r.name]
+            r.readmit()
+            st.failures = 0
+            st.quarantined = False
+            st.backoff_level = 0
+            r.last_progress = self.clock.now()
+            self.metrics.on_readmit(r.name)
+            flight_recorder().record("router_readmit", replica=r.name,
+                                     deploy=True,
+                                     version=r.weight_version)
+
+    def retire_version(self, version: str) -> int:
+        """Rollback cleanup: a pending stream pinned to `version` that
+        has already emitted tokens can never resume once the fleet rolled
+        back — resuming it under the restored weights would stitch two
+        weight sets. Fail those few streams with a typed, retryable
+        error (the client re-submits and gets a clean run on the restored
+        version); pinned-but-empty streams just lose their pin and place
+        normally. Returns streams retired."""
+        with self._lock:
+            kept: List[RouterHandle] = []
+            retired = 0
+            for h in self._pending:
+                if h.weight_version == version and h._prefix.size > 0:
+                    h.future.set_exception(RejectedError(
+                        f"stream {h.rid} is pinned to retired weight "
+                        f"version {version}; resubmit to run on the "
+                        "restored version", reason="version_retired",
+                        retry_after_s=self.config.retry_after_s))
+                    self.metrics.on_reject("version_retired")
+                    self._inflight.pop(h.rid, None)
+                    retired += 1
+                    flight_recorder().record(
+                        "deploy_retire_stream", rid=h.rid,
+                        version=version, emitted=int(h._prefix.size))
+                else:
+                    if h.weight_version == version:
+                        h.weight_version = None
+                    kept.append(h)
+            self._pending = kept
+            return retired
 
     # ---- the pump ----
 
@@ -646,7 +840,9 @@ class ReplicaRouter:
             return {"status": status, "replicas": states,
                     "quarantined": sorted(
                         n for n, st in self._state.items()
-                        if st.quarantined)}
+                        if st.quarantined),
+                    "weight_versions": {
+                        r.name: r.weight_version for r in self.replicas}}
 
     # ---- lifecycle (live mode) ----
 
@@ -720,7 +916,7 @@ class ReplicaRouter:
 # the engine's retryable set plus the router's own back-off-and-retry words
 _ROUTER_RETRYABLE = frozenset({"queue_full", "token_budget", "shed",
                                "tenant_quota", "fleet_unavailable",
-                               "replica_down"})
+                               "replica_down", "version_retired"})
 
 _TENANT_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 
@@ -736,6 +932,8 @@ class RouterServer:
                  port: int = 0, request_timeout_s: float = 60.0):
         self.router = router
         self.request_timeout_s = float(request_timeout_s)
+        self._deploy_controller = None   # built on first POST /deploy
+        self._deploy_lock = threading.Lock()
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -761,14 +959,27 @@ class RouterServer:
                     code = 503 if health["status"] == "unavailable" else 200
                     self._reply_json(code, health)
                 elif self.path == "/metrics":
-                    self._reply(200, outer.router.metrics.render().encode(),
+                    text = outer.router.metrics.render()
+                    ctrl = outer._deploy_controller
+                    if ctrl is not None:
+                        # pdtpu_deploy_* families ride the same scrape
+                        text += ctrl.metrics.render()
+                    self._reply(200, text.encode(),
                                 ctype="text/plain; version=0.0.4")
                 elif self.path == "/debug/flightrecorder":
                     self._reply_json(200, flight_recorder().snapshot())
+                elif self.path == "/debug/deploy":
+                    ctrl = outer._deploy_controller
+                    self._reply_json(
+                        200, ctrl.status() if ctrl is not None
+                        else {"state": "idle", "history": []})
                 else:
                     self._reply_json(404, {"error": "not found"})
 
             def do_POST(self):
+                if self.path == "/deploy":
+                    self._deploy()
+                    return
                 if self.path != "/generate":
                     self._reply_json(404, {"error": "not found"})
                     return
@@ -833,12 +1044,65 @@ class RouterServer:
                     "failovers": handle.failovers,
                 })
 
+            def _deploy(self):
+                """POST /deploy {"directory", "version", "wait"?}: start
+                (or, with wait=true, run to completion) a rolling deploy
+                of the certified weight set. 412 on uncertified weights,
+                409 when a rollout is already in progress."""
+                from ..distributed.fleet.utils.http_server import \
+                    read_request_body
+                body = read_request_body(self)
+                if body is None:
+                    return
+                from ..checkpoint import (UncertifiedWeightsError,
+                                          WeightSet)
+                try:
+                    payload = json.loads(body or b"{}")
+                    ws = WeightSet(payload["directory"],
+                                   payload["version"])
+                    wait = bool(payload.get("wait", False))
+                except (ValueError, KeyError, TypeError) as e:
+                    self._reply_json(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    status = outer.deploy(ws, wait=wait)
+                except UncertifiedWeightsError as e:
+                    self._reply_json(412, {
+                        "error": str(e),
+                        "reason": getattr(e, "reason", "uncertified")})
+                    return
+                except RuntimeError as e:   # rollout already in progress
+                    self._reply_json(409, {"error": str(e)})
+                    return
+                self._reply_json(
+                    202 if status.get("state") in ("rolling",
+                                                   "rolling_back")
+                    else 200, status)
+
         _Handler.timeout = self.request_timeout_s + 30.0
         self._server = ThreadingHTTPServer((host, port), _Handler)
         self._server.daemon_threads = False
         self._server.block_on_close = True
         self.host, self.port = self._server.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+
+    def deploy(self, weightset, config=None, wait: bool = False) -> dict:
+        """Roll `weightset` across the fleet. wait=False starts the
+        rollout on the controller's background thread and returns the
+        initial status; wait=True blocks until the rollout completes or
+        rolls back and returns the final record. One controller instance
+        is kept for the server's lifetime so /debug/deploy keeps
+        history."""
+        with self._deploy_lock:
+            if self._deploy_controller is None:
+                from .deploy import DeploymentController
+                self._deploy_controller = DeploymentController(
+                    self.router, config=config)
+            ctrl = self._deploy_controller
+        if wait:
+            return ctrl.run(weightset)
+        ctrl.spawn(weightset)
+        return ctrl.status()
 
     def start(self) -> "RouterServer":
         self.router.start()

@@ -198,6 +198,8 @@ class ServingServer:
                     if outer.llm_engine is not None:
                         m = outer.llm_engine.metrics
                         health["llm_queue_depth"] = m.queue_depth
+                        health["llm_weight_version"] = \
+                            outer.llm_engine.weight_version
                         health["llm_slots_active"] = m.slots_active
                         health["llm_slots_total"] = m.slots_total
                         health["llm_inflight_tokens"] = \

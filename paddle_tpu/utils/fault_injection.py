@@ -68,6 +68,20 @@ every replica pump, so replica death is placeable without real signals:
                           persistent (logs once), the load-aware tier of
                           the routing policy must steer around it
 
+Rolling-deploy clauses (ISSUE 16) exercise the drain→swap→canary→
+re-admit pipeline in ``serving/deploy.py``; ``swap_stall`` keys on the
+replica index, ``deploy_bad_weights`` on the controller's lifetime
+deploy counter (0 = the first deploy this process runs):
+
+    swap_stall@1:2.5      replica 1's in-place weight swap takes 2.5
+                          extra (simulated) seconds to settle — the
+                          canary gate must wait for the swap instead of
+                          probing half-installed weights
+    deploy_bad_weights@0  the first deploy loads weights that fail the
+                          canary (NaN-poisoned after the certified
+                          load, so certification still passes): the
+                          controller must roll the whole fleet back
+
 Each clause fires exactly once per process (a restarted process re-arms,
 which is what crash-resume tests want) — except ``poison_request`` and
 ``replica_slow``, whose defining property is persistence: they log once
@@ -352,6 +366,24 @@ class FaultPlan:
                     self.log.append(repr(f))
                 return ("slow", float(f.arg or "1.0"))
         return None
+
+    def maybe_swap_stall(self, replica_idx: int) -> Optional[float]:
+        """swap_stall@i:s — replica i's in-place weight swap needs s extra
+        seconds before its new weights are trustworthy (device transfer
+        still landing). Returns the stall seconds (fires once) or None.
+        The replica records a not-before timestamp; the deployment
+        controller's canary gate must wait it out."""
+        f = self._take("swap_stall", replica_idx)
+        return None if f is None else float(f.arg or "1.0")
+
+    def maybe_bad_weights(self, deploy_idx: int) -> bool:
+        """deploy_bad_weights@n — the n-th deploy this process starts
+        loads weights that must fail the canary gate. Polled by the
+        DeploymentController AFTER certification succeeds (bad weights
+        with a valid manifest are exactly the case the canary exists
+        for); the controller NaN-poisons the loaded tree so the golden
+        prompts genuinely produce non-finite logits."""
+        return self._take("deploy_bad_weights", deploy_idx) is not None
 
     def maybe_kill(self, step: int, point: str = KILL_POINT_STEP):
         """SIGKILL the current process at a named kill point. Used to

@@ -1,0 +1,612 @@
+"""Zero-downtime rolling weight deployment (ISSUE 16): certified
+WeightSets, drain→swap→canary→re-admit over a live fleet with zero
+dropped streams and zero recompiles, fleet auto-rollback on a failed
+canary, and version-skew safety — a stream never stitches two weight
+sets, even across crash failover.
+
+Scheduler tests drive the PRODUCTION DeploymentController.pump() and
+ReplicaRouter.pump() under a SimClock; one live test exercises the
+RouterServer POST /deploy HTTP surface end to end."""
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def gpt_tiny():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    paddle.seed(0)
+    return GPTForCausalLM.from_preset("gpt2-tiny")
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    from paddle_tpu.utils.fault_injection import set_global_plan
+    set_global_plan(None)
+    yield
+    set_global_plan(None)
+
+
+def _fleet(gpt_tiny, clock, n=2, plan=None, router_cfg=None, num_slots=4,
+           observatory=False):
+    from paddle_tpu import serving
+    replicas = [
+        serving.InProcessReplica(
+            serving.LLMEngine(
+                gpt_tiny,
+                serving.LLMEngineConfig(num_slots=num_slots, block_len=8,
+                                        n_blocks=4, max_queue_depth=64,
+                                        observatory=observatory),
+                clock=clock),
+            i, fault_plan=plan)
+        for i in range(n)]
+    return serving.ReplicaRouter(replicas, router_cfg), replicas
+
+
+def _drive(router, clock, max_steps=2000, dt=0.01):
+    steps = 0
+    while router.has_work():
+        clock.advance(dt)
+        router.pump()
+        steps += 1
+        assert steps < max_steps, "router failed to converge"
+    return steps
+
+
+def _drive_deploy(router, ctrl, clock, max_steps=6000, dt=0.01):
+    """Interleave router + controller pumps until the rollout settles
+    AND all traffic has drained — the SimClock analog of live mode."""
+    steps = 0
+    while ctrl.active() or router.has_work():
+        clock.advance(dt)
+        router.pump()
+        ctrl.pump()
+        steps += 1
+        assert steps < max_steps, "deploy failed to converge"
+    return steps
+
+
+def _reference(gpt_tiny, prompts, max_new_tokens):
+    from paddle_tpu.models.generation import generate
+    plen = prompts[0].size
+    assert all(p.size == plen for p in prompts)
+    out = np.asarray(generate(gpt_tiny, np.stack(prompts),
+                              max_new_tokens=max_new_tokens))
+    return out[:, plen:]
+
+
+def _publish(gpt_tiny, directory, version):
+    """Publish the model's own params as a certified WeightSet — a
+    numerically identical 'new' version, so canaries pass and streams
+    stay bit-comparable to the single-engine oracle."""
+    from paddle_tpu.checkpoint import WeightSet
+    from paddle_tpu.models.generation import make_decoder_fns
+    params, _, _ = make_decoder_fns(gpt_tiny)
+    return WeightSet.publish(str(directory), version, params)
+
+
+def _manual_swap(router, name, params, version):
+    """Drive one idle replica through the deploy lifecycle by hand —
+    fixture setup for version-skew tests, not the controller path."""
+    r = router._replica_by_name(name)
+    router.drain_replica(name)
+    r.swap(params, version)
+    assert r.swap_ready()
+    router.readmit_replica(name)
+
+
+# ---- the weight set: publish / certify / refuse ----
+
+def test_weightset_publish_certify_load_roundtrip(gpt_tiny, tmp_path):
+    from paddle_tpu.checkpoint import WeightSet
+
+    ws = _publish(gpt_tiny, tmp_path, "v2")
+    assert os.path.exists(ws.data_path)
+    manifest = ws.certify()
+    assert manifest["version"] == "v2"
+    assert manifest["format"] == WeightSet.FORMAT
+    loaded = WeightSet(str(tmp_path), "v2").load()
+    import jax
+    from paddle_tpu.models.generation import make_decoder_fns
+    params, _, _ = make_decoder_fns(gpt_tiny)
+    orig = jax.tree_util.tree_leaves(params)
+    back = jax.tree_util.tree_leaves(loaded)
+    assert len(orig) == len(back)
+    for a, b in zip(orig, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_weightset_certify_refuses_typed(gpt_tiny, tmp_path):
+    """Every refusal is a typed UncertifiedWeightsError naming WHY:
+    missing manifest, bit-rot (CRC), and manifest/version mismatch."""
+    from paddle_tpu.checkpoint import UncertifiedWeightsError, WeightSet
+
+    # nothing published at all
+    with pytest.raises(UncertifiedWeightsError) as ei:
+        WeightSet(str(tmp_path), "v9").certify()
+    assert ei.value.reason == "no_manifest"
+
+    ws = _publish(gpt_tiny, tmp_path, "v2")
+    # flip one byte mid-file: the manifest CRC must catch it
+    with open(ws.data_path, "r+b") as f:
+        f.seek(os.path.getsize(ws.data_path) // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(UncertifiedWeightsError) as ei:
+        ws.certify()
+    assert ei.value.reason == "crc_mismatch"
+
+    # a manifest claiming a different version than its filename
+    ws3 = _publish(gpt_tiny, tmp_path, "v3")
+    m = json.load(open(ws3.manifest_path))
+    m["version"] = "v4"
+    json.dump(m, open(ws3.manifest_path, "w"))
+    with pytest.raises(UncertifiedWeightsError) as ei:
+        ws3.certify()
+    assert ei.value.reason == "version_mismatch"
+
+
+def test_deploy_refuses_uncertified_weights(gpt_tiny, tmp_path):
+    """The controller never lets uncertified bytes near a replica: a
+    missing/corrupt manifest is a typed refusal BEFORE any drain."""
+    from paddle_tpu import serving
+    from paddle_tpu.checkpoint import UncertifiedWeightsError, WeightSet
+
+    clock = serving.SimClock()
+    router, reps = _fleet(gpt_tiny, clock)
+    ctrl = serving.DeploymentController(router)
+    with pytest.raises(UncertifiedWeightsError):
+        ctrl.start(WeightSet(str(tmp_path), "v2"))
+    assert ctrl.status() == {"state": "idle", "history": []}
+    assert all(r.deploy_state == "serving" for r in reps)
+
+
+# ---- replica lifecycle + placement / gauges ----
+
+def test_drain_excludes_from_placement_and_readmit_restores(gpt_tiny):
+    """A deploy-draining replica takes no new placements (health word
+    'draining') but KEEPS decoding — unlike quarantine — and readmission
+    makes it placeable again. weight_version rides /healthz and the
+    pdtpu_router_replica_weight_info gauge."""
+    from paddle_tpu import serving
+
+    clock = serving.SimClock()
+    router, reps = _fleet(gpt_tiny, clock)
+    router.drain_replica("replica0")
+    assert reps[0].health() == "draining"
+    hz = router.healthz()
+    assert hz["status"] == "degraded"
+    assert hz["replicas"]["replica0"] == "draining"
+    assert hz["weight_versions"] == {"replica0": "v0", "replica1": "v0"}
+
+    rng = np.random.RandomState(3)
+    handles = [router.submit(rng.randint(1, 500, size=(8,)), 4)
+               for _ in range(3)]
+    assert all(h._replica is reps[1] for h in handles)
+    _drive(router, clock)
+    assert all(h.result(timeout=0).size == 4 for h in handles)
+
+    router.readmit_replica("replica0")
+    assert reps[0].health() == "ok"
+    h = router.submit(rng.randint(1, 500, size=(8,)), 4)
+    assert h._replica is reps[0]       # lighter again -> placeable
+    _drive(router, clock)
+
+    flat = serving.parse_exposition(router.metrics.render())
+    assert flat['pdtpu_router_replica_weight_info'
+                '{replica="replica0",version="v0"}'] == 1
+    assert flat['pdtpu_router_replica_weight_info'
+                '{replica="replica1",version="v0"}'] == 1
+
+
+def test_replace_params_guards(gpt_tiny):
+    """The hot swap is refused (typed WeightSwapError) with work in
+    flight or a signature-divergent tree; a legal swap advances
+    weight_version and flushes the stale-version prefix cache with the
+    page ledger balanced."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import serving
+
+    clock = serving.SimClock()
+    eng = serving.LLMEngine(
+        gpt_tiny, serving.LLMEngineConfig(num_slots=2, block_len=8,
+                                          n_blocks=4, max_queue_depth=8),
+        clock=clock)
+    params = eng.params
+    eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=4)
+    with pytest.raises(serving.WeightSwapError, match="work in flight"):
+        eng.replace_params(params, "v2")
+    while eng.has_work():
+        clock.advance(0.01)
+        eng.pump()
+    assert eng.metrics.snapshot()["cached_blocks"] > 0   # finished stream
+
+    # one leaf reshaped: refused, the culprit leaf named
+    bad = jax.tree_util.tree_map(lambda x: x, params)
+    leaves, treedef = jax.tree_util.tree_flatten(bad)
+    i = max(range(len(leaves)), key=lambda j: jnp.ndim(leaves[j]))
+    assert jnp.ndim(leaves[i]) > 1
+    leaves[i] = jnp.reshape(leaves[i], (-1,))
+    with pytest.raises(serving.WeightSwapError, match="signature"):
+        eng.replace_params(jax.tree_util.tree_unflatten(treedef, leaves),
+                           "v2")
+    assert eng.weight_version == "v0"
+
+    assert eng.pool.cached_blocks() > 0
+    eng.replace_params(params, "v2")
+    assert eng.weight_version == "v2"
+    assert eng.pool.cached_blocks() == 0  # old-version KV cannot survive
+    assert eng.pool.check_balance()       # ledger stays exact post-flush
+
+
+def test_swap_stall_gates_canary(gpt_tiny, tmp_path):
+    """swap_stall@0:5.0: the canary must NOT run until the stall
+    elapses — the controller parks in canary_wait on SimClock time."""
+    from paddle_tpu import serving
+    from paddle_tpu.utils.fault_injection import FaultPlan, set_global_plan
+
+    clock = serving.SimClock()
+    router, reps = _fleet(gpt_tiny, clock)
+    set_global_plan(FaultPlan.from_spec("swap_stall@0:5.0"))
+    ws = _publish(gpt_tiny, tmp_path, "v2")
+    ctrl = serving.DeploymentController(
+        router, serving.DeployConfig(watch_window_s=0.05))
+    ctrl.start(ws)
+    ctrl.pump()                      # drain replica0 (idle: nothing moves)
+    ctrl.pump()                      # settle -> swap (stall armed)
+    assert reps[0].deploy_state == "swapping"
+    assert reps[0].weight_version == "v2"     # weights ARE in place...
+    for _ in range(10):              # ...but the canary gate holds
+        clock.advance(0.2)
+        ctrl.pump()
+    assert ctrl.status()["phase"] == "canary_wait"
+    clock.advance(4.0)               # stall over (5.0s total elapsed)
+    ctrl.pump()                      # canary_wait -> canary
+    _drive_deploy(router, ctrl, clock)
+    assert ctrl.status()["state"] == "idle"
+    assert ctrl.status()["history"][-1]["outcome"] == "completed"
+    from paddle_tpu.utils.fault_injection import global_plan
+    assert any("swap_stall" in line for line in global_plan().log)
+
+
+# ---- the acceptance proof: rolling deploy under load ----
+
+def test_rolling_deploy_zero_drops_no_recompile_bit_identical(
+        gpt_tiny, tmp_path, monkeypatch):
+    """Roll v0→v2 across a 3-replica fleet MID-decode: every stream
+    admitted before the rollout finishes bit-identical to an
+    uninterrupted single-engine generate() (zero dropped, zero garbled),
+    the whole fleet lands on v2, and the compile observatory sees ZERO
+    recompiles — the swap reuses the warm unified-step executable."""
+    from paddle_tpu import serving
+    from paddle_tpu.obs.compile_observatory import compile_observatory
+    from paddle_tpu.obs.flight_recorder import flight_recorder
+
+    monkeypatch.setenv("PDTPU_FLIGHT_DIR", str(tmp_path))
+    flight_recorder().clear()
+    obs = compile_observatory()
+    obs.reset()
+    try:
+        clock = serving.SimClock()
+        router, reps = _fleet(gpt_tiny, clock, n=3, observatory=True)
+        rng = np.random.RandomState(7)
+        shapes = [rng.randint(1, 500, size=(8,)).astype(np.int32)
+                  for _ in range(6)]
+
+        # wave A warms every executable signature the fleet will need
+        warm = [router.submit(p, max_new_tokens=10) for p in shapes]
+        _drive(router, clock)
+        for h in warm:
+            assert h.result(timeout=0).size == 10
+        obs.mark_warm()
+
+        # wave B: same shapes, swapped mid-flight
+        handles = [router.submit(p, max_new_tokens=10) for p in shapes]
+        for _ in range(6):
+            clock.advance(0.01)
+            router.pump()
+        assert all(len(h.tokens_so_far()) > 0 for h in handles)
+
+        ws = _publish(gpt_tiny, tmp_path, "v2")
+        ctrl = serving.DeploymentController(
+            router, serving.DeployConfig(watch_window_s=0.05,
+                                         settle_timeout_s=60.0))
+        ctrl.start(ws)
+        _drive_deploy(router, ctrl, clock)
+
+        ref = _reference(gpt_tiny, shapes, 10)
+        for i, h in enumerate(handles):
+            np.testing.assert_array_equal(h.result(timeout=0), ref[i])
+        assert all(r.weight_version == "v2" for r in reps)
+        assert all(r.deploy_state == "serving" for r in reps)
+        assert router.healthz()["status"] == "ok"
+
+        # zero recompiles across the whole rollout
+        assert obs.recompiles == 0
+        events = flight_recorder().snapshot()["events"]
+        assert not [e for e in events if e["kind"] == "compile_recompile"]
+
+        # flight story: started -> swap x3 -> complete, in seq order
+        started = [e for e in events if e["kind"] == "deploy_started"]
+        swaps = [e for e in events if e["kind"] == "deploy_swap"]
+        done = [e for e in events if e["kind"] == "deploy_complete"]
+        assert len(started) == 1 and len(done) == 1
+        assert [e["replica"] for e in swaps] == \
+            ["replica0", "replica1", "replica2"]
+        assert started[0]["seq"] < swaps[0]["seq"] < done[0]["seq"]
+        assert done[0]["replicas"] == ["replica0", "replica1", "replica2"]
+
+        snap = ctrl.metrics.snapshot()
+        assert snap["deploys"] == {"started": 1, "completed": 1,
+                                   "rolled_back": 0}
+        assert snap["swaps"] == 3
+        assert snap["canaries"] == {"pass": 3, "fail": 0}
+        assert router.metrics.snapshot()["rejected"] == 0   # no drops
+        flat = serving.parse_exposition(ctrl.metrics.render())
+        assert flat['pdtpu_deploy_deploys_total{outcome="completed"}'] == 1
+        assert flat['pdtpu_deploy_version_info{version="v2"}'] == 1
+    finally:
+        obs.reset()
+        obs.disable()
+
+
+@pytest.mark.fault_matrix
+def test_bad_weights_canary_fails_and_fleet_rolls_back(
+        gpt_tiny, tmp_path, monkeypatch):
+    """deploy_bad_weights@0 NaN-poisons the (certified!) load: the FIRST
+    replica's canary must catch the non-finite logits while it is still
+    placement-excluded — zero traffic ever lands on the bad weights —
+    and the fleet auto-rolls back to v0, with the deploy_canary_fail →
+    deploy_rollback sequence in the flight-recorder dump."""
+    from paddle_tpu import serving
+    from paddle_tpu.obs.flight_recorder import flight_recorder
+    from paddle_tpu.utils.fault_injection import FaultPlan, set_global_plan
+
+    monkeypatch.setenv("PDTPU_FLIGHT_DIR", str(tmp_path))
+    flight_recorder().clear()
+    clock = serving.SimClock()
+    router, reps = _fleet(gpt_tiny, clock)
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, 500, size=(8,)).astype(np.int32)
+               for _ in range(4)]
+    handles = [router.submit(p, max_new_tokens=10) for p in prompts]
+    for _ in range(4):
+        clock.advance(0.01)
+        router.pump()
+
+    set_global_plan(FaultPlan.from_spec("deploy_bad_weights@0"))
+    ws = _publish(gpt_tiny, tmp_path, "v2")
+    ctrl = serving.DeploymentController(
+        router, serving.DeployConfig(watch_window_s=0.05))
+    ctrl.start(ws)
+    _drive_deploy(router, ctrl, clock)
+
+    # user-visible impact: NONE — every stream bit-identical
+    ref = _reference(gpt_tiny, prompts, 10)
+    for i, h in enumerate(handles):
+        np.testing.assert_array_equal(h.result(timeout=0), ref[i])
+    assert all(r.weight_version == "v0" for r in reps)   # rolled back
+    assert all(r.deploy_state == "serving" for r in reps)
+
+    rec = ctrl.status()["history"][-1]
+    assert rec["outcome"] == "rolled_back"
+    assert rec["reason"].startswith("canary_fail:nonfinite_logits")
+    snap = ctrl.metrics.snapshot()
+    assert snap["deploys"]["rolled_back"] == 1
+    assert snap["canaries"]["fail"] == 1
+
+    events = flight_recorder().snapshot()["events"]
+    fail = [e for e in events if e["kind"] == "deploy_canary_fail"]
+    rb = [e for e in events if e["kind"] == "deploy_rollback"]
+    assert len(fail) == 1 and len(rb) == 1
+    assert fail[0]["replica"] == "replica0"
+    assert fail[0]["reason"].startswith("nonfinite_logits")
+    assert fail[0]["seq"] < rb[0]["seq"]
+    assert rb[0]["reason"] == rec["reason"]
+
+    # the rollback dumped the black box with the full story
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("pdtpu_flight_")]
+    assert dumps, "rollback must dump the flight recorder"
+    dumped = json.load(open(os.path.join(str(tmp_path), dumps[0])))
+    kinds = [e["kind"] for e in dumped["events"]]
+    assert kinds.index("deploy_canary_fail") < kinds.index(
+        "deploy_rollback")
+
+    # the restored replica really decodes finitely again
+    toks, finite = reps[0].engine.canary_probe([1, 2, 3], 3)
+    assert finite and toks.size == 3
+
+
+# ---- version-skew safety ----
+
+@pytest.mark.fault_matrix
+def test_skew_failover_resumes_only_on_same_version_replica(gpt_tiny):
+    """A v0-pinned stream that loses its replica mid-decode resumes on
+    the v0 survivor — NOT the idle v2 replica that plain load ranking
+    would pick — and finishes bit-identical."""
+    from paddle_tpu import serving
+    from paddle_tpu.models.generation import make_decoder_fns
+    from paddle_tpu.utils.fault_injection import FaultPlan, set_global_plan
+
+    clock = serving.SimClock()
+    router, reps = _fleet(gpt_tiny, clock, n=3)
+    params, _, _ = make_decoder_fns(gpt_tiny)
+    _manual_swap(router, "replica2", params, "v2")
+    assert [r.weight_version for r in reps] == ["v0", "v0", "v2"]
+
+    rng = np.random.RandomState(13)
+    prompts = [rng.randint(1, 500, size=(8,)).astype(np.int32)
+               for _ in range(2)]
+    handles = [router.submit(p, max_new_tokens=12) for p in prompts]
+    assert [h._replica for h in handles] == [reps[0], reps[1]]
+    assert all(h.weight_version == "v0" for h in handles)
+    for _ in range(5):
+        clock.advance(0.01)
+        router.pump()
+    assert len(handles[0].tokens_so_far()) > 0    # pin is frozen now
+
+    # replica2 (v2) is IDLE — the load ranking would hand it the victim;
+    # the version pin must route to busy replica1 (v0) instead
+    set_global_plan(FaultPlan.from_spec("replica_crash@0"))
+    _drive(router, clock)
+    assert handles[0].failovers == 1
+    assert handles[0]._replica is reps[1]         # the v0 survivor, not v2
+    ref = _reference(gpt_tiny, prompts, 12)
+    for i, h in enumerate(handles):
+        np.testing.assert_array_equal(h.result(timeout=0), ref[i])
+    # replica2 never saw a single one of these streams
+    assert reps[2].engine.metrics.snapshot()["completed"] == 0
+
+
+@pytest.mark.fault_matrix
+def test_skew_pending_queue_until_same_version_replica_exists(gpt_tiny):
+    """When the last v0 replica dies and only v2 remains, a v0-pinned
+    mid-decode stream is PENDING-QUEUED — never resumed on v2 — and
+    completes bit-identical the moment a v0 replica comes back."""
+    from paddle_tpu import serving
+    from paddle_tpu.models.generation import make_decoder_fns
+    from paddle_tpu.utils.fault_injection import FaultPlan, set_global_plan
+
+    clock = serving.SimClock()
+    router, reps = _fleet(gpt_tiny, clock)
+    params, _, _ = make_decoder_fns(gpt_tiny)
+    _manual_swap(router, "replica1", params, "v2")
+
+    rng = np.random.RandomState(17)
+    prompt = rng.randint(1, 500, size=(8,)).astype(np.int32)
+    h = router.submit(prompt, max_new_tokens=12)
+    assert h._replica is reps[0] and h.weight_version == "v0"
+    for _ in range(5):
+        clock.advance(0.01)
+        router.pump()
+    emitted = len(h.tokens_so_far())
+    assert emitted > 0
+
+    set_global_plan(FaultPlan.from_spec("replica_crash@0"))
+    for _ in range(50):                 # plenty of pumps: must NOT place
+        clock.advance(0.01)
+        router.pump()
+    assert h._inner is None and h._replica is None
+    assert not h.future.done()
+    assert router.has_work()            # zero-drop: kept pending
+    assert h.weight_version == "v0"     # the pin survives the wait
+
+    # a v0 replica returns (rollback restored replica1) -> stream resumes
+    _manual_swap(router, "replica1", params, "v0")
+    _drive(router, clock)
+    assert h._replica is reps[1] and h.future.done()
+    np.testing.assert_array_equal(
+        h.result(timeout=0), _reference(gpt_tiny, [prompt], 12)[0])
+    assert h.failovers == 1
+
+
+@pytest.mark.fault_matrix
+def test_replica_crash_mid_rollout_while_another_drains(
+        gpt_tiny, tmp_path):
+    """The ISSUE 16 fault-matrix scenario: replica1 hard-crashes during
+    the rollout while replica0 is deploy-draining. The crash rides the
+    normal failover path (v0-pinned victims land on the remaining v0
+    replica), the rollout SKIPS the corpse and completes on the
+    survivors, and every stream still finishes bit-identical."""
+    from paddle_tpu import serving
+    from paddle_tpu.obs.flight_recorder import flight_recorder
+    from paddle_tpu.utils.fault_injection import FaultPlan, set_global_plan
+
+    flight_recorder().clear()
+    clock = serving.SimClock()
+    router, reps = _fleet(gpt_tiny, clock, n=3)
+    rng = np.random.RandomState(19)
+    prompts = [rng.randint(1, 500, size=(8,)).astype(np.int32)
+               for _ in range(6)]
+    handles = [router.submit(p, max_new_tokens=10) for p in prompts]
+    for _ in range(4):
+        clock.advance(0.01)
+        router.pump()
+
+    ws = _publish(gpt_tiny, tmp_path, "v2")
+    ctrl = serving.DeploymentController(
+        router, serving.DeployConfig(watch_window_s=0.05,
+                                     settle_timeout_s=60.0))
+    ctrl.start(ws)                       # replica0 drains first
+    set_global_plan(FaultPlan.from_spec("replica_crash@1"))
+    _drive_deploy(router, ctrl, clock)
+
+    ref = _reference(gpt_tiny, prompts, 10)
+    for i, h in enumerate(handles):
+        np.testing.assert_array_equal(h.result(timeout=0), ref[i])
+    rec = ctrl.status()["history"][-1]
+    assert rec["outcome"] == "completed"
+    assert rec["skipped"] == ["replica1"]
+    assert rec["swapped"] == ["replica0", "replica2"]
+    assert reps[0].weight_version == "v2"
+    assert reps[2].weight_version == "v2"
+    assert reps[1].crashed
+    events = flight_recorder().snapshot()["events"]
+    assert [e["replica"] for e in events
+            if e["kind"] == "deploy_skip"] == ["replica1"]
+    assert [e for e in events if e["kind"] == "deploy_complete"]
+
+
+# ---- live HTTP surface ----
+
+def test_router_server_deploy_http(gpt_tiny, tmp_path):
+    """POST /deploy rolls the fleet from the HTTP face: 202 + rolling
+    status, /debug/deploy converges to idle with a completed record,
+    /healthz advertises the new weight versions, and pdtpu_deploy_*
+    joins the /metrics scrape. A second POST mid-rollout gets 409."""
+    import time as _time
+    from paddle_tpu import serving
+
+    ws = _publish(gpt_tiny, tmp_path, "v2")
+    router, reps = _fleet(gpt_tiny, serving.MonotonicClock(), n=2)
+    server = serving.RouterServer(router).start()
+    base = f"http://{server.host}:{server.port}"
+    try:
+        body = json.dumps({"directory": str(tmp_path),
+                           "version": "v2"}).encode()
+        req = urllib.request.Request(
+            base + "/deploy", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 202
+            assert json.loads(r.read())["state"] == "rolling"
+
+        # an overlapping rollout is refused while this one runs
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r2:
+                code = r2.status       # raced past completion: fine
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:
+            with urllib.request.urlopen(base + "/debug/deploy",
+                                        timeout=30) as r:
+                st = json.loads(r.read())
+            if st["state"] == "idle":
+                break
+            _time.sleep(0.05)
+        assert st["state"] == "idle"
+        assert st["history"][-1]["outcome"] == "completed"
+
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            hz = json.loads(r.read())
+        assert hz["weight_versions"] == {"replica0": "v2",
+                                         "replica1": "v2"}
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            flat = serving.parse_exposition(r.read().decode())
+        assert flat['pdtpu_deploy_deploys_total{outcome="completed"}'] == 1
+        assert flat['pdtpu_router_replica_weight_info'
+                    '{replica="replica0",version="v2"}'] == 1
+    finally:
+        server.stop(drain=False)

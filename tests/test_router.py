@@ -133,6 +133,7 @@ def test_router_healthz_and_metrics_families(gpt_tiny):
     assert router.healthz() == {
         "status": "ok",
         "replicas": {"replica0": "ok", "replica1": "ok"},
+        "weight_versions": {"replica0": "v0", "replica1": "v0"},
         "quarantined": []}
     flat = serving.parse_exposition(router.metrics.render())
     assert flat['pdtpu_router_requests_total{outcome="completed"}'] == 1

@@ -131,7 +131,15 @@ GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
              # crash-to-all-streams-resumed failover time is a CEILING
              # (the zero-dropped-streams dance must stay fast)
              "fleet_qps_scaling": "higher",
-             "fleet_failover_resume_ms": "lower"}
+             "fleet_failover_resume_ms": "lower",
+             # ISSUE 16 rolling-deploy gates (`bench.py --deploy`): p99
+             # TTFT measured across a full rolling weight swap of the
+             # fleet is a CEILING (drain/swap/canary churn must not
+             # starve admissions), and the count of streams dropped by
+             # the rollout MUST stay 0 — the gate pins the zero-downtime
+             # contract itself
+             "deploy_ttft_p99_ms": "lower",
+             "deploy_dropped_streams": "lower"}
 
 
 def _metrics_of(row):
@@ -151,7 +159,8 @@ def _metrics_of(row):
               "llm_host_fraction",
               "compile_executables", "compile_seconds_total",
               "train_numerics_overhead_pct",
-              "fleet_qps_scaling", "fleet_failover_resume_ms"):
+              "fleet_qps_scaling", "fleet_failover_resume_ms",
+              "deploy_ttft_p99_ms", "deploy_dropped_streams"):
         if extra.get(k) is not None:
             out[k] = float(extra[k])
     return out

@@ -72,7 +72,17 @@ trajectory BIT-IDENTICAL to an uninterrupted run; a
 `step_N.corrupt/` by the restore scrubber before resume; and SIGTERM
 triggers an emergency persist of the newest ring snapshot whose
 `ckpt_emergency` flight event reconciles with the preemption marker
-and the newest certified step on disk) — then prints a pass/fail
+and the newest certified step on disk), and the ISSUE 16 rolling-deploy
+scenarios in tests/test_deploy.py (a `deploy_bad_weights@0` NaN-poisoned
+— yet CRC-certified — weight set is caught by the canary on the first,
+still placement-excluded replica and auto-rolls the fleet back with the
+`deploy_canary_fail` → `deploy_rollback` sequence in the flight dump
+and zero user-visible impact; a replica hard-crashed mid-rollout while
+another replica is deploy-draining rides the normal failover path and
+the rollout skips the corpse and completes on the survivors; and the
+version-skew suite pins that a stream which has emitted tokens only
+ever resumes on a SAME-weight-version replica — pending-queued, never
+stitched, when none exists) — then prints a pass/fail
 table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
@@ -104,6 +114,7 @@ TEST_FILES = [
     os.path.join("tests", "test_train_numerics.py"),
     os.path.join("tests", "test_router.py"),
     os.path.join("tests", "test_async_checkpoint.py"),
+    os.path.join("tests", "test_deploy.py"),
 ]
 
 
