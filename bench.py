@@ -505,6 +505,36 @@ def run_serve_bench():
     print(json.dumps(result))
 
 
+def _poisson_prompt_trace(rng, n, rate_hz, vocab, min_len=3, max_len=13,
+                          max_new=None, min_new=None, len_fn=None):
+    """ONE seeded Poisson prompt trace (ISSUE 17): every serving bench
+    phase that replays an open-loop prompt trace draws it here so two
+    replays from equal-seeded states are token-identical — the spec phase
+    replays the SAME trace spec-off then spec-on and diffs the streams
+    bit-for-bit. `rng` is an int seed (a fresh RandomState is built) or a
+    live RandomState to continue. Draw order is lens → gaps → prompt
+    bodies → new_lens; changing it changes every trace, so don't.
+
+    Returns (prompts, gaps, new_lens); new_lens is None unless max_new is
+    given (then uniform[min_new or max(2, max_new//4), max_new]).
+    `len_fn(rng, i) -> int` overrides the uniform[min_len, max_len)
+    prompt-length draw per request (the mixed phase's every-4th-long
+    shape)."""
+    if not isinstance(rng, np.random.RandomState):
+        rng = np.random.RandomState(rng)
+    if len_fn is None:
+        lens = [int(s) for s in rng.randint(min_len, max_len, size=n)]
+    else:
+        lens = [int(len_fn(rng, i)) for i in range(n)]
+    gaps = rng.exponential(1.0 / rate_hz, size=n)
+    prompts = [rng.randint(1, vocab, size=s).astype(np.int32) for s in lens]
+    new_lens = None
+    if max_new is not None:
+        lo = max(2, max_new // 4) if min_new is None else min_new
+        new_lens = rng.randint(lo, max_new + 1, size=n)
+    return prompts, gaps, new_lens
+
+
 def run_llm_bench():
     """LLM decode-engine benchmark (ISSUE 5): replays a seeded Poisson
     prompt trace through the REAL continuous-batching stack — a tiny
@@ -553,11 +583,8 @@ def run_llm_bench():
     engine.start()
 
     rng = np.random.RandomState(0)
-    prompt_lens = rng.randint(3, 13, size=n_req)
-    gaps = rng.exponential(1.0 / rate_hz, size=n_req)
-    prompts = [rng.randint(1, vocab, size=s).astype(np.int32)
-               for s in prompt_lens]
-    new_lens = rng.randint(max(2, max_new // 4), max_new + 1, size=n_req)
+    prompts, gaps, new_lens = _poisson_prompt_trace(
+        rng, n_req, rate_hz, vocab, max_new=max_new)
 
     # ONE warmup request compiles the engine's single unified mixed
     # prefill+decode executable (ISSUE 7: the per-pow2-bucket prefill zoo
@@ -655,21 +682,21 @@ def run_llm_bench():
         engine.metrics.ledger = engine.ledger
         engine.metrics.burn = engine.burn
         pd0 = engine.prefill_dispatches
-        m_gaps = rng.exponential(1.0 / mixed_hz, size=n_mixed)
+        m_prompts, m_gaps, _ = _poisson_prompt_trace(
+            rng, n_mixed, mixed_hz, vocab,
+            len_fn=lambda r, i: (r.randint(40, 57) if i % 4 == 0
+                                 else r.randint(3, 9)))
         m_handles, m_rejected = [], 0
         m_new = max(2, max_new // 2)
         t_next = time.perf_counter()
-        for i, gap in enumerate(m_gaps):
+        for gap, p in zip(m_gaps, m_prompts):
             t_next += gap
             delay = t_next - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
-            plen = int(rng.randint(40, 57)) if i % 4 == 0 \
-                else int(rng.randint(3, 9))
             try:
-                m_handles.append((plen, engine.submit(
-                    rng.randint(1, vocab, size=plen).astype(np.int32),
-                    max_new_tokens=m_new)))
+                m_handles.append((len(p), engine.submit(
+                    p, max_new_tokens=m_new)))
             except RejectedError:
                 m_rejected += 1
         for _, h in m_handles:
@@ -711,18 +738,17 @@ def run_llm_bench():
         engine.metrics.ledger = engine.ledger
         engine.metrics.burn = engine.burn
         pt0 = engine.prefill_tokens
-        p_gaps = rng.exponential(1.0 / pref_hz, size=n_pref)
+        suffixes, p_gaps, _ = _poisson_prompt_trace(
+            rng, n_pref, pref_hz, vocab, min_len=3, max_len=7)
         p_handles, p_rejected = [], 0
         p_new = max(2, max_new // 2)
         pt_start = time.perf_counter()
         t_next = pt_start
-        for i, gap in enumerate(p_gaps):
+        for i, (gap, sfx) in enumerate(zip(p_gaps, suffixes)):
             t_next += gap
             delay = t_next - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
-            sfx = rng.randint(1, vocab,
-                              size=int(rng.randint(3, 7))).astype(np.int32)
             p = (np.concatenate([shared, sfx]) if i % 10 else sfx)
             try:
                 p_handles.append(engine.submit(p, max_new_tokens=p_new))
@@ -774,19 +800,18 @@ def run_llm_bench():
         engine.metrics.burn = engine.burn
         classes = ["interactive", "batch", "best_effort"]
         cls_trace = [classes[i % 4 % 3] for i in range(n_over)]  # 50% i/25/25
-        o_lens = rng.randint(3, 13, size=n_over)
-        o_gaps = rng.exponential(1.0 / over_hz, size=n_over)
+        o_prompts, o_gaps, _ = _poisson_prompt_trace(
+            rng, n_over, over_hz, vocab)
         o_handles, o_rejected = [], 0
         t_next = time.perf_counter()
-        for gap, s, c in zip(o_gaps, o_lens, cls_trace):
+        for gap, p, c in zip(o_gaps, o_prompts, cls_trace):
             t_next += gap
             delay = t_next - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
             try:
                 o_handles.append(engine.submit(
-                    rng.randint(1, vocab, size=s).astype(np.int32),
-                    max_new_tokens=max_new, slo=c))
+                    p, max_new_tokens=max_new, slo=c))
             except RejectedError:
                 o_rejected += 1
         for h in o_handles:
@@ -807,6 +832,69 @@ def run_llm_bench():
             "overload_brownout_entries": osnap["brownout_entries"],
         })
     engine.stop(drain=True)
+
+    # ---- speculative-decoding phase (ISSUE 17): replay ONE seeded prompt
+    # trace batch-1 and closed-loop through two fresh engines — the plain
+    # target, then the same target with a draft model attached (the draft
+    # IS the target here, so greedy acceptance is deterministic) — and
+    # compare pure decode speed. Greedy spec decode is bit-identical BY
+    # CONSTRUCTION; the phase reports it (llm_spec_bitmatch) and gates
+    # llm_spec_tok_s and llm_spec_accept_rate as FLOORS through
+    # check_bench_result.py: the win is dispatch-count collapse — one
+    # draft-scan dispatch + one verify dispatch advance up to spec_k+1
+    # positions that plain decode buys with spec_k+1 pump round-trips.
+    if os.environ.get("BENCH_LLM_SPEC", "1") != "0":
+        n_spec = int(os.environ.get("BENCH_LLM_SPEC_REQUESTS", "6"))
+        spec_new = int(os.environ.get("BENCH_LLM_SPEC_MAX_NEW",
+                                      str(max(16, max_new))))
+        spec_k = int(os.environ.get("BENCH_LLM_SPEC_K", "4"))
+
+        def replay(draft):
+            eng = LLMEngine(model, LLMEngineConfig(
+                num_slots=1, block_len=8,
+                n_blocks=max(4, -(-(16 + spec_new) // 8)),
+                max_queue_depth=64, spec_k=spec_k),
+                draft_model=draft)
+            eng.start()
+            # warm long enough that a draft window actually runs: the
+            # propose-scan executable compiles on the FIRST proposal (a
+            # 2-token warmup never proposes — remaining < 2), and that
+            # one-time compile must not land inside the timed replay
+            eng.generate([1, 2, 3], max_new_tokens=2 * spec_k, timeout=300)
+            eng.metrics = LLMMetrics()   # warmup rows don't count
+            eng.metrics.set_slots(eng.pool.active_slots(),
+                                  eng.pool.num_slots)
+            prompts, _, _ = _poisson_prompt_trace(0, n_spec, rate_hz, vocab)
+            t0 = time.perf_counter()
+            streams = [eng.generate(p, max_new_tokens=spec_new, timeout=300)
+                       for p in prompts]
+            s_dt = time.perf_counter() - t0
+            s_snap = eng.metrics.snapshot()
+            eng.stop(drain=True)
+            return streams, s_dt, s_snap
+
+        base_streams, base_dt, _bsnap = replay(None)
+        spec_streams, spec_dt, ssnap = replay(model)
+        n_tok = int(sum(s.size for s in base_streams))
+        bitmatch = (len(base_streams) == len(spec_streams) and all(
+            np.array_equal(a, b)
+            for a, b in zip(base_streams, spec_streams)))
+        spec_tok_s = n_tok / spec_dt if spec_dt > 0 else 0.0
+        base_tok_s = n_tok / base_dt if base_dt > 0 else 0.0
+        result["extra"].update({
+            "llm_spec_tok_s": round(spec_tok_s, 1),
+            "llm_spec_base_tok_s": round(base_tok_s, 1),
+            "llm_spec_speedup": (round(spec_tok_s / base_tok_s, 4)
+                                 if base_tok_s > 0 else None),
+            "llm_spec_accept_rate": round(
+                ssnap["spec_accept_rate"] or 0.0, 4),
+            "llm_spec_bitmatch": bool(bitmatch),
+            "spec_windows": ssnap["spec_windows"],
+            "spec_drafted": ssnap["spec_drafted"],
+            "spec_accepted": ssnap["spec_accepted"],
+            "spec_requests": n_spec,
+            "spec_k": spec_k,
+        })
     print(json.dumps(result))
 
 
@@ -942,11 +1030,7 @@ def run_fleet_bench():
 
     # ONE seeded trace replayed identically over every fleet size — the
     # scaling numbers compare fleets, never traces
-    rng = np.random.RandomState(0)
-    prompt_lens = rng.randint(3, 13, size=n_req)
-    prompts = [rng.randint(1, vocab, size=s).astype(np.int32)
-               for s in prompt_lens]
-    gaps = rng.exponential(1.0 / rate_hz, size=n_req)
+    prompts, gaps, _ = _poisson_prompt_trace(0, n_req, rate_hz, vocab)
 
     qps = {}
     rejected_total = 0
@@ -1103,11 +1187,12 @@ def run_deploy_bench():
     ctrl = DeploymentController(
         router, DeployConfig(watch_window_s=0.25, settle_timeout_s=300.0))
 
-    rng = np.random.RandomState(0)
+    # rejects can burn extra trace entries, so over-provision the draw
+    d_prompts, d_gaps, _ = _poisson_prompt_trace(
+        0, n_replicas + 2 * max_req, rate_hz, vocab)
+    idx = 0
 
-    def submit_one(handles, rejected):
-        p = rng.randint(1, vocab,
-                        size=int(rng.randint(3, 13))).astype(np.int32)
+    def submit_one(handles, rejected, p):
         try:
             handles.append(router.submit(p, max_new_tokens=max_new))
             return rejected
@@ -1116,14 +1201,16 @@ def run_deploy_bench():
 
     handles, rejected = [], 0
     for _ in range(n_replicas):     # pre-roll: swap lands MID-traffic
-        rejected = submit_one(handles, rejected)
+        rejected = submit_one(handles, rejected, d_prompts[idx])
+        idx += 1
     t0 = time.perf_counter()
     ctrl.spawn(ws)
     # Poisson arrivals sustained across the WHOLE rollout window
     while ((ctrl.active() or len(handles) < min_req)
-           and len(handles) < max_req):
-        time.sleep(rng.exponential(1.0 / rate_hz))
-        rejected = submit_one(handles, rejected)
+           and len(handles) < max_req and idx < len(d_prompts)):
+        time.sleep(d_gaps[idx])
+        rejected = submit_one(handles, rejected, d_prompts[idx])
+        idx += 1
     while ctrl.active():            # trace capped out before the rollout
         time.sleep(0.01)
     rollout_s = time.perf_counter() - t0

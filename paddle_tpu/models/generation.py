@@ -92,6 +92,35 @@ def make_decoder_fns(model):
     return params, prefill, decode_step
 
 
+def make_verify_fn(model):
+    """Multi-position greedy verify builder (ISSUE 17 speculative
+    decoding): returns (params, verify) where
+
+      verify(params, toks [B, C], caches, pos, paged=None) ->
+          (tokens [B, C] int32, new_caches)
+
+    runs the same cached forward as `make_decoder_fns`'s prefill but
+    argmaxes EVERY position: tokens[b, t] is the greedy token the model
+    emits after consuming toks[b, :t+1] on top of the cache state at
+    `pos`. This is what makes draft-token verification one dispatch: a
+    verify row carrying [last_tok, d1..dK] scores all K+1 candidate
+    continuations at once, and because each position's logits are
+    computed under exactly the causal masking a sequential decode would
+    see (chunk invariance, PR 7), tokens[b, t] equals what t sequential
+    decode_step calls would have produced — so accepting the longest
+    matching draft prefix plus the first divergent (corrective) token is
+    bit-identical to plain greedy decoding. Reading only column `adv-1`
+    degenerates to the pre-spec unified step, which is why one
+    executable serves prefill, plain decode, and verification."""
+    params, prefill, _ = make_decoder_fns(model)
+
+    def verify(p, toks, caches_, pos, paged=None):
+        logits, new_caches = prefill(p, toks, caches_, pos, paged=paged)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_caches
+
+    return params, verify
+
+
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, eos_token_id=None, seed=0):
     """Returns a Tensor [B, S0 + max_new_tokens] of prompt + continuation.

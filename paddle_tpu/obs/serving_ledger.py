@@ -8,7 +8,11 @@ Built on the SAME frame bookkeeping as the training goodput ledger
                         positions of the unified mixed step (or the
                         whole predict dispatch in `BatchingEngine`);
 - ``decode_compute``  — device execution attributed to decode rows
-                        (one position each);
+                        (the positions the target actually committed —
+                        under speculative decoding, accepted window
+                        tokens);
+- ``draft_compute``   — draft-model execution (ISSUE 17): catch-up and
+                        proposal dispatches, booked by draft positions;
 - ``host``            — everything else the pump does on the CPU:
                         admission, KV-pool ops, prefix lookup, row
                         assembly, h2d staging, sampling readback;
@@ -63,8 +67,8 @@ from .goodput import PhaseLedger
 _log = logging.getLogger("paddle_tpu.serving.economics")
 
 # attribution order is the chrome-trace lane order
-SERVING_LEDGER_PHASES = ("prefill_compute", "decode_compute", "host",
-                         "idle")
+SERVING_LEDGER_PHASES = ("prefill_compute", "decode_compute",
+                         "draft_compute", "host", "idle")
 
 
 class ServingLedger(PhaseLedger):
@@ -82,14 +86,20 @@ class ServingLedger(PhaseLedger):
         self.prefill_tokens = 0
         self.decode_tokens = 0
         self.dispatches = 0
+        # speculative decoding (ISSUE 17): draft-side position economics
+        self.draft_positions = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         # decode-MFU inputs (obs.flops helpers; None until registered)
         self.flops_per_token: Optional[float] = None
         self.peak_flops_total: Optional[float] = None
         # cost metering: owner -> accumulated device seconds / tokens
         self._tenant_seconds: Dict[str, float] = {}
         self._tenant_tokens: Dict[str, int] = {}
+        self._tenant_draft_tokens: Dict[str, int] = {}
         self._class_seconds: Dict[str, float] = {}
         self._class_tokens: Dict[str, int] = {}
+        self._class_draft_tokens: Dict[str, int] = {}
 
     def set_decode_flops(self, flops_per_token: float,
                          peak_flops_total: float):
@@ -104,54 +114,89 @@ class ServingLedger(PhaseLedger):
         self.prefill_tokens = 0
         self.decode_tokens = 0
         self.dispatches = 0
+        self.draft_positions = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         self._tenant_seconds.clear()
         self._tenant_tokens.clear()
+        self._tenant_draft_tokens.clear()
         self._class_seconds.clear()
         self._class_tokens.clear()
+        self._class_draft_tokens.clear()
 
     # ---- per-dispatch attribution ----
     def book_dispatch(self, device_seconds: float, prefill_positions: int,
                       decode_positions: int, total_positions: int,
-                      owners: Iterable[Tuple[str, str, int]]):
+                      owners: Iterable[Tuple[str, str, int]],
+                      draft_positions: int = 0, drafted: int = 0,
+                      draft_accepted: int = 0):
         """Attribute ONE successful device dispatch.
 
         `device_seconds` is the measured execution span (dispatch →
-        block_until_ready); it is split between `prefill_compute` and
-        `decode_compute` by advanced-position weights and — via
-        `book()` — subtracted from the enclosing `host` frame, so the
-        pump's tiling holds by construction. `owners` is one
+        block_until_ready); it is split between `prefill_compute`,
+        `decode_compute` and `draft_compute` by advanced-position weights
+        and — via `book()` — subtracted from the enclosing `host` frame,
+        so the pump's tiling holds by construction. `owners` is one
         `(tenant, slo_class, positions)` triple per active row; the
         SAME device seconds are apportioned across owners by the same
         position weights, which is what makes per-tenant device seconds
-        sum to `prefill_compute + decode_compute` exactly.
+        sum to `prefill_compute + decode_compute + draft_compute`
+        exactly.
+
+        Speculative decoding (ISSUE 17): draft-model dispatches book with
+        `draft_positions` > 0 and zero useful positions — their seconds
+        land in `draft_compute` and their per-owner positions in the
+        separate `draft_tokens` meter, so per-tenant `tokens` keeps
+        meaning positions the TARGET committed. A target verify dispatch
+        books `drafted`/`draft_accepted` window counters, and its
+        rejected window columns simply never enter `useful` — wasted
+        speculation surfaces as pad-waste in `token_efficiency`, which is
+        the observable the accept-rate runbook watches.
         """
         device_seconds = max(float(device_seconds), 0.0)
         useful = int(prefill_positions) + int(decode_positions)
-        if useful > 0:
-            pre_s = device_seconds * prefill_positions / useful
+        draft_positions = int(draft_positions)
+        advanced = useful + draft_positions
+        if advanced > 0:
+            pre_s = device_seconds * prefill_positions / advanced
             self.book("prefill_compute", pre_s)
-            self.book("decode_compute", device_seconds - pre_s)
+            if draft_positions:
+                dec_s = device_seconds * decode_positions / advanced
+                self.book("decode_compute", dec_s)
+                self.book("draft_compute", device_seconds - pre_s - dec_s)
+            else:
+                self.book("decode_compute", device_seconds - pre_s)
         else:  # a dispatch with no advanced rows is pure host overhead
             self.book("host", device_seconds)
+        is_draft = draft_positions > 0
         with self._lock:
             self.dispatches += 1
             self.useful_positions += useful
             self.total_positions += int(total_positions)
             self.prefill_tokens += int(prefill_positions)
             self.decode_tokens += int(decode_positions)
+            self.draft_positions += draft_positions
+            self.spec_drafted += int(drafted)
+            self.spec_accepted += int(draft_accepted)
             for tenant, slo, positions in owners:
                 positions = int(positions)
-                if positions <= 0 or useful <= 0:
+                if positions <= 0 or advanced <= 0:
                     continue
-                share = device_seconds * positions / useful
+                share = device_seconds * positions / advanced
                 self._tenant_seconds[tenant] = \
                     self._tenant_seconds.get(tenant, 0.0) + share
-                self._tenant_tokens[tenant] = \
-                    self._tenant_tokens.get(tenant, 0) + positions
                 self._class_seconds[slo] = \
                     self._class_seconds.get(slo, 0.0) + share
-                self._class_tokens[slo] = \
-                    self._class_tokens.get(slo, 0) + positions
+                if is_draft:
+                    self._tenant_draft_tokens[tenant] = \
+                        self._tenant_draft_tokens.get(tenant, 0) + positions
+                    self._class_draft_tokens[slo] = \
+                        self._class_draft_tokens.get(slo, 0) + positions
+                else:
+                    self._tenant_tokens[tenant] = \
+                        self._tenant_tokens.get(tenant, 0) + positions
+                    self._class_tokens[slo] = \
+                        self._class_tokens.get(slo, 0) + positions
 
     # ---- reporting ----
     def snapshot(self) -> dict:
@@ -165,13 +210,21 @@ class ServingLedger(PhaseLedger):
             prefill_toks = self.prefill_tokens
             decode_toks = self.decode_tokens
             dispatches = self.dispatches
+            draft_pos = self.draft_positions
+            drafted = self.spec_drafted
+            accepted = self.spec_accepted
             tenants = {t: {"device_seconds": s,
-                           "tokens": self._tenant_tokens.get(t, 0)}
+                           "tokens": self._tenant_tokens.get(t, 0),
+                           "draft_tokens":
+                               self._tenant_draft_tokens.get(t, 0)}
                        for t, s in self._tenant_seconds.items()}
             classes = {c: {"device_seconds": s,
-                           "tokens": self._class_tokens.get(c, 0)}
+                           "tokens": self._class_tokens.get(c, 0),
+                           "draft_tokens":
+                               self._class_draft_tokens.get(c, 0)}
                       for c, s in self._class_seconds.items()}
-        compute = phases["prefill_compute"] + phases["decode_compute"]
+        compute = (phases["prefill_compute"] + phases["decode_compute"]
+                   + phases["draft_compute"])
         mfu = decode_mfu(self.flops_per_token, decode_toks,
                          phases["decode_compute"], self.peak_flops_total)
         return {
@@ -186,6 +239,10 @@ class ServingLedger(PhaseLedger):
             "decode_tokens": decode_toks,
             "dispatches": dispatches,
             "decode_mfu": mfu,
+            "draft_positions": draft_pos,
+            "spec_drafted": drafted,
+            "spec_accepted": accepted,
+            "spec_accept_rate": (accepted / drafted) if drafted else None,
             "tenants": tenants,
             "classes": classes,
         }

@@ -255,6 +255,49 @@ class SlotPagedKVPool:
             + [slot * self.n_blocks + j
                for j in range(len(attached), blocks)])
 
+    def rewind_length(self, slot: int, length: int):
+        """Shrink `slot`'s committed length to `length`, returning own
+        pages past the new block count to the ledger (ISSUE 17
+        speculative decoding: a draft window commits K tokens of KV
+        optimistically; rejected positions must give their pages back so
+        `check_balance()` keeps holding). Cache-registered own pages stay
+        claimed — the prefix cache owns their lifetime, and `_own_claimed`
+        is a contiguous count, so the scan un-claims from the top down and
+        stops at the first cached page. Attached (shared) pages are never
+        touched: they back the prefix below any rewind point. Growing is
+        `set_length`'s job; a larger `length` raises."""
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        length = int(length)
+        cur = int(self.lengths[slot])
+        if length > cur:
+            raise ValueError(
+                f"rewind_length can only shrink: {length} > committed "
+                f"{cur} (use set_length to grow)")
+        if length < 0:
+            raise ValueError(f"length must be >= 0, got {length}")
+        if length == cur:
+            return
+        self._lens_version += 1
+        self.lengths[slot] = length
+        blocks = -(-length // self.block_len)
+        attached = self._attached.get(slot, [])
+        own_needed = max(0, blocks - len(attached))
+        claimed = self._own_claimed.get(slot, 0)
+        new_claimed = claimed
+        for j in range(len(attached) + claimed - 1,
+                       len(attached) + own_needed - 1, -1):
+            if slot * self.n_blocks + j in self.cached:
+                break
+            new_claimed -= 1
+        if new_claimed != claimed:
+            self.stats["blocks_freed"] += claimed - new_claimed
+            self._own_claimed[slot] = new_claimed
+        self.block_table[slot] = (
+            attached[:blocks]
+            + [slot * self.n_blocks + j
+               for j in range(len(attached), blocks)])
+
     # ---- prefix sharing (ISSUE 8) ----
     def attach_blocks(self, slot: int, pages: List[int]):
         """Point `slot`'s leading logical blocks at shared pages computed

@@ -1,7 +1,7 @@
 """Continuous-batching LLM decode engine over the slot-paged KV pool
 (ISSUE 5 tentpole; ISSUE 6 supervision + overload control; ISSUE 7
 ragged paged attention + chunked prefill; ISSUE 8 prefix-sharing radix
-KV cache + multi-tenant scheduling).
+KV cache + multi-tenant scheduling; ISSUE 17 speculative decoding).
 
 Prefix sharing (ISSUE 8): admission consults a per-tenant radix
 `PrefixCache` — a prompt hitting a cached prefix attaches the donor's
@@ -64,6 +64,25 @@ queued + active) sheds the NEWEST queued request of the lowest class
 below the submitter (reason "shed") before rejecting; sustained queue
 pressure enters brownout, capping newly-admitted `max_new_tokens` so the
 backlog drains at interactive-friendly latency.
+
+Speculative decoding (ISSUE 17): a `draft_model` (same vocab, own
+`SlotPagedKVPool` + page-congruent "draft" `PrefixCache`) turns each
+decode pump into draft-propose + single-dispatch verify. A chunk-wide
+draft catch-up replays committed tokens the draft hasn't seen, a jitted
+width-1 `lax.scan` proposes `spec_k` tokens per eligible slot (and
+pre-writes the draft KV for the all-accept case), and the target scores
+all `spec_k + 1` positions in the ONE existing unified dispatch
+(`[last_tok, d1..dK]`, adv = K+1). Greedy acceptance takes the longest
+draft prefix matching the target's per-position argmax plus the
+target's corrective token — bit-identical to plain decode by
+construction. Commit is `set_length(L + accepted + 1)`; rejected
+columns need no KV scrub (garbage past the committed length IS the
+rollback invariant) and the draft pool rewinds via `rewind_length`.
+Draft dispatches are supervision-EXEMPT: a failed one triggers
+draft-scoped solo probes, a blamed request loses only its draft
+(spec_off, stream continues plain), unattributable failures walk a
+failstreak to engine-wide `_spec_disabled` — the target breaker is
+never charged.
 
 Determinism: every decision is a pure function of `clock.now()` and the
 queue/pool tables. Under a `SimClock` the engine runs threadless and a
@@ -173,6 +192,15 @@ class LLMEngineConfig:
     # ---- rolling weight deployment (ISSUE 16) ----
     weight_version: str = "v0"     # version id of the params the engine
     #                                starts on; replace_params() advances it
+    # ---- speculative decoding (ISSUE 17) ----
+    spec_k: int = 4                # draft tokens proposed per verify window
+    #                                (only meaningful when the engine is
+    #                                built with a draft_model); the verify
+    #                                window spans spec_k + 1 of the unified
+    #                                step's prefill_chunk columns, so
+    #                                spec_k + 1 <= prefill_chunk is enforced
+    #                                at engine construction when a draft
+    #                                model is present
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -210,6 +238,8 @@ class LLMEngineConfig:
         if self.trace_buffer < 1:
             raise ValueError(
                 f"trace_buffer must be >= 1, got {self.trace_buffer}")
+        if self.spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {self.spec_k}")
         if not 0.0 < self.slo_burn_budget <= 1.0:
             raise ValueError(
                 f"slo_burn_budget must be in (0, 1], got "
@@ -281,7 +311,8 @@ class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "eos_token_id", "arrival",
                  "deadline", "handle", "slot", "emitted", "last_tok",
                  "slo", "submit_idx", "cost", "chunk_off", "tenant",
-                 "attached_pages", "rid", "trace")
+                 "attached_pages", "rid", "trace", "draft_slot",
+                 "spec_off", "draft_attached")
 
     def __init__(self, prompt, max_new_tokens, eos_token_id, arrival,
                  deadline, slo, submit_idx, tenant="default"):
@@ -312,6 +343,17 @@ class _GenRequest:
         #                                       request opted into tracing —
         #                                       every hot-path hook guards on
         #                                       this ONE predicate
+        # speculative decoding (ISSUE 17)
+        self.draft_slot: Optional[int] = None  # row in the DRAFT pool; None
+        #                                       when spec is off or the draft
+        #                                       pool had no row to give
+        self.spec_off: bool = False           # draft quarantined for THIS
+        #                                       request (poisoned draft
+        #                                       dispatch): stream continues
+        #                                       as plain decode
+        self.draft_attached: List[int] = []   # shared draft-pool pages this
+        #                                       request attached (for the
+        #                                       draft cache insert)
 
 
 class LLMEngine:
@@ -332,8 +374,9 @@ class LLMEngine:
                  clock: Optional[Clock] = None,
                  metrics: Optional[LLMMetrics] = None,
                  fault_plan=None,
-                 on_break: Optional[Callable[[], None]] = None):
-        from ...models.generation import make_decoder_fns
+                 on_break: Optional[Callable[[], None]] = None,
+                 draft_model=None):
+        from ...models.generation import make_decoder_fns, make_verify_fn
         self.model = model
         model.eval()
         self.config = config or LLMEngineConfig()
@@ -341,6 +384,7 @@ class LLMEngine:
         self.metrics = metrics or LLMMetrics()
         self.params, self._prefill_fn, self._decode_fn = \
             make_decoder_fns(model)
+        _, self._verify_fn = make_verify_fn(model)
         if not self.config.weight_version:
             raise ValueError("weight_version must be a non-empty string")
         self.weight_version = self.config.weight_version
@@ -357,6 +401,49 @@ class LLMEngine:
         self.prefix_cache: Optional[PrefixCache] = (
             PrefixCache(self.pool) if self.config.enable_prefix_cache
             else None)
+        # ---- speculative decoding (ISSUE 17) ----
+        # a draft model arms spec mode: per decode pump a SINGLE draft
+        # dispatch (an on-device lax.scan of spec_k+1 width-1 steps over
+        # the draft's OWN slot-paged pool) proposes K tokens per eligible
+        # row, and the target's unified step verifies all K+1 positions in
+        # one dispatch; greedy acceptance = longest matching prefix + the
+        # target's corrective token, so output is bit-identical to plain
+        # decode. Rejected target columns need no rollback (committing
+        # only the accepted length leaves them as the garbage-past-adv the
+        # pool invariant already covers); the DRAFT pool rolls back via
+        # rewind_length.
+        self.draft_model = draft_model
+        self.draft_pool: Optional[SlotPagedKVPool] = None
+        self.draft_prefix_cache: Optional[PrefixCache] = None
+        self._draft_params = None
+        self._draft_verify_fn = None
+        self._draft_step_jit = None     # chunk-wide draft catch-up
+        self._draft_propose_jit = None  # the single-dispatch K-token scan
+        self._spec_disabled = False     # engine-wide draft kill switch
+        self._draft_failstreak = 0      # consecutive unattributed draft
+        #                                 dispatch failures (exempt from the
+        #                                 engine breaker by design)
+        self.spec_windows = 0           # lifetime verify windows committed
+        self.spec_drafted = 0           # lifetime draft tokens verified
+        self.spec_accepted = 0          # lifetime draft tokens accepted
+        if draft_model is not None:
+            if self.config.spec_k + 1 > self.config.prefill_chunk:
+                raise ValueError(
+                    f"spec_k + 1 ({self.config.spec_k + 1}) exceeds the "
+                    f"unified step width prefill_chunk "
+                    f"({self.config.prefill_chunk}): the verify window "
+                    "must fit one dispatch")
+            draft_model.eval()
+            self._draft_params, self._draft_verify_fn = \
+                make_verify_fn(draft_model)
+            self.draft_pool = SlotPagedKVPool(
+                draft_model.init_cache, self.config.num_slots,
+                self.config.block_len, self.config.n_blocks,
+                dtype=self.config.cache_dtype,
+                pad_tokens=self.config.prefill_chunk)
+            if self.config.enable_prefix_cache:
+                self.draft_prefix_cache = PrefixCache(self.draft_pool,
+                                                      name="draft")
         self.metrics.set_slots(0, self.pool.num_slots)
         self._queues: Dict[str, deque] = {c: deque() for c in SLO_CLASSES}
         self._active: Dict[int, _GenRequest] = {}   # slot -> request
@@ -436,16 +523,22 @@ class LLMEngine:
     # ---- the one jitted executable ----
     def _step(self):
         """Unified mixed-row step: `toks [N, C]` carries each slot's chunk
-        (prompt tokens for prefilling rows, [last_tok, 0...] for decoding
-        rows, zeros for free slots), `pos [N]` the row's committed length
+        (prompt tokens for prefilling rows, [last_tok, d1..dk, 0...] for
+        decoding rows — k > 0 when a draft window rides the row, ISSUE
+        17 — zeros for free slots), `pos [N]` the row's committed length
         (= write offset), `adv [N]` how many of the C columns are real
-        (chunk size / 1 / 0). KV stripes are written at `pos` (garbage
+        (chunk size / 1+k / 0). KV stripes are written at `pos` (garbage
         columns past `adv` land in cols the row's validity never reaches
         or in the slab's pad region, and are overwritten before any
-        seq_len admits them); ragged paged attention masks every row to
-        `col <= pos+t` and `col < pos+adv`; each row's next greedy token
-        is read at query index `adv-1` (free rows emit a harmless argmax
-        of a fully-masked zero row)."""
+        seq_len admits them — which is also what makes rejected draft
+        positions rollback-free: only the accepted length is ever
+        committed); ragged paged attention masks every row to
+        `col <= pos+t` and `col < pos+adv`. The step returns the
+        PER-POSITION greedy tokens `[N, C]` (make_verify_fn): column
+        `adv-1` is the classic next token for prefill/plain-decode rows,
+        and columns 0..k score a spec row's whole verify window in this
+        one dispatch (free rows emit harmless argmaxes of fully-masked
+        rows)."""
         if self._step_jit is None:
             block_len = self.pool.block_len
             pages_per_row = self.pool.n_blocks
@@ -453,25 +546,84 @@ class LLMEngine:
             def step(params, toks, pos, adv, table, slabs):
                 seq_lens = (pos + adv).astype(jnp.int32)
                 paged = (table, seq_lens, block_len, pages_per_row)
-                logits, slabs = self._prefill_fn(params, toks, slabs, pos,
-                                                 paged=paged)
-                sel = jnp.maximum(adv - 1, 0)
-                last = jnp.take_along_axis(
-                    logits, sel[:, None, None], axis=1)[:, 0]
-                return jnp.argmax(last, axis=-1).astype(jnp.int32), slabs
+                return self._verify_fn(params, toks, slabs, pos,
+                                       paged=paged)
 
             self._step_jit = jax.jit(step)
         return self._step_jit
 
+    def _draft_step(self):
+        """Draft-pool analogue of `_step` (ISSUE 17): the chunk-wide
+        catch-up executable that replays already-committed target tokens
+        (prompt suffixes and corrective tokens) into the draft pool so
+        its KV tracks the true stream. Output tokens are discarded — only
+        the written KV stripes matter."""
+        if self._draft_step_jit is None:
+            block_len = self.draft_pool.block_len
+            pages_per_row = self.draft_pool.n_blocks
+            vfy = self._draft_verify_fn
+
+            def step(params, toks, pos, adv, table, slabs):
+                seq_lens = (pos + adv).astype(jnp.int32)
+                paged = (table, seq_lens, block_len, pages_per_row)
+                return vfy(params, toks, slabs, pos, paged=paged)
+
+            self._draft_step_jit = jax.jit(step)
+        return self._draft_step_jit
+
+    def _draft_propose(self):
+        """The single-dispatch draft proposal (ISSUE 17): an on-device
+        `lax.scan` of spec_k+1 sequential width-1 draft steps. Step 0
+        feeds each proposing row's last committed token at `pos`; each
+        later step feeds the previous step's argmax, so the scan emits
+        d1..dK autoregressively — ONE dispatch, not K. The final (K+1th)
+        iteration feeds dK purely for its KV write: after an all-accept
+        window the draft pool is then already caught up to the target's
+        new committed length, so steady-state spec pays exactly two
+        dispatches (propose + verify) per K+1 emitted tokens — that
+        dispatch-count collapse is the batch-1 latency win. Rows with
+        act=0 park at the slab pad position (same convention as free rows
+        in `_build_rows_locked`) and advance nothing."""
+        if self._draft_propose_jit is None:
+            block_len = self.draft_pool.block_len
+            pages_per_row = self.draft_pool.n_blocks
+            K = self.config.spec_k
+            vfy = self._draft_verify_fn
+
+            def propose(params, tok0, pos, act, table, slabs):
+                def body(carry, _):
+                    tok, off, slabs_c = carry
+                    seq_lens = (pos + off + act).astype(jnp.int32)
+                    paged = (table, seq_lens, block_len, pages_per_row)
+                    out, slabs_c = vfy(params, tok[:, None], slabs_c,
+                                       pos + off, paged=paged)
+                    nxt = out[:, 0]
+                    return (nxt, off + act, slabs_c), nxt
+
+                (_, _, slabs), drafts = jax.lax.scan(
+                    body, (tok0, jnp.zeros_like(pos), slabs), None,
+                    length=K + 1)
+                # drafts [K+1, N]: rows 0..K-1 are d1..dK; row K is the
+                # throwaway catch-up step (KV write only)
+                return jnp.transpose(drafts[:K]), slabs
+
+            self._draft_propose_jit = jax.jit(propose)
+        return self._draft_propose_jit
+
     # ---- supervised dispatch ----
-    def _run_dispatch(self, kinds, fn, args):
+    def _run_dispatch(self, kinds, fn, args, exempt: bool = False):
         """One supervised jitted dispatch attempt. Every attempt — retries
         and blame probes included — consumes a dispatch index, which is
         what deterministic fault clauses key on. `kinds` is the ordered
         (kind, request_ids) pairs riding this dispatch — prefill rows
         announce first, then decode rows, both at the SAME index (a
         dispatch_raise clause fires once, at the first announcement;
-        poison_request clauses match their kind)."""
+        poison_request clauses match their kind; draft dispatches
+        announce kind "draft", which is what lets a fault plan poison
+        ONLY the draft). `exempt=True` marks a breaker-exempt dispatch
+        (ISSUE 17: draft proposals are an optimization, so their failures
+        must never charge the target engine's circuit breaker or
+        dispatch-failure stats)."""
         idx = self._dispatch_idx
         self._dispatch_idx += 1
         plan = self._fault_plan
@@ -484,7 +636,18 @@ class LLMEngine:
                                               request_ids=rids)
             return fn(*args)
 
-        return self.supervisor.run(guarded, label=label)
+        return self.supervisor.run(guarded, label=label, exempt=exempt)
+
+    def _free_row_locked(self, req: "_GenRequest", slot: int):
+        """Free a request's target-pool row AND its draft-pool row (ISSUE
+        17) — every terminal path (finish, evict, quarantine, evacuate,
+        shutdown) must release both or the draft pool's slot ledger
+        diverges from the target's."""
+        self.pool.free(slot)
+        if self.draft_pool is not None and req.draft_slot is not None:
+            if self.draft_pool.active[req.draft_slot]:
+                self.draft_pool.free(req.draft_slot)
+            req.draft_slot = None
 
     # ---- lifecycle ----
     def start(self) -> "LLMEngine":
@@ -536,7 +699,7 @@ class LLMEngine:
                         RejectedError("engine shut down mid-decode",
                                       reason="shutdown"))
                     self.metrics.on_reject("shutdown")
-                    self.pool.free(slot)
+                    self._free_row_locked(req, slot)
                 self._active.clear()
                 self.metrics.set_queue_depth(0)
                 self.metrics.set_slots(0, self.pool.num_slots)
@@ -583,7 +746,7 @@ class LLMEngine:
                     "engine drain timed out mid-decode",
                     reason="drain_timeout"))
                 self.metrics.on_reject("drain_timeout")
-                self.pool.free(slot)
+                self._free_row_locked(req, slot)
                 stranded += 1
             self._active.clear()
             if stranded:
@@ -660,7 +823,7 @@ class LLMEngine:
                         f"engine evacuated ({reason}) mid-decode",
                         reason=reason))
                 self.metrics.on_reject(reason)
-                self.pool.free(slot)
+                self._free_row_locked(req, slot)
                 n += 1
             self._active.clear()
             self.metrics.set_queue_depth(0)
@@ -715,6 +878,13 @@ class LLMEngine:
             flushed = 0
             if self.prefix_cache is not None:
                 flushed = self.prefix_cache.clear()
+            if self.draft_prefix_cache is not None:
+                # the draft's weights did not change, but keeping both
+                # caches' lifecycles aligned across deploys is cheap and
+                # removes a whole class of "stale draft prefix after
+                # rollback" questions (draft KV is an optimization, never
+                # a correctness input — acceptance re-verifies everything)
+                flushed += self.draft_prefix_cache.clear()
             prior = self.weight_version
             self.params = converted
             self.weight_version = str(version)
@@ -1140,14 +1310,308 @@ class LLMEngine:
                             "prefix_lookup", self.clock.now(),
                             attach_len=plan.attach_len,
                             prompt_len=len(req.prompt))
+                # speculative decoding (ISSUE 17): give the request a row
+                # in the draft pool. Exhaustion is not an error — the
+                # request simply runs spec-off (plain decode is always
+                # available and always correct).
+                if self.draft_pool is not None and not self._spec_disabled:
+                    try:
+                        dslot = self.draft_pool.allocate(req.cost)
+                    except SlotsExhaustedError:
+                        dslot = None
+                    if dslot is not None:
+                        req.draft_slot = dslot
+                        if self.draft_prefix_cache is not None:
+                            # same max_tokens cap as the target acquire:
+                            # both pools share block_len, so draft and
+                            # target attach page-congruent prefixes and a
+                            # warm hit skips the SAME token span on both
+                            # sides
+                            dplan = self.draft_prefix_cache.acquire(
+                                req.tenant, req.prompt,
+                                max_tokens=len(req.prompt) - 1)
+                            if dplan.pages:
+                                self.draft_pool.attach_blocks(
+                                    dslot, dplan.pages)
+                                req.draft_attached = list(dplan.pages)
+                            if dplan.tail_page is not None:
+                                self.draft_pool.cow_copy(dplan.tail_page,
+                                                         dslot)
+                            if dplan.attach_len:
+                                # attached/COW'd draft KV is immediately
+                                # valid: the draft starts its catch-up
+                                # from here, not from token 0
+                                self.draft_pool.set_length(
+                                    dslot, dplan.attach_len)
+                            self.draft_prefix_cache.release(dplan)
                 self._active[slot] = req
                 self.metrics.set_slots(self.pool.active_slots(),
                                        self.pool.num_slots)
 
-    def _build_rows_locked(self):
+    # ---- speculative decoding (ISSUE 17) ----
+    def _stream_token(self, req: _GenRequest, i: int) -> int:
+        """Token i of the request's true committed stream
+        (prompt + emitted) — what draft catch-up replays."""
+        plen = len(req.prompt)
+        return int(req.prompt[i]) if i < plen else int(req.emitted[i - plen])
+
+    def _draft_phase(self) -> Dict[int, List[int]]:
+        """The pump's draft work, run BEFORE the target's unified step:
+        one chunk-wide catch-up dispatch for rows whose draft KV trails
+        the target's committed stream (prompt suffixes after admission /
+        failover re-prefill, gap tokens after partial windows), then ONE
+        proposal dispatch — the spec_k+1-step on-device scan — over every
+        caught-up decode-ready row. Returns {target_slot: [d1..dK]}, the
+        verify windows `_build_rows_locked` stitches into the unified
+        step. Both dispatches announce kind "draft" and run
+        breaker-exempt: any failure degrades this pump to plain decode
+        (and quarantines the implicated request's DRAFT on attribution),
+        never the streams."""
+        if self.draft_pool is None or self._spec_disabled:
+            return {}
+        C = self.config.prefill_chunk
+        K = self.config.spec_k
+        dpool = self.draft_pool
+        pad_pos = dpool.n_blocks * dpool.block_len
+        N = dpool.num_slots
+
+        # -- catch-up: replay committed stream tokens into the draft pool
+        with self._cond:
+            toks = np.zeros((N, C), np.int32)
+            pos = np.full((N,), pad_pos, np.int32)
+            adv = np.zeros((N,), np.int32)
+            catchup: List[Tuple[int, _GenRequest, int, int, int]] = []
+            for slot, req in self._active.items():
+                ds = req.draft_slot
+                if ds is None or req.spec_off:
+                    continue
+                tlen = int(self.pool.lengths[slot])
+                dlen = int(dpool.lengths[ds])
+                if dlen >= tlen:
+                    continue
+                n = min(C, tlen - dlen)
+                for j in range(n):
+                    toks[ds, j] = self._stream_token(req, dlen + j)
+                pos[ds] = dlen
+                adv[ds] = n
+                catchup.append((slot, req, ds, dlen, n))
+        if catchup:
+            rids = tuple(sorted(r.submit_idx for _, r, _, _, _ in catchup))
+            fn = self._draft_step()
+            args = (self._draft_params, jnp.asarray(toks), jnp.asarray(pos),
+                    jnp.asarray(adv), dpool.device_block_table(),
+                    dpool.slabs)
+            tdc0 = self.clock.now() if self.ledger is not None else None
+            try:
+                out, new_slabs = self._run_dispatch(
+                    (("draft", rids),), fn, args, exempt=True)
+            except DispatchFailedError as e:
+                self._draft_failure(
+                    [(s, r) for s, r, _, _, _ in catchup], e, "catchup")
+                return {}
+            if self.ledger is not None:
+                jax.block_until_ready(out)
+                self.ledger.book_dispatch(
+                    self.clock.now() - tdc0, prefill_positions=0,
+                    decode_positions=0, total_positions=0,
+                    owners=[(r.tenant, r.slo, n)
+                            for _, r, _, _, n in catchup],
+                    draft_positions=int(sum(n for *_, n in catchup)))
+            dpool.slabs = new_slabs
+            with self._cond:
+                for slot, req, ds, dlen, n in catchup:
+                    if self._active.get(slot) is not req \
+                            or not dpool.active[ds]:
+                        continue
+                    dpool.set_length(ds, dlen + n)
+                    plen = len(req.prompt)
+                    if (self.draft_prefix_cache is not None
+                            and dlen < plen <= dlen + n):
+                        # the draft's prompt KV just completed: index it
+                        # so shared-prefix siblings attach on the draft
+                        # side too (page-congruent with the target cache)
+                        self.draft_prefix_cache.insert(
+                            req.tenant, req.prompt, ds, req.draft_attached)
+            self._draft_failstreak = 0
+
+        # -- proposal: ONE scan dispatch over caught-up decode-ready rows
+        with self._cond:
+            tok0 = np.zeros((N,), np.int32)
+            ppos = np.full((N,), pad_pos, np.int32)
+            act = np.zeros((N,), np.int32)
+            eligible: List[Tuple[int, _GenRequest, int, int]] = []
+            for slot, req in self._active.items():
+                ds = req.draft_slot
+                if ds is None or req.spec_off:
+                    continue
+                if req.chunk_off < len(req.prompt):
+                    continue            # still in chunked prefill
+                L = int(self.pool.lengths[slot])
+                if int(dpool.lengths[ds]) != L:
+                    continue            # draft KV still catching up
+                if req.max_new_tokens - len(req.emitted) < 2:
+                    continue            # a window cannot beat one step
+                if L + K + 1 > self.pool.capacity:
+                    continue            # window would overrun the slot
+                tok0[ds] = req.last_tok
+                ppos[ds] = L
+                act[ds] = 1
+                eligible.append((slot, req, ds, L))
+        if not eligible:
+            return {}
+        rids = tuple(sorted(r.submit_idx for _, r, _, _ in eligible))
+        fn = self._draft_propose()
+        args = (self._draft_params, jnp.asarray(tok0), jnp.asarray(ppos),
+                jnp.asarray(act), dpool.device_block_table(), dpool.slabs)
+        tdc0 = self.clock.now() if self.ledger is not None else None
+        try:
+            drafts_dev, new_slabs = self._run_dispatch(
+                (("draft", rids),), fn, args, exempt=True)
+        except DispatchFailedError as e:
+            self._draft_failure([(s, r) for s, r, _, _ in eligible], e,
+                                "propose")
+            return {}
+        if self.ledger is not None:
+            jax.block_until_ready(drafts_dev)
+            self.ledger.book_dispatch(
+                self.clock.now() - tdc0, prefill_positions=0,
+                decode_positions=0, total_positions=0,
+                owners=[(r.tenant, r.slo, K + 1)
+                        for _, r, _, _ in eligible],
+                draft_positions=(K + 1) * len(eligible))
+        dpool.slabs = new_slabs
+        drafts = np.asarray(drafts_dev)
+        spec: Dict[int, List[int]] = {}
+        with self._cond:
+            for slot, req, ds, L in eligible:
+                if self._active.get(slot) is not req \
+                        or not dpool.active[ds]:
+                    continue
+                # the scan wrote K+1 stripes: last_tok @ L and d1..dK at
+                # L+1..L+K (the final iteration feeds dK for exactly this
+                # write), so after an all-accept window (commit L+K+1)
+                # the draft needs NO catch-up dispatch
+                dpool.set_length(ds, L + K + 1)
+                spec[slot] = [int(t) for t in drafts[ds]]
+        self._draft_failstreak = 0
+        return spec
+
+    def _draft_failure(self, rows, err, stage: str):
+        """A draft dispatch failed after supervision (retries are not
+        worth a latency optimization — one failure degrades the pump to
+        plain decode). Attribution mirrors `_blame_and_quarantine` at
+        draft scope: solo-probe each riding request with a width-1
+        draft-kind dispatch; a blamed request's DRAFT is quarantined
+        (spec_off + draft row freed) while its target stream continues
+        bit-identically. Probes commit nothing — slabs are immutable and
+        never assigned here. Unattributable failures count an
+        engine-wide failstreak that disables spec at breaker_threshold;
+        the target breaker is NEVER charged on any draft path."""
+        dpool = self.draft_pool
+        fn = self._draft_propose()
+        N = dpool.num_slots
+        blamed = []
+        for slot, req in rows:
+            ds = req.draft_slot
+            if ds is None:
+                continue
+            tok0 = np.zeros((N,), np.int32)
+            act = np.zeros((N,), np.int32)
+            tok0[ds] = req.last_tok
+            act[ds] = 1
+            # probe at pos=0: the result is discarded and never
+            # committed, so clobber-free addressing is all that matters
+            args = (self._draft_params, jnp.asarray(tok0),
+                    jnp.asarray(np.zeros((N,), np.int32)),
+                    jnp.asarray(act), dpool.device_block_table(),
+                    dpool.slabs)
+            try:
+                self._run_dispatch((("draft", (req.submit_idx,)),), fn,
+                                   args, exempt=True)
+            except DispatchFailedError as probe_err:
+                blamed.append((slot, req, probe_err))
+                flight_recorder().record(
+                    "solo_probe", engine="llm", rid=req.rid,
+                    submit_idx=req.submit_idx, stage="draft",
+                    outcome="failed")
+            else:
+                flight_recorder().record(
+                    "solo_probe", engine="llm", rid=req.rid,
+                    submit_idx=req.submit_idx, stage="draft", outcome="ok")
+        if blamed and (len(blamed) < len(rows) or len(rows) == 1):
+            with self._cond:
+                for slot, req, probe_err in blamed:
+                    if self._active.get(slot) is not req:
+                        continue
+                    req.spec_off = True
+                    ds = req.draft_slot
+                    if ds is not None and dpool.active[ds]:
+                        dpool.free(ds)
+                    req.draft_slot = None
+                    self.metrics.on_draft_quarantine()
+                    flight_recorder().record(
+                        "draft_quarantine", engine="llm", rid=req.rid,
+                        submit_idx=req.submit_idx, stage=stage,
+                        reason="poisoned_draft", error=str(probe_err))
+            _log.warning(
+                "quarantined the DRAFT of %d request(s) after a poisoned "
+                "%s dispatch; their streams continue as plain decode",
+                len(blamed), stage)
+            return
+        self._draft_failstreak += 1
+        flight_recorder().record(
+            "draft_failure", engine="llm", stage=stage,
+            failstreak=self._draft_failstreak, error=str(err))
+        if self._draft_failstreak >= self.config.breaker_threshold:
+            self._spec_disabled = True
+            flight_recorder().record(
+                "draft_disabled", engine="llm",
+                failstreak=self._draft_failstreak)
+            _log.error(
+                "disabling speculative decoding after %d consecutive "
+                "unattributable draft dispatch failures; the engine "
+                "continues on plain decode", self._draft_failstreak)
+
+    def _acceptance_locked(self, decode_slots, spec_drafts,
+                           nxt) -> Dict[int, Tuple[List[int], int, int]]:
+        """Greedy verification over the step's per-position tokens:
+        for each decode row, walk the longest prefix of its draft window
+        matching the target's own argmaxes, then take the target's one
+        corrective token — truncated by the request's EOS / max-tokens
+        caps exactly where sequential decode would stop. Returns
+        {slot: (emit_tokens, accepted_draft_count, drafted_count)}; a
+        plain decode row (no drafts) degenerates to ([next_token], 0, 0),
+        which is precisely the pre-spec commit."""
+        accept: Dict[int, Tuple[List[int], int, int]] = {}
+        for slot in decode_slots:
+            req = self._active.get(slot)
+            if req is None:
+                continue
+            drafts = spec_drafts.get(slot, ())
+            row = nxt[slot]
+            k = len(drafts)
+            a = 0
+            while a < k and int(row[a]) == int(drafts[a]):
+                a += 1
+            emit_toks: List[int] = []
+            for j in range(a + 1):
+                tok = int(row[j])
+                emit_toks.append(tok)
+                if len(req.emitted) + len(emit_toks) >= req.max_new_tokens:
+                    break
+                if req.eos_token_id is not None \
+                        and tok == req.eos_token_id:
+                    break
+            accept[slot] = (emit_toks, min(len(emit_toks), a), k)
+        return accept
+
+    def _build_rows_locked(self, spec_drafts=None):
         """Assemble the unified step's host-side row set from the active
         table: (toks [N, C], pos [N], adv [N], prefill_slots,
-        decode_slots). Free slots stay all-zero (adv=0 → fully masked)."""
+        decode_slots). Free slots stay all-zero (adv=0 → fully masked).
+        A decode row with a draft window (ISSUE 17) carries
+        [last_tok, d1..dk] at adv=1+k — the verify chunk; plain decode
+        rows stay [last_tok] at adv=1."""
         N = self.pool.num_slots
         C = self.config.prefill_chunk
         toks = np.zeros((N, C), np.int32)
@@ -1170,9 +1634,13 @@ class LLMEngine:
                 adv[slot] = n
                 prefill_slots.append(slot)
             else:
+                drafts = (spec_drafts.get(slot, ())
+                          if spec_drafts else ())
                 toks[slot, 0] = req.last_tok
+                for j, d in enumerate(drafts):
+                    toks[slot, 1 + j] = d
                 pos[slot] = self.pool.lengths[slot]
-                adv[slot] = 1
+                adv[slot] = 1 + len(drafts)
                 decode_slots.append(slot)
         return toks, pos, adv, prefill_slots, decode_slots
 
@@ -1192,13 +1660,23 @@ class LLMEngine:
         """Run ONE unified mixed prefill+decode dispatch over every slot
         and commit its results. Returns 1 when the committed step carried
         at least one decode row (the decode-iteration count the
-        continuous-batching invariants pin), else 0."""
+        continuous-batching invariants pin), else 0.
+
+        With a draft model attached (ISSUE 17) the pump first runs the
+        draft phase: decode rows carry verify windows [last_tok, d1..dK]
+        instead of a lone token, and the commit takes the longest
+        target-matching draft prefix plus the corrective token — up to
+        K+1 tokens per row from the SAME single dispatch, bit-identical
+        to plain greedy decode. Quarantine retries reuse this pump's
+        windows: a failed dispatch commits nothing, so the surviving
+        rows' positions — and therefore their drafts — are unchanged."""
+        spec_drafts = self._draft_phase()
         while True:
             with self._cond:
                 if not self._active:
                     return 0
                 toks, pos, adv, prefill_slots, decode_slots = \
-                    self._build_rows_locked()
+                    self._build_rows_locked(spec_drafts)
                 kinds = self._kinds_of(prefill_slots, decode_slots)
             t0 = self.clock.now()
             fn = self._step()
@@ -1253,26 +1731,46 @@ class LLMEngine:
                 # the rows' tenants / SLO classes (ISSUE 11)
                 jax.block_until_ready(nxt)
                 tc1 = self.clock.now()
+            nxt = np.asarray(nxt)   # [N, C] per-position greedy tokens
+            with self._cond:
+                accept = self._acceptance_locked(decode_slots, spec_drafts,
+                                                 nxt)
+            if self.ledger is not None or self.observatory is not None:
                 if self.ledger is not None:
                     with self._cond:
                         owners = [(self._active[s].tenant,
                                    self._active[s].slo, int(adv[s]))
-                                  for s in prefill_slots + decode_slots
+                                  for s in prefill_slots
                                   if s in self._active]
+                        decode_useful = drafted = accepted = 0
+                        for s in decode_slots:
+                            req = self._active.get(s)
+                            if req is None or s not in accept:
+                                continue
+                            emit_toks, acc, k = accept[s]
+                            owners.append((req.tenant, req.slo,
+                                           len(emit_toks)))
+                            decode_useful += len(emit_toks)
+                            drafted += k
+                            accepted += acc
+                    # a verify row's rejected columns stay inside
+                    # total_positions but out of the useful decode count:
+                    # wasted draft positions surface as pad-waste in
+                    # token_efficiency, exactly like prefill padding
                     self.ledger.book_dispatch(
                         tc1 - tc0,
                         prefill_positions=int(sum(adv[s]
                                                   for s in prefill_slots)),
-                        decode_positions=len(decode_slots),
+                        decode_positions=decode_useful,
                         total_positions=int(toks.size),
-                        owners=owners)
+                        owners=owners,
+                        drafted=drafted, draft_accepted=accepted)
                 if self.observatory is not None:
                     # the span above already blocked on the result, so it
                     # is pure device execution — attribute it to this
                     # call site's latest executable (ISSUE 12)
                     self.observatory.note_device_seconds(
                         "llm/unified_step", tc1 - tc0)
-            nxt = np.asarray(nxt)
             now = self.clock.now()
             with self._cond:
                 n_decode = len(decode_slots)
@@ -1318,7 +1816,7 @@ class LLMEngine:
                             self.prefix_cache.insert(
                                 req.tenant, req.prompt, slot,
                                 req.attached_pages)
-                        self._emit(req, int(nxt[slot]))
+                        self._emit(req, int(nxt[slot, int(adv[slot]) - 1]))
                         if self._finish_if_done(req, now):
                             del self._active[slot]
                         elif req.deadline is not None and now >= req.deadline:
@@ -1327,17 +1825,43 @@ class LLMEngine:
                         # mid-prefill eviction: no tokens yet, but the slot
                         # must not keep absorbing chunk work
                         self._evict_expired_locked(req, slot, now)
+                total_emitted = 0
                 for slot in decode_slots:
                     req = self._active.get(slot)
-                    if req is None:
+                    if req is None or slot not in accept:
                         continue  # evacuated mid-step (deploy drain)
-                    # the decode wrote last_tok's KV at pos[slot]
-                    self.pool.set_length(slot, int(pos[slot]) + 1)
+                    emit_toks, acc, k = accept[slot]
+                    L = int(pos[slot])
+                    # the verify wrote KV for every consumed column, but
+                    # only the accepted prefix + corrective token is
+                    # committed: lengths/block tables never cover the
+                    # rejected tail, so the pool's garbage-past-length
+                    # invariant IS the rollback
+                    self.pool.set_length(slot, L + len(emit_toks))
+                    if self.draft_pool is not None \
+                            and req.draft_slot is not None \
+                            and self.draft_pool.active[req.draft_slot]:
+                        # the draft ran ahead on its own proposals; rewind
+                        # its tables to the verified stream so the next
+                        # window extends truth, not rejected speculation
+                        dlen = int(self.draft_pool.lengths[req.draft_slot])
+                        self.draft_pool.rewind_length(
+                            req.draft_slot,
+                            min(dlen, L + len(emit_toks)))
                     if req.trace is not None:
-                        req.trace.event("decode_step", now,
-                                        tok=int(nxt[slot]),
-                                        n_active=len(decode_slots))
-                    self._emit(req, int(nxt[slot]))
+                        ev = dict(tok=int(emit_toks[-1]),
+                                  n_active=len(decode_slots))
+                        if k:
+                            ev.update(drafted=k, accepted=acc)
+                        req.trace.event("decode_step", now, **ev)
+                    for tok in emit_toks:
+                        self._emit(req, tok)
+                    total_emitted += len(emit_toks)
+                    if k:
+                        self.spec_windows += 1
+                        self.spec_drafted += k
+                        self.spec_accepted += acc
+                        self.metrics.on_spec_window(k, acc)
                     if self._finish_if_done(req, now):
                         del self._active[slot]
                     elif req.deadline is not None and now >= req.deadline:
@@ -1345,7 +1869,8 @@ class LLMEngine:
                 self.metrics.set_slots(self.pool.active_slots(),
                                        self.pool.num_slots)
             if n_decode:
-                self.metrics.on_decode_step(n_decode, (now - t0) * 1e3)
+                self.metrics.on_decode_step(n_decode, (now - t0) * 1e3,
+                                            tokens=total_emitted)
                 return 1
             return 0
 
@@ -1363,7 +1888,7 @@ class LLMEngine:
         self.metrics.on_expire()
         if self.burn is not None:
             self.burn.observe(req.slo, False, outcome="deadline")
-        self.pool.free(slot)
+        self._free_row_locked(req, slot)
         del self._active[slot]
 
     def _blame_and_quarantine(self, fn, toks, pos, adv, last_err) -> bool:
@@ -1428,7 +1953,7 @@ class LLMEngine:
                     "quarantine", engine="llm", rid=req.rid,
                     submit_idx=req.submit_idx, reason="poisoned",
                     tokens_emitted=len(req.emitted))
-                self.pool.free(slot)
+                self._free_row_locked(req, slot)
                 del self._active[slot]
             self.metrics.set_slots(self.pool.active_slots(),
                                    self.pool.num_slots)
@@ -1457,7 +1982,7 @@ class LLMEngine:
                 if self.burn is not None:
                     self.burn.observe(req.slo, False,
                                       outcome="engine_failure")
-                self.pool.free(slot)
+                self._free_row_locked(req, slot)
             self._active.clear()
             self.metrics.set_slots(self.pool.active_slots(),
                                    self.pool.num_slots)
@@ -1485,7 +2010,7 @@ class LLMEngine:
         self.metrics.on_complete((now - req.arrival) * 1e3, slo=req.slo,
                                  tenant=req.tenant)
         if req.slot is not None and self.pool.active[req.slot]:
-            self.pool.free(req.slot)
+            self._free_row_locked(req, req.slot)
         return True
 
     # ---- scheduler thread (production mode) ----

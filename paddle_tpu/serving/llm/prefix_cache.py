@@ -94,10 +94,18 @@ class PrefixCache:
     """Per-tenant radix index over cached KV pages in a SlotPagedKVPool.
 
     Constructing the cache wires itself as the pool's `on_pressure` hook
-    so allocation pressure transparently evicts cold entries."""
+    so allocation pressure transparently evicts cold entries.
 
-    def __init__(self, pool: SlotPagedKVPool):
+    `name` labels which pool this cache fronts (ISSUE 17: the engine runs
+    a "target" cache and, with a draft model attached, a parallel "draft"
+    cache over the draft pool — both tries are keyed by the same prompt
+    tokens and the same page-aligned block_len, so a prompt that warm-hits
+    on the target side attaches the congruent draft pages too and the
+    draft skips re-prefilling the shared prefix)."""
+
+    def __init__(self, pool: SlotPagedKVPool, name: str = "target"):
         self.pool = pool
+        self.name = name
         self.block_len = pool.block_len
         self._roots: Dict[str, _Node] = {}
         self._tick = 0
@@ -374,6 +382,7 @@ class PrefixCache:
 
     def snapshot(self) -> dict:
         return {
+            "name": self.name,
             **self.stats,
             "hit_rate": self.hit_rate(),
             "tenants": {t: {**s, "hit_rate":

@@ -274,7 +274,10 @@ class LLMMetrics(ServingMetrics):
                               "brownout_entries": 0,
                               "prefix_hits": 0, "prefix_misses": 0,
                               "prefix_hit_tokens": 0,
-                              "prefix_lookup_tokens": 0})
+                              "prefix_lookup_tokens": 0,
+                              "spec_windows": 0, "spec_drafted": 0,
+                              "spec_accepted": 0,
+                              "spec_draft_quarantines": 0})
         self.slots_active = 0
         self.slots_total = 0
         # per-SLO-class accounting (ISSUE 6 overload control): aggregate
@@ -424,20 +427,44 @@ class LLMMetrics(ServingMetrics):
             if slo in self._class_ttft:
                 self._class_ttft[slo].append(float(ttft_ms))
 
-    def on_decode_step(self, active_rows: int, step_ms: float):
+    def on_decode_step(self, active_rows: int, step_ms: float,
+                       tokens: Optional[int] = None):
+        """One committed decode iteration over `active_rows` rows.
+        `tokens` is how many tokens the iteration actually emitted —
+        under speculative decoding (ISSUE 17) an accepted draft window
+        commits several tokens per row, so throughput counters take the
+        real emission while the batch-rows histogram keeps counting HOW
+        FULL the fixed-width step was (its documented meaning)."""
+        tokens = int(active_rows) if tokens is None else int(tokens)
         with self._lock:
             self.counters["decode_steps"] += 1
-            self.counters["tokens_out"] += int(active_rows)
+            self.counters["tokens_out"] += tokens
             self.batch_hist[active_rows] = \
                 self.batch_hist.get(active_rows, 0) + 1
             self.dispatched_rows += int(active_rows)
             self.counters["dispatches"] += 1
             self._intertoken_ms.append(float(step_ms))
-            self._decode_window.append((int(active_rows), float(step_ms)))
+            self._decode_window.append((tokens, float(step_ms)))
         from ..profiler import record_instant
         record_instant("serving/llm_decode", {
             "active_rows": active_rows, "step_ms": step_ms,
+            "tokens": tokens,
         })
+
+    def on_spec_window(self, drafted: int, accepted: int):
+        """One verified speculative window (ISSUE 17): `drafted` tokens
+        proposed, `accepted` of them kept (the corrective token is not
+        counted either way — it is ordinary decode output)."""
+        with self._lock:
+            self.counters["spec_windows"] += 1
+            self.counters["spec_drafted"] += int(drafted)
+            self.counters["spec_accepted"] += int(accepted)
+
+    def on_draft_quarantine(self):
+        """A request's draft was quarantined (spec_off) after a poisoned
+        draft dispatch; its target stream continues as plain decode."""
+        with self._lock:
+            self.counters["spec_draft_quarantines"] += 1
 
     def set_slots(self, active: int, total: int):
         with self._lock:
@@ -511,6 +538,8 @@ class LLMMetrics(ServingMetrics):
         s["slot_occupancy"] = (self.slots_active / self.slots_total
                                if self.slots_total else 0.0)
         s["tokens_per_s"] = self.tokens_per_s()
+        s["spec_accept_rate"] = (s["spec_accepted"] / s["spec_drafted"]
+                                 if s["spec_drafted"] else None)
         s["shed_rate"] = (s["shed"] / s["submitted"] if s["submitted"]
                           else 0.0)
         for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
@@ -547,6 +576,19 @@ class LLMMetrics(ServingMetrics):
         b.sample(f"{px}_decode_steps_total", s["decode_steps"])
         b.family(f"{px}_prefills_total", "counter")
         b.sample(f"{px}_prefills_total", s["prefills"])
+        # ---- speculative decoding families (ISSUE 17) ----
+        b.family(f"{px}_spec_windows_total", "counter")
+        b.sample(f"{px}_spec_windows_total", s["spec_windows"])
+        b.family(f"{px}_spec_drafted_total", "counter")
+        b.sample(f"{px}_spec_drafted_total", s["spec_drafted"])
+        b.family(f"{px}_spec_accepted_total", "counter")
+        b.sample(f"{px}_spec_accepted_total", s["spec_accepted"])
+        b.family(f"{px}_spec_accept_rate", "gauge")
+        b.sample(f"{px}_spec_accept_rate", s["spec_accept_rate"],
+                 round_to=4)
+        b.family(f"{px}_spec_draft_quarantines_total", "counter")
+        b.sample(f"{px}_spec_draft_quarantines_total",
+                 s["spec_draft_quarantines"])
         # ---- overload control + supervision families (ISSUE 6) ----
         b.family(f"{px}_class_requests_total", "counter")
         for c in SLO_CLASSES:

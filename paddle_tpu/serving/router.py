@@ -316,7 +316,15 @@ class RouterHandle:
 
     def _resume_args(self, now: float) -> dict:
         """submit() kwargs that continue this stream on a survivor:
-        re-prefill prompt+emitted, decode only the remaining budget."""
+        re-prefill prompt+emitted, decode only the remaining budget.
+
+        Speculative decoding (ISSUE 17): the engine only ever surfaces
+        VERIFIED tokens on its handles — unverified draft tokens live in
+        the dead replica's draft pool, never in tokens_so_far() — so a
+        stream killed mid-draft-window resumes from exactly the accepted
+        stream here, and the survivor (spec-enabled or not) re-enters
+        draft mode from a clean committed length. Greedy determinism then
+        keeps the resumed stream bit-identical to an uninterrupted one."""
         prompt = (np.concatenate([self.prompt, self._prefix])
                   if self._prefix.size else self.prompt)
         deadline_ms = None
@@ -837,12 +845,24 @@ class ReplicaRouter:
             down = sum(1 for s in states.values() if s != "ok")
             status = ("unavailable" if down == len(self.replicas)
                       else "degraded" if down else "ok")
-            return {"status": status, "replicas": states,
-                    "quarantined": sorted(
-                        n for n, st in self._state.items()
-                        if st.quarantined),
-                    "weight_versions": {
-                        r.name: r.weight_version for r in self.replicas}}
+            out = {"status": status, "replicas": states,
+                   "quarantined": sorted(
+                       n for n, st in self._state.items()
+                       if st.quarantined),
+                   "weight_versions": {
+                       r.name: r.weight_version for r in self.replicas}}
+            # speculative decoding (ISSUE 17): per-replica window accept
+            # rate (None: crashed, or no windows yet) — the fleet-level
+            # view the accept-rate runbook in docs/serving.md watches.
+            # Only advertised when some replica actually carries a draft.
+            if any(getattr(r.engine, "draft_model", None) is not None
+                   for r in self.replicas):
+                out["spec_accept_rates"] = {
+                    r.name: (None if r.crashed else
+                             r.engine.metrics.snapshot()
+                             .get("spec_accept_rate"))
+                    for r in self.replicas}
+            return out
 
     # ---- lifecycle (live mode) ----
 

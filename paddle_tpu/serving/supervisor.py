@@ -87,14 +87,23 @@ class EngineSupervisor:
         self._open = False
         self.stats: Dict[str, int] = {
             "dispatch_failures": 0, "watchdog_fires": 0,
-            "breaker_trips": 0, "quarantines": 0,
+            "breaker_trips": 0, "quarantines": 0, "exempt_failures": 0,
         }
 
     # ---- supervised dispatch ----
-    def run(self, fn: Callable, label: str = "dispatch"):
+    def run(self, fn: Callable, label: str = "dispatch",
+            exempt: bool = False):
         """One supervised dispatch attempt. Returns fn()'s result or
         raises DispatchFailedError / DispatchHungError — never the raw
-        model exception, and never blocks past the watchdog budget."""
+        model exception, and never blocks past the watchdog budget.
+
+        `exempt=True` marks a best-effort auxiliary dispatch (ISSUE 17:
+        speculative-draft proposals): its failures are still typed and
+        recorded, but they land in the separate "exempt_failures" stat so
+        health checks and breaker-adjacent accounting built on
+        "dispatch_failures" never see an optimization's faults — blame
+        stays chunk-granular, a poisoned draft cannot charge the target
+        engine."""
         try:
             if self.dispatch_timeout_s is None:
                 return fn()
@@ -106,7 +115,7 @@ class EngineSupervisor:
                 self.stats["watchdog_fires"] += 1
             flight_recorder().record(
                 "dispatch_hang", engine=self.name, label=label,
-                seconds=e.seconds)
+                seconds=e.seconds, exempt=exempt)
             budget = (f"{self.dispatch_timeout_s:.1f}s watchdog budget"
                       if self.dispatch_timeout_s is not None
                       else "no watchdog configured — a real hang would "
@@ -116,10 +125,11 @@ class EngineSupervisor:
                 f"(injected {e.seconds:.1f}s; {budget})") from e
         except Exception as e:
             with self._lock:
-                self.stats["dispatch_failures"] += 1
+                self.stats["exempt_failures" if exempt
+                           else "dispatch_failures"] += 1
             flight_recorder().record(
                 "dispatch_failure", engine=self.name, label=label,
-                error=f"{type(e).__name__}: {e}")
+                error=f"{type(e).__name__}: {e}", exempt=exempt)
             raise DispatchFailedError(
                 f"{self.name} {label} dispatch failed: "
                 f"{type(e).__name__}: {e}") from e

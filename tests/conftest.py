@@ -96,6 +96,14 @@ def pytest_configure(config):
         "markers", "router: multi-replica serving tier tests (breaker-aware "
         "router, failover re-prefill, quarantine ladder; serving/router.py); "
         "select with -m router")
+    config.addinivalue_line(
+        "markers", "deploy: zero-downtime rolling weight deployment tests "
+        "(drain/swap/canary/re-admit, fleet auto-rollback; "
+        "serving/deploy.py); select with -m deploy")
+    config.addinivalue_line(
+        "markers", "spec: speculative-decoding tests (draft propose + "
+        "single-dispatch verify, greedy accept/rollback, bit-identity; "
+        "ISSUE 17); select with -m spec")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -118,4 +126,11 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.obs)
         if mod == "test_router":
             item.add_marker(pytest.mark.router)
+            item.add_marker(pytest.mark.serving)
+        if mod == "test_deploy":
+            item.add_marker(pytest.mark.deploy)
+            item.add_marker(pytest.mark.serving)
+        if mod == "test_spec_decode":
+            item.add_marker(pytest.mark.spec)
+            item.add_marker(pytest.mark.llm)
             item.add_marker(pytest.mark.serving)

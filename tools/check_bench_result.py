@@ -139,7 +139,17 @@ GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
              # the rollout MUST stay 0 — the gate pins the zero-downtime
              # contract itself
              "deploy_ttft_p99_ms": "lower",
-             "deploy_dropped_streams": "lower"}
+             "deploy_dropped_streams": "lower",
+             # ISSUE 17 speculative-decoding gates (`bench.py --llm` spec
+             # phase): batch-1 closed-loop tok/s with the draft model
+             # attached is a FLOOR — pin it ABOVE the spec-off baseline
+             # (llm_spec_base_tok_s, which rides along ungated) so the
+             # dispatch-collapse win itself is regression-proof — and the
+             # greedy acceptance rate is a FLOOR (a draft/target
+             # divergence or a rollback bug craters the accept rate long
+             # before it shows up in tok/s)
+             "llm_spec_tok_s": "higher",
+             "llm_spec_accept_rate": "higher"}
 
 
 def _metrics_of(row):
@@ -160,7 +170,8 @@ def _metrics_of(row):
               "compile_executables", "compile_seconds_total",
               "train_numerics_overhead_pct",
               "fleet_qps_scaling", "fleet_failover_resume_ms",
-              "deploy_ttft_p99_ms", "deploy_dropped_streams"):
+              "deploy_ttft_p99_ms", "deploy_dropped_streams",
+              "llm_spec_tok_s", "llm_spec_accept_rate"):
         if extra.get(k) is not None:
             out[k] = float(extra[k])
     return out

@@ -82,7 +82,18 @@ another replica is deploy-draining rides the normal failover path and
 the rollout skips the corpse and completes on the survivors; and the
 version-skew suite pins that a stream which has emitted tokens only
 ever resumes on a SAME-weight-version replica — pending-queued, never
-stitched, when none exists) — then prints a pass/fail
+stitched, when none exists), and the ISSUE 17 speculative-decoding
+scenarios in tests/test_spec_decode.py (`spec`-marked module: a
+`poison_request@0:draft` request has exactly its DRAFT quarantined by
+the draft-scoped solo-probe ladder — the `draft_quarantine` flight
+event names the draft stage while the target stream continues as
+plain decode BIT-IDENTICAL to one-shot generate(), the co-scheduled
+request keeps speculating, and the target breaker is never charged
+(draft dispatches are supervision-exempt); unattributable draft
+failures walk the `draft_failure` failstreak to `draft_disabled` at
+breaker_threshold with the engine still serving; and a spec-armed
+replica crashed MID-draft-window resumes every victim from VERIFIED
+tokens only, bit-identical on the survivor) — then prints a pass/fail
 table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
@@ -115,6 +126,7 @@ TEST_FILES = [
     os.path.join("tests", "test_router.py"),
     os.path.join("tests", "test_async_checkpoint.py"),
     os.path.join("tests", "test_deploy.py"),
+    os.path.join("tests", "test_spec_decode.py"),
 ]
 
 
