@@ -895,6 +895,101 @@ def run_llm_bench():
             "spec_requests": n_spec,
             "spec_k": spec_k,
         })
+
+    # ---- seeded sampling + constrained decoding phase (ISSUE 18): the
+    # same closed-loop replay idiom as the spec phase, through three
+    # fresh engines — greedy baseline, per-request seeded
+    # temperature/top-p sampling, and grammar-constrained JSON decoding.
+    # Gates: llm_sampled_tok_s is a FLOOR (the batched on-device
+    # sampling lane must stay within ~10% of greedy — same dispatch
+    # count, same fixed-width step, only the select differs) and
+    # llm_mask_overhead_pct a CEILING (host-side sampling-operand
+    # assembly as a fraction of pump wall time, from the ledger's
+    # sample_mask phase). llm_sampled_bitmatch reports seeded-replay
+    # determinism: the identical trace re-run is token-identical.
+    if os.environ.get("BENCH_LLM_SAMPLED", "1") != "0":
+        from paddle_tpu.serving.llm import SamplingParams
+        n_samp = int(os.environ.get("BENCH_LLM_SAMPLED_REQUESTS", "6"))
+        samp_new = int(os.environ.get("BENCH_LLM_SAMPLED_MAX_NEW",
+                                      str(max(16, max_new))))
+
+        def sampled_replay(sp_of):
+            eng = LLMEngine(model, LLMEngineConfig(
+                num_slots=1, block_len=8,
+                n_blocks=max(4, -(-(16 + samp_new) // 8)),
+                max_queue_depth=64, economics=True))
+            eng.start()
+            eng.generate([1, 2, 3], max_new_tokens=2, timeout=300,
+                         sampling=sp_of(0))   # compile the unified step
+            eng.metrics = LLMMetrics()   # warmup rows don't count
+            eng.metrics.set_slots(eng.pool.active_slots(),
+                                  eng.pool.num_slots)
+            eng.ledger.reset()
+            prompts, _, _ = _poisson_prompt_trace(0, n_samp, rate_hz,
+                                                  vocab)
+            t0 = time.perf_counter()
+            streams = [eng.generate(p, max_new_tokens=samp_new,
+                                    timeout=300, sampling=sp_of(i + 1))
+                       for i, p in enumerate(prompts)]
+            s_dt = time.perf_counter() - t0
+            s_led = eng.ledger.snapshot()
+            eng.stop(drain=True)
+            return streams, s_dt, s_led
+
+        base_streams, base_dt, _ = sampled_replay(lambda i: None)
+        sp_of = lambda i: SamplingParams(temperature=0.8, top_p=0.95,
+                                         seed=1000 + i)
+        samp_streams, samp_dt, _ = sampled_replay(sp_of)
+        replay_streams, _, _ = sampled_replay(sp_of)
+        bitmatch = (len(samp_streams) == len(replay_streams) and all(
+            np.array_equal(a, b)
+            for a, b in zip(samp_streams, replay_streams)))
+        # constrained pass: every request decodes a JSON object under the
+        # same compiled token-DFA; mask overhead is measured HERE, where
+        # the grammar bank actually gates logits
+        gtok = {1: "{", 2: "}", 3: '"a"', 4: ":", 5: "1", 6: "23",
+                7: ",", 8: '"b"', 9: "true", 10: "false"}
+        gschema = {"type": "object",
+                   "properties": {"a": {"type": "integer"},
+                                  "b": {"type": "boolean"}},
+                   "required": ["a", "b"]}
+        gsp = lambda i: SamplingParams(
+            temperature=1.0, seed=7000 + i,
+            grammar={"schema": gschema, "tokens": gtok})
+        con_streams, _con_dt, con_led = sampled_replay(gsp)
+        # validity = the actual contract: every emitted token legal from
+        # the DFA state its predecessors reached (a stream truncated by
+        # max_new_tokens mid-number is still grammar-clean)
+        from paddle_tpu.serving.llm import compile_grammar
+        gdfa = compile_grammar({"schema": gschema, "tokens": gtok},
+                               vocab, None)
+
+        def _grammar_clean(s):
+            st = 0
+            for t in s:
+                st = int(gdfa.trans[st, int(t)])
+                if st < 0:
+                    return False
+            return True
+
+        con_valid = all(_grammar_clean(s) for s in con_streams)
+        n_tok = int(sum(s.size for s in base_streams))
+        n_stok = int(sum(s.size for s in samp_streams))
+        base_tok_s = n_tok / base_dt if base_dt > 0 else 0.0
+        samp_tok_s = n_stok / samp_dt if samp_dt > 0 else 0.0
+        wall = con_led["wall_seconds"]
+        mask_pct = (100.0 * con_led["phase_seconds"]["sample_mask"]
+                    / wall if wall > 0 else 0.0)
+        result["extra"].update({
+            "llm_sampled_tok_s": round(samp_tok_s, 1),
+            "llm_sampled_base_tok_s": round(base_tok_s, 1),
+            "llm_sampled_ratio": (round(samp_tok_s / base_tok_s, 4)
+                                  if base_tok_s > 0 else None),
+            "llm_mask_overhead_pct": round(mask_pct, 4),
+            "llm_sampled_bitmatch": bool(bitmatch),
+            "llm_constrained_valid": bool(con_valid),
+            "sampled_requests": n_samp,
+        })
     print(json.dumps(result))
 
 

@@ -30,18 +30,77 @@ from ..utils.jit_cache import JitLRUCache
 _GENERATE_JIT_CACHE_CAP = 8
 
 
-def _select_token(logits, do_sample, temperature, top_k, key):
-    """logits [B, V] -> next token [B] (greedy or temperature/top-k)."""
-    if not do_sample:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    lg = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
-    if top_k and top_k > 0:
-        # kth-largest via lax.top_k (O(V·k-ish)) instead of a full
-        # O(V log V) sort; ties at the threshold keep identical semantics
-        # (every logit >= kth survives)
-        kth = jax.lax.top_k(lg, top_k)[0][:, -1][:, None]
-        lg = jnp.where(lg < kth, -1e30, lg)
-    return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+def _top_p_filter(lg, top_p):
+    """Nucleus filter on [B, V] logits; `top_p` is a scalar or [B] f32.
+
+    Keeps the smallest set of tokens whose probability mass reaches
+    top_p (the standard "cumulative mass before this sorted slot is
+    still < p" rule, so at least the most-likely token always
+    survives), then maps the sorted cut back to logit space as a
+    per-row threshold — ties at the threshold survive, matching the
+    top-k tie semantics above."""
+    B, V = lg.shape
+    p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
+    srt = jnp.sort(lg, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(srt, axis=-1)
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    n_keep = jnp.maximum(jnp.sum(cum_before < p[:, None], axis=-1), 1)
+    thr = jnp.take_along_axis(srt, (n_keep - 1)[:, None], axis=-1)
+    keep = (lg >= thr) | (p[:, None] >= 1.0)
+    return jnp.where(keep, lg, -1e30)
+
+
+def _top_k_filter(lg, top_k):
+    """Per-row top-k filter on [B, V] logits; `top_k` is an i32 [B]
+    vector (the serving engine's batched path) — k <= 0 means no
+    filter for that row. Sort-based so k can differ per row; tie
+    semantics match the static lax.top_k branch (>= kth survives)."""
+    B, V = lg.shape
+    k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
+    srt = jnp.sort(lg, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        srt, (jnp.clip(k, 1, V) - 1)[:, None], axis=-1)
+    keep = (lg >= kth) | (k[:, None] <= 0)
+    return jnp.where(keep, lg, -1e30)
+
+
+def _select_token(logits, do_sample, temperature, top_k, key, top_p=1.0):
+    """logits [B, V] -> next token [B] (greedy or temp/top-k/top-p).
+
+    Two calling conventions share this one function:
+
+    * static knobs (one-shot generate()): `do_sample` a python bool,
+      `temperature`/`top_k`/`top_p` python scalars, `key` a single PRNG
+      key — python-level branches keep the pre-top-p greedy and
+      sampled paths bit-identical to earlier releases;
+    * batched per-row params (serving sampling subsystem, ISSUE 18):
+      `do_sample` a bool [B] array, `temperature`/`top_k`/`top_p`
+      [B] arrays, `key` a [B, 2] array of PER-ROW keys — every row
+      mixes greedy and sampled freely inside one traced program, so
+      per-request params never force a recompile.
+    """
+    if isinstance(do_sample, bool):
+        if not do_sample:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lg = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+        if top_k and top_k > 0:
+            # kth-largest via lax.top_k (O(V·k-ish)) instead of a full
+            # O(V log V) sort; ties at the threshold keep identical
+            # semantics (every logit >= kth survives)
+            kth = jax.lax.top_k(lg, top_k)[0][:, -1][:, None]
+            lg = jnp.where(lg < kth, -1e30, lg)
+        if top_p is not None and float(top_p) < 1.0:
+            lg = _top_p_filter(lg, float(top_p))
+        return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+    # batched per-row path: params and keys are traced arrays
+    B = logits.shape[0]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temp = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
+    lg = logits.astype(jnp.float32) / jnp.maximum(temp, 1e-6)[:, None]
+    lg = _top_k_filter(lg, top_k)
+    lg = _top_p_filter(lg, top_p)
+    sampled = jax.vmap(jax.random.categorical)(key, lg).astype(jnp.int32)
+    return jnp.where(jnp.asarray(do_sample, bool), sampled, greedy)
 
 
 def make_decoder_fns(model):
@@ -122,7 +181,8 @@ def make_verify_fn(model):
 
 
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
-             temperature=1.0, top_k=0, eos_token_id=None, seed=0):
+             temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+             seed=0):
     """Returns a Tensor [B, S0 + max_new_tokens] of prompt + continuation.
     With eos_token_id, finished rows pad with eos and the decode loop
     stops early once every row has finished. The number of decode-step
@@ -151,8 +211,11 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     gen_cache = model.__dict__.setdefault(
         "_generate_jit_cache",
         JitLRUCache(_GENERATE_JIT_CACHE_CAP, name="generate"))
+    # top_p is part of the key: a distinct nucleus cutoff is a distinct
+    # compiled filter, and omitting it would silently reuse the wrong
+    # executable (ISSUE 18 satellite — the LRU test pins the churn story)
     cache_key = (B, S0, max_new_tokens, do_sample, float(temperature),
-                 int(top_k), eos_token_id)
+                 int(top_k), float(top_p), eos_token_id)
     # token buffer pre-filled with eos so rows finished before the loop
     # exits keep the documented eos padding
     eos_fill = 0 if eos_token_id is None else int(eos_token_id)
@@ -161,7 +224,7 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         logits, caches_ = prefill(p, prompt, caches_, jnp.int32(0))
         key, sub = jax.random.split(key)
         tok0 = _select_token(logits[:, -1], do_sample, temperature, top_k,
-                             sub)
+                             sub, top_p)
         done0 = (jnp.zeros((B,), jnp.bool_) if eos_token_id is None
                  else tok0 == eos_token_id)
         buf = jnp.full((B, max_new_tokens), eos_fill, jnp.int32)
@@ -177,7 +240,7 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             step_logits, caches_c = decode_step(p, tok, S0 + i, caches_c)
             key_c, sub_c = jax.random.split(key_c)
             nxt = _select_token(step_logits, do_sample, temperature, top_k,
-                                sub_c)
+                                sub_c, top_p)
             if eos_token_id is not None:
                 nxt = jnp.where(done, eos_token_id, nxt)
                 done = done | (nxt == eos_token_id)

@@ -66,9 +66,12 @@ from .goodput import PhaseLedger
 
 _log = logging.getLogger("paddle_tpu.serving.economics")
 
-# attribution order is the chrome-trace lane order
+# attribution order is the chrome-trace lane order; "sample_mask"
+# (ISSUE 18) is the host-side sampling-operand assembly — per-slot
+# params, RNG-lane counters, DFA states, grammar bank — booked out of
+# the enclosing host span so constrained-decoding overhead is visible
 SERVING_LEDGER_PHASES = ("prefill_compute", "decode_compute",
-                         "draft_compute", "host", "idle")
+                         "draft_compute", "sample_mask", "host", "idle")
 
 
 class ServingLedger(PhaseLedger):

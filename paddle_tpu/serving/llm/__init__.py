@@ -23,3 +23,5 @@ from .llm_engine import (DispatchFailedError,  # noqa: F401
                          DispatchHungError, GenerationHandle, LLMEngine,
                          LLMEngineConfig, WeightSwapError)
 from .prefix_cache import AttachPlan, PrefixCache  # noqa: F401
+from .sampling import (SamplingParams, SlotSamplingTable,  # noqa: F401
+                       TokenDFA, compile_grammar)

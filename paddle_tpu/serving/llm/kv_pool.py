@@ -529,7 +529,10 @@ class SlotPagedKVPool:
         shared pages read from their physical row, exactly as the ragged
         kernel would). The payload is self-describing enough for
         `import_rows` on ANOTHER pool with the same slab geometry — the
-        groundwork for prefill/decode-disaggregated KV handoff."""
+        groundwork for prefill/decode-disaggregated KV handoff. KV alone
+        is not enough to resume a SAMPLED stream bit-identically: pair
+        this payload with `LLMEngine.export_sampling_lanes` (ISSUE 18),
+        which carries each slot's RNG-lane index and grammar DFA state."""
         rows: Dict[int, dict] = {}
         for slot in slots:
             slot = int(slot)

@@ -84,14 +84,32 @@ draft-scoped solo probes, a blamed request loses only its draft
 failstreak to engine-wide `_spec_disabled` — the target breaker is
 never charged.
 
+Per-slot sampling + grammar-constrained decoding (ISSUE 18): every
+request carries `SamplingParams` (temperature / top-k / top-p / seed /
+JSON-schema grammar) that ride the ONE unified step as batched per-slot
+ARRAYS — the engine still compiles exactly one step program for its
+lifetime, whatever mix of greedy, sampled and constrained rows it
+carries. A seeded request's token `i` is drawn on a per-request
+threefry lane keyed by `(seed, i)` alone (`sampling.lane_key`), so
+sampled streams are bit-identical across batch composition, engine
+restart, and router failover re-prefill (the survivor resumes the lane
+at `sample_offset = tokens already emitted`). Grammars compile to
+token-level DFAs interned in a fixed-shape bank; the step applies the
+per-slot state's legal-token mask on device and returns each row's
+advanced DFA state. Speculative decoding composes by seeded replay:
+the verify pass samples every window position on the same lanes, so
+the longest-matching-prefix acceptance yields streams literally
+identical to plain sampled decode (see sampling.py). Constrained slots
+do not speculate.
+
 Determinism: every decision is a pure function of `clock.now()` and the
 queue/pool tables. Under a `SimClock` the engine runs threadless and a
 test harness calls `pump()` directly — slot churn and decode-iteration
 counts are provable facts, not timing accidents. Under the default
 `MonotonicClock`, `start()` runs the same `pump()` from a scheduler
-thread. Decoding is greedy (argmax): that is what makes continuous
-batching bit-reproducible against one-shot generate() for free; sampling
-belongs to the one-shot API.
+thread. Default decoding is greedy (argmax), bit-reproducible against
+one-shot generate() for free; seeded sampling extends the same
+guarantee to `(seed, params)`-keyed streams.
 """
 from __future__ import annotations
 
@@ -115,6 +133,8 @@ from ..supervisor import (DispatchFailedError, DispatchHungError,  # noqa: F401
                           EngineSupervisor)
 from .kv_pool import SlotPagedKVPool, SlotsExhaustedError
 from .prefix_cache import PrefixCache
+from .sampling import (GREEDY, SamplingParams, SlotSamplingTable,
+                       compile_grammar, select_next, select_tokens)
 
 _log = logging.getLogger("paddle_tpu.serving.llm")
 
@@ -201,6 +221,15 @@ class LLMEngineConfig:
     #                                spec_k + 1 <= prefill_chunk is enforced
     #                                at engine construction when a draft
     #                                model is present
+    # ---- per-slot sampling + constrained decoding (ISSUE 18) ----
+    max_grammars: int = 8          # distinct compiled grammars the fixed-
+    #                                shape DFA bank holds; the bank's shape
+    #                                is part of the unified step's traced
+    #                                signature, so it is pre-allocated — a
+    #                                request needing a 9th grammar rejects
+    #                                instead of recompiling the step
+    max_dfa_states: int = 128      # per-grammar token-DFA state ceiling
+    #                                (same fixed-shape reasoning)
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -240,6 +269,12 @@ class LLMEngineConfig:
                 f"trace_buffer must be >= 1, got {self.trace_buffer}")
         if self.spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {self.spec_k}")
+        if self.max_grammars < 1:
+            raise ValueError(
+                f"max_grammars must be >= 1, got {self.max_grammars}")
+        if self.max_dfa_states < 1:
+            raise ValueError(
+                f"max_dfa_states must be >= 1, got {self.max_dfa_states}")
         if not 0.0 < self.slo_burn_budget <= 1.0:
             raise ValueError(
                 f"slo_burn_budget must be in (0, 1], got "
@@ -312,7 +347,8 @@ class _GenRequest:
                  "deadline", "handle", "slot", "emitted", "last_tok",
                  "slo", "submit_idx", "cost", "chunk_off", "tenant",
                  "attached_pages", "rid", "trace", "draft_slot",
-                 "spec_off", "draft_attached")
+                 "spec_off", "draft_attached", "sampling",
+                 "sample_offset", "gid", "dfa_state0")
 
     def __init__(self, prompt, max_new_tokens, eos_token_id, arrival,
                  deadline, slo, submit_idx, tenant="default"):
@@ -354,6 +390,20 @@ class _GenRequest:
         self.draft_attached: List[int] = []   # shared draft-pool pages this
         #                                       request attached (for the
         #                                       draft cache insert)
+        # per-slot sampling + constrained decoding (ISSUE 18)
+        self.sampling: Optional[SamplingParams] = None  # None == GREEDY
+        self.sample_offset: int = 0           # stream index of this
+        #                                       request's FIRST emitted
+        #                                       token — 0 normally, the
+        #                                       already-emitted count on a
+        #                                       failover re-prefill (the
+        #                                       RNG-lane counter restore)
+        self.gid: int = 0                     # interned grammar id in the
+        #                                       engine's DFA bank; 0 = the
+        #                                       pass-through row
+        self.dfa_state0: int = 0              # DFA state at first emission
+        #                                       (walked over the resumed
+        #                                       prompt tail on failover)
 
 
 class LLMEngine:
@@ -385,6 +435,18 @@ class LLMEngine:
         self.params, self._prefill_fn, self._decode_fn = \
             make_decoder_fns(model)
         _, self._verify_fn = make_verify_fn(model)
+        # per-slot sampling + grammar bank (ISSUE 18): sized off the
+        # model's vocab — the DFA bank's last axis is a legal-token mask
+        vocab_size = int(getattr(getattr(model, "config", None),
+                                 "vocab_size", 0))
+        if vocab_size < 1:
+            raise ValueError(
+                "model must expose config.vocab_size for the sampling "
+                "subsystem's grammar mask")
+        self.sampling_table = SlotSamplingTable(
+            self.config.num_slots, vocab_size,
+            max_grammars=self.config.max_grammars,
+            max_dfa_states=self.config.max_dfa_states)
         if not self.config.weight_version:
             raise ValueError("weight_version must be a non-empty string")
         self.weight_version = self.config.weight_version
@@ -417,6 +479,7 @@ class LLMEngine:
         self.draft_prefix_cache: Optional[PrefixCache] = None
         self._draft_params = None
         self._draft_verify_fn = None
+        self._draft_prefill_fn = None
         self._draft_step_jit = None     # chunk-wide draft catch-up
         self._draft_propose_jit = None  # the single-dispatch K-token scan
         self._spec_disabled = False     # engine-wide draft kill switch
@@ -436,6 +499,10 @@ class LLMEngine:
             draft_model.eval()
             self._draft_params, self._draft_verify_fn = \
                 make_verify_fn(draft_model)
+            # the propose scan samples its proposals on the SAME per-
+            # request lanes as the target verify (seeded-replay
+            # acceptance), so it needs raw draft logits, not argmaxes
+            _, self._draft_prefill_fn, _ = make_decoder_fns(draft_model)
             self.draft_pool = SlotPagedKVPool(
                 draft_model.init_cache, self.config.num_slots,
                 self.config.block_len, self.config.n_blocks,
@@ -534,23 +601,48 @@ class LLMEngine:
         positions rollback-free: only the accepted length is ever
         committed); ragged paged attention masks every row to
         `col <= pos+t` and `col < pos+adv`. The step returns the
-        PER-POSITION greedy tokens `[N, C]` (make_verify_fn): column
-        `adv-1` is the classic next token for prefill/plain-decode rows,
-        and columns 0..k score a spec row's whole verify window in this
-        one dispatch (free rows emit harmless argmaxes of fully-masked
-        rows)."""
+        PER-POSITION selected tokens `[N, C]` plus each row's advanced
+        grammar-DFA state `[N]` (ISSUE 18): selection is the vectorized
+        per-row `_select_token` path — masked argmax for greedy rows
+        (bit-identical to the old make_verify_fn step on unconstrained
+        rows), seeded temperature/top-k/top-p draws on per-request
+        `(seed, stream_index)` threefry lanes for sampling rows, with
+        the grammar bank's legal-token mask applied BEFORE the filters.
+        Column `adv-1` is the classic next token for prefill /
+        plain-decode rows; columns 0..k score a spec row's whole verify
+        window in this one dispatch (free rows emit harmless selections
+        of fully-masked rows). All sampling inputs are traced [N]
+        arrays + the fixed-shape DFA bank, so the mix of request params
+        never changes the executable."""
         if self._step_jit is None:
             block_len = self.pool.block_len
             pages_per_row = self.pool.n_blocks
+            prefill = self._prefill_fn
 
-            def step(params, toks, pos, adv, table, slabs):
+            def step(params, toks, pos, adv, table, slabs, temp, topk,
+                     topp, samp, seed, ctr, dstate, gid, bank):
                 seq_lens = (pos + adv).astype(jnp.int32)
                 paged = (table, seq_lens, block_len, pages_per_row)
-                return self._verify_fn(params, toks, slabs, pos,
-                                       paged=paged)
+                logits, new_slabs = prefill(params, toks, slabs, pos,
+                                            paged=paged)
+                sel, new_state = select_tokens(
+                    logits, adv, temp, topk, topp, samp, seed, ctr,
+                    dstate, gid, bank)
+                return sel, new_state, new_slabs
 
             self._step_jit = jax.jit(step)
         return self._step_jit
+
+    def _sampling_args_locked(self, ctr):
+        """The unified step's per-slot sampling operands: the live table
+        rows plus this dispatch's stream-index base `ctr [N]` and the
+        cached device DFA bank. Table arrays ride the device-args cache
+        (invalidated on bind/clear/DFA commit) so the steady-state cost
+        here is one [N] ctr upload."""
+        tab = self.sampling_table
+        temp, topk, topp, samp, seed, dstate, gid = tab.device_args()
+        return (temp, topk, topp, samp, seed, jnp.asarray(ctr),
+                dstate, gid, tab.device_bank())
 
     def _draft_step(self):
         """Draft-pool analogue of `_step` (ISSUE 17): the chunk-wide
@@ -583,26 +675,36 @@ class LLMEngine:
         dispatches (propose + verify) per K+1 emitted tokens — that
         dispatch-count collapse is the batch-1 latency win. Rows with
         act=0 park at the slab pad position (same convention as free rows
-        in `_build_rows_locked`) and advance nothing."""
+        in `_build_rows_locked`) and advance nothing.
+
+        Sampled rows (ISSUE 18): scan step j selects its proposal with
+        `select_next` on the SAME per-request lane the target verify
+        will use for stream index `ctr + j` — when draft and target
+        logits agree the proposal IS the target's coin-fixed draw, so
+        seeded-replay acceptance keeps the spec speedup for sampled
+        requests. Greedy rows still argmax. Grammar-constrained rows
+        never reach this scan (spec-ineligible)."""
         if self._draft_propose_jit is None:
             block_len = self.draft_pool.block_len
             pages_per_row = self.draft_pool.n_blocks
             K = self.config.spec_k
-            vfy = self._draft_verify_fn
+            dprefill = self._draft_prefill_fn
 
-            def propose(params, tok0, pos, act, table, slabs):
-                def body(carry, _):
+            def propose(params, tok0, pos, act, table, slabs, temp,
+                        topk, topp, samp, seed, ctr):
+                def body(carry, j):
                     tok, off, slabs_c = carry
                     seq_lens = (pos + off + act).astype(jnp.int32)
                     paged = (table, seq_lens, block_len, pages_per_row)
-                    out, slabs_c = vfy(params, tok[:, None], slabs_c,
-                                       pos + off, paged=paged)
-                    nxt = out[:, 0]
+                    lg, slabs_c = dprefill(params, tok[:, None], slabs_c,
+                                           pos + off, paged=paged)
+                    nxt = select_next(lg[:, 0], temp, topk, topp, samp,
+                                      seed, ctr + j)
                     return (nxt, off + act, slabs_c), nxt
 
                 (_, _, slabs), drafts = jax.lax.scan(
-                    body, (tok0, jnp.zeros_like(pos), slabs), None,
-                    length=K + 1)
+                    body, (tok0, jnp.zeros_like(pos), slabs),
+                    jnp.arange(K + 1, dtype=jnp.int32))
                 # drafts [K+1, N]: rows 0..K-1 are d1..dK; row K is the
                 # throwaway catch-up step (KV write only)
                 return jnp.transpose(drafts[:K]), slabs
@@ -644,6 +746,7 @@ class LLMEngine:
         shutdown) must release both or the draft pool's slot ledger
         diverges from the target's."""
         self.pool.free(slot)
+        self.sampling_table.clear(slot)
         if self.draft_pool is not None and req.draft_slot is not None:
             if self.draft_pool.active[req.draft_slot]:
                 self.draft_pool.free(req.draft_slot)
@@ -834,6 +937,37 @@ class LLMEngine:
             flight_recorder().record("deploy_evacuate", engine="llm",
                                      reason=reason, n=n)
         return n
+
+    def export_sampling_lanes(self, slots) -> dict:
+        """Serialize the sampling-lane state of active `slots` — the
+        companion payload to `kv_pool.export_rows` (ISSUE 18): per slot,
+        the request seed, the NEXT RNG stream index, the sampling params,
+        and (for constrained rows) the grammar key plus current DFA
+        state. A peer that imports the KV rows and rebinds these lanes
+        (seed → `SamplingParams`, next_index → `sample_offset`,
+        grammar_key → recompile + DFA fast-forward) continues the stream
+        bit-identically to the uninterrupted run — the same contract the
+        router's failover re-prefill exercises without KV transfer."""
+        out: Dict[int, dict] = {}
+        with self._cond:
+            tab = self.sampling_table
+            for slot in slots:
+                slot = int(slot)
+                req = self._active.get(slot)
+                if req is None:
+                    raise ValueError(f"slot {slot} has no active request")
+                sp = req.sampling or GREEDY
+                out[slot] = {
+                    "seed": None if sp.seed is None else int(sp.seed),
+                    "next_index": req.sample_offset + len(req.emitted),
+                    "temperature": float(sp.temperature),
+                    "top_k": int(sp.top_k),
+                    "top_p": float(sp.top_p),
+                    "grammar_key": (sp.grammar_key()
+                                    if sp.constrained else None),
+                    "dfa_state": int(tab.dfa_state[slot]),
+                }
+        return out
 
     def replace_params(self, new_params, version: str):
         """Hot in-place weight swap between pump iterations — NO
@@ -1034,7 +1168,9 @@ class LLMEngine:
                slo: Optional[str] = None,
                tenant: Optional[str] = None,
                rid: Optional[str] = None,
-               trace: bool = False) -> GenerationHandle:
+               trace: bool = False,
+               sampling: Optional[SamplingParams] = None,
+               sample_offset: int = 0) -> GenerationHandle:
         """Admit one prompt (1-D int token ids). `slo` names the request's
         SLO class (config.default_slo when None); `tenant` its isolation
         domain (config.default_tenant when None) — tenants get fair
@@ -1042,13 +1178,28 @@ class LLMEngine:
         private prefix-cache namespace. `rid` is the request id (ingested
         from a traceparent header by the server, generated when None);
         `trace=True` accumulates a per-request timeline on the handle and
-        in the engine's timeline store. Raises RejectedError when the
-        sequence can never fit a slot, the queue/token budget/tenant
-        quota is exhausted and nothing lower-priority can be shed, the
-        engine is draining, or the circuit breaker is open."""
+        in the engine's timeline store.
+
+        `sampling` (ISSUE 18) carries the per-request sampling contract;
+        None is greedy. `sample_offset` restores the request's RNG lane
+        on a failover re-prefill: it is the stream index of the first
+        token THIS admission will emit (= tokens already emitted on the
+        dead replica, re-prefilled as the prompt's tail), so draw i of
+        the logical stream stays keyed by `(seed, i)` across the
+        failover. For a constrained request the same tail is walked
+        through the grammar DFA host-side to restore the mask state.
+
+        Raises RejectedError when the sequence can never fit a slot, the
+        queue/token budget/tenant quota is exhausted and nothing
+        lower-priority can be shed, the grammar bank is full, the engine
+        is draining, or the circuit breaker is open."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must contain at least one token")
+        sample_offset = int(sample_offset)
+        if sample_offset < 0:
+            raise ValueError(
+                f"sample_offset must be >= 0, got {sample_offset}")
         mnt = (self.config.max_new_tokens if max_new_tokens is None
                else int(max_new_tokens))
         if mnt < 1:
@@ -1063,6 +1214,46 @@ class LLMEngine:
         rid = rid or new_request_id()
         eos = (self.config.eos_token_id if eos_token_id is None
                else eos_token_id)
+        gid, dstate0 = 0, 0
+        if sampling is not None:
+            sampling.validate()
+            if sampling.grammar is not None:
+                gkey = sampling.grammar_key()
+                gid = self.sampling_table.lookup(gkey)
+                if gid is None:
+                    tg0 = self.clock.now()
+                    dfa = compile_grammar(
+                        sampling.grammar, self.sampling_table.vocab_size,
+                        eos)
+                    try:
+                        gid = self.sampling_table.intern(gkey, dfa)
+                    except ValueError as e:
+                        # bank capacity is an admission-control condition,
+                        # not a caller bug: typed reject, not ValueError
+                        self.metrics.on_reject("grammar_capacity")
+                        self._record_reject("grammar_capacity", rid=rid,
+                                            tenant=tenant)
+                        raise RejectedError(str(e),
+                                            reason="grammar_capacity")
+                    if self.ledger is not None:
+                        self.ledger.book("sample_mask",
+                                         self.clock.now() - tg0)
+                    self.metrics.set_grammars(
+                        self.sampling_table.grammars_compiled)
+                if sample_offset:
+                    # failover re-prefill: the prompt's tail IS the
+                    # emitted-so-far constrained stream — walk it through
+                    # the DFA so the mask resumes mid-grammar exactly
+                    bank = self.sampling_table.bank[gid]
+                    q = 0
+                    for t in prompt[-min(sample_offset, prompt.size):]:
+                        nq = int(bank[q, int(t)])
+                        if nq < 0:
+                            raise ValueError(
+                                "failover resume tail violates the "
+                                f"request grammar at token {int(t)}")
+                        q = nq
+                    dstate0 = q
         if prompt.size + mnt > self.pool.capacity:
             self.metrics.on_reject("prompt_too_long")
             self._record_reject("prompt_too_long", rid=rid, tenant=tenant)
@@ -1118,6 +1309,10 @@ class LLMEngine:
                               self._submit_idx, tenant=tenant)
             req.rid = rid
             req.handle.rid = rid
+            req.sampling = sampling
+            req.sample_offset = sample_offset
+            req.gid = gid
+            req.dfa_state0 = dstate0
             if trace:
                 req.trace = RequestTrace(rid, now, slo=slo, tenant=tenant)
                 req.trace.event("submitted", now, prompt_len=int(prompt.size),
@@ -1137,12 +1332,13 @@ class LLMEngine:
                  deadline_ms: Optional[float] = None,
                  timeout: Optional[float] = None,
                  slo: Optional[str] = None,
-                 tenant: Optional[str] = None) -> np.ndarray:
+                 tenant: Optional[str] = None,
+                 sampling: Optional[SamplingParams] = None) -> np.ndarray:
         """Synchronous convenience: submit + wait for the full sequence."""
         return self.submit(prompt, max_new_tokens=max_new_tokens,
                            eos_token_id=eos_token_id,
                            deadline_ms=deadline_ms, slo=slo,
-                           tenant=tenant).result(timeout)
+                           tenant=tenant, sampling=sampling).result(timeout)
 
     def prefix_probe(self, prompt, tenant: Optional[str] = None) -> int:
         """Longest block-aligned cached-prefix match for `prompt` in this
@@ -1217,6 +1413,8 @@ class LLMEngine:
             for r in self._active.values():
                 per_tenant[r.tenant] = per_tenant.get(r.tenant, 0) + r.cost
             self.metrics.set_tenant_inflight(per_tenant)
+            self.metrics.set_sample_slots(
+                self.sampling_table.mode_counts(self._active.keys()))
         if self.prefix_cache is not None:
             self.metrics.set_prefix_cache(
                 self.prefix_cache.stats["cached_blocks"],
@@ -1310,11 +1508,21 @@ class LLMEngine:
                             "prefix_lookup", self.clock.now(),
                             attach_len=plan.attach_len,
                             prompt_len=len(req.prompt))
+                # per-slot sampling state (ISSUE 18): bind the request's
+                # params + grammar/DFA row for the slot's lifetime
+                self.sampling_table.bind(slot, req.sampling or GREEDY,
+                                         gid=req.gid,
+                                         dfa_state=req.dfa_state0)
                 # speculative decoding (ISSUE 17): give the request a row
                 # in the draft pool. Exhaustion is not an error — the
                 # request simply runs spec-off (plain decode is always
-                # available and always correct).
-                if self.draft_pool is not None and not self._spec_disabled:
+                # available and always correct). Grammar-constrained
+                # requests (ISSUE 18) never speculate — their one
+                # emission column per step is masked by a DFA state the
+                # draft cannot see ahead of — so they skip the draft row
+                # instead of pinning one idle.
+                if self.draft_pool is not None and not self._spec_disabled \
+                        and req.gid == 0:
                     try:
                         dslot = self.draft_pool.allocate(req.cost)
                     except SlotsExhaustedError:
@@ -1439,10 +1647,20 @@ class LLMEngine:
             tok0 = np.zeros((N,), np.int32)
             ppos = np.full((N,), pad_pos, np.int32)
             act = np.zeros((N,), np.int32)
+            # per-lane sampling operands, indexed by DRAFT slot (ISSUE
+            # 18): the scan proposes on the same (seed, stream index)
+            # lanes the target verify will draw on
+            dtemp = np.ones((N,), np.float32)
+            dtopk = np.zeros((N,), np.int32)
+            dtopp = np.ones((N,), np.float32)
+            dsamp = np.zeros((N,), bool)
+            dseed = np.zeros((N,), np.int32)
+            dctr = np.zeros((N,), np.int32)
+            tab = self.sampling_table
             eligible: List[Tuple[int, _GenRequest, int, int]] = []
             for slot, req in self._active.items():
                 ds = req.draft_slot
-                if ds is None or req.spec_off:
+                if ds is None or req.spec_off or req.gid > 0:
                     continue
                 if req.chunk_off < len(req.prompt):
                     continue            # still in chunked prefill
@@ -1456,13 +1674,21 @@ class LLMEngine:
                 tok0[ds] = req.last_tok
                 ppos[ds] = L
                 act[ds] = 1
+                dtemp[ds] = tab.temperature[slot]
+                dtopk[ds] = tab.top_k[slot]
+                dtopp[ds] = tab.top_p[slot]
+                dsamp[ds] = tab.do_sample[slot]
+                dseed[ds] = tab.seed[slot]
+                dctr[ds] = req.sample_offset + len(req.emitted)
                 eligible.append((slot, req, ds, L))
         if not eligible:
             return {}
         rids = tuple(sorted(r.submit_idx for _, r, _, _ in eligible))
         fn = self._draft_propose()
         args = (self._draft_params, jnp.asarray(tok0), jnp.asarray(ppos),
-                jnp.asarray(act), dpool.device_block_table(), dpool.slabs)
+                jnp.asarray(act), dpool.device_block_table(), dpool.slabs,
+                jnp.asarray(dtemp), jnp.asarray(dtopk), jnp.asarray(dtopp),
+                jnp.asarray(dsamp), jnp.asarray(dseed), jnp.asarray(dctr))
         tdc0 = self.clock.now() if self.ledger is not None else None
         try:
             drafts_dev, new_slabs = self._run_dispatch(
@@ -1521,10 +1747,17 @@ class LLMEngine:
             act[ds] = 1
             # probe at pos=0: the result is discarded and never
             # committed, so clobber-free addressing is all that matters
+            # — neutral greedy lanes keep the probe deterministic
             args = (self._draft_params, jnp.asarray(tok0),
                     jnp.asarray(np.zeros((N,), np.int32)),
                     jnp.asarray(act), dpool.device_block_table(),
-                    dpool.slabs)
+                    dpool.slabs,
+                    jnp.asarray(np.ones((N,), np.float32)),
+                    jnp.asarray(np.zeros((N,), np.int32)),
+                    jnp.asarray(np.ones((N,), np.float32)),
+                    jnp.asarray(np.zeros((N,), bool)),
+                    jnp.asarray(np.zeros((N,), np.int32)),
+                    jnp.asarray(np.zeros((N,), np.int32)))
             try:
                 self._run_dispatch((("draft", (req.submit_idx,)),), fn,
                                    args, exempt=True)
@@ -1607,14 +1840,23 @@ class LLMEngine:
 
     def _build_rows_locked(self, spec_drafts=None):
         """Assemble the unified step's host-side row set from the active
-        table: (toks [N, C], pos [N], adv [N], prefill_slots,
+        table: (toks [N, C], pos [N], adv [N], ctr [N], prefill_slots,
         decode_slots). Free slots stay all-zero (adv=0 → fully masked).
         A decode row with a draft window (ISSUE 17) carries
         [last_tok, d1..dk] at adv=1+k — the verify chunk; plain decode
-        rows stay [last_tok] at adv=1."""
+        rows stay [last_tok] at adv=1.
+
+        `ctr` (ISSUE 18) is each row's RNG-lane stream index for column
+        0: decode rows sit at `sample_offset + emitted` (column t draws
+        stream token index ctr+t); prefill rows back the base off by
+        adv-1 so the emission column adv-1 lands exactly on the first
+        emitted token's index — the earlier columns' draws are discarded
+        with their logits, negative intermediate indices fold_in as
+        harmless uint32 bit-casts."""
         N = self.pool.num_slots
         C = self.config.prefill_chunk
         toks = np.zeros((N, C), np.int32)
+        ctr = np.zeros((N,), np.int32)
         # free rows still get a (discarded) C-wide KV stripe written at
         # their pos by the unified step; park it in the slab's pad region
         # (block tables never address cols >= n_blocks*block_len) so it
@@ -1626,12 +1868,14 @@ class LLMEngine:
         decode_slots: List[int] = []
         for slot, req in self._active.items():
             plen = len(req.prompt)
+            base = req.sample_offset + len(req.emitted)
             if req.chunk_off < plen:
                 off = req.chunk_off
                 n = min(C, plen - off)
                 toks[slot, :n] = req.prompt[off:off + n]
                 pos[slot] = off
                 adv[slot] = n
+                ctr[slot] = base - (n - 1)
                 prefill_slots.append(slot)
             else:
                 drafts = (spec_drafts.get(slot, ())
@@ -1641,8 +1885,9 @@ class LLMEngine:
                     toks[slot, 1 + j] = d
                 pos[slot] = self.pool.lengths[slot]
                 adv[slot] = 1 + len(drafts)
+                ctr[slot] = base
                 decode_slots.append(slot)
-        return toks, pos, adv, prefill_slots, decode_slots
+        return toks, pos, adv, ctr, prefill_slots, decode_slots
 
     def _kinds_of(self, prefill_slots, decode_slots) -> Tuple:
         """(kind, request_ids) announcement order for fault injection:
@@ -1675,14 +1920,25 @@ class LLMEngine:
             with self._cond:
                 if not self._active:
                     return 0
-                toks, pos, adv, prefill_slots, decode_slots = \
+                toks, pos, adv, ctr, prefill_slots, decode_slots = \
                     self._build_rows_locked(spec_drafts)
                 kinds = self._kinds_of(prefill_slots, decode_slots)
+                # sampling-operand assembly (ISSUE 18) — per-slot params,
+                # RNG-lane counters, DFA states and the grammar bank —
+                # is the host-side cost of constrained/sampled decoding;
+                # meter it so the mask-overhead ceiling row in bench has
+                # a real signal behind it
+                ts0 = self.clock.now()
+                sargs = self._sampling_args_locked(ctr)
+                mask_dt = self.clock.now() - ts0
+            self.metrics.on_mask_overhead(mask_dt * 1e3)
+            if self.ledger is not None:
+                self.ledger.book("sample_mask", mask_dt)
             t0 = self.clock.now()
             fn = self._step()
             args = (self.params, jnp.asarray(toks), jnp.asarray(pos),
                     jnp.asarray(adv), self.pool.device_block_table(),
-                    self.pool.slabs)
+                    self.pool.slabs) + sargs
             if self.observatory is not None:
                 self.observatory.observe_call("llm/unified_step", fn, args)
             attempts = self.config.dispatch_retries + 1
@@ -1696,7 +1952,8 @@ class LLMEngine:
                     # dispatch's span is booked as compute
                     tc0 = self.clock.now()
                 try:
-                    nxt, new_slabs = self._run_dispatch(kinds, fn, args)
+                    nxt, new_dstate, new_slabs = self._run_dispatch(
+                        kinds, fn, args)
                 except DispatchFailedError as e:
                     last_err = e
                     self.metrics.on_dispatch_failure(e.reason)
@@ -1719,7 +1976,8 @@ class LLMEngine:
                     self.supervisor.record_success()
                 break
             else:
-                if self._blame_and_quarantine(fn, toks, pos, adv, last_err):
+                if self._blame_and_quarantine(fn, toks, pos, adv, ctr,
+                                              last_err):
                     continue    # survivors retry on a rebuilt row set
                 self._fail_all_active(attempts, last_err)
                 self.supervisor.record_failure()
@@ -1731,7 +1989,8 @@ class LLMEngine:
                 # the rows' tenants / SLO classes (ISSUE 11)
                 jax.block_until_ready(nxt)
                 tc1 = self.clock.now()
-            nxt = np.asarray(nxt)   # [N, C] per-position greedy tokens
+            nxt = np.asarray(nxt)   # [N, C] per-position selected tokens
+            new_dstate = np.asarray(new_dstate)  # [N] advanced DFA states
             with self._cond:
                 accept = self._acceptance_locked(decode_slots, spec_drafts,
                                                  nxt)
@@ -1817,6 +2076,11 @@ class LLMEngine:
                                 req.tenant, req.prompt, slot,
                                 req.attached_pages)
                         self._emit(req, int(nxt[slot, int(adv[slot]) - 1]))
+                        if req.gid:
+                            # first constrained emission: commit the DFA
+                            # state advanced in-step past that token
+                            self.sampling_table.set_dfa_state(
+                                slot, int(new_dstate[slot]))
                         if self._finish_if_done(req, now):
                             del self._active[slot]
                         elif req.deadline is not None and now >= req.deadline:
@@ -1856,6 +2120,12 @@ class LLMEngine:
                         req.trace.event("decode_step", now, **ev)
                     for tok in emit_toks:
                         self._emit(req, tok)
+                    if req.gid:
+                        # constrained rows never speculate (one emission
+                        # per step), so the in-step advanced state is
+                        # exactly the post-emission state
+                        self.sampling_table.set_dfa_state(
+                            slot, int(new_dstate[slot]))
                     total_emitted += len(emit_toks)
                     if k:
                         self.spec_windows += 1
@@ -1891,7 +2161,8 @@ class LLMEngine:
         self._free_row_locked(req, slot)
         del self._active[slot]
 
-    def _blame_and_quarantine(self, fn, toks, pos, adv, last_err) -> bool:
+    def _blame_and_quarantine(self, fn, toks, pos, adv, ctr,
+                              last_err) -> bool:
         """Step retries exhausted: probe each active request in ISOLATION
         — the same fixed-width dispatch with every other row masked to
         (toks=0, pos=0, adv=0), announced as that single request's kind
@@ -1916,14 +2187,21 @@ class LLMEngine:
             solo_toks = np.zeros_like(toks)
             solo_pos = np.zeros_like(pos)
             solo_adv = np.zeros_like(adv)
+            solo_ctr = np.zeros_like(ctr)
             solo_toks[slot] = toks[slot]
             solo_pos[slot] = pos[slot]
             solo_adv[slot] = adv[slot]
+            solo_ctr[slot] = ctr[slot]
             kind = ("prefill" if req.chunk_off < len(req.prompt)
                     else "decode")
+            with self._cond:
+                # probe with the REAL sampling operands: a poisoning that
+                # only reproduces under the row's grammar mask or sampled
+                # lane must still be attributable
+                sargs = self._sampling_args_locked(solo_ctr)
             args = (self.params, jnp.asarray(solo_toks),
                     jnp.asarray(solo_pos), jnp.asarray(solo_adv),
-                    self.pool.device_block_table(), self.pool.slabs)
+                    self.pool.device_block_table(), self.pool.slabs) + sargs
             try:
                 self._run_dispatch(((kind, (req.submit_idx,)),), fn, args)
             except DispatchFailedError as e:
@@ -1994,13 +2272,24 @@ class LLMEngine:
         req.emitted.append(tok)
         req.last_tok = tok
         req.handle._append(tok)
+        if req.gid > 0:
+            self.metrics.on_sample_token("constrained")
+        elif req.sampling is not None and req.sampling.do_sample:
+            self.metrics.on_sample_token("sampled")
 
     def _finish_if_done(self, req: _GenRequest, now: float) -> bool:
-        """Retire a request whose last emitted token ended it (EOS or
-        max-tokens). Frees its slot when it held one."""
+        """Retire a request whose last emitted token ended it (EOS,
+        max-tokens, or — for a grammar-constrained request — a terminal
+        DFA state: accepting with no legal continuation, where the only
+        in-grammar move left is stopping). Frees its slot when it held
+        one."""
         done = (len(req.emitted) >= req.max_new_tokens
                 or (req.eos_token_id is not None
-                    and req.emitted[-1] == req.eos_token_id))
+                    and req.emitted[-1] == req.eos_token_id)
+                or (req.gid > 0 and req.slot is not None
+                    and self.sampling_table.is_terminal(
+                        req.gid,
+                        int(self.sampling_table.dfa_state[req.slot]))))
         if not done:
             return False
         # finalize the timeline BEFORE resolving the future: a waiter that

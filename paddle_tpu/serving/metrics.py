@@ -277,7 +277,9 @@ class LLMMetrics(ServingMetrics):
                               "prefix_lookup_tokens": 0,
                               "spec_windows": 0, "spec_drafted": 0,
                               "spec_accepted": 0,
-                              "spec_draft_quarantines": 0})
+                              "spec_draft_quarantines": 0,
+                              "sampled_tokens": 0,
+                              "constrained_tokens": 0})
         self.slots_active = 0
         self.slots_total = 0
         # per-SLO-class accounting (ISSUE 6 overload control): aggregate
@@ -310,6 +312,13 @@ class LLMMetrics(ServingMetrics):
         self._occ_wall = 0.0        # observed seconds
         self._occ_last_t: Optional[float] = None
         self._occ_prev = 0.0        # occupancy held since the last observe
+        # per-slot sampling modes (ISSUE 18): slot occupancy broken down
+        # by decode mode, plus the host-side cost of assembling the
+        # per-step sampling operands (params, RNG lanes, grammar masks)
+        self.sample_slots: Dict[str, int] = {
+            "greedy": 0, "sampled": 0, "constrained": 0}
+        self._mask_overhead_ms: deque = deque(maxlen=self.window)
+        self.grammars_compiled = 0
 
     def _class(self, slo) -> Optional[Dict[str, int]]:
         return self.class_counters.get(slo) if slo else None
@@ -460,6 +469,38 @@ class LLMMetrics(ServingMetrics):
             self.counters["spec_drafted"] += int(drafted)
             self.counters["spec_accepted"] += int(accepted)
 
+    def on_sample_token(self, mode: str):
+        """One emitted token from a non-greedy slot (ISSUE 18): `mode` is
+        "sampled" (temperature/top-k/top-p RNG lane) or "constrained"
+        (grammar-masked lane). Greedy emissions stay in `tokens_out`
+        alone, so the two counters partition the non-greedy traffic."""
+        with self._lock:
+            self.counters[f"{mode}_tokens"] += 1
+
+    def set_sample_slots(self, counts: Dict[str, int]):
+        """Refresh the per-mode slot occupancy gauge from the engine's
+        sampling table (greedy / sampled / constrained active slots)."""
+        with self._lock:
+            self.sample_slots = {
+                m: int(counts.get(m, 0))
+                for m in ("greedy", "sampled", "constrained")}
+
+    def on_mask_overhead(self, ms: float):
+        """Host-side sampling-operand assembly time for one unified step
+        (params + RNG-lane counters + DFA states + grammar bank): the
+        per-step overhead the bench's mask-overhead ceiling row bounds."""
+        with self._lock:
+            self._mask_overhead_ms.append(float(ms))
+
+    def set_grammars(self, compiled: int):
+        with self._lock:
+            self.grammars_compiled = int(compiled)
+
+    def mask_overhead_quantile_ms(self, q: float) -> Optional[float]:
+        with self._lock:
+            vals = sorted(self._mask_overhead_ms)
+        return _quantile(vals, q)
+
     def on_draft_quarantine(self):
         """A request's draft was quarantined (spec_off) after a poisoned
         draft dispatch; its target stream continues as plain decode."""
@@ -540,6 +581,10 @@ class LLMMetrics(ServingMetrics):
         s["tokens_per_s"] = self.tokens_per_s()
         s["spec_accept_rate"] = (s["spec_accepted"] / s["spec_drafted"]
                                  if s["spec_drafted"] else None)
+        with self._lock:
+            s["sample_slots"] = dict(self.sample_slots)
+            s["grammars_compiled"] = self.grammars_compiled
+        s["mask_overhead_p99_ms"] = self.mask_overhead_quantile_ms(0.99)
         s["shed_rate"] = (s["shed"] / s["submitted"] if s["submitted"]
                           else 0.0)
         for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
@@ -589,6 +634,20 @@ class LLMMetrics(ServingMetrics):
         b.family(f"{px}_spec_draft_quarantines_total", "counter")
         b.sample(f"{px}_spec_draft_quarantines_total",
                  s["spec_draft_quarantines"])
+        # ---- sampling + constrained decoding families (ISSUE 18) ----
+        b.family(f"{px}_sample_slots", "gauge")
+        for mode in ("greedy", "sampled", "constrained"):
+            b.sample(f"{px}_sample_slots", s["sample_slots"].get(mode, 0),
+                     {"mode": mode})
+        b.family(f"{px}_sample_tokens_total", "counter")
+        for mode in ("sampled", "constrained"):
+            b.sample(f"{px}_sample_tokens_total", s[f"{mode}_tokens"],
+                     {"mode": mode})
+        b.family(f"{px}_sample_mask_overhead_ms", "summary")
+        b.sample(f"{px}_sample_mask_overhead_ms", s["mask_overhead_p99_ms"],
+                 {"quantile": "0.99"}, round_to=3)
+        b.family(f"{px}_sample_grammars_compiled", "gauge")
+        b.sample(f"{px}_sample_grammars_compiled", s["grammars_compiled"])
         # ---- overload control + supervision families (ISSUE 6) ----
         b.family(f"{px}_class_requests_total", "counter")
         for c in SLO_CLASSES:

@@ -58,6 +58,7 @@ from ..obs.trace import ingest_traceparent, new_request_id
 from ..utils.fault_injection import FaultPlan, global_plan
 from .clock import Clock, SimClock
 from .engine import DeadlineExceededError, RejectedError
+from .llm.sampling import SamplingParams
 from .metrics import RouterMetrics, SLO_CLASSES
 
 _log = logging.getLogger("paddle_tpu.serving.router")
@@ -262,13 +263,17 @@ class RouterHandle:
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  eos_token_id: Optional[int], slo: str, tenant: str,
-                 rid: str, seq: int, deadline_abs: Optional[float]):
+                 rid: str, seq: int, deadline_abs: Optional[float],
+                 sampling: Optional[SamplingParams] = None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_token_id = eos_token_id
         self.slo = slo
         self.tenant = tenant
         self.rid = rid
+        self.sampling = sampling            # per-request seeded sampling
+        #                                     params (ISSUE 18); carried
+        #                                     across failovers unchanged
         self.future: Future = Future()
         self.ttft_ms: Optional[float] = None
         self.failovers = 0                  # replica deaths survived
@@ -324,7 +329,17 @@ class RouterHandle:
         stream killed mid-draft-window resumes from exactly the accepted
         stream here, and the survivor (spec-enabled or not) re-enters
         draft mode from a clean committed length. Greedy determinism then
-        keeps the resumed stream bit-identical to an uninterrupted one."""
+        keeps the resumed stream bit-identical to an uninterrupted one.
+
+        Seeded sampling (ISSUE 18): determinism across failover now also
+        requires restoring the RNG-lane counter — `sample_offset` tells
+        the survivor that `_prefix.size` stream tokens were already
+        drawn, so its first emission uses stream index `_prefix.size`
+        of lane `(seed, ·)`, exactly the draw the dead replica would
+        have made next. The engine re-derives the grammar DFA state by
+        walking the resumed prompt's emitted tail host-side, so a
+        constrained stream resumes mid-object without ever re-emitting
+        or skipping a token."""
         prompt = (np.concatenate([self.prompt, self._prefix])
                   if self._prefix.size else self.prompt)
         deadline_ms = None
@@ -334,7 +349,9 @@ class RouterHandle:
                     max_new_tokens=self.max_new_tokens - self._prefix.size,
                     eos_token_id=self.eos_token_id,
                     deadline_ms=deadline_ms, slo=self.slo,
-                    tenant=self.tenant, rid=self.rid)
+                    tenant=self.tenant, rid=self.rid,
+                    sampling=self.sampling,
+                    sample_offset=int(self._prefix.size))
 
 
 class _ReplicaState:
@@ -418,12 +435,18 @@ class ReplicaRouter:
                deadline_ms: Optional[float] = None,
                slo: Optional[str] = None,
                tenant: Optional[str] = None,
-               rid: Optional[str] = None) -> RouterHandle:
+               rid: Optional[str] = None,
+               sampling: Optional[SamplingParams] = None) -> RouterHandle:
         """Admit one prompt to the fleet. Raises RejectedError with
         reason `fleet_unavailable` when every replica is quarantined,
         `shed` when the fleet is degraded past the shed fraction and the
         request is best_effort, or the chosen replica's own reject when
-        every healthy replica refuses admission."""
+        every healthy replica refuses admission. `sampling` (ISSUE 18)
+        rides the handle across failovers: re-placements resubmit the
+        same params plus the emitted-token count as `sample_offset`, so
+        a seeded stream stays bit-identical across replica deaths."""
+        if sampling is not None:
+            sampling.validate()
         ecfg = self.replicas[0].engine.config
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
@@ -470,7 +493,8 @@ class ReplicaRouter:
                     "down); best_effort shed at router", reason="shed",
                     retry_after_s=self.config.retry_after_s)
             handle = RouterHandle(prompt, mnt, eos, slo, tenant, rid,
-                                  self._seq, deadline_abs)
+                                  self._seq, deadline_abs,
+                                  sampling=sampling)
             self._seq += 1
             replica, last_exc = self._place_locked(handle, now)
             if replica is None:
@@ -493,13 +517,15 @@ class ReplicaRouter:
                  deadline_ms: Optional[float] = None,
                  timeout: Optional[float] = None,
                  slo: Optional[str] = None,
-                 tenant: Optional[str] = None) -> np.ndarray:
+                 tenant: Optional[str] = None,
+                 sampling: Optional[SamplingParams] = None) -> np.ndarray:
         """Synchronous convenience: submit + wait (live mode only —
         under SimClock nothing pumps while you block)."""
         return self.submit(prompt, max_new_tokens=max_new_tokens,
                            eos_token_id=eos_token_id,
                            deadline_ms=deadline_ms, slo=slo,
-                           tenant=tenant).result(timeout)
+                           tenant=tenant,
+                           sampling=sampling).result(timeout)
 
     # ---- routing policy ----
 
@@ -1026,6 +1052,11 @@ class RouterServer:
                             "malformed X-Tenant-Id (want 1-64 chars of "
                             "[A-Za-z0-9._-], starting alphanumeric), got "
                             f"{tenant!r}")
+                    # sampling fields (ISSUE 18): temperature / top_k /
+                    # top_p / seed / grammar; absent → greedy (None)
+                    sampling = SamplingParams.from_payload(payload)
+                    if sampling is not None:
+                        sampling.validate()
                 except (ValueError, KeyError, TypeError) as e:
                     self._reply_json(400, {"error": f"bad request: {e}"})
                     return
@@ -1037,7 +1068,8 @@ class RouterServer:
                         max_new_tokens=payload.get("max_new_tokens"),
                         eos_token_id=payload.get("eos_token_id"),
                         deadline_ms=payload.get("deadline_ms"),
-                        slo=slo, tenant=tenant, rid=rid)
+                        slo=slo, tenant=tenant, rid=rid,
+                        sampling=sampling)
                     toks = handle.result(timeout=outer.request_timeout_s)
                 except RejectedError as e:
                     reason = getattr(e, "reason", "rejected")

@@ -9,7 +9,10 @@ ThreadingHTTPServer + BaseHTTPRequestHandler, whose hardened
                     draining); 504 deadline expired before dispatch
     POST /generate  {"input_ids": [...], "max_new_tokens": 32,
                     "eos_token_id": 2, "deadline_ms": 500,
-                    "slo": "interactive"|"batch"|"best_effort"}
+                    "slo": "interactive"|"batch"|"best_effort",
+                    "temperature": 0.8, "top_k": 40, "top_p": 0.95,
+                    "seed": 1234, "grammar": {"schema": ...,
+                    "tokens": {...}}}   # sampling fields optional
                     -> 200 {"tokens": [...], "ttft_ms": ...} from the
                     continuous-batching LLMEngine (serving/llm/); same
                     503/504 admission-control mapping. An optional
@@ -81,6 +84,7 @@ from ..obs.flight_recorder import flight_recorder
 from ..obs.trace import ingest_traceparent, new_request_id
 from .engine import (BatchingEngine, DeadlineExceededError, EngineConfig,
                      RejectedError)
+from .llm.sampling import SamplingParams
 from .metrics import SLO_CLASSES
 
 # RejectedError reasons that mean "try again later" (HTTP 429 +
@@ -317,6 +321,11 @@ class ServingServer:
                             "malformed X-Tenant-Id (want 1-64 chars of "
                             "[A-Za-z0-9._-], starting alphanumeric), got "
                             f"{tenant!r}")
+                    # sampling fields (ISSUE 18): temperature / top_k /
+                    # top_p / seed / grammar; absent → greedy (None)
+                    sampling = SamplingParams.from_payload(payload)
+                    if sampling is not None:
+                        sampling.validate()
                 except (ValueError, KeyError, TypeError) as e:
                     self._reply_json(400, {"error": f"bad request: {e}"})
                     return
@@ -328,7 +337,8 @@ class ServingServer:
                         max_new_tokens=payload.get("max_new_tokens"),
                         eos_token_id=payload.get("eos_token_id"),
                         deadline_ms=payload.get("deadline_ms"),
-                        slo=slo, tenant=tenant, rid=rid, trace=traced)
+                        slo=slo, tenant=tenant, rid=rid, trace=traced,
+                        sampling=sampling)
                     toks = handle.result(timeout=outer.request_timeout_s)
                 except RejectedError as e:
                     self._reply_rejected(e)

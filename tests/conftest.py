@@ -104,6 +104,10 @@ def pytest_configure(config):
         "markers", "spec: speculative-decoding tests (draft propose + "
         "single-dispatch verify, greedy accept/rollback, bit-identity; "
         "ISSUE 17); select with -m spec")
+    config.addinivalue_line(
+        "markers", "sampling: per-slot seeded sampling + grammar-"
+        "constrained decoding tests (RNG lanes, token DFA masks, "
+        "failover counter restore; ISSUE 18); select with -m sampling")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -132,5 +136,9 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.serving)
         if mod == "test_spec_decode":
             item.add_marker(pytest.mark.spec)
+            item.add_marker(pytest.mark.llm)
+            item.add_marker(pytest.mark.serving)
+        if mod == "test_sampling":
+            item.add_marker(pytest.mark.sampling)
             item.add_marker(pytest.mark.llm)
             item.add_marker(pytest.mark.serving)

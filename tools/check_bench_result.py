@@ -149,7 +149,17 @@ GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
              # divergence or a rollback bug craters the accept rate long
              # before it shows up in tok/s)
              "llm_spec_tok_s": "higher",
-             "llm_spec_accept_rate": "higher"}
+             "llm_spec_accept_rate": "higher",
+             # ISSUE 18 sampling gates (`bench.py --llm` sampled phase):
+             # per-slot seeded sampling rides the SAME fixed-width
+             # unified step as greedy — only the select differs — so its
+             # closed-loop tok/s is a FLOOR pinned within ~10% of the
+             # greedy baseline (llm_sampled_base_tok_s rides along
+             # ungated), and the host-side sampling-operand/grammar-mask
+             # assembly cost, as a percent of pump wall time from the
+             # ledger's sample_mask phase, is a CEILING
+             "llm_sampled_tok_s": "higher",
+             "llm_mask_overhead_pct": "lower"}
 
 
 def _metrics_of(row):
@@ -171,7 +181,8 @@ def _metrics_of(row):
               "train_numerics_overhead_pct",
               "fleet_qps_scaling", "fleet_failover_resume_ms",
               "deploy_ttft_p99_ms", "deploy_dropped_streams",
-              "llm_spec_tok_s", "llm_spec_accept_rate"):
+              "llm_spec_tok_s", "llm_spec_accept_rate",
+              "llm_sampled_tok_s", "llm_mask_overhead_pct"):
         if extra.get(k) is not None:
             out[k] = float(extra[k])
     return out

@@ -93,8 +93,13 @@ request keeps speculating, and the target breaker is never charged
 failures walk the `draft_failure` failstreak to `draft_disabled` at
 breaker_threshold with the engine still serving; and a spec-armed
 replica crashed MID-draft-window resumes every victim from VERIFIED
-tokens only, bit-identical on the survivor) — then prints a pass/fail
-table. Exit 0 iff every scenario recovered.
+tokens only, bit-identical on the survivor), and the ISSUE 18 seeded
+sampling scenario in tests/test_sampling.py (`fault_matrix`-marked: a
+replica hard-crashed MID-SAMPLED-STREAM fails over and the survivor's
+re-prefill restores the RNG-lane counter — `sample_offset` — so the
+resumed seeded stream is token-identical to the uninterrupted seeded
+run, the determinism contract extended past greedy) — then prints a
+pass/fail table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
     python tools/check_fault_matrix.py --list     # show scenarios only
@@ -127,6 +132,7 @@ TEST_FILES = [
     os.path.join("tests", "test_async_checkpoint.py"),
     os.path.join("tests", "test_deploy.py"),
     os.path.join("tests", "test_spec_decode.py"),
+    os.path.join("tests", "test_sampling.py"),
 ]
 
 
