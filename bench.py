@@ -990,6 +990,83 @@ def run_llm_bench():
             "llm_constrained_valid": bool(con_valid),
             "sampled_requests": n_samp,
         })
+    # ---- tiered KV + disaggregation phase (ISSUE 19): two sub-phases.
+    # (a) Spill/onboard: a deliberately tiny device pool (2 slots) replays
+    # a prompt set whose cached working set exceeds it, so pressure
+    # eviction spills full-block KV pages into the host-RAM tier; the
+    # SAME trace replayed warm then onboards those pages back instead of
+    # re-prefilling. llm_tiered_hit_rate is the fraction of the warm
+    # pass's onboardable full-block prompt tokens actually served from
+    # the host tier (a FLOOR: device-cache hits don't count, so a
+    # regression that stops spilling or stops onboarding drops it), and
+    # llm_onboard_tok_s is the host→HBM onboard rate over the warm pass
+    # (FLOOR). (b) Disaggregation: a prefill-role + decode-role fleet on
+    # the wall clock runs a few streams end to end; llm_handoff_ms is the
+    # p99 export→re-place latency from the router's handoff summary
+    # (CEILING — the whole point of staging KV is that the stream never
+    # waits on a re-prefill).
+    if os.environ.get("BENCH_LLM_TIERED", "1") != "0":
+        n_tier = int(os.environ.get("BENCH_LLM_TIERED_PROMPTS", "6"))
+        tier_new = int(os.environ.get("BENCH_LLM_TIERED_MAX_NEW", "4"))
+        t_eng = LLMEngine(model, LLMEngineConfig(
+            num_slots=2, block_len=8, n_blocks=4,
+            host_kv_bytes=int(os.environ.get(
+                "BENCH_LLM_HOST_KV_BYTES", str(64 << 20))),
+            max_queue_depth=64, economics=True))
+        t_eng.start()
+        t_eng.generate([1, 2, 3], max_new_tokens=2, timeout=300)  # compile
+        t_rng = np.random.RandomState(19)
+        # 17 tokens = 2 full blocks + tail; 6 prompts vs 2 cacheable rows
+        t_prompts = [t_rng.randint(1, vocab, size=(17,)).astype(np.int32)
+                     for _ in range(n_tier)]
+        for p in t_prompts:           # cold pass: fill, then spill
+            t_eng.generate(p, max_new_tokens=tier_new, timeout=300)
+        onboard0 = t_eng.host_onboard_tokens
+        t0 = time.perf_counter()
+        for p in t_prompts:           # warm pass: onboard from host
+            t_eng.generate(p, max_new_tokens=tier_new, timeout=300)
+        warm_dt = time.perf_counter() - t0
+        onboard_tok = t_eng.host_onboard_tokens - onboard0
+        # tokens the onboard walk could have served: full blocks below
+        # the one-token-always-prefills cap (17 tokens -> 16)
+        bl = t_eng.config.block_len
+        onboardable = sum(((p.size - 1) // bl) * bl for p in t_prompts)
+        host_snap = t_eng.host_kv.snapshot()
+        t_eng.stop(drain=True)
+
+        from paddle_tpu.serving import InProcessReplica, ReplicaRouter
+        mk_eng = lambda: LLMEngine(model, LLMEngineConfig(
+            num_slots=4, block_len=8, n_blocks=4, max_queue_depth=64))
+        reps = [InProcessReplica(mk_eng(), 0, role="prefill"),
+                InProcessReplica(mk_eng(), 1, role="decode")]
+        router = ReplicaRouter(reps)
+        n_hand = int(os.environ.get("BENCH_LLM_HANDOFF_STREAMS", "3"))
+        hs = [router.submit(
+                  t_rng.randint(1, vocab, size=(9,)).astype(np.int32),
+                  max_new_tokens=8)
+              for _ in range(n_hand)]
+        steps = 0
+        while router.has_work():
+            router.pump()
+            steps += 1
+            assert steps < 200000, "disagg fleet failed to drain"
+        for h in hs:
+            h.result(timeout=0)
+        rsnap = router.metrics.snapshot()
+        handoff_ms = router.metrics.handoff_quantile_ms(0.99)
+        result["extra"].update({
+            "llm_tiered_hit_rate": (round(onboard_tok / onboardable, 4)
+                                    if onboardable else 0.0),
+            "llm_onboard_tok_s": round(
+                onboard_tok / warm_dt if warm_dt > 0 else 0.0, 1),
+            "llm_handoff_ms": (round(handoff_ms, 3)
+                               if handoff_ms is not None else None),
+            "llm_host_spills": host_snap["spills"],
+            "llm_host_pages": host_snap["pages"],
+            "llm_handoffs": rsnap["handoffs"],
+            "llm_handoffs_failed": rsnap["handoffs_failed"],
+            "tiered_prompts": n_tier,
+        })
     print(json.dumps(result))
 
 

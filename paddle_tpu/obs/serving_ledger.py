@@ -69,9 +69,14 @@ _log = logging.getLogger("paddle_tpu.serving.economics")
 # attribution order is the chrome-trace lane order; "sample_mask"
 # (ISSUE 18) is the host-side sampling-operand assembly — per-slot
 # params, RNG-lane counters, DFA states, grammar bank — booked out of
-# the enclosing host span so constrained-decoding overhead is visible
+# the enclosing host span so constrained-decoding overhead is visible.
+# "kv_spill"/"kv_onboard" (ISSUE 19) are the tiered-cache host phases:
+# d2h serialization of pressure-evicted pages into the host pool, and
+# h2d upload of spilled/handed-off pages at admission — booked out of
+# the host span so cache-tiering cost is attributable, not smeared.
 SERVING_LEDGER_PHASES = ("prefill_compute", "decode_compute",
-                         "draft_compute", "sample_mask", "host", "idle")
+                         "draft_compute", "sample_mask",
+                         "kv_spill", "kv_onboard", "host", "idle")
 
 
 class ServingLedger(PhaseLedger):

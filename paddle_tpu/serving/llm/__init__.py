@@ -18,6 +18,7 @@ Deterministic scheduler testing (no threads, no sleeps):
 See docs/serving.md (LLM decode engine section) for slot-pool sizing and
 block_len tradeoffs.
 """
+from .host_kv import HostKVPool  # noqa: F401
 from .kv_pool import SlotPagedKVPool, SlotsExhaustedError  # noqa: F401
 from .llm_engine import (DispatchFailedError,  # noqa: F401
                          DispatchHungError, GenerationHandle, LLMEngine,

@@ -1,0 +1,162 @@
+"""Host-RAM KV spill tier (ISSUE 19).
+
+One chip's HBM caps the radix prefix cache's hit rate: under slot
+pressure `PrefixCache.evict_for_pressure` releases exactly the pages the
+next burst of traffic wants back, and every release used to turn a
+would-be cache hit into a full re-prefill. `HostKVPool` is the tier
+below the device pool: a bounded, byte-budgeted LRU of **owned host
+numpy copies** of evicted full-block KV pages, namespaced per tenant.
+
+Keying. A page's KV depends on every token before it, so a block is
+addressed by its FULL token path from the prefix start:
+``(tenant, (t0, t1, ..., t_{(j+1)*block_len - 1}))``. That makes the
+host tier content-addressed the same way the device radix trie is —
+two tenants with identical token streams never share an entry (same
+isolation contract as the per-tenant radix roots), and a block is only
+onboardable when *all* of its predecessors are also covered (the engine
+walks block by block from the device-cached boundary).
+
+Only FULL blocks spill. COW tails are partial blocks under a node that
+may itself be evicted; re-onboarding a tail without its parent would
+leave a hole, and a tail is at most ``block_len - 1`` tokens of
+re-prefill — not worth the bookkeeping. Tails are simply dropped on
+eviction, as before.
+
+Values are plain per-layer ``(k, v)`` numpy pairs shaped
+``[kv_heads, block_len, head_dim]`` — the exact payload
+`SlotPagedKVPool.export_page` produces and the engine's onboard path
+writes back with a `dynamic_update_slice`, so the round trip is bitwise
+(pinned by tests/test_tiered.py).
+
+Thread safety: one lock around the OrderedDict; callers (the engine
+pump and `evict_for_pressure`, both under the engine lock today) stay
+correct if that ever changes.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+Layers = List[Tuple[np.ndarray, np.ndarray]]
+_Key = Tuple[str, Tuple[int, ...]]
+
+
+class HostKVPool:
+    """Bounded LRU of spilled KV pages, keyed ``(tenant, token_path)``."""
+
+    def __init__(self, byte_budget: int, block_len: int):
+        if byte_budget <= 0:
+            raise ValueError(f"byte_budget must be > 0, got {byte_budget}")
+        if block_len <= 0:
+            raise ValueError(f"block_len must be > 0, got {block_len}")
+        self.byte_budget = int(byte_budget)
+        self.block_len = int(block_len)
+        self._lock = threading.Lock()
+        self._pages: "OrderedDict[_Key, Layers]" = OrderedDict()
+        self._sizes: Dict[_Key, int] = {}
+        self.bytes_used = 0
+        self.stats: Dict[str, int] = {
+            "spills": 0,        # pages accepted by put()
+            "onboards": 0,      # pages served by get()
+            "hits": 0,          # get() found the key
+            "misses": 0,        # get() did not
+            "evictions": 0,     # pages LRU-evicted to stay under budget
+            "rejected": 0,      # pages refused (single page over budget)
+        }
+
+    @staticmethod
+    def _key(tenant: str, path) -> _Key:
+        return (str(tenant), tuple(int(t) for t in path))
+
+    # ---- spill side ----
+    def put(self, tenant: str, path, layers: Layers) -> bool:
+        """Admit one evicted full-block page. `path` is the block's full
+        token path (length must be a block_len multiple). Returns False
+        when the page alone exceeds the byte budget (refused, counted)."""
+        key = self._key(tenant, path)
+        if len(key[1]) == 0 or len(key[1]) % self.block_len != 0:
+            raise ValueError(
+                f"path length {len(key[1])} is not a positive multiple of "
+                f"block_len={self.block_len}")
+        # np.array(copy=True): ascontiguousarray would alias an already-
+        # contiguous input, and an aliased page silently mutates when the
+        # caller reuses its buffer — the host tier must own its bytes
+        owned = [(np.array(k, copy=True, order="C"),
+                  np.array(v, copy=True, order="C"))
+                 for k, v in layers]
+        size = sum(k.nbytes + v.nbytes for k, v in owned)
+        with self._lock:
+            if size > self.byte_budget:
+                self.stats["rejected"] += 1
+                return False
+            if key in self._pages:        # refresh in place
+                self.bytes_used -= self._sizes[key]
+                self._pages.pop(key)
+            while self._pages and self.bytes_used + size > self.byte_budget:
+                old_key, _ = self._pages.popitem(last=False)
+                self.bytes_used -= self._sizes.pop(old_key)
+                self.stats["evictions"] += 1
+            self._pages[key] = owned
+            self._sizes[key] = size
+            self.bytes_used += size
+            self.stats["spills"] += 1
+            return True
+
+    # ---- onboard side ----
+    def get(self, tenant: str, path) -> Optional[Layers]:
+        """Fetch one page for re-onboarding; bumps LRU recency. Returns
+        None on miss. The stored arrays are returned directly (read-only
+        by convention — the onboard path only uploads them)."""
+        key = self._key(tenant, path)
+        with self._lock:
+            layers = self._pages.get(key)
+            if layers is None:
+                self.stats["misses"] += 1
+                return None
+            self._pages.move_to_end(key)
+            self.stats["hits"] += 1
+            self.stats["onboards"] += 1
+            return layers
+
+    def probe(self, tenant: str, tokens) -> int:
+        """Read-only: longest prefix of `tokens` (in whole blocks, in
+        tokens) fully covered by spilled pages. No LRU bump, no stats —
+        safe for router placement scoring (mirrors PrefixCache.probe)."""
+        toks = [int(t) for t in tokens]
+        bl = self.block_len
+        covered = 0
+        with self._lock:
+            j = 0
+            while (j + 1) * bl <= len(toks):
+                key = (str(tenant), tuple(toks[:(j + 1) * bl]))
+                if key not in self._pages:
+                    break
+                covered = (j + 1) * bl
+                j += 1
+        return covered
+
+    # ---- maintenance / views ----
+    def clear(self):
+        """Drop everything — called on weight swap: spilled KV is a pure
+        function of (weights, tokens), so stale-version pages are poison."""
+        with self._lock:
+            self._pages.clear()
+            self._sizes.clear()
+            self.bytes_used = 0
+
+    @property
+    def pages(self) -> int:
+        with self._lock:
+            return len(self._pages)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "pages": len(self._pages),
+                "bytes": self.bytes_used,
+                "byte_budget": self.byte_budget,
+                **dict(self.stats),
+            }

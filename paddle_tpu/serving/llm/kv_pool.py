@@ -542,22 +542,81 @@ class SlotPagedKVPool:
             pages = list(self.block_table.get(slot, []))
             layers = []
             for k, v in self.slabs:
-                kn, vn = np.asarray(k), np.asarray(v)
+                # ISSUE 19: length-trimmed fetch — slice each occupied
+                # page's columns on DEVICE and fetch only those, instead
+                # of materializing the whole [num_slots, Hkv, slab_len, D]
+                # slab on the host per layer. Spill/handoff copies scale
+                # with the row's committed length, not the pool size; the
+                # payload is bit-identical to the untrimmed path (pinned
+                # in tests/test_router.py).
                 kparts, vparts = [], []
                 for j, p in enumerate(pages):
                     prow = p // self.n_blocks
                     c0 = (p % self.n_blocks) * self.block_len
                     w = min(self.block_len, length - j * self.block_len)
-                    kparts.append(kn[prow, :, c0:c0 + w, :])
-                    vparts.append(vn[prow, :, c0:c0 + w, :])
+                    kparts.append(np.asarray(k[prow, :, c0:c0 + w, :]))
+                    vparts.append(np.asarray(v[prow, :, c0:c0 + w, :]))
                 if kparts:
                     layers.append((np.concatenate(kparts, axis=1),
                                    np.concatenate(vparts, axis=1)))
                 else:
-                    layers.append((kn[slot, :, :0, :], vn[slot, :, :0, :]))
+                    hkv, d = k.shape[1], k.shape[3]
+                    empty = np.zeros((hkv, 0, d), dtype=k.dtype)
+                    layers.append((empty, empty.copy()))
             rows[slot] = {"length": length, "layers": layers}
         return {"block_len": self.block_len, "capacity": self.capacity,
                 "rows": rows}
+
+    def export_page(self, page: int,
+                    width: Optional[int] = None) -> List[Tuple[np.ndarray,
+                                                               np.ndarray]]:
+        """Fetch ONE page's occupied KV columns to host numpy: per layer
+        an owned ([Hkv, width, D] K, same-shape V) pair, sliced on device
+        so the transfer is exactly `width` tokens. This is the spill unit
+        the host tier (HostKVPool, ISSUE 19) stores; `width` defaults to
+        the full block."""
+        if not (0 <= page < self.num_slots * self.n_blocks):
+            raise ValueError(f"page {page} out of range")
+        w = self.block_len if width is None else int(width)
+        if not (0 < w <= self.block_len):
+            raise ValueError(
+                f"width must be in 1..{self.block_len}, got {w}")
+        prow = page // self.n_blocks
+        c0 = (page % self.n_blocks) * self.block_len
+        return [(np.asarray(k[prow, :, c0:c0 + w, :]),
+                 np.asarray(v[prow, :, c0:c0 + w, :]))
+                for k, v in self.slabs]
+
+    def import_page(self, slot: int, block_idx: int,
+                    layers: List[Tuple[np.ndarray, np.ndarray]]):
+        """Land one spilled page's KV into `slot`'s OWN identity page at
+        logical block `block_idx` (the write-path invariant: a slot's
+        block j is physically at column j of its own row, so the identity
+        block table already covers it). Inverse of `export_page`, bitwise.
+        Ledger accounting rides the normal path: the engine's next
+        `set_length` past this block claims the own page."""
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        if not (0 <= block_idx < self.n_blocks):
+            raise ValueError(f"block_idx {block_idx} out of range "
+                             f"0..{self.n_blocks - 1}")
+        if len(layers) != len(self.slabs):
+            raise ValueError(
+                f"payload has {len(layers)} layers, pool has "
+                f"{len(self.slabs)}")
+        c0 = block_idx * self.block_len
+        new_slabs = []
+        for (k, v), (ke, ve) in zip(self.slabs, layers):
+            if ke.shape[1] > self.block_len:
+                raise ValueError(
+                    f"page payload holds {ke.shape[1]} tokens, block_len "
+                    f"is {self.block_len}")
+            ku = jnp.asarray(ke, dtype=k.dtype)[None]
+            vu = jnp.asarray(ve, dtype=v.dtype)[None]
+            k = jax.lax.dynamic_update_slice(k, ku, (slot, 0, c0, 0))
+            v = jax.lax.dynamic_update_slice(v, vu, (slot, 0, c0, 0))
+            new_slabs.append((k, v))
+        self.slabs = new_slabs
 
     def import_rows(self, exported: dict) -> Dict[int, int]:
         """Materialize `export_rows` payload rows into THIS pool: each

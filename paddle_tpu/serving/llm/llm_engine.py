@@ -131,6 +131,7 @@ from ..engine import DeadlineExceededError, RejectedError
 from ..metrics import LLMMetrics, SLO_CLASSES
 from ..supervisor import (DispatchFailedError, DispatchHungError,  # noqa: F401
                           EngineSupervisor)
+from .host_kv import HostKVPool
 from .kv_pool import SlotPagedKVPool, SlotsExhaustedError
 from .prefix_cache import PrefixCache
 from .sampling import (GREEDY, SamplingParams, SlotSamplingTable,
@@ -230,6 +231,13 @@ class LLMEngineConfig:
     #                                instead of recompiling the step
     max_dfa_states: int = 128      # per-grammar token-DFA state ceiling
     #                                (same fixed-shape reasoning)
+    # ---- tiered KV cache (ISSUE 19) ----
+    host_kv_bytes: int = 0         # host-RAM spill tier byte budget: > 0
+    #                                arms a bounded LRU HostKVPool that
+    #                                captures refcount-0 prefix pages on
+    #                                pressure eviction and re-onboards them
+    #                                at admission instead of re-prefilling;
+    #                                0 = device-only caching (prior behavior)
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -275,6 +283,9 @@ class LLMEngineConfig:
         if self.max_dfa_states < 1:
             raise ValueError(
                 f"max_dfa_states must be >= 1, got {self.max_dfa_states}")
+        if self.host_kv_bytes < 0:
+            raise ValueError(
+                f"host_kv_bytes must be >= 0, got {self.host_kv_bytes}")
         if not 0.0 < self.slo_burn_budget <= 1.0:
             raise ValueError(
                 f"slo_burn_budget must be in (0, 1], got "
@@ -324,14 +335,24 @@ class GenerationHandle:
         self.trace: Optional[RequestTrace] = None   # when tracing opted in
         self._lock = threading.Lock()
         self._tokens: List[int] = []
+        self._logprobs: List[Optional[float]] = []
 
-    def _append(self, tok: int):
+    def _append(self, tok: int, lp: Optional[float] = None):
         with self._lock:
             self._tokens.append(int(tok))
+            self._logprobs.append(None if lp is None else float(lp))
 
     def tokens_so_far(self) -> List[int]:
         with self._lock:
             return list(self._tokens)
+
+    def logprobs_so_far(self) -> List[Optional[float]]:
+        """Per-emitted-token log-probabilities (ISSUE 19): the model's raw
+        (pre-temperature) log-softmax at each selected token, streamed in
+        lockstep with `tokens_so_far()`. Entries are None when the request
+        did not opt in via submit(logprobs=True)."""
+        with self._lock:
+            return list(self._logprobs)
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         return self.future.result(timeout)
@@ -348,7 +369,8 @@ class _GenRequest:
                  "slo", "submit_idx", "cost", "chunk_off", "tenant",
                  "attached_pages", "rid", "trace", "draft_slot",
                  "spec_off", "draft_attached", "sampling",
-                 "sample_offset", "gid", "dfa_state0")
+                 "sample_offset", "gid", "dfa_state0",
+                 "want_logprobs", "kv_row")
 
     def __init__(self, prompt, max_new_tokens, eos_token_id, arrival,
                  deadline, slo, submit_idx, tenant="default"):
@@ -404,6 +426,15 @@ class _GenRequest:
         self.dfa_state0: int = 0              # DFA state at first emission
         #                                       (walked over the resumed
         #                                       prompt tail on failover)
+        # tiered KV + disaggregation (ISSUE 19)
+        self.want_logprobs: bool = False      # surface per-token logprobs
+        #                                       on the handle
+        self.kv_row: Optional[dict] = None    # pre-computed KV for the
+        #                                       prompt's first `length`
+        #                                       tokens (a prefill→decode
+        #                                       handoff import); admission
+        #                                       uploads it instead of
+        #                                       re-prefilling
 
 
 class LLMEngine:
@@ -458,10 +489,22 @@ class LLMEngine:
             model.init_cache, self.config.num_slots, self.config.block_len,
             self.config.n_blocks, dtype=self.config.cache_dtype,
             pad_tokens=self.config.prefill_chunk)
+        # host-RAM spill tier (ISSUE 19): a byte-budgeted LRU the prefix
+        # cache spills refcount-0 pages into on pressure eviction; the
+        # admission path re-onboards covered blocks instead of
+        # re-prefilling them
+        self.host_kv: Optional[HostKVPool] = (
+            HostKVPool(self.config.host_kv_bytes, self.config.block_len)
+            if self.config.host_kv_bytes > 0 else None)
+        self._spill_booked = 0.0     # spill_seconds already booked to the
+        #                              ledger's kv_spill phase (delta
+        #                              accounting per pump)
         # radix prefix cache (ISSUE 8): wires itself as the pool's
         # on_pressure hook so pinned rows free up under allocation pressure
         self.prefix_cache: Optional[PrefixCache] = (
-            PrefixCache(self.pool) if self.config.enable_prefix_cache
+            PrefixCache(self.pool, host_pool=self.host_kv,
+                        clock=self.clock.now)
+            if self.config.enable_prefix_cache
             else None)
         # ---- speculative decoding (ISSUE 17) ----
         # a draft model arms spec mode: per decode pump a SINGLE draft
@@ -489,6 +532,12 @@ class LLMEngine:
         self.spec_windows = 0           # lifetime verify windows committed
         self.spec_drafted = 0           # lifetime draft tokens verified
         self.spec_accepted = 0          # lifetime draft tokens accepted
+        # tiered KV + disaggregation (ISSUE 19): lifetime counters the
+        # bench's tiered phase and the tests read directly
+        self.host_onboard_tokens = 0    # prompt tokens onboarded from the
+        #                                 host spill tier (skipped prefill)
+        self.kv_import_tokens = 0       # prompt tokens imported via a
+        #                                 prefill→decode handoff kv_row
         if draft_model is not None:
             if self.config.spec_k + 1 > self.config.prefill_chunk:
                 raise ValueError(
@@ -628,7 +677,18 @@ class LLMEngine:
                 sel, new_state = select_tokens(
                     logits, adv, temp, topk, topp, samp, seed, ctr,
                     dstate, gid, bank)
-                return sel, new_state, new_slabs
+                # per-token logprobs (ISSUE 19): the RAW model
+                # distribution's log-softmax at each selected token —
+                # pre-temperature/top-k/top-p, so it is a property of the
+                # stream, not of the sampling filters. Computed
+                # unconditionally (selection above is untouched, so token
+                # streams stay bit-identical whether or not a request
+                # reads them); float32 keeps the reduction stable under
+                # low-precision cache dtypes.
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
+                    sel[..., None].astype(jnp.int32), axis=-1)[..., 0]
+                return sel, lp, new_state, new_slabs
 
             self._step_jit = jax.jit(step)
         return self._step_jit
@@ -969,6 +1029,80 @@ class LLMEngine:
                 }
         return out
 
+    def export_stream(self, rid: str) -> dict:
+        """Export ONE active stream for a prefill→decode handoff (ISSUE
+        19) and release its row — atomically, under a single lock
+        acquisition, so no decode step can advance the stream between the
+        snapshot and the release (the payload's emitted/KV/lane views are
+        mutually consistent by construction).
+
+        Requires the stream to have completed prefill (it has emitted at
+        least one token): at that point the row's KV covers exactly
+        ``len(prompt) + len(emitted) - 1`` tokens — the last emitted
+        token's KV is written by the step that consumes it — so a peer
+        that resubmits ``prompt + emitted`` with this payload's `kv_row`
+        pays a ONE-token prefill and continues bit-identically
+        (chunk-invariance + the bitwise export/import round trip).
+
+        The engine-side handle is detached: its future is left unresolved
+        (the receiving replica's handle carries the stream forward — the
+        same convention as failover-abandoned handles) and the row is
+        freed for new work. Raises ValueError when the rid is not active
+        or still mid-prefill."""
+        with self._cond:
+            found = None
+            for slot, req in self._active.items():
+                if req.rid == rid:
+                    found = (slot, req)
+                    break
+            if found is None:
+                raise ValueError(f"no active stream with rid {rid!r}")
+            slot, req = found
+            if req.chunk_off < len(req.prompt) or not req.emitted:
+                raise ValueError(
+                    f"stream {rid!r} has not completed prefill: a handoff "
+                    "exports post-prefill KV only")
+            row = self.pool.export_rows([slot])["rows"][slot]
+            # inline the lane dict (export_sampling_lanes takes _cond,
+            # which is non-reentrant)
+            sp = req.sampling or GREEDY
+            lane = {
+                "seed": None if sp.seed is None else int(sp.seed),
+                "next_index": req.sample_offset + len(req.emitted),
+                "temperature": float(sp.temperature),
+                "top_k": int(sp.top_k),
+                "top_p": float(sp.top_p),
+                "grammar_key": (sp.grammar_key()
+                                if sp.constrained else None),
+                "dfa_state": int(self.sampling_table.dfa_state[slot]),
+            }
+            payload = {
+                "rid": rid,
+                "tenant": req.tenant,
+                "prompt": np.asarray(req.prompt, np.int32).copy(),
+                "emitted": list(req.emitted),
+                "logprobs": (req.handle.logprobs_so_far()
+                             if req.want_logprobs else None),
+                "kv_row": {
+                    "block_len": self.pool.block_len,
+                    "length": int(row["length"]),
+                    "layers": row["layers"],
+                },
+                "lane": lane,
+                "weight_version": self.weight_version,
+            }
+            self._conclude(req, "handoff")
+            self._free_row_locked(req, slot)
+            del self._active[slot]
+            self.metrics.set_slots(self.pool.active_slots(),
+                                   self.pool.num_slots)
+            self._cond.notify_all()
+        flight_recorder().record(
+            "kv_export", engine="llm", rid=rid,
+            tokens=int(payload["kv_row"]["length"]),
+            emitted=len(payload["emitted"]))
+        return payload
+
     def replace_params(self, new_params, version: str):
         """Hot in-place weight swap between pump iterations — NO
         recompile. The unified step executable keys on its arguments'
@@ -1170,7 +1304,10 @@ class LLMEngine:
                rid: Optional[str] = None,
                trace: bool = False,
                sampling: Optional[SamplingParams] = None,
-               sample_offset: int = 0) -> GenerationHandle:
+               sample_offset: int = 0,
+               logprobs: bool = False,
+               kv_row: Optional[dict] = None,
+               lane: Optional[dict] = None) -> GenerationHandle:
         """Admit one prompt (1-D int token ids). `slo` names the request's
         SLO class (config.default_slo when None); `tenant` its isolation
         domain (config.default_tenant when None) — tenants get fair
@@ -1188,6 +1325,16 @@ class LLMEngine:
         the logical stream stays keyed by `(seed, i)` across the
         failover. For a constrained request the same tail is walked
         through the grammar DFA host-side to restore the mask state.
+
+        ISSUE 19: `logprobs=True` streams each emitted token's raw
+        log-probability onto the handle (`logprobs_so_far()`). `kv_row`
+        imports pre-computed KV for the prompt's first `kv_row["length"]`
+        tokens at admission (a prefill→decode handoff: the exporting
+        replica's `export_stream` payload), skipping their re-prefill.
+        `lane` is the exported sampling-lane dict riding the same
+        payload; when it matches this admission's `sample_offset`, a
+        constrained request restores its DFA state directly from the
+        lane instead of re-walking the resumed tail.
 
         Raises RejectedError when the sequence can never fit a slot, the
         queue/token budget/tenant quota is exhausted and nothing
@@ -1240,7 +1387,14 @@ class LLMEngine:
                                          self.clock.now() - tg0)
                     self.metrics.set_grammars(
                         self.sampling_table.grammars_compiled)
-                if sample_offset:
+                if sample_offset and lane is not None \
+                        and lane.get("grammar_key") == gkey \
+                        and int(lane.get("next_index", -1)) == sample_offset:
+                    # prefill→decode handoff (ISSUE 19): the exported lane
+                    # carries the DFA state at exactly this admission's
+                    # resume index — restore it directly, no re-walk
+                    dstate0 = int(lane["dfa_state"])
+                elif sample_offset:
                     # failover re-prefill: the prompt's tail IS the
                     # emitted-so-far constrained stream — walk it through
                     # the DFA so the mask resumes mid-grammar exactly
@@ -1254,6 +1408,18 @@ class LLMEngine:
                                 f"request grammar at token {int(t)}")
                         q = nq
                     dstate0 = q
+        if kv_row is not None:
+            if int(kv_row.get("block_len", -1)) != self.pool.block_len:
+                raise ValueError(
+                    f"kv_row block_len {kv_row.get('block_len')!r} does "
+                    f"not match the pool's ({self.pool.block_len}): KV "
+                    "pages are not portable across block geometries")
+            klen = int(kv_row["length"])
+            if not 0 < klen <= prompt.size - 1:
+                raise ValueError(
+                    f"kv_row length {klen} must cover 1..{prompt.size - 1} "
+                    "prompt tokens (at least one token always prefills — "
+                    "that step emits the first token's logits)")
         if prompt.size + mnt > self.pool.capacity:
             self.metrics.on_reject("prompt_too_long")
             self._record_reject("prompt_too_long", rid=rid, tenant=tenant)
@@ -1313,6 +1479,8 @@ class LLMEngine:
             req.sample_offset = sample_offset
             req.gid = gid
             req.dfa_state0 = dstate0
+            req.want_logprobs = bool(logprobs)
+            req.kv_row = kv_row
             if trace:
                 req.trace = RequestTrace(rid, now, slo=slo, tenant=tenant)
                 req.trace.event("submitted", now, prompt_len=int(prompt.size),
@@ -1347,12 +1515,19 @@ class LLMEngine:
         router calls this on every candidate per admission to steer a
         request to the replica already holding its prefix KV, and a
         probe on a losing candidate must leave that replica's cache
-        untouched. Surfaced over HTTP via /healthz `llm_prefix_probe`."""
-        if self.prefix_cache is None:
-            return 0
+        untouched. Surfaced over HTTP via /healthz `llm_prefix_probe`.
+
+        ISSUE 19: the probe consults BOTH tiers — a replica whose device
+        cache evicted a prefix into its host pool can still onboard it
+        without re-prefilling, so for placement scoring it is exactly as
+        warm as one still holding the pages in HBM."""
         tenant = self.config.default_tenant if tenant is None else tenant
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        return self.prefix_cache.probe(tenant, prompt)
+        host = (self.host_kv.probe(tenant, prompt)
+                if self.host_kv is not None else 0)
+        if self.prefix_cache is None:
+            return host
+        return max(self.prefix_cache.probe(tenant, prompt), host)
 
     def inflight_tokens(self) -> int:
         """Current admitted token cost (queued + active): the router's
@@ -1421,6 +1596,16 @@ class LLMEngine:
                 self.prefix_cache.stats["evictions"],
                 {t: s["cached_blocks"]
                  for t, s in self.prefix_cache.tenant_stats.items()})
+        if self.host_kv is not None:
+            self.metrics.set_host_kv(self.host_kv.snapshot())
+            if self.ledger is not None and self.prefix_cache is not None:
+                # spill work happens inside pool.allocate's pressure hook
+                # (mid-_admit), so the cache accumulates its wall time and
+                # the pump books the delta into the kv_spill phase here
+                spill = self.prefix_cache.spill_seconds
+                if spill > self._spill_booked:
+                    self.ledger.book("kv_spill", spill - self._spill_booked)
+                    self._spill_booked = spill
         self.metrics.set_fragmentation(self.pool.fragmentation_ratio())
         return n
 
@@ -1482,7 +1667,35 @@ class LLMEngine:
                     req.trace.event(
                         "admitted", t_adm, slot=slot,
                         queue_wait_ms=(t_adm - req.arrival) * 1e3)
-                if self.prefix_cache is not None:
+                if req.kv_row is not None:
+                    # prefill→decode handoff import (ISSUE 19): upload the
+                    # exported row into this slot's own identity pages and
+                    # start chunked prefill past the covered span. No
+                    # set_length here — the next chunk commit's
+                    # set_length claims the own pages exactly as a cold
+                    # prefill would, so check_balance holds without a
+                    # special ledger path.
+                    t0 = self.clock.now()
+                    bl = self.pool.block_len
+                    klen = int(req.kv_row["length"])
+                    layers = req.kv_row["layers"]
+                    for j in range(0, klen, bl):
+                        w = min(bl, klen - j)
+                        blk = [(k[:, j:j + w, :], v[:, j:j + w, :])
+                               for k, v in layers]
+                        self.pool.import_page(slot, j // bl, blk)
+                    req.chunk_off = klen
+                    self.kv_import_tokens += klen
+                    if self.ledger is not None:
+                        self.ledger.book("kv_onboard",
+                                         self.clock.now() - t0)
+                    flight_recorder().record(
+                        "kv_import", engine="llm", rid=req.rid,
+                        tokens=klen)
+                    if req.trace is not None:
+                        req.trace.event("kv_import", self.clock.now(),
+                                        tokens=klen)
+                elif self.prefix_cache is not None:
                     # cap at plen-1 so at least one prompt token always
                     # prefills (that step produces the first output
                     # token's logits); an over-cap full block degrades to
@@ -1508,6 +1721,44 @@ class LLMEngine:
                             "prefix_lookup", self.clock.now(),
                             attach_len=plan.attach_len,
                             prompt_len=len(req.prompt))
+                # host-tier onboard (ISSUE 19): where the device radix
+                # cache's coverage ends on a block boundary, keep walking
+                # block-by-block through the host spill pool and upload
+                # covered pages into the slot's own identity pages —
+                # chunked prefill then starts past everything either tier
+                # held. A COW tail (non-aligned chunk_off) ends the walk:
+                # that block is already mid-copy. Onboarded blocks are
+                # re-indexed into the device trie for free when the
+                # completed prefill runs `prefix_cache.insert`.
+                if (self.host_kv is not None and req.kv_row is None
+                        and req.chunk_off % self.pool.block_len == 0):
+                    bl = self.pool.block_len
+                    t0 = self.clock.now()
+                    j = req.chunk_off // bl
+                    onboarded = 0
+                    # same cap as the device acquire: at least one prompt
+                    # token always prefills
+                    while (j + 1) * bl <= len(req.prompt) - 1:
+                        layers = self.host_kv.get(
+                            req.tenant, req.prompt[:(j + 1) * bl])
+                        if layers is None:
+                            break
+                        self.pool.import_page(slot, j, layers)
+                        j += 1
+                        onboarded += 1
+                    if onboarded:
+                        req.chunk_off = j * bl
+                        self.host_onboard_tokens += onboarded * bl
+                        if self.ledger is not None:
+                            self.ledger.book("kv_onboard",
+                                             self.clock.now() - t0)
+                        flight_recorder().record(
+                            "kv_onboard", engine="llm", rid=req.rid,
+                            blocks=onboarded, tokens=onboarded * bl)
+                        if req.trace is not None:
+                            req.trace.event(
+                                "kv_onboard", self.clock.now(),
+                                blocks=onboarded, tokens=onboarded * bl)
                 # per-slot sampling state (ISSUE 18): bind the request's
                 # params + grammar/DFA row for the slot's lifetime
                 self.sampling_table.bind(slot, req.sampling or GREEDY,
@@ -1952,7 +2203,7 @@ class LLMEngine:
                     # dispatch's span is booked as compute
                     tc0 = self.clock.now()
                 try:
-                    nxt, new_dstate, new_slabs = self._run_dispatch(
+                    nxt, lps, new_dstate, new_slabs = self._run_dispatch(
                         kinds, fn, args)
                 except DispatchFailedError as e:
                     last_err = e
@@ -1990,6 +2241,7 @@ class LLMEngine:
                 jax.block_until_ready(nxt)
                 tc1 = self.clock.now()
             nxt = np.asarray(nxt)   # [N, C] per-position selected tokens
+            lps = np.asarray(lps)   # [N, C] per-position selected logprobs
             new_dstate = np.asarray(new_dstate)  # [N] advanced DFA states
             with self._cond:
                 accept = self._acceptance_locked(decode_slots, spec_drafts,
@@ -2075,7 +2327,8 @@ class LLMEngine:
                             self.prefix_cache.insert(
                                 req.tenant, req.prompt, slot,
                                 req.attached_pages)
-                        self._emit(req, int(nxt[slot, int(adv[slot]) - 1]))
+                        self._emit(req, int(nxt[slot, int(adv[slot]) - 1]),
+                                   float(lps[slot, int(adv[slot]) - 1]))
                         if req.gid:
                             # first constrained emission: commit the DFA
                             # state advanced in-step past that token
@@ -2118,8 +2371,8 @@ class LLMEngine:
                         if k:
                             ev.update(drafted=k, accepted=acc)
                         req.trace.event("decode_step", now, **ev)
-                    for tok in emit_toks:
-                        self._emit(req, tok)
+                    for j, tok in enumerate(emit_toks):
+                        self._emit(req, tok, float(lps[slot, j]))
                     if req.gid:
                         # constrained rows never speculate (one emission
                         # per step), so the in-step advanced state is
@@ -2268,10 +2521,11 @@ class LLMEngine:
             "engine_failure", engine="llm", failed=n_failed,
             attempts=attempts, error=str(last_err))
 
-    def _emit(self, req: _GenRequest, tok: int):
+    def _emit(self, req: _GenRequest, tok: int,
+              lp: Optional[float] = None):
         req.emitted.append(tok)
         req.last_tok = tok
-        req.handle._append(tok)
+        req.handle._append(tok, lp if req.want_logprobs else None)
         if req.gid > 0:
             self.metrics.on_sample_token("constrained")
         elif req.sampling is not None and req.sampling.do_sample:

@@ -103,7 +103,8 @@ class PrefixCache:
     on the target side attaches the congruent draft pages too and the
     draft skips re-prefilling the shared prefix)."""
 
-    def __init__(self, pool: SlotPagedKVPool, name: str = "target"):
+    def __init__(self, pool: SlotPagedKVPool, name: str = "target",
+                 host_pool=None, clock=None):
         self.pool = pool
         self.name = name
         self.block_len = pool.block_len
@@ -111,6 +112,18 @@ class PrefixCache:
         self._tick = 0
         self.stats = _tenant_stats()
         self.tenant_stats: Dict[str, dict] = {}
+        # ISSUE 19 spill tier: when a HostKVPool is attached, pressure
+        # eviction of a refcount-0 FULL block serializes its page to host
+        # RAM (keyed by tenant + full token path) before releasing it, so
+        # a later admission can re-onboard it instead of re-prefilling.
+        # Tails (partial blocks) are dropped as before — see host_kv.py.
+        self.host_pool = host_pool
+        # optional clock (engine passes clock.now) so spill copy time is
+        # attributable: the engine books the delta into the ledger's
+        # `kv_spill` phase each pump
+        self.clock = clock
+        self.spill_seconds = 0.0
+        self.spilled_pages = 0
         pool.on_pressure = self.evict_for_pressure
 
     def _ts(self, tenant: str) -> dict:
@@ -284,27 +297,30 @@ class PrefixCache:
         """Least-recently-touched evictable entry across all tenants:
         refcount-0 tails, and refcount-0 leaf nodes (no children AND no
         tail — interior nodes and tailed nodes are structurally pinned
-        until their descendants go first)."""
-        best = None   # (tick, kind, tenant, node_or_parent, key)
+        until their descendants go first). Each candidate carries the
+        victim block's FULL token path from the prefix start — the
+        content address the host spill tier is keyed by (ISSUE 19)."""
+        best = None   # (tick, kind, tenant, node_or_parent, key, path)
         for tenant, root in self._roots.items():
             stack: List[Tuple[_Node, Optional[_Node],
-                              Optional[Tuple[int, ...]]]] = \
-                [(root, None, None)]
+                              Optional[Tuple[int, ...]],
+                              Tuple[int, ...]]] = \
+                [(root, None, None, ())]
             while stack:
-                node, parent, key = stack.pop()
+                node, parent, key, path = stack.pop()
                 if (node.tail_page is not None
                         and self.pool.refcount.get(node.tail_page, 0) == 0):
-                    cand = (node.tail_tick, "tail", tenant, node, None)
+                    cand = (node.tail_tick, "tail", tenant, node, None, path)
                     if best is None or cand[0] < best[0]:
                         best = cand
                 if (parent is not None and not node.children
                         and node.tail_page is None
                         and self.pool.refcount.get(node.page, 0) == 0):
-                    cand = (node.tick, "node", tenant, parent, key)
+                    cand = (node.tick, "node", tenant, parent, key, path)
                     if best is None or cand[0] < best[0]:
                         best = cand
                 for k, c in node.children.items():
-                    stack.append((c, node, k))
+                    stack.append((c, node, k, path + k))
         return best
 
     def evict_for_pressure(self) -> int:
@@ -318,7 +334,7 @@ class PrefixCache:
             victim = self._lru_victim()
             if victim is None:
                 break
-            _, kind, tenant, holder, key = victim
+            _, kind, tenant, holder, key, path = victim
             ts = self._ts(tenant)
             if kind == "tail":
                 self.pool.release_cached(holder.tail_page)
@@ -327,6 +343,17 @@ class PrefixCache:
                 holder.tail_tick = 0
             else:
                 child = holder.children.pop(key)
+                if self.host_pool is not None:
+                    # spill the full block to the host tier before the
+                    # page is released (refcount is provably 0 here, so
+                    # the device copy is quiescent — the export is the
+                    # exact KV the trie indexed)
+                    t0 = self.clock() if self.clock is not None else None
+                    self.host_pool.put(
+                        tenant, path, self.pool.export_page(child.page))
+                    self.spilled_pages += 1
+                    if t0 is not None:
+                        self.spill_seconds += self.clock() - t0
                 self.pool.release_cached(child.page)
             ts["evictions"] += 1
             self.stats["evictions"] += 1
@@ -366,6 +393,11 @@ class PrefixCache:
             ts["cached_blocks"] = 0
         self._roots.clear()
         self.stats["cached_blocks"] = 0
+        if self.host_pool is not None:
+            # spilled KV is a function of the weights that computed it —
+            # a weight swap poisons the host tier the same way it poisons
+            # the device trie
+            self.host_pool.clear()
         return released
 
     # ---- views ----

@@ -319,6 +319,10 @@ class LLMMetrics(ServingMetrics):
             "greedy": 0, "sampled": 0, "constrained": 0}
         self._mask_overhead_ms: deque = deque(maxlen=self.window)
         self.grammars_compiled = 0
+        # host-RAM KV spill tier (ISSUE 19): the engine pushes the
+        # HostKVPool's snapshot() each pump; None until a tiered engine
+        # reports, so a device-only engine renders no host families
+        self.host_kv: Optional[Dict[str, int]] = None
 
     def _class(self, slo) -> Optional[Dict[str, int]]:
         return self.class_counters.get(slo) if slo else None
@@ -496,6 +500,13 @@ class LLMMetrics(ServingMetrics):
         with self._lock:
             self.grammars_compiled = int(compiled)
 
+    def set_host_kv(self, snap: Dict[str, int]):
+        """Refresh the host spill tier's gauges/counters from
+        `HostKVPool.snapshot()` (pages, bytes, spills, onboards, hits,
+        misses, evictions, rejected)."""
+        with self._lock:
+            self.host_kv = dict(snap)
+
     def mask_overhead_quantile_ms(self, q: float) -> Optional[float]:
         with self._lock:
             vals = sorted(self._mask_overhead_ms)
@@ -584,6 +595,8 @@ class LLMMetrics(ServingMetrics):
         with self._lock:
             s["sample_slots"] = dict(self.sample_slots)
             s["grammars_compiled"] = self.grammars_compiled
+            s["host_kv"] = (dict(self.host_kv)
+                            if self.host_kv is not None else None)
         s["mask_overhead_p99_ms"] = self.mask_overhead_quantile_ms(0.99)
         s["shed_rate"] = (s["shed"] / s["submitted"] if s["submitted"]
                           else 0.0)
@@ -648,6 +661,19 @@ class LLMMetrics(ServingMetrics):
                  {"quantile": "0.99"}, round_to=3)
         b.family(f"{px}_sample_grammars_compiled", "gauge")
         b.sample(f"{px}_sample_grammars_compiled", s["grammars_compiled"])
+        # ---- tiered KV cache families (ISSUE 19) ----
+        if s["host_kv"] is not None:
+            hk = s["host_kv"]
+            b.family(f"{px}_kv_host_pages_total", "gauge")
+            b.sample(f"{px}_kv_host_pages_total", hk["pages"])
+            b.family(f"{px}_kv_host_bytes_total", "gauge")
+            b.sample(f"{px}_kv_host_bytes_total", hk["bytes"])
+            b.family(f"{px}_kv_host_spills_total", "counter")
+            b.sample(f"{px}_kv_host_spills_total", hk["spills"])
+            b.family(f"{px}_kv_host_onboards_total", "counter")
+            b.sample(f"{px}_kv_host_onboards_total", hk["onboards"])
+            b.family(f"{px}_kv_host_evictions_total", "counter")
+            b.sample(f"{px}_kv_host_evictions_total", hk["evictions"])
         # ---- overload control + supervision families (ISSUE 6) ----
         b.family(f"{px}_class_requests_total", "counter")
         for c in SLO_CLASSES:
@@ -728,6 +754,13 @@ class RouterMetrics:
         self.affinity_decisions = 0
         self.replica_inflight: Dict[str, int] = {}
         self.replica_weight_version: Dict[str, str] = {}   # ISSUE 16
+        # prefill/decode disaggregation (ISSUE 19)
+        self.replica_role: Dict[str, str] = {}     # replica -> role tag
+        self.handoffs = 0                          # prefill→decode moves
+        self.handoffs_failed = 0                   # export succeeded but no
+        #                                            decode home re-admitted
+        #                                            the stream in time
+        self._handoff_ms: deque = deque(maxlen=4096)
 
     # ---- router callbacks ----
     def on_submit(self):
@@ -756,12 +789,35 @@ class RouterMetrics:
             self.counters["failed"] += 1
 
     def set_replica(self, replica: str, state: str, inflight_tokens: int,
-                    weight_version: Optional[str] = None):
+                    weight_version: Optional[str] = None,
+                    role: Optional[str] = None):
         with self._lock:
             self.replica_state[replica] = state
             self.replica_inflight[replica] = int(inflight_tokens)
             if weight_version is not None:
                 self.replica_weight_version[replica] = str(weight_version)
+            if role is not None:
+                self.replica_role[replica] = str(role)
+
+    def on_handoff(self, src: str, dst: str, ms: float):
+        """One completed prefill→decode stream handoff (ISSUE 19): KV
+        exported from `src`, stream re-admitted on `dst` after `ms`
+        milliseconds of export-to-accepted-submit wall time — the
+        latency the bench's `llm_handoff_ms` ceiling bounds."""
+        with self._lock:
+            self.handoffs += 1
+            self._handoff_ms.append(float(ms))
+
+    def on_handoff_failed(self):
+        """A handoff export could not be re-admitted anywhere (the stream
+        falls back to failover re-prefill, never dropped)."""
+        with self._lock:
+            self.handoffs_failed += 1
+
+    def handoff_quantile_ms(self, q: float) -> Optional[float]:
+        with self._lock:
+            vals = sorted(self._handoff_ms)
+        return _quantile(vals, q)
 
     def on_quarantine(self, replica: str):
         with self._lock:
@@ -796,7 +852,10 @@ class RouterMetrics:
                 "readmissions": dict(self.readmissions),
                 "failovers": dict(self.failovers),
                 "replica_weight_version": dict(self.replica_weight_version),
+                "replica_role": dict(self.replica_role),
                 "resumed_streams": self.resumed_streams,
+                "handoffs": self.handoffs,
+                "handoffs_failed": self.handoffs_failed,
                 "affinity_hit_rate": (
                     self.affinity_hits / self.affinity_decisions
                     if self.affinity_decisions else 0.0),
@@ -853,3 +912,19 @@ class RouterMetrics:
         b.family(f"{px}_prefix_affinity_hit_rate", "gauge")
         b.sample(f"{px}_prefix_affinity_hit_rate", s["affinity_hit_rate"],
                  round_to=4)
+        # ---- prefill/decode disaggregation families (ISSUE 19) ----
+        if s["replica_role"]:
+            b.family(f"{px}_replica_role_info", "gauge")
+            for replica in sorted(s["replica_role"]):
+                b.sample(f"{px}_replica_role_info", 1,
+                         {"replica": replica,
+                          "role": s["replica_role"][replica]})
+        b.family(f"{px}_handoffs_total", "counter")
+        b.sample(f"{px}_handoffs_total", s["handoffs"])
+        b.family(f"{px}_handoffs_failed_total", "counter")
+        b.sample(f"{px}_handoffs_failed_total", s["handoffs_failed"])
+        hq = self.handoff_quantile_ms(0.99)
+        if hq is not None:
+            b.family(f"{px}_handoff_ms", "summary")
+            b.sample(f"{px}_handoff_ms", hq, {"quantile": "0.99"},
+                     round_to=3)

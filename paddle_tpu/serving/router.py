@@ -38,6 +38,19 @@ the HTTP front end, so N replicas run under one SimClock and the whole
 failover dance is scripted-time deterministic in tests. `RouterServer`
 is the HTTP face (same /generate contract as `ServingServer`, plus
 fleet-level /healthz and pdtpu_router_* /metrics).
+
+Prefill/decode disaggregation (ISSUE 19): replicas carry a role
+(`prefill` / `decode` / `mixed`). A stream that finishes prefill on a
+prefill-role replica exports its KV row + sampling lane atomically
+(`LLMEngine.export_stream`) and is re-placed decode-first with the
+staged payload, paying a one-token prefill on the destination instead
+of recomputing the prompt. The staged KV stays on the handle until the
+stream completes, so a decode replica crashing right after the handoff
+re-places the SAME payload — and when it has gone stale (tokens emitted
+since), the stream falls back to the ordinary failover re-prefill.
+Role preference is exactly that — a preference: every healthy replica
+stays in the ranked candidate list, because zero dropped streams beats
+role purity.
 """
 from __future__ import annotations
 
@@ -80,10 +93,16 @@ class InProcessReplica:
     progress for the hung-forward watchdog."""
 
     def __init__(self, engine, index: int, name: Optional[str] = None,
-                 fault_plan: Optional[FaultPlan] = None):
+                 fault_plan: Optional[FaultPlan] = None,
+                 role: str = "mixed"):
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'mixed', got "
+                f"{role!r}")
         self.engine = engine
         self.index = int(index)
         self.name = name or f"replica{index}"
+        self.role = role
         self.clock: Clock = engine.clock
         self._fault_plan = fault_plan
         self.crashed = False
@@ -135,6 +154,14 @@ class InProcessReplica:
                 f"replica {self.name} is down", reason="replica_down",
                 retry_after_s=1.0)
         return self.engine.submit(*args, **kwargs)
+
+    def export_stream(self, rid: str) -> dict:
+        """Atomic KV + lane export for a prefill→decode handoff
+        (ISSUE 19). ValueError propagates when the stream is still
+        mid-prefill; RuntimeError when the replica is down."""
+        if self.crashed:
+            raise RuntimeError(f"replica {self.name} is down")
+        return self.engine.export_stream(rid)
 
     # -- lifecycle --
 
@@ -264,7 +291,8 @@ class RouterHandle:
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  eos_token_id: Optional[int], slo: str, tenant: str,
                  rid: str, seq: int, deadline_abs: Optional[float],
-                 sampling: Optional[SamplingParams] = None):
+                 sampling: Optional[SamplingParams] = None,
+                 logprobs: bool = False):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_token_id = eos_token_id
@@ -282,15 +310,34 @@ class RouterHandle:
         #                                     emitted — a stream is never
         #                                     stitched across two weight
         #                                     sets (ISSUE 16)
+        self.want_logprobs = bool(logprobs)   # per-token logprob surface
         self._seq = seq                     # router submit order
         self._deadline_abs = deadline_abs
         self._prefix = np.empty(0, np.int32)   # harvested off dead replicas
+        self._logprobs: List[Optional[float]] = []   # stitched with _prefix
         self._inner = None                  # live GenerationHandle or None
         self._replica: Optional[InProcessReplica] = None
+        # prefill→decode disaggregation (ISSUE 19): the exported KV row +
+        # sampling lane ride the handle until the stream completes, so a
+        # decode replica crashing right after a handoff re-places the
+        # same payload instead of re-prefilling. _resume_args drops them
+        # once stale (tokens emitted since the export).
+        self._staged_kv: Optional[dict] = None
+        self._staged_lane: Optional[dict] = None
+        self._handoff_src: Optional[str] = None   # set export→first place
+        self._handoff_t0: Optional[float] = None
 
     def tokens_so_far(self) -> List[int]:
         live = self._inner.tokens_so_far() if self._inner is not None else []
         return [int(t) for t in self._prefix] + list(live)
+
+    def logprobs_so_far(self) -> List[Optional[float]]:
+        """Per-emitted-token logprobs, stitched across failovers and
+        handoffs exactly like `tokens_so_far` (index-aligned with it).
+        All-None unless the stream was submitted with logprobs=True."""
+        live = (self._inner.logprobs_so_far()
+                if self._inner is not None else [])
+        return list(self._logprobs) + list(live)
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         return self.future.result(timeout)
@@ -308,6 +355,8 @@ class RouterHandle:
                           np.int32).reshape(-1)
         if toks.size:
             self._prefix = np.concatenate([self._prefix, toks])
+            self._logprobs.extend(
+                self._inner.logprobs_so_far()[:toks.size])
         if self.ttft_ms is None:
             self.ttft_ms = self._inner.ttft_ms
         self._inner = None
@@ -345,13 +394,29 @@ class RouterHandle:
         deadline_ms = None
         if self._deadline_abs is not None:
             deadline_ms = max(1.0, (self._deadline_abs - now) * 1e3)
-        return dict(prompt=prompt,
+        args = dict(prompt=prompt,
                     max_new_tokens=self.max_new_tokens - self._prefix.size,
                     eos_token_id=self.eos_token_id,
                     deadline_ms=deadline_ms, slo=self.slo,
                     tenant=self.tenant, rid=self.rid,
                     sampling=self.sampling,
-                    sample_offset=int(self._prefix.size))
+                    sample_offset=int(self._prefix.size),
+                    logprobs=self.want_logprobs)
+        # disaggregation (ISSUE 19): attach the staged KV row when it
+        # still covers exactly prompt'.size - 1 tokens (the one-token-
+        # prefill invariant); anything else means tokens were emitted
+        # since the export and the ordinary re-prefill path takes over.
+        if self._staged_kv is not None:
+            if int(self._staged_kv["length"]) == int(prompt.size) - 1:
+                args["kv_row"] = self._staged_kv
+            else:
+                self._staged_kv = None
+                self._staged_lane = None
+        lane = self._staged_lane
+        if (lane is not None
+                and int(lane.get("next_index", -1)) == self._prefix.size):
+            args["lane"] = lane
+        return args
 
 
 class _ReplicaState:
@@ -436,7 +501,8 @@ class ReplicaRouter:
                slo: Optional[str] = None,
                tenant: Optional[str] = None,
                rid: Optional[str] = None,
-               sampling: Optional[SamplingParams] = None) -> RouterHandle:
+               sampling: Optional[SamplingParams] = None,
+               logprobs: bool = False) -> RouterHandle:
         """Admit one prompt to the fleet. Raises RejectedError with
         reason `fleet_unavailable` when every replica is quarantined,
         `shed` when the fleet is degraded past the shed fraction and the
@@ -444,7 +510,10 @@ class ReplicaRouter:
         every healthy replica refuses admission. `sampling` (ISSUE 18)
         rides the handle across failovers: re-placements resubmit the
         same params plus the emitted-token count as `sample_offset`, so
-        a seeded stream stays bit-identical across replica deaths."""
+        a seeded stream stays bit-identical across replica deaths.
+        `logprobs` (ISSUE 19) surfaces the model's per-token logprob for
+        every emitted token on `logprobs_so_far()`, stitched across
+        failovers and handoffs like the tokens themselves."""
         if sampling is not None:
             sampling.validate()
         ecfg = self.replicas[0].engine.config
@@ -494,7 +563,7 @@ class ReplicaRouter:
                     retry_after_s=self.config.retry_after_s)
             handle = RouterHandle(prompt, mnt, eos, slo, tenant, rid,
                                   self._seq, deadline_abs,
-                                  sampling=sampling)
+                                  sampling=sampling, logprobs=logprobs)
             self._seq += 1
             replica, last_exc = self._place_locked(handle, now)
             if replica is None:
@@ -547,18 +616,36 @@ class ReplicaRouter:
         replicas qualify — resuming the emitted prefix under different
         weights would stitch two weight sets into one stream. A stream
         with no tokens yet may re-pin (there is nothing to stitch).
+
+        Role preference (ISSUE 19): a stream carrying staged handoff KV
+        prefers decode > mixed > prefill; a stream that must (re)prefill
+        prefers prefill > mixed > decode. The preference ranks AHEAD of
+        the prefix probe but never filters: every healthy same-version
+        replica stays a candidate, so an all-mixed fleet ranks exactly
+        as before and a role-specialized fleet still places everything
+        somewhere rather than dropping a stream.
         Returns the accepting replica, or (None, last_reject)."""
         args = handle._resume_args(now)
         pinned = (handle.weight_version
                   if handle._prefix.size > 0 else None)
+        staged = "kv_row" in args
+
+        def role_rank(r: InProcessReplica) -> int:
+            if r.role == "mixed":
+                return 1
+            if staged:
+                return 0 if r.role == "decode" else 2
+            return 0 if r.role == "prefill" else 2
+
         ranked = sorted(
-            ((-(r.prefix_probe(args["prompt"], tenant=handle.tenant)),
+            ((role_rank(r),
+              -(r.prefix_probe(args["prompt"], tenant=handle.tenant)),
               r.inflight_tokens(), r.index, r)
              for r in self._candidates_locked()
              if pinned is None or r.weight_version == pinned),
-            key=lambda t: t[:3])
+            key=lambda t: t[:4])
         last_exc: Optional[Exception] = None
-        for neg_match, _, _, r in ranked:
+        for _rank, neg_match, _, _, r in ranked:
             try:
                 inner = r.submit(**args)
             except RejectedError as e:
@@ -568,6 +655,26 @@ class ReplicaRouter:
             handle._replica = r
             handle.weight_version = r.weight_version
             self.metrics.on_route(r.name, prefix_hit=neg_match < 0)
+            if handle._handoff_src is not None:
+                src = handle._handoff_src
+                handle._handoff_src = None
+                if staged:
+                    t0 = (handle._handoff_t0
+                          if handle._handoff_t0 is not None else now)
+                    ms = max(0.0, (now - t0) * 1e3)
+                    self.metrics.on_handoff(src, r.name, ms)
+                    flight_recorder().record(
+                        "router_handoff", rid=handle.rid, src=src,
+                        dst=r.name, ms=round(ms, 3),
+                        kv_tokens=int(args["kv_row"]["length"]))
+                else:
+                    # staged KV went stale before a destination accepted
+                    # the stream: it re-prefilled instead (still bit-
+                    # identical, just not a KV handoff)
+                    self.metrics.on_handoff_failed()
+                    flight_recorder().record(
+                        "router_handoff", rid=handle.rid, src=src,
+                        dst=r.name, fallback="re_prefill")
             return r, None
         return None, last_exc
 
@@ -688,6 +795,43 @@ class ReplicaRouter:
                 still.append(h)   # zero dropped: keep trying every pump
         self._pending = still
 
+    def _handoff_locked(self, now: float):
+        """Prefill/decode disaggregation (ISSUE 19): any stream that has
+        finished prefill on a prefill-role replica (its handle shows
+        emitted tokens but the stream is still live) exports its KV row
+        + sampling lane in one atomic engine call, absorbs the emitted
+        tokens into the stitched prefix, and is re-placed decode-first
+        with the staged payload. A stream that cannot place right now
+        goes to `_pending` with the payload intact — zero dropped
+        streams, the handoff just completes on a later pump."""
+        if all(r.role != "prefill" for r in self.replicas):
+            return
+        for h in list(self._inflight.values()):
+            r = h._replica
+            if (r is None or r.role != "prefill" or r.crashed
+                    or h._inner is None or h._inner.future.done()):
+                continue
+            try:
+                payload = r.export_stream(h.rid)
+            except (ValueError, RuntimeError):
+                continue   # mid-prefill (or replica just died): next pump
+            h._handoff_src = r.name
+            h._handoff_t0 = now
+            h._absorb_inner()
+            h._staged_kv = payload["kv_row"]
+            h._staged_lane = payload["lane"]
+            if h._finished():
+                # prefill emitted everything the budget allowed (e.g.
+                # max_new_tokens == 1): nothing to hand off
+                h._handoff_src = None
+                h.future.set_result(h._prefix.copy())
+                self.metrics.on_complete()
+                del self._inflight[h.rid]
+                continue
+            replica, _ = self._place_locked(h, now)
+            if replica is None:
+                self._pending.append(h)
+
     def _harvest_locked(self, now: float):
         for rid, h in list(self._inflight.items()):
             inner = h._inner
@@ -726,7 +870,8 @@ class ReplicaRouter:
             state = "quarantined" if st.quarantined else r.health()
             inflight = 0 if r.crashed else r.engine.inflight_tokens()
             self.metrics.set_replica(r.name, state, inflight,
-                                     weight_version=r.weight_version)
+                                     weight_version=r.weight_version,
+                                     role=r.role)
 
     # ---- rolling-deploy lifecycle (ISSUE 16) ----
 
@@ -852,6 +997,7 @@ class ReplicaRouter:
         for r in live:
             n += r.pump()
         with self._lock:
+            self._handoff_locked(self.clock.now())
             self._harvest_locked(self.clock.now())
             self._update_gauges_locked()
         return n
@@ -881,6 +1027,11 @@ class ReplicaRouter:
             # rate (None: crashed, or no windows yet) — the fleet-level
             # view the accept-rate runbook in docs/serving.md watches.
             # Only advertised when some replica actually carries a draft.
+            # disaggregation (ISSUE 19): advertise roles only when the
+            # fleet is actually specialized (all-mixed is the default
+            # topology and needs no extra healthz surface)
+            if any(r.role != "mixed" for r in self.replicas):
+                out["roles"] = {r.name: r.role for r in self.replicas}
             if any(getattr(r.engine, "draft_model", None) is not None
                    for r in self.replicas):
                 out["spec_accept_rates"] = {
@@ -1057,6 +1208,14 @@ class RouterServer:
                     sampling = SamplingParams.from_payload(payload)
                     if sampling is not None:
                         sampling.validate()
+                    # per-token logprobs (ISSUE 19): strictly boolean —
+                    # a truthy 1 / "yes" is a malformed request, not a
+                    # lenient opt-in
+                    want_lp = payload.get("logprobs", False)
+                    if not isinstance(want_lp, bool):
+                        raise ValueError(
+                            f"logprobs must be a boolean, got "
+                            f"{want_lp!r}")
                 except (ValueError, KeyError, TypeError) as e:
                     self._reply_json(400, {"error": f"bad request: {e}"})
                     return
@@ -1069,7 +1228,7 @@ class RouterServer:
                         eos_token_id=payload.get("eos_token_id"),
                         deadline_ms=payload.get("deadline_ms"),
                         slo=slo, tenant=tenant, rid=rid,
-                        sampling=sampling)
+                        sampling=sampling, logprobs=want_lp)
                     toks = handle.result(timeout=outer.request_timeout_s)
                 except RejectedError as e:
                     reason = getattr(e, "reason", "rejected")
@@ -1089,12 +1248,15 @@ class RouterServer:
                     self._reply_json(
                         500, {"error": f"{type(e).__name__}: {e}"})
                     return
-                self._reply_json(200, {
+                resp = {
                     "tokens": np.asarray(toks).tolist(),
                     "ttft_ms": handle.ttft_ms,
                     "rid": rid,
                     "failovers": handle.failovers,
-                })
+                }
+                if want_lp:
+                    resp["logprobs"] = handle.logprobs_so_far()
+                self._reply_json(200, resp)
 
             def _deploy(self):
                 """POST /deploy {"directory", "version", "wait"?}: start

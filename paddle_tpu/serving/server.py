@@ -326,6 +326,13 @@ class ServingServer:
                     sampling = SamplingParams.from_payload(payload)
                     if sampling is not None:
                         sampling.validate()
+                    # per-token logprobs (ISSUE 19): strictly boolean —
+                    # a truthy 1 / "yes" is a malformed request
+                    want_lp = payload.get("logprobs", False)
+                    if not isinstance(want_lp, bool):
+                        raise ValueError(
+                            f"logprobs must be a boolean, got "
+                            f"{want_lp!r}")
                 except (ValueError, KeyError, TypeError) as e:
                     self._reply_json(400, {"error": f"bad request: {e}"})
                     return
@@ -338,7 +345,7 @@ class ServingServer:
                         eos_token_id=payload.get("eos_token_id"),
                         deadline_ms=payload.get("deadline_ms"),
                         slo=slo, tenant=tenant, rid=rid, trace=traced,
-                        sampling=sampling)
+                        sampling=sampling, logprobs=want_lp)
                     toks = handle.result(timeout=outer.request_timeout_s)
                 except RejectedError as e:
                     self._reply_rejected(e)
@@ -355,6 +362,8 @@ class ServingServer:
                     "ttft_ms": handle.ttft_ms,
                     "rid": rid,
                 }
+                if want_lp:
+                    resp["logprobs"] = handle.logprobs_so_far()
                 if traced:
                     resp["trace"] = handle.timeline()
                 self._reply_json(200, resp)

@@ -108,6 +108,11 @@ def pytest_configure(config):
         "markers", "sampling: per-slot seeded sampling + grammar-"
         "constrained decoding tests (RNG lanes, token DFA masks, "
         "failover counter restore; ISSUE 18); select with -m sampling")
+    config.addinivalue_line(
+        "markers", "tiered: tiered KV cache + disaggregation tests "
+        "(host-RAM spill/onboard round trips, prefill→decode handoff "
+        "bit-identity, per-token logprobs; ISSUE 19); select with "
+        "-m tiered")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -140,5 +145,9 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.serving)
         if mod == "test_sampling":
             item.add_marker(pytest.mark.sampling)
+            item.add_marker(pytest.mark.llm)
+            item.add_marker(pytest.mark.serving)
+        if mod == "test_tiered":
+            item.add_marker(pytest.mark.tiered)
             item.add_marker(pytest.mark.llm)
             item.add_marker(pytest.mark.serving)

@@ -361,6 +361,52 @@ def test_kv_pool_export_import_rows_bitwise_roundtrip():
         SlotPagedKVPool(init_cache, 3, 8, 2).import_rows(exported)
 
 
+def test_export_rows_length_trimmed_bitwise_parity():
+    """export_rows ships ONLY the occupied prefix (ISSUE 19: a handoff
+    payload must not drag a row's full static capacity across the wire).
+    Parity pin: the trimmed per-layer arrays must equal a manual
+    host-side slice of the full slabs over the identity page range —
+    bitwise, including a non-block-aligned tail — and cost
+    length-proportional bytes."""
+    import jax.numpy as jnp
+    from paddle_tpu.serving.llm import SlotPagedKVPool
+
+    def init_cache(b, max_len):
+        return [(jnp.zeros((b, 2, max_len, 3), jnp.float32),
+                 jnp.zeros((b, 2, max_len, 3), jnp.float32))
+                for _ in range(2)]
+
+    rng = np.random.RandomState(6)
+    pool = SlotPagedKVPool(init_cache, 3, 4, 4)     # block_len=4, 4 blocks
+    slot = pool.allocate(10)
+    pool.set_length(slot, 10)                       # 2 full blocks + tail 2
+    for li in range(len(pool.slabs)):
+        k, v = pool.slabs[li]
+        pool.slabs[li] = (
+            jnp.asarray(rng.randn(*k.shape).astype(np.float32)),
+            jnp.asarray(rng.randn(*v.shape).astype(np.float32)))
+
+    row = pool.export_rows([slot])["rows"][slot]
+    assert row["length"] == 10
+    # identity layout: the slot's token t lives at slab column t of its
+    # own row — fetch the WHOLE raw slab host-side (the untrimmed path)
+    # and demand the trimmed export equals its first `length` columns
+    for li, (ke, ve) in enumerate(row["layers"]):
+        assert np.asarray(ke).shape == (2, 10, 3)   # trimmed, not 16
+        kfull, vfull = (np.asarray(a) for a in pool.slabs[li])
+        np.testing.assert_array_equal(np.asarray(ke),
+                                      kfull[slot, :, :10, :])
+        np.testing.assert_array_equal(np.asarray(ve),
+                                      vfull[slot, :, :10, :])
+    # export_page (the spill unit) agrees with the same oracle,
+    # including a partial-width tail
+    tail = pool.export_page(slot * pool.n_blocks + 2, width=2)
+    for li, (ke, ve) in enumerate(tail):
+        kfull, _ = (np.asarray(a) for a in pool.slabs[li])
+        np.testing.assert_array_equal(np.asarray(ke),
+                                      kfull[slot, :, 8:10, :])
+
+
 # ---- /healthz advertises engine-initiated drain (ISSUE 14 fix) ----
 
 def test_healthz_advertises_engine_drain(gpt_tiny):

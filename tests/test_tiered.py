@@ -1,0 +1,501 @@
+"""Tiered KV cache + prefill/decode disaggregation (ISSUE 19).
+
+Two contracts under test. **Tiering:** under slot pressure the radix
+prefix cache spills refcount-0 full blocks into a bounded host-RAM LRU
+(`HostKVPool`), and a later admission of the same prefix re-onboards the
+spilled pages instead of re-prefilling — with the warm-from-host stream
+bit-identical to a cold greedy generate() and the pool's page ledger
+balanced throughout. **Disaggregation:** replicas carry prefill/decode
+roles; a stream that finishes prefill on a prefill-role replica exports
+its KV row + sampling lane atomically and continues on a decode replica,
+bit-identical to an uninterrupted single-engine run — including seeded
+sampled streams (lane counter restore) and a decode replica crashing
+right after the handoff (staged payload re-placed, zero dropped).
+
+Every scheduler test runs the PRODUCTION pump under a SimClock —
+scripted instants, no sleeps, no thread flake."""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def gpt_tiny():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    paddle.seed(0)
+    return GPTForCausalLM.from_preset("gpt2-tiny")
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    from paddle_tpu.utils.fault_injection import set_global_plan
+    set_global_plan(None)
+    yield
+    set_global_plan(None)
+
+
+def _drive_engine(eng, clock, dt=0.01):
+    steps = 0
+    while eng.has_work():
+        clock.advance(dt)
+        eng.pump()
+        steps += 1
+        assert steps < 2000, "engine failed to converge"
+
+
+def _drive_router(router, clock, dt=0.01, max_steps=2000):
+    steps = 0
+    while router.has_work():
+        clock.advance(dt)
+        router.pump()
+        steps += 1
+        assert steps < max_steps, "router failed to converge"
+
+
+def _reference(model, prompt, max_new_tokens):
+    from paddle_tpu.models.generation import generate
+    out = np.asarray(generate(model, np.asarray(prompt)[None, :],
+                              max_new_tokens=max_new_tokens))
+    return out[0, np.asarray(prompt).size:]
+
+
+def _disagg_fleet(model, clock, roles=("prefill", "decode"), **cfg_kw):
+    from paddle_tpu import serving
+    kw = dict(num_slots=4, block_len=8, n_blocks=4, max_queue_depth=64)
+    kw.update(cfg_kw)
+    reps = [serving.InProcessReplica(
+                serving.LLMEngine(model, serving.LLMEngineConfig(**kw),
+                                  clock=clock),
+                i, role=role)
+            for i, role in enumerate(roles)]
+    return serving.ReplicaRouter(reps), reps
+
+
+# ---- HostKVPool unit surface ----
+
+def test_host_kv_pool_lru_budget_and_tenant_keys():
+    """Byte-budgeted LRU semantics: oldest page evicted first, a get()
+    bumps recency, a single page over budget is refused (not admitted,
+    not evicting others), and keys are (tenant, full token path) — two
+    tenants with identical paths never share an entry."""
+    from paddle_tpu.serving.llm import HostKVPool
+
+    page = lambda fill: [(np.full((2, 4, 3), fill, np.float32),
+                          np.full((2, 4, 3), -fill, np.float32))]
+    page_bytes = 2 * (2 * 4 * 3 * 4)
+    pool = HostKVPool(byte_budget=3 * page_bytes, block_len=4)
+
+    with pytest.raises(ValueError, match="multiple"):
+        pool.put("t", [1, 2, 3], page(0.0))       # not a block multiple
+
+    paths = [tuple(range(i * 4, i * 4 + 4)) for i in range(4)]
+    for i in range(3):
+        assert pool.put("t", paths[i], page(float(i)))
+    assert pool.pages == 3 and pool.bytes_used == 3 * page_bytes
+
+    # touch the oldest so the SECOND-oldest becomes the LRU victim
+    assert pool.get("t", paths[0]) is not None
+    assert pool.put("t", paths[3], page(3.0))
+    assert pool.get("t", paths[1]) is None        # evicted
+    assert pool.get("t", paths[0]) is not None    # survived the bump
+    assert pool.snapshot()["evictions"] == 1
+
+    # an oversized single page is refused outright
+    big = [(np.zeros((2, 4, 300), np.float32),
+            np.zeros((2, 4, 300), np.float32))]
+    assert not pool.put("t", paths[0], big)
+    assert pool.snapshot()["rejected"] == 1 and pool.pages == 3
+
+    # tenant namespacing: same path, different tenant = different entry
+    assert pool.get("other", paths[0]) is None
+    assert pool.probe("other", list(paths[0])) == 0
+    assert pool.probe("t", list(paths[0]) + [99]) == 4
+
+    # stored pages are owned copies, bit-exact on the way back
+    src = page(7.5)
+    pool.put("t2", paths[0], src)
+    src[0][0][:] = 0.0                            # mutate the original
+    k, v = pool.get("t2", paths[0])[0]
+    np.testing.assert_array_equal(k, np.full((2, 4, 3), 7.5, np.float32))
+    np.testing.assert_array_equal(v, np.full((2, 4, 3), -7.5, np.float32))
+
+    pool.clear()
+    assert pool.pages == 0 and pool.bytes_used == 0
+
+
+# ---- the tentpole: pressure spill -> warm-from-host onboard ----
+
+def test_pressure_spill_then_host_onboard_bit_identical(gpt_tiny):
+    """Fill the pool until every free row is cache-pinned, admit one
+    more stream (on_pressure spills the LRU prefix to the host tier),
+    then resubmit the evicted prompt: the engine must onboard the
+    spilled full blocks instead of re-prefilling them, emit a stream
+    bit-identical to the cold run, and keep the page ledger balanced."""
+    from paddle_tpu import serving
+
+    clock = serving.SimClock()
+    eng = serving.LLMEngine(
+        gpt_tiny,
+        serving.LLMEngineConfig(num_slots=2, block_len=8, n_blocks=4,
+                                host_kv_bytes=1 << 22),
+        clock=clock)
+    rng = np.random.RandomState(11)
+    pA, pB, pC = (rng.randint(1, 500, size=(17,)).astype(np.int32)
+                  for _ in range(3))          # 2 full blocks + 1-token tail
+    refA = _reference(gpt_tiny, pA, 6)
+
+    h = eng.submit(pA, max_new_tokens=6)
+    _drive_engine(eng, clock)
+    np.testing.assert_array_equal(np.asarray(h.result(timeout=0)), refA)
+    eng.submit(pB, max_new_tokens=6)
+    _drive_engine(eng, clock)
+    tenant = eng.config.default_tenant
+    assert eng.prefix_cache.probe(tenant, pA) == 16
+
+    # both rows cache-pinned: pC's admission exercises on_pressure,
+    # spilling pA's (LRU) full blocks host-side before release
+    eng.submit(pC, max_new_tokens=6)
+    _drive_engine(eng, clock)
+    assert eng.host_kv.pages >= 2
+    assert eng.prefix_cache.probe(tenant, pA) == 0      # gone from device
+    assert eng.prefix_probe(pA) == 16                   # host tier answers
+    assert eng.prefix_cache.spilled_pages >= 2
+    eng.pool.check_balance()
+
+    # warm-from-host: the onboard path uploads the spilled pages and
+    # prefill resumes at the block boundary — bitwise equal to cold
+    h2 = eng.submit(pA, max_new_tokens=6)
+    _drive_engine(eng, clock)
+    np.testing.assert_array_equal(np.asarray(h2.result(timeout=0)), refA)
+    assert eng.host_onboard_tokens == 16
+    eng.pool.check_balance()
+
+    snap = eng.host_kv.snapshot()
+    assert snap["onboards"] == 2 and snap["spills"] >= 2
+
+    # the host tier rides the engine's Prometheus surface
+    eng.pump()
+    text = eng.metrics.render()
+    for fam in ("pdtpu_llm_kv_host_pages_total",
+                "pdtpu_llm_kv_host_bytes_total",
+                "pdtpu_llm_kv_host_spills_total",
+                "pdtpu_llm_kv_host_onboards_total"):
+        assert fam in text, fam
+    flat = serving.parse_exposition(text)
+    assert flat["pdtpu_llm_kv_host_onboards_total"] == 2
+
+
+def test_host_tier_is_tenant_namespaced(gpt_tiny):
+    """A prefix spilled under tenant A must NOT warm tenant B: the host
+    pool keys on (tenant, token path) exactly like the device radix
+    roots, so B pays its own prefill (and still gets the same bits —
+    isolation is about KV provenance, not output)."""
+    from paddle_tpu import serving
+
+    clock = serving.SimClock()
+    eng = serving.LLMEngine(
+        gpt_tiny,
+        serving.LLMEngineConfig(num_slots=2, block_len=8, n_blocks=4,
+                                host_kv_bytes=1 << 22),
+        clock=clock)
+    rng = np.random.RandomState(12)
+    prompt = rng.randint(1, 500, size=(17,)).astype(np.int32)
+    filler1 = rng.randint(1, 500, size=(17,)).astype(np.int32)
+    filler2 = rng.randint(1, 500, size=(17,)).astype(np.int32)
+
+    eng.submit(prompt, max_new_tokens=4, tenant="alice")
+    _drive_engine(eng, clock)
+    eng.submit(filler1, max_new_tokens=4, tenant="alice")
+    _drive_engine(eng, clock)
+    eng.submit(filler2, max_new_tokens=4, tenant="alice")   # pressure
+    _drive_engine(eng, clock)
+    assert eng.host_kv.pages >= 2
+    assert eng.prefix_probe(prompt, tenant="alice") >= 8
+    assert eng.prefix_probe(prompt, tenant="bob") == 0
+
+    before = eng.host_onboard_tokens
+    h = eng.submit(prompt, max_new_tokens=4, tenant="bob")
+    _drive_engine(eng, clock)
+    np.testing.assert_array_equal(
+        np.asarray(h.result(timeout=0)), _reference(gpt_tiny, prompt, 4))
+    assert eng.host_onboard_tokens == before    # no cross-tenant onboard
+    eng.pool.check_balance()
+
+
+def test_ledger_books_spill_and_onboard_phases(gpt_tiny):
+    """With economics armed, spill serialization and host onboarding
+    are attributed to their own ledger phases (kv_spill / kv_onboard)
+    instead of vanishing into the host frame — the phase tiling stays
+    exact."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving.clock import SimClock
+
+    class _Ticking(SimClock):
+        def now(self):
+            self._t += 0.0002
+            return self._t
+
+    clock = _Ticking()
+    eng = serving.LLMEngine(
+        gpt_tiny,
+        serving.LLMEngineConfig(num_slots=2, block_len=8, n_blocks=4,
+                                host_kv_bytes=1 << 22, economics=True),
+        clock=clock)
+    rng = np.random.RandomState(13)
+    prompts = [rng.randint(1, 500, size=(17,)).astype(np.int32)
+               for _ in range(3)]
+    for p in prompts:
+        eng.submit(p, max_new_tokens=4)
+        _drive_engine(eng, clock)
+    assert eng.host_kv.pages >= 2
+    eng.submit(prompts[0], max_new_tokens=4)    # warm-from-host
+    _drive_engine(eng, clock)
+    assert eng.host_onboard_tokens >= 16
+
+    ph = eng.ledger.snapshot()["phase_seconds"]
+    assert set(("kv_spill", "kv_onboard")) <= set(ph)
+    assert ph["kv_spill"] > 0.0
+    assert ph["kv_onboard"] > 0.0
+
+
+# ---- disaggregation: prefill -> decode handoff ----
+
+def test_handoff_prefill_to_decode_bit_identical_greedy(gpt_tiny):
+    """Role-specialized fleet: admission lands on the prefill replica,
+    the finished prefill exports KV + lane in one atomic call, and the
+    stream continues on the decode replica — bit-identical to the
+    uninterrupted single-engine run, with the handoff visible in router
+    metrics, flight events, and the destination's kv-import counter."""
+    from paddle_tpu import serving
+    from paddle_tpu.obs.flight_recorder import flight_recorder
+
+    flight_recorder().clear()
+    clock = serving.SimClock()
+    router, reps = _disagg_fleet(gpt_tiny, clock)
+    rng = np.random.RandomState(14)
+    prompts = [rng.randint(1, 500, size=(9,)).astype(np.int32)
+               for _ in range(3)]
+    handles = [router.submit(p, max_new_tokens=10) for p in prompts]
+    assert all(h._replica is reps[0] for h in handles)   # prefill-first
+
+    _drive_router(router, clock)
+    for h, p in zip(handles, prompts):
+        np.testing.assert_array_equal(
+            np.asarray(h.result(timeout=0)), _reference(gpt_tiny, p, 10))
+        assert h._replica is reps[1]                     # decoded there
+
+    snap = router.metrics.snapshot()
+    assert snap["handoffs"] == 3 and snap["handoffs_failed"] == 0
+    assert snap["completed"] == 3 and snap["failed"] == 0
+    assert router.metrics.handoff_quantile_ms(0.99) is not None
+    # one-token prefill on the destination: the handed-off KV covers
+    # prompt'+emitted-1, so each stream imports (9 + 1) - 1 = 9 tokens
+    assert reps[1].engine.kv_import_tokens == 3 * 9
+    events = [e for e in flight_recorder().snapshot()["events"]
+              if e["kind"] == "router_handoff"]
+    assert len(events) == 3
+    assert all(e["src"] == "replica0" and e["dst"] == "replica1"
+               for e in events)
+    assert all(e["kv_tokens"] == 9 for e in events)
+    kv_exports = [e for e in flight_recorder().snapshot()["events"]
+                  if e["kind"] == "kv_export"]
+    assert len(kv_exports) == 3
+    for r in reps:
+        r.engine.pool.check_balance()
+    # healthz advertises the specialization
+    hz = router.healthz()
+    assert hz["roles"] == {"replica0": "prefill", "replica1": "decode"}
+    flat = serving.parse_exposition(router.metrics.render())
+    assert flat["pdtpu_router_handoffs_total"] == 3
+    assert flat[
+        'pdtpu_router_replica_role_info{replica="replica0",'
+        'role="prefill"}'] == 1
+
+
+def test_handoff_sampled_stream_lane_restore_bit_identical(gpt_tiny):
+    """Seeded sampled stream across the handoff: the exported lane
+    carries the RNG counter and the destination resumes drawing at
+    stream index len(emitted) — bit-identical to the same request on a
+    single mixed engine (which is itself deterministic by ISSUE 18)."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving.llm.sampling import SamplingParams
+
+    sp = SamplingParams(temperature=0.9, top_k=50, top_p=0.95, seed=77)
+    prompt = np.arange(5, 17, dtype=np.int32)
+
+    clock0 = serving.SimClock()
+    solo = serving.LLMEngine(
+        gpt_tiny,
+        serving.LLMEngineConfig(num_slots=4, block_len=8, n_blocks=4),
+        clock=clock0)
+    h_solo = solo.submit(prompt, max_new_tokens=12, sampling=sp)
+    _drive_engine(solo, clock0)
+    ref = np.asarray(h_solo.result(timeout=0))
+
+    clock = serving.SimClock()
+    router, reps = _disagg_fleet(gpt_tiny, clock)
+    h = router.submit(prompt, max_new_tokens=12, sampling=sp)
+    _drive_router(router, clock)
+    np.testing.assert_array_equal(np.asarray(h.result(timeout=0)), ref)
+    assert router.metrics.snapshot()["handoffs"] == 1
+    assert reps[1].engine.kv_import_tokens > 0
+
+
+@pytest.mark.fault_matrix
+def test_decode_crash_mid_handoff_resumes_bit_identical(gpt_tiny):
+    """Crash the decode replica IMMEDIATELY after the handoff landed on
+    it (no further tokens emitted): the staged KV payload is still
+    fresh, so the failover re-places the SAME payload on the surviving
+    decode replica — one-token prefill, no prompt recompute — and the
+    stream finishes bit-identical to an uninterrupted run. Zero dropped
+    streams."""
+    from paddle_tpu import serving
+
+    clock = serving.SimClock()
+    router, reps = _disagg_fleet(gpt_tiny, clock,
+                                 roles=("prefill", "decode", "decode"))
+    rng = np.random.RandomState(15)
+    prompt = rng.randint(1, 500, size=(9,)).astype(np.int32)
+    h = router.submit(prompt, max_new_tokens=10)
+    assert h._replica is reps[0]
+
+    steps = 0
+    while router.metrics.snapshot()["handoffs"] == 0:
+        clock.advance(0.01)
+        router.pump()
+        steps += 1
+        assert steps < 200, "handoff never happened"
+    dst = h._replica
+    assert dst.role == "decode"
+    emitted_at_handoff = h._prefix.size
+    assert emitted_at_handoff >= 1
+    assert h._staged_kv is not None
+
+    dst.crash()                       # decode dies holding the stream
+    _drive_router(router, clock)
+    np.testing.assert_array_equal(
+        np.asarray(h.result(timeout=0)), _reference(gpt_tiny, prompt, 10))
+    assert h.failovers == 1
+    survivor = [r for r in reps if r.role == "decode" and r is not dst][0]
+    # staged-KV reuse, not a re-prefill: the survivor imported the row
+    assert survivor.engine.kv_import_tokens == \
+        prompt.size + emitted_at_handoff - 1
+    survivor.engine.pool.check_balance()
+    snap = router.metrics.snapshot()
+    assert snap["completed"] == 1 and snap["failed"] == 0
+
+
+# ---- per-token logprobs (satellite) ----
+
+def test_logprobs_parity_with_host_recompute(gpt_tiny):
+    """logprobs=True surfaces the raw model distribution's log p of each
+    emitted token. Parity oracle: a teacher-forced host forward over
+    concat(prompt, tokens[:-1]) with float32 log_softmax. Float tolerance
+    (np.allclose), NOT bitwise: the engine computes its gather inside the
+    jitted step. The token stream itself must stay bit-identical whether
+    or not logprobs ride along."""
+    import jax
+    from paddle_tpu import serving
+
+    clock = serving.SimClock()
+    eng = serving.LLMEngine(
+        gpt_tiny,
+        serving.LLMEngineConfig(num_slots=4, block_len=8, n_blocks=4),
+        clock=clock)
+    prompt = np.arange(3, 12, dtype=np.int32)
+
+    h_plain = eng.submit(prompt, max_new_tokens=8)
+    h_lp = eng.submit(prompt, max_new_tokens=8, logprobs=True)
+    _drive_engine(eng, clock)
+    toks = np.asarray(h_lp.result(timeout=0))
+    np.testing.assert_array_equal(np.asarray(h_plain.result(timeout=0)),
+                                  toks)
+    assert h_plain.logprobs_so_far() == [None] * 8      # not requested
+
+    lps = h_lp.logprobs_so_far()
+    assert len(lps) == 8 and all(isinstance(x, float) for x in lps)
+    full = np.concatenate([prompt, toks])
+    logits = np.asarray(gpt_tiny(full[None, :-1].astype(np.int32)).numpy())
+    ref_lp = np.asarray(
+        jax.nn.log_softmax(logits.astype(np.float32), axis=-1))[0]
+    want = [float(ref_lp[prompt.size - 1 + j, toks[j]])
+            for j in range(8)]
+    assert np.allclose(lps, want, rtol=1e-4, atol=1e-5), (lps, want)
+
+
+def test_logprobs_stitched_across_handoff(gpt_tiny):
+    """The router surfaces one logprob per emitted token even when the
+    stream crossed a prefill->decode handoff: the prefill-side values
+    are absorbed with the tokens and the decode side appends — same
+    values as a single-engine run of the same request."""
+    from paddle_tpu import serving
+
+    prompt = np.arange(2, 11, dtype=np.int32)
+    clock0 = serving.SimClock()
+    solo = serving.LLMEngine(
+        gpt_tiny,
+        serving.LLMEngineConfig(num_slots=4, block_len=8, n_blocks=4),
+        clock=clock0)
+    h_solo = solo.submit(prompt, max_new_tokens=10, logprobs=True)
+    _drive_engine(solo, clock0)
+    ref_lp = h_solo.logprobs_so_far()
+
+    clock = serving.SimClock()
+    router, _ = _disagg_fleet(gpt_tiny, clock)
+    h = router.submit(prompt, max_new_tokens=10, logprobs=True)
+    _drive_router(router, clock)
+    np.testing.assert_array_equal(np.asarray(h.result(timeout=0)),
+                                  np.asarray(h_solo.result(timeout=0)))
+    got = h.logprobs_so_far()
+    assert len(got) == 10 and None not in got
+    assert np.allclose(got, ref_lp, rtol=1e-4, atol=1e-5)
+
+
+def test_server_logprobs_param_and_400(gpt_tiny):
+    """HTTP surface: logprobs=true returns one logprob per token;
+    a non-boolean logprobs value is a 400, not a lenient coercion."""
+    from paddle_tpu import serving
+
+    eng = serving.LLMEngine(
+        gpt_tiny, serving.LLMEngineConfig(num_slots=2, block_len=8,
+                                          n_blocks=4))
+    srv = serving.ServingServer(llm_engine=eng, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"input_ids": [1, 2, 3, 4],
+                             "max_new_tokens": 4,
+                             "logprobs": True}).encode(),
+            method="POST")
+        with urllib.request.urlopen(req, timeout=120) as r:
+            body = json.loads(r.read())
+        assert len(body["logprobs"]) == len(body["tokens"]) == 4
+        assert all(isinstance(x, float) for x in body["logprobs"])
+
+        bad = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"input_ids": [1, 2, 3],
+                             "logprobs": 1}).encode(),
+            method="POST")
+        try:
+            urllib.request.urlopen(bad, timeout=120)
+            assert False, "non-boolean logprobs must 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "logprobs" in json.loads(e.read())["error"]
+
+        # absent -> no logprobs key in the response at all
+        req2 = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"input_ids": [1, 2, 3],
+                             "max_new_tokens": 2}).encode(),
+            method="POST")
+        with urllib.request.urlopen(req2, timeout=120) as r:
+            assert "logprobs" not in json.loads(r.read())
+    finally:
+        srv.stop()

@@ -159,7 +159,20 @@ GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
              # assembly cost, as a percent of pump wall time from the
              # ledger's sample_mask phase, is a CEILING
              "llm_sampled_tok_s": "higher",
-             "llm_mask_overhead_pct": "lower"}
+             "llm_mask_overhead_pct": "lower",
+             # ISSUE 19 tiered-KV / disaggregation gates (`bench.py --llm`
+             # tiered phase): the warm-replay host-tier hit rate (fraction
+             # of onboardable full-block prompt tokens actually served
+             # from host RAM instead of re-prefilled) and the host→HBM
+             # onboard token rate are FLOORS — a change that stops
+             # spilling under pressure or re-prefills what the host tier
+             # holds must fail the gate — and the p99 prefill→decode
+             # handoff latency (export to re-place, router summary) is a
+             # CEILING: staged-KV handoff must never degenerate into a
+             # queued re-prefill
+             "llm_tiered_hit_rate": "higher",
+             "llm_onboard_tok_s": "higher",
+             "llm_handoff_ms": "lower"}
 
 
 def _metrics_of(row):
@@ -182,7 +195,9 @@ def _metrics_of(row):
               "fleet_qps_scaling", "fleet_failover_resume_ms",
               "deploy_ttft_p99_ms", "deploy_dropped_streams",
               "llm_spec_tok_s", "llm_spec_accept_rate",
-              "llm_sampled_tok_s", "llm_mask_overhead_pct"):
+              "llm_sampled_tok_s", "llm_mask_overhead_pct",
+              "llm_tiered_hit_rate", "llm_onboard_tok_s",
+              "llm_handoff_ms"):
         if extra.get(k) is not None:
             out[k] = float(extra[k])
     return out

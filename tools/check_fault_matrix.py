@@ -98,7 +98,13 @@ sampling scenario in tests/test_sampling.py (`fault_matrix`-marked: a
 replica hard-crashed MID-SAMPLED-STREAM fails over and the survivor's
 re-prefill restores the RNG-lane counter — `sample_offset` — so the
 resumed seeded stream is token-identical to the uninterrupted seeded
-run, the determinism contract extended past greedy) — then prints a
+run, the determinism contract extended past greedy), and the ISSUE 19
+disaggregation scenario in tests/test_tiered.py (`tiered`-marked
+module: a decode-role replica hard-crashed immediately after accepting
+a prefill→decode handoff re-places the stream's STAGED KV payload on a
+surviving decode replica — one-token prefill, no prompt recompute —
+and the stream finishes bit-identical to an uninterrupted run with the
+destination pool's page ledger balanced) — then prints a
 pass/fail table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
@@ -133,6 +139,7 @@ TEST_FILES = [
     os.path.join("tests", "test_deploy.py"),
     os.path.join("tests", "test_spec_decode.py"),
     os.path.join("tests", "test_sampling.py"),
+    os.path.join("tests", "test_tiered.py"),
 ]
 
 
