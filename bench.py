@@ -1067,6 +1067,104 @@ def run_llm_bench():
             "llm_handoffs_failed": rsnap["handoffs_failed"],
             "tiered_prompts": n_tier,
         })
+    # ---- multi-LoRA phase (ISSUE 20): ONE seeded Poisson trace replayed
+    # twice — base-only through an UNARMED engine, then through an
+    # adapter-armed engine with 8 concurrent adapters round-robined over
+    # the requests, so every dispatch mixes rows of several adapters in
+    # the one unified step. llm_lora_tok_s (FLOOR) is the armed pass's
+    # throughput; llm_lora_overhead_pct (CEILING, ≤15% at pin time) is
+    # the armed-vs-base drop — the gathered low-rank delta must stay a
+    # marginal cost of the step, never per-adapter dispatches. The
+    # analytic per-token adapter FLOPs (obs.flops.
+    # lora_decode_flops_per_token) ride along ungated for sizing.
+    if os.environ.get("BENCH_LLM_LORA", "1") != "0":
+        from paddle_tpu.obs.flops import lora_decode_flops_per_token
+        from paddle_tpu.tuning import target_sites
+        n_lora = int(os.environ.get("BENCH_LLM_LORA_REQUESTS", "12"))
+        lora_hz = float(os.environ.get("BENCH_LLM_LORA_RATE_HZ",
+                                       str(rate_hz)))
+        lora_new = int(os.environ.get("BENCH_LLM_LORA_MAX_NEW", "8"))
+        n_adapters = int(os.environ.get("BENCH_LLM_LORA_ADAPTERS", "8"))
+        lora_rank = int(os.environ.get("BENCH_LLM_LORA_RANK", "4"))
+        l_rng = np.random.RandomState(20)
+        l_prompts, l_gaps, l_new = _poisson_prompt_trace(
+            l_rng, n_lora, lora_hz, vocab, max_new=lora_new)
+
+        def _lora_replay(eng, adapter_ids):
+            lh = []
+            t0 = time.perf_counter()
+            t_next = t0
+            for i, (gap, p, m) in enumerate(
+                    zip(l_gaps, l_prompts, l_new)):
+                t_next += gap
+                delay = t_next - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                kw = ({"adapter": adapter_ids[i % len(adapter_ids)]}
+                      if adapter_ids else {})
+                try:
+                    lh.append(eng.submit(p, max_new_tokens=int(m), **kw))
+                except RejectedError:
+                    pass
+            toks = 0
+            for h in lh:
+                try:
+                    toks += int(h.result(timeout=120).size)
+                except Exception:
+                    pass
+            return toks, time.perf_counter() - t0
+
+        mk_cfg = lambda **kw: LLMEngineConfig(
+            num_slots=num_slots, block_len=8,
+            n_blocks=max(4, -(-(64 + max_new) // 8)),
+            max_queue_depth=max(4 * num_slots, 64), **kw)
+        b_eng = LLMEngine(model, mk_cfg())
+        b_eng.start()
+        b_eng.generate(l_prompts[0], max_new_tokens=2, timeout=300)
+        b_toks, b_dt = _lora_replay(b_eng, None)
+        b_eng.stop(drain=True)
+
+        l_eng = LLMEngine(model, mk_cfg(max_adapters=n_adapters,
+                                        lora_rank=lora_rank))
+        l_eng.start()
+        # synthetic adapters in the bank's exact canonical layout: small
+        # random deltas (nonzero B so the gathered matmul does real work)
+        sites, _arch = target_sites(model)
+        aids = []
+        for a in range(n_adapters):
+            a_rng = np.random.RandomState(100 + a)
+            tree = {
+                str(i): {
+                    name: {"A": (0.01 * a_rng.randn(
+                                lora_rank, io[0])).astype(np.float32),
+                           "B": (0.01 * a_rng.randn(
+                                io[1], lora_rank)).astype(np.float32)}
+                    for name, io in layer.items()}
+                for i, layer in enumerate(sites)}
+            aid = f"bench-ad{a}"
+            l_eng.register_adapter(aid, tree)
+            aids.append(aid)
+        l_eng.generate(l_prompts[0], max_new_tokens=2, timeout=300)
+        l_toks, l_dt = _lora_replay(l_eng, aids)
+        adapter_tokens = dict(
+            l_eng.metrics.snapshot().get("adapter_tokens", {}))
+        l_eng.stop(drain=True)
+        lora_base_tok_s = b_toks / b_dt if b_dt > 0 else 0.0
+        lora_tok_s = l_toks / l_dt if l_dt > 0 else 0.0
+        overhead_pct = (100.0 * (lora_base_tok_s - lora_tok_s)
+                        / lora_base_tok_s if lora_base_tok_s > 0 else 0.0)
+        dims_flat = [io for layer in sites for io in layer.values()]
+        result["extra"].update({
+            "llm_lora_tok_s": round(lora_tok_s, 1),
+            "llm_lora_base_tok_s": round(lora_base_tok_s, 1),
+            "llm_lora_overhead_pct": round(overhead_pct, 4),
+            "llm_lora_flops_per_token": lora_decode_flops_per_token(
+                lora_rank, dims_flat),
+            "llm_lora_adapter_tokens": adapter_tokens,
+            "lora_adapters": n_adapters,
+            "lora_rank": lora_rank,
+            "lora_requests": n_lora,
+        })
     print(json.dumps(result))
 
 

@@ -426,11 +426,14 @@ class WeightSet:
 
     @classmethod
     def publish(cls, directory: str, version: str, params,
-                golden: Optional[Dict[str, Any]] = None) -> "WeightSet":
+                golden: Optional[Dict[str, Any]] = None,
+                extra: Optional[Dict[str, Any]] = None) -> "WeightSet":
         """Write `params` as a certified weight set. Data lands first
         (tmp → fsync → rename), the manifest last — a crash at any point
         leaves either no manifest (uncertified, refused by deploys) or a
-        fully certified pair."""
+        fully certified pair. `extra` merges additional manifest keys
+        (subclass metadata — e.g. the adapter signature) and may not
+        shadow the protocol keys."""
         from .framework_io import save as _save
         ws = cls(directory, version)
         os.makedirs(ws.directory, exist_ok=True)
@@ -442,6 +445,13 @@ class WeightSet:
         spec = {"version": ws.version, "format": cls.FORMAT,
                 "crc32": _file_crc(tmp_data), "time": time.time(),
                 "leaves": _leaf_specs(params)}
+        if extra:
+            clash = set(extra) & set(spec) | ({"golden"} & set(extra))
+            if clash:
+                raise ValueError(
+                    f"extra manifest keys {sorted(clash)} shadow the "
+                    "weight-set protocol")
+            spec.update(extra)
         if golden is not None:
             spec["golden"] = golden
         with open(tmp_manifest, "w") as f:
@@ -508,6 +518,60 @@ class WeightSet:
         """The manifest's golden canary block, if published (certifies as
         a side effect — golden data from an uncertified set is useless)."""
         return self.certify().get("golden")
+
+
+class AdapterWeightSet(WeightSet):
+    """A certified **adapter-only** weight set (ISSUE 20).
+
+    Same protocol as `WeightSet` (tmp→fsync→rename, manifest-last,
+    CRC-certified, optional golden block) with its own format string so
+    a base-weight deploy can never accidentally consume an adapter tree
+    and vice versa, plus a mandatory `adapter` manifest block carrying
+    `tuning.lora.adapter_signature` of the base model the adapter was
+    trained against. `certify_for(signature)` is the deploy-side gate:
+    full CRC certification AND a field-by-field signature comparison,
+    with a typed `UncertifiedWeightsError(reason="adapter_mismatch")`
+    refusal when the serving fleet's base model disagrees on rank,
+    target modules, layer count or projection dims — a rank-16 adapter
+    must never be gathered into a rank-8 bank."""
+
+    FORMAT = "pdtpu.adapter.v1"
+
+    @classmethod
+    def publish(cls, directory: str, version: str, params,
+                signature: Dict[str, Any],
+                golden: Optional[Dict[str, Any]] = None,
+                ) -> "AdapterWeightSet":
+        if not isinstance(signature, dict) or "rank" not in signature:
+            raise ValueError(
+                "AdapterWeightSet.publish requires the adapter_signature "
+                "dict of the base model (got "
+                f"{type(signature).__name__})")
+        return super().publish(directory, version, params, golden=golden,
+                               extra={"adapter": signature})
+
+    def certify_for(self, signature: Dict[str, Any]) -> Dict[str, Any]:
+        """Certify bytes AND bind to a base model: raises a typed
+        refusal unless the manifest's adapter signature matches
+        `signature` exactly. Returns the manifest dict."""
+        spec = self.certify()
+        published = spec.get("adapter")
+        if not isinstance(published, dict):
+            raise UncertifiedWeightsError(
+                f"adapter set {self.version!r} manifest carries no "
+                "adapter signature", reason="adapter_mismatch")
+        diff = sorted(
+            k for k in set(published) | set(signature)
+            if published.get(k) != signature.get(k))
+        if diff:
+            pub = {k: published.get(k) for k in diff}
+            want = {k: signature.get(k) for k in diff}
+            raise UncertifiedWeightsError(
+                f"adapter set {self.version!r} was trained against a "
+                f"different base model: mismatched field(s) {diff} "
+                f"(published {pub!r}, serving {want!r})",
+                reason="adapter_mismatch")
+        return spec
 
 
 # ---- continuous checkpointing tier ----

@@ -128,23 +128,31 @@ def make_decoder_fns(model):
     engine's chunked-prefill mixed dispatch). Left as None, attention runs
     the trivial contiguous-table path — the same kernel, so streams stay
     bit-identical across the two callers at a shared block size.
+
+    Both also accept `adapters=(per_layer_banks, adapter_idx [B],
+    scale [K])` — the per-slot LoRA parameter-indirection operand
+    (ISSUE 20). per_layer_banks[i] maps site name -> (A [K, r, in],
+    B [K, out, r]) stacked device arrays; each row gathers its own bank
+    row inside the step, so K adapters share one executable and bank row
+    0 (all-zeros) keeps adapter-less rows bit-identical to base. Left as
+    None, the adapted projections are not even traced.
     """
     params, buffers = model.functional_state()
 
-    def prefill(p, prompt, caches_, pos, paged=None):
+    def prefill(p, prompt, caches_, pos, paged=None, adapters=None):
         with model._bound_state(p, buffers), no_grad():
             logits, new_caches = model.forward_with_cache(
                 Tensor(prompt),
                 [(Tensor(k), Tensor(v)) for k, v in caches_], pos,
-                paged=paged)
+                paged=paged, adapters=adapters)
         return logits.data, [(k.data, v.data) for k, v in new_caches]
 
-    def decode_step(p, tok, pos, caches_, paged=None):
+    def decode_step(p, tok, pos, caches_, paged=None, adapters=None):
         with model._bound_state(p, buffers), no_grad():
             logits, new_caches = model.forward_with_cache(
                 Tensor(tok[:, None]),
                 [(Tensor(k), Tensor(v)) for k, v in caches_], pos,
-                paged=paged)
+                paged=paged, adapters=adapters)
         return logits.data[:, 0], [(k.data, v.data)
                                    for k, v in new_caches]
 
@@ -173,8 +181,9 @@ def make_verify_fn(model):
     executable serves prefill, plain decode, and verification."""
     params, prefill, _ = make_decoder_fns(model)
 
-    def verify(p, toks, caches_, pos, paged=None):
-        logits, new_caches = prefill(p, toks, caches_, pos, paged=paged)
+    def verify(p, toks, caches_, pos, paged=None, adapters=None):
+        logits, new_caches = prefill(p, toks, caches_, pos, paged=paged,
+                                     adapters=adapters)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_caches
 
     return params, verify

@@ -19,6 +19,7 @@ from ..distributed.meta_parallel.mp_layers import (ColumnParallelLinear,
 from ..nn import Dropout, Embedding, LayerNorm
 from ..nn import functional as F
 from ..nn.layer.layers import Layer, LayerList
+from ..ops.lora import add_lora_delta
 from ..ops.attention import decode_attention, flash_attention, \
     update_kv_cache
 
@@ -83,10 +84,17 @@ class GPTAttention(Layer):
                                           input_is_parallel=True)
         self.dropout_p = config.attention_dropout_prob
 
-    def forward(self, hidden, cache=None, pos=None, paged=None):
+    def forward(self, hidden, cache=None, pos=None, paged=None,
+                adapters=None):
         qkv = self.qkv_proj(hidden)
         hd = self.head_dim
         if cache is not None:
+            if adapters is not None:
+                # gathered per-row LoRA delta on the fused qkv projection
+                # (ISSUE 20); row 0 of the bank is zeros = base pass-through
+                amap, aidx, ascale = adapters
+                qkv = add_lora_delta(qkv, hidden, amap.get("qkv_proj"),
+                                     aidx, ascale)
             k_cache, v_cache = cache
 
             def attn_dec(a, kc, vc, pos_):
@@ -109,7 +117,11 @@ class GPTAttention(Layer):
                         kc, vc)
 
             ctx, new_k, new_v = apply(attn_dec, qkv, k_cache, v_cache, pos)
-            return self.out_proj(ctx), (new_k, new_v)
+            out = self.out_proj(ctx)
+            if adapters is not None:
+                out = add_lora_delta(out, ctx, amap.get("out_proj"),
+                                     aidx, ascale)
+            return out, (new_k, new_v)
 
         def attn(a):
             B, S, _ = a.shape
@@ -166,19 +178,29 @@ class GPTDecoderLayer(Layer):
             aux = None
         return x + self.dropout(h), aux
 
-    def forward(self, x, cache=None, pos=None, paged=None):
+    def forward(self, x, cache=None, pos=None, paged=None, adapters=None):
         if cache is not None:
             if self.use_moe:
                 raise NotImplementedError(
                     "KV-cache decode is not wired through MoE layers yet")
             h, new_cache = self.self_attn(self.norm1(x), cache=cache,
-                                          pos=pos, paged=paged)
+                                          pos=pos, paged=paged,
+                                          adapters=adapters)
             # same dropout as the training forward (identity in eval), so
             # forward_with_cache on a training-mode model matches forward()
             x = x + self.dropout(h)
-            h = self.linear1(self.norm2(x))
+            h_in = self.norm2(x)
+            h = self.linear1(h_in)
+            if adapters is not None:
+                amap, aidx, ascale = adapters
+                h = add_lora_delta(h, h_in, amap.get("linear1"),
+                                   aidx, ascale)
             h = apply(lambda a: jax.nn.gelu(a), h)
-            x = x + self.dropout(self.linear2(h))
+            h2 = self.linear2(h)
+            if adapters is not None:
+                h2 = add_lora_delta(h2, h, amap.get("linear2"),
+                                    aidx, ascale)
+            x = x + self.dropout(h2)
             return x, new_cache
         if self._use_recompute and self.training:
             from ..distributed.fleet.utils.recompute import recompute
@@ -208,9 +230,12 @@ class GPTModel(Layer):
         self.final_norm = LayerNorm(config.hidden_size,
                                     epsilon=config.layer_norm_eps)
 
-    def forward(self, input_ids, caches=None, pos=None, paged=None):
+    def forward(self, input_ids, caches=None, pos=None, paged=None,
+                adapters=None):
         """Returns (hidden, total_aux_loss) — aux is None for dense models.
-        With caches: (hidden, new_caches), positions offset by `pos`."""
+        With caches: (hidden, new_caches), positions offset by `pos`.
+        `adapters` is the per-slot LoRA indirection operand
+        (per_layer_banks, adapter_idx, scale) — see ops/lora.py."""
         S = input_ids.shape[1]
         from ..core.tensor import Tensor, apply as _apply
         from ..tensor.creation import arange
@@ -226,9 +251,11 @@ class GPTModel(Layer):
                 self.position_embeddings(pos_ids)
             hidden = self.dropout(hidden)  # identity in eval; parity with
             new_caches = []                # the training forward
-            for layer, cache in zip(self.layers, caches):
+            for i, (layer, cache) in enumerate(zip(self.layers, caches)):
+                layer_ad = None if adapters is None else (
+                    adapters[0][i], adapters[1], adapters[2])
                 hidden, nc = layer(hidden, cache=cache, pos=pos,
-                                   paged=paged)
+                                   paged=paged, adapters=layer_ad)
                 new_caches.append(nc)
             return self.final_norm(hidden), new_caches
         pos_ids = arange(S, dtype="int64")
@@ -314,9 +341,10 @@ class GPTForCausalLM(Layer):
         return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
                 for _ in range(cfg.num_hidden_layers)]
 
-    def forward_with_cache(self, input_ids, caches, pos, paged=None):
+    def forward_with_cache(self, input_ids, caches, pos, paged=None,
+                           adapters=None):
         hidden, new_caches = self.gpt(input_ids, caches=caches, pos=pos,
-                                      paged=paged)
+                                      paged=paged, adapters=adapters)
         return self.lm_head(hidden), new_caches
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,

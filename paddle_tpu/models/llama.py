@@ -28,6 +28,7 @@ from ..nn import functional as F
 from ..nn.layer.layers import Layer, LayerList
 from ..ops.attention import decode_attention, flash_attention, \
     update_kv_cache
+from ..ops.lora import add_lora_delta
 
 
 @dataclass
@@ -124,7 +125,7 @@ class LlamaAttention(Layer):
                                         has_bias=False, input_is_parallel=True)
 
     def forward(self, hidden, attn_mask=None, cache=None, pos=None,
-                paged=None):
+                paged=None, adapters=None):
         if attn_mask is not None:
             raise NotImplementedError(
                 "padding masks are not wired into the fused attention yet; "
@@ -136,8 +137,19 @@ class LlamaAttention(Layer):
         hd = self.head_dim
         theta = self.config.rope_theta
         if cache is not None:
+            if adapters is not None:
+                # gathered per-row LoRA deltas (ISSUE 20); bank row 0 is
+                # zeros so adapter-less rows stay bit-identical to base
+                amap, aidx, ascale = adapters
+                q = add_lora_delta(q, hidden, amap.get("q_proj"),
+                                   aidx, ascale)
+                k = add_lora_delta(k, hidden, amap.get("k_proj"),
+                                   aidx, ascale)
+                v = add_lora_delta(v, hidden, amap.get("v_proj"),
+                                   aidx, ascale)
             return self._forward_cached(q, k, v, cache, pos, n_rep, hd,
-                                        theta, paged=paged)
+                                        theta, paged=paged,
+                                        adapters=adapters)
 
         def attn(qa, ka, va):
             qh = qa.reshape(qa.shape[0], qa.shape[1], -1, hd)
@@ -162,7 +174,7 @@ class LlamaAttention(Layer):
         return self.o_proj(ctx)
 
     def _forward_cached(self, q, k, v, cache, pos, n_rep, hd, theta,
-                        paged=None):
+                        paged=None, adapters=None):
         """Static-shape KV-cache decode/prefill step (jit/scan friendly):
         new k/v are written into the [B, Hkv, Lmax, D] cache at `pos`,
         attention runs over the FULL cache with an absolute-position causal
@@ -201,7 +213,11 @@ class LlamaAttention(Layer):
             return out, kc, vc
 
         ctx, new_k, new_v = apply(attn_dec, q, k, v, k_cache, v_cache, pos)
-        return self.o_proj(ctx), (new_k, new_v)
+        out = self.o_proj(ctx)
+        if adapters is not None:
+            amap, aidx, ascale = adapters
+            out = add_lora_delta(out, ctx, amap.get("o_proj"), aidx, ascale)
+        return out, (new_k, new_v)
 
 
 class LlamaMLP(Layer):
@@ -215,11 +231,20 @@ class LlamaMLP(Layer):
         self.down_proj = RowParallelLinear(i, h, has_bias=False,
                                            input_is_parallel=True)
 
-    def forward(self, x):
+    def forward(self, x, adapters=None):
         gate = self.gate_proj(x)
         up = self.up_proj(x)
+        if adapters is not None:
+            amap, aidx, ascale = adapters
+            gate = add_lora_delta(gate, x, amap.get("gate_proj"),
+                                  aidx, ascale)
+            up = add_lora_delta(up, x, amap.get("up_proj"), aidx, ascale)
         act = apply(lambda g, u: jax.nn.silu(g) * u, gate, up)
-        return self.down_proj(act)
+        down = self.down_proj(act)
+        if adapters is not None:
+            down = add_lora_delta(down, act, amap.get("down_proj"),
+                                  aidx, ascale)
+        return down
 
 
 class LlamaDecoderLayer(Layer):
@@ -243,15 +268,16 @@ class LlamaDecoderLayer(Layer):
         h = self.mlp(h)
         return residual + h
 
-    def forward(self, hidden, cache=None, pos=None, paged=None):
+    def forward(self, hidden, cache=None, pos=None, paged=None,
+                adapters=None):
         if cache is not None:
             residual = hidden
             h, new_cache = self.self_attn(self.input_layernorm(hidden),
                                           cache=cache, pos=pos,
-                                          paged=paged)
+                                          paged=paged, adapters=adapters)
             hidden = residual + h
             hidden = hidden + self.mlp(
-                self.post_attention_layernorm(hidden))
+                self.post_attention_layernorm(hidden), adapters=adapters)
             return hidden, new_cache
         if self._use_recompute and self.training:
             from ..distributed.fleet.utils.recompute import recompute
@@ -269,13 +295,16 @@ class LlamaModel(Layer):
                                  for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids, caches=None, pos=None, paged=None):
+    def forward(self, input_ids, caches=None, pos=None, paged=None,
+                adapters=None):
         hidden = self.embed_tokens(input_ids)
         if caches is not None:
             new_caches = []
-            for layer, cache in zip(self.layers, caches):
+            for i, (layer, cache) in enumerate(zip(self.layers, caches)):
+                layer_ad = None if adapters is None else (
+                    adapters[0][i], adapters[1], adapters[2])
                 hidden, nc = layer(hidden, cache=cache, pos=pos,
-                                   paged=paged)
+                                   paged=paged, adapters=layer_ad)
                 new_caches.append(nc)
             return self.norm(hidden), new_caches
         for layer in self.layers:
@@ -315,9 +344,10 @@ class LlamaForCausalLM(Layer):
         return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
                 for _ in range(cfg.num_hidden_layers)]
 
-    def forward_with_cache(self, input_ids, caches, pos, paged=None):
+    def forward_with_cache(self, input_ids, caches, pos, paged=None,
+                           adapters=None):
         hidden, new_caches = self.llama(input_ids, caches=caches, pos=pos,
-                                        paged=paged)
+                                        paged=paged, adapters=adapters)
         return self.lm_head(hidden), new_caches
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,

@@ -76,6 +76,23 @@ def decode_flops_per_token(n_params: int) -> float:
     return 2.0 * n_params
 
 
+def lora_decode_flops_per_token(rank: int, target_dims) -> float:
+    """Extra forward FLOPs per token for one LoRA-adapted row (ISSUE 20).
+
+    Each adapted site adds two skinny matmuls to the base projection:
+    ``x[in] @ A.T[in, r]`` then ``z[r] @ B.T[r, out]`` — `2*r*(in+out)`
+    FLOPs under the same 2·MAC convention as `decode_flops_per_token`.
+    `target_dims` is an iterable of per-site `(in_features,
+    out_features)` pairs covering EVERY adapted site of EVERY layer
+    (i.e. `num_layers * len(targets)` entries — the caller flattens,
+    mirroring how the MoE correction counts active params, not per-layer
+    shorthand). The adapter-overhead analytics in bench.py's lora phase
+    and docs sizing math both call this, so the bound can never diverge
+    from the measured `llm_lora_overhead_pct` by formula."""
+    r = int(rank)
+    return float(sum(2.0 * r * (int(i) + int(o)) for i, o in target_dims))
+
+
 def decode_mfu(flops_per_token: float, tokens: int, seconds: float,
                peak_flops_total: float):
     """Effective decode MFU: achieved decode FLOP/s over peak.

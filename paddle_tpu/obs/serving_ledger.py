@@ -108,6 +108,10 @@ class ServingLedger(PhaseLedger):
         self._class_seconds: Dict[str, float] = {}
         self._class_tokens: Dict[str, int] = {}
         self._class_draft_tokens: Dict[str, int] = {}
+        # multi-LoRA serving (ISSUE 20): the same per-row shares
+        # re-bucketed by adapter id ("base" for row-0 streams)
+        self._adapter_seconds: Dict[str, float] = {}
+        self._adapter_tokens: Dict[str, int] = {}
 
     def set_decode_flops(self, flops_per_token: float,
                          peak_flops_total: float):
@@ -131,13 +135,17 @@ class ServingLedger(PhaseLedger):
         self._class_seconds.clear()
         self._class_tokens.clear()
         self._class_draft_tokens.clear()
+        self._adapter_seconds.clear()
+        self._adapter_tokens.clear()
 
     # ---- per-dispatch attribution ----
     def book_dispatch(self, device_seconds: float, prefill_positions: int,
                       decode_positions: int, total_positions: int,
                       owners: Iterable[Tuple[str, str, int]],
                       draft_positions: int = 0, drafted: int = 0,
-                      draft_accepted: int = 0):
+                      draft_accepted: int = 0,
+                      adapter_owners: Optional[
+                          Iterable[Tuple[str, int]]] = None):
         """Attribute ONE successful device dispatch.
 
         `device_seconds` is the measured execution span (dispatch →
@@ -160,6 +168,12 @@ class ServingLedger(PhaseLedger):
         rejected window columns simply never enter `useful` — wasted
         speculation surfaces as pad-waste in `token_efficiency`, which is
         the observable the accept-rate runbook watches.
+
+        Multi-LoRA (ISSUE 20): `adapter_owners` is one
+        `(adapter_id, positions)` pair per active row — the same rows as
+        `owners`, bucketed by adapter ("base" for pass-through rows) —
+        so per-adapter device seconds are a re-partition of the tenant
+        totals, not a second measurement.
         """
         device_seconds = max(float(device_seconds), 0.0)
         useful = int(prefill_positions) + int(decode_positions)
@@ -205,6 +219,21 @@ class ServingLedger(PhaseLedger):
                         self._tenant_tokens.get(tenant, 0) + positions
                     self._class_tokens[slo] = \
                         self._class_tokens.get(slo, 0) + positions
+            if adapter_owners is not None:
+                # ISSUE 20: the SAME per-row shares re-bucketed by adapter
+                # id ("base" for row-0 streams) — same formula, same
+                # advanced denominator, so per-adapter device seconds sum
+                # exactly to the per-tenant totals of the same dispatch.
+                for adapter, positions in adapter_owners:
+                    positions = int(positions)
+                    if positions <= 0 or advanced <= 0:
+                        continue
+                    share = device_seconds * positions / advanced
+                    self._adapter_seconds[adapter] = \
+                        self._adapter_seconds.get(adapter, 0.0) + share
+                    if not is_draft:
+                        self._adapter_tokens[adapter] = \
+                            self._adapter_tokens.get(adapter, 0) + positions
 
     # ---- reporting ----
     def snapshot(self) -> dict:
@@ -231,6 +260,9 @@ class ServingLedger(PhaseLedger):
                            "draft_tokens":
                                self._class_draft_tokens.get(c, 0)}
                       for c, s in self._class_seconds.items()}
+            adapters = {a: {"device_seconds": s,
+                            "tokens": self._adapter_tokens.get(a, 0)}
+                        for a, s in self._adapter_seconds.items()}
         compute = (phases["prefill_compute"] + phases["decode_compute"]
                    + phases["draft_compute"])
         mfu = decode_mfu(self.flops_per_token, decode_toks,
@@ -253,6 +285,7 @@ class ServingLedger(PhaseLedger):
             "spec_accept_rate": (accepted / drafted) if drafted else None,
             "tenants": tenants,
             "classes": classes,
+            "adapters": adapters,
         }
 
 

@@ -5,3 +5,4 @@ most fusion is XLA's job; Pallas covers what XLA can't fuse well (blockwise
 attention over long sequences, sharded softmax-CE).
 """
 from .attention import flash_attention, scaled_dot_product_attention  # noqa: F401
+from .lora import add_lora_delta, lora_delta, lora_matmul  # noqa: F401

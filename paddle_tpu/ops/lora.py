@@ -1,0 +1,62 @@
+"""Gathered per-row low-rank (LoRA) delta for multi-adapter batched decode.
+
+One jitted unified step serves K adapters concurrently: the bank stacks every
+adapter's factors as ``A [K, r, in]`` / ``B [K, out, r]`` device arrays and each
+batch row carries an ``adapter_idx`` into the stack.  The delta is a gathered
+per-row low-rank matmul — no per-adapter executables, so adapters can churn
+without a single recompile.  Row 0 of the bank is all-zeros: ``x @ 0 @ 0`` is
+exact zeros, so ``adapter=None`` rows (idx 0) stay bit-identical to the base
+model.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def lora_delta(x, A, B, idx, scale):
+    """Per-row low-rank delta, gathered from stacked adapter banks.
+
+    x:     [B, T, in]   activations entering the adapted projection
+    A:     [K, r, in]   stacked down-projections
+    B:     [K, out, r]  stacked up-projections
+    idx:   [B] int32    bank row per batch row (0 = base pass-through)
+    scale: [K] float32  per-adapter alpha/rank scaling
+
+    Returns [B, T, out] in x.dtype.  Each batch row only touches its own bank
+    row, so a mixed batch matches per-adapter solo decode token-for-token.
+    """
+    Ag = jnp.take(A, idx, axis=0)  # [B, r, in]
+    Bg = jnp.take(B, idx, axis=0)  # [B, out, r]
+    z = jnp.einsum("bti,bri->btr", x.astype(jnp.float32), Ag.astype(jnp.float32))
+    d = jnp.einsum("btr,bor->bto", z, Bg.astype(jnp.float32))
+    d = d * jnp.take(scale, idx)[:, None, None]
+    return d.astype(x.dtype)
+
+
+def lora_matmul(x, A, B):
+    """Un-gathered low-rank product ``(x @ A^T) @ B^T`` for a single adapter.
+
+    Training-path primitive behind ``LoRALinear``: x [..., in], A [r, in],
+    B [out, r] -> [..., out] in float32 (caller scales and casts).
+    """
+    z = jnp.einsum("...i,ri->...r", x.astype(jnp.float32), A.astype(jnp.float32))
+    return jnp.einsum("...r,or->...o", z, B.astype(jnp.float32))
+
+
+def add_lora_delta(y, x, entry, idx, scale):
+    """Tensor-level bridge: add the gathered delta for one projection site.
+
+    y/x are autograd Tensors (serving runs under no_grad); entry is ``(A, B)``
+    raw bank arrays for this site, or None when the site is not adapted — the
+    projection output passes through untouched.
+    """
+    if entry is None:
+        return y
+    from ..core.tensor import apply
+
+    A, B = entry
+
+    def _add(yv, xv, Av, Bv, iv, sv):
+        return yv + lora_delta(xv, Av, Bv, iv, sv).astype(yv.dtype)
+
+    return apply(_add, y, x, A, B, idx, scale)

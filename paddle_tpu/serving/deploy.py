@@ -52,10 +52,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..checkpoint import UncertifiedWeightsError
 from ..obs.deploy_metrics import DeployMetrics
 from ..obs.flight_recorder import flight_recorder
 from ..utils.fault_injection import global_plan
 from .clock import Clock, SimClock
+from .llm.lora import AdapterError
 
 _log = logging.getLogger("paddle_tpu.serving.deploy")
 
@@ -527,3 +529,148 @@ class DeploymentController:
         # sequence; drop the atomic dump now that the story is complete
         flight_recorder().try_dump(
             reason=f"deploy_rollback:{job['version']}")
+
+    # ---- adapter rollout (ISSUE 20) ----
+
+    def deploy_adapter(self, weightset, adapter_id: Optional[str] = None,
+                       alpha: Optional[float] = None) -> Dict[str, Any]:
+        """Fleet-wide LoRA adapter rollout — the lightweight sibling of
+        `start()`/`pump()`, completing synchronously in one call.
+
+        An adapter swap needs NONE of the base machinery's heavy phases:
+        no drain (base weights and every other bank row are untouched —
+        in-flight streams keep decoding through the whole rollout), no
+        recompile (the bank's operand shapes are fixed), no settle. Per
+        live replica: `register_adapter` rewrites the bank row between
+        pump iterations (stashing the prior row as a rollback token),
+        then golden prompts greedy-decode THROUGH the adapter
+        (`canary_probe(adapter=...)`) — finite logits, and token
+        sequences bit-identical to the manifest golden block or to the
+        first replica through the gate. Any refusal or canary failure
+        rolls the row back on every replica that already took it
+        (`rollback_adapter`, newest first), so the fleet is never left
+        serving a half-deployed or NaN adapter. Zero streams dropped in
+        either direction.
+
+        `weightset` must be an `AdapterWeightSet`; it is certified
+        against the fleet's bank signature (`certify_for` — typed
+        `adapter_mismatch` refusal on rank/target-module skew).
+        `adapter_id` defaults to the weight-set version. Returns the
+        history record ({"outcome": "completed" | "rolled_back", ...}).
+        """
+        with self._lock:
+            if self._job is not None:
+                raise RuntimeError(
+                    f"deploy of {self._job['version']!r} in progress; an "
+                    "adapter rollout cannot interleave with a base-weight "
+                    "rollout")
+            live = [r for r in self.router.replicas if not r.crashed]
+            if not live:
+                raise RuntimeError("no live replica to deploy to")
+            banks = []
+            for r in live:
+                bank = getattr(r.engine, "adapter_bank", None)
+                if bank is None:
+                    raise RuntimeError(
+                        f"replica {r.name} serves without an adapter bank "
+                        "(config.max_adapters=0)")
+                banks.append(bank)
+            if not hasattr(weightset, "certify_for"):
+                raise UncertifiedWeightsError(
+                    "adapter rollout requires an AdapterWeightSet "
+                    f"(got {type(weightset).__name__}); base WeightSets "
+                    "go through start()", reason="bad_format")
+            manifest = weightset.certify_for(banks[0].signature)
+            tree = weightset.load()
+            aid = str(adapter_id or weightset.version)
+            plan = global_plan()
+            poisoned = (plan is not None
+                        and plan.maybe_bad_weights(self._deploy_seq))
+            self._deploy_seq += 1
+            if poisoned:
+                tree = _nan_poison(tree)
+            prompts = [list(map(int, p))
+                       for p in self.config.canary_prompts]
+            reference: Optional[List[np.ndarray]] = None
+            golden = manifest.get("golden")
+            if golden:
+                prompts = [list(map(int, p)) for p in golden["prompts"]]
+                if golden.get("tokens"):
+                    reference = [np.asarray(t, np.int32)
+                                 for t in golden["tokens"]]
+            now = self.clock.now()
+            self.metrics.on_start(f"adapter:{aid}")
+            flight_recorder().record(
+                "adapter_deploy_started", adapter=aid,
+                version=weightset.version,
+                replicas=[r.name for r in live],
+                bad_weights_injected=bool(poisoned))
+            snaps: Dict[str, Any] = {}    # name -> rollback token
+            order: List[str] = []         # registration order
+            done: List[str] = []
+            fail: Optional[str] = None
+            for r in live:
+                try:
+                    snaps[r.name] = r.engine.register_adapter(
+                        aid, tree, alpha=alpha)
+                    order.append(r.name)
+                except AdapterError as e:
+                    # typed refusal — the row was never written, so this
+                    # replica needs no rollback
+                    fail = f"register_fail:{r.name}:{e.reason}"
+                    break
+                outputs: List[np.ndarray] = []
+                for i, prompt in enumerate(prompts):
+                    toks, finite = r.engine.canary_probe(
+                        prompt, self.config.canary_max_new_tokens,
+                        adapter=aid)
+                    if not finite:
+                        fail = f"nonfinite_logits:{r.name}:prompt{i}"
+                        break
+                    if reference is not None:
+                        ref = reference[i]
+                        if toks.shape != ref.shape \
+                                or not np.array_equal(toks, ref):
+                            fail = f"reference_mismatch:{r.name}:prompt{i}"
+                            break
+                    outputs.append(toks)
+                self.metrics.on_canary(fail is None)
+                if fail is not None:
+                    break
+                if reference is None:
+                    # first replica through the gate defines bit-identity
+                    reference = outputs
+                done.append(r.name)
+            duration = self.clock.now() - now
+            if fail is None:
+                flight_recorder().record(
+                    "adapter_deploy_complete", adapter=aid,
+                    replicas=done, duration_s=round(duration, 4))
+                record = {"version": f"adapter:{aid}",
+                          "outcome": "completed", "reason": None,
+                          "swapped": done, "skipped": [],
+                          "duration_s": duration}
+                self.metrics.on_finish("completed", duration)
+                self._history.append(record)
+                return record
+            # fleet auto-rollback: every replica whose row was rewritten
+            # takes its prior row back (None token = fresh load → unload)
+            restored = []
+            for name in reversed(order):
+                r = self.router._replica_by_name(name)
+                if r.crashed:
+                    continue
+                r.engine.rollback_adapter(aid, snaps[name])
+                restored.append(name)
+            self.metrics.on_rollback(fail)
+            flight_recorder().record(
+                "adapter_deploy_rollback", adapter=aid, reason=fail,
+                restored=restored, duration_s=round(duration, 4))
+            record = {"version": f"adapter:{aid}",
+                      "outcome": "rolled_back", "reason": fail,
+                      "swapped": done, "skipped": [],
+                      "duration_s": duration}
+            self.metrics.on_finish("rolled_back", duration)
+            self._history.append(record)
+            flight_recorder().try_dump(reason=f"adapter_rollback:{aid}")
+            return record

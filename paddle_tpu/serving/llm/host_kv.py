@@ -139,13 +139,21 @@ class HostKVPool:
         return covered
 
     # ---- maintenance / views ----
-    def clear(self):
-        """Drop everything — called on weight swap: spilled KV is a pure
-        function of (weights, tokens), so stale-version pages are poison."""
+    def clear(self, only=None):
+        """Drop spilled pages — called on weight swap: spilled KV is a
+        pure function of (weights, tokens), so stale-version pages are
+        poison. `only` (ISSUE 20) is an optional predicate on the
+        namespace key: an adapter hot-swap drops exactly that adapter's
+        pages; None drops everything."""
         with self._lock:
-            self._pages.clear()
-            self._sizes.clear()
-            self.bytes_used = 0
+            if only is None:
+                self._pages.clear()
+                self._sizes.clear()
+                self.bytes_used = 0
+                return
+            for key in [k for k in self._pages if only(k[0])]:
+                self._pages.pop(key)
+                self.bytes_used -= self._sizes.pop(key)
 
     @property
     def pages(self) -> int:

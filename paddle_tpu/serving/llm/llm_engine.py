@@ -133,6 +133,7 @@ from ..supervisor import (DispatchFailedError, DispatchHungError,  # noqa: F401
                           EngineSupervisor)
 from .host_kv import HostKVPool
 from .kv_pool import SlotPagedKVPool, SlotsExhaustedError
+from .lora import AdapterBank, AdapterError
 from .prefix_cache import PrefixCache
 from .sampling import (GREEDY, SamplingParams, SlotSamplingTable,
                        compile_grammar, select_next, select_tokens)
@@ -238,6 +239,24 @@ class LLMEngineConfig:
     #                                pressure eviction and re-onboards them
     #                                at admission instead of re-prefilling;
     #                                0 = device-only caching (prior behavior)
+    # ---- multi-LoRA serving (ISSUE 20) ----
+    max_adapters: int = 0          # > 0 arms the AdapterBank: that many
+    #                                hot-swappable LoRA adapter rows ride
+    #                                the ONE unified step through a
+    #                                per-slot adapter_idx lane (bank row 0
+    #                                is the all-zero base pass-through, so
+    #                                adapter=None streams stay
+    #                                bit-identical); 0 = no bank and the
+    #                                step's operands/executable are
+    #                                byte-identical to the pre-LoRA engine
+    lora_rank: int = 8             # bank row rank — part of the step's
+    #                                traced operand shapes, so fixed at
+    #                                construction; loading an adapter of
+    #                                any other rank is a typed refusal,
+    #                                never a recompile
+    lora_alpha: Optional[float] = None  # default scaling numerator for
+    #                                rows loaded without an explicit
+    #                                alpha (None = 2 * lora_rank)
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -286,6 +305,15 @@ class LLMEngineConfig:
         if self.host_kv_bytes < 0:
             raise ValueError(
                 f"host_kv_bytes must be >= 0, got {self.host_kv_bytes}")
+        if self.max_adapters < 0:
+            raise ValueError(
+                f"max_adapters must be >= 0, got {self.max_adapters}")
+        if self.lora_rank < 1:
+            raise ValueError(
+                f"lora_rank must be >= 1, got {self.lora_rank}")
+        if self.lora_alpha is not None and self.lora_alpha <= 0:
+            raise ValueError(
+                f"lora_alpha must be > 0, got {self.lora_alpha}")
         if not 0.0 < self.slo_burn_budget <= 1.0:
             raise ValueError(
                 f"slo_burn_budget must be in (0, 1], got "
@@ -370,7 +398,7 @@ class _GenRequest:
                  "attached_pages", "rid", "trace", "draft_slot",
                  "spec_off", "draft_attached", "sampling",
                  "sample_offset", "gid", "dfa_state0",
-                 "want_logprobs", "kv_row")
+                 "want_logprobs", "kv_row", "adapter")
 
     def __init__(self, prompt, max_new_tokens, eos_token_id, arrival,
                  deadline, slo, submit_idx, tenant="default"):
@@ -435,6 +463,11 @@ class _GenRequest:
         #                                       handoff import); admission
         #                                       uploads it instead of
         #                                       re-prefilling
+        # multi-LoRA serving (ISSUE 20)
+        self.adapter: Optional[str] = None    # AdapterBank id whose
+        #                                       low-rank delta this stream
+        #                                       decodes under; None = base
+        #                                       model (bank row 0)
 
 
 class LLMEngine:
@@ -478,6 +511,16 @@ class LLMEngine:
             self.config.num_slots, vocab_size,
             max_grammars=self.config.max_grammars,
             max_dfa_states=self.config.max_dfa_states)
+        # multi-LoRA bank (ISSUE 20): K stacked adapter trees + a per-slot
+        # adapter_idx lane appended to the unified step's operands. None
+        # unless armed, so an unarmed engine's step signature — and its
+        # compiled executable — stays byte-identical to the pre-LoRA one.
+        self.adapter_bank: Optional[AdapterBank] = None
+        if self.config.max_adapters > 0:
+            self.adapter_bank = AdapterBank(
+                model, self.config.max_adapters, self.config.lora_rank,
+                self.config.num_slots,
+                default_alpha=self.config.lora_alpha)
         if not self.config.weight_version:
             raise ValueError("weight_version must be a non-empty string")
         self.weight_version = self.config.weight_version
@@ -669,11 +712,18 @@ class LLMEngine:
             prefill = self._prefill_fn
 
             def step(params, toks, pos, adv, table, slabs, temp, topk,
-                     topp, samp, seed, ctr, dstate, gid, bank):
+                     topp, samp, seed, ctr, dstate, gid, bank,
+                     adapters=None):
+                # `adapters` (ISSUE 20) is the AdapterBank's stacked LoRA
+                # operand — (per-layer A/B banks, per-slot adapter_idx,
+                # per-row scale). An unarmed engine never passes it, so
+                # its traced signature is unchanged; an armed engine
+                # passes a fixed-structure pytree whose leaf VALUES churn
+                # as adapters load/swap — zero recompiles either way.
                 seq_lens = (pos + adv).astype(jnp.int32)
                 paged = (table, seq_lens, block_len, pages_per_row)
                 logits, new_slabs = prefill(params, toks, slabs, pos,
-                                            paged=paged)
+                                            paged=paged, adapters=adapters)
                 sel, new_state = select_tokens(
                     logits, adv, temp, topk, topp, samp, seed, ctr,
                     dstate, gid, bank)
@@ -703,6 +753,16 @@ class LLMEngine:
         temp, topk, topp, samp, seed, dstate, gid = tab.device_args()
         return (temp, topk, topp, samp, seed, jnp.asarray(ctr),
                 dstate, gid, tab.device_bank())
+
+    def _adapter_args_locked(self):
+        """The unified step's adapter operand as a (possibly empty) args
+        tail (ISSUE 20): () when no bank is armed — the step is then
+        called with its pre-LoRA 15-arg signature — else the bank's
+        cached device views, rebuilt only after a row load/swap or a
+        slot bind (same invalidation idiom as the sampling table)."""
+        if self.adapter_bank is None:
+            return ()
+        return (self.adapter_bank.device_args(),)
 
     def _draft_step(self):
         """Draft-pool analogue of `_step` (ISSUE 17): the chunk-wide
@@ -807,6 +867,8 @@ class LLMEngine:
         diverges from the target's."""
         self.pool.free(slot)
         self.sampling_table.clear(slot)
+        if self.adapter_bank is not None:
+            self.adapter_bank.clear_slot(slot)
         if self.draft_pool is not None and req.draft_slot is not None:
             if self.draft_pool.active[req.draft_slot]:
                 self.draft_pool.free(req.draft_slot)
@@ -1090,6 +1152,7 @@ class LLMEngine:
                 },
                 "lane": lane,
                 "weight_version": self.weight_version,
+                "adapter": req.adapter,
             }
             self._conclude(req, "handoff")
             self._free_row_locked(req, slot)
@@ -1161,7 +1224,91 @@ class LLMEngine:
             "weight_swap", engine="llm", version=str(version),
             prior=prior, leaves=len(new_leaves), flushed_blocks=flushed)
 
-    def canary_probe(self, prompt, max_new_tokens: int = 4):
+    # ---- multi-LoRA adapter lifecycle (ISSUE 20) ----
+    def _flush_adapter_kv(self, adapter_id: str):
+        """Drop ONE adapter's `(tenant, adapter)` KV namespaces from both
+        cache tiers: its cached KV was computed under the delta being
+        replaced. Base and other-adapter namespaces stay warm."""
+        suffix = f"\x00adapter:{adapter_id}"
+        if self.prefix_cache is not None:
+            # clears the matching host-tier namespaces too
+            self.prefix_cache.clear(only=lambda ns: ns.endswith(suffix))
+        elif self.host_kv is not None:
+            self.host_kv.clear(only=lambda ns: ns.endswith(suffix))
+
+    def _require_bank(self) -> AdapterBank:
+        if self.adapter_bank is None:
+            raise AdapterError(
+                "engine built without an adapter bank "
+                "(config.max_adapters=0)", reason="adapter_unavailable")
+        return self.adapter_bank
+
+    def register_adapter(self, adapter_id: str, tree,
+                         alpha: Optional[float] = None):
+        """Load — or hot-swap, when the id is already resident — one
+        adapter into a bank row. Unlike `replace_params` this needs NO
+        drain: the swap rewrites bank-row values between pump
+        iterations while the step executable and every other row's
+        streams are untouched (base weights included), which is what
+        makes adapter rollout zero-downtime by construction. The tree
+        is validated against the base-model signature first (typed
+        AdapterError on rank/target/shape mismatch — never a
+        recompile).
+
+        Returns the PRIOR row snapshot (None for a fresh load) — the
+        rollback token `rollback_adapter` restores when a post-swap
+        canary fails."""
+        bank = self._require_bank()
+        prior = bank.snapshot_row(adapter_id)
+        row = bank.load(adapter_id, tree, alpha=alpha)
+        # flush the adapter's KV namespaces: cached pages were computed
+        # under the OLD delta (same reasoning as replace_params, scoped
+        # to one adapter's namespaces instead of the whole cache)
+        if prior is not None:
+            self._flush_adapter_kv(adapter_id)
+        flight_recorder().record(
+            "adapter_swap", engine="llm", adapter=str(adapter_id),
+            row=row, update=prior is not None,
+            bank_version=bank.version)
+        self.metrics.on_adapter_swap()
+        return prior
+
+    def rollback_adapter(self, adapter_id: str, snapshot):
+        """Restore a bank row to a `register_adapter` rollback token
+        (None = the adapter was fresh: unload it). The canary-failed
+        delta stops serving the instant the row is rewritten; in-flight
+        streams on the row continue on the restored values — no drop,
+        no drain."""
+        bank = self._require_bank()
+        bank.restore(adapter_id, snapshot)
+        self._flush_adapter_kv(adapter_id)
+        flight_recorder().record(
+            "adapter_rollback", engine="llm", adapter=str(adapter_id),
+            restored=snapshot is not None, bank_version=bank.version)
+        self.metrics.on_adapter_rollback()
+
+    def unregister_adapter(self, adapter_id: str):
+        """Unload an adapter and zero its row. Typed refusal while any
+        queued/active stream still decodes under it — unloading would
+        silently flip those streams to a zero delta mid-sequence."""
+        bank = self._require_bank()
+        with self._cond:
+            users = [r.rid for r in self._active.values()
+                     if r.adapter == adapter_id]
+            users += [r.rid for q in self._queues.values()
+                      for r in q if r.adapter == adapter_id]
+            if users:
+                raise AdapterError(
+                    f"adapter {adapter_id!r} still has {len(users)} "
+                    f"in-flight stream(s) ({users[:4]}...): drain or "
+                    "finish them first", reason="adapter_in_use")
+            bank.unload(adapter_id)
+        flight_recorder().record(
+            "adapter_unload", engine="llm", adapter=str(adapter_id),
+            bank_version=bank.version)
+
+    def canary_probe(self, prompt, max_new_tokens: int = 4,
+                     adapter: Optional[str] = None):
         """Golden-prompt canary: greedy-decode `max_new_tokens` tokens
         directly through the prefill/decode functions on the CONTIGUOUS
         cache path (paged=None — same kernel as the paged path at shared
@@ -1169,15 +1316,30 @@ class LLMEngine:
         checking every logits tensor for finiteness along the way.
         Runs outside the scheduler on purpose: the gate must work on a
         drained, placement-excluded replica before any traffic lands on
-        the new weights. Returns (tokens np.int32 [max_new_tokens],
-        logits_finite bool)."""
+        the new weights. `adapter` (ISSUE 20) probes through that bank
+        row's LoRA delta — the gate an adapter hot-swap must clear
+        before its rows keep serving — and raises a typed AdapterError
+        when the id is not loaded. Returns (tokens np.int32
+        [max_new_tokens], logits_finite bool)."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("canary prompt must be non-empty")
+        adapters = None
+        if adapter is not None:
+            if self.adapter_bank is None:
+                raise AdapterError(
+                    "engine built without an adapter bank "
+                    "(config.max_adapters=0)", reason="adapter_unavailable")
+            row = self.adapter_bank.row_of(adapter)
+            if row is None:
+                raise AdapterError(f"unknown adapter {adapter!r}",
+                                   reason="unknown_adapter")
+            adapters = self.adapter_bank.args_for_rows([row])
         total = int(prompt.size) + int(max_new_tokens)
         caches = self.model.init_cache(1, total)
         logits, caches = self._prefill_fn(
-            self.params, jnp.asarray(prompt[None, :]), caches, 0)
+            self.params, jnp.asarray(prompt[None, :]), caches, 0,
+            adapters=adapters)
         lg = np.asarray(logits)
         finite = bool(np.isfinite(lg).all())
         last = int(np.argmax(lg[0, -1]))
@@ -1186,7 +1348,7 @@ class LLMEngine:
         for _ in range(int(max_new_tokens) - 1):
             logits, caches = self._decode_fn(
                 self.params, jnp.asarray([last], dtype=jnp.int32),
-                pos, caches)
+                pos, caches, adapters=adapters)
             lg = np.asarray(logits)
             finite = finite and bool(np.isfinite(lg).all())
             last = int(np.argmax(lg[0]))
@@ -1307,7 +1469,8 @@ class LLMEngine:
                sample_offset: int = 0,
                logprobs: bool = False,
                kv_row: Optional[dict] = None,
-               lane: Optional[dict] = None) -> GenerationHandle:
+               lane: Optional[dict] = None,
+               adapter: Optional[str] = None) -> GenerationHandle:
         """Admit one prompt (1-D int token ids). `slo` names the request's
         SLO class (config.default_slo when None); `tenant` its isolation
         domain (config.default_tenant when None) — tenants get fair
@@ -1336,6 +1499,14 @@ class LLMEngine:
         constrained request restores its DFA state directly from the
         lane instead of re-walking the resumed tail.
 
+        ISSUE 20: `adapter` names a loaded AdapterBank row — the stream
+        then decodes under that adapter's LoRA delta on the SAME unified
+        step as its base/other-adapter neighbors. None rides bank row 0
+        (all-zero delta) and is bit-identical to a pre-LoRA engine.
+        Naming an adapter on an engine without a bank, or one that is
+        not loaded, is a typed reject ("adapter_unavailable" /
+        "unknown_adapter"), never a recompile.
+
         Raises RejectedError when the sequence can never fit a slot, the
         queue/token budget/tenant quota is exhausted and nothing
         lower-priority can be shed, the grammar bank is full, the engine
@@ -1359,6 +1530,24 @@ class LLMEngine:
         if not isinstance(tenant, str) or not tenant:
             raise ValueError("tenant must be a non-empty string")
         rid = rid or new_request_id()
+        if adapter is not None:
+            if self.adapter_bank is None:
+                self.metrics.on_reject("adapter_unavailable", tenant=tenant)
+                self._record_reject("adapter_unavailable", rid=rid,
+                                    tenant=tenant)
+                raise RejectedError(
+                    f"request names adapter {adapter!r} but the engine "
+                    "was built without an adapter bank "
+                    "(config.max_adapters=0)",
+                    reason="adapter_unavailable")
+            if self.adapter_bank.row_of(adapter) is None:
+                self.metrics.on_reject("unknown_adapter", tenant=tenant)
+                self._record_reject("unknown_adapter", rid=rid,
+                                    tenant=tenant)
+                raise RejectedError(
+                    f"adapter {adapter!r} is not loaded "
+                    f"(loaded: {self.adapter_bank.adapter_ids})",
+                    reason="unknown_adapter")
         eos = (self.config.eos_token_id if eos_token_id is None
                else eos_token_id)
         gid, dstate0 = 0, 0
@@ -1481,6 +1670,7 @@ class LLMEngine:
             req.dfa_state0 = dstate0
             req.want_logprobs = bool(logprobs)
             req.kv_row = kv_row
+            req.adapter = adapter
             if trace:
                 req.trace = RequestTrace(rid, now, slo=slo, tenant=tenant)
                 req.trace.event("submitted", now, prompt_len=int(prompt.size),
@@ -1508,7 +1698,19 @@ class LLMEngine:
                            deadline_ms=deadline_ms, slo=slo,
                            tenant=tenant, sampling=sampling).result(timeout)
 
-    def prefix_probe(self, prompt, tenant: Optional[str] = None) -> int:
+    @staticmethod
+    def _kv_ns(tenant: str, adapter: Optional[str]) -> str:
+        """Prefix-cache/host-KV namespace for a stream (ISSUE 20): KV
+        computed under an adapter's LoRA delta diverges from base KV
+        after the first adapted layer, so each `(tenant, adapter)` pair
+        gets its own radix namespace — adapter streams never attach base
+        pages and vice versa. The composed key rides the existing
+        string-tenant cache machinery unchanged (NUL cannot appear in a
+        tenant id, so the composition is injective)."""
+        return tenant if not adapter else f"{tenant}\x00adapter:{adapter}"
+
+    def prefix_probe(self, prompt, tenant: Optional[str] = None,
+                     adapter: Optional[str] = None) -> int:
         """Longest block-aligned cached-prefix match for `prompt` in this
         engine's radix cache, in tokens — 0 with the cache disabled.
         Read-only (no refcounts, ticks, or stats move): the replica
@@ -1520,14 +1722,19 @@ class LLMEngine:
         ISSUE 19: the probe consults BOTH tiers — a replica whose device
         cache evicted a prefix into its host pool can still onboard it
         without re-prefilling, so for placement scoring it is exactly as
-        warm as one still holding the pages in HBM."""
+        warm as one still holding the pages in HBM.
+
+        ISSUE 20: `adapter` probes that adapter's own `(tenant, adapter)`
+        namespace — router placement is then warmth-aware per adapter,
+        not just per tenant."""
         tenant = self.config.default_tenant if tenant is None else tenant
+        ns = self._kv_ns(tenant, adapter)
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        host = (self.host_kv.probe(tenant, prompt)
+        host = (self.host_kv.probe(ns, prompt)
                 if self.host_kv is not None else 0)
         if self.prefix_cache is None:
             return host
-        return max(self.prefix_cache.probe(tenant, prompt), host)
+        return max(self.prefix_cache.probe(ns, prompt), host)
 
     def inflight_tokens(self) -> int:
         """Current admitted token cost (queued + active): the router's
@@ -1702,7 +1909,7 @@ class LLMEngine:
                     # a COW tail, so an exact-duplicate prompt still
                     # costs only a one-token prefill
                     plan = self.prefix_cache.acquire(
-                        req.tenant, req.prompt,
+                        self._kv_ns(req.tenant, req.adapter), req.prompt,
                         max_tokens=len(req.prompt) - 1)
                     if plan.pages:
                         self.pool.attach_blocks(slot, plan.pages)
@@ -1740,7 +1947,8 @@ class LLMEngine:
                     # token always prefills
                     while (j + 1) * bl <= len(req.prompt) - 1:
                         layers = self.host_kv.get(
-                            req.tenant, req.prompt[:(j + 1) * bl])
+                            self._kv_ns(req.tenant, req.adapter),
+                            req.prompt[:(j + 1) * bl])
                         if layers is None:
                             break
                         self.pool.import_page(slot, j, layers)
@@ -1764,6 +1972,24 @@ class LLMEngine:
                 self.sampling_table.bind(slot, req.sampling or GREEDY,
                                          gid=req.gid,
                                          dfa_state=req.dfa_state0)
+                # multi-LoRA lane (ISSUE 20): point the slot's
+                # adapter_idx at the request's bank row. The adapter may
+                # have been unloaded between submit and admit — that is
+                # a typed reject here, never a wrong-delta decode.
+                if self.adapter_bank is not None:
+                    try:
+                        self.adapter_bank.bind_slot(slot, req.adapter)
+                    except AdapterError as e:
+                        self._conclude(req, "rejected:unknown_adapter")
+                        req.handle.future.set_exception(RejectedError(
+                            f"adapter {req.adapter!r} was unloaded before "
+                            f"admission ({e})", reason="unknown_adapter"))
+                        self.metrics.on_reject("unknown_adapter",
+                                               tenant=req.tenant)
+                        self._record_reject("unknown_adapter", rid=req.rid,
+                                            tenant=req.tenant)
+                        self._free_row_locked(req, slot)
+                        continue
                 # speculative decoding (ISSUE 17): give the request a row
                 # in the draft pool. Exhaustion is not an error — the
                 # request simply runs spec-off (plain decode is always
@@ -2142,7 +2368,11 @@ class LLMEngine:
 
     def _kinds_of(self, prefill_slots, decode_slots) -> Tuple:
         """(kind, request_ids) announcement order for fault injection:
-        prefill rows first, then decode rows, both at one dispatch idx."""
+        prefill rows first, then decode rows, both at one dispatch idx.
+        Rows riding an adapter (ISSUE 20) additionally announce kind
+        "adapter" at the SAME index, so a `poison_request@rid:adapter`
+        clause scopes a fault to one adapter's streams without touching
+        co-scheduled base/other-adapter rows."""
         kinds = []
         if prefill_slots:
             kinds.append(("prefill", tuple(sorted(
@@ -2150,6 +2380,11 @@ class LLMEngine:
         if decode_slots:
             kinds.append(("decode", tuple(sorted(
                 self._active[s].submit_idx for s in decode_slots))))
+        adapter_rows = [s for s in list(prefill_slots) + list(decode_slots)
+                        if self._active[s].adapter]
+        if adapter_rows:
+            kinds.append(("adapter", tuple(sorted(
+                self._active[s].submit_idx for s in adapter_rows))))
         return tuple(kinds)
 
     def _step_once(self) -> int:
@@ -2182,6 +2417,7 @@ class LLMEngine:
                 ts0 = self.clock.now()
                 sargs = self._sampling_args_locked(ctr)
                 mask_dt = self.clock.now() - ts0
+                aargs = self._adapter_args_locked()
             self.metrics.on_mask_overhead(mask_dt * 1e3)
             if self.ledger is not None:
                 self.ledger.book("sample_mask", mask_dt)
@@ -2189,7 +2425,7 @@ class LLMEngine:
             fn = self._step()
             args = (self.params, jnp.asarray(toks), jnp.asarray(pos),
                     jnp.asarray(adv), self.pool.device_block_table(),
-                    self.pool.slabs) + sargs
+                    self.pool.slabs) + sargs + aargs
             if self.observatory is not None:
                 self.observatory.observe_call("llm/unified_step", fn, args)
             attempts = self.config.dispatch_retries + 1
@@ -2253,6 +2489,9 @@ class LLMEngine:
                                    self._active[s].slo, int(adv[s]))
                                   for s in prefill_slots
                                   if s in self._active]
+                        adapter_owners = [
+                            (self._active[s].adapter or "base", int(adv[s]))
+                            for s in prefill_slots if s in self._active]
                         decode_useful = drafted = accepted = 0
                         for s in decode_slots:
                             req = self._active.get(s)
@@ -2261,13 +2500,18 @@ class LLMEngine:
                             emit_toks, acc, k = accept[s]
                             owners.append((req.tenant, req.slo,
                                            len(emit_toks)))
+                            adapter_owners.append((req.adapter or "base",
+                                                   len(emit_toks)))
                             decode_useful += len(emit_toks)
                             drafted += k
                             accepted += acc
                     # a verify row's rejected columns stay inside
                     # total_positions but out of the useful decode count:
                     # wasted draft positions surface as pad-waste in
-                    # token_efficiency, exactly like prefill padding
+                    # token_efficiency, exactly like prefill padding.
+                    # adapter_owners (ISSUE 20) re-buckets the SAME
+                    # per-row shares by adapter id, so per-adapter
+                    # device-seconds sum exactly to the tenant total.
                     self.ledger.book_dispatch(
                         tc1 - tc0,
                         prefill_positions=int(sum(adv[s]
@@ -2275,7 +2519,10 @@ class LLMEngine:
                         decode_positions=decode_useful,
                         total_positions=int(toks.size),
                         owners=owners,
-                        drafted=drafted, draft_accepted=accepted)
+                        drafted=drafted, draft_accepted=accepted,
+                        adapter_owners=(adapter_owners
+                                        if self.adapter_bank is not None
+                                        else None))
                 if self.observatory is not None:
                     # the span above already blocked on the result, so it
                     # is pure device execution — attribute it to this
@@ -2325,8 +2572,8 @@ class LLMEngine:
                             # is still active: siblings queued behind it
                             # attach without waiting for it to finish
                             self.prefix_cache.insert(
-                                req.tenant, req.prompt, slot,
-                                req.attached_pages)
+                                self._kv_ns(req.tenant, req.adapter),
+                                req.prompt, slot, req.attached_pages)
                         self._emit(req, int(nxt[slot, int(adv[slot]) - 1]),
                                    float(lps[slot, int(adv[slot]) - 1]))
                         if req.gid:
@@ -2450,13 +2697,23 @@ class LLMEngine:
             with self._cond:
                 # probe with the REAL sampling operands: a poisoning that
                 # only reproduces under the row's grammar mask or sampled
-                # lane must still be attributable
+                # lane must still be attributable — and (ISSUE 20) with
+                # the REAL adapter operands, so an adapter-scoped fault
+                # reproduces in isolation too
                 sargs = self._sampling_args_locked(solo_ctr)
+                aargs = self._adapter_args_locked()
             args = (self.params, jnp.asarray(solo_toks),
                     jnp.asarray(solo_pos), jnp.asarray(solo_adv),
-                    self.pool.device_block_table(), self.pool.slabs) + sargs
+                    self.pool.device_block_table(),
+                    self.pool.slabs) + sargs + aargs
+            probe_kinds = [(kind, (req.submit_idx,))]
+            if req.adapter:
+                # the solo probe must announce the same adapter kind the
+                # full step did, or an adapter-keyed clause could not
+                # reproduce and the fault would look unattributable
+                probe_kinds.append(("adapter", (req.submit_idx,)))
             try:
-                self._run_dispatch(((kind, (req.submit_idx,)),), fn, args)
+                self._run_dispatch(tuple(probe_kinds), fn, args)
             except DispatchFailedError as e:
                 blamed.append((slot, req, e))
                 flight_recorder().record(
@@ -2530,6 +2787,8 @@ class LLMEngine:
             self.metrics.on_sample_token("constrained")
         elif req.sampling is not None and req.sampling.do_sample:
             self.metrics.on_sample_token("sampled")
+        if self.adapter_bank is not None:
+            self.metrics.on_adapter_token(req.adapter or "base")
 
     def _finish_if_done(self, req: _GenRequest, now: float) -> bool:
         """Retire a request whose last emitted token ended it (EOS,

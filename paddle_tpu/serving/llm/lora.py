@@ -1,0 +1,260 @@
+"""Multi-adapter LoRA bank for the unified prefill+decode step.
+
+Holds K stacked adapter trees on device — per decoder layer, per target site,
+``A [K, r, in]`` / ``B [K, out, r]`` — plus a per-slot ``adapter_idx`` lane.
+The ONE jitted unified step gathers each row's bank entry inside the dispatch
+(ops/lora.py), so adapters load/swap/unload without a single recompile: the
+operand shapes never change, only the values.  Row 0 is all-zeros and is what
+``adapter=None`` slots ride — exact-zero delta, bit-identical to base.
+
+Mutations go through host numpy staging + a cached device mirror (the
+``SlotSamplingTable`` idiom): a row load rebuilds only the touched layer/site
+arrays; binding a slot invalidates only the tiny [N] idx upload.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...tuning.lora import adapter_signature, target_sites
+
+
+class AdapterError(ValueError):
+    """Typed refusal: an adapter tree does not fit this bank/base model.
+
+    reason in {"bank_full", "unknown_adapter", "adapter_mismatch",
+    "rank_mismatch", "targets_mismatch", "layers_mismatch"}.
+    """
+
+    def __init__(self, msg, reason="adapter_mismatch"):
+        super().__init__(msg)
+        self.reason = reason
+
+
+class AdapterBank:
+    def __init__(self, model, max_adapters: int, rank: int,
+                 num_slots: int, default_alpha: Optional[float] = None):
+        if max_adapters < 1:
+            raise ValueError(f"max_adapters must be >= 1, got {max_adapters}")
+        if rank < 1:
+            raise ValueError(f"rank must be >= 1, got {rank}")
+        sites, arch = target_sites(model)
+        self.arch = arch
+        self.rank = int(rank)
+        self.max_adapters = int(max_adapters)
+        self.num_rows = self.max_adapters + 1  # row 0 = base pass-through
+        self.default_alpha = (2.0 * rank if default_alpha is None
+                              else float(default_alpha))
+        self.signature = adapter_signature(model, rank)
+        self._site_dims = sites[0]            # {site: (in, out)} — homogeneous
+        self._num_layers = len(sites)
+        K = self.num_rows
+        self._A: List[Dict[str, jnp.ndarray]] = [
+            {name: jnp.zeros((K, self.rank, i), jnp.float32)
+             for name, (i, o) in dims.items()} for dims in sites]
+        self._B: List[Dict[str, jnp.ndarray]] = [
+            {name: jnp.zeros((K, o, self.rank), jnp.float32)
+             for name, (i, o) in dims.items()} for dims in sites]
+        self._scale = np.zeros(K, np.float32)
+        self._slot_rows = np.zeros(int(num_slots), np.int32)
+        self._rows: Dict[str, int] = {}
+        self._free = list(range(1, K))
+        self._dev_layers = None
+        self._dev_slot = None
+        self.version = 0          # bumped on every row mutation
+        self._lock = threading.Lock()
+
+    # -- registry --
+    @property
+    def adapter_ids(self):
+        with self._lock:
+            return sorted(self._rows)
+
+    def row_of(self, adapter_id: str) -> Optional[int]:
+        with self._lock:
+            return self._rows.get(adapter_id)
+
+    def validate_tree(self, tree, rank: Optional[int] = None):
+        """Typed refusal when a tree's rank/target-module signature
+        mismatches the base model this bank was built for."""
+        r = self.rank if rank is None else int(rank)
+        if r != self.rank:
+            raise AdapterError(
+                f"adapter rank {r} != bank rank {self.rank}",
+                reason="rank_mismatch")
+        if not isinstance(tree, dict):
+            raise AdapterError(
+                f"adapter tree must be a dict, got {type(tree).__name__}")
+        want_layers = {str(i) for i in range(self._num_layers)}
+        if set(tree) != want_layers:
+            raise AdapterError(
+                f"adapter covers layers {sorted(tree)} but base model has "
+                f"layers {sorted(want_layers)}", reason="layers_mismatch")
+        want_sites = set(self._site_dims)
+        for li, layer_tree in tree.items():
+            if set(layer_tree) != want_sites:
+                raise AdapterError(
+                    f"layer {li} adapts {sorted(layer_tree)} but the bank "
+                    f"targets {sorted(want_sites)}",
+                    reason="targets_mismatch")
+            for name, entry in layer_tree.items():
+                in_f, out_f = self._site_dims[name]
+                A, B = np.asarray(entry["A"]), np.asarray(entry["B"])
+                if A.shape != (self.rank, in_f) or \
+                        B.shape != (out_f, self.rank):
+                    raise AdapterError(
+                        f"layer {li} site {name!r}: got A{A.shape}/"
+                        f"B{B.shape}, base model wants "
+                        f"A{(self.rank, in_f)}/B{(out_f, self.rank)}",
+                        reason="adapter_mismatch")
+
+    def load(self, adapter_id: str, tree, alpha: Optional[float] = None) -> int:
+        """Upsert an adapter into a bank row (hot swap when it exists).
+
+        Validates against the base-model signature first (typed refusal),
+        then rewrites the row's slices functionally — the step's operand
+        shapes are untouched, so no recompile.  Returns the row index.
+        """
+        adapter_id = str(adapter_id)
+        if not adapter_id:
+            raise AdapterError("adapter_id must be non-empty",
+                               reason="unknown_adapter")
+        self.validate_tree(tree)
+        with self._lock:
+            row = self._rows.get(adapter_id)
+            if row is None:
+                if not self._free:
+                    raise AdapterError(
+                        f"adapter bank full ({self.max_adapters} rows); "
+                        "unload an adapter first", reason="bank_full")
+                row = self._free.pop(0)
+                self._rows[adapter_id] = row
+            self._write_row_locked(row, tree,
+                                   self.default_alpha if alpha is None
+                                   else float(alpha))
+            return row
+
+    def _write_row_locked(self, row, tree, alpha):
+        for i in range(self._num_layers):
+            layer_tree = tree[str(i)]
+            for name in self._site_dims:
+                A = jnp.asarray(np.asarray(layer_tree[name]["A"],
+                                           np.float32))
+                B = jnp.asarray(np.asarray(layer_tree[name]["B"],
+                                           np.float32))
+                self._A[i][name] = self._A[i][name].at[row].set(A)
+                self._B[i][name] = self._B[i][name].at[row].set(B)
+        self._scale[row] = float(alpha) / self.rank
+        self._dev_layers = None
+        self._dev_slot = None
+        self.version += 1
+
+    def _zero_row_locked(self, row):
+        for i in range(self._num_layers):
+            for name in self._site_dims:
+                self._A[i][name] = self._A[i][name].at[row].set(0.0)
+                self._B[i][name] = self._B[i][name].at[row].set(0.0)
+        self._scale[row] = 0.0
+        self._dev_layers = None
+        self._dev_slot = None
+        self.version += 1
+
+    def unload(self, adapter_id: str):
+        with self._lock:
+            row = self._rows.pop(adapter_id, None)
+            if row is None:
+                raise AdapterError(f"unknown adapter {adapter_id!r}",
+                                   reason="unknown_adapter")
+            self._zero_row_locked(row)
+            self._free.insert(0, row)
+
+    def snapshot_row(self, adapter_id: str):
+        """Host copy of an adapter's current row (None when absent) — the
+        rollback token a hot swap stashes before overwriting."""
+        with self._lock:
+            row = self._rows.get(adapter_id)
+            if row is None:
+                return None
+            tree = {}
+            for i in range(self._num_layers):
+                tree[str(i)] = {
+                    name: {"A": np.asarray(self._A[i][name][row]),
+                           "B": np.asarray(self._B[i][name][row])}
+                    for name in self._site_dims}
+            return {"tree": tree,
+                    "alpha": float(self._scale[row]) * self.rank}
+
+    def restore(self, adapter_id: str, snap):
+        """Roll a row back to a snapshot_row() token; None = unload."""
+        if snap is None:
+            self.unload(adapter_id)
+            return
+        self.load(adapter_id, snap["tree"], alpha=snap["alpha"])
+
+    # -- per-slot lane --
+    def bind_slot(self, slot: int, adapter_id: Optional[str]) -> int:
+        if adapter_id is None or adapter_id == "":
+            row = 0
+        else:
+            with self._lock:
+                row = self._rows.get(adapter_id)
+            if row is None:
+                raise AdapterError(f"unknown adapter {adapter_id!r}",
+                                   reason="unknown_adapter")
+        self._slot_rows[slot] = row
+        self._dev_slot = None
+        return row
+
+    def clear_slot(self, slot: int):
+        self._slot_rows[slot] = 0
+        self._dev_slot = None
+
+    def slot_row(self, slot: int) -> int:
+        return int(self._slot_rows[slot])
+
+    def adapter_of_row(self, row: int) -> Optional[str]:
+        if row == 0:
+            return None
+        with self._lock:
+            for aid, r in self._rows.items():
+                if r == row:
+                    return aid
+        return None
+
+    # -- jit operands --
+    def device_args(self):
+        """(per_layer_banks, adapter_idx [N], scale [K]) — the adapters
+        operand of make_decoder_fns.  Pytree structure is fixed for the
+        bank's lifetime; only leaf values change as adapters churn."""
+        if self._dev_layers is None:
+            self._dev_layers = tuple(
+                {name: (self._A[i][name], self._B[i][name])
+                 for name in self._site_dims}
+                for i in range(self._num_layers))
+        if self._dev_slot is None:
+            self._dev_slot = (jnp.asarray(self._slot_rows),
+                              jnp.asarray(self._scale))
+        idx, scale = self._dev_slot
+        return self._dev_layers, idx, scale
+
+    def args_for_rows(self, rows):
+        """Adapters operand for an ad-hoc batch (canary probes, blame
+        probes): same banks, explicit row per batch row."""
+        if self._dev_layers is None:
+            self.device_args()
+        return (self._dev_layers,
+                jnp.asarray(np.asarray(rows, np.int32)),
+                jnp.asarray(self._scale))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "rank": self.rank,
+                "max_adapters": self.max_adapters,
+                "loaded": sorted(self._rows),
+                "free_rows": len(self._free),
+                "version": self.version,
+            }

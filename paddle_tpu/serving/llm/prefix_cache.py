@@ -362,16 +362,24 @@ class PrefixCache:
             released += 1
         return released
 
-    def clear(self) -> int:
-        """Release EVERY cached page and drop the whole trie, keeping the
-        pool ledger balanced. Used on an in-place weight swap (ISSUE 16):
+    def clear(self, only=None) -> int:
+        """Release cached pages and drop their trie(s), keeping the pool
+        ledger balanced. Used on an in-place weight swap (ISSUE 16):
         cached KV was computed under the old weights, and attaching it to
         a new-version prompt would stitch two weight sets inside one
         attention window. Caller must hold the engine idle (acquire-plan
-        refcounts all released); cached pins are dropped here. Returns
-        pages released."""
+        refcounts all released); cached pins are dropped here.
+
+        `only` (ISSUE 20) is an optional namespace predicate: an adapter
+        hot-swap invalidates exactly that adapter's `(tenant, adapter)`
+        namespaces, leaving base/other-adapter tries warm. None keeps
+        the original flush-everything contract. Returns pages
+        released."""
         released = 0
-        for tenant, root in self._roots.items():
+        victims = [t for t in self._roots
+                   if only is None or only(t)]
+        for tenant in victims:
+            root = self._roots[tenant]
             ts = self._ts(tenant)
             stack: List[Tuple[_Node, bool]] = [(root, True)]
             while stack:
@@ -390,14 +398,17 @@ class PrefixCache:
                     self.stats["evictions"] += 1
                 for c in node.children.values():
                     stack.append((c, False))
+            self.stats["cached_blocks"] -= ts["cached_blocks"]
             ts["cached_blocks"] = 0
-        self._roots.clear()
-        self.stats["cached_blocks"] = 0
+            del self._roots[tenant]
+        if only is None:
+            self._roots.clear()
+            self.stats["cached_blocks"] = 0
         if self.host_pool is not None:
             # spilled KV is a function of the weights that computed it —
             # a weight swap poisons the host tier the same way it poisons
-            # the device trie
-            self.host_pool.clear()
+            # the device trie (adapter-scoped when `only` is)
+            self.host_pool.clear(only=only)
         return released
 
     # ---- views ----

@@ -279,7 +279,9 @@ class LLMMetrics(ServingMetrics):
                               "spec_accepted": 0,
                               "spec_draft_quarantines": 0,
                               "sampled_tokens": 0,
-                              "constrained_tokens": 0})
+                              "constrained_tokens": 0,
+                              "adapter_swaps": 0,
+                              "adapter_rollbacks": 0})
         self.slots_active = 0
         self.slots_total = 0
         # per-SLO-class accounting (ISSUE 6 overload control): aggregate
@@ -323,6 +325,10 @@ class LLMMetrics(ServingMetrics):
         # HostKVPool's snapshot() each pump; None until a tiered engine
         # reports, so a device-only engine renders no host families
         self.host_kv: Optional[Dict[str, int]] = None
+        # multi-LoRA serving (ISSUE 18/20): emitted tokens per adapter id
+        # ("base" for row-0 streams) — on an armed engine every emission
+        # lands in exactly one bucket, so these sum to tokens_out
+        self.adapter_tokens: Dict[str, int] = {}
 
     def _class(self, slo) -> Optional[Dict[str, int]]:
         return self.class_counters.get(slo) if slo else None
@@ -518,6 +524,25 @@ class LLMMetrics(ServingMetrics):
         with self._lock:
             self.counters["spec_draft_quarantines"] += 1
 
+    # ---- multi-LoRA serving (ISSUE 20) ----
+    def on_adapter_token(self, adapter: str):
+        """One emitted token attributed to a LoRA adapter: `adapter` is
+        the bank id, or "base" for a row-0 (no-adapter) stream. The
+        per-adapter counters partition `tokens_out` exactly — the token
+        analogue of the ledger's adapter-seconds partitioning tenant
+        device-seconds."""
+        with self._lock:
+            self.adapter_tokens[adapter] = \
+                self.adapter_tokens.get(adapter, 0) + 1
+
+    def on_adapter_swap(self):
+        with self._lock:
+            self.counters["adapter_swaps"] += 1
+
+    def on_adapter_rollback(self):
+        with self._lock:
+            self.counters["adapter_rollbacks"] += 1
+
     def set_slots(self, active: int, total: int):
         with self._lock:
             self.slots_active = int(active)
@@ -597,6 +622,7 @@ class LLMMetrics(ServingMetrics):
             s["grammars_compiled"] = self.grammars_compiled
             s["host_kv"] = (dict(self.host_kv)
                             if self.host_kv is not None else None)
+            s["adapter_tokens"] = dict(self.adapter_tokens)
         s["mask_overhead_p99_ms"] = self.mask_overhead_quantile_ms(0.99)
         s["shed_rate"] = (s["shed"] / s["submitted"] if s["submitted"]
                           else 0.0)
@@ -661,6 +687,17 @@ class LLMMetrics(ServingMetrics):
                  {"quantile": "0.99"}, round_to=3)
         b.family(f"{px}_sample_grammars_compiled", "gauge")
         b.sample(f"{px}_sample_grammars_compiled", s["grammars_compiled"])
+        # ---- multi-LoRA serving families (ISSUE 20) ----
+        if s["adapter_tokens"]:
+            b.family(f"{px}_adapter_tokens_total", "counter")
+            for aid in sorted(s["adapter_tokens"]):
+                b.sample(f"{px}_adapter_tokens_total",
+                         s["adapter_tokens"][aid], {"adapter": aid})
+            b.family(f"{px}_adapter_swaps_total", "counter")
+            b.sample(f"{px}_adapter_swaps_total", s["adapter_swaps"])
+            b.family(f"{px}_adapter_rollbacks_total", "counter")
+            b.sample(f"{px}_adapter_rollbacks_total",
+                     s["adapter_rollbacks"])
         # ---- tiered KV cache families (ISSUE 19) ----
         if s["host_kv"] is not None:
             hk = s["host_kv"]

@@ -136,10 +136,12 @@ class InProcessReplica:
 
     # -- routing inputs --
 
-    def prefix_probe(self, prompt, tenant: Optional[str] = None) -> int:
+    def prefix_probe(self, prompt, tenant: Optional[str] = None,
+                     adapter: Optional[str] = None) -> int:
         if self.crashed:
             return 0
-        return self.engine.prefix_probe(prompt, tenant=tenant)
+        return self.engine.prefix_probe(prompt, tenant=tenant,
+                                        adapter=adapter)
 
     def inflight_tokens(self) -> int:
         if self.crashed:
@@ -292,7 +294,8 @@ class RouterHandle:
                  eos_token_id: Optional[int], slo: str, tenant: str,
                  rid: str, seq: int, deadline_abs: Optional[float],
                  sampling: Optional[SamplingParams] = None,
-                 logprobs: bool = False):
+                 logprobs: bool = False,
+                 adapter: Optional[str] = None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.eos_token_id = eos_token_id
@@ -302,6 +305,10 @@ class RouterHandle:
         self.sampling = sampling            # per-request seeded sampling
         #                                     params (ISSUE 18); carried
         #                                     across failovers unchanged
+        self.adapter = adapter              # LoRA adapter id (ISSUE 20);
+        #                                     carried across failovers so
+        #                                     the survivor decodes through
+        #                                     the same bank row
         self.future: Future = Future()
         self.ttft_ms: Optional[float] = None
         self.failovers = 0                  # replica deaths survived
@@ -401,7 +408,8 @@ class RouterHandle:
                     tenant=self.tenant, rid=self.rid,
                     sampling=self.sampling,
                     sample_offset=int(self._prefix.size),
-                    logprobs=self.want_logprobs)
+                    logprobs=self.want_logprobs,
+                    adapter=self.adapter)
         # disaggregation (ISSUE 19): attach the staged KV row when it
         # still covers exactly prompt'.size - 1 tokens (the one-token-
         # prefill invariant); anything else means tokens were emitted
@@ -502,7 +510,8 @@ class ReplicaRouter:
                tenant: Optional[str] = None,
                rid: Optional[str] = None,
                sampling: Optional[SamplingParams] = None,
-               logprobs: bool = False) -> RouterHandle:
+               logprobs: bool = False,
+               adapter: Optional[str] = None) -> RouterHandle:
         """Admit one prompt to the fleet. Raises RejectedError with
         reason `fleet_unavailable` when every replica is quarantined,
         `shed` when the fleet is degraded past the shed fraction and the
@@ -513,7 +522,10 @@ class ReplicaRouter:
         a seeded stream stays bit-identical across replica deaths.
         `logprobs` (ISSUE 19) surfaces the model's per-token logprob for
         every emitted token on `logprobs_so_far()`, stitched across
-        failovers and handoffs like the tokens themselves."""
+        failovers and handoffs like the tokens themselves. `adapter`
+        (ISSUE 20) decodes the stream through that LoRA bank row on
+        whichever replica accepts it — the id rides the handle, so a
+        failover resubmits it and the survivor restores the adapter."""
         if sampling is not None:
             sampling.validate()
         ecfg = self.replicas[0].engine.config
@@ -563,7 +575,8 @@ class ReplicaRouter:
                     retry_after_s=self.config.retry_after_s)
             handle = RouterHandle(prompt, mnt, eos, slo, tenant, rid,
                                   self._seq, deadline_abs,
-                                  sampling=sampling, logprobs=logprobs)
+                                  sampling=sampling, logprobs=logprobs,
+                                  adapter=adapter)
             self._seq += 1
             replica, last_exc = self._place_locked(handle, now)
             if replica is None:
@@ -639,7 +652,8 @@ class ReplicaRouter:
 
         ranked = sorted(
             ((role_rank(r),
-              -(r.prefix_probe(args["prompt"], tenant=handle.tenant)),
+              -(r.prefix_probe(args["prompt"], tenant=handle.tenant,
+                               adapter=handle.adapter)),
               r.inflight_tokens(), r.index, r)
              for r in self._candidates_locked()
              if pinned is None or r.weight_version == pinned),

@@ -113,6 +113,11 @@ def pytest_configure(config):
         "(host-RAM spill/onboard round trips, prefill→decode handoff "
         "bit-identity, per-token logprobs; ISSUE 19); select with "
         "-m tiered")
+    config.addinivalue_line(
+        "markers", "lora: multi-LoRA fine-tune-and-serve tests (adapter "
+        "injection/training, per-slot bank indirection in the unified "
+        "step, hot swap/rollback, adapter KV namespaces; ISSUE 20); "
+        "select with -m lora")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -149,5 +154,9 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.serving)
         if mod == "test_tiered":
             item.add_marker(pytest.mark.tiered)
+            item.add_marker(pytest.mark.llm)
+            item.add_marker(pytest.mark.serving)
+        if mod == "test_lora":
+            item.add_marker(pytest.mark.lora)
             item.add_marker(pytest.mark.llm)
             item.add_marker(pytest.mark.serving)

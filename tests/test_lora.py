@@ -1,0 +1,646 @@
+"""Multi-LoRA fine-tune-and-serve loop (ISSUE 20).
+
+Contracts under test. **Tuning:** `inject_lora` freezes every base
+parameter bitwise and trains ONLY the low-rank adapter leaves — a CPU
+fine-tune moves the loss while the base weights stay byte-identical,
+and `functional_state()` yields an adapter-only params tree (what the
+async checkpoint ring snapshots during LoRA fine-tuning). **Serving:**
+the `AdapterBank` threads K stacked adapter trees through the ONE
+fixed-width jitted unified step via a per-slot `adapter_idx` lane —
+`adapter=None` slots ride the all-zeros row 0 bit-identical to the
+pre-LoRA engine, a mixed batch of several adapters matches each
+adapter's solo decode token-for-token, and adapter load/hot-swap/unload
+never recompiles. **Isolation & lifecycle:** per-adapter KV namespaces
+`(tenant, adapter)`, typed admission refusals, adapter-scoped fault
+blame, hot-swap canary with fleet auto-rollback, and failover that
+restores the adapter on the survivor bit-identically.
+
+Scheduler tests drive the PRODUCTION pump under a SimClock. The
+heavyweight end-to-end scenarios (fine-tune loop, fleet rollouts,
+fault-matrix rows) are `slow`-marked to keep tier-1 inside its time
+budget — `tools/check_fault_matrix.py` collects and runs them by the
+`fault_matrix` marker regardless."""
+import os
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def gpt_tiny():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    paddle.seed(0)
+    return GPTForCausalLM.from_preset("gpt2-tiny")
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    from paddle_tpu.utils.fault_injection import set_global_plan
+    set_global_plan(None)
+    yield
+    set_global_plan(None)
+
+
+def _mk_tree(model, seed, rank=4, scale=0.3):
+    """A synthetic adapter in the bank's canonical layout: random A AND
+    nonzero B (a fresh-trained adapter has B=0 → zero delta; tests need
+    deltas that actually flip greedy tokens)."""
+    from paddle_tpu.tuning import target_sites
+    sites, _arch = target_sites(model)
+    r = np.random.RandomState(seed)
+    return {
+        str(i): {name: {"A": (scale * r.randn(rank, io[0])
+                              ).astype(np.float32),
+                        "B": (scale * r.randn(io[1], rank)
+                              ).astype(np.float32)}
+                 for name, io in layer.items()}
+        for i, layer in enumerate(sites)}
+
+
+def _armed(gpt_tiny, clock, **cfg_kw):
+    from paddle_tpu import serving
+    kw = dict(num_slots=4, block_len=8, n_blocks=8, max_queue_depth=64,
+              max_adapters=3, lora_rank=4)
+    kw.update(cfg_kw)
+    return serving.LLMEngine(gpt_tiny, serving.LLMEngineConfig(**kw),
+                             clock=clock)
+
+
+def _drive(eng, clock, dt=0.01, max_steps=2000):
+    steps = 0
+    while eng.has_work():
+        clock.advance(dt)
+        eng.pump()
+        steps += 1
+        assert steps < max_steps, "engine failed to converge"
+
+
+def _drive_router(router, clock, dt=0.01, max_steps=4000):
+    steps = 0
+    while router.has_work():
+        clock.advance(dt)
+        router.pump()
+        steps += 1
+        assert steps < max_steps, "router failed to converge"
+
+
+def _reference(gpt_tiny, prompt, max_new_tokens):
+    from paddle_tpu.models.generation import generate
+    out = np.asarray(generate(gpt_tiny, np.asarray(prompt)[None, :],
+                              max_new_tokens=max_new_tokens))
+    return out[0, np.asarray(prompt).size:]
+
+
+def _solo_adapter_decode(gpt_tiny, clock, tree, prompt, max_new, aid="solo"):
+    """Oracle: a fresh armed engine decoding ONE stream through `tree`."""
+    eng = _armed(gpt_tiny, clock)
+    eng.register_adapter(aid, tree)
+    h = eng.submit(np.asarray(prompt, np.int32), max_new_tokens=max_new,
+                   adapter=aid)
+    _drive(eng, clock)
+    return h.result(timeout=0)
+
+
+# ---- tuning: train the adapter, freeze the base ----
+
+@pytest.mark.slow
+def test_lora_finetune_moves_loss_base_bitwise_frozen():
+    """A few SGD steps on `lora_parameters` reduce the causal-LM loss;
+    every base weight is BITWISE untouched (frozen, not merely small-
+    gradient), and only lora_A/lora_B moved."""
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    from paddle_tpu.tuning import LoRAConfig, inject_lora, lora_parameters
+
+    paddle.seed(7)
+    model = GPTForCausalLM.from_preset("gpt2-tiny")
+    base_before = {n: np.array(p.numpy(), copy=True)
+                   for n, p in model.named_parameters()}
+    inject_lora(model, LoRAConfig(rank=4, alpha=8.0))
+    params = lora_parameters(model)
+    assert params and all(p.trainable for p in params)
+
+    opt = optimizer.SGD(learning_rate=0.1, parameters=params)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randint(
+        1, model.config.vocab_size, size=(2, 8)).astype(np.int64))
+    labels = paddle.to_tensor(rng.randint(
+        1, model.config.vocab_size, size=(2, 8)).astype(np.int64))
+    losses = []
+    for _ in range(3):
+        loss = model(x, labels=labels)
+        losses.append(float(loss.numpy()))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < losses[0], losses
+
+    moved = 0
+    for n, p in model.named_parameters():
+        cur = np.asarray(p.numpy())
+        if "lora_" in n:
+            if not np.array_equal(cur, np.zeros_like(cur)):
+                moved += 1
+            continue
+        # injection re-homes a wrapped Linear's params under `.base.`
+        key = n.replace(".base.", ".") if n.replace(".base.", ".") in \
+            base_before else n
+        np.testing.assert_array_equal(
+            cur, base_before[key], err_msg=f"base weight {n} moved")
+    assert moved > 0, "no adapter leaf moved during fine-tune"
+
+
+def test_adapter_state_roundtrip_and_signature():
+    """adapter_state_dict → load_adapter_state is bitwise; the signature
+    pins arch/layers/rank/targets/dims."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    from paddle_tpu.tuning import (LoRAConfig, adapter_signature,
+                                   adapter_state_dict, inject_lora,
+                                   load_adapter_state)
+
+    paddle.seed(3)
+    m1 = GPTForCausalLM.from_preset("gpt2-tiny")
+    inject_lora(m1, LoRAConfig(rank=4))
+    # give the adapter nonzero content so the round trip is meaningful
+    rng = np.random.RandomState(1)
+    for _, p in m1.named_parameters():
+        if p.trainable:
+            p.set_value(rng.randn(*p.shape).astype(np.float32))
+    tree = adapter_state_dict(m1)
+
+    paddle.seed(3)
+    m2 = GPTForCausalLM.from_preset("gpt2-tiny")
+    inject_lora(m2, LoRAConfig(rank=4))
+    load_adapter_state(m2, tree)
+    for (n1, p1), (n2, p2) in zip(m1.named_parameters(),
+                                  m2.named_parameters()):
+        assert n1 == n2
+        np.testing.assert_array_equal(p1.numpy(), p2.numpy())
+
+    sig = adapter_signature(m1, 4)
+    assert sig["arch"] == "gpt" and sig["rank"] == 4
+    assert sig["num_layers"] == len(tree)
+    assert sorted(sig["targets"]) == sorted(next(iter(tree.values())))
+
+
+def test_functional_state_params_are_adapter_only():
+    """The async-checkpoint pin: after inject_lora, `functional_state()`
+    params = ONLY the trainable lora leaves (2 per site per layer), so
+    the snapshot ring copies kilobytes, not the base model."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    from paddle_tpu.tuning import LoRAConfig, inject_lora, target_sites
+
+    paddle.seed(5)
+    model = GPTForCausalLM.from_preset("gpt2-tiny")
+    inject_lora(model, LoRAConfig(rank=4))
+    sites, _ = target_sites(model)
+    params, buffers = model.functional_state()
+    assert len(params) == 2 * sum(len(s) for s in sites)
+    assert all("lora_" in k for k in params)
+    assert buffers, "base weights must ride the buffers tree"
+
+
+# ---- serving: the bank in the unified step ----
+
+@pytest.mark.lora
+def test_base_slots_bit_identical_on_armed_engine(gpt_tiny):
+    """adapter=None streams on a bank-armed engine ride row 0 (exact-
+    zero delta) and match the pre-LoRA greedy generate() bitwise."""
+    from paddle_tpu import serving
+    clock = serving.SimClock()
+    eng = _armed(gpt_tiny, clock)
+    eng.register_adapter("ad1", _mk_tree(gpt_tiny, 1))  # bank non-empty
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 500, size=(6,)).astype(np.int32)
+               for _ in range(3)]
+    handles = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    _drive(eng, clock)
+    for p, h in zip(prompts, handles):
+        np.testing.assert_array_equal(h.result(timeout=0),
+                                      _reference(gpt_tiny, p, 8))
+    eng.stop()
+
+
+@pytest.mark.lora
+@pytest.mark.slow
+def test_mixed_adapter_batch_matches_solo_decode(gpt_tiny):
+    """One dispatch-width batch mixing base + 2 different adapters over
+    the SAME prompt: every stream matches its solo-decode oracle
+    token-for-token (the gathered per-row delta never bleeds across
+    slots), and the adapter streams actually diverge from base."""
+    from paddle_tpu import serving
+    clock = serving.SimClock()
+    trees = {f"ad{i}": _mk_tree(gpt_tiny, i) for i in (1, 2)}
+    prompt = np.random.RandomState(4).randint(
+        1, 500, size=(6,)).astype(np.int32)
+
+    solo = {aid: _solo_adapter_decode(gpt_tiny, clock, t, prompt, 8,
+                                      aid=aid)
+            for aid, t in trees.items()}
+
+    eng = _armed(gpt_tiny, clock)
+    for aid, t in trees.items():
+        eng.register_adapter(aid, t)
+    hb = eng.submit(prompt, max_new_tokens=8)
+    ha = {aid: eng.submit(prompt, max_new_tokens=8, adapter=aid)
+          for aid in trees}
+    _drive(eng, clock)
+    base_out = hb.result(timeout=0)
+    np.testing.assert_array_equal(base_out, _reference(gpt_tiny, prompt, 8))
+    diverged = 0
+    for aid in trees:
+        out = ha[aid].result(timeout=0)
+        np.testing.assert_array_equal(
+            out, solo[aid], err_msg=f"{aid}: mixed != solo")
+        diverged += int(not np.array_equal(out, base_out))
+    assert diverged > 0, "no adapter changed a single greedy token"
+    eng.stop()
+
+
+@pytest.mark.lora
+def test_adapter_churn_zero_recompiles(gpt_tiny):
+    """Register / hot-swap / unload adapters across decode waves: the
+    bank only rewrites operand VALUES, so the warm unified-step
+    executable is reused — zero post-warmup recompiles."""
+    from paddle_tpu import serving
+    from paddle_tpu.obs.compile_observatory import compile_observatory
+    obs = compile_observatory()
+    obs.reset()
+    try:
+        clock = serving.SimClock()
+        eng = _armed(gpt_tiny, clock, observatory=True)
+        eng.register_adapter("ad1", _mk_tree(gpt_tiny, 1))
+        prompt = np.arange(1, 7, dtype=np.int32)
+        h = eng.submit(prompt, max_new_tokens=4, adapter="ad1")
+        _drive(eng, clock)
+        h.result(timeout=0)
+        obs.mark_warm()
+
+        eng.register_adapter("ad2", _mk_tree(gpt_tiny, 2))   # fresh load
+        eng.register_adapter("ad1", _mk_tree(gpt_tiny, 9))   # hot swap
+        hs = [eng.submit(prompt, max_new_tokens=4, adapter=a)
+              for a in ("ad1", "ad2", None)]
+        _drive(eng, clock)
+        for h in hs:
+            assert h.result(timeout=0).size == 4
+        eng.unregister_adapter("ad2")
+        h = eng.submit(prompt, max_new_tokens=4, adapter="ad1")
+        _drive(eng, clock)
+        h.result(timeout=0)
+        assert obs.recompiles == 0
+        eng.stop()
+    finally:
+        obs.reset()
+
+
+@pytest.mark.lora
+def test_adapter_kv_namespaces_probe_and_scoped_flush(gpt_tiny):
+    """Prefix KV is keyed `(tenant, adapter)`: an adapter's warm blocks
+    never serve base (or another adapter's) admissions, and a hot swap
+    flushes EXACTLY that adapter's namespaces — base stays warm."""
+    from paddle_tpu import serving
+    clock = serving.SimClock()
+    eng = _armed(gpt_tiny, clock, block_len=4, n_blocks=16)
+    eng.register_adapter("ad1", _mk_tree(gpt_tiny, 1))
+    eng.register_adapter("ad2", _mk_tree(gpt_tiny, 2))
+    prompt = np.arange(1, 14, dtype=np.int32)   # 3 full blocks + tail
+    for ad in (None, "ad1", "ad2"):
+        h = eng.submit(prompt, max_new_tokens=2, adapter=ad,
+                       tenant="acme")
+        _drive(eng, clock)
+        h.result(timeout=0)
+    assert eng.prefix_probe(prompt, tenant="acme") > 0
+    assert eng.prefix_probe(prompt, tenant="acme", adapter="ad1") > 0
+    assert eng.prefix_probe(prompt, tenant="acme", adapter="ad2") > 0
+    # namespaces don't alias: an unknown adapter id probes cold
+    assert eng.prefix_probe(prompt, tenant="acme", adapter="other") == 0
+
+    eng.register_adapter("ad1", _mk_tree(gpt_tiny, 9))   # hot swap
+    assert eng.prefix_probe(prompt, tenant="acme", adapter="ad1") == 0, \
+        "swapped adapter's stale KV must be flushed"
+    assert eng.prefix_probe(prompt, tenant="acme") > 0, \
+        "base namespace must survive an adapter swap"
+    assert eng.prefix_probe(prompt, tenant="acme", adapter="ad2") > 0, \
+        "sibling adapter's namespace must survive the swap"
+    eng.stop()
+
+
+@pytest.mark.lora
+def test_typed_adapter_rejects(gpt_tiny):
+    """Admission and lifecycle refusals are typed: adapter_unavailable
+    (no bank), unknown_adapter, bank_full, rank_mismatch, and
+    adapter_in_use on unregister with live streams."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving.llm.lora import AdapterError
+    clock = serving.SimClock()
+    prompt = np.arange(1, 5, dtype=np.int32)
+
+    plain = serving.LLMEngine(
+        gpt_tiny, serving.LLMEngineConfig(num_slots=2, block_len=8,
+                                          n_blocks=4), clock=clock)
+    with pytest.raises(serving.RejectedError) as exc:
+        plain.submit(prompt, max_new_tokens=2, adapter="ad1")
+    assert exc.value.reason == "adapter_unavailable"
+
+    eng = _armed(gpt_tiny, clock, max_adapters=1)
+    with pytest.raises(serving.RejectedError) as exc:
+        eng.submit(prompt, max_new_tokens=2, adapter="nope")
+    assert exc.value.reason == "unknown_adapter"
+
+    eng.register_adapter("ad1", _mk_tree(gpt_tiny, 1))
+    with pytest.raises(AdapterError) as aexc:
+        eng.register_adapter("ad2", _mk_tree(gpt_tiny, 2))
+    assert aexc.value.reason == "bank_full"
+    with pytest.raises(AdapterError) as aexc:
+        eng.register_adapter("ad1", _mk_tree(gpt_tiny, 1, rank=8))
+    assert aexc.value.reason in ("rank_mismatch", "adapter_mismatch")
+
+    h = eng.submit(prompt, max_new_tokens=16, adapter="ad1")
+    clock.advance(0.01)
+    eng.pump()                      # stream is now live on the row
+    with pytest.raises(AdapterError) as aexc:
+        eng.unregister_adapter("ad1")
+    assert aexc.value.reason == "adapter_in_use"
+    _drive(eng, clock)
+    h.result(timeout=0)
+    eng.unregister_adapter("ad1")   # idle now: unload succeeds
+    assert eng.adapter_bank.row_of("ad1") is None
+    eng.stop()
+
+
+# ---- fault matrix ----
+
+@pytest.mark.lora
+@pytest.mark.slow
+@pytest.mark.fault_matrix
+def test_poisoned_adapter_stream_quarantined_without_evicting_others(
+        gpt_tiny):
+    """poison_request@1:adapter fires only on adapter-kind dispatches
+    carrying submit-index 1: that ONE adapter stream is quarantined
+    (typed 'poisoned') while the co-scheduled base stream and the
+    OTHER adapter's stream finish bit-identical to their oracles."""
+    from paddle_tpu import serving
+    from paddle_tpu.utils.fault_injection import FaultPlan
+
+    clock = serving.SimClock()
+    trees = {"ad1": _mk_tree(gpt_tiny, 1), "ad2": _mk_tree(gpt_tiny, 2)}
+    prompt = np.random.RandomState(6).randint(
+        1, 500, size=(6,)).astype(np.int32)
+    solo2 = _solo_adapter_decode(gpt_tiny, clock, trees["ad2"], prompt, 6,
+                                 aid="ad2")
+
+    plan = FaultPlan.from_spec("poison_request@1:adapter")
+    eng = serving.LLMEngine(
+        gpt_tiny,
+        serving.LLMEngineConfig(num_slots=4, block_len=8, n_blocks=8,
+                                max_queue_depth=64, max_adapters=3,
+                                lora_rank=4),
+        clock=clock, fault_plan=plan)
+    for aid, t in trees.items():
+        eng.register_adapter(aid, t)
+    base = eng.submit(prompt, max_new_tokens=6)                 # idx 0
+    poisoned = eng.submit(prompt, max_new_tokens=6, adapter="ad1")  # 1
+    other = eng.submit(prompt, max_new_tokens=6, adapter="ad2")     # 2
+    _drive(eng, clock)
+
+    with pytest.raises(serving.DispatchFailedError) as exc:
+        poisoned.result(timeout=0)
+    assert exc.value.reason == "poisoned"
+    np.testing.assert_array_equal(base.result(timeout=0),
+                                  _reference(gpt_tiny, prompt, 6))
+    np.testing.assert_array_equal(other.result(timeout=0), solo2)
+    snap = eng.metrics.snapshot()
+    assert snap["quarantined"] == 1 and snap["completed"] == 2
+    assert not eng.broken
+    eng.pool.check_balance()
+    eng.stop()
+
+
+@pytest.mark.lora
+@pytest.mark.slow
+@pytest.mark.fault_matrix
+def test_nan_adapter_swap_canary_rolls_back_fleet(gpt_tiny, tmp_path,
+                                                  monkeypatch):
+    """A NaN-poisoned (yet CRC-certified) adapter hot-swap is caught by
+    the per-replica adapter canary and the fleet auto-rolls the row
+    back: `adapter_swap` precedes `adapter_rollback` per replica in the
+    flight record, streams admitted before the rollout finish on the
+    ORIGINAL adapter bit-identically (zero dropped), and base weights
+    were never touched. A good set then rolls out cleanly on the SAME
+    fleet (no drain, canary on both replicas, record `completed`)."""
+    from paddle_tpu import serving
+    from paddle_tpu.checkpoint import AdapterWeightSet
+    from paddle_tpu.obs.flight_recorder import flight_recorder
+
+    monkeypatch.setenv("PDTPU_FLIGHT_DIR", str(tmp_path))
+    flight_recorder().clear()
+    clock = serving.SimClock()
+    good = _mk_tree(gpt_tiny, 1)
+    prompt = np.random.RandomState(8).randint(
+        1, 500, size=(6,)).astype(np.int32)
+    solo = _solo_adapter_decode(gpt_tiny, clock, good, prompt, 8)
+
+    reps = [serving.InProcessReplica(_armed(gpt_tiny, clock), i)
+            for i in range(2)]
+    router = serving.ReplicaRouter(reps)
+    for r in reps:
+        r.engine.register_adapter("helpdesk", good)
+
+    # in-flight adapter + base streams that must survive the rollout
+    h_ad = router.submit(prompt, max_new_tokens=8, adapter="helpdesk")
+    h_b = router.submit(prompt, max_new_tokens=8)
+    for _ in range(3):
+        clock.advance(0.01)
+        router.pump()
+    assert len(h_ad.tokens_so_far()) > 0
+
+    bad = {li: {s: {"A": np.full_like(e["A"], np.nan), "B": e["B"]}
+                for s, e in layer.items()}
+           for li, layer in good.items()}
+    sig = reps[0].engine.adapter_bank.signature
+    ws = AdapterWeightSet.publish(str(tmp_path), "helpdesk-v2", bad, sig)
+    ctrl = serving.DeploymentController(
+        router, serving.DeployConfig(watch_window_s=0.01))
+    rec = ctrl.deploy_adapter(ws, adapter_id="helpdesk")
+    assert rec["outcome"] == "rolled_back"
+    assert rec["reason"].startswith("nonfinite_logits")
+
+    _drive_router(router, clock)
+    np.testing.assert_array_equal(h_ad.result(timeout=0), solo)
+    np.testing.assert_array_equal(h_b.result(timeout=0),
+                                  _reference(gpt_tiny, prompt, 8))
+    # the restored row still serves the ORIGINAL delta
+    h2 = router.submit(prompt, max_new_tokens=8, adapter="helpdesk")
+    _drive_router(router, clock)
+    np.testing.assert_array_equal(h2.result(timeout=0), solo)
+
+    events = flight_recorder().snapshot()["events"]
+    kinds = [e["kind"] for e in events]
+    assert "adapter_deploy_started" in kinds
+    assert "adapter_deploy_rollback" in kinds
+    swaps = [i for i, e in enumerate(events)
+             if e["kind"] == "adapter_swap" and e.get("update")]
+    rollbacks = [i for i, e in enumerate(events)
+                 if e["kind"] == "adapter_rollback"]
+    assert swaps and rollbacks
+    assert min(swaps) < min(rollbacks), \
+        "swap must precede rollback in the flight record"
+    assert len(rollbacks) == len(swaps)
+
+    # happy path on the same fleet: the SAME good tree published as a
+    # certified set rolls out under a fresh adapter id with no drain,
+    # and decodes bit-identical to the solo oracle on both replicas
+    ws2 = AdapterWeightSet.publish(str(tmp_path), "summarize-v1", good,
+                                   sig)
+    rec2 = ctrl.deploy_adapter(ws2)
+    assert rec2["outcome"] == "completed"
+    assert sorted(rec2["swapped"]) == ["replica0", "replica1"]
+    for r in reps:
+        assert r.engine.adapter_bank.row_of("summarize-v1") is not None
+    h3 = router.submit(prompt, max_new_tokens=8, adapter="summarize-v1")
+    _drive_router(router, clock)
+    np.testing.assert_array_equal(h3.result(timeout=0), solo)
+
+
+@pytest.mark.lora
+@pytest.mark.slow
+@pytest.mark.fault_matrix
+def test_replica_crash_mid_adapter_stream_fails_over_bit_identical(
+        gpt_tiny):
+    """A replica hard-crashed MID-adapter-stream: the adapter id rides
+    the RouterHandle, the survivor (same adapter registered) re-prefills
+    through the SAME bank row, and the stream finishes bit-identical to
+    an uninterrupted solo adapter decode."""
+    from paddle_tpu import serving
+    from paddle_tpu.utils.fault_injection import FaultPlan, set_global_plan
+
+    clock = serving.SimClock()
+    tree = _mk_tree(gpt_tiny, 1)
+    prompt = np.random.RandomState(9).randint(
+        1, 500, size=(6,)).astype(np.int32)
+    solo = _solo_adapter_decode(gpt_tiny, clock, tree, prompt, 12)
+
+    reps = [serving.InProcessReplica(_armed(gpt_tiny, clock), i)
+            for i in range(2)]
+    router = serving.ReplicaRouter(reps)
+    for r in reps:
+        r.engine.register_adapter("ad1", tree)
+
+    handles = [router.submit(prompt, max_new_tokens=12, adapter="ad1")
+               for _ in range(2)]          # load-aware: one per replica
+    assert {h._replica.name for h in handles} == {"replica0", "replica1"}
+    for _ in range(5):
+        clock.advance(0.01)
+        router.pump()
+    assert all(len(h.tokens_so_far()) > 0 for h in handles)
+
+    set_global_plan(FaultPlan.from_spec("replica_crash@0"))
+    _drive_router(router, clock)
+    victims = [h for h in handles if h.failovers == 1]
+    assert len(victims) == 1
+    for h in handles:
+        np.testing.assert_array_equal(h.result(timeout=0), solo)
+    snap = router.metrics.snapshot()
+    assert snap["completed"] == 2 and snap["failed"] == 0
+
+
+# ---- certified adapter weight sets + fleet rollout ----
+
+def test_adapter_weightset_certify_for_typed_refusals(gpt_tiny, tmp_path):
+    """AdapterWeightSet: own format string, mandatory signature block,
+    `certify_for` passes on the matching base model and refuses typed
+    (`adapter_mismatch`) on rank / target skew; a plain WeightSet never
+    certifies as an adapter set."""
+    from paddle_tpu.checkpoint import (AdapterWeightSet,
+                                       UncertifiedWeightsError, WeightSet)
+    from paddle_tpu.tuning import adapter_signature
+
+    tree = _mk_tree(gpt_tiny, 1)
+    sig = adapter_signature(gpt_tiny, 4)
+    ws = AdapterWeightSet.publish(str(tmp_path), "ad-v1", tree, sig)
+    manifest = ws.certify_for(sig)
+    assert manifest["format"] == "pdtpu.adapter.v1"
+    assert manifest["adapter"]["rank"] == 4
+
+    wrong = dict(sig, rank=8)
+    with pytest.raises(UncertifiedWeightsError) as exc:
+        ws.certify_for(wrong)
+    assert exc.value.reason == "adapter_mismatch"
+    assert "rank" in str(exc.value)
+
+    # a base-format WeightSet of the same bytes is NOT an adapter set
+    with pytest.raises(UncertifiedWeightsError) as exc:
+        WeightSet(str(tmp_path), "ad-v1").certify()
+    assert exc.value.reason == "bad_format"
+
+    with pytest.raises(ValueError):
+        AdapterWeightSet.publish(str(tmp_path), "ad-v2", tree, None)
+
+
+# ---- economics + observability ----
+
+def test_ledger_adapter_owner_rebucketing():
+    """`adapter_owners` re-buckets the SAME per-row shares by adapter
+    id: per-adapter device seconds sum exactly to the tenant totals of
+    the same dispatches, tokens likewise."""
+    from paddle_tpu.obs.serving_ledger import ServingLedger
+
+    led = ServingLedger()
+    with led.measure("host"):
+        led.book_dispatch(
+            0.10, 4, 6, 16,
+            owners=[("acme", "interactive", 6), ("beta", "batch", 4)],
+            adapter_owners=[("ad1", 6), ("base", 4)])
+        led.book_dispatch(
+            0.05, 0, 10, 16,
+            owners=[("acme", "interactive", 10)],
+            adapter_owners=[("ad1", 4), ("ad2", 6)])
+    snap = led.snapshot()
+    tenants_s = sum(v["device_seconds"] for v in snap["tenants"].values())
+    adapters_s = sum(v["device_seconds"]
+                     for v in snap["adapters"].values())
+    assert abs(tenants_s - adapters_s) < 1e-12
+    assert abs(tenants_s - 0.15) < 1e-12
+    assert snap["adapters"]["ad1"]["tokens"] == 10
+    assert snap["adapters"]["ad2"]["tokens"] == 6
+    assert snap["adapters"]["base"]["tokens"] == 4
+    assert sum(v["tokens"] for v in snap["adapters"].values()) == \
+        sum(v["tokens"] for v in snap["tenants"].values())
+
+
+@pytest.mark.lora
+def test_metrics_adapter_token_families_render(gpt_tiny):
+    """pdtpu_llm_adapter_* families: per-adapter token counters (base
+    rows bucketed as adapter="base") and swap/rollback counters render
+    on the same scrape as the engine families."""
+    from paddle_tpu import serving
+    clock = serving.SimClock()
+    eng = _armed(gpt_tiny, clock)
+    snap0 = eng.register_adapter("ad1", _mk_tree(gpt_tiny, 1))
+    prompt = np.arange(1, 6, dtype=np.int32)
+    hs = [eng.submit(prompt, max_new_tokens=3, adapter="ad1"),
+          eng.submit(prompt, max_new_tokens=3)]
+    _drive(eng, clock)
+    for h in hs:
+        h.result(timeout=0)
+    eng.rollback_adapter("ad1", snap0)     # snap0 None → unload
+    snap = eng.metrics.snapshot()
+    assert snap["adapter_tokens"]["ad1"] == 3
+    assert snap["adapter_tokens"]["base"] == 3
+    text = eng.metrics.render()
+    assert 'pdtpu_llm_adapter_tokens_total{adapter="ad1"} 3' in text
+    assert 'pdtpu_llm_adapter_swaps_total 1' in text
+    assert 'pdtpu_llm_adapter_rollbacks_total 1' in text
+    eng.stop()
+
+
+def test_lora_decode_flops_helper():
+    """Σ 2·r·(in+out) over every adapted site, stdlib arithmetic."""
+    from paddle_tpu.obs.flops import lora_decode_flops_per_token
+    assert lora_decode_flops_per_token(8, [(4, 4), (4, 8)]) == \
+        2 * 8 * 8 + 2 * 8 * 12
+    assert lora_decode_flops_per_token(1, []) == 0.0

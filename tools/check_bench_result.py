@@ -172,7 +172,18 @@ GATE_KEYS = {"mfu": "higher", "serve_qps": "higher", "serve_p99_ms": "lower",
              # queued re-prefill
              "llm_tiered_hit_rate": "higher",
              "llm_onboard_tok_s": "higher",
-             "llm_handoff_ms": "lower"}
+             "llm_handoff_ms": "lower",
+             # ISSUE 20 multi-LoRA gates (`bench.py --llm` lora phase):
+             # one seeded Poisson trace replayed through an UNARMED
+             # engine (base-only) then through an adapter-armed engine
+             # with 8 concurrent adapters round-robined across the
+             # slots. The armed tok/s is a FLOOR, and the armed-vs-base
+             # throughput overhead percent is a CEILING (≤15% at pin
+             # time): the gathered low-rank delta must stay a marginal
+             # cost of the ONE unified step, never a per-adapter
+             # dispatch (llm_lora_base_tok_s rides along ungated)
+             "llm_lora_tok_s": "higher",
+             "llm_lora_overhead_pct": "lower"}
 
 
 def _metrics_of(row):
@@ -197,7 +208,8 @@ def _metrics_of(row):
               "llm_spec_tok_s", "llm_spec_accept_rate",
               "llm_sampled_tok_s", "llm_mask_overhead_pct",
               "llm_tiered_hit_rate", "llm_onboard_tok_s",
-              "llm_handoff_ms"):
+              "llm_handoff_ms",
+              "llm_lora_tok_s", "llm_lora_overhead_pct"):
         if extra.get(k) is not None:
             out[k] = float(extra[k])
     return out

@@ -104,14 +104,27 @@ module: a decode-role replica hard-crashed immediately after accepting
 a prefill→decode handoff re-places the stream's STAGED KV payload on a
 surviving decode replica — one-token prefill, no prompt recompute —
 and the stream finishes bit-identical to an uninterrupted run with the
-destination pool's page ledger balanced) — then prints a
+destination pool's page ledger balanced), and the ISSUE 20 multi-LoRA
+scenarios in tests/test_lora.py (`lora`-marked module: a
+`poison_request@rid:adapter` fault quarantines exactly ONE adapter's
+stream — the adapter-kind solo probe blames it by rid — while
+co-scheduled base and other-adapter rows keep decoding bit-identical;
+a NaN-poisoned adapter hot-swap is caught by the per-replica adapter
+canary and the fleet auto-rolls the bank row back with the
+`adapter_swap` → `adapter_rollback` flight sequence in recorded order
+and zero dropped streams, base weights untouched; and a replica
+hard-crashed MID-ADAPTER-STREAM fails over with the adapter id riding
+the router handle, so the survivor re-prefills through the SAME bank
+row and the stream finishes bit-identical to an uninterrupted
+adapter decode) — then prints a
 pass/fail table. Exit 0 iff every scenario recovered.
 
     python tools/check_fault_matrix.py            # run the matrix
     python tools/check_fault_matrix.py --list     # show scenarios only
 
-tier-1 already picks these up (neither test file is slow-marked);
-this tool is the human/CI-facing view of the same matrix.
+tier-1 picks most of these up directly; the heaviest scenarios (the
+`slow`-marked tests/test_lora.py rows) run only here — collection is
+by the `fault_matrix` marker, never filtered by `slow`.
 """
 from __future__ import annotations
 
@@ -140,6 +153,7 @@ TEST_FILES = [
     os.path.join("tests", "test_spec_decode.py"),
     os.path.join("tests", "test_sampling.py"),
     os.path.join("tests", "test_tiered.py"),
+    os.path.join("tests", "test_lora.py"),
 ]
 
 
